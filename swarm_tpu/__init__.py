@@ -1,0 +1,24 @@
+"""swarm_tpu — a TPU-native distributed scanning framework.
+
+A ground-up re-design of the capabilities of Jec00/swarm (reference:
+``/root/reference``) for TPU hardware:
+
+- The reference's shelled-out scan engines (nmap -sV service detection,
+  nuclei template matching, httpx/httprobe probing — see
+  ``worker/modules/*.json`` in the reference) are replaced by a
+  **fingerprint-match engine**: template corpora compile to flat tensor
+  databases and banner/response batches are matched on-device with
+  jit/vmap XLA kernels (``swarm_tpu.ops``), sharded across chips with
+  ``jax.sharding`` meshes (``swarm_tpu.parallel``).
+- The control plane (server REST API, job queue, chunk blob storage,
+  scan summaries — reference ``server/server.py``) is wire-compatible
+  but rebuilt on embedded stores with lease-based dispatch
+  (``swarm_tpu.server``, ``swarm_tpu.stores``).
+- The worker (reference ``worker/worker.py``) keeps the poll loop and
+  module registry but adds a ``tpu`` backend that batches chunk rows
+  onto the device (``swarm_tpu.worker``).
+- Host-side network I/O (the one thing XLA cannot do) lives in a native
+  C++ front-end (``native/``), bound via ctypes.
+"""
+
+__version__ = "0.1.0"

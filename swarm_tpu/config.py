@@ -1,0 +1,130 @@
+"""One typed config layer for server, worker and client.
+
+Replaces the reference's three ad-hoc config mechanisms — server-side
+module constants (``server/server.py:18-45``), worker argparse-from-env
+(``worker/worker.py:131-140``), and the client's ``~/.axiom.json``
+(``client/swarm:84-92``) — with a single dataclass resolved from, in
+increasing precedence: defaults → config file → environment → explicit
+overrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+DEFAULT_CONFIG_FILE = "~/.swarm_tpu.json"
+# Also honored for client compatibility with the reference CLI's config.
+LEGACY_CONFIG_FILE = "~/.axiom.json"
+
+_ENV_PREFIX = "SWARM_"
+# Reference worker env names (worker/Dockerfile:20-21) honored as aliases.
+_ENV_ALIASES = {
+    "server_url": ["SERVER_URL"],
+    "api_key": ["API_KEY"],
+    "worker_id": ["WORKER_ID"],
+}
+
+
+@dataclasses.dataclass
+class Config:
+    # --- control plane ---
+    server_url: str = "http://127.0.0.1:5001"
+    api_key: str = "CHANGE_THIS"
+    host: str = "0.0.0.0"
+    port: int = 5001
+
+    # --- stores (embedded by default; URLs switch to real backends) ---
+    state_backend: str = "memory"  # "memory" | "redis"
+    redis_url: str = "redis://127.0.0.1:6379/0"
+    blob_backend: str = "local"  # "local" | "s3"
+    blob_root: str = "uploads"  # local blob directory (doubles as S3 layout)
+    s3_bucket: str = "bucket_name"
+    doc_backend: str = "local"  # "local" | "mongo"
+    doc_root: str = "docdb"
+    mongo_url: str = "mongodb://localhost:27017"
+    mongo_db: str = "asm"
+
+    # --- worker ---
+    worker_id: str = "worker-0"
+    poll_interval_idle_s: float = 10.0
+    poll_interval_busy_s: float = 0.8
+    modules_dir: str = "modules"
+    max_jobs: int = 0  # 0 = unlimited (the reference accepted but ignored this)
+
+    # --- dispatch leases (new vs reference: requeue-on-expiry) ---
+    lease_seconds: float = 600.0
+    max_attempts: int = 3
+
+    # --- fleet orchestration ---
+    fleet_provider: str = "null"  # "null" | "digitalocean" | "process"
+    fleet_api_token: str = ""
+    fleet_rate_limit_per_min: int = 250
+    fleet_region: str = "nyc3"
+    fleet_size: str = "s-1vcpu-1gb"
+    fleet_image: str = ""
+    idle_polls_before_teardown: int = 15
+
+    # --- TPU engine ---
+    templates_dir: str = ""
+    engine_batch_rows: int = 2048
+    engine_row_width: int = 1024
+    mesh_data_axis: int = 0  # 0 = all available devices on the data axis
+
+    def resolve_url(self) -> str:
+        return self.server_url.rstrip("/")
+
+    @classmethod
+    def load(
+        cls,
+        path: Optional[str] = None,
+        env: Optional[dict[str, str]] = None,
+        **overrides: Any,
+    ) -> "Config":
+        env = os.environ if env is None else env
+        values: dict[str, Any] = {}
+
+        if path:
+            # An explicitly supplied config must load — a typo'd path or
+            # malformed JSON silently falling back to defaults would start
+            # the server with the placeholder API key.
+            values.update(json.loads(Path(path).expanduser().read_text()))
+        else:
+            for candidate in (DEFAULT_CONFIG_FILE, LEGACY_CONFIG_FILE):
+                p = Path(candidate).expanduser()
+                if p.is_file():
+                    try:
+                        values.update(json.loads(p.read_text()))
+                    except (json.JSONDecodeError, OSError):
+                        pass
+                    break
+
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        for name, field in fields.items():
+            env_keys = [_ENV_PREFIX + name.upper()] + _ENV_ALIASES.get(name, [])
+            for key in env_keys:
+                if key in env:
+                    values[name] = env[key]
+                    break
+
+        values.update({k: v for k, v in overrides.items() if v is not None})
+
+        coerced: dict[str, Any] = {}
+        for name, value in values.items():
+            field = fields.get(name)
+            if field is None:
+                continue
+            if field.type in ("int", int) and not isinstance(value, int):
+                value = int(value)
+            elif field.type in ("float", float) and not isinstance(value, float):
+                value = float(value)
+            coerced[name] = value
+        return cls(**coerced)
+
+    def save(self, path: Optional[str] = None) -> Path:
+        p = Path(path or DEFAULT_CONFIG_FILE).expanduser()
+        p.write_text(json.dumps(dataclasses.asdict(self), indent=4))
+        return p

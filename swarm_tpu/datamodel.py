@@ -1,0 +1,248 @@
+"""Core data model: scans, jobs, chunks, workers.
+
+Identifier formats and the status taxonomy follow the reference wire
+protocol so the reference client works against this server unchanged:
+
+- scan ids are ``<module>_<unix-ts>`` (reference ``server/server.py:181-183``)
+- job ids are ``<scan_id>_<chunk_index>`` (reference ``server/server.py:441``)
+- job statuses walk ``queued → in progress → starting → downloading →
+  executing → uploading → complete`` with terminal failure statuses
+  ``cmd failed`` / ``upload failed - *`` (reference ``server/server.py:454,485``,
+  ``worker/worker.py:61-108``).
+
+On top of the reference's model this adds *leases*: a dispatched job
+carries a lease deadline and is requeued when the lease expires without
+a state transition (the reference loses jobs whose worker dies —
+``SURVEY.md §5``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Iterator, Optional
+
+
+class JobStatus:
+    """Status taxonomy, wire-identical to the reference."""
+
+    QUEUED = "queued"
+    IN_PROGRESS = "in progress"
+    STARTING = "starting"
+    DOWNLOADING = "downloading"
+    EXECUTING = "executing"
+    UPLOADING = "uploading"
+    COMPLETE = "complete"
+    CMD_FAILED = "cmd failed"
+    UPLOAD_FAILED_NOT_FOUND = "upload failed - file not found"
+    UPLOAD_FAILED_CREDENTIALS = "upload failed - credentials"
+    UPLOAD_FAILED_UNKNOWN = "upload failed - unknown"
+
+    TERMINAL = frozenset(
+        {
+            COMPLETE,
+            CMD_FAILED,
+            UPLOAD_FAILED_NOT_FOUND,
+            UPLOAD_FAILED_CREDENTIALS,
+            UPLOAD_FAILED_UNKNOWN,
+        }
+    )
+    FAILED = frozenset(TERMINAL - {COMPLETE})
+    ALL = frozenset(
+        {
+            QUEUED,
+            IN_PROGRESS,
+            STARTING,
+            DOWNLOADING,
+            EXECUTING,
+            UPLOADING,
+        }
+        | TERMINAL
+    )
+
+
+class WorkerStatus:
+    """Worker liveness states (reference ``server/server.py:489-507``)."""
+
+    ACTIVE = "active"
+    PENDING = "pending"
+    INACTIVE = "inactive"
+
+
+def generate_scan_id(module: str, timestamp: Optional[int] = None) -> str:
+    """``<module>_<unix-ts>`` — reference ``server/server.py:181-183``."""
+    ts = int(time.time()) if timestamp is None else int(timestamp)
+    return f"{module}_{ts}"
+
+
+def job_id_for(scan_id: str, chunk_index: int) -> str:
+    """``<scan_id>_<chunk_index>`` — reference ``server/server.py:441``."""
+    return f"{scan_id}_{chunk_index}"
+
+
+def parse_job_id(job_id: str) -> tuple[str, int]:
+    """Split a job id back into ``(scan_id, chunk_index)``.
+
+    The reference client splits on ``_`` and assumes exactly three parts
+    (``client/swarm:58-63``); this version is robust to modules whose
+    names themselves contain underscores by splitting from the right.
+    """
+    scan_id, _, idx = job_id.rpartition("_")
+    return scan_id, int(idx)
+
+
+def parse_scan_id(scan_id: str) -> tuple[str, int]:
+    """Split ``<module>_<ts>`` into ``(module, started_ts)``."""
+    module, _, ts = scan_id.rpartition("_")
+    return module, int(ts)
+
+
+def chunk_input_key(scan_id: str, chunk_index: int) -> str:
+    """Blob key for an input chunk (reference ``server/server.py:446``)."""
+    return f"{scan_id}/input/chunk_{chunk_index}.txt"
+
+
+def chunk_output_key(scan_id: str, chunk_index: int) -> str:
+    """Blob key for an output chunk (reference ``worker/worker.py:96``)."""
+    return f"{scan_id}/output/chunk_{chunk_index}.txt"
+
+
+def chunk_generator(sequence: list, batch_size: int) -> Iterator[list]:
+    """Split a target list into fixed-size chunks.
+
+    Mirrors reference ``server/server.py:185-187``; a chunk is the unit
+    of dispatch, checkpointing and (on the TPU path) device sharding.
+    ``batch_size <= 0`` means one whole-sequence chunk (the reference
+    normalizes 0 the same way in ``server/server.py:434-435``).
+    """
+    batch_size = int(batch_size)
+    if batch_size <= 0:
+        batch_size = max(1, len(sequence))
+    for i in range(0, len(sequence), batch_size):
+        yield sequence[i : i + batch_size]
+
+
+@dataclasses.dataclass
+class Job:
+    """One chunk of a scan, dispatched to exactly one worker at a time.
+
+    Field names match the reference's Redis job hash payload
+    (``server/server.py:198-205``) so serialized jobs are wire-identical;
+    ``lease_expires_at`` is an addition (absent fields are simply extra
+    keys to the reference client, which ignores unknown keys).
+    """
+
+    job_id: str
+    scan_id: str
+    chunk_index: int
+    module: str
+    status: str = JobStatus.QUEUED
+    worker_id: Optional[str] = None
+    started_at: Optional[float] = None
+    completed_at: Optional[float] = None
+    lease_expires_at: Optional[float] = None
+    attempts: int = 0
+
+    @classmethod
+    def create(cls, scan_id: str, chunk_index: int, module: str) -> "Job":
+        return cls(
+            job_id=job_id_for(scan_id, chunk_index),
+            scan_id=scan_id,
+            chunk_index=chunk_index,
+            module=module,
+        )
+
+    def to_wire(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, payload: dict[str, Any]) -> "Job":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        known = {k: v for k, v in payload.items() if k in fields}
+        known.setdefault("job_id", job_id_for(payload["scan_id"], payload["chunk_index"]))
+        return cls(**known)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_wire())
+
+    @classmethod
+    def from_json(cls, blob: str | bytes) -> "Job":
+        return cls.from_wire(json.loads(blob))
+
+
+@dataclasses.dataclass
+class WorkerInfo:
+    """Per-worker liveness record (reference ``server/server.py:471-508``)."""
+
+    worker_id: str
+    last_contact: Optional[float] = None
+    polls_with_no_jobs: int = 0
+    status: str = WorkerStatus.ACTIVE
+
+    def to_wire(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d.pop("worker_id")
+        return d
+
+    @classmethod
+    def from_wire(cls, worker_id: str, payload: dict[str, Any]) -> "WorkerInfo":
+        fields = {f.name for f in dataclasses.fields(cls)} - {"worker_id"}
+        return cls(worker_id=worker_id, **{k: v for k, v in payload.items() if k in fields})
+
+
+@dataclasses.dataclass
+class ScanSummary:
+    """Per-scan rollup (reference ``server/server.py:239-294``)."""
+
+    scan_id: str
+    total_chunks: int = 0
+    chunks_complete: int = 0
+    percent_complete: float = 0.0
+    workers: list = dataclasses.field(default_factory=list)
+    module: Optional[str] = None
+    scan_started: Optional[int] = None
+    scan_completed: Optional[float] = None
+    completed_at: Optional[float] = None
+    scan_time: Optional[float] = None
+    scan_status: Optional[str] = None
+    average_scan_time: Optional[float] = None
+
+    def to_wire(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def rollup_scans(jobs: dict[str, dict]) -> list[dict]:
+    """Collate per-job records into per-scan summaries.
+
+    Behavior-parity with reference ``server/server.py:239-302``: chunk
+    totals, completion percentage, distinct workers, scan_started parsed
+    from the scan id timestamp, completed_at = max job completed_at.
+    """
+    scans: dict[str, ScanSummary] = {}
+    for job in jobs.values():
+        scan_id = job.get("scan_id")
+        summary = scans.get(scan_id)
+        if summary is None:
+            summary = scans[scan_id] = ScanSummary(scan_id=scan_id, module=job.get("module"))
+            try:
+                summary.scan_started = parse_scan_id(scan_id)[1]
+            except (ValueError, TypeError, AttributeError):
+                summary.scan_started = None
+        summary.total_chunks += 1
+        if job.get("status") == JobStatus.COMPLETE:
+            summary.chunks_complete += 1
+        if job.get("worker_id") not in summary.workers:
+            summary.workers.append(job.get("worker_id"))
+        completed = job.get("completed_at")
+        if completed is not None and (
+            summary.completed_at is None or completed > summary.completed_at
+        ):
+            summary.completed_at = completed
+    for summary in scans.values():
+        summary.percent_complete = round(
+            summary.chunks_complete / summary.total_chunks * 100, 2
+        )
+        if summary.percent_complete == 100:
+            summary.scan_status = "complete"
+    return [s.to_wire() for s in scans.values()]

@@ -1,0 +1,508 @@
+"""State, blob and document stores behind narrow interfaces.
+
+The reference wires three external services directly into route handlers:
+Redis for queue/state (``server/server.py:41``), S3 for chunk blobs
+(``server/server.py:45``), MongoDB for durable summaries
+(``server/server.py:43``). This module keeps those *roles* — and the
+exact key layouts, so the data plane is wire-compatible — behind three
+small interfaces with embedded default implementations (thread-safe,
+zero external dependencies) plus optional adapters for the real services
+when their client libraries are importable.
+
+Embedded defaults matter for the TPU deployment story: a single-host TPU
+worker fleet should not need a Redis/Mongo/S3 side-car to run a scan.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from pathlib import Path
+from typing import Any, Iterable, Optional
+
+
+# ---------------------------------------------------------------------------
+# State store (Redis-role): hashes + lists, the five ops the server uses.
+# ---------------------------------------------------------------------------
+
+
+class StateStore:
+    """Subset of Redis semantics used by the control plane.
+
+    Key names carried over verbatim from the reference so a real Redis
+    populated by this server is indistinguishable on the wire: ``jobs`` /
+    ``workers`` hashes, ``job_queue`` / ``completed`` lists
+    (``server/server.py:207,214,326,475``).
+    """
+
+    def hset(self, name: str, key: str, value: str) -> None:
+        raise NotImplementedError
+
+    def hget(self, name: str, key: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def hkeys(self, name: str) -> list[str]:
+        raise NotImplementedError
+
+    def hgetall(self, name: str) -> dict[str, str]:
+        raise NotImplementedError
+
+    def hdel(self, name: str, key: str) -> None:
+        raise NotImplementedError
+
+    def rpush(self, name: str, value: str) -> None:
+        raise NotImplementedError
+
+    def lpush(self, name: str, value: str) -> None:
+        raise NotImplementedError
+
+    def lpop(self, name: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def lrange(self, name: str, start: int, stop: int) -> list[str]:
+        raise NotImplementedError
+
+    def llen(self, name: str) -> int:
+        raise NotImplementedError
+
+    def flushall(self) -> None:
+        raise NotImplementedError
+
+
+class MemoryStateStore(StateStore):
+    """Embedded thread-safe state store (hashes + lists)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._hashes: dict[str, dict[str, str]] = {}
+        self._lists: dict[str, deque[str]] = {}
+
+    def hset(self, name, key, value):
+        with self._lock:
+            self._hashes.setdefault(name, {})[key] = value
+
+    def hget(self, name, key):
+        with self._lock:
+            return self._hashes.get(name, {}).get(key)
+
+    def hkeys(self, name):
+        with self._lock:
+            return list(self._hashes.get(name, {}).keys())
+
+    def hgetall(self, name):
+        with self._lock:
+            return dict(self._hashes.get(name, {}))
+
+    def hdel(self, name, key):
+        with self._lock:
+            self._hashes.get(name, {}).pop(key, None)
+
+    def rpush(self, name, value):
+        with self._lock:
+            self._lists.setdefault(name, deque()).append(value)
+
+    def lpush(self, name, value):
+        with self._lock:
+            self._lists.setdefault(name, deque()).appendleft(value)
+
+    def lpop(self, name):
+        with self._lock:
+            q = self._lists.get(name)
+            return q.popleft() if q else None
+
+    def lrange(self, name, start, stop):
+        with self._lock:
+            items = list(self._lists.get(name, ()))
+        if stop == -1:
+            return items[start:]
+        return items[start : stop + 1]
+
+    def llen(self, name):
+        with self._lock:
+            return len(self._lists.get(name, ()))
+
+    def flushall(self):
+        with self._lock:
+            self._hashes.clear()
+            self._lists.clear()
+
+
+class RedisStateStore(StateStore):
+    """Adapter over a real Redis (requires the ``redis`` package)."""
+
+    def __init__(self, url: str) -> None:
+        import redis  # gated: not part of the baked image
+
+        self._r = redis.Redis.from_url(url)
+
+    @staticmethod
+    def _d(value: Optional[bytes]) -> Optional[str]:
+        return value.decode() if value is not None else None
+
+    def hset(self, name, key, value):
+        self._r.hset(name, key, value)
+
+    def hget(self, name, key):
+        return self._d(self._r.hget(name, key))
+
+    def hkeys(self, name):
+        return [k.decode() for k in self._r.hkeys(name)]
+
+    def hgetall(self, name):
+        return {k.decode(): v.decode() for k, v in self._r.hgetall(name).items()}
+
+    def hdel(self, name, key):
+        self._r.hdel(name, key)
+
+    def rpush(self, name, value):
+        self._r.rpush(name, value)
+
+    def lpush(self, name, value):
+        self._r.lpush(name, value)
+
+    def lpop(self, name):
+        return self._d(self._r.lpop(name))
+
+    def lrange(self, name, start, stop):
+        return [v.decode() for v in self._r.lrange(name, start, stop)]
+
+    def llen(self, name):
+        return self._r.llen(name)
+
+    def flushall(self):
+        self._r.flushall()
+
+
+# ---------------------------------------------------------------------------
+# Blob store (S3-role): chunk input/output files.
+# ---------------------------------------------------------------------------
+
+
+class BlobStore:
+    """Key layout matches the reference S3 bucket:
+    ``{scan_id}/input/chunk_{i}.txt`` and ``{scan_id}/output/chunk_{i}.txt``
+    (``server/server.py:446``, ``worker/worker.py:71,96``).
+    """
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+    def delete_all(self) -> None:
+        raise NotImplementedError
+
+
+class LocalBlobStore(BlobStore):
+    """Directory-backed blob store (the embedded default)."""
+
+    def __init__(self, root: str | Path) -> None:
+        self._root = Path(root)
+        self._root.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, key: str) -> Path:
+        p = (self._root / key).resolve()
+        if not p.is_relative_to(self._root.resolve()):
+            raise ValueError(f"blob key escapes store root: {key!r}")
+        return p
+
+    def put(self, key, data):
+        p = self._path(key)
+        with self._lock:
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_bytes(data)
+
+    def get(self, key):
+        return self._path(key).read_bytes()
+
+    def exists(self, key):
+        return self._path(key).is_file()
+
+    def list(self, prefix):
+        root = self._root.resolve()
+        # Walk only the deepest existing directory implied by the prefix,
+        # then string-filter the remainder — not the whole store.
+        base_dir = (root / prefix).parent if not prefix.endswith("/") else root / prefix
+        if not base_dir.is_dir():
+            return []
+        out = []
+        for p in base_dir.rglob("*"):
+            if p.is_file():
+                rel = p.relative_to(root).as_posix()
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)
+
+    def delete_all(self):
+        import shutil
+
+        with self._lock:
+            shutil.rmtree(self._root, ignore_errors=True)
+            self._root.mkdir(parents=True, exist_ok=True)
+
+
+class MemoryBlobStore(BlobStore):
+    """In-memory blob store for tests."""
+
+    def __init__(self) -> None:
+        self._blobs: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key, data):
+        with self._lock:
+            self._blobs[key] = bytes(data)
+
+    def get(self, key):
+        with self._lock:
+            if key not in self._blobs:
+                raise KeyError(key)
+            return self._blobs[key]
+
+    def exists(self, key):
+        with self._lock:
+            return key in self._blobs
+
+    def list(self, prefix):
+        with self._lock:
+            return sorted(k for k in self._blobs if k.startswith(prefix))
+
+    def delete_all(self):
+        with self._lock:
+            self._blobs.clear()
+
+
+class S3BlobStore(BlobStore):
+    """Adapter over real S3 (requires ``boto3``)."""
+
+    def __init__(self, bucket: str, **client_kwargs: Any) -> None:
+        import boto3  # gated
+
+        self._bucket = bucket
+        self._s3 = boto3.client("s3", **client_kwargs)
+
+    def put(self, key, data):
+        self._s3.put_object(Bucket=self._bucket, Key=key, Body=data)
+
+    def get(self, key):
+        return self._s3.get_object(Bucket=self._bucket, Key=key)["Body"].read()
+
+    def exists(self, key):
+        try:
+            self._s3.head_object(Bucket=self._bucket, Key=key)
+            return True
+        except Exception:
+            return False
+
+    def list(self, prefix):
+        paginator = self._s3.get_paginator("list_objects_v2")
+        keys: list[str] = []
+        for page in paginator.paginate(Bucket=self._bucket, Prefix=prefix):
+            keys.extend(o["Key"] for o in page.get("Contents", []))
+        return sorted(keys)
+
+    def delete_all(self):
+        raise NotImplementedError("refusing to wipe a real bucket")
+
+
+# ---------------------------------------------------------------------------
+# Document store (Mongo-role): scan summaries + parsed chunks.
+# ---------------------------------------------------------------------------
+
+
+class DocCollection:
+    def insert_one(self, doc: dict) -> None:
+        raise NotImplementedError
+
+    def find_one(self, query: dict) -> Optional[dict]:
+        raise NotImplementedError
+
+    def find(self, query: Optional[dict] = None) -> list[dict]:
+        raise NotImplementedError
+
+
+class DocStore:
+    """Collection names carried from the reference ``asm`` database:
+    ``scans`` summaries (``server/server.py:277-294``), per-scan parsed
+    collections (``server/server.py:393``), ``jobs`` (``server/server.py:367``).
+    """
+
+    def collection(self, name: str) -> DocCollection:
+        raise NotImplementedError
+
+    def drop_all(self) -> None:
+        raise NotImplementedError
+
+
+class _MemoryCollection(DocCollection):
+    def __init__(self) -> None:
+        self._docs: list[dict] = []
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _matches(doc: dict, query: Optional[dict]) -> bool:
+        return not query or all(doc.get(k) == v for k, v in query.items())
+
+    def insert_one(self, doc):
+        with self._lock:
+            self._docs.append(dict(doc))
+
+    def find_one(self, query):
+        with self._lock:
+            for doc in self._docs:
+                if self._matches(doc, query):
+                    return dict(doc)
+        return None
+
+    def find(self, query=None):
+        with self._lock:
+            return [dict(d) for d in self._docs if self._matches(d, query)]
+
+
+class MemoryDocStore(DocStore):
+    def __init__(self) -> None:
+        self._collections: dict[str, _MemoryCollection] = {}
+        self._lock = threading.Lock()
+
+    def collection(self, name):
+        with self._lock:
+            return self._collections.setdefault(name, _MemoryCollection())
+
+    def drop_all(self):
+        with self._lock:
+            self._collections.clear()
+
+
+class _JsonlCollection(DocCollection):
+    """Append-only JSONL file per collection — durable embedded docs."""
+
+    def __init__(self, path: Path) -> None:
+        self._path = path
+        self._lock = threading.Lock()
+
+    def insert_one(self, doc):
+        with self._lock:
+            self._path.parent.mkdir(parents=True, exist_ok=True)
+            with self._path.open("a") as f:
+                f.write(json.dumps(doc) + "\n")
+
+    def _iter(self) -> Iterable[dict]:
+        if not self._path.is_file():
+            return
+        with self._path.open() as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+
+    def find_one(self, query):
+        with self._lock:
+            for doc in self._iter():
+                if _MemoryCollection._matches(doc, query):
+                    return doc
+        return None
+
+    def find(self, query=None):
+        with self._lock:
+            return [d for d in self._iter() if _MemoryCollection._matches(d, query)]
+
+
+class LocalDocStore(DocStore):
+    def __init__(self, root: str | Path) -> None:
+        self._root = Path(root)
+        self._lock = threading.Lock()
+        self._collections: dict[str, _JsonlCollection] = {}
+
+    def collection(self, name):
+        safe = name.replace("/", "_")
+        # Cache per name so all callers share one file lock.
+        with self._lock:
+            coll = self._collections.get(safe)
+            if coll is None:
+                coll = self._collections[safe] = _JsonlCollection(
+                    self._root / f"{safe}.jsonl"
+                )
+            return coll
+
+    def drop_all(self):
+        import shutil
+
+        with self._lock:
+            self._collections.clear()
+            shutil.rmtree(self._root, ignore_errors=True)
+
+
+class _MongoCollection(DocCollection):
+    """Conforms pymongo's Cursor/ObjectId behavior to the DocCollection
+    contract: find() returns a list of plain dicts, insert_one does not
+    mutate the caller's document."""
+
+    def __init__(self, coll) -> None:
+        self._coll = coll
+
+    @staticmethod
+    def _strip(doc: Optional[dict]) -> Optional[dict]:
+        if doc is not None:
+            doc = dict(doc)
+            doc.pop("_id", None)
+        return doc
+
+    def insert_one(self, doc):
+        self._coll.insert_one(dict(doc))
+
+    def find_one(self, query):
+        return self._strip(self._coll.find_one(query))
+
+    def find(self, query=None):
+        return [self._strip(d) for d in self._coll.find(query or {})]
+
+
+class MongoDocStore(DocStore):
+    """Adapter over real MongoDB (requires ``pymongo``)."""
+
+    def __init__(self, url: str, db: str) -> None:
+        import pymongo  # gated
+
+        self._db = pymongo.MongoClient(url)[db]
+
+    def collection(self, name):
+        return _MongoCollection(self._db[name])
+
+    def drop_all(self):
+        raise NotImplementedError("refusing to drop a real database")
+
+
+# ---------------------------------------------------------------------------
+# Factory
+# ---------------------------------------------------------------------------
+
+
+def build_stores(cfg) -> tuple[StateStore, BlobStore, DocStore]:
+    """Construct the three stores from a :class:`swarm_tpu.config.Config`."""
+    if cfg.state_backend == "redis":
+        state: StateStore = RedisStateStore(cfg.redis_url)
+    else:
+        state = MemoryStateStore()
+
+    if cfg.blob_backend == "s3":
+        blobs: BlobStore = S3BlobStore(cfg.s3_bucket)
+    elif cfg.blob_backend == "memory":
+        blobs = MemoryBlobStore()
+    else:
+        blobs = LocalBlobStore(cfg.blob_root)
+
+    if cfg.doc_backend == "mongo":
+        docs: DocStore = MongoDocStore(cfg.mongo_url, cfg.mongo_db)
+    elif cfg.doc_backend == "memory":
+        docs = MemoryDocStore()
+    else:
+        docs = LocalDocStore(cfg.doc_root)
+    return state, blobs, docs

@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX imports.
+
+Multi-chip behavior (dp/tp/sp shardings, halo exchange) is validated on
+a virtual CPU mesh — the analog of the reference's multi-droplet setup
+without a cluster (SURVEY.md §4f). Benchmarks run on real TPU separately.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_stores(tmp_path):
+    """Embedded stores rooted in a temp dir."""
+    from swarm_tpu.config import Config
+    from swarm_tpu.stores import build_stores
+
+    cfg = Config(
+        blob_root=str(tmp_path / "blobs"),
+        doc_root=str(tmp_path / "docs"),
+    )
+    return build_stores(cfg)

@@ -1,0 +1,71 @@
+import json
+
+from swarm_tpu import datamodel as dm
+
+
+def test_scan_id_format():
+    sid = dm.generate_scan_id("nmap", timestamp=1700000000)
+    assert sid == "nmap_1700000000"
+    module, ts = dm.parse_scan_id(sid)
+    assert module == "nmap" and ts == 1700000000
+
+
+def test_job_id_roundtrip_with_underscored_module():
+    sid = dm.generate_scan_id("http_probe", timestamp=123)
+    jid = dm.job_id_for(sid, 7)
+    scan_id, idx = dm.parse_job_id(jid)
+    assert scan_id == sid and idx == 7
+
+
+def test_chunk_generator_covers_all_rows():
+    rows = [str(i) for i in range(103)]
+    chunks = list(dm.chunk_generator(rows, 10))
+    assert len(chunks) == 11
+    assert sum(len(c) for c in chunks) == 103
+    assert chunks[-1] == rows[100:]
+    # reference treats batch_size 0 as one whole-file chunk (server.py:434-435)
+    assert list(dm.chunk_generator(rows, 0)) == [rows]
+    assert list(dm.chunk_generator([], 0)) == []
+
+
+def test_chunk_keys_match_reference_layout():
+    assert dm.chunk_input_key("nmap_1", 3) == "nmap_1/input/chunk_3.txt"
+    assert dm.chunk_output_key("nmap_1", 3) == "nmap_1/output/chunk_3.txt"
+
+
+def test_job_wire_roundtrip_ignores_unknown_keys():
+    job = dm.Job.create("nmap_1700000000", 2, "nmap")
+    wire = job.to_wire()
+    wire["some_future_field"] = "ignored"
+    back = dm.Job.from_json(json.dumps(wire))
+    assert back == job
+
+
+def test_status_taxonomy():
+    assert dm.JobStatus.COMPLETE in dm.JobStatus.TERMINAL
+    assert dm.JobStatus.CMD_FAILED in dm.JobStatus.FAILED
+    assert dm.JobStatus.EXECUTING not in dm.JobStatus.TERMINAL
+    assert "upload failed - credentials" in dm.JobStatus.ALL
+
+
+def test_rollup_scans():
+    jobs = {}
+    for i in range(4):
+        j = dm.Job.create("nmap_1700000000", i, "nmap")
+        j.worker_id = f"w{i % 2}"
+        if i < 3:
+            j.status = dm.JobStatus.COMPLETE
+            j.completed_at = 1700000100.0 + i
+        jobs[j.job_id] = j.to_wire()
+    [scan] = dm.rollup_scans(jobs)
+    assert scan["total_chunks"] == 4
+    assert scan["chunks_complete"] == 3
+    assert scan["percent_complete"] == 75.0
+    assert scan["scan_started"] == 1700000000
+    assert scan["completed_at"] == 1700000102.0
+    assert set(scan["workers"]) == {"w0", "w1"}
+
+    jobs[dm.job_id_for("nmap_1700000000", 3)]["status"] = "complete"
+    [scan] = dm.rollup_scans(jobs)
+    assert scan["percent_complete"] == 100.0
+    assert scan["scan_status"] == "complete"

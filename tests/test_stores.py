@@ -1,0 +1,69 @@
+import pytest
+
+from swarm_tpu.stores import (
+    LocalBlobStore,
+    LocalDocStore,
+    MemoryBlobStore,
+    MemoryStateStore,
+    MemoryDocStore,
+)
+
+
+@pytest.mark.parametrize("blob_cls", ["local", "memory"])
+def test_blob_store_roundtrip(tmp_path, blob_cls):
+    store = LocalBlobStore(tmp_path) if blob_cls == "local" else MemoryBlobStore()
+    store.put("scan_1/input/chunk_0.txt", b"a\nb\n")
+    store.put("scan_1/output/chunk_0.txt", b"result")
+    assert store.get("scan_1/input/chunk_0.txt") == b"a\nb\n"
+    assert store.exists("scan_1/output/chunk_0.txt")
+    assert not store.exists("scan_1/output/chunk_1.txt")
+    assert store.list("scan_1/output/") == ["scan_1/output/chunk_0.txt"]
+
+
+def test_local_blob_store_rejects_escape(tmp_path):
+    store = LocalBlobStore(tmp_path / "root")
+    with pytest.raises(ValueError):
+        store.put("../outside.txt", b"nope")
+
+
+def test_state_store_hash_and_list_ops():
+    s = MemoryStateStore()
+    s.hset("jobs", "j1", '{"status": "queued"}')
+    s.hset("jobs", "j2", '{"status": "complete"}')
+    assert sorted(s.hkeys("jobs")) == ["j1", "j2"]
+    assert s.hget("jobs", "j1") == '{"status": "queued"}'
+    assert s.hget("jobs", "missing") is None
+    s.rpush("job_queue", "j1")
+    s.rpush("job_queue", "j2")
+    assert s.llen("job_queue") == 2
+    assert s.lpop("job_queue") == "j1"
+    s.lpush("job_queue", "j0")
+    assert s.lrange("job_queue", 0, -1) == ["j0", "j2"]
+    s.flushall()
+    assert s.hkeys("jobs") == []
+    assert s.lpop("job_queue") is None
+
+
+@pytest.mark.parametrize("kind", ["memory", "local"])
+def test_doc_store(tmp_path, kind):
+    store = MemoryDocStore() if kind == "memory" else LocalDocStore(tmp_path)
+    scans = store.collection("scans")
+    assert scans.find_one({"scan_id": "x"}) is None
+    scans.insert_one({"scan_id": "x", "percent_complete": 100})
+    scans.insert_one({"scan_id": "y", "percent_complete": 50})
+    assert scans.find_one({"scan_id": "x"})["percent_complete"] == 100
+    assert len(scans.find()) == 2
+    assert len(scans.find({"percent_complete": 50})) == 1
+
+
+def test_config_layering(tmp_path, monkeypatch):
+    from swarm_tpu.config import Config
+
+    cfg_file = tmp_path / "cfg.json"
+    cfg_file.write_text('{"api_key": "from-file", "port": 6000}')
+    env = {"SWARM_PORT": "7000", "SERVER_URL": "http://env:1"}
+    cfg = Config.load(path=str(cfg_file), env=env, lease_seconds=5)
+    assert cfg.api_key == "from-file"
+    assert cfg.port == 7000  # env beats file
+    assert cfg.server_url == "http://env:1"  # reference alias honored
+    assert cfg.lease_seconds == 5.0  # explicit override
