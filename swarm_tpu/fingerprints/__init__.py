@@ -1,0 +1,16 @@
+"""Fingerprint corpus front-end: parsers and compilers.
+
+Turns template corpora (nuclei-template YAML, nmap-service-probes) into
+(a) an exact CPU-evaluable form (`model.Template`) and (b) a dense
+tensor database (`compile.CompiledDB`) consumed by the device match
+kernels in :mod:`swarm_tpu.ops`.
+"""
+
+from swarm_tpu.fingerprints.model import (  # noqa: F401
+    Extractor,
+    Matcher,
+    Operation,
+    Response,
+    Template,
+)
+from swarm_tpu.fingerprints.nuclei import load_corpus, parse_template  # noqa: F401

@@ -1,0 +1,797 @@
+"""Template corpus → dense tensor database for the device match kernels.
+
+Lowering strategy (designed for TPU/XLA, not a port — the reference
+shells out to nuclei/nmap for this entire layer):
+
+- Every *word-like* payload (word matchers, binary matchers, dsl
+  ``contains`` conjuncts, regex required-literals) becomes a **word
+  slot**: a (bytes, stream, case) triple. Slots of length ≥ 4 register a
+  q-gram (8-gram, or 4-gram for short words) in per-(stream, case, q)
+  hash tables — sorted unique h1 groups + entry arrays + a Bloom bitmap
+  probed by the kernel. Tiny slots (1–3 bytes) take a dense shifted
+  compare. The kernel produces a word-slot bit vector per row, verified
+  byte-exact up to ``verify_width``; longer slots verify their prefix and
+  mark hits *uncertain* (host-confirmed; hits are sparse in scanning).
+- Matchers lower to records over those bits plus scalar features
+  (status, part lengths): word/binary → slot-bucket reductions,
+  status/size → scalar compares, simple dsl → conjunctive scalar
+  programs (len/status/content_length) with optional residues (md5 → a
+  digest check the host or the device md5 kernel confirms), regex → a
+  prefilter slot whose hits are uncertain-by-construction.
+- Matchers that cannot be soundly approximated (kval/json/xpath,
+  literal-less regex, exotic dsl) force their template onto the
+  **host-always** list — evaluated by the exact CPU oracle so overall
+  parity stays 100%; the compiler reports how much of the corpus that
+  tail is.
+- Out-of-band parts (``interactsh_*``) are constant-False on both
+  engines (no interaction server in either framework's scope).
+
+Uncertainty contract (the parity invariant): a matcher's device bit is
+exact unless its ``uncertain`` bit is set, and uncertain bits can only
+be set when the underlying superset signal *fired* — absence of a hit is
+always exact. Host confirmation therefore only runs on (row, template)
+pairs whose verdict actually fired an uncertain matcher.
+"""
+
+from __future__ import annotations
+
+import binascii
+import dataclasses
+import re
+from typing import Optional
+
+import numpy as np
+
+from swarm_tpu.fingerprints import dslc
+from swarm_tpu.fingerprints.model import Matcher, Template
+from swarm_tpu.ops import hashing
+from swarm_tpu.ops.encoding import (
+    HOST_ONLY_PARTS,
+    STREAMS,
+    lower_bytes_np,
+    stream_for_part,
+)
+
+# ---------------------------------------------------------------------------
+# Constants / enums (shared with ops.match / ops.verdict)
+# ---------------------------------------------------------------------------
+
+VERIFY_WIDTH = 64  # byte-exact verify cap; longer slots are prefix+host
+
+# Matcher kinds
+MK_CONST_FALSE = 0
+MK_WORDS = 1  # word/binary/contains — slots under this matcher's condition
+MK_STATUS = 2
+MK_SIZE = 3
+MK_SCALAR_DSL = 4  # conjunctive scalar program (+ optional residue)
+MK_REGEX_PREFILTER = 5  # slot bit is a superset; hit ⇒ uncertain
+
+# Scalar-program variable ids
+SV_STATUS = 0
+SV_LEN_BODY = 1
+SV_LEN_HEADER = 2
+SV_LEN_ALL = 3
+SV_CONTENT_LENGTH = 4
+SCALAR_VARS = 5
+
+# Scalar-program comparison ops
+SOP_EQ, SOP_NE, SOP_LT, SOP_GT, SOP_LE, SOP_GE, SOP_TRUE = range(7)
+
+MAX_SCALAR_CONJUNCTS = 6
+MAX_GROUP = 8  # max word slots sharing one (table, h1) group
+
+# Rough byte-commonness weights for picking the rarest q-gram of a word.
+_COMMON = np.zeros(256, dtype=np.float32)
+for _c in b"etaoinshrdlucmfwygpb ":
+    _COMMON[_c] = 1.0
+for _c in b"ETAOINSHRDLU<>/\"'=.-_:;()0123456789":
+    _COMMON[_c] = 0.7
+for _c in b"\r\n\t&?%+,![]{}":
+    _COMMON[_c] = 0.5
+
+
+def _gram_offsets_by_rarity(data: bytes, q: int) -> list[int]:
+    """Candidate gram offsets, rarest window first."""
+    if len(data) <= q:
+        return [0]
+    weights = _COMMON[np.frombuffer(data, dtype=np.uint8)]
+    window_scores = np.convolve(weights, np.ones(q), mode="valid")
+    return list(np.argsort(window_scores, kind="stable").astype(int))
+
+
+# ---------------------------------------------------------------------------
+# Regex required-literal extraction (prefilter factory)
+# ---------------------------------------------------------------------------
+
+
+def required_literal(pattern: str, min_len: int = 4) -> Optional[bytes]:
+    """Longest byte literal that must occur in any match of ``pattern``.
+
+    Conservative walk of the sre parse tree: only literals on required,
+    non-alternating paths count. Returns None when nothing ≥ min_len is
+    guaranteed — those regexes make their template host-always.
+    """
+    try:
+        import re._parser as sre_parse  # py3.11+
+    except ImportError:  # pragma: no cover
+        import sre_parse  # type: ignore
+    try:
+        tree = sre_parse.parse(pattern)
+    except re.error:
+        return None
+
+    case_insensitive = bool(tree.state.flags & re.IGNORECASE)
+
+    best: list[bytes] = [b""]
+
+    def walk(seq) -> None:
+        run = bytearray()
+
+        def flush():
+            nonlocal run
+            if len(run) > len(best[0]):
+                best[0] = bytes(run)
+            run = bytearray()
+
+        for op, arg in seq:
+            opname = str(op)
+            if opname == "LITERAL" and 0 <= arg < 256:
+                run.append(arg)
+            elif opname == "MAX_REPEAT" or opname == "MIN_REPEAT":
+                lo, _hi, child = arg
+                flush()
+                if lo >= 1:
+                    walk(child)
+            elif opname == "SUBPATTERN":
+                flush()
+                walk(arg[3])
+            elif opname == "AT":
+                # zero-width assertion: consumes nothing, so bytes on either
+                # side are still adjacent in any match — run continues.
+                continue
+            else:
+                # IN, BRANCH, ANY, CATEGORY, GROUPREF… — not a required literal
+                flush()
+        flush()
+
+    walk(tree)
+    lit = best[0]
+    if len(lit) < min_len:
+        return None
+    del case_insensitive  # see below: literals are always lowered
+    # Always lowercase: the prefilter probes the *lowered* stream, which is
+    # a sound superset for both case-sensitive and (?i)/scoped-(?i) regexes
+    # (a cs occurrence in the raw stream implies the lowered literal occurs
+    # in the lowered stream).
+    return bytes(lower_bytes_np(np.frombuffer(lit, np.uint8)).tobytes())
+
+
+# ---------------------------------------------------------------------------
+# DSL lowering: conjunctive scalar programs + contains/md5 residues
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ScalarProgram:
+    conjuncts: list[tuple[int, int, float]]  # (var, op, value)
+    contains: list[tuple[bytes, str, bool]]  # (needle, stream, case_insensitive)
+    residue: bool = False  # md5/sha residue → hit needs host confirm
+    never: bool = False  # statically unsatisfiable (e.g. "AbC" in tolower(x))
+
+
+_CMP_OPS = {"==": SOP_EQ, "!=": SOP_NE, "<": SOP_LT, ">": SOP_GT, "<=": SOP_LE, ">=": SOP_GE}
+_SWAP = {SOP_LT: SOP_GT, SOP_GT: SOP_LT, SOP_LE: SOP_GE, SOP_GE: SOP_LE}
+
+
+def _scalar_var(node) -> Optional[int]:
+    if node[0] == "var" and node[1] == "status_code":
+        return SV_STATUS
+    if node[0] == "var" and node[1] == "content_length":
+        return SV_CONTENT_LENGTH
+    if node[0] == "call" and node[1] == "len" and len(node[2]) == 1:
+        inner = node[2][0]
+        if inner[0] == "var":
+            return {
+                "body": SV_LEN_BODY,
+                "header": SV_LEN_HEADER,
+                "all_headers": SV_LEN_HEADER,
+                "raw": SV_LEN_ALL,
+            }.get(inner[1])
+    return None
+
+
+def _part_stream_of_var(node) -> Optional[tuple[str, Optional[str]]]:
+    """(stream, case_wrap) for body/header vars; case_wrap ∈ {None,
+    'lower', 'upper'} from a tolower()/toupper() wrapper."""
+    wrap: Optional[str] = None
+    while node[0] == "call" and node[1] in ("tolower", "toupper") and len(node[2]) == 1:
+        wrap = "lower" if node[1] == "tolower" else "upper"
+        node = node[2][0]
+    if node[0] == "var":
+        stream = {"body": "body", "header": "header", "all_headers": "header", "raw": "all"}.get(node[1])
+        if stream:
+            return stream, wrap
+    return None
+
+
+_HASH_FNS = ("md5", "sha1", "sha256", "mmh3")
+
+
+def lower_dsl(ast) -> Optional[ScalarProgram]:
+    """Lower one dsl expression to a scalar program, or None if it
+    doesn't fit the supported shape (top-level conjunction of scalar
+    compares / contains / hash-equality residues)."""
+    prog = ScalarProgram(conjuncts=[], contains=[])
+
+    def handle(node) -> bool:
+        if node[0] == "bin" and node[1] == "&&":
+            return handle(node[2]) and handle(node[3])
+        if node[0] == "bin" and node[1] in _CMP_OPS:
+            op = _CMP_OPS[node[1]]
+            lhs, rhs = node[2], node[3]
+            for a, b, swapped in ((lhs, rhs, False), (rhs, lhs, True)):
+                var = _scalar_var(a)
+                if var is not None and b[0] == "lit" and isinstance(b[1], (int, float)):
+                    real_op = _SWAP.get(op, op) if swapped else op
+                    prog.conjuncts.append((var, real_op, float(b[1])))
+                    return True
+            # hash-equality residue:  md5(body) == "…"  (either side)
+            for a, b in ((lhs, rhs), (rhs, lhs)):
+                if (
+                    op == SOP_EQ
+                    and a[0] == "call"
+                    and a[1] in _HASH_FNS
+                    and b[0] == "lit"
+                    and isinstance(b[1], str)
+                ):
+                    prog.residue = True
+                    return True
+            return False
+        if node[0] == "call" and node[1] == "contains" and len(node[2]) == 2:
+            hay, needle = node[2]
+            loc = _part_stream_of_var(hay)
+            if loc and needle[0] == "lit" and isinstance(needle[1], str):
+                stream, wrap = loc
+                data = needle[1].encode()
+                if len(data) == 0:
+                    return False
+                if wrap is None:
+                    prog.contains.append((data, stream, False))
+                elif wrap == "lower":
+                    if data != data.lower():
+                        # an uppercase needle can never occur in a
+                        # lowercased haystack — statically false
+                        prog.never = True
+                    else:
+                        prog.contains.append((data, stream, True))
+                else:  # upper
+                    if data != data.upper():
+                        prog.never = True
+                    else:
+                        prog.contains.append((data.lower(), stream, True))
+                return True
+        return False
+
+    if not handle(ast):
+        return None
+    if len(prog.conjuncts) > MAX_SCALAR_CONJUNCTS:
+        return None
+    return prog
+
+
+# ---------------------------------------------------------------------------
+# The compiled database
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class WordTable:
+    """One (stream, case, gram-size) hash table.
+
+    A window hit must match the entry's (h1, h2) *and* the word's
+    suffix-gram hashes at position ``pos + suf_delta`` — 128 hash bits
+    total, computed entirely from the rolling-hash arrays the kernel
+    already has (no byte gathers). Hits are still marked *uncertain*
+    and host-confirmed, so a hash collision can never corrupt a verdict;
+    the hashes exist to make candidate traffic ≈ true-hit traffic.
+    """
+
+    stream: str
+    lowered: bool
+    q: int
+    group_h1: np.ndarray  # uint32 [G] sorted unique
+    entry_start: np.ndarray  # int32 [G]
+    entry_count: np.ndarray  # int32 [G]
+    entry_h2: np.ndarray  # uint32 [E]
+    entry_slot: np.ndarray  # int32 [E]
+    entry_off: np.ndarray  # int32 [E] gram offset within the slot bytes
+    entry_len: np.ndarray  # int32 [E] true word length
+    entry_suf_delta: np.ndarray  # int32 [E] = (len - q) - off  (suffix pos - window pos)
+    entry_suf_h1: np.ndarray  # uint32 [E]
+    entry_suf_h2: np.ndarray  # uint32 [E]
+    bloom: np.ndarray  # uint32 [BLOOM_WORDS]
+    max_group: int = 1
+
+    @property
+    def num_groups(self) -> int:
+        return int(self.group_h1.shape[0])
+
+
+@dataclasses.dataclass
+class IndexBucket:
+    """One width-class of a ragged index table.
+
+    ``rows[i]`` owns ``idx[i, :width]``; rows with fewer real entries are
+    padded by repeating their first entry (neutral for both AND and OR
+    reductions).
+    """
+
+    width: int
+    rows: np.ndarray  # int32 [NB] — owner ids (matcher / op / template)
+    idx: np.ndarray  # int32 [NB, width]
+
+
+def bucket_ragged(ragged: list[list[int]], owner_count: int) -> list[IndexBucket]:
+    """Ragged owner→members lists → power-of-two width buckets.
+
+    Total gather volume stays Σ|members| × (≤2) instead of
+    owners × max(|members|).
+    """
+    by_width: dict[int, list[tuple[int, list[int]]]] = {}
+    for owner, members in enumerate(ragged):
+        if not members:
+            continue
+        width = 1
+        while width < len(members):
+            width *= 2
+        by_width.setdefault(width, []).append((owner, members))
+    buckets = []
+    for width in sorted(by_width):
+        rows = np.array([o for o, _ in by_width[width]], dtype=np.int32)
+        idx = np.zeros((len(rows), width), dtype=np.int32)
+        for i, (_o, members) in enumerate(by_width[width]):
+            for j in range(width):
+                idx[i, j] = members[j] if j < len(members) else members[0]
+        buckets.append(IndexBucket(width=width, rows=rows, idx=idx))
+    return buckets
+
+
+@dataclasses.dataclass
+class CompiledDB:
+    # --- word slots ---
+    slot_bytes: np.ndarray  # uint8 [NW, VERIFY_WIDTH] (lowered for ci slots)
+    slot_len: np.ndarray  # int32 [NW] true length (may exceed VERIFY_WIDTH)
+    slot_long: np.ndarray  # bool [NW] — len > VERIFY_WIDTH ⇒ hit is uncertain
+    tables: list[WordTable]
+    # tiny slots, dense path: per (stream, lowered) padded byte matrix
+    tiny_bytes: np.ndarray  # uint8 [NTINY, TINY_MAX]
+    tiny_len: np.ndarray  # int32 [NTINY]
+    tiny_slot: np.ndarray  # int32 [NTINY]
+    tiny_stream: np.ndarray  # int32 [NTINY] index into STREAMS
+    tiny_lowered: np.ndarray  # bool [NTINY]
+
+    # --- matchers ---
+    m_kind: np.ndarray  # int32 [NM]
+    m_negative: np.ndarray  # bool [NM]
+    m_cond_and: np.ndarray  # bool [NM]
+    m_slot_buckets: list  # list[IndexBucket] matcher → word-slot ids
+    m_scalar: np.ndarray  # float32 [NM, MAX_SCALAR_CONJUNCTS, 3] (var, op, val)
+    m_residue: np.ndarray  # bool [NM] — scalar pass still needs host confirm
+    m_status: np.ndarray  # int32 [NM, MAX_STATUS] (pad = -1)
+    m_size: np.ndarray  # int32 [NM, MAX_STATUS] (pad = -1)
+    m_size_stream: np.ndarray  # int32 [NM] stream index for size matchers
+
+    # --- operations & templates ---
+    op_cond_and: np.ndarray  # bool [NOP]
+    op_m_buckets: list  # list[IndexBucket] op → matcher ids
+    t_op_buckets: list  # list[IndexBucket] template → op ids
+
+    template_ids: list  # str [NT] — device-evaluated templates
+    host_always: list  # list[Template] — exact-CPU-only tail
+    templates: list  # the NT Template objects (for host confirmation)
+    stats: dict
+
+    @property
+    def num_slots(self) -> int:
+        return int(self.slot_bytes.shape[0])
+
+    @property
+    def num_templates(self) -> int:
+        return len(self.template_ids)
+
+
+# ---------------------------------------------------------------------------
+# Compiler
+# ---------------------------------------------------------------------------
+
+
+class _SlotSpace:
+    """Dedup (bytes, stream, lowered) → slot id."""
+
+    def __init__(self) -> None:
+        self.index: dict[tuple[bytes, str, bool], int] = {}
+        self.entries: list[tuple[bytes, str, bool]] = []
+
+    def get(self, data: bytes, stream: str, lowered: bool) -> int:
+        if lowered:
+            data = bytes(lower_bytes_np(np.frombuffer(data, np.uint8)).tobytes()) if data else data
+        key = (data, stream, lowered)
+        slot = self.index.get(key)
+        if slot is None:
+            slot = len(self.entries)
+            self.index[key] = slot
+            self.entries.append(key)
+        return slot
+
+
+def _word_payloads(matcher: Matcher) -> Optional[list[bytes]]:
+    if matcher.type == "word":
+        return [w.encode("utf-8", "surrogateescape") for w in matcher.words]
+    if matcher.type == "binary":
+        out = []
+        for hexstr in matcher.binary:
+            try:
+                out.append(binascii.unhexlify(re.sub(r"\s", "", hexstr)))
+            except (binascii.Error, ValueError):
+                return None
+        return out
+    return None
+
+
+def compile_corpus(
+    templates: list[Template],
+    verify_width: int = VERIFY_WIDTH,
+) -> CompiledDB:
+    slots = _SlotSpace()
+    matchers: list[dict] = []
+    ops: list[dict] = []
+    t_ops: list[list[int]] = []
+    kept_templates: list[Template] = []
+    host_always: list[Template] = []
+
+    def lower_matcher(m: Matcher) -> Optional[dict]:
+        """→ matcher record dict, or None if not device-loweable."""
+        rec = {
+            "kind": MK_CONST_FALSE,
+            "negative": m.negative,
+            "cond_and": m.condition == "and",
+            "slots": [],
+            "scalar": [],
+            "residue": False,
+            "status": [],
+            "size": [],
+            "size_stream": 0,
+        }
+        if m.type in ("word", "binary"):
+            payloads = _word_payloads(m)
+            if payloads is None or not payloads:
+                return None
+            if m.part in HOST_ONLY_PARTS:
+                return None  # oracle has real bytes here; not device-loweable
+            stream = stream_for_part(m.part)
+            if stream is None:
+                return rec  # unknown/OOB part: constant False on both engines
+            if any(len(p) == 0 for p in payloads):
+                return None
+            # cpu_ref (like nuclei) ignores case-insensitive for binary
+            # payloads — keep the device identical.
+            lowered = m.case_insensitive and m.type == "word"
+            rec["kind"] = MK_WORDS
+            rec["slots"] = [slots.get(p, stream, lowered) for p in payloads]
+            return rec
+        if m.type == "status":
+            if not m.status:
+                return None
+            rec["kind"] = MK_STATUS
+            rec["status"] = list(m.status)
+            return rec
+        if m.type == "size":
+            stream = stream_for_part(m.part)
+            if stream is None:
+                return rec
+            if not m.size:
+                return None
+            rec["kind"] = MK_SIZE
+            rec["size"] = list(m.size)
+            rec["size_stream"] = STREAMS.index(stream)
+            return rec
+        if m.type == "regex":
+            stream = stream_for_part(m.part)
+            if stream is None:
+                return rec
+            # every regex in the list needs its own required literal; the
+            # matcher bit is the OR/AND of per-regex prefilter bits.
+            slot_ids = []
+            for pattern in m.regex:
+                lit = required_literal(pattern)
+                if lit is None:
+                    return None
+                # prefilter literals always probe the lowered stream (sound
+                # superset regardless of the regex's case flags)
+                slot_ids.append(slots.get(lit, stream, True))
+            if not slot_ids:
+                return None
+            rec["kind"] = MK_REGEX_PREFILTER
+            rec["slots"] = slot_ids
+            return rec
+        if m.type == "dsl":
+            progs = []
+            for expr in m.dsl:
+                ast = dslc.try_parse(expr)
+                if ast is None:
+                    return None
+                prog = lower_dsl(ast)
+                if prog is None:
+                    return None
+                progs.append(prog)
+            if len(progs) != 1:
+                # multi-expression dsl matchers are rare; host them for now
+                return None
+            prog = progs[0]
+            if prog.never:
+                return rec  # statically unsatisfiable: constant False
+            rec["kind"] = MK_SCALAR_DSL
+            rec["scalar"] = prog.conjuncts
+            rec["residue"] = prog.residue
+            rec["cond_and"] = True  # conjuncts and contains() are all AND'd
+            rec["slots"] = [
+                slots.get(needle, stream, lowered)
+                for needle, stream, lowered in prog.contains
+            ]
+            return rec
+        return None  # kval / json / xpath
+
+    for template in templates:
+        if template.protocol == "workflow" or not template.operations:
+            continue
+        lowered_ops: list[dict] = []
+        ok = True
+        for op in template.operations:
+            recs = []
+            for m in op.matchers:
+                rec = lower_matcher(m)
+                if rec is None:
+                    ok = False
+                    break
+                recs.append(rec)
+            if not ok:
+                break
+            lowered_ops.append(
+                {"cond_and": op.matchers_condition == "and", "matchers": recs}
+            )
+        if not ok:
+            host_always.append(template)
+            continue
+        op_ids = []
+        for lop in lowered_ops:
+            if not lop["matchers"]:
+                continue
+            m_ids = []
+            for rec in lop["matchers"]:
+                m_ids.append(len(matchers))
+                matchers.append(rec)
+            ops.append({"cond_and": lop["cond_and"], "matchers": m_ids})
+            op_ids.append(len(ops) - 1)
+        if not op_ids:
+            # no matchers anywhere: never matches (same as oracle)
+            continue
+        t_ops.append(op_ids)
+        kept_templates.append(template)
+
+    # --- build slot arrays ---
+    NW = len(slots.entries)
+    slot_bytes = np.zeros((max(NW, 1), verify_width), dtype=np.uint8)
+    slot_len = np.zeros((max(NW, 1),), dtype=np.int32)
+    for i, (data, _stream, _lowered) in enumerate(slots.entries):
+        view = data[:verify_width]
+        slot_bytes[i, : len(view)] = np.frombuffer(view, dtype=np.uint8)
+        slot_len[i] = len(data)
+    slot_long = slot_len > verify_width
+
+    # --- build q-gram tables + tiny path ---
+    # Each slot picks its rarest gram; oversized (table, h1) groups then
+    # shed members to their next-rarest gram so the kernel's per-group
+    # loop bound stays small.
+    table_members: dict[tuple[str, bool, int], list[tuple[int, int, int, int]]] = {}
+    tiny: list[int] = []
+    placements: dict[int, tuple[tuple, int, int, int]] = {}  # slot -> (tkey, h1, h2, off)
+    candidates: dict[int, list[int]] = {}
+    group_sizes: dict[tuple, int] = {}  # (tkey, h1) -> count
+
+    def _hash_at(data: bytes, off: int, q: int) -> tuple[int, int]:
+        return hashing.gram_hash_np(data[off : off + q], q)
+
+    for slot_id, (data, stream, lowered) in enumerate(slots.entries):
+        if len(data) < hashing.GRAM_SHORT:
+            tiny.append(slot_id)
+            continue
+        q = hashing.GRAM_LONG if len(data) >= hashing.GRAM_LONG else hashing.GRAM_SHORT
+        tkey = (stream, lowered, q)
+        offs = _gram_offsets_by_rarity(data, q)
+        candidates[slot_id] = offs
+        off = offs[0]
+        h1, h2 = _hash_at(data, off, q)
+        placements[slot_id] = (tkey, h1, h2, off)
+        group_sizes[(tkey, h1)] = group_sizes.get((tkey, h1), 0) + 1
+
+    for _round in range(12):
+        oversized = {k for k, n in group_sizes.items() if n > MAX_GROUP}
+        if not oversized:
+            break
+        moved = False
+        for slot_id, (tkey, h1, h2, off) in list(placements.items()):
+            if (tkey, h1) not in oversized or group_sizes[(tkey, h1)] <= MAX_GROUP:
+                continue
+            data = slots.entries[slot_id][0]
+            q = tkey[2]
+            for alt in candidates[slot_id]:
+                if alt == off:
+                    continue
+                ah1, ah2 = _hash_at(data, alt, q)
+                if group_sizes.get((tkey, ah1), 0) < MAX_GROUP:
+                    group_sizes[(tkey, h1)] -= 1
+                    group_sizes[(tkey, ah1)] = group_sizes.get((tkey, ah1), 0) + 1
+                    placements[slot_id] = (tkey, ah1, ah2, alt)
+                    moved = True
+                    break
+        if not moved:
+            break
+
+    for slot_id, (tkey, h1, h2, off) in placements.items():
+        table_members.setdefault(tkey, []).append((h1, h2, slot_id, off))
+
+    tables: list[WordTable] = []
+    for (stream, lowered, q), members in sorted(table_members.items()):
+        members.sort()
+        group_h1: list[int] = []
+        entry_start: list[int] = []
+        entry_count: list[int] = []
+        e_h2: list[int] = []
+        e_slot: list[int] = []
+        e_off: list[int] = []
+        e_len: list[int] = []
+        e_sufd: list[int] = []
+        e_sufh1: list[int] = []
+        e_sufh2: list[int] = []
+        for h1, h2, slot_id, off in members:
+            if not group_h1 or group_h1[-1] != h1:
+                group_h1.append(h1)
+                entry_start.append(len(e_h2))
+                entry_count.append(0)
+            entry_count[-1] += 1
+            data = slots.entries[slot_id][0]
+            suf_off = len(data) - q  # suffix gram start within the word
+            sh1, sh2 = _hash_at(data, suf_off, q)
+            e_h2.append(h2)
+            e_slot.append(slot_id)
+            e_off.append(off)
+            e_len.append(len(data))
+            e_sufd.append(suf_off - off)
+            e_sufh1.append(sh1)
+            e_sufh2.append(sh2)
+        max_group = max(entry_count)
+        if max_group > MAX_GROUP:
+            raise ValueError(
+                f"word-table group overflow ({max_group} > {MAX_GROUP}); "
+                "raise MAX_GROUP or diversify gram offsets"
+            )
+        # Bloom carries every entry's (h1, h2) pair so a probe can only
+        # pass where some entry's gram might start.
+        tables.append(
+            WordTable(
+                stream=stream,
+                lowered=lowered,
+                q=q,
+                group_h1=np.array(group_h1, dtype=np.uint32),
+                entry_start=np.array(entry_start, dtype=np.int32),
+                entry_count=np.array(entry_count, dtype=np.int32),
+                entry_h2=np.array(e_h2, dtype=np.uint32),
+                entry_slot=np.array(e_slot, dtype=np.int32),
+                entry_off=np.array(e_off, dtype=np.int32),
+                entry_len=np.array(e_len, dtype=np.int32),
+                entry_suf_delta=np.array(e_sufd, dtype=np.int32),
+                entry_suf_h1=np.array(e_sufh1, dtype=np.uint32),
+                entry_suf_h2=np.array(e_sufh2, dtype=np.uint32),
+                bloom=hashing.build_bloom_np(
+                    np.repeat(
+                        np.array(group_h1, dtype=np.uint32),
+                        np.array(entry_count, dtype=np.int64),
+                    ),
+                    np.array(e_h2, dtype=np.uint32),
+                ),
+                max_group=max_group,
+            )
+        )
+
+    NTINY = len(tiny)
+    tiny_bytes = np.zeros((max(NTINY, 1), hashing.TINY_MAX), dtype=np.uint8)
+    tiny_len = np.zeros((max(NTINY, 1),), dtype=np.int32)
+    tiny_slot = np.zeros((max(NTINY, 1),), dtype=np.int32)
+    tiny_stream = np.zeros((max(NTINY, 1),), dtype=np.int32)
+    tiny_lowered = np.zeros((max(NTINY, 1),), dtype=bool)
+    for i, slot_id in enumerate(tiny):
+        data, stream, lowered = slots.entries[slot_id]
+        tiny_bytes[i, : len(data)] = np.frombuffer(data, dtype=np.uint8)
+        tiny_len[i] = len(data)
+        tiny_slot[i] = slot_id
+        tiny_stream[i] = STREAMS.index(stream)
+        tiny_lowered[i] = lowered
+
+    # --- matcher arrays ---
+    NM = max(len(matchers), 1)
+    max_status = max(
+        (max(len(r["status"]), len(r["size"])) for r in matchers), default=1
+    ) or 1
+    m_kind = np.zeros((NM,), dtype=np.int32)
+    m_negative = np.zeros((NM,), dtype=bool)
+    m_cond_and = np.zeros((NM,), dtype=bool)
+    m_scalar = np.zeros((NM, MAX_SCALAR_CONJUNCTS, 3), dtype=np.float32)
+    m_scalar[:, :, 1] = SOP_TRUE
+    m_residue = np.zeros((NM,), dtype=bool)
+    m_status = np.full((NM, max_status), -1, dtype=np.int32)
+    m_size = np.full((NM, max_status), -1, dtype=np.int32)
+    m_size_stream = np.zeros((NM,), dtype=np.int32)
+    for i, rec in enumerate(matchers):
+        m_kind[i] = rec["kind"]
+        m_negative[i] = rec["negative"]
+        m_cond_and[i] = rec["cond_and"]
+        for j, (var, op, val) in enumerate(rec["scalar"][:MAX_SCALAR_CONJUNCTS]):
+            m_scalar[i, j] = (var, op, val)
+        m_residue[i] = rec["residue"]
+        for j, s in enumerate(rec["status"]):
+            m_status[i, j] = s
+        for j, s in enumerate(rec["size"]):
+            m_size[i, j] = s
+        m_size_stream[i] = rec["size_stream"]
+    m_slot_buckets = bucket_ragged([r["slots"] for r in matchers], NM)
+
+    # --- operation / template arrays ---
+    NOP = max(len(ops), 1)
+    op_cond_and = np.zeros((NOP,), dtype=bool)
+    for i, o in enumerate(ops):
+        op_cond_and[i] = o["cond_and"]
+    op_m_buckets = bucket_ragged([o["matchers"] for o in ops], NOP)
+    t_op_buckets = bucket_ragged(t_ops, max(len(t_ops), 1))
+
+    stats = {
+        "templates_in": len(templates),
+        "templates_device": len(kept_templates),
+        "templates_host_always": len(host_always),
+        "matchers": len(matchers),
+        "word_slots": NW,
+        "tiny_slots": NTINY,
+        "tables": {
+            f"{t.stream}/{'ci' if t.lowered else 'cs'}/q{t.q}": int(
+                t.entry_h2.shape[0]
+            )
+            for t in tables
+        },
+    }
+
+    return CompiledDB(
+        slot_bytes=slot_bytes,
+        slot_len=slot_len,
+        slot_long=slot_long,
+        tables=tables,
+        tiny_bytes=tiny_bytes,
+        tiny_len=tiny_len,
+        tiny_slot=tiny_slot,
+        tiny_stream=tiny_stream,
+        tiny_lowered=tiny_lowered,
+        m_kind=m_kind,
+        m_negative=m_negative,
+        m_cond_and=m_cond_and,
+        m_slot_buckets=m_slot_buckets,
+        m_scalar=m_scalar,
+        m_residue=m_residue,
+        m_status=m_status,
+        m_size=m_size,
+        m_size_stream=m_size_stream,
+        op_cond_and=op_cond_and,
+        op_m_buckets=op_m_buckets,
+        t_op_buckets=t_op_buckets,
+        template_ids=[t.id for t in kept_templates],
+        host_always=host_always,
+        templates=kept_templates,
+        stats=stats,
+    )

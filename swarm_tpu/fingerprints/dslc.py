@@ -1,0 +1,322 @@
+"""nuclei matcher-DSL compiler: parse → AST → evaluate (host) / lower (device).
+
+The corpus's 766 ``dsl`` matchers are govaluate-style expressions such as
+``len(body)==2336 && status_code==200 && md5(body)=="…"``
+(``technologies/favicon-detection.yaml:23-27`` in the reference corpus).
+This module parses them once into a small AST that both the exact host
+evaluator (here) and the device lowering (``ops/dsl_device.py``) consume.
+
+AST node forms (plain tuples, trivially traversable):
+  ("lit", value) · ("var", name) · ("call", fname, [args])
+  ("bin", op, lhs, rhs) · ("un", op, expr)
+"""
+
+from __future__ import annotations
+
+import base64 as _b64
+import hashlib
+import re
+from typing import Any, Callable, Optional
+
+
+class DslError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""\s*(?:
+        (?P<num>\d+\.\d+|\d+)
+      | (?P<str>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
+      | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+      | (?P<op>\|\||&&|==|!=|<=|>=|=~|!~|<<|>>|[-+*/%()!,<>])
+    )""",
+    re.VERBOSE | re.DOTALL,
+)
+
+
+def _tokenize(text: str) -> list[tuple[str, str]]:
+    tokens: list[tuple[str, str]] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if not m or m.end() == pos:
+            if text[pos:].strip() == "":
+                break
+            raise DslError(f"bad token at {text[pos:pos+20]!r}")
+        pos = m.end()
+        for kind in ("num", "str", "name", "op"):
+            val = m.group(kind)
+            if val is not None:
+                tokens.append((kind, val))
+                break
+    tokens.append(("eof", ""))
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# Pratt parser
+# ---------------------------------------------------------------------------
+
+_BINARY_PRECEDENCE = {
+    "||": 1,
+    "&&": 2,
+    "==": 3, "!=": 3, "=~": 3, "!~": 3,
+    "<": 4, ">": 4, "<=": 4, ">=": 4,
+    "+": 5, "-": 5,
+    "*": 6, "/": 6, "%": 6,
+}
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.tokens = tokens
+        self.i = 0
+
+    def peek(self) -> tuple[str, str]:
+        return self.tokens[self.i]
+
+    def next(self) -> tuple[str, str]:
+        tok = self.tokens[self.i]
+        self.i += 1
+        return tok
+
+    def expect(self, value: str) -> None:
+        kind, val = self.next()
+        if val != value:
+            raise DslError(f"expected {value!r}, got {val!r}")
+
+    def parse_expression(self, min_prec: int = 0) -> tuple:
+        left = self.parse_unary()
+        while True:
+            kind, val = self.peek()
+            prec = _BINARY_PRECEDENCE.get(val)
+            if kind != "op" or prec is None or prec < min_prec:
+                return left
+            self.next()
+            right = self.parse_expression(prec + 1)
+            left = ("bin", val, left, right)
+
+    def parse_unary(self) -> tuple:
+        kind, val = self.peek()
+        if kind == "op" and val == "!":
+            self.next()
+            return ("un", "!", self.parse_unary())
+        if kind == "op" and val == "-":
+            self.next()
+            return ("un", "-", self.parse_unary())
+        return self.parse_primary()
+
+    def parse_primary(self) -> tuple:
+        kind, val = self.next()
+        if kind == "num":
+            return ("lit", float(val) if "." in val else int(val))
+        if kind == "str":
+            body = val[1:-1]
+            body = body.encode().decode("unicode_escape") if "\\" in body else body
+            return ("lit", body)
+        if kind == "name":
+            if val in ("true", "false"):
+                return ("lit", val == "true")
+            nkind, nval = self.peek()
+            if nkind == "op" and nval == "(":
+                self.next()
+                args = []
+                if self.peek() != ("op", ")"):
+                    args.append(self.parse_expression())
+                    while self.peek() == ("op", ","):
+                        self.next()
+                        args.append(self.parse_expression())
+                self.expect(")")
+                return ("call", val, args)
+            return ("var", val)
+        if kind == "op" and val == "(":
+            expr = self.parse_expression()
+            self.expect(")")
+            return expr
+        raise DslError(f"unexpected token {val!r}")
+
+
+def parse_dsl(text: str) -> tuple:
+    parser = _Parser(_tokenize(text))
+    ast = parser.parse_expression()
+    if parser.peek()[0] != "eof":
+        raise DslError(f"trailing input after expression: {text!r}")
+    return ast
+
+
+# ---------------------------------------------------------------------------
+# Host evaluator (the exact/oracle semantics)
+# ---------------------------------------------------------------------------
+
+
+def _to_bytes(v: Any) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode("utf-8", "surrogateescape")
+    return str(v).encode()
+
+
+def _text(v: Any) -> str:
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return str(v)
+
+
+_FUNCTIONS: dict[str, Callable] = {
+    "len": lambda v: len(_to_bytes(v)) if isinstance(v, (bytes, str)) else len(v),
+    "md5": lambda v: hashlib.md5(_to_bytes(v)).hexdigest(),
+    "sha1": lambda v: hashlib.sha1(_to_bytes(v)).hexdigest(),
+    "sha256": lambda v: hashlib.sha256(_to_bytes(v)).hexdigest(),
+    "contains": lambda hay, needle: _to_bytes(needle) in _to_bytes(hay),
+    "tolower": lambda v: _to_bytes(v).lower(),
+    "toupper": lambda v: _to_bytes(v).upper(),
+    "trim_space": lambda v: _to_bytes(v).strip(),
+    "base64": lambda v: _b64.b64encode(_to_bytes(v)).decode(),
+    "base64_decode": lambda v: _b64.b64decode(_to_bytes(v)),
+    "hex_encode": lambda v: _to_bytes(v).hex(),
+    "regex": lambda pattern, v: re.search(_text(pattern), _text(v)) is not None,
+    "mmh3": None,  # installed below (needs helper)
+}
+
+
+def _mmh3_32(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3 x86 32-bit — the hash nuclei's favicon dsl uses.
+
+    Pure-python reference; the device version lives in ops/hashes.py.
+    Returns the *signed* 32-bit value (Shodan/nuclei convention).
+    """
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    n = len(data)
+    rounded = n - (n % 4)
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = data[rounded:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+_FUNCTIONS["mmh3"] = lambda v: str(_mmh3_32(_to_bytes(v)))
+
+
+def _cmp_coerce(a: Any, b: Any) -> tuple[Any, Any]:
+    """Make ==/</> tolerant of bytes-vs-str and str-vs-number mixes."""
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a, b
+    if isinstance(a, (bytes, str)) and isinstance(b, (bytes, str)):
+        return _to_bytes(a), _to_bytes(b)
+    if isinstance(a, (int, float)) and isinstance(b, (bytes, str)):
+        try:
+            return a, float(_text(b))
+        except ValueError:
+            return str(a), _text(b)
+    if isinstance(b, (int, float)) and isinstance(a, (bytes, str)):
+        b2, a2 = _cmp_coerce(b, a)
+        return a2, b2
+    return a, b
+
+
+def evaluate(ast: tuple, env: dict[str, Any]) -> Any:
+    kind = ast[0]
+    if kind == "lit":
+        return ast[1]
+    if kind == "var":
+        name = ast[1]
+        if name not in env:
+            raise DslError(f"unknown variable {name!r}")
+        return env[name]
+    if kind == "un":
+        v = evaluate(ast[2], env)
+        return (not v) if ast[1] == "!" else -v
+    if kind == "call":
+        fn = _FUNCTIONS.get(ast[1])
+        if fn is None:
+            raise DslError(f"unknown function {ast[1]!r}")
+        return fn(*(evaluate(a, env) for a in ast[2]))
+    if kind == "bin":
+        op = ast[1]
+        if op == "&&":
+            return bool(evaluate(ast[2], env)) and bool(evaluate(ast[3], env))
+        if op == "||":
+            return bool(evaluate(ast[2], env)) or bool(evaluate(ast[3], env))
+        a, b = evaluate(ast[2], env), evaluate(ast[3], env)
+        if op in ("==", "!=", "<", ">", "<=", ">="):
+            a, b = _cmp_coerce(a, b)
+            try:
+                result = {
+                    "==": a == b, "!=": a != b,
+                    "<": a < b, ">": a > b, "<=": a <= b, ">=": a >= b,
+                }[op]
+            except TypeError:
+                result = False if op != "!=" else True
+            return result
+        if op == "=~":
+            return re.search(_text(b), _text(a)) is not None
+        if op == "!~":
+            return re.search(_text(b), _text(a)) is None
+        if op == "+":
+            if isinstance(a, (bytes, str)) or isinstance(b, (bytes, str)):
+                return _to_bytes(a) + _to_bytes(b)
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return a / b
+        if op == "%":
+            return a % b
+    raise DslError(f"bad AST node {ast!r}")
+
+
+def build_env(response) -> dict[str, Any]:
+    """DSL variable environment for one :class:`Response`."""
+    body = response.part("body")
+    header = response.part("header")
+    return {
+        "body": body,
+        "header": header,
+        "all_headers": header,
+        "raw": response.part("raw"),
+        "status_code": response.status,
+        "content_length": response.content_length,
+        "host": response.host,
+        "port": response.port,
+        "duration": response.duration_s,
+        "interactsh_protocol": "",
+        "interactsh_request": "",
+    }
+
+
+def try_parse(text: str) -> Optional[tuple]:
+    try:
+        return parse_dsl(text)
+    except DslError:
+        return None

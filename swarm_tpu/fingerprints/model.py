@@ -1,0 +1,152 @@
+"""Parsed fingerprint-template model and the response record it matches.
+
+This is the framework-neutral form between the YAML corpus and the two
+match engines (exact CPU oracle in ``ops/cpu_ref.py``, tensor DB in
+``fingerprints/compile.py``). The matcher DSL surface mirrors what the
+reference corpus actually uses (SURVEY.md §2.3: word 6,895 / status
+2,558 / regex 1,779 / dsl 766 / kval 44 / json 23 / xpath 7 / binary 6;
+parts body/header/interactsh_protocol; and/or conditions; negative and
+named matchers; regex/kval extractors).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+# Matcher types understood by the engines. kval/json/xpath (74 uses in
+# the corpus) are parsed but marked unsupported-on-device; they evaluate
+# on the host path only.
+MATCHER_TYPES = (
+    "word",
+    "regex",
+    "status",
+    "size",
+    "binary",
+    "dsl",
+    "kval",
+    "json",
+    "xpath",
+)
+
+# Response parts a matcher can address. "all" = header + body. For raw
+# TCP (network templates) body/raw/all alias the banner bytes.
+PARTS = ("body", "header", "all", "raw", "interactsh_protocol", "host")
+
+
+@dataclasses.dataclass
+class Matcher:
+    type: str
+    part: str = "body"
+    words: list[str] = dataclasses.field(default_factory=list)
+    regex: list[str] = dataclasses.field(default_factory=list)
+    status: list[int] = dataclasses.field(default_factory=list)
+    size: list[int] = dataclasses.field(default_factory=list)
+    binary: list[str] = dataclasses.field(default_factory=list)  # hex strings
+    dsl: list[str] = dataclasses.field(default_factory=list)
+    kval: list[str] = dataclasses.field(default_factory=list)
+    condition: str = "or"  # across this matcher's words/regexes/...
+    negative: bool = False
+    case_insensitive: bool = False
+    name: Optional[str] = None
+
+    def payload_count(self) -> int:
+        return len(
+            self.words or self.regex or self.status or self.size or self.binary
+            or self.dsl or self.kval
+        )
+
+
+@dataclasses.dataclass
+class Extractor:
+    type: str  # regex | kval | json | xpath | dsl
+    part: str = "body"
+    name: Optional[str] = None
+    regex: list[str] = dataclasses.field(default_factory=list)
+    kval: list[str] = dataclasses.field(default_factory=list)
+    group: int = 0
+    internal: bool = False
+
+
+@dataclasses.dataclass
+class Operation:
+    """One request/probe block inside a template.
+
+    For http templates this is one ``requests`` entry (method + paths or
+    raw requests); for network templates one ``network`` entry (inputs +
+    hosts). The probe half is metadata consumed by the I/O front-end;
+    the matcher half is what the match engines evaluate against the
+    response.
+    """
+
+    matchers: list[Matcher] = dataclasses.field(default_factory=list)
+    matchers_condition: str = "or"
+    extractors: list[Extractor] = dataclasses.field(default_factory=list)
+    # --- probe metadata ---
+    method: Optional[str] = None
+    paths: list[str] = dataclasses.field(default_factory=list)
+    raw: list[str] = dataclasses.field(default_factory=list)
+    inputs: list[bytes] = dataclasses.field(default_factory=list)  # network send
+    hosts: list[str] = dataclasses.field(default_factory=list)
+    read_size: Optional[int] = None
+    redirects: bool = False
+    max_redirects: int = 0
+
+
+@dataclasses.dataclass
+class Template:
+    id: str
+    protocol: str  # http | network | dns | file | headless | ssl | workflow
+    severity: str = "info"
+    name: Optional[str] = None
+    tags: list[str] = dataclasses.field(default_factory=list)
+    operations: list[Operation] = dataclasses.field(default_factory=list)
+    source_path: Optional[str] = None
+    # Raw parsed YAML for fields the model doesn't lift (workflows etc.)
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def all_matchers(self) -> list[tuple[int, Matcher]]:
+        out = []
+        for op_idx, op in enumerate(self.operations):
+            out.extend((op_idx, m) for m in op.matchers)
+        return out
+
+
+@dataclasses.dataclass
+class Response:
+    """One observed (host, port) response row — the unit the engines match.
+
+    The TPU path batches these into fixed-shape padded arrays
+    (``ops/encoding.py``); the CPU oracle consumes them directly.
+    """
+
+    host: str = ""
+    port: int = 0
+    status: int = 0
+    body: bytes = b""
+    header: bytes = b""
+    duration_s: float = 0.0
+    # For raw TCP banners, set banner and leave body/header empty.
+    banner: Optional[bytes] = None
+
+    def part(self, name: str) -> bytes:
+        # Canonical part aliasing — MUST stay in lockstep with
+        # encoding.PART_TO_STREAM (which is derived from this table) so the
+        # oracle and the device agree on what every part name means.
+        if self.banner is not None and name in (
+            "body", "raw", "all", "data", "response", "body_1", "body_2",
+        ):
+            return self.banner
+        if name in ("body", "data", "body_1", "body_2"):
+            return self.body
+        if name in ("header", "all_headers"):
+            return self.header
+        if name in ("all", "raw", "response"):
+            return self.header + b"\r\n" + self.body if self.header else self.body
+        if name == "host":
+            return self.host.encode()
+        return b""
+
+    @property
+    def content_length(self) -> int:
+        return len(self.body if self.banner is None else self.banner)

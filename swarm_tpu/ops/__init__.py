@@ -1,0 +1,1 @@
+"""Match-engine compute: exact CPU oracle and XLA device kernels."""

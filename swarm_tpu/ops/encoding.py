@@ -1,0 +1,153 @@
+"""Response rows → fixed-shape uint8 device batches.
+
+XLA needs static shapes; scan responses are ragged byte strings. The
+strategy (SURVEY.md §5 "long-context"): pad each part stream (body /
+header / all) to a per-batch width, bucket batches by length class to
+bound padding waste, and flag rows whose parts were truncated — those
+rows are re-checked on the host so truncation can never cost a match
+(parity invariant).
+
+Part canonicalization: matcher ``part`` names map onto the three
+physical streams; unknown / out-of-band parts (``interactsh_protocol``
+etc.) map to None and their matchers evaluate constant-False on both
+engines, which keeps device and oracle agreeing exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from swarm_tpu.fingerprints.model import Response
+
+# Physical streams materialized per batch.
+STREAMS = ("body", "header", "all")
+
+# matcher part name -> physical stream. Must agree with
+# model.Response.part(): every alias here returns exactly that stream's
+# bytes from the oracle. Parts absent here and returning b"" from the
+# oracle (interactsh_* …) lower to constant-False on the device — the
+# same verdict the oracle computes on empty bytes can only differ for
+# negative matchers, which both engines evaluate consistently from the
+# same constant. 'host' is oracle-only (real bytes, no stream): matchers
+# on it are not device-loweable and force host-always.
+PART_TO_STREAM = {
+    "body": "body",
+    "data": "body",
+    "body_1": "body",
+    "body_2": "body",
+    "header": "header",
+    "all_headers": "header",
+    "all": "all",
+    "raw": "all",
+    "response": "all",
+}
+
+HOST_ONLY_PARTS = frozenset({"host"})
+
+
+def stream_for_part(part: str) -> Optional[str]:
+    return PART_TO_STREAM.get(part)
+
+
+def lower_bytes_np(a: np.ndarray) -> np.ndarray:
+    """ASCII-lowercase a uint8 array (matches bytes.lower() for ASCII)."""
+    is_upper = (a >= 65) & (a <= 90)
+    return np.where(is_upper, a + 32, a)
+
+
+@dataclasses.dataclass
+class ResponseBatch:
+    """Fixed-shape encoding of B response rows.
+
+    streams: dict stream -> uint8 [B, W_stream]
+    lengths: dict stream -> int32 [B] (true byte length, pre-truncation
+             lengths are in ``true_lengths`` for the truncation flag)
+    status:  int32 [B]
+    truncated: bool [B] — any stream lost bytes to the width cap; these
+             rows must be host-verified for exact parity.
+    """
+
+    streams: dict
+    lengths: dict
+    status: np.ndarray
+    truncated: np.ndarray
+    rows: list  # original Response objects (host fallback + reporting)
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.status.shape[0])
+
+
+def _encode_stream(
+    parts: Sequence[bytes], width: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    n = len(parts)
+    out = np.zeros((n, width), dtype=np.uint8)
+    lens = np.zeros((n,), dtype=np.int32)
+    trunc = np.zeros((n,), dtype=bool)
+    for i, blob in enumerate(parts):
+        if len(blob) > width:
+            trunc[i] = True
+            blob = blob[:width]
+        lens[i] = len(blob)
+        if blob:
+            out[i, : len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+    return out, lens, trunc
+
+
+def round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def pick_width(parts: Sequence[bytes], max_width: int, multiple: int = 128) -> int:
+    """Bucket width: smallest lane-aligned width covering the batch,
+    capped at ``max_width`` (beyond which rows are truncated + host-flagged)."""
+    longest = max((len(p) for p in parts), default=0)
+    return max(multiple, min(max_width, round_up(max(longest, 1), multiple)))
+
+
+def encode_batch(
+    rows: Sequence[Response],
+    max_body: int = 4096,
+    max_header: int = 1024,
+    pad_rows_to: Optional[int] = None,
+) -> ResponseBatch:
+    """Encode responses into the three padded streams.
+
+    ``pad_rows_to`` pads the batch dimension (with empty rows) so the
+    jitted kernel sees a small set of static batch shapes.
+    """
+    rows = list(rows)
+    n_real = len(rows)
+    if pad_rows_to is not None and pad_rows_to > n_real:
+        rows = rows + [Response()] * (pad_rows_to - n_real)
+
+    bodies = [r.part("body") for r in rows]
+    headers = [r.part("header") for r in rows]
+    alls = [r.part("all") for r in rows]
+
+    streams: dict[str, np.ndarray] = {}
+    lengths: dict[str, np.ndarray] = {}
+    trunc_any = np.zeros((len(rows),), dtype=bool)
+    for name, parts, cap in (
+        ("body", bodies, max_body),
+        ("header", headers, max_header),
+        ("all", alls, max_body + max_header),
+    ):
+        width = pick_width(parts, cap)
+        arr, lens, trunc = _encode_stream(parts, width)
+        streams[name] = arr
+        lengths[name] = lens
+        trunc_any |= trunc
+
+    status = np.array([r.status for r in rows], dtype=np.int32)
+    return ResponseBatch(
+        streams=streams,
+        lengths=lengths,
+        status=status,
+        truncated=trunc_any,
+        rows=rows[:n_real],
+    )

@@ -1,0 +1,141 @@
+"""MatchEngine: the user-facing exact fingerprint engine.
+
+Composes the pieces: template corpus → CompiledDB (once), responses →
+padded batches → device kernel → sparse host confirmation with the
+exact CPU oracle. The result is bit-identical to running the oracle on
+every (row, template) pair — the device does ~all the work, the host
+touches only uncertain pairs that actually fired and the (small,
+reported) host-always template tail.
+
+This replaces the reference worker's subprocess shell-outs to
+nmap/-sV//nuclei (``worker/worker.py:79-84``) as the compute engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from swarm_tpu.fingerprints.compile import CompiledDB, compile_corpus
+from swarm_tpu.fingerprints.model import Response, Template
+from swarm_tpu.ops import cpu_ref
+from swarm_tpu.ops.encoding import encode_batch
+from swarm_tpu.ops.match import DeviceDB
+
+
+@dataclasses.dataclass
+class RowMatches:
+    """Exact match set for one response row."""
+
+    template_ids: list
+    extractions: dict  # template_id -> list[str]
+    confirmed_on_host: int = 0  # uncertain pairs the host re-checked
+
+
+@dataclasses.dataclass
+class EngineStats:
+    rows: int = 0
+    batches: int = 0
+    device_seconds: float = 0.0
+    host_confirm_seconds: float = 0.0
+    host_confirm_pairs: int = 0
+    host_always_pairs: int = 0
+    overflow_rows: int = 0
+
+
+class MatchEngine:
+    def __init__(
+        self,
+        templates: Sequence[Template],
+        max_body: int = 4096,
+        max_header: int = 1024,
+        batch_rows: int = 1024,
+        candidate_k: int = 128,
+        host_always: str = "full",  # "full" (exact) | "skip" (device-only)
+    ):
+        self.templates = list(templates)
+        self.db: CompiledDB = compile_corpus(self.templates)
+        self.device = DeviceDB(self.db, candidate_k=candidate_k)
+        self.max_body = max_body
+        self.max_header = max_header
+        self.batch_rows = batch_rows
+        self.host_always_mode = host_always
+        self.stats = EngineStats()
+        # templates with regex extractors need a host pass on *hits* even
+        # when the verdict itself was device-certain, so extraction output
+        # stays bit-identical to the oracle
+        self._has_extractors = [
+            any(ex.type == "regex" for op in t.operations for ex in op.extractors)
+            for t in self.db.templates
+        ]
+
+    # ------------------------------------------------------------------
+    def match(self, responses: Sequence[Response]) -> list[RowMatches]:
+        out: list[RowMatches] = []
+        for start in range(0, len(responses), self.batch_rows):
+            out.extend(self._match_batch(responses[start : start + self.batch_rows]))
+        return out
+
+    # ------------------------------------------------------------------
+    def _match_batch(self, rows: Sequence[Response]) -> list[RowMatches]:
+        batch = encode_batch(rows, max_body=self.max_body, max_header=self.max_header)
+        t0 = time.perf_counter()
+        t_value, t_unc, overflow = self.device.match(
+            batch.streams, batch.lengths, batch.status
+        )
+        t_value = np.asarray(t_value)
+        t_unc = np.asarray(t_unc)
+        overflow = np.asarray(overflow)
+        self.stats.device_seconds += time.perf_counter() - t0
+        self.stats.rows += len(rows)
+        self.stats.batches += 1
+
+        # rows needing whole-row reconfirmation (candidate overflow or
+        # stream truncation made word bits unsound for the row)
+        row_redo = overflow | batch.truncated[: len(rows)]
+        self.stats.overflow_rows += int(row_redo.sum())
+
+        t1 = time.perf_counter()
+        results: list[RowMatches] = []
+        for b, row in enumerate(rows):
+            matched: list[str] = []
+            extractions: dict = {}
+            confirmed = 0
+            for t_idx, template in enumerate(self.db.templates):
+                if row_redo[b] or t_unc[b, t_idx]:
+                    res = cpu_ref.match_template(template, row)
+                    confirmed += 1
+                    hit = res.matched
+                    if hit and res.extractions:
+                        extractions[template.id] = res.extractions
+                else:
+                    hit = bool(t_value[b, t_idx])
+                    if hit and self._has_extractors[t_idx]:
+                        res = cpu_ref.match_template(template, row)
+                        confirmed += 1
+                        if res.extractions:
+                            extractions[template.id] = res.extractions
+                if hit:
+                    matched.append(template.id)
+            self.stats.host_confirm_pairs += confirmed
+            # host-always tail: templates the compiler couldn't lower
+            if self.host_always_mode == "full":
+                for template in self.db.host_always:
+                    res = cpu_ref.match_template(template, row)
+                    self.stats.host_always_pairs += 1
+                    if res.matched:
+                        matched.append(template.id)
+                        if res.extractions:
+                            extractions[template.id] = res.extractions
+            results.append(
+                RowMatches(
+                    template_ids=matched,
+                    extractions=extractions,
+                    confirmed_on_host=confirmed,
+                )
+            )
+        self.stats.host_confirm_seconds += time.perf_counter() - t1
+        return results

@@ -1,0 +1,95 @@
+"""Rolling q-gram hashes shared by the compiler (numpy) and kernels (jnp).
+
+The device never runs a general string-search: fixed-length q-grams of
+each pattern are hashed at compile time into sorted tables + a Bloom
+bitmap, and at match time the same hash is computed for every window
+position of the response streams with q shifted multiply-adds (pure
+vector ops, no gathers). Window hits are then verified exactly.
+
+Both sides MUST compute identical values, so the polynomial and bases
+live here: H(b, i) = Σ_{j<q} b[i+j]·r^j (mod 2^32), two independent
+bases per gram size (h1 indexes the table, h2 kills collisions before
+the exact byte verify).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Odd multipliers (invertible mod 2^32), chosen independently per role.
+BASE1 = np.uint32(0x01000193)  # FNV-ish
+BASE2 = np.uint32(0x85EBCA77)
+GRAM_LONG = 8  # words >= 8 bytes hash an 8-gram
+GRAM_SHORT = 4  # words 4..7 bytes hash a 4-gram
+TINY_MAX = GRAM_SHORT - 1  # words 1..3 bytes take the dense-compare path
+
+BLOOM_BITS = 1 << 18  # 32 KiB bitmap per table: ~0.3% window FP at 7.5k words
+BLOOM_WORDS = BLOOM_BITS // 32
+
+
+def _powers(base: np.uint32, q: int) -> np.ndarray:
+    out = np.ones(q, dtype=np.uint64)
+    for j in range(1, q):
+        out[j] = (out[j - 1] * np.uint64(base)) & np.uint64(0xFFFFFFFF)
+    return out
+
+
+def gram_hash_np(data: bytes | np.ndarray, q: int) -> tuple[int, int]:
+    """Hash the first q bytes of ``data`` (compile-time side)."""
+    arr = np.frombuffer(bytes(data[:q]), dtype=np.uint8).astype(np.uint64)
+    assert arr.shape[0] == q, "gram shorter than q"
+    p1, p2 = _powers(BASE1, q), _powers(BASE2, q)
+    h1 = int((arr * p1).sum() & np.uint64(0xFFFFFFFF))
+    h2 = int((arr * p2).sum() & np.uint64(0xFFFFFFFF))
+    return h1, h2
+
+
+def window_hashes_jnp(stream, q: int):
+    """[B, W] uint8 → ([B, W] uint32 h1, [B, W] uint32 h2).
+
+    Position i holds the hash of bytes[i:i+q] (windows running past W
+    hash into zero padding; they can only ever produce candidates that
+    the exact verify rejects).
+    """
+    import jax.numpy as jnp
+
+    b = stream.astype(jnp.uint32)
+    B, W = b.shape
+    padded = jnp.pad(b, ((0, 0), (0, q)))
+    p1 = _powers(BASE1, q)
+    p2 = _powers(BASE2, q)
+    h1 = jnp.zeros((B, W), dtype=jnp.uint32)
+    h2 = jnp.zeros((B, W), dtype=jnp.uint32)
+    for j in range(q):  # unrolled: q static shifted multiply-adds
+        window = padded[:, j : j + W]
+        h1 = h1 + window * jnp.uint32(int(p1[j]))
+        h2 = h2 + window * jnp.uint32(int(p2[j]))
+    return h1, h2
+
+
+def bloom_indices_np(h1: np.ndarray, h2: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    mask = BLOOM_BITS - 1
+    return (h1 & mask).astype(np.int64), (h2 & mask).astype(np.int64)
+
+
+def build_bloom_np(h1s: np.ndarray, h2s: np.ndarray) -> np.ndarray:
+    """Pack table-side bloom bitmap: uint32 [BLOOM_WORDS]."""
+    bitmap = np.zeros(BLOOM_WORDS, dtype=np.uint32)
+    i1, i2 = bloom_indices_np(np.asarray(h1s, np.uint32), np.asarray(h2s, np.uint32))
+    for idx in np.concatenate([i1, i2]):
+        bitmap[idx >> 5] |= np.uint32(1) << np.uint32(idx & 31)
+    return bitmap
+
+
+def bloom_probe_jnp(bitmap, h1, h2):
+    """Window-side probe: both bits must be set."""
+    import jax.numpy as jnp
+
+    mask = jnp.uint32(BLOOM_BITS - 1)
+    i1 = (h1 & mask).astype(jnp.int32)
+    i2 = (h2 & mask).astype(jnp.int32)
+    w1 = bitmap[i1 >> 5]
+    w2 = bitmap[i2 >> 5]
+    bit1 = (w1 >> (i1 & 31).astype(jnp.uint32)) & 1
+    bit2 = (w2 >> (i2 & 31).astype(jnp.uint32)) & 1
+    return (bit1 & bit2) == 1

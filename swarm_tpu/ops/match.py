@@ -1,0 +1,297 @@
+"""Device match kernel: response streams → word-slot bits → verdicts.
+
+Pure jnp/XLA (a fused Pallas variant comes later); everything is static
+shape, vector ops, gathers from small tables, and a handful of scatters.
+Pipeline per batch (design in fingerprints/compile.py docstring):
+
+1. Rolling q-gram hashes of each (stream, case) in use — shifted
+   multiply-adds only.
+2. Per word-table: Bloom probe every window (2 gathers from a 32 KiB
+   bitmap), top-k the surviving windows, binary-search the sorted h1
+   groups, then check entry h2 + suffix-gram h1/h2 and position bounds.
+   Hits scatter into a word-slot bit vector; all q-gram hits are marked
+   *uncertain* (host confirms sparse hits — exactness contract).
+3. Tiny slots (1–3 bytes) evaluate by dense shifted compare — exact.
+4. Verdict lowering: slot buckets → matcher bits (and/or + negation),
+   scalar programs (status/size/len dsl), then op and template
+   reductions. Uncertainty propagates alongside values.
+
+The kernel's guarantee: a (row, template) pair whose uncertain bit is
+clear has the exact oracle verdict; uncertain pairs carry a superset
+signal and only ever need host confirmation when something *fired*.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from swarm_tpu.fingerprints import compile as fpc
+from swarm_tpu.ops import hashing
+from swarm_tpu.ops.encoding import STREAMS
+
+
+class DeviceDB:
+    """CompiledDB uploaded to device + the jitted match function.
+
+    The numpy tables become jnp constants captured in the traced
+    function; re-tracing happens per distinct batch shape (width
+    buckets keep that to a handful of shapes).
+    """
+
+    def __init__(self, db: fpc.CompiledDB, candidate_k: int = 128):
+        self.db = db
+        self.candidate_k = candidate_k
+        self._fn_cache: dict = {}
+
+    def match(self, streams: dict, lengths: dict, status):
+        """streams: name → uint8 [B, W]; lengths: name → int32 [B].
+
+        Returns (t_value [B, NT] bool, t_uncertain [B, NT] bool,
+        overflow [B] bool).
+        """
+        shape_key = tuple(sorted((k, v.shape) for k, v in streams.items()))
+        fn = self._fn_cache.get(shape_key)
+        if fn is None:
+            fn = jax.jit(functools.partial(_match_impl, self.db, self.candidate_k))
+            self._fn_cache[shape_key] = fn
+        return fn(
+            {k: jnp.asarray(v) for k, v in streams.items()},
+            {k: jnp.asarray(v) for k, v in lengths.items()},
+            jnp.asarray(status),
+        )
+
+
+def _lower_stream(arr):
+    is_upper = (arr >= 65) & (arr <= 90)
+    return jnp.where(is_upper, arr + 32, arr)
+
+
+def _shifted(stream, q: int):
+    """padded shifted views for window ops."""
+    B, W = stream.shape
+    padded = jnp.pad(stream, ((0, 0), (0, q)))
+    return [padded[:, j : j + W] for j in range(q)]
+
+
+def match_slots(db: fpc.CompiledDB, candidate_k: int, streams, lengths):
+    """→ (value_bits [B, NS] bool, uncertain_bits [B, NS] bool, overflow [B])."""
+    ns = db.num_slots
+    some = next(iter(streams.values()))
+    B = some.shape[0]
+    value_bits = jnp.zeros((B, max(ns, 1)), dtype=bool)
+    uncertain_bits = jnp.zeros((B, max(ns, 1)), dtype=bool)
+    overflow = jnp.zeros((B,), dtype=bool)
+
+    # --- cached lowered streams and hash arrays ---
+    lowered_cache: dict = {}
+
+    def get_stream(name: str, lowered: bool):
+        if not lowered:
+            return streams[name]
+        if name not in lowered_cache:
+            lowered_cache[name] = _lower_stream(streams[name])
+        return lowered_cache[name]
+
+    hash_cache: dict = {}
+
+    def get_hashes(name: str, lowered: bool, q: int):
+        key = (name, lowered, q)
+        if key not in hash_cache:
+            hash_cache[key] = hashing.window_hashes_jnp(get_stream(name, lowered), q)
+        return hash_cache[key]
+
+    # --- q-gram tables ---
+    for table in db.tables:
+        h1, h2 = get_hashes(table.stream, table.lowered, table.q)
+        W = h1.shape[1]
+        slen = jnp.minimum(lengths[table.stream], W)
+
+        flags = hashing.bloom_probe_jnp(jnp.asarray(table.bloom), h1, h2)
+        # windows starting past slen - q can't begin a real gram
+        positions = jnp.arange(W, dtype=jnp.int32)
+        flags = flags & (positions[None, :] <= (slen - table.q)[:, None])
+
+        k = min(candidate_k, W)
+        vals = jnp.where(flags, positions[None, :] + 1, 0)
+        top_vals, _ = jax.lax.top_k(vals, k)
+        pos = top_vals - 1  # -1 = invalid
+        valid = pos >= 0
+        cpos = jnp.maximum(pos, 0)
+        overflow = overflow | (jnp.sum(flags, axis=1) > k)
+
+        h1c = jnp.take_along_axis(h1, cpos, axis=1)
+        h2c = jnp.take_along_axis(h2, cpos, axis=1)
+
+        group_h1 = jnp.asarray(table.group_h1)
+        gidx = jnp.searchsorted(group_h1, h1c)
+        G = table.num_groups
+        gidx_c = jnp.minimum(gidx, G - 1)
+        found = valid & (group_h1[gidx_c] == h1c)
+
+        e_start = jnp.asarray(table.entry_start)[gidx_c]
+        e_count = jnp.asarray(table.entry_count)[gidx_c]
+        entry_h2 = jnp.asarray(table.entry_h2)
+        entry_slot = jnp.asarray(table.entry_slot)
+        entry_off = jnp.asarray(table.entry_off)
+        entry_len = jnp.asarray(table.entry_len)
+        entry_sufd = jnp.asarray(table.entry_suf_delta)
+        entry_sufh1 = jnp.asarray(table.entry_suf_h1)
+        entry_sufh2 = jnp.asarray(table.entry_suf_h2)
+
+        b_idx = jnp.arange(B, dtype=jnp.int32)[:, None] * jnp.ones(
+            (1, k), dtype=jnp.int32
+        )
+
+        for g in range(table.max_group):
+            e = jnp.minimum(e_start + g, entry_h2.shape[0] - 1)
+            in_group = found & (g < e_count)
+            h2_ok = entry_h2[e] == h2c
+            # suffix-gram check from the same rolling-hash arrays
+            spos = cpos + entry_sufd[e]
+            spos_c = jnp.clip(spos, 0, W - 1)
+            suf_ok = (
+                (jnp.take_along_axis(h1, spos_c, axis=1) == entry_sufh1[e])
+                & (jnp.take_along_axis(h2, spos_c, axis=1) == entry_sufh2[e])
+                & (spos >= 0)
+                & (spos < W)
+            )
+            start = cpos - entry_off[e]
+            fits = (start >= 0) & (start + entry_len[e] <= slen[:, None])
+            hit = in_group & h2_ok & suf_ok & fits
+            slot = entry_slot[e]
+            value_bits = value_bits.at[b_idx, slot].max(hit)
+            uncertain_bits = uncertain_bits.at[b_idx, slot].max(hit)
+
+    # --- tiny slots: dense shifted compare (exact) ---
+    tiny_count = int((np.asarray(db.tiny_len) > 0).sum())
+    shift_cache: dict = {}
+    for i in range(tiny_count):
+        length = int(db.tiny_len[i])
+        slot_id = int(db.tiny_slot[i])
+        stream_name = STREAMS[int(db.tiny_stream[i])]
+        lowered = bool(db.tiny_lowered[i])
+        skey = (stream_name, lowered)
+        if skey not in shift_cache:
+            shift_cache[skey] = _shifted(
+                get_stream(stream_name, lowered), hashing.TINY_MAX
+            )
+        shifts = shift_cache[skey]
+        W = shifts[0].shape[1]
+        positions = jnp.arange(W, dtype=jnp.int32)
+        eq = jnp.ones_like(shifts[0], dtype=bool)
+        for j in range(length):
+            eq = eq & (shifts[j] == int(db.tiny_bytes[i, j]))
+        slen = jnp.minimum(lengths[stream_name], W)
+        eq = eq & (positions[None, :] <= (slen - length)[:, None])
+        hit = eq.any(axis=1)
+        value_bits = value_bits.at[:, slot_id].max(hit)
+
+    return value_bits, uncertain_bits, overflow
+
+
+def eval_verdicts(db: fpc.CompiledDB, value_bits, uncertain_bits, lengths, status):
+    """Slot bits + scalars → (t_value, t_uncertain) [B, NT] bool."""
+    B = status.shape[0]
+    NM = db.m_kind.shape[0]
+
+    len_body = lengths["body"].astype(jnp.float32)
+    len_header = lengths["header"].astype(jnp.float32)
+    len_all = lengths["all"].astype(jnp.float32)
+    svars = jnp.stack(
+        [status.astype(jnp.float32), len_body, len_header, len_all, len_body],
+        axis=1,
+    )  # [B, SCALAR_VARS]
+
+    # --- slot reductions (vacuously true when a matcher has no slots) ---
+    slot_red = jnp.ones((B, NM), dtype=bool)
+    m_unc = jnp.zeros((B, NM), dtype=bool)
+    cond_and = jnp.asarray(db.m_cond_and)
+    for bucket in db.m_slot_buckets:
+        gv = value_bits[:, bucket.idx]  # [B, nb, w]
+        gu = uncertain_bits[:, bucket.idx]
+        rows = jnp.asarray(bucket.rows)
+        red = jnp.where(cond_and[rows][None, :], gv.all(-1), gv.any(-1))
+        slot_red = slot_red.at[:, rows].set(red)
+        m_unc = m_unc.at[:, rows].set(gu.any(-1))
+
+    # --- scalar programs ---
+    var_id = db.m_scalar[:, :, 0].astype(np.int32)  # [NM, C] static
+    op_id = db.m_scalar[:, :, 1].astype(np.int32)
+    cmp_val = jnp.asarray(db.m_scalar[:, :, 2])  # [NM, C] f32
+    v = svars[:, var_id]  # [B, NM, C]
+    checks = [
+        v == cmp_val,  # SOP_EQ
+        v != cmp_val,
+        v < cmp_val,
+        v > cmp_val,
+        v <= cmp_val,
+        v >= cmp_val,
+        jnp.ones_like(v, dtype=bool),  # SOP_TRUE
+    ]
+    conj = jnp.select(
+        [op_id[None] == i for i in range(len(checks))], checks, default=False
+    )
+    scalar_ok = conj.all(-1)  # [B, NM]
+
+    # --- status / size matchers ---
+    status_ok = (status[:, None, None] == jnp.asarray(db.m_status)[None]).any(-1)
+    len_streams = jnp.stack(
+        [lengths["body"], lengths["header"], lengths["all"]], axis=1
+    )  # [B, 3]
+    size_sel = len_streams[:, db.m_size_stream]  # [B, NM]
+    size_ok = (size_sel[:, :, None] == jnp.asarray(db.m_size)[None]).any(-1)
+
+    kind = db.m_kind  # static numpy
+    is_words = jnp.asarray((kind == fpc.MK_WORDS) | (kind == fpc.MK_REGEX_PREFILTER))
+    is_scalar = jnp.asarray(kind == fpc.MK_SCALAR_DSL)
+    is_status = jnp.asarray(kind == fpc.MK_STATUS)
+    is_size = jnp.asarray(kind == fpc.MK_SIZE)
+
+    m_value = jnp.zeros((B, NM), dtype=bool)
+    m_value = jnp.where(is_words[None, :], slot_red, m_value)
+    m_value = jnp.where(is_scalar[None, :], scalar_ok & slot_red, m_value)
+    m_value = jnp.where(is_status[None, :], status_ok, m_value)
+    m_value = jnp.where(is_size[None, :], size_ok, m_value)
+
+    # md5-style residues: a scalar pass still needs host confirmation
+    m_unc = m_unc | (jnp.asarray(db.m_residue)[None, :] & m_value)
+    # negation after uncertainty capture
+    m_value = m_value ^ jnp.asarray(db.m_negative)[None, :]
+
+    # --- operations ---
+    NOP = db.op_cond_and.shape[0]
+    op_value = jnp.zeros((B, NOP), dtype=bool)
+    op_unc = jnp.zeros((B, NOP), dtype=bool)
+    op_cond = jnp.asarray(db.op_cond_and)
+    for bucket in db.op_m_buckets:
+        gv = m_value[:, bucket.idx]
+        gu = m_unc[:, bucket.idx]
+        rows = jnp.asarray(bucket.rows)
+        red = jnp.where(op_cond[rows][None, :], gv.all(-1), gv.any(-1))
+        op_value = op_value.at[:, rows].set(red)
+        op_unc = op_unc.at[:, rows].set(gu.any(-1))
+
+    # --- templates: OR over their operations ---
+    NT = max(db.num_templates, 1)
+    t_value = jnp.zeros((B, NT), dtype=bool)
+    t_unc = jnp.zeros((B, NT), dtype=bool)
+    for bucket in db.t_op_buckets:
+        gv = op_value[:, bucket.idx]
+        gu = op_unc[:, bucket.idx]
+        rows = jnp.asarray(bucket.rows)
+        t_value = t_value.at[:, rows].set(gv.any(-1))
+        t_unc = t_unc.at[:, rows].set(gu.any(-1))
+    return t_value, t_unc
+
+
+def _match_impl(db: fpc.CompiledDB, candidate_k: int, streams, lengths, status):
+    value_bits, uncertain_bits, overflow = match_slots(
+        db, candidate_k, streams, lengths
+    )
+    t_value, t_unc = eval_verdicts(db, value_bits, uncertain_bits, lengths, status)
+    return t_value, t_unc, overflow

@@ -231,7 +231,12 @@ class LocalBlobStore(BlobStore):
         root = self._root.resolve()
         # Walk only the deepest existing directory implied by the prefix,
         # then string-filter the remainder — not the whole store.
-        base_dir = (root / prefix).parent if not prefix.endswith("/") else root / prefix
+        if not prefix or prefix.endswith("/"):
+            base_dir = (root / prefix).resolve()
+        else:
+            base_dir = (root / prefix).resolve().parent
+        if not base_dir.is_relative_to(root):
+            base_dir = root
         if not base_dir.is_dir():
             return []
         out = []

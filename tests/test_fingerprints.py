@@ -1,0 +1,179 @@
+from pathlib import Path
+
+import pytest
+
+from swarm_tpu.fingerprints import load_corpus, model
+from swarm_tpu.fingerprints import dslc
+from swarm_tpu.ops import cpu_ref
+
+DATA = Path(__file__).parent / "data" / "templates"
+REFERENCE_CORPUS = Path("/root/reference/worker/artifacts/templates")
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    templates, errors = load_corpus(DATA)
+    assert not errors, errors
+    return {t.id: t for t in templates}
+
+
+def test_parse_http_template(corpus):
+    t = corpus["demo-panel"]
+    assert t.protocol == "http"
+    assert t.severity == "info"
+    assert "panel" in t.tags
+    [op] = t.operations
+    assert op.matchers_condition == "and"
+    assert [m.type for m in op.matchers] == ["word", "status"]
+    assert op.matchers[0].condition == "and"
+    assert op.matchers[1].status == [200, 401]
+    [ex] = op.extractors
+    assert ex.group == 1
+
+
+def test_parse_network_template(corpus):
+    t = corpus["demo-banner"]
+    assert t.protocol == "network"
+    [op] = t.operations
+    assert op.inputs == [b"HELLO\r\n"]
+    assert "{{Host}}:7777" in op.hosts
+    assert op.matchers[1].type == "binary"
+
+
+# ---------------------------------------------------------------------------
+# DSL
+# ---------------------------------------------------------------------------
+
+
+def test_dsl_parse_and_eval():
+    ast = dslc.parse_dsl('len(body)==4 && status_code==200 && md5(body)=="098f6bcd4621d373cade4e832627b4f6"')
+    env = {"body": b"test", "status_code": 200}
+    assert dslc.evaluate(ast, env) is True
+    env2 = {"body": b"nope", "status_code": 200}
+    assert dslc.evaluate(ast, env2) is False
+
+
+def test_dsl_operators():
+    cases = [
+        ("1+2*3 == 7", {}, True),
+        ("!contains(body, \"x\") || status_code>=500", {"body": b"abc", "status_code": 200}, True),
+        ("tolower(body) == \"abc\"", {"body": b"AbC"}, True),
+        ('body =~ "ab+c"', {"body": b"xabbbc"}, True),
+        ('"500" == status_code', {"status_code": 500}, True),
+        ("len(body)>1000 && len(body)<2000", {"body": b"a" * 1500}, True),
+    ]
+    for text, env, expected in cases:
+        assert dslc.evaluate(dslc.parse_dsl(text), env) is expected, text
+
+
+def test_dsl_mmh3_matches_known_value():
+    # mmh3("") == 0; known vector: mmh3("hello") signed 32-bit
+    assert dslc._mmh3_32(b"") == 0
+    assert dslc._mmh3_32(b"hello") == 613153351
+
+
+def test_dsl_unparseable_returns_none():
+    assert dslc.try_parse("len(body") is None
+
+
+# ---------------------------------------------------------------------------
+# CPU oracle
+# ---------------------------------------------------------------------------
+
+
+def make_response(**kw):
+    defaults = dict(
+        host="10.0.0.1",
+        port=443,
+        status=200,
+        body=b"<html><title>Demo Admin</title> powered by acmecms demo-build 3.11</html>",
+        header=b"HTTP/1.1 200 OK\r\nServer: demo\r\nX-Widget-Version: 2.41",
+    )
+    defaults.update(kw)
+    return model.Response(**defaults)
+
+
+def test_oracle_and_condition_template(corpus):
+    t = corpus["demo-panel"]
+    hit = cpu_ref.match_template(t, make_response())
+    assert hit.matched
+    assert hit.extractions == ["3.11"]
+    # status not in list -> and-condition fails
+    miss = cpu_ref.match_template(t, make_response(status=500))
+    assert not miss.matched
+    # one of the two and'd words missing -> fails
+    miss2 = cpu_ref.match_template(
+        t, make_response(body=b"<title>Demo Admin</title> only")
+    )
+    assert not miss2.matched
+
+
+def test_oracle_or_named_matchers(corpus):
+    t = corpus["demo-tech"]
+    r = make_response()
+    hit = cpu_ref.match_template(t, r)
+    assert hit.matched
+    # case-insensitive word + header regex + negative matcher all fire
+    assert set(hit.matcher_names) == {"acme-cms", "widgetd", "not-maintenance"}
+    # negative matcher flips when the word appears
+    r2 = make_response(body=b"site in maintenance mode")
+    hit2 = cpu_ref.match_template(t, r2)
+    assert "not-maintenance" not in hit2.matcher_names
+
+
+def test_oracle_network_banner(corpus):
+    t = corpus["demo-banner"]
+    r = model.Response(host="10.0.0.2", port=7777, banner=b"DEMOD: 31.5 ready")
+    hit = cpu_ref.match_template(t, r)
+    assert hit.matched  # word "DEMOD: 3" and binary 44454d4f ("DEMO")
+    r2 = model.Response(host="10.0.0.2", port=7777, banner=b"SSH-2.0-OpenSSH")
+    assert not cpu_ref.match_template(t, r2).matched
+
+
+def test_oracle_dsl_favicon(corpus):
+    t = corpus["demo-favicon"]
+    hit = cpu_ref.match_template(t, make_response(body=b"0123456789abcdef"))
+    assert hit.matched and hit.matcher_names == ["acme-appliance"]
+    hit2 = cpu_ref.match_template(t, make_response(body=b"z" * 1500))
+    assert hit2.matched and hit2.matcher_names == ["sized"]
+    assert not cpu_ref.match_template(t, make_response(body=b"tiny")).matched
+
+
+# ---------------------------------------------------------------------------
+# Real reference corpus (data-only; read-only mount)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not REFERENCE_CORPUS.is_dir(), reason="reference corpus absent")
+def test_parse_reference_network_templates():
+    templates, errors = load_corpus(REFERENCE_CORPUS / "network")
+    assert len(templates) >= 30
+    assert not errors, errors[:3]
+    rsync = [t for t in templates if t.id == "detect-rsyncd"]
+    assert rsync, "detect-rsyncd should parse"
+    [t] = rsync
+    [op] = t.operations
+    assert op.inputs == [b"?\r\n"]
+    assert op.matchers[0].condition == "and"
+
+
+@pytest.mark.skipif(not REFERENCE_CORPUS.is_dir(), reason="reference corpus absent")
+def test_parse_reference_technologies():
+    templates, errors = load_corpus(REFERENCE_CORPUS / "technologies")
+    ids = {t.id for t in templates}
+    assert "tech-detect" in ids and "favicon-detection" in ids
+    tech = next(t for t in templates if t.id == "tech-detect")
+    matchers = [m for _, m in tech.all_matchers()]
+    assert len(matchers) > 400
+    assert all(m.name for m in matchers)
+
+
+@pytest.mark.skipif(not REFERENCE_CORPUS.is_dir(), reason="reference corpus absent")
+def test_oracle_on_reference_rsyncd_banner():
+    templates, _ = load_corpus(REFERENCE_CORPUS / "network")
+    rsyncd = next(t for t in templates if t.id == "detect-rsyncd")
+    r = model.Response(host="h", port=873, banner=b"@RSYNCD: 31.0\nERROR: protocol startup error\n")
+    assert cpu_ref.match_template(rsyncd, r).matched
+    r2 = model.Response(host="h", port=873, banner=b"@RSYNCD: 31.0\n")
+    # and-condition requires both words
+    assert not cpu_ref.match_template(rsyncd, r2).matched

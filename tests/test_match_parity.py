@@ -1,0 +1,149 @@
+"""Device-engine ↔ CPU-oracle parity — the backbone metric (BASELINE.md:
+"100% match parity with the CPU module path").
+
+Responses are crafted adversarially: template payload words embedded at
+random positions (including stream start/end boundaries), case flips,
+statuses drawn from corpus matchers, bodies with exact dsl lengths.
+Any (row, template) disagreement between MatchEngine and the oracle is
+a failure.
+"""
+
+import random
+from pathlib import Path
+
+import pytest
+
+from swarm_tpu.fingerprints import load_corpus, model
+from swarm_tpu.ops import cpu_ref
+from swarm_tpu.ops.engine import MatchEngine
+
+DATA = Path(__file__).parent / "data" / "templates"
+REFERENCE_CORPUS = Path("/root/reference/worker/artifacts/templates")
+
+
+def corpus_words(templates, rng, n):
+    """Sample word payloads from the corpus to embed in responses."""
+    words = []
+    for t in templates:
+        for _, m in t.all_matchers():
+            words.extend(m.words)
+    words = [w for w in words if w]
+    return [rng.choice(words) for _ in range(min(n, len(words)) and n)] if words else []
+
+
+def fuzz_rows(templates, rng, count):
+    words = corpus_words(templates, rng, 400)
+    statuses = [200, 200, 404, 401, 500, 302, 301, 403]
+    filler = (
+        b"<html><head><title>srv</title></head><body>welcome to the page "
+        b"lorem ipsum dolor sit amet consectetur adipiscing elit sed do "
+    )
+    rows = []
+    for i in range(count):
+        body = bytearray()
+        # random filler with embedded corpus words
+        for _ in range(rng.randint(0, 6)):
+            body += filler[: rng.randint(5, len(filler))]
+            if words:
+                w = rng.choice(words).encode("utf-8", "surrogateescape")
+                if rng.random() < 0.3:
+                    w = w.upper() if rng.random() < 0.5 else w.lower()
+                body += w
+        if rng.random() < 0.2 and words:
+            # boundary placement: word at the very start or very end
+            w = rng.choice(words).encode("utf-8", "surrogateescape")
+            body = bytearray(w) + body if rng.random() < 0.5 else body + w
+        header = b"HTTP/1.1 200 OK\r\nServer: nginx\r\nContent-Type: text/html"
+        if rng.random() < 0.3 and words:
+            header += b"\r\nX-Extra: " + rng.choice(words).encode("utf-8", "surrogateescape")
+        if rng.random() < 0.15:
+            rows.append(
+                model.Response(
+                    host=f"10.0.0.{i}", port=7777, banner=bytes(body) or b"\x00banner"
+                )
+            )
+        else:
+            rows.append(
+                model.Response(
+                    host=f"10.0.0.{i}",
+                    port=443,
+                    status=rng.choice(statuses),
+                    body=bytes(body),
+                    header=header,
+                )
+            )
+    return rows
+
+
+def assert_parity(templates, rows, **engine_kw):
+    eng = MatchEngine(templates, **engine_kw)
+    got = eng.match(rows)
+    for b, row in enumerate(rows):
+        expected = sorted(
+            t.id for t in templates if cpu_ref.match_template(t, row).matched
+        )
+        actual = sorted(got[b].template_ids)
+        assert actual == expected, (
+            f"row {b} ({row.host}): device={actual} oracle={expected} "
+            f"diff +{set(actual)-set(expected)} -{set(expected)-set(actual)}"
+        )
+    return eng
+
+
+def test_parity_synthetic_corpus():
+    templates, errors = load_corpus(DATA)
+    assert not errors
+    rng = random.Random(7)
+    rows = fuzz_rows(templates, rng, 60)
+    # deliberate exact-dsl rows
+    rows.append(model.Response(host="f", port=80, status=200, body=b"0123456789abcdef"))
+    rows.append(model.Response(host="g", port=80, status=200, body=b"q" * 1999))
+    eng = assert_parity(templates, rows)
+    assert eng.stats.rows == len(rows)
+
+
+@pytest.mark.skipif(not REFERENCE_CORPUS.is_dir(), reason="reference corpus absent")
+def test_parity_reference_network_corpus():
+    templates, _ = load_corpus(REFERENCE_CORPUS / "network")
+    rng = random.Random(11)
+    rows = fuzz_rows(templates, rng, 50)
+    # real-ish banners that hit specific network templates
+    rows += [
+        model.Response(host="r1", port=873, banner=b"@RSYNCD: 31.0\nERROR: protocol startup error\n"),
+        model.Response(host="r2", port=22, banner=b"SSH-2.0-OpenSSH_8.9p1 Ubuntu"),
+        model.Response(host="r3", port=6379, banner=b"-ERR unknown command 'test'"),
+        model.Response(host="r4", port=11211, banner=b"VERSION 1.6.17\r\n"),
+        model.Response(host="r5", port=21, banner=b"220 ProFTPD Server ready.\r\n"),
+    ]
+    assert_parity(templates, rows)
+
+
+@pytest.mark.skipif(not REFERENCE_CORPUS.is_dir(), reason="reference corpus absent")
+def test_parity_reference_panels_subset():
+    templates, _ = load_corpus(REFERENCE_CORPUS / "exposed-panels", limit=150)
+    rng = random.Random(13)
+    rows = fuzz_rows(templates, rng, 40)
+    rows.append(
+        model.Response(
+            host="g1", port=443, status=200,
+            body=b"<html><title>Grafana</title>Grafana v9.1.0</html>",
+        )
+    )
+    assert_parity(templates, rows)
+
+
+@pytest.mark.skipif(not REFERENCE_CORPUS.is_dir(), reason="reference corpus absent")
+def test_parity_reference_technologies_tech_detect():
+    """tech-detect: 542 named regex matchers in one or-op — the densest
+    template in the corpus."""
+    templates, _ = load_corpus(REFERENCE_CORPUS / "technologies")
+    rng = random.Random(17)
+    rows = fuzz_rows(templates, rng, 25)
+    rows.append(
+        model.Response(
+            host="t1", port=443, status=200,
+            body=b'<html><img src="https://x.mollom.com/a.png">'
+            b"Project Management Software atlassian.com/software/jira</html>",
+        )
+    )
+    assert_parity(templates, rows)

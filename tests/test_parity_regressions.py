@@ -1,0 +1,137 @@
+"""Regression tests for device/oracle parity breaks found in review."""
+
+from swarm_tpu.fingerprints import model, parse_template
+from swarm_tpu.ops import cpu_ref
+from swarm_tpu.ops.engine import MatchEngine
+
+
+def _engine_vs_oracle(template_doc: dict, rows: list[model.Response]):
+    t = parse_template(template_doc)
+    eng = MatchEngine([t])
+    got = eng.match(rows)
+    for b, row in enumerate(rows):
+        oracle = cpu_ref.match_template(t, row)
+        assert (t.id in got[b].template_ids) == oracle.matched, (
+            f"row {b}: device={t.id in got[b].template_ids} oracle={oracle.matched}"
+        )
+        dev_extract = got[b].extractions.get(t.id, [])
+        assert dev_extract == oracle.extractions, (
+            f"row {b}: extractions device={dev_extract} oracle={oracle.extractions}"
+        )
+    return eng
+
+
+def test_extractions_on_device_certain_hit():
+    # status matcher (device-certain) + regex extractor: extraction must
+    # still appear
+    doc = {
+        "id": "x-extract",
+        "info": {"severity": "info"},
+        "requests": [
+            {
+                "matchers": [{"type": "status", "status": [200]}],
+                "extractors": [
+                    {"type": "regex", "part": "body", "group": 1,
+                     "regex": [r"version ([0-9.]+)"]}
+                ],
+            }
+        ],
+    }
+    rows = [
+        model.Response(host="a", status=200, body=b"app version 4.2.1 here"),
+        model.Response(host="b", status=404, body=b"app version 9.9.9"),
+    ]
+    _engine_vs_oracle(doc, rows)
+
+
+def test_host_part_matcher_goes_host_always():
+    doc = {
+        "id": "x-hostpart",
+        "info": {"severity": "info"},
+        "requests": [
+            {"matchers": [{"type": "word", "part": "host", "words": ["prod.example.com"]}]}
+        ],
+    }
+    rows = [
+        model.Response(host="prod.example.com", status=200, body=b"hi"),
+        model.Response(host="other.example.com", status=200, body=b"hi"),
+    ]
+    eng = _engine_vs_oracle(doc, rows)
+    assert len(eng.db.host_always) == 1
+
+
+def test_binary_matcher_ignores_case_insensitive():
+    doc = {
+        "id": "x-binci",
+        "info": {"severity": "info"},
+        "requests": [
+            {"matchers": [{"type": "binary", "binary": ["414243"],  # "ABC"
+                           "case-insensitive": True}]}
+        ],
+    }
+    rows = [
+        model.Response(host="a", status=200, body=b"xx abc yy"),  # lower: no match
+        model.Response(host="b", status=200, body=b"xx ABC yy"),  # exact: match
+    ]
+    _engine_vs_oracle(doc, rows)
+
+
+def test_contains_tolower_uppercase_needle_is_const_false():
+    doc = {
+        "id": "x-tolower",
+        "info": {"severity": "info"},
+        "requests": [
+            {"matchers": [{"type": "dsl", "dsl": ['contains(tolower(body), "AbC")']}]}
+        ],
+    }
+    rows = [
+        model.Response(host="a", status=200, body=b"zz abc zz"),
+        model.Response(host="b", status=200, body=b"zz AbC zz"),
+    ]
+    _engine_vs_oracle(doc, rows)
+
+
+def test_contains_toupper_wrap():
+    doc = {
+        "id": "x-toupper",
+        "info": {"severity": "info"},
+        "requests": [
+            {"matchers": [{"type": "dsl", "dsl": ['contains(toupper(body), "WIDGET")']}]}
+        ],
+    }
+    rows = [
+        model.Response(host="a", status=200, body=b"a WiDgEt b"),  # matches
+        model.Response(host="b", status=200, body=b"a widge b"),  # no
+    ]
+    _engine_vs_oracle(doc, rows)
+
+
+def test_part_aliases_agree_between_engines():
+    # data / body_1 / response aliases must mean the same bytes on both
+    # engines, for both http and banner rows
+    for part in ("data", "body_1", "response", "raw"):
+        doc = {
+            "id": f"x-part-{part}",
+            "info": {"severity": "info"},
+            "requests": [
+                {"matchers": [{"type": "word", "part": part, "words": ["needle-xyz"]}]}
+            ],
+        }
+        rows = [
+            model.Response(host="h1", status=200, body=b"has needle-xyz here"),
+            model.Response(host="h2", status=200, body=b"nothing"),
+            model.Response(host="h3", banner=b"banner needle-xyz banner"),
+        ]
+        _engine_vs_oracle(doc, rows)
+
+
+def test_blob_list_empty_prefix(tmp_path):
+    from swarm_tpu.stores import LocalBlobStore
+
+    store = LocalBlobStore(tmp_path / "uploads")
+    (tmp_path / "outside.txt").write_text("sibling")
+    store.put("s1/input/chunk_0.txt", b"x")
+    assert store.list("") == ["s1/input/chunk_0.txt"]
+    # no key literally starts with "../" (S3 semantics) and the sibling
+    # file outside the root must never leak into the listing
+    assert store.list("../") == []
