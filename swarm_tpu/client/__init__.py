@@ -1,0 +1,1 @@
+"""CLI client for the swarm_tpu control plane."""

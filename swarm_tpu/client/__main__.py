@@ -1,0 +1,5 @@
+import sys
+
+from swarm_tpu.client.cli import main
+
+sys.exit(main())

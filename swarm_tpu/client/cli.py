@@ -1,0 +1,336 @@
+"""``swarm`` CLI — the controller of actions performed within the swarm.
+
+Action set and semantics follow the reference client (``client/swarm``):
+``scan, workers, scans, jobs, spinup, terminate, recycle, cat, stream,
+reset``, plus ``--tail`` live following, ``--autoscale`` pre-spinup with
+auto batch-size = lines/(nodes×1.8) (``client/swarm:140-150``), the ECT
+estimator in the scans view (``client/swarm:225-246``), and
+``--configure`` persistence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+import requests
+
+from swarm_tpu.client.tables import Table
+from swarm_tpu.config import Config
+from swarm_tpu.datamodel import parse_job_id
+
+
+class JobClient:
+    def __init__(self, server_url: str, api_key: str, timeout: float = 60.0):
+        self.base = server_url.rstrip("/")
+        self.timeout = timeout
+        self.session = requests.Session()
+        self.session.headers["Authorization"] = f"Bearer {api_key}"
+
+    # ------------------------------------------------------------------
+    def start_scan(
+        self,
+        path: str,
+        module: str,
+        chunk_index: int,
+        batch_size,
+        scan_id: Optional[str] = None,
+    ) -> tuple[int, str]:
+        with open(path, "r") as f:
+            file_content = f.readlines()
+        data = {
+            "module": module,
+            "file_content": file_content,
+            "batch_size": int(float(batch_size)),
+            "scan_id": scan_id,
+            "chunk_index": chunk_index,
+        }
+        resp = self.session.post(f"{self.base}/queue", json=data, timeout=self.timeout)
+        return resp.status_code, resp.text
+
+    def get_statuses(self) -> Optional[dict]:
+        resp = self.session.get(f"{self.base}/get-statuses", timeout=self.timeout)
+        return resp.json() if resp.status_code == 200 else None
+
+    def fetch_raw(self, scan_id: str) -> str:
+        resp = self.session.get(f"{self.base}/raw/{scan_id}", timeout=self.timeout)
+        if resp.status_code == 200:
+            return resp.text
+        return f"Error: {resp.status_code} - {resp.text}"
+
+    def get_latest_chunk_raw(self) -> Optional[str]:
+        resp = self.session.get(f"{self.base}/get-latest-chunk", timeout=self.timeout)
+        if resp.status_code != 200 or not resp.text:
+            return None
+        scan_id, chunk_id = parse_job_id(resp.text.strip())
+        resp2 = self.session.get(
+            f"{self.base}/get-chunk/{scan_id}/{chunk_id}", timeout=self.timeout
+        )
+        if resp2.status_code == 200:
+            return resp2.json()["contents"].strip()
+        return None
+
+    def tail(self, timeout_polls: int = 36000) -> None:
+        """Live-follow completed chunks (reference client/swarm:72-82)."""
+        empty_polls = 0
+        while empty_polls <= timeout_polls:
+            chunk = self.get_latest_chunk_raw()
+            if chunk is not None:
+                sys.stdout.write(chunk + "\n")
+                sys.stdout.flush()
+            else:
+                empty_polls += 1
+                time.sleep(0.05)
+
+    def spin_up(self, prefix: str, nodes: int) -> tuple[int, str]:
+        resp = self.session.post(
+            f"{self.base}/spin-up",
+            json={"prefix": prefix, "nodes": nodes},
+            timeout=self.timeout,
+        )
+        return resp.status_code, resp.text
+
+    def spin_down(self, prefix: str) -> tuple[int, str]:
+        resp = self.session.post(
+            f"{self.base}/spin-down", json={"prefix": prefix}, timeout=self.timeout
+        )
+        return resp.status_code, resp.text
+
+    def reset(self) -> tuple[int, str]:
+        resp = self.session.post(f"{self.base}/reset", timeout=self.timeout)
+        return resp.status_code, resp.text
+
+
+# ---------------------------------------------------------------------------
+# Views
+# ---------------------------------------------------------------------------
+
+
+def estimate_completion_time(scan_started, total_chunks, chunks_complete, completed_at):
+    """ECT = remaining% × elapsed/complete% (reference client/swarm:225-246)."""
+    if not chunks_complete or not scan_started:
+        return None
+    now = time.time()
+    elapsed = now - scan_started
+    frac = chunks_complete / total_chunks
+    if elapsed <= 0:
+        return None
+    if frac >= 1:
+        eta = completed_at or now
+    else:
+        eta = now + (1 - frac) * (elapsed / frac)
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(eta))
+
+
+def _fmt_ts(ts) -> str:
+    if not ts:
+        return ""
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(ts))
+
+
+def render_workers(statuses: dict) -> str:
+    table = Table(["Worker ID", "Last Contacted", "Polls with No Jobs", "Status"])
+    for worker_id, w in statuses.get("workers", {}).items():
+        table.add_row(
+            [worker_id, _fmt_ts(w.get("last_contact")), w.get("polls_with_no_jobs"), w.get("status")]
+        )
+    return str(table)
+
+
+def render_jobs(statuses: dict) -> str:
+    table = Table(
+        ["Job ID", "Scan ID", "Chunk", "Status", "Worker ID", "Started", "Completed", "Seconds"]
+    )
+    jobs = sorted(
+        statuses.get("jobs", {}).items(), key=lambda kv: int(kv[1].get("chunk_index", 0))
+    )
+    for job_id, j in jobs:
+        started, completed = j.get("started_at"), j.get("completed_at")
+        duration = f"{completed - started:.1f}" if started and completed else ""
+        table.add_row(
+            [job_id, j.get("scan_id"), j.get("chunk_index"), j.get("status"),
+             j.get("worker_id"), _fmt_ts(started), _fmt_ts(completed), duration]
+        )
+    return str(table)
+
+
+def render_scans(statuses: dict) -> str:
+    table = Table(
+        ["Scan ID", "Chunks", "Complete", "%", "Workers", "Module", "Started", "Completed", "ECT"]
+    )
+    for s in statuses.get("scans", []):
+        ect = estimate_completion_time(
+            s.get("scan_started"), s.get("total_chunks") or 1,
+            s.get("chunks_complete") or 0, s.get("completed_at"),
+        )
+        table.add_row(
+            [s.get("scan_id"), s.get("total_chunks"), s.get("chunks_complete"),
+             s.get("percent_complete"), len(s.get("workers") or []), s.get("module"),
+             _fmt_ts(s.get("scan_started")), _fmt_ts(s.get("completed_at")), ect or ""]
+        )
+    return str(table)
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+ACTIONS = [
+    "scan", "workers", "scans", "jobs", "spinup", "terminate",
+    "cat", "stream", "recycle", "reset",
+]
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="Swarm Scan Client")
+    parser.add_argument("action", nargs="?", choices=ACTIONS)
+    parser.add_argument("--server-url", default=None)
+    parser.add_argument("--api-key", default=None)
+    parser.add_argument("--config", default=None)
+    parser.add_argument("--configure", action="store_true",
+                        help="persist server URL and API key to the config file")
+    parser.add_argument("--file", help="targets file (scan)")
+    parser.add_argument("--module", help="scan module name")
+    parser.add_argument("--batch-size", default="auto")
+    parser.add_argument("--prefix", help="node name prefix (spinup/terminate)")
+    parser.add_argument("--nodes", type=int, help="node count (spinup)")
+    parser.add_argument("--scan-id", help="scan id (cat/stream)")
+    parser.add_argument("--autoscale", action="store_true")
+    parser.add_argument("--tail", action="store_true", help="follow completed chunks")
+    args = parser.parse_args(argv)
+
+    cfg = Config.load(path=args.config, server_url=args.server_url, api_key=args.api_key)
+    client = JobClient(cfg.resolve_url(), cfg.api_key)
+
+    if args.configure:
+        cfg.save(args.config)
+        print(f"Configuration saved")
+
+    try:
+        rc = _run_action(args, cfg, client)
+    except requests.ConnectionError:
+        print(f"Cannot reach server at {cfg.resolve_url()}")
+        return 2
+
+    if args.tail:
+        client.tail()
+    return rc
+
+
+def _run_action(args, cfg: Config, client: JobClient) -> int:
+    if args.action == "scan":
+        if not args.file or not args.module:
+            print("Both file and module are required for starting a scan")
+            return 1
+        total_workers = args.nodes or 1
+        if args.autoscale:
+            if not args.prefix or not args.nodes:
+                print("Both prefix and nodes are required for autoscale")
+                return 1
+            code, text = client.spin_up(args.prefix, args.nodes)
+            print(code, text)
+        if args.batch_size != "auto":
+            batch_size = int(float(args.batch_size))
+        else:
+            with open(args.file) as f:
+                lines = sum(1 for _ in f)
+            batch_size = max(1, int(lines / (total_workers * 1.8)))
+        code, text = client.start_scan(args.file, args.module, 0, batch_size)
+        print(f"Start Scan Status Code: {code}")
+        print(f"Start Scan Response: {text}")
+        return 0 if code == 200 else 1
+
+    if args.action in ("workers", "scans", "jobs"):
+        statuses = client.get_statuses()
+        if statuses is None:
+            print("Failed to retrieve statuses")
+            return 1
+        if args.action == "workers":
+            print("Worker Statuses:")
+            print(render_workers(statuses))
+        elif args.action == "jobs":
+            print("Job Statuses:")
+            print(render_jobs(statuses))
+        else:
+            print("Scan Information:")
+            print(render_scans(statuses))
+        return 0
+
+    if args.action == "spinup":
+        if not args.prefix or not args.nodes:
+            print("Both prefix and nodes are required for spinning up")
+            return 1
+        code, _text = client.spin_up(args.prefix, args.nodes)
+        if code == 202:
+            print(f"Successfully issued spinup for prefix {args.prefix}")
+            return 0
+        return 1
+
+    if args.action == "terminate":
+        if not args.prefix:
+            print("Prefix is required for spinning down")
+            return 1
+        code, text = client.spin_down(args.prefix)
+        print(code, text)
+        return 0 if code == 202 else 1
+
+    if args.action == "recycle":
+        if not args.prefix or not args.nodes:
+            print("Both prefix and nodes are required for recycle")
+            return 1
+        print(client.spin_down(args.prefix))
+        print("Waiting 10 seconds to spin fleet back up")
+        time.sleep(10)
+        print(client.spin_up(args.prefix, args.nodes))
+        return 0
+
+    if args.action == "stream":
+        # stdin → rolling 10-line chunks → /queue (reference client/swarm:316-334)
+        if not args.scan_id or not args.module:
+            print("Both scan-id and module are required for stream")
+            return 1
+        chunk: list[str] = []
+        chunk_index = 0
+        batch = 0 if args.batch_size == "auto" else int(float(args.batch_size))
+        for line in sys.stdin:
+            chunk.append(line)
+            if len(chunk) >= 10:
+                chunk_index += 1
+                resp = client.session.post(
+                    f"{client.base}/queue",
+                    json={
+                        "module": args.module,
+                        "file_content": chunk,
+                        "batch_size": batch,
+                        "scan_id": args.scan_id,
+                        "chunk_index": chunk_index,
+                    },
+                    timeout=client.timeout,
+                )
+                print(f"Uploading chunk {chunk_index}: {resp.status_code}")
+                chunk = []
+                time.sleep(0.3)
+        return 0
+
+    if args.action == "cat":
+        if not args.scan_id:
+            print("scan-id is required for cat")
+            return 1
+        print(client.fetch_raw(args.scan_id))
+        return 0
+
+    if args.action == "reset":
+        code, text = client.reset()
+        print(code, text)
+        return 0 if code == 200 else 1
+
+    if args.action is None:
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,9 +9,12 @@ shells out to nuclei/nmap for this entire layer):
   q-gram (8-gram, or 4-gram for short words) in per-(stream, case, q)
   hash tables — sorted unique h1 groups + entry arrays + a Bloom bitmap
   probed by the kernel. Tiny slots (1–3 bytes) take a dense shifted
-  compare. The kernel produces a word-slot bit vector per row, verified
-  byte-exact up to ``verify_width``; longer slots verify their prefix and
-  mark hits *uncertain* (host-confirmed; hits are sparse in scanning).
+  compare (exact). The kernel verifies q-gram hits via 128 hash bits
+  (entry h1/h2 + suffix-gram h1/h2) — every q-gram hit is marked
+  *uncertain* and host-confirmed (hits are sparse in scanning), so no
+  byte gathers run on device. ``slot_bytes``/``slot_len`` are retained
+  for the planned fused-Pallas byte-exact verify, which will clear the
+  uncertain bit on device.
 - Matchers lower to records over those bits plus scalar features
   (status, part lengths): word/binary → slot-bucket reductions,
   status/size → scalar compares, simple dsl → conjunctive scalar
@@ -158,11 +161,15 @@ def required_literal(pattern: str, min_len: int = 4) -> Optional[bytes]:
     lit = best[0]
     if len(lit) < min_len:
         return None
-    del case_insensitive  # see below: literals are always lowered
-    # Always lowercase: the prefilter probes the *lowered* stream, which is
-    # a sound superset for both case-sensitive and (?i)/scoped-(?i) regexes
-    # (a cs occurrence in the raw stream implies the lowered literal occurs
-    # in the lowered stream).
+    if case_insensitive and any(b >= 0x80 for b in lit):
+        # Python's IGNORECASE folds Unicode (0xDC↔0xFC over the latin-1
+        # decode) but the device stream lowering is ASCII-only — the
+        # lowered-literal probe would not be a superset. Host the template.
+        return None
+    # Always ASCII-lowercase: the prefilter probes the *lowered* stream,
+    # a sound superset for case-sensitive regexes (non-A-Z bytes are
+    # untouched in both literal and stream) and for (?i) regexes with
+    # ASCII literals.
     return bytes(lower_bytes_np(np.frombuffer(lit, np.uint8)).tobytes())
 
 
@@ -462,6 +469,14 @@ def compile_corpus(
             "size": [],
             "size_stream": 0,
         }
+
+        def const(value: bool) -> dict:
+            # constant matcher: encode as MK_CONST_FALSE with the
+            # negation flag folded in (negative ^ value ≡ value after
+            # the kernel's generic `value ^= negative` step)
+            rec["kind"] = MK_CONST_FALSE
+            rec["negative"] = bool(m.negative) ^ bool(value)
+            return rec
         if m.type in ("word", "binary"):
             payloads = _word_payloads(m)
             if payloads is None or not payloads:
@@ -487,10 +502,12 @@ def compile_corpus(
             return rec
         if m.type == "size":
             stream = stream_for_part(m.part)
-            if stream is None:
-                return rec
             if not m.size:
                 return None
+            if stream is None:
+                # oracle sees b"" for this part: len==0 is a compile-time
+                # constant (size [0] matches the empty part!)
+                return const(0 in m.size)
             rec["kind"] = MK_SIZE
             rec["size"] = list(m.size)
             rec["size_stream"] = STREAMS.index(stream)
@@ -498,7 +515,18 @@ def compile_corpus(
         if m.type == "regex":
             stream = stream_for_part(m.part)
             if stream is None:
-                return rec
+                # oracle runs the regex over the empty string — also a
+                # compile-time constant (e.g. `.*` matches empty)
+                results = []
+                for pattern in m.regex:
+                    try:
+                        results.append(re.search(pattern, "") is not None)
+                    except re.error:
+                        return None
+                if not results:
+                    return None
+                value = all(results) if m.condition == "and" else any(results)
+                return const(value)
             # every regex in the list needs its own required literal; the
             # matcher bit is the OR/AND of per-regex prefilter bits.
             slot_ids = []

@@ -167,6 +167,16 @@ def _text(v: Any) -> str:
     return str(v)
 
 
+_REGEX_CACHE: dict[str, "re.Pattern[str]"] = {}
+
+
+def _search(pattern: str, text: str):
+    compiled = _REGEX_CACHE.get(pattern)
+    if compiled is None:
+        compiled = _REGEX_CACHE[pattern] = re.compile(pattern)
+    return compiled.search(text)
+
+
 _FUNCTIONS: dict[str, Callable] = {
     "len": lambda v: len(_to_bytes(v)) if isinstance(v, (bytes, str)) else len(v),
     "md5": lambda v: hashlib.md5(_to_bytes(v)).hexdigest(),
@@ -179,7 +189,7 @@ _FUNCTIONS: dict[str, Callable] = {
     "base64": lambda v: _b64.b64encode(_to_bytes(v)).decode(),
     "base64_decode": lambda v: _b64.b64decode(_to_bytes(v)),
     "hex_encode": lambda v: _to_bytes(v).hex(),
-    "regex": lambda pattern, v: re.search(_text(pattern), _text(v)) is not None,
+    "regex": lambda pattern, v: _search(_text(pattern), _text(v)) is not None,
     "mmh3": None,  # installed below (needs helper)
 }
 
@@ -278,9 +288,9 @@ def evaluate(ast: tuple, env: dict[str, Any]) -> Any:
                 result = False if op != "!=" else True
             return result
         if op == "=~":
-            return re.search(_text(b), _text(a)) is not None
+            return _search(_text(b), _text(a)) is not None
         if op == "!~":
-            return re.search(_text(b), _text(a)) is None
+            return _search(_text(b), _text(a)) is None
         if op == "+":
             if isinstance(a, (bytes, str)) or isinstance(b, (bytes, str)):
                 return _to_bytes(a) + _to_bytes(b)

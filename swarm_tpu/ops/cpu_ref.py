@@ -47,6 +47,19 @@ def _decode(part: bytes) -> str:
     return part.decode("latin-1")
 
 
+_REGEX_CACHE: dict[str, "re.Pattern[str]"] = {}
+
+
+def _compile_cached(pattern: str):
+    """Unbounded compile cache — the corpus has ~1.8k distinct regexes,
+    which overflows re's internal 512-entry cache and would otherwise
+    recompile per evaluation in the host-confirm loop."""
+    compiled = _REGEX_CACHE.get(pattern)
+    if compiled is None:
+        compiled = _REGEX_CACHE[pattern] = re.compile(pattern)
+    return compiled
+
+
 def _parse_headers(header_blob: bytes) -> dict[str, str]:
     headers: dict[str, str] = {}
     for line in header_blob.split(b"\r\n"):
@@ -73,7 +86,7 @@ def match_matcher(matcher: Matcher, response: Response) -> Optional[bool]:
         text = _decode(part)
         for pattern in matcher.regex:
             try:
-                results.append(re.search(pattern, text) is not None)
+                results.append(_compile_cached(pattern).search(text) is not None)
             except re.error:
                 return None
     elif matcher.type == "status":
@@ -95,7 +108,10 @@ def match_matcher(matcher: Matcher, response: Response) -> Optional[bool]:
                 return None
             try:
                 results.append(bool(dslc.evaluate(ast, env)))
-            except dslc.DslError:
+            except Exception:
+                # one exotic corpus expression (RE2-only regex syntax,
+                # mixed-type arithmetic, bad base64…) must degrade to
+                # "unsupported", never abort a whole scan
                 return None
     elif matcher.type == "kval":
         headers = _parse_headers(response.part("header"))
@@ -120,7 +136,7 @@ def _extract(op: Operation, response: Response) -> list[str]:
         text = _decode(response.part(ex.part))
         for pattern in ex.regex:
             try:
-                for m in re.finditer(pattern, text):
+                for m in _compile_cached(pattern).finditer(text):
                     try:
                         out.append(m.group(ex.group))
                     except IndexError:

@@ -27,12 +27,11 @@ STREAMS = ("body", "header", "all")
 
 # matcher part name -> physical stream. Must agree with
 # model.Response.part(): every alias here returns exactly that stream's
-# bytes from the oracle. Parts absent here and returning b"" from the
-# oracle (interactsh_* …) lower to constant-False on the device — the
-# same verdict the oracle computes on empty bytes can only differ for
-# negative matchers, which both engines evaluate consistently from the
-# same constant. 'host' is oracle-only (real bytes, no stream): matchers
-# on it are not device-loweable and force host-always.
+# bytes from the oracle. Parts absent here return b"" from the oracle
+# (interactsh_* …), so their matchers lower to compile-time constants
+# (word → False, size → 0∈sizes, regex → matches-empty; negation folded
+# in — see compile.lower_matcher). 'host' is oracle-only (real bytes, no
+# stream): matchers on it are not device-loweable and force host-always.
 PART_TO_STREAM = {
     "body": "body",
     "data": "body",
@@ -63,11 +62,12 @@ class ResponseBatch:
     """Fixed-shape encoding of B response rows.
 
     streams: dict stream -> uint8 [B, W_stream]
-    lengths: dict stream -> int32 [B] (true byte length, pre-truncation
-             lengths are in ``true_lengths`` for the truncation flag)
+    lengths: dict stream -> int32 [B] — post-truncation byte length (the
+             length of what's actually in the stream array)
     status:  int32 [B]
     truncated: bool [B] — any stream lost bytes to the width cap; these
-             rows must be host-verified for exact parity.
+             rows are host-re-evaluated wholesale, which is what keeps
+             size/len semantics exact for them.
     """
 
     streams: dict

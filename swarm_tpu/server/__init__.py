@@ -1,0 +1,1 @@
+"""Control plane: REST C2 server, job queue, fleet orchestration."""

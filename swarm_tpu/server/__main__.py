@@ -1,0 +1,3 @@
+from swarm_tpu.server.app import main
+
+main()

@@ -1,0 +1,272 @@
+"""HTTP REST C2 server — wire-compatible with the reference API.
+
+Same 11 routes, methods, payload shapes, status codes and bearer-token
+auth as reference ``server/server.py`` (so the reference client/worker
+work unchanged), built on the stdlib threading HTTP server instead of
+Flask (not in this image). Additive routes let workers move chunk data
+over HTTP instead of needing direct S3 credentials:
+
+    GET  /get-input-chunk/<scan>/<chunk>     (reference worker hits S3)
+    POST /put-output-chunk/<scan>/<chunk>
+    GET  /healthz                            (unauthenticated liveness)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from swarm_tpu.config import Config
+from swarm_tpu.server.fleet import build_provider
+from swarm_tpu.server.queue import JobQueueService
+from swarm_tpu.stores import build_stores
+
+
+class SwarmServer:
+    """Route table + dispatch. Handlers return (status, body, content_type)."""
+
+    def __init__(self, cfg: Config, queue: Optional[JobQueueService] = None, fleet=None):
+        self.cfg = cfg
+        if queue is None:
+            state, blobs, docs = build_stores(cfg)
+            fleet = fleet if fleet is not None else build_provider(cfg)
+            queue = JobQueueService(cfg, state, blobs, docs, fleet=fleet)
+        self.queue = queue
+        self.fleet = fleet if fleet is not None else queue.fleet
+        self._routes: list[tuple[str, re.Pattern, Callable]] = []
+        self._register_routes()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------------
+    def _register_routes(self) -> None:
+        r = self._routes.append
+        r(("GET", re.compile(r"^/healthz$"), self._healthz))
+        r(("GET", re.compile(r"^/get-statuses$"), self._get_statuses))
+        r(("POST", re.compile(r"^/update-job/(?P<job_id>[^/]+)$"), self._update_job))
+        r(("GET", re.compile(r"^/get-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$"), self._get_chunk))
+        r(("GET", re.compile(r"^/get-latest-chunk$"), self._get_latest_chunk))
+        r(("GET", re.compile(r"^/parse_job/(?P<job_id>[^/]+)$"), self._parse_job))
+        r(("GET", re.compile(r"^/raw/(?P<scan_id>[^/]+)$"), self._raw))
+        r(("POST", re.compile(r"^/queue$"), self._queue_job))
+        r(("GET", re.compile(r"^/get-job$"), self._get_job))
+        r(("POST", re.compile(r"^/spin-up$"), self._spin_up))
+        r(("POST", re.compile(r"^/spin-down$"), self._spin_down))
+        r(("POST", re.compile(r"^/reset$"), self._reset))
+        r(("GET", re.compile(r"^/get-input-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$"), self._get_input_chunk))
+        r(("POST", re.compile(r"^/put-output-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$"), self._put_output_chunk))
+
+    # ------------------------------------------------------------------
+    # Handlers — signatures: (match, query, body_bytes) -> (code, body, ctype)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _json(code: int, payload: Any) -> tuple[int, bytes, str]:
+        return code, json.dumps(payload).encode(), "application/json"
+
+    @staticmethod
+    def _text(code: int, text: str) -> tuple[int, bytes, str]:
+        return code, text.encode(), "text/html; charset=utf-8"
+
+    def _healthz(self, m, q, body):
+        return self._json(200, {"status": "ok"})
+
+    def _get_statuses(self, m, q, body):
+        return self._json(200, self.queue.statuses())
+
+    def _update_job(self, m, q, body):
+        try:
+            changes = json.loads(body or b"{}")
+        except ValueError:
+            return self._json(400, {"message": "Invalid JSON"})
+        if self.queue.update_job(m["job_id"], changes):
+            return self._json(200, {"message": "Job status updated"})
+        return self._json(404, {"message": "Job not found"})
+
+    def _get_chunk(self, m, q, body):
+        content = self.queue.output_chunk(m["scan_id"], int(m["chunk_id"]))
+        if content is None:
+            return self._json(404, {"message": "Chunk not found"})
+        return self._json(200, {"contents": content})
+
+    def _get_latest_chunk(self, m, q, body):
+        job_id = self.queue.latest_completed_job_id()
+        if job_id is None:
+            return self._text(204, "")
+        return self._text(200, job_id)
+
+    def _parse_job(self, m, q, body):
+        if self.queue.parse_job(m["job_id"]):
+            return self._json(200, {"message": "Job parsed and inserted into mongodb"})
+        return self._json(404, {"message": "Job not found"})
+
+    def _raw(self, m, q, body):
+        return self._text(200, self.queue.raw_scan(m["scan_id"]))
+
+    def _queue_job(self, m, q, body):
+        try:
+            job_data = json.loads(body or b"{}")
+        except ValueError:
+            return self._text(400, "Invalid JSON")
+        try:
+            self.queue.queue_scan(job_data)
+        except ValueError as e:
+            return self._text(400, str(e))
+        return self._text(200, "Job queued successfully")
+
+    def _get_job(self, m, q, body):
+        worker_id = (q.get("worker_id") or [None])[0]
+        job = self.queue.next_job(worker_id or "unknown")
+        if job is None:
+            return self._text(204, "No jobs available")
+        return self._json(200, job)
+
+    def _spin_up(self, m, q, body):
+        try:
+            data = json.loads(body or b"{}")
+        except ValueError:
+            return self._json(400, {"message": "Invalid JSON"})
+        prefix, nodes = data.get("prefix"), data.get("nodes")
+        if prefix is None or nodes is None:
+            return self._json(400, {"message": "Both prefix and nodes are required"})
+        threading.Thread(
+            target=self.fleet.spin_up, args=(prefix, int(nodes)), daemon=True
+        ).start()
+        return self._json(
+            202, {"message": f"Spinning up {nodes} droplets with prefix {prefix}"}
+        )
+
+    def _spin_down(self, m, q, body):
+        try:
+            data = json.loads(body or b"{}")
+        except ValueError:
+            return self._json(400, {"message": "Invalid JSON"})
+        prefix = data.get("prefix")
+        if prefix is None:
+            return self._json(400, {"message": "Prefix is required"})
+        self.fleet.teardown_async(prefix)
+        return self._json(202, {"message": f"Spinning down droplets with prefix {prefix}"})
+
+    def _reset(self, m, q, body):
+        self.queue.reset()
+        return self._json(200, {"message": "Redis database reset"})
+
+    def _get_input_chunk(self, m, q, body):
+        data = self.queue.input_chunk(m["scan_id"], int(m["chunk_id"]))
+        if data is None:
+            return self._json(404, {"message": "Chunk not found"})
+        return 200, data, "application/octet-stream"
+
+    def _put_output_chunk(self, m, q, body):
+        self.queue.put_output_chunk(m["scan_id"], int(m["chunk_id"]), body or b"")
+        return self._json(200, {"message": "stored"})
+
+    # ------------------------------------------------------------------
+    # Dispatch
+    # ------------------------------------------------------------------
+    UNAUTHENTICATED = {"/healthz"}
+
+    def dispatch(
+        self, method: str, path: str, query: dict, headers: dict, body: bytes
+    ) -> tuple[int, bytes, str]:
+        parsed_path = path.rstrip("/") or "/"
+        if parsed_path not in self.UNAUTHENTICATED:
+            auth = headers.get("Authorization", "")
+            if not auth.startswith("Bearer "):
+                return self._json(401, {"message": "Authentication required"})
+            if auth.split(" ", 1)[1] != self.cfg.api_key:
+                return self._json(401, {"message": "Unauthorized"})
+        for route_method, pattern, handler in self._routes:
+            if route_method != method:
+                continue
+            match = pattern.match(path)
+            if match:
+                try:
+                    return handler(match.groupdict(), query, body)
+                except Exception as e:  # route crash → 500, keep serving
+                    return self._json(500, {"message": f"{type(e).__name__}: {e}"})
+        return self._json(404, {"message": "Not found"})
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+    def serve_forever(self) -> None:
+        self._httpd = _make_httpd(self)
+        self._httpd.serve_forever()
+
+    def start_background(self) -> threading.Thread:
+        self._httpd = _make_httpd(self)
+        thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        thread.start()
+        return thread
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None
+        return self._httpd.server_address[1]
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def _make_httpd(server: SwarmServer) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _run(self, method: str) -> None:
+            parsed = urlparse(self.path)
+            query = parse_qs(parsed.query)
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            code, payload, ctype = server.dispatch(
+                method, parsed.path, query, dict(self.headers), body
+            )
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            if payload and method != "HEAD":
+                self.wfile.write(payload)
+
+        def do_GET(self):
+            self._run("GET")
+
+        def do_POST(self):
+            self._run("POST")
+
+        def do_HEAD(self):
+            self._run("HEAD")
+
+    return ThreadingHTTPServer((server.cfg.host, server.cfg.port), Handler)
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="swarm_tpu C2 server")
+    parser.add_argument("--host", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--api-key", default=None)
+    parser.add_argument("--config", default=None)
+    args = parser.parse_args(argv)
+    cfg = Config.load(
+        path=args.config, host=args.host, port=args.port, api_key=args.api_key
+    )
+    server = SwarmServer(cfg)
+    print(f"swarm_tpu server on {cfg.host}:{cfg.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,217 @@
+"""Elastic worker-fleet orchestration behind a provider interface.
+
+The reference hardcodes DigitalOcean droplet create/delete with a
+250-req/min thread limiter and idle auto-teardown
+(``server/server.py:47-162, 506-546``). Here the same capabilities sit
+behind ``FleetProvider``:
+
+- ``NullProvider`` — no-op (TPU pods are typically statically
+  provisioned; elastic scale means releasing queued shards, not
+  hardware).
+- ``ProcessProvider`` — spawns/kills local worker *processes*; the
+  embedded single-host analog of a droplet fleet and what tests use.
+- ``DigitalOceanProvider`` — wire-equivalent of the reference: same
+  API endpoints, name-prefix selection, cloud-init user_data boot.
+
+All providers share the token-bucket rate limiter and run create/delete
+in background threads like the reference's ``/spin-up`` handler.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+
+class RateLimiter:
+    """Token bucket: at most ``per_minute`` acquisitions per rolling minute."""
+
+    def __init__(self, per_minute: int):
+        self.per_minute = max(1, per_minute)
+        self._lock = threading.Lock()
+        self._stamps: list[float] = []
+
+    def acquire(self) -> None:
+        while True:
+            with self._lock:
+                now = time.time()
+                self._stamps = [s for s in self._stamps if now - s < 60.0]
+                if len(self._stamps) < self.per_minute:
+                    self._stamps.append(now)
+                    return
+                sleep_for = 60.0 - (now - self._stamps[0])
+            time.sleep(max(0.05, sleep_for))
+
+
+def generate_node_names(prefix: str, nodes: int) -> list[str]:
+    """``prefix1..prefixN`` (reference server.py:76-77)."""
+    return [f"{prefix}{i}" for i in range(1, nodes + 1)]
+
+
+class FleetProvider:
+    def spin_up(self, prefix: str, nodes: int) -> None:
+        raise NotImplementedError
+
+    def spin_down(self, prefix: str) -> None:
+        raise NotImplementedError
+
+    def list_nodes(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+    def teardown_async(self, prefix: str) -> None:
+        t = threading.Thread(target=self.spin_down, args=(prefix,), daemon=True)
+        t.start()
+
+
+class NullProvider(FleetProvider):
+    def spin_up(self, prefix, nodes):
+        pass
+
+    def spin_down(self, prefix):
+        pass
+
+    def list_nodes(self, prefix):
+        return []
+
+
+class ProcessProvider(FleetProvider):
+    """Local worker processes as fleet nodes (embedded / test provider)."""
+
+    def __init__(self, cfg, extra_args: Optional[list[str]] = None):
+        self.cfg = cfg
+        self.extra_args = extra_args or []
+        self._lock = threading.Lock()
+        self._procs: dict[str, subprocess.Popen] = {}
+
+    def spin_up(self, prefix, nodes):
+        for name in generate_node_names(prefix, nodes):
+            with self._lock:
+                if name in self._procs and self._procs[name].poll() is None:
+                    continue
+                cmd = [
+                    sys.executable,
+                    "-m",
+                    "swarm_tpu.worker",
+                    "--server-url",
+                    self.cfg.resolve_url(),
+                    "--api-key",
+                    self.cfg.api_key,
+                    "--worker-id",
+                    name,
+                ] + self.extra_args
+                self._procs[name] = subprocess.Popen(
+                    cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+                )
+
+    def spin_down(self, prefix):
+        with self._lock:
+            for name, proc in list(self._procs.items()):
+                if name.startswith(prefix) and proc.poll() is None:
+                    proc.terminate()
+                    self._procs.pop(name, None)
+
+    def list_nodes(self, prefix):
+        with self._lock:
+            return [
+                n
+                for n, p in self._procs.items()
+                if n.startswith(prefix) and p.poll() is None
+            ]
+
+    def shutdown(self):
+        self.spin_down("")
+
+
+class DigitalOceanProvider(FleetProvider):
+    """Reference-equivalent cloud provider (requires network egress)."""
+
+    API = "https://api.digitalocean.com/v2"
+
+    def __init__(self, cfg, worker_image: str = "pry0cc/axiom-worker"):
+        import requests  # stdlib-adjacent; baked in
+
+        self._requests = requests
+        self.cfg = cfg
+        self.worker_image = worker_image
+        self.limiter = RateLimiter(cfg.fleet_rate_limit_per_min)
+
+    def _headers(self) -> dict:
+        return {"Authorization": f"Bearer {self.cfg.fleet_api_token}"}
+
+    def _user_data(self, name: str) -> str:
+        env = (
+            f"-e SERVER_URL={self.cfg.resolve_url()} -e API_KEY={self.cfg.api_key} "
+            f"-e WORKER_ID={name}"
+        )
+        return f"#cloud-config\nruncmd:\n  - \"docker run -d {env} {self.worker_image}\"\n"
+
+    def _create_one(self, name: str) -> None:
+        self.limiter.acquire()
+        self._requests.post(
+            f"{self.API}/droplets",
+            headers=self._headers(),
+            json={
+                "name": name,
+                "region": self.cfg.fleet_region,
+                "size": self.cfg.fleet_size,
+                "image": self.cfg.fleet_image,
+                "user_data": self._user_data(name),
+            },
+            timeout=30,
+        )
+
+    def _delete_one(self, droplet_id: int) -> None:
+        self.limiter.acquire()
+        self._requests.delete(
+            f"{self.API}/droplets/{droplet_id}", headers=self._headers(), timeout=30
+        )
+
+    def _droplets(self, prefix: str) -> list[dict]:
+        resp = self._requests.get(
+            f"{self.API}/droplets?per_page=200", headers=self._headers(), timeout=30
+        )
+        if resp.status_code != 200:
+            return []
+        return [
+            d
+            for d in resp.json().get("droplets", [])
+            if d.get("name", "").startswith(prefix)
+        ]
+
+    def spin_up(self, prefix, nodes):
+        threads = [
+            threading.Thread(target=self._create_one, args=(n,), daemon=True)
+            for n in generate_node_names(prefix, nodes)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def spin_down(self, prefix):
+        droplets = self._droplets(prefix)
+        threads = [
+            threading.Thread(target=self._delete_one, args=(d["id"],), daemon=True)
+            for d in droplets
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def list_nodes(self, prefix):
+        return [d["name"] for d in self._droplets(prefix)]
+
+
+def build_provider(cfg) -> FleetProvider:
+    if cfg.fleet_provider == "digitalocean":
+        return DigitalOceanProvider(cfg)
+    if cfg.fleet_provider == "process":
+        return ProcessProvider(cfg)
+    return NullProvider()

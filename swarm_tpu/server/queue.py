@@ -1,0 +1,276 @@
+"""Job queue service: chunking, dispatch, status rollup, results.
+
+Wire-behavior matches the reference server routes (``server/server.py``):
+the same Redis-role key names (``jobs``/``workers`` hashes, ``job_queue``/
+``completed`` lists), the same blob layout (``{scan}/input|output/
+chunk_{i}.txt``), the same job/scan id formats and status strings — so
+the reference client and worker interoperate unchanged.
+
+Fixes over the reference (SURVEY.md §5 "no retry or requeue"):
+- **Leases**: a dispatched job carries ``lease_expires_at``; expired
+  in-progress jobs are requeued (bounded by ``max_attempts``).
+- Failed terminal states can optionally be requeued the same way.
+- Worker statuses live in the state store (the reference kept them in a
+  process-local dict, losing them on restart).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Optional
+
+from swarm_tpu.config import Config
+from swarm_tpu.datamodel import (
+    Job,
+    JobStatus,
+    WorkerInfo,
+    WorkerStatus,
+    chunk_generator,
+    chunk_input_key,
+    chunk_output_key,
+    generate_scan_id,
+    job_id_for,
+    rollup_scans,
+)
+from swarm_tpu.stores import BlobStore, DocStore, StateStore
+
+
+class JobQueueService:
+    def __init__(
+        self,
+        cfg: Config,
+        state: StateStore,
+        blobs: BlobStore,
+        docs: DocStore,
+        fleet=None,
+    ):
+        self.cfg = cfg
+        self.state = state
+        self.blobs = blobs
+        self.docs = docs
+        self.fleet = fleet
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # Submission (reference queue_job, server.py:414-461)
+    # ------------------------------------------------------------------
+    def queue_scan(self, job_data: dict) -> dict:
+        module = job_data.get("module")
+        if not module:
+            raise ValueError("Module must be provided")
+        scan_id = job_data.get("scan_id") or generate_scan_id(module)
+        file_content = job_data.get("file_content") or []
+        lines = [l.rstrip("\n") for l in file_content]
+        batch_size = int(float(job_data.get("batch_size") or 0))
+        base_index = int(job_data.get("chunk_index") or 0)
+
+        queued = 0
+        for offset, chunk in enumerate(chunk_generator(lines, batch_size)):
+            chunk_index = base_index + offset
+            self.blobs.put(
+                chunk_input_key(scan_id, chunk_index), "\n".join(chunk).encode()
+            )
+            job = Job.create(scan_id, chunk_index, module)
+            self._put_job(job)
+            self.state.rpush("job_queue", job.job_id)
+            queued += 1
+        return {"scan_id": scan_id, "chunks": queued}
+
+    def _put_job(self, job: Job) -> None:
+        self.state.hset("jobs", job.job_id, job.to_json())
+
+    def _get_job_record(self, job_id: str) -> Optional[Job]:
+        raw = self.state.hget("jobs", job_id)
+        return Job.from_json(raw) if raw else None
+
+    # ------------------------------------------------------------------
+    # Dispatch (reference get_job, server.py:465-515) + leases
+    # ------------------------------------------------------------------
+    def next_job(self, worker_id: str) -> Optional[dict]:
+        now = time.time()
+        worker = self._load_worker(worker_id)
+        worker.last_contact = now
+
+        with self._lock:
+            self._requeue_expired(now)
+            job_id = self.state.lpop("job_queue")
+
+        if job_id is not None:
+            job = self._get_job_record(job_id)
+            if job is None:  # queue/hash desync (e.g. partial reset)
+                return self.next_job(worker_id)
+            job.status = JobStatus.IN_PROGRESS
+            job.started_at = now
+            job.worker_id = worker_id
+            job.lease_expires_at = now + self.cfg.lease_seconds
+            job.attempts += 1
+            self._put_job(job)
+            worker.polls_with_no_jobs = 0
+            worker.status = WorkerStatus.ACTIVE
+            self._save_worker(worker)
+            return job.to_wire()
+
+        worker.polls_with_no_jobs += 1
+        worker.status = WorkerStatus.PENDING
+        if worker.polls_with_no_jobs > self.cfg.idle_polls_before_teardown:
+            worker.status = WorkerStatus.INACTIVE
+            if self.fleet is not None:
+                self.fleet.teardown_async(worker_id)
+        self._save_worker(worker)
+        return None
+
+    def _requeue_expired(self, now: float) -> None:
+        """Lease enforcement: in-progress jobs whose lease lapsed go back
+        to the queue (the reference loses them forever)."""
+        for job_id, raw in self.state.hgetall("jobs").items():
+            try:
+                job = Job.from_json(raw)
+            except (ValueError, KeyError, TypeError):
+                continue
+            if (
+                job.status == JobStatus.IN_PROGRESS
+                and job.lease_expires_at is not None
+                and job.lease_expires_at < now
+            ):
+                if job.attempts >= self.cfg.max_attempts:
+                    job.status = JobStatus.CMD_FAILED
+                    self._put_job(job)
+                    continue
+                job.status = JobStatus.QUEUED
+                job.worker_id = None
+                job.lease_expires_at = None
+                self._put_job(job)
+                self.state.rpush("job_queue", job.job_id)
+
+    def _load_worker(self, worker_id: str) -> WorkerInfo:
+        raw = self.state.hget("workers", worker_id)
+        if raw:
+            try:
+                return WorkerInfo.from_wire(worker_id, json.loads(raw))
+            except (ValueError, TypeError):
+                pass
+        return WorkerInfo(worker_id=worker_id, polls_with_no_jobs=-1)
+
+    def _save_worker(self, worker: WorkerInfo) -> None:
+        self.state.hset("workers", worker.worker_id, json.dumps(worker.to_wire()))
+
+    # ------------------------------------------------------------------
+    # Status transitions (reference update_job, server.py:308-335)
+    # ------------------------------------------------------------------
+    def update_job(self, job_id: str, changes: dict) -> bool:
+        job = self._get_job_record(job_id)
+        if job is None:
+            return False
+        wire = job.to_wire()
+        for key, value in changes.items():
+            if key in wire and key is not None:
+                wire[key] = value
+                if key == "status" and value == JobStatus.COMPLETE:
+                    wire["completed_at"] = time.time()
+                    self.state.rpush("completed", job_id)
+        updated = Job.from_wire(wire)
+        if updated.status in JobStatus.TERMINAL:
+            updated.lease_expires_at = None
+        self._put_job(updated)
+        return True
+
+    # ------------------------------------------------------------------
+    # Status aggregation (reference get_statuses, server.py:219-305)
+    # ------------------------------------------------------------------
+    def statuses(self) -> dict:
+        workers = {}
+        for worker_id, raw in self.state.hgetall("workers").items():
+            try:
+                workers[worker_id] = json.loads(raw)
+            except ValueError:
+                continue
+        jobs = {}
+        for job_id, raw in self.state.hgetall("jobs").items():
+            try:
+                jobs[job_id] = json.loads(raw)
+            except ValueError:
+                continue
+        scans = rollup_scans(jobs)
+        for scan in scans:
+            if scan["percent_complete"] == 100:
+                self._persist_scan_summary(scan)
+        return {"workers": workers, "jobs": jobs, "scans": scans}
+
+    def _persist_scan_summary(self, scan: dict) -> None:
+        coll = self.docs.collection("scans")
+        if coll.find_one({"scan_id": scan["scan_id"]}) is None:
+            coll.insert_one(
+                {
+                    "scan_id": scan["scan_id"],
+                    "total_chunks": scan["total_chunks"],
+                    "chunks_complete": scan["chunks_complete"],
+                    "percent_complete": scan["percent_complete"],
+                    "module": scan["module"],
+                    "scan_started": scan["scan_started"],
+                    "scan_completed": scan["completed_at"],
+                    "scan_status": "complete",
+                }
+            )
+
+    # ------------------------------------------------------------------
+    # Results (reference get_chunk / get_latest_chunk / raw / parse_job)
+    # ------------------------------------------------------------------
+    def output_chunk(self, scan_id: str, chunk_index: int) -> Optional[str]:
+        key = chunk_output_key(scan_id, chunk_index)
+        try:
+            return self.blobs.get(key).decode("utf-8", "replace")
+        except (KeyError, FileNotFoundError, OSError):
+            return None
+
+    def input_chunk(self, scan_id: str, chunk_index: int) -> Optional[bytes]:
+        try:
+            return self.blobs.get(chunk_input_key(scan_id, chunk_index))
+        except (KeyError, FileNotFoundError, OSError):
+            return None
+
+    def put_output_chunk(self, scan_id: str, chunk_index: int, data: bytes) -> None:
+        self.blobs.put(chunk_output_key(scan_id, chunk_index), data)
+
+    def latest_completed_job_id(self) -> Optional[str]:
+        return self.state.lpop("completed")
+
+    def raw_scan(self, scan_id: str) -> str:
+        contents = []
+        for key in self.blobs.list(f"{scan_id}/output/"):
+            if key.endswith(".txt"):
+                contents.append(self.blobs.get(key).decode("utf-8", "replace"))
+        return "".join(contents)
+
+    def parse_job(self, job_id: str) -> bool:
+        """Parse one output chunk into the per-scan document collection.
+
+        The reference (server.py:362-396) reads job metadata from a Mongo
+        ``jobs`` collection nothing populates; this reads the live job
+        record instead, keeping the route's observable behavior.
+        """
+        job = self._get_job_record(job_id)
+        if job is None:
+            return False
+        content = self.output_chunk(job.scan_id, job.chunk_index)
+        if content is None:
+            return False
+        self.docs.collection(job.scan_id).insert_one(
+            {
+                "scan_id": job.scan_id,
+                "chunk_index": job.chunk_index,
+                "module": job.module,
+                "worker_id": job.worker_id,
+                "start_time": job.started_at,
+                "end_time": job.completed_at,
+                "job_id": job_id,
+                "content": content,
+            }
+        )
+        return True
+
+    # ------------------------------------------------------------------
+    def reset(self) -> None:
+        """Flush all queue/scan state (reference /reset, server.py:550-554)."""
+        self.state.flushall()

@@ -1,0 +1,1 @@
+"""Worker runtime: poll loop, module registry, TPU batch executor."""

@@ -1,0 +1,3 @@
+from swarm_tpu.worker.runtime import main
+
+main()

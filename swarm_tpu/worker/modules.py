@@ -1,0 +1,120 @@
+"""Module registry — the plugin system for scan types.
+
+Wire-compatible with the reference's ``worker/modules/*.json`` command
+templates (``{input}``/``{output}`` substitution, ``worker/worker.py:27-33``)
+and extended with a TPU backend:
+
+    {"command": "nmap -T5 ... -oN {output} -iL {input}"}     # subprocess
+    {"backend": "tpu", "templates": "/path/to/corpus",       # device batch
+     "input_format": "jsonl"}
+
+The TPU backend replaces the shell-out with a device-batched
+fingerprint match (the reference's compute was nmap/-sV/nuclei inside
+the subprocess — SURVEY.md §2.2). ``input_format``:
+
+- ``jsonl``: each input line is a JSON response row
+  ``{host, port, status, body?, header?, banner?}`` (body/header/banner
+  base64 when *_b64 variants used). Produced by the native probe
+  front-end or any external collector.
+- ``targets``: each line is a bare ``host[:port]`` target; requires the
+  native I/O front-end to grab banners first (wired in executor.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from pathlib import Path
+from typing import Optional
+
+from swarm_tpu.fingerprints.model import Response
+
+
+class ModuleSpec:
+    def __init__(self, name: str, raw: dict):
+        self.name = name
+        self.raw = raw
+        self.backend = raw.get("backend", "command")
+        self.command_template: Optional[str] = raw.get("command")
+        templates = raw.get("templates")
+        # allow $SWARM_TEMPLATES_DIR-style indirection in module files
+        self.templates_dir: Optional[str] = (
+            os.path.expandvars(templates) if templates else None
+        )
+        self.input_format: str = raw.get("input_format", "jsonl")
+        self.probe: dict = raw.get("probe", {})
+
+    def command(self, input_path: str, output_path: str) -> str:
+        """Substitute {input}/{output} (reference worker.py:27-33)."""
+        if not self.command_template:
+            raise ValueError(f"module {self.name} has no command")
+        return self.command_template.replace("{input}", input_path).replace(
+            "{output}", output_path
+        )
+
+
+class ModuleRegistry:
+    def __init__(self, modules_dir: str | Path):
+        self.modules_dir = Path(modules_dir)
+
+    def load(self, name: str) -> ModuleSpec:
+        safe = Path(name).name  # no path traversal via module names
+        path = self.modules_dir / f"{safe}.json"
+        with path.open() as f:
+            return ModuleSpec(safe, json.load(f))
+
+    def names(self) -> list[str]:
+        if not self.modules_dir.is_dir():
+            return []
+        return sorted(p.stem for p in self.modules_dir.glob("*.json"))
+
+
+# ---------------------------------------------------------------------------
+# Response row (de)serialization for the jsonl input format
+# ---------------------------------------------------------------------------
+
+
+def _bytes_field(obj: dict, name: str) -> bytes:
+    if f"{name}_b64" in obj:
+        return base64.b64decode(obj[f"{name}_b64"])
+    value = obj.get(name)
+    if value is None:
+        return b""
+    if isinstance(value, bytes):
+        return value
+    return str(value).encode("utf-8", "surrogateescape")
+
+
+def parse_response_line(line: str) -> Optional[Response]:
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        obj = json.loads(line)
+    except ValueError:
+        # bare target line — pass through as an empty response so the
+        # row count is stable; real probing is the front-end's job
+        host, _, port = line.partition(":")
+        return Response(host=host, port=int(port) if port.isdigit() else 0)
+    banner = _bytes_field(obj, "banner") if ("banner" in obj or "banner_b64" in obj) else None
+    return Response(
+        host=str(obj.get("host", "")),
+        port=int(obj.get("port", 0) or 0),
+        status=int(obj.get("status", 0) or 0),
+        body=_bytes_field(obj, "body"),
+        header=_bytes_field(obj, "header"),
+        banner=banner,
+    )
+
+
+def format_match_line(row: Response, matches) -> str:
+    return json.dumps(
+        {
+            "host": row.host,
+            "port": row.port,
+            "matches": matches.template_ids,
+            "extractions": matches.extractions,
+        },
+        sort_keys=True,
+    )

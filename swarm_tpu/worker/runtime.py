@@ -1,0 +1,223 @@
+"""Worker poll loop — reference ``worker/worker.py`` rebuilt.
+
+Same observable protocol: poll ``/get-job``, walk the job through
+``starting → downloading → executing → uploading → complete`` (or
+``cmd failed`` / ``upload failed - *``) via ``/update-job``, with the
+reference's cadence (0.8 s between jobs, 10 s when idle). Differences:
+
+- chunk data moves over the server HTTP API by default (the reference
+  requires AWS credentials on every worker); direct S3 remains possible
+  via a custom transport.
+- the ``tpu`` module backend executes the chunk as a device batch with
+  the in-process MatchEngine instead of a subprocess.
+- ``max_jobs`` actually works (the reference parsed and ignored it, and
+  its post-loop thread spawn was dead code — SURVEY.md §2.1 defects).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import tempfile
+import time
+from pathlib import Path
+from typing import Optional
+
+import requests
+
+from swarm_tpu.config import Config
+from swarm_tpu.datamodel import JobStatus
+from swarm_tpu.worker.modules import (
+    ModuleRegistry,
+    ModuleSpec,
+    format_match_line,
+    parse_response_line,
+)
+
+
+class ServerClient:
+    """HTTP client for the worker-facing server API."""
+
+    def __init__(self, server_url: str, api_key: str, timeout: float = 30.0):
+        self.base = server_url.rstrip("/")
+        self.timeout = timeout
+        self.session = requests.Session()
+        self.session.headers["Authorization"] = f"Bearer {api_key}"
+
+    def get_job(self, worker_id: str) -> Optional[dict]:
+        resp = self.session.get(
+            f"{self.base}/get-job", params={"worker_id": worker_id}, timeout=self.timeout
+        )
+        return resp.json() if resp.status_code == 200 else None
+
+    def update_job(self, job_id: str, changes: dict) -> bool:
+        resp = self.session.post(
+            f"{self.base}/update-job/{job_id}", json=changes, timeout=self.timeout
+        )
+        return resp.status_code == 200
+
+    def get_input_chunk(self, scan_id: str, chunk_index: int) -> Optional[bytes]:
+        resp = self.session.get(
+            f"{self.base}/get-input-chunk/{scan_id}/{chunk_index}", timeout=self.timeout
+        )
+        return resp.content if resp.status_code == 200 else None
+
+    def put_output_chunk(self, scan_id: str, chunk_index: int, data: bytes) -> bool:
+        resp = self.session.post(
+            f"{self.base}/put-output-chunk/{scan_id}/{chunk_index}",
+            data=data,
+            timeout=self.timeout,
+        )
+        return resp.status_code == 200
+
+
+class JobProcessor:
+    def __init__(
+        self,
+        cfg: Config,
+        client: Optional[ServerClient] = None,
+        registry: Optional[ModuleRegistry] = None,
+        work_dir: Optional[str] = None,
+    ):
+        self.cfg = cfg
+        self.client = client or ServerClient(cfg.resolve_url(), cfg.api_key)
+        self.registry = registry or ModuleRegistry(cfg.modules_dir)
+        self.work_dir = Path(work_dir or tempfile.mkdtemp(prefix="swarm_worker_"))
+        self.work_dir.mkdir(parents=True, exist_ok=True)
+        self._engines: dict[str, object] = {}  # templates_dir -> MatchEngine
+        self.jobs_done = 0
+
+    # ------------------------------------------------------------------
+    def process_jobs(self) -> None:
+        """The infinite poll loop (reference worker.py:113-126)."""
+        while True:
+            try:
+                job = self.client.get_job(self.cfg.worker_id)
+                if job:
+                    self.process_chunk(job)
+                    if self.cfg.max_jobs and self.jobs_done >= self.cfg.max_jobs:
+                        return
+                else:
+                    time.sleep(self.cfg.poll_interval_idle_s)
+            except Exception as e:
+                print(f"error getting job: {e}")
+                time.sleep(self.cfg.poll_interval_idle_s)
+            time.sleep(self.cfg.poll_interval_busy_s)
+
+    # ------------------------------------------------------------------
+    def process_chunk(self, job: dict) -> None:
+        job_id = job.get("job_id") or f"{job['scan_id']}_{job['chunk_index']}"
+        scan_id, chunk_index = job["scan_id"], int(job["chunk_index"])
+        update = lambda status: self.client.update_job(job_id, {"status": status})
+
+        update(JobStatus.STARTING)
+        update(JobStatus.DOWNLOADING)
+        data = self.client.get_input_chunk(scan_id, chunk_index)
+        if data is None:
+            update(JobStatus.CMD_FAILED)
+            return
+
+        update(JobStatus.EXECUTING)
+        try:
+            module = self.registry.load(job["module"])
+        except (OSError, ValueError) as e:
+            print(f"module load failed: {e}")
+            update(JobStatus.CMD_FAILED)
+            return
+
+        try:
+            if module.backend == "tpu":
+                output = self._execute_tpu(module, data)
+            else:
+                output = self._execute_command(module, scan_id, chunk_index, data)
+        except Exception as e:
+            print(f"execution failed: {e}")
+            update(JobStatus.CMD_FAILED)
+            return
+        if output is None:
+            update(JobStatus.CMD_FAILED)
+            return
+
+        update(JobStatus.UPLOADING)
+        try:
+            ok = self.client.put_output_chunk(scan_id, chunk_index, output)
+        except requests.RequestException:
+            ok = False
+        if ok:
+            update(JobStatus.COMPLETE)
+            self.jobs_done += 1
+        else:
+            update(JobStatus.UPLOAD_FAILED_UNKNOWN)
+
+    # ------------------------------------------------------------------
+    def _execute_command(
+        self, module: ModuleSpec, scan_id: str, chunk_index: int, data: bytes
+    ) -> Optional[bytes]:
+        """Subprocess path — behavior-parity with reference worker.py:79-90."""
+        job_dir = self.work_dir / scan_id
+        job_dir.mkdir(parents=True, exist_ok=True)
+        input_file = job_dir / f"chunk_{chunk_index}.txt"
+        output_file = job_dir / f"chunk_{chunk_index}.out.txt"
+        input_file.write_bytes(data)
+        command = module.command(str(input_file), str(output_file))
+        proc = subprocess.run(
+            command, shell=True, stdout=subprocess.PIPE, stderr=subprocess.PIPE
+        )
+        if proc.returncode != 0:
+            print(f"Error executing command: {command}")
+            print(proc.stderr.decode("utf-8", "replace"))
+            return None
+        return output_file.read_bytes() if output_file.is_file() else proc.stdout
+
+    # ------------------------------------------------------------------
+    def _engine_for(self, templates_dir: str):
+        engine = self._engines.get(templates_dir)
+        if engine is None:
+            from swarm_tpu.fingerprints import load_corpus
+            from swarm_tpu.ops.engine import MatchEngine
+
+            templates, _errors = load_corpus(templates_dir)
+            engine = MatchEngine(templates)
+            self._engines[templates_dir] = engine
+        return engine
+
+    def _execute_tpu(self, module: ModuleSpec, data: bytes) -> bytes:
+        """Device-batch path: chunk rows → MatchEngine → JSONL hits."""
+        if not module.templates_dir:
+            raise ValueError(f"tpu module {module.name} missing 'templates'")
+        engine = self._engine_for(module.templates_dir)
+        rows = []
+        for line in data.decode("utf-8", "surrogateescape").splitlines():
+            row = parse_response_line(line)
+            if row is not None:
+                rows.append(row)
+        results = engine.match(rows)
+        out_lines = [
+            format_match_line(row, matches) for row, matches in zip(rows, results)
+        ]
+        return ("\n".join(out_lines) + "\n").encode() if out_lines else b""
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="swarm_tpu worker")
+    parser.add_argument("--server-url", default=None)
+    parser.add_argument("--api-key", default=None)
+    parser.add_argument("--worker-id", default=None)
+    parser.add_argument("--modules-dir", default=None)
+    parser.add_argument("--max-jobs", type=int, default=None)
+    parser.add_argument("--config", default=None)
+    args = parser.parse_args(argv)
+    cfg = Config.load(
+        path=args.config,
+        server_url=args.server_url,
+        api_key=args.api_key,
+        worker_id=args.worker_id,
+        modules_dir=args.modules_dir,
+        max_jobs=args.max_jobs,
+    )
+    JobProcessor(cfg).process_jobs()
+
+
+if __name__ == "__main__":
+    main()

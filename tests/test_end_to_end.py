@@ -1,0 +1,134 @@
+"""Full-loop test: CLI → server → worker (tpu + command backends) → results.
+
+This is the reference's §3.1–§3.4 call stacks exercised in one process:
+scan submission, worker poll/execute/upload, status rollup, raw results
+and tail retrieval — with the TPU fingerprint module doing the compute.
+"""
+
+import base64
+import json
+import threading
+
+import pytest
+
+from swarm_tpu.config import Config
+from swarm_tpu.server.app import SwarmServer
+from swarm_tpu.worker.runtime import JobProcessor, ServerClient
+from swarm_tpu.worker.modules import ModuleRegistry
+from swarm_tpu.client.cli import JobClient, main as cli_main
+
+TEMPLATES = "tests/data/templates"
+
+
+@pytest.fixture
+def stack(tmp_path, monkeypatch):
+    monkeypatch.setenv("SWARM_TEMPLATES_DIR", TEMPLATES)
+    modules_dir = tmp_path / "modules"
+    modules_dir.mkdir()
+    (modules_dir / "fingerprint.json").write_text(
+        json.dumps({"backend": "tpu", "templates": "${SWARM_TEMPLATES_DIR}"})
+    )
+    (modules_dir / "echo.json").write_text(
+        json.dumps({"command": "cat {input} > {output}"})
+    )
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="e2ekey",
+        blob_root=str(tmp_path / "blobs"), doc_root=str(tmp_path / "docs"),
+        modules_dir=str(modules_dir),
+        poll_interval_idle_s=0.05, poll_interval_busy_s=0.01,
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    cfg.server_url = f"http://127.0.0.1:{srv.port}"
+    yield cfg, srv, tmp_path
+    srv.shutdown()
+
+
+def run_worker(cfg, max_jobs):
+    wcfg = Config(**{**cfg.__dict__, "max_jobs": max_jobs, "worker_id": "tpu-w0"})
+    proc = JobProcessor(wcfg)
+    proc.process_jobs()
+    return proc
+
+
+def test_end_to_end_tpu_scan(stack):
+    cfg, srv, tmp_path = stack
+
+    # --- client submits a jsonl scan (3 rows, batch 2 -> 2 chunks) ---
+    rows = [
+        {"host": "10.0.0.1", "port": 443, "status": 200,
+         "body": "<title>Demo Admin</title> demo-build 7.7 page"},
+        {"host": "10.0.0.2", "port": 80, "status": 200, "body": "hello world"},
+        {"host": "10.0.0.3", "port": 7777,
+         "banner_b64": base64.b64encode(b"DEMOD: 2 service ready").decode()},
+    ]
+    scan_file = tmp_path / "targets.jsonl"
+    scan_file.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+    client = JobClient(cfg.resolve_url(), cfg.api_key)
+    code, text = client.start_scan(str(scan_file), "fingerprint", 0, 2)
+    assert code == 200
+
+    # --- worker drains both chunks ---
+    worker = run_worker(cfg, max_jobs=2)
+    assert worker.jobs_done == 2
+
+    # --- scan complete, results correct ---
+    statuses = client.get_statuses()
+    [scan] = statuses["scans"]
+    assert scan["percent_complete"] == 100.0
+    scan_id = scan["scan_id"]
+
+    raw = client.fetch_raw(scan_id)
+    out = [json.loads(l) for l in raw.strip().splitlines()]
+    by_host = {o["host"]: o for o in out}
+    assert "demo-panel" in by_host["10.0.0.1"]["matches"]
+    assert by_host["10.0.0.1"]["extractions"]["demo-panel"] == ["7.7"]
+    assert by_host["10.0.0.2"]["matches"] == ["demo-tech"]  # negative matcher
+    assert "demo-banner" in by_host["10.0.0.3"]["matches"]
+
+
+def test_end_to_end_command_module(stack):
+    cfg, srv, tmp_path = stack
+    scan_file = tmp_path / "targets.txt"
+    scan_file.write_text("alpha\nbeta\ngamma\n")
+    client = JobClient(cfg.resolve_url(), cfg.api_key)
+    code, _ = client.start_scan(str(scan_file), "echo", 0, 3)
+    assert code == 200
+    worker = run_worker(cfg, max_jobs=1)
+    assert worker.jobs_done == 1
+    statuses = client.get_statuses()
+    scan_id = statuses["scans"][0]["scan_id"]
+    assert client.fetch_raw(scan_id) == "alpha\nbeta\ngamma"
+
+
+def test_cli_actions_render(stack, capsys):
+    cfg, srv, tmp_path = stack
+    scan_file = tmp_path / "t.txt"
+    scan_file.write_text("one\ntwo\n")
+    base_args = ["--server-url", cfg.resolve_url(), "--api-key", cfg.api_key]
+    assert cli_main(["scan", "--file", str(scan_file), "--module", "echo",
+                     "--batch-size", "1"] + base_args) == 0
+    run_worker(cfg, max_jobs=2)
+    for action in ("workers", "jobs", "scans"):
+        assert cli_main([action] + base_args) == 0
+    captured = capsys.readouterr().out
+    assert "tpu-w0" in captured
+    assert "complete" in captured
+    assert cli_main(["reset"] + base_args) == 0
+
+
+def test_worker_cmd_failed_on_bad_module(stack):
+    cfg, srv, tmp_path = stack
+    (tmp_path / "modules" / "boom.json").write_text(json.dumps({"command": "exit 3"}))
+    scan_file = tmp_path / "t.txt"
+    scan_file.write_text("x\n")
+    client = JobClient(cfg.resolve_url(), cfg.api_key)
+    client.start_scan(str(scan_file), "boom", 0, 1)
+    wcfg = Config(**{**cfg.__dict__, "max_jobs": 1, "worker_id": "w-fail"})
+    proc = JobProcessor(wcfg)
+    job = proc.client.get_job("w-fail")
+    proc.process_chunk(job)
+    statuses = client.get_statuses()
+    [job_rec] = statuses["jobs"].values()
+    assert job_rec["status"] == "cmd failed"

@@ -135,3 +135,71 @@ def test_blob_list_empty_prefix(tmp_path):
     # no key literally starts with "../" (S3 semantics) and the sibling
     # file outside the root must never leak into the listing
     assert store.list("../") == []
+
+
+def test_unknown_part_size_and_regex_constants():
+    # oracle evaluates these over b"" — size [0] and empty-matching
+    # regexes are TRUE constants, not const-False
+    doc = {
+        "id": "x-oob-const",
+        "info": {"severity": "info"},
+        "requests": [
+            {
+                "matchers-condition": "and",
+                "matchers": [
+                    {"type": "size", "part": "interactsh_protocol", "size": [0]},
+                    {"type": "regex", "part": "interactsh_protocol", "regex": ["^$"]},
+                ],
+            }
+        ],
+    }
+    rows = [model.Response(host="a", status=200, body=b"anything")]
+    _engine_vs_oracle(doc, rows)
+    # and the false variants
+    doc2 = {
+        "id": "x-oob-false",
+        "info": {"severity": "info"},
+        "requests": [
+            {
+                "matchers": [
+                    {"type": "size", "part": "interactsh_protocol", "size": [5],
+                     "negative": True},
+                ]
+            }
+        ],
+    }
+    _engine_vs_oracle(doc2, rows)
+
+
+def test_exotic_dsl_degrades_to_unsupported_not_crash():
+    # RE2-only syntax raises re.error inside evaluate; must not abort
+    doc = {
+        "id": "x-exotic-dsl",
+        "info": {"severity": "info"},
+        "requests": [
+            {"matchers": [{"type": "dsl", "dsl": ['body =~ "\\\\p{Greek}"']}]}
+        ],
+    }
+    rows = [model.Response(host="a", status=200, body=b"abc")]
+    t = parse_template(doc)
+    res = cpu_ref.match_template(t, rows[0])
+    assert not res.matched and res.unsupported
+    eng = MatchEngine([t])
+    out = eng.match(rows)  # must not raise
+    assert out[0].template_ids == []
+
+
+def test_ci_regex_nonascii_literal_goes_host():
+    doc = {
+        "id": "x-ci-nonascii",
+        "info": {"severity": "info"},
+        "requests": [
+            {"matchers": [{"type": "regex", "regex": ["(?i)münchen-admin-panel"]}]}
+        ],
+    }
+    rows = [
+        model.Response(host="a", status=200, body="MÜNCHEN-ADMIN-PANEL".encode("latin-1")),
+        model.Response(host="b", status=200, body=b"unrelated"),
+    ]
+    eng = _engine_vs_oracle(doc, rows)
+    assert len(eng.db.host_always) == 1

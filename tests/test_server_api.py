@@ -1,0 +1,176 @@
+"""REST API contract tests — the wire surface the reference client expects."""
+
+import json
+
+import pytest
+import requests
+
+from swarm_tpu.config import Config
+from swarm_tpu.server.app import SwarmServer
+
+
+@pytest.fixture
+def server(tmp_path):
+    cfg = Config(
+        host="127.0.0.1",
+        port=0,
+        api_key="testkey",
+        blob_root=str(tmp_path / "blobs"),
+        doc_root=str(tmp_path / "docs"),
+        lease_seconds=30,
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture
+def api(server):
+    class Api:
+        base = f"http://127.0.0.1:{server.port}"
+        headers = {"Authorization": "Bearer testkey"}
+
+        def get(self, path, **kw):
+            kw.setdefault("headers", self.headers)
+            return requests.get(self.base + path, **kw)
+
+        def post(self, path, **kw):
+            kw.setdefault("headers", self.headers)
+            return requests.post(self.base + path, **kw)
+
+    return Api()
+
+
+def _queue_scan(api, lines=30, batch=10, module="echo"):
+    resp = api.post(
+        "/queue",
+        json={
+            "module": module,
+            "file_content": [f"10.0.0.{i}\n" for i in range(lines)],
+            "batch_size": batch,
+            "scan_id": None,
+            "chunk_index": 0,
+        },
+    )
+    return resp
+
+
+def test_auth_required(api):
+    assert requests.get(api.base + "/get-statuses").status_code == 401
+    bad = {"Authorization": "Bearer wrong"}
+    assert requests.get(api.base + "/get-statuses", headers=bad).status_code == 401
+    assert requests.get(api.base + "/healthz").status_code == 200
+
+
+def test_queue_and_dispatch_cycle(api):
+    resp = _queue_scan(api)
+    assert resp.status_code == 200
+    assert resp.text == "Job queued successfully"
+
+    # worker polls
+    job = api.get("/get-job", params={"worker_id": "w1"})
+    assert job.status_code == 200
+    job_data = job.json()
+    assert job_data["status"] == "in progress"
+    assert job_data["worker_id"] == "w1"
+    assert job_data["chunk_index"] == 0
+    scan_id = job_data["scan_id"]
+
+    # input chunk is served over HTTP
+    chunk = api.get(f"/get-input-chunk/{scan_id}/0")
+    assert chunk.status_code == 200
+    assert chunk.content.decode().splitlines()[0] == "10.0.0.0"
+
+    # worker walks the status machine
+    for status in ("starting", "downloading", "executing", "uploading"):
+        r = api.post(f"/update-job/{scan_id}_0", json={"status": status})
+        assert r.status_code == 200
+
+    api.post(f"/put-output-chunk/{scan_id}/0", data=b"result for chunk 0\n")
+    api.post(f"/update-job/{scan_id}_0", json={"status": "complete"})
+
+    # statuses rollup
+    statuses = api.get("/get-statuses").json()
+    assert "w1" in statuses["workers"]
+    assert statuses["jobs"][f"{scan_id}_0"]["status"] == "complete"
+    assert statuses["jobs"][f"{scan_id}_0"]["completed_at"] is not None
+    [scan] = statuses["scans"]
+    assert scan["total_chunks"] == 3
+    assert scan["chunks_complete"] == 1
+
+    # completed queue + chunk retrieval (reference tail path)
+    latest = api.get("/get-latest-chunk")
+    assert latest.status_code == 200
+    assert latest.text == f"{scan_id}_0"
+    chunk = api.get(f"/get-chunk/{scan_id}/0")
+    assert chunk.json()["contents"] == "result for chunk 0\n"
+    # queue drained -> 204
+    assert api.get("/get-latest-chunk").status_code == 204
+
+    # raw merged output
+    raw = api.get(f"/raw/{scan_id}")
+    assert raw.text == "result for chunk 0\n"
+
+    # parse_job -> doc store
+    parsed = api.get(f"/parse_job/{scan_id}_0")
+    assert parsed.status_code == 200
+
+
+def test_unknown_job_404(api):
+    assert api.post("/update-job/nope_1", json={"status": "x"}).status_code == 404
+    assert api.get("/get-chunk/nope/0").status_code == 404
+
+
+def test_empty_queue_204(api):
+    resp = api.get("/get-job", params={"worker_id": "idle1"})
+    assert resp.status_code == 204
+
+
+def test_queue_requires_module(api):
+    resp = api.post("/queue", json={"file_content": ["a\n"], "batch_size": 1})
+    assert resp.status_code == 400
+
+
+def test_spin_up_down_validation(api):
+    assert api.post("/spin-up", json={}).status_code == 400
+    assert api.post("/spin-up", json={"prefix": "x", "nodes": 2}).status_code == 202
+    assert api.post("/spin-down", json={}).status_code == 400
+    assert api.post("/spin-down", json={"prefix": "x"}).status_code == 202
+
+
+def test_reset(api):
+    _queue_scan(api)
+    assert api.post("/reset").json()["message"] == "Redis database reset"
+    assert api.get("/get-statuses").json()["jobs"] == {}
+
+
+def test_lease_requeue(tmp_path):
+    """A job whose worker dies comes back after lease expiry — the fix
+    for the reference's lost-job hole (SURVEY.md §5)."""
+    import time as _time
+
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="k",
+        blob_root=str(tmp_path / "b"), doc_root=str(tmp_path / "d"),
+        lease_seconds=0.2, max_attempts=3,
+    )
+    srv = SwarmServer(cfg)
+    q = srv.queue
+    q.queue_scan({"module": "echo", "file_content": ["t1\n"], "batch_size": 1})
+    job = q.next_job("dying-worker")
+    assert job["status"] == "in progress"
+    assert q.next_job("other") is None  # nothing else queued yet
+    _time.sleep(0.25)
+    rejob = q.next_job("healthy-worker")  # lease expired -> requeued
+    assert rejob is not None
+    assert rejob["job_id"] == job["job_id"]
+    assert rejob["worker_id"] == "healthy-worker"
+    assert rejob["attempts"] == 2
+    # exhaust attempts -> terminal cmd failed
+    _time.sleep(0.25)
+    assert q.next_job("w3") is not None
+    _time.sleep(0.25)
+    assert q.next_job("w4") is None
+    raw = json.loads(q.state.hget("jobs", job["job_id"]))
+    assert raw["status"] == "cmd failed"
