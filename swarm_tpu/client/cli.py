@@ -295,24 +295,31 @@ def _run_action(args, cfg: Config, client: JobClient) -> int:
         chunk: list[str] = []
         chunk_index = 0
         batch = 0 if args.batch_size == "auto" else int(float(args.batch_size))
+
+        def flush(lines: list[str]) -> None:
+            nonlocal chunk_index
+            chunk_index += 1
+            resp = client.session.post(
+                f"{client.base}/queue",
+                json={
+                    "module": args.module,
+                    "file_content": lines,
+                    "batch_size": batch,
+                    "scan_id": args.scan_id,
+                    "chunk_index": chunk_index,
+                },
+                timeout=client.timeout,
+            )
+            print(f"Uploading chunk {chunk_index}: {resp.status_code}")
+
         for line in sys.stdin:
             chunk.append(line)
             if len(chunk) >= 10:
-                chunk_index += 1
-                resp = client.session.post(
-                    f"{client.base}/queue",
-                    json={
-                        "module": args.module,
-                        "file_content": chunk,
-                        "batch_size": batch,
-                        "scan_id": args.scan_id,
-                        "chunk_index": chunk_index,
-                    },
-                    timeout=client.timeout,
-                )
-                print(f"Uploading chunk {chunk_index}: {resp.status_code}")
+                flush(chunk)
                 chunk = []
                 time.sleep(0.3)
+        if chunk:  # the reference dropped the trailing partial chunk
+            flush(chunk)
         return 0
 
     if args.action == "cat":

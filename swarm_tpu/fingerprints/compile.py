@@ -123,17 +123,26 @@ def required_literal(pattern: str, min_len: int = 4) -> Optional[bytes]:
     except re.error:
         return None
 
-    case_insensitive = bool(tree.state.flags & re.IGNORECASE)
+    global_ci = bool(tree.state.flags & re.IGNORECASE)
 
+    # best required literal; a run collected under case-insensitivity
+    # (global or scoped (?i:...)) is unusable if it has non-ASCII bytes —
+    # Python folds Unicode over the latin-1 decode, device lowering is
+    # ASCII-only, so the lowered probe would not be a superset.
     best: list[bytes] = [b""]
 
-    def walk(seq) -> None:
+    def consider(run: bytes, ci: bool) -> None:
+        if ci and any(b >= 0x80 for b in run):
+            return
+        if len(run) > len(best[0]):
+            best[0] = bytes(run)
+
+    def walk(seq, ci: bool) -> None:
         run = bytearray()
 
         def flush():
             nonlocal run
-            if len(run) > len(best[0]):
-                best[0] = bytes(run)
+            consider(bytes(run), ci)
             run = bytearray()
 
         for op, arg in seq:
@@ -144,10 +153,14 @@ def required_literal(pattern: str, min_len: int = 4) -> Optional[bytes]:
                 lo, _hi, child = arg
                 flush()
                 if lo >= 1:
-                    walk(child)
+                    walk(child, ci)
             elif opname == "SUBPATTERN":
+                # arg = (group, add_flags, del_flags, seq): scoped flags
                 flush()
-                walk(arg[3])
+                child_ci = (ci or bool(arg[1] & re.IGNORECASE)) and not bool(
+                    arg[2] & re.IGNORECASE
+                )
+                walk(arg[3], child_ci)
             elif opname == "AT":
                 # zero-width assertion: consumes nothing, so bytes on either
                 # side are still adjacent in any match — run continues.
@@ -157,14 +170,9 @@ def required_literal(pattern: str, min_len: int = 4) -> Optional[bytes]:
                 flush()
         flush()
 
-    walk(tree)
+    walk(tree, global_ci)
     lit = best[0]
     if len(lit) < min_len:
-        return None
-    if case_insensitive and any(b >= 0x80 for b in lit):
-        # Python's IGNORECASE folds Unicode (0xDC↔0xFC over the latin-1
-        # decode) but the device stream lowering is ASCII-only — the
-        # lowered-literal probe would not be a superset. Host the template.
         return None
     # Always ASCII-lowercase: the prefilter probes the *lowered* stream,
     # a sound superset for case-sensitive regexes (non-A-Z bytes are

@@ -170,11 +170,17 @@ def _text(v: Any) -> str:
 _REGEX_CACHE: dict[str, "re.Pattern[str]"] = {}
 
 
-def _search(pattern: str, text: str):
+def compile_cached(pattern: str) -> "re.Pattern[str]":
+    """Unbounded pattern→compiled cache shared by the DSL evaluator and
+    the CPU oracle (the corpus outgrows re's 512-entry internal cache)."""
     compiled = _REGEX_CACHE.get(pattern)
     if compiled is None:
         compiled = _REGEX_CACHE[pattern] = re.compile(pattern)
-    return compiled.search(text)
+    return compiled
+
+
+def _search(pattern: str, text: str):
+    return compile_cached(pattern).search(text)
 
 
 _FUNCTIONS: dict[str, Callable] = {

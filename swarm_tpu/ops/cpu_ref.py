@@ -47,17 +47,9 @@ def _decode(part: bytes) -> str:
     return part.decode("latin-1")
 
 
-_REGEX_CACHE: dict[str, "re.Pattern[str]"] = {}
-
-
-def _compile_cached(pattern: str):
-    """Unbounded compile cache — the corpus has ~1.8k distinct regexes,
-    which overflows re's internal 512-entry cache and would otherwise
-    recompile per evaluation in the host-confirm loop."""
-    compiled = _REGEX_CACHE.get(pattern)
-    if compiled is None:
-        compiled = _REGEX_CACHE[pattern] = re.compile(pattern)
-    return compiled
+# shared compile cache (see dslc.compile_cached): the corpus has ~1.8k
+# distinct regexes, which overflows re's internal 512-entry cache
+_compile_cached = dslc.compile_cached
 
 
 def _parse_headers(header_blob: bytes) -> dict[str, str]:

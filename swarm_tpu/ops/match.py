@@ -78,8 +78,46 @@ def _shifted(stream, q: int):
     return [padded[:, j : j + W] for j in range(q)]
 
 
-def match_slots(db: fpc.CompiledDB, candidate_k: int, streams, lengths):
-    """→ (value_bits [B, NS] bool, uncertain_bits [B, NS] bool, overflow [B])."""
+def table_arrays_of(table: fpc.WordTable) -> dict:
+    """The traced-array view of one WordTable (jnp constants by default;
+    the sharded path passes per-rank slices instead)."""
+    return {
+        "group_h1": jnp.asarray(table.group_h1),
+        "entry_start": jnp.asarray(table.entry_start),
+        "entry_count": jnp.asarray(table.entry_count),
+        "entry_h2": jnp.asarray(table.entry_h2),
+        "entry_slot": jnp.asarray(table.entry_slot),
+        "entry_off": jnp.asarray(table.entry_off),
+        "entry_len": jnp.asarray(table.entry_len),
+        "entry_suf_delta": jnp.asarray(table.entry_suf_delta),
+        "entry_suf_h1": jnp.asarray(table.entry_suf_h1),
+        "entry_suf_h2": jnp.asarray(table.entry_suf_h2),
+        "bloom": jnp.asarray(table.bloom),
+    }
+
+
+def match_slots(
+    db: fpc.CompiledDB,
+    candidate_k: int,
+    streams,
+    lengths,
+    table_arrays: Optional[list] = None,
+    pos_offset: int = 0,
+    back_halo: int = 0,
+    fwd_halo: int = 0,
+):
+    """→ (value_bits [B, NS] bool, uncertain_bits [B, NS] bool, overflow [B]).
+
+    Sequence parallelism support: ``streams`` may be halo-extended
+    ([B, back_halo + W_local + fwd_halo]). Candidate windows *start*
+    only in the W_local middle region (each global window position is
+    owned by exactly one shard) but hash/verify reads may reach into
+    both halos — a word whose gram sits in this shard can begin in the
+    previous shard's bytes (back halo) and end in the next shard's
+    (forward halo). Both halos must be ≥ the longest table entry for
+    the superset property to survive sharding. ``pos_offset`` is the
+    shard's global byte offset; ``lengths`` are always global.
+    """
     ns = db.num_slots
     some = next(iter(streams.values()))
     B = some.shape[0]
@@ -105,43 +143,59 @@ def match_slots(db: fpc.CompiledDB, candidate_k: int, streams, lengths):
             hash_cache[key] = hashing.window_hashes_jnp(get_stream(name, lowered), q)
         return hash_cache[key]
 
-    # --- q-gram tables ---
-    for table in db.tables:
-        h1, h2 = get_hashes(table.stream, table.lowered, table.q)
-        W = h1.shape[1]
-        slen = jnp.minimum(lengths[table.stream], W)
+    def offset_of(name: str):
+        # per-stream global byte offset (streams have different widths,
+        # so sequence shards start at different global positions per stream)
+        if isinstance(pos_offset, dict):
+            return pos_offset[name]
+        return pos_offset
 
-        flags = hashing.bloom_probe_jnp(jnp.asarray(table.bloom), h1, h2)
+    # --- q-gram tables ---
+    for t_idx, table in enumerate(db.tables):
+        arrays = (
+            table_arrays[t_idx] if table_arrays is not None else table_arrays_of(table)
+        )
+        h1, h2 = get_hashes(table.stream, table.lowered, table.q)
+        We = h1.shape[1]  # extended width (back halo + local + fwd halo)
+        W = We - back_halo - fwd_halo  # windows start only in the middle
+        slen = lengths[table.stream]  # global length
+
+        flags = hashing.bloom_probe_jnp(
+            arrays["bloom"],
+            h1[:, back_halo : back_halo + W],
+            h2[:, back_halo : back_halo + W],
+        )
         # windows starting past slen - q can't begin a real gram
         positions = jnp.arange(W, dtype=jnp.int32)
-        flags = flags & (positions[None, :] <= (slen - table.q)[:, None])
+        gpositions = positions + offset_of(table.stream)
+        flags = flags & (gpositions[None, :] <= (slen - table.q)[:, None])
 
         k = min(candidate_k, W)
         vals = jnp.where(flags, positions[None, :] + 1, 0)
         top_vals, _ = jax.lax.top_k(vals, k)
-        pos = top_vals - 1  # -1 = invalid
+        pos = top_vals - 1  # -1 = invalid (local window coordinate)
         valid = pos >= 0
-        cpos = jnp.maximum(pos, 0)
+        cpos = jnp.maximum(pos, 0) + back_halo  # extended coordinate
         overflow = overflow | (jnp.sum(flags, axis=1) > k)
 
         h1c = jnp.take_along_axis(h1, cpos, axis=1)
         h2c = jnp.take_along_axis(h2, cpos, axis=1)
 
-        group_h1 = jnp.asarray(table.group_h1)
+        group_h1 = arrays["group_h1"]
         gidx = jnp.searchsorted(group_h1, h1c)
-        G = table.num_groups
+        G = group_h1.shape[0]
         gidx_c = jnp.minimum(gidx, G - 1)
         found = valid & (group_h1[gidx_c] == h1c)
 
-        e_start = jnp.asarray(table.entry_start)[gidx_c]
-        e_count = jnp.asarray(table.entry_count)[gidx_c]
-        entry_h2 = jnp.asarray(table.entry_h2)
-        entry_slot = jnp.asarray(table.entry_slot)
-        entry_off = jnp.asarray(table.entry_off)
-        entry_len = jnp.asarray(table.entry_len)
-        entry_sufd = jnp.asarray(table.entry_suf_delta)
-        entry_sufh1 = jnp.asarray(table.entry_suf_h1)
-        entry_sufh2 = jnp.asarray(table.entry_suf_h2)
+        e_start = arrays["entry_start"][gidx_c]
+        e_count = arrays["entry_count"][gidx_c]
+        entry_h2 = arrays["entry_h2"]
+        entry_slot = arrays["entry_slot"]
+        entry_off = arrays["entry_off"]
+        entry_len = arrays["entry_len"]
+        entry_sufd = arrays["entry_suf_delta"]
+        entry_sufh1 = arrays["entry_suf_h1"]
+        entry_sufh2 = arrays["entry_suf_h2"]
 
         b_idx = jnp.arange(B, dtype=jnp.int32)[:, None] * jnp.ones(
             (1, k), dtype=jnp.int32
@@ -151,17 +205,24 @@ def match_slots(db: fpc.CompiledDB, candidate_k: int, streams, lengths):
             e = jnp.minimum(e_start + g, entry_h2.shape[0] - 1)
             in_group = found & (g < e_count)
             h2_ok = entry_h2[e] == h2c
-            # suffix-gram check from the same rolling-hash arrays
+            # suffix-gram check from the same rolling-hash arrays; the
+            # suffix may live in the halo region (sequence parallelism)
             spos = cpos + entry_sufd[e]
-            spos_c = jnp.clip(spos, 0, W - 1)
+            spos_c = jnp.clip(spos, 0, We - 1)
             suf_ok = (
                 (jnp.take_along_axis(h1, spos_c, axis=1) == entry_sufh1[e])
                 & (jnp.take_along_axis(h2, spos_c, axis=1) == entry_sufh2[e])
                 & (spos >= 0)
-                & (spos < W)
+                & (spos < We)
             )
-            start = cpos - entry_off[e]
-            fits = (start >= 0) & (start + entry_len[e] <= slen[:, None])
+            # global bounds: word fully inside the true part bytes
+            gstart = (cpos - back_halo) + offset_of(table.stream) - entry_off[e]
+            fits = (gstart >= 0) & (gstart + entry_len[e] <= slen[:, None])
+            # extended-view bounds: with halos ≥ max entry length these
+            # only bite in the unsharded case (buffer edges)
+            fits = fits & (cpos - entry_off[e] >= 0) & (
+                cpos - entry_off[e] + entry_len[e] <= We
+            )
             hit = in_group & h2_ok & suf_ok & fits
             slot = entry_slot[e]
             value_bits = value_bits.at[b_idx, slot].max(hit)
@@ -181,13 +242,22 @@ def match_slots(db: fpc.CompiledDB, candidate_k: int, streams, lengths):
                 get_stream(stream_name, lowered), hashing.TINY_MAX
             )
         shifts = shift_cache[skey]
-        W = shifts[0].shape[1]
-        positions = jnp.arange(W, dtype=jnp.int32)
+        We_t = shifts[0].shape[1]
+        # global coordinates (halo positions are valid too — the byte
+        # compare is exact and the OR across shards dedupes)
+        gpositions = (
+            jnp.arange(We_t, dtype=jnp.int32) - back_halo + offset_of(stream_name)
+        )
         eq = jnp.ones_like(shifts[0], dtype=bool)
         for j in range(length):
             eq = eq & (shifts[j] == int(db.tiny_bytes[i, j]))
-        slen = jnp.minimum(lengths[stream_name], W)
-        eq = eq & (positions[None, :] <= (slen - length)[:, None])
+        slen = lengths[stream_name]
+        eq = eq & (gpositions[None, :] >= 0)
+        eq = eq & (gpositions[None, :] <= (slen - length)[:, None])
+        # window must lie inside this view's real bytes (an all-zero tiny
+        # pattern must not match the zero padding / zero-filled halo edge)
+        local = jnp.arange(We_t, dtype=jnp.int32)
+        eq = eq & (local[None, :] + length <= We_t)
         hit = eq.any(axis=1)
         value_bits = value_bits.at[:, slot_id].max(hit)
 

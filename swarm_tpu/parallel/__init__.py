@@ -1,0 +1,1 @@
+"""Multi-chip sharding: mesh construction and the sharded match step."""

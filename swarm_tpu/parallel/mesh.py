@@ -1,0 +1,53 @@
+"""Device mesh construction for the match workload.
+
+Axes (the scan-workload analogs of ML parallelism, SURVEY.md §2.4):
+
+- ``data``  — target rows (the reference's chunk-per-worker data
+  parallelism, now a sharded batch axis; perfect scaling, results
+  gathered per shard).
+- ``model`` — hash-table groups (pattern-space parallelism: every rank
+  probes the same windows against its 1/R slice of each table's sorted
+  h1 range; slot bits OR-combine with one psum over ICI).
+- ``seq``   — response byte axis (context parallelism for long bodies:
+  each rank scans its byte slice with a ppermute halo exchange of the
+  longest-pattern overlap — the ring-attention analog).
+
+Pipeline/expert axes have no analog here (no layered weights, no
+experts) — the reference likewise has nothing to shard (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "model", "seq")
+
+
+def factor_devices(n: int) -> tuple[int, int, int]:
+    """Split n devices into (data, model, seq) — favor data, then model."""
+    if n <= 1:
+        return (1, 1, 1)
+    seq = 2 if n % 2 == 0 and n >= 8 else 1
+    rem = n // seq
+    model = 2 if rem % 2 == 0 and rem >= 4 else 1
+    data = rem // model
+    return (data, model, seq)
+
+
+def make_mesh(
+    shape: Optional[tuple[int, int, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = factor_devices(len(devices))
+    data, model, seq = shape
+    count = data * model * seq
+    if count > len(devices):
+        raise ValueError(f"mesh {shape} needs {count} devices, have {len(devices)}")
+    arr = np.array(devices[:count]).reshape(data, model, seq)
+    return Mesh(arr, AXES)

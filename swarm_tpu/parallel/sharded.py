@@ -1,0 +1,225 @@
+"""The sharded match step: dp × tp × sp over a device mesh.
+
+This is the multi-chip execution path (the reference scaled by adding
+droplets; this scales by sharding one batch across a TPU slice):
+
+- **data**: rows sharded; no cross-shard traffic until result gather.
+- **model**: every rank probes the same windows against its 1/R slice
+  of each word table's sorted h1 range (disjoint group ranges, disjoint
+  candidate sets, per-rank blooms). Slot bits combine with one
+  ``psum`` over ICI — the collective cost is B × NS bits per step.
+- **seq**: response bytes sharded; each rank owns the candidate windows
+  starting in its slice and exchanges halos of ``max_entry_len`` bytes
+  with both neighbors via ``ppermute`` (the ring/halo pattern of
+  context parallelism) so words spanning shard boundaries are found by
+  exactly the rank that owns their gram position.
+
+The verdict stage runs replicated on every (model, seq) rank after the
+psum — it is tiny next to the probe stage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from swarm_tpu.fingerprints import compile as fpc
+from swarm_tpu.ops import hashing
+from swarm_tpu.ops.match import eval_verdicts, match_slots
+
+
+def shard_tables_np(db: fpc.CompiledDB, ranks: int) -> list[dict]:
+    """Split every table's sorted h1-group range into ``ranks`` contiguous
+    slices with identical padded shapes, one pytree leaf-list per table:
+    arrays get a leading [ranks] axis to shard over 'model'.
+
+    Padding uses a sentinel h1 of 0xFFFFFFFF with zero entry counts, so
+    a padded group can never be "found" twice (searchsorted may land on
+    it, but count 0 yields no entries).
+    """
+    stacked: list[dict] = []
+    for table in db.tables:
+        G = table.num_groups
+        g_per = max(1, -(-G // ranks))
+        gmax = g_per
+        emax = 1
+        slices = []
+        for r in range(ranks):
+            lo = min(r * g_per, G)
+            hi = min(lo + g_per, G)
+            if hi > lo:
+                e_lo = int(table.entry_start[lo])
+                e_hi = int(
+                    table.entry_start[hi - 1] + table.entry_count[hi - 1]
+                )
+            else:
+                e_lo = e_hi = 0
+            slices.append((lo, hi, e_lo, e_hi))
+            emax = max(emax, e_hi - e_lo)
+        arrs = {
+            "group_h1": np.full((ranks, gmax), 0xFFFFFFFF, dtype=np.uint32),
+            "entry_start": np.zeros((ranks, gmax), dtype=np.int32),
+            "entry_count": np.zeros((ranks, gmax), dtype=np.int32),
+            "entry_h2": np.zeros((ranks, emax), dtype=np.uint32),
+            "entry_slot": np.zeros((ranks, emax), dtype=np.int32),
+            "entry_off": np.zeros((ranks, emax), dtype=np.int32),
+            "entry_len": np.full((ranks, emax), 1 << 30, dtype=np.int32),
+            "entry_suf_delta": np.zeros((ranks, emax), dtype=np.int32),
+            "entry_suf_h1": np.zeros((ranks, emax), dtype=np.uint32),
+            "entry_suf_h2": np.zeros((ranks, emax), dtype=np.uint32),
+            "bloom": np.zeros((ranks, hashing.BLOOM_WORDS), dtype=np.uint32),
+        }
+        for r, (lo, hi, e_lo, e_hi) in enumerate(slices):
+            n_g, n_e = hi - lo, e_hi - e_lo
+            if n_g == 0:
+                continue
+            arrs["group_h1"][r, :n_g] = table.group_h1[lo:hi]
+            arrs["entry_start"][r, :n_g] = table.entry_start[lo:hi] - e_lo
+            arrs["entry_count"][r, :n_g] = table.entry_count[lo:hi]
+            for name, src in (
+                ("entry_h2", table.entry_h2),
+                ("entry_slot", table.entry_slot),
+                ("entry_off", table.entry_off),
+                ("entry_len", table.entry_len),
+                ("entry_suf_delta", table.entry_suf_delta),
+                ("entry_suf_h1", table.entry_suf_h1),
+                ("entry_suf_h2", table.entry_suf_h2),
+            ):
+                arrs[name][r, :n_e] = src[e_lo:e_hi]
+            arrs["bloom"][r] = hashing.build_bloom_np(
+                np.repeat(table.group_h1[lo:hi], table.entry_count[lo:hi]),
+                table.entry_h2[e_lo:e_hi],
+            )
+        stacked.append(arrs)
+    return stacked
+
+
+def max_entry_len(db: fpc.CompiledDB) -> int:
+    out = int(hashing.GRAM_LONG)
+    for table in db.tables:
+        if table.entry_len.size:
+            out = max(out, int(table.entry_len.max()))
+    return out
+
+
+@dataclasses.dataclass
+class ShardedMatcher:
+    """Builds and caches the pjit'd sharded match step for one mesh."""
+
+    db: fpc.CompiledDB
+    mesh: Mesh
+    candidate_k: int = 128
+
+    def __post_init__(self):
+        self.ranks = {name: int(self.mesh.shape[name]) for name in self.mesh.axis_names}
+        self.halo = max_entry_len(self.db) if self.ranks.get("seq", 1) > 1 else 0
+        self._tables_np = shard_tables_np(self.db, self.ranks.get("model", 1))
+        self._fn_cache: dict = {}
+
+    # ------------------------------------------------------------------
+    def _build(self, shape_key):
+        db, halo = self.db, self.halo
+        seq_ranks = self.ranks.get("seq", 1)
+        candidate_k = self.candidate_k
+
+        def step(tables, streams, lengths, status):
+            # --- halo exchange over 'seq' (no-op when unsharded) ---
+            back = fwd = 0
+            offsets = 0
+            streams_ext = streams
+            if seq_ranks > 1:
+                seq_index = jax.lax.axis_index("seq")
+                ext = {}
+                offsets = {}
+                for name, local in streams.items():
+                    fwd_halo = jax.lax.ppermute(
+                        local[:, :halo],
+                        "seq",
+                        [(r, r - 1) for r in range(1, seq_ranks)],
+                    )
+                    back_halo = jax.lax.ppermute(
+                        local[:, -halo:],
+                        "seq",
+                        [(r, r + 1) for r in range(seq_ranks - 1)],
+                    )
+                    ext[name] = jnp.concatenate([back_halo, local, fwd_halo], axis=1)
+                    offsets[name] = seq_index * local.shape[1]
+                streams_ext = ext
+                back = fwd = halo
+
+            # --- probe with this rank's table slices ---
+            value_bits, uncertain_bits, overflow = match_slots(
+                db,
+                candidate_k,
+                streams_ext,
+                lengths,
+                table_arrays=[{k: v[0] for k, v in t.items()} for t in tables],
+                pos_offset=offsets,
+                back_halo=back,
+                fwd_halo=fwd,
+            )
+
+            # --- combine pattern-space + byte-space partial bits ---
+            combine_axes = tuple(
+                ax
+                for ax in ("model", "seq")
+                if self.ranks.get(ax, 1) > 1
+            )
+            if combine_axes:
+                value_bits = jax.lax.psum(value_bits.astype(jnp.int32), combine_axes) > 0
+                uncertain_bits = (
+                    jax.lax.psum(uncertain_bits.astype(jnp.int32), combine_axes) > 0
+                )
+                overflow = jax.lax.psum(overflow.astype(jnp.int32), combine_axes) > 0
+
+            t_value, t_unc = eval_verdicts(db, value_bits, uncertain_bits, lengths, status)
+            return t_value, t_unc, overflow
+
+        shard_map = jax.shard_map
+        mesh = self.mesh
+        stream_spec = {k: P("data", "seq") for k in shape_key["streams"]}
+        table_specs = [
+            {name: P("model") for name in t} for t in self._tables_np
+        ]
+        fn = shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(
+                table_specs,
+                stream_spec,
+                {k: P("data") for k in shape_key["lengths"]},
+                P("data"),
+            ),
+            out_specs=(P("data"), P("data"), P("data")),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    # ------------------------------------------------------------------
+    def match(self, streams: dict, lengths: dict, status):
+        shape_key = {
+            "streams": tuple(sorted((k, v.shape) for k, v in streams.items())),
+            "lengths": tuple(sorted(lengths)),
+        }
+        cache_key = (shape_key["streams"],)
+        fn = self._fn_cache.get(cache_key)
+        if fn is None:
+            fn = self._build(
+                {"streams": {k: None for k in streams}, "lengths": {k: None for k in lengths}}
+            )
+            self._fn_cache[cache_key] = fn
+        tables_j = [
+            {k: jnp.asarray(v) for k, v in t.items()} for t in self._tables_np
+        ]
+        return fn(
+            tables_j,
+            {k: jnp.asarray(v) for k, v in streams.items()},
+            {k: jnp.asarray(v) for k, v in lengths.items()},
+            jnp.asarray(status),
+        )

@@ -120,7 +120,7 @@ class SwarmServer:
         worker_id = (q.get("worker_id") or [None])[0]
         job = self.queue.next_job(worker_id or "unknown")
         if job is None:
-            return self._text(204, "No jobs available")
+            return self._text(204, "")
         return self._json(200, job)
 
     def _spin_up(self, m, q, body):
@@ -229,9 +229,14 @@ def _make_httpd(server: SwarmServer) -> ThreadingHTTPServer:
             code, payload, ctype = server.dispatch(
                 method, parsed.path, query, dict(self.headers), body
             )
+            if code == 204:
+                # 204 is bodyless by spec; a body here would linger in the
+                # socket and corrupt the next keep-alive request
+                payload = b""
             self.send_response(code)
             self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(payload)))
+            if code != 204:
+                self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             if payload and method != "HEAD":
                 self.wfile.write(payload)

@@ -17,9 +17,15 @@ Fixes over the reference (SURVEY.md §5 "no retry or requeue"):
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from typing import Any, Optional
+
+# scan ids reach worker filesystem paths and (via {input}/{output}
+# substitution) shell=True command lines — constrain them hard. The
+# reference's own format is `<module>_<unix-ts>`.
+_SCAN_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
 
 from swarm_tpu.config import Config
 from swarm_tpu.datamodel import (
@@ -60,7 +66,11 @@ class JobQueueService:
         module = job_data.get("module")
         if not module:
             raise ValueError("Module must be provided")
+        if not _SCAN_ID_RE.match(str(module)):
+            raise ValueError("Invalid module name")
         scan_id = job_data.get("scan_id") or generate_scan_id(module)
+        if not _SCAN_ID_RE.match(str(scan_id)):
+            raise ValueError("Invalid scan_id")
         file_content = job_data.get("file_content") or []
         lines = [l.rstrip("\n") for l in file_content]
         batch_size = int(float(job_data.get("batch_size") or 0))
@@ -93,20 +103,27 @@ class JobQueueService:
         worker = self._load_worker(worker_id)
         worker.last_contact = now
 
+        job: Optional[Job] = None
         with self._lock:
             self._requeue_expired(now)
-            job_id = self.state.lpop("job_queue")
+            # loop (not recursion): drop dangling ids from queue/hash
+            # desync (e.g. /reset racing a submit) without blowing the stack
+            while True:
+                job_id = self.state.lpop("job_queue")
+                if job_id is None:
+                    break
+                job = self._get_job_record(job_id)
+                if job is not None:
+                    break
 
-        if job_id is not None:
-            job = self._get_job_record(job_id)
-            if job is None:  # queue/hash desync (e.g. partial reset)
-                return self.next_job(worker_id)
+        if job is not None:
             job.status = JobStatus.IN_PROGRESS
             job.started_at = now
             job.worker_id = worker_id
             job.lease_expires_at = now + self.cfg.lease_seconds
             job.attempts += 1
             self._put_job(job)
+            self.state.hset("leases", job.job_id, str(job.lease_expires_at))
             worker.polls_with_no_jobs = 0
             worker.status = WorkerStatus.ACTIVE
             self._save_worker(worker)
@@ -123,26 +140,41 @@ class JobQueueService:
 
     def _requeue_expired(self, now: float) -> None:
         """Lease enforcement: in-progress jobs whose lease lapsed go back
-        to the queue (the reference loses them forever)."""
-        for job_id, raw in self.state.hgetall("jobs").items():
+        to the queue (the reference loses them forever).
+
+        Scans only the ``leases`` index (jobs currently leased), not the
+        whole jobs hash, so dispatch latency stays O(in-flight) rather
+        than O(all jobs ever)."""
+        for job_id, expiry in self.state.hgetall("leases").items():
+            try:
+                if float(expiry) >= now:
+                    continue
+            except ValueError:
+                pass
+            raw = self.state.hget("jobs", job_id)
+            if raw is None:
+                self.state.hdel("leases", job_id)
+                continue
             try:
                 job = Job.from_json(raw)
             except (ValueError, KeyError, TypeError):
+                self.state.hdel("leases", job_id)
                 continue
-            if (
-                job.status == JobStatus.IN_PROGRESS
-                and job.lease_expires_at is not None
-                and job.lease_expires_at < now
-            ):
-                if job.attempts >= self.cfg.max_attempts:
-                    job.status = JobStatus.CMD_FAILED
-                    self._put_job(job)
-                    continue
-                job.status = JobStatus.QUEUED
-                job.worker_id = None
-                job.lease_expires_at = None
+            if job.status != JobStatus.IN_PROGRESS or job.lease_expires_at is None:
+                self.state.hdel("leases", job_id)
+                continue
+            if job.lease_expires_at >= now:
+                continue
+            self.state.hdel("leases", job_id)
+            if job.attempts >= self.cfg.max_attempts:
+                job.status = JobStatus.CMD_FAILED
                 self._put_job(job)
-                self.state.rpush("job_queue", job.job_id)
+                continue
+            job.status = JobStatus.QUEUED
+            job.worker_id = None
+            job.lease_expires_at = None
+            self._put_job(job)
+            self.state.rpush("job_queue", job.job_id)
 
     def _load_worker(self, worker_id: str) -> WorkerInfo:
         raw = self.state.hget("workers", worker_id)
@@ -163,6 +195,18 @@ class JobQueueService:
         job = self._get_job_record(job_id)
         if job is None:
             return False
+        changes = dict(changes)
+        # Fencing token (not a mutation): our worker sends its id so a
+        # zombie whose lease expired and whose job was reassigned cannot
+        # clobber the new assignee's state. Reference workers omit it and
+        # stay unfenced, preserving wire behavior.
+        fence = changes.pop("worker_id", None)
+        if fence is not None and job.worker_id is not None and fence != job.worker_id:
+            return False
+        if "status" in changes and job.status in JobStatus.TERMINAL:
+            # terminal states never regress (duplicate 'completed' pushes
+            # would make the client tail re-emit chunks)
+            return False
         wire = job.to_wire()
         for key, value in changes.items():
             if key in wire and key is not None:
@@ -173,6 +217,7 @@ class JobQueueService:
         updated = Job.from_wire(wire)
         if updated.status in JobStatus.TERMINAL:
             updated.lease_expires_at = None
+            self.state.hdel("leases", job_id)
         self._put_job(updated)
         return True
 

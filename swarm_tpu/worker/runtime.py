@@ -16,6 +16,7 @@ reference's cadence (0.8 s between jobs, 10 s when idle). Differences:
 
 from __future__ import annotations
 
+import re
 import subprocess
 import tempfile
 import time
@@ -49,7 +50,9 @@ class ServerClient:
         )
         return resp.json() if resp.status_code == 200 else None
 
-    def update_job(self, job_id: str, changes: dict) -> bool:
+    def update_job(self, job_id: str, changes: dict, worker_id: Optional[str] = None) -> bool:
+        if worker_id is not None:
+            changes = {**changes, "worker_id": worker_id}  # fencing token
         resp = self.session.post(
             f"{self.base}/update-job/{job_id}", json=changes, timeout=self.timeout
         )
@@ -107,7 +110,14 @@ class JobProcessor:
     def process_chunk(self, job: dict) -> None:
         job_id = job.get("job_id") or f"{job['scan_id']}_{job['chunk_index']}"
         scan_id, chunk_index = job["scan_id"], int(job["chunk_index"])
-        update = lambda status: self.client.update_job(job_id, {"status": status})
+        # defense in depth: the server validates scan ids, but these flow
+        # into filesystem paths and {input}/{output} command substitution
+        if not re.match(r"^[A-Za-z0-9._-]{1,128}$", str(scan_id)):
+            self.client.update_job(job_id, {"status": JobStatus.CMD_FAILED})
+            return
+        update = lambda status: self.client.update_job(
+            job_id, {"status": status}, worker_id=self.cfg.worker_id
+        )
 
         update(JobStatus.STARTING)
         update(JobStatus.DOWNLOADING)

@@ -203,3 +203,21 @@ def test_ci_regex_nonascii_literal_goes_host():
     ]
     eng = _engine_vs_oracle(doc, rows)
     assert len(eng.db.host_always) == 1
+
+
+def test_scoped_inline_ci_group_nonascii():
+    # scoped (?i:...) flags: the non-ASCII ci run is unusable as a
+    # prefilter literal but the cs "panel" run after it is fine
+    doc = {
+        "id": "x-scoped-ci",
+        "info": {"severity": "info"},
+        "requests": [
+            {"matchers": [{"type": "regex", "regex": ["(?i:\u00dcBER)-panel-zone"]}]}
+        ],
+    }
+    rows = [
+        model.Response(host="a", status=200, body="über-panel-zone".encode("latin-1")),
+        model.Response(host="b", status=200, body="\u00dcBER-panel-zone".encode("latin-1")),
+        model.Response(host="c", status=200, body=b"panel only"),
+    ]
+    _engine_vs_oracle(doc, rows)

@@ -174,3 +174,67 @@ def test_lease_requeue(tmp_path):
     assert q.next_job("w4") is None
     raw = json.loads(q.state.hget("jobs", job["job_id"]))
     assert raw["status"] == "cmd failed"
+
+
+def test_204_keepalive_connection_reuse(api):
+    """204 must be bodyless: a body would linger in the keep-alive socket
+    and corrupt the next request on the reused connection."""
+    s = requests.Session()
+    s.headers.update(api.headers)
+    for _ in range(3):
+        r = s.get(api.base + "/get-job", params={"worker_id": "idle-ka"})
+        assert r.status_code == 204
+        assert r.content == b""
+    r = s.get(api.base + "/get-statuses")
+    assert r.status_code == 200
+
+
+def test_queue_rejects_hostile_scan_id(api):
+    for bad in ("x$(touch /tmp/pwn)", "../escape", "a b", "x;y", "🦊"):
+        resp = api.post(
+            "/queue",
+            json={"module": "echo", "file_content": ["t\n"], "batch_size": 1,
+                  "scan_id": bad},
+        )
+        assert resp.status_code == 400, bad
+    assert api.post(
+        "/queue",
+        json={"module": "e$(x)", "file_content": ["t\n"], "batch_size": 1},
+    ).status_code == 400
+
+
+def test_update_job_fencing_and_terminal_no_regress(tmp_path):
+    import time as _time
+    from swarm_tpu.server.app import SwarmServer as _S
+
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="k",
+        blob_root=str(tmp_path / "b"), doc_root=str(tmp_path / "d"),
+        lease_seconds=0.15, max_attempts=5,
+    )
+    q = _S(cfg).queue
+    q.queue_scan({"module": "echo", "file_content": ["t\n"], "batch_size": 1})
+    job = q.next_job("zombie")
+    _time.sleep(0.2)
+    rejob = q.next_job("fresh")  # lease expired, reassigned
+    assert rejob["worker_id"] == "fresh"
+    # zombie's fenced update must be rejected
+    assert not q.update_job(job["job_id"], {"status": "cmd failed", "worker_id": "zombie"})
+    # new assignee completes
+    assert q.update_job(job["job_id"], {"status": "complete", "worker_id": "fresh"})
+    # duplicate complete (even from the right worker) must not re-push
+    assert not q.update_job(job["job_id"], {"status": "complete", "worker_id": "fresh"})
+    assert q.state.llen("completed") == 1
+
+
+def test_dangling_queue_ids_drop_in_loop(tmp_path):
+    from swarm_tpu.server.app import SwarmServer as _S
+
+    cfg = Config(host="127.0.0.1", port=0, api_key="k",
+                 blob_root=str(tmp_path / "b"), doc_root=str(tmp_path / "d"))
+    q = _S(cfg).queue
+    for i in range(2000):  # would exceed the recursion limit before
+        q.state.rpush("job_queue", f"ghost_{i}_0")
+    q.queue_scan({"module": "echo", "file_content": ["t\n"], "batch_size": 1})
+    job = q.next_job("w")
+    assert job is not None and not job["job_id"].startswith("ghost")
