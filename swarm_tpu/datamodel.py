@@ -20,8 +20,14 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 import time
 from typing import Any, Iterator, Optional
+
+# Scan ids flow into filesystem paths and {input}/{output} command
+# substitution on both server and worker — one shared rule so the two
+# validation sites cannot drift.
+SCAN_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
 
 
 class JobStatus:

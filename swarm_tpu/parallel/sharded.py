@@ -120,6 +120,10 @@ class ShardedMatcher:
         self.ranks = {name: int(self.mesh.shape[name]) for name in self.mesh.axis_names}
         self.halo = max_entry_len(self.db) if self.ranks.get("seq", 1) > 1 else 0
         self._tables_np = shard_tables_np(self.db, self.ranks.get("model", 1))
+        # constant after construction — upload once, not per match call
+        self._tables_j = [
+            {k: jnp.asarray(v) for k, v in t.items()} for t in self._tables_np
+        ]
         self._fn_cache: dict = {}
 
     # ------------------------------------------------------------------
@@ -203,6 +207,23 @@ class ShardedMatcher:
 
     # ------------------------------------------------------------------
     def match(self, streams: dict, lengths: dict, status):
+        seq_ranks = self.ranks.get("seq", 1)
+        if seq_ranks > 1:
+            for name, arr in streams.items():
+                per_rank = arr.shape[1] // seq_ranks
+                if arr.shape[1] % seq_ranks:
+                    raise ValueError(
+                        f"stream {name!r} width {arr.shape[1]} not divisible "
+                        f"by seq ranks {seq_ranks}"
+                    )
+                if per_rank < self.halo:
+                    # the halo slices local[:, :halo] would silently come
+                    # up short and misalign every window coordinate
+                    raise ValueError(
+                        f"stream {name!r}: per-rank width {per_rank} < halo "
+                        f"{self.halo} (longest table entry); widen the "
+                        f"stream or lower the seq factor"
+                    )
         shape_key = {
             "streams": tuple(sorted((k, v.shape) for k, v in streams.items())),
             "lengths": tuple(sorted(lengths)),
@@ -214,11 +235,8 @@ class ShardedMatcher:
                 {"streams": {k: None for k in streams}, "lengths": {k: None for k in lengths}}
             )
             self._fn_cache[cache_key] = fn
-        tables_j = [
-            {k: jnp.asarray(v) for k, v in t.items()} for t in self._tables_np
-        ]
         return fn(
-            tables_j,
+            self._tables_j,
             {k: jnp.asarray(v) for k, v in streams.items()},
             {k: jnp.asarray(v) for k, v in lengths.items()},
             jnp.asarray(status),

@@ -17,18 +17,13 @@ Fixes over the reference (SURVEY.md §5 "no retry or requeue"):
 from __future__ import annotations
 
 import json
-import re
 import threading
 import time
 from typing import Any, Optional
 
-# scan ids reach worker filesystem paths and (via {input}/{output}
-# substitution) shell=True command lines — constrain them hard. The
-# reference's own format is `<module>_<unix-ts>`.
-_SCAN_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
-
 from swarm_tpu.config import Config
 from swarm_tpu.datamodel import (
+    SCAN_ID_RE,
     Job,
     JobStatus,
     WorkerInfo,
@@ -66,10 +61,10 @@ class JobQueueService:
         module = job_data.get("module")
         if not module:
             raise ValueError("Module must be provided")
-        if not _SCAN_ID_RE.match(str(module)):
+        if not SCAN_ID_RE.match(str(module)):
             raise ValueError("Invalid module name")
         scan_id = job_data.get("scan_id") or generate_scan_id(module)
-        if not _SCAN_ID_RE.match(str(scan_id)):
+        if not SCAN_ID_RE.match(str(scan_id)):
             raise ValueError("Invalid scan_id")
         file_content = job_data.get("file_content") or []
         lines = [l.rstrip("\n") for l in file_content]
@@ -113,8 +108,12 @@ class JobQueueService:
                 if job_id is None:
                     break
                 job = self._get_job_record(job_id)
-                if job is not None:
+                if job is not None and job.status == JobStatus.QUEUED:
                     break
+                # dangling id, or a job that left QUEUED while its id was
+                # still in the list (e.g. completed unfenced after a
+                # lease-expiry requeue) — never re-lease those
+                job = None
 
         if job is not None:
             job.status = JobStatus.IN_PROGRESS
@@ -201,7 +200,9 @@ class JobQueueService:
         # clobber the new assignee's state. Reference workers omit it and
         # stay unfenced, preserving wire behavior.
         fence = changes.pop("worker_id", None)
-        if fence is not None and job.worker_id is not None and fence != job.worker_id:
+        if fence is not None and fence != job.worker_id:
+            # also rejects fenced updates to a requeued job (worker_id
+            # None): a zombie must not touch a job back in the queue
             return False
         if "status" in changes and job.status in JobStatus.TERMINAL:
             # terminal states never regress (duplicate 'completed' pushes

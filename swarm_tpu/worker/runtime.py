@@ -26,7 +26,7 @@ from typing import Optional
 import requests
 
 from swarm_tpu.config import Config
-from swarm_tpu.datamodel import JobStatus
+from swarm_tpu.datamodel import SCAN_ID_RE, JobStatus
 from swarm_tpu.worker.modules import (
     ModuleRegistry,
     ModuleSpec,
@@ -112,7 +112,7 @@ class JobProcessor:
         scan_id, chunk_index = job["scan_id"], int(job["chunk_index"])
         # defense in depth: the server validates scan ids, but these flow
         # into filesystem paths and {input}/{output} command substitution
-        if not re.match(r"^[A-Za-z0-9._-]{1,128}$", str(scan_id)):
+        if not SCAN_ID_RE.match(str(scan_id)):
             self.client.update_job(job_id, {"status": JobStatus.CMD_FAILED})
             return
         update = lambda status: self.client.update_job(

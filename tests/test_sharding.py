@@ -61,7 +61,6 @@ def test_sharded_equals_unsharded(world, shape):
     np.testing.assert_array_equal(su, uu)
     # overflow may only differ in the safe direction (sharded ranks have
     # k candidates *each*, so they can only overflow less)
-    assert not np.any(so & ~uo) or True
     np.testing.assert_array_equal(so | uo, uo)
 
 
