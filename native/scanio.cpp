@@ -1,0 +1,435 @@
+// scanio — host-side async network I/O front-end for swarm_tpu.
+//
+// The reference's compute layer shelled out to native scanning engines
+// (nmap/dnsx/httpx/httprobe — SURVEY.md §2.2, /root/reference/worker/
+// modules/*.json). In this framework the *matching* compute runs on
+// TPU; what remains genuinely native is the part XLA cannot do: tens
+// of thousands of concurrent sockets. This library provides that as a
+// batch API with flat fixed-shape buffers, so results drop straight
+// into numpy arrays and from there into the device pipeline
+// (fingerprints/encoding.py).
+//
+//   * swarm_tcp_scan  — epoll-driven connect scan + banner grab with
+//     optional per-target probe payloads (covers nmap-style port
+//     probing, httprobe liveness, httpx-style HTTP GET probing —
+//     payload = HTTP request bytes).
+//   * swarm_dns_resolve — bulk UDP DNS A-record resolution against a
+//     resolver pool (dnsx equivalent).
+//
+// Plain C ABI over flat arrays; no allocation ownership crosses the
+// boundary (caller provides every output buffer). Single-threaded
+// event loop per call — callers wanting more run calls on threads;
+// the GIL is released in the ctypes layer by construction.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <queue>
+#include <vector>
+
+namespace {
+
+int64_t now_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+int set_nonblock(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  if (flags < 0) return -1;
+  return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Status codes shared by both scanners.
+enum {
+  SW_OPEN = 0,           // connected; banner_len bytes captured (may be 0)
+  SW_CLOSED = 1,         // connection refused / reset before connect
+  SW_CONNECT_TIMEOUT = 2,
+  SW_ERROR = 3,          // local error (fd limit, unreachable, ...)
+  SW_PENDING = 4         // internal; never returned
+};
+
+// ---------------------------------------------------------------------------
+// TCP connect scan / banner grab / payload probe
+// ---------------------------------------------------------------------------
+//
+// ips[i]      IPv4 in network byte order.
+// pay_idx[i]  index into (pay_off, pay_len) or -1 for a pure banner wait.
+//             Payload bytes are sent immediately after connect.
+// banners     [n * banner_cap] output bytes; blens[i] valid length.
+// status      per-target status code; rtt_us connect latency (or -1).
+//
+// Returns 0, or -1 on setup failure (epoll).
+int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
+                   const uint8_t* payload_blob, const int64_t* pay_off,
+                   const int32_t* pay_len, const int32_t* pay_idx,
+                   int32_t max_concurrency, int32_t connect_timeout_ms,
+                   int32_t read_timeout_ms, int32_t banner_cap,
+                   uint8_t* banners, int32_t* blens, int8_t* status,
+                   int32_t* rtt_us) {
+  struct Conn {
+    int fd = -1;
+    int32_t target = -1;
+    int64_t deadline_us = 0;
+    int64_t started_us = 0;
+    int64_t sent = 0;       // payload bytes written so far
+    bool connected = false;
+  };
+
+  if (n <= 0) return 0;
+  for (int32_t i = 0; i < n; ++i) {
+    status[i] = SW_PENDING;
+    blens[i] = 0;
+    rtt_us[i] = -1;
+  }
+
+  int ep = epoll_create1(0);
+  if (ep < 0) return -1;
+
+  int conc = std::max(1, (int)max_concurrency);
+  std::vector<Conn> slots(conc);
+  std::vector<int> free_slots;
+  for (int s = conc - 1; s >= 0; --s) free_slots.push_back(s);
+  // fd → slot lookup via epoll event data: store slot index.
+
+  int32_t next_target = 0;
+  int32_t done = 0;
+
+  auto finish = [&](int s, int8_t st) {
+    Conn& c = slots[s];
+    if (c.fd >= 0) {
+      epoll_ctl(ep, EPOLL_CTL_DEL, c.fd, nullptr);
+      close(c.fd);
+    }
+    if (c.target >= 0 && status[c.target] == SW_PENDING) status[c.target] = st;
+    c = Conn{};
+    free_slots.push_back(s);
+    ++done;
+  };
+
+  auto launch = [&](int32_t t) -> bool {
+    // returns false if no slot was consumed (target finished instantly)
+    int s = free_slots.back();
+    Conn& c = slots[s];
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) {
+      status[t] = SW_ERROR;
+      ++done;
+      return false;
+    }
+    struct sockaddr_in sa;
+    std::memset(&sa, 0, sizeof(sa));
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(ports[t]);
+    sa.sin_addr.s_addr = ips[t];
+    int rc = connect(fd, (struct sockaddr*)&sa, sizeof(sa));
+    if (rc < 0 && errno != EINPROGRESS) {
+      close(fd);
+      status[t] = (errno == ECONNREFUSED) ? SW_CLOSED : SW_ERROR;
+      ++done;
+      return false;
+    }
+    free_slots.pop_back();
+    c.fd = fd;
+    c.target = t;
+    c.started_us = now_us();
+    c.deadline_us = c.started_us + int64_t(connect_timeout_ms) * 1000;
+    c.connected = (rc == 0);
+    struct epoll_event ev;
+    std::memset(&ev, 0, sizeof(ev));
+    ev.data.u32 = (uint32_t)s;
+    ev.events = c.connected ? (EPOLLIN | EPOLLOUT) : EPOLLOUT;
+    if (epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev) < 0) {
+      close(fd);
+      c = Conn{};
+      free_slots.push_back(s);
+      status[t] = SW_ERROR;
+      ++done;
+      return false;
+    }
+    if (c.connected) {
+      rtt_us[t] = 0;
+      c.deadline_us = c.started_us + int64_t(read_timeout_ms) * 1000;
+    }
+    return true;
+  };
+
+  auto on_connected = [&](int s) {
+    Conn& c = slots[s];
+    c.connected = true;
+    rtt_us[c.target] = (int32_t)std::min<int64_t>(
+        now_us() - c.started_us, INT32_MAX);
+    c.deadline_us = now_us() + int64_t(read_timeout_ms) * 1000;
+  };
+
+  // drive payload write; returns false if the conn died
+  auto pump_write = [&](int s) -> bool {
+    Conn& c = slots[s];
+    int32_t pi = pay_idx ? pay_idx[c.target] : -1;
+    if (pi < 0) return true;
+    int64_t off = pay_off[pi] + c.sent;
+    int64_t left = pay_len[pi] - c.sent;
+    while (left > 0) {
+      ssize_t w = send(c.fd, payload_blob + off, (size_t)left, MSG_NOSIGNAL);
+      if (w > 0) {
+        c.sent += w;
+        off += w;
+        left -= w;
+        continue;
+      }
+      if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return true;
+      finish(s, blens[c.target] > 0 ? SW_OPEN : SW_CLOSED);
+      return false;
+    }
+    return true;
+  };
+
+  auto pump_read = [&](int s) {
+    Conn& c = slots[s];
+    int32_t t = c.target;
+    for (;;) {
+      int32_t space = banner_cap - blens[t];
+      if (space <= 0) {
+        finish(s, SW_OPEN);
+        return;
+      }
+      ssize_t r = recv(c.fd, banners + int64_t(t) * banner_cap + blens[t],
+                       (size_t)space, 0);
+      if (r > 0) {
+        blens[t] += (int32_t)r;
+        continue;
+      }
+      if (r == 0) {  // orderly EOF
+        finish(s, SW_OPEN);
+        return;
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      finish(s, SW_OPEN);  // reset after connect still counts as open
+      return;
+    }
+  };
+
+  std::vector<struct epoll_event> events(conc);
+  while (done < n) {
+    while (!free_slots.empty() && next_target < n) launch(next_target++);
+
+    // nearest deadline bounds the wait
+    int64_t now = now_us();
+    int64_t nearest = now + 60000;  // 60ms default tick
+    for (int s = 0; s < conc; ++s)
+      if (slots[s].fd >= 0) nearest = std::min(nearest, slots[s].deadline_us);
+    int wait_ms = (int)std::max<int64_t>(0, (nearest - now + 999) / 1000);
+
+    int nev = epoll_wait(ep, events.data(), conc, wait_ms);
+    for (int e = 0; e < nev; ++e) {
+      int s = (int)events[e].data.u32;
+      Conn& c = slots[s];
+      if (c.fd < 0) continue;
+      uint32_t evs = events[e].events;
+      if (!c.connected) {
+        if (evs & (EPOLLERR | EPOLLHUP)) {
+          int err = 0;
+          socklen_t elen = sizeof(err);
+          getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+          finish(s, err == ECONNREFUSED ? SW_CLOSED : SW_ERROR);
+          continue;
+        }
+        if (evs & EPOLLOUT) {
+          int err = 0;
+          socklen_t elen = sizeof(err);
+          getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+          if (err != 0) {
+            finish(s, err == ECONNREFUSED ? SW_CLOSED : SW_ERROR);
+            continue;
+          }
+          on_connected(s);
+          if (!pump_write(s)) continue;
+          struct epoll_event ev;
+          std::memset(&ev, 0, sizeof(ev));
+          ev.data.u32 = (uint32_t)s;
+          ev.events = EPOLLIN;
+          epoll_ctl(ep, EPOLL_CTL_MOD, c.fd, &ev);
+        }
+        continue;
+      }
+      if (evs & EPOLLOUT)
+        if (!pump_write(s)) continue;
+      if (evs & (EPOLLIN | EPOLLHUP | EPOLLERR)) pump_read(s);
+    }
+
+    // expire deadlines
+    now = now_us();
+    for (int s = 0; s < conc; ++s) {
+      Conn& c = slots[s];
+      if (c.fd >= 0 && now >= c.deadline_us)
+        finish(s, c.connected ? SW_OPEN : SW_CONNECT_TIMEOUT);
+    }
+  }
+
+  close(ep);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Bulk UDP DNS A-record resolution (dnsx equivalent)
+// ---------------------------------------------------------------------------
+//
+// names: concatenated ASCII hostnames; (name_off[i], name_len[i]) slices.
+// resolvers: IPv4 network-order addresses, round-robin per query.
+// addrs_out: [n * max_addrs] network-order A records; naddrs_out[i] count.
+// status: SW_OPEN (answered), SW_CLOSED (NXDOMAIN/no A), SW_CONNECT_TIMEOUT.
+//
+// One wave ≤ 60000 queries (16-bit DNS id namespace, minus headroom);
+// the Python wrapper batches larger inputs.
+int swarm_dns_resolve(const uint8_t* names, const int32_t* name_off,
+                      const int32_t* name_len, int32_t n,
+                      const uint32_t* resolvers, int32_t nres,
+                      int32_t resolver_port, int32_t timeout_ms,
+                      int32_t retries, int32_t max_addrs, uint32_t* addrs_out,
+                      int32_t* naddrs_out, int8_t* status) {
+  if (n <= 0) return 0;
+  if (n > 60000 || nres <= 0) return -1;
+  for (int32_t i = 0; i < n; ++i) {
+    naddrs_out[i] = 0;
+    status[i] = SW_PENDING;
+  }
+
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) return -1;
+  set_nonblock(fd);
+  int rcvbuf = 4 << 20;
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcvbuf, sizeof(rcvbuf));
+
+  // Build one query packet per name: header + QNAME + QTYPE A + QCLASS IN.
+  auto build_query = [&](int32_t i, uint8_t* pkt) -> int {
+    uint16_t id = (uint16_t)i;
+    pkt[0] = id >> 8;
+    pkt[1] = id & 0xFF;
+    pkt[2] = 0x01;  // RD
+    pkt[3] = 0x00;
+    pkt[4] = 0x00; pkt[5] = 0x01;  // QDCOUNT=1
+    std::memset(pkt + 6, 0, 6);
+    int w = 12;
+    const uint8_t* nm = names + name_off[i];
+    int32_t len = name_len[i];
+    int32_t start = 0;
+    for (int32_t p = 0; p <= len; ++p) {
+      if (p == len || nm[p] == '.') {
+        int32_t lab = p - start;
+        if (lab <= 0 || lab > 63 || w + lab + 1 > 255) return -1;
+        pkt[w++] = (uint8_t)lab;
+        std::memcpy(pkt + w, nm + start, lab);
+        w += lab;
+        start = p + 1;
+      }
+    }
+    pkt[w++] = 0;
+    pkt[w++] = 0x00; pkt[w++] = 0x01;  // QTYPE A
+    pkt[w++] = 0x00; pkt[w++] = 0x01;  // QCLASS IN
+    return w;
+  };
+
+  auto send_query = [&](int32_t i, int attempt) {
+    uint8_t pkt[512];
+    int plen = build_query(i, pkt);
+    if (plen < 0) {
+      if (status[i] == SW_PENDING) status[i] = SW_ERROR;
+      return;
+    }
+    struct sockaddr_in sa;
+    std::memset(&sa, 0, sizeof(sa));
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)resolver_port);
+    sa.sin_addr.s_addr = resolvers[(i + attempt) % nres];
+    sendto(fd, pkt, plen, 0, (struct sockaddr*)&sa, sizeof(sa));
+  };
+
+  int32_t unresolved = n;
+  for (int attempt = 0; attempt <= retries && unresolved > 0; ++attempt) {
+    for (int32_t i = 0; i < n; ++i)
+      if (status[i] == SW_PENDING) send_query(i, attempt);
+
+    int64_t deadline = now_us() + int64_t(timeout_ms) * 1000;
+    while (unresolved > 0) {
+      int64_t left_us = deadline - now_us();
+      if (left_us <= 0) break;
+      struct pollfd pfd = {fd, POLLIN, 0};
+      struct timespec ts = {left_us / 1000000, (left_us % 1000000) * 1000};
+      // ppoll for µs precision on the tail
+      if (ppoll(&pfd, 1, &ts, nullptr) <= 0) break;
+      uint8_t buf[1500];
+      for (;;) {
+        ssize_t r = recv(fd, buf, sizeof(buf), 0);
+        if (r < 12) break;
+        uint16_t id = (uint16_t(buf[0]) << 8) | buf[1];
+        if (id >= (uint16_t)n || status[id] != SW_PENDING) continue;
+        uint16_t flags = (uint16_t(buf[2]) << 8) | buf[3];
+        uint16_t qd = (uint16_t(buf[4]) << 8) | buf[5];
+        uint16_t an = (uint16_t(buf[6]) << 8) | buf[7];
+        int rcode = flags & 0xF;
+        if (rcode != 0) {
+          status[id] = SW_CLOSED;
+          --unresolved;
+          continue;
+        }
+        // skip questions
+        int off = 12;
+        bool bad = false;
+        for (int q = 0; q < qd && !bad; ++q) {
+          while (off < r && buf[off] != 0) {
+            if ((buf[off] & 0xC0) == 0xC0) { off += 1; break; }
+            off += buf[off] + 1;
+          }
+          off += 1 + 4;
+          if (off > r) bad = true;
+        }
+        int found = 0;
+        for (int a = 0; a < an && !bad; ++a) {
+          // name (possibly compressed)
+          while (off < r && buf[off] != 0) {
+            if ((buf[off] & 0xC0) == 0xC0) { off += 1; break; }
+            off += buf[off] + 1;
+          }
+          off += 1;
+          if (off + 10 > r) { bad = true; break; }
+          uint16_t atype = (uint16_t(buf[off]) << 8) | buf[off + 1];
+          uint16_t rdlen = (uint16_t(buf[off + 8]) << 8) | buf[off + 9];
+          off += 10;
+          if (off + rdlen > r) { bad = true; break; }
+          if (atype == 1 && rdlen == 4 && found < max_addrs) {
+            uint32_t addr;
+            std::memcpy(&addr, buf + off, 4);
+            addrs_out[int64_t(id) * max_addrs + found] = addr;
+            ++found;
+          }
+          off += rdlen;
+        }
+        naddrs_out[id] = found;
+        status[id] = found > 0 ? SW_OPEN : SW_CLOSED;
+        --unresolved;
+      }
+    }
+  }
+  for (int32_t i = 0; i < n; ++i)
+    if (status[i] == SW_PENDING) status[i] = SW_CONNECT_TIMEOUT;
+  close(fd);
+  return 0;
+}
+
+}  // extern "C"

@@ -128,6 +128,11 @@ class Response:
     duration_s: float = 0.0
     # For raw TCP banners, set banner and leave body/header empty.
     banner: Optional[bytes] = None
+    # False = the probe never got a response (unresolvable/unreachable).
+    # Dead rows are never matched — nuclei produces no output for failed
+    # requests, and negative matchers must not fire on an empty phantom
+    # response.
+    alive: bool = True
 
     def part(self, name: str) -> bytes:
         # Canonical part aliasing — MUST stay in lockstep with

@@ -1,0 +1,11 @@
+from swarm_tpu.native.scanio import (  # noqa: F401
+    STATUS_CLOSED,
+    STATUS_ERROR,
+    STATUS_OPEN,
+    STATUS_TIMEOUT,
+    DnsResult,
+    ScanResult,
+    dns_resolve,
+    ensure_lib,
+    tcp_scan,
+)

@@ -1,0 +1,245 @@
+"""ctypes binding for the native async scan I/O engine (native/scanio.cpp).
+
+The native layer replaces the reference's shelled-out scanning binaries
+(``worker/modules/*.json`` → nmap/dnsx/httpx/httprobe, SURVEY.md §2.2)
+with one epoll event loop producing flat numpy buffers — the
+fixed-shape ``(host, port, banner)`` arrays the device match pipeline
+consumes. All calls release the GIL (ctypes does this for foreign
+calls), so a worker can overlap probing with device compute.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import socket
+import struct
+import subprocess
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+
+STATUS_OPEN = 0
+STATUS_CLOSED = 1
+STATUS_TIMEOUT = 2
+STATUS_ERROR = 3
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_LIB_PATH = _NATIVE_DIR / "libscanio.so"
+_lib: Optional[ctypes.CDLL] = None
+
+
+def ensure_lib() -> ctypes.CDLL:
+    """Load libscanio.so, building it with make on first use."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _LIB_PATH.exists():
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+        )
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
+    u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    i8p = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32 = ctypes.c_int32
+    lib.swarm_tcp_scan.argtypes = [
+        u32p, u16p, i32,              # ips, ports, n
+        u8p, i64p, i32p, i32p,        # payload blob/off/len, pay_idx
+        i32, i32, i32, i32,           # conc, connect_to, read_to, cap
+        u8p, i32p, i8p, i32p,         # banners, blens, status, rtt
+    ]
+    lib.swarm_tcp_scan.restype = i32
+    lib.swarm_dns_resolve.argtypes = [
+        u8p, i32p, i32p, i32,         # names, off, len, n
+        u32p, i32, i32,               # resolvers, nres, port
+        i32, i32, i32,                # timeout, retries, max_addrs
+        u32p, i32p, i8p,              # addrs, naddrs, status
+    ]
+    lib.swarm_dns_resolve.restype = i32
+    _lib = lib
+    return lib
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ScanResult:
+    """Flat-buffer result of one tcp_scan batch (row i ↔ target i)."""
+
+    banners: np.ndarray   # uint8 [n, banner_cap]
+    banner_len: np.ndarray  # int32 [n]
+    status: np.ndarray    # int8 [n] — STATUS_*
+    rtt_us: np.ndarray    # int32 [n], -1 if never connected
+
+    def banner(self, i: int) -> bytes:
+        return self.banners[i, : self.banner_len[i]].tobytes()
+
+    @property
+    def open_mask(self) -> np.ndarray:
+        return self.status == STATUS_OPEN
+
+
+def parse_ipv4(hosts: Sequence[str]) -> np.ndarray:
+    """Dotted-quad strings → uint32 network-order array."""
+    out = np.empty(len(hosts), dtype=np.uint32)
+    for i, h in enumerate(hosts):
+        out[i] = struct.unpack("=I", socket.inet_aton(h))[0]
+    return out
+
+
+def format_ipv4(addrs: np.ndarray) -> list[str]:
+    return [socket.inet_ntoa(struct.pack("=I", int(a))) for a in addrs]
+
+
+def tcp_scan(
+    ips: np.ndarray | Sequence[str],
+    ports: np.ndarray | Sequence[int],
+    payloads: Optional[Sequence[Optional[bytes]]] = None,
+    *,
+    max_concurrency: int = 512,
+    connect_timeout_ms: int = 1500,
+    read_timeout_ms: int = 2000,
+    banner_cap: int = 4096,
+) -> ScanResult:
+    """Batch TCP connect scan + banner/payload probe.
+
+    ``payloads[i]`` (optional) is written right after connect — an HTTP
+    request for httpx-style probing, a protocol nudge for banner
+    grabbing, or None to listen silently (nmap-style banner wait).
+    """
+    lib = ensure_lib()
+    if len(ips) and isinstance(ips[0], str):
+        ips = parse_ipv4(ips)  # type: ignore[arg-type]
+    ips = np.ascontiguousarray(ips, dtype=np.uint32)
+    ports_a = np.ascontiguousarray(ports, dtype=np.uint16)
+    n = ips.shape[0]
+    if ports_a.shape[0] != n:
+        raise ValueError("ips and ports must be the same length")
+
+    # dedupe payloads into one blob
+    pay_idx = np.full(n, -1, dtype=np.int32)
+    blob_parts: list[bytes] = []
+    offsets: list[int] = []
+    lens: list[int] = []
+    seen: dict[bytes, int] = {}
+    total = 0
+    if payloads is not None:
+        for i, p in enumerate(payloads):
+            if not p:
+                continue
+            idx = seen.get(p)
+            if idx is None:
+                idx = len(offsets)
+                seen[p] = idx
+                offsets.append(total)
+                lens.append(len(p))
+                blob_parts.append(p)
+                total += len(p)
+            pay_idx[i] = idx
+    blob = np.frombuffer(b"".join(blob_parts) or b"\0", dtype=np.uint8).copy()
+    pay_off = np.asarray(offsets or [0], dtype=np.int64)
+    pay_len = np.asarray(lens or [0], dtype=np.int32)
+
+    banners = np.zeros((n, banner_cap), dtype=np.uint8)
+    blens = np.zeros(n, dtype=np.int32)
+    status = np.zeros(n, dtype=np.int8)
+    rtt = np.zeros(n, dtype=np.int32)
+    if n:
+        rc = lib.swarm_tcp_scan(
+            ips, ports_a, n,
+            blob, pay_off, pay_len, pay_idx,
+            max_concurrency, connect_timeout_ms, read_timeout_ms, banner_cap,
+            banners, blens, status, rtt,
+        )
+        if rc != 0:
+            raise OSError(f"swarm_tcp_scan failed (rc={rc})")
+    return ScanResult(banners=banners, banner_len=blens, status=status, rtt_us=rtt)
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DnsResult:
+    addrs: np.ndarray    # uint32 [n, max_addrs] network order
+    naddrs: np.ndarray   # int32 [n]
+    status: np.ndarray   # int8 [n]
+
+    def addresses(self, i: int) -> list[str]:
+        return format_ipv4(self.addrs[i, : self.naddrs[i]])
+
+    @property
+    def resolved_mask(self) -> np.ndarray:
+        return self.status == STATUS_OPEN
+
+
+def _encode_name(s: str) -> bytes:
+    """Hostname → DNS wire-ready bytes; b'' for unencodable names (the
+    native layer reports those as SW_ERROR rather than failing the wave)."""
+    if not s:
+        return b""
+    s = s.rstrip(".")
+    try:
+        return s.encode("ascii")
+    except UnicodeEncodeError:
+        pass
+    try:
+        return s.encode("idna")
+    except UnicodeError:
+        return b""
+
+
+def dns_resolve(
+    names: Sequence[str],
+    resolvers: Sequence[str],
+    *,
+    resolver_port: int = 53,
+    timeout_ms: int = 2000,
+    retries: int = 2,
+    max_addrs: int = 8,
+    wave: int = 50_000,
+) -> DnsResult:
+    """Bulk A-record resolution against a resolver pool (dnsx analog).
+
+    Waves of ≤50k keep inside the 16-bit DNS id namespace per socket.
+    """
+    lib = ensure_lib()
+    n = len(names)
+    addrs = np.zeros((max(n, 1), max_addrs), dtype=np.uint32)
+    naddrs = np.zeros(max(n, 1), dtype=np.int32)
+    status = np.zeros(max(n, 1), dtype=np.int8)
+    res = parse_ipv4(list(resolvers))
+    for start in range(0, n, wave):
+        sub = names[start : start + wave]
+        encoded = [_encode_name(s) for s in sub]
+        blob = np.frombuffer(b"".join(encoded) or b"\0", dtype=np.uint8).copy()
+        offs = np.zeros(len(sub), dtype=np.int32)
+        lens = np.zeros(len(sub), dtype=np.int32)
+        pos = 0
+        for i, e in enumerate(encoded):
+            offs[i] = pos
+            lens[i] = len(e)
+            pos += len(e)
+        sub_addrs = np.zeros((len(sub), max_addrs), dtype=np.uint32)
+        sub_naddrs = np.zeros(len(sub), dtype=np.int32)
+        sub_status = np.zeros(len(sub), dtype=np.int8)
+        rc = lib.swarm_dns_resolve(
+            blob, offs, lens, len(sub),
+            res, len(res), resolver_port,
+            timeout_ms, retries, max_addrs,
+            sub_addrs, sub_naddrs, sub_status,
+        )
+        if rc != 0:
+            raise OSError(f"swarm_dns_resolve failed (rc={rc})")
+        addrs[start : start + len(sub)] = sub_addrs
+        naddrs[start : start + len(sub)] = sub_naddrs
+        status[start : start + len(sub)] = sub_status
+    return DnsResult(addrs=addrs[:n], naddrs=naddrs[:n], status=status[:n])

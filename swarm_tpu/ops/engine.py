@@ -101,6 +101,11 @@ class MatchEngine:
         t1 = time.perf_counter()
         results: list[RowMatches] = []
         for b, row in enumerate(rows):
+            if not row.alive:
+                # no response was observed; nothing to match (negative
+                # matchers must not fire on a phantom empty response)
+                results.append(RowMatches(template_ids=[], extractions={}))
+                continue
             matched: list[str] = []
             extractions: dict = {}
             confirmed = 0

@@ -105,16 +105,17 @@ def parse_response_line(line: str) -> Optional[Response]:
         body=_bytes_field(obj, "body"),
         header=_bytes_field(obj, "header"),
         banner=banner,
+        alive=bool(obj.get("alive", True)),
     )
 
 
 def format_match_line(row: Response, matches) -> str:
-    return json.dumps(
-        {
-            "host": row.host,
-            "port": row.port,
-            "matches": matches.template_ids,
-            "extractions": matches.extractions,
-        },
-        sort_keys=True,
-    )
+    out = {
+        "host": row.host,
+        "port": row.port,
+        "matches": matches.template_ids,
+        "extractions": matches.extractions,
+    }
+    if not row.alive:
+        out["unreachable"] = True
+    return json.dumps(out, sort_keys=True)

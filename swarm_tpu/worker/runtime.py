@@ -191,15 +191,24 @@ class JobProcessor:
         return engine
 
     def _execute_tpu(self, module: ModuleSpec, data: bytes) -> bytes:
-        """Device-batch path: chunk rows → MatchEngine → JSONL hits."""
+        """Device-batch path: chunk rows → MatchEngine → JSONL hits.
+
+        ``input_format: targets`` first runs the native probe front-end
+        (resolve + connect + banner/HTTP fetch) to build the rows."""
         if not module.templates_dir:
             raise ValueError(f"tpu module {module.name} missing 'templates'")
         engine = self._engine_for(module.templates_dir)
-        rows = []
-        for line in data.decode("utf-8", "surrogateescape").splitlines():
-            row = parse_response_line(line)
-            if row is not None:
-                rows.append(row)
+        text = data.decode("utf-8", "surrogateescape")
+        if module.input_format == "targets":
+            from swarm_tpu.worker.executor import ProbeExecutor
+
+            rows = ProbeExecutor(module.probe).run(text.splitlines())
+        else:
+            rows = []
+            for line in text.splitlines():
+                row = parse_response_line(line)
+                if row is not None:
+                    rows.append(row)
         results = engine.match(rows)
         out_lines = [
             format_match_line(row, matches) for row, matches in zip(rows, results)
