@@ -123,6 +123,56 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
     ++done;
   };
 
+  auto on_connected = [&](int s) {
+    Conn& c = slots[s];
+    c.connected = true;
+    rtt_us[c.target] = (int32_t)std::min<int64_t>(
+        now_us() - c.started_us, INT32_MAX);
+    c.deadline_us = now_us() + int64_t(read_timeout_ms) * 1000;
+  };
+
+  auto payload_left = [&](int s) -> bool {
+    Conn& c = slots[s];
+    int32_t pi = pay_idx ? pay_idx[c.target] : -1;
+    return pi >= 0 && c.sent < pay_len[pi];
+  };
+
+  // level-triggered rearm: EPOLLOUT only while payload bytes remain,
+  // otherwise a drained socket makes epoll_wait spin hot for the whole
+  // read window
+  auto arm = [&](int s, bool want_out) {
+    Conn& c = slots[s];
+    struct epoll_event ev;
+    std::memset(&ev, 0, sizeof(ev));
+    ev.data.u32 = (uint32_t)s;
+    ev.events = EPOLLIN | (want_out ? (uint32_t)EPOLLOUT : 0u);
+    epoll_ctl(ep, EPOLL_CTL_MOD, c.fd, &ev);
+  };
+
+  // drive payload write; returns false if the conn died
+  auto pump_write = [&](int s) -> bool {
+    Conn& c = slots[s];
+    int32_t pi = pay_idx ? pay_idx[c.target] : -1;
+    if (pi < 0) return true;
+    int64_t off = pay_off[pi] + c.sent;
+    int64_t left = pay_len[pi] - c.sent;
+    while (left > 0) {
+      ssize_t w = send(c.fd, payload_blob + off, (size_t)left, MSG_NOSIGNAL);
+      if (w > 0) {
+        c.sent += w;
+        off += w;
+        left -= w;
+        continue;
+      }
+      if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return true;
+      // a reset while writing on an established connection still means
+      // the port was open — same rule as pump_read's post-connect reset
+      finish(s, SW_OPEN);
+      return false;
+    }
+    return true;
+  };
+
   auto launch = [&](int32_t t) -> bool {
     // returns false if no slot was consumed (target finished instantly)
     int s = free_slots.back();
@@ -154,7 +204,7 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
     struct epoll_event ev;
     std::memset(&ev, 0, sizeof(ev));
     ev.data.u32 = (uint32_t)s;
-    ev.events = c.connected ? (EPOLLIN | EPOLLOUT) : EPOLLOUT;
+    ev.events = c.connected ? EPOLLIN : EPOLLOUT;
     if (epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev) < 0) {
       close(fd);
       c = Conn{};
@@ -166,36 +216,7 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
     if (c.connected) {
       rtt_us[t] = 0;
       c.deadline_us = c.started_us + int64_t(read_timeout_ms) * 1000;
-    }
-    return true;
-  };
-
-  auto on_connected = [&](int s) {
-    Conn& c = slots[s];
-    c.connected = true;
-    rtt_us[c.target] = (int32_t)std::min<int64_t>(
-        now_us() - c.started_us, INT32_MAX);
-    c.deadline_us = now_us() + int64_t(read_timeout_ms) * 1000;
-  };
-
-  // drive payload write; returns false if the conn died
-  auto pump_write = [&](int s) -> bool {
-    Conn& c = slots[s];
-    int32_t pi = pay_idx ? pay_idx[c.target] : -1;
-    if (pi < 0) return true;
-    int64_t off = pay_off[pi] + c.sent;
-    int64_t left = pay_len[pi] - c.sent;
-    while (left > 0) {
-      ssize_t w = send(c.fd, payload_blob + off, (size_t)left, MSG_NOSIGNAL);
-      if (w > 0) {
-        c.sent += w;
-        off += w;
-        left -= w;
-        continue;
-      }
-      if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return true;
-      finish(s, blens[c.target] > 0 ? SW_OPEN : SW_CLOSED);
-      return false;
+      if (pump_write(s) && payload_left(s)) arm(s, true);
     }
     return true;
   };
@@ -260,16 +281,14 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
           }
           on_connected(s);
           if (!pump_write(s)) continue;
-          struct epoll_event ev;
-          std::memset(&ev, 0, sizeof(ev));
-          ev.data.u32 = (uint32_t)s;
-          ev.events = EPOLLIN;
-          epoll_ctl(ep, EPOLL_CTL_MOD, c.fd, &ev);
+          arm(s, payload_left(s));
         }
         continue;
       }
-      if (evs & EPOLLOUT)
+      if (evs & EPOLLOUT) {
         if (!pump_write(s)) continue;
+        if (!payload_left(s)) arm(s, false);
+      }
       if (evs & (EPOLLIN | EPOLLHUP | EPOLLERR)) pump_read(s);
     }
 
@@ -363,7 +382,12 @@ int swarm_dns_resolve(const uint8_t* names, const int32_t* name_off,
   int32_t unresolved = n;
   for (int attempt = 0; attempt <= retries && unresolved > 0; ++attempt) {
     for (int32_t i = 0; i < n; ++i)
-      if (status[i] == SW_PENDING) send_query(i, attempt);
+      if (status[i] == SW_PENDING) {
+        send_query(i, attempt);
+        // unencodable name (build_query failed) is terminal — count it
+        // resolved or the wave blocks for the full timeout every retry
+        if (status[i] == SW_ERROR) --unresolved;
+      }
 
     int64_t deadline = now_us() + int64_t(timeout_ms) * 1000;
     while (unresolved > 0) {

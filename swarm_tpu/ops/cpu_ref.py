@@ -163,6 +163,11 @@ def match_operation(
 
 
 def match_template(template: Template, response: Response) -> MatchResult:
+    if not response.alive:
+        # no response was ever observed — nuclei produces no output for
+        # failed requests, and negative matchers must not fire on a
+        # phantom empty response (same gate as MatchEngine)
+        return MatchResult(template_id=template.id, matched=False)
     matched = False
     names: list[str] = []
     extractions: list[str] = []

@@ -80,7 +80,19 @@ class MatchEngine:
         return out
 
     # ------------------------------------------------------------------
-    def _match_batch(self, rows: Sequence[Response]) -> list[RowMatches]:
+    def _match_batch(self, all_rows: Sequence[Response]) -> list[RowMatches]:
+        # dead rows (no response observed) match nothing by contract —
+        # drop them before encoding so the device never pays for them
+        alive_idx = [i for i, r in enumerate(all_rows) if r.alive]
+        if len(alive_idx) < len(all_rows):
+            out = [RowMatches(template_ids=[], extractions={}) for _ in all_rows]
+            if alive_idx:
+                live = self._match_batch([all_rows[i] for i in alive_idx])
+                for j, i in enumerate(alive_idx):
+                    out[i] = live[j]
+            self.stats.rows += len(all_rows) - len(alive_idx)
+            return out
+        rows = all_rows
         batch = encode_batch(rows, max_body=self.max_body, max_header=self.max_header)
         t0 = time.perf_counter()
         t_value, t_unc, overflow = self.device.match(
@@ -101,11 +113,6 @@ class MatchEngine:
         t1 = time.perf_counter()
         results: list[RowMatches] = []
         for b, row in enumerate(rows):
-            if not row.alive:
-                # no response was observed; nothing to match (negative
-                # matchers must not fire on a phantom empty response)
-                results.append(RowMatches(template_ids=[], extractions={}))
-                continue
             matched: list[str] = []
             extractions: dict = {}
             confirmed = 0

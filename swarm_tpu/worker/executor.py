@@ -122,6 +122,39 @@ class ProbeExecutor:
         self.spec = {**_DEFAULTS, **(spec or {})}
 
     # ------------------------------------------------------------------
+    def resolve(self, target_lines: Sequence[str]) -> list[tuple[str, list[str]]]:
+        """Resolve-only mode (the dnsx module): → [(name, [A records])].
+
+        IP literals pass through as (ip, [ip]); unresolvable names keep
+        an empty address list so callers see every input accounted for.
+        """
+        names: list[str] = []
+        for line in target_lines:
+            try:
+                t = parse_target(line)
+            except ValueError:
+                continue
+            if t is not None:
+                names.append(t[0])
+        to_resolve = sorted({n for n in names if not is_ip(n)})
+        resolvers = list(self.spec["resolvers"]) or _system_resolvers()
+        addr_of: dict[str, list[str]] = {n: [] for n in to_resolve}
+        if to_resolve and resolvers:
+            res = scanio.dns_resolve(
+                to_resolve, resolvers, timeout_ms=int(self.spec["read_timeout_ms"])
+            )
+            for i, name in enumerate(to_resolve):
+                addr_of[name] = res.addresses(i)
+        seen: set[str] = set()
+        out: list[tuple[str, list[str]]] = []
+        for name in names:
+            if name in seen:
+                continue
+            seen.add(name)
+            out.append((name, [name] if is_ip(name) else addr_of.get(name, [])))
+        return out
+
+    # ------------------------------------------------------------------
     def run(self, target_lines: Sequence[str]) -> list[Response]:
         """Probe every target; one Response per (target, port) probe.
 

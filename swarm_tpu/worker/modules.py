@@ -7,6 +7,8 @@ and extended with a TPU backend:
     {"command": "nmap -T5 ... -oN {output} -iL {input}"}     # subprocess
     {"backend": "tpu", "templates": "/path/to/corpus",       # device batch
      "input_format": "jsonl"}
+    {"backend": "probe", "probe": {...},                     # native I/O only
+     "output_format": "httpx_json"}
 
 The TPU backend replaces the shell-out with a device-batched
 fingerprint match (the reference's compute was nmap/-sV/nuclei inside
@@ -43,6 +45,7 @@ class ModuleSpec:
             os.path.expandvars(templates) if templates else None
         )
         self.input_format: str = raw.get("input_format", "jsonl")
+        self.output_format: str = raw.get("output_format", "matches_jsonl")
         self.probe: dict = raw.get("probe", {})
 
     def command(self, input_path: str, output_path: str) -> str:

@@ -137,6 +137,8 @@ class JobProcessor:
         try:
             if module.backend == "tpu":
                 output = self._execute_tpu(module, data)
+            elif module.backend == "probe":
+                output = self._execute_probe(module, data)
             else:
                 output = self._execute_command(module, scan_id, chunk_index, data)
         except Exception as e:
@@ -210,10 +212,39 @@ class JobProcessor:
                 if row is not None:
                     rows.append(row)
         results = engine.match(rows)
+        if module.output_format == "nuclei":
+            from swarm_tpu.worker import formats
+
+            sev, proto = formats.severity_index(engine.templates)
+            return formats.format_nuclei(rows, results, sev, proto).encode()
         out_lines = [
             format_match_line(row, matches) for row, matches in zip(rows, results)
         ]
         return ("\n".join(out_lines) + "\n").encode() if out_lines else b""
+
+    # ------------------------------------------------------------------
+    def _execute_probe(self, module: ModuleSpec, data: bytes) -> bytes:
+        """Native-I/O-only path (dnsx/httprobe/httpx/web module parity):
+        probe the targets with the C++ front-end and emit the module's
+        output format — no template matching involved."""
+        from swarm_tpu.worker import formats
+        from swarm_tpu.worker.executor import ProbeExecutor
+
+        lines = data.decode("utf-8", "surrogateescape").splitlines()
+        executor = ProbeExecutor(module.probe)
+        if module.probe.get("type") == "dns":
+            resolutions = executor.resolve(lines)
+            return formats.format_dnsx(
+                resolutions, with_a=bool(module.probe.get("with_a"))
+            ).encode()
+        rows = executor.run(lines)
+        if module.output_format == "httprobe":
+            return formats.format_httprobe(rows).encode()
+        if module.output_format == "httpx_json":
+            return formats.format_httpx_json(rows).encode()
+        raise ValueError(
+            f"module {module.name}: unknown output_format {module.output_format!r}"
+        )
 
 
 def main(argv: Optional[list[str]] = None) -> None:
