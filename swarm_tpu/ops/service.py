@@ -1,0 +1,194 @@
+"""Service/version classification — the ``nmap -sV`` capability.
+
+Reference parity target: the nmap module (``-sV --top-ports 1000``,
+`/root/reference/worker/modules/nmap.json`) whose matching brain is the
+nmap-service-probes DB. Here every match directive lowers into the same
+device match infrastructure the template corpus uses (regex → required
+literal → word table, ``fingerprints/compile.py``): the TPU prefilters
+(row, match) candidate pairs over the whole banner batch, then the host
+confirms only the candidates with the real regex to bind capture groups
+for version extraction. First hard match in DB order wins; softmatches
+name the service when nothing hard fires (nmap semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from swarm_tpu.fingerprints.model import Matcher, Operation, Response, Template
+from swarm_tpu.fingerprints.nmap_probes import (
+    ServiceMatch,
+    ServiceProbe,
+    load_probes,
+    substitute_version,
+)
+
+
+@dataclasses.dataclass
+class ServiceInfo:
+    host: str
+    port: int
+    open: bool = False
+    service: Optional[str] = None
+    product: Optional[str] = None
+    version: Optional[str] = None
+    info: Optional[str] = None
+    cpe: list[str] = dataclasses.field(default_factory=list)
+    soft: bool = False  # only a softmatch fired
+
+    def line(self) -> str:
+        """One output line: host:port state service product version."""
+        state = "open" if self.open else "closed"
+        fields = [f"{self.host}:{self.port}", state, self.service or "unknown"]
+        desc = " ".join(x for x in (self.product, self.version) if x)
+        if desc:
+            fields.append(desc)
+        if self.info:
+            fields.append(f"({self.info})")
+        return "\t".join(fields)
+
+
+def _inline_flags(m: ServiceMatch) -> str:
+    """Fold the directive's s/i flags into the pattern so every regex
+    engine downstream (device required-literal lowering, CPU oracle,
+    host confirm) sees identical semantics."""
+    prefix = ""
+    if "s" in m.flags:
+        prefix += "(?s)"
+    if "i" in m.flags:
+        prefix += "(?i)"
+    return prefix + m.pattern
+
+
+class ServiceClassifier:
+    """Compiled probes DB + the batched classify path."""
+
+    def __init__(
+        self,
+        probes: Optional[list[ServiceProbe]] = None,
+        db_path: Optional[str] = None,
+        **engine_kwargs,
+    ):
+        if probes is None:
+            probes, self.skipped_matches = load_probes(db_path)
+        else:
+            self.skipped_matches = 0
+        self.probes = probes
+        self.probe_by_name = {p.name: p for p in probes}
+
+        # Flatten matches in DB order; each becomes one network template
+        # whose single regex matcher runs over the banner stream.
+        self._matches: list[tuple[str, ServiceMatch]] = []  # (probe_name, match)
+        templates = []
+        for probe in probes:
+            for match in probe.matches:
+                tid = f"svc/{probe.name}/{len(self._matches)}"
+                self._matches.append((probe.name, match))
+                templates.append(
+                    Template(
+                        id=tid,
+                        protocol="network",
+                        operations=[
+                            Operation(
+                                matchers=[
+                                    Matcher(
+                                        type="regex",
+                                        part="body",
+                                        regex=[_inline_flags(match)],
+                                    )
+                                ]
+                            )
+                        ],
+                    )
+                )
+        from swarm_tpu.ops.engine import MatchEngine  # deferred: heavy import
+
+        self.engine = MatchEngine(templates, **engine_kwargs)
+        self._compiled = [m.compile() for _probe, m in self._matches]
+
+    # ------------------------------------------------------------------
+    def _allowed(self, sent_probe: Optional[str]) -> Optional[set]:
+        """Probe names whose matches apply to a response elicited by
+        ``sent_probe`` (itself + declared fallbacks + NULL)."""
+        if sent_probe is None:
+            return None  # no probe bookkeeping: every match applies
+        allowed = {sent_probe, "NULL"}
+        probe = self.probe_by_name.get(sent_probe)
+        if probe:
+            allowed.update(probe.fallback)
+        return allowed
+
+    def classify(
+        self,
+        rows: Sequence[Response],
+        sent_probes: Optional[Sequence[Optional[str]]] = None,
+    ) -> list[ServiceInfo]:
+        results = self.engine.match(rows)
+        out: list[ServiceInfo] = []
+        for i, (row, hits) in enumerate(zip(rows, results)):
+            info = ServiceInfo(host=row.host, port=row.port, open=row.alive)
+            banner = row.part("body")
+            if not row.alive or not banner:
+                out.append(info)
+                continue
+            allowed = self._allowed(sent_probes[i] if sent_probes else None)
+            candidates = sorted(
+                int(tid.rsplit("/", 1)[1])
+                for tid in hits.template_ids
+                if tid.startswith("svc/")
+            )
+            soft_hit: Optional[ServiceMatch] = None
+            for idx in candidates:
+                probe_name, match = self._matches[idx]
+                if allowed is not None and probe_name not in allowed:
+                    continue
+                pattern = self._compiled[idx]
+                mo = pattern.search(banner) if pattern else None
+                if not mo:
+                    continue  # device prefilter is a superset; host veto
+                if match.soft:
+                    soft_hit = soft_hit or match
+                    continue
+                info.service = match.service
+                info.product = substitute_version(match.product, mo)
+                info.version = substitute_version(match.version, mo)
+                info.info = substitute_version(match.info, mo)
+                info.cpe = [substitute_version(c, mo) for c in match.cpe]
+                out.append(info)
+                break
+            else:
+                if soft_hit:
+                    info.service = soft_hit.service
+                    info.soft = True
+                out.append(info)
+        return out
+
+    # ------------------------------------------------------------------
+    def probe_for_port(self, port: int) -> ServiceProbe:
+        """Payload selection: lowest-rarity TCP probe with a payload
+        covering the port; NULL (listen-only) otherwise."""
+        best: Optional[ServiceProbe] = None
+        for probe in self.probes:
+            if probe.proto != "TCP" or not probe.payload:
+                continue
+            if probe.covers_port(port) and (best is None or probe.rarity < best.rarity):
+                best = probe
+        if best:
+            return best
+        null = self.probe_by_name.get("NULL")
+        if null:
+            return null
+        return ServiceProbe(proto="TCP", name="NULL")
+
+    def default_payload_probe(self) -> Optional[ServiceProbe]:
+        """Second-round probe for silent-but-open ports: the lowest-
+        rarity TCP payload probe regardless of port coverage (nmap keeps
+        escalating probes by rarity when the NULL listen stays quiet)."""
+        best: Optional[ServiceProbe] = None
+        for probe in self.probes:
+            if probe.proto != "TCP" or not probe.payload:
+                continue
+            if best is None or probe.rarity < best.rarity:
+                best = probe
+        return best

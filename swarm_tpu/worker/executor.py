@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import ipaddress
 import re
+from pathlib import Path
 from typing import Optional, Sequence
 from urllib.parse import urlsplit
 
@@ -97,6 +98,25 @@ def parse_http_response(raw: bytes) -> tuple[int, bytes, bytes]:
     return status, head, body
 
 
+_TOP_PORTS_FILE = (
+    Path(__file__).resolve().parent.parent / "data" / "top-ports.txt"
+)
+_top_ports_cache: Optional[list[int]] = None
+
+
+def top_ports(limit: Optional[int] = None) -> list[int]:
+    """Default port fan-out for service scans (data/top-ports.txt)."""
+    global _top_ports_cache
+    if _top_ports_cache is None:
+        ports: list[int] = []
+        for line in _TOP_PORTS_FILE.read_text().splitlines():
+            if line.startswith("#"):
+                continue
+            ports.extend(int(tok) for tok in line.split())
+        _top_ports_cache = ports
+    return _top_ports_cache[:limit] if limit else list(_top_ports_cache)
+
+
 _resolv_cache: Optional[list[str]] = None
 
 
@@ -119,7 +139,43 @@ def _system_resolvers() -> list[str]:
 
 class ProbeExecutor:
     def __init__(self, spec: Optional[dict] = None):
+        self.explicit = set(spec or {})  # keys the caller actually set
         self.spec = {**_DEFAULTS, **(spec or {})}
+
+    # ------------------------------------------------------------------
+    def _parse_lines(
+        self, target_lines: Sequence[str]
+    ) -> tuple[list[tuple[str, Optional[int], str]], list[str]]:
+        """→ (parsed targets, malformed lines). Malformed lines become
+        dead rows downstream so every input line stays accounted for."""
+        parsed: list[tuple[str, Optional[int], str]] = []
+        malformed: list[str] = []
+        for line in target_lines:
+            try:
+                t = parse_target(line)
+            except ValueError:
+                malformed.append(line.strip())
+                continue
+            if t is not None:
+                parsed.append(t)
+        return parsed, malformed
+
+    def _resolve_names(
+        self, parsed: Sequence[tuple[str, Optional[int], str]], all_addrs: bool = False
+    ) -> dict[str, list[str]]:
+        """Bulk-resolve the non-IP hostnames in ``parsed`` → name→addrs
+        (empty list when unresolvable)."""
+        names = sorted({h for h, _, _ in parsed if not is_ip(h)})
+        addr_of: dict[str, list[str]] = {n: [] for n in names}
+        resolvers = list(self.spec["resolvers"]) or _system_resolvers()
+        if names and resolvers:
+            res = scanio.dns_resolve(
+                names, resolvers, timeout_ms=int(self.spec["read_timeout_ms"])
+            )
+            for i, name in enumerate(names):
+                addrs = res.addresses(i)
+                addr_of[name] = addrs if all_addrs else addrs[:1]
+        return addr_of
 
     # ------------------------------------------------------------------
     def resolve(self, target_lines: Sequence[str]) -> list[tuple[str, list[str]]]:
@@ -128,26 +184,11 @@ class ProbeExecutor:
         IP literals pass through as (ip, [ip]); unresolvable names keep
         an empty address list so callers see every input accounted for.
         """
-        names: list[str] = []
-        for line in target_lines:
-            try:
-                t = parse_target(line)
-            except ValueError:
-                continue
-            if t is not None:
-                names.append(t[0])
-        to_resolve = sorted({n for n in names if not is_ip(n)})
-        resolvers = list(self.spec["resolvers"]) or _system_resolvers()
-        addr_of: dict[str, list[str]] = {n: [] for n in to_resolve}
-        if to_resolve and resolvers:
-            res = scanio.dns_resolve(
-                to_resolve, resolvers, timeout_ms=int(self.spec["read_timeout_ms"])
-            )
-            for i, name in enumerate(to_resolve):
-                addr_of[name] = res.addresses(i)
+        parsed, _malformed = self._parse_lines(target_lines)
+        addr_of = self._resolve_names(parsed, all_addrs=True)
         seen: set[str] = set()
         out: list[tuple[str, list[str]]] = []
-        for name in names:
+        for name, _port, _path in parsed:
             if name in seen:
                 continue
             seen.add(name)
@@ -163,40 +204,15 @@ class ProbeExecutor:
         chunk contract the reference's tools also kept (every input line
         is accounted for in the output file).
         """
-        parsed = []
-        malformed: list[str] = []
-        for line in target_lines:
-            try:
-                t = parse_target(line)
-            except ValueError:
-                malformed.append(line.strip())
-                continue
-            if t is not None:
-                parsed.append(t)
-
-        # --- resolve hostnames in bulk ---
-        names = sorted({h for h, _, _ in parsed if not is_ip(h)})
-        addr_of: dict[str, Optional[str]] = {}
-        resolvers = list(self.spec["resolvers"]) or _system_resolvers()
-        if names and resolvers:
-            res = scanio.dns_resolve(
-                names,
-                resolvers,
-                timeout_ms=int(self.spec["read_timeout_ms"]),
-            )
-            for i, name in enumerate(names):
-                addrs = res.addresses(i)
-                addr_of[name] = addrs[0] if addrs else None
-        else:
-            for name in names:
-                addr_of[name] = None
+        parsed, malformed = self._parse_lines(target_lines)
+        addr_of = self._resolve_names(parsed)
 
         # --- fan out (target × ports) ---
         probes: list[tuple[str, str, int, str]] = []  # (host, ip, port, path)
         dead: list[tuple[str, int]] = []  # unresolved rows
         spec_ports = [p for p in self.spec["ports"] if 0 < int(p) < 65536]
         for host, explicit_port, path in parsed:
-            ip = host if is_ip(host) else addr_of.get(host)
+            ip = host if is_ip(host) else next(iter(addr_of.get(host) or []), None)
             ports = [explicit_port] if explicit_port else spec_ports
             for port in ports:
                 if ip is None:
@@ -244,3 +260,96 @@ class ProbeExecutor:
         rows.extend(Response(host=h, port=p, alive=False) for h, p in dead)
         rows.extend(Response(host=m, port=0, alive=False) for m in malformed)
         return rows
+
+    # ------------------------------------------------------------------
+    def run_service(
+        self, target_lines: Sequence[str], classifier
+    ) -> tuple[list[Response], list[Optional[str]]]:
+        """Service-scan probing (the nmap -sV front half): per-port probe
+        payload selection from the probes DB, raw banner capture.
+
+        → (rows, sent_probe_names) aligned for
+        ``ServiceClassifier.classify``. Targets without an explicit port
+        fan out over the spec's ports (default: the bundled top-ports
+        list).
+        """
+        parsed, malformed = self._parse_lines(target_lines)
+        addr_of = self._resolve_names(parsed)
+
+        # explicit ports only when the caller set them; service scans
+        # default to the top-ports fan-out, not the HTTP default [80]
+        spec_ports = (
+            [int(p) for p in self.spec["ports"] if 0 < int(p) < 65536]
+            if "ports" in self.explicit
+            else []
+        ) or top_ports()
+        probes: list[tuple[str, str, int, str, bytes]] = []
+        rows: list[Response] = []
+        sent: list[Optional[str]] = []
+        for line in malformed:
+            rows.append(Response(host=line, port=0, alive=False))
+            sent.append(None)
+        for host, explicit_port, _path in parsed:
+            ip = host if is_ip(host) else next(iter(addr_of.get(host) or []), None)
+            for port in [explicit_port] if explicit_port else spec_ports:
+                if ip is None:
+                    rows.append(Response(host=host, port=port, alive=False))
+                    sent.append(None)
+                    continue
+                probe = classifier.probe_for_port(port)
+                probes.append((host, ip, port, probe.name, probe.payload))
+
+        if probes:
+            result = scanio.tcp_scan(
+                [ip for _h, ip, _p, _n, _pl in probes],
+                np.asarray([p for _h, _ip, p, _n, _pl in probes], dtype=np.uint16),
+                [pl if pl else None for _h, _ip, _p, _n, pl in probes],
+                max_concurrency=int(self.spec["concurrency"]),
+                connect_timeout_ms=int(self.spec["connect_timeout_ms"]),
+                read_timeout_ms=int(self.spec["read_timeout_ms"]),
+                banner_cap=int(self.spec["banner_cap"]),
+            )
+            for i, (host, _ip, port, probe_name, _pl) in enumerate(probes):
+                alive = int(result.status[i]) == scanio.STATUS_OPEN
+                rows.append(
+                    Response(
+                        host=host,
+                        port=port,
+                        banner=result.banner(i) if alive else b"",
+                        alive=alive,
+                    )
+                )
+                sent.append(probe_name)
+
+            # second round: open ports that stayed silent under the NULL
+            # listen get the lowest-rarity payload probe (nmap escalates
+            # through payload probes when nothing announces itself)
+            second = classifier.default_payload_probe()
+            base = len(rows) - len(probes)
+            retry = [
+                (base + i, probes[i])
+                for i in range(len(probes))
+                if rows[base + i].alive
+                and not rows[base + i].banner
+                and not probes[i][4]  # no payload was sent the first time
+            ]
+            if second is not None and retry:
+                result2 = scanio.tcp_scan(
+                    [p[1] for _ri, p in retry],
+                    np.asarray([p[2] for _ri, p in retry], dtype=np.uint16),
+                    [second.payload] * len(retry),
+                    max_concurrency=int(self.spec["concurrency"]),
+                    connect_timeout_ms=int(self.spec["connect_timeout_ms"]),
+                    read_timeout_ms=int(self.spec["read_timeout_ms"]),
+                    banner_cap=int(self.spec["banner_cap"]),
+                )
+                for j, (ri, p) in enumerate(retry):
+                    if (
+                        int(result2.status[j]) == scanio.STATUS_OPEN
+                        and result2.banner(j)
+                    ):
+                        rows[ri] = Response(
+                            host=p[0], port=p[2], banner=result2.banner(j)
+                        )
+                        sent[ri] = second.name
+        return rows, sent

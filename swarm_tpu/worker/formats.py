@@ -74,9 +74,9 @@ def format_httpx_json(rows: Sequence[Response]) -> str:
     lines = []
     for row in rows:
         # httpx emits only successfully probed URLs: the connect must have
-        # succeeded AND an HTTP response must have come back (a bare open
-        # socket with no response produces no output line)
-        if not row.alive or (row.status == 0 and not row.body and not row.header):
+        # succeeded AND a parseable HTTP status line must have come back
+        # (a silent open socket, or an SSH/SMTP banner, produces nothing)
+        if not row.alive or row.status == 0:
             continue
         obj = {
             "url": url_of(row),

@@ -139,6 +139,8 @@ class JobProcessor:
                 output = self._execute_tpu(module, data)
             elif module.backend == "probe":
                 output = self._execute_probe(module, data)
+            elif module.backend == "service":
+                output = self._execute_service(module, data)
             else:
                 output = self._execute_command(module, scan_id, chunk_index, data)
         except Exception as e:
@@ -245,6 +247,26 @@ class JobProcessor:
         raise ValueError(
             f"module {module.name}: unknown output_format {module.output_format!r}"
         )
+
+    # ------------------------------------------------------------------
+    def _execute_service(self, module: ModuleSpec, data: bytes) -> bytes:
+        """Service/version detection (the nmap -sV replacement): native
+        banner probing with payloads from the probes DB, device-batched
+        match prefilter, host version extraction."""
+        from swarm_tpu.ops.service import ServiceClassifier
+        from swarm_tpu.worker.executor import ProbeExecutor
+
+        key = f"svc::{module.raw.get('probes_db') or ''}"
+        classifier = self._engines.get(key)
+        if classifier is None:
+            classifier = ServiceClassifier(db_path=module.raw.get("probes_db"))
+            self._engines[key] = classifier
+        rows, sent = ProbeExecutor(module.probe).run_service(
+            data.decode("utf-8", "surrogateescape").splitlines(), classifier
+        )
+        infos = classifier.classify(rows, sent)
+        lines = [info.line() for info in infos if info.open]
+        return ("\n".join(lines) + "\n").encode() if lines else b""
 
 
 def main(argv: Optional[list[str]] = None) -> None:

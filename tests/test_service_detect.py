@@ -1,0 +1,188 @@
+"""nmap-service-probes parsing + TPU-prefiltered service classification.
+
+Covers the reference's nmap -sV capability (SURVEY.md §2.2): probes-DB
+parsing (payload escapes, match directives, version templates), probe
+selection per port, and banner → service/product/version classification
+with the device match engine as prefilter.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+
+import pytest
+
+from swarm_tpu.fingerprints.nmap_probes import (
+    load_probes,
+    parse_port_spec,
+    parse_probes,
+    substitute_version,
+    unescape_payload,
+)
+from swarm_tpu.fingerprints.model import Response
+from swarm_tpu.ops.service import ServiceClassifier
+
+MINI_DB = """
+Probe TCP NULL q||
+totalwaitms 5000
+rarity 1
+ports 1-65535
+match ssh m|^SSH-([\\d.]+)-OpenSSH[_-]([^\\s\\r\\n]+)| p/OpenSSH/ v/$2/ i/protocol $1/ cpe:/a:openbsd:openssh:$2/
+softmatch ssh m|^SSH-[\\d.]+-|
+match ftp m|^220[ -].*\\(vsFTPd ([^)]+)\\)| p/vsftpd/ v/$1/
+
+Probe TCP GetRequest q|GET / HTTP/1.0\\r\\n\\r\\n|
+rarity 1
+ports 80,8000-8100
+fallback NULL
+match http m|^HTTP/1\\.[01] \\d\\d\\d.*Server: nginx/([^\\s\\r\\n]*)|s p/nginx/ v/$1/
+softmatch http m|^HTTP/1\\.[01] \\d\\d\\d|s
+"""
+
+
+def test_parse_probes_structure():
+    probes, skipped = parse_probes(MINI_DB)
+    assert skipped == 0
+    assert [p.name for p in probes] == ["NULL", "GetRequest"]
+    null, get = probes
+    assert null.payload == b""
+    assert null.totalwaitms == 5000
+    assert get.payload == b"GET / HTTP/1.0\r\n\r\n"
+    assert get.fallback == ["NULL"]
+    assert get.covers_port(8080) and get.covers_port(80)
+    assert not get.covers_port(443)
+    assert len(null.matches) == 3
+    ssh = null.matches[0]
+    assert ssh.service == "ssh" and ssh.product == "OpenSSH"
+    assert ssh.version == "$2" and ssh.info == "protocol $1"
+    assert ssh.cpe == ["a:openbsd:openssh:$2"]
+    assert null.matches[1].soft
+
+
+def test_unescape_payload():
+    assert unescape_payload(r"a\r\n\0\x41\\b") == b"a\r\n\x00A\\b"
+
+
+def test_parse_port_spec():
+    assert parse_port_spec("80,443,8000-8002") == [(80, 80), (443, 443), (8000, 8002)]
+
+
+def test_substitute_version():
+    import re
+
+    mo = re.search(rb"v(\d+)\.(\d+)", b"v8.9")
+    assert substitute_version("$1.$2p1", mo) == "8.9p1"
+    assert substitute_version("fixed", mo) == "fixed"
+    assert substitute_version(None, mo) is None
+    assert substitute_version("$1 and $5", mo) == "8 and"
+
+
+def test_bundled_db_loads():
+    probes, skipped = load_probes()
+    names = [p.name for p in probes]
+    assert "NULL" in names and "GetRequest" in names
+    assert skipped == 0, f"{skipped} bundled matches failed to compile"
+    total = sum(len(p.matches) for p in probes)
+    assert total >= 30
+
+
+@pytest.fixture(scope="module")
+def classifier():
+    return ServiceClassifier(probes=parse_probes(MINI_DB)[0])
+
+
+def test_classify_hard_match_with_version(classifier):
+    rows = [
+        Response(host="a", port=22, banner=b"SSH-2.0-OpenSSH_8.9p1 Ubuntu-3ubuntu0.1\r\n"),
+        Response(host="b", port=21, banner=b"220 (vsFTPd 3.0.5)\r\n"),
+        Response(host="c", port=2222, banner=b"SSH-2.0-CustomSSHd_1.0\r\n"),
+        Response(host="d", port=9999, banner=b"hello whoever you are"),
+        Response(host="e", port=23, banner=b"", alive=False),
+    ]
+    infos = classifier.classify(rows, sent_probes=["NULL"] * 5)
+    assert infos[0].service == "ssh"
+    assert infos[0].product == "OpenSSH" and infos[0].version == "8.9p1"
+    assert infos[0].info == "protocol 2.0"
+    assert infos[0].cpe == ["a:openbsd:openssh:8.9p1"]
+    assert infos[1].service == "ftp" and infos[1].version == "3.0.5"
+    # only the softmatch fires for an unknown SSH implementation
+    assert infos[2].service == "ssh" and infos[2].soft
+    assert infos[3].service is None and infos[3].open
+    assert not infos[4].open and infos[4].service is None
+
+
+def test_classify_probe_scoping(classifier):
+    # an HTTP banner elicited by the NULL probe must NOT match GetRequest
+    # matches (nmap scopes match directives to their probe + fallbacks)
+    http_banner = b"HTTP/1.1 200 OK\r\nServer: nginx/1.25.3\r\n\r\nhi"
+    rows = [Response(host="a", port=8080, banner=http_banner)]
+    got_null = classifier.classify(rows, sent_probes=["NULL"])[0]
+    assert got_null.service is None
+    got_get = classifier.classify(rows, sent_probes=["GetRequest"])[0]
+    assert got_get.service == "http"
+    assert got_get.product == "nginx" and got_get.version == "1.25.3"
+
+
+def test_classify_without_probe_bookkeeping(classifier):
+    rows = [Response(host="a", port=80, banner=b"HTTP/1.0 404 Not Found\r\n\r\n")]
+    info = classifier.classify(rows)[0]  # no sent_probes: everything applies
+    assert info.service == "http" and info.soft
+
+
+def test_probe_for_port(classifier):
+    assert classifier.probe_for_port(8080).name == "GetRequest"
+    assert classifier.probe_for_port(22).name == "NULL"
+
+
+def test_service_info_line():
+    from swarm_tpu.ops.service import ServiceInfo
+
+    info = ServiceInfo(
+        host="10.0.0.1", port=22, open=True,
+        service="ssh", product="OpenSSH", version="8.9p1", info="protocol 2.0",
+    )
+    assert info.line() == "10.0.0.1:22\topen\tssh\tOpenSSH 8.9p1\t(protocol 2.0)"
+
+
+# ---------------------------------------------------------------------------
+# End to end over a live socket: probe payload selection + classify
+# ---------------------------------------------------------------------------
+
+
+class _SSHServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+
+
+class _SSHHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        self.request.sendall(b"SSH-2.0-OpenSSH_9.6\r\n")
+
+
+def test_service_scan_end_to_end():
+    srv = _SSHServer(("127.0.0.1", 0), _SSHHandler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    try:
+        from swarm_tpu.worker.executor import ProbeExecutor
+
+        classifier = ServiceClassifier(probes=parse_probes(MINI_DB)[0])
+        rows, sent = ProbeExecutor({"read_timeout_ms": 1500}).run_service(
+            [f"127.0.0.1:{port}"], classifier
+        )
+        assert len(rows) == 1 and rows[0].alive
+        assert sent == ["NULL"]
+        info = classifier.classify(rows, sent)[0]
+        assert info.service == "ssh"
+        assert info.product == "OpenSSH" and info.version == "9.6"
+    finally:
+        srv.shutdown()
+
+
+def test_top_ports_default():
+    from swarm_tpu.worker.executor import top_ports
+
+    ports = top_ports()
+    assert 22 in ports and 443 in ports and len(ports) >= 80
+    assert top_ports(5) == ports[:5]
