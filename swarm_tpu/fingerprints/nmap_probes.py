@@ -120,26 +120,32 @@ def parse_port_spec(spec: str) -> list[tuple[int, int]]:
     return out
 
 
-_VERSION_FIELD_RE = re.compile(r"(cpe:|[pvioh])([|/])")
+_VERSION_FIELD_RE = re.compile(r"(cpe:|[pvidoh])(.)")
 
 
 def _parse_version_info(rest: str, m: ServiceMatch) -> None:
-    """p/…/ v/…/ i/…/ o/…/ h/…/ cpe:/…/[a] annotations after the regex."""
+    """p/…/ v/…/ i/…/ d/…/ o/…/ h/…/ cpe:/…/[a] annotations after the
+    regex. Fields are consumed strictly left-to-right at field
+    boundaries — never scanned for inside a previous field's value
+    (``d/switch/`` must not yield a phantom ``h/`` field)."""
     i = 0
-    while i < len(rest):
-        mo = _VERSION_FIELD_RE.match(rest, i)
-        if not mo:
+    n = len(rest)
+    while i < n:
+        if rest[i].isspace():
             i += 1
             continue
+        mo = _VERSION_FIELD_RE.match(rest, i)
+        if not mo:
+            return  # unrecognized token: stop rather than mis-slice
         key, delim = mo.group(1), mo.group(2)
         start = mo.end()
         end = rest.find(delim, start)
         if end < 0:
-            break
+            return
         value = rest[start:end]
         i = end + 1
         # cpe may carry a trailing 'a' (applies-to-app) flag
-        if i < len(rest) and key == "cpe:" and rest[i] == "a":
+        while i < n and not rest[i].isspace():
             i += 1
         if key == "p":
             m.product = value
@@ -151,6 +157,8 @@ def _parse_version_info(rest: str, m: ServiceMatch) -> None:
             m.ostype = value
         elif key == "h":
             m.hostname = value
+        elif key == "d":
+            pass  # devicetype: parsed (so later fields stay aligned), not lifted
         elif key == "cpe:":
             m.cpe.append(value)
 
@@ -232,18 +240,38 @@ def load_probes(path: Optional[str | Path] = None) -> tuple[list[ServiceProbe], 
     return parse_probes(p.read_text(encoding="latin-1"))
 
 
+_HELPER_RE = re.compile(
+    r"\$P\((\d)\)"                                  # printable filter
+    r"|\$SUBST\((\d),\"([^\"]*)\",\"([^\"]*)\"\)"   # substring replace
+    r"|\$I\((\d),\"([<>])\"\)"                      # unsigned int from bytes
+    r"|\$(\d)"                                      # plain backref
+)
+
+
 def substitute_version(template: Optional[str], mo: re.Match) -> Optional[str]:
-    """$1..$9 backref substitution in p/v/i templates (nmap semantics;
-    missing groups substitute empty)."""
+    """Backref substitution in p/v/i templates: ``$1``..``$9`` plus the
+    nmap helper functions ``$P(n)`` (strip non-printable bytes),
+    ``$SUBST(n,"a","b")`` and ``$I(n,"<"|">")`` (endian-tagged unsigned
+    int). Missing groups substitute empty."""
     if template is None:
         return None
 
-    def repl(m: re.Match) -> str:
-        idx = int(m.group(1))
+    def group(idx: str) -> bytes:
         try:
-            g = mo.group(idx)
+            return mo.group(int(idx)) or b""
         except (IndexError, re.error):
-            return ""
-        return g.decode("latin-1", "replace") if g else ""
+            return b""
 
-    return re.sub(r"\$(\d)", repl, template).strip()
+    def repl(m: re.Match) -> str:
+        p, s_n, s_a, s_b, i_n, i_e, plain = m.groups()
+        if p is not None:
+            return bytes(b for b in group(p) if 32 <= b < 127).decode("ascii")
+        if s_n is not None:
+            return group(s_n).decode("latin-1", "replace").replace(s_a, s_b)
+        if i_n is not None:
+            return str(
+                int.from_bytes(group(i_n), "little" if i_e == "<" else "big")
+            )
+        return group(plain).decode("latin-1", "replace")
+
+    return _HELPER_RE.sub(repl, template).strip()

@@ -106,18 +106,25 @@ class ServiceClassifier:
 
         self.engine = MatchEngine(templates, **engine_kwargs)
         self._compiled = [m.compile() for _probe, m in self._matches]
+        self._by_probe: dict[str, list[int]] = {}
+        for idx, (probe_name, _m) in enumerate(self._matches):
+            self._by_probe.setdefault(probe_name, []).append(idx)
+        self._port_probe_cache: dict[int, ServiceProbe] = {}
 
     # ------------------------------------------------------------------
-    def _allowed(self, sent_probe: Optional[str]) -> Optional[set]:
-        """Probe names whose matches apply to a response elicited by
-        ``sent_probe`` (itself + declared fallbacks + NULL)."""
+    def _probe_order(self, sent_probe: Optional[str]) -> Optional[list[str]]:
+        """Probes whose matches apply to a response elicited by
+        ``sent_probe``, in nmap evaluation order: the sent probe's own
+        matches first, then its declared fallbacks, then NULL."""
         if sent_probe is None:
             return None  # no probe bookkeeping: every match applies
-        allowed = {sent_probe, "NULL"}
+        order = [sent_probe]
         probe = self.probe_by_name.get(sent_probe)
         if probe:
-            allowed.update(probe.fallback)
-        return allowed
+            order.extend(f for f in probe.fallback if f not in order)
+        if "NULL" not in order:
+            order.append("NULL")
+        return order
 
     def classify(
         self,
@@ -132,17 +139,25 @@ class ServiceClassifier:
             if not row.alive or not banner:
                 out.append(info)
                 continue
-            allowed = self._allowed(sent_probes[i] if sent_probes else None)
-            candidates = sorted(
+            cand = {
                 int(tid.rsplit("/", 1)[1])
                 for tid in hits.template_ids
                 if tid.startswith("svc/")
-            )
+            }
+            probe_order = self._probe_order(sent_probes[i] if sent_probes else None)
+            if probe_order is None:
+                ordered = sorted(cand)
+            else:
+                ordered = [
+                    idx
+                    for pname in probe_order
+                    for idx in self._by_probe.get(pname, [])
+                    if idx in cand
+                ]
             soft_hit: Optional[ServiceMatch] = None
-            for idx in candidates:
-                probe_name, match = self._matches[idx]
-                if allowed is not None and probe_name not in allowed:
-                    continue
+            hard_done = False
+            for idx in ordered:
+                _probe_name, match = self._matches[idx]
                 pattern = self._compiled[idx]
                 mo = pattern.search(banner) if pattern else None
                 if not mo:
@@ -150,36 +165,44 @@ class ServiceClassifier:
                 if match.soft:
                     soft_hit = soft_hit or match
                     continue
+                # after a softmatch names a service, only hard matches for
+                # that same service may win (nmap softmatch semantics)
+                if soft_hit is not None and match.service != soft_hit.service:
+                    continue
                 info.service = match.service
                 info.product = substitute_version(match.product, mo)
                 info.version = substitute_version(match.version, mo)
                 info.info = substitute_version(match.info, mo)
                 info.cpe = [substitute_version(c, mo) for c in match.cpe]
-                out.append(info)
+                hard_done = True
                 break
-            else:
-                if soft_hit:
-                    info.service = soft_hit.service
-                    info.soft = True
-                out.append(info)
+            if not hard_done and soft_hit:
+                info.service = soft_hit.service
+                info.soft = True
+            out.append(info)
         return out
 
     # ------------------------------------------------------------------
     def probe_for_port(self, port: int) -> ServiceProbe:
         """Payload selection: lowest-rarity TCP probe with a payload
-        covering the port; NULL (listen-only) otherwise."""
+        covering the port; NULL (listen-only) otherwise. Memoized —
+        service scans call this per (host, port) on the probing hot
+        path."""
+        cached = self._port_probe_cache.get(port)
+        if cached is not None:
+            return cached
         best: Optional[ServiceProbe] = None
         for probe in self.probes:
             if probe.proto != "TCP" or not probe.payload:
                 continue
             if probe.covers_port(port) and (best is None or probe.rarity < best.rarity):
                 best = probe
-        if best:
-            return best
-        null = self.probe_by_name.get("NULL")
-        if null:
-            return null
-        return ServiceProbe(proto="TCP", name="NULL")
+        if best is None:
+            best = self.probe_by_name.get("NULL") or ServiceProbe(
+                proto="TCP", name="NULL"
+            )
+        self._port_probe_cache[port] = best
+        return best
 
     def default_payload_probe(self) -> Optional[ServiceProbe]:
         """Second-round probe for silent-but-open ports: the lowest-

@@ -1,0 +1,161 @@
+"""Workflow execution over the one-pass batched match.
+
+Semantics mirror the reference corpus's workflow templates (SURVEY.md
+§2.3): a trigger template (by path or tags) gates subtemplates (by tag
+or path), optionally scoped to specific *named matchers* of the trigger;
+subtemplates nest recursively. Plus nuclei's automatic-scan mode:
+detected technologies (named matchers of tech templates) map through
+``wappalyzer-mapping.yml`` to tags whose templates are then selected.
+
+Everything evaluates against ONE device-batched match of the full
+corpus — workflows only decide which of those hits get reported, so the
+device never waits on conditional host logic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from swarm_tpu.fingerprints.model import Response, Template
+from swarm_tpu.fingerprints.workflows import (
+    SubtemplateRef,
+    TemplateIndex,
+    Workflow,
+    parse_workflow,
+)
+from swarm_tpu.ops import cpu_ref
+
+
+class WorkflowRunner:
+    def __init__(
+        self,
+        templates: Sequence[Template],
+        engine=None,
+        wappalyzer: Optional[dict[str, list[str]]] = None,
+        **engine_kwargs,
+    ):
+        self.workflows: list[Workflow] = [
+            parse_workflow(t) for t in templates if t.protocol == "workflow"
+        ]
+        self.matchable = [t for t in templates if t.protocol != "workflow"]
+        self.index = TemplateIndex(self.matchable)
+        self.by_id = {t.id: t for t in self.matchable}
+        self.wappalyzer = {k.lower(): v for k, v in (wappalyzer or {}).items()}
+        if engine is None:
+            from swarm_tpu.ops.engine import MatchEngine
+
+            engine = MatchEngine(self.matchable, **engine_kwargs)
+        self.engine = engine
+
+    # ------------------------------------------------------------------
+    def run(self, rows: Sequence[Response]) -> list[dict[str, list[str]]]:
+        """→ per row: {workflow_id: [matched template ids]} (workflows
+        whose trigger didn't fire are absent)."""
+        results = self.engine.match(rows)
+        out = []
+        for row, rm in zip(rows, results):
+            hit_ids = set(rm.template_ids)
+            names_cache: dict[str, list[str]] = {}
+            per: dict[str, list[str]] = {}
+            for wf in self.workflows:
+                matched = self._eval_workflow(wf, row, hit_ids, names_cache)
+                if matched:
+                    per[wf.id] = sorted(matched)
+            out.append(per)
+        return out
+
+    # ------------------------------------------------------------------
+    def _matcher_names(
+        self, template: Template, row: Response, cache: dict[str, list[str]]
+    ) -> list[str]:
+        """Named matchers of ``template`` that fired on ``row`` — host
+        confirm on demand, once per (row, template)."""
+        if template.id not in cache:
+            cache[template.id] = cpu_ref.match_template(template, row).matcher_names
+        return cache[template.id]
+
+    def _eval_workflow(
+        self, wf: Workflow, row: Response, hit_ids: set, cache: dict
+    ) -> set:
+        matched: set = set()
+        for step in wf.steps:
+            triggers: list[Template] = []
+            if step.template:
+                t = self.index.by_path(step.template)
+                if t:
+                    triggers.append(t)
+            for tag in step.tags:
+                triggers.extend(self.index.by_tag.get(tag.lower(), []))
+            for trigger in triggers:
+                if trigger.id not in hit_ids:
+                    continue
+                if step.matchers:
+                    fired = self._matcher_names(trigger, row, cache)
+                    for gate in step.matchers:
+                        if gate.name in fired:
+                            for ref in gate.subtemplates:
+                                matched |= self._eval_ref(ref, row, hit_ids, cache)
+                elif step.subtemplates:
+                    for ref in step.subtemplates:
+                        matched |= self._eval_ref(ref, row, hit_ids, cache)
+                else:
+                    matched.add(trigger.id)
+        return matched
+
+    def _eval_ref(
+        self, ref: SubtemplateRef, row: Response, hit_ids: set, cache: dict
+    ) -> set:
+        matched: set = set()
+        for t in self.index.resolve(ref):
+            if t.id not in hit_ids:
+                continue
+            if ref.matchers:
+                fired = self._matcher_names(t, row, cache)
+                for gate in ref.matchers:
+                    if gate.name in fired:
+                        for sub in gate.subtemplates:
+                            matched |= self._eval_ref(sub, row, hit_ids, cache)
+            elif ref.subtemplates:
+                for sub in ref.subtemplates:
+                    matched |= self._eval_ref(sub, row, hit_ids, cache)
+            else:
+                matched.add(t.id)
+        return matched
+
+    # ------------------------------------------------------------------
+    # nuclei automatic-scan mode: tech detection → wappalyzer tags
+    # ------------------------------------------------------------------
+    def auto_scan(self, rows: Sequence[Response]) -> list[dict]:
+        """Per row: detected technologies (fired named matchers of
+        'tech'-tagged templates), their mapped tags, and the matched
+        template ids those tags select."""
+        results = self.engine.match(rows)
+        tech_templates = self.index.by_tag.get("tech", [])
+        out = []
+        for row, rm in zip(rows, results):
+            hit_ids = set(rm.template_ids)
+            cache: dict[str, list[str]] = {}
+            techs: set[str] = set()
+            for t in tech_templates:
+                if t.id in hit_ids:
+                    techs.update(
+                        n.lower() for n in self._matcher_names(t, row, cache)
+                    )
+            tags: set[str] = set()
+            for tech in techs:
+                tags.update(tag.lower() for tag in self.wappalyzer.get(tech, []))
+                tags.add(tech)  # a tech name is itself a usable tag
+            selected = {
+                t.id
+                for tag in tags
+                for t in self.index.by_tag.get(tag, [])
+                if t.id in hit_ids
+            }
+            out.append(
+                {
+                    "technologies": sorted(techs),
+                    "tags": sorted(tags),
+                    "template_ids": sorted(selected),
+                }
+            )
+        return out

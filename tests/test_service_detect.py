@@ -78,6 +78,64 @@ def test_substitute_version():
     assert substitute_version("$1 and $5", mo) == "8 and"
 
 
+def test_version_info_unknown_fields_stay_aligned():
+    # d/…/ (devicetype) values must not be scanned for field keys —
+    # 'h' inside 'switch' is not a hostname field
+    probes, _ = parse_probes(
+        "Probe TCP NULL q||\n"
+        'match http m|^HTTP| p/Cisco IOS http config/ d/switch/ o/IOS/\n'
+    )
+    m = probes[0].matches[0]
+    assert m.product == "Cisco IOS http config"
+    assert m.ostype == "IOS"
+    assert m.hostname is None
+
+
+def test_substitute_version_helpers():
+    import re
+
+    mo = re.search(rb"(v[\x01\x02\d.]+)_(\w+)", b"\x00v1\x01.2_beta\x00")
+    assert substitute_version("$P(1)", mo) == "v1.2"
+    assert substitute_version('$SUBST(2,"e","E")', mo) == "bEta"
+    mo2 = re.search(rb"x(..)", b"x\x01\x02")
+    assert substitute_version('$I(1,">")', mo2) == str(0x0102)
+    assert substitute_version('$I(1,"<")', mo2) == str(0x0201)
+
+
+def test_classify_probe_match_ordering():
+    # the sent probe's own matches are tried before fallback (NULL)
+    # matches even though NULL appears first in the DB
+    db = (
+        "Probe TCP NULL q||\n"
+        "ports 1-65535\n"
+        "match generic m|^BANNER| p/generic-from-null/\n"
+        "Probe TCP Poke q|hi|\n"
+        "ports 9000\n"
+        "fallback NULL\n"
+        "match specific m|^BANNER-X| p/specific-from-poke/\n"
+    )
+    clf = ServiceClassifier(probes=parse_probes(db)[0])
+    rows = [Response(host="a", port=9000, banner=b"BANNER-X here")]
+    info = clf.classify(rows, sent_probes=["Poke"])[0]
+    assert info.service == "specific" and info.product == "specific-from-poke"
+
+
+def test_classify_softmatch_restricts_service():
+    # once a softmatch names a service, hard matches for other services
+    # cannot win (nmap -sV softmatch semantics)
+    db = (
+        "Probe TCP NULL q||\n"
+        "ports 1-65535\n"
+        "softmatch ftp m|^220[ -]|\n"
+        "match smtp m|^220[ -].*mail| p/maild/\n"
+        "match ftp m|^220[ -].*FTP| p/ftpd/\n"
+    )
+    clf = ServiceClassifier(probes=parse_probes(db)[0])
+    got = clf.classify([Response(host="a", port=21, banner=b"220 mail FTP ready")])
+    # softmatch ftp fires first; the smtp hard match is skipped; ftp wins
+    assert got[0].service == "ftp" and got[0].product == "ftpd"
+
+
 def test_bundled_db_loads():
     probes, skipped = load_probes()
     names = [p.name for p in probes]

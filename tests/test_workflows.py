@@ -1,0 +1,120 @@
+"""Workflow chaining + wappalyzer auto-scan (SURVEY.md §2.3).
+
+Reference semantics under test (`workflows/74cms-workflow.yaml:8-13`):
+a trigger template's *named matcher* gates tag-selected subtemplates;
+plus the tech→tags mapping path of nuclei's automatic scan.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from swarm_tpu.fingerprints import load_corpus
+from swarm_tpu.fingerprints.model import Response
+from swarm_tpu.fingerprints.workflows import (
+    TemplateIndex,
+    parse_wappalyzer_mapping,
+    parse_workflow,
+)
+from swarm_tpu.ops.workflows import WorkflowRunner
+
+DATA = Path(__file__).resolve().parent / "data"
+
+ACME_PAGE = Response(
+    host="10.0.0.1",
+    port=80,
+    status=200,
+    body=b"<html><body>site powered by AcmeCMS, demo-build 3.11</body></html>",
+    header=b"HTTP/1.1 200 OK\r\nX-Widget-Version: 4.2",
+)
+PLAIN_PAGE = Response(
+    host="10.0.0.2", port=80, status=200, body=b"hello world", header=b"HTTP/1.1 200 OK"
+)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    templates, errors = load_corpus(DATA / "templates")
+    assert not errors
+    return templates
+
+
+@pytest.fixture(scope="module")
+def runner(corpus):
+    mapping = parse_wappalyzer_mapping((DATA / "wappalyzer-mini.yml").read_text())
+    return WorkflowRunner(corpus, wappalyzer=mapping)
+
+
+def test_corpus_contains_workflow(corpus):
+    protos = {t.id: t.protocol for t in corpus}
+    assert protos.get("demo-workflow") == "workflow"
+
+
+def test_parse_workflow_model(corpus):
+    wf_t = next(t for t in corpus if t.id == "demo-workflow")
+    wf = parse_workflow(wf_t)
+    assert len(wf.steps) == 1
+    step = wf.steps[0]
+    assert step.template == "http/demo-tech.yaml"
+    assert step.matchers[0].name == "acme-cms"
+    assert step.matchers[0].subtemplates[0].tags == ["acme"]
+
+
+def test_template_index(corpus):
+    idx = TemplateIndex([t for t in corpus if t.protocol != "workflow"])
+    assert idx.by_path("http/demo-tech.yaml").id == "demo-tech"
+    assert idx.by_path("nope/missing.yaml") is None
+    acme = idx.by_tag.get("acme", [])
+    assert [t.id for t in acme] == ["demo-acme-vuln"]
+
+
+def test_workflow_gates_subtemplates(runner):
+    out = runner.run([ACME_PAGE, PLAIN_PAGE])
+    # row 0: acme-cms named matcher fires -> acme-tagged subtemplate hit
+    assert out[0] == {"demo-workflow": ["demo-acme-vuln"]}
+    # row 1: demo-tech matches (negative matcher) but the acme-cms NAMED
+    # matcher does not fire, so the workflow reports nothing
+    assert out[1] == {}
+
+
+def test_workflow_dead_row(runner):
+    out = runner.run([Response(host="x", port=80, alive=False)])
+    assert out == [{}]
+
+
+def test_parse_wappalyzer_mapping():
+    mapping = parse_wappalyzer_mapping(
+        "# comment\nnode.js: nodejs\nApache HTTP Server: apache,httpd\nbad-line\n"
+    )
+    assert mapping == {
+        "node.js": ["nodejs"],
+        "apache http server": ["apache", "httpd"],
+    }
+
+
+def test_auto_scan(runner):
+    out = runner.auto_scan([ACME_PAGE, PLAIN_PAGE])
+    # acme-cms (named matcher of the tech template) detected ->
+    # mapped tags select the acme-tagged template among the hits
+    assert "acme-cms" in out[0]["technologies"]
+    assert "acme" in out[0]["tags"]
+    assert out[0]["template_ids"] == ["demo-acme-vuln"]
+    assert out[1]["technologies"] == [] or "acme-cms" not in out[1]["technologies"]
+    assert out[1]["template_ids"] == []
+
+
+def test_reference_workflows_parse():
+    ref = Path("/root/reference/worker/artifacts/templates/workflows")
+    if not ref.is_dir():
+        pytest.skip("reference corpus absent")
+    templates, errors = load_corpus(ref)
+    assert len(templates) > 150
+    parsed = [parse_workflow(t) for t in templates if t.protocol == "workflow"]
+    assert parsed and all(p.steps for p in parsed if p.steps is not None)
+    # every step either names a trigger or carries tags
+    with_trigger = [
+        s for p in parsed for s in p.steps if s.template or s.tags
+    ]
+    assert with_trigger
