@@ -144,13 +144,21 @@ class TemplateIndex:
                 self.by_tag.setdefault(tag.lower(), []).append(t)
             if t.source_path:
                 self._paths.append((str(t.source_path).replace("\\", "/"), t))
+        # refs are row-invariant: memoize so per-row workflow evaluation
+        # never rescans the corpus path list
+        self._by_path_cache: dict[str, Optional[Template]] = {}
 
     def by_path(self, ref: str) -> Optional[Template]:
-        ref = ref.replace("\\", "/").lstrip("/")
+        if ref in self._by_path_cache:
+            return self._by_path_cache[ref]
+        norm = ref.replace("\\", "/").lstrip("/")
+        found = None
         for path, t in self._paths:
-            if path.endswith("/" + ref) or path == ref:
-                return t
-        return None
+            if path.endswith("/" + norm) or path == norm:
+                found = t
+                break
+        self._by_path_cache[ref] = found
+        return found
 
     def resolve(self, ref: SubtemplateRef) -> list[Template]:
         out: list[Template] = []

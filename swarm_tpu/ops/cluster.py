@@ -1,0 +1,351 @@
+"""Fingerprint clustering: bit-packed pairwise Hamming structure on the MXU.
+
+The TLS-fingerprint clustering workload (BASELINE.json config #5 —
+"Internet-wide TLS JA3/JARM hash + fingerprint clustering") needs, for
+N fingerprints, the pairwise Hamming-distance structure of their
+bit-packed encodings. The N×N distance matrix is O(N²) HBM — 17 GB of
+f32 at N=64k — and must never materialize. These kernels tile the
+computation so only O(N) ever leaves the chip:
+
+* Each (i, j) tile of the implicit distance matrix is computed in VMEM
+  from 0/1 bf16 bit rows via one MXU ``dot_general``:
+  ``hamming = popcount_i + popcount_j − 2·(a_i · a_j)``.
+* Thresholding and the per-row reductions (neighbor counts; masked
+  arg-min for density-peaks parents) fuse into the same kernel, so the
+  tile dies in VMEM.
+
+Two reduction kernels + a host-side O(N) label pass give full
+density-peaks clustering (Rodriguez & Laio style): ``rho`` = neighbor
+count within ``radius``; ``delta``/``parent`` = distance/index of the
+nearest strictly-denser row; points with ``delta > radius`` seed
+clusters, everything else follows its parent. The Pallas path runs on
+TPU; a jit'd XLA fallback with identical semantics (row-tile ``lax.map``
+so it also never materializes N²) covers CPU meshes and tests.
+
+This is new capability relative to the reference (Jec00/swarm has no
+TLS stack at all — SURVEY.md §2.2 lists only nmap/dnsx/httpx/httprobe/
+nuclei); it exists to serve the north-star benchmark configs, not for
+behavior parity.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# One fingerprint row = FP_BITS bits = FP_WORDS uint32 words.
+FP_BITS = 512
+FP_WORDS = FP_BITS // 32
+
+_TILE = 256  # rows per grid tile; VMEM ≈ 3 × 256×512×2B + 256² f32 ≈ 1 MB
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing
+
+
+def pack_strings(strings: list[bytes | str], n_bits: int = FP_BITS) -> np.ndarray:
+    """Fingerprint strings → uint32 [N, n_bits/32] bit rows.
+
+    Each byte contributes its 8 bits, truncated/zero-padded to
+    ``n_bits``; two strings differing in one character differ in 1–8
+    bits, so Hamming radius in bit units bounds character edits.
+    """
+    n = len(strings)
+    words = n_bits // 32
+    out = np.zeros((n, words), dtype=np.uint32)
+    for i, s in enumerate(strings):
+        raw = s.encode() if isinstance(s, str) else bytes(s)
+        raw = raw[: n_bits // 8]
+        arr = np.frombuffer(raw, dtype=np.uint8)
+        bits = np.unpackbits(arr, bitorder="little")
+        pad = np.zeros(n_bits, dtype=np.uint8)
+        pad[: bits.shape[0]] = bits
+        out[i] = np.packbits(pad, bitorder="little").view(np.uint32)
+    return out
+
+
+def unpack_bits_jnp(packed) -> jnp.ndarray:
+    """uint32 [N, W] → 0/1 bf16 [N, W*32] (O(N), stays tiny in HBM)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (packed[:, :, None] >> shifts[None, None, :]) & 1
+    return bits.reshape(packed.shape[0], -1).astype(jnp.bfloat16)
+
+
+def _pad_rows(bits: jnp.ndarray, tile: int) -> jnp.ndarray:
+    n = bits.shape[0]
+    pad = (-n) % tile
+    if pad:
+        bits = jnp.pad(bits, ((0, pad), (0, 0)))
+    return bits
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels (TPU)
+
+
+def _counts_kernel(n_ref, radius_ref, a_ref, b_ref, out_ref):
+    """Neighbor counts within radius for one (i, j) tile pair.
+
+    a_ref: [T, FP_BITS] bf16 rows i·T..; b_ref: same for j; out [T, 1]
+    int32 accumulated across the j grid axis (self-pair included).
+    """
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+    a = a_ref[:]
+    b = b_ref[:]
+    dot = jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    pa = jnp.sum(a.astype(jnp.float32), axis=1, keepdims=True)
+    pb = jnp.sum(b.astype(jnp.float32), axis=1, keepdims=True)
+    dist = pa + pb.T - 2.0 * dot  # [T, T] hamming, in VMEM only
+    t = a.shape[0]
+    n = n_ref[0]
+    col = j * t + jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    valid = col < n
+    near = (dist <= radius_ref[0]) & valid
+    counts = jnp.sum(near.astype(jnp.int32), axis=1, keepdims=True)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = counts
+
+    @pl.when(j > 0)
+    def _acc():
+        out_ref[:] = out_ref[:] + counts
+
+
+def _parent_kernel(n_ref, a_ref, b_ref, rho_a_ref, rho_b_ref, dmin_ref, pidx_ref):
+    """Masked arg-min: nearest row with strictly higher density.
+
+    Ties in rho break toward the lower index (a total order, so every
+    non-peak row has a parent). Accumulates (min dist, arg) over j.
+    """
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    a = a_ref[:]
+    b = b_ref[:]
+    dot = jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    pa = jnp.sum(a.astype(jnp.float32), axis=1, keepdims=True)
+    pb = jnp.sum(b.astype(jnp.float32), axis=1, keepdims=True)
+    dist = pa + pb.T - 2.0 * dot
+    t = a.shape[0]
+    n = n_ref[0]
+    row = i * t + jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    col = j * t + jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    rho_a = rho_a_ref[:]  # [T, 1]
+    rho_b = rho_b_ref[:]
+    denser = (rho_b.T > rho_a) | ((rho_b.T == rho_a) & (col < row))
+    ok = denser & (col < n) & (col != row)
+    big = jnp.float32(3.0e38)
+    masked = jnp.where(ok, dist, big)
+    dmin = jnp.min(masked, axis=1, keepdims=True)
+    amin = jnp.argmin(masked, axis=1).astype(jnp.int32)[:, None] + j * t
+
+    @pl.when(j == 0)
+    def _init():
+        dmin_ref[:] = dmin
+        pidx_ref[:] = jnp.where(dmin < big, amin, -1)
+
+    @pl.when(j > 0)
+    def _acc():
+        better = dmin < dmin_ref[:]
+        pidx_ref[:] = jnp.where(
+            better & (dmin < big), amin, pidx_ref[:]
+        )
+        dmin_ref[:] = jnp.minimum(dmin_ref[:], dmin)
+
+
+def _pallas_counts(bits, n: int, radius: float, tile: int):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    npad = bits.shape[0]
+    grid = (npad // tile, npad // tile)
+    return pl.pallas_call(
+        _counts_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile, FP_BITS), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile, FP_BITS), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((npad, 1), jnp.int32),
+    )(
+        jnp.asarray([n], jnp.int32),
+        jnp.asarray([radius], jnp.float32),
+        bits,
+        bits,
+    )[:, 0]
+
+
+def _pallas_parent(bits, rho, n: int, tile: int):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    npad = bits.shape[0]
+    grid = (npad // tile, npad // tile)
+    rho_col = rho.astype(jnp.float32)[:, None]
+    dmin, pidx = pl.pallas_call(
+        _parent_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile, FP_BITS), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile, FP_BITS), lambda i, j: (j, 0)),
+            pl.BlockSpec((tile, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i, j: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((npad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((npad, 1), jnp.int32),
+        ],
+    )(jnp.asarray([n], jnp.int32), bits, bits, rho_col, rho_col)
+    return dmin[:, 0], pidx[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback (CPU meshes, tests) — same tile math via lax.map
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _xla_counts(bits, n, radius, tile: int):
+    npad = bits.shape[0]
+    pop = jnp.sum(bits.astype(jnp.float32), axis=1)
+    col_valid = jnp.arange(npad) < n
+
+    def one_tile(i):
+        a = jax.lax.dynamic_slice(bits, (i * tile, 0), (tile, FP_BITS))
+        pa = jax.lax.dynamic_slice(pop, (i * tile,), (tile,))
+        dot = a.astype(jnp.float32) @ bits.astype(jnp.float32).T
+        dist = pa[:, None] + pop[None, :] - 2.0 * dot
+        near = (dist <= radius) & col_valid[None, :]
+        return jnp.sum(near.astype(jnp.int32), axis=1)
+
+    return jax.lax.map(one_tile, jnp.arange(npad // tile)).reshape(-1)
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _xla_parent(bits, rho, n, tile: int):
+    npad = bits.shape[0]
+    pop = jnp.sum(bits.astype(jnp.float32), axis=1)
+    col = jnp.arange(npad)
+    big = jnp.float32(3.0e38)
+
+    def one_tile(i):
+        a = jax.lax.dynamic_slice(bits, (i * tile, 0), (tile, FP_BITS))
+        pa = jax.lax.dynamic_slice(pop, (i * tile,), (tile,))
+        rho_a = jax.lax.dynamic_slice(rho, (i * tile,), (tile,))
+        row = i * tile + jnp.arange(tile)
+        dot = a.astype(jnp.float32) @ bits.astype(jnp.float32).T
+        dist = pa[:, None] + pop[None, :] - 2.0 * dot
+        denser = (rho[None, :] > rho_a[:, None]) | (
+            (rho[None, :] == rho_a[:, None]) & (col[None, :] < row[:, None])
+        )
+        ok = denser & (col[None, :] < n) & (col[None, :] != row[:, None])
+        masked = jnp.where(ok, dist, big)
+        dmin = jnp.min(masked, axis=1)
+        pidx = jnp.where(dmin < big, jnp.argmin(masked, axis=1), -1)
+        return dmin, pidx.astype(jnp.int32)
+
+    dmin, pidx = jax.lax.map(one_tile, jnp.arange(npad // tile))
+    return dmin.reshape(-1), pidx.reshape(-1)
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Public API
+
+
+def neighbor_counts(
+    packed: np.ndarray, radius: float, tile: int = _TILE
+) -> np.ndarray:
+    """rho[i] = #{j : hamming(i, j) ≤ radius} (includes i itself)."""
+    n = packed.shape[0]
+    if n == 0:
+        return np.zeros(0, dtype=np.int32)
+    tile = min(tile, max(8, 1 << (n - 1).bit_length()))
+    bits = _pad_rows(unpack_bits_jnp(jnp.asarray(packed)), tile)
+    if _use_pallas():
+        rho = _pallas_counts(bits, n, float(radius), tile)
+    else:
+        rho = _xla_counts(bits, jnp.int32(n), jnp.float32(radius), tile)
+    return np.asarray(rho[:n])
+
+
+def nearest_denser(
+    packed: np.ndarray, rho: np.ndarray, tile: int = _TILE
+) -> tuple[np.ndarray, np.ndarray]:
+    """(delta, parent): distance/index of nearest strictly-denser row.
+
+    The unique global density maximum gets parent −1 and delta +inf-ish.
+    """
+    n = packed.shape[0]
+    if n == 0:
+        return np.zeros(0, np.float32), np.zeros(0, np.int32)
+    tile = min(tile, max(8, 1 << (n - 1).bit_length()))
+    bits = _pad_rows(unpack_bits_jnp(jnp.asarray(packed)), tile)
+    rho_j = jnp.pad(jnp.asarray(rho, jnp.float32), (0, bits.shape[0] - n),
+                    constant_values=-1.0)
+    if _use_pallas():
+        dmin, pidx = _pallas_parent(bits, rho_j, n, tile)
+    else:
+        dmin, pidx = _xla_parent(bits, rho_j, jnp.int32(n), tile)
+    return np.asarray(dmin[:n]), np.asarray(pidx[:n])
+
+
+def density_cluster(
+    packed: np.ndarray, radius: float, tile: int = _TILE
+) -> tuple[np.ndarray, np.ndarray]:
+    """Full density-peaks clustering → (labels [N] int32, rho [N] int32).
+
+    Rows whose nearest-denser neighbor is farther than ``radius`` seed
+    clusters; every other row joins its parent's cluster. Two device
+    passes (O(N²) compute, O(N) memory) + one O(N) host pass.
+    """
+    n = packed.shape[0]
+    if n == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    rho = neighbor_counts(packed, radius, tile)
+    delta, parent = nearest_denser(packed, rho, tile)
+    labels = np.full(n, -1, dtype=np.int32)
+    order = np.argsort(-rho, kind="stable")  # densest first
+    next_label = 0
+    for i in order:
+        if parent[i] < 0 or delta[i] > radius:
+            labels[i] = next_label
+            next_label += 1
+        else:
+            # parents are strictly denser or equal-rho-lower-index, so the
+            # densest-first stable order always labels them before i
+            assert labels[parent[i]] >= 0, "parent labeled after child"
+            labels[i] = labels[parent[i]]
+    return labels, rho
+
+
+def pairwise_hamming(packed_a: np.ndarray, packed_b: np.ndarray) -> np.ndarray:
+    """Small-N explicit distance matrix (diagnostics / tests only)."""
+    a = np.unpackbits(packed_a.view(np.uint8), axis=1, bitorder="little")
+    b = np.unpackbits(packed_b.view(np.uint8), axis=1, bitorder="little")
+    return (
+        a.sum(1)[:, None] + b.sum(1)[None, :] - 2 * (a.astype(np.int32) @ b.T)
+    ).astype(np.int32)

@@ -1,0 +1,19 @@
+"""TLS fingerprinting stack: wire codec, JARM/JA3S, used by the jarm module.
+
+See swarm_tpu/tls/jarm.py for the fingerprint construction and
+swarm_tpu/ops/cluster.py for the device-side clustering of the results.
+"""
+
+from swarm_tpu.tls.jarm import (  # noqa: F401
+    TlsFingerprint,
+    fingerprint_from_banners,
+    ja3s,
+    jarm_hash,
+    probe_set,
+)
+from swarm_tpu.tls.wire import (  # noqa: F401
+    HelloSpec,
+    ServerHello,
+    build_client_hello,
+    parse_server_flight,
+)

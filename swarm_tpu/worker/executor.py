@@ -353,3 +353,73 @@ class ProbeExecutor:
                         )
                         sent[ri] = second.name
         return rows, sent
+
+    # ------------------------------------------------------------------
+    def run_jarm(self, target_lines: Sequence[str]):
+        """Active TLS fingerprinting: 10 JARM ClientHellos per target.
+
+        → list[TlsFingerprint], one per (target, port), default port
+        443. Every input line is accounted for (dead/malformed targets
+        yield alive=False rows), matching the chunk contract of the
+        other probe paths.
+        """
+        from swarm_tpu.tls import jarm as jarm_mod
+        from swarm_tpu.tls import wire as tls_wire
+        from swarm_tpu.tls.jarm import EMPTY_JARM, TlsFingerprint
+
+        parsed, malformed = self._parse_lines(target_lines)
+        addr_of = self._resolve_names(parsed)
+        targets: list[tuple[str, str, int]] = []
+        dead: list[tuple[str, int]] = []
+        for host, explicit_port, _path in parsed:
+            ip = host if is_ip(host) else next(iter(addr_of.get(host) or []), None)
+            port = explicit_port or 443
+            if ip is None:
+                dead.append((host, port))
+            else:
+                targets.append((host, ip, port))
+
+        fps: list = []
+        if targets:
+            ips, ports, payloads = [], [], []
+            for host, ip, port in targets:
+                sni = "" if is_ip(host) else host
+                for spec in jarm_mod.probe_set(sni):
+                    ips.append(ip)
+                    ports.append(port)
+                    payloads.append(tls_wire.build_client_hello(spec))
+            result = scanio.tcp_scan(
+                ips,
+                np.asarray(ports, dtype=np.uint16),
+                payloads,
+                max_concurrency=int(self.spec["concurrency"]),
+                connect_timeout_ms=int(self.spec["connect_timeout_ms"]),
+                read_timeout_ms=int(self.spec["read_timeout_ms"]),
+                banner_cap=max(8192, int(self.spec["banner_cap"])),
+            )
+            np_count = jarm_mod.NUM_PROBES
+            for t, (host, _ip, port) in enumerate(targets):
+                statuses = [
+                    int(result.status[t * np_count + k]) for k in range(np_count)
+                ]
+                banners = [
+                    result.banner(t * np_count + k)
+                    if statuses[k] == scanio.STATUS_OPEN
+                    else b""
+                    for k in range(np_count)
+                ]
+                fps.append(
+                    jarm_mod.fingerprint_from_banners(
+                        host, port, banners,
+                        open_=scanio.STATUS_OPEN in statuses,
+                    )
+                )
+        fps.extend(
+            TlsFingerprint(host=h, port=p, jarm=EMPTY_JARM, ja3s="", alive=False)
+            for h, p in dead
+        )
+        fps.extend(
+            TlsFingerprint(host=m, port=0, jarm=EMPTY_JARM, ja3s="", alive=False)
+            for m in malformed
+        )
+        return fps

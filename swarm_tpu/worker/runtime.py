@@ -141,6 +141,8 @@ class JobProcessor:
                 output = self._execute_probe(module, data)
             elif module.backend == "service":
                 output = self._execute_service(module, data)
+            elif module.backend == "jarm":
+                output = self._execute_jarm(module, data)
             else:
                 output = self._execute_command(module, scan_id, chunk_index, data)
         except Exception as e:
@@ -161,6 +163,40 @@ class JobProcessor:
             self.jobs_done += 1
         else:
             update(JobStatus.UPLOAD_FAILED_UNKNOWN)
+
+    # ------------------------------------------------------------------
+    def _execute_jarm(self, module: ModuleSpec, data: bytes) -> bytes:
+        """Active TLS fingerprinting (JARM + JA3S) with device-side
+        density-peaks clustering of the resulting fingerprints
+        (BASELINE.json config #5). Output: one line per target with its
+        fingerprint, cluster label, and cluster size."""
+        from swarm_tpu.ops import cluster as cl
+        from swarm_tpu.worker.executor import ProbeExecutor
+
+        fps = ProbeExecutor(module.probe).run_jarm(
+            data.decode("utf-8", "surrogateescape").splitlines()
+        )
+        alive = [fp for fp in fps if fp.alive]
+        lab: list[int] = []
+        sizes: dict[int, int] = {}
+        if alive:
+            radius = float(module.raw.get("cluster_radius", 32.0))
+            packed = cl.pack_strings([fp.jarm for fp in alive])
+            labels, _rho = cl.density_cluster(packed, radius)
+            lab = [int(x) for x in labels]
+            for label in lab:
+                sizes[label] = sizes.get(label, 0) + 1
+        lines = []
+        alive_iter = iter(lab)
+        for fp in fps:
+            if fp.alive:
+                label = next(alive_iter)
+                lines.append(
+                    f"{fp.line()} cluster={label} cluster_size={sizes[label]}"
+                )
+            else:
+                lines.append(fp.line())
+        return ("\n".join(lines) + "\n").encode() if lines else b""
 
     # ------------------------------------------------------------------
     def _execute_command(

@@ -1,0 +1,340 @@
+"""TLS fingerprinting stack: wire codec, JARM/JA3S, clustering kernels.
+
+Covers the capability layer that replaces external TLS tooling (the
+reference has none — SURVEY.md §2.2) and serves BASELINE.json config #5:
+ClientHello construction accepted by a real OpenSSL endpoint, ServerHello
+parsing, fingerprint stability, and the density-peaks clustering kernels
+against a numpy oracle.
+"""
+
+import hashlib
+import socket
+import ssl
+import struct
+import subprocess
+import threading
+
+import numpy as np
+import pytest
+
+from swarm_tpu.ops import cluster as cl
+from swarm_tpu.tls import jarm, wire
+
+
+# ---------------------------------------------------------------------------
+# wire: ClientHello structure
+
+
+def test_client_hello_record_structure():
+    spec = wire.HelloSpec(ciphers=jarm.CIPHERS_12, hostname="example.com")
+    raw = wire.build_client_hello(spec, random=bytes(32))
+    assert raw[0] == wire.HANDSHAKE
+    assert struct.unpack("!H", raw[1:3])[0] == wire.TLS12
+    rlen = struct.unpack("!H", raw[3:5])[0]
+    assert len(raw) == 5 + rlen
+    assert raw[5] == wire.HELLO_CLIENT
+    hlen = struct.unpack("!I", b"\x00" + raw[6:9])[0]
+    assert rlen == hlen + 4
+
+
+def test_client_hello_deterministic_given_random():
+    spec = wire.HelloSpec(ciphers=jarm.CIPHERS_12, hostname="a.test")
+    assert wire.build_client_hello(spec, bytes(32)) == wire.build_client_hello(
+        spec, bytes(32)
+    )
+
+
+def test_probe_set_shapes():
+    probes = jarm.probe_set("t.example")
+    assert len(probes) == jarm.NUM_PROBES
+    blobs = {wire.build_client_hello(p, bytes(32)) for p in probes}
+    assert len(blobs) == jarm.NUM_PROBES  # all ten probes are distinct
+    assert any(p.offer_tls13 for p in probes)
+    assert any(p.hello_version == wire.TLS11 for p in probes)
+
+
+def test_middle_out_is_permutation():
+    c = jarm.CIPHERS_12
+    assert sorted(jarm._middle_out(c)) == sorted(c)
+    odd = c[:5]
+    assert sorted(jarm._middle_out(odd)) == sorted(odd)
+
+
+# ---------------------------------------------------------------------------
+# wire: ServerHello parse
+
+
+def synth_server_hello(
+    cipher=0xC02F,
+    legacy=wire.TLS12,
+    exts=((wire.EXT_RENEG, b"\x00"), (wire.EXT_EMS, b"")),
+    alpn=b"h2",
+    supported_version=None,
+):
+    ext_list = list(exts)
+    if alpn:
+        ext_list.append((wire.EXT_ALPN, struct.pack("!HB", len(alpn) + 1, len(alpn)) + alpn))
+    if supported_version:
+        ext_list.append((wire.EXT_SUPPORTED_VERSIONS, struct.pack("!H", supported_version)))
+    blob = b"".join(
+        struct.pack("!HH", t, len(d)) + d for t, d in ext_list
+    )
+    body = (
+        struct.pack("!H", legacy)
+        + bytes(32)
+        + b"\x00"  # empty session id
+        + struct.pack("!H", cipher)
+        + b"\x00"
+        + struct.pack("!H", len(blob))
+        + blob
+    )
+    hs = bytes([wire.HELLO_SERVER]) + struct.pack("!I", len(body))[1:] + body
+    return bytes([wire.HANDSHAKE]) + struct.pack("!HH", legacy, len(hs)) + hs
+
+
+def test_parse_server_hello_fields():
+    raw = synth_server_hello(cipher=0x1301, supported_version=wire.TLS13)
+    h = wire.parse_server_flight(raw)
+    assert h.ok and h.cipher == 0x1301
+    assert h.version == wire.TLS13 and h.legacy_version == wire.TLS12
+    assert h.alpn == b"h2"
+    assert wire.EXT_ALPN in h.extensions
+
+
+def test_parse_fragmented_and_trailing():
+    raw = synth_server_hello()
+    # split the handshake across two records
+    hs = raw[5:]
+    r1 = bytes([wire.HANDSHAKE]) + struct.pack("!HH", wire.TLS12, 7) + hs[:7]
+    r2 = bytes([wire.HANDSHAKE]) + struct.pack("!HH", wire.TLS12, len(hs) - 7) + hs[7:]
+    h = wire.parse_server_flight(r1 + r2 + b"garbage-after")
+    assert h.ok and h.cipher == 0xC02F
+
+
+def test_parse_alert_and_junk():
+    alert = bytes([wire.ALERT]) + struct.pack("!HH", wire.TLS12, 2) + b"\x02\x28"
+    h = wire.parse_server_flight(alert)
+    assert not h.ok and h.alert == 0x28
+    assert not wire.parse_server_flight(b"HTTP/1.1 400 Bad Request\r\n\r\n").ok
+    assert not wire.parse_server_flight(b"").ok
+    assert not wire.parse_server_flight(b"\x16\x03\x03").ok  # truncated header
+
+
+# ---------------------------------------------------------------------------
+# jarm hash / ja3s
+
+
+def test_jarm_hash_shape_and_determinism():
+    hellos = [wire.parse_server_flight(synth_server_hello())] * jarm.NUM_PROBES
+    h1 = jarm.jarm_hash(hellos)
+    assert len(h1) == 62 and h1 == jarm.jarm_hash(hellos)
+    assert h1 != jarm.EMPTY_JARM
+    # a different server choice must move the fingerprint
+    other = [wire.parse_server_flight(synth_server_hello(cipher=0x009C))] * jarm.NUM_PROBES
+    assert jarm.jarm_hash(other) != h1
+
+
+def test_jarm_hash_all_dead():
+    assert jarm.jarm_hash([wire.NO_HELLO] * jarm.NUM_PROBES) == jarm.EMPTY_JARM
+    assert len(jarm.EMPTY_JARM) == 62
+
+
+def test_ja3s_standard_algorithm():
+    h = wire.parse_server_flight(synth_server_hello(alpn=b""))
+    expected = hashlib.md5(
+        (
+            f"{wire.TLS12},{0xC02F},"
+            + "-".join(str(e) for e in (wire.EXT_RENEG, wire.EXT_EMS))
+        ).encode()
+    ).hexdigest()
+    assert jarm.ja3s(h) == expected
+    assert jarm.ja3s(wire.NO_HELLO) == ""
+
+
+def test_fingerprint_from_banners_partial():
+    ok = synth_server_hello()
+    banners = [ok if i % 2 == 0 else b"" for i in range(jarm.NUM_PROBES)]
+    fp = jarm.fingerprint_from_banners("h", 443, banners)
+    assert fp.alive and fp.ja3s
+    assert "000" in fp.jarm  # dead probes encode as 000
+
+
+# ---------------------------------------------------------------------------
+# clustering kernels vs numpy oracle (XLA fallback path on the CPU mesh)
+
+
+def _synth_packed(n=300, groups=3, seed=7):
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 2**32, (groups, cl.FP_WORDS), dtype=np.uint32)
+    rows, truth = [], []
+    for i in range(n):
+        r = base[i % groups].copy()
+        for _ in range(rng.integers(0, 4)):
+            w = rng.integers(0, cl.FP_WORDS)
+            b = rng.integers(0, 32)
+            r[w] ^= np.uint32(1) << np.uint32(b)
+        rows.append(r)
+        truth.append(i % groups)
+    return np.stack(rows), np.asarray(truth)
+
+
+def test_neighbor_counts_exact():
+    packed, _ = _synth_packed()
+    D = cl.pairwise_hamming(packed, packed)
+    for radius in (0.0, 8.0, 64.0):
+        rho = cl.neighbor_counts(packed, radius)
+        assert np.array_equal(rho, (D <= radius).sum(1).astype(np.int32))
+
+
+def test_nearest_denser_valid_parents():
+    packed, _ = _synth_packed()
+    n = packed.shape[0]
+    D = cl.pairwise_hamming(packed, packed)
+    rho = cl.neighbor_counts(packed, 8.0)
+    delta, parent = cl.nearest_denser(packed, rho)
+    idx = np.arange(n)
+    ok = (rho[None, :] > rho[:, None]) | (
+        (rho[None, :] == rho[:, None]) & (idx[None, :] < idx[:, None])
+    )
+    np.fill_diagonal(ok, False)
+    masked = np.where(ok, D.astype(np.float32), 3.0e38)
+    dmin = masked.min(1)
+    roots = 0
+    for i in range(n):
+        if parent[i] < 0:
+            roots += 1
+            continue
+        # any tie at the minimum distance is a valid parent
+        assert ok[i, parent[i]] and D[i, parent[i]] == dmin[i]
+        assert delta[i] == dmin[i]
+    assert roots == 1  # exactly one global density peak
+
+
+def test_density_cluster_recovers_groups():
+    packed, truth = _synth_packed()
+    labels, rho = cl.density_cluster(packed, radius=8.0)
+    assert labels.shape == truth.shape and (labels >= 0).all()
+    assert len(set(labels.tolist())) == 3
+    # perfect purity: every cluster maps to one latent group
+    for label in set(labels.tolist()):
+        assert len(set(truth[labels == label].tolist())) == 1
+
+
+def test_cluster_empty_and_single():
+    labels, rho = cl.density_cluster(np.zeros((0, cl.FP_WORDS), np.uint32), 8.0)
+    assert labels.shape == (0,)
+    one = np.ones((1, cl.FP_WORDS), np.uint32)
+    labels, rho = cl.density_cluster(one, 8.0)
+    assert labels.tolist() == [0] and rho.tolist() == [1]
+
+
+def test_pack_strings_hamming_bounds():
+    packed = cl.pack_strings(["abc", "abd", "xyz"])
+    D = cl.pairwise_hamming(packed, packed)
+    assert D[0, 0] == 0
+    assert 1 <= D[0, 1] <= 8  # one differing char → ≤ 8 bits
+    assert D[0, 2] > D[0, 1]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end against a real OpenSSL-backed TLS endpoint
+
+
+@pytest.fixture(scope="module")
+def tls_server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tls")
+    key, crt = tmp / "key.pem", tmp / "crt.pem"
+    gen = subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(crt), "-days", "1",
+            "-subj", "/CN=localhost",
+        ],
+        capture_output=True,
+    )
+    if gen.returncode != 0:
+        pytest.skip(f"openssl unavailable: {gen.stderr.decode()[:200]}")
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(str(crt), str(key))
+    sock = socket.socket()
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(64)
+    port = sock.getsockname()[1]
+    stop = threading.Event()
+
+    def handshake(conn):
+        # the probe abandons the handshake after the server's first
+        # flight, so wrap_socket fails/time-outs by design
+        try:
+            conn.settimeout(5)
+            tls = ctx.wrap_socket(conn, server_side=True)
+            tls.close()
+        except (ssl.SSLError, OSError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def serve():
+        while not stop.is_set():
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=handshake, args=(conn,), daemon=True).start()
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    yield port
+    stop.set()
+    sock.close()
+
+
+def test_jarm_against_real_openssl(tls_server):
+    from swarm_tpu.worker.executor import ProbeExecutor
+
+    executor = ProbeExecutor({"read_timeout_ms": 4000})
+    fps = executor.run_jarm([f"127.0.0.1:{tls_server}", "nope..invalid.."])
+    by_host = {fp.host: fp for fp in fps}
+    fp = by_host["127.0.0.1"]
+    assert fp.alive, "real TLS server did not yield a fingerprint"
+    assert fp.jarm != jarm.EMPTY_JARM and len(fp.jarm) == 62
+    assert fp.ja3s  # at least one ServerHello parsed
+    # stability: probing again reproduces the fingerprint
+    fps2 = executor.run_jarm([f"127.0.0.1:{tls_server}"])
+    assert fps2[0].jarm == fp.jarm
+
+
+def test_jarm_module_end_to_end(tls_server, tmp_path):
+    """Full module path: registry → executor → clustering → output."""
+    from swarm_tpu.worker.modules import ModuleRegistry
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    # a plain-TCP listener: open port, but nothing TLS behind it
+    plain = socket.socket()
+    plain.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    plain.bind(("127.0.0.1", 0))
+    plain.listen(64)
+    plain_port = plain.getsockname()[1]
+    try:
+        reg_dir = tmp_path / "modules"
+        reg_dir.mkdir()
+        (reg_dir / "jarm.json").write_text(
+            '{"backend": "jarm", "probe": {"read_timeout_ms": 4000}}'
+        )
+        proc = JobProcessor.__new__(JobProcessor)
+        proc.registry = ModuleRegistry(str(reg_dir))
+        module = proc.registry.load("jarm")
+        targets = (
+            f"127.0.0.1:{tls_server}\n127.0.0.1:1\n127.0.0.1:{plain_port}\n"
+        ).encode()
+        out = proc._execute_jarm(module, targets).decode()
+        lines = out.strip().split("\n")
+        assert len(lines) == 3
+        assert "jarm=" in lines[0] and "cluster=0" in lines[0]
+        assert "cluster_size=1" in lines[0]
+        assert "[dead]" in lines[1]  # connection refused
+        assert "[open not-tls]" in lines[2]  # open port, no TLS behind it
+    finally:
+        plain.close()
