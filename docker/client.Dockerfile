@@ -1,0 +1,10 @@
+# Client CLI image (reference parity: client/Dockerfile — ENTRYPOINT CLI).
+#   docker build -f docker/client.Dockerfile -t swarm-tpu-client .
+#   docker run swarm-tpu-client --server-url http://c2:5001 --api-key k scans
+FROM python:3.11-slim
+
+WORKDIR /app
+COPY swarm_tpu /app/swarm_tpu
+RUN pip install --no-cache-dir requests
+
+ENTRYPOINT ["python", "-m", "swarm_tpu.client"]

@@ -1,0 +1,16 @@
+# C2 server image (reference parity: server/Dockerfile — python-slim,
+# port 5001). Build from the repo root:
+#   docker build -f docker/server.Dockerfile -t swarm-tpu-server .
+FROM python:3.11-slim
+
+WORKDIR /app
+COPY swarm_tpu /app/swarm_tpu
+RUN pip install --no-cache-dir requests
+
+# Embedded file-backed stores by default; point at Redis/S3/Mongo with
+# SWARM_* env vars for the external-services deployment.
+ENV SWARM_BLOB_ROOT=/data/blobs SWARM_DOC_ROOT=/data/docs
+RUN mkdir -p /data/blobs /data/docs
+
+EXPOSE 5001
+CMD ["python", "-m", "swarm_tpu.server", "--port", "5001"]

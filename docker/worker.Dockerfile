@@ -1,0 +1,24 @@
+# Worker image (reference parity: worker/Dockerfile — bundles modules +
+# fingerprint data; env-var driven CMD). The native scan I/O engine is
+# built at image build time; JAX ships CPU-only here — TPU hosts mount
+# their platform jaxlib instead.
+#   docker build -f docker/worker.Dockerfile -t swarm-tpu-worker .
+FROM python:3.11-slim
+
+WORKDIR /app
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+
+COPY native /app/native
+RUN make -C /app/native
+
+COPY swarm_tpu /app/swarm_tpu
+COPY modules /app/modules
+RUN pip install --no-cache-dir requests pyyaml numpy jax
+
+RUN mkdir -p /app/downloads
+
+# Reference CMD shape (worker/Dockerfile:20-21): config via env vars.
+CMD ["sh", "-c", "python -m swarm_tpu.worker \
+  --server-url $SERVER_URL --api-key $API_KEY --worker-id $WORKER_ID \
+  --modules-dir /app/modules"]

@@ -159,7 +159,8 @@ def render_jobs(statuses: dict) -> str:
 
 def render_scans(statuses: dict) -> str:
     table = Table(
-        ["Scan ID", "Chunks", "Complete", "%", "Workers", "Module", "Started", "Completed", "ECT"]
+        ["Scan ID", "Chunks", "Complete", "%", "Workers", "Module", "Started",
+         "Completed", "ECT", "Rows/s"]
     )
     for s in statuses.get("scans", []):
         ect = estimate_completion_time(
@@ -169,7 +170,8 @@ def render_scans(statuses: dict) -> str:
         table.add_row(
             [s.get("scan_id"), s.get("total_chunks"), s.get("chunks_complete"),
              s.get("percent_complete"), len(s.get("workers") or []), s.get("module"),
-             _fmt_ts(s.get("scan_started")), _fmt_ts(s.get("completed_at")), ect or ""]
+             _fmt_ts(s.get("scan_started")), _fmt_ts(s.get("completed_at")),
+             ect or "", s.get("rows_per_second") or ""]
         )
     return str(table)
 

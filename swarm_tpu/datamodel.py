@@ -149,6 +149,11 @@ class Job:
     completed_at: Optional[float] = None
     lease_expires_at: Optional[float] = None
     attempts: int = 0
+    # per-job performance sample reported by the worker on completion
+    # (download/execute/upload seconds, device rows + seconds — SURVEY.md
+    # §5 "tracing": timing exported through the same status API fields).
+    # Extra key to the reference client, which ignores unknown fields.
+    perf: Optional[dict] = None
 
     @classmethod
     def create(cls, scan_id: str, chunk_index: int, module: str) -> "Job":
@@ -213,6 +218,11 @@ class ScanSummary:
     scan_time: Optional[float] = None
     scan_status: Optional[str] = None
     average_scan_time: Optional[float] = None
+    # aggregated worker perf samples (None until a job reports perf)
+    rows_processed: Optional[int] = None
+    device_seconds: Optional[float] = None
+    execute_seconds: Optional[float] = None
+    rows_per_second: Optional[float] = None
 
     def to_wire(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -245,10 +255,25 @@ def rollup_scans(jobs: dict[str, dict]) -> list[dict]:
             summary.completed_at is None or completed > summary.completed_at
         ):
             summary.completed_at = completed
+        perf = job.get("perf")
+        if isinstance(perf, dict):
+            summary.rows_processed = (summary.rows_processed or 0) + int(
+                perf.get("rows", 0)
+            )
+            summary.device_seconds = (summary.device_seconds or 0.0) + float(
+                perf.get("device_s", 0.0)
+            )
+            summary.execute_seconds = (summary.execute_seconds or 0.0) + float(
+                perf.get("execute_s", 0.0)
+            )
     for summary in scans.values():
         summary.percent_complete = round(
             summary.chunks_complete / summary.total_chunks * 100, 2
         )
         if summary.percent_complete == 100:
             summary.scan_status = "complete"
+        if summary.rows_processed and summary.execute_seconds:
+            summary.rows_per_second = round(
+                summary.rows_processed / summary.execute_seconds, 2
+            )
     return [s.to_wire() for s in scans.values()]

@@ -64,6 +64,9 @@ class Extractor:
     name: Optional[str] = None
     regex: list[str] = dataclasses.field(default_factory=list)
     kval: list[str] = dataclasses.field(default_factory=list)
+    json: list[str] = dataclasses.field(default_factory=list)  # jq-style paths
+    xpath: list[str] = dataclasses.field(default_factory=list)
+    attribute: Optional[str] = None  # xpath: extract this attr, else text
     group: int = 0
     internal: bool = False
 

@@ -68,6 +68,9 @@ def _parse_extractor(raw: dict) -> Extractor:
         name=raw.get("name"),
         regex=[str(r) for r in _as_list(raw.get("regex"))],
         kval=[str(k) for k in _as_list(raw.get("kval"))],
+        json=[str(j) for j in _as_list(raw.get("json"))],
+        xpath=[str(x) for x in _as_list(raw.get("xpath"))],
+        attribute=raw.get("attribute"),
         group=int(raw.get("group", 0)),
         internal=bool(raw.get("internal", False)),
     )

@@ -53,13 +53,11 @@ _compile_cached = dslc.compile_cached
 
 
 def _parse_headers(header_blob: bytes) -> dict[str, str]:
-    headers: dict[str, str] = {}
-    for line in header_blob.split(b"\r\n"):
-        if b":" in line:
-            k, _, v = line.partition(b":")
-            key = k.strip().decode("latin-1").lower().replace("-", "_")
-            headers[key] = v.strip().decode("latin-1")
-    return headers
+    # single implementation shared with the kval extractor so matcher
+    # and extractor normalization can never diverge
+    from swarm_tpu.fingerprints import extractors
+
+    return extractors.parse_header_blob(header_blob)
 
 
 def match_matcher(matcher: Matcher, response: Response) -> Optional[bool]:
@@ -108,7 +106,10 @@ def match_matcher(matcher: Matcher, response: Response) -> Optional[bool]:
     elif matcher.type == "kval":
         headers = _parse_headers(response.part("header"))
         results = [k.lower().replace("-", "_") in headers for k in matcher.kval]
-    else:  # json / xpath — host-tool territory, not implemented yet
+    else:
+        # json/xpath appear only as *extractors* in the corpus (measured
+        # §2.3: matchers are word/regex/status/size/binary/dsl/kval);
+        # a matcher of an unknown type degrades to "unsupported"
         return None
 
     if not results:
@@ -121,9 +122,12 @@ def match_matcher(matcher: Matcher, response: Response) -> Optional[bool]:
 
 
 def _extract(op: Operation, response: Response) -> list[str]:
+    from swarm_tpu.fingerprints import extractors as ext
+
     out: list[str] = []
     for ex in op.extractors:
         if ex.type != "regex":
+            out.extend(ext.extract_structured(ex, response))
             continue
         text = _decode(response.part(ex.part))
         for pattern in ex.regex:

@@ -64,11 +64,15 @@ class MatchEngine:
         self.batch_rows = batch_rows
         self.host_always_mode = host_always
         self.stats = EngineStats()
-        # templates with regex extractors need a host pass on *hits* even
-        # when the verdict itself was device-certain, so extraction output
+        # templates with extractors need a host pass on *hits* even when
+        # the verdict itself was device-certain, so extraction output
         # stays bit-identical to the oracle
         self._has_extractors = [
-            any(ex.type == "regex" for op in t.operations for ex in op.extractors)
+            any(
+                ex.type in ("regex", "kval", "json", "xpath")
+                for op in t.operations
+                for ex in op.extractors
+            )
             for t in self.db.templates
         ]
 

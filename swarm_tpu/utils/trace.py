@@ -1,0 +1,70 @@
+"""Tracing and profiling — the observability the reference never had.
+
+The reference's only observability is ``print()`` plus job timestamps
+(SURVEY.md §5 "Tracing/profiling: None"). Here:
+
+- :class:`PhaseTimer` — wall-clock per pipeline phase
+  (download/execute/upload), reported to the server inside the job's
+  ``perf`` field on completion and aggregated into the per-scan rollup
+  (``rows_per_second`` etc. in ``/get-statuses``).
+- :func:`maybe_device_profile` — wraps a block in a JAX profiler trace
+  (TensorBoard-loadable) when ``SWARM_PROFILE_DIR`` is set; free when
+  it is not. Device-level visibility into the match kernels without any
+  code change at the call sites.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator, Optional
+
+PROFILE_ENV = "SWARM_PROFILE_DIR"
+
+
+class PhaseTimer:
+    """Accumulates named wall-clock phases → a flat perf dict."""
+
+    def __init__(self) -> None:
+        self.seconds: dict[str, float] = {}
+        self.counters: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] = (
+                self.seconds.get(name, 0.0) + time.perf_counter() - t0
+            )
+
+    def count(self, name: str, value: float) -> None:
+        self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def perf(self) -> dict:
+        out: dict = {f"{k}_s": round(v, 6) for k, v in self.seconds.items()}
+        for k, v in self.counters.items():
+            out[k] = int(v) if float(v).is_integer() else v
+        return out
+
+
+@contextlib.contextmanager
+def maybe_device_profile(tag: str, profile_dir: Optional[str] = None) -> Iterator[bool]:
+    """JAX profiler trace around the block when profiling is enabled.
+
+    ``profile_dir`` defaults to ``$SWARM_PROFILE_DIR``; yields whether a
+    trace was actually recorded. Traces land in
+    ``<dir>/<tag>/plugins/profile/...`` for TensorBoard.
+    """
+    root = profile_dir if profile_dir is not None else os.environ.get(PROFILE_ENV)
+    if not root:
+        yield False
+        return
+    import jax
+
+    target = os.path.join(root, tag)
+    os.makedirs(target, exist_ok=True)
+    with jax.profiler.trace(target):
+        yield True

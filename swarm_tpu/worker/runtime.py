@@ -27,6 +27,7 @@ import requests
 
 from swarm_tpu.config import Config
 from swarm_tpu.datamodel import SCAN_ID_RE, JobStatus
+from swarm_tpu.utils.trace import PhaseTimer, maybe_device_profile
 from swarm_tpu.worker.modules import (
     ModuleRegistry,
     ModuleSpec,
@@ -115,13 +116,16 @@ class JobProcessor:
         if not SCAN_ID_RE.match(str(scan_id)):
             self.client.update_job(job_id, {"status": JobStatus.CMD_FAILED})
             return
-        update = lambda status: self.client.update_job(
-            job_id, {"status": status}, worker_id=self.cfg.worker_id
+        update = lambda status, **extra: self.client.update_job(
+            job_id, {"status": status, **extra}, worker_id=self.cfg.worker_id
         )
+        timer = PhaseTimer()
+        self._engine_stats_mark = None
 
         update(JobStatus.STARTING)
         update(JobStatus.DOWNLOADING)
-        data = self.client.get_input_chunk(scan_id, chunk_index)
+        with timer.phase("download"):
+            data = self.client.get_input_chunk(scan_id, chunk_index)
         if data is None:
             update(JobStatus.CMD_FAILED)
             return
@@ -135,16 +139,19 @@ class JobProcessor:
             return
 
         try:
-            if module.backend == "tpu":
-                output = self._execute_tpu(module, data)
-            elif module.backend == "probe":
-                output = self._execute_probe(module, data)
-            elif module.backend == "service":
-                output = self._execute_service(module, data)
-            elif module.backend == "jarm":
-                output = self._execute_jarm(module, data)
-            else:
-                output = self._execute_command(module, scan_id, chunk_index, data)
+            with timer.phase("execute"), maybe_device_profile(job_id):
+                if module.backend == "tpu":
+                    output = self._execute_tpu(module, data)
+                elif module.backend == "probe":
+                    output = self._execute_probe(module, data)
+                elif module.backend == "service":
+                    output = self._execute_service(module, data)
+                elif module.backend == "jarm":
+                    output = self._execute_jarm(module, data)
+                else:
+                    output = self._execute_command(
+                        module, scan_id, chunk_index, data
+                    )
         except Exception as e:
             print(f"execution failed: {e}")
             update(JobStatus.CMD_FAILED)
@@ -154,15 +161,34 @@ class JobProcessor:
             return
 
         update(JobStatus.UPLOADING)
-        try:
-            ok = self.client.put_output_chunk(scan_id, chunk_index, output)
-        except requests.RequestException:
-            ok = False
+        with timer.phase("upload"):
+            try:
+                ok = self.client.put_output_chunk(scan_id, chunk_index, output)
+            except requests.RequestException:
+                ok = False
         if ok:
-            update(JobStatus.COMPLETE)
+            perf = timer.perf()
+            perf["input_bytes"] = len(data)
+            perf["output_bytes"] = len(output)
+            perf.update(self._engine_perf_delta())
+            update(JobStatus.COMPLETE, perf=perf)
             self.jobs_done += 1
         else:
             update(JobStatus.UPLOAD_FAILED_UNKNOWN)
+
+    def _engine_perf_delta(self) -> dict:
+        """Device-engine stats accumulated during this job (tpu backend
+        caches engines across jobs, so report the delta since job start)."""
+        mark = self._engine_stats_mark
+        if mark is None:
+            return {}
+        engine, rows0, dev0, confirm0 = mark
+        ds = engine.stats
+        return {
+            "rows": ds.rows - rows0,
+            "device_s": round(ds.device_seconds - dev0, 6),
+            "host_confirm_s": round(ds.host_confirm_seconds - confirm0, 6),
+        }
 
     # ------------------------------------------------------------------
     def _execute_jarm(self, module: ModuleSpec, data: bytes) -> bytes:
@@ -238,6 +264,12 @@ class JobProcessor:
         if not module.templates_dir:
             raise ValueError(f"tpu module {module.name} missing 'templates'")
         engine = self._engine_for(module.templates_dir)
+        self._engine_stats_mark = (
+            engine,
+            engine.stats.rows,
+            engine.stats.device_seconds,
+            engine.stats.host_confirm_seconds,
+        )
         text = data.decode("utf-8", "surrogateescape")
         if module.input_format == "targets":
             from swarm_tpu.worker.executor import ProbeExecutor

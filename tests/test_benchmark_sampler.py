@@ -1,0 +1,53 @@
+"""Benchmark sampler parity with reference experimental/benchmark.py."""
+
+from swarm_tpu.benchmark import main, plan, sample_lines
+
+
+def test_plan_reference_math_large():
+    # 100k lines / 10 instances: batch = 10000/1.7, sample = batch/150
+    p = plan(100_000, 10)
+    assert p.batch_size == int(100_000 / 10) / 1.7
+    assert p.batch_size > 1000
+    assert p.sample_size == p.batch_size / 150
+    assert p.magnification == p.batch_size / p.sample_size
+
+
+def test_plan_reference_math_small():
+    p = plan(1000, 10)  # batch ≈ 58.8 → sample = batch/7
+    assert p.batch_size <= 1000
+    assert p.sample_size == p.batch_size / 7
+    assert abs(p.magnification - 7.0) < 1e-9
+
+
+def test_plan_fewer_lines_than_instances():
+    p = plan(3, 10)
+    assert p.instances == 3
+    assert p.batch_size == 1 and p.sample_size == 1
+    assert p.magnification == 1.0
+
+
+def test_sample_deterministic_with_seed():
+    lines = [f"host{i}.example\n" for i in range(1000)]
+    p = plan(len(lines), 10)
+    s1 = sample_lines(lines, p, seed=42)
+    s2 = sample_lines(lines, p, seed=42)
+    assert s1 == s2
+    assert len(s1) == p.lines_to_get
+    assert set(s1) <= set(lines)
+
+
+def test_extrapolation():
+    p = plan(100_000, 10)
+    assert abs(p.extrapolate(10.0) - 10.0 * p.magnification) < 1e-9
+
+
+def test_cli_writes_sample(tmp_path, capsys):
+    inp = tmp_path / "targets.txt"
+    inp.write_text("".join(f"h{i}.example\n" for i in range(500)))
+    out = tmp_path / "sample.txt"
+    main([str(inp), "5", "--out", str(out), "--seed", "1",
+          "--rows-per-second", "1000"])
+    captured = capsys.readouterr().out
+    assert "Magnification factor:" in captured
+    assert "Estimated full-run execute time: 0.50s" in captured
+    assert out.read_text().strip()

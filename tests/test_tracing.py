@@ -1,0 +1,82 @@
+"""Tracing/profiling: phase timers, perf propagation, scan rollup.
+
+The reference has zero observability beyond prints + two timestamps
+(SURVEY.md §5); this framework reports per-job perf samples through the
+same status-update path and aggregates them into the scan rollup.
+"""
+
+import time
+
+from swarm_tpu.datamodel import Job, JobStatus, rollup_scans
+from swarm_tpu.utils.trace import PhaseTimer, maybe_device_profile
+
+
+def test_phase_timer_accumulates():
+    t = PhaseTimer()
+    with t.phase("download"):
+        time.sleep(0.01)
+    with t.phase("download"):
+        pass
+    with t.phase("execute"):
+        pass
+    t.count("rows", 100)
+    t.count("rows", 28)
+    perf = t.perf()
+    assert perf["download_s"] >= 0.01
+    assert "execute_s" in perf
+    assert perf["rows"] == 128
+
+
+def test_device_profile_disabled_is_free(monkeypatch):
+    monkeypatch.delenv("SWARM_PROFILE_DIR", raising=False)
+    with maybe_device_profile("job_x") as active:
+        assert active is False
+
+
+def test_device_profile_writes_trace(tmp_path):
+    import jax.numpy as jnp
+
+    with maybe_device_profile("job_y", profile_dir=str(tmp_path)) as active:
+        assert active is True
+        jnp.ones((8, 8)).sum().block_until_ready()
+    produced = list((tmp_path / "job_y").rglob("*"))
+    assert produced, "profiler produced no files"
+
+
+def test_job_perf_survives_wire_roundtrip():
+    job = Job.create("mod_1700000000", 0, "mod")
+    job.perf = {"execute_s": 1.5, "rows": 10}
+    wire = job.to_wire()
+    back = Job.from_wire(wire)
+    assert back.perf == {"execute_s": 1.5, "rows": 10}
+
+
+def test_rollup_aggregates_perf():
+    jobs = {}
+    for i in range(3):
+        j = Job.create("m_1700000000", i, "m")
+        j.status = JobStatus.COMPLETE
+        j.completed_at = 1700000100.0 + i
+        j.worker_id = "w1"
+        j.perf = {"rows": 1000, "device_s": 0.5, "execute_s": 2.0}
+        jobs[j.job_id] = j.to_wire()
+    # one job without perf (e.g. a reference worker) must not break it
+    j = Job.create("m_1700000000", 3, "m")
+    j.status = JobStatus.COMPLETE
+    jobs[j.job_id] = j.to_wire()
+
+    scans = rollup_scans(jobs)
+    assert len(scans) == 1
+    s = scans[0]
+    assert s["rows_processed"] == 3000
+    assert s["device_seconds"] == 1.5
+    assert s["execute_seconds"] == 6.0
+    assert s["rows_per_second"] == 500.0
+
+
+def test_rollup_no_perf_stays_none():
+    j = Job.create("m_1700000000", 0, "m")
+    j.status = JobStatus.COMPLETE
+    scans = rollup_scans({j.job_id: j.to_wire()})
+    assert scans[0]["rows_processed"] is None
+    assert scans[0]["rows_per_second"] is None
