@@ -43,7 +43,6 @@ class SamplePlan:
 def plan(total_lines: int, instances: int) -> SamplePlan:
     """Reference math (benchmark.py:30-42), including its edge cases."""
     batch_size = int(total_lines / instances) / 1.7 if instances else 0.0
-    sample_size = int(batch_size / 2)
     if total_lines < instances:
         instances = total_lines
         batch_size = 1.0

@@ -89,6 +89,9 @@ class Operation:
     method: Optional[str] = None
     paths: list[str] = dataclasses.field(default_factory=list)
     raw: list[str] = dataclasses.field(default_factory=list)
+    headers: list[tuple[str, str]] = dataclasses.field(default_factory=list)
+    body: str = ""
+    payloads: dict = dataclasses.field(default_factory=dict)  # fuzz lists
     inputs: list[bytes] = dataclasses.field(default_factory=list)  # network send
     hosts: list[str] = dataclasses.field(default_factory=list)
     read_size: Optional[int] = None

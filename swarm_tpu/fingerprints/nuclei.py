@@ -97,6 +97,13 @@ def _parse_operation(raw: dict, protocol: str) -> Operation:
         method=raw.get("method"),
         paths=[str(p) for p in _as_list(raw.get("path"))],
         raw=[str(r) for r in _as_list(raw.get("raw"))],
+        headers=(
+            [(str(k), str(v)) for k, v in raw["headers"].items()]
+            if isinstance(raw.get("headers"), dict)
+            else []
+        ),
+        body=str(raw.get("body") or ""),
+        payloads=raw.get("payloads") or {},
         hosts=[str(h) for h in _as_list(raw.get("host"))],
         redirects=bool(raw.get("redirects", False)),
         max_redirects=int(raw.get("max-redirects", 0)),

@@ -1,0 +1,388 @@
+"""Active template-request scanning — the nuclei execution mode.
+
+The reference's nuclei engine issues each template's *own* HTTP requests
+(custom paths, methods, headers, bodies) and matches responses
+per-request (`worker/modules/nuclei.json` runs it over the full corpus).
+This module is the TPU-shaped equivalent:
+
+1. **Plan** (host, once per corpus): every http operation's requests are
+   compiled and deduplicated into a flat request table — measured on the
+   corpus: 2,816 simple-GET templates collapse onto ~3.2k distinct
+   paths, 559 of them sharing bare ``{{BaseURL}}`` (SURVEY.md §2.3).
+   GET/POST and single-step fully-resolvable ``raw`` requests are
+   supported; payload/fuzzing templates, multi-step raw chains with
+   dynamic values, and redirect-dependent flows are skipped and counted
+   (they need stateful per-target sessions, not batch I/O).
+2. **Probe** (native I/O): the (target × request) fan-out runs in waves
+   through the epoll front-end — the same massive concurrency nuclei
+   gets from its internal scheduler, but as flat batches.
+3. **Match** (device): every response row goes through the one compiled
+   corpus DB in big vmap batches — no per-template dispatch.
+4. **Attribute** (host): a row's hits only count for templates that own
+   the row's request — nuclei's "matchers see their own request's
+   response" semantics; a template fires on a target if any of its
+   requests' rows fired.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import secrets
+from typing import Optional, Sequence
+
+import numpy as np
+
+from swarm_tpu.fingerprints.model import Response, Template
+from swarm_tpu.native import scanio
+from swarm_tpu.worker.executor import (
+    ProbeExecutor,
+    is_ip,
+    parse_http_response,
+)
+
+_PLACEHOLDER_RE = re.compile(r"\{\{([^{}]+)\}\}")
+
+# one deterministic-per-process random token: nuclei uses {{randstr}} to
+# provoke 404s that are distinguishable from real content
+_RANDSTR = "swarm" + secrets.token_hex(8)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlannedRequest:
+    method: str
+    path: str  # begins with '/', placeholders already substituted
+    headers: tuple[tuple[str, str], ...] = ()
+    body: bytes = b""
+
+    def wire(self, host: str, port: int) -> bytes:
+        host_hdr = host if port in (80, 443) else f"{host}:{port}"
+        body = _finalize(self.body.decode("latin-1"), host, port).encode("latin-1")
+        lines = [
+            f"{self.method} {_finalize(self.path, host, port)} HTTP/1.1",
+            f"Host: {host_hdr}",
+        ]
+        has = {k.lower() for k, _ in self.headers}
+        for k, v in self.headers:
+            if k.lower() not in ("host", "connection", "content-length"):
+                lines.append(f"{k}: {_finalize(v, host, port)}")
+        if "user-agent" not in has:
+            lines.append("User-Agent: swarm-tpu/1.0")
+        if body:
+            lines.append(f"Content-Length: {len(body)}")
+        lines.append("Connection: close")
+        raw = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1", "replace")
+        return raw + body
+
+
+@dataclasses.dataclass
+class RequestPlan:
+    requests: list[PlannedRequest]
+    owners: list[set[int]]  # request idx -> template indices
+    skipped: dict[str, list[str]]  # reason -> template ids
+    planned_templates: set[int]  # template indices with ≥1 request
+
+
+def _substitute(text: str, host: str = "", port: int = 80) -> Optional[str]:
+    """Resolve standard nuclei placeholders; None if any remain."""
+
+    def repl(m: re.Match) -> str:
+        name = m.group(1).strip()
+        low = name.lower()
+        if low in ("baseurl", "rooturl"):
+            return "\x00BASE\x00"  # stripped later; plan paths are host-free
+        if low == "hostname":
+            return "\x00HOSTPORT\x00"
+        if low == "host":
+            return "\x00HOST\x00"
+        if low == "port":
+            return str(port)
+        if low == "path":
+            return "/"
+        if low == "scheme":
+            return "http"
+        if low.startswith("randstr") or low.startswith("rand_"):
+            return _RANDSTR
+        return m.group(0)  # unknown → leave; caller rejects
+
+    out = _PLACEHOLDER_RE.sub(repl, text)
+    if _PLACEHOLDER_RE.search(out):
+        return None
+    return out
+
+
+def _finalize(text: str, host: str, port: int) -> str:
+    """Per-target resolution of the plan-time markers. An *interior*
+    BaseURL/RootURL (query params, bodies, headers) becomes the absolute
+    URL; a path's leading BaseURL was already stripped at plan time."""
+    host_hdr = host if port in (80, 443) else f"{host}:{port}"
+    return (
+        text.replace("\x00BASE\x00", f"http://{host_hdr}")
+        .replace("\x00HOSTPORT\x00", host_hdr)
+        .replace("\x00HOST\x00", host)
+    )
+
+
+def _parse_raw(raw: str) -> Optional[PlannedRequest]:
+    """One raw HTTP request text → PlannedRequest (None = unsupported)."""
+    raw = raw.replace("\r\n", "\n").strip("\n")
+    if "\n\n" in raw:
+        head, _, body = raw.partition("\n\n")
+    else:
+        head, body = raw, ""
+    lines = head.split("\n")
+    first = lines[0].split()
+    if len(first) < 2:
+        return None
+    method, path = first[0].upper(), first[1]
+    if not path.startswith("/"):
+        # absolute-URL raw requests target other hosts — out of scope
+        if path.startswith("\x00BASE\x00"):
+            path = path[len("\x00BASE\x00"):] or "/"
+        else:
+            return None
+    headers = []
+    for line in lines[1:]:
+        if ":" not in line:
+            return None
+        k, _, v = line.partition(":")
+        if k.strip().lower() == "host":
+            continue  # rebuilt per target
+        headers.append((k.strip(), v.strip()))
+    return PlannedRequest(
+        method=method,
+        path=path,
+        headers=tuple(headers),
+        body=body.encode("latin-1", "replace"),
+    )
+
+
+def build_plan(templates: Sequence[Template]) -> RequestPlan:
+    """Corpus → deduplicated request table + ownership map."""
+    dedup: dict[PlannedRequest, int] = {}
+    owners: list[set[int]] = []
+    skipped: dict[str, list[str]] = {}
+    planned: set[int] = set()
+
+    def add(req: PlannedRequest, t_idx: int) -> None:
+        idx = dedup.get(req)
+        if idx is None:
+            idx = dedup[req] = len(owners)
+            owners.append(set())
+        owners[idx].add(t_idx)
+        planned.add(t_idx)
+
+    def skip(reason: str, t: Template) -> None:
+        skipped.setdefault(reason, []).append(t.id)
+
+    for t_idx, t in enumerate(templates):
+        if t.protocol != "http":
+            continue  # network/dns handled by their own paths
+        if any(op.payloads for op in t.operations):
+            skip("payloads", t)
+            continue
+        ok = False
+        unsupported: Optional[str] = None
+        for op in t.operations:
+            if op.raw:
+                if len(op.raw) > 1:
+                    unsupported = "multi-step-raw"
+                    continue
+                sub = _substitute(op.raw[0])
+                if sub is None:
+                    unsupported = "dynamic-values"
+                    continue
+                req = _parse_raw(sub)
+                if req is None:
+                    unsupported = "raw-unparseable"
+                    continue
+                add(req, t_idx)
+                ok = True
+                continue
+            method = (op.method or "GET").upper()
+            if method not in ("GET", "POST", "PUT", "HEAD", "OPTIONS"):
+                unsupported = f"method-{method}"
+                continue
+            body = op.body.encode("latin-1", "replace") if op.body else b""
+            for path_t in op.paths:
+                sub = _substitute(path_t)
+                if sub is None:
+                    unsupported = "dynamic-values"
+                    continue
+                # strip only the *leading* BaseURL; interior occurrences
+                # resolve to absolute URLs at wire time
+                if sub.startswith("\x00BASE\x00"):
+                    sub = sub[len("\x00BASE\x00"):]
+                elif sub.startswith(("http://", "https://")):
+                    # token-spray-style templates request third-party API
+                    # hosts, not the scanned target — out of scope here
+                    unsupported = "external-target"
+                    continue
+                path = sub or "/"
+                if not path.startswith("/"):
+                    path = "/" + path
+                headers = []
+                header_ok = True
+                for k, v in op.headers:
+                    hv = _substitute(v)
+                    if hv is None:
+                        header_ok = False  # e.g. "Bearer {{token}}"
+                        break
+                    headers.append((k, hv))
+                if not header_ok:
+                    unsupported = "dynamic-values"
+                    continue
+                add(
+                    PlannedRequest(
+                        method=method, path=path, headers=tuple(headers), body=body
+                    ),
+                    t_idx,
+                )
+                ok = True
+        if not ok and unsupported:
+            skip(unsupported, t)
+
+    return RequestPlan(
+        requests=list(dedup),
+        owners=owners,
+        skipped=skipped,
+        planned_templates=planned,
+    )
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ActiveHit:
+    host: str
+    port: int
+    template_id: str
+    path: str
+    extractions: list[str]
+
+
+class ActiveScanner:
+    """(targets × planned requests) → device-matched, request-attributed
+    template hits. ``engine`` is a MatchEngine over the same corpus the
+    plan was built from."""
+
+    def __init__(self, engine, probe_spec: Optional[dict] = None):
+        self.engine = engine
+        self.plan = build_plan(engine.templates)
+        self.executor = ProbeExecutor(probe_spec)
+        spec = self.executor.spec
+        self.wave_rows = int(spec.get("wave_rows", 16384))
+        # template index -> id, and per-request owner id sets, once
+        self._tid = [t.id for t in engine.templates]
+        self._owner_ids = [
+            {self._tid[i] for i in owner} for owner in self.plan.owners
+        ]
+
+    def run(self, target_lines: Sequence[str]) -> tuple[list[ActiveHit], dict]:
+        parsed, malformed = self.executor._parse_lines(target_lines)
+        addr_of = self.executor._resolve_names(parsed)
+        spec_ports = [
+            int(p) for p in self.executor.spec["ports"] if 0 < int(p) < 65536
+        ]
+        targets: list[tuple[str, str, int]] = []
+        dead = 0
+        for host, explicit_port, _path in parsed:
+            ip = host if is_ip(host) else next(iter(addr_of.get(host) or []), None)
+            ports = [explicit_port] if explicit_port else spec_ports
+            for port in ports:
+                if ip is None:
+                    dead += 1
+                else:
+                    targets.append((host, ip, port))
+
+        hits: list[ActiveHit] = []
+        stats = {
+            "targets": len(targets),
+            "dead_targets": dead,
+            "malformed": len(malformed),
+            "requests_planned": len(self.plan.requests),
+            "rows_probed": 0,
+            "skipped_templates": {
+                k: len(v) for k, v in self.plan.skipped.items()
+            },
+        }
+        if not targets or not self.plan.requests:
+            return hits, stats
+
+        # liveness pre-pass: one connect per target; only live targets
+        # fan out over the full request table
+        live = self._liveness(targets)
+        stats["live_targets"] = len(live)
+
+        # index-sliced waves: never materialize the full (target × request)
+        # cross product — 10k live targets × 3.2k requests is 32M tuples
+        nreq = len(self.plan.requests)
+        total = len(live) * nreq
+        for w0 in range(0, total, self.wave_rows):
+            wave = [
+                (*live[i // nreq], i % nreq)
+                for i in range(w0, min(w0 + self.wave_rows, total))
+            ]
+            stats["rows_probed"] += len(wave)
+            hits.extend(self._run_wave(wave))
+        return hits, stats
+
+    # ------------------------------------------------------------------
+    def _liveness(self, targets):
+        result = scanio.tcp_scan(
+            [ip for _h, ip, _p in targets],
+            np.asarray([p for _h, _ip, p in targets], dtype=np.uint16),
+            None,
+            max_concurrency=int(self.executor.spec["concurrency"]),
+            connect_timeout_ms=int(self.executor.spec["connect_timeout_ms"]),
+            read_timeout_ms=1,  # connect-only
+            banner_cap=1,
+        )
+        # SW_OPEN = connect succeeded (banner may be empty at 1 ms read)
+        return [
+            t for t, s in zip(targets, result.status) if int(s) == scanio.STATUS_OPEN
+        ]
+
+    def _run_wave(self, wave) -> list[ActiveHit]:
+        payloads = [
+            self.plan.requests[r_idx].wire(host, port)
+            for host, _ip, port, r_idx in wave
+        ]
+        result = scanio.tcp_scan(
+            [ip for _h, ip, _p, _r in wave],
+            np.asarray([p for _h, _ip, p, _r in wave], dtype=np.uint16),
+            payloads,
+            max_concurrency=int(self.executor.spec["concurrency"]),
+            connect_timeout_ms=int(self.executor.spec["connect_timeout_ms"]),
+            read_timeout_ms=int(self.executor.spec["read_timeout_ms"]),
+            banner_cap=int(self.executor.spec["banner_cap"]),
+        )
+        rows: list[Response] = []
+        meta: list[tuple[str, int, int]] = []  # (host, port, r_idx)
+        for i, (host, _ip, port, r_idx) in enumerate(wave):
+            if int(result.status[i]) != scanio.STATUS_OPEN:
+                continue
+            code, header, body = parse_http_response(result.banner(i))
+            rows.append(
+                Response(host=host, port=port, status=code, header=header, body=body)
+            )
+            meta.append((host, port, r_idx))
+        if not rows:
+            return []
+        matches = self.engine.match(rows)
+        out: list[ActiveHit] = []
+        for (host, port, r_idx), rm in zip(meta, matches):
+            owner_ids = self._owner_ids[r_idx]
+            for tid in rm.template_ids:
+                if tid in owner_ids:
+                    out.append(
+                        ActiveHit(
+                            host=host,
+                            port=port,
+                            template_id=tid,
+                            path=self.plan.requests[r_idx].path,
+                            extractions=rm.extractions.get(tid, []),
+                        )
+                    )
+        return out

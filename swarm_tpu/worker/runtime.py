@@ -148,6 +148,8 @@ class JobProcessor:
                     output = self._execute_service(module, data)
                 elif module.backend == "jarm":
                     output = self._execute_jarm(module, data)
+                elif module.backend == "active":
+                    output = self._execute_active(module, data)
                 else:
                     output = self._execute_command(
                         module, scan_id, chunk_index, data
@@ -189,6 +191,47 @@ class JobProcessor:
             "device_s": round(ds.device_seconds - dev0, 6),
             "host_confirm_s": round(ds.host_confirm_seconds - confirm0, 6),
         }
+
+    # ------------------------------------------------------------------
+    def _execute_active(self, module: ModuleSpec, data: bytes) -> bytes:
+        """Active template-request scanning (nuclei's execution mode):
+        each template's own requests are issued per target, responses
+        device-matched, hits attributed per request (worker/active.py)."""
+        from swarm_tpu.fingerprints.model import Response
+        from swarm_tpu.worker import formats
+        from swarm_tpu.worker.active import ActiveScanner
+
+        if not module.templates_dir:
+            raise ValueError(f"active module {module.name} missing 'templates'")
+        engine = self._engine_for(module.templates_dir)
+        self._engine_stats_mark = (
+            engine,
+            engine.stats.rows,
+            engine.stats.device_seconds,
+            engine.stats.host_confirm_seconds,
+        )
+        key = f"active::{module.templates_dir}"
+        scanner = self._engines.get(key)
+        if scanner is None:
+            scanner = ActiveScanner(engine, module.probe)
+            self._engines[key] = scanner
+        hits, stats = scanner.run(
+            data.decode("utf-8", "surrogateescape").splitlines()
+        )
+        sev, _proto = formats.severity_index(engine.templates)
+        lines = []
+        for h in hits:
+            base = formats.url_of(Response(host=h.host, port=h.port))
+            extra = " [" + ",".join(h.extractions) + "]" if h.extractions else ""
+            lines.append(
+                f"[{h.template_id}] [http] [{sev.get(h.template_id, 'info')}] "
+                f"{base}{h.path}{extra}"
+            )
+        print(
+            f"active scan: {stats['rows_probed']} requests over "
+            f"{stats.get('live_targets', 0)} live targets, {len(lines)} hits"
+        )
+        return ("\n".join(lines) + "\n").encode() if lines else b""
 
     # ------------------------------------------------------------------
     def _execute_jarm(self, module: ModuleSpec, data: bytes) -> bytes:

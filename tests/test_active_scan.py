@@ -1,0 +1,224 @@
+"""Active template-request scanning (worker/active.py).
+
+The nuclei execution mode: templates' own requests issued per target,
+responses matched on device, hits attributed only to templates that own
+the request that produced the row. End-to-end against local HTTP
+servers whose responses differ per path — the attribution semantics are
+only observable with path-dependent content.
+"""
+
+import socketserver
+import textwrap
+import threading
+
+import pytest
+import yaml
+
+from swarm_tpu.fingerprints.nuclei import parse_template
+from swarm_tpu.worker import active
+
+
+def T(doc: str, path="t/x.yaml"):
+    return parse_template(yaml.safe_load(textwrap.dedent(doc)), source_path=path)
+
+
+LOGIN_TEMPLATE = """\
+id: demo-login-panel
+info:
+  name: login panel
+  severity: info
+requests:
+  - method: GET
+    path:
+      - "{{BaseURL}}/admin/login"
+    matchers:
+      - type: word
+        words: ["secret-admin-portal"]
+"""
+
+ROOT_TEMPLATE = """\
+id: demo-root-tech
+info:
+  name: root tech
+  severity: info
+requests:
+  - method: GET
+    path:
+      - "{{BaseURL}}"
+    matchers:
+      - type: word
+        words: ["acme-platform"]
+"""
+
+RAW_TEMPLATE = """\
+id: demo-raw-post
+info:
+  name: raw post probe
+  severity: medium
+requests:
+  - raw:
+      - |
+        POST /api/check HTTP/1.1
+        Host: {{Hostname}}
+        Content-Type: application/json
+
+        {"probe": true}
+    matchers:
+      - type: word
+        words: ["raw-post-ok"]
+"""
+
+PAYLOAD_TEMPLATE = """\
+id: demo-payload-skip
+info:
+  name: payload fuzzing
+  severity: high
+requests:
+  - method: GET
+    payloads:
+      user:
+        - admin
+        - root
+    path:
+      - "{{BaseURL}}/login?u={{user}}"
+    matchers:
+      - type: word
+        words: ["never"]
+"""
+
+
+# ---------------------------------------------------------------------------
+# plan compilation
+
+
+def test_plan_dedup_and_ownership():
+    t1, t2 = T(ROOT_TEMPLATE), T(ROOT_TEMPLATE.replace("demo-root-tech", "other"))
+    t3 = T(LOGIN_TEMPLATE)
+    plan = active.build_plan([t1, t2, t3])
+    assert len(plan.requests) == 2  # "/" deduplicated across t1/t2
+    by_path = {r.path: i for i, r in enumerate(plan.requests)}
+    assert plan.owners[by_path["/"]] == {0, 1}
+    assert plan.owners[by_path["/admin/login"]] == {2}
+
+
+def test_plan_raw_request_parsed():
+    plan = active.build_plan([T(RAW_TEMPLATE)])
+    assert len(plan.requests) == 1
+    r = plan.requests[0]
+    assert r.method == "POST" and r.path == "/api/check"
+    assert r.body == b'{"probe": true}'
+    wire = r.wire("target.example", 8080)
+    assert b"Host: target.example:8080" in wire
+    assert b"Content-Length: 15" in wire
+    assert wire.endswith(b'{"probe": true}')
+
+
+def test_plan_skips_payloads_and_dynamic():
+    dynamic = T(LOGIN_TEMPLATE.replace("/admin/login", "/x/{{unknowable}}"))
+    plan = active.build_plan([T(PAYLOAD_TEMPLATE), dynamic])
+    assert not plan.requests
+    assert plan.skipped["payloads"] == ["demo-payload-skip"]
+    assert plan.skipped["dynamic-values"] == ["demo-login-panel"]
+
+
+def test_plan_randstr_resolves():
+    t = T(LOGIN_TEMPLATE.replace("/admin/login", "/probe/{{randstr}}"))
+    plan = active.build_plan([t])
+    assert len(plan.requests) == 1
+    assert plan.requests[0].path.startswith("/probe/swarm")
+
+
+def test_interior_baseurl_becomes_absolute():
+    t = T(LOGIN_TEMPLATE.replace("/admin/login", "/go?next={{BaseURL}}/home"))
+    plan = active.build_plan([t])
+    wire = plan.requests[0].wire("h.example", 8080)
+    assert b"GET /go?next=http://h.example:8080/home HTTP/1.1" in wire
+
+
+# ---------------------------------------------------------------------------
+# end-to-end with path-dependent servers
+
+
+class _PathServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def _serve(routes):
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                data = self.request.recv(4096).decode("latin-1")
+                line = data.split("\r\n")[0]
+                parts = line.split()
+                path = parts[1] if len(parts) > 1 else "/"
+                method = parts[0] if parts else "GET"
+                body = routes.get((method, path)) or routes.get(path) or "nothing here"
+                resp = (
+                    "HTTP/1.1 200 OK\r\nServer: test\r\n"
+                    f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n{body}"
+                )
+                self.request.sendall(resp.encode())
+            except OSError:
+                pass
+
+    srv = _PathServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+@pytest.fixture
+def path_server():
+    srv = _serve(
+        {
+            "/": "welcome to the acme-platform homepage",
+            "/admin/login": "the secret-admin-portal awaits",
+            ("POST", "/api/check"): "raw-post-ok indeed",
+        }
+    )
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def test_active_scan_attributes_hits_per_request(path_server):
+    from swarm_tpu.ops.engine import MatchEngine
+
+    templates = [T(ROOT_TEMPLATE), T(LOGIN_TEMPLATE), T(RAW_TEMPLATE)]
+    engine = MatchEngine(templates)
+    scanner = active.ActiveScanner(engine, {"read_timeout_ms": 3000})
+    hits, stats = scanner.run([f"127.0.0.1:{path_server}"])
+    got = {(h.template_id, h.path) for h in hits}
+    assert got == {
+        ("demo-root-tech", "/"),
+        ("demo-login-panel", "/admin/login"),
+        ("demo-raw-post", "/api/check"),
+    }
+    assert stats["live_targets"] == 1
+    assert stats["rows_probed"] == 3
+
+
+def test_active_scan_no_cross_attribution(path_server):
+    """A word present on SOME path must not fire a template that only
+    requests a different path — the single-response engine would get
+    this wrong; attribution is the point of the active scanner."""
+    from swarm_tpu.ops.engine import MatchEngine
+
+    # this template looks for the homepage word but only on /admin/login
+    crossed = T(
+        ROOT_TEMPLATE.replace('- "{{BaseURL}}"', '- "{{BaseURL}}/admin/login"')
+        .replace("demo-root-tech", "demo-crossed")
+    )
+    engine = MatchEngine([crossed])
+    scanner = active.ActiveScanner(engine, {"read_timeout_ms": 3000})
+    hits, _stats = scanner.run([f"127.0.0.1:{path_server}"])
+    assert hits == []  # acme-platform is on "/", not on /admin/login
+
+
+def test_active_scan_dead_target():
+    from swarm_tpu.ops.engine import MatchEngine
+
+    engine = MatchEngine([T(ROOT_TEMPLATE)])
+    scanner = active.ActiveScanner(engine, {"connect_timeout_ms": 300})
+    hits, stats = scanner.run(["127.0.0.1:1"])
+    assert hits == [] and stats["live_targets"] == 0
+    assert stats["rows_probed"] == 0  # liveness gate saved the fan-out

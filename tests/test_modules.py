@@ -72,8 +72,7 @@ def test_module_specs_load():
     assert web.backend == "probe" and web.probe["resolvers"]
     assert web.output_format == "httpx_json"
     nuclei = registry.load("nuclei")
-    assert nuclei.backend == "tpu" and nuclei.input_format == "targets"
-    assert nuclei.output_format == "nuclei"
+    assert nuclei.backend == "active" and nuclei.input_format == "targets"
     httprobe = registry.load("httprobe")
     assert httprobe.probe["concurrency"] == 60  # reference: httprobe -c 60
 
