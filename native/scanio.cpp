@@ -26,6 +26,7 @@
 #include <cstdint>
 #include <cstring>
 #include <ctime>
+#include <dlfcn.h>
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -52,6 +53,64 @@ int set_nonblock(int fd) {
   return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
 }
 
+// ---------------------------------------------------------------------------
+// OpenSSL 3 via dlopen — the image ships libssl.so.3 but no headers, so
+// the minimal client-side API surface is declared here by hand. These
+// are stable OpenSSL 3 ABI symbols (opaque pointers only). If the
+// library is absent the TLS path reports SW_TLS_FAILED and everything
+// else keeps working.
+
+constexpr int kSSL_ERROR_WANT_READ = 2;
+constexpr int kSSL_ERROR_WANT_WRITE = 3;
+constexpr long kSSL_CTRL_SET_TLSEXT_HOSTNAME = 55;
+constexpr long kTLSEXT_NAMETYPE_host_name = 0;
+
+struct SslApi {
+  void* (*TLS_client_method)();
+  void* (*SSL_CTX_new)(void*);
+  void (*SSL_CTX_free)(void*);
+  void (*SSL_CTX_set_verify)(void*, int, void*);
+  void* (*SSL_new)(void*);
+  int (*SSL_set_fd)(void*, int);
+  void (*SSL_set_connect_state)(void*);
+  int (*SSL_do_handshake)(void*);
+  int (*SSL_read)(void*, void*, int);
+  int (*SSL_write)(void*, const void*, int);
+  int (*SSL_get_error)(const void*, int);
+  void (*SSL_free)(void*);
+  long (*SSL_ctrl)(void*, int, long, void*);
+  bool ok = false;
+};
+
+const SslApi& ssl_api() {
+  static SslApi api = [] {
+    SslApi a;
+    void* h = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) return a;
+    auto sym = [&](const char* n) { return dlsym(h, n); };
+    a.TLS_client_method = (void* (*)())sym("TLS_client_method");
+    a.SSL_CTX_new = (void* (*)(void*))sym("SSL_CTX_new");
+    a.SSL_CTX_free = (void (*)(void*))sym("SSL_CTX_free");
+    a.SSL_CTX_set_verify = (void (*)(void*, int, void*))sym("SSL_CTX_set_verify");
+    a.SSL_new = (void* (*)(void*))sym("SSL_new");
+    a.SSL_set_fd = (int (*)(void*, int))sym("SSL_set_fd");
+    a.SSL_set_connect_state = (void (*)(void*))sym("SSL_set_connect_state");
+    a.SSL_do_handshake = (int (*)(void*))sym("SSL_do_handshake");
+    a.SSL_read = (int (*)(void*, void*, int))sym("SSL_read");
+    a.SSL_write = (int (*)(void*, const void*, int))sym("SSL_write");
+    a.SSL_get_error = (int (*)(const void*, int))sym("SSL_get_error");
+    a.SSL_free = (void (*)(void*))sym("SSL_free");
+    a.SSL_ctrl = (long (*)(void*, int, long, void*))sym("SSL_ctrl");
+    a.ok = a.TLS_client_method && a.SSL_CTX_new && a.SSL_CTX_free &&
+           a.SSL_new && a.SSL_set_fd && a.SSL_set_connect_state &&
+           a.SSL_do_handshake && a.SSL_read && a.SSL_write &&
+           a.SSL_get_error && a.SSL_free && a.SSL_ctrl;
+    return a;
+  }();
+  return api;
+}
+
 }  // namespace
 
 extern "C" {
@@ -62,8 +121,12 @@ enum {
   SW_CLOSED = 1,         // connection refused / reset before connect
   SW_CONNECT_TIMEOUT = 2,
   SW_ERROR = 3,          // local error (fd limit, unreachable, ...)
-  SW_PENDING = 4         // internal; never returned
+  SW_PENDING = 4,        // internal; never returned
+  SW_TLS_FAILED = 5      // TCP connected but the TLS handshake failed
 };
+
+// 1 when libssl could be loaded (TLS-wrapped probing available).
+int swarm_tls_available() { return ssl_api().ok ? 1 : 0; }
 
 // ---------------------------------------------------------------------------
 // TCP connect scan / banner grab / payload probe
@@ -71,18 +134,27 @@ enum {
 //
 // ips[i]      IPv4 in network byte order.
 // pay_idx[i]  index into (pay_off, pay_len) or -1 for a pure banner wait.
-//             Payload bytes are sent immediately after connect.
-// banners     [n * banner_cap] output bytes; blens[i] valid length.
+//             Payload bytes are sent immediately after connect (through
+//             the TLS channel when tls_mask[i] is set).
+// tls_mask[i] nonzero → wrap the connection in TLS before the payload;
+//             (sni_off/sni_len)[i] slice sni_blob for the SNI name
+//             (len 0 = no SNI, e.g. bare-IP targets). All four may be
+//             null for an all-plaintext scan.
+// banners     [n * banner_cap] output bytes; blens[i] valid length
+//             (decrypted bytes on TLS connections).
 // status      per-target status code; rtt_us connect latency (or -1).
 //
 // Returns 0, or -1 on setup failure (epoll).
-int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
-                   const uint8_t* payload_blob, const int64_t* pay_off,
-                   const int32_t* pay_len, const int32_t* pay_idx,
-                   int32_t max_concurrency, int32_t connect_timeout_ms,
-                   int32_t read_timeout_ms, int32_t banner_cap,
-                   uint8_t* banners, int32_t* blens, int8_t* status,
-                   int32_t* rtt_us) {
+int swarm_tcp_scan_tls(const uint32_t* ips, const uint16_t* ports, int32_t n,
+                       const uint8_t* payload_blob, const int64_t* pay_off,
+                       const int32_t* pay_len, const int32_t* pay_idx,
+                       const int8_t* tls_mask, const uint8_t* sni_blob,
+                       const int32_t* sni_off, const int32_t* sni_len,
+                       int32_t max_concurrency, int32_t connect_timeout_ms,
+                       int32_t read_timeout_ms, int32_t banner_cap,
+                       uint8_t* banners, int32_t* blens, int8_t* status,
+                       int32_t* rtt_us) {
+  enum HsState { HS_PLAIN = 0, HS_RUNNING = 1, HS_DONE = 2 };
   struct Conn {
     int fd = -1;
     int32_t target = -1;
@@ -90,6 +162,8 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
     int64_t started_us = 0;
     int64_t sent = 0;       // payload bytes written so far
     bool connected = false;
+    void* ssl = nullptr;
+    int hs = HS_PLAIN;
   };
 
   if (n <= 0) return 0;
@@ -102,6 +176,18 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
   int ep = epoll_create1(0);
   if (ep < 0) return -1;
 
+  // one TLS context for the whole call (verification off: scanners
+  // fingerprint servers, they don't authenticate them)
+  const SslApi& api = ssl_api();
+  void* ctx = nullptr;
+  bool any_tls = false;
+  if (tls_mask)
+    for (int32_t i = 0; i < n; ++i) any_tls = any_tls || tls_mask[i];
+  if (any_tls && api.ok) {
+    ctx = api.SSL_CTX_new(api.TLS_client_method());
+    if (ctx && api.SSL_CTX_set_verify) api.SSL_CTX_set_verify(ctx, 0, nullptr);
+  }
+
   int conc = std::max(1, (int)max_concurrency);
   std::vector<Conn> slots(conc);
   std::vector<int> free_slots;
@@ -113,6 +199,7 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
 
   auto finish = [&](int s, int8_t st) {
     Conn& c = slots[s];
+    if (c.ssl) api.SSL_free(c.ssl);
     if (c.fd >= 0) {
       epoll_ctl(ep, EPOLL_CTL_DEL, c.fd, nullptr);
       close(c.fd);
@@ -157,20 +244,78 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
     int64_t off = pay_off[pi] + c.sent;
     int64_t left = pay_len[pi] - c.sent;
     while (left > 0) {
-      ssize_t w = send(c.fd, payload_blob + off, (size_t)left, MSG_NOSIGNAL);
-      if (w > 0) {
-        c.sent += w;
-        off += w;
-        left -= w;
-        continue;
+      ssize_t w;
+      if (c.hs == HS_DONE) {
+        int r = api.SSL_write(c.ssl, payload_blob + off,
+                              (int)std::min<int64_t>(left, 1 << 20));
+        if (r <= 0) {
+          int err = api.SSL_get_error(c.ssl, r);
+          if (err == kSSL_ERROR_WANT_READ || err == kSSL_ERROR_WANT_WRITE)
+            return true;  // retried on the next event
+          finish(s, SW_OPEN);  // post-handshake reset: port was open
+          return false;
+        }
+        w = r;
+      } else {
+        w = send(c.fd, payload_blob + off, (size_t)left, MSG_NOSIGNAL);
+        if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return true;
+        if (w <= 0) {
+          // a reset while writing on an established connection still
+          // means the port was open — same rule as pump_read
+          finish(s, SW_OPEN);
+          return false;
+        }
       }
-      if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return true;
-      // a reset while writing on an established connection still means
-      // the port was open — same rule as pump_read's post-connect reset
-      finish(s, SW_OPEN);
-      return false;
+      c.sent += w;
+      off += w;
+      left -= w;
     }
     return true;
+  };
+
+  // advance a TLS handshake; arms epoll for whichever direction the
+  // handshake is blocked on
+  auto drive_handshake = [&](int s) {
+    Conn& c = slots[s];
+    int r = api.SSL_do_handshake(c.ssl);
+    if (r == 1) {
+      c.hs = HS_DONE;
+      if (pump_write(s)) arm(s, payload_left(s));
+      return;
+    }
+    int err = api.SSL_get_error(c.ssl, r);
+    if (err == kSSL_ERROR_WANT_READ) {
+      arm(s, false);
+    } else if (err == kSSL_ERROR_WANT_WRITE) {
+      arm(s, true);
+    } else {
+      finish(s, SW_TLS_FAILED);  // alert, not-TLS peer, protocol error
+    }
+  };
+
+  // post-TCP-connect: either begin TLS or send the payload in the clear
+  auto after_connect = [&](int s) {
+    Conn& c = slots[s];
+    bool want_tls = tls_mask && tls_mask[c.target];
+    if (!want_tls) {
+      if (pump_write(s) && payload_left(s)) arm(s, true);
+      return;
+    }
+    if (!ctx || !(c.ssl = api.SSL_new(ctx))) {
+      finish(s, SW_TLS_FAILED);  // libssl unavailable: port-open is kept
+      return;
+    }
+    api.SSL_set_fd(c.ssl, c.fd);
+    if (sni_blob && sni_len && sni_len[c.target] > 0 && sni_len[c.target] < 256) {
+      char name[256];
+      std::memcpy(name, sni_blob + sni_off[c.target], sni_len[c.target]);
+      name[sni_len[c.target]] = 0;
+      api.SSL_ctrl(c.ssl, kSSL_CTRL_SET_TLSEXT_HOSTNAME,
+                   kTLSEXT_NAMETYPE_host_name, name);
+    }
+    api.SSL_set_connect_state(c.ssl);
+    c.hs = HS_RUNNING;
+    drive_handshake(s);
   };
 
   auto launch = [&](int32_t t) -> bool {
@@ -216,7 +361,7 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
     if (c.connected) {
       rtt_us[t] = 0;
       c.deadline_us = c.started_us + int64_t(read_timeout_ms) * 1000;
-      if (pump_write(s) && payload_left(s)) arm(s, true);
+      after_connect(s);
     }
     return true;
   };
@@ -230,19 +375,31 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
         finish(s, SW_OPEN);
         return;
       }
-      ssize_t r = recv(c.fd, banners + int64_t(t) * banner_cap + blens[t],
-                       (size_t)space, 0);
-      if (r > 0) {
-        blens[t] += (int32_t)r;
-        continue;
+      uint8_t* dst = banners + int64_t(t) * banner_cap + blens[t];
+      ssize_t r;
+      if (c.hs == HS_DONE) {
+        int rr = api.SSL_read(c.ssl, dst, (int)space);
+        if (rr <= 0) {
+          int err = api.SSL_get_error(c.ssl, rr);
+          if (err == kSSL_ERROR_WANT_READ || err == kSSL_ERROR_WANT_WRITE)
+            return;
+          finish(s, SW_OPEN);  // close_notify / reset after handshake
+          return;
+        }
+        r = rr;
+      } else {
+        r = recv(c.fd, dst, (size_t)space, 0);
+        if (r == 0) {  // orderly EOF
+          finish(s, SW_OPEN);
+          return;
+        }
+        if (r < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+          finish(s, SW_OPEN);  // reset after connect still counts as open
+          return;
+        }
       }
-      if (r == 0) {  // orderly EOF
-        finish(s, SW_OPEN);
-        return;
-      }
-      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-      finish(s, SW_OPEN);  // reset after connect still counts as open
-      return;
+      blens[t] += (int32_t)r;
     }
   };
 
@@ -280,9 +437,16 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
             continue;
           }
           on_connected(s);
-          if (!pump_write(s)) continue;
-          arm(s, payload_left(s));
+          after_connect(s);
         }
+        continue;
+      }
+      if (c.hs == HS_RUNNING) {
+        // the handshake owns the socket until it completes either way
+        drive_handshake(s);
+        // appdata can arrive inside the same TLS records as the final
+        // handshake flight; epoll won't re-fire for buffered bytes
+        if (c.fd >= 0 && c.hs == HS_DONE) pump_read(s);
         continue;
       }
       if (evs & EPOLLOUT) {
@@ -297,12 +461,30 @@ int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
     for (int s = 0; s < conc; ++s) {
       Conn& c = slots[s];
       if (c.fd >= 0 && now >= c.deadline_us)
-        finish(s, c.connected ? SW_OPEN : SW_CONNECT_TIMEOUT);
+        finish(s, !c.connected          ? SW_CONNECT_TIMEOUT
+                : c.hs == HS_RUNNING    ? SW_TLS_FAILED
+                                        : SW_OPEN);
     }
   }
 
   close(ep);
+  if (ctx) api.SSL_CTX_free(ctx);
   return 0;
+}
+
+// Legacy all-plaintext entry point (kept for ABI stability).
+int swarm_tcp_scan(const uint32_t* ips, const uint16_t* ports, int32_t n,
+                   const uint8_t* payload_blob, const int64_t* pay_off,
+                   const int32_t* pay_len, const int32_t* pay_idx,
+                   int32_t max_concurrency, int32_t connect_timeout_ms,
+                   int32_t read_timeout_ms, int32_t banner_cap,
+                   uint8_t* banners, int32_t* blens, int8_t* status,
+                   int32_t* rtt_us) {
+  return swarm_tcp_scan_tls(ips, ports, n, payload_blob, pay_off, pay_len,
+                            pay_idx, nullptr, nullptr, nullptr, nullptr,
+                            max_concurrency, connect_timeout_ms,
+                            read_timeout_ms, banner_cap, banners, blens,
+                            status, rtt_us);
 }
 
 // ---------------------------------------------------------------------------

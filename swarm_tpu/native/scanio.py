@@ -24,6 +24,7 @@ STATUS_OPEN = 0
 STATUS_CLOSED = 1
 STATUS_TIMEOUT = 2
 STATUS_ERROR = 3
+STATUS_TLS_FAILED = 5  # TCP connected, TLS handshake failed / unavailable
 
 _NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
 _LIB_PATH = _NATIVE_DIR / "libscanio.so"
@@ -56,6 +57,16 @@ def ensure_lib() -> ctypes.CDLL:
         u8p, i32p, i8p, i32p,         # banners, blens, status, rtt
     ]
     lib.swarm_tcp_scan.restype = i32
+    lib.swarm_tcp_scan_tls.argtypes = [
+        u32p, u16p, i32,              # ips, ports, n
+        u8p, i64p, i32p, i32p,        # payload blob/off/len, pay_idx
+        i8p, u8p, i32p, i32p,         # tls_mask, sni blob/off/len
+        i32, i32, i32, i32,           # conc, connect_to, read_to, cap
+        u8p, i32p, i8p, i32p,         # banners, blens, status, rtt
+    ]
+    lib.swarm_tcp_scan_tls.restype = i32
+    lib.swarm_tls_available.argtypes = []
+    lib.swarm_tls_available.restype = i32
     lib.swarm_dns_resolve.argtypes = [
         u8p, i32p, i32p, i32,         # names, off, len, n
         u32p, i32, i32,               # resolvers, nres, port
@@ -99,11 +110,18 @@ def format_ipv4(addrs: np.ndarray) -> list[str]:
     return [socket.inet_ntoa(struct.pack("=I", int(a))) for a in addrs]
 
 
+def tls_available() -> bool:
+    """Whether libssl could be loaded (TLS-wrapped probing works)."""
+    return bool(ensure_lib().swarm_tls_available())
+
+
 def tcp_scan(
     ips: np.ndarray | Sequence[str],
     ports: np.ndarray | Sequence[int],
     payloads: Optional[Sequence[Optional[bytes]]] = None,
     *,
+    tls: Optional[Sequence[bool]] = None,
+    sni: Optional[Sequence[Optional[str]]] = None,
     max_concurrency: int = 512,
     connect_timeout_ms: int = 1500,
     read_timeout_ms: int = 2000,
@@ -114,6 +132,9 @@ def tcp_scan(
     ``payloads[i]`` (optional) is written right after connect — an HTTP
     request for httpx-style probing, a protocol nudge for banner
     grabbing, or None to listen silently (nmap-style banner wait).
+    ``tls[i]`` wraps target i in TLS first (payload sent and banner read
+    through the encrypted channel); ``sni[i]`` sets its SNI hostname.
+    Targets where the handshake fails report STATUS_TLS_FAILED.
     """
     lib = ensure_lib()
     if len(ips) and isinstance(ips[0], str):
@@ -148,14 +169,41 @@ def tcp_scan(
     pay_off = np.asarray(offsets or [0], dtype=np.int64)
     pay_len = np.asarray(lens or [0], dtype=np.int32)
 
+    # TLS mask + SNI name blob
+    tls_mask = np.zeros(n, dtype=np.int8)
+    if tls is not None:
+        tls_mask[: len(tls)] = [1 if t else 0 for t in tls]
+    sni_parts: list[bytes] = []
+    sni_off = np.zeros(n, dtype=np.int32)
+    sni_len = np.zeros(n, dtype=np.int32)
+    stotal = 0
+    if sni is not None:
+        for i, name in enumerate(sni):
+            if not name:
+                continue
+            try:
+                enc = (
+                    name.encode("idna")
+                    if any(ord(c) > 127 for c in name)
+                    else name.encode("ascii")
+                )
+            except UnicodeError:
+                continue  # unencodable label → probe without SNI
+            sni_off[i] = stotal
+            sni_len[i] = len(enc)
+            sni_parts.append(enc)
+            stotal += len(enc)
+    sni_blob = np.frombuffer(b"".join(sni_parts) or b"\0", dtype=np.uint8).copy()
+
     banners = np.zeros((n, banner_cap), dtype=np.uint8)
     blens = np.zeros(n, dtype=np.int32)
     status = np.zeros(n, dtype=np.int8)
     rtt = np.zeros(n, dtype=np.int32)
     if n:
-        rc = lib.swarm_tcp_scan(
+        rc = lib.swarm_tcp_scan_tls(
             ips, ports_a, n,
             blob, pay_off, pay_len, pay_idx,
+            tls_mask, sni_blob, sni_off, sni_len,
             max_concurrency, connect_timeout_ms, read_timeout_ms, banner_cap,
             banners, blens, status, rtt,
         )

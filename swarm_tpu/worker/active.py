@@ -39,6 +39,7 @@ from swarm_tpu.worker.executor import (
     ProbeExecutor,
     is_ip,
     parse_http_response,
+    use_tls,
 )
 
 _PLACEHOLDER_RE = re.compile(r"\{\{([^{}]+)\}\}")
@@ -285,16 +286,16 @@ class ActiveScanner:
         spec_ports = [
             int(p) for p in self.executor.spec["ports"] if 0 < int(p) < 65536
         ]
-        targets: list[tuple[str, str, int]] = []
+        targets: list[tuple[str, str, int, bool]] = []  # (host, ip, port, tls)
         dead = 0
-        for host, explicit_port, _path in parsed:
+        for host, explicit_port, _path, scheme in parsed:
             ip = host if is_ip(host) else next(iter(addr_of.get(host) or []), None)
             ports = [explicit_port] if explicit_port else spec_ports
             for port in ports:
                 if ip is None:
                     dead += 1
                 else:
-                    targets.append((host, ip, port))
+                    targets.append((host, ip, port, use_tls(scheme, port)))
 
         hits: list[ActiveHit] = []
         stats = {
@@ -331,8 +332,8 @@ class ActiveScanner:
     # ------------------------------------------------------------------
     def _liveness(self, targets):
         result = scanio.tcp_scan(
-            [ip for _h, ip, _p in targets],
-            np.asarray([p for _h, _ip, p in targets], dtype=np.uint16),
+            [ip for _h, ip, _p, _t in targets],
+            np.asarray([p for _h, _ip, p, _t in targets], dtype=np.uint16),
             None,
             max_concurrency=int(self.executor.spec["concurrency"]),
             connect_timeout_ms=int(self.executor.spec["connect_timeout_ms"]),
@@ -347,12 +348,14 @@ class ActiveScanner:
     def _run_wave(self, wave) -> list[ActiveHit]:
         payloads = [
             self.plan.requests[r_idx].wire(host, port)
-            for host, _ip, port, r_idx in wave
+            for host, _ip, port, _t, r_idx in wave
         ]
         result = scanio.tcp_scan(
-            [ip for _h, ip, _p, _r in wave],
-            np.asarray([p for _h, _ip, p, _r in wave], dtype=np.uint16),
+            [ip for _h, ip, _p, _t, _r in wave],
+            np.asarray([p for _h, _ip, p, _t, _r in wave], dtype=np.uint16),
             payloads,
+            tls=[t for _h, _ip, _p, t, _r in wave],
+            sni=[h if not is_ip(h) else None for h, _ip, _p, _t, _r in wave],
             max_concurrency=int(self.executor.spec["concurrency"]),
             connect_timeout_ms=int(self.executor.spec["connect_timeout_ms"]),
             read_timeout_ms=int(self.executor.spec["read_timeout_ms"]),
@@ -360,7 +363,7 @@ class ActiveScanner:
         )
         rows: list[Response] = []
         meta: list[tuple[str, int, int]] = []  # (host, port, r_idx)
-        for i, (host, _ip, port, r_idx) in enumerate(wave):
+        for i, (host, _ip, port, _t, r_idx) in enumerate(wave):
             if int(result.status[i]) != scanio.STATUS_OPEN:
                 continue
             code, header, body = parse_http_response(result.banner(i))

@@ -48,8 +48,11 @@ _DEFAULTS = {
 }
 
 
-def parse_target(line: str) -> Optional[tuple[str, Optional[int], str]]:
-    """→ (host, explicit_port | None, path); None for blank/comment lines.
+def parse_target(
+    line: str,
+) -> Optional[tuple[str, Optional[int], str, str]]:
+    """→ (host, explicit_port | None, path, scheme) — scheme "" unless
+    the line stated one; None for blank/comment lines.
 
     Malformed lines (bad URL, out-of-range port) raise ValueError — the
     caller turns those into dead rows so one bad line never sinks the
@@ -68,14 +71,29 @@ def parse_target(line: str) -> Optional[tuple[str, Optional[int], str]]:
             port = 443
         if not host:
             raise ValueError(f"no host in target {line!r}")
-        return (host, port, path)
+        return (host, port, path, parts.scheme.lower())
     host, sep, port_s = line.rpartition(":")
     if sep and port_s.isdigit():
         port = int(port_s)
         if not 0 < port < 65536:
             raise ValueError(f"port out of range in target {line!r}")
-        return (host, port, path)
-    return (line, None, path)
+        return (host, port, path, "")
+    return (line, None, path, "")
+
+
+def tls_port(port: int) -> bool:
+    """Default TLS heuristic when the target stated no scheme
+    (url_of convention)."""
+    return port in (443, 8443)
+
+
+def use_tls(scheme: str, port: int) -> bool:
+    """A user-stated scheme always wins over the port heuristic."""
+    if scheme == "https":
+        return True
+    if scheme == "http":
+        return False
+    return tls_port(port)
 
 
 def is_ip(host: str) -> bool:
@@ -165,7 +183,7 @@ class ProbeExecutor:
     ) -> dict[str, list[str]]:
         """Bulk-resolve the non-IP hostnames in ``parsed`` → name→addrs
         (empty list when unresolvable)."""
-        names = sorted({h for h, _, _ in parsed if not is_ip(h)})
+        names = sorted({t[0] for t in parsed if not is_ip(t[0])})
         addr_of: dict[str, list[str]] = {n: [] for n in names}
         resolvers = list(self.spec["resolvers"]) or _system_resolvers()
         if names and resolvers:
@@ -188,7 +206,7 @@ class ProbeExecutor:
         addr_of = self._resolve_names(parsed, all_addrs=True)
         seen: set[str] = set()
         out: list[tuple[str, list[str]]] = []
-        for name, _port, _path in parsed:
+        for name, *_ in parsed:
             if name in seen:
                 continue
             seen.add(name)
@@ -208,17 +226,18 @@ class ProbeExecutor:
         addr_of = self._resolve_names(parsed)
 
         # --- fan out (target × ports) ---
-        probes: list[tuple[str, str, int, str]] = []  # (host, ip, port, path)
+        # probes: (host, ip, port, path, tls)
+        probes: list[tuple[str, str, int, str, bool]] = []
         dead: list[tuple[str, int]] = []  # unresolved rows
         spec_ports = [p for p in self.spec["ports"] if 0 < int(p) < 65536]
-        for host, explicit_port, path in parsed:
+        for host, explicit_port, path, scheme in parsed:
             ip = host if is_ip(host) else next(iter(addr_of.get(host) or []), None)
             ports = [explicit_port] if explicit_port else spec_ports
             for port in ports:
                 if ip is None:
                     dead.append((host, port))
                 else:
-                    probes.append((host, ip, port, path))
+                    probes.append((host, ip, port, path, use_tls(scheme, port)))
 
         rows: list[Response] = []
         if probes:
@@ -231,18 +250,23 @@ class ProbeExecutor:
                         "User-Agent: swarm-tpu/1.0\r\nAccept: */*\r\n"
                         "Connection: close\r\n\r\n"
                     ).encode()
-                    for host, _ip, _port, path in probes
+                    for host, _ip, _port, path, _tls in probes
                 ]
             result = scanio.tcp_scan(
-                [ip for _h, ip, _p, _pa in probes],
-                np.asarray([p for _h, _ip, p, _pa in probes], dtype=np.uint16),
+                [ip for _h, ip, _p, _pa, _t in probes],
+                np.asarray([p for _h, _ip, p, _pa, _t in probes], dtype=np.uint16),
                 payloads,
+                tls=[http and t for _h, _ip, _p, _pa, t in probes],
+                sni=[
+                    host if not is_ip(host) else None
+                    for host, _ip, _p, _pa, _t in probes
+                ],
                 max_concurrency=int(self.spec["concurrency"]),
                 connect_timeout_ms=int(self.spec["connect_timeout_ms"]),
                 read_timeout_ms=int(self.spec["read_timeout_ms"]),
                 banner_cap=int(self.spec["banner_cap"]),
             )
-            for i, (host, _ip, port, _path) in enumerate(probes):
+            for i, (host, _ip, port, _path, _tls) in enumerate(probes):
                 raw = result.banner(i)
                 if int(result.status[i]) != scanio.STATUS_OPEN:
                     rows.append(Response(host=host, port=port, alive=False))
@@ -289,7 +313,7 @@ class ProbeExecutor:
         for line in malformed:
             rows.append(Response(host=line, port=0, alive=False))
             sent.append(None)
-        for host, explicit_port, _path in parsed:
+        for host, explicit_port, _path, _scheme in parsed:
             ip = host if is_ip(host) else next(iter(addr_of.get(host) or []), None)
             for port in [explicit_port] if explicit_port else spec_ports:
                 if ip is None:
@@ -371,7 +395,7 @@ class ProbeExecutor:
         addr_of = self._resolve_names(parsed)
         targets: list[tuple[str, str, int]] = []
         dead: list[tuple[str, int]] = []
-        for host, explicit_port, _path in parsed:
+        for host, explicit_port, _path, _scheme in parsed:
             ip = host if is_ip(host) else next(iter(addr_of.get(host) or []), None)
             port = explicit_port or 443
             if ip is None:

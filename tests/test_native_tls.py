@@ -1,0 +1,160 @@
+"""Native TLS-wrapped probing (scanio + executor https path).
+
+The reference's https coverage came from its Go tools' TLS clients
+(httpx/httprobe — SURVEY.md §2.2); here the native epoll engine wraps
+connections in OpenSSL (dlopen'd libssl.so.3) with nonblocking
+handshakes in the same event loop. Tests run against a real
+ssl-module-served HTTPS endpoint.
+"""
+
+import http.server
+import socketserver
+import ssl
+import subprocess
+import threading
+
+import pytest
+
+from swarm_tpu.native import scanio
+
+
+@pytest.fixture(scope="module")
+def https_server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tls")
+    key, crt = tmp / "key.pem", tmp / "crt.pem"
+    gen = subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(crt), "-days", "1",
+            "-subj", "/CN=localhost",
+        ],
+        capture_output=True,
+    )
+    if gen.returncode != 0:
+        pytest.skip("openssl unavailable")
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"<html><title>secure-widget</title>tls works</html>"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Server", "https-test")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(str(crt), str(key))
+    srv.socket = ctx.wrap_socket(srv.socket, server_side=True)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def plain_server():
+    class H(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                self.request.recv(1024)
+                self.request.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Length: 5\r\n\r\nplain"
+                )
+            except OSError:
+                pass
+
+    class S(socketserver.ThreadingTCPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    srv = S(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+REQ = b"GET / HTTP/1.1\r\nHost: localhost\r\nConnection: close\r\n\r\n"
+
+
+def test_tls_available():
+    assert scanio.tls_available(), "libssl not loadable in this image"
+
+
+def test_tls_scan_decrypts_response(https_server):
+    r = scanio.tcp_scan(
+        ["127.0.0.1"], [https_server], [REQ],
+        tls=[True], sni=["localhost"], read_timeout_ms=4000,
+    )
+    assert int(r.status[0]) == scanio.STATUS_OPEN
+    banner = r.banner(0)
+    assert banner.startswith(b"HTTP/1.0 200") or banner.startswith(b"HTTP/1.1 200")
+    assert b"secure-widget" in banner  # decrypted application data
+
+
+def test_tls_to_plain_port_reports_tls_failed(plain_server):
+    r = scanio.tcp_scan(
+        ["127.0.0.1"], [plain_server], [REQ], tls=[True], read_timeout_ms=2000
+    )
+    assert int(r.status[0]) == scanio.STATUS_TLS_FAILED
+
+
+def test_mixed_tls_and_plain_wave(https_server, plain_server):
+    r = scanio.tcp_scan(
+        ["127.0.0.1"] * 3,
+        [https_server, plain_server, 1],
+        [REQ, REQ, None],
+        tls=[True, False, False],
+        sni=["localhost", None, None],
+        read_timeout_ms=4000,
+    )
+    assert int(r.status[0]) == scanio.STATUS_OPEN and b"200" in r.banner(0)
+    assert int(r.status[1]) == scanio.STATUS_OPEN and r.banner(1).endswith(b"plain")
+    assert int(r.status[2]) == scanio.STATUS_CLOSED
+
+
+def test_executor_probes_https(https_server, monkeypatch):
+    """The http probe path wraps 443/8443 in TLS; patch tls_port to
+    treat the test port as TLS so the full parse path is exercised."""
+    from swarm_tpu.worker import executor as ex
+
+    monkeypatch.setattr(ex, "tls_port", lambda p: p == https_server)
+    rows = ex.ProbeExecutor(
+        {"ports": [https_server], "read_timeout_ms": 4000}
+    ).run(["127.0.0.1"])
+    assert len(rows) == 1
+    row = rows[0]
+    assert row.alive and row.status == 200
+    assert b"secure-widget" in row.body
+    assert b"https-test" in row.header
+
+
+def test_executor_tls_failure_is_dead_row(plain_server, monkeypatch):
+    from swarm_tpu.worker import executor as ex
+
+    monkeypatch.setattr(ex, "tls_port", lambda p: p == plain_server)
+    rows = ex.ProbeExecutor(
+        {"ports": [plain_server], "read_timeout_ms": 2000}
+    ).run(["127.0.0.1"])
+    assert len(rows) == 1 and not rows[0].alive
+
+
+def test_use_tls_scheme_overrides_port_heuristic():
+    from swarm_tpu.worker.executor import use_tls
+
+    assert use_tls("https", 9443) is True   # stated scheme wins
+    assert use_tls("http", 8443) is False   # stated scheme wins
+    assert use_tls("", 443) is True         # heuristic fallback
+    assert use_tls("", 8443) is True
+    assert use_tls("", 80) is False
+
+
+def test_sni_unencodable_name_does_not_sink_batch(plain_server):
+    # a hostname idna cannot encode must degrade to no-SNI, not raise
+    r = scanio.tcp_scan(
+        ["127.0.0.1"], [plain_server], None,
+        tls=[False], sni=["ä" * 64 + ".example"], read_timeout_ms=500,
+    )
+    assert int(r.status[0]) == scanio.STATUS_OPEN

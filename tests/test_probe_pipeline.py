@@ -59,11 +59,12 @@ def http_port():
 
 
 def test_parse_target_forms():
-    assert parse_target("example.com") == ("example.com", None, "/")
-    assert parse_target("example.com:8443") == ("example.com", 8443, "/")
-    assert parse_target("10.0.0.1:80") == ("10.0.0.1", 80, "/")
-    assert parse_target("http://example.com/admin") == ("example.com", None, "/admin")
-    assert parse_target("https://example.com") == ("example.com", 443, "/")
+    assert parse_target("example.com") == ("example.com", None, "/", "")
+    assert parse_target("example.com:8443") == ("example.com", 8443, "/", "")
+    assert parse_target("10.0.0.1:80") == ("10.0.0.1", 80, "/", "")
+    assert parse_target("http://example.com/admin") == (
+        "example.com", None, "/admin", "http")
+    assert parse_target("https://example.com") == ("example.com", 443, "/", "https")
     assert parse_target("# comment") is None
     assert parse_target("") is None
 
