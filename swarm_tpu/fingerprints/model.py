@@ -134,6 +134,9 @@ class Response:
     duration_s: float = 0.0
     # For raw TCP banners, set banner and leave body/header empty.
     banner: Optional[bytes] = None
+    # Whether the probe ran over TLS; None = unknown (port heuristic
+    # applies when rendering URLs).
+    tls: Optional[bool] = None
     # False = the probe never got a response (unresolvable/unreachable).
     # Dead rows are never matched — nuclei produces no output for failed
     # requests, and negative matchers must not fire on an empty phantom

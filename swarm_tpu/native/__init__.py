@@ -3,9 +3,11 @@ from swarm_tpu.native.scanio import (  # noqa: F401
     STATUS_ERROR,
     STATUS_OPEN,
     STATUS_TIMEOUT,
+    STATUS_TLS_FAILED,
     DnsResult,
     ScanResult,
     dns_resolve,
     ensure_lib,
     tcp_scan,
+    tls_available,
 )

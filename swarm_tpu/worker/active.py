@@ -76,12 +76,22 @@ class PlannedRequest:
         return raw + body
 
 
+@dataclasses.dataclass(frozen=True)
+class NetRequest:
+    """One network-protocol probe: raw bytes to a template-declared port."""
+
+    port: int
+    payload: bytes
+
+
 @dataclasses.dataclass
 class RequestPlan:
     requests: list[PlannedRequest]
     owners: list[set[int]]  # request idx -> template indices
     skipped: dict[str, list[str]]  # reason -> template ids
     planned_templates: set[int]  # template indices with ≥1 request
+    net_requests: list[NetRequest] = dataclasses.field(default_factory=list)
+    net_owners: list[set[int]] = dataclasses.field(default_factory=list)
 
 
 def _substitute(text: str, host: str = "", port: int = 80) -> Optional[str]:
@@ -176,9 +186,43 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
     def skip(reason: str, t: Template) -> None:
         skipped.setdefault(reason, []).append(t.id)
 
+    net_dedup: dict[NetRequest, int] = {}
+
+    def add_net(req: NetRequest, t_idx: int) -> None:
+        idx = net_dedup.get(req)
+        if idx is None:
+            idx = net_dedup[req] = len(net_owners_list)
+            net_owners_list.append(set())
+        net_owners_list[idx].add(t_idx)
+        planned.add(t_idx)
+
+    net_owners_list: list[set[int]] = []
+
     for t_idx, t in enumerate(templates):
+        if t.protocol == "network":
+            # hosts entries declare the port: "{{Host}}:873"-style; the
+            # bare "{{Hostname}}" form rides the target's own port and
+            # needs no separate plan entry (SURVEY.md §2.3 network
+            # templates send inputs.data and match banners). Each
+            # operation carries its own (ports, payload) pair.
+            any_port = False
+            for op in t.operations:
+                ports = set()
+                for h in op.hosts:
+                    _, sep, port_s = h.rpartition(":")
+                    if sep and port_s.isdigit():
+                        ports.add(int(port_s))
+                if not ports:
+                    continue
+                any_port = True
+                payload = b"".join(op.inputs)
+                for port in sorted(ports):
+                    add_net(NetRequest(port=port, payload=payload), t_idx)
+            if not any_port:
+                skip("network-no-port", t)
+            continue
         if t.protocol != "http":
-            continue  # network/dns handled by their own paths
+            continue  # dns/file/headless/ssl handled elsewhere
         if any(op.payloads for op in t.operations):
             skip("payloads", t)
             continue
@@ -248,6 +292,8 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
         owners=owners,
         skipped=skipped,
         planned_templates=planned,
+        net_requests=list(net_dedup),
+        net_owners=net_owners_list,
     )
 
 
@@ -261,6 +307,7 @@ class ActiveHit:
     template_id: str
     path: str
     extractions: list[str]
+    tls: bool = False  # how the hit's request was actually probed
 
 
 class ActiveScanner:
@@ -278,6 +325,9 @@ class ActiveScanner:
         self._tid = [t.id for t in engine.templates]
         self._owner_ids = [
             {self._tid[i] for i in owner} for owner in self.plan.owners
+        ]
+        self._net_owner_ids = [
+            {self._tid[i] for i in owner} for owner in self.plan.net_owners
         ]
 
     def run(self, target_lines: Sequence[str]) -> tuple[list[ActiveHit], dict]:
@@ -308,12 +358,12 @@ class ActiveScanner:
                 k: len(v) for k, v in self.plan.skipped.items()
             },
         }
-        if not targets or not self.plan.requests:
+        if not targets or not (self.plan.requests or self.plan.net_requests):
             return hits, stats
 
         # liveness pre-pass: one connect per target; only live targets
         # fan out over the full request table
-        live = self._liveness(targets)
+        live = self._liveness(targets) if self.plan.requests else []
         stats["live_targets"] = len(live)
 
         # index-sliced waves: never materialize the full (target × request)
@@ -327,6 +377,14 @@ class ActiveScanner:
             ]
             stats["rows_probed"] += len(wave)
             hits.extend(self._run_wave(wave))
+
+        # network-protocol pass: template-declared ports on each host
+        # (one probe per host × net request, regardless of target port)
+        if self.plan.net_requests:
+            hosts = list({(h, ip) for h, ip, _p, _t in targets})
+            net_hits, net_rows = self._run_network(hosts)
+            hits.extend(net_hits)
+            stats["rows_probed"] += net_rows
         return hits, stats
 
     # ------------------------------------------------------------------
@@ -345,6 +403,56 @@ class ActiveScanner:
             t for t, s in zip(targets, result.status) if int(s) == scanio.STATUS_OPEN
         ]
 
+    def _run_network(self, hosts) -> tuple[list[ActiveHit], int]:
+        """(host × net request) banner probes → attributed hits."""
+        work = [
+            (host, ip, r_idx)
+            for host, ip in hosts
+            for r_idx in range(len(self.plan.net_requests))
+        ]
+        out: list[ActiveHit] = []
+        for w0 in range(0, len(work), self.wave_rows):
+            wave = work[w0 : w0 + self.wave_rows]
+            reqs = [self.plan.net_requests[r] for _h, _ip, r in wave]
+            result = scanio.tcp_scan(
+                [ip for _h, ip, _r in wave],
+                np.asarray([r.port for r in reqs], dtype=np.uint16),
+                [r.payload or None for r in reqs],
+                max_concurrency=int(self.executor.spec["concurrency"]),
+                connect_timeout_ms=int(self.executor.spec["connect_timeout_ms"]),
+                read_timeout_ms=int(self.executor.spec["read_timeout_ms"]),
+                banner_cap=int(self.executor.spec["banner_cap"]),
+            )
+            rows: list[Response] = []
+            meta: list[tuple[str, int, int]] = []
+            for i, (host, _ip, r_idx) in enumerate(wave):
+                if int(result.status[i]) != scanio.STATUS_OPEN or not result.banner(i):
+                    continue
+                rows.append(
+                    Response(
+                        host=host,
+                        port=self.plan.net_requests[r_idx].port,
+                        banner=result.banner(i),
+                    )
+                )
+                meta.append((host, self.plan.net_requests[r_idx].port, r_idx))
+            if not rows:
+                continue
+            for (host, port, r_idx), rm in zip(meta, self.engine.match(rows)):
+                owner_ids = self._net_owner_ids[r_idx]
+                for tid in rm.template_ids:
+                    if tid in owner_ids:
+                        out.append(
+                            ActiveHit(
+                                host=host,
+                                port=port,
+                                template_id=tid,
+                                path="",
+                                extractions=rm.extractions.get(tid, []),
+                            )
+                        )
+        return out, len(work)
+
     def _run_wave(self, wave) -> list[ActiveHit]:
         payloads = [
             self.plan.requests[r_idx].wire(host, port)
@@ -362,20 +470,23 @@ class ActiveScanner:
             banner_cap=int(self.executor.spec["banner_cap"]),
         )
         rows: list[Response] = []
-        meta: list[tuple[str, int, int]] = []  # (host, port, r_idx)
-        for i, (host, _ip, port, _t, r_idx) in enumerate(wave):
+        meta: list[tuple[str, int, bool, int]] = []  # (host, port, tls, r_idx)
+        for i, (host, _ip, port, t, r_idx) in enumerate(wave):
             if int(result.status[i]) != scanio.STATUS_OPEN:
                 continue
             code, header, body = parse_http_response(result.banner(i))
             rows.append(
-                Response(host=host, port=port, status=code, header=header, body=body)
+                Response(
+                    host=host, port=port, status=code,
+                    header=header, body=body, tls=t,
+                )
             )
-            meta.append((host, port, r_idx))
+            meta.append((host, port, t, r_idx))
         if not rows:
             return []
         matches = self.engine.match(rows)
         out: list[ActiveHit] = []
-        for (host, port, r_idx), rm in zip(meta, matches):
+        for (host, port, t, r_idx), rm in zip(meta, matches):
             owner_ids = self._owner_ids[r_idx]
             for tid in rm.template_ids:
                 if tid in owner_ids:
@@ -386,6 +497,7 @@ class ActiveScanner:
                             template_id=tid,
                             path=self.plan.requests[r_idx].path,
                             extractions=rm.extractions.get(tid, []),
+                            tls=t,
                         )
                     )
         return out

@@ -163,7 +163,7 @@ class ProbeExecutor:
     # ------------------------------------------------------------------
     def _parse_lines(
         self, target_lines: Sequence[str]
-    ) -> tuple[list[tuple[str, Optional[int], str]], list[str]]:
+    ) -> tuple[list[tuple[str, Optional[int], str, str]], list[str]]:
         """→ (parsed targets, malformed lines). Malformed lines become
         dead rows downstream so every input line stays accounted for."""
         parsed: list[tuple[str, Optional[int], str]] = []
@@ -179,7 +179,9 @@ class ProbeExecutor:
         return parsed, malformed
 
     def _resolve_names(
-        self, parsed: Sequence[tuple[str, Optional[int], str]], all_addrs: bool = False
+        self,
+        parsed: Sequence[tuple[str, Optional[int], str, str]],
+        all_addrs: bool = False,
     ) -> dict[str, list[str]]:
         """Bulk-resolve the non-IP hostnames in ``parsed`` → name→addrs
         (empty list when unresolvable)."""
@@ -266,7 +268,7 @@ class ProbeExecutor:
                 read_timeout_ms=int(self.spec["read_timeout_ms"]),
                 banner_cap=int(self.spec["banner_cap"]),
             )
-            for i, (host, _ip, port, _path, _tls) in enumerate(probes):
+            for i, (host, _ip, port, _path, tls_used) in enumerate(probes):
                 raw = result.banner(i)
                 if int(result.status[i]) != scanio.STATUS_OPEN:
                     rows.append(Response(host=host, port=port, alive=False))
@@ -276,7 +278,7 @@ class ProbeExecutor:
                     rows.append(
                         Response(
                             host=host, port=port, status=code,
-                            header=header, body=body,
+                            header=header, body=body, tls=tls_used,
                         )
                     )
                 else:

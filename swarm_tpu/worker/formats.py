@@ -27,8 +27,14 @@ _SERVER_RE = re.compile(rb"^server:[ \t]*(.+?)[ \t\r]*$", re.IGNORECASE | re.MUL
 
 
 def url_of(row: Response) -> str:
-    """Canonical URL for a probed row (httprobe/httpx conventions)."""
-    scheme = "https" if row.port in (443, 8443) else "http"
+    """Canonical URL for a probed row (httprobe/httpx conventions).
+
+    A row that records how it was actually probed (``row.tls``) renders
+    that scheme; otherwise the port heuristic applies."""
+    if row.tls is not None:
+        scheme = "https" if row.tls else "http"
+    else:
+        scheme = "https" if row.port in (443, 8443) else "http"
     default = 443 if scheme == "https" else 80
     if row.port in (default, 0):
         return f"{scheme}://{row.host}"

@@ -218,14 +218,20 @@ class JobProcessor:
         hits, stats = scanner.run(
             data.decode("utf-8", "surrogateescape").splitlines()
         )
-        sev, _proto = formats.severity_index(engine.templates)
+        sev, proto = formats.severity_index(engine.templates)
         lines = []
         for h in hits:
-            base = formats.url_of(Response(host=h.host, port=h.port))
+            p = proto.get(h.template_id, "http")
+            target = (
+                formats.url_of(Response(host=h.host, port=h.port, tls=h.tls))
+                + h.path
+                if p == "http"
+                else f"{h.host}:{h.port}"
+            )
             extra = " [" + ",".join(h.extractions) + "]" if h.extractions else ""
             lines.append(
-                f"[{h.template_id}] [http] [{sev.get(h.template_id, 'info')}] "
-                f"{base}{h.path}{extra}"
+                f"[{h.template_id}] [{p}] [{sev.get(h.template_id, 'info')}] "
+                f"{target}{extra}"
             )
         print(
             f"active scan: {stats['rows_probed']} requests over "

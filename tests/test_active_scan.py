@@ -222,3 +222,86 @@ def test_active_scan_dead_target():
     hits, stats = scanner.run(["127.0.0.1:1"])
     assert hits == [] and stats["live_targets"] == 0
     assert stats["rows_probed"] == 0  # liveness gate saved the fan-out
+
+
+NETWORK_TEMPLATE = """\
+id: demo-net-banner
+info:
+  name: fake rsyncd
+  severity: info
+network:
+  - inputs:
+      - data: "?\\r\\n"
+    host:
+      - "{{Hostname}}"
+      - "{{Host}}:%d"
+    matchers:
+      - type: word
+        words: ["FAKED: 31.0"]
+    extractors:
+      - type: regex
+        regex:
+          - 'FAKED: [0-9.]+'
+"""
+
+
+def test_network_template_plan_and_probe():
+    import socketserver
+
+    class Banner(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                self.request.sendall(b"FAKED: 31.0\n")
+                self.request.recv(64)
+            except OSError:
+                pass
+
+    class S(socketserver.ThreadingTCPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    srv = S(("127.0.0.1", 0), Banner)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    try:
+        from swarm_tpu.ops.engine import MatchEngine
+
+        t = T(NETWORK_TEMPLATE % port, path="network/demo-net.yaml")
+        plan = active.build_plan([t])
+        assert len(plan.net_requests) == 1
+        assert plan.net_requests[0].port == port
+        assert plan.net_requests[0].payload == b"?\r\n"
+
+        engine = MatchEngine([t])
+        scanner = active.ActiveScanner(engine, {"read_timeout_ms": 2500})
+        # target port is irrelevant: the net pass probes the template's port
+        hits, stats = scanner.run([f"127.0.0.1:{port}"])
+        net = [h for h in hits if h.template_id == "demo-net-banner"]
+        assert len(net) == 1
+        assert net[0].port == port
+        assert net[0].extractions == ["FAKED: 31.0"]
+    finally:
+        srv.shutdown()
+
+
+def test_network_template_no_port_skipped():
+    t = T(
+        """\
+id: net-hostname-only
+info:
+  name: x
+  severity: info
+network:
+  - inputs:
+      - data: "hi"
+    host:
+      - "{{Hostname}}"
+    matchers:
+      - type: word
+        words: ["x"]
+""",
+        path="network/hostname-only.yaml",
+    )
+    plan = active.build_plan([t])
+    assert plan.net_requests == []
+    assert plan.skipped["network-no-port"] == ["net-hostname-only"]
