@@ -36,13 +36,18 @@ def ensure_lib() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    # always invoke make: it is mtime-incremental, and a stale prebuilt
-    # .so from an older checkout would lack newer symbols
-    subprocess.run(
-        ["make", "-C", str(_NATIVE_DIR)],
-        check=True,
-        capture_output=True,
-    )
+    # invoke make when possible (mtime-incremental, so a stale prebuilt
+    # .so from an older checkout picks up new symbols); a deployment
+    # without a toolchain falls back to the shipped .so
+    try:
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+        )
+    except (OSError, subprocess.CalledProcessError):
+        if not _LIB_PATH.exists():
+            raise
     lib = ctypes.CDLL(str(_LIB_PATH))
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
     u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")

@@ -78,10 +78,14 @@ class PlannedRequest:
 
 @dataclasses.dataclass(frozen=True)
 class NetRequest:
-    """One network-protocol probe: raw bytes to a template-declared port."""
+    """One network-protocol probe: raw bytes to a template-declared port.
+
+    ``port`` 0 = the target's own port (a bare ``{{Hostname}}`` host
+    entry); ``tls`` = the ``tls://`` host-entry prefix."""
 
     port: int
     payload: bytes
+    tls: bool = False
 
 
 @dataclasses.dataclass
@@ -200,25 +204,34 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
 
     for t_idx, t in enumerate(templates):
         if t.protocol == "network":
-            # hosts entries declare the port: "{{Host}}:873"-style; the
-            # bare "{{Hostname}}" form rides the target's own port and
-            # needs no separate plan entry (SURVEY.md §2.3 network
-            # templates send inputs.data and match banners). Each
-            # operation carries its own (ports, payload) pair.
-            any_port = False
+            # hosts entries declare the port ("{{Host}}:873", optionally
+            # "tls://" prefixed); a bare "{{Hostname}}" rides the
+            # target's own port (planned as port 0, expanded per target
+            # at probe time). Each operation carries its own
+            # (ports, payload) pair (SURVEY.md §2.3: network templates
+            # send inputs.data and match banners).
+            any_entry = False
             for op in t.operations:
-                ports = set()
+                entries: set[tuple[int, bool]] = set()  # (port, tls)
                 for h in op.hosts:
+                    tls = False
+                    if "://" in h:
+                        scheme, _, h = h.partition("://")
+                        tls = scheme.lower() in ("tls", "ssl")
                     _, sep, port_s = h.rpartition(":")
                     if sep and port_s.isdigit():
-                        ports.add(int(port_s))
-                if not ports:
+                        entries.add((int(port_s), tls))
+                    else:
+                        entries.add((0, tls))  # target's own port
+                if not entries:
                     continue
-                any_port = True
+                any_entry = True
                 payload = b"".join(op.inputs)
-                for port in sorted(ports):
-                    add_net(NetRequest(port=port, payload=payload), t_idx)
-            if not any_port:
+                for port, tls in sorted(entries):
+                    add_net(
+                        NetRequest(port=port, payload=payload, tls=tls), t_idx
+                    )
+            if not any_entry:
                 skip("network-no-port", t)
             continue
         if t.protocol != "http":
@@ -379,13 +392,23 @@ class ActiveScanner:
             hits.extend(self._run_wave(wave))
 
         # network-protocol pass: template-declared ports on each host
-        # (one probe per host × net request, regardless of target port)
+        # (port-0 requests ride the target's own port)
         if self.plan.net_requests:
-            hosts = list({(h, ip) for h, ip, _p, _t in targets})
-            net_hits, net_rows = self._run_network(hosts)
+            net_hits, net_rows = self._run_network(targets)
             hits.extend(net_hits)
             stats["rows_probed"] += net_rows
-        return hits, stats
+
+        # one line per finding: a template observed via several requests
+        # on the same endpoint (e.g. {{Hostname}} + {{Host}}:<port> both
+        # landing on one service) reports once, as nuclei does
+        seen: set = set()
+        unique: list[ActiveHit] = []
+        for h in hits:
+            key = (h.host, h.port, h.template_id, h.path)
+            if key not in seen:
+                seen.add(key)
+                unique.append(h)
+        return unique, stats
 
     # ------------------------------------------------------------------
     def _liveness(self, targets):
@@ -403,55 +426,69 @@ class ActiveScanner:
             t for t, s in zip(targets, result.status) if int(s) == scanio.STATUS_OPEN
         ]
 
-    def _run_network(self, hosts) -> tuple[list[ActiveHit], int]:
-        """(host × net request) banner probes → attributed hits."""
-        work = [
-            (host, ip, r_idx)
-            for host, ip in hosts
-            for r_idx in range(len(self.plan.net_requests))
-        ]
+    def _attribute(self, rows, meta, owner_table) -> list[ActiveHit]:
+        """Device-match ``rows`` and keep each row's hits only for the
+        templates owning its request (shared by http and network passes).
+        ``meta``: (host, port, tls, r_idx, path) aligned with rows."""
         out: list[ActiveHit] = []
-        for w0 in range(0, len(work), self.wave_rows):
-            wave = work[w0 : w0 + self.wave_rows]
-            reqs = [self.plan.net_requests[r] for _h, _ip, r in wave]
+        if not rows:
+            return out
+        for (host, port, tls, r_idx, path), rm in zip(meta, self.engine.match(rows)):
+            owner_ids = owner_table[r_idx]
+            for tid in rm.template_ids:
+                if tid in owner_ids:
+                    out.append(
+                        ActiveHit(
+                            host=host,
+                            port=port,
+                            template_id=tid,
+                            path=path,
+                            extractions=rm.extractions.get(tid, []),
+                            tls=tls,
+                        )
+                    )
+        return out
+
+    def _run_network(self, targets) -> tuple[list[ActiveHit], int]:
+        """(host × net request) banner probes → attributed hits.
+
+        Port-0 requests expand to each target's own port; explicit-port
+        requests probe once per distinct host."""
+        work: set[tuple[str, str, int, int]] = set()  # (host, ip, port, r_idx)
+        for r_idx, req in enumerate(self.plan.net_requests):
+            if req.port:
+                for host, ip in {(h, ip) for h, ip, _p, _t in targets}:
+                    work.add((host, ip, req.port, r_idx))
+            else:
+                for host, ip, port, _t in targets:
+                    work.add((host, ip, port, r_idx))
+        work_list = sorted(work)  # deterministic probe/hit ordering
+        out: list[ActiveHit] = []
+        for w0 in range(0, len(work_list), self.wave_rows):
+            wave = work_list[w0 : w0 + self.wave_rows]
+            reqs = [self.plan.net_requests[r] for _h, _ip, _p, r in wave]
             result = scanio.tcp_scan(
-                [ip for _h, ip, _r in wave],
-                np.asarray([r.port for r in reqs], dtype=np.uint16),
+                [ip for _h, ip, _p, _r in wave],
+                np.asarray([p for _h, _ip, p, _r in wave], dtype=np.uint16),
                 [r.payload or None for r in reqs],
+                tls=[r.tls for r in reqs],
+                sni=[
+                    h if not is_ip(h) else None for h, _ip, _p, _r in wave
+                ],
                 max_concurrency=int(self.executor.spec["concurrency"]),
                 connect_timeout_ms=int(self.executor.spec["connect_timeout_ms"]),
                 read_timeout_ms=int(self.executor.spec["read_timeout_ms"]),
                 banner_cap=int(self.executor.spec["banner_cap"]),
             )
             rows: list[Response] = []
-            meta: list[tuple[str, int, int]] = []
-            for i, (host, _ip, r_idx) in enumerate(wave):
+            meta: list[tuple[str, int, bool, int, str]] = []
+            for i, (host, _ip, port, r_idx) in enumerate(wave):
                 if int(result.status[i]) != scanio.STATUS_OPEN or not result.banner(i):
                     continue
-                rows.append(
-                    Response(
-                        host=host,
-                        port=self.plan.net_requests[r_idx].port,
-                        banner=result.banner(i),
-                    )
-                )
-                meta.append((host, self.plan.net_requests[r_idx].port, r_idx))
-            if not rows:
-                continue
-            for (host, port, r_idx), rm in zip(meta, self.engine.match(rows)):
-                owner_ids = self._net_owner_ids[r_idx]
-                for tid in rm.template_ids:
-                    if tid in owner_ids:
-                        out.append(
-                            ActiveHit(
-                                host=host,
-                                port=port,
-                                template_id=tid,
-                                path="",
-                                extractions=rm.extractions.get(tid, []),
-                            )
-                        )
-        return out, len(work)
+                rows.append(Response(host=host, port=port, banner=result.banner(i)))
+                meta.append((host, port, reqs[i].tls, r_idx, ""))
+            out.extend(self._attribute(rows, meta, self._net_owner_ids))
+        return out, len(work_list)
 
     def _run_wave(self, wave) -> list[ActiveHit]:
         payloads = [
@@ -470,7 +507,7 @@ class ActiveScanner:
             banner_cap=int(self.executor.spec["banner_cap"]),
         )
         rows: list[Response] = []
-        meta: list[tuple[str, int, bool, int]] = []  # (host, port, tls, r_idx)
+        meta: list[tuple[str, int, bool, int, str]] = []
         for i, (host, _ip, port, t, r_idx) in enumerate(wave):
             if int(result.status[i]) != scanio.STATUS_OPEN:
                 continue
@@ -481,23 +518,5 @@ class ActiveScanner:
                     header=header, body=body, tls=t,
                 )
             )
-            meta.append((host, port, t, r_idx))
-        if not rows:
-            return []
-        matches = self.engine.match(rows)
-        out: list[ActiveHit] = []
-        for (host, port, t, r_idx), rm in zip(meta, matches):
-            owner_ids = self._owner_ids[r_idx]
-            for tid in rm.template_ids:
-                if tid in owner_ids:
-                    out.append(
-                        ActiveHit(
-                            host=host,
-                            port=port,
-                            template_id=tid,
-                            path=self.plan.requests[r_idx].path,
-                            extractions=rm.extractions.get(tid, []),
-                            tls=t,
-                        )
-                    )
-        return out
+            meta.append((host, port, t, r_idx, self.plan.requests[r_idx].path))
+        return self._attribute(rows, meta, self._owner_ids)

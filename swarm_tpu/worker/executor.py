@@ -166,7 +166,7 @@ class ProbeExecutor:
     ) -> tuple[list[tuple[str, Optional[int], str, str]], list[str]]:
         """→ (parsed targets, malformed lines). Malformed lines become
         dead rows downstream so every input line stays accounted for."""
-        parsed: list[tuple[str, Optional[int], str]] = []
+        parsed: list[tuple[str, Optional[int], str, str]] = []
         malformed: list[str] = []
         for line in target_lines:
             try:

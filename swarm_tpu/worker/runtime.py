@@ -321,16 +321,22 @@ class JobProcessor:
         )
         text = data.decode("utf-8", "surrogateescape")
         if module.input_format == "targets":
-            from swarm_tpu.worker.executor import ProbeExecutor
+            # double-buffered: probe wave i+1 while matching wave i
+            from swarm_tpu.worker.streaming import stream_match
 
-            rows = ProbeExecutor(module.probe).run(text.splitlines())
+            rows, results, _stats = stream_match(
+                engine,
+                text.splitlines(),
+                probe_spec=module.probe,
+                wave_targets=int(module.raw.get("wave_targets", 1024)),
+            )
         else:
             rows = []
             for line in text.splitlines():
                 row = parse_response_line(line)
                 if row is not None:
                     rows.append(row)
-        results = engine.match(rows)
+            results = engine.match(rows)
         if module.output_format == "nuclei":
             from swarm_tpu.worker import formats
 

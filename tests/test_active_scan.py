@@ -268,9 +268,9 @@ def test_network_template_plan_and_probe():
 
         t = T(NETWORK_TEMPLATE % port, path="network/demo-net.yaml")
         plan = active.build_plan([t])
-        assert len(plan.net_requests) == 1
-        assert plan.net_requests[0].port == port
-        assert plan.net_requests[0].payload == b"?\r\n"
+        # {{Hostname}} plans port 0 (target's own port) + the explicit port
+        assert sorted(r.port for r in plan.net_requests) == [0, port]
+        assert all(r.payload == b"?\r\n" for r in plan.net_requests)
 
         engine = MatchEngine([t])
         scanner = active.ActiveScanner(engine, {"read_timeout_ms": 2500})
@@ -284,7 +284,9 @@ def test_network_template_plan_and_probe():
         srv.shutdown()
 
 
-def test_network_template_no_port_skipped():
+def test_network_hostname_only_rides_target_port():
+    """A bare {{Hostname}} host entry probes the target's own port
+    (planned as port 0, expanded at probe time) — nuclei semantics."""
     t = T(
         """\
 id: net-hostname-only
@@ -293,15 +295,68 @@ info:
   severity: info
 network:
   - inputs:
-      - data: "hi"
+      - data: "?\\r\\n"
     host:
       - "{{Hostname}}"
     matchers:
       - type: word
-        words: ["x"]
+        words: ["FAKED: 31.0"]
 """,
         path="network/hostname-only.yaml",
     )
     plan = active.build_plan([t])
-    assert plan.net_requests == []
-    assert plan.skipped["network-no-port"] == ["net-hostname-only"]
+    assert len(plan.net_requests) == 1
+    assert plan.net_requests[0].port == 0  # = target's own port
+
+    import socketserver
+
+    class Banner(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                self.request.sendall(b"FAKED: 31.0\n")
+                self.request.recv(64)
+            except OSError:
+                pass
+
+    class S(socketserver.ThreadingTCPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    srv = S(("127.0.0.1", 0), Banner)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    try:
+        from swarm_tpu.ops.engine import MatchEngine
+
+        engine = MatchEngine([t])
+        scanner = active.ActiveScanner(engine, {"read_timeout_ms": 2500})
+        hits, _stats = scanner.run([f"127.0.0.1:{port}"])
+        assert [(h.template_id, h.port) for h in hits] == [
+            ("net-hostname-only", port)
+        ]
+    finally:
+        srv.shutdown()
+
+
+def test_network_tls_prefix_parsed():
+    t = T(
+        """\
+id: net-tls-probe
+info:
+  name: x
+  severity: info
+network:
+  - inputs:
+      - data: "ping"
+    host:
+      - "tls://{{Host}}:3389"
+    matchers:
+      - type: word
+        words: ["never-matches-here"]
+""",
+        path="network/tls-probe.yaml",
+    )
+    plan = active.build_plan([t])
+    assert len(plan.net_requests) == 1
+    assert plan.net_requests[0].port == 3389
+    assert plan.net_requests[0].tls is True

@@ -1,0 +1,146 @@
+"""Streaming probe→device pipeline (worker/streaming.py).
+
+The double-buffered wave pipeline must (a) produce byte-identical
+output to the sequential path, (b) actually overlap the two stages,
+(c) bound producer lookahead, and (d) propagate failures.
+"""
+
+import threading
+import time
+
+import pytest
+
+from swarm_tpu.worker.streaming import StreamingPipeline, stream_match
+
+
+def test_results_preserve_order_and_content():
+    probed = lambda wave: [f"probed:{t}" for t in wave]
+    consumed = lambda rows: [r.upper() for r in rows]
+    pipe = StreamingPipeline(probed, consumed, wave_targets=3)
+    out = pipe.run([f"t{i}" for i in range(10)])
+    flat = [x for wave in out for x in wave]
+    assert flat == [f"PROBED:T{i}" for i in range(10)]
+    assert pipe.stats.waves == 4  # 3+3+3+1
+    assert pipe.stats.rows == 10
+
+
+def test_stages_overlap():
+    """Producer and consumer busy windows must intersect."""
+    spans = {"probe": [], "match": []}
+    lock = threading.Lock()
+
+    def probe(wave):
+        t0 = time.perf_counter()
+        time.sleep(0.05)
+        with lock:
+            spans["probe"].append((t0, time.perf_counter()))
+        return wave
+
+    def consume(rows):
+        t0 = time.perf_counter()
+        time.sleep(0.05)
+        with lock:
+            spans["match"].append((t0, time.perf_counter()))
+        return rows
+
+    pipe = StreamingPipeline(probe, consume, wave_targets=1)
+    pipe.run(["a", "b", "c", "d"])
+    overlapping = any(
+        p0 < m1 and m0 < p1
+        for p0, p1 in spans["probe"]
+        for m0, m1 in spans["match"]
+    )
+    assert overlapping, "probe and match never ran concurrently"
+    # 4 waves × (0.05 + 0.05) sequential = 0.4s; pipelined ≈ 0.25s
+    assert pipe.stats.wall_seconds < 0.35
+    assert pipe.stats.overlap_seconds > 0.0
+
+
+def test_bounded_lookahead():
+    """With queue_depth=1 the producer may lead by ≤ depth+1 waves."""
+    produced = []
+    consumed = []
+
+    def probe(wave):
+        produced.append(wave[0])
+        return wave
+
+    def consume(rows):
+        time.sleep(0.03)
+        consumed.append(rows[0])
+        lead = len(produced) - len(consumed)
+        assert lead <= 2, f"producer ran {lead} waves ahead"
+        return rows
+
+    StreamingPipeline(probe, consume, wave_targets=1, queue_depth=1).run(
+        [str(i) for i in range(8)]
+    )
+    assert consumed == [str(i) for i in range(8)]
+
+
+def test_producer_exception_propagates():
+    def probe(wave):
+        raise RuntimeError("probe died")
+
+    pipe = StreamingPipeline(probe, lambda r: r, wave_targets=1)
+    with pytest.raises(RuntimeError, match="probe died"):
+        pipe.run(["a"])
+
+
+def test_consumer_exception_propagates_and_joins():
+    def consume(rows):
+        raise ValueError("device died")
+
+    pipe = StreamingPipeline(lambda w: w, consume, wave_targets=1)
+    with pytest.raises(ValueError, match="device died"):
+        pipe.run(["a", "b", "c"])
+
+
+def test_stream_match_equals_sequential(tmp_path):
+    """End-to-end: streamed targets-mode match == sequential match."""
+    import socketserver
+
+    from swarm_tpu.ops.engine import MatchEngine
+    from swarm_tpu.worker.executor import ProbeExecutor
+    from swarm_tpu.fingerprints import load_corpus
+
+    class H(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                self.request.recv(2048)
+                body = b"<html><title>Apache Tomcat</title>demo tech page</html>"
+                self.request.sendall(
+                    b"HTTP/1.1 200 OK\r\nServer: Apache\r\nContent-Length: "
+                    + str(len(body)).encode()
+                    + b"\r\nConnection: close\r\n\r\n"
+                    + body
+                )
+            except OSError:
+                pass
+
+    class S(socketserver.ThreadingTCPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    srv = S(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    try:
+        templates, _ = load_corpus("tests/data/templates")
+        engine = MatchEngine(templates)
+        targets = [f"127.0.0.1:{port}"] * 7 + ["127.0.0.1:1"]
+        spec = {"read_timeout_ms": 2500}
+
+        rows_s, results_s, stats = stream_match(
+            engine, targets, probe_spec=spec, wave_targets=3
+        )
+        rows_q = ProbeExecutor(spec).run(targets)
+        results_q = engine.match(rows_q)
+
+        assert [r.host for r in rows_s] == [r.host for r in rows_q]
+        assert [r.template_ids for r in results_s] == [
+            r.template_ids for r in results_q
+        ]
+        assert stats.waves == 3 and stats.rows == 8
+    finally:
+        srv.shutdown()
