@@ -97,6 +97,9 @@ class Operation:
     read_size: Optional[int] = None
     redirects: bool = False
     max_redirects: int = 0
+    # dns protocol: record type + query-name template ("{{FQDN}}")
+    dns_type: str = ""
+    dns_name: str = ""
 
 
 @dataclasses.dataclass

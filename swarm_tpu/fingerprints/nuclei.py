@@ -108,6 +108,9 @@ def _parse_operation(raw: dict, protocol: str) -> Operation:
         redirects=bool(raw.get("redirects", False)),
         max_redirects=int(raw.get("max-redirects", 0)),
     )
+    if protocol == "dns":
+        op.dns_type = str(raw.get("type") or "A").upper()
+        op.dns_name = str(raw.get("name") or "{{FQDN}}")
     if protocol == "network":
         for entry in _as_list(raw.get("inputs")):
             if isinstance(entry, dict):

@@ -96,6 +96,9 @@ class RequestPlan:
     planned_templates: set[int]  # template indices with ≥1 request
     net_requests: list[NetRequest] = dataclasses.field(default_factory=list)
     net_owners: list[set[int]] = dataclasses.field(default_factory=list)
+    # dns protocol: record types to query, each owned by its templates
+    dns_qtypes: list[str] = dataclasses.field(default_factory=list)
+    dns_owners: list[set[int]] = dataclasses.field(default_factory=list)
 
 
 def _substitute(text: str, host: str = "", port: int = 80) -> Optional[str]:
@@ -191,6 +194,9 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
         skipped.setdefault(reason, []).append(t.id)
 
     net_dedup: dict[NetRequest, int] = {}
+    dns_qtype_idx: dict[str, int] = {}
+    dns_qtypes_list: list[str] = []
+    dns_owners_list: list[set[int]] = []
 
     def add_net(req: NetRequest, t_idx: int) -> None:
         idx = net_dedup.get(req)
@@ -234,8 +240,30 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
             if not any_entry:
                 skip("network-no-port", t)
             continue
+        if t.protocol == "dns":
+            # dns templates query one record type for the target name;
+            # several templates share a query (4 CNAME templates → one
+            # CNAME query per host)
+            from swarm_tpu.worker.dnsquery import QTYPES
+
+            any_q = False
+            for op in t.operations:
+                qtype = op.dns_type or "A"
+                if qtype not in QTYPES:
+                    continue
+                any_q = True
+                if qtype in dns_qtype_idx:
+                    dns_owners_list[dns_qtype_idx[qtype]].add(t_idx)
+                else:
+                    dns_qtype_idx[qtype] = len(dns_qtypes_list)
+                    dns_qtypes_list.append(qtype)
+                    dns_owners_list.append({t_idx})
+                planned.add(t_idx)
+            if not any_q:
+                skip("dns-qtype", t)
+            continue
         if t.protocol != "http":
-            continue  # dns/file/headless/ssl handled elsewhere
+            continue  # file/headless/ssl handled elsewhere
         if any(op.payloads for op in t.operations):
             skip("payloads", t)
             continue
@@ -307,6 +335,8 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
         planned_templates=planned,
         net_requests=list(net_dedup),
         net_owners=net_owners_list,
+        dns_qtypes=dns_qtypes_list,
+        dns_owners=dns_owners_list,
     )
 
 
@@ -342,6 +372,9 @@ class ActiveScanner:
         self._net_owner_ids = [
             {self._tid[i] for i in owner} for owner in self.plan.net_owners
         ]
+        self._dns_owner_ids = [
+            {self._tid[i] for i in owner} for owner in self.plan.dns_owners
+        ]
 
     def run(self, target_lines: Sequence[str]) -> tuple[list[ActiveHit], dict]:
         parsed, malformed = self.executor._parse_lines(target_lines)
@@ -371,7 +404,10 @@ class ActiveScanner:
                 k: len(v) for k, v in self.plan.skipped.items()
             },
         }
-        if not targets or not (self.plan.requests or self.plan.net_requests):
+        plan_has_work = (
+            self.plan.requests or self.plan.net_requests or self.plan.dns_qtypes
+        )
+        if not targets or not plan_has_work:
             return hits, stats
 
         # liveness pre-pass: one connect per target; only live targets
@@ -397,6 +433,12 @@ class ActiveScanner:
             net_hits, net_rows = self._run_network(targets)
             hits.extend(net_hits)
             stats["rows_probed"] += net_rows
+
+        # dns-protocol pass: typed queries per distinct hostname
+        if self.plan.dns_qtypes:
+            dns_hits, dns_rows = self._run_dns(parsed, addr_of)
+            hits.extend(dns_hits)
+            stats["rows_probed"] += dns_rows
 
         # one line per finding: a template observed via several requests
         # on the same endpoint (e.g. {{Hostname}} + {{Host}}:<port> both
@@ -448,6 +490,45 @@ class ActiveScanner:
                         )
                     )
         return out
+
+    def _run_dns(self, parsed, addr_of) -> tuple[list[ActiveHit], int]:
+        """Typed DNS queries per distinct target name → attributed hits.
+
+        Matchers run over the dig-style rendering (dnsquery.render), so
+        rcode words (SERVFAIL/REFUSED) and answer rdata both match."""
+        from swarm_tpu.worker import dnsquery
+        from swarm_tpu.worker.executor import _system_resolvers
+
+        hosts = sorted({t[0] for t in parsed})
+        if not hosts:
+            return [], 0
+        resolvers = list(self.executor.spec["resolvers"]) or _system_resolvers()
+        if not resolvers:
+            return [], 0
+        queries: list[tuple[str, str]] = []
+        meta_q: list[tuple[str, int]] = []  # (host, qtype idx)
+        for host in hosts:
+            for q_idx, qtype in enumerate(self.plan.dns_qtypes):
+                qname = (
+                    dnsquery.reverse_name(host)
+                    if qtype == "PTR" and is_ip(host)
+                    else host
+                )
+                queries.append((qname, qtype))
+                meta_q.append((host, q_idx))
+        replies = dnsquery.query_batch(
+            queries,
+            resolvers,
+            timeout_ms=int(self.executor.spec["read_timeout_ms"]),
+        )
+        rows: list[Response] = []
+        meta: list[tuple[str, int, bool, int, str]] = []
+        for (host, q_idx), reply in zip(meta_q, replies):
+            if reply is None:
+                continue
+            rows.append(Response(host=host, port=53, banner=reply.render()))
+            meta.append((host, 53, False, q_idx, ""))
+        return self._attribute(rows, meta, self._dns_owner_ids), len(queries)
 
     def _run_network(self, targets) -> tuple[list[ActiveHit], int]:
         """(host × net request) banner probes → attributed hits.

@@ -1,0 +1,232 @@
+"""DNS-protocol template execution: typed queries, rendering, matching.
+
+Covers the corpus's 17 dns templates' op surface (SURVEY.md §2.3):
+CNAME/MX/TXT/CAA/NS/PTR/A queries, dig-style rendering the matchers
+run over, rcode words, and the active-scanner dns pass end-to-end
+against a local UDP resolver.
+"""
+
+import socket
+import socketserver
+import struct
+import textwrap
+import threading
+
+import pytest
+import yaml
+
+from swarm_tpu.fingerprints.nuclei import parse_template
+from swarm_tpu.worker import active, dnsquery
+
+
+# ---------------------------------------------------------------------------
+# wire codec unit tests (loopback through our own builder/parser)
+
+
+def _answer(name_ptr: int, rtype: int, rdata: bytes) -> bytes:
+    return (
+        struct.pack("!H", 0xC000 | name_ptr)
+        + struct.pack("!HHIH", rtype, 1, 300, len(rdata))
+        + rdata
+    )
+
+
+def _reply_packet(qid: int, qname: str, qtype: int, answers, rcode=0) -> bytes:
+    q = dnsquery._encode_qname(qname)
+    hdr = struct.pack("!HHHHHH", qid, 0x8180 | rcode, 1, len(answers), 0, 0)
+    body = q + struct.pack("!HH", qtype, 1)
+    return hdr + body + b"".join(answers)
+
+
+def _name_bytes(name: str) -> bytes:
+    return dnsquery._encode_qname(name)
+
+
+def test_parse_cname_reply():
+    pkt = _reply_packet(
+        0, "docs.example.com", 5,
+        [_answer(12, 5, _name_bytes("target.github.io"))],
+    )
+    reply = dnsquery.parse_reply(pkt, "docs.example.com", "CNAME")
+    assert reply.rcode == "NOERROR"
+    assert reply.answers[0].type_name == "CNAME"
+    assert reply.answers[0].rdata == "target.github.io"
+    assert b"github.io" in reply.render()
+
+
+def test_parse_mx_txt_caa():
+    pkt = _reply_packet(
+        0, "example.com", 255,
+        [
+            _answer(12, 15, struct.pack("!H", 10) + _name_bytes("mail.example.com")),
+            _answer(12, 16, b"\x0bv=spf1 -all"),
+            _answer(12, 257, b"\x00\x05issue" + b"letsencrypt.org"),
+        ],
+    )
+    reply = dnsquery.parse_reply(pkt, "example.com", "ANY")
+    rendered = reply.render().decode()
+    assert "10 mail.example.com" in rendered
+    assert '"v=spf1 -all"' in rendered
+    assert 'issue "letsencrypt.org"' in rendered
+
+
+def test_parse_servfail_rcode():
+    pkt = _reply_packet(0, "broken.example", 1, [], rcode=2)
+    reply = dnsquery.parse_reply(pkt, "broken.example", "A")
+    assert reply.rcode == "SERVFAIL"
+    assert b"SERVFAIL" in reply.render()
+
+
+def test_reverse_name():
+    assert dnsquery.reverse_name("192.0.2.7") == "7.2.0.192.in-addr.arpa"
+
+
+def test_compressed_name_decompression():
+    # name at offset 12 (the question), answer CNAME pointing into it
+    pkt = _reply_packet(
+        0, "a.b.example.com", 5,
+        [_answer(12, 5, struct.pack("!H", 0xC000 | 14))],  # ptr into qname
+    )
+    reply = dnsquery.parse_reply(pkt, "a.b.example.com", "CNAME")
+    assert reply.answers[0].rdata.endswith("example.com")
+
+
+# ---------------------------------------------------------------------------
+# local UDP resolver fixture
+
+
+class _UDPServer(socketserver.ThreadingUDPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+@pytest.fixture
+def dns_server():
+    """Answers CNAME queries for *.example.test with ghs.googlehosted.com;
+    SERVFAIL for servfail.test; empty NOERROR otherwise."""
+
+    class H(socketserver.BaseRequestHandler):
+        def handle(self):
+            data, sock = self.request
+            if len(data) < 12:
+                return
+            qid = data[:2]
+            qname, off = dnsquery._read_name(data, 12)
+            qtype = struct.unpack("!H", data[off : off + 2])[0]
+            question = data[12 : off + 4]
+            if qname.endswith("servfail.test"):
+                hdr = qid + struct.pack("!HHHHH", 0x8182, 1, 0, 0, 0)
+                sock.sendto(hdr + question, self.client_address)
+                return
+            answers = b""
+            an = 0
+            if qtype == 5 and qname.endswith("example.test"):
+                rdata = dnsquery._encode_qname("ghs.googlehosted.com")
+                answers = (
+                    struct.pack("!H", 0xC00C)
+                    + struct.pack("!HHIH", 5, 1, 60, len(rdata))
+                    + rdata
+                )
+                an = 1
+            hdr = qid + struct.pack("!HHHHH", 0x8180, 1, an, 0, 0)
+            sock.sendto(hdr + question + answers, self.client_address)
+
+    srv = _UDPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def test_query_batch_against_local_resolver(dns_server):
+    replies = dnsquery.query_batch(
+        [("app.example.test", "CNAME"), ("app.servfail.test", "A"),
+         ("other.test", "CNAME")],
+        ["127.0.0.1"],
+        timeout_ms=2000,
+        port=dns_server,
+    )
+    assert replies[0] is not None
+    assert "ghs.googlehosted.com" in replies[0].answers[0].rdata
+    assert replies[1].rcode == "SERVFAIL"
+    assert replies[2].rcode == "NOERROR" and not replies[2].answers
+
+
+# ---------------------------------------------------------------------------
+# active-scanner dns pass end-to-end
+
+
+DNS_TEMPLATE = """\
+id: demo-cname-service
+info:
+  name: cname service detect
+  severity: info
+dns:
+  - name: "{{FQDN}}"
+    type: CNAME
+    matchers:
+      - type: word
+        name: googlehosted
+        words:
+          - "googlehosted.com"
+"""
+
+SERVFAIL_TEMPLATE = """\
+id: demo-servfail
+info:
+  name: servfail detect
+  severity: info
+dns:
+  - name: "{{FQDN}}"
+    type: A
+    matchers:
+      - type: word
+        words:
+          - "SERVFAIL"
+          - "REFUSED"
+"""
+
+
+def T(doc, path="dns/x.yaml"):
+    return parse_template(yaml.safe_load(textwrap.dedent(doc)), source_path=path)
+
+
+def test_dns_plan_dedups_qtypes():
+    t1 = T(DNS_TEMPLATE)
+    t2 = T(DNS_TEMPLATE.replace("demo-cname-service", "other-cname"))
+    t3 = T(SERVFAIL_TEMPLATE)
+    plan = active.build_plan([t1, t2, t3])
+    assert sorted(plan.dns_qtypes) == ["A", "CNAME"]
+    cname_idx = plan.dns_qtypes.index("CNAME")
+    assert plan.dns_owners[cname_idx] == {0, 1}
+
+
+def test_dns_pass_end_to_end(dns_server, monkeypatch):
+    from swarm_tpu.ops.engine import MatchEngine
+    from swarm_tpu.worker import dnsquery as dq
+
+    real_batch = dq.query_batch
+    monkeypatch.setattr(
+        dq, "query_batch",
+        lambda queries, resolvers, timeout_ms=2000, retries=1, port=53:
+            real_batch(queries, resolvers, timeout_ms, retries, port=dns_server),
+    )
+    templates = [T(DNS_TEMPLATE), T(SERVFAIL_TEMPLATE)]
+    engine = MatchEngine(templates)
+    scanner = active.ActiveScanner(
+        engine, {"resolvers": ["127.0.0.1"], "read_timeout_ms": 2000}
+    )
+    # bypass A-record resolution: point both names at localhost
+    monkeypatch.setattr(
+        scanner.executor, "_resolve_names",
+        lambda parsed, all_addrs=False: {
+            t[0]: ["127.0.0.1"] for t in parsed
+        },
+    )
+    hits, stats = scanner.run(["app.example.test:1", "app.servfail.test:1"])
+    got = {(h.template_id, h.host) for h in hits}
+    assert ("demo-cname-service", "app.example.test") in got
+    assert ("demo-servfail", "app.servfail.test") in got
+    # no cross-attribution: servfail template must not fire on the
+    # healthy name, nor cname on the servfail name
+    assert ("demo-servfail", "app.example.test") not in got
+    assert ("demo-cname-service", "app.servfail.test") not in got
