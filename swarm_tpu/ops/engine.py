@@ -22,7 +22,7 @@ import numpy as np
 from swarm_tpu.fingerprints.compile import CompiledDB, compile_corpus
 from swarm_tpu.fingerprints.model import Response, Template
 from swarm_tpu.ops import cpu_ref
-from swarm_tpu.ops.encoding import encode_batch
+from swarm_tpu.ops.encoding import encode_batch, round_up
 from swarm_tpu.ops.match import DeviceDB
 
 
@@ -55,6 +55,7 @@ class MatchEngine:
         batch_rows: int = 1024,
         candidate_k: int = 128,
         host_always: str = "full",  # "full" (exact) | "skip" (device-only)
+        mesh="auto",  # "auto" | None | jax.sharding.Mesh
     ):
         self.templates = list(templates)
         self.db: CompiledDB = compile_corpus(self.templates)
@@ -64,6 +65,18 @@ class MatchEngine:
         self.batch_rows = batch_rows
         self.host_always_mode = host_always
         self.stats = EngineStats()
+        # Multi-chip: shard each batch dp×tp×sp across the local mesh
+        # (the production analog of the reference's chunk-per-worker
+        # scale-out, server/server.py:465-515 — here one worker drives a
+        # whole slice). "auto" shards whenever >1 device is visible;
+        # sharding never changes results (tests/test_sharding.py).
+        # Resolution is lazy: construction must stay JAX-free (the
+        # oracle-only and pre-fork users never touch a device).
+        self._mesh_arg = mesh
+        self._backend_ready = mesh is None
+        self.sharded = None
+        self.mesh = None
+        self._candidate_k = candidate_k
         # templates with extractors need a host pass on *hits* even when
         # the verdict itself was device-certain, so extraction output
         # stays bit-identical to the oracle
@@ -84,6 +97,64 @@ class MatchEngine:
         return out
 
     # ------------------------------------------------------------------
+    def _resolve_backend(self) -> None:
+        """First-match mesh resolution (kept out of __init__ so engine
+        construction never initializes the JAX backend)."""
+        mesh = self._mesh_arg
+        if mesh == "auto":
+            import jax
+
+            mesh = None
+            if len(jax.devices()) > 1:
+                from swarm_tpu.parallel.mesh import make_mesh
+
+                mesh = make_mesh()
+        if mesh is not None:
+            from swarm_tpu.parallel.sharded import ShardedMatcher
+
+            self.sharded = ShardedMatcher(self.db, mesh, candidate_k=self._candidate_k)
+            self.mesh = mesh
+        self._backend_ready = True
+
+    # ------------------------------------------------------------------
+    def _encode_for_backend(self, rows: Sequence[Response]):
+        """Encode rows for whichever device backend is active.
+
+        The sharded backend needs the batch row count divisible by the
+        'data' axis and every stream width divisible by 'seq' with each
+        per-rank slice at least one halo wide (parallel/sharded.py
+        raises otherwise); padding is zeros, which the length masks
+        already ignore, and padded rows are sliced off the verdicts.
+        """
+        if not self._backend_ready:
+            self._resolve_backend()
+        if self.sharded is None:
+            return (
+                encode_batch(rows, max_body=self.max_body, max_header=self.max_header),
+                self.device,
+            )
+        data_ranks = self.sharded.ranks.get("data", 1)
+        seq_ranks = self.sharded.ranks.get("seq", 1)
+        batch = encode_batch(
+            rows,
+            max_body=self.max_body,
+            max_header=self.max_header,
+            pad_rows_to=round_up(len(rows), data_ranks),
+        )
+        if seq_ranks > 1:
+            halo = self.sharded.halo
+            for name, arr in batch.streams.items():
+                per_rank = max(
+                    round_up(arr.shape[1], seq_ranks) // seq_ranks, halo
+                )
+                target = round_up(per_rank, 128) * seq_ranks
+                if target > arr.shape[1]:
+                    batch.streams[name] = np.pad(
+                        arr, ((0, 0), (0, target - arr.shape[1]))
+                    )
+        return batch, self.sharded
+
+    # ------------------------------------------------------------------
     def _match_batch(self, all_rows: Sequence[Response]) -> list[RowMatches]:
         # dead rows (no response observed) match nothing by contract —
         # drop them before encoding so the device never pays for them
@@ -97,14 +168,15 @@ class MatchEngine:
             self.stats.rows += len(all_rows) - len(alive_idx)
             return out
         rows = all_rows
-        batch = encode_batch(rows, max_body=self.max_body, max_header=self.max_header)
+        batch, matcher = self._encode_for_backend(rows)
         t0 = time.perf_counter()
-        t_value, t_unc, overflow = self.device.match(
+        t_value, t_unc, overflow = matcher.match(
             batch.streams, batch.lengths, batch.status
         )
-        t_value = np.asarray(t_value)
-        t_unc = np.asarray(t_unc)
-        overflow = np.asarray(overflow)
+        # slice off mesh row padding before the host walk
+        t_value = np.asarray(t_value)[: len(rows)]
+        t_unc = np.asarray(t_unc)[: len(rows)]
+        overflow = np.asarray(overflow)[: len(rows)]
         self.stats.device_seconds += time.perf_counter() - t0
         self.stats.rows += len(rows)
         self.stats.batches += 1

@@ -186,11 +186,17 @@ class JobProcessor:
             return {}
         engine, rows0, dev0, confirm0 = mark
         ds = engine.stats
-        return {
+        out = {
             "rows": ds.rows - rows0,
             "device_s": round(ds.device_seconds - dev0, 6),
             "host_confirm_s": round(ds.host_confirm_seconds - confirm0, 6),
         }
+        mesh = getattr(engine, "mesh", None)
+        if mesh is not None:
+            out["mesh"] = "x".join(
+                f"{ax}{int(mesh.shape[ax])}" for ax in mesh.axis_names
+            )
+        return out
 
     # ------------------------------------------------------------------
     def _execute_active(self, module: ModuleSpec, data: bytes) -> bytes:

@@ -90,7 +90,11 @@ def assert_parity(templates, rows, **engine_kw):
     return eng
 
 
-def test_parity_synthetic_corpus():
+@pytest.mark.parametrize("mesh", ["auto", None], ids=["sharded", "single-device"])
+def test_parity_synthetic_corpus(mesh):
+    # both device backends must agree with the oracle: "auto" engages
+    # the 8-device conftest mesh, None pins the single-device DeviceDB
+    # (the production path on a real 1-chip worker)
     templates, errors = load_corpus(DATA)
     assert not errors
     rng = random.Random(7)
@@ -98,8 +102,9 @@ def test_parity_synthetic_corpus():
     # deliberate exact-dsl rows
     rows.append(model.Response(host="f", port=80, status=200, body=b"0123456789abcdef"))
     rows.append(model.Response(host="g", port=80, status=200, body=b"q" * 1999))
-    eng = assert_parity(templates, rows)
+    eng = assert_parity(templates, rows, mesh=mesh)
     assert eng.stats.rows == len(rows)
+    assert (eng.sharded is not None) == (mesh == "auto")
 
 
 @pytest.mark.skipif(not REFERENCE_CORPUS.is_dir(), reason="reference corpus absent")

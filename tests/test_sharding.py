@@ -77,3 +77,46 @@ def test_table_sharding_covers_all_groups(world):
                 counts = arrs["entry_count"][r]
                 seen.extend(int(h) for h, c in zip(h1s, counts) if c > 0)
             assert sorted(seen) == sorted(int(h) for h in table.group_h1)
+
+
+# ----------------------------------------------------------------------
+# Production path: MatchEngine auto-meshes over all visible devices and
+# must return byte-identical RowMatches to the single-device engine —
+# including uneven row counts (mesh row padding) and extractions.
+# ----------------------------------------------------------------------
+
+def _engine_results(templates, rows, **kw):
+    from swarm_tpu.ops.engine import MatchEngine
+
+    eng = MatchEngine(templates, max_body=512, max_header=512, **kw)
+    return eng, eng.match(rows)
+
+
+@pytest.mark.parametrize("n_rows", [1, 13])
+def test_engine_sharded_equals_single_device(n_rows):
+    templates, _ = load_corpus(DATA)
+    rng = random.Random(101)
+    rows = fuzz_rows(templates, rng, n_rows)
+
+    single_eng, single = _engine_results(templates, rows, mesh=None)
+    auto_eng, auto = _engine_results(templates, rows, mesh="auto")
+    assert auto_eng.sharded is not None, "8-device conftest mesh must engage"
+    assert single_eng.sharded is None
+
+    assert len(single) == len(auto) == n_rows
+    for s, a in zip(single, auto):
+        assert sorted(s.template_ids) == sorted(a.template_ids)
+        assert s.extractions == a.extractions
+
+
+def test_engine_explicit_mesh_shapes():
+    templates, _ = load_corpus(DATA)
+    rng = random.Random(7)
+    rows = fuzz_rows(templates, rng, 6)
+    _, base = _engine_results(templates, rows, mesh=None)
+    for shape in ((8, 1, 1), (2, 2, 2), (1, 2, 4)):
+        mesh = make_mesh(shape)
+        _, got = _engine_results(templates, rows, mesh=mesh)
+        for s, a in zip(base, got):
+            assert sorted(s.template_ids) == sorted(a.template_ids)
+            assert s.extractions == a.extractions
