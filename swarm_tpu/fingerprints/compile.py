@@ -9,12 +9,12 @@ shells out to nuclei/nmap for this entire layer):
   q-gram (8-gram, or 4-gram for short words) in per-(stream, case, q)
   hash tables — sorted unique h1 groups + entry arrays + a Bloom bitmap
   probed by the kernel. Tiny slots (1–3 bytes) take a dense shifted
-  compare (exact). The kernel verifies q-gram hits via 128 hash bits
-  (entry h1/h2 + suffix-gram h1/h2) — every q-gram hit is marked
-  *uncertain* and host-confirmed (hits are sparse in scanning), so no
-  byte gathers run on device. ``slot_bytes``/``slot_len`` are retained
-  for the planned fused-Pallas byte-exact verify, which will clear the
-  uncertain bit on device.
+  compare (exact). The kernel screens q-gram hits via 128 hash bits
+  (entry h1/h2 + suffix-gram h1/h2), then **byte-verifies** each hit on
+  device by gathering the window under ``slot_bytes``/``slot_len`` and
+  comparing — a verified hit is *certain* (no host confirm), a failed
+  compare is a proven non-match, and only slots longer than
+  ``VERIFY_WIDTH`` (prefix-verified) stay uncertain.
 - Matchers lower to records over those bits plus scalar features
   (status, part lengths): word/binary → slot-bucket reductions,
   status/size → scalar compares, simple dsl → conjunctive scalar
@@ -107,12 +107,61 @@ def _gram_offsets_by_rarity(data: bytes, q: int) -> list[int]:
 # ---------------------------------------------------------------------------
 
 
-def required_literal(pattern: str, min_len: int = 4) -> Optional[bytes]:
-    """Longest byte literal that must occur in any match of ``pattern``.
+MAX_LITERAL_ALTS = 8  # cap on any-of literal sets from alternations
 
-    Conservative walk of the sre parse tree: only literals on required,
-    non-alternating paths count. Returns None when nothing ≥ min_len is
-    guaranteed — those regexes make their template host-always.
+
+def _lower_ascii(data: bytes) -> bytes:
+    return bytes(lower_bytes_np(np.frombuffer(data, np.uint8)).tobytes())
+
+
+# Strings present in ~every HTTP(HTML) response: a required literal that
+# is (or sits inside) one of these fires on all traffic, so candidates
+# containing only such members rank below any discriminating set.
+_UBIQUITOUS = (
+    b"<title>", b"</title>", b"<html", b"</html>", b"<head", b"</head>",
+    b"<body", b"</body>", b"<div", b"</div>", b"<span", b"</span>",
+    b"<link", b"<meta", b"<script", b"</script>", b"href=", b"src=",
+    b"http://", b"https://", b"content-type", b"text/html", b"charset=",
+    b"</a>", b"utf-8", b"class=", b"style=", b"width=", b"id=",
+)
+
+
+def _lit_rarity(lit: bytes) -> int:
+    """Effective discriminating length of one literal: a literal that is
+    itself (a piece of) boilerplate prunes nothing; one that merely
+    *contains* boilerplate plus more is judged by its full length."""
+    if any(lit in u for u in _UBIQUITOUS):
+        return 1
+    return len(lit)
+
+
+def _litset_score(cand: list[bytes]) -> tuple[int, int]:
+    """(min member rarity, -member count): every member must be rare
+    for the set to prune, since any member firing routes to confirm."""
+    return (min(_lit_rarity(c) for c in cand), -len(cand))
+
+
+def required_literal_set(
+    pattern: str, min_len: int = 4, max_alts: int = MAX_LITERAL_ALTS
+) -> Optional[list[bytes]]:
+    """A set S of lowered byte literals such that **every** match of
+    ``pattern`` contains at least one s ∈ S as a substring.
+
+    Walks the sre parse tree keeping a *set* of literal runs: an
+    alternation multiplies the run set by each branch's full literal
+    expansions (so ``(?:InvalidURI|NoSuchBucket)`` and case-permutation
+    chains like ``(f|F)(i|I)…`` both resolve — the latter collapses to
+    one literal after ASCII lowering, since the probe always runs on
+    the lowered stream). Non-literal nodes flush the run set as a
+    candidate. Returns the best candidate (longest minimum member, then
+    fewest members) with every member ≥ min_len, or None.
+
+    Soundness: a run set is only considered when every member reflects
+    a byte sequence forced by one complete alternation path; ASCII
+    lowering is sound because the device probes the lowered stream
+    (non-A-Z bytes are untouched on both sides). Runs collected under
+    case-insensitivity with non-ASCII bytes are rejected — Python folds
+    Unicode there, device lowering is ASCII-only.
     """
     try:
         import re._parser as sre_parse  # py3.11+
@@ -124,61 +173,191 @@ def required_literal(pattern: str, min_len: int = 4) -> Optional[bytes]:
         return None
 
     global_ci = bool(tree.state.flags & re.IGNORECASE)
+    best: list[Optional[list[bytes]]] = [None]
 
-    # best required literal; a run collected under case-insensitivity
-    # (global or scoped (?i:...)) is unusable if it has non-ASCII bytes —
-    # Python folds Unicode over the latin-1 decode, device lowering is
-    # ASCII-only, so the lowered probe would not be a superset.
-    best: list[bytes] = [b""]
-
-    def consider(run: bytes, ci: bool) -> None:
-        if ci and any(b >= 0x80 for b in run):
+    def consider(cand: list[bytes]) -> None:
+        if not cand or any(len(c) < min_len for c in cand):
             return
-        if len(run) > len(best[0]):
-            best[0] = bytes(run)
+        cur = best[0]
+        if cur is None or _litset_score(cand) > _litset_score(cur):
+            best[0] = cand
 
-    def walk(seq, ci: bool) -> None:
-        run = bytearray()
+    def class_alts(arg, ci: bool) -> Optional[list[bytes]]:
+        """Small literal character class [Gg] → its (lowered) bytes."""
+        alts = set()
+        for kind, val in arg:
+            if str(kind) != "LITERAL" or not (0 <= val < 256):
+                return None
+            if ci and val >= 0x80:
+                # Python folds Unicode over the latin-1 decode; ASCII
+                # lowering can't reproduce that, so the set would not
+                # be necessary
+                return None
+            alts.add(_lower_ascii(bytes([val])))
+            if len(alts) > 4:
+                return None
+        return sorted(alts)
 
-        def flush():
-            nonlocal run
-            consider(bytes(run), ci)
-            run = bytearray()
+    def expansions(seq, ci: bool) -> Optional[list[bytes]]:
+        """All full literal expansions of ``seq`` (lowered, deduped), or
+        None if any part is not literal/branch/class/fixed-repeat.
+        Lowering is sound: the probe always scans the lowered stream."""
+        outs = [b""]
+
+        def cross(alts: list[bytes]) -> bool:
+            nonlocal outs
+            outs = sorted({o + a for o in outs for a in alts})
+            return len(outs) <= max_alts
 
         for op, arg in seq:
             opname = str(op)
             if opname == "LITERAL" and 0 <= arg < 256:
-                run.append(arg)
-            elif opname == "MAX_REPEAT" or opname == "MIN_REPEAT":
-                lo, _hi, child = arg
-                flush()
-                if lo >= 1:
-                    walk(child, ci)
+                if ci and arg >= 0x80:
+                    return None  # Unicode folding ≠ ASCII lowering
+                if not cross([_lower_ascii(bytes([arg]))]):
+                    return None
+            elif opname == "IN":
+                alts = class_alts(arg, ci)
+                if alts is None or not cross(alts):
+                    return None
             elif opname == "SUBPATTERN":
-                # arg = (group, add_flags, del_flags, seq): scoped flags
-                flush()
                 child_ci = (ci or bool(arg[1] & re.IGNORECASE)) and not bool(
                     arg[2] & re.IGNORECASE
                 )
-                walk(arg[3], child_ci)
+                child = expansions(arg[3], child_ci)
+                if child is None or not cross(child):
+                    return None
+            elif opname == "BRANCH":
+                alts = []
+                for branch in arg[1]:
+                    exp = expansions(branch, ci)
+                    if exp is None:
+                        return None
+                    alts.extend(exp)
+                if not cross(alts):
+                    return None
+            elif opname == "MAX_REPEAT" or opname == "MIN_REPEAT":
+                lo, hi, child = arg
+                if lo != hi:
+                    return None
+                exp = expansions(child, ci)
+                if exp is None:
+                    return None
+                for _ in range(int(lo)):
+                    if not cross(exp):
+                        return None
             elif opname == "AT":
-                # zero-width assertion: consumes nothing, so bytes on either
-                # side are still adjacent in any match — run continues.
                 continue
             else:
-                # IN, BRANCH, ANY, CATEGORY, GROUPREF… — not a required literal
+                return None
+        return outs
+
+    def nec_set(seq, ci: bool) -> Optional[list[bytes]]:
+        """Best necessary literal set of a subsequence (its own walk)."""
+        saved = best[0]
+        best[0] = None
+        walk(seq, ci)
+        out = best[0]
+        best[0] = saved
+        return out
+
+    def walk(seq, ci: bool) -> None:
+        # runs: every member lowered; every match of the consumed prefix
+        # contains one member as a contiguous substring
+        runs: list[bytes] = [b""]
+
+        def runs_candidate() -> None:
+            if all(runs) and runs != [b""]:
+                consider(sorted(set(runs)))
+
+        def flush() -> None:
+            nonlocal runs
+            runs_candidate()
+            runs = [b""]
+
+        def extend(alts: list[bytes]) -> None:
+            nonlocal runs
+            new = sorted({r + a for r in runs for a in alts})
+            if len(new) > max_alts:
+                flush()
+            else:
+                runs = new
+
+        for op, arg in seq:
+            opname = str(op)
+            if opname == "LITERAL" and 0 <= arg < 256:
+                if ci and arg >= 0x80:
+                    flush()
+                else:
+                    extend([_lower_ascii(bytes([arg]))])
+            elif opname == "IN":
+                alts = class_alts(arg, ci)
+                if alts is not None:
+                    extend(alts)
+                else:
+                    flush()
+            elif opname == "SUBPATTERN":
+                # groups are transparent: expand inline when possible so
+                # literals on both sides stay adjacent
+                child_ci = (ci or bool(arg[1] & re.IGNORECASE)) and not bool(
+                    arg[2] & re.IGNORECASE
+                )
+                exp = expansions(arg[3], child_ci)
+                if exp is not None:
+                    extend(exp)
+                else:
+                    flush()
+                    walk(arg[3], child_ci)
+                    flush()
+            elif opname == "BRANCH":
+                exp = expansions([(op, arg)], ci)
+                if exp is not None:
+                    extend(exp)
+                    continue
+                flush()
+                # every branch with its own necessary set → the union
+                # is necessary for the alternation as a whole
+                sets = [nec_set(b, ci) for b in arg[1]]
+                if all(s is not None for s in sets):
+                    union = sorted({m for s in sets for m in s})
+                    if len(union) <= max_alts:
+                        consider(union)
+            elif opname == "MAX_REPEAT" or opname == "MIN_REPEAT":
+                lo, hi, child = arg
+                if lo >= 1:
+                    exp = expansions(child, ci)
+                    if exp is not None:
+                        # one guaranteed copy keeps runs adjacent; a
+                        # variable tail breaks adjacency afterwards
+                        extend(exp)
+                        if hi == lo:
+                            for _ in range(int(lo) - 1):
+                                extend(exp)
+                        else:
+                            flush()
+                    else:
+                        flush()
+                        walk(child, ci)
+                        flush()
+                else:
+                    flush()
+            elif opname == "AT":
+                # zero-width assertion: consumes nothing, so bytes on
+                # either side are still adjacent in any match
+                continue
+            else:
+                # ANY, CATEGORY, GROUPREF… — not a required literal
                 flush()
         flush()
 
     walk(tree, global_ci)
-    lit = best[0]
-    if len(lit) < min_len:
-        return None
-    # Always ASCII-lowercase: the prefilter probes the *lowered* stream,
-    # a sound superset for case-sensitive regexes (non-A-Z bytes are
-    # untouched in both literal and stream) and for (?i) regexes with
-    # ASCII literals.
-    return bytes(lower_bytes_np(np.frombuffer(lit, np.uint8)).tobytes())
+    return best[0]
+
+
+def required_literal(pattern: str, min_len: int = 4) -> Optional[bytes]:
+    """Single required literal (longest member of a singleton set)."""
+    lits = required_literal_set(pattern, min_len=min_len, max_alts=1)
+    return lits[0] if lits else None
 
 
 # ---------------------------------------------------------------------------
@@ -192,6 +371,89 @@ class ScalarProgram:
     contains: list[tuple[bytes, str, bool]]  # (needle, stream, case_insensitive)
     residue: bool = False  # md5/sha residue → hit needs host confirm
     never: bool = False  # statically unsatisfiable (e.g. "AbC" in tolower(x))
+    any_of: bool = False  # contains are OR-reduced (no conjuncts/residue)
+    negated: bool = False  # value = NOT(OR of contains) — !contains() exprs
+
+
+def _lower_contains_call(node):
+    """contains(part_var, "lit") → (needle, stream, ci) | "never" | None."""
+    if not (node[0] == "call" and node[1] == "contains" and len(node[2]) == 2):
+        return None
+    hay, needle = node[2]
+    loc = _part_stream_of_var(hay)
+    if not (loc and needle[0] == "lit" and isinstance(needle[1], str)):
+        return None
+    stream, wrap = loc
+    data = needle[1].encode()
+    if len(data) == 0:
+        return None
+    if wrap is None:
+        return (data, stream, False)
+    if wrap == "lower":
+        # an uppercase needle can never occur in a lowercased haystack
+        return (data, stream, True) if data == data.lower() else "never"
+    return (data.lower(), stream, True) if data == data.upper() else "never"
+
+
+def _regex_conjunct_prefilter(node):
+    """regex("pat", part_var) / part_var =~ "pat" → one contains tuple
+    when the pattern has a singleton required-literal set (prog.contains
+    entries are AND-reduced, so only singletons are expressible)."""
+    if node[0] == "call" and node[1] == "regex" and len(node[2]) == 2:
+        pat, hay = node[2]
+    elif node[0] == "bin" and node[1] == "=~":
+        hay, pat = node[2], node[3]
+    else:
+        return None
+    if pat[0] != "lit" or not isinstance(pat[1], str):
+        return None
+    loc = _part_stream_of_var(hay)
+    if loc is None:
+        return None
+    stream, _wrap = loc  # tolower/toupper wrap is moot: probe is lowered
+    lits = required_literal_set(pat[1])
+    if lits is None or len(lits) != 1:
+        return None
+    return (lits[0], stream, True)
+
+
+def _lower_negated_contains_conj(node):
+    """``!contains(a) && !contains(b) && …`` → the [a, b, …] slot list
+    (the value is NOT(a || b || …)); None if any conjunct differs.
+    A "never" branch (statically-absent needle) drops out: !never ≡ True
+    is the AND identity."""
+    if node[0] == "bin" and node[1] == "&&":
+        lhs = _lower_negated_contains_conj(node[2])
+        if lhs is None:
+            return None
+        rhs = _lower_negated_contains_conj(node[3])
+        if rhs is None:
+            return None
+        return lhs + rhs
+    if node[0] == "un" and node[1] == "!":
+        c = _lower_contains_call(node[2])
+        if c is None:
+            return None
+        return [] if c == "never" else [c]
+    return None
+
+
+def _lower_or_contains(node):
+    """Flatten an ||-tree of contains() calls to its slot list, or None
+    if the tree has any other node. Statically-false branches drop out
+    (OR identity); an all-false tree returns []."""
+    if node[0] == "bin" and node[1] == "||":
+        lhs = _lower_or_contains(node[2])
+        if lhs is None:
+            return None
+        rhs = _lower_or_contains(node[3])
+        if rhs is None:
+            return None
+        return lhs + rhs
+    c = _lower_contains_call(node)
+    if c is None:
+        return None
+    return [] if c == "never" else [c]
 
 
 _CMP_OPS = {"==": SOP_EQ, "!=": SOP_NE, "<": SOP_LT, ">": SOP_GT, "<=": SOP_LE, ">=": SOP_GE}
@@ -232,13 +494,32 @@ def _part_stream_of_var(node) -> Optional[tuple[str, Optional[str]]]:
 _HASH_FNS = ("md5", "sha1", "sha256", "mmh3")
 
 
-def lower_dsl(ast) -> Optional[ScalarProgram]:
+def lower_dsl(ast, superset: bool = False) -> Optional[ScalarProgram]:
     """Lower one dsl expression to a scalar program, or None if it
     doesn't fit the supported shape (top-level conjunction of scalar
-    compares / contains / hash-equality residues)."""
+    compares / contains / hash-equality residues).
+
+    ``superset=True`` never fails: unsupported top-level conjuncts are
+    *dropped* (yielding a necessary condition — sound as a prefilter
+    whose hits get host-confirmed) and flagged via ``residue``. Only
+    valid for non-negated matchers: dropping conjuncts widens the
+    pre-negation value, which negation would flip into a miss.
+    """
     prog = ScalarProgram(conjuncts=[], contains=[])
 
     def handle(node) -> bool:
+        ok = handle_exact(node)
+        if not ok and superset:
+            # a dropped regex()/=~ conjunct still contributes its
+            # required literal as a contains prefilter (necessary)
+            c = _regex_conjunct_prefilter(node)
+            if c is not None:
+                prog.contains.append(c)
+            prog.residue = True
+            return True
+        return ok
+
+    def handle_exact(node) -> bool:
         if node[0] == "bin" and node[1] == "&&":
             return handle(node[2]) and handle(node[3])
         if node[0] == "bin" and node[1] in _CMP_OPS:
@@ -263,35 +544,133 @@ def lower_dsl(ast) -> Optional[ScalarProgram]:
                     return True
             return False
         if node[0] == "call" and node[1] == "contains" and len(node[2]) == 2:
-            hay, needle = node[2]
-            loc = _part_stream_of_var(hay)
-            if loc and needle[0] == "lit" and isinstance(needle[1], str):
-                stream, wrap = loc
-                data = needle[1].encode()
-                if len(data) == 0:
-                    return False
-                if wrap is None:
-                    prog.contains.append((data, stream, False))
-                elif wrap == "lower":
-                    if data != data.lower():
-                        # an uppercase needle can never occur in a
-                        # lowercased haystack — statically false
-                        prog.never = True
-                    else:
-                        prog.contains.append((data, stream, True))
-                else:  # upper
-                    if data != data.upper():
-                        prog.never = True
-                    else:
-                        prog.contains.append((data.lower(), stream, True))
-                return True
+            c = _lower_contains_call(node)
+            if c is None:
+                return False
+            if c == "never":
+                prog.never = True
+            else:
+                prog.contains.append(c)
+            return True
         return False
+
+    # the whole expression is an OR over contains() calls — exactly an
+    # OR-reduced slot bucket (jsf-detection-style fingerprint dsl)
+    ors = _lower_or_contains(ast)
+    if ors is not None:
+        if not ors:
+            return ScalarProgram(conjuncts=[], contains=[], never=True)
+        # a singleton stays conjunctive so AND-merging keeps working
+        return ScalarProgram(conjuncts=[], contains=ors, any_of=len(ors) > 1)
+
+    # De Morgan: !contains(a) [&& !contains(b)…] ≡ NOT(a || b) — an
+    # OR-reduced bucket under matcher-level negation (exact, since the
+    # slots themselves are byte-verified)
+    negs = _lower_negated_contains_conj(ast)
+    if negs is not None:
+        if not negs:
+            # every negated branch is statically absent ⇒ always True
+            return ScalarProgram(conjuncts=[], contains=[])
+        return ScalarProgram(
+            conjuncts=[], contains=negs, any_of=True, negated=True
+        )
 
     if not handle(ast):
         return None
     if len(prog.conjuncts) > MAX_SCALAR_CONJUNCTS:
-        return None
+        if not superset:
+            return None
+        # dropping conjuncts keeps the necessary-condition property
+        prog.conjuncts = prog.conjuncts[:MAX_SCALAR_CONJUNCTS]
+        prog.residue = True
     return prog
+
+
+def _merge_dsl_progs(
+    progs: list[ScalarProgram], condition: str, superset: bool = False
+) -> Optional[ScalarProgram]:
+    """Merge one program per dsl expression under the matcher's
+    expression-list condition. Exact when the shapes allow it; with
+    ``superset=True`` an OR-list weakens each branch to its most
+    selective contains (a sound necessary condition), never failing
+    unless some branch has no contains at all."""
+    if len(progs) == 1:
+        return progs[0]
+    if condition == "and":
+        if any(p.never for p in progs):
+            return ScalarProgram(conjuncts=[], contains=[], never=True)
+        negated = [p for p in progs if p.negated]
+        plain = [p for p in progs if not p.negated]
+        if negated and not plain:
+            # !(A) && !(B) ≡ !(A ∪ B): one OR bucket under negation
+            return ScalarProgram(
+                conjuncts=[],
+                contains=[c for p in negated for c in p.contains],
+                any_of=True,
+                negated=True,
+            )
+        if negated or any(p.any_of for p in plain):
+            # negated/OR-group members can't fold into the AND bucket;
+            # superset mode drops them (widening an AND is sound)
+            if not superset:
+                return None
+            plain = [p for p in plain if not p.any_of]
+            out = _merge_dsl_progs(
+                plain or [ScalarProgram(conjuncts=[], contains=[])],
+                "and",
+                superset=True,
+            )
+            out.residue = True
+            return out
+        out = ScalarProgram(conjuncts=[], contains=[])
+        for p in plain:
+            out.conjuncts += p.conjuncts
+            out.contains += p.contains
+            out.residue |= p.residue
+        if len(out.conjuncts) > MAX_SCALAR_CONJUNCTS:
+            if not superset:
+                return None
+            out.conjuncts = out.conjuncts[:MAX_SCALAR_CONJUNCTS]
+            out.residue = True
+        return out
+    # condition "or"
+    live = [p for p in progs if not p.never]
+    if not live:
+        return ScalarProgram(conjuncts=[], contains=[], never=True)
+    if any(
+        not p.contains and not p.conjuncts and not p.residue for p in live
+    ):
+        # an always-True branch (e.g. every negated needle statically
+        # absent) makes the whole OR always True
+        return ScalarProgram(conjuncts=[], contains=[])
+    if any(p.negated for p in live):
+        return None  # !(…) under OR has no bucket form
+    if all(
+        not p.conjuncts
+        and not p.residue
+        # AND-reduced multi-contains branches can't flatten into an OR
+        and (p.any_of or len(p.contains) == 1)
+        for p in live
+    ):
+        return ScalarProgram(
+            conjuncts=[],
+            contains=[c for p in live for c in p.contains],
+            any_of=True,
+        )
+    if not superset:
+        return None
+    picked = []
+    for p in live:
+        if not p.contains:
+            return None  # a literal-less OR branch widens to always-True
+        if p.any_of:
+            # the branch is itself an OR: every member must stay (the
+            # union is the branch's necessary condition)
+            picked.extend(p.contains)
+        else:
+            # AND branch: any single member is a sound weakening
+            picked.append(max(p.contains, key=lambda c: len(c[0])))
+    return ScalarProgram(conjuncts=[], contains=picked, any_of=True, residue=True)
 
 
 # ---------------------------------------------------------------------------
@@ -398,8 +777,10 @@ class CompiledDB:
 
     # --- operations & templates ---
     op_cond_and: np.ndarray  # bool [NOP]
+    op_prefilter: np.ndarray  # bool [NOP] — superset-lowered: fired ⇒ host confirm
     op_m_buckets: list  # list[IndexBucket] op → matcher ids
     t_op_buckets: list  # list[IndexBucket] template → op ids
+    t_prefilter: np.ndarray  # bool [NT] — any op superset-lowered (reporting)
 
     template_ids: list  # str [NT] — device-evaluated templates
     host_always: list  # list[Template] — exact-CPU-only tail
@@ -462,6 +843,7 @@ def compile_corpus(
     ops: list[dict] = []
     t_ops: list[list[int]] = []
     kept_templates: list[Template] = []
+    t_prefilter_flags: list[bool] = []
     host_always: list[Template] = []
 
     def lower_matcher(m: Matcher) -> Optional[dict]:
@@ -535,70 +917,201 @@ def compile_corpus(
                     return None
                 value = all(results) if m.condition == "and" else any(results)
                 return const(value)
-            # every regex in the list needs its own required literal; the
-            # matcher bit is the OR/AND of per-regex prefilter bits.
-            slot_ids = []
+            # every regex in the list needs a required literal *set*
+            # (any-of — alternations yield several members). The matcher
+            # bit is AND of singletons when condition=and, else the flat
+            # OR union — both sound supersets, and MK_REGEX_PREFILTER is
+            # uncertain-on-fire either way, so weaker only costs extra
+            # confirms, never misses. Literals probe the lowered stream.
+            lit_sets = []
             for pattern in m.regex:
-                lit = required_literal(pattern)
-                if lit is None:
+                lits = required_literal_set(pattern)
+                if lits is None:
                     return None
-                # prefilter literals always probe the lowered stream (sound
-                # superset regardless of the regex's case flags)
-                slot_ids.append(slots.get(lit, stream, True))
-            if not slot_ids:
+                lit_sets.append(lits)
+            if not lit_sets:
                 return None
             rec["kind"] = MK_REGEX_PREFILTER
-            rec["slots"] = slot_ids
+            rec["cond_and"] = m.condition == "and" and all(
+                len(s) == 1 for s in lit_sets
+            )
+            rec["slots"] = [
+                slots.get(lit, stream, True) for s in lit_sets for lit in s
+            ]
             return rec
         if m.type == "dsl":
             progs = []
             for expr in m.dsl:
                 ast = dslc.try_parse(expr)
-                if ast is None:
-                    return None
+                if ast is None or dslc.always_errors(ast):
+                    # oracle semantics: a parse failure or an expression
+                    # that errors in every env (unknown var/function —
+                    # the multi-step status_code_2/body_1 tail) makes
+                    # the whole matcher "unsupported" → constant False
+                    # with negation NOT applied (cpu_ref.match_matcher
+                    # returns None before the negation step)
+                    rec["negative"] = False
+                    return rec
                 prog = lower_dsl(ast)
                 if prog is None:
                     return None
                 progs.append(prog)
-            if len(progs) != 1:
-                # multi-expression dsl matchers are rare; host them for now
+            merged = _merge_dsl_progs(progs, m.condition)
+            if merged is None:
                 return None
-            prog = progs[0]
-            if prog.never:
+            if merged.never:
                 return rec  # statically unsatisfiable: constant False
             rec["kind"] = MK_SCALAR_DSL
-            rec["scalar"] = prog.conjuncts
-            rec["residue"] = prog.residue
-            rec["cond_and"] = True  # conjuncts and contains() are all AND'd
+            rec["scalar"] = merged.conjuncts
+            rec["residue"] = merged.residue
+            rec["cond_and"] = not merged.any_of
+            rec["negative"] = bool(m.negative) ^ merged.negated
             rec["slots"] = [
                 slots.get(needle, stream, lowered)
-                for needle, stream, lowered in prog.contains
+                for needle, stream, lowered in merged.contains
             ]
             return rec
         return None  # kval / json / xpath
+
+    def const_true_unc() -> dict:
+        """Fires on every row; the template-level prefilter flag routes
+        fired rows to host confirmation (MK_SCALAR_DSL with an empty
+        program evaluates vacuously True pre-negation)."""
+        return {
+            "kind": MK_SCALAR_DSL,
+            "negative": False,
+            "cond_and": True,
+            "slots": [],
+            "scalar": [],
+            "residue": False,
+            "status": [],
+            "size": [],
+            "size_stream": 0,
+        }
+
+    def lower_matcher_superset(m: Matcher) -> dict:
+        """Necessary-condition lowering — never fails. The matcher's
+        device value is a superset of its oracle value (post-negation),
+        so a template built from these can only over-fire; not-fired
+        rows are exact. Only meaningful under a template prefilter flag.
+        """
+        rec = lower_matcher(m)
+        if rec is not None:
+            return rec
+        if m.negative:
+            # a partial (widened) pre-negation value would flip into a
+            # *narrowed* post-negation value — unsound as a superset
+            return const_true_unc()
+        if m.type == "dsl":
+            progs = []
+            for expr in m.dsl:
+                ast = dslc.try_parse(expr)
+                if ast is None:  # unreachable: exact path consts these
+                    return const_true_unc()
+                progs.append(lower_dsl(ast, superset=True))
+            merged = _merge_dsl_progs(progs, m.condition, superset=True)
+            if merged is None:
+                return const_true_unc()
+            if merged.never:
+                rec = const_true_unc()
+                rec["negative"] = True  # constant False, exact
+                return rec
+            if merged.negated:
+                # negated buckets don't widen monotonically — play safe
+                return const_true_unc()
+            rec = const_true_unc()
+            rec["scalar"] = merged.conjuncts
+            # no m-level residue here: a weakened matcher firing every
+            # row would make the template *always* uncertain; the
+            # op_prefilter flag already confirms exactly the fired rows
+            rec["cond_and"] = not merged.any_of
+            rec["slots"] = [
+                slots.get(needle, stream, lowered)
+                for needle, stream, lowered in merged.contains
+            ]
+            return rec
+        if m.type == "regex":
+            stream = stream_for_part(m.part)
+            if stream is not None:
+                # relax the length floor before giving up: a 2–3 byte
+                # anchor (binary protocol magic like "N\x00\x0e") takes
+                # the exact tiny-slot path and still beats fire-always
+                def relaxed(p):
+                    for ml in (4, 3, 2):
+                        s = required_literal_set(p, min_len=ml)
+                        if s is not None:
+                            return s
+                    return None
+
+                lit_sets = [relaxed(p) for p in m.regex]
+                if m.condition == "and" or len(m.regex) == 1:
+                    # any single pattern's set is already necessary —
+                    # the union of the available ones is sound (weaker)
+                    avail = [s for s in lit_sets if s]
+                    lit_sets = avail if avail else None
+                else:
+                    # OR needs a set for every pattern
+                    if any(s is None for s in lit_sets):
+                        lit_sets = None
+                if lit_sets:
+                    rec = const_true_unc()
+                    rec["kind"] = MK_REGEX_PREFILTER
+                    rec["cond_and"] = False
+                    rec["slots"] = [
+                        slots.get(lit, stream, True)
+                        for s in lit_sets
+                        for lit in s
+                    ]
+                    return rec
+            return const_true_unc()
+        if m.type == "kval":
+            # header KEY presence; the key bytes (either separator
+            # form) occurring anywhere in the header is a necessary
+            # condition, and OR over forms/keys is a superset of both
+            # kval conditions
+            slot_ids = []
+            for key in m.kval:
+                for form in {key.lower().replace("_", "-"), key.lower()}:
+                    data = form.encode()
+                    if data:
+                        slot_ids.append(slots.get(data, "header", True))
+            if slot_ids:
+                rec = const_true_unc()
+                rec["kind"] = MK_WORDS
+                rec["cond_and"] = False
+                rec["slots"] = slot_ids
+                return rec
+            return const_true_unc()
+        return const_true_unc()
 
     for template in templates:
         if template.protocol == "workflow" or not template.operations:
             continue
         lowered_ops: list[dict] = []
-        ok = True
         for op in template.operations:
             recs = []
+            exact = True
             for m in op.matchers:
                 rec = lower_matcher(m)
                 if rec is None:
-                    ok = False
+                    exact = False
                     break
                 recs.append(rec)
-            if not ok:
-                break
+            if not exact:
+                # per-op superset re-lowering: this op becomes a device
+                # *prefilter* — rows where it fires are host-confirmed
+                # (op_prefilter & op_value ⇒ t_unc), rows where it
+                # doesn't are exact; sibling exact ops are unaffected
+                recs = [lower_matcher_superset(m) for m in op.matchers]
             lowered_ops.append(
-                {"cond_and": op.matchers_condition == "and", "matchers": recs}
+                {
+                    "cond_and": op.matchers_condition == "and",
+                    "matchers": recs,
+                    "prefilter": not exact,
+                }
             )
-        if not ok:
-            host_always.append(template)
-            continue
         op_ids = []
+        prefiltered = False
         for lop in lowered_ops:
             if not lop["matchers"]:
                 continue
@@ -606,13 +1119,21 @@ def compile_corpus(
             for rec in lop["matchers"]:
                 m_ids.append(len(matchers))
                 matchers.append(rec)
-            ops.append({"cond_and": lop["cond_and"], "matchers": m_ids})
+            ops.append(
+                {
+                    "cond_and": lop["cond_and"],
+                    "matchers": m_ids,
+                    "prefilter": lop["prefilter"],
+                }
+            )
             op_ids.append(len(ops) - 1)
+            prefiltered |= lop["prefilter"]
         if not op_ids:
             # no matchers anywhere: never matches (same as oracle)
             continue
         t_ops.append(op_ids)
         kept_templates.append(template)
+        t_prefilter_flags.append(prefiltered)
 
     # --- build slot arrays ---
     NW = len(slots.entries)
@@ -784,14 +1305,20 @@ def compile_corpus(
     # --- operation / template arrays ---
     NOP = max(len(ops), 1)
     op_cond_and = np.zeros((NOP,), dtype=bool)
+    op_prefilter = np.zeros((NOP,), dtype=bool)
     for i, o in enumerate(ops):
         op_cond_and[i] = o["cond_and"]
+        op_prefilter[i] = o["prefilter"]
     op_m_buckets = bucket_ragged([o["matchers"] for o in ops], NOP)
     t_op_buckets = bucket_ragged(t_ops, max(len(t_ops), 1))
+
+    t_prefilter = np.array(t_prefilter_flags or [False], dtype=bool)
 
     stats = {
         "templates_in": len(templates),
         "templates_device": len(kept_templates),
+        "templates_prefilter": int(sum(t_prefilter_flags)),
+        "ops_prefilter": int(op_prefilter.sum()),
         "templates_host_always": len(host_always),
         "matchers": len(matchers),
         "word_slots": NW,
@@ -824,8 +1351,10 @@ def compile_corpus(
         m_size=m_size,
         m_size_stream=m_size_stream,
         op_cond_and=op_cond_and,
+        op_prefilter=op_prefilter,
         op_m_buckets=op_m_buckets,
         t_op_buckets=t_op_buckets,
+        t_prefilter=t_prefilter,
         template_ids=[t.id for t in kept_templates],
         host_always=host_always,
         templates=kept_templates,

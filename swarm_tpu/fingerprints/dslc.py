@@ -336,3 +336,43 @@ def try_parse(text: str) -> Optional[tuple]:
         return parse_dsl(text)
     except DslError:
         return None
+
+
+#: Names ``build_env`` defines — the oracle's complete variable surface.
+ENV_VARS = frozenset(
+    {
+        "body", "header", "all_headers", "raw", "status_code",
+        "content_length", "host", "port", "duration",
+        "interactsh_protocol", "interactsh_request",
+    }
+)
+
+
+def always_errors(ast: tuple) -> bool:
+    """True if evaluating ``ast`` raises for *every* environment —
+    i.e. an unknown variable/function sits on an unconditionally
+    evaluated path (&&/|| short-circuit only protects the RIGHT
+    operand; comparisons/arithmetic/calls evaluate both sides).
+
+    The oracle maps an evaluation error to "matcher unsupported" →
+    verdict False with negation NOT applied (cpu_ref.match_matcher),
+    so an always-erroring expression makes its whole matcher a
+    compile-time constant False — the multi-step template tail
+    (status_code_2, body_1, set_cookie…) lowers exactly this way.
+    """
+    kind = ast[0]
+    if kind == "lit":
+        return False
+    if kind == "var":
+        return ast[1] not in ENV_VARS
+    if kind == "un":
+        return always_errors(ast[2])
+    if kind == "call":
+        if ast[1] not in _FUNCTIONS:
+            return True
+        return any(always_errors(a) for a in ast[2])
+    if kind == "bin":
+        if ast[1] in ("&&", "||"):
+            return always_errors(ast[2])
+        return always_errors(ast[2]) or always_errors(ast[3])
+    return False

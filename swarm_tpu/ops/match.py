@@ -150,6 +150,12 @@ def match_slots(
             return pos_offset[name]
         return pos_offset
 
+    # Slot truth bytes for the fused verify — small ([NW, VERIFY_WIDTH],
+    # ci slots pre-lowered) and replicated across shards (slot ids are
+    # global even when table groups are model-sharded).
+    slot_bytes_j = jnp.asarray(db.slot_bytes)
+    slot_len_j = jnp.asarray(db.slot_len)
+
     # --- q-gram tables ---
     for t_idx, table in enumerate(db.tables):
         arrays = (
@@ -201,6 +207,11 @@ def match_slots(
             (1, k), dtype=jnp.int32
         )
 
+        # First hash-hit per candidate window is byte-verified below;
+        # additional same-window hits (h1+h2+suffix collisions across
+        # entries — vanishingly rare) keep the old uncertain-hit path.
+        cand_has = jnp.zeros((B, k), dtype=bool)
+        cand_entry = jnp.zeros((B, k), dtype=jnp.int32)
         for g in range(table.max_group):
             e = jnp.minimum(e_start + g, entry_h2.shape[0] - 1)
             in_group = found & (g < e_count)
@@ -225,8 +236,37 @@ def match_slots(
             )
             hit = in_group & h2_ok & suf_ok & fits
             slot = entry_slot[e]
-            value_bits = value_bits.at[b_idx, slot].max(hit)
-            uncertain_bits = uncertain_bits.at[b_idx, slot].max(hit)
+            new = hit & ~cand_has
+            cand_entry = jnp.where(new, e, cand_entry)
+            extra = hit & ~new
+            value_bits = value_bits.at[b_idx, slot].max(extra)
+            uncertain_bits = uncertain_bits.at[b_idx, slot].max(extra)
+            cand_has = cand_has | hit
+
+        # --- fused byte-exact verify (the compile.py:16-17 contract) ---
+        # Gather the slot's true bytes under each first-hit window and
+        # compare. Equal and len ≤ VERIFY_WIDTH ⇒ the hit is *certain*
+        # (no host confirm). Unequal ⇒ a hash collision: provably no
+        # match at this window, so no bit is set at all. Equal prefix of
+        # a longer slot ⇒ value + uncertain (host checks the tail).
+        ec = cand_entry
+        slot_c = entry_slot[ec]
+        start = cpos - entry_off[ec]  # extended coordinate of word start
+        lv = jnp.minimum(entry_len[ec], fpc.VERIFY_WIDTH)
+        stream_v = get_stream(table.stream, table.lowered)
+        offs = jnp.arange(fpc.VERIFY_WIDTH, dtype=jnp.int32)
+        idx = start[:, :, None] + offs[None, None, :]  # [B, k, V]
+        idx_c = jnp.clip(idx, 0, We - 1)
+        gathered = jnp.take_along_axis(
+            stream_v, idx_c.reshape(B, -1), axis=1
+        ).reshape(B, k, fpc.VERIFY_WIDTH)
+        expected = slot_bytes_j[slot_c]  # [B, k, V]
+        pos_ok = offs[None, None, :] < lv[:, :, None]
+        eq = ((gathered == expected) | ~pos_ok).all(-1)
+        long = slot_len_j[slot_c] > fpc.VERIFY_WIDTH
+        fired = cand_has & eq
+        value_bits = value_bits.at[b_idx, slot_c].max(fired)
+        uncertain_bits = uncertain_bits.at[b_idx, slot_c].max(fired & long)
 
     # --- tiny slots: dense shifted compare (exact) ---
     tiny_count = int((np.asarray(db.tiny_len) > 0).sum())
@@ -317,6 +357,7 @@ def eval_verdicts(db: fpc.CompiledDB, value_bits, uncertain_bits, lengths, statu
     size_ok = (size_sel[:, :, None] == jnp.asarray(db.m_size)[None]).any(-1)
 
     kind = db.m_kind  # static numpy
+    is_regex_prefilter = jnp.asarray(kind == fpc.MK_REGEX_PREFILTER)
     is_words = jnp.asarray((kind == fpc.MK_WORDS) | (kind == fpc.MK_REGEX_PREFILTER))
     is_scalar = jnp.asarray(kind == fpc.MK_SCALAR_DSL)
     is_status = jnp.asarray(kind == fpc.MK_STATUS)
@@ -330,6 +371,11 @@ def eval_verdicts(db: fpc.CompiledDB, value_bits, uncertain_bits, lengths, statu
 
     # md5-style residues: a scalar pass still needs host confirmation
     m_unc = m_unc | (jnp.asarray(db.m_residue)[None, :] & m_value)
+    # regex prefilters are *semantically* uncertain when fired: the
+    # required literal being byte-verified present does not prove the
+    # regex matches, so the fired bit always needs host confirmation
+    # (absence of the literal stays exact — the regex cannot match).
+    m_unc = m_unc | (is_regex_prefilter[None, :] & m_value)
     # negation after uncertainty capture
     m_value = m_value ^ jnp.asarray(db.m_negative)[None, :]
 
@@ -345,6 +391,11 @@ def eval_verdicts(db: fpc.CompiledDB, value_bits, uncertain_bits, lengths, statu
         red = jnp.where(op_cond[rows][None, :], gv.all(-1), gv.any(-1))
         op_value = op_value.at[:, rows].set(red)
         op_unc = op_unc.at[:, rows].set(gu.any(-1))
+    # superset-lowered (prefilter) ops: the device value can only
+    # over-fire, so fired rows need host confirmation and unfired rows
+    # are exact — precisely `fired & prefilter`. Sibling exact ops of
+    # the same template stay certain.
+    op_unc = op_unc | (jnp.asarray(db.op_prefilter)[None, :] & op_value)
 
     # --- templates: OR over their operations ---
     NT = max(db.num_templates, 1)

@@ -44,7 +44,10 @@ def test_extractions_on_device_certain_hit():
     _engine_vs_oracle(doc, rows)
 
 
-def test_host_part_matcher_goes_host_always():
+def test_host_part_matcher_becomes_prefilter():
+    # host-part words aren't device-loweable (the stream has no host
+    # bytes); the template compiles to a superset *prefilter* op whose
+    # fired rows are host-confirmed — not to the host-always list
     doc = {
         "id": "x-hostpart",
         "info": {"severity": "info"},
@@ -57,7 +60,9 @@ def test_host_part_matcher_goes_host_always():
         model.Response(host="other.example.com", status=200, body=b"hi"),
     ]
     eng = _engine_vs_oracle(doc, rows)
-    assert len(eng.db.host_always) == 1
+    assert len(eng.db.host_always) == 0
+    assert eng.db.op_prefilter.sum() == 1
+    assert eng.db.t_prefilter.sum() == 1
 
 
 def test_binary_matcher_ignores_case_insensitive():
@@ -189,7 +194,11 @@ def test_exotic_dsl_degrades_to_unsupported_not_crash():
     assert out[0].template_ids == []
 
 
-def test_ci_regex_nonascii_literal_goes_host():
+def test_ci_regex_nonascii_literal_splits_run():
+    # a non-ASCII byte under (?i) can't be ASCII-lowered, but the ASCII
+    # run on either side of it is still a sound required literal — the
+    # matcher stays on device ("nchen-admin-panel" here), fired rows
+    # get the usual regex host confirmation, and parity holds
     doc = {
         "id": "x-ci-nonascii",
         "info": {"severity": "info"},
@@ -202,7 +211,8 @@ def test_ci_regex_nonascii_literal_goes_host():
         model.Response(host="b", status=200, body=b"unrelated"),
     ]
     eng = _engine_vs_oracle(doc, rows)
-    assert len(eng.db.host_always) == 1
+    assert len(eng.db.host_always) == 0
+    assert eng.db.num_slots == 1
 
 
 def test_scoped_inline_ci_group_nonascii():
