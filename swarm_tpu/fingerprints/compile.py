@@ -107,7 +107,7 @@ def _gram_offsets_by_rarity(data: bytes, q: int) -> list[int]:
 # ---------------------------------------------------------------------------
 
 
-MAX_LITERAL_ALTS = 8  # cap on any-of literal sets from alternations
+MAX_LITERAL_ALTS = 16  # cap on any-of literal sets from alternations
 
 
 def _lower_ascii(data: bytes) -> bytes:
@@ -360,6 +360,80 @@ def required_literal(pattern: str, min_len: int = 4) -> Optional[bytes]:
     return lits[0] if lits else None
 
 
+def full_literal_expansions(
+    pattern: str, max_alts: int = MAX_LITERAL_ALTS
+) -> Optional[tuple[list[bytes], bool]]:
+    """(alternatives, case_insensitive) when ``re.search(pattern, s)``
+    is *exactly* equivalent to "s contains one of the alternatives" —
+    i.e. the pattern is pure literals/alternations/fixed repeats with
+    no classes, anchors, or variable quantifiers. Alternatives are
+    lowered when ci (probe the lowered stream), raw bytes otherwise.
+
+    This turns literal-shaped corpus "regexes" (MySqlException,
+    (?i)x-frame-options, …) into exact word slots instead of
+    uncertain prefilters.
+    """
+    try:
+        import re._parser as sre_parse  # py3.11+
+    except ImportError:  # pragma: no cover
+        import sre_parse  # type: ignore
+    try:
+        tree = sre_parse.parse(pattern)
+    except re.error:
+        return None
+    ci = bool(tree.state.flags & re.IGNORECASE)
+
+    def expand(seq, ci: bool) -> Optional[list[bytes]]:
+        outs = [b""]
+        for op, arg in seq:
+            opname = str(op)
+            if opname == "LITERAL" and 0 <= arg < 256:
+                if ci and arg >= 0x80:
+                    return None  # Unicode folding ≠ ASCII lowering
+                b = bytes([arg])
+                outs = [o + (_lower_ascii(b) if ci else b) for o in outs]
+            elif opname == "SUBPATTERN":
+                child_ci = (ci or bool(arg[1] & re.IGNORECASE)) and not bool(
+                    arg[2] & re.IGNORECASE
+                )
+                if child_ci != ci:
+                    return None  # mixed-case scopes don't map to one slot case
+                child = expand(arg[3], ci)
+                if child is None:
+                    return None
+                outs = [o + c for o in outs for c in child]
+            elif opname == "BRANCH":
+                alts = []
+                for branch in arg[1]:
+                    exp = expand(branch, ci)
+                    if exp is None:
+                        return None
+                    alts.extend(exp)
+                outs = [o + a for o in outs for a in alts]
+            elif opname == "MAX_REPEAT" or opname == "MIN_REPEAT":
+                lo, hi, child = arg
+                if lo != hi:
+                    return None
+                exp = expand(child, ci)
+                if exp is None:
+                    return None
+                for _ in range(int(lo)):
+                    outs = [o + c for o in outs for c in exp]
+                    if len(outs) > max_alts:
+                        return None
+            else:
+                # IN, ANY, AT, CATEGORY… — not a pure literal pattern
+                return None
+            if len(outs) > max_alts:
+                return None
+        return outs
+
+    outs = expand(tree, ci)
+    if outs is None or any(not o for o in outs):
+        return None  # an empty alternative matches everything
+    return sorted(set(outs)), ci
+
+
 # ---------------------------------------------------------------------------
 # DSL lowering: conjunctive scalar programs + contains/md5 residues
 # ---------------------------------------------------------------------------
@@ -393,6 +467,51 @@ def _lower_contains_call(node):
         # an uppercase needle can never occur in a lowercased haystack
         return (data, stream, True) if data == data.lower() else "never"
     return (data.lower(), stream, True) if data == data.upper() else "never"
+
+
+def _contains_equiv(node):
+    """Substring-equivalence of a dsl node: a list of (needle, stream,
+    ci) tuples whose OR is *exactly* the node's value, or "never"
+    (statically False), or None (no equivalence).
+
+    Covers contains() calls and pure-literal regex()/=~ applications —
+    ``regex('(?i)x-frame-options', all_headers)`` is exactly a ci
+    substring check, so security-header style matchers lower without
+    any prefilter uncertainty.
+    """
+    c = _lower_contains_call(node)
+    if c is not None:
+        return c if c == "never" else [c]
+    if node[0] == "call" and node[1] == "regex" and len(node[2]) == 2:
+        pat, hay = node[2]
+    elif node[0] == "bin" and node[1] == "=~":
+        hay, pat = node[2], node[3]
+    else:
+        return None
+    if pat[0] != "lit" or not isinstance(pat[1], str):
+        return None
+    loc = _part_stream_of_var(hay)
+    if loc is None:
+        return None
+    stream, wrap = loc
+    full = full_literal_expansions(pat[1])
+    if full is None:
+        return None
+    alts, pat_ci = full
+    out = []
+    for alt in alts:
+        if pat_ci or wrap is None:
+            # ci alternatives are pre-lowered; raw ones keep their case
+            out.append((alt, stream, pat_ci))
+        elif wrap == "lower":
+            if alt != alt.lower():
+                continue  # can't occur in a lowered haystack
+            out.append((alt, stream, True))
+        else:  # upper wrap, case-sensitive pattern
+            if alt != alt.upper():
+                continue
+            out.append((alt.lower(), stream, True))
+    return out if out else "never"
 
 
 def _regex_conjunct_prefilter(node):
@@ -431,10 +550,10 @@ def _lower_negated_contains_conj(node):
             return None
         return lhs + rhs
     if node[0] == "un" and node[1] == "!":
-        c = _lower_contains_call(node[2])
-        if c is None:
+        eq = _contains_equiv(node[2])
+        if eq is None:
             return None
-        return [] if c == "never" else [c]
+        return [] if eq == "never" else eq
     return None
 
 
@@ -450,10 +569,10 @@ def _lower_or_contains(node):
         if rhs is None:
             return None
         return lhs + rhs
-    c = _lower_contains_call(node)
-    if c is None:
+    eq = _contains_equiv(node)
+    if eq is None:
         return None
-    return [] if c == "never" else [c]
+    return [] if eq == "never" else eq
 
 
 _CMP_OPS = {"==": SOP_EQ, "!=": SOP_NE, "<": SOP_LT, ">": SOP_GT, "<=": SOP_LE, ">=": SOP_GE}
@@ -543,15 +662,17 @@ def lower_dsl(ast, superset: bool = False) -> Optional[ScalarProgram]:
                     prog.residue = True
                     return True
             return False
-        if node[0] == "call" and node[1] == "contains" and len(node[2]) == 2:
-            c = _lower_contains_call(node)
-            if c is None:
-                return False
-            if c == "never":
+        eq = _contains_equiv(node)
+        if eq is not None:
+            if eq == "never":
                 prog.never = True
-            else:
-                prog.contains.append(c)
-            return True
+                return True
+            if len(eq) == 1:
+                prog.contains.append(eq[0])
+                return True
+            # an embedded multi-alternative OR can't sit in the AND
+            # bucket — only the whole-expression or-shape handles it
+            return False
         return False
 
     # the whole expression is an OR over contains() calls — exactly an
@@ -869,15 +990,26 @@ def compile_corpus(
             return rec
         if m.type in ("word", "binary"):
             payloads = _word_payloads(m)
-            if payloads is None or not payloads:
+            if payloads is None:
                 return None
+            if not payloads:
+                # oracle: empty word list → no results → verdict False
+                # (negation applies) — a compile-time constant
+                return const(False)
             if m.part in HOST_ONLY_PARTS:
                 return None  # oracle has real bytes here; not device-loweable
             stream = stream_for_part(m.part)
             if stream is None:
                 return rec  # unknown/OOB part: constant False on both engines
             if any(len(p) == 0 for p in payloads):
-                return None
+                # an empty needle is always present (b"" in hay ≡ True):
+                # under OR the matcher is constantly True; under AND the
+                # empty words are identity conjuncts — drop them
+                if m.condition != "and":
+                    return const(True)
+                payloads = [p for p in payloads if p]
+                if not payloads:
+                    return const(True)
             # cpu_ref (like nuclei) ignores case-insensitive for binary
             # payloads — keep the device identical.
             lowered = m.case_insensitive and m.type == "word"
@@ -917,6 +1049,22 @@ def compile_corpus(
                     return None
                 value = all(results) if m.condition == "and" else any(results)
                 return const(value)
+            # pure-literal patterns are *exact* substring checks — no
+            # prefilter uncertainty at all (MySqlException,
+            # (?i)x-drupal, Set-Cookie: (Craft|CRAFT) …)
+            pure = [full_literal_expansions(p) for p in m.regex]
+            if all(p is not None for p in pure) and (
+                m.condition != "and"
+                or all(len(alts) == 1 for alts, _ in pure)
+            ):
+                rec["kind"] = MK_WORDS
+                rec["cond_and"] = m.condition == "and"
+                rec["slots"] = [
+                    slots.get(lit, stream, ci)
+                    for alts, ci in pure
+                    for lit in alts
+                ]
+                return rec
             # every regex in the list needs a required literal *set*
             # (any-of — alternations yield several members). The matcher
             # bit is AND of singletons when condition=and, else the flat
@@ -941,6 +1089,7 @@ def compile_corpus(
             return rec
         if m.type == "dsl":
             progs = []
+            solo = m.condition == "and" or len(m.dsl) == 1
             for expr in m.dsl:
                 ast = dslc.try_parse(expr)
                 if ast is None or dslc.always_errors(ast):
@@ -952,6 +1101,18 @@ def compile_corpus(
                     # returns None before the negation step)
                     rec["negative"] = False
                     return rec
+                if solo and dslc.effectively_false(ast):
+                    # every row either errors (matcher unsupported →
+                    # False, unnegated) or yields False (expr False →
+                    # under AND/single-expr the matcher is False, which
+                    # negation could flip) — but False-by-error wins on
+                    # exactly the rows where the guard passes, so only
+                    # the unnegated constant is sound for both cases…
+                    # unless the matcher is negated, where the two
+                    # disagree; keep those on the uncertain path.
+                    if not m.negative:
+                        rec["negative"] = False
+                        return rec
                 prog = lower_dsl(ast)
                 if prog is None:
                     return None

@@ -376,3 +376,23 @@ def always_errors(ast: tuple) -> bool:
             return always_errors(ast[2])
         return always_errors(ast[2]) or always_errors(ast[3])
     return False
+
+
+def effectively_false(ast: tuple) -> bool:
+    """True if every evaluation either errors or yields falsy — both of
+    which make a single-expression (or AND-listed) dsl matcher False:
+    an error marks the whole matcher unsupported → False, and a falsy
+    value is False outright. The canonical corpus shape is
+    ``status_code==200 && "…" == mmh3(base64_py(body))`` — the unknown
+    function only errors when the guard passes, so ``always_errors``
+    alone can't fold it, but False-or-error still holds row-wise.
+    """
+    if always_errors(ast):
+        return True
+    kind = ast[0]
+    if kind == "bin":
+        if ast[1] == "&&":
+            return effectively_false(ast[2]) or effectively_false(ast[3])
+        if ast[1] == "||":
+            return effectively_false(ast[2]) and effectively_false(ast[3])
+    return False
