@@ -164,6 +164,12 @@ int swarm_tcp_scan_tls(const uint32_t* ips, const uint16_t* ports, int32_t n,
     bool connected = false;
     void* ssl = nullptr;
     int hs = HS_PLAIN;
+    // TLS renegotiation cross-blocking: SSL_write can need the peer's
+    // bytes (WANT_READ) and SSL_read can need to flush ours
+    // (WANT_WRITE); epoll must be armed for the direction OpenSSL
+    // reported, not the direction the caller wanted
+    bool wr_blocked_on_read = false;
+    bool rd_blocked_on_write = false;
   };
 
   if (n <= 0) return 0;
@@ -236,6 +242,14 @@ int swarm_tcp_scan_tls(const uint32_t* ips, const uint16_t* ports, int32_t n,
     epoll_ctl(ep, EPOLL_CTL_MOD, c.fd, &ev);
   };
 
+  // EPOLLOUT is wanted when payload remains and SSL_write is not
+  // waiting on peer data, or when SSL_read reported WANT_WRITE
+  auto want_out = [&](int s) -> bool {
+    Conn& c = slots[s];
+    return (payload_left(s) && !c.wr_blocked_on_read) ||
+           c.rd_blocked_on_write;
+  };
+
   // drive payload write; returns false if the conn died
   auto pump_write = [&](int s) -> bool {
     Conn& c = slots[s];
@@ -246,12 +260,19 @@ int swarm_tcp_scan_tls(const uint32_t* ips, const uint16_t* ports, int32_t n,
     while (left > 0) {
       ssize_t w;
       if (c.hs == HS_DONE) {
+        c.wr_blocked_on_read = false;
         int r = api.SSL_write(c.ssl, payload_blob + off,
                               (int)std::min<int64_t>(left, 1 << 20));
         if (r <= 0) {
           int err = api.SSL_get_error(c.ssl, r);
-          if (err == kSSL_ERROR_WANT_READ || err == kSSL_ERROR_WANT_WRITE)
-            return true;  // retried on the next event
+          if (err == kSSL_ERROR_WANT_READ) {
+            // wait for peer bytes, not writability — EPOLLOUT would
+            // re-fire instantly and busy-spin until data arrives
+            c.wr_blocked_on_read = true;
+            return true;
+          }
+          if (err == kSSL_ERROR_WANT_WRITE)
+            return true;  // retried on the next EPOLLOUT
           finish(s, SW_OPEN);  // post-handshake reset: port was open
           return false;
         }
@@ -280,7 +301,7 @@ int swarm_tcp_scan_tls(const uint32_t* ips, const uint16_t* ports, int32_t n,
     int r = api.SSL_do_handshake(c.ssl);
     if (r == 1) {
       c.hs = HS_DONE;
-      if (pump_write(s)) arm(s, payload_left(s));
+      if (pump_write(s)) arm(s, want_out(s));
       return;
     }
     int err = api.SSL_get_error(c.ssl, r);
@@ -378,11 +399,17 @@ int swarm_tcp_scan_tls(const uint32_t* ips, const uint16_t* ports, int32_t n,
       uint8_t* dst = banners + int64_t(t) * banner_cap + blens[t];
       ssize_t r;
       if (c.hs == HS_DONE) {
+        c.rd_blocked_on_write = false;
         int rr = api.SSL_read(c.ssl, dst, (int)space);
         if (rr <= 0) {
           int err = api.SSL_get_error(c.ssl, rr);
-          if (err == kSSL_ERROR_WANT_READ || err == kSSL_ERROR_WANT_WRITE)
+          if (err == kSSL_ERROR_WANT_READ) return;
+          if (err == kSSL_ERROR_WANT_WRITE) {
+            // renegotiation flush: need EPOLLOUT or we stall until the
+            // read deadline even though the socket is writable
+            c.rd_blocked_on_write = true;
             return;
+          }
           finish(s, SW_OPEN);  // close_notify / reset after handshake
           return;
         }
@@ -450,10 +477,17 @@ int swarm_tcp_scan_tls(const uint32_t* ips, const uint16_t* ports, int32_t n,
         continue;
       }
       if (evs & EPOLLOUT) {
+        if (c.rd_blocked_on_write) {
+          pump_read(s);
+          if (c.fd < 0) continue;
+        }
         if (!pump_write(s)) continue;
-        if (!payload_left(s)) arm(s, false);
       }
-      if (evs & (EPOLLIN | EPOLLHUP | EPOLLERR)) pump_read(s);
+      if (evs & (EPOLLIN | EPOLLHUP | EPOLLERR)) {
+        if (c.wr_blocked_on_read && !pump_write(s)) continue;
+        pump_read(s);
+      }
+      if (c.fd >= 0) arm(s, want_out(s));
     }
 
     // expire deadlines

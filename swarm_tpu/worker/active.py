@@ -56,17 +56,19 @@ class PlannedRequest:
     headers: tuple[tuple[str, str], ...] = ()
     body: bytes = b""
 
-    def wire(self, host: str, port: int) -> bytes:
-        host_hdr = host if port in (80, 443) else f"{host}:{port}"
-        body = _finalize(self.body.decode("latin-1"), host, port).encode("latin-1")
+    def wire(self, host: str, port: int, tls: bool = False) -> bytes:
+        host_hdr = _host_hdr(host, port, tls)
+        body = _finalize(
+            self.body.decode("latin-1"), host, port, tls
+        ).encode("latin-1")
         lines = [
-            f"{self.method} {_finalize(self.path, host, port)} HTTP/1.1",
+            f"{self.method} {_finalize(self.path, host, port, tls)} HTTP/1.1",
             f"Host: {host_hdr}",
         ]
         has = {k.lower() for k, _ in self.headers}
         for k, v in self.headers:
             if k.lower() not in ("host", "connection", "content-length"):
-                lines.append(f"{k}: {_finalize(v, host, port)}")
+                lines.append(f"{k}: {_finalize(v, host, port, tls)}")
         if "user-agent" not in has:
             lines.append("User-Agent: swarm-tpu/1.0")
         if body:
@@ -101,8 +103,10 @@ class RequestPlan:
     dns_owners: list[set[int]] = dataclasses.field(default_factory=list)
 
 
-def _substitute(text: str, host: str = "", port: int = 80) -> Optional[str]:
-    """Resolve standard nuclei placeholders; None if any remain."""
+def _substitute(text: str) -> Optional[str]:
+    """Resolve standard nuclei placeholders to plan-time markers; None
+    if any unknown placeholder remains. Markers are resolved per target
+    in ``_finalize`` — the plan itself stays target-free."""
 
     def repl(m: re.Match) -> str:
         name = m.group(1).strip()
@@ -114,11 +118,11 @@ def _substitute(text: str, host: str = "", port: int = 80) -> Optional[str]:
         if low == "host":
             return "\x00HOST\x00"
         if low == "port":
-            return str(port)
+            return "\x00PORT\x00"
         if low == "path":
             return "/"
         if low == "scheme":
-            return "http"
+            return "\x00SCHEME\x00"
         if low.startswith("randstr") or low.startswith("rand_"):
             return _RANDSTR
         return m.group(0)  # unknown → leave; caller rejects
@@ -129,15 +133,25 @@ def _substitute(text: str, host: str = "", port: int = 80) -> Optional[str]:
     return out
 
 
-def _finalize(text: str, host: str, port: int) -> str:
-    """Per-target resolution of the plan-time markers. An *interior*
-    BaseURL/RootURL (query params, bodies, headers) becomes the absolute
-    URL; a path's leading BaseURL was already stripped at plan time."""
-    host_hdr = host if port in (80, 443) else f"{host}:{port}"
+def _host_hdr(host: str, port: int, tls: bool) -> str:
+    """host[:port], omitting the port only when it is the scheme default."""
+    default = 443 if tls else 80
+    return host if port == default else f"{host}:{port}"
+
+
+def _finalize(text: str, host: str, port: int, tls: bool) -> str:
+    """Per-target resolution of the plan-time markers with the probe's
+    actual scheme/port (not defaults). An *interior* BaseURL/RootURL
+    (query params, bodies, headers) becomes the absolute URL; a path's
+    leading BaseURL was already stripped at plan time."""
+    scheme = "https" if tls else "http"
+    hdr = _host_hdr(host, port, tls)
     return (
-        text.replace("\x00BASE\x00", f"http://{host_hdr}")
-        .replace("\x00HOSTPORT\x00", host_hdr)
+        text.replace("\x00BASE\x00", f"{scheme}://{hdr}")
+        .replace("\x00HOSTPORT\x00", hdr)
         .replace("\x00HOST\x00", host)
+        .replace("\x00PORT\x00", str(port))
+        .replace("\x00SCHEME\x00", scheme)
     )
 
 
@@ -573,8 +587,8 @@ class ActiveScanner:
 
     def _run_wave(self, wave) -> list[ActiveHit]:
         payloads = [
-            self.plan.requests[r_idx].wire(host, port)
-            for host, _ip, port, _t, r_idx in wave
+            self.plan.requests[r_idx].wire(host, port, tls)
+            for host, _ip, port, tls, r_idx in wave
         ]
         result = scanio.tcp_scan(
             [ip for _h, ip, _p, _t, _r in wave],

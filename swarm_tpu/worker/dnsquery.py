@@ -18,6 +18,7 @@ in this rendering.
 from __future__ import annotations
 
 import dataclasses
+import random
 import select
 import socket
 import struct
@@ -195,14 +196,44 @@ def query_batch(
 ) -> list[Optional[DnsReply]]:
     """[(qname, qtype)] → replies (None = no/invalid response).
 
-    All queries share one UDP socket; responses are matched by id.
+    Batches larger than the usable 16-bit id namespace are split into
+    sequential sub-batches so arbitrarily large query lists work.
+    """
+    out: list[Optional[DnsReply]] = []
+    for lo in range(0, len(queries), _MAX_BATCH):
+        out.extend(
+            _query_batch_one(
+                queries[lo : lo + _MAX_BATCH],
+                resolvers,
+                timeout_ms=timeout_ms,
+                retries=retries,
+                port=port,
+            )
+        )
+    return out
+
+
+_MAX_BATCH = 60000  # ids per socket, below the 65536 id namespace
+
+
+def _query_batch_one(
+    queries: Sequence[tuple[str, str]],
+    resolvers: Sequence[str],
+    timeout_ms: int,
+    retries: int,
+    port: int,
+) -> list[Optional[DnsReply]]:
+    """One shared-socket wave of at most _MAX_BATCH queries.
+
+    Transaction ids are a random permutation of the id space (not the
+    query index): an off-path forger must guess the id, not count.
     """
     n = len(queries)
     out: list[Optional[DnsReply]] = [None] * n
     if n == 0 or not resolvers:
         return out
-    if n > 60000:
-        raise ValueError("batch exceeds the 16-bit DNS id namespace")
+    ids = random.sample(range(65536), n)
+    id_to_idx = {qid: i for i, qid in enumerate(ids)}
     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     sock.setblocking(False)
     try:
@@ -221,7 +252,7 @@ def query_batch(
                 pending.discard(i)
                 continue
             packets.append(
-                struct.pack("!HHHHHH", i, 0x0100, 1, 0, 0, 0)
+                struct.pack("!HHHHHH", ids[i], 0x0100, 1, 0, 0, 0)
                 + enc
                 + struct.pack("!HH", tcode, 1)
             )
@@ -232,8 +263,8 @@ def query_batch(
             # echoed question must match what we asked
             if addr not in resolver_addrs or len(data) < 12:
                 return
-            rid = struct.unpack("!H", data[:2])[0]
-            if rid not in pending:
+            rid = id_to_idx.get(struct.unpack("!H", data[:2])[0])
+            if rid is None or rid not in pending:
                 return
             flags = struct.unpack("!H", data[2:4])[0]
             if not flags & 0x8000:  # not a response

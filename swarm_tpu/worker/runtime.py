@@ -16,6 +16,7 @@ reference's cadence (0.8 s between jobs, 10 s when idle). Differences:
 
 from __future__ import annotations
 
+import json
 import re
 import subprocess
 import tempfile
@@ -216,7 +217,10 @@ class JobProcessor:
             engine.stats.device_seconds,
             engine.stats.host_confirm_seconds,
         )
-        key = f"active::{module.templates_dir}"
+        # keyed by probe spec too: two modules sharing a templates dir
+        # but differing in ports/timeouts/concurrency must not alias
+        probe_key = json.dumps(module.probe or {}, sort_keys=True)
+        key = f"active::{module.templates_dir}::{probe_key}"
         scanner = self._engines.get(key)
         if scanner is None:
             scanner = ActiveScanner(engine, module.probe)

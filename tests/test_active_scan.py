@@ -135,6 +135,32 @@ def test_interior_baseurl_becomes_absolute():
     assert b"GET /go?next=http://h.example:8080/home HTTP/1.1" in wire
 
 
+def test_scheme_port_resolved_per_target():
+    """{{Scheme}}/{{Port}}/{{BaseURL}} reflect the actual probe, not
+    plan-time defaults: a TLS probe on 8443 renders https://h:8443."""
+    t = T(
+        LOGIN_TEMPLATE.replace(
+            "/admin/login", "/r?u={{Scheme}}://{{Hostname}}&p={{Port}}"
+        )
+    )
+    plan = active.build_plan([t])
+    wire = plan.requests[0].wire("h.example", 8443, tls=True)
+    assert b"GET /r?u=https://h.example:8443&p=8443 HTTP/1.1" in wire
+    # scheme-default ports drop the :port everywhere
+    wire = plan.requests[0].wire("h.example", 443, tls=True)
+    assert b"GET /r?u=https://h.example&p=443 HTTP/1.1" in wire
+    wire = plan.requests[0].wire("h.example", 80, tls=False)
+    assert b"GET /r?u=http://h.example&p=80 HTTP/1.1" in wire
+    assert b"Host: h.example\r\n" in wire
+
+
+def test_interior_baseurl_https_target():
+    t = T(LOGIN_TEMPLATE.replace("/admin/login", "/go?next={{BaseURL}}/home"))
+    plan = active.build_plan([t])
+    wire = plan.requests[0].wire("h.example", 443, tls=True)
+    assert b"GET /go?next=https://h.example/home HTTP/1.1" in wire
+
+
 # ---------------------------------------------------------------------------
 # end-to-end with path-dependent servers
 

@@ -137,6 +137,55 @@ def dns_server():
     srv.shutdown()
 
 
+def test_query_batch_chunks_above_id_namespace(dns_server, monkeypatch):
+    """Batches larger than _MAX_BATCH split into waves transparently,
+    preserving reply order across the chunk boundary."""
+    monkeypatch.setattr(dnsquery, "_MAX_BATCH", 2)
+    replies = dnsquery.query_batch(
+        [("a.example.test", "CNAME"), ("b.example.test", "CNAME"),
+         ("c.example.test", "CNAME"), ("app.servfail.test", "A"),
+         ("other.test", "CNAME")],
+        ["127.0.0.1"],
+        timeout_ms=2000,
+        port=dns_server,
+    )
+    assert len(replies) == 5
+    for r in replies[:3]:
+        assert r is not None and "ghs.googlehosted.com" in r.answers[0].rdata
+    assert replies[3].rcode == "SERVFAIL"
+    assert replies[4].rcode == "NOERROR" and not replies[4].answers
+
+
+def test_query_ids_are_randomized(dns_server):
+    """Transaction ids must not be the query index: an off-path forger
+    should have to guess 16 random bits, not count upward."""
+    seen: list[int] = []
+
+    class H(socketserver.BaseRequestHandler):
+        def handle(self):
+            data, sock = self.request
+            seen.append(struct.unpack("!H", data[:2])[0])
+            qname, off = dnsquery._read_name(data, 12)
+            question = data[12 : off + 4]
+            hdr = data[:2] + struct.pack("!HHHHH", 0x8180, 1, 0, 0, 0)
+            sock.sendto(hdr + question, self.client_address)
+
+    srv = _UDPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        queries = [(f"q{i}.test", "A") for i in range(32)]
+        replies = dnsquery.query_batch(
+            queries, ["127.0.0.1"], timeout_ms=2000,
+            port=srv.server_address[1],
+        )
+        assert all(r is not None for r in replies)
+        ids = set(seen)
+        assert len(ids) == 32  # all distinct
+        assert ids != set(range(32))  # not the sequential index
+    finally:
+        srv.shutdown()
+
+
 def test_query_batch_against_local_resolver(dns_server):
     replies = dnsquery.query_batch(
         [("app.example.test", "CNAME"), ("app.servfail.test", "A"),
