@@ -170,6 +170,7 @@ int swarm_tcp_scan_tls(const uint32_t* ips, const uint16_t* ports, int32_t n,
     // reported, not the direction the caller wanted
     bool wr_blocked_on_read = false;
     bool rd_blocked_on_write = false;
+    uint32_t armed = 0;  // current epoll mask — skip no-op MODs
   };
 
   if (n <= 0) return 0;
@@ -235,11 +236,13 @@ int swarm_tcp_scan_tls(const uint32_t* ips, const uint16_t* ports, int32_t n,
   // read window
   auto arm = [&](int s, bool want_out) {
     Conn& c = slots[s];
+    uint32_t events = EPOLLIN | (want_out ? (uint32_t)EPOLLOUT : 0u);
+    if (events == c.armed) return;  // steady read phase: zero syscalls
     struct epoll_event ev;
     std::memset(&ev, 0, sizeof(ev));
     ev.data.u32 = (uint32_t)s;
-    ev.events = EPOLLIN | (want_out ? (uint32_t)EPOLLOUT : 0u);
-    epoll_ctl(ep, EPOLL_CTL_MOD, c.fd, &ev);
+    ev.events = events;
+    if (epoll_ctl(ep, EPOLL_CTL_MOD, c.fd, &ev) == 0) c.armed = events;
   };
 
   // EPOLLOUT is wanted when payload remains and SSL_write is not
@@ -371,6 +374,7 @@ int swarm_tcp_scan_tls(const uint32_t* ips, const uint16_t* ports, int32_t n,
     std::memset(&ev, 0, sizeof(ev));
     ev.data.u32 = (uint32_t)s;
     ev.events = c.connected ? EPOLLIN : EPOLLOUT;
+    c.armed = ev.events;
     if (epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev) < 0) {
       close(fd);
       c = Conn{};
@@ -473,7 +477,12 @@ int swarm_tcp_scan_tls(const uint32_t* ips, const uint16_t* ports, int32_t n,
         drive_handshake(s);
         // appdata can arrive inside the same TLS records as the final
         // handshake flight; epoll won't re-fire for buffered bytes
-        if (c.fd >= 0 && c.hs == HS_DONE) pump_read(s);
+        if (c.fd >= 0 && c.hs == HS_DONE) {
+          pump_read(s);
+          // pump_read may have flagged rd_blocked_on_write after the
+          // handshake-completion arm — re-arm or the conn stalls
+          if (c.fd >= 0) arm(s, want_out(s));
+        }
         continue;
       }
       if (evs & EPOLLOUT) {

@@ -84,13 +84,21 @@ MAX_SCALAR_CONJUNCTS = 6
 MAX_GROUP = 8  # max word slots sharing one (table, h1) group
 
 # Rough byte-commonness weights for picking the rarest q-gram of a word.
+# Calibrated for the actual haystacks (HTML bodies, HTTP headers):
+# markup/structural bytes are the MOST common there — "</title>",
+# "\r\nServer:", "=\"" style grams recur in nearly every response, so a
+# gram of markup bytes must never beat a gram of letters. (A weight
+# inversion here once made every "…</title>" word share the
+# "</title>" gram — one shared table group, mass candidate collisions.)
 _COMMON = np.zeros(256, dtype=np.float32)
-for _c in b"etaoinshrdlucmfwygpb ":
+for _c in b"<>/\"'=.-_:;()\r\n\t ":
+    _COMMON[_c] = 1.3
+for _c in b"etaoinshrdlucmfwygpb":
     _COMMON[_c] = 1.0
-for _c in b"ETAOINSHRDLU<>/\"'=.-_:;()0123456789":
-    _COMMON[_c] = 0.7
-for _c in b"\r\n\t&?%+,![]{}":
-    _COMMON[_c] = 0.5
+for _c in b"ETAOINSHRDLU0123456789":
+    _COMMON[_c] = 0.8
+for _c in b"&?%+,![]{}":
+    _COMMON[_c] = 0.9
 
 
 def _gram_offsets_by_rarity(data: bytes, q: int) -> list[int]:
@@ -443,10 +451,17 @@ def full_literal_expansions(
 class ScalarProgram:
     conjuncts: list[tuple[int, int, float]]  # (var, op, value)
     contains: list[tuple[bytes, str, bool]]  # (needle, stream, case_insensitive)
-    residue: bool = False  # md5/sha residue → hit needs host confirm
+    residue: bool = False  # sha/mmh3 residue → hit needs host confirm
     never: bool = False  # statically unsatisfiable (e.g. "AbC" in tolower(x))
     any_of: bool = False  # contains are OR-reduced (no conjuncts/residue)
     negated: bool = False  # value = NOT(OR of contains) — !contains() exprs
+    # md5(body) == "<hex>" conjunct, lowered to the device digest
+    # comparison (ops/md5.py) — exact, no host confirm
+    md5: Optional[bytes] = None
+    # conjuncts of the form !contains(...)/!regex('literal',...): every
+    # listed needle must be ABSENT (NOT(OR)) — the missing-header
+    # template shape (misconfiguration/http-missing-security-headers)
+    neg_contains: list = dataclasses.field(default_factory=list)
 
 
 def _lower_contains_call(node):
@@ -454,6 +469,18 @@ def _lower_contains_call(node):
     if not (node[0] == "call" and node[1] == "contains" and len(node[2]) == 2):
         return None
     hay, needle = node[2]
+    # interactsh_* env vars are constant "" (OOB callbacks are out of
+    # scope, surfaced per-template as oob-skipped): contains over them
+    # is statically False — without this fold the whole op degrades to
+    # a fire-always prefilter (e.g. cves/2022/CVE-2022-26134.yaml)
+    if (
+        hay[0] == "var"
+        and hay[1] in ("interactsh_protocol", "interactsh_request")
+        and needle[0] == "lit"
+        and isinstance(needle[1], str)
+        and needle[1]
+    ):
+        return "never"
     loc = _part_stream_of_var(hay)
     if not (loc and needle[0] == "lit" and isinstance(needle[1], str)):
         return None
@@ -650,7 +677,10 @@ def lower_dsl(ast, superset: bool = False) -> Optional[ScalarProgram]:
                     real_op = _SWAP.get(op, op) if swapped else op
                     prog.conjuncts.append((var, real_op, float(b[1])))
                     return True
-            # hash-equality residue:  md5(body) == "…"  (either side)
+            # hash equality:  md5(body) == "…"  (either side). The
+            # md5-of-plain-body shape lowers to the on-device digest
+            # compare (ops/md5.py) — exact; other hash fns / wrapped
+            # args stay residues (host confirms fired rows).
             for a, b in ((lhs, rhs), (rhs, lhs)):
                 if (
                     op == SOP_EQ
@@ -659,8 +689,31 @@ def lower_dsl(ast, superset: bool = False) -> Optional[ScalarProgram]:
                     and b[0] == "lit"
                     and isinstance(b[1], str)
                 ):
-                    prog.residue = True
+                    digest = None
+                    if (
+                        a[1] == "md5"
+                        and list(a[2]) == [("var", "body")]
+                        and re.fullmatch(r"[0-9a-fA-F]{32}", b[1])
+                    ):
+                        digest = bytes.fromhex(b[1].lower())
+                    if digest is not None:
+                        if prog.md5 is not None and prog.md5 != digest:
+                            prog.never = True  # two different body digests
+                        prog.md5 = digest
+                    else:
+                        prog.residue = True
                     return True
+            return False
+        if node[0] == "un" and node[1] == "!":
+            # negated substring conjunct: !contains(...) / !regex('lit')
+            # ≡ "none of the needles present" — slot bits are exact, so
+            # negation is exact (per-matcher neg_contains bucket)
+            eq = _contains_equiv(node[2])
+            if eq == "never":
+                return True  # !False — vacuous conjunct
+            if eq is not None:
+                prog.neg_contains.extend(eq)
+                return True
             return False
         eq = _contains_equiv(node)
         if eq is not None:
@@ -730,6 +783,19 @@ def _merge_dsl_progs(
                 any_of=True,
                 negated=True,
             )
+        if negated and not any(p.residue for p in negated):
+            # a negated-OR branch is exactly a neg_contains conjunct:
+            # NOT(OR(needles)) ≡ "none present" — fold it into the AND
+            # bucket instead of failing the merge (the
+            # missing-security-headers matcher shape: !regex(lit) in
+            # one expression, scalar compares in the next)
+            fold = ScalarProgram(
+                conjuncts=[],
+                contains=[],
+                neg_contains=[c for p in negated for c in p.contains],
+            )
+            plain = plain + [fold]
+            negated = []
         if negated or any(p.any_of for p in plain):
             # negated/OR-group members can't fold into the AND bucket;
             # superset mode drops them (widening an AND is sound)
@@ -747,7 +813,12 @@ def _merge_dsl_progs(
         for p in plain:
             out.conjuncts += p.conjuncts
             out.contains += p.contains
+            out.neg_contains += p.neg_contains
             out.residue |= p.residue
+            if p.md5 is not None:
+                if out.md5 is not None and out.md5 != p.md5:
+                    out.never = True
+                out.md5 = p.md5
         if len(out.conjuncts) > MAX_SCALAR_CONJUNCTS:
             if not superset:
                 return None
@@ -759,7 +830,12 @@ def _merge_dsl_progs(
     if not live:
         return ScalarProgram(conjuncts=[], contains=[], never=True)
     if any(
-        not p.contains and not p.conjuncts and not p.residue for p in live
+        not p.contains
+        and not p.conjuncts
+        and not p.residue
+        and p.md5 is None
+        and not p.neg_contains
+        for p in live
     ):
         # an always-True branch (e.g. every negated needle statically
         # absent) makes the whole OR always True
@@ -769,6 +845,8 @@ def _merge_dsl_progs(
     if all(
         not p.conjuncts
         and not p.residue
+        and p.md5 is None
+        and not p.neg_contains
         # AND-reduced multi-contains branches can't flatten into an OR
         and (p.any_of or len(p.contains) == 1)
         for p in live
@@ -890,8 +968,14 @@ class CompiledDB:
     m_negative: np.ndarray  # bool [NM]
     m_cond_and: np.ndarray  # bool [NM]
     m_slot_buckets: list  # list[IndexBucket] matcher → word-slot ids
+    # negated-contains bucket: matcher requires NONE of these slots to
+    # be present (http-missing-security-headers-style dsl conjuncts)
+    m_negslot_buckets: list  # list[IndexBucket] matcher → word-slot ids
     m_scalar: np.ndarray  # float32 [NM, MAX_SCALAR_CONJUNCTS, 3] (var, op, val)
     m_residue: np.ndarray  # bool [NM] — scalar pass still needs host confirm
+    # device md5 digest equality (ops/md5.py): md5(body) == digest
+    m_md5: np.ndarray  # uint32 [NM, 4] little-endian digest words
+    m_md5_check: np.ndarray  # bool [NM]
     m_status: np.ndarray  # int32 [NM, MAX_STATUS] (pad = -1)
     m_size: np.ndarray  # int32 [NM, MAX_STATUS] (pad = -1)
     m_size_stream: np.ndarray  # int32 [NM] stream index for size matchers
@@ -902,6 +986,13 @@ class CompiledDB:
     op_m_buckets: list  # list[IndexBucket] op → matcher ids
     t_op_buckets: list  # list[IndexBucket] template → op ids
     t_prefilter: np.ndarray  # bool [NT] — any op superset-lowered (reporting)
+
+    # host-side provenance (sparse confirmation, engine.py): device ids
+    # back to source template/operation/matcher indices + ragged lists
+    m_src: np.ndarray  # int32 [NM, 3] (template_idx, op_local, matcher_local)
+    op_src: np.ndarray  # int32 [NOP, 2] (template_idx, op_local)
+    op_matchers: list  # list[list[int]] op id → device matcher ids
+    t_ops: list  # list[list[int]] template id → device op ids
 
     template_ids: list  # str [NT] — device-evaluated templates
     host_always: list  # list[Template] — exact-CPU-only tail
@@ -979,6 +1070,8 @@ def compile_corpus(
             "status": [],
             "size": [],
             "size_stream": 0,
+            "md5": None,
+            "neg_slots": [],
         }
 
         def const(value: bool) -> dict:
@@ -1035,16 +1128,25 @@ def compile_corpus(
             rec["size_stream"] = STREAMS.index(stream)
             return rec
         if m.type == "regex":
+            # a pattern Python's re rejects makes the oracle return
+            # "unsupported" → constant False, negation NOT applied
+            # (cpu_ref.match_matcher returns None pre-negation) — e.g.
+            # waf-detect's '(?)content="CloudWAF"'. Exact, and it keeps
+            # one broken pattern from demoting 86 siblings to a
+            # host-confirmed prefilter op.
+            try:
+                for pattern in m.regex:
+                    re.compile(pattern)
+            except re.error:
+                rec["negative"] = False
+                return rec
             stream = stream_for_part(m.part)
             if stream is None:
                 # oracle runs the regex over the empty string — also a
                 # compile-time constant (e.g. `.*` matches empty)
                 results = []
                 for pattern in m.regex:
-                    try:
-                        results.append(re.search(pattern, "") is not None)
-                    except re.error:
-                        return None
+                    results.append(re.search(pattern, "") is not None)
                 if not results:
                     return None
                 value = all(results) if m.condition == "and" else any(results)
@@ -1073,7 +1175,16 @@ def compile_corpus(
             # confirms, never misses. Literals probe the lowered stream.
             lit_sets = []
             for pattern in m.regex:
-                lits = required_literal_set(pattern)
+                # relax the length floor before failing: a 2–3 byte
+                # anchor is a weak but still exact-on-miss prefilter
+                # (waf-detect's '(?i)ray.id' family) — and one
+                # unloweable pattern would otherwise demote every
+                # sibling matcher's op to host-confirmed prefilter
+                lits = None
+                for ml in (4, 3, 2):
+                    lits = required_literal_set(pattern, min_len=ml)
+                    if lits is not None:
+                        break
                 if lits is None:
                     return None
                 lit_sets.append(lits)
@@ -1131,6 +1242,11 @@ def compile_corpus(
                 slots.get(needle, stream, lowered)
                 for needle, stream, lowered in merged.contains
             ]
+            rec["md5"] = merged.md5
+            rec["neg_slots"] = [
+                slots.get(needle, stream, lowered)
+                for needle, stream, lowered in merged.neg_contains
+            ]
             return rec
         return None  # kval / json / xpath
 
@@ -1148,6 +1264,8 @@ def compile_corpus(
             "status": [],
             "size": [],
             "size_stream": 0,
+            "md5": None,
+            "neg_slots": [],
         }
 
     def lower_matcher_superset(m: Matcher) -> dict:
@@ -1249,7 +1367,7 @@ def compile_corpus(
         if template.protocol == "workflow" or not template.operations:
             continue
         lowered_ops: list[dict] = []
-        for op in template.operations:
+        for op_local, op in enumerate(template.operations):
             recs = []
             exact = True
             for m in op.matchers:
@@ -1269,22 +1387,29 @@ def compile_corpus(
                     "cond_and": op.matchers_condition == "and",
                     "matchers": recs,
                     "prefilter": not exact,
+                    "op_local": op_local,
                 }
             )
         op_ids = []
         prefiltered = False
+        t_idx = len(t_ops)  # this template's index once kept
         for lop in lowered_ops:
             if not lop["matchers"]:
                 continue
             m_ids = []
-            for rec in lop["matchers"]:
+            for m_local, rec in enumerate(lop["matchers"]):
                 m_ids.append(len(matchers))
+                # provenance back to the source nuclei matcher so the
+                # host can re-evaluate exactly this matcher (engine's
+                # sparse confirmation path) instead of the whole template
+                rec["src"] = (t_idx, lop["op_local"], m_local)
                 matchers.append(rec)
             ops.append(
                 {
                     "cond_and": lop["cond_and"],
                     "matchers": m_ids,
                     "prefilter": lop["prefilter"],
+                    "src": (t_idx, lop["op_local"]),
                 }
             )
             op_ids.append(len(ops) - 1)
@@ -1378,7 +1503,14 @@ def compile_corpus(
                 entry_count.append(0)
             entry_count[-1] += 1
             data = slots.entries[slot_id][0]
-            suf_off = len(data) - q  # suffix gram start within the word
+            # suffix gram: the rarest window *different* from the main
+            # gram. The last-q-bytes choice made same-suffix families
+            # ("…</title>") share a trivially-true check (delta 0) —
+            # the false-fire storm the device verify then had to absorb.
+            suf_off = next(
+                (a for a in candidates.get(slot_id, [0]) if a != off),
+                len(data) - q,
+            )
             sh1, sh2 = _hash_at(data, suf_off, q)
             e_h2.append(h2)
             e_slot.append(slot_id)
@@ -1449,6 +1581,8 @@ def compile_corpus(
     m_status = np.full((NM, max_status), -1, dtype=np.int32)
     m_size = np.full((NM, max_status), -1, dtype=np.int32)
     m_size_stream = np.zeros((NM,), dtype=np.int32)
+    m_md5 = np.zeros((NM, 4), dtype=np.uint32)
+    m_md5_check = np.zeros((NM,), dtype=bool)
     for i, rec in enumerate(matchers):
         m_kind[i] = rec["kind"]
         m_negative[i] = rec["negative"]
@@ -1456,12 +1590,18 @@ def compile_corpus(
         for j, (var, op, val) in enumerate(rec["scalar"][:MAX_SCALAR_CONJUNCTS]):
             m_scalar[i, j] = (var, op, val)
         m_residue[i] = rec["residue"]
+        if rec.get("md5") is not None:
+            m_md5[i] = np.frombuffer(rec["md5"], dtype="<u4")
+            m_md5_check[i] = True
         for j, s in enumerate(rec["status"]):
             m_status[i, j] = s
         for j, s in enumerate(rec["size"]):
             m_size[i, j] = s
         m_size_stream[i] = rec["size_stream"]
     m_slot_buckets = bucket_ragged([r["slots"] for r in matchers], NM)
+    m_negslot_buckets = bucket_ragged(
+        [r.get("neg_slots", []) for r in matchers], NM
+    )
 
     # --- operation / template arrays ---
     NOP = max(len(ops), 1)
@@ -1474,6 +1614,16 @@ def compile_corpus(
     t_op_buckets = bucket_ragged(t_ops, max(len(t_ops), 1))
 
     t_prefilter = np.array(t_prefilter_flags or [False], dtype=bool)
+
+    # provenance for the engine's sparse host-confirmation: device
+    # matcher/op id → source (template, operation[, matcher]) indices
+    m_src = np.zeros((NM, 3), dtype=np.int32)
+    for i, rec in enumerate(matchers):
+        m_src[i] = rec["src"]
+    op_src = np.zeros((NOP, 2), dtype=np.int32)
+    for i, o in enumerate(ops):
+        op_src[i] = o["src"]
+    op_matchers = [list(o["matchers"]) for o in ops]
 
     stats = {
         "templates_in": len(templates),
@@ -1506,8 +1656,11 @@ def compile_corpus(
         m_negative=m_negative,
         m_cond_and=m_cond_and,
         m_slot_buckets=m_slot_buckets,
+        m_negslot_buckets=m_negslot_buckets,
         m_scalar=m_scalar,
         m_residue=m_residue,
+        m_md5=m_md5,
+        m_md5_check=m_md5_check,
         m_status=m_status,
         m_size=m_size,
         m_size_stream=m_size_stream,
@@ -1516,6 +1669,10 @@ def compile_corpus(
         op_m_buckets=op_m_buckets,
         t_op_buckets=t_op_buckets,
         t_prefilter=t_prefilter,
+        m_src=m_src,
+        op_src=op_src,
+        op_matchers=op_matchers,
+        t_ops=[list(o) for o in t_ops],
         template_ids=[t.id for t in kept_templates],
         host_always=host_always,
         templates=kept_templates,

@@ -331,11 +331,23 @@ def build_env(response) -> dict[str, Any]:
     }
 
 
+_PARSE_CACHE: dict[str, Optional[tuple]] = {}
+
+
 def try_parse(text: str) -> Optional[tuple]:
+    """Parse-or-None, memoized: the corpus has a few thousand distinct
+    expressions but the engine's sparse confirmation path re-evaluates
+    the hot ones per fired row — parsing must not dominate that."""
     try:
-        return parse_dsl(text)
-    except DslError:
-        return None
+        return _PARSE_CACHE[text]
+    except KeyError:
+        try:
+            ast = parse_dsl(text)
+        except DslError:
+            ast = None
+        if len(_PARSE_CACHE) < 65536:
+            _PARSE_CACHE[text] = ast
+        return ast
 
 
 #: Names ``build_env`` defines — the oracle's complete variable surface.

@@ -4,8 +4,20 @@ Composes the pieces: template corpus → CompiledDB (once), responses →
 padded batches → device kernel → sparse host confirmation with the
 exact CPU oracle. The result is bit-identical to running the oracle on
 every (row, template) pair — the device does ~all the work, the host
-touches only uncertain pairs that actually fired and the (small,
-reported) host-always template tail.
+touches only the specific uncertain *matchers* that actually fired
+(plus the small, reported host-always template tail, empty for the
+reference corpus).
+
+Throughput contract: the packed path (:meth:`MatchEngine.match_packed`)
+never does per-row Python work for certain rows — verdicts stay bitset
+matrices end to end, uncertainty is resolved pair-sparsely, and the
+three-valued (Kleene) refinement in the kernel (ops/match.py
+``eval_verdicts``) keeps the uncertain set small. A key consequence of
+that refinement drives the sparse resolver here: an op that is still
+*undecided* after its certain matchers are known has a neutral certain
+part (all-false under OR, all-true under AND), so its exact value is
+the combination of its *uncertain* matchers alone — the host never
+needs the certain siblings' values.
 
 This replaces the reference worker's subprocess shell-outs to
 nmap/-sV//nuclei (``worker/worker.py:79-84``) as the compute engine.
@@ -36,6 +48,24 @@ class RowMatches:
 
 
 @dataclasses.dataclass
+class PackedMatches:
+    """Exact verdicts for one batch in wire form.
+
+    ``bits[b, t >> 3] & (0x80 >> (t & 7))`` is template ``t``'s verdict
+    for row ``b`` (np.packbits MSB-first convention); ``template_ids``
+    maps the column index to ids. ``extractions`` is sparse:
+    ``(row, template_id) -> list[str]``. ``host_always_matches`` lists
+    (row, template_id) hits from the host-only tail, if any.
+    """
+
+    bits: np.ndarray  # uint8 [B, ceil(NT/8)]
+    template_ids: list
+    extractions: dict
+    host_always_matches: list
+    confirms_per_row: dict  # row -> host confirmations spent on it
+
+
+@dataclasses.dataclass
 class EngineStats:
     rows: int = 0
     batches: int = 0
@@ -44,6 +74,17 @@ class EngineStats:
     host_confirm_pairs: int = 0
     host_always_pairs: int = 0
     overflow_rows: int = 0
+
+
+def _bit(packed: np.ndarray, b: int, i: int) -> bool:
+    return bool((packed[b, i >> 3] >> (7 - (i & 7))) & 1)
+
+
+def _iter_set_bits(row_bytes: np.ndarray, limit: int) -> np.ndarray:
+    """Indices of set bits in one packed row (MSB-first), < limit."""
+    if limit <= 0:
+        return np.empty((0,), dtype=np.int64)
+    return np.flatnonzero(np.unpackbits(row_bytes, count=limit))
 
 
 class MatchEngine:
@@ -77,23 +118,58 @@ class MatchEngine:
         self.sharded = None
         self.mesh = None
         self._candidate_k = candidate_k
+        db = self.db
+        # device matcher/op id → source objects for sparse confirmation
+        self._m_obj = [
+            db.templates[t].operations[o].matchers[m]
+            for t, o, m in db.m_src
+        ] if db.templates else []
+        self._op_obj = [
+            db.templates[t].operations[o] for t, o in db.op_src
+        ] if db.templates else []
         # templates with extractors need a host pass on *hits* even when
         # the verdict itself was device-certain, so extraction output
         # stays bit-identical to the oracle
         self._has_extractors = [
-            any(
-                ex.type in ("regex", "kval", "json", "xpath")
-                for op in t.operations
-                for ex in op.extractors
-            )
-            for t in self.db.templates
+            any(op.extractors for op in t.operations) for t in db.templates
+        ]
+        self._ext_t_idx = [
+            i for i, has in enumerate(self._has_extractors) if has
         ]
 
     # ------------------------------------------------------------------
     def match(self, responses: Sequence[Response]) -> list[RowMatches]:
+        """Per-row exact match sets (compat/active-scanner form).
+
+        Built from the packed path; per-row object assembly makes this
+        the slower surface — bulk pipelines use :meth:`match_packed`.
+        """
         out: list[RowMatches] = []
+        NT = self.db.num_templates
         for start in range(0, len(responses), self.batch_rows):
-            out.extend(self._match_batch(responses[start : start + self.batch_rows]))
+            rows = responses[start : start + self.batch_rows]
+            packed = self.match_packed(rows)
+            per_row_conf = packed.confirms_per_row
+            for b in range(len(rows)):
+                tids = [
+                    self.db.template_ids[t]
+                    for t in _iter_set_bits(packed.bits[b], NT)
+                ]
+                extr = {
+                    tid: ext
+                    for (rb, tid), ext in packed.extractions.items()
+                    if rb == b
+                }
+                for rb, tid in packed.host_always_matches:
+                    if rb == b:
+                        tids.append(tid)
+                out.append(
+                    RowMatches(
+                        template_ids=tids,
+                        extractions=extr,
+                        confirmed_on_host=per_row_conf.get(b, 0),
+                    )
+                )
         return out
 
     # ------------------------------------------------------------------
@@ -155,75 +231,205 @@ class MatchEngine:
         return batch, self.sharded
 
     # ------------------------------------------------------------------
-    def _match_batch(self, all_rows: Sequence[Response]) -> list[RowMatches]:
+    def match_packed(self, all_rows: Sequence[Response]) -> PackedMatches:
+        """Exact verdict bitsets for up to ``batch_rows`` responses.
+
+        The production wire format: one device dispatch, vectorized
+        verdict assembly, host work proportional to the number of
+        *uncertain fired matchers* — not to rows × templates.
+        """
+        NT = self.db.num_templates
+        nbytes = (NT + 7) >> 3
         # dead rows (no response observed) match nothing by contract —
         # drop them before encoding so the device never pays for them
         alive_idx = [i for i, r in enumerate(all_rows) if r.alive]
         if len(alive_idx) < len(all_rows):
-            out = [RowMatches(template_ids=[], extractions={}) for _ in all_rows]
+            bits = np.zeros((len(all_rows), max(nbytes, 1)), dtype=np.uint8)
+            extractions: dict = {}
+            host_always: list = []
+            conf: dict = {}
             if alive_idx:
-                live = self._match_batch([all_rows[i] for i in alive_idx])
+                live = self.match_packed([all_rows[i] for i in alive_idx])
+                back = {j: i for j, i in enumerate(alive_idx)}
                 for j, i in enumerate(alive_idx):
-                    out[i] = live[j]
+                    bits[i] = live.bits[j]
+                extractions = {
+                    (back[rb], tid): ext
+                    for (rb, tid), ext in live.extractions.items()
+                }
+                host_always = [
+                    (back[rb], tid) for rb, tid in live.host_always_matches
+                ]
+                conf = {
+                    back[rb]: n for rb, n in live.confirms_per_row.items()
+                }
             self.stats.rows += len(all_rows) - len(alive_idx)
-            return out
+            return PackedMatches(
+                bits=bits,
+                template_ids=self.db.template_ids,
+                extractions=extractions,
+                host_always_matches=host_always,
+                confirms_per_row=conf,
+            )
+
         rows = all_rows
         batch, matcher = self._encode_for_backend(rows)
         t0 = time.perf_counter()
-        t_value, t_unc, overflow = matcher.match(
-            batch.streams, batch.lengths, batch.status
+        pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
+            matcher.match(batch.streams, batch.lengths, batch.status, full=True)
         )
         # slice off mesh row padding before the host walk
-        t_value = np.asarray(t_value)[: len(rows)]
-        t_unc = np.asarray(t_unc)[: len(rows)]
-        overflow = np.asarray(overflow)[: len(rows)]
+        B = len(rows)
+        pt_value = np.array(np.asarray(pt_value)[:B])  # writable copy
+        pt_unc = np.asarray(pt_unc)[:B]
+        pop_value = np.asarray(pop_value)[:B]
+        pop_unc = np.asarray(pop_unc)[:B]
+        pm_unc = np.asarray(pm_unc)[:B]
+        overflow = np.asarray(overflow)[:B]
         self.stats.device_seconds += time.perf_counter() - t0
-        self.stats.rows += len(rows)
+        self.stats.rows += B
         self.stats.batches += 1
 
         # rows needing whole-row reconfirmation (candidate overflow or
         # stream truncation made word bits unsound for the row)
-        row_redo = overflow | batch.truncated[: len(rows)]
+        row_redo = overflow | batch.truncated[:B]
         self.stats.overflow_rows += int(row_redo.sum())
 
         t1 = time.perf_counter()
-        results: list[RowMatches] = []
-        for b, row in enumerate(rows):
-            matched: list[str] = []
-            extractions: dict = {}
-            confirmed = 0
-            for t_idx, template in enumerate(self.db.templates):
-                if row_redo[b] or t_unc[b, t_idx]:
-                    res = cpu_ref.match_template(template, row)
-                    confirmed += 1
-                    hit = res.matched
-                    if hit and res.extractions:
-                        extractions[template.id] = res.extractions
-                else:
-                    hit = bool(t_value[b, t_idx])
-                    if hit and self._has_extractors[t_idx]:
-                        res = cpu_ref.match_template(template, row)
-                        confirmed += 1
-                        if res.extractions:
-                            extractions[template.id] = res.extractions
-                if hit:
-                    matched.append(template.id)
-            self.stats.host_confirm_pairs += confirmed
-            # host-always tail: templates the compiler couldn't lower
-            if self.host_always_mode == "full":
-                for template in self.db.host_always:
+        confirms: dict = {}
+        db = self.db
+
+        op_cache: dict = {}  # (b, op_id) -> exact bool
+        # content-keyed matcher memo: scan batches repeat headers and
+        # default pages heavily, and a matcher's verdict depends only on
+        # its part bytes (bytes hashing is cached by CPython, so the
+        # dict lookup is cheap after the first touch per row)
+        part_cache: dict = {}
+
+        def confirm_matcher(m_id: int, row: Response) -> bool:
+            matcher = self._m_obj[m_id]
+            if matcher.type not in ("word", "regex", "binary", "size"):
+                # dsl/status/kval read beyond matcher.part — not cacheable
+                mv = cpu_ref.match_matcher(matcher, row)
+                return bool(mv) if mv is not None else False
+            key = (m_id, row.part(matcher.part))
+            v = part_cache.get(key)
+            if v is None:
+                mv = cpu_ref.match_matcher(matcher, row)
+                v = bool(mv) if mv is not None else False
+                part_cache[key] = v
+            return v
+
+        def resolve_op(b: int, op_id: int, row: Response) -> bool:
+            key = (b, op_id)
+            v = op_cache.get(key)
+            if v is not None:
+                return v
+            if not _bit(pop_unc, b, op_id):
+                v = _bit(pop_value, b, op_id)
+            elif db.op_prefilter[op_id]:
+                # superset-lowered op: per-matcher bits are weakened, so
+                # fired rows re-run the whole op on the oracle
+                v = cpu_ref.match_operation(self._op_obj[op_id], row)[0]
+                confirms[b] = confirms.get(b, 0) + 1
+                self.stats.host_confirm_pairs += 1
+            else:
+                # undecided ⇒ certain matchers are neutral; combine the
+                # uncertain ones' exact values under the op condition
+                vals = []
+                for m_id in db.op_matchers[op_id]:
+                    if _bit(pm_unc, b, m_id):
+                        vals.append(confirm_matcher(m_id, row))
+                        confirms[b] = confirms.get(b, 0) + 1
+                        self.stats.host_confirm_pairs += 1
+                v = all(vals) if db.op_cond_and[op_id] else any(vals)
+            op_cache[key] = v
+            return v
+
+        # --- full-row redo (rare): the oracle end to end, extractions
+        # included (the extraction pass below skips these rows) ---
+        redo_rows = np.flatnonzero(row_redo)
+        redo_extractions: dict = {}
+        for b in redo_rows:
+            row = rows[b]
+            rowbits = np.zeros((pt_value.shape[1],), dtype=np.uint8)
+            for t_idx, template in enumerate(db.templates):
+                res = cpu_ref.match_template(template, row)
+                confirms[b] = confirms.get(b, 0) + 1
+                self.stats.host_confirm_pairs += 1
+                if res.matched:
+                    rowbits[t_idx >> 3] |= 0x80 >> (t_idx & 7)
+                    if res.extractions:
+                        redo_extractions[(int(b), template.id)] = (
+                            res.extractions
+                        )
+            pt_value[b] = rowbits
+
+        # --- sparse uncertainty resolution ---
+        if not row_redo.all() and pt_unc.any():
+            skip = set(redo_rows.tolist())
+            for b, byte_i in np.argwhere(pt_unc):
+                if b in skip:
+                    continue
+                v = int(pt_unc[b, byte_i])
+                row = rows[b]
+                base = int(byte_i) * 8
+                for k in range(8):
+                    if not (v & (0x80 >> k)):
+                        continue
+                    t_idx = base + k
+                    if t_idx >= NT:
+                        continue
+                    # undecided ⇒ no certain-true op; OR over the
+                    # uncertain ops' exact values decides the template
+                    hit = False
+                    for op_id in db.t_ops[t_idx]:
+                        if _bit(pop_unc, b, op_id) and resolve_op(
+                            b, op_id, row
+                        ):
+                            hit = True
+                            break
+                    mask = 0x80 >> (t_idx & 7)
+                    if hit:
+                        pt_value[b, byte_i] |= mask
+                    else:
+                        pt_value[b, byte_i] &= 0xFF ^ mask
+
+        # --- extraction pass: only extractor templates, only hit rows ---
+        extractions: dict = dict(redo_extractions)
+        redo_set = set(redo_rows.tolist())
+        for t_idx in self._ext_t_idx:
+            col = pt_value[:, t_idx >> 3] & (0x80 >> (t_idx & 7))
+            for b in np.flatnonzero(col):
+                if int(b) in redo_set:
+                    continue  # oracle already extracted above
+                row = rows[b]
+                parts: list = []
+                for op_id in db.t_ops[t_idx]:
+                    if resolve_op(b, op_id, row):
+                        parts.extend(
+                            cpu_ref._extract(self._op_obj[op_id], row)
+                        )
+                if parts:
+                    extractions[(int(b), db.template_ids[t_idx])] = parts
+
+        # --- host-always tail: templates the compiler couldn't lower ---
+        host_always_matches: list = []
+        if self.host_always_mode == "full" and db.host_always:
+            for b, row in enumerate(rows):
+                for template in db.host_always:
                     res = cpu_ref.match_template(template, row)
                     self.stats.host_always_pairs += 1
                     if res.matched:
-                        matched.append(template.id)
+                        host_always_matches.append((b, template.id))
                         if res.extractions:
-                            extractions[template.id] = res.extractions
-            results.append(
-                RowMatches(
-                    template_ids=matched,
-                    extractions=extractions,
-                    confirmed_on_host=confirmed,
-                )
-            )
+                            extractions[(b, template.id)] = res.extractions
+
         self.stats.host_confirm_seconds += time.perf_counter() - t1
-        return results
+        return PackedMatches(
+            bits=pt_value,
+            template_ids=db.template_ids,
+            extractions=extractions,
+            host_always_matches=host_always_matches,
+            confirms_per_row=confirms,
+        )

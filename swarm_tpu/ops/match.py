@@ -48,16 +48,36 @@ class DeviceDB:
         self.candidate_k = candidate_k
         self._fn_cache: dict = {}
 
-    def match(self, streams: dict, lengths: dict, status):
+    def match(self, streams: dict, lengths: dict, status, full: bool = False):
         """streams: name → uint8 [B, W]; lengths: name → int32 [B].
 
         Returns (t_value [B, NT] bool, t_uncertain [B, NT] bool,
-        overflow [B] bool).
+        overflow [B] bool); with ``full`` the op/matcher planes are
+        included: (t_value, t_unc, op_value, op_unc, m_unc, overflow)
+        — the engine's sparse-confirmation inputs, packed.
         """
-        shape_key = tuple(sorted((k, v.shape) for k, v in streams.items()))
+        shape_key = (
+            tuple(sorted((k, v.shape) for k, v in streams.items())),
+            full,
+        )
         fn = self._fn_cache.get(shape_key)
         if fn is None:
-            fn = jax.jit(functools.partial(_match_impl, self.db, self.candidate_k))
+            impl = functools.partial(
+                _match_impl, self.db, self.candidate_k, full=full
+            )
+            if full:
+                # bit-plane outputs ship packed (MSB-first, np.packbits
+                # convention): ~9× less host transfer per batch
+                def packed_impl(streams, lengths, status, _impl=impl):
+                    *planes, overflow = _impl(streams, lengths, status)
+                    return (
+                        *[jnp.packbits(p, axis=1) for p in planes],
+                        overflow,
+                    )
+
+                fn = jax.jit(packed_impl)
+            else:
+                fn = jax.jit(impl)
             self._fn_cache[shape_key] = fn
         return fn(
             {k: jnp.asarray(v) for k, v in streams.items()},
@@ -207,11 +227,19 @@ def match_slots(
             (1, k), dtype=jnp.int32
         )
 
-        # First hash-hit per candidate window is byte-verified below;
-        # additional same-window hits (h1+h2+suffix collisions across
-        # entries — vanishingly rare) keep the old uncertain-hit path.
-        cand_has = jnp.zeros((B, k), dtype=bool)
-        cand_entry = jnp.zeros((B, k), dtype=jnp.int32)
+        stream_v = get_stream(table.stream, table.lowered)
+        offs = jnp.arange(fpc.VERIFY_WIDTH, dtype=jnp.int32)
+
+        # EVERY entry hit is byte-verified (the compile.py:16-17
+        # contract): gather the slot's true bytes under the window and
+        # compare. Equal and len ≤ VERIFY_WIDTH ⇒ the hit is *certain*
+        # (no host confirm). Unequal ⇒ a hash collision: provably no
+        # match at this window, so no bit is set at all. Equal prefix of
+        # a longer slot ⇒ value + uncertain (host checks the tail).
+        # Per-entry (not first-hit-per-window) verification matters:
+        # words sharing their chosen gram land in one h1 group and can
+        # all pass the hash checks at one window — each needs its own
+        # byte compare. max_group ≤ 8 bounds the extra gathers.
         for g in range(table.max_group):
             e = jnp.minimum(e_start + g, entry_h2.shape[0] - 1)
             in_group = found & (g < e_count)
@@ -236,37 +264,20 @@ def match_slots(
             )
             hit = in_group & h2_ok & suf_ok & fits
             slot = entry_slot[e]
-            new = hit & ~cand_has
-            cand_entry = jnp.where(new, e, cand_entry)
-            extra = hit & ~new
-            value_bits = value_bits.at[b_idx, slot].max(extra)
-            uncertain_bits = uncertain_bits.at[b_idx, slot].max(extra)
-            cand_has = cand_has | hit
-
-        # --- fused byte-exact verify (the compile.py:16-17 contract) ---
-        # Gather the slot's true bytes under each first-hit window and
-        # compare. Equal and len ≤ VERIFY_WIDTH ⇒ the hit is *certain*
-        # (no host confirm). Unequal ⇒ a hash collision: provably no
-        # match at this window, so no bit is set at all. Equal prefix of
-        # a longer slot ⇒ value + uncertain (host checks the tail).
-        ec = cand_entry
-        slot_c = entry_slot[ec]
-        start = cpos - entry_off[ec]  # extended coordinate of word start
-        lv = jnp.minimum(entry_len[ec], fpc.VERIFY_WIDTH)
-        stream_v = get_stream(table.stream, table.lowered)
-        offs = jnp.arange(fpc.VERIFY_WIDTH, dtype=jnp.int32)
-        idx = start[:, :, None] + offs[None, None, :]  # [B, k, V]
-        idx_c = jnp.clip(idx, 0, We - 1)
-        gathered = jnp.take_along_axis(
-            stream_v, idx_c.reshape(B, -1), axis=1
-        ).reshape(B, k, fpc.VERIFY_WIDTH)
-        expected = slot_bytes_j[slot_c]  # [B, k, V]
-        pos_ok = offs[None, None, :] < lv[:, :, None]
-        eq = ((gathered == expected) | ~pos_ok).all(-1)
-        long = slot_len_j[slot_c] > fpc.VERIFY_WIDTH
-        fired = cand_has & eq
-        value_bits = value_bits.at[b_idx, slot_c].max(fired)
-        uncertain_bits = uncertain_bits.at[b_idx, slot_c].max(fired & long)
+            start = cpos - entry_off[e]  # extended coord of word start
+            lv = jnp.minimum(entry_len[e], fpc.VERIFY_WIDTH)
+            idx = start[:, :, None] + offs[None, None, :]  # [B, k, V]
+            idx_c = jnp.clip(idx, 0, We - 1)
+            gathered = jnp.take_along_axis(
+                stream_v, idx_c.reshape(B, -1), axis=1
+            ).reshape(B, k, fpc.VERIFY_WIDTH)
+            expected = slot_bytes_j[slot]  # [B, k, V]
+            pos_ok = offs[None, None, :] < lv[:, :, None]
+            eq = ((gathered == expected) | ~pos_ok).all(-1)
+            long = slot_len_j[slot] > fpc.VERIFY_WIDTH
+            fired = hit & eq
+            value_bits = value_bits.at[b_idx, slot].max(fired)
+            uncertain_bits = uncertain_bits.at[b_idx, slot].max(fired & long)
 
     # --- tiny slots: dense shifted compare (exact) ---
     tiny_count = int((np.asarray(db.tiny_len) > 0).sum())
@@ -304,8 +315,32 @@ def match_slots(
     return value_bits, uncertain_bits, overflow
 
 
-def eval_verdicts(db: fpc.CompiledDB, value_bits, uncertain_bits, lengths, status):
-    """Slot bits + scalars → (t_value, t_uncertain) [B, NT] bool."""
+def eval_verdicts(
+    db: fpc.CompiledDB,
+    value_bits,
+    uncertain_bits,
+    lengths,
+    status,
+    full=False,
+    md5_digest=None,
+):
+    """Slot bits + scalars → (t_value, t_uncertain) [B, NT] bool.
+
+    With ``full=True`` also returns the intermediate planes
+    ``(t_value, t_unc, op_value, op_unc, m_unc)`` so the host can
+    resolve an uncertain verdict by re-evaluating only the specific
+    uncertain matchers (engine.py) instead of the whole template.
+    (No m_value plane: an undecided op's certain matchers are neutral
+    by the Kleene argument, so the host never reads their values.)
+
+    Uncertainty is refined with three-valued logic at every reduction:
+    a verdict already decided by its *certain* inputs (a certain-true
+    input under OR, a certain-false one under AND) is exact no matter
+    what the uncertain inputs turn out to be, so its uncertain bit is
+    cleared. This is what keeps host confirmation sparse — e.g. a
+    status-matcher miss certain-falsifies an AND op and no regex
+    sibling ever needs host evaluation.
+    """
     B = status.shape[0]
     NM = db.m_kind.shape[0]
 
@@ -325,9 +360,34 @@ def eval_verdicts(db: fpc.CompiledDB, value_bits, uncertain_bits, lengths, statu
         gv = value_bits[:, bucket.idx]  # [B, nb, w]
         gu = uncertain_bits[:, bucket.idx]
         rows = jnp.asarray(bucket.rows)
-        red = jnp.where(cond_and[rows][None, :], gv.all(-1), gv.any(-1))
+        is_and = cond_and[rows][None, :]
+        red = jnp.where(is_and, gv.all(-1), gv.any(-1))
+        # Kleene: a certain-hit slot decides OR; a missed slot is always
+        # certain (uncertainty only attaches to fired q-gram hits), so
+        # any miss decides AND
+        decided = jnp.where(
+            is_and, (~gv).any(-1), (gv & ~gu).any(-1)
+        )
         slot_red = slot_red.at[:, rows].set(red)
-        m_unc = m_unc.at[:, rows].set(gu.any(-1))
+        m_unc = m_unc.at[:, rows].set(gu.any(-1) & ~decided)
+
+    # --- negated-contains buckets: NONE of the slots may be present ---
+    # (dsl conjuncts like !regex('(?i)x-frame-options', all_headers) —
+    # the missing-security-headers shape). Slot absence is always
+    # certain; an uncertain *fired* slot leaves presence unknown, so
+    # the matcher goes uncertain, and a certain-present slot decides
+    # the whole conjunction false.
+    neg_present = jnp.zeros((B, NM), dtype=bool)
+    neg_decided_false = jnp.zeros((B, NM), dtype=bool)
+    for bucket in db.m_negslot_buckets:
+        gv = value_bits[:, bucket.idx]
+        gu = uncertain_bits[:, bucket.idx]
+        rows = jnp.asarray(bucket.rows)
+        neg_present = neg_present.at[:, rows].set(gv.any(-1))
+        neg_decided_false = neg_decided_false.at[:, rows].set(
+            (gv & ~gu).any(-1)
+        )
+        m_unc = m_unc.at[:, rows].max(gu.any(-1))
 
     # --- scalar programs ---
     var_id = db.m_scalar[:, :, 0].astype(np.int32)  # [NM, C] static
@@ -363,12 +423,37 @@ def eval_verdicts(db: fpc.CompiledDB, value_bits, uncertain_bits, lengths, statu
     is_status = jnp.asarray(kind == fpc.MK_STATUS)
     is_size = jnp.asarray(kind == fpc.MK_SIZE)
 
+    # device md5 digest equality (md5(body) == "<hex>" dsl conjuncts).
+    # Fail CLOSED without a digest: the matcher keeps its superset value
+    # but goes uncertain, so a caller that forgets to supply the digest
+    # costs host confirms — never silent false hits.
+    has_md5 = bool(db.m_md5_check.any())
+    if md5_digest is not None:
+        md5_ok = (~jnp.asarray(db.m_md5_check))[None, :] | (
+            md5_digest[:, None, :].astype(jnp.uint32)
+            == jnp.asarray(db.m_md5)[None]
+        ).all(-1)
+    else:
+        md5_ok = jnp.ones((B, NM), dtype=bool)
+        if has_md5:
+            m_unc = m_unc | jnp.asarray(db.m_md5_check)[None, :]
+
     m_value = jnp.zeros((B, NM), dtype=bool)
     m_value = jnp.where(is_words[None, :], slot_red, m_value)
-    m_value = jnp.where(is_scalar[None, :], scalar_ok & slot_red, m_value)
+    m_value = jnp.where(
+        is_scalar[None, :],
+        scalar_ok & slot_red & ~neg_present & md5_ok,
+        m_value,
+    )
     m_value = jnp.where(is_status[None, :], status_ok, m_value)
     m_value = jnp.where(is_size[None, :], size_ok, m_value)
 
+    # Kleene over the scalar∧slots∧¬neg∧md5 conjunction: a certainly
+    # failed exact conjunct decides the matcher false whatever the
+    # uncertain slots resolve to
+    m_unc = m_unc & ~(
+        is_scalar[None, :] & (~scalar_ok | ~md5_ok | neg_decided_false)
+    )
     # md5-style residues: a scalar pass still needs host confirmation
     m_unc = m_unc | (jnp.asarray(db.m_residue)[None, :] & m_value)
     # regex prefilters are *semantically* uncertain when fired: the
@@ -388,14 +473,22 @@ def eval_verdicts(db: fpc.CompiledDB, value_bits, uncertain_bits, lengths, statu
         gv = m_value[:, bucket.idx]
         gu = m_unc[:, bucket.idx]
         rows = jnp.asarray(bucket.rows)
-        red = jnp.where(op_cond[rows][None, :], gv.all(-1), gv.any(-1))
+        is_and = op_cond[rows][None, :]
+        red = jnp.where(is_and, gv.all(-1), gv.any(-1))
+        # Kleene: certain-true matcher decides OR; certain-false decides
+        # AND (matcher certainty = ~gu post-negation)
+        decided = jnp.where(
+            is_and, (~gv & ~gu).any(-1), (gv & ~gu).any(-1)
+        )
         op_value = op_value.at[:, rows].set(red)
-        op_unc = op_unc.at[:, rows].set(gu.any(-1))
-    # superset-lowered (prefilter) ops: the device value can only
-    # over-fire, so fired rows need host confirmation and unfired rows
-    # are exact — precisely `fired & prefilter`. Sibling exact ops of
-    # the same template stay certain.
-    op_unc = op_unc | (jnp.asarray(db.op_prefilter)[None, :] & op_value)
+        op_unc = op_unc.at[:, rows].set(gu.any(-1) & ~decided)
+    # superset-lowered (prefilter) ops: individual matcher bits inside
+    # them are weakened (not per-matcher exact), so the Kleene
+    # refinement above does not apply — the op is uncertain exactly when
+    # it fired, certain-false otherwise, and fired rows are
+    # host-confirmed at op granularity.
+    is_pref = jnp.asarray(db.op_prefilter)[None, :]
+    op_unc = jnp.where(is_pref, op_value, op_unc)
 
     # --- templates: OR over their operations ---
     NT = max(db.num_templates, 1)
@@ -406,13 +499,33 @@ def eval_verdicts(db: fpc.CompiledDB, value_bits, uncertain_bits, lengths, statu
         gu = op_unc[:, bucket.idx]
         rows = jnp.asarray(bucket.rows)
         t_value = t_value.at[:, rows].set(gv.any(-1))
-        t_unc = t_unc.at[:, rows].set(gu.any(-1))
+        # Kleene: any certain-true op decides the template-level OR
+        t_unc = t_unc.at[:, rows].set(
+            gu.any(-1) & ~(gv & ~gu).any(-1)
+        )
+    if full:
+        return t_value, t_unc, op_value, op_unc, m_unc
     return t_value, t_unc
 
 
-def _match_impl(db: fpc.CompiledDB, candidate_k: int, streams, lengths, status):
+def _match_impl(
+    db: fpc.CompiledDB, candidate_k: int, streams, lengths, status, full=False
+):
     value_bits, uncertain_bits, overflow = match_slots(
         db, candidate_k, streams, lengths
     )
-    t_value, t_unc = eval_verdicts(db, value_bits, uncertain_bits, lengths, status)
-    return t_value, t_unc, overflow
+    digest = None
+    if bool(db.m_md5_check.any()) and "body" in streams:
+        from swarm_tpu.ops.md5 import md5_words
+
+        digest = md5_words(streams["body"], lengths["body"])
+    out = eval_verdicts(
+        db,
+        value_bits,
+        uncertain_bits,
+        lengths,
+        status,
+        full=full,
+        md5_digest=digest,
+    )
+    return (*out, overflow)

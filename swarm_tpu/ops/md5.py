@@ -1,0 +1,113 @@
+"""MD5 on device: batched digests over padded byte streams.
+
+The corpus uses ``md5(body) == "<hex>"`` dsl matchers (e.g.
+``technologies/adobe/adobe-coldfusion-detect.yaml``) which previously
+forced a host confirmation on every fired row. MD5's block chain is
+sequential, but across the batch it vectorizes perfectly: one
+``lax.scan`` over 64-byte blocks, 64 unrolled rounds of uint32 ops per
+block, every lane a row. Cost is O(W/64) scan steps regardless of how
+many templates compare digests.
+
+All arithmetic is uint32 with natural wraparound; no x64 mode needed
+(bit lengths fit u32 for any stream the engine encodes).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# RFC 1321 tables
+_K = np.array(
+    [int(abs(math.sin(i + 1)) * (1 << 32)) & 0xFFFFFFFF for i in range(64)],
+    dtype=np.uint32,
+)
+_S = np.array(
+    [7, 12, 17, 22] * 4 + [5, 9, 14, 20] * 4
+    + [4, 11, 16, 23] * 4 + [6, 10, 15, 21] * 4,
+    dtype=np.int32,
+)
+_INIT = np.array(
+    [0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476], dtype=np.uint32
+)
+
+
+def _rotl(x, s: int):
+    return (x << s) | (x >> (32 - s))
+
+
+def md5_words(stream, lengths):
+    """uint8 [B, W] (zero-padded past each row's length) + int32 [B]
+    → digest as uint32 [B, 4], little-endian words (word 0's LE bytes
+    are the first 8 hex chars of the usual digest string)."""
+    stream = jnp.asarray(stream, dtype=jnp.uint8)
+    lengths = jnp.asarray(lengths, dtype=jnp.int32)
+    B, W = stream.shape
+    # room for the 0x80 marker + 8 length bytes past a full-width row
+    ext = jnp.pad(stream, ((0, 0), (0, 64)))
+    Wp = W + 64
+    idx = jnp.arange(Wp, dtype=jnp.int32)
+    L = lengths[:, None]
+    msg = jnp.where(idx[None, :] < L, ext, jnp.uint8(0))
+    msg = jnp.where(idx[None, :] == L, jnp.uint8(0x80), msg)
+    # message bit length, little-endian, in the final 8 bytes of the
+    # last block (bit counts fit u32: upper four bytes stay zero)
+    pad_end = ((lengths + 9 + 63) // 64) * 64  # [B]
+    bitlen = (lengths.astype(jnp.uint32) * 8)[:, None]
+    off = idx[None, :] - (pad_end[:, None] - 8)
+    len_byte = (
+        (bitlen >> (8 * jnp.clip(off, 0, 3))) & 0xFF
+    ).astype(jnp.uint8)
+    msg = jnp.where((off >= 0) & (off < 4), len_byte, msg)
+
+    # 64-byte blocks → 16 little-endian u32 words each
+    nb = Wp // 64
+    blocks = msg.reshape(B, nb, 16, 4).astype(jnp.uint32)
+    words = (
+        blocks[..., 0]
+        | (blocks[..., 1] << 8)
+        | (blocks[..., 2] << 16)
+        | (blocks[..., 3] << 24)
+    )  # [B, nb, 16]
+    n_blocks = pad_end // 64  # [B]
+
+    k_j = jnp.asarray(_K)
+
+    def per_block(state, inp):
+        m, block_i = inp  # m: [B, 16]
+        a, b, c, d = state[:, 0], state[:, 1], state[:, 2], state[:, 3]
+        for i in range(64):
+            if i < 16:
+                f = (b & c) | (~b & d)
+                g = i
+            elif i < 32:
+                f = (d & b) | (~d & c)
+                g = (5 * i + 1) & 15
+            elif i < 48:
+                f = b ^ c ^ d
+                g = (3 * i + 5) & 15
+            else:
+                f = c ^ (b | ~d)
+                g = (7 * i) & 15
+            tmp = d
+            d = c
+            c = b
+            b = b + _rotl(a + f + k_j[i] + m[:, g], int(_S[i]))
+            a = tmp
+        new = state + jnp.stack([a, b, c, d], axis=1)
+        # rows whose padded message ended earlier skip this block
+        live = (block_i < n_blocks)[:, None]
+        return jnp.where(live, new, state), None
+
+    init = jnp.broadcast_to(jnp.asarray(_INIT), (B, 4)).astype(jnp.uint32)
+    state, _ = jax.lax.scan(
+        per_block,
+        init,
+        (jnp.moveaxis(words, 1, 0), jnp.arange(nb, dtype=jnp.int32)),
+    )
+    # digest convention: the 4 state words little-endian — the compiler
+    # prepares m_md5 the same way (np.frombuffer(digest, "<u4"))
+    return state

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from swarm_tpu.fingerprints import compile as fpc
 from swarm_tpu.ops import hashing
 from swarm_tpu.ops.match import eval_verdicts, match_slots
+from swarm_tpu.ops.md5 import md5_words
 
 
 def shard_tables_np(db: fpc.CompiledDB, ranks: int) -> list[dict]:
@@ -127,7 +128,7 @@ class ShardedMatcher:
         self._fn_cache: dict = {}
 
     # ------------------------------------------------------------------
-    def _build(self, shape_key):
+    def _build(self, shape_key, full: bool = False):
         db, halo = self.db, self.halo
         seq_ranks = self.ranks.get("seq", 1)
         candidate_k = self.candidate_k
@@ -182,8 +183,32 @@ class ShardedMatcher:
                 )
                 overflow = jax.lax.psum(overflow.astype(jnp.int32), combine_axes) > 0
 
-            t_value, t_unc = eval_verdicts(db, value_bits, uncertain_bits, lengths, status)
-            return t_value, t_unc, overflow
+            # device md5 (ops/md5.py): the block chain is sequential in
+            # the byte dimension, so a seq-sharded body is re-gathered
+            # (tiled over ICI) just for the digest — cheap next to the
+            # probe stage, and only when the corpus compares digests
+            digest = None
+            if bool(db.m_md5_check.any()) and "body" in streams:
+                body = streams["body"]
+                if seq_ranks > 1:
+                    body = jax.lax.all_gather(
+                        body, "seq", axis=1, tiled=True
+                    )
+                digest = md5_words(body, lengths["body"])
+            out = eval_verdicts(
+                db,
+                value_bits,
+                uncertain_bits,
+                lengths,
+                status,
+                full=full,
+                md5_digest=digest,
+            )
+            if full:
+                # pack bit planes per data-rank (axis 1 is unsharded, so
+                # packed bytes concatenate cleanly over 'data')
+                out = tuple(jnp.packbits(p, axis=1) for p in out)
+            return (*out, overflow)
 
         shard_map = jax.shard_map
         mesh = self.mesh
@@ -191,6 +216,7 @@ class ShardedMatcher:
         table_specs = [
             {name: P("model") for name in t} for t in self._tables_np
         ]
+        n_out = 6 if full else 3
         fn = shard_map(
             step,
             mesh=mesh,
@@ -200,13 +226,13 @@ class ShardedMatcher:
                 {k: P("data") for k in shape_key["lengths"]},
                 P("data"),
             ),
-            out_specs=(P("data"), P("data"), P("data")),
+            out_specs=tuple(P("data") for _ in range(n_out)),
             check_vma=False,
         )
         return jax.jit(fn)
 
     # ------------------------------------------------------------------
-    def match(self, streams: dict, lengths: dict, status):
+    def match(self, streams: dict, lengths: dict, status, full: bool = False):
         seq_ranks = self.ranks.get("seq", 1)
         if seq_ranks > 1:
             for name, arr in streams.items():
@@ -228,11 +254,12 @@ class ShardedMatcher:
             "streams": tuple(sorted((k, v.shape) for k, v in streams.items())),
             "lengths": tuple(sorted(lengths)),
         }
-        cache_key = (shape_key["streams"],)
+        cache_key = (shape_key["streams"], full)
         fn = self._fn_cache.get(cache_key)
         if fn is None:
             fn = self._build(
-                {"streams": {k: None for k in streams}, "lengths": {k: None for k in lengths}}
+                {"streams": {k: None for k in streams}, "lengths": {k: None for k in lengths}},
+                full=full,
             )
             self._fn_cache[cache_key] = fn
         return fn(

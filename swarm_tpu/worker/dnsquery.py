@@ -226,13 +226,15 @@ def _query_batch_one(
     """One shared-socket wave of at most _MAX_BATCH queries.
 
     Transaction ids are a random permutation of the id space (not the
-    query index): an off-path forger must guess the id, not count.
+    query index), drawn from the OS CSPRNG — an off-path forger must
+    guess the id, and observing earlier waves must not let it
+    reconstruct PRNG state to predict later ones.
     """
     n = len(queries)
     out: list[Optional[DnsReply]] = [None] * n
     if n == 0 or not resolvers:
         return out
-    ids = random.sample(range(65536), n)
+    ids = random.SystemRandom().sample(range(65536), n)
     id_to_idx = {qid: i for i, qid in enumerate(ids)}
     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     sock.setblocking(False)

@@ -1,0 +1,256 @@
+"""Device-exactness tests for the sparse-confirmation lowerings.
+
+Covers the paths that keep host confirmation sparse: device md5
+(ops/md5.py), negated-contains dsl conjuncts, interactsh constant
+folding, invalid-regex constant folding, and the Kleene uncertainty
+refinement (ops/match.py eval_verdicts). Each asserts both parity with
+the CPU oracle AND that no host confirmation was needed — i.e. the
+verdict really was decided on device.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+import yaml
+
+from swarm_tpu.fingerprints.model import Response
+from swarm_tpu.fingerprints.nuclei import parse_template
+from swarm_tpu.ops import cpu_ref
+from swarm_tpu.ops.engine import MatchEngine
+
+
+def T(doc: str, path="t/x.yaml"):
+    return parse_template(yaml.safe_load(doc), source_path=path)
+
+
+def engine_for(*docs):
+    return MatchEngine([T(d, path=f"t/{i}.yaml") for i, d in enumerate(docs)],
+                       mesh=None, batch_rows=16)
+
+
+def check_parity(eng, rows):
+    got = eng.match(rows)
+    for b, row in enumerate(rows):
+        want = {
+            t.id for t in eng.db.templates
+            if cpu_ref.match_template(t, row).matched
+        }
+        assert set(got[b].template_ids) == want, (b, got[b].template_ids, want)
+    return got
+
+
+BODY = b"<html><head><title>Home</title></head><body>hello world</body></html>"
+DIGEST = hashlib.md5(BODY).hexdigest()
+
+
+MD5_TEMPLATE = f"""
+id: demo-md5
+info: {{name: n, severity: info}}
+requests:
+  - matchers:
+      - type: dsl
+        dsl:
+          - 'status_code==200 && ("{DIGEST}" == md5(body))'
+"""
+
+
+def test_md5_lowered_to_device():
+    eng = engine_for(MD5_TEMPLATE)
+    assert int(eng.db.m_md5_check.sum()) == 1
+    assert int(eng.db.m_residue.sum()) == 0
+    rows = [
+        Response(host="a", port=80, status=200, body=BODY, header=b"HTTP/1.1 200"),
+        Response(host="b", port=80, status=200, body=BODY + b"!", header=b"HTTP/1.1 200"),
+        Response(host="c", port=80, status=404, body=BODY, header=b"HTTP/1.1 404"),
+    ]
+    got = check_parity(eng, rows)
+    assert got[0].template_ids == ["demo-md5"]
+    assert got[1].template_ids == []
+    # the digest compare ran on device — zero host confirmations
+    assert eng.stats.host_confirm_pairs == 0
+
+
+NEG_HDR_TEMPLATE = """
+id: demo-missing-header
+info: {name: n, severity: info}
+requests:
+  - matchers:
+      - type: dsl
+        dsl:
+          - "!regex('(?i)x-frame-options', all_headers)"
+          - "status_code != 301 && status_code != 302"
+        condition: and
+"""
+
+
+def test_negated_contains_lowered_to_device():
+    eng = engine_for(NEG_HDR_TEMPLATE)
+    assert sum(len(b.rows) for b in eng.db.m_negslot_buckets) == 1
+    assert not eng.db.op_prefilter.any()
+    rows = [
+        Response(host="a", port=80, status=200, body=b"x",
+                 header=b"HTTP/1.1 200 OK\r\nServer: nginx"),
+        Response(host="b", port=80, status=200, body=b"x",
+                 header=b"HTTP/1.1 200 OK\r\nX-Frame-Options: DENY"),
+        Response(host="c", port=80, status=301, body=b"",
+                 header=b"HTTP/1.1 301\r\nLocation: /"),
+    ]
+    got = check_parity(eng, rows)
+    assert got[0].template_ids == ["demo-missing-header"]
+    assert got[1].template_ids == []
+    assert got[2].template_ids == []
+    assert eng.stats.host_confirm_pairs == 0
+
+
+OOB_TEMPLATE = """
+id: demo-oob
+info: {name: n, severity: info}
+requests:
+  - matchers:
+      - type: dsl
+        dsl:
+          - 'contains(interactsh_protocol, "dns")'
+          - 'contains(body, "anything")'
+        condition: and
+"""
+
+
+def test_interactsh_contains_constant_false():
+    eng = engine_for(OOB_TEMPLATE)
+    assert not eng.db.op_prefilter.any()
+    rows = [Response(host="a", port=80, status=200, body=b"anything here",
+                     header=b"HTTP/1.1 200")]
+    got = check_parity(eng, rows)
+    assert got[0].template_ids == []
+    assert eng.stats.host_confirm_pairs == 0
+
+
+BAD_REGEX_TEMPLATE = """
+id: demo-bad-regex
+info: {name: n, severity: info}
+requests:
+  - matchers-condition: or
+    matchers:
+      - type: regex
+        part: header
+        regex:
+          - '(?)content="CloudWAF"'
+      - type: word
+        part: header
+        words:
+          - "real-marker"
+"""
+
+
+def test_invalid_regex_constant_false_keeps_sibling_exact():
+    """A pattern Python re rejects = oracle 'unsupported' → constant
+    False; the sibling word matcher must stay device-exact (the op must
+    NOT demote to a host-confirmed prefilter)."""
+    eng = engine_for(BAD_REGEX_TEMPLATE)
+    assert not eng.db.op_prefilter.any()
+    rows = [
+        Response(host="a", port=80, status=200, body=b"x",
+                 header=b'HTTP/1.1 200\r\nX: content="CloudWAF"'),
+        Response(host="b", port=80, status=200, body=b"x",
+                 header=b"HTTP/1.1 200\r\nY: real-marker"),
+    ]
+    got = check_parity(eng, rows)
+    assert got[0].template_ids == []  # bad pattern is False, not a hit
+    assert got[1].template_ids == ["demo-bad-regex"]
+    assert eng.stats.host_confirm_pairs == 0
+
+
+KLEENE_TEMPLATE = """
+id: demo-kleene
+info: {name: n, severity: info}
+requests:
+  - matchers-condition: and
+    matchers:
+      - type: status
+        status:
+          - 200
+      - type: regex
+        part: body
+        regex:
+          - 'verysecret[0-9]+marker'
+"""
+
+
+def test_kleene_status_miss_skips_regex_confirm():
+    """AND op: the exact status matcher failing certain-falsifies the
+    op, so the fired regex prefilter sibling needs no host confirm."""
+    eng = engine_for(KLEENE_TEMPLATE)
+    rows = [
+        Response(host="a", port=80, status=404,
+                 body=b"xx verysecret123marker yy", header=b"HTTP/1.1 404"),
+    ]
+    got = check_parity(eng, rows)
+    assert got[0].template_ids == []
+    assert eng.stats.host_confirm_pairs == 0
+
+
+def test_regex_prefilter_confirms_only_fired(monkeypatch):
+    """OR template with one regex: fired literal → exactly one host
+    confirmation; absent literal → zero."""
+    eng = engine_for(KLEENE_TEMPLATE)
+    rows = [
+        Response(host="a", port=80, status=200,
+                 body=b"xx verysecret99marker yy", header=b"HTTP/1.1 200"),
+        Response(host="b", port=80, status=200,
+                 body=b"nothing to see", header=b"HTTP/1.1 200"),
+    ]
+    got = check_parity(eng, rows)
+    assert got[0].template_ids == ["demo-kleene"]
+    assert got[1].template_ids == []
+    assert eng.stats.host_confirm_pairs == 1
+
+
+REFERENCE_CORPUS = "/root/reference/worker/artifacts/templates"
+
+
+@pytest.mark.skipif(
+    not __import__("pathlib").Path(REFERENCE_CORPUS).is_dir(),
+    reason="reference corpus not present",
+)
+def test_corpus_device_split_does_not_regress():
+    """The compiler's corpus report, asserted: the full reference
+    corpus must lower with NO host-always tail and a bounded set of
+    prefilter ops — the split behind the headline exactness/perf
+    story can't silently regress."""
+    from swarm_tpu.fingerprints import load_corpus
+    from swarm_tpu.fingerprints.compile import compile_corpus
+
+    templates, errors = load_corpus(REFERENCE_CORPUS)
+    assert len(errors) == 0
+    db = compile_corpus(templates)
+    assert len(templates) >= 3900
+    assert db.stats["templates_host_always"] == 0
+    assert db.num_templates >= 3700
+    # op-level prefilters (whole-op host confirm on fire) are the
+    # expensive fallback — keep them rare
+    assert int(db.op_prefilter.sum()) <= 40
+    # the md5/neg-contains lowerings must stay engaged
+    assert int(db.m_md5_check.sum()) >= 10
+    assert int(db.m_residue.sum()) == 0
+    assert sum(len(b.rows) for b in db.m_negslot_buckets) >= 10
+
+
+def test_md5_device_kernel_matches_hashlib():
+    from swarm_tpu.ops.md5 import md5_words
+
+    rng = np.random.default_rng(0)
+    W = 256
+    lens = [0, 1, 54, 55, 56, 57, 63, 64, 65, 119, 120, 128, 255, 256]
+    stream = np.zeros((len(lens), W), dtype=np.uint8)
+    datas = []
+    for i, L in enumerate(lens):
+        d = rng.integers(0, 256, size=L, dtype=np.uint8).tobytes()
+        datas.append(d)
+        stream[i, :L] = np.frombuffer(d, dtype=np.uint8)
+    out = np.asarray(md5_words(stream, np.array(lens, dtype=np.int32)))
+    for i, d in enumerate(datas):
+        want = np.frombuffer(hashlib.md5(d).digest(), dtype="<u4")
+        assert np.array_equal(out[i], want), f"len={lens[i]}"
