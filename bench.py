@@ -1,34 +1,33 @@
-"""Headline benchmark: host:port service fingerprints/sec/chip.
+"""Benchmarks: the framework's headline numbers on one chip.
 
-Measures the sustained on-device throughput of the full match step —
-rolling q-gram hashing, Bloom candidate probe, word-table verification,
-tiny-slot dense compare, matcher/operation/template verdict lowering —
-over the complete reference template corpus (3,989 nuclei templates →
-~3.5k device-lowered templates; the remainder is the measured host
-tail, see swarm_tpu/ops/engine.py).
+Emits one JSON line per metric (the last line is the headline the
+driver tails):
 
-Methodology (mirrors BASELINE.json config #2/#3: banner/header/title
-fingerprinting, batched vmap on one chip):
-  * inputs are device-resident, as produced by the double-buffered
-    host→device feed in production (swarm_tpu/worker/runtime.py);
-  * outputs are packed on-device to bitsets before any fetch — the
-    wire format results actually ship in;
-  * steady-state timing over many dispatches, async pipeline,
-    block_until_ready at the end.
+1. ``exact_fingerprints_per_sec_per_chip`` — END-TO-END
+   ``MatchEngine.match_packed``: encode → device kernel (q-gram probe,
+   byte verify, device regex verify, device md5, verdict lowering) →
+   sparse host confirmation + extraction, over the full 3,989-template
+   reference corpus on a realistic response mix. This includes the
+   exactness contract's full cost (BASELINE.md's 100%-parity metric).
+2. ``service_probe_classifications_per_sec`` — BASELINE config #4
+   analog: banner stream → nmap-service-probes classifier
+   (ops/service.py) end to end.
+3. ``jarm_cluster_rows_per_sec`` — BASELINE config #5 analog: packed
+   JARM fingerprints → density clustering (ops/cluster.py,
+   Pallas/XLA MXU hamming kernels).
+4. ``service_fingerprints_per_sec_per_chip`` — the device-only match
+   step (the kernel ceiling; headline continuity with round 1).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "fingerprints/sec/chip",
-   "vs_baseline": N}
-
-vs_baseline is measured / target-per-chip, where the north-star target
-is 10M fingerprints/sec on a v4-8 (4 chips) => 2.5M/sec/chip
-(BASELINE.json).
+vs_baseline divides by the north-star target 10M fingerprints/sec on a
+v4-8 (4 chips) => 2.5M/sec/chip (BASELINE.json); auxiliary metrics
+report vs_baseline 0.0 (no published reference number exists —
+BASELINE.md documents the absence).
 """
 
 from __future__ import annotations
 
-import functools
 import json
+import signal
 import sys
 import time
 from pathlib import Path
@@ -43,137 +42,282 @@ TARGET_PER_CHIP = 10_000_000 / 4  # north star: 10M/s on a v4-8 (4 chips)
 ROWS = 2048
 MAX_BODY = 2048
 MAX_HEADER = 512
-WARMUP = 3
-ITERS = 50
+WARMUP = 2
+ITERS = 20
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def synthetic_batch(rows: int):
-    """Realistic-shaped probe responses: varied servers, titles, sizes."""
-    from swarm_tpu.fingerprints.model import Response
-    from swarm_tpu.ops.encoding import encode_batch
+def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value),
+                "unit": unit,
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        ),
+        flush=True,
+    )
 
-    servers = [b"nginx/1.%d" % i for i in range(9)] + [
-        b"Apache/2.4.%d (Ubuntu)" % i for i in range(9)
-    ] + [b"Microsoft-IIS/10.0", b"cloudflare", b"gws", b"LiteSpeed"]
-    titles = [
-        b"Welcome to nginx!", b"Apache2 Ubuntu Default Page", b"Grafana",
-        b"Sign in \xc2\xb7 GitLab", b"Dashboard [Jenkins]", b"phpMyAdmin",
-        b"Login - Adminer", b"404 Not Found", b"Index of /", b"Home",
-        b"Kibana", b"RouterOS router configuration page",
+
+def realistic_rows(n: int, seed: int = 7):
+    """Internet-scan-shaped response mix: mostly default pages, 404s,
+    redirects and bare replies; ~10% fingerprint-rich rows. Content
+    repeats across hosts the way real scans do (default pages are
+    byte-identical fleet-wide)."""
+    from swarm_tpu.fingerprints.model import Response
+
+    rng = np.random.default_rng(seed)
+    servers = [
+        b"nginx", b"nginx/1.18.0 (Ubuntu)", b"Apache/2.4.41 (Ubuntu)",
+        b"Apache", b"cloudflare", b"Microsoft-IIS/10.0", b"openresty",
+        b"LiteSpeed", b"AmazonS3", b"gws",
     ]
-    bodies = [
-        b"<div class=login><form action=/auth method=post>"
-        b"<input name=user><input type=password name=pass></form></div>",
-        b"<p>It works!</p>",
-        b"<script src=/static/js/app.%d.js></script><div id=root></div>",
-        b"<meta name=generator content=\"WordPress 6.%d\">",
-        b"<pre>Directory listing for /</pre>",
-        b"window.grafanaBootData = {settings: {buildInfo: {version: \"9.%d\"}}}",
+    rich = [
+        b"<html><head><title>Grafana</title></head><body><script>window.grafanaBootData={settings:{buildInfo:{version:\"9.1.0\"}}}</script></body></html>",
+        b"<html><head><title>Dashboard [Jenkins]</title></head><body>Jenkins</body></html>",
+        b"<html><head><title>phpMyAdmin</title></head><body>phpMyAdmin</body></html>",
+        b"<html><head><title>Sign in - GitLab</title></head><body class=gitlab>GitLab</body></html>",
+        b"<meta name=\"generator\" content=\"WordPress 6.2\"><html><body>wp-content/themes</body></html>",
+        b"<html><head><title>RouterOS router configuration page</title></head><body>mikrotik</body></html>",
     ]
-    out = []
-    rng = np.random.default_rng(1234)
-    for i in range(rows):
-        title = titles[i % len(titles)]
-        body_core = bodies[i % len(bodies)]
-        if b"%d" in body_core:
-            body_core = body_core % (i % 10)
-        filler = bytes(rng.integers(97, 122, size=int(rng.integers(0, 900)), dtype=np.uint8))
-        body = (
-            b"<html><head><title>" + title + b"</title></head><body>"
-            + body_core + filler + b"</body></html>"
+    rows = []
+    for i in range(n):
+        r = rng.random()
+        srv = servers[int(rng.integers(0, len(servers)))]
+        if r < 0.35:
+            body = b"<html><head><title>Welcome to nginx!</title></head><body><h1>Welcome to nginx!</h1></body></html>"
+            status = 200
+        elif r < 0.55:
+            body = b"<html><head><title>404 Not Found</title></head><body><center><h1>404 Not Found</h1></center><hr><center>nginx</center></body></html>"
+            status = 404
+        elif r < 0.70:
+            body = b""
+            status = 301
+        elif r < 0.80:
+            body = b"<html><head><title>403 Forbidden</title></head><body><center><h1>403 Forbidden</h1></center></body></html>"
+            status = 403
+        elif r < 0.90:
+            filler = bytes(
+                rng.integers(97, 123, size=int(rng.integers(200, 1500)), dtype=np.uint8)
+            )
+            body = (
+                b"<html><head><title>Home - Example Corp</title></head><body>"
+                + filler + b"</body></html>"
+            )
+            status = 200
+        else:
+            body = rich[int(rng.integers(0, len(rich)))]
+            status = 200
+        hdr = (
+            b"HTTP/1.1 %d X\r\nServer: %s\r\nContent-Type: text/html\r\n"
+            b"Date: Tue, 29 Jul 2026 12:00:00 GMT" % (status, srv)
         )
-        header = (
-            b"HTTP/1.1 200 OK\r\nServer: " + servers[i % len(servers)]
-            + b"\r\nContent-Type: text/html; charset=utf-8\r\n"
-            + b"X-Powered-By: PHP/8.%d\r\nSet-Cookie: session=%d" % (i % 3, i)
-        )
-        out.append(
+        rows.append(
             Response(
                 host=f"192.0.2.{i % 254}",
-                port=(443, 80, 8080, 8443)[i % 4],
-                status=(200, 200, 200, 301, 404, 403)[i % 6],
-                body=body[:MAX_BODY],
-                header=header[:MAX_HEADER],
+                port=(80, 443, 8080)[i % 3],
+                status=status,
+                body=body,
+                header=hdr,
             )
         )
-    return encode_batch(out, max_body=MAX_BODY, max_header=MAX_HEADER)
+    return rows
 
 
-def main() -> int:
+def resolve_device():
+    import jax
+
+    # the accelerator tunnel can wedge instead of erroring — bound the
+    # wait, then fall back to ANY available backend (auto-detect).
+    def bail(_sig, _frm):
+        raise RuntimeError("backend init timed out")
+
+    signal.signal(signal.SIGALRM, bail)
+    signal.alarm(120)
+    try:
+        dev = jax.devices()[0]
+    except RuntimeError as e:
+        log(f"!!! configured backend unavailable ({e}); auto-detecting")
+        jax.config.update("jax_platforms", "")
+        signal.alarm(120)
+        try:
+            dev = jax.devices()[0]
+        except RuntimeError:
+            jax.config.update("jax_platforms", "cpu")
+            dev = jax.devices()[0]
+    finally:
+        signal.alarm(0)
+    log(f"bench device: {dev.platform} / {getattr(dev, 'device_kind', '?')}")
+    if dev.platform == "cpu":
+        log(
+            "!!! RUNNING ON CPU — per-chip numbers below are NOT "
+            "accelerator throughput"
+        )
+    return dev
+
+
+def bench_exact_engine(templates) -> float:
+    from swarm_tpu.ops.engine import MatchEngine
+
+    eng = MatchEngine(
+        templates,
+        mesh=None,
+        batch_rows=ROWS,
+        max_body=MAX_BODY,
+        max_header=MAX_HEADER,
+    )
+    batches = [realistic_rows(ROWS, seed=s) for s in range(4)]
+    t0 = time.time()
+    eng.match_packed(batches[0])
+    log(f"engine compile+first batch: {time.time() - t0:.1f}s")
+    for b in batches:
+        eng.match_packed(b)  # warm every shape/content path
+    t0 = time.perf_counter()
+    n = 0
+    for i in range(ITERS):
+        out = eng.match_packed(batches[i % len(batches)])
+        n += ROWS
+    dt = time.perf_counter() - t0
+    s = eng.stats
+    log(
+        f"exact engine: {n} rows in {dt:.2f}s "
+        f"(host confirms {s.host_confirm_pairs}, "
+        f"host {s.host_confirm_seconds:.2f}s, device {s.device_seconds:.2f}s)"
+    )
+    return n / dt, eng.db
+
+
+def bench_service_classifier() -> float:
+    from swarm_tpu.fingerprints.model import Response
+    from swarm_tpu.ops.service import ServiceClassifier
+
+    cl = ServiceClassifier()
+    banners = [
+        b"HTTP/1.1 200 OK\r\nServer: nginx/1.18.0\r\n\r\n<html>",
+        b"SSH-2.0-OpenSSH_8.9p1 Ubuntu-3ubuntu0.1\r\n",
+        b"220 mail.example.com ESMTP Postfix (Ubuntu)\r\n",
+        b"HTTP/1.1 404 Not Found\r\nServer: Apache/2.4.41\r\n\r\n",
+        b"+OK Dovecot ready.\r\n",
+        b"220 (vsFTPd 3.0.3)\r\n",
+        b"MySQL\x00\x00\x00\x0a8.0.31",
+        b"", b"\x00\x00\x00\x00", b"HTTP/1.0 400 Bad Request\r\n\r\n",
+    ]
+    rows = [
+        Response(
+            host=f"198.51.100.{i % 254}",
+            port=(80, 22, 25, 443, 110, 21, 3306, 8080)[i % 8],
+            banner=banners[i % len(banners)],
+        )
+        for i in range(ROWS)
+    ]
+    cl.classify(rows)  # warm
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(max(ITERS // 4, 3)):
+        cl.classify(rows)
+        n += ROWS
+    dt = time.perf_counter() - t0
+    log(f"service classifier: {n} banners in {dt:.2f}s")
+    return n / dt
+
+
+def bench_jarm_cluster() -> float:
+    from swarm_tpu.ops import cluster
+
+    rng = np.random.default_rng(5)
+    n = 4096
+    # synthetic JARM-style fingerprints: 64 base TLS stacks + per-host
+    # jitter, the shape real fleet clustering sees
+    alphabet = np.frombuffer(b"0123456789abcdef", dtype=np.uint8)
+    base = alphabet[rng.integers(0, 16, size=(64, 62))]
+    picks = base[rng.integers(0, 64, size=n)].copy()
+    jitter = rng.integers(0, 62, size=n)
+    picks[np.arange(n), jitter] = alphabet[rng.integers(0, 16, size=n)]
+    packed = cluster.pack_strings([bytes(r) for r in picks])
+    cluster.density_cluster(packed, radius=40.0)  # warm
+    t0 = time.perf_counter()
+    reps = max(ITERS // 4, 3)
+    for _ in range(reps):
+        cluster.density_cluster(packed, radius=40.0)
+    dt = time.perf_counter() - t0
+    log(f"jarm cluster: {reps}x{n} fingerprints in {dt:.2f}s")
+    return reps * n / dt
+
+
+def bench_device_only(db, dev) -> float:
     import jax
     import jax.numpy as jnp
 
-    from swarm_tpu.fingerprints import load_corpus
-    from swarm_tpu.fingerprints.compile import compile_corpus
+    from swarm_tpu.ops.encoding import encode_batch
     from swarm_tpu.ops.match import _match_impl
 
-    try:
-        dev = jax.devices()[0]
-    except RuntimeError:
-        # a preset JAX_PLATFORMS pointing at an unloadable plugin —
-        # fall back to whatever backend is actually available
-        jax.config.update("jax_platforms", "")
-        dev = jax.devices()[0]
-    log(f"bench device: {dev.platform} / {getattr(dev, 'device_kind', '?')}")
-
-    corpus = REFERENCE_CORPUS if REFERENCE_CORPUS.is_dir() else BUNDLED_CORPUS
-    t0 = time.time()
-    templates, errors = load_corpus(corpus)
-    db = compile_corpus(templates)
     log(
-        f"corpus: {len(templates)} templates ({len(errors)} parse errors) -> "
+        f"corpus: {db.stats['templates_in']} templates -> "
         f"{db.num_templates} device templates, {db.num_slots} word slots, "
-        f"{len(db.host_always)} host-tail in {time.time() - t0:.1f}s"
+        f"{db.stats['rx_matchers']} device-regex matchers, "
+        f"{len(db.host_always)} host-tail"
     )
-
-    batch = synthetic_batch(ROWS)
+    rows = realistic_rows(ROWS, seed=11)
+    batch = encode_batch(rows, max_body=MAX_BODY, max_header=MAX_HEADER)
     streams = {k: jax.device_put(v, dev) for k, v in batch.streams.items()}
     lengths = {k: jax.device_put(v, dev) for k, v in batch.lengths.items()}
     status = jax.device_put(batch.status, dev)
 
     def step(streams, lengths, status):
         t_value, t_unc, overflow = _match_impl(db, 128, streams, lengths, status)
-        # pack to the shipped wire format on device: bitset rows
-        packed_v = jnp.packbits(t_value, axis=1)
-        packed_u = jnp.packbits(t_unc, axis=1)
-        return packed_v, packed_u, overflow
+        return jnp.packbits(t_value, axis=1), jnp.packbits(t_unc, axis=1), overflow
 
     fn = jax.jit(step)
     t0 = time.time()
     out = fn(streams, lengths, status)
     jax.block_until_ready(out)
-    log(f"compile+first call: {time.time() - t0:.1f}s")
-
+    log(f"device compile+first call: {time.time() - t0:.1f}s")
     for _ in range(WARMUP):
         out = fn(streams, lengths, status)
     jax.block_until_ready(out)
-
-    t0 = time.time()
+    t0 = time.perf_counter()
     for _ in range(ITERS):
         out = fn(streams, lengths, status)
     jax.block_until_ready(out)
-    per_batch = (time.time() - t0) / ITERS
-    rows_per_sec = ROWS / per_batch
+    per_batch = (time.perf_counter() - t0) / ITERS
+    log(f"device steady state: {per_batch * 1e3:.2f} ms / {ROWS} rows")
+    return ROWS / per_batch
 
-    hits = int(np.unpackbits(np.asarray(out[0]), axis=1).sum())
-    log(
-        f"steady state: {per_batch * 1e3:.2f} ms / {ROWS} rows "
-        f"({hits} template hits/batch)"
+
+def main() -> int:
+    resolve_device()
+    import jax
+
+    dev = jax.devices()[0]
+
+    from swarm_tpu.fingerprints import load_corpus
+
+    corpus = REFERENCE_CORPUS if REFERENCE_CORPUS.is_dir() else BUNDLED_CORPUS
+    templates, errors = load_corpus(corpus)
+    log(f"corpus loaded: {len(templates)} templates ({len(errors)} errors)")
+
+    exact, db = bench_exact_engine(templates)
+    emit(
+        "exact_fingerprints_per_sec_per_chip",
+        exact,
+        "fingerprints/sec/chip",
+        exact / TARGET_PER_CHIP,
     )
-
-    print(
-        json.dumps(
-            {
-                "metric": "service_fingerprints_per_sec_per_chip",
-                "value": round(rows_per_sec),
-                "unit": "fingerprints/sec/chip",
-                "vs_baseline": round(rows_per_sec / TARGET_PER_CHIP, 3),
-            }
-        )
+    svc = bench_service_classifier()
+    emit("service_probe_classifications_per_sec", svc, "banners/sec", 0.0)
+    jarm = bench_jarm_cluster()
+    emit("jarm_cluster_rows_per_sec", jarm, "fingerprints/sec", 0.0)
+    devrate = bench_device_only(db, dev)
+    emit(
+        "service_fingerprints_per_sec_per_chip",
+        devrate,
+        "fingerprints/sec/chip",
+        devrate / TARGET_PER_CHIP,
     )
     return 0
 

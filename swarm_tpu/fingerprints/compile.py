@@ -45,7 +45,7 @@ from typing import Optional
 
 import numpy as np
 
-from swarm_tpu.fingerprints import dslc
+from swarm_tpu.fingerprints import dslc, regexlin
 from swarm_tpu.fingerprints.model import Matcher, Template
 from swarm_tpu.ops import hashing
 from swarm_tpu.ops.encoding import (
@@ -640,24 +640,34 @@ def _part_stream_of_var(node) -> Optional[tuple[str, Optional[str]]]:
 _HASH_FNS = ("md5", "sha1", "sha256", "mmh3")
 
 
-def lower_dsl(ast, superset: bool = False) -> Optional[ScalarProgram]:
-    """Lower one dsl expression to a scalar program, or None if it
-    doesn't fit the supported shape (top-level conjunction of scalar
-    compares / contains / hash-equality residues).
+def lower_dsl(ast) -> Optional[ScalarProgram]:
+    """Lower one dsl expression to a scalar program.
 
-    ``superset=True`` never fails: unsupported top-level conjuncts are
-    *dropped* (yielding a necessary condition — sound as a prefilter
-    whose hits get host-confirmed) and flagged via ``residue``. Only
-    valid for non-negated matchers: dropping conjuncts widens the
-    pre-negation value, which negation would flip into a miss.
+    Top-level conjuncts that fit the supported shape (scalar compares,
+    contains/part-equality, hash equality, negated contains) lower
+    exactly. Any other conjunct is *dropped*, keeping its required
+    literal (if one exists) as a contains prefilter and flagging
+    ``residue`` — the program is then a sound necessary condition whose
+    fired rows are host-confirmed per matcher (sound under negation
+    too: uncertainty is captured pre-negation, and a non-fired superset
+    is exactly False pre-negation). None is only returned for
+    whole-expression shapes with no conjunctive form (handled by the
+    or-shape branches below returning None).
     """
     prog = ScalarProgram(conjuncts=[], contains=[])
 
     def handle(node) -> bool:
         ok = handle_exact(node)
-        if not ok and superset:
-            # a dropped regex()/=~ conjunct still contributes its
-            # required literal as a contains prefilter (necessary)
+        if not ok:
+            # Drop the conjunct, keep its required literal (if any) as
+            # a contains prefilter, and flag the residue: the lowered
+            # program is a sound necessary condition whose fired rows
+            # are host-confirmed PER MATCHER (m_residue & fired ⇒
+            # m_unc) — this keeps one exotic conjunct from demoting a
+            # whole op to the host-confirmed prefilter path. Sound for
+            # negated matchers too: uncertainty is captured
+            # pre-negation, and a non-fired superset is exactly False
+            # pre-negation.
             c = _regex_conjunct_prefilter(node)
             if c is not None:
                 prog.contains.append(c)
@@ -676,6 +686,43 @@ def lower_dsl(ast, superset: bool = False) -> Optional[ScalarProgram]:
                 if var is not None and b[0] == "lit" and isinstance(b[1], (int, float)):
                     real_op = _SWAP.get(op, op) if swapped else op
                     prog.conjuncts.append((var, real_op, float(b[1])))
+                    return True
+            # whole-part string equality:  body == "literal"  — exactly
+            # len(part)==len(lit) AND contains(part, lit) (a substring
+            # of equal length IS the part). The evaluator compares
+            # utf-8 bytes (_cmp_coerce/_to_bytes) and tolower is ASCII
+            # bytes.lower(), both matching the device streams.
+            if op == SOP_EQ:
+                for a, b in ((lhs, rhs), (rhs, lhs)):
+                    loc = _part_stream_of_var(a)
+                    if not (
+                        loc and b[0] == "lit" and isinstance(b[1], str)
+                    ):
+                        continue
+                    stream, wrap = loc
+                    data = b[1].encode("utf-8", "surrogateescape")
+                    if wrap == "lower" and data != data.lower():
+                        prog.never = True  # uppercase can't survive
+                        return True
+                    if wrap == "upper" and data != data.upper():
+                        prog.never = True
+                        return True
+                    lenvar = {
+                        "body": SV_LEN_BODY,
+                        "header": SV_LEN_HEADER,
+                        "all": SV_LEN_ALL,
+                    }[stream]
+                    prog.conjuncts.append(
+                        (lenvar, SOP_EQ, float(len(data)))
+                    )
+                    if data:
+                        prog.contains.append(
+                            (
+                                data.lower() if wrap else data,
+                                stream,
+                                wrap is not None,
+                            )
+                        )
                     return True
             # hash equality:  md5(body) == "…"  (either side). The
             # md5-of-plain-body shape lowers to the on-device digest
@@ -749,12 +796,10 @@ def lower_dsl(ast, superset: bool = False) -> Optional[ScalarProgram]:
             conjuncts=[], contains=negs, any_of=True, negated=True
         )
 
-    if not handle(ast):
-        return None
+    handle(ast)  # always succeeds: unsupported conjuncts drop to residue
     if len(prog.conjuncts) > MAX_SCALAR_CONJUNCTS:
-        if not superset:
-            return None
-        # dropping conjuncts keeps the necessary-condition property
+        # dropping conjuncts keeps the necessary-condition property;
+        # the residue flag host-confirms fired rows per matcher
         prog.conjuncts = prog.conjuncts[:MAX_SCALAR_CONJUNCTS]
         prog.residue = True
     return prog
@@ -976,6 +1021,28 @@ class CompiledDB:
     # device md5 digest equality (ops/md5.py): md5(body) == digest
     m_md5: np.ndarray  # uint32 [NM, 4] little-endian digest words
     m_md5_check: np.ndarray  # bool [NM]
+
+    # --- device regex verify (ops/regexdev.py) ---
+    # matchers whose every pattern compiled to linear shift-and
+    # programs: fired rows re-check exactly on device, no host confirm
+    rx_m_ids: np.ndarray  # int32 [NRXM] device matcher ids
+    rx_seq_slot_buckets: list  # list[IndexBucket] seq → gate slot ids
+    rx_seq_always: np.ndarray  # bool [NSEQ] — no gate: scan every row
+    rx_seq_matcher: np.ndarray  # int32 [NSEQ] → index into rx_m_ids
+    rx_seq_stream: np.ndarray  # int32 [NSEQ] index into STREAMS
+    rx_seq_ci: np.ndarray  # bool [NSEQ] — run on the lowered stream
+    rx_classes: np.ndarray  # uint32 [NSEQ, RX_MAX_M, 8] byte-class bitmaps
+    rx_bytemap: np.ndarray  # uint32 [NSEQ, 256, L] byte → state-lane bits
+    rx_m_count: np.ndarray  # int32 [NSEQ] positions used
+    rx_seed: np.ndarray  # uint32 [NSEQ, L] start-closure mask
+    rx_skip: np.ndarray  # uint32 [NSEQ, L] skippable positions
+    rx_accept: np.ndarray  # uint32 [NSEQ, L] accepting positions
+    rx_self: np.ndarray  # uint32 [NSEQ, L] self-loop positions
+    rx_anchored: np.ndarray  # bool [NSEQ] — \A/^: seed only at byte 0
+    rx_end_mode: np.ndarray  # int32 [NSEQ] — regexlin.END_* ($ / \Z)
+    rx_start_wb: np.ndarray  # bool [NSEQ] — leading \b seed guard
+    rx_end_wb: np.ndarray  # bool [NSEQ] — trailing \b accept guard
+    rx_max_skip_run: int
     m_status: np.ndarray  # int32 [NM, MAX_STATUS] (pad = -1)
     m_size: np.ndarray  # int32 [NM, MAX_STATUS] (pad = -1)
     m_size_stream: np.ndarray  # int32 [NM] stream index for size matchers
@@ -1006,6 +1073,13 @@ class CompiledDB:
     @property
     def num_templates(self) -> int:
         return len(self.template_ids)
+
+    def rx_k_pairs(self, batch_rows: int) -> int:
+        """Regex-verify compaction budget for one batch: up to 8 gated
+        fires per row plus every always-on sequence's guaranteed row.
+        Shared by the single-chip and sharded paths so overflow (and
+        therefore host-confirm volume) behaves identically."""
+        return (8 + int(self.rx_seq_always.sum())) * batch_rows
 
 
 # ---------------------------------------------------------------------------
@@ -1057,6 +1131,9 @@ def compile_corpus(
     kept_templates: list[Template] = []
     t_prefilter_flags: list[bool] = []
     host_always: list[Template] = []
+    # regex sequences with no gating literal scan every row — ration
+    # them corpus-wide so the verify stage's worklist stays bounded
+    rx_always_budget = [4]
 
     def lower_matcher(m: Matcher) -> Optional[dict]:
         """→ matcher record dict, or None if not device-loweable."""
@@ -1072,6 +1149,7 @@ def compile_corpus(
             "size_stream": 0,
             "md5": None,
             "neg_slots": [],
+            "rx": None,
         }
 
         def const(value: bool) -> dict:
@@ -1177,26 +1255,58 @@ def compile_corpus(
             for pattern in m.regex:
                 # relax the length floor before failing: a 2–3 byte
                 # anchor is a weak but still exact-on-miss prefilter
-                # (waf-detect's '(?i)ray.id' family) — and one
-                # unloweable pattern would otherwise demote every
-                # sibling matcher's op to host-confirmed prefilter
+                # (waf-detect's '(?i)ray.id' family)
                 lits = None
                 for ml in (4, 3, 2):
                     lits = required_literal_set(pattern, min_len=ml)
                     if lits is not None:
                         break
-                if lits is None:
-                    return None
-                lit_sets.append(lits)
-            if not lit_sets:
+                lit_sets.append(lits)  # None = no gating literal
+            # device regex verify (ops/regexdev.py): when every pattern
+            # compiles to linear shift-and programs (and the matcher is
+            # OR-reduced, the corpus norm), fired rows are re-checked
+            # ON DEVICE — the matcher becomes exact, no host confirm.
+            # A pattern with no gating literal runs on EVERY row, so
+            # those are rationed (rx_always_budget).
+            rx_progs = None
+            if m.condition != "and" or len(m.regex) == 1:
+                progs = [regexlin.compile_linear(p) for p in m.regex]
+                if all(p is not None for p in progs):
+                    # budget counts expanded SEQUENCES (each always-on
+                    # sequence scans every row of every batch)
+                    n_always = sum(
+                        len(pr[0])
+                        for lits, pr in zip(lit_sets, progs)
+                        if not lits
+                    )
+                    if n_always == 0 or rx_always_budget[0] >= n_always:
+                        rx_progs = progs
+                        rx_always_budget[0] -= n_always
+            if rx_progs is None and any(s is None for s in lit_sets):
+                # a literal-less pattern with no device program: one
+                # bad pattern demotes the whole op (prefilter)
                 return None
             rec["kind"] = MK_REGEX_PREFILTER
-            rec["cond_and"] = m.condition == "and" and all(
-                len(s) == 1 for s in lit_sets
+            rec["cond_and"] = (
+                m.condition == "and"
+                and all(s is not None and len(s) == 1 for s in lit_sets)
             )
             rec["slots"] = [
-                slots.get(lit, stream, True) for s in lit_sets for lit in s
+                slots.get(lit, stream, True)
+                for s in lit_sets
+                if s
+                for lit in s
             ]
+            if rx_progs is not None:
+                rec["rx"] = []
+                for lits, (alts, ci) in zip(lit_sets, rx_progs):
+                    gate = (
+                        [slots.get(lit, stream, True) for lit in lits]
+                        if lits
+                        else []
+                    )
+                    for lp in alts:
+                        rec["rx"].append((lp, ci, stream, gate))
             return rec
         if m.type == "dsl":
             progs = []
@@ -1266,6 +1376,7 @@ def compile_corpus(
             "size_stream": 0,
             "md5": None,
             "neg_slots": [],
+            "rx": None,
         }
 
     def lower_matcher_superset(m: Matcher) -> dict:
@@ -1287,7 +1398,7 @@ def compile_corpus(
                 ast = dslc.try_parse(expr)
                 if ast is None:  # unreachable: exact path consts these
                     return const_true_unc()
-                progs.append(lower_dsl(ast, superset=True))
+                progs.append(lower_dsl(ast))
             merged = _merge_dsl_progs(progs, m.condition, superset=True)
             if merged is None:
                 return const_true_unc()
@@ -1380,7 +1491,13 @@ def compile_corpus(
                 # per-op superset re-lowering: this op becomes a device
                 # *prefilter* — rows where it fires are host-confirmed
                 # (op_prefilter & op_value ⇒ t_unc), rows where it
-                # doesn't are exact; sibling exact ops are unaffected
+                # doesn't are exact; sibling exact ops are unaffected.
+                # Refund any always-on rx budget the discarded sibling
+                # recs had claimed.
+                for rec in recs:
+                    for _lp, _ci, _stream, gate in rec.get("rx") or []:
+                        if not gate:
+                            rx_always_budget[0] += 1
                 recs = [lower_matcher_superset(m) for m in op.matchers]
             lowered_ops.append(
                 {
@@ -1603,6 +1720,65 @@ def compile_corpus(
         [r.get("neg_slots", []) for r in matchers], NM
     )
 
+    # --- device-regex sequence tables ---
+    rx_matchers = [
+        (i, rec) for i, rec in enumerate(matchers) if rec.get("rx")
+    ]
+    rx_m_ids = np.array([i for i, _ in rx_matchers], dtype=np.int32)
+    seqs: list[tuple[int, object, bool, str, list]] = []
+    for rxi, (_m_id, rec) in enumerate(rx_matchers):
+        for lp, ci, stream, gate in rec["rx"]:
+            seqs.append((rxi, lp, ci, stream, gate))
+    rx_seq_slot_buckets = bucket_ragged(
+        [s[4] for s in seqs], max(len(seqs), 1)
+    )
+    rx_seq_always = np.array(
+        [not s[4] for s in seqs] or [False], dtype=bool
+    )
+    NSEQ = max(len(seqs), 1)
+    rx_max_m = max((s[1].m for s in seqs), default=1)
+    rx_lanes = (rx_max_m + 31) // 32  # uint32 state lanes
+    rx_seq_matcher = np.zeros((NSEQ,), dtype=np.int32)
+    rx_seq_stream = np.zeros((NSEQ,), dtype=np.int32)
+    rx_seq_ci = np.zeros((NSEQ,), dtype=bool)
+    rx_classes = np.zeros((NSEQ, rx_max_m, 8), dtype=np.uint32)
+    rx_m_count = np.ones((NSEQ,), dtype=np.int32)
+    rx_seed = np.zeros((NSEQ, rx_lanes), dtype=np.uint32)
+    rx_skip = np.zeros((NSEQ, rx_lanes), dtype=np.uint32)
+    rx_accept = np.zeros((NSEQ, rx_lanes), dtype=np.uint32)
+    rx_self = np.zeros((NSEQ, rx_lanes), dtype=np.uint32)
+    rx_anchored = np.zeros((NSEQ,), dtype=bool)
+    rx_end_mode = np.zeros((NSEQ,), dtype=np.int32)
+    rx_start_wb = np.zeros((NSEQ,), dtype=bool)
+    rx_end_wb = np.zeros((NSEQ,), dtype=bool)
+    rx_max_skip_run = 0
+    for si, (rxi, lp, ci, stream, _gate) in enumerate(seqs):
+        rx_seq_matcher[si] = rxi
+        rx_seq_stream[si] = STREAMS.index(stream)
+        rx_seq_ci[si] = ci
+        rx_classes[si, : lp.m] = lp.classes
+        rx_m_count[si] = lp.m
+        rx_anchored[si] = lp.anchored
+        rx_end_mode[si] = lp.end_mode
+        rx_start_wb[si] = lp.start_wb
+        rx_end_wb[si] = lp.end_wb
+        seed, skip, accept, sl = regexlin.derived_masks(lp)
+        for j, v in enumerate((seed, skip, accept, sl)):
+            arr = (rx_seed, rx_skip, rx_accept, rx_self)[j]
+            for ln in range(rx_lanes):
+                arr[si, ln] = (v >> (32 * ln)) & 0xFFFFFFFF
+        rx_max_skip_run = max(rx_max_skip_run, lp.max_skip_run)
+    # byte → position-bits lookup (the kernel's per-byte B[c] masks):
+    # transpose of rx_classes into state lanes.
+    rx_bytemap = np.zeros((NSEQ, 256, rx_lanes), dtype=np.uint32)
+    if seqs:
+        for c in range(256):
+            bits = (rx_classes[:, :, c >> 5] >> np.uint32(c & 31)) & 1
+            for i in range(rx_max_m):
+                rx_bytemap[:, c, i // 32] |= bits[:, i].astype(
+                    np.uint32
+                ) << np.uint32(i % 32)
+
     # --- operation / template arrays ---
     NOP = max(len(ops), 1)
     op_cond_and = np.zeros((NOP,), dtype=bool)
@@ -1632,6 +1808,8 @@ def compile_corpus(
         "ops_prefilter": int(op_prefilter.sum()),
         "templates_host_always": len(host_always),
         "matchers": len(matchers),
+        "rx_matchers": len(rx_matchers),
+        "rx_sequences": len(seqs),
         "word_slots": NW,
         "tiny_slots": NTINY,
         "tables": {
@@ -1661,6 +1839,24 @@ def compile_corpus(
         m_residue=m_residue,
         m_md5=m_md5,
         m_md5_check=m_md5_check,
+        rx_m_ids=rx_m_ids,
+        rx_seq_slot_buckets=rx_seq_slot_buckets,
+        rx_seq_always=rx_seq_always,
+        rx_seq_matcher=rx_seq_matcher,
+        rx_seq_stream=rx_seq_stream,
+        rx_seq_ci=rx_seq_ci,
+        rx_classes=rx_classes,
+        rx_bytemap=rx_bytemap,
+        rx_m_count=rx_m_count,
+        rx_seed=rx_seed,
+        rx_skip=rx_skip,
+        rx_accept=rx_accept,
+        rx_self=rx_self,
+        rx_anchored=rx_anchored,
+        rx_end_mode=rx_end_mode,
+        rx_start_wb=rx_start_wb,
+        rx_end_wb=rx_end_wb,
+        rx_max_skip_run=rx_max_skip_run,
         m_status=m_status,
         m_size=m_size,
         m_size_stream=m_size_stream,

@@ -4,7 +4,8 @@ The corpus's 766 ``dsl`` matchers are govaluate-style expressions such as
 ``len(body)==2336 && status_code==200 && md5(body)=="…"``
 (``technologies/favicon-detection.yaml:23-27`` in the reference corpus).
 This module parses them once into a small AST that both the exact host
-evaluator (here) and the device lowering (``ops/dsl_device.py``) consume.
+evaluator (here) and the device lowering (``fingerprints/compile.py``,
+``lower_dsl``) consume.
 
 AST node forms (plain tuples, trivially traversable):
   ("lit", value) · ("var", name) · ("call", fname, [args])

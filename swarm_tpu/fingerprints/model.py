@@ -92,6 +92,7 @@ class Operation:
     headers: list[tuple[str, str]] = dataclasses.field(default_factory=list)
     body: str = ""
     payloads: dict = dataclasses.field(default_factory=dict)  # fuzz lists
+    attack: str = "batteringram"  # payload combination mode
     inputs: list[bytes] = dataclasses.field(default_factory=list)  # network send
     hosts: list[str] = dataclasses.field(default_factory=list)
     read_size: Optional[int] = None

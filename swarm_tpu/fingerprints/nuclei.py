@@ -104,6 +104,7 @@ def _parse_operation(raw: dict, protocol: str) -> Operation:
         ),
         body=str(raw.get("body") or ""),
         payloads=raw.get("payloads") or {},
+        attack=str(raw.get("attack") or "batteringram"),
         hosts=[str(h) for h in _as_list(raw.get("host"))],
         redirects=bool(raw.get("redirects", False)),
         max_redirects=int(raw.get("max-redirects", 0)),

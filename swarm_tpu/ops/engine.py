@@ -136,6 +136,11 @@ class MatchEngine:
         self._ext_t_idx = [
             i for i, has in enumerate(self._has_extractors) if has
         ]
+        # vectorized per-op matcher-id tables: resolving a giant op
+        # (fingerprinthub: 2,897 matchers) must not walk bits in Python
+        self._op_m_arr = [
+            np.asarray(ids, dtype=np.int64) for ids in db.op_matchers
+        ]
 
     # ------------------------------------------------------------------
     def match(self, responses: Sequence[Response]) -> list[RowMatches]:
@@ -336,12 +341,14 @@ class MatchEngine:
             else:
                 # undecided ⇒ certain matchers are neutral; combine the
                 # uncertain ones' exact values under the op condition
-                vals = []
-                for m_id in db.op_matchers[op_id]:
-                    if _bit(pm_unc, b, m_id):
-                        vals.append(confirm_matcher(m_id, row))
-                        confirms[b] = confirms.get(b, 0) + 1
-                        self.stats.host_confirm_pairs += 1
+                ids = self._op_m_arr[op_id]
+                bits = (pm_unc[b, ids >> 3] >> (7 - (ids & 7))) & 1
+                vals = [
+                    confirm_matcher(int(m_id), row)
+                    for m_id in ids[bits.astype(bool)]
+                ]
+                confirms[b] = confirms.get(b, 0) + len(vals)
+                self.stats.host_confirm_pairs += len(vals)
                 v = all(vals) if db.op_cond_and[op_id] else any(vals)
             op_cache[key] = v
             return v

@@ -323,6 +323,7 @@ def eval_verdicts(
     status,
     full=False,
     md5_digest=None,
+    rx=None,
 ):
     """Slot bits + scalars → (t_value, t_uncertain) [B, NT] bool.
 
@@ -461,6 +462,14 @@ def eval_verdicts(
     # regex matches, so the fired bit always needs host confirmation
     # (absence of the literal stays exact — the regex cannot match).
     m_unc = m_unc | (is_regex_prefilter[None, :] & m_value)
+    # ...EXCEPT matchers the device regex verify re-checked exactly
+    # (ops/regexdev.py): their value is the true search result and
+    # only budget-overflow pairs stay uncertain.
+    if rx is not None and len(db.rx_m_ids):
+        rx_value, rx_unc = rx
+        ids = jnp.asarray(db.rx_m_ids)
+        m_value = m_value.at[:, ids].set(rx_value)
+        m_unc = m_unc.at[:, ids].set(rx_unc)
     # negation after uncertainty capture
     m_value = m_value ^ jnp.asarray(db.m_negative)[None, :]
 
@@ -519,6 +528,14 @@ def _match_impl(
         from swarm_tpu.ops.md5 import md5_words
 
         digest = md5_words(streams["body"], lengths["body"])
+    rx = None
+    if len(db.rx_m_ids):
+        from swarm_tpu.ops.regexdev import regex_verify
+
+        B = next(iter(streams.values())).shape[0]
+        rx = regex_verify(
+            db, streams, lengths, value_bits, k_pairs=db.rx_k_pairs(B)
+        )
     out = eval_verdicts(
         db,
         value_bits,
@@ -527,5 +544,6 @@ def _match_impl(
         status,
         full=full,
         md5_digest=digest,
+        rx=rx,
     )
     return (*out, overflow)

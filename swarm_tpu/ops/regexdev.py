@@ -1,0 +1,218 @@
+"""Device regex verify: exact shift-and over fired rows.
+
+Regex matchers whose patterns compiled to linear programs
+(fingerprints/regexlin.py) are re-checked ON DEVICE when their literal
+prefilter fires: the fired (row, sequence) pairs are compacted with a
+fixed budget, each pair's stream bytes are gathered once, and a
+``lax.scan`` runs the 64-state bit-parallel recurrence (two uint32
+lanes) over the bytes — byte-class masks come from one [NSEQ, 256, 2]
+lookup per byte. The result replaces the prefilter's
+uncertain-on-fire semantics with an exact device verdict; only pairs
+beyond the compaction budget stay uncertain (host confirms them).
+
+This is the "regex on TPU" piece of SURVEY.md §7's hard-part #1: no
+general regex engine exists in XLA, but the corpus's matcher regexes
+are linear-program shaped, and search semantics (does it match
+anywhere) need no captures or backtracking.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from swarm_tpu.fingerprints import compile as fpc
+from swarm_tpu.ops.encoding import STREAMS
+
+UNROLL = 8  # bytes per scan step
+
+
+def regex_verify(
+    db: fpc.CompiledDB,
+    streams: dict,
+    lengths: dict,
+    value_bits,
+    k_pairs: int,
+):
+    """→ (rx_value [B, NRXM] bool, rx_unc [B, NRXM] bool).
+
+    ``value_bits`` are the post-combine slot bits (the literal
+    prefilters gate which pairs run). ``streams`` must be the FULL
+    per-row byte streams (sequence-sharded callers gather first).
+    """
+    NRXM = len(db.rx_m_ids)
+    some = next(iter(streams.values()))
+    B = some.shape[0]
+    if NRXM == 0:
+        z = jnp.zeros((B, 1), dtype=bool)
+        return z, z
+
+    # --- fired gate, per sequence: OR over the owning pattern's
+    # literal slots; literal-less sequences scan every row (rationed
+    # by the compiler's rx_always_budget) ---
+    seq_matcher = jnp.asarray(db.rx_seq_matcher)
+    NSEQ = db.rx_seq_matcher.shape[0]
+    fired_seq = jnp.broadcast_to(
+        jnp.asarray(db.rx_seq_always)[None, :], (B, NSEQ)
+    )
+    for bucket in db.rx_seq_slot_buckets:
+        gv = value_bits[:, bucket.idx]
+        fired_seq = fired_seq.at[:, jnp.asarray(bucket.rows)].max(
+            gv.any(-1)
+        )
+
+    # --- compact fired pairs under a fixed budget ---
+    flat = fired_seq.reshape(-1)
+    K = int(k_pairs)
+    (idx,) = jnp.nonzero(flat, size=K, fill_value=-1)
+    valid = idx >= 0
+    safe = jnp.maximum(idx, 0)
+    pair_b = safe // NSEQ
+    pair_s = safe % NSEQ
+
+    # --- stacked stream variants (static set, from the compiled db) ---
+    variants = sorted(
+        {
+            (int(s), bool(c))
+            for s, c in zip(db.rx_seq_stream, db.rx_seq_ci)
+        }
+    )
+    var_of_seq = np.zeros((max(NSEQ, 1),), dtype=np.int32)
+    for si in range(NSEQ):
+        var_of_seq[si] = variants.index(
+            (int(db.rx_seq_stream[si]), bool(db.rx_seq_ci[si]))
+        )
+    w_max = max(streams[STREAMS[s]].shape[1] for s, _ in variants)
+    bufs = []
+    lens = []
+    for s, ci in variants:
+        name = STREAMS[s]
+        arr = jnp.asarray(streams[name])
+        if ci:
+            up = (arr >= 65) & (arr <= 90)
+            arr = jnp.where(up, arr + 32, arr)
+        if arr.shape[1] < w_max:
+            arr = jnp.pad(arr, ((0, 0), (0, w_max - arr.shape[1])))
+        bufs.append(arr)
+        lens.append(jnp.asarray(lengths[name]))
+    stacked = jnp.stack(bufs, axis=1)  # [B, V, w_max]
+    len_stack = jnp.stack(lens, axis=1)  # [B, V]
+
+    pair_var = jnp.asarray(var_of_seq)[pair_s]
+    pair_bytes = stacked[pair_b, pair_var]  # [K, w_max]
+    pair_len = len_stack[pair_b, pair_var]  # [K]
+
+    # --- per-pair program masks ([K, L] state lanes) ---
+    bytemap = jnp.asarray(db.rx_bytemap)  # [NSEQ, 256, L]
+    L = db.rx_bytemap.shape[2]
+    seed = jnp.asarray(db.rx_seed)[pair_s]  # [K, L]
+    skip = jnp.asarray(db.rx_skip)[pair_s]
+    accept = jnp.asarray(db.rx_accept)[pair_s]
+    sloop = jnp.asarray(db.rx_self)[pair_s]
+    anchored = jnp.asarray(db.rx_anchored)[pair_s][:, None]  # [K, 1]
+    end_mode = jnp.asarray(db.rx_end_mode)[pair_s]  # [K]
+    start_wb = jnp.asarray(db.rx_start_wb)[pair_s]
+    end_wb = jnp.asarray(db.rx_end_wb)[pair_s]
+    r_closure = int(db.rx_max_skip_run)
+
+    from swarm_tpu.fingerprints.regexlin import (
+        END_DOLLAR,
+        END_NONE,
+        END_Z,
+        _WORD_BYTES,
+    )
+
+    word_tab = jnp.asarray(_WORD_BYTES)
+    # $ needs "just before a final newline" — precompute per pair
+    last_byte = jnp.take_along_axis(
+        pair_bytes, jnp.maximum(pair_len - 1, 0)[:, None], axis=1
+    )[:, 0]
+    trail_nl = (last_byte == 0x0A) & (pair_len > 0)
+
+    def lane_shift(d):
+        """64/96-bit left shift by 1 across uint32 lanes [K, L]."""
+        carry = jnp.concatenate(
+            [jnp.zeros((K, 1), dtype=jnp.uint32), d[:, :-1] >> 31],
+            axis=1,
+        )
+        return (d << 1) | carry
+
+    pad = (-w_max) % UNROLL
+    if pad:
+        pair_bytes_p = jnp.pad(pair_bytes, ((0, 0), (0, pad)))
+    else:
+        pair_bytes_p = pair_bytes
+    n_steps = (w_max + pad) // UNROLL
+    xs = jnp.moveaxis(
+        pair_bytes_p.reshape(K, n_steps, UNROLL), 1, 0
+    )  # [n_steps, K, UNROLL]
+
+    map_flat = bytemap.reshape(-1, L)
+    pair_s32 = pair_s.astype(jnp.int32)
+    zeros_k = jnp.zeros((K,), dtype=jnp.uint32)
+
+    def step(carry, inp):
+        d, matched, t0, prev_word, pending, pend_word = carry
+        block = inp  # [K, UNROLL]
+        for u in range(UNROLL):
+            c = block[:, u].astype(jnp.int32)
+            pos = t0 + u
+            live = pos < pair_len
+            w_c = word_tab[c] & live
+            # trailing-\b accepts from the previous byte resolve now:
+            # boundary iff wordness changes (or EOS, handled after)
+            matched = matched | (pending & live & (pend_word ^ w_c))
+            pending = pending & ~live  # EOS case resolves after scan
+            bc = map_flat[pair_s32 * 256 + c]  # [K, L]
+            bc = jnp.where(live[:, None], bc, 0)
+            # seed guards: anchors fix the start, \b needs a boundary
+            s_ok = (~anchored[:, 0] | (pos == 0)) & (
+                ~start_wb | (w_c ^ prev_word)
+            )
+            s = jnp.where(s_ok[:, None], seed, 0)
+            d = ((lane_shift(d) | s) & bc) | (d & sloop & bc)
+            for _ in range(r_closure):
+                d = d | (lane_shift(d) & skip)
+            acc = ((d & accept) != 0).any(axis=1)
+            end_ok = (
+                (end_mode == END_NONE)
+                | ((end_mode == END_Z) & (pos == pair_len - 1))
+                | (
+                    (end_mode == END_DOLLAR)
+                    & (
+                        (pos == pair_len - 1)
+                        | (trail_nl & (pos == pair_len - 2))
+                    )
+                )
+            )
+            matched = matched | (acc & end_ok & ~end_wb)
+            pending = pending | (acc & end_wb)
+            pend_word = jnp.where(acc & end_wb, w_c, pend_word)
+            prev_word = w_c
+        return (d, matched, t0 + UNROLL, prev_word, pending, pend_word), None
+
+    init = (
+        jnp.zeros((K, L), dtype=jnp.uint32),
+        jnp.zeros((K,), dtype=bool),
+        jnp.int32(0),
+        jnp.zeros((K,), dtype=bool),
+        jnp.zeros((K,), dtype=bool),
+        jnp.zeros((K,), dtype=bool),
+    )
+    (_, matched, _, _, pending, pend_word), _ = jax.lax.scan(
+        step, init, xs
+    )
+    # end of stream is a boundary exactly after a word char
+    matched = matched | (pending & pend_word)
+    matched = matched & valid
+
+    # --- scatter back to matcher granularity ---
+    rx_value = jnp.zeros((B, NRXM), dtype=bool)
+    rx_value = rx_value.at[pair_b, seq_matcher[pair_s]].max(matched)
+    # pairs that didn't fit the budget leave their matcher uncertain
+    included = jnp.zeros((B * NSEQ,), dtype=bool).at[safe].max(valid)
+    missing_seq = fired_seq & ~included.reshape(B, NSEQ)
+    rx_unc = jnp.zeros((B, NRXM), dtype=bool)
+    rx_unc = rx_unc.at[:, seq_matcher].max(missing_seq)
+    return rx_value, rx_unc

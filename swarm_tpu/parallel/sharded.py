@@ -187,14 +187,33 @@ class ShardedMatcher:
             # the byte dimension, so a seq-sharded body is re-gathered
             # (tiled over ICI) just for the digest — cheap next to the
             # probe stage, and only when the corpus compares digests
+            def full_stream(name):
+                local = streams[name]
+                if seq_ranks > 1:
+                    return jax.lax.all_gather(
+                        local, "seq", axis=1, tiled=True
+                    )
+                return local
+
             digest = None
             if bool(db.m_md5_check.any()) and "body" in streams:
-                body = streams["body"]
-                if seq_ranks > 1:
-                    body = jax.lax.all_gather(
-                        body, "seq", axis=1, tiled=True
-                    )
-                digest = md5_words(body, lengths["body"])
+                digest = md5_words(full_stream("body"), lengths["body"])
+            # device regex verify over the combined slot bits: like md5
+            # it needs whole rows, so used streams gather over 'seq'
+            rx = None
+            if len(db.rx_m_ids):
+                from swarm_tpu.ops.encoding import STREAMS
+                from swarm_tpu.ops.regexdev import regex_verify
+
+                used = {STREAMS[int(s)] for s in db.rx_seq_stream}
+                gathered = {n: full_stream(n) for n in used}
+                rx = regex_verify(
+                    db,
+                    gathered,
+                    lengths,
+                    value_bits,
+                    k_pairs=db.rx_k_pairs(status.shape[0]),
+                )
             out = eval_verdicts(
                 db,
                 value_bits,
@@ -203,6 +222,7 @@ class ShardedMatcher:
                 status,
                 full=full,
                 md5_digest=digest,
+                rx=rx,
             )
             if full:
                 # pack bit planes per data-rank (axis 1 is unsharded, so

@@ -33,6 +33,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from swarm_tpu.fingerprints import dslc
 from swarm_tpu.fingerprints.model import Response, Template
 from swarm_tpu.native import scanio
 from swarm_tpu.worker.executor import (
@@ -103,14 +104,29 @@ class RequestPlan:
     dns_owners: list[set[int]] = dataclasses.field(default_factory=list)
 
 
-def _substitute(text: str) -> Optional[str]:
+def _substitute(text: str, payload_vars: Optional[dict] = None) -> Optional[str]:
     """Resolve standard nuclei placeholders to plan-time markers; None
     if any unknown placeholder remains. Markers are resolved per target
-    in ``_finalize`` — the plan itself stays target-free."""
+    in ``_finalize`` — the plan itself stays target-free.
+
+    With ``payload_vars`` set (payload-attack expansion), bare variable
+    placeholders take the combo's value and expression placeholders
+    ({{base64('user:' + token)}}) are evaluated through the dsl
+    engine with the combo as the environment."""
 
     def repl(m: re.Match) -> str:
         name = m.group(1).strip()
         low = name.lower()
+        if payload_vars is not None:
+            if name in payload_vars:
+                return str(payload_vars[name])
+            ast = dslc.try_parse(name)
+            if ast is not None and ast[0] != "var":
+                try:
+                    v = dslc.evaluate(ast, dict(payload_vars))
+                    return v.decode("latin-1") if isinstance(v, bytes) else str(v)
+                except Exception:
+                    pass  # unknown fn/var → fall through to builtins
         if low in ("baseurl", "rooturl"):
             return "\x00BASE\x00"  # stripped later; plan paths are host-free
         if low == "hostname":
@@ -153,6 +169,100 @@ def _finalize(text: str, host: str, port: int, tls: bool) -> str:
         .replace("\x00PORT\x00", str(port))
         .replace("\x00SCHEME\x00", scheme)
     )
+
+
+# bounded payload fan-out: wordlist files are read up to MAX_PAYLOAD_
+# VALUES lines and attack combinations cap at MAX_PAYLOAD_COMBOS per
+# operation — the reference shells out to nuclei which walks the full
+# 89k-line lists; a scanning *fleet* bounds per-job work instead
+MAX_PAYLOAD_VALUES = 100
+MAX_PAYLOAD_COMBOS = 200
+
+
+def _payload_values(
+    spec, template_path: Optional[str]
+) -> Optional[list[str]]:
+    """One payload variable's value list; file refs resolve against the
+    template's ancestors (the corpus root holds helpers/wordlists)."""
+    if isinstance(spec, list):
+        return [str(v) for v in spec[:MAX_PAYLOAD_VALUES]]
+    if not isinstance(spec, str):
+        return None
+    import pathlib
+
+    cand: list[pathlib.Path] = []
+    if template_path:
+        for parent in pathlib.Path(template_path).parents:
+            cand.append(parent / spec)
+    for path in cand:
+        try:
+            if path.is_file():
+                out = []
+                with open(path, "r", encoding="utf-8", errors="replace") as f:
+                    for line in f:
+                        line = line.rstrip("\n")
+                        if line:
+                            out.append(line)
+                        if len(out) >= MAX_PAYLOAD_VALUES:
+                            break
+                return out
+        except OSError:
+            continue
+    return None
+
+
+def _payload_combos(op, template_path: Optional[str]) -> Optional[list[dict]]:
+    """Attack-mode expansion → bounded list of var→value dicts.
+
+    batteringram: one shared value stream; pitchfork: zip the lists;
+    clusterbomb: cartesian product (capped)."""
+    lists: dict[str, list[str]] = {}
+    for var, spec in op.payloads.items():
+        vals = _payload_values(spec, template_path)
+        if vals is None or not vals:
+            return None
+        lists[str(var)] = vals
+    if not lists:
+        return []
+    mode = (op.attack or "batteringram").lower()
+    names = list(lists)
+    combos: list[dict] = []
+    if mode == "clusterbomb" and len(names) > 1:
+        import itertools
+
+        for values in itertools.product(*(lists[n] for n in names)):
+            combos.append(dict(zip(names, values)))
+            if len(combos) >= MAX_PAYLOAD_COMBOS:
+                break
+    elif mode == "pitchfork" and len(names) > 1:
+        for values in zip(*(lists[n] for n in names)):
+            combos.append(dict(zip(names, values)))
+            if len(combos) >= MAX_PAYLOAD_COMBOS:
+                break
+    else:
+        # batteringram (or single-var): one value stream, every var
+        # takes the same value (nuclei's batteringram semantics)
+        for v in lists[names[0]]:
+            combos.append({n: v for n in names})
+            if len(combos) >= MAX_PAYLOAD_COMBOS:
+                break
+    return combos
+
+
+_INDEXED_VAR_RE = re.compile(
+    r"\b(?:body|header|all_headers|status_code|response|raw|duration)_\d+\b"
+)
+
+
+def _uses_indexed_vars(t: Template) -> bool:
+    """True when any matcher/extractor references per-step history vars
+    (the req-condition idiom) — cross-request evaluation state."""
+    for op in t.operations:
+        for m in op.matchers:
+            for expr in m.dsl:
+                if _INDEXED_VAR_RE.search(expr):
+                    return True
+    return False
 
 
 def _parse_raw(raw: str) -> Optional[PlannedRequest]:
@@ -277,68 +387,105 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
                 skip("dns-qtype", t)
             continue
         if t.protocol != "http":
-            continue  # file/headless/ssl handled elsewhere
-        if any(op.payloads for op in t.operations):
-            skip("payloads", t)
+            # non-http, non-network/dns protocols (file/headless/ssl)
+            # are not executed by the active scanner; plan-time skip
+            # counters surface them per class below
+            skip(f"protocol-{t.protocol}", t)
             continue
         ok = False
         unsupported: Optional[str] = None
         for op in t.operations:
-            if op.raw:
-                if len(op.raw) > 1:
-                    unsupported = "multi-step-raw"
+            # payload attacks (default-logins, fuzzing, token-spray):
+            # expand the bounded combo set and plan one request per
+            # combo — every combo's response batch-matches on device
+            # and any hit attributes to the template
+            if op.payloads:
+                combos = _payload_combos(op, t.source_path)
+                if combos is None:
+                    unsupported = "payload-values"
                     continue
-                sub = _substitute(op.raw[0])
-                if sub is None:
+            else:
+                combos = [None]
+            for payload_vars in combos:
+                if op.raw:
+                    # multi-request raws: nuclei evaluates matchers per
+                    # response (OR across steps) unless they reference
+                    # indexed history vars (body_2, status_code_1 … /
+                    # req-condition) — those need cross-request state
+                    # this engine doesn't model, so they stay skipped
+                    # rather than silently never-matching.
+                    if len(op.raw) > 1 and _uses_indexed_vars(t):
+                        unsupported = "multi-step-condition"
+                        continue
+                    # all-or-nothing: a step a matcher depends on must
+                    # not silently drop while its siblings plan
+                    step_reqs = []
+                    step_fail = None
+                    for step in op.raw:
+                        sub = _substitute(step, payload_vars)
+                        if sub is None:
+                            step_fail = "dynamic-values"
+                            break
+                        req = _parse_raw(sub)
+                        if req is None:
+                            step_fail = "raw-unparseable"
+                            break
+                        step_reqs.append(req)
+                    if step_fail:
+                        unsupported = step_fail
+                        continue
+                    for req in step_reqs:
+                        add(req, t_idx)
+                    ok = True
+                    continue
+                method = (op.method or "GET").upper()
+                if method not in ("GET", "POST", "PUT", "HEAD", "OPTIONS"):
+                    unsupported = f"method-{method}"
+                    continue
+                body_t = _substitute(op.body or "", payload_vars)
+                if body_t is None:
                     unsupported = "dynamic-values"
                     continue
-                req = _parse_raw(sub)
-                if req is None:
-                    unsupported = "raw-unparseable"
-                    continue
-                add(req, t_idx)
-                ok = True
-                continue
-            method = (op.method or "GET").upper()
-            if method not in ("GET", "POST", "PUT", "HEAD", "OPTIONS"):
-                unsupported = f"method-{method}"
-                continue
-            body = op.body.encode("latin-1", "replace") if op.body else b""
-            for path_t in op.paths:
-                sub = _substitute(path_t)
-                if sub is None:
-                    unsupported = "dynamic-values"
-                    continue
-                # strip only the *leading* BaseURL; interior occurrences
-                # resolve to absolute URLs at wire time
-                if sub.startswith("\x00BASE\x00"):
-                    sub = sub[len("\x00BASE\x00"):]
-                elif sub.startswith(("http://", "https://")):
-                    # token-spray-style templates request third-party API
-                    # hosts, not the scanned target — out of scope here
-                    unsupported = "external-target"
-                    continue
-                path = sub or "/"
-                if not path.startswith("/"):
-                    path = "/" + path
-                headers = []
-                header_ok = True
-                for k, v in op.headers:
-                    hv = _substitute(v)
-                    if hv is None:
-                        header_ok = False  # e.g. "Bearer {{token}}"
-                        break
-                    headers.append((k, hv))
-                if not header_ok:
-                    unsupported = "dynamic-values"
-                    continue
-                add(
-                    PlannedRequest(
-                        method=method, path=path, headers=tuple(headers), body=body
-                    ),
-                    t_idx,
-                )
-                ok = True
+                body = body_t.encode("latin-1", "replace")
+                for path_t in op.paths:
+                    sub = _substitute(path_t, payload_vars)
+                    if sub is None:
+                        unsupported = "dynamic-values"
+                        continue
+                    # strip only the *leading* BaseURL; interior
+                    # occurrences resolve to absolute URLs at wire time
+                    if sub.startswith("\x00BASE\x00"):
+                        sub = sub[len("\x00BASE\x00"):]
+                    elif sub.startswith(("http://", "https://")):
+                        # token-spray-style templates request third-party
+                        # API hosts, not the scanned target — out of
+                        # scope here
+                        unsupported = "external-target"
+                        continue
+                    path = sub or "/"
+                    if not path.startswith("/"):
+                        path = "/" + path
+                    headers = []
+                    header_ok = True
+                    for k, v in op.headers:
+                        hv = _substitute(v, payload_vars)
+                        if hv is None:
+                            header_ok = False  # e.g. "Bearer {{token}}"
+                            break
+                        headers.append((k, hv))
+                    if not header_ok:
+                        unsupported = "dynamic-values"
+                        continue
+                    add(
+                        PlannedRequest(
+                            method=method,
+                            path=path,
+                            headers=tuple(headers),
+                            body=body,
+                        ),
+                        t_idx,
+                    )
+                    ok = True
         if not ok and unsupported:
             skip(unsupported, t)
 

@@ -113,11 +113,15 @@ def test_plan_raw_request_parsed():
     assert wire.endswith(b'{"probe": true}')
 
 
-def test_plan_skips_payloads_and_dynamic():
+def test_plan_expands_payloads_and_skips_dynamic():
     dynamic = T(LOGIN_TEMPLATE.replace("/admin/login", "/x/{{unknowable}}"))
     plan = active.build_plan([T(PAYLOAD_TEMPLATE), dynamic])
-    assert not plan.requests
-    assert plan.skipped["payloads"] == ["demo-payload-skip"]
+    # payload attacks expand into per-combo requests (bounded)
+    assert sorted(r.path for r in plan.requests) == [
+        "/login?u=admin",
+        "/login?u=root",
+    ]
+    assert "payloads" not in plan.skipped
     assert plan.skipped["dynamic-values"] == ["demo-login-panel"]
 
 

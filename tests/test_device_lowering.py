@@ -192,20 +192,53 @@ def test_kleene_status_miss_skips_regex_confirm():
     assert eng.stats.host_confirm_pairs == 0
 
 
-def test_regex_prefilter_confirms_only_fired(monkeypatch):
-    """OR template with one regex: fired literal → exactly one host
-    confirmation; absent literal → zero."""
+def test_regex_verified_on_device():
+    """A linear-program-compilable regex is exact on device: fired or
+    not, zero host confirmations (ops/regexdev.py)."""
     eng = engine_for(KLEENE_TEMPLATE)
+    assert eng.db.stats["rx_matchers"] == 1
     rows = [
         Response(host="a", port=80, status=200,
                  body=b"xx verysecret99marker yy", header=b"HTTP/1.1 200"),
         Response(host="b", port=80, status=200,
+                 # literal prefilter fires but the regex itself misses
+                 body=b"verysecret but no digits marker",
+                 header=b"HTTP/1.1 200"),
+        Response(host="c", port=80, status=200,
                  body=b"nothing to see", header=b"HTTP/1.1 200"),
     ]
     got = check_parity(eng, rows)
     assert got[0].template_ids == ["demo-kleene"]
     assert got[1].template_ids == []
-    assert eng.stats.host_confirm_pairs == 1
+    assert got[2].template_ids == []
+    assert eng.stats.host_confirm_pairs == 0
+
+
+CI_RX_TEMPLATE = """
+id: demo-ci-rx
+info: {name: n, severity: info}
+requests:
+  - matchers:
+      - type: regex
+        part: header
+        regex:
+          - '(?i)server:[ ]?nginx[\\/]?([0-9.]+)?'
+"""
+
+
+def test_ci_regex_verified_on_device():
+    eng = engine_for(CI_RX_TEMPLATE)
+    assert eng.db.stats["rx_matchers"] == 1
+    rows = [
+        Response(host="a", port=80, status=200, body=b"x",
+                 header=b"HTTP/1.1 200\r\nSERVER: NGINX/1.18"),
+        Response(host="b", port=80, status=200, body=b"x",
+                 header=b"HTTP/1.1 200\r\nServer: apache"),
+    ]
+    got = check_parity(eng, rows)
+    assert got[0].template_ids == ["demo-ci-rx"]
+    assert got[1].template_ids == []
+    assert eng.stats.host_confirm_pairs == 0
 
 
 REFERENCE_CORPUS = "/root/reference/worker/artifacts/templates"
@@ -231,11 +264,17 @@ def test_corpus_device_split_does_not_regress():
     assert db.num_templates >= 3700
     # op-level prefilters (whole-op host confirm on fire) are the
     # expensive fallback — keep them rare
-    assert int(db.op_prefilter.sum()) <= 40
+    assert int(db.op_prefilter.sum()) <= 20
+    # per-matcher residues (confirm-on-fire) are the cheap fallback —
+    # bounded so exotic-dsl growth is noticed
+    assert int(db.m_residue.sum()) <= 20
     # the md5/neg-contains lowerings must stay engaged
     assert int(db.m_md5_check.sum()) >= 10
-    assert int(db.m_residue.sum()) == 0
     assert sum(len(b.rows) for b in db.m_negslot_buckets) >= 10
+    # the device regex verify must cover the bulk of regex matchers,
+    # with always-on (literal-less) sequences tightly rationed
+    assert db.stats["rx_matchers"] >= 800
+    assert int(db.rx_seq_always.sum()) <= 4
 
 
 def test_md5_device_kernel_matches_hashlib():

@@ -1,0 +1,185 @@
+"""Payload-attack template execution (default-logins / fuzzing class).
+
+The reference delegates these to the nuclei binary
+(worker/modules/nuclei.json runs the full corpus incl.
+default-logins/minio/minio-default-login.yaml's ``payloads:`` block);
+here the planner expands bounded attack combos into per-combo planned
+requests and the responses batch-match on device.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import textwrap
+import threading
+
+import pytest
+import yaml
+
+from swarm_tpu.fingerprints.nuclei import parse_template
+from swarm_tpu.worker import active
+
+
+def T(doc: str, path="t/x.yaml"):
+    return parse_template(yaml.safe_load(textwrap.dedent(doc)), source_path=path)
+
+
+LOGIN_TEMPLATE = """\
+id: demo-default-login
+info: {name: n, severity: high}
+requests:
+  - raw:
+      - |
+        POST /api/login HTTP/1.1
+        Host: {{Hostname}}
+        Content-Type: application/json
+
+        {"username":"{{username}}","password":"{{password}}"}
+    payloads:
+      username:
+        - admin
+        - root
+      password:
+        - admin
+        - toor
+    attack: pitchfork
+    matchers:
+      - type: word
+        part: body
+        words:
+          - "login-accepted"
+"""
+
+
+def test_pitchfork_expands_zip():
+    plan = active.build_plan([T(LOGIN_TEMPLATE)])
+    bodies = sorted(r.body for r in plan.requests)
+    assert bodies == [
+        b'{"username":"admin","password":"admin"}',
+        b'{"username":"root","password":"toor"}',
+    ]
+    assert not plan.skipped
+
+
+def test_clusterbomb_expands_product():
+    t = T(LOGIN_TEMPLATE.replace("attack: pitchfork", "attack: clusterbomb"))
+    plan = active.build_plan([t])
+    assert len(plan.requests) == 4
+
+
+def test_batteringram_single_stream():
+    doc = """\
+    id: demo-ram
+    info: {name: n, severity: info}
+    requests:
+      - method: GET
+        path:
+          - "{{BaseURL}}/probe-{{word}}"
+        payloads:
+          word:
+            - alpha
+            - beta
+        matchers:
+          - type: status
+            status:
+              - 200
+    """
+    plan = active.build_plan([T(doc)])
+    assert sorted(r.path for r in plan.requests) == [
+        "/probe-alpha",
+        "/probe-beta",
+    ]
+
+
+def test_wordlist_file_payloads(tmp_path):
+    words = tmp_path / "helpers" / "wordlists" / "paths.txt"
+    words.parent.mkdir(parents=True)
+    words.write_text("".join(f"w{i}\n" for i in range(500)))
+    tdir = tmp_path / "fuzzing"
+    tdir.mkdir()
+    doc = {
+        "id": "demo-fuzz",
+        "info": {"name": "n", "severity": "info"},
+        "requests": [
+            {
+                "method": "GET",
+                "path": ["{{BaseURL}}/{{path}}"],
+                "payloads": {"path": "helpers/wordlists/paths.txt"},
+                "matchers": [{"type": "status", "status": [200]}],
+            }
+        ],
+    }
+    t = parse_template(doc, source_path=str(tdir / "demo-fuzz.yaml"))
+    plan = active.build_plan([t])
+    # bounded fan-out: MAX_PAYLOAD_VALUES lines, not the whole file
+    assert len(plan.requests) == active.MAX_PAYLOAD_VALUES
+    assert plan.requests[0].path == "/w0"
+
+
+def test_expression_payload_placeholder():
+    doc = """\
+    id: demo-token
+    info: {name: n, severity: info}
+    requests:
+      - method: GET
+        path:
+          - "{{BaseURL}}/check"
+        headers:
+          Authorization: "Basic {{base64('user:' + token)}}"
+        payloads:
+          token:
+            - sekrit
+        matchers:
+          - type: status
+            status:
+              - 200
+    """
+    plan = active.build_plan([T(doc)])
+    assert len(plan.requests) == 1
+    import base64
+
+    want = base64.b64encode(b"user:sekrit").decode()
+    assert ("Authorization", f"Basic {want}") in plan.requests[0].headers
+
+
+# --- end to end: an admin:admin endpoint caught by the login template ---
+
+
+class _Srv(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+@pytest.fixture
+def login_server():
+    class H(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                data = self.request.recv(8192).decode("latin-1")
+                body = data.split("\r\n\r\n", 1)[-1]
+                if '"username":"admin","password":"admin"' in body:
+                    out = "login-accepted token=xyz"
+                else:
+                    out = "denied"
+                resp = (
+                    "HTTP/1.1 200 OK\r\nContent-Type: text/html\r\n"
+                    f"Content-Length: {len(out)}\r\nConnection: close\r\n\r\n{out}"
+                )
+                self.request.sendall(resp.encode())
+            except OSError:
+                pass
+
+    srv = _Srv(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def test_default_login_caught_end_to_end(login_server):
+    from swarm_tpu.ops.engine import MatchEngine
+
+    t = T(LOGIN_TEMPLATE)
+    engine = MatchEngine([t], mesh=None)
+    scanner = active.ActiveScanner(engine, {"read_timeout_ms": 3000})
+    hits, stats = scanner.run([f"127.0.0.1:{login_server}"])
+    assert [h.template_id for h in hits] == ["demo-default-login"]

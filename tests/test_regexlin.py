@@ -1,0 +1,85 @@
+"""regexlin compiler/simulator parity with Python re.
+
+The device kernel (ops/regexdev.py) mirrors ``search_ref`` exactly, so
+this suite is the semantic backbone for on-device regex: any
+compile_linear output must agree with ``re.search`` over the latin-1
+decode for every input.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+
+import pytest
+
+from swarm_tpu.fingerprints.regexlin import (
+    compile_linear,
+    search_pattern,
+)
+
+PATTERNS = [
+    # literal / class / repeat shapes from the corpus
+    r"nginx[\/ ]?(\d+\.\d+)?",
+    r"(?i)x.amz.cf.id|nguardx",
+    r"(?i)ray.id",
+    r"[a-fA-F]{5}-[a-fA-F]{5}-[a-fA-F]{7}",
+    r"root:.*:0:0:",
+    r"(?i)st8(id|.wa|.wf)?.?(\d+|\w+)?",
+    r"<title>[Dd]ruid",
+    r"\d+\.\d+\.\d+",
+    r"(?i)apache(/([\d.]+))?",
+    r"[^\n]{3}end",
+    r"(?s)start..stop",
+    r"colou?r",
+    r"ab{2,4}c",
+    r"x(yz)?w",
+    r"(GET|POST|PUT) /admin",
+    # edge assertions
+    r"\APRE[0-9]+",
+    r"^hello",
+    r"world\Z",
+    r"tail$",
+    r"\bword\b",
+    r"\basp\.net\b",
+    r"(?i)\AFORTIWAFSID=",
+    # ci classes incl. negation
+    r"(?i)[^a]bc",
+    r"(?i)[a-z]{3}\d",
+]
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_matches_re_search(pattern):
+    got = compile_linear(pattern)
+    assert got is not None, f"{pattern!r} failed to compile"
+    alts, ci = got
+    cre = re.compile(pattern)
+    rng = random.Random(hash(pattern) & 0xFFFF)
+    cases = [b"", b"x", b"\n\n", pattern.encode("latin-1", "replace")]
+    # random bytes + planted near-matches
+    for _ in range(60):
+        cases.append(bytes(rng.randrange(256) for _ in range(rng.randint(0, 60))))
+    lit = re.sub(r"\\[dwsDWSAbZ]|[\^\$\.\*\+\?\(\)\[\]\{\}\|]", "1", pattern)
+    for _ in range(20):
+        base = bytearray(rng.randbytes(30))
+        pos = rng.randint(0, 20)
+        base[pos:pos] = lit.encode("latin-1", "replace")[:20]
+        cases.append(bytes(base))
+    # boundary-sensitive placements
+    cases += [lit.encode("latin-1", "replace"),
+              b" " + lit.encode("latin-1", "replace") + b" ",
+              b"x" + lit.encode("latin-1", "replace") + b"y",
+              lit.encode("latin-1", "replace") + b"\n"]
+    for data in cases:
+        want = cre.search(data.decode("latin-1")) is not None
+        mine = search_pattern(alts, ci, data)
+        assert mine == want, (pattern, data)
+
+
+def test_rejects_out_of_scope():
+    assert compile_linear(r"(a+)+b\1") is None  # backreference
+    assert compile_linear(r"(?=look)ahead") is None
+    assert compile_linear(r"a" * 200) is None  # > MAX_POSITIONS
+    assert compile_linear(r"x?") is None  # matches empty
+    assert compile_linear(r"(?m)^line") is None  # multiline anchors
