@@ -161,7 +161,7 @@ def resolve_device():
     return dev
 
 
-def bench_exact_engine(templates) -> float:
+def bench_exact_engine(templates) -> tuple:  # (rows_per_sec, CompiledDB)
     from swarm_tpu.ops.engine import MatchEngine
 
     eng = MatchEngine(

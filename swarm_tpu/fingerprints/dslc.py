@@ -17,6 +17,7 @@ from __future__ import annotations
 import base64 as _b64
 import hashlib
 import re
+import time as _time
 from typing import Any, Callable, Optional
 
 
@@ -198,6 +199,9 @@ _FUNCTIONS: dict[str, Callable] = {
     "hex_encode": lambda v: _to_bytes(v).hex(),
     "regex": lambda pattern, v: _search(_text(pattern), _text(v)) is not None,
     "mmh3": None,  # installed below (needs helper)
+    # wall-clock seconds; corpus use: ssl/expired-ssl.yaml
+    # ``unixtime() > not_after`` (evaluated host-side by the ssl scanner)
+    "unixtime": lambda: int(_time.time()),
 }
 
 

@@ -59,7 +59,7 @@ def extract_kval(ex: Extractor, response: Response) -> list[str]:
 # ---------------------------------------------------------------------------
 # json (jq-lite)
 
-_SEG_RE = re.compile(r"\.([A-Za-z0-9_\-$]+)|\[(\d+)\]")
+_SEG_RE = re.compile(r"\.([A-Za-z0-9_\-$]+)|\[(\d+)?\]")
 
 
 def jq_path(expr: str, doc: Any) -> Optional[Any]:
@@ -78,11 +78,17 @@ def jq_path(expr: str, doc: Any) -> Optional[Any]:
             if not isinstance(node, dict) or m.group(1) not in node:
                 return None
             node = node[m.group(1)]
-        else:
+        elif m.group(2) is not None:
             idx = int(m.group(2))
             if not isinstance(node, list) or idx >= len(node):
                 return None
             node = node[idx]
+        else:
+            # ``[]`` — jq iterate-all; supported in trailing position
+            # (corpus use: ssl templates' ``.dns_names[]``). The list
+            # itself is returned; extract_json flattens it per element.
+            if not isinstance(node, list) or pos < len(expr):
+                return None
     return node
 
 
@@ -96,7 +102,14 @@ def extract_json(ex: Extractor, response: Response) -> list[str]:
         val = jq_path(expr, doc)
         if val is None:
             continue
-        if isinstance(val, str):
+        if isinstance(val, list) and expr.rstrip().endswith("[]"):
+            # iterate-all path: one output per element (jq streaming)
+            out.extend(
+                v if isinstance(v, str)
+                else jsonlib.dumps(v, separators=(",", ":"))
+                for v in val
+            )
+        elif isinstance(val, str):
             out.append(val)
         else:
             out.append(jsonlib.dumps(val, separators=(",", ":")))

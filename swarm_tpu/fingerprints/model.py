@@ -101,6 +101,15 @@ class Operation:
     # dns protocol: record type + query-name template ("{{FQDN}}")
     dns_type: str = ""
     dns_name: str = ""
+    # file protocol: extension gate (lowercased, no dot; "all" = any).
+    # Reference corpus: worker/artifacts/templates/file/**.yaml and the
+    # standalone worker/artifacts/s3-bucket.yaml:7-10.
+    extensions: list[str] = dataclasses.field(default_factory=list)
+    # ssl protocol: handshake version pin (nuclei names: sslv3, tls10,
+    # tls11, tls12, tls13; "" = negotiate freely). Reference corpus:
+    # worker/artifacts/templates/ssl/deprecated-tls.yaml pins per entry.
+    ssl_min_version: str = ""
+    ssl_max_version: str = ""
 
 
 @dataclasses.dataclass

@@ -109,6 +109,15 @@ def _parse_operation(raw: dict, protocol: str) -> Operation:
         redirects=bool(raw.get("redirects", False)),
         max_redirects=int(raw.get("max-redirects", 0)),
     )
+    if protocol == "ssl":
+        # (the corpus's ``address`` field is always the default
+        # "{{Host}}:{{Port}}" — the scanner dials the input target)
+        op.ssl_min_version = str(raw.get("min_version") or "").lower()
+        op.ssl_max_version = str(raw.get("max_version") or "").lower()
+    if protocol == "file":
+        op.extensions = [
+            str(e).lower().lstrip(".") for e in _as_list(raw.get("extensions"))
+        ]
     if protocol == "dns":
         op.dns_type = str(raw.get("type") or "A").upper()
         op.dns_name = str(raw.get("name") or "{{FQDN}}")

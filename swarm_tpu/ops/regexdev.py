@@ -4,9 +4,11 @@ Regex matchers whose patterns compiled to linear programs
 (fingerprints/regexlin.py) are re-checked ON DEVICE when their literal
 prefilter fires: the fired (row, sequence) pairs are compacted with a
 fixed budget, each pair's stream bytes are gathered once, and a
-``lax.scan`` runs the 64-state bit-parallel recurrence (two uint32
-lanes) over the bytes — byte-class masks come from one [NSEQ, 256, 2]
-lookup per byte. The result replaces the prefilter's
+``lax.scan`` runs the bit-parallel shift-and recurrence — up to
+``regexlin.MAX_POSITIONS`` (96) NFA positions in uint32 lanes
+(lane-count generic; 96 states = 3 lanes) — over the bytes, with
+byte-class masks from one [NSEQ, 256, L] lookup per byte. The result
+replaces the prefilter's
 uncertain-on-fire semantics with an exact device verdict; only pairs
 beyond the compaction budget stay uncertain (host confirms them).
 

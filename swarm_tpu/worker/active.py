@@ -256,12 +256,20 @@ _INDEXED_VAR_RE = re.compile(
 
 def _uses_indexed_vars(t: Template) -> bool:
     """True when any matcher/extractor references per-step history vars
-    (the req-condition idiom) — cross-request evaluation state."""
+    (the req-condition idiom) — cross-request evaluation state. Indexed
+    references appear both in dsl expressions and as matcher/extractor
+    ``part`` names (e.g. ``part: body_2``,
+    misconfiguration/google/insecure-firebase-database.yaml)."""
     for op in t.operations:
         for m in op.matchers:
+            if _INDEXED_VAR_RE.search(m.part or ""):
+                return True
             for expr in m.dsl:
                 if _INDEXED_VAR_RE.search(expr):
                     return True
+        for ex in op.extractors:
+            if _INDEXED_VAR_RE.search(ex.part or ""):
+                return True
     return False
 
 
@@ -387,9 +395,11 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
                 skip("dns-qtype", t)
             continue
         if t.protocol != "http":
-            # non-http, non-network/dns protocols (file/headless/ssl)
-            # are not executed by the active scanner; plan-time skip
-            # counters surface them per class below
+            # file and ssl templates run under their dedicated modules
+            # (worker/filescan.py, worker/sslscan.py — modules/file.json,
+            # modules/ssl.json), not the active scanner; headless (7
+            # corpus templates) needs a browser engine and is out of
+            # scope — the skip counter keeps that honest per class
             skip(f"protocol-{t.protocol}", t)
             continue
         ok = False

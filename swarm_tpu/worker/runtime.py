@@ -99,6 +99,9 @@ class JobProcessor:
                 job = self.client.get_job(self.cfg.worker_id)
                 if job:
                     self.process_chunk(job)
+                    # max_jobs bounds *attempts*: a failing job must not
+                    # leave a --max-jobs worker polling forever
+                    self.jobs_done += 1
                     if self.cfg.max_jobs and self.jobs_done >= self.cfg.max_jobs:
                         return
                 else:
@@ -151,6 +154,10 @@ class JobProcessor:
                     output = self._execute_jarm(module, data)
                 elif module.backend == "active":
                     output = self._execute_active(module, data)
+                elif module.backend == "file":
+                    output = self._execute_file(module, data)
+                elif module.backend == "ssl":
+                    output = self._execute_ssl(module, data)
                 else:
                     output = self._execute_command(
                         module, scan_id, chunk_index, data
@@ -175,7 +182,6 @@ class JobProcessor:
             perf["output_bytes"] = len(output)
             perf.update(self._engine_perf_delta())
             update(JobStatus.COMPLETE, perf=perf)
-            self.jobs_done += 1
         else:
             update(JobStatus.UPLOAD_FAILED_UNKNOWN)
 
@@ -248,6 +254,63 @@ class JobProcessor:
             f"{stats.get('live_targets', 0)} live targets, {len(lines)} hits"
         )
         return ("\n".join(lines) + "\n").encode() if lines else b""
+
+    # ------------------------------------------------------------------
+    def _execute_file(self, module: ModuleSpec, data: bytes) -> bytes:
+        """File-template scanning: input chunk lines are file/directory
+        paths, matched against the corpus's ``file``-protocol templates
+        (worker/filescan.py) in one exact device batch."""
+        from swarm_tpu.fingerprints import load_corpus
+        from swarm_tpu.worker.filescan import FileScanner, format_findings
+
+        if not module.templates_dir:
+            raise ValueError(f"file module {module.name} missing 'templates'")
+        key = f"file::{module.templates_dir}"
+        scanner = self._engines.get(key)
+        if scanner is None:
+            templates, _errors = load_corpus(module.templates_dir)
+            scanner = FileScanner(templates)
+            self._engines[key] = scanner
+        findings, stats = scanner.scan_paths(
+            data.decode("utf-8", "surrogateescape").splitlines()
+        )
+        print(
+            f"file scan: {stats['files_scanned']} files x "
+            f"{stats['templates']} templates, {stats['hits']} hits"
+        )
+        return format_findings(findings)
+
+    # ------------------------------------------------------------------
+    def _execute_ssl(self, module: ModuleSpec, data: bytes) -> bytes:
+        """ssl-protocol template execution: version-pinned handshakes +
+        matchers over the session/cert document (worker/sslscan.py)."""
+        from swarm_tpu.fingerprints import load_corpus
+        from swarm_tpu.worker.sslscan import SslScanner, format_findings
+
+        if not module.templates_dir:
+            raise ValueError(f"ssl module {module.name} missing 'templates'")
+        probe = module.probe or {}
+        key = (
+            f"ssl::{module.templates_dir}::"
+            f"{json.dumps(probe, sort_keys=True)}"
+        )
+        scanner = self._engines.get(key)
+        if scanner is None:
+            templates, _errors = load_corpus(module.templates_dir)
+            scanner = SslScanner(
+                templates,
+                concurrency=int(probe.get("concurrency", 32)),
+                timeout=float(probe.get("connect_timeout_ms", 4000)) / 1000.0,
+            )
+            self._engines[key] = scanner
+        findings, stats = scanner.scan(
+            data.decode("utf-8", "surrogateescape").splitlines()
+        )
+        print(
+            f"ssl scan: {stats['targets']} targets x {stats['templates']} "
+            f"templates, {stats['hits']} hits"
+        )
+        return format_findings(findings)
 
     # ------------------------------------------------------------------
     def _execute_jarm(self, module: ModuleSpec, data: bytes) -> bytes:

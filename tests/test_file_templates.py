@@ -1,0 +1,163 @@
+"""File-template engine tests (nuclei ``file`` protocol).
+
+Reference behavior: the nuclei binary executes the 76 templates under
+``worker/artifacts/templates/file/`` and the standalone
+``worker/artifacts/s3-bucket.yaml`` over local files, gated by each
+entry's ``extensions`` list. Golden case per VERDICT: extracting S3
+URLs from a sample corpus via s3-bucket.yaml's regex extractors.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from swarm_tpu.fingerprints import load_corpus
+from swarm_tpu.fingerprints.nuclei import load_template_file
+from swarm_tpu.worker.filescan import FileScanner, format_findings
+from swarm_tpu.worker.modules import ModuleSpec
+
+REFERENCE_TEMPLATES = Path("/root/reference/worker/artifacts/templates")
+S3_TEMPLATE = Path("/root/reference/worker/artifacts/s3-bucket.yaml")
+
+
+INLINE_PERLISH = """\
+id: perlish-scanner
+info:
+  name: inline test scanner
+  severity: info
+file:
+  - extensions:
+      - pl
+      - pm
+    extractors:
+      - type: regex
+        regex:
+          - 'eval'
+          - 'syscall'
+"""
+
+INLINE_CONF_AUDIT = """\
+id: conf-audit
+info:
+  name: inline conf audit
+  severity: high
+file:
+  - extensions:
+      - conf
+    matchers-condition: and
+    matchers:
+      - type: word
+        words:
+          - "safety off"
+        negative: true
+      - type: word
+        words:
+          - "configure terminal"
+"""
+
+
+def _write(tmp_path: Path, name: str, content: str) -> Path:
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(content)
+    return p
+
+
+def test_extension_gating_extractor_only(tmp_path):
+    t = load_template_file(_write(tmp_path, "t/perlish.yaml", INLINE_PERLISH))
+    scanner = FileScanner([t])
+    assert scanner.engine is None  # extractor-only: no device DB needed
+    _write(tmp_path, "a.pl", "while(1) { eval $x; }\n")
+    _write(tmp_path, "b.txt", "eval eval eval\n")  # right bytes, wrong ext
+    _write(tmp_path, "c.pm", "nothing suspicious\n")
+    findings, stats = scanner.scan_paths([str(tmp_path)])
+    hits = {(f.template_id, Path(f.path).name) for f in findings}
+    assert ("perlish-scanner", "a.pl") in hits
+    assert all(name != "b.txt" for _, name in hits)
+    assert all(name != "c.pm" for _, name in hits)
+    [f] = [f for f in findings if Path(f.path).name == "a.pl"]
+    assert "eval" in f.extractions
+
+
+def test_matcher_template_negative_and_condition(tmp_path):
+    t = load_template_file(_write(tmp_path, "t/conf.yaml", INLINE_CONF_AUDIT))
+    scanner = FileScanner([t])
+    assert scanner.engine is not None
+    # fires: has the required word, lacks the negative word, right ext
+    _write(tmp_path, "router.conf", "interface g0\nconfigure terminal\n")
+    # suppressed by the negative matcher
+    _write(tmp_path, "safe.conf", "configure terminal\nsafety off\n")
+    # wrong extension: same bytes must not fire
+    _write(tmp_path, "router.txt", "configure terminal\n")
+    findings, _ = scanner.scan_paths([str(tmp_path)])
+    names = {Path(f.path).name for f in findings}
+    assert names == {"router.conf"}
+    [f] = findings
+    assert f.severity == "high"
+    out = format_findings(findings).decode()
+    assert "[conf-audit] [file] [high]" in out
+
+
+@pytest.mark.skipif(not S3_TEMPLATE.is_file(), reason="reference corpus absent")
+def test_s3_bucket_golden_extraction(tmp_path):
+    """VERDICT #5's golden test: S3 URLs extracted from a sample corpus."""
+    t = load_template_file(S3_TEMPLATE)
+    assert t.protocol == "file"
+    scanner = FileScanner([t])
+    _write(
+        tmp_path,
+        "app.js",
+        'fetch("https://prod-assets.s3.amazonaws.com/logo.png");\n'
+        'const backup = "//s3.amazonaws.com/backup-bucket-2024";\n',
+    )
+    _write(tmp_path, "clean.js", "console.log('nothing to see');\n")
+    findings, _ = scanner.scan_paths([str(tmp_path)])
+    assert [Path(f.path).name for f in findings] == ["app.js"]
+    [f] = findings
+    assert f.template_id == "s3-bucket"
+    assert "prod-assets.s3.amazonaws.com" in f.extractions
+    assert "//s3.amazonaws.com/backup-bucket-2024" in f.extractions
+
+
+@pytest.mark.skipif(
+    not REFERENCE_TEMPLATES.is_dir(), reason="reference corpus absent"
+)
+def test_full_file_corpus_covered(tmp_path):
+    """Every reference file template is executable: matcher-bearing ones
+    compile into the device DB, the rest run as extractor-only."""
+    templates, errors = load_corpus(REFERENCE_TEMPLATES / "file")
+    assert not errors
+    scanner = FileScanner(templates)
+    assert len(scanner.templates) == len(templates)
+    covered = {t.id for t in scanner.matcher_templates} | {
+        t.id for t in scanner.extractor_only
+    }
+    assert covered == {t.id for t in templates}
+    # cisco audit behavior against the real corpus: a config missing the
+    # hardening line fires disable-ip-source-route; extension-gated.
+    _write(
+        tmp_path,
+        "switch.conf",
+        "configure terminal\nip source-route\nend\n",
+    )
+    findings, stats = scanner.scan_paths([str(tmp_path)])
+    assert stats["files_scanned"] == 1
+    assert "disable-ip-source-route" in {f.template_id for f in findings}
+
+
+def test_runtime_file_backend(tmp_path):
+    from swarm_tpu.config import Config
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    tdir = tmp_path / "templates"
+    _write(tmp_path, "templates/perlish.yaml", INLINE_PERLISH)
+    _write(tmp_path, "scanme/x.pl", "open F; eval $y\n")
+    cfg = Config.load(server_url="http://127.0.0.1:1", api_key="k", worker_id="w")
+    proc = JobProcessor(cfg, client=object(), work_dir=str(tmp_path / "wd"))
+    module = ModuleSpec(
+        "file", {"backend": "file", "templates": str(tdir)}
+    )
+    data = f"{tmp_path / 'scanme'}\n".encode()
+    out = proc._execute_file(module, data).decode()
+    assert "[perlish-scanner] [file] [info]" in out
+    assert "x.pl" in out

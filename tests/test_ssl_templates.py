@@ -1,0 +1,173 @@
+"""ssl-protocol template tests (nuclei ``ssl`` templates).
+
+Runs the 5 reference ssl templates (worker/artifacts/templates/ssl/)
+against a local TLS server with generated certificates: a self-signed
+valid cert must fire self-signed-ssl / tls-version / ssl-dns-names but
+not expired-ssl; an expired cert must fire expired-ssl; deprecated-tls
+must stay quiet against a modern-only server.
+"""
+
+import datetime
+import socket
+import ssl
+import threading
+from pathlib import Path
+
+import pytest
+
+from swarm_tpu.fingerprints import load_corpus
+from swarm_tpu.worker.sslscan import SslScanner, _parse_target, handshake
+
+REFERENCE_SSL = Path("/root/reference/worker/artifacts/templates/ssl")
+
+
+def _make_cert(tmp_path, cn="selfie.test", san=("selfie.test", "alt.test"),
+               expired=False):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    if expired:
+        not_before, not_after = now - datetime.timedelta(days=730), now - datetime.timedelta(days=365)
+    else:
+        not_before, not_after = now - datetime.timedelta(days=1), now + datetime.timedelta(days=365)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)  # self-signed
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(not_before)
+        .not_valid_after(not_after)
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName(d) for d in san]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_pem = tmp_path / "cert.pem"
+    key_pem = tmp_path / "key.pem"
+    cert_pem.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_pem.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return cert_pem, key_pem
+
+
+def _tls_server(cert_pem, key_pem):
+    """Accept-loop TLS server on an ephemeral port; returns (port, stop)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(str(cert_pem), str(key_pem))
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(16)
+    lsock.settimeout(0.2)
+    port = lsock.getsockname()[1]
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                tls = ctx.wrap_socket(conn, server_side=True)
+                tls.close()
+            except (ssl.SSLError, OSError):
+                conn.close()
+        lsock.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return port, stop
+
+
+@pytest.fixture
+def tls_port(tmp_path):
+    cert, key = _make_cert(tmp_path)
+    port, stop = _tls_server(cert, key)
+    yield port
+    stop.set()
+
+
+def test_parse_target():
+    assert _parse_target("example.com") == ("example.com", 443)
+    assert _parse_target("example.com:8443") == ("example.com", 8443)
+    assert _parse_target("https://example.com/x") == ("example.com", 443)
+    assert _parse_target("  # comment") is None
+    assert _parse_target("[2001:db8::1]") == ("2001:db8::1", 443)
+    assert _parse_target("[2001:db8::1]:8443") == ("2001:db8::1", 8443)
+    assert _parse_target("::1") == ("::1", 443)
+
+
+def test_handshake_doc(tls_port):
+    doc = handshake("127.0.0.1", tls_port, timeout=5.0)
+    assert doc is not None
+    assert doc["tls_version"] in ("tls12", "tls13")
+    assert doc["common_name"] == ["selfie.test"]
+    assert doc["issuer_common_name"] == ["selfie.test"]
+    assert set(doc["dns_names"]) == {"selfie.test", "alt.test"}
+    assert doc["not_after"] > doc["not_before"]
+    assert doc["self_signed"] is True
+
+
+@pytest.mark.skipif(not REFERENCE_SSL.is_dir(), reason="reference corpus absent")
+def test_reference_ssl_templates_selfsigned_valid(tls_port):
+    templates, errors = load_corpus(REFERENCE_SSL)
+    assert not errors and len(templates) == 5
+    scanner = SslScanner(templates, concurrency=4, timeout=5.0)
+    findings, stats = scanner.scan([f"127.0.0.1:{tls_port}"])
+    by_id = {}
+    for f in findings:
+        by_id.setdefault(f.template_id, []).append(f)
+    assert "self-signed-ssl" in by_id  # CN == issuer CN
+    assert "tls-version" in by_id
+    assert by_id["tls-version"][0].extractions[0] in ("tls12", "tls13")
+    assert "ssl-dns-names" in by_id
+    assert set(by_id["ssl-dns-names"][0].extractions) >= {"selfie.test", "alt.test"}
+    assert "expired-ssl" not in by_id  # cert is valid
+    # modern-only local server: the sslv3/tls10/tls11-pinned handshakes
+    # must all fail, so deprecated-tls stays quiet
+    assert "deprecated-tls" not in by_id
+
+
+@pytest.mark.skipif(not REFERENCE_SSL.is_dir(), reason="reference corpus absent")
+def test_reference_expired_ssl(tmp_path):
+    cert, key = _make_cert(tmp_path, expired=True)
+    port, stop = _tls_server(cert, key)
+    try:
+        templates, _ = load_corpus(REFERENCE_SSL)
+        scanner = SslScanner(templates, concurrency=4, timeout=5.0)
+        findings, _ = scanner.scan([f"127.0.0.1:{port}"])
+        ids = {f.template_id for f in findings}
+        assert "expired-ssl" in ids  # unixtime() > not_after
+    finally:
+        stop.set()
+
+
+def test_runtime_ssl_backend(tls_port, tmp_path):
+    from swarm_tpu.config import Config
+    from swarm_tpu.worker.modules import ModuleSpec
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    if not REFERENCE_SSL.is_dir():
+        pytest.skip("reference corpus absent")
+    cfg = Config.load(server_url="http://127.0.0.1:1", api_key="k", worker_id="w")
+    proc = JobProcessor(cfg, client=object(), work_dir=str(tmp_path / "wd"))
+    module = ModuleSpec(
+        "ssl", {"backend": "ssl", "templates": str(REFERENCE_SSL)}
+    )
+    out = proc._execute_ssl(module, f"127.0.0.1:{tls_port}\n".encode()).decode()
+    assert "[self-signed-ssl] [ssl] [low] 127.0.0.1" in out
