@@ -244,3 +244,117 @@ def test_top_ports_default():
     ports = top_ports()
     assert 22 in ports and 443 in ports and len(ports) >= 80
     assert top_ports(5) == ports[:5]
+
+
+# ---------------------------------------------------------------------------
+# Production-scale DB: the reference's nmap module ran -sV over the real
+# nmap-service-probes (thousands of match directives). The bundled DB is
+# hundreds of directives; this generated DB proves the pipeline at full
+# scale — parse -> classifier compile (documented time) -> device
+# prefilter -> exact first-match-wins classification.
+# ---------------------------------------------------------------------------
+
+N_SCALE_PROBES = 520
+
+
+def _scale_db(n: int = N_SCALE_PROBES) -> str:
+    lines = []
+    for i in range(n):
+        lines += [
+            "##############################NEXT PROBE#####################",
+            f"Probe TCP P{i} q|Q{i}\\r\\n|",
+            "totalwaitms 4000",
+            f"rarity {1 + i % 9}",
+            f"ports {1000 + i}",
+            f"match svc{i}a m|^BANNER-{i}-ALPHA ([\\d.]+)| p/Prod{i}A/ v/$1/",
+            f"match svc{i}b m|^BANNER-{i}-BETA/([\\w.]+)| p/Prod{i}B/ v/$1/",
+            f"match svc{i}c m|SIG-{i}-GAMMA| p/Prod{i}C/",
+            f"match svc{i}d m|^DELTA-{i}:(\\d+)$| p/Prod{i}D/ v/$1/",
+            f"softmatch svc{i} m|^BANNER-{i}-|",
+        ]
+    return "\n".join(lines) + "\n"
+
+
+def test_scale_db_parse_and_classify():
+    import time
+
+    probes, skipped = parse_probes(_scale_db())
+    assert len(probes) == N_SCALE_PROBES
+    n_matches = sum(len(p.matches) for p in probes)
+    assert n_matches == N_SCALE_PROBES * 5 and skipped == 0
+
+    t0 = time.monotonic()
+    clf = ServiceClassifier(probes=probes)
+    compile_s = time.monotonic() - t0
+    # the device prefilter must carry the DB: every directive above has
+    # a required literal, so none may fall into the host-always tail
+    db = clf.engine.db
+    assert db.num_templates == n_matches
+    assert len(db.host_always) == 0, [t.id for t in db.host_always[:5]]
+    print(
+        f"\nscale DB: {len(probes)} probes / {n_matches} directives, "
+        f"classifier compile {compile_s:.1f}s"
+    )
+
+    from swarm_tpu.fingerprints.model import Response
+
+    rows, expected = [], []
+    for k in (0, 7, 123, 400, N_SCALE_PROBES - 1):
+        rows.append(Response(host="h", port=1000 + k,
+                             banner=f"BANNER-{k}-ALPHA 2.{k}.1\r\n".encode()))
+        expected.append((f"svc{k}a", f"Prod{k}A", f"2.{k}.1"))
+        rows.append(Response(host="h", port=1000 + k,
+                             banner=f"prefix SIG-{k}-GAMMA suffix".encode()))
+        expected.append((f"svc{k}c", f"Prod{k}C", None))
+        rows.append(Response(host="h", port=1000 + k,
+                             banner=f"BANNER-{k}-UNKNOWNTAIL".encode()))
+        expected.append((f"svc{k}", None, None))  # softmatch only
+    rows.append(Response(host="h", port=9, banner=b"no service here at all"))
+    expected.append((None, None, None))
+
+    t0 = time.monotonic()
+    infos = clf.classify(rows)
+    first_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    infos = clf.classify(rows)
+    steady_s = time.monotonic() - t0
+    print(f"scale classify: first {first_s:.1f}s, steady {steady_s*1e3:.0f}ms")
+    for info, (svc, prod, ver) in zip(infos, expected):
+        assert info.service == svc, (info, svc)
+        assert info.product == prod, (info, prod)
+        assert info.version == ver, (info, ver)
+        if svc and not prod:
+            assert info.soft  # softmatch-only rows are marked soft
+
+
+def test_bundled_db_scale_and_split():
+    """The shipped DB meets the production contract: hundreds of match
+    directives, nothing skipped, and the device prefilter carries all
+    but a bounded tail."""
+    from swarm_tpu.fingerprints.nmap_probes import BUNDLED_DB
+
+    probes, skipped = load_probes(BUNDLED_DB)
+    n_matches = sum(len(p.matches) for p in probes)
+    assert skipped == 0
+    assert len(probes) >= 20
+    assert n_matches >= 290
+    clf = ServiceClassifier(probes=probes)
+    db = clf.engine.db
+    # regression fence for the device/host split: binary-payload regexes
+    # without extractable literals may host-confirm, but the bulk must
+    # stay device-resident
+    assert len(db.host_always) <= n_matches * 0.05, (
+        len(db.host_always), n_matches)
+
+
+def test_top_ports_full_contract():
+    """The reference contract is --top-ports 1000 (worker/modules/
+    nmap.json); the shipped list must carry exactly 1000 unique ports
+    with the high-value head first."""
+    from swarm_tpu.worker.executor import top_ports
+
+    ports = top_ports()
+    assert len(ports) == 1000
+    assert len(set(ports)) == 1000
+    assert set(ports[:10]) >= {80, 443, 22, 21}
+    assert all(0 < p < 65536 for p in ports)
