@@ -1,0 +1,73 @@
+"""Multi-host initialization: DCN process group for multi-slice runs.
+
+The reference scales out by adding droplets that poll over HTTP
+(``server/server.py:47-162``) — control plane over commodity DCN. The
+TPU analog keeps that HTTP control plane untouched and adds, for a
+worker that spans multiple TPU hosts, the JAX distributed runtime:
+``jax.distributed.initialize`` connects the hosts so one
+``jax.sharding.Mesh`` can span every chip in the slice, with XLA
+placing collectives on ICI within a host/slice and DCN across hosts.
+
+Opt-in via environment (nothing happens on single-host workers):
+
+    SWARM_COORDINATOR=host:port   the rank-0 worker's address
+    SWARM_NUM_PROCESSES=N         total participating worker processes
+    SWARM_PROCESS_ID=K            this worker's rank (0-based)
+
+The standard JAX cluster-autodetect environments (GKE/Cloud TPU pod
+metadata) also work — when the SWARM_* triplet is absent but
+``jax.distributed`` can autodetect, pass ``autodetect=True``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional
+
+
+def maybe_initialize_distributed(
+    env: Optional[Mapping[str, str]] = None,
+    autodetect: bool = False,
+) -> bool:
+    """Initialize the JAX multi-host runtime when configured.
+
+    Returns True when ``jax.distributed.initialize`` was called (so
+    ``jax.devices()`` now spans all hosts), False when running
+    single-host. Safe to call more than once — a second call with the
+    runtime already up is a no-op returning True.
+    """
+    env = os.environ if env is None else env
+    coord = env.get("SWARM_COORDINATOR", "")
+    nproc = env.get("SWARM_NUM_PROCESSES", "")
+    pid = env.get("SWARM_PROCESS_ID", "")
+
+    import jax
+
+    state = getattr(jax._src.distributed, "global_state", None)
+    if state is not None and getattr(state, "client", None) is not None:
+        return True  # already initialized
+
+    configured = [bool(coord), bool(nproc), bool(pid)]
+    if any(configured) and not all(configured):
+        # a partial triplet silently running single-host would leave the
+        # other hosts blocked at the coordinator barrier — fail loudly
+        raise ValueError(
+            "multi-host config incomplete: SWARM_COORDINATOR, "
+            "SWARM_NUM_PROCESSES and SWARM_PROCESS_ID must all be set "
+            f"(got coordinator={coord!r}, num_processes={nproc!r}, "
+            f"process_id={pid!r})"
+        )
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nproc),
+            process_id=int(pid),
+        )
+        return True
+    if autodetect:
+        try:
+            jax.distributed.initialize()
+            return True
+        except Exception:
+            return False
+    return False

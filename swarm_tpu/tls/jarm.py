@@ -14,9 +14,12 @@ probe *shapes* (forward/reverse/top-half/bottom-half/middle-out cipher
 orders, 1.1/1.2/1.3 versions, no-overlap probe) and the same
 30+32 output split; the byte-level encoding tables are this module's
 own, so hashes are self-consistent within the framework rather than
-comparable to upstream JARM strings. JA3S is the standard algorithm
-(md5 of "version,cipher,ext-list" in decimals) and matches any
-compliant implementation.
+comparable to upstream JARM strings. The output field is therefore
+named ``jarmx`` (JARM-style, not upstream-comparable) — clustering and
+intra-framework comparison are first-class, feeding public JARM intel
+lists is explicitly not. JA3S is the standard algorithm (md5 of
+"version,cipher,ext-list" in decimals) and matches any compliant
+implementation.
 
 Fingerprints feed the density-peaks clustering kernel
 (swarm_tpu/ops/cluster.py) — BASELINE.json config #5.
@@ -158,7 +161,7 @@ def ja3s(hello: wire.ServerHello) -> str:
 class TlsFingerprint:
     host: str
     port: int
-    jarm: str
+    jarmx: str  # JARM-style but NOT upstream-comparable (own tables)
     ja3s: str  # from the first successful probe
     alive: bool  # at least one probe produced a ServerHello
     open: bool = False  # TCP port accepted a connection
@@ -166,7 +169,7 @@ class TlsFingerprint:
     def line(self) -> str:
         if self.alive:
             return (
-                f"{self.host}:{self.port} jarm={self.jarm} ja3s={self.ja3s or '-'}"
+                f"{self.host}:{self.port} jarmx={self.jarmx} ja3s={self.ja3s or '-'}"
             )
         # the port-open fact from the socket layer survives even when no
         # probe elicited TLS — an open non-TLS service is not "dead"
@@ -183,7 +186,7 @@ def fingerprint_from_banners(
     return TlsFingerprint(
         host=host,
         port=port,
-        jarm=jh,
+        jarmx=jh,
         ja3s=ja3s(first_ok) if first_ok else "",
         alive=jh != EMPTY_JARM,
         open=open_,

@@ -524,6 +524,28 @@ class ActiveHit:
     tls: bool = False  # how the hit's request was actually probed
 
 
+def _uses_oob(t: Template) -> bool:
+    """True when the template references out-of-band interaction
+    (interactsh) anywhere — matcher parts, dsl expressions, or request
+    text embedding ``{{interactsh-url}}``. Such templates cannot fully
+    evaluate without an interaction callback server (scope-excluded;
+    SURVEY §2.3: 144 interactsh matchers), and scan output must say so
+    rather than silently not matching."""
+    for op in t.operations:
+        for m in op.matchers:
+            if (m.part or "").startswith("interactsh"):
+                return True
+            if any("interactsh" in e for e in m.dsl):
+                return True
+        texts = list(op.paths) + list(op.raw) + [op.body or ""]
+        texts += [v for _k, v in op.headers]
+        texts += [str(v) for v in op.payloads.values()]
+        for text in texts:
+            if "interactsh" in text:
+                return True
+    return False
+
+
 class ActiveScanner:
     """(targets × planned requests) → device-matched, request-attributed
     template hits. ``engine`` is a MatchEngine over the same corpus the
@@ -532,6 +554,12 @@ class ActiveScanner:
     def __init__(self, engine, probe_spec: Optional[dict] = None):
         self.engine = engine
         self.plan = build_plan(engine.templates)
+        # honest scope marker: these ids are emitted as oob-skipped in
+        # scan output (runtime._execute_active) so "didn't match" and
+        # "can't match without OOB" stay distinguishable in /raw
+        self.oob_limited = sorted(
+            t.id for t in engine.templates if _uses_oob(t)
+        )
         self.executor = ProbeExecutor(probe_spec)
         spec = self.executor.spec
         self.wave_rows = int(spec.get("wave_rows", 16384))
@@ -574,6 +602,7 @@ class ActiveScanner:
             "skipped_templates": {
                 k: len(v) for k, v in self.plan.skipped.items()
             },
+            "oob_limited": len(self.oob_limited),
         }
         plan_has_work = (
             self.plan.requests or self.plan.net_requests or self.plan.dns_qtypes

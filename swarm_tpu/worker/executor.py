@@ -441,11 +441,11 @@ class ProbeExecutor:
                     )
                 )
         fps.extend(
-            TlsFingerprint(host=h, port=p, jarm=EMPTY_JARM, ja3s="", alive=False)
+            TlsFingerprint(host=h, port=p, jarmx=EMPTY_JARM, ja3s="", alive=False)
             for h, p in dead
         )
         fps.extend(
-            TlsFingerprint(host=m, port=0, jarm=EMPTY_JARM, ja3s="", alive=False)
+            TlsFingerprint(host=m, port=0, jarmx=EMPTY_JARM, ja3s="", alive=False)
             for m in malformed
         )
         return fps

@@ -58,7 +58,14 @@ class FileScanner:
         max_file_size: int = DEFAULT_MAX_FILE_SIZE,
         max_files: int = DEFAULT_MAX_FILES,
         engine=None,
+        scan_root: Optional[str] = None,
     ):
+        # optional confinement: when set, input paths outside this root
+        # are ignored — job chunks come over the wire, and a scan job
+        # must not be able to read arbitrary worker files
+        self.scan_root = (
+            Path(scan_root).resolve() if scan_root else None
+        )
         file_templates = [t for t in templates if t.protocol == "file"]
         self.templates = file_templates
         self.matcher_templates = [
@@ -110,15 +117,30 @@ class FileScanner:
             # pathlib only swallows ENOENT-class errors; EACCES (e.g. an
             # unreadable /proc symlink) would abort the whole walk
             try:
-                return q.is_file()
+                if not q.is_file():
+                    return False
             except OSError:
                 return False
+            if self.scan_root is not None:
+                # confinement holds for every candidate, not just the
+                # input path: a symlink inside the root must not reach
+                # files outside it
+                try:
+                    q.resolve().relative_to(self.scan_root)
+                except (ValueError, OSError):
+                    return False
+            return True
 
         for raw in paths:
             raw = raw.strip()
             if not raw or raw.startswith("#"):
                 continue  # blank line would be Path('.') — scan nothing
             p = Path(raw)
+            if self.scan_root is not None:
+                try:
+                    p.resolve().relative_to(self.scan_root)
+                except (ValueError, OSError):
+                    continue  # outside the confinement root
             try:
                 candidates = (
                     sorted(q for q in p.rglob("*") if is_file(q))

@@ -231,9 +231,8 @@ class JobProcessor:
         if scanner is None:
             scanner = ActiveScanner(engine, module.probe)
             self._engines[key] = scanner
-        hits, stats = scanner.run(
-            data.decode("utf-8", "surrogateescape").splitlines()
-        )
+        target_lines = data.decode("utf-8", "surrogateescape").splitlines()
+        hits, stats = scanner.run(target_lines)
         sev, proto = formats.severity_index(engine.templates)
         lines = []
         for h in hits:
@@ -249,10 +248,40 @@ class JobProcessor:
                 f"[{h.template_id}] [{p}] [{sev.get(h.template_id, 'info')}] "
                 f"{target}{extra}"
             )
+        # nuclei parity: a host scan also executes the corpus's
+        # ssl-protocol templates (nuclei runs them alongside http/
+        # network/dns; the active planner itself skips them)
+        ssl_templates = [
+            t for t in engine.templates if t.protocol == "ssl"
+        ]
+        if ssl_templates:
+            from swarm_tpu.worker import sslscan
+
+            ssl_key = f"activessl::{module.templates_dir}::{probe_key}"
+            ssl_scanner = self._engines.get(ssl_key)
+            if ssl_scanner is None:
+                probe = module.probe or {}
+                ssl_scanner = sslscan.SslScanner(
+                    ssl_templates,
+                    concurrency=int(probe.get("concurrency", 32)),
+                    timeout=float(probe.get("connect_timeout_ms", 4000))
+                    / 1000.0,
+                )
+                self._engines[ssl_key] = ssl_scanner
+            ssl_findings, _ssl_stats = ssl_scanner.scan(target_lines)
+            lines.extend(sslscan.format_lines(ssl_findings))
         print(
             f"active scan: {stats['rows_probed']} requests over "
             f"{stats.get('live_targets', 0)} live targets, {len(lines)} hits"
         )
+        # scope honesty: templates referencing interactsh can never fire
+        # without an interaction server — mark them so /raw output
+        # distinguishes "didn't match" from "can't match without OOB"
+        for tid in scanner.oob_limited:
+            lines.append(
+                f"# [{tid}] [oob-skipped] requires out-of-band "
+                "interaction server (interactsh); not evaluated"
+            )
         return ("\n".join(lines) + "\n").encode() if lines else b""
 
     # ------------------------------------------------------------------
@@ -265,11 +294,12 @@ class JobProcessor:
 
         if not module.templates_dir:
             raise ValueError(f"file module {module.name} missing 'templates'")
-        key = f"file::{module.templates_dir}"
+        scan_root = module.raw.get("scan_root") or None
+        key = f"file::{module.templates_dir}::{scan_root}"
         scanner = self._engines.get(key)
         if scanner is None:
             templates, _errors = load_corpus(module.templates_dir)
-            scanner = FileScanner(templates)
+            scanner = FileScanner(templates, scan_root=scan_root)
             self._engines[key] = scanner
         findings, stats = scanner.scan_paths(
             data.decode("utf-8", "surrogateescape").splitlines()
@@ -329,7 +359,7 @@ class JobProcessor:
         sizes: dict[int, int] = {}
         if alive:
             radius = float(module.raw.get("cluster_radius", 32.0))
-            packed = cl.pack_strings([fp.jarm for fp in alive])
+            packed = cl.pack_strings([fp.jarmx for fp in alive])
             labels, _rho = cl.density_cluster(packed, radius)
             lab = [int(x) for x in labels]
             for label in lab:
@@ -484,6 +514,13 @@ def main(argv: Optional[list[str]] = None) -> None:
         modules_dir=args.modules_dir,
         max_jobs=args.max_jobs,
     )
+    # multi-host worker: join the DCN process group when configured
+    # (SWARM_COORDINATOR/-NUM_PROCESSES/-PROCESS_ID) so the tpu
+    # backend's mesh spans every host's chips; no-op single-host
+    from swarm_tpu.parallel.multihost import maybe_initialize_distributed
+
+    if maybe_initialize_distributed():
+        print("multi-host: jax.distributed initialized")
     JobProcessor(cfg).process_jobs()
 
 

@@ -294,7 +294,7 @@ class SslScanner:
         return findings, stats
 
 
-def format_findings(findings: Sequence[SslFinding]) -> bytes:
+def format_lines(findings: Sequence[SslFinding]) -> list[str]:
     lines = []
     for h in findings:
         extra = (
@@ -305,4 +305,9 @@ def format_findings(findings: Sequence[SslFinding]) -> bytes:
         lines.append(
             f"[{h.template_id}] [ssl] [{h.severity}] {h.host}:{h.port}{extra}"
         )
+    return lines
+
+
+def format_findings(findings: Sequence[SslFinding]) -> bytes:
+    lines = format_lines(findings)
     return ("\n".join(lines) + "\n").encode() if lines else b""

@@ -161,3 +161,29 @@ def test_runtime_file_backend(tmp_path):
     out = proc._execute_file(module, data).decode()
     assert "[perlish-scanner] [file] [info]" in out
     assert "x.pl" in out
+
+
+def test_scan_root_confinement(tmp_path):
+    t = load_template_file(_write(tmp_path, "t/perlish.yaml", INLINE_PERLISH))
+    inside = tmp_path / "allowed"
+    _write(tmp_path, "allowed/a.pl", "eval $x\n")
+    _write(tmp_path, "outside.pl", "eval $x\n")
+    scanner = FileScanner([t], scan_root=str(inside))
+    findings, _ = scanner.scan_paths(
+        [str(inside), str(tmp_path / "outside.pl")]
+    )
+    names = {Path(f.path).name for f in findings}
+    assert names == {"a.pl"}  # path outside the root is ignored
+
+
+def test_scan_root_confinement_blocks_symlinks(tmp_path):
+    t = load_template_file(_write(tmp_path, "t/perlish.yaml", INLINE_PERLISH))
+    inside = tmp_path / "allowed"
+    inside.mkdir()
+    secret = _write(tmp_path, "secret/creds.pl", "eval $x\n")
+    (inside / "link.pl").symlink_to(secret)
+    (inside / "dirlink").symlink_to(tmp_path / "secret")
+    scanner = FileScanner([t], scan_root=str(inside))
+    findings, stats = scanner.scan_paths([str(inside)])
+    assert findings == []  # symlinked escapes are refused
+    assert stats["files_scanned"] == 0

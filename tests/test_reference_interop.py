@@ -1,0 +1,224 @@
+"""Wire-compatibility proven against the ACTUAL reference programs.
+
+Runs the unmodified reference client (``/root/reference/client/swarm``)
+and reference worker (``/root/reference/worker/worker.py``) as
+subprocesses against this framework's server: client submits a scan,
+the reference worker pulls the job, shells out the module command, and
+pushes results through the reference's S3 layout; the client then
+``cat``s the merged output. prettytable and boto3 are not installed in
+this image, so minimal stubs are injected via PYTHONPATH — boto3's stub
+maps bucket keys onto the server's local blob root (identical
+``{scan_id}/input|output/chunk_N.txt`` layout), standing in for a
+shared S3 bucket.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+import requests
+
+from swarm_tpu.config import Config
+from swarm_tpu.server.app import SwarmServer
+
+REF_CLIENT = Path("/root/reference/client/swarm")
+REF_WORKER = Path("/root/reference/worker/worker.py")
+
+pytestmark = pytest.mark.skipif(
+    not (REF_CLIENT.is_file() and REF_WORKER.is_file()),
+    reason="reference programs absent",
+)
+
+PRETTYTABLE_STUB = """\
+class PrettyTable:
+    def __init__(self, field_names=None):
+        self.field_names = list(field_names or [])
+        self._rows = []
+    def add_row(self, row):
+        self._rows.append(list(row))
+    def __str__(self):
+        return "\\n".join(
+            [" | ".join(map(str, self.field_names))]
+            + [" | ".join(map(str, r)) for r in self._rows]
+        )
+"""
+
+BOTO3_STUB = """\
+import os, shutil
+
+class _FakeS3:
+    def __init__(self):
+        self.root = os.environ["FAKE_S3_ROOT"]
+    def download_file(self, bucket, key, filename):
+        src = os.path.join(self.root, key)
+        if not os.path.isfile(src):
+            raise FileNotFoundError(src)
+        d = os.path.dirname(filename)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        shutil.copyfile(src, filename)
+    def upload_file(self, filename, bucket, key):
+        dst = os.path.join(self.root, key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copyfile(filename, dst)
+
+def client(name, **kwargs):
+    assert name == "s3", name
+    return _FakeS3()
+"""
+
+
+@pytest.fixture
+def interop(tmp_path):
+    blob_root = tmp_path / "blobs"
+    cfg = Config(
+        host="127.0.0.1",
+        port=0,
+        api_key="interopkey",
+        blob_root=str(blob_root),
+        doc_root=str(tmp_path / "docs"),
+        lease_seconds=30,
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+
+    # stub site dir for the reference programs' third-party imports
+    stubs = tmp_path / "stubs"
+    stubs.mkdir()
+    (stubs / "prettytable.py").write_text(PRETTYTABLE_STUB)
+    (stubs / "boto3.py").write_text(BOTO3_STUB)
+    bc = stubs / "botocore"
+    bc.mkdir()
+    (bc / "__init__.py").write_text("")
+    (bc / "exceptions.py").write_text(
+        "class NoCredentialsError(Exception):\n    pass\n"
+    )
+
+    # reference worker resolves modules/ and downloads/ relative to cwd
+    wcwd = tmp_path / "worker_cwd"
+    (wcwd / "modules").mkdir(parents=True)
+    (wcwd / "modules" / "echo.json").write_text(
+        json.dumps({"command": "cp {input} {output}"})
+    )
+
+    env = dict(
+        os.environ,
+        PYTHONPATH=str(stubs),
+        FAKE_S3_ROOT=str(blob_root),
+        HOME=str(tmp_path),  # hermetic: no ~/.axiom.json pickup
+    )
+    base = f"http://127.0.0.1:{srv.port}"
+
+    class Ctx:
+        pass
+
+    ctx = Ctx()
+    ctx.base = base
+    ctx.env = env
+    ctx.wcwd = wcwd
+    ctx.tmp = tmp_path
+    ctx.headers = {"Authorization": "Bearer interopkey"}
+    yield ctx
+    srv.shutdown()
+
+
+def _run_client(ctx, *args, timeout=30):
+    return subprocess.run(
+        [sys.executable, str(REF_CLIENT), *args,
+         "--server-url", ctx.base, "--api-key", "interopkey"],
+        env=ctx.env, cwd=str(ctx.tmp),
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_reference_client_and_worker_full_cycle(interop):
+    ctx = interop
+    targets = ctx.tmp / "targets.txt"
+    targets.write_text("alpha.example\nbeta.example\ngamma.example\n")
+
+    # 1. reference client submits the scan (explicit batch size: the
+    # reference's auto mode crashes without --autoscale, SURVEY §2.1)
+    out = _run_client(
+        ctx, "scan", "--file", str(targets), "--module", "echo",
+        "--batch-size", "2",
+    )
+    assert out.returncode == 0, out.stderr
+    assert "Start Scan Status Code: 200" in out.stdout
+    assert "Job queued successfully" in out.stdout
+
+    # scan id is generated server-side: echo_<ts>
+    statuses = requests.get(
+        f"{ctx.base}/get-statuses", headers=ctx.headers, timeout=10
+    ).json()
+    scan_ids = {j["scan_id"] for j in statuses["jobs"].values()}
+    assert len(scan_ids) == 1
+    scan_id = scan_ids.pop()
+    assert scan_id.startswith("echo_")
+    assert len(statuses["jobs"]) == 2  # 3 targets / batch 2 -> 2 chunks
+
+    # 2. the unmodified reference worker processes both chunks (its
+    # --max-jobs is parsed but ignored — SURVEY known defect — so poll
+    # for completion and terminate it)
+    worker = subprocess.Popen(
+        [sys.executable, str(REF_WORKER),
+         "--server-url", ctx.base, "--api-key", "interopkey",
+         "--worker-id", "ref-worker-1",
+         "--aws-access-key", "x", "--aws-secret-key", "y"],
+        env=ctx.env, cwd=str(ctx.wcwd),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        done = False
+        while time.monotonic() < deadline:
+            st = requests.get(
+                f"{ctx.base}/get-statuses", headers=ctx.headers, timeout=10
+            ).json()
+            js = [j for j in st["jobs"].values() if j["scan_id"] == scan_id]
+            if js and all(j["status"] == "complete" for j in js):
+                done = True
+                break
+            time.sleep(0.5)
+        assert done, st
+        # the reference worker identity reached the server's rollup
+        assert "ref-worker-1" in st["workers"]
+    finally:
+        worker.terminate()
+        worker.wait(timeout=10)
+
+    # 3. reference client cats the merged raw results
+    out = _run_client(ctx, "cat", "--scan-id", scan_id)
+    assert out.returncode == 0, out.stderr
+    for t in ("alpha.example", "beta.example", "gamma.example"):
+        assert t in out.stdout
+
+
+def test_reference_client_status_views(interop):
+    """workers/scans/jobs render through the (stubbed) PrettyTable —
+    the payload shapes the reference's table code indexes must exist."""
+    ctx = interop
+    targets = ctx.tmp / "t2.txt"
+    targets.write_text("one.example\n")
+    out = _run_client(
+        ctx, "scan", "--file", str(targets), "--module", "echo",
+        "--batch-size", "1",
+    )
+    assert out.returncode == 0, out.stderr
+    for view in ("jobs", "scans", "workers"):
+        out = _run_client(ctx, view)
+        assert out.returncode == 0, (view, out.stderr)
+    # jobs view must show the queued job row
+    out = _run_client(ctx, "jobs")
+    assert "echo_" in out.stdout
+
+
+def test_reference_client_reset(interop):
+    ctx = interop
+    out = _run_client(ctx, "reset")
+    assert out.returncode == 0, out.stderr
+    assert "200" in out.stdout

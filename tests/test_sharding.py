@@ -120,3 +120,54 @@ def test_engine_explicit_mesh_shapes():
         for s, a in zip(base, got):
             assert sorted(s.template_ids) == sorted(a.template_ids)
             assert s.extractions == a.extractions
+
+
+# ---------------------------------------------------------------------------
+# Multi-host initialization hook (parallel/multihost.py)
+# ---------------------------------------------------------------------------
+
+
+def test_multihost_noop_without_env():
+    from swarm_tpu.parallel.multihost import maybe_initialize_distributed
+
+    assert maybe_initialize_distributed(env={}) is False
+
+
+def test_multihost_initializes_from_env(monkeypatch):
+    import jax
+
+    from swarm_tpu.parallel import multihost
+
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed,
+        "initialize",
+        lambda **kw: calls.append(kw),
+    )
+    ok = multihost.maybe_initialize_distributed(
+        env={
+            "SWARM_COORDINATOR": "10.0.0.1:8476",
+            "SWARM_NUM_PROCESSES": "4",
+            "SWARM_PROCESS_ID": "2",
+        }
+    )
+    assert ok is True
+    assert calls == [
+        {
+            "coordinator_address": "10.0.0.1:8476",
+            "num_processes": 4,
+            "process_id": 2,
+        }
+    ]
+
+
+def test_multihost_partial_config_fails_loudly():
+    import pytest as _pytest
+
+    from swarm_tpu.parallel import multihost
+
+    with _pytest.raises(ValueError, match="incomplete"):
+        multihost.maybe_initialize_distributed(
+            env={"SWARM_COORDINATOR": "10.0.0.1:8476",
+                 "SWARM_NUM_PROCESSES": "4"}
+        )

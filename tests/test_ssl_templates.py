@@ -171,3 +171,57 @@ def test_runtime_ssl_backend(tls_port, tmp_path):
     )
     out = proc._execute_ssl(module, f"127.0.0.1:{tls_port}\n".encode()).decode()
     assert "[self-signed-ssl] [ssl] [low] 127.0.0.1" in out
+
+
+def test_active_module_runs_ssl_templates(tls_port, tmp_path):
+    """nuclei parity: a host scan through the active backend executes
+    ssl-protocol templates alongside the http corpus."""
+    from swarm_tpu.config import Config
+    from swarm_tpu.worker.modules import ModuleSpec
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    tdir = tmp_path / "templates"
+    tdir.mkdir()
+    (tdir / "selfsigned.yaml").write_text(
+        "id: mini-self-signed\n"
+        "info:\n  severity: low\n"
+        "ssl:\n"
+        "  - address: \"{{Host}}:{{Port}}\"\n"
+        "    matchers:\n"
+        "      - type: dsl\n"
+        "        dsl:\n"
+        "          - \"common_name == issuer_common_name\"\n"
+        "    extractors:\n"
+        "      - type: json\n"
+        "        name: common_name\n"
+        "        internal: true\n"
+        "        json:\n"
+        "          - \".common_name[]\"\n"
+        "      - type: json\n"
+        "        name: issuer_common_name\n"
+        "        internal: true\n"
+        "        json:\n"
+        "          - \".issuer_common_name[]\"\n"
+    )
+    (tdir / "panel.yaml").write_text(
+        "id: mini-panel\n"
+        "info:\n  severity: info\n"
+        "requests:\n"
+        "  - method: GET\n"
+        "    path:\n"
+        "      - \"{{BaseURL}}/admin\"\n"
+        "    matchers:\n"
+        "      - type: word\n"
+        "        words: [\"never-matches-anything-here\"]\n"
+    )
+    cfg = Config.load(server_url="http://127.0.0.1:1", api_key="k", worker_id="w")
+    proc = JobProcessor(cfg, client=object(), work_dir=str(tmp_path / "wd"))
+    module = ModuleSpec(
+        "active",
+        {"backend": "active", "templates": str(tdir),
+         "probe": {"ports": [tls_port], "connect_timeout_ms": 2000,
+                   "read_timeout_ms": 2000}},
+    )
+    out = proc._execute_active(module, f"127.0.0.1:{tls_port}\n".encode()).decode()
+    assert f"[mini-self-signed] [ssl] [low] 127.0.0.1:{tls_port}" in out
+    assert "mini-panel" not in out  # http template didn't match

@@ -156,7 +156,7 @@ def test_fingerprint_from_banners_partial():
     banners = [ok if i % 2 == 0 else b"" for i in range(jarm.NUM_PROBES)]
     fp = jarm.fingerprint_from_banners("h", 443, banners)
     assert fp.alive and fp.ja3s
-    assert "000" in fp.jarm  # dead probes encode as 000
+    assert "000" in fp.jarmx  # dead probes encode as 000
 
 
 # ---------------------------------------------------------------------------
@@ -299,11 +299,11 @@ def test_jarm_against_real_openssl(tls_server):
     by_host = {fp.host: fp for fp in fps}
     fp = by_host["127.0.0.1"]
     assert fp.alive, "real TLS server did not yield a fingerprint"
-    assert fp.jarm != jarm.EMPTY_JARM and len(fp.jarm) == 62
+    assert fp.jarmx != jarm.EMPTY_JARM and len(fp.jarmx) == 62
     assert fp.ja3s  # at least one ServerHello parsed
     # stability: probing again reproduces the fingerprint
     fps2 = executor.run_jarm([f"127.0.0.1:{tls_server}"])
-    assert fps2[0].jarm == fp.jarm
+    assert fps2[0].jarmx == fp.jarmx
 
 
 def test_jarm_module_end_to_end(tls_server, tmp_path):
@@ -332,7 +332,7 @@ def test_jarm_module_end_to_end(tls_server, tmp_path):
         out = proc._execute_jarm(module, targets).decode()
         lines = out.strip().split("\n")
         assert len(lines) == 3
-        assert "jarm=" in lines[0] and "cluster=0" in lines[0]
+        assert "jarmx=" in lines[0] and "cluster=0" in lines[0]
         assert "cluster_size=1" in lines[0]
         assert "[dead]" in lines[1]  # connection refused
         assert "[open not-tls]" in lines[2]  # open port, no TLS behind it
