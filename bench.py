@@ -157,6 +157,10 @@ def resolve_device():
     if not ok:
         jax.config.update("jax_platforms", "cpu")
 
+    from swarm_tpu.utils.xlacache import enable_compilation_cache
+
+    enable_compilation_cache()
+
     # second line of defense: bound the wait, then fall back to ANY
     # available backend (auto-detect).
     def bail(_sig, _frm):

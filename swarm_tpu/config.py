@@ -54,6 +54,10 @@ class Config:
     poll_interval_busy_s: float = 0.8
     modules_dir: str = "modules"
     max_jobs: int = 0  # 0 = unlimited (the reference accepted but ignored this)
+    # comma-separated module names whose engines are built before the
+    # poll loop starts (with the persistent XLA cache, a prewarmed
+    # worker serves its first job at steady-state latency)
+    prewarm_modules: str = ""
 
     # --- dispatch leases (new vs reference: requeue-on-expiry) ---
     lease_seconds: float = 600.0

@@ -1,0 +1,54 @@
+"""Persistent XLA compilation cache.
+
+The full-corpus match kernel takes tens of seconds to compile (device
+word tables + q-gram prefilter + verify + regex lanes in one jit). The
+reference worker had no analogous cost — its engines were prebuilt
+binaries — so worker startup parity argues for caching: with JAX's
+persistent compilation cache enabled, every worker restart (and every
+fleet scale-up clone, server/fleet.py) after the first reuses the
+serialized executable instead of recompiling.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+DEFAULT_CACHE_DIR = "~/.cache/swarm_tpu/xla"
+_active_dir: Optional[str] = None
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
+    """Idempotently point JAX's persistent compilation cache at
+    ``cache_dir`` (default ``~/.cache/swarm_tpu/xla``, overridable via
+    ``SWARM_XLA_CACHE_DIR``; empty string disables). Returns the dir
+    actually in effect ('' when disabled) — once bound, later calls
+    with a different dir return the original binding. A cache dir that
+    cannot be created degrades to no-cache rather than failing startup
+    (the worker must run with a read-only HOME)."""
+    global _active_dir
+    if _active_dir is not None:
+        return _active_dir
+    raw = (
+        cache_dir
+        if cache_dir is not None
+        else os.environ.get("SWARM_XLA_CACHE_DIR", DEFAULT_CACHE_DIR)
+    )
+    if not raw:
+        return ""
+    path = Path(raw).expanduser()
+    try:
+        path.mkdir(parents=True, exist_ok=True)
+    except OSError as e:
+        print(f"xla cache disabled ({path}: {e})")
+        return ""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    # cache everything that took real compile time; tiny kernels
+    # aren't worth the disk round-trip
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _active_dir = str(path)
+    return _active_dir

@@ -114,6 +114,34 @@ def format_nuclei(
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+def format_nmap_report(infos: Sequence) -> str:
+    """nmap ``-oN``-shaped service report (the output consumers of the
+    reference's nmap module parse): a per-host report block with a
+    PORT/STATE/SERVICE/VERSION table over open ports."""
+    by_host: dict[str, list] = {}
+    for info in infos:
+        if info.open:
+            by_host.setdefault(info.host, []).append(info)
+    blocks = []
+    for host, ports in by_host.items():
+        lines = [
+            f"Nmap scan report for {host}",
+            "PORT      STATE SERVICE        VERSION",
+        ]
+        for info in sorted(ports, key=lambda x: x.port):
+            version = " ".join(
+                x for x in (info.product, info.version) if x
+            )
+            if info.info:
+                version = (version + f" ({info.info})").strip()
+            svc = (info.service or "unknown") + ("?" if info.soft else "")
+            lines.append(
+                f"{str(info.port) + '/tcp':<9} open  {svc:<14} {version}".rstrip()
+            )
+        blocks.append("\n".join(lines))
+    return "\n\n".join(blocks) + ("\n" if blocks else "")
+
+
 def severity_index(templates: Sequence[Template]) -> tuple[dict[str, str], dict[str, str]]:
     """(template_id → severity, template_id → protocol) lookup tables."""
     sev = {t.id: t.severity for t in templates}

@@ -92,6 +92,31 @@ class JobProcessor:
         self.jobs_done = 0
 
     # ------------------------------------------------------------------
+    def prewarm(self, module_name: str) -> bool:
+        """Build a module's engine/scanner before the first job: an
+        empty-input execution exercises exactly the construction path
+        (corpus load + device compile), so with the persistent XLA
+        cache the first real job runs at steady-state latency."""
+        try:
+            module = self.registry.load(module_name)
+            dispatch = {
+                "tpu": self._execute_tpu,
+                "probe": self._execute_probe,
+                "service": self._execute_service,
+                "jarm": self._execute_jarm,
+                "active": self._execute_active,
+                "file": self._execute_file,
+                "ssl": self._execute_ssl,
+            }.get(module.backend)
+            if dispatch is None:
+                return False  # command modules have nothing to warm
+            dispatch(module, b"")
+            return True
+        except Exception as e:
+            print(f"prewarm {module_name} failed: {e}")
+            return False
+
+    # ------------------------------------------------------------------
     def process_jobs(self) -> None:
         """The infinite poll loop (reference worker.py:113-126)."""
         while True:
@@ -153,7 +178,9 @@ class JobProcessor:
                 elif module.backend == "jarm":
                     output = self._execute_jarm(module, data)
                 elif module.backend == "active":
-                    output = self._execute_active(module, data)
+                    output = self._execute_active(
+                        module, data, chunk_index=chunk_index
+                    )
                 elif module.backend == "file":
                     output = self._execute_file(module, data)
                 elif module.backend == "ssl":
@@ -206,7 +233,9 @@ class JobProcessor:
         return out
 
     # ------------------------------------------------------------------
-    def _execute_active(self, module: ModuleSpec, data: bytes) -> bytes:
+    def _execute_active(
+        self, module: ModuleSpec, data: bytes, chunk_index: int = 0
+    ) -> bytes:
         """Active template-request scanning (nuclei's execution mode):
         each template's own requests are issued per target, responses
         device-matched, hits attributed per request (worker/active.py)."""
@@ -268,20 +297,38 @@ class JobProcessor:
                     / 1000.0,
                 )
                 self._engines[ssl_key] = ssl_scanner
-            ssl_findings, _ssl_stats = ssl_scanner.scan(target_lines)
+            # portless targets follow the module's port fan-out, so ssl
+            # templates evaluate on the same ports the http scan probes
+            probe_ports = [
+                int(p) for p in (module.probe or {}).get("ports", [443])
+            ] or [443]
+            ssl_findings, _ssl_stats = ssl_scanner.scan(
+                target_lines, default_ports=probe_ports
+            )
             lines.extend(sslscan.format_lines(ssl_findings))
         print(
             f"active scan: {stats['rows_probed']} requests over "
             f"{stats.get('live_targets', 0)} live targets, {len(lines)} hits"
         )
-        # scope honesty: templates referencing interactsh can never fire
-        # without an interaction server — mark them so /raw output
-        # distinguishes "didn't match" from "can't match without OOB"
-        for tid in scanner.oob_limited:
-            lines.append(
-                f"# [{tid}] [oob-skipped] requires out-of-band "
-                "interaction server (interactsh); not evaluated"
-            )
+        # Scope honesty, once per scan (chunk 0 only — these are
+        # per-scan facts; repeating them in every chunk would flood a
+        # sharded scan's merged /raw with duplicates):
+        if chunk_index == 0:
+            # interactsh-referencing templates cannot fully evaluate
+            # without an interaction server; their non-OOB requests (if
+            # any) still run, so the marker scopes itself to the OOB part
+            for tid in scanner.oob_limited:
+                lines.append(
+                    f"# [{tid}] [oob-skipped] interactsh-dependent "
+                    "checks not evaluated (no out-of-band interaction "
+                    "server)"
+                )
+            # headless templates need a browser engine — out of scope
+            for tid in scanner.plan.skipped.get("protocol-headless", []):
+                lines.append(
+                    f"# [{tid}] [headless-skipped] requires a browser "
+                    "engine; not evaluated"
+                )
         return ("\n".join(lines) + "\n").encode() if lines else b""
 
     # ------------------------------------------------------------------
@@ -491,6 +538,10 @@ class JobProcessor:
             data.decode("utf-8", "surrogateescape").splitlines(), classifier
         )
         infos = classifier.classify(rows, sent)
+        if module.output_format == "nmap":
+            from swarm_tpu.worker import formats
+
+            return formats.format_nmap_report(infos).encode()
         lines = [info.line() for info in infos if info.open]
         return ("\n".join(lines) + "\n").encode() if lines else b""
 
@@ -518,10 +569,16 @@ def main(argv: Optional[list[str]] = None) -> None:
     # (SWARM_COORDINATOR/-NUM_PROCESSES/-PROCESS_ID) so the tpu
     # backend's mesh spans every host's chips; no-op single-host
     from swarm_tpu.parallel.multihost import maybe_initialize_distributed
+    from swarm_tpu.utils.xlacache import enable_compilation_cache
 
+    enable_compilation_cache()  # warm restarts skip the corpus recompile
     if maybe_initialize_distributed():
         print("multi-host: jax.distributed initialized")
-    JobProcessor(cfg).process_jobs()
+    proc = JobProcessor(cfg)
+    for name in filter(None, (n.strip() for n in cfg.prewarm_modules.split(","))):
+        if proc.prewarm(name):
+            print(f"prewarmed module {name}")
+    proc.process_jobs()
 
 
 if __name__ == "__main__":

@@ -143,7 +143,9 @@ def handshake(
     return doc
 
 
-def _parse_target(line: str) -> Optional[tuple[str, int]]:
+def _parse_target(line: str) -> Optional[tuple[str, Optional[int]]]:
+    """→ (host, explicit_port_or_None); None for blanks/comments. The
+    caller applies its port default/fan-out to portless targets."""
     line = line.strip()
     if not line or line.startswith("#"):
         return None
@@ -157,17 +159,17 @@ def _parse_target(line: str) -> Optional[tuple[str, int]]:
             try:
                 return host, int(rest[1:])
             except ValueError:
-                return host, 443
-        return host, 443
+                return host, None
+        return host, None
     if line.count(":") > 1:
-        return line, 443  # bare IPv6 address, no port syntax possible
+        return line, None  # bare IPv6 address, no port syntax possible
     if ":" in line:
         host, _, p = line.rpartition(":")
         try:
             return host, int(p)
         except ValueError:
-            return line, 443
-    return line, 443
+            return line, None
+    return line, None
 
 
 class SslScanner:
@@ -272,14 +274,26 @@ class SslScanner:
                 )
         return findings
 
-    def scan(self, lines: Sequence[str]) -> tuple[list[SslFinding], dict]:
+    def scan(
+        self,
+        lines: Sequence[str],
+        default_ports: Optional[Sequence[int]] = None,
+    ) -> tuple[list[SslFinding], dict]:
+        """``default_ports`` applies to portless target lines (the
+        active module passes its probe ports so ssl templates follow
+        the scan's port fan-out instead of assuming 443)."""
+        defaults = list(dict.fromkeys(int(p) for p in default_ports or [443]))
         targets = []
         seen = set()
         for line in lines:
             t = _parse_target(line)
-            if t and t not in seen:
-                seen.add(t)
-                targets.append(t)
+            if t is None:
+                continue
+            host, port = t
+            for p in [port] if port is not None else defaults:
+                if (host, p) not in seen:
+                    seen.add((host, p))
+                    targets.append((host, p))
         findings: list[SslFinding] = []
         with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
             for result in pool.map(

@@ -445,10 +445,20 @@ def test_oob_marker_in_scan_output(tmp_path):
         {"backend": "active", "templates": str(tdir),
          "probe": {"connect_timeout_ms": 200, "read_timeout_ms": 200}},
     )
-    # no live targets: zero hits, but the oob marker must still appear
+    (tdir / "headless.yaml").write_text(
+        "id: demo-headless\n"
+        "info:\n  severity: info\n"
+        "headless:\n"
+        "  - steps:\n"
+        "      - action: navigate\n"
+        "        args:\n"
+        "          url: \"{{BaseURL}}\"\n"
+    )
+    # no live targets: zero hits, but the scope markers must still appear
     out = proc._execute_active(module, b"").decode()
     assert "[demo-oob-rce] [oob-skipped]" in out
     assert "interaction server" in out
+    assert "[demo-headless] [headless-skipped]" in out
     assert "demo-login-panel" not in out  # non-oob template: no marker
 
 

@@ -214,3 +214,31 @@ def test_execute_tpu_nuclei_output(http_port, tmp_path):
     # is the line format, so assert shape on any produced lines
     for line in out.strip().splitlines():
         assert line.startswith("[") and "] [" in line
+
+
+def test_prewarm_builds_engine(tmp_path):
+    import json as _json
+
+    from swarm_tpu.config import Config
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    tdir = tmp_path / "templates"
+    tdir.mkdir()
+    (tdir / "t.yaml").write_text(
+        "id: warm-me\nrequests:\n  - method: GET\n    path: [\"{{BaseURL}}/\"]\n"
+        "    matchers:\n      - type: word\n        words: [\"xyzzy\"]\n"
+    )
+    mdir = tmp_path / "modules"
+    mdir.mkdir()
+    (mdir / "warm.json").write_text(_json.dumps({
+        "backend": "active", "templates": str(tdir),
+        "probe": {"connect_timeout_ms": 100, "read_timeout_ms": 100},
+    }))
+    (mdir / "cmd.json").write_text(_json.dumps({"command": "true"}))
+    cfg = Config.load(server_url="http://127.0.0.1:1", api_key="k",
+                      worker_id="w", modules_dir=str(mdir))
+    proc = JobProcessor(cfg, client=object(), work_dir=str(tmp_path / "wd"))
+    assert proc.prewarm("warm") is True
+    assert any(k.startswith("active::") for k in proc._engines)
+    assert proc.prewarm("cmd") is False       # nothing to warm
+    assert proc.prewarm("missing") is False   # load failure is contained

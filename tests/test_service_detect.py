@@ -358,3 +358,24 @@ def test_top_ports_full_contract():
     assert len(set(ports)) == 1000
     assert set(ports[:10]) >= {80, 443, 22, 21}
     assert all(0 < p < 65536 for p in ports)
+
+
+def test_nmap_report_format():
+    from swarm_tpu.ops.service import ServiceInfo
+    from swarm_tpu.worker.formats import format_nmap_report
+
+    infos = [
+        ServiceInfo(host="10.0.0.5", port=22, open=True, service="ssh",
+                    product="OpenSSH", version="9.6p1", info="protocol 2.0"),
+        ServiceInfo(host="10.0.0.5", port=80, open=True, service="http",
+                    product="nginx", version="1.18.0"),
+        ServiceInfo(host="10.0.0.5", port=25, open=True, service="smtp",
+                    soft=True),
+        ServiceInfo(host="10.0.0.9", port=443, open=False),  # closed: omitted
+    ]
+    out = format_nmap_report(infos)
+    assert "Nmap scan report for 10.0.0.5" in out
+    assert "22/tcp    open  ssh            OpenSSH 9.6p1 (protocol 2.0)" in out
+    assert "80/tcp    open  http           nginx 1.18.0" in out
+    assert "25/tcp    open  smtp?" in out  # softmatch marked tentative
+    assert "10.0.0.9" not in out

@@ -103,13 +103,13 @@ def tls_port(tmp_path):
 
 
 def test_parse_target():
-    assert _parse_target("example.com") == ("example.com", 443)
+    assert _parse_target("example.com") == ("example.com", None)
     assert _parse_target("example.com:8443") == ("example.com", 8443)
-    assert _parse_target("https://example.com/x") == ("example.com", 443)
+    assert _parse_target("https://example.com/x") == ("example.com", None)
     assert _parse_target("  # comment") is None
-    assert _parse_target("[2001:db8::1]") == ("2001:db8::1", 443)
+    assert _parse_target("[2001:db8::1]") == ("2001:db8::1", None)
     assert _parse_target("[2001:db8::1]:8443") == ("2001:db8::1", 8443)
-    assert _parse_target("::1") == ("::1", 443)
+    assert _parse_target("::1") == ("::1", None)
 
 
 def test_handshake_doc(tls_port):
@@ -225,3 +225,37 @@ def test_active_module_runs_ssl_templates(tls_port, tmp_path):
     out = proc._execute_active(module, f"127.0.0.1:{tls_port}\n".encode()).decode()
     assert f"[mini-self-signed] [ssl] [low] 127.0.0.1:{tls_port}" in out
     assert "mini-panel" not in out  # http template didn't match
+
+
+def test_active_ssl_follows_probe_ports(tls_port, tmp_path):
+    """Portless targets get the module's port fan-out for ssl templates
+    too — a self-signed cert on a non-443 port is still caught."""
+    from swarm_tpu.fingerprints.nuclei import load_template_file
+    from swarm_tpu.worker.sslscan import SslScanner
+
+    (tmp_path / "ss.yaml").write_text(
+        "id: fanout-self-signed\n"
+        "info:\n  severity: low\n"
+        "ssl:\n"
+        "  - address: \"{{Host}}:{{Port}}\"\n"
+        "    matchers:\n"
+        "      - type: dsl\n"
+        "        dsl: [\"common_name == issuer_common_name\"]\n"
+        "    extractors:\n"
+        "      - type: json\n"
+        "        name: common_name\n"
+        "        internal: true\n"
+        "        json: [\".common_name[]\"]\n"
+        "      - type: json\n"
+        "        name: issuer_common_name\n"
+        "        internal: true\n"
+        "        json: [\".issuer_common_name[]\"]\n"
+    )
+    t = load_template_file(tmp_path / "ss.yaml")
+    scanner = SslScanner([t], concurrency=4, timeout=5.0)
+    # portless target + default_ports carrying the module's fan-out
+    findings, stats = scanner.scan(["127.0.0.1"], default_ports=[tls_port])
+    assert [f.port for f in findings] == [tls_port]
+    # without the fan-out the portless target dials 443 and finds nothing
+    findings2, _ = scanner.scan(["127.0.0.1"])
+    assert findings2 == []

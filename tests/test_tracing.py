@@ -80,3 +80,26 @@ def test_rollup_no_perf_stays_none():
     scans = rollup_scans({j.job_id: j.to_wire()})
     assert scans[0]["rows_processed"] is None
     assert scans[0]["rows_per_second"] is None
+
+
+def test_compilation_cache_enable(tmp_path, monkeypatch):
+    import jax
+
+    from swarm_tpu.utils import xlacache
+
+    monkeypatch.setattr(xlacache, "_active_dir", None)
+    d = xlacache.enable_compilation_cache(str(tmp_path / "xc"))
+    assert d == str(tmp_path / "xc")
+    assert jax.config.jax_compilation_cache_dir == d
+    # idempotent: second call with another dir keeps (and reports) the
+    # original binding
+    d2 = xlacache.enable_compilation_cache(str(tmp_path / "other"))
+    assert jax.config.jax_compilation_cache_dir == d
+    assert d2 == d
+    assert not (tmp_path / "other").exists()
+    # empty string disables; an uncreatable dir degrades to no-cache
+    monkeypatch.setattr(xlacache, "_active_dir", None)
+    assert xlacache.enable_compilation_cache("") == ""
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")  # a file where a dir is needed
+    assert xlacache.enable_compilation_cache(str(blocker / "sub")) == ""
