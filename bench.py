@@ -51,18 +51,19 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+_EMIT_NOTE = ""  # set when the run is NOT on accelerator hardware
+
+
 def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value),
-                "unit": unit,
-                "vs_baseline": round(vs_baseline, 3),
-            }
-        ),
-        flush=True,
-    )
+    rec = {
+        "metric": metric,
+        "value": round(value),
+        "unit": unit,
+        "vs_baseline": round(vs_baseline, 3),
+    }
+    if _EMIT_NOTE:
+        rec["note"] = _EMIT_NOTE
+    print(json.dumps(rec), flush=True)
 
 
 def realistic_rows(n: int, seed: int = 7):
@@ -396,8 +397,13 @@ def main() -> int:
     if dev.platform == "cpu":
         # CPU fallback (wedged tunnel / no accelerator): the numbers are
         # flagged non-accelerator anyway — keep wall-clock bounded
-        global ROWS, ITERS
+        global ROWS, ITERS, _EMIT_NOTE
         ROWS, ITERS = 256, 2
+        _EMIT_NOTE = (
+            "CPU FALLBACK - accelerator unreachable at bench time; "
+            "values are NOT chip throughput (see BENCH_r01 for the "
+            "device-measured rate)"
+        )
 
     from swarm_tpu.fingerprints import load_corpus
 

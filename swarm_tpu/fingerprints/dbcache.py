@@ -1,0 +1,130 @@
+"""Corpus-compile cache: parsed templates + CompiledDB on disk.
+
+Compiling the full reference corpus (3,989 YAML templates → device
+tensors) costs ~8-10 s of pure Python per process. Together with the
+persistent XLA cache (utils/xlacache.py) this makes a warm worker's
+engine construction near-instant: both halves of startup — corpus
+lowering and kernel compilation — are paid once per (corpus, compiler
+version) and reused across restarts and fleet clones.
+
+The cache key covers the corpus contents (every template file's path,
+size, mtime) AND the compiler's own source bytes, so editing either the
+templates or the lowering code invalidates cleanly. Entries are pickles
+written atomically under ``~/.cache/swarm_tpu/db`` (override:
+``SWARM_DB_CACHE_DIR``; empty disables). Only this framework writes the
+cache dir — entries are trusted local artifacts, same trust level as
+the XLA cache next to it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+DEFAULT_CACHE_DIR = "~/.cache/swarm_tpu/db"
+_FORMAT_VERSION = 1
+
+# compiler source files whose bytes salt the key: a lowering change must
+# never serve stale compiled DBs
+_CODE_FILES = ("compile.py", "nuclei.py", "model.py", "regexlin.py", "dslc.py")
+
+
+def _code_salt() -> bytes:
+    h = hashlib.sha256()
+    here = Path(__file__).resolve().parent
+    for name in _CODE_FILES:
+        try:
+            h.update(name.encode())
+            h.update((here / name).read_bytes())
+        except OSError:
+            h.update(b"?")
+    return h.digest()
+
+
+def corpus_key(templates_dir: str | Path) -> str:
+    """Stable key over the corpus tree + compiler version."""
+    root = Path(templates_dir)
+    h = hashlib.sha256()
+    h.update(b"v%d|" % _FORMAT_VERSION)
+    h.update(_code_salt())
+    entries = sorted(
+        p for p in root.rglob("*")
+        if p.is_file() and p.suffix in (".yaml", ".yml", ".txt")
+    )
+    for p in entries:
+        st = p.stat()
+        h.update(
+            f"{p.relative_to(root)}|{st.st_size}|{st.st_mtime_ns}\n".encode()
+        )
+    return h.hexdigest()
+
+
+def _cache_dir() -> Optional[Path]:
+    raw = os.environ.get("SWARM_DB_CACHE_DIR", DEFAULT_CACHE_DIR)
+    if not raw:
+        return None
+    path = Path(raw).expanduser()
+    try:
+        path.mkdir(parents=True, exist_ok=True)
+    except OSError:
+        return None
+    return path
+
+
+def load_or_compile(templates_dir: str | Path):
+    """→ (templates, CompiledDB), served from the disk cache when the
+    corpus+compiler key matches; compiled (and cached) otherwise."""
+    from swarm_tpu.fingerprints import load_corpus
+    from swarm_tpu.fingerprints.compile import compile_corpus
+
+    cache = _cache_dir()
+    # entries are named <dir-hash>-<content-key>.pkl: the dir hash
+    # groups entries per corpus location so publishing a new key evicts
+    # the stale siblings (the mtime-sensitive key would otherwise mint
+    # an immortal multi-MB pickle per checkout/touch)
+    dir_tag = hashlib.sha256(
+        str(Path(templates_dir).resolve()).encode()
+    ).hexdigest()[:16]
+    key = corpus_key(templates_dir) if cache else ""
+    if cache:
+        entry = cache / f"{dir_tag}-{key}.pkl"
+        if entry.is_file():
+            try:
+                with open(entry, "rb") as fh:
+                    templates, db = pickle.load(fh)
+                return templates, db
+            except Exception:
+                # corrupt/incompatible entry: fall through to recompile
+                try:
+                    entry.unlink()
+                except OSError:
+                    pass
+    templates, _errors = load_corpus(templates_dir)
+    db = compile_corpus(templates)
+    if cache:
+        # atomic publish so a concurrent reader never sees a torn
+        # pickle; ANY failure degrades to no-cache (the compile already
+        # succeeded — a cache write must never fail the scan)
+        tmp = None
+        try:
+            fd, tmp = tempfile.mkstemp(dir=cache, suffix=".tmp")
+            with os.fdopen(fd, "wb") as fh:
+                pickle.dump((templates, db), fh, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, cache / f"{dir_tag}-{key}.pkl")
+            tmp = None
+            for stale in cache.glob(f"{dir_tag}-*.pkl"):
+                if stale.name != f"{dir_tag}-{key}.pkl":
+                    stale.unlink(missing_ok=True)
+        except Exception:
+            pass
+        finally:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+    return templates, db

@@ -97,9 +97,10 @@ class MatchEngine:
         candidate_k: int = 128,
         host_always: str = "full",  # "full" (exact) | "skip" (device-only)
         mesh="auto",  # "auto" | None | jax.sharding.Mesh
+        db: Optional[CompiledDB] = None,  # precompiled (fingerprints/dbcache)
     ):
         self.templates = list(templates)
-        self.db: CompiledDB = compile_corpus(self.templates)
+        self.db = db if db is not None else compile_corpus(self.templates)
         self.device = DeviceDB(self.db, candidate_k=candidate_k)
         self.max_body = max_body
         self.max_header = max_header

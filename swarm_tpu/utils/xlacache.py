@@ -12,6 +12,7 @@ serialized executable instead of recompiling.
 from __future__ import annotations
 
 import os
+import sys
 from pathlib import Path
 from typing import Optional
 
@@ -41,7 +42,8 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
     try:
         path.mkdir(parents=True, exist_ok=True)
     except OSError as e:
-        print(f"xla cache disabled ({path}: {e})")
+        # stderr: bench.py's stdout is a JSON-only metric stream
+        print(f"xla cache disabled ({path}: {e})", file=sys.stderr)
         return ""
     import jax
 

@@ -297,13 +297,21 @@ class JobProcessor:
                     / 1000.0,
                 )
                 self._engines[ssl_key] = ssl_scanner
-            # portless targets follow the module's port fan-out, so ssl
-            # templates evaluate on the same ports the http scan probes
-            probe_ports = [
-                int(p) for p in (module.probe or {}).get("ports", [443])
-            ] or [443]
+            # portless targets follow the module's port fan-out, but
+            # only its TLS-plausible ports — a handshake to a plaintext
+            # port (80, 8080) can only burn its timeout. No TLS-likely
+            # port configured → nuclei's default of 443.
+            probe = module.probe or {}
+            if "ssl_ports" in probe:  # explicit override: honored as-is
+                tls_ports = [int(p) for p in probe["ssl_ports"]] or [443]
+            else:
+                tls_ports = [
+                    int(p)
+                    for p in probe.get("ports", [443])
+                    if int(p) in sslscan.TLS_LIKELY_PORTS
+                ] or [443]
             ssl_findings, _ssl_stats = ssl_scanner.scan(
-                target_lines, default_ports=probe_ports
+                target_lines, default_ports=tls_ports
             )
             lines.extend(sslscan.format_lines(ssl_findings))
         print(
@@ -447,11 +455,13 @@ class JobProcessor:
     def _engine_for(self, templates_dir: str):
         engine = self._engines.get(templates_dir)
         if engine is None:
-            from swarm_tpu.fingerprints import load_corpus
+            from swarm_tpu.fingerprints.dbcache import load_or_compile
             from swarm_tpu.ops.engine import MatchEngine
 
-            templates, _errors = load_corpus(templates_dir)
-            engine = MatchEngine(templates)
+            # disk-cached corpus compile (+ persistent XLA cache): a
+            # warm worker builds the full-corpus engine in ~a second
+            templates, db = load_or_compile(templates_dir)
+            engine = MatchEngine(templates, db=db)
             self._engines[templates_dir] = engine
         return engine
 

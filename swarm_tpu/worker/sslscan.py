@@ -41,6 +41,17 @@ _VERSIONS = {
     "tls13": pyssl.TLSVersion.TLSv1_3,
 }
 
+# Ports where a direct TLS handshake is plausible (implicit-TLS
+# services); fan-out filters a module's probe ports through this so
+# plaintext ports (80, 8080, …) don't eat doomed handshake timeouts.
+TLS_LIKELY_PORTS = frozenset(
+    {
+        443, 465, 563, 636, 853, 989, 990, 992, 993, 994, 995, 2376,
+        2484, 3269, 4443, 5061, 5986, 6443, 6514, 6697, 8333, 8443,
+        8834, 9443, 10443, 16993,
+    }
+)
+
 _WIRE_TO_NUCLEI = {
     "SSLv3": "ssl30",
     "TLSv1": "tls10",

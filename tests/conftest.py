@@ -6,8 +6,13 @@ without a cluster (SURVEY.md §4f). Benchmarks run on real TPU separately.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the env presets a TPU platform
+# hermetic corpus-compile cache: don't read/write ~/.cache during tests
+os.environ.setdefault(
+    "SWARM_DB_CACHE_DIR", tempfile.mkdtemp(prefix="swarm_test_dbc_")
+)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -132,3 +132,39 @@ def test_worker_cmd_failed_on_bad_module(stack):
     statuses = client.get_statuses()
     [job_rec] = statuses["jobs"].values()
     assert job_rec["status"] == "cmd failed"
+
+
+def test_cli_stream_and_cat(stack, monkeypatch, capsys):
+    """Reference client/swarm:316-334 stream mode: stdin -> rolling
+    10-line chunks -> /queue under a caller-fixed scan id, then cat."""
+    import io
+
+    cfg, srv, tmp_path = stack
+    base_args = ["--server-url", cfg.resolve_url(), "--api-key", cfg.api_key]
+    lines = "".join(f"host{i}.example\n" for i in range(23))  # 2 full + 1 partial
+    monkeypatch.setattr("sys.stdin", io.StringIO(lines))
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    assert cli_main(["stream", "--module", "echo", "--scan-id", "echo_777",
+                     "--batch-size", "0"] + base_args) == 0
+    out = capsys.readouterr().out
+    assert out.count("Uploading chunk") == 3  # trailing partial flushed too
+    run_worker(cfg, max_jobs=3)
+    assert cli_main(["cat", "--scan-id", "echo_777"] + base_args) == 0
+    catted = capsys.readouterr().out
+    for i in (0, 9, 10, 19, 20, 22):
+        assert f"host{i}.example" in catted
+
+
+def test_cli_tail_follows_completed_chunks(stack, capsys):
+    """Reference client/swarm:72-82 tail loop: /get-latest-chunk pops
+    the completed list, /get-chunk fetches the output."""
+    cfg, srv, tmp_path = stack
+    scan_file = tmp_path / "tail.txt"
+    scan_file.write_text("aa\nbb\ncc\n")
+    client = JobClient(cfg.resolve_url(), cfg.api_key)
+    code, _ = client.start_scan(str(scan_file), "echo", 0, 0)
+    assert code == 200
+    run_worker(cfg, max_jobs=1)
+    chunk = client.get_latest_chunk_raw()
+    assert chunk is not None and "aa" in chunk and "cc" in chunk
+    assert client.get_latest_chunk_raw() is None  # completed list drained

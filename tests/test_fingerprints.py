@@ -177,3 +177,56 @@ def test_oracle_on_reference_rsyncd_banner():
     r2 = model.Response(host="h", port=873, banner=b"@RSYNCD: 31.0\n")
     # and-condition requires both words
     assert not cpu_ref.match_template(rsyncd, r2).matched
+
+
+# ---------------------------------------------------------------------------
+# Corpus-compile disk cache (fingerprints/dbcache.py)
+# ---------------------------------------------------------------------------
+
+
+def test_dbcache_roundtrip_and_invalidation(tmp_path, monkeypatch):
+    import os
+    import time as _time
+
+    from swarm_tpu.fingerprints import dbcache
+
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    (corpus / "a.yaml").write_text(
+        "id: cache-a\nrequests:\n  - method: GET\n    path: [\"{{BaseURL}}/\"]\n"
+        "    matchers:\n      - type: word\n        words: [\"alpha-sig\"]\n"
+    )
+    cache = tmp_path / "dbc"
+    monkeypatch.setenv("SWARM_DB_CACHE_DIR", str(cache))
+
+    t1, db1 = dbcache.load_or_compile(corpus)
+    assert len(list(cache.glob("*.pkl"))) == 1
+    t2, db2 = dbcache.load_or_compile(corpus)  # served from cache
+    assert [t.id for t in t2] == [t.id for t in t1]
+    assert db2.num_templates == db1.num_templates
+
+    # content change invalidates: key differs, entry recompiled
+    key_before = dbcache.corpus_key(corpus)
+    _time.sleep(0.01)
+    (corpus / "b.yaml").write_text(
+        "id: cache-b\nrequests:\n  - method: GET\n    path: [\"{{BaseURL}}/\"]\n"
+        "    matchers:\n      - type: word\n        words: [\"beta-sig\"]\n"
+    )
+    assert dbcache.corpus_key(corpus) != key_before
+    t3, _db3 = dbcache.load_or_compile(corpus)
+    assert {t.id for t in t3} == {"cache-a", "cache-b"}
+    # stale sibling evicted on publish: one live entry per corpus dir
+    assert len(list(cache.glob("*.pkl"))) == 1
+
+    # corrupt entry degrades to recompile, not a crash
+    for p in cache.glob("*.pkl"):
+        p.write_bytes(b"not a pickle")
+    t4, _ = dbcache.load_or_compile(corpus)
+    assert {t.id for t in t4} == {"cache-a", "cache-b"}
+
+    # empty dir env disables caching entirely
+    monkeypatch.setenv("SWARM_DB_CACHE_DIR", "")
+    for p in cache.glob("*.pkl"):
+        p.unlink()
+    dbcache.load_or_compile(corpus)
+    assert list(cache.glob("*.pkl")) == []

@@ -1,0 +1,160 @@
+"""Fleet orchestration tests (server/fleet.py).
+
+The reference's elastic scaling (`server/server.py:47-162,517-546`) is
+a DO droplet fleet with a 250/min rate limiter and idle teardown. These
+tests pin: name generation, the token-bucket limiter, the provider
+factory, and the DigitalOcean provider's wire shape (create payload,
+cloud-init user_data, prefix-scoped deletion) against a fake requests
+layer — no egress.
+"""
+
+import threading
+import time
+
+from swarm_tpu.config import Config
+from swarm_tpu.server.fleet import (
+    DigitalOceanProvider,
+    NullProvider,
+    ProcessProvider,
+    RateLimiter,
+    build_provider,
+    generate_node_names,
+)
+
+
+def test_node_names_reference_format():
+    assert generate_node_names("sw", 3) == ["sw1", "sw2", "sw3"]
+    assert generate_node_names("x", 0) == []
+
+
+def test_rate_limiter_caps_burst():
+    rl = RateLimiter(per_minute=5)
+    t0 = time.monotonic()
+    for _ in range(5):
+        rl.acquire()
+    assert time.monotonic() - t0 < 0.5  # first 5 are immediate
+    # the 6th would block ~60s; assert it does NOT return immediately
+    done = threading.Event()
+
+    def sixth():
+        rl.acquire()
+        done.set()
+
+    t = threading.Thread(target=sixth, daemon=True)
+    t.start()
+    assert not done.wait(0.3)
+
+
+def test_build_provider_dispatch():
+    assert isinstance(build_provider(Config()), NullProvider)
+    assert isinstance(
+        build_provider(Config(fleet_provider="process")), ProcessProvider
+    )
+    assert isinstance(
+        build_provider(Config(fleet_provider="digitalocean")),
+        DigitalOceanProvider,
+    )
+
+
+class _FakeResponse:
+    def __init__(self, payload):
+        self.status_code = 200
+        self._payload = payload
+
+    def json(self):
+        return self._payload
+
+
+class _FakeRequests:
+    """Records calls; serves a fixed droplet inventory."""
+
+    def __init__(self, droplets=()):
+        self.droplets = list(droplets)
+        self.posts: list[tuple[str, dict]] = []
+        self.deletes: list[str] = []
+        self._lock = threading.Lock()
+
+    def post(self, url, headers=None, json=None, timeout=None):
+        with self._lock:
+            self.posts.append((url, json))
+        return _FakeResponse({})
+
+    def delete(self, url, headers=None, timeout=None):
+        with self._lock:
+            self.deletes.append(url)
+        return _FakeResponse({})
+
+    def get(self, url, headers=None, timeout=None):
+        return _FakeResponse({"droplets": self.droplets})
+
+
+def _do_provider(fake, **cfg_kw):
+    cfg = Config(
+        fleet_provider="digitalocean",
+        fleet_api_token="tok",
+        server_url="http://c2.example:5001",
+        api_key="fleetkey",
+        fleet_image="snapshot-123",
+        **cfg_kw,
+    )
+    p = DigitalOceanProvider(cfg)
+    p._requests = fake
+    return p
+
+
+def test_do_spin_up_wire_shape():
+    fake = _FakeRequests()
+    p = _do_provider(fake)
+    p.spin_up("sw", 3)
+    assert len(fake.posts) == 3
+    urls = {u for u, _ in fake.posts}
+    assert urls == {"https://api.digitalocean.com/v2/droplets"}
+    names = sorted(body["name"] for _, body in fake.posts)
+    assert names == ["sw1", "sw2", "sw3"]
+    _, body = fake.posts[0]
+    assert body["image"] == "snapshot-123"
+    # cloud-init user_data boots the worker image with the C2 wiring
+    # (reference server.py:79-102)
+    ud = body["user_data"]
+    assert "#cloud-config" in ud
+    assert "SERVER_URL=http://c2.example:5001" in ud
+    assert "API_KEY=fleetkey" in ud
+    assert f"WORKER_ID={body['name']}" in ud
+
+
+def test_do_spin_down_prefix_scoped():
+    fake = _FakeRequests(
+        droplets=[
+            {"id": 11, "name": "sw1"},
+            {"id": 12, "name": "sw2"},
+            {"id": 99, "name": "other1"},
+        ]
+    )
+    p = _do_provider(fake)
+    assert p.list_nodes("sw") == ["sw1", "sw2"]
+    p.spin_down("sw")
+    assert sorted(fake.deletes) == [
+        "https://api.digitalocean.com/v2/droplets/11",
+        "https://api.digitalocean.com/v2/droplets/12",
+    ]
+
+
+def test_process_provider_lifecycle(tmp_path):
+    """ProcessProvider spawns real worker processes and kills them —
+    the single-host analog of a droplet fleet."""
+    cfg = Config(
+        fleet_provider="process",
+        server_url="http://127.0.0.1:1",  # nothing listening: they just poll
+        api_key="k",
+    )
+    p = ProcessProvider(cfg)
+    try:
+        p.spin_up("pw", 2)
+        assert sorted(p.list_nodes("pw")) == ["pw1", "pw2"]
+        p.spin_down("pw")
+        deadline = time.monotonic() + 10
+        while p.list_nodes("pw") and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert p.list_nodes("pw") == []
+    finally:
+        p.shutdown()
