@@ -29,8 +29,18 @@ DEFAULT_CACHE_DIR = "~/.cache/swarm_tpu/db"
 _FORMAT_VERSION = 1
 
 # compiler source files whose bytes salt the key: a lowering change must
-# never serve stale compiled DBs
-_CODE_FILES = ("compile.py", "nuclei.py", "model.py", "regexlin.py", "dslc.py")
+# never serve stale compiled DBs. compile.py bakes tables from
+# ops/hashing.py (gram hashes, blooms) and ops/encoding.py (stream
+# layout) into the CompiledDB, so those salt the key too.
+_CODE_FILES = (
+    "compile.py",
+    "nuclei.py",
+    "model.py",
+    "regexlin.py",
+    "dslc.py",
+    "../ops/hashing.py",
+    "../ops/encoding.py",
+)
 
 
 def _code_salt() -> bytes:

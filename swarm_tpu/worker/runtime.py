@@ -297,10 +297,10 @@ class JobProcessor:
                     / 1000.0,
                 )
                 self._engines[ssl_key] = ssl_scanner
-            # portless targets follow the module's port fan-out, but
-            # only its TLS-plausible ports — a handshake to a plaintext
-            # port (80, 8080) can only burn its timeout. No TLS-likely
-            # port configured → nuclei's default of 443.
+            # portless targets follow the module's port fan-out minus
+            # known-plaintext ports (a handshake to 80/8080 can only
+            # burn its timeout); nonstandard TLS ports stay covered.
+            # Nothing TLS-plausible configured → nuclei's default 443.
             probe = module.probe or {}
             if "ssl_ports" in probe:  # explicit override: honored as-is
                 tls_ports = [int(p) for p in probe["ssl_ports"]] or [443]
@@ -308,7 +308,7 @@ class JobProcessor:
                 tls_ports = [
                     int(p)
                     for p in probe.get("ports", [443])
-                    if int(p) in sslscan.TLS_LIKELY_PORTS
+                    if int(p) not in sslscan.PLAINTEXT_PORTS
                 ] or [443]
             ssl_findings, _ssl_stats = ssl_scanner.scan(
                 target_lines, default_ports=tls_ports

@@ -41,14 +41,17 @@ _VERSIONS = {
     "tls13": pyssl.TLSVersion.TLSv1_3,
 }
 
-# Ports where a direct TLS handshake is plausible (implicit-TLS
-# services); fan-out filters a module's probe ports through this so
-# plaintext ports (80, 8080, …) don't eat doomed handshake timeouts.
-TLS_LIKELY_PORTS = frozenset(
+# Ports that are KNOWN plaintext protocols: the ssl fan-out excludes
+# these from a module's probe ports (a TLS handshake there can only
+# burn its timeout) and keeps everything else — nonstandard TLS ports
+# (4433, appliance admin UIs, …) stay covered.
+PLAINTEXT_PORTS = frozenset(
     {
-        443, 465, 563, 636, 853, 989, 990, 992, 993, 994, 995, 2376,
-        2484, 3269, 4443, 5061, 5986, 6443, 6514, 6697, 8333, 8443,
-        8834, 9443, 10443, 16993,
+        21, 22, 23, 25, 53, 69, 79, 80, 110, 111, 119, 123, 135, 137,
+        139, 143, 161, 389, 445, 512, 513, 514, 515, 554, 587, 873,
+        1080, 2049, 3000, 3128, 3306, 5000, 5060, 5432, 5900, 6000,
+        6379, 8000, 8008, 8080, 8081, 8088, 9090, 9100, 9200, 11211,
+        27017, 50000,
     }
 )
 

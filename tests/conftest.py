@@ -10,9 +10,11 @@ import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the env presets a TPU platform
 # hermetic corpus-compile cache: don't read/write ~/.cache during tests
-os.environ.setdefault(
-    "SWARM_DB_CACHE_DIR", tempfile.mkdtemp(prefix="swarm_test_dbc_")
-)
+# (lazy so a preset env var doesn't leak an orphan temp dir)
+if "SWARM_DB_CACHE_DIR" not in os.environ:
+    os.environ["SWARM_DB_CACHE_DIR"] = tempfile.mkdtemp(
+        prefix="swarm_test_dbc_"
+    )
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

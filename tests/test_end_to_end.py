@@ -168,3 +168,119 @@ def test_cli_tail_follows_completed_chunks(stack, capsys):
     chunk = client.get_latest_chunk_raw()
     assert chunk is not None and "aa" in chunk and "cc" in chunk
     assert client.get_latest_chunk_raw() is None  # completed list drained
+
+
+def test_fleet_spinup_scan_teardown(tmp_path, monkeypatch):
+    """Reference §3.5 end to end with real processes: /spin-up boots a
+    process fleet, the fleet drains a scan, idleness tears it down."""
+    import time
+
+    from swarm_tpu.server.fleet import ProcessProvider
+
+    monkeypatch.setenv("SWARM_TEMPLATES_DIR", TEMPLATES)
+    modules_dir = tmp_path / "modules"
+    modules_dir.mkdir()
+    (modules_dir / "echo.json").write_text(
+        json.dumps({"command": "cat {input} > {output}"})
+    )
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="fleete2e",
+        blob_root=str(tmp_path / "blobs"), doc_root=str(tmp_path / "docs"),
+        modules_dir=str(modules_dir),
+        fleet_provider="process",
+        idle_polls_before_teardown=3,
+    )
+    # spawned workers read config via SWARM_* env
+    monkeypatch.setenv("SWARM_MODULES_DIR", str(modules_dir))
+    monkeypatch.setenv("SWARM_POLL_INTERVAL_IDLE_S", "0.1")
+    monkeypatch.setenv("SWARM_POLL_INTERVAL_BUSY_S", "0.02")
+    monkeypatch.setenv("SWARM_DB_CACHE_DIR", str(tmp_path / "dbc"))
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    cfg.server_url = f"http://127.0.0.1:{srv.port}"
+    fleet = srv.fleet
+    assert isinstance(fleet, ProcessProvider)
+    try:
+        client = JobClient(cfg.resolve_url(), cfg.api_key)
+        code, _ = client.spin_up("flt", 2)
+        assert code == 202  # async accept, reference server.py:531
+        deadline = time.monotonic() + 15
+        while len(fleet.list_nodes("flt")) < 2 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert sorted(fleet.list_nodes("flt")) == ["flt1", "flt2"]
+
+        scan_file = tmp_path / "targets.txt"
+        scan_file.write_text("".join(f"t{i}.example\n" for i in range(6)))
+        code, _ = client.start_scan(str(scan_file), "echo", 0, 2)  # 3 chunks
+        assert code == 200
+        deadline = time.monotonic() + 60
+        scan_id = None
+        while time.monotonic() < deadline:
+            st = client.get_statuses()
+            scans = st.get("scans") or []
+            done = [s for s in scans if s.get("percent_complete") == 100]
+            if done:
+                scan_id = done[0]["scan_id"]
+                break
+            time.sleep(0.25)
+        assert scan_id, "fleet never completed the scan"
+        raw = client.fetch_raw(scan_id)
+        for i in range(6):
+            assert f"t{i}.example" in raw
+
+        # idleness: workers keep polling an empty queue until the server
+        # tears their nodes down (reference server.py:506-512)
+        deadline = time.monotonic() + 30
+        while fleet.list_nodes("flt") and time.monotonic() < deadline:
+            time.sleep(0.25)
+        assert fleet.list_nodes("flt") == []
+    finally:
+        fleet.shutdown()
+        srv.shutdown()
+
+
+def test_cli_spinup_terminate_recycle(tmp_path, monkeypatch, capsys):
+    """CLI fleet actions against the process provider (reference
+    client/swarm:263-315)."""
+    import time as _time
+
+    from swarm_tpu.server.fleet import ProcessProvider
+
+    modules_dir = tmp_path / "modules"
+    modules_dir.mkdir()
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="cli-fleet",
+        blob_root=str(tmp_path / "blobs"), doc_root=str(tmp_path / "docs"),
+        modules_dir=str(modules_dir), fleet_provider="process",
+    )
+    monkeypatch.setenv("SWARM_POLL_INTERVAL_IDLE_S", "0.2")
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    cfg.server_url = f"http://127.0.0.1:{srv.port}"
+    fleet = srv.fleet
+    assert isinstance(fleet, ProcessProvider)
+    base_args = ["--server-url", cfg.resolve_url(), "--api-key", cfg.api_key]
+    real_sleep = _time.sleep
+    monkeypatch.setattr("time.sleep", lambda s: real_sleep(min(s, 0.05)))
+    try:
+        assert cli_main(["spinup", "--prefix", "cf", "--nodes", "2"]
+                        + base_args) == 0
+        deadline = _time.monotonic() + 15
+        while len(fleet.list_nodes("cf")) < 2 and _time.monotonic() < deadline:
+            real_sleep(0.1)
+        assert sorted(fleet.list_nodes("cf")) == ["cf1", "cf2"]
+        # recycle = spin-down + spin-up
+        assert cli_main(["recycle", "--prefix", "cf", "--nodes", "1"]
+                        + base_args) == 0
+        deadline = _time.monotonic() + 15
+        while fleet.list_nodes("cf") != ["cf1"] and _time.monotonic() < deadline:
+            real_sleep(0.1)
+        assert fleet.list_nodes("cf") == ["cf1"]
+        assert cli_main(["terminate", "--prefix", "cf"] + base_args) == 0
+        deadline = _time.monotonic() + 15
+        while fleet.list_nodes("cf") and _time.monotonic() < deadline:
+            real_sleep(0.1)
+        assert fleet.list_nodes("cf") == []
+    finally:
+        fleet.shutdown()
+        srv.shutdown()

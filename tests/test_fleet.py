@@ -158,3 +158,43 @@ def test_process_provider_lifecycle(tmp_path):
         assert p.list_nodes("pw") == []
     finally:
         p.shutdown()
+
+
+def test_idle_teardown_via_queue():
+    """Reference behavior: >N empty polls flips the worker inactive and
+    tears its node down (server.py:499-512) — wired through the queue
+    service's fleet hook here."""
+    from swarm_tpu.server.queue import JobQueueService
+    from swarm_tpu.stores import (
+        MemoryBlobStore,
+        MemoryDocStore,
+        MemoryStateStore,
+    )
+
+    class RecordingProvider(NullProvider):
+        def __init__(self):
+            self.torn_down = []
+
+        def teardown_async(self, prefix):
+            self.torn_down.append(prefix)
+
+    fleet = RecordingProvider()
+    cfg = Config(api_key="k", idle_polls_before_teardown=3)
+    q = JobQueueService(
+        cfg, MemoryStateStore(), MemoryBlobStore(), MemoryDocStore(),
+        fleet=fleet,
+    )
+    for i in range(4):
+        assert q.next_job("idle-w") is None
+    st = q.statuses()["workers"]["idle-w"]
+    assert st["status"] == "pending"
+    assert fleet.torn_down == []
+    q.next_job("idle-w")  # crosses the idle threshold
+    st = q.statuses()["workers"]["idle-w"]
+    assert st["status"] == "inactive"
+    assert fleet.torn_down == ["idle-w"]
+    # a job arriving revives the worker on its next successful poll
+    q.queue_scan({"module": "echo", "file_content": ["x\n"],
+                  "batch_size": 1, "scan_id": "echo_42"})
+    assert q.next_job("idle-w") is not None
+    assert q.statuses()["workers"]["idle-w"]["status"] == "active"
