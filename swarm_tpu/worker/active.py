@@ -307,12 +307,91 @@ def _parse_raw(raw: str) -> Optional[PlannedRequest]:
     )
 
 
-def build_plan(templates: Sequence[Template]) -> RequestPlan:
-    """Corpus → deduplicated request table + ownership map."""
+def _ast_var_names(ast) -> set:
+    """Variable names referenced anywhere in a parsed dsl expression."""
+    names: set = set()
+
+    def walk(node):
+        if not isinstance(node, tuple):
+            return
+        if node[0] == "var":
+            names.add(node[1])
+        for part in node:
+            if isinstance(part, tuple):
+                walk(part)
+            elif isinstance(part, list):
+                for sub in part:
+                    walk(sub)
+
+    walk(ast)
+    return names
+
+
+def _unresolved_names(t: Template) -> set:
+    """Placeholder names in the template's request text that the plain
+    substitution layer cannot resolve."""
+    out: set = set()
+    for op in t.operations:
+        texts = list(op.paths) + list(op.raw) + [op.body or ""]
+        texts += [v for _k, v in op.headers]
+        for text in texts:
+            for m in _PLACEHOLDER_RE.finditer(text):
+                name = m.group(1).strip()
+                if _substitute("{{" + name + "}}") is None:
+                    out.add(name)
+    return out
+
+
+def _classify_dynamic(t: Template) -> str:
+    """Honest skip bucket for a template with unresolved placeholders:
+
+    - ``oob-interactsh`` — needs an out-of-band interaction server
+      (already surfaced per-template in scan output);
+    - ``extractor-chain`` — every unresolved value comes from the
+      template's own (internal) extractors/payloads: a per-target
+      session could execute it;
+    - ``requires-var`` — needs operator-supplied values (nuclei's
+      ``-var``; the token-spray class). Supply via the active module's
+      ``"vars"`` object.
+    """
+    if _uses_oob(t):
+        return "oob-interactsh"
+    sources: set = set()
+    for op in t.operations:
+        sources |= {ex.name for ex in op.extractors if ex.name}
+        sources |= set(op.payloads.keys())
+    unresolved = _unresolved_names(t)
+
+    def covered(name: str) -> bool:
+        if name in sources:
+            return True
+        ast = dslc.try_parse(name)
+        if ast is None:
+            return False
+        refs = _ast_var_names(ast)
+        return bool(refs) and refs <= sources
+
+    if unresolved and all(covered(n) for n in unresolved):
+        return "extractor-chain"
+    return "requires-var"
+
+
+def build_plan(
+    templates: Sequence[Template],
+    user_vars: Optional[dict] = None,
+) -> RequestPlan:
+    """Corpus → deduplicated request table + ownership map.
+
+    ``user_vars`` are operator-supplied template variables (nuclei's
+    ``-var token=…``), substituted wherever payload values would be —
+    they unlock the requires-var class (API token-spray templates
+    etc.) when the operator provides credentials."""
     dedup: dict[PlannedRequest, int] = {}
     owners: list[set[int]] = []
     skipped: dict[str, list[str]] = {}
     planned: set[int] = set()
+
+    current_added: list[list[int]] = [[]]  # per-template http indices
 
     def add(req: PlannedRequest, t_idx: int) -> None:
         idx = dedup.get(req)
@@ -320,6 +399,7 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
             idx = dedup[req] = len(owners)
             owners.append(set())
         owners[idx].add(t_idx)
+        current_added[0].append(idx)
         planned.add(t_idx)
 
     def skip(reason: str, t: Template) -> None:
@@ -404,6 +484,8 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
             continue
         ok = False
         unsupported: Optional[str] = None
+        current_added[0] = []
+        planned_matchers = False  # did any PLANNED op carry matchers?
         for op in t.operations:
             # payload attacks (default-logins, fuzzing, token-spray):
             # expand the bounded combo set and plan one request per
@@ -416,6 +498,10 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
                     continue
             else:
                 combos = [None]
+            if user_vars:
+                # operator vars are the base layer; payload combos
+                # override on collision (nuclei -var semantics)
+                combos = [{**user_vars, **(c or {})} for c in combos]
             for payload_vars in combos:
                 if op.raw:
                     # multi-request raws: nuclei evaluates matchers per
@@ -447,6 +533,7 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
                     for req in step_reqs:
                         add(req, t_idx)
                     ok = True
+                    planned_matchers = planned_matchers or bool(op.matchers)
                     continue
                 method = (op.method or "GET").upper()
                 if method not in ("GET", "POST", "PUT", "HEAD", "OPTIONS"):
@@ -496,12 +583,28 @@ def build_plan(templates: Sequence[Template]) -> RequestPlan:
                         t_idx,
                     )
                     ok = True
+                    planned_matchers = planned_matchers or bool(op.matchers)
+        if ok and unsupported and not planned_matchers:
+            # the planned subset carries no matchers while sibling
+            # ops/steps failed — nothing planned can ever fire, so a
+            # silent partial plan would hide the gap; retract and skip
+            for idx in current_added[0]:
+                owners[idx].discard(t_idx)
+            planned.discard(t_idx)
+            ok = False
         if not ok and unsupported:
+            if unsupported == "dynamic-values":
+                unsupported = _classify_dynamic(t)
             skip(unsupported, t)
 
+    # drop orphaned requests (a retracted partial template may leave a
+    # dedup entry whose owner set emptied — probing it would be wasted
+    # I/O with no possible attribution)
+    requests_list = list(dedup)
+    keep = [i for i, o in enumerate(owners) if o]
     return RequestPlan(
-        requests=list(dedup),
-        owners=owners,
+        requests=[requests_list[i] for i in keep],
+        owners=[owners[i] for i in keep],
         skipped=skipped,
         planned_templates=planned,
         net_requests=list(net_dedup),
@@ -551,15 +654,34 @@ class ActiveScanner:
     template hits. ``engine`` is a MatchEngine over the same corpus the
     plan was built from."""
 
-    def __init__(self, engine, probe_spec: Optional[dict] = None):
+    def __init__(
+        self,
+        engine,
+        probe_spec: Optional[dict] = None,
+        user_vars: Optional[dict] = None,
+    ):
         self.engine = engine
-        self.plan = build_plan(engine.templates)
+        self.plan = build_plan(engine.templates, user_vars=user_vars)
         # honest scope marker: these ids are emitted as oob-skipped in
         # scan output (runtime._execute_active) so "didn't match" and
         # "can't match without OOB" stay distinguishable in /raw
         self.oob_limited = sorted(
             t.id for t in engine.templates if _uses_oob(t)
         )
+        # session-class templates (extractor chains, indexed-history
+        # raw flows) execute statefully per target instead of batching
+        session_ids = set(
+            self.plan.skipped.get("extractor-chain", [])
+        ) | set(self.plan.skipped.get("multi-step-condition", []))
+        self.session_scanner = None
+        if session_ids:
+            from swarm_tpu.worker.sessions import SessionScanner
+
+            self.session_scanner = SessionScanner(
+                [t for t in engine.templates if t.id in session_ids],
+                probe_spec=probe_spec,
+                user_vars=user_vars,
+            )
         self.executor = ProbeExecutor(probe_spec)
         spec = self.executor.spec
         self.wave_rows = int(spec.get("wave_rows", 16384))
@@ -599,20 +721,29 @@ class ActiveScanner:
             "malformed": len(malformed),
             "requests_planned": len(self.plan.requests),
             "rows_probed": 0,
+            # session-handled classes aren't skips: the session pass
+            # below executes them
             "skipped_templates": {
-                k: len(v) for k, v in self.plan.skipped.items()
+                k: len(v)
+                for k, v in self.plan.skipped.items()
+                if self.session_scanner is None
+                or k not in ("extractor-chain", "multi-step-condition")
             },
             "oob_limited": len(self.oob_limited),
         }
         plan_has_work = (
-            self.plan.requests or self.plan.net_requests or self.plan.dns_qtypes
+            self.plan.requests
+            or self.plan.net_requests
+            or self.plan.dns_qtypes
+            or self.session_scanner is not None
         )
         if not targets or not plan_has_work:
             return hits, stats
 
         # liveness pre-pass: one connect per target; only live targets
-        # fan out over the full request table
-        live = self._liveness(targets) if self.plan.requests else []
+        # fan out over the full request table (and over sessions)
+        need_live = bool(self.plan.requests) or self.session_scanner is not None
+        live = self._liveness(targets) if need_live else []
         stats["live_targets"] = len(live)
 
         # index-sliced waves: never materialize the full (target × request)
@@ -639,6 +770,22 @@ class ActiveScanner:
             dns_hits, dns_rows = self._run_dns(parsed, addr_of)
             hits.extend(dns_hits)
             stats["rows_probed"] += dns_rows
+
+        # session pass: extractor-chain / multi-step-condition templates
+        # run stateful per-target flows (worker/sessions.py) — against
+        # the liveness-gated set only (dead hosts would each burn a
+        # connect timeout per session template)
+        if self.session_scanner is not None and live:
+            session_hits = self.session_scanner.run(live)
+            stats["session_templates"] = len(self.session_scanner.templates)
+            stats["session_hits"] = len(session_hits)
+            hits.extend(
+                ActiveHit(
+                    host=h.host, port=h.port, template_id=h.template_id,
+                    path="", extractions=h.extractions, tls=h.tls,
+                )
+                for h in session_hits
+            )
 
         # one line per finding: a template observed via several requests
         # on the same endpoint (e.g. {{Hostname}} + {{Host}}:<port> both

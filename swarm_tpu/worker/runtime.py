@@ -252,13 +252,17 @@ class JobProcessor:
             engine.stats.device_seconds,
             engine.stats.host_confirm_seconds,
         )
-        # keyed by probe spec too: two modules sharing a templates dir
-        # but differing in ports/timeouts/concurrency must not alias
-        probe_key = json.dumps(module.probe or {}, sort_keys=True)
+        # keyed by probe spec + vars too: two modules sharing a
+        # templates dir but differing in ports/timeouts/concurrency or
+        # operator-supplied template vars must not alias
+        user_vars = module.raw.get("vars") or None
+        probe_key = json.dumps(
+            [module.probe or {}, user_vars], sort_keys=True
+        )
         key = f"active::{module.templates_dir}::{probe_key}"
         scanner = self._engines.get(key)
         if scanner is None:
-            scanner = ActiveScanner(engine, module.probe)
+            scanner = ActiveScanner(engine, module.probe, user_vars=user_vars)
             self._engines[key] = scanner
         target_lines = data.decode("utf-8", "surrogateescape").splitlines()
         hits, stats = scanner.run(target_lines)

@@ -122,7 +122,8 @@ def test_plan_expands_payloads_and_skips_dynamic():
         "/login?u=root",
     ]
     assert "payloads" not in plan.skipped
-    assert plan.skipped["dynamic-values"] == ["demo-login-panel"]
+    # {{unknowable}} has no extractor/payload source: operator-var class
+    assert plan.skipped["requires-var"] == ["demo-login-panel"]
 
 
 def test_plan_randstr_resolves():
@@ -477,3 +478,63 @@ def test_oob_corpus_coverage():
     # the corpus carries ~150 interactsh-referencing template files
     # (SURVEY §2.3 counts 144 interactsh_protocol matcher parts)
     assert len(oob) >= 100, len(oob)
+
+
+# ---------------------------------------------------------------------------
+# Dynamic-value classification + operator-supplied vars (nuclei -var)
+# ---------------------------------------------------------------------------
+
+TOKEN_TEMPLATE = """\
+id: demo-api-token
+info:
+  severity: info
+requests:
+  - method: GET
+    path:
+      - "{{BaseURL}}/api/me"
+    headers:
+      Authorization: "Bearer {{token}}"
+    matchers:
+      - type: word
+        words: ["token-accepted"]
+"""
+
+CHAIN_TEMPLATE = """\
+id: demo-chain-login
+info:
+  severity: high
+requests:
+  - method: GET
+    path:
+      - "{{BaseURL}}/login"
+    extractors:
+      - type: regex
+        name: csrf
+        internal: true
+        regex: ['name="csrf" value="([a-f0-9]+)"']
+  - method: POST
+    path:
+      - "{{BaseURL}}/login"
+    body: "csrf={{csrf}}&user=admin"
+    matchers:
+      - type: word
+        words: ["welcome-admin"]
+"""
+
+
+def test_dynamic_skip_classification():
+    plan = active.build_plan(
+        [T(TOKEN_TEMPLATE), T(CHAIN_TEMPLATE), T(OOB_TEMPLATE)]
+    )
+    assert plan.skipped.get("requires-var") == ["demo-api-token"]
+    assert plan.skipped.get("extractor-chain") == ["demo-chain-login"]
+    assert plan.skipped.get("oob-interactsh") == ["demo-oob-rce"]
+    assert "dynamic-values" not in plan.skipped
+
+
+def test_user_vars_unlock_requires_var():
+    t = T(TOKEN_TEMPLATE)
+    plan = active.build_plan([t], user_vars={"token": "sekrit123"})
+    assert not plan.skipped
+    [req] = plan.requests
+    assert ("Authorization", "Bearer sekrit123") in list(req.headers)

@@ -1,0 +1,253 @@
+"""Stateful per-target sessions (worker/sessions.py).
+
+The two dynamic classes the batch planner cannot express, exercised
+against a live local server: a CSRF-token chain (internal extractor
+feeds the next request) and an indexed-history matcher (req-condition
+semantics over step responses).
+"""
+
+import socketserver
+import textwrap
+import threading
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+import yaml
+
+from swarm_tpu.fingerprints.nuclei import parse_template
+from swarm_tpu.worker.sessions import SessionScanner
+
+CSRF_TOKEN = "a1b2c3d4e5f6"
+
+
+class _ChainHandler(BaseHTTPRequestHandler):
+    def _send(self, code: int, body: bytes):
+        self.send_response(code)
+        self.send_header("Content-Type", "text/html")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/login":
+            self._send(
+                200,
+                b'<form><input name="csrf" value="%s"></form>'
+                % CSRF_TOKEN.encode(),
+            )
+        elif self.path == "/step1":
+            self._send(200, b"first-step-marker")
+        elif self.path == "/step2":
+            self._send(200, b"second-step-marker")
+        else:
+            self._send(404, b"nope")
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(n).decode()
+        if self.path == "/login" and f"csrf={CSRF_TOKEN}" in body:
+            self._send(200, b"welcome-admin")
+        else:
+            self._send(403, b"bad-csrf")
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def chain_port():
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _ChainHandler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def T(doc: str):
+    return parse_template(yaml.safe_load(textwrap.dedent(doc)),
+                          source_path="t/x.yaml")
+
+
+CHAIN_TEMPLATE = """\
+id: session-chain-login
+info:
+  severity: high
+requests:
+  - method: GET
+    path:
+      - "{{BaseURL}}/login"
+    extractors:
+      - type: regex
+        name: csrf
+        internal: true
+        group: 1
+        regex: ['name="csrf" value="([a-f0-9]+)"']
+  - method: POST
+    path:
+      - "{{BaseURL}}/login"
+    body: "csrf={{csrf}}&user=admin"
+    matchers:
+      - type: word
+        words: ["welcome-admin"]
+"""
+
+BROKEN_CHAIN = """\
+id: session-chain-miss
+info:
+  severity: high
+requests:
+  - method: GET
+    path:
+      - "{{BaseURL}}/step1"
+    extractors:
+      - type: regex
+        name: csrf
+        internal: true
+        group: 1
+        regex: ['value="([a-f0-9]{12})"']
+  - method: POST
+    path:
+      - "{{BaseURL}}/login"
+    body: "csrf={{csrf}}"
+    matchers:
+      - type: word
+        words: ["welcome-admin"]
+"""
+
+INDEXED_TEMPLATE = """\
+id: session-indexed
+info:
+  severity: info
+requests:
+  - raw:
+      - |
+        GET /step1 HTTP/1.1
+        Host: {{Hostname}}
+      - |
+        GET /step2 HTTP/1.1
+        Host: {{Hostname}}
+    matchers:
+      - type: dsl
+        dsl:
+          - 'contains(body_1, "first-step-marker") && contains(body_2, "second-step-marker") && status_code_1 == 200'
+"""
+
+INDEXED_PART_TEMPLATE = """\
+id: session-indexed-part
+info:
+  severity: info
+requests:
+  - raw:
+      - |
+        GET /step1 HTTP/1.1
+        Host: {{Hostname}}
+      - |
+        GET /step2 HTTP/1.1
+        Host: {{Hostname}}
+    matchers:
+      - type: word
+        part: body_2
+        words: ["second-step-marker"]
+      - type: word
+        part: body_1
+        words: ["second-step-marker"]
+"""
+
+
+def _scan(templates, port):
+    scanner = SessionScanner(templates, {"read_timeout_ms": 3000})
+    return scanner.run([("127.0.0.1", "127.0.0.1", port, False)])
+
+
+def test_csrf_chain_fires(chain_port):
+    hits = _scan([T(CHAIN_TEMPLATE)], chain_port)
+    assert [h.template_id for h in hits] == ["session-chain-login"]
+
+
+def test_broken_chain_does_not_fire(chain_port):
+    # the extractor never matches -> {{csrf}} unresolved -> no hit
+    assert _scan([T(BROKEN_CHAIN)], chain_port) == []
+
+
+def test_indexed_history_dsl(chain_port):
+    hits = _scan([T(INDEXED_TEMPLATE)], chain_port)
+    assert [h.template_id for h in hits] == ["session-indexed"]
+
+
+def test_indexed_part_matcher(chain_port):
+    # OR semantics: matcher 1 (body_2 has marker-2) fires, matcher 2
+    # (body_1 has marker-2) doesn't — template still matches
+    hits = _scan([T(INDEXED_PART_TEMPLATE)], chain_port)
+    assert [h.template_id for h in hits] == ["session-indexed-part"]
+
+
+def test_active_scanner_runs_sessions(chain_port, tmp_path):
+    """End to end through the active module: a session template fires
+    alongside the batch corpus and leaves the skipped stats."""
+    from swarm_tpu.config import Config
+    from swarm_tpu.worker.modules import ModuleSpec
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    tdir = tmp_path / "templates"
+    tdir.mkdir()
+    (tdir / "chain.yaml").write_text(CHAIN_TEMPLATE)
+    (tdir / "plain.yaml").write_text(
+        "id: plain-step1\nrequests:\n  - method: GET\n"
+        "    path: [\"{{BaseURL}}/step1\"]\n"
+        "    matchers:\n      - type: word\n        words: [\"first-step-marker\"]\n"
+    )
+    cfg = Config.load(server_url="http://127.0.0.1:1", api_key="k", worker_id="w")
+    proc = JobProcessor(cfg, client=object(), work_dir=str(tmp_path / "wd"))
+    module = ModuleSpec(
+        "active",
+        {"backend": "active", "templates": str(tdir),
+         "probe": {"ports": [chain_port], "connect_timeout_ms": 2000,
+                   "read_timeout_ms": 2000}},
+    )
+    out = proc._execute_active(module, b"127.0.0.1\n").decode()
+    assert "[session-chain-login]" in out
+    assert "[plain-step1]" in out
+
+
+NEGATIVE_INDEXED = """\
+id: session-neg-indexed
+info:
+  severity: info
+requests:
+  - raw:
+      - |
+        GET /step1 HTTP/1.1
+        Host: {{Hostname}}
+      - |
+        GET /step2 HTTP/1.1
+        Host: {{Hostname}}
+    matchers:
+      - type: word
+        part: body_2
+        negative: true
+        words: ["second-step-marker"]
+"""
+
+
+def test_negative_indexed_waits_for_history(chain_port):
+    """req-condition evaluation happens once after all steps: a
+    negative matcher on body_2 must NOT fire just because step 2
+    hadn't arrived yet when step 1 was evaluated."""
+    assert _scan([T(NEGATIVE_INDEXED)], chain_port) == []
+
+
+def test_session_only_corpus_still_scans(chain_port):
+    """A corpus of only session-class templates produces hits even
+    though the batch plan is empty (regression: the early no-work
+    return used to skip the session pass)."""
+    from swarm_tpu.ops.engine import MatchEngine
+    from swarm_tpu.worker.active import ActiveScanner
+
+    eng = MatchEngine([T(CHAIN_TEMPLATE)])
+    scanner = ActiveScanner(
+        eng, {"ports": [chain_port], "connect_timeout_ms": 2000,
+              "read_timeout_ms": 2000},
+    )
+    assert scanner.plan.requests == []  # nothing batchable, no orphans
+    hits, stats = scanner.run([f"127.0.0.1:{chain_port}"])
+    assert [h.template_id for h in hits] == ["session-chain-login"]
+    assert stats["session_hits"] == 1
