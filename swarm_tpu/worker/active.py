@@ -286,11 +286,13 @@ def _parse_raw(raw: str) -> Optional[PlannedRequest]:
         return None
     method, path = first[0].upper(), first[1]
     if not path.startswith("/"):
-        # absolute-URL raw requests target other hosts — out of scope
         if path.startswith("\x00BASE\x00"):
             path = path[len("\x00BASE\x00"):] or "/"
-        else:
+        elif path.startswith(("http://", "https://")):
+            # absolute-URL raws target other hosts — out of scope
             return None
+        # else: verbatim request-target (nuclei sends raws as written —
+        # e.g. CVE-2018-16133's backslash path-traversal probe)
     headers = []
     for line in lines[1:]:
         if ":" not in line:
@@ -342,7 +344,7 @@ def _unresolved_names(t: Template) -> set:
     return out
 
 
-def _classify_dynamic(t: Template) -> str:
+def _classify_dynamic(t: Template, user_vars: Optional[dict] = None) -> str:
     """Honest skip bucket for a template with unresolved placeholders:
 
     - ``oob-interactsh`` — needs an out-of-band interaction server
@@ -356,7 +358,7 @@ def _classify_dynamic(t: Template) -> str:
     """
     if _uses_oob(t):
         return "oob-interactsh"
-    sources: set = set()
+    sources: set = set(user_vars or ())
     for op in t.operations:
         sources |= {ex.name for ex in op.extractors if ex.name}
         sources |= set(op.payloads.keys())
@@ -524,7 +526,17 @@ def build_plan(
                             break
                         req = _parse_raw(sub)
                         if req is None:
-                            step_fail = "raw-unparseable"
+                            # @Host:-annotated and absolute-URL raws
+                            # address third-party hosts, not the target
+                            step_fail = (
+                                "external-target"
+                                if "@Host:" in step
+                                or sub.lstrip().split(None, 2)[1:2]
+                                and sub.lstrip().split(None, 2)[1].startswith(
+                                    ("http://", "https://")
+                                )
+                                else "raw-unparseable"
+                            )
                             break
                         step_reqs.append(req)
                     if step_fail:
@@ -536,7 +548,10 @@ def build_plan(
                     planned_matchers = planned_matchers or bool(op.matchers)
                     continue
                 method = (op.method or "GET").upper()
-                if method not in ("GET", "POST", "PUT", "HEAD", "OPTIONS"):
+                if method not in (
+                    "GET", "POST", "PUT", "HEAD", "OPTIONS",
+                    "DELETE", "PATCH", "PURGE", "TRACE",
+                ):
                     unsupported = f"method-{method}"
                     continue
                 body_t = _substitute(op.body or "", payload_vars)
@@ -594,7 +609,7 @@ def build_plan(
             ok = False
         if not ok and unsupported:
             if unsupported == "dynamic-values":
-                unsupported = _classify_dynamic(t)
+                unsupported = _classify_dynamic(t, user_vars)
             skip(unsupported, t)
 
     # drop orphaned requests (a retracted partial template may leave a

@@ -74,14 +74,19 @@ def _request_once(
             chunks = []
             total = 0
             while total < 1 << 20:  # 1 MiB response cap
-                chunk = sock.recv(65536)
+                try:
+                    chunk = sock.recv(65536)
+                except TimeoutError:
+                    # server ignored Connection: close / keeps streaming
+                    # — whatever arrived is still a usable response
+                    break
                 if not chunk:
                     break
                 chunks.append(chunk)
                 total += len(chunk)
             if tls:
                 sock.close()
-            return b"".join(chunks)
+            return b"".join(chunks) if chunks else None
     except (OSError, pyssl.SSLError, ValueError):
         return None
 
@@ -101,13 +106,19 @@ def _history_env(responses: Sequence[Response]) -> dict:
     return env
 
 
-def _indexed_part(responses: Sequence[Response], part: str) -> Optional[bytes]:
+_MISSING = object()  # indexed step that was never fetched
+
+
+def _indexed_part(responses: Sequence[Response], part: str):
+    """bytes for an indexed part, None when the part isn't indexed, or
+    ``_MISSING`` when the referenced step was never fetched (truncated
+    session) — a missing step must evaluate False, never empty-match."""
     m = _INDEXED_RE.fullmatch(part or "")
     if not m:
         return None
     name, idx = m.group(1), int(m.group(2))
     if not 1 <= idx <= len(responses):
-        return b""
+        return _MISSING
     base = {"response": "raw", "status_code": "status_code"}.get(name, name)
     if base == "status_code":
         return str(responses[idx - 1].status).encode()
@@ -132,6 +143,8 @@ def _eval_matcher(m, responses: Sequence[Response]) -> bool:
         v = all(vs) if m.condition == "and" else any(vs)
         return (not v) if m.negative else v
     data = _indexed_part(responses, m.part)
+    if data is _MISSING:
+        return False  # phantom step: no matcher may fire on it
     if data is not None:
         # evaluate against a synthetic response whose body is the
         # indexed slice, with the part rewritten to plain "body"
@@ -181,7 +194,29 @@ class SessionScanner:
     def _run_one(
         self, t: Template, host: str, ip: str, port: int, tls: bool
     ) -> Optional[SessionHit]:
-        vars_: dict = dict(self.user_vars)
+        """One (target, template): payload-bearing templates fan out
+        over their (bounded) combo set, first hit wins — nuclei's
+        payload semantics for stateful flows (default-logins with
+        req-condition etc.)."""
+        combos: list = [None]
+        for op in t.operations:
+            if op.payloads:
+                combos = planner._payload_combos(op, t.source_path) or [None]
+                break
+        for combo in combos:
+            hit = self._run_combo(
+                t, host, ip, port, tls,
+                {**self.user_vars, **(combo or {})},
+            )
+            if hit is not None:
+                return hit
+        return None
+
+    def _run_combo(
+        self, t: Template, host: str, ip: str, port: int, tls: bool,
+        base_vars: dict,
+    ) -> Optional[SessionHit]:
+        vars_: dict = dict(base_vars)
         responses: list[Response] = []
         op_results: dict[int, list[bool]] = {}
         extractions: list[str] = []

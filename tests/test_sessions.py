@@ -46,6 +46,8 @@ class _ChainHandler(BaseHTTPRequestHandler):
         body = self.rfile.read(n).decode()
         if self.path == "/login" and f"csrf={CSRF_TOKEN}" in body:
             self._send(200, b"welcome-admin")
+        elif self.path == "/plogin" and "user=admin&pass=letmein" in body:
+            self._send(200, b"payload-welcome")
         else:
             self._send(403, b"bad-csrf")
 
@@ -251,3 +253,71 @@ def test_session_only_corpus_still_scans(chain_port):
     hits, stats = scanner.run([f"127.0.0.1:{chain_port}"])
     assert [h.template_id for h in hits] == ["session-chain-login"]
     assert stats["session_hits"] == 1
+
+
+PAYLOAD_SESSION = """\
+id: session-payload-login
+info:
+  severity: critical
+requests:
+  - raw:
+      - |
+        GET /step1 HTTP/1.1
+        Host: {{Hostname}}
+      - |
+        POST /plogin HTTP/1.1
+        Host: {{Hostname}}
+        Content-Type: application/x-www-form-urlencoded
+
+        user={{user}}&pass={{pass}}
+    attack: pitchfork
+    payloads:
+      user:
+        - root
+        - admin
+      pass:
+        - toor
+        - letmein
+    matchers:
+      - type: dsl
+        dsl:
+          - 'contains(body_2, "payload-welcome") && status_code_1 == 200'
+"""
+
+
+def test_payload_session_fans_out(chain_port):
+    """A payload-bearing req-condition template tries its combos per
+    target; the (admin, letmein) pitchfork pair fires."""
+    hits = _scan([T(PAYLOAD_SESSION)], chain_port)
+    assert [h.template_id for h in hits] == ["session-payload-login"]
+
+
+def test_user_var_plus_extractor_is_session_class():
+    """A template mixing an operator var with an extractor chain is
+    extractor-chain (executable as a session) once the var is
+    supplied — not requires-var."""
+    from swarm_tpu.worker import active
+
+    t = T("""\
+id: mixed-var-chain
+requests:
+  - method: GET
+    path: ["{{BaseURL}}/login"]
+    headers:
+      Authorization: "Bearer {{token}}"
+    extractors:
+      - type: regex
+        name: csrf
+        internal: true
+        regex: ['value="([a-f0-9]+)"']
+  - method: POST
+    path: ["{{BaseURL}}/login"]
+    body: "csrf={{csrf}}"
+    matchers:
+      - type: word
+        words: ["welcome-admin"]
+""")
+    plan = active.build_plan([t])
+    assert plan.skipped.get("requires-var") == ["mixed-var-chain"]
+    plan2 = active.build_plan([t], user_vars={"token": "sek"})
+    assert plan2.skipped.get("extractor-chain") == ["mixed-var-chain"]
