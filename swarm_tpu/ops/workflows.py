@@ -54,28 +54,48 @@ class WorkflowRunner:
         results = self.engine.match(rows)
         out = []
         for row, rm in zip(rows, results):
-            hit_ids = set(rm.template_ids)
-            names_cache: dict[str, list[str]] = {}
-            per: dict[str, list[str]] = {}
-            for wf in self.workflows:
-                matched = self._eval_workflow(wf, row, hit_ids, names_cache)
-                if matched:
-                    per[wf.id] = sorted(matched)
-            out.append(per)
+            out.append(
+                self.evaluate_hits(
+                    set(rm.template_ids), lambda _tid, _r=row: [_r]
+                )
+            )
         return out
+
+    def evaluate_hits(self, hit_ids: set, row_of) -> dict[str, list[str]]:
+        """Workflow gating over an already-matched hit set.
+
+        ``row_of(template_id)`` returns the Response list whose matches
+        fired that template — named-matcher gates re-confirm against
+        every one (a gate fires if its name fired on ANY of them). This
+        is the production entry for the active scanner, where each
+        template's hits came from its own requests' responses.
+        """
+        names_cache: dict[str, list[str]] = {}
+        per: dict[str, list[str]] = {}
+        for wf in self.workflows:
+            matched = self._eval_workflow(wf, row_of, hit_ids, names_cache)
+            if matched:
+                per[wf.id] = sorted(matched)
+        return per
 
     # ------------------------------------------------------------------
     def _matcher_names(
-        self, template: Template, row: Response, cache: dict[str, list[str]]
+        self, template: Template, row_of, cache: dict[str, list[str]]
     ) -> list[str]:
-        """Named matchers of ``template`` that fired on ``row`` — host
-        confirm on demand, once per (row, template)."""
+        """Named matchers of ``template`` that fired on any of its rows
+        — host confirm on demand, once per template."""
         if template.id not in cache:
-            cache[template.id] = cpu_ref.match_template(template, row).matcher_names
+            names: list[str] = []
+            for row in row_of(template.id) or []:
+                if row is not None:
+                    names.extend(
+                        cpu_ref.match_template(template, row).matcher_names
+                    )
+            cache[template.id] = sorted(set(names))
         return cache[template.id]
 
     def _eval_workflow(
-        self, wf: Workflow, row: Response, hit_ids: set, cache: dict
+        self, wf: Workflow, row_of, hit_ids: set, cache: dict
     ) -> set:
         matched: set = set()
         for step in wf.steps:
@@ -90,34 +110,34 @@ class WorkflowRunner:
                 if trigger.id not in hit_ids:
                     continue
                 if step.matchers:
-                    fired = self._matcher_names(trigger, row, cache)
+                    fired = self._matcher_names(trigger, row_of, cache)
                     for gate in step.matchers:
                         if gate.name in fired:
                             for ref in gate.subtemplates:
-                                matched |= self._eval_ref(ref, row, hit_ids, cache)
+                                matched |= self._eval_ref(ref, row_of, hit_ids, cache)
                 elif step.subtemplates:
                     for ref in step.subtemplates:
-                        matched |= self._eval_ref(ref, row, hit_ids, cache)
+                        matched |= self._eval_ref(ref, row_of, hit_ids, cache)
                 else:
                     matched.add(trigger.id)
         return matched
 
     def _eval_ref(
-        self, ref: SubtemplateRef, row: Response, hit_ids: set, cache: dict
+        self, ref: SubtemplateRef, row_of, hit_ids: set, cache: dict
     ) -> set:
         matched: set = set()
         for t in self.index.resolve(ref):
             if t.id not in hit_ids:
                 continue
             if ref.matchers:
-                fired = self._matcher_names(t, row, cache)
+                fired = self._matcher_names(t, row_of, cache)
                 for gate in ref.matchers:
                     if gate.name in fired:
                         for sub in gate.subtemplates:
-                            matched |= self._eval_ref(sub, row, hit_ids, cache)
+                            matched |= self._eval_ref(sub, row_of, hit_ids, cache)
             elif ref.subtemplates:
                 for sub in ref.subtemplates:
-                    matched |= self._eval_ref(sub, row, hit_ids, cache)
+                    matched |= self._eval_ref(sub, row_of, hit_ids, cache)
             else:
                 matched.add(t.id)
         return matched
@@ -139,7 +159,8 @@ class WorkflowRunner:
             for t in tech_templates:
                 if t.id in hit_ids:
                     techs.update(
-                        n.lower() for n in self._matcher_names(t, row, cache)
+                        n.lower()
+                        for n in self._matcher_names(t, lambda _tid, _r=row: [_r], cache)
                     )
             tags: set[str] = set()
             for tech in techs:

@@ -9,10 +9,11 @@ This module is the TPU-shaped equivalent:
    compiled and deduplicated into a flat request table — measured on the
    corpus: 2,816 simple-GET templates collapse onto ~3.2k distinct
    paths, 559 of them sharing bare ``{{BaseURL}}`` (SURVEY.md §2.3).
-   GET/POST and single-step fully-resolvable ``raw`` requests are
-   supported; payload/fuzzing templates, multi-step raw chains with
-   dynamic values, and redirect-dependent flows are skipped and counted
-   (they need stateful per-target sessions, not batch I/O).
+   Standard methods, payload fan-outs, and fully-resolvable ``raw``
+   requests plan as batch work; extractor-chain and req-condition
+   templates route to stateful per-target sessions
+   (worker/sessions.py); the remaining skip classes are counted
+   honestly (oob-interactsh / requires-var / external-target).
 2. **Probe** (native I/O): the (target × request) fan-out runs in waves
    through the epoll front-end — the same massive concurrency nuclei
    gets from its internal scheduler, but as flat batches.
@@ -640,6 +641,9 @@ class ActiveHit:
     path: str
     extractions: list[str]
     tls: bool = False  # how the hit's request was actually probed
+    # the response that fired the hit (internal: workflow named-matcher
+    # gates re-confirm against it; never rendered into output)
+    row: Optional[Response] = None
 
 
 def _uses_oob(t: Template) -> bool:
@@ -696,6 +700,15 @@ class ActiveScanner:
                 [t for t in engine.templates if t.id in session_ids],
                 probe_spec=probe_spec,
                 user_vars=user_vars,
+            )
+        # workflow templates gate which hits report (ops/workflows.py);
+        # evaluation reuses this scanner's engine — no extra compile
+        self.workflow_runner = None
+        if any(t.protocol == "workflow" for t in engine.templates):
+            from swarm_tpu.ops.workflows import WorkflowRunner
+
+            self.workflow_runner = WorkflowRunner(
+                engine.templates, engine=engine
             )
         self.executor = ProbeExecutor(probe_spec)
         spec = self.executor.spec
@@ -798,6 +811,9 @@ class ActiveScanner:
                 ActiveHit(
                     host=h.host, port=h.port, template_id=h.template_id,
                     path="", extractions=h.extractions, tls=h.tls,
+                    # the final step's response stands in for workflow
+                    # named-matcher gates on session templates
+                    row=h.row if self.workflow_runner is not None else None,
                 )
                 for h in session_hits
             )
@@ -812,6 +828,42 @@ class ActiveScanner:
             if key not in seen:
                 seen.add(key)
                 unique.append(h)
+
+        # workflow pass: per-HOST gating over the hit set — a workflow
+        # fires when its trigger matched and its (possibly named-
+        # matcher-scoped) subtemplates matched on the same input target,
+        # regardless of which port/protocol each hit arrived on
+        # (nuclei runs a workflow's steps against one input host)
+        if self.workflow_runner is not None:
+            stats["workflow_hits"] = 0
+            by_host: dict[str, dict] = {}
+            for h in unique:
+                by_host.setdefault(h.host, {}).setdefault(
+                    h.template_id, []
+                ).append(h)
+            wf_hits: list[ActiveHit] = []
+            for host, hitmap in by_host.items():
+                per = self.workflow_runner.evaluate_hits(
+                    set(hitmap),
+                    lambda tid, _m=hitmap: [
+                        hh.row for hh in _m.get(tid, [])
+                    ],
+                )
+                first = next(iter(hitmap.values()))[0]
+                for wid, sub_ids in sorted(per.items()):
+                    wf_hits.append(
+                        ActiveHit(
+                            host=host, port=first.port, template_id=wid,
+                            path="", extractions=sorted(sub_ids),
+                            tls=first.tls,
+                        )
+                    )
+            stats["workflow_hits"] = len(wf_hits)
+            unique.extend(wf_hits)
+        # the rows only existed for workflow re-confirmation — don't
+        # keep every matched response body alive in the hit list
+        for h in unique:
+            h.row = None
         return unique, stats
 
     # ------------------------------------------------------------------
@@ -837,7 +889,9 @@ class ActiveScanner:
         out: list[ActiveHit] = []
         if not rows:
             return out
-        for (host, port, tls, r_idx, path), rm in zip(meta, self.engine.match(rows)):
+        matched = self.engine.match(rows)
+        keep_rows = self.workflow_runner is not None  # rows feed gates
+        for row, (host, port, tls, r_idx, path), rm in zip(rows, meta, matched):
             owner_ids = owner_table[r_idx]
             for tid in rm.template_ids:
                 if tid in owner_ids:
@@ -849,6 +903,7 @@ class ActiveScanner:
                             path=path,
                             extractions=rm.extractions.get(tid, []),
                             tls=tls,
+                            row=row if keep_rows else None,
                         )
                     )
         return out

@@ -49,6 +49,9 @@ class SessionHit:
     template_id: str
     extractions: list[str]
     tls: bool = False
+    # final step's response (workflow named-matcher gates re-confirm
+    # against it; None outside workflow contexts)
+    row: Optional[Response] = None
 
 
 def _request_once(
@@ -304,6 +307,7 @@ class SessionScanner:
             return SessionHit(
                 host=host, port=port, template_id=t.id,
                 extractions=extractions, tls=tls,
+                row=responses[-1] if responses else None,
             )
         return None
 

@@ -118,3 +118,50 @@ def test_reference_workflows_parse():
         s for p in parsed for s in p.steps if s.template or s.tags
     ]
     assert with_trigger
+
+
+def test_workflow_fires_in_active_scan(tmp_path):
+    """Production path: an active scan over a corpus containing a
+    workflow emits a workflow hit (named-matcher gate re-confirmed on
+    the hit's own response) only when trigger + subtemplates matched."""
+    import socketserver
+    import threading
+    from http.server import BaseHTTPRequestHandler
+
+    from swarm_tpu.ops.engine import MatchEngine
+    from swarm_tpu.worker.active import ActiveScanner
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = (b"<html><body>site powered by AcmeCMS, "
+                    b"demo-build 3.11</body></html>")
+            self.send_response(200)
+            self.send_header("X-Widget-Version", "4.2")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    try:
+        templates, errors = load_corpus(DATA / "templates")
+        assert not errors
+        eng = MatchEngine(templates)
+        scanner = ActiveScanner(
+            eng, {"ports": [port], "connect_timeout_ms": 2000,
+                  "read_timeout_ms": 2000},
+        )
+        assert scanner.workflow_runner is not None
+        hits, stats = scanner.run([f"127.0.0.1:{port}"])
+        by_id = {h.template_id: h for h in hits}
+        assert "demo-tech" in by_id and "demo-acme-vuln" in by_id
+        wf = by_id.get("demo-workflow")
+        assert wf is not None, sorted(by_id)
+        assert wf.extractions == ["demo-acme-vuln"]
+        assert stats["workflow_hits"] == 1
+    finally:
+        srv.shutdown()
