@@ -701,6 +701,33 @@ class ActiveScanner:
                 probe_spec=probe_spec,
                 user_vars=user_vars,
             )
+        # ssl-protocol templates run alongside the http corpus (nuclei
+        # host-scan parity); their hits join the workflow hit set
+        self.ssl_scanner = None
+        self._ssl_ports: list[int] = []
+        ssl_templates = [t for t in engine.templates if t.protocol == "ssl"]
+        if ssl_templates:
+            from swarm_tpu.worker import sslscan
+
+            spec0 = probe_spec or {}
+            self.ssl_scanner = sslscan.SslScanner(
+                ssl_templates,
+                concurrency=int(spec0.get("concurrency", 32)),
+                timeout=float(spec0.get("connect_timeout_ms", 4000)) / 1000.0,
+            )
+            # portless targets follow the module's port fan-out minus
+            # known-plaintext ports; explicit ssl_ports wins verbatim;
+            # nothing TLS-plausible configured → nuclei's default 443
+            if "ssl_ports" in spec0:
+                self._ssl_ports = [
+                    int(p) for p in spec0["ssl_ports"]
+                ] or [443]
+            else:
+                self._ssl_ports = [
+                    int(p)
+                    for p in spec0.get("ports", [443])
+                    if int(p) not in sslscan.PLAINTEXT_PORTS
+                ] or [443]
         # workflow templates gate which hits report (ops/workflows.py);
         # evaluation reuses this scanner's engine — no extra compile
         self.workflow_runner = None
@@ -818,6 +845,22 @@ class ActiveScanner:
                 for h in session_hits
             )
 
+        # ssl-protocol pass: version-pinned handshakes + session/cert
+        # document matchers (worker/sslscan.py); hits participate in
+        # workflow gating below like any other protocol's
+        if self.ssl_scanner is not None:
+            ssl_findings, ssl_stats = self.ssl_scanner.scan(
+                target_lines, default_ports=self._ssl_ports
+            )
+            stats["ssl_targets"] = ssl_stats["targets"]
+            hits.extend(
+                ActiveHit(
+                    host=f.host, port=f.port, template_id=f.template_id,
+                    path="", extractions=f.extractions, tls=True,
+                )
+                for f in ssl_findings
+            )
+
         # one line per finding: a template observed via several requests
         # on the same endpoint (e.g. {{Hostname}} + {{Host}}:<port> both
         # landing on one service) reports once, as nuclei does
@@ -829,33 +872,48 @@ class ActiveScanner:
                 seen.add(key)
                 unique.append(h)
 
-        # workflow pass: per-HOST gating over the hit set — a workflow
-        # fires when its trigger matched and its (possibly named-
-        # matcher-scoped) subtemplates matched on the same input target,
-        # regardless of which port/protocol each hit arrived on
-        # (nuclei runs a workflow's steps against one input host)
+        # workflow pass: per-(host, port) gating — nuclei's workflow
+        # unit is one input target, so trigger and subtemplates must
+        # have matched the same service. Port-less protocol hits (dns:
+        # port 0) describe the host, not a service, and join every
+        # service group of their host.
         if self.workflow_runner is not None:
             stats["workflow_hits"] = 0
-            by_host: dict[str, dict] = {}
+            groups: dict[tuple, dict] = {}
+            hostwide: dict[str, list] = {}
             for h in unique:
-                by_host.setdefault(h.host, {}).setdefault(
-                    h.template_id, []
-                ).append(h)
+                if h.port == 0:
+                    hostwide.setdefault(h.host, []).append(h)
+                else:
+                    groups.setdefault((h.host, h.port), {}).setdefault(
+                        h.template_id, []
+                    ).append(h)
+            for host, hs in hostwide.items():
+                host_groups = [
+                    g for (gh, _p), g in groups.items() if gh == host
+                ] or [groups.setdefault((host, 0), {})]
+                for g in host_groups:
+                    for h in hs:
+                        g.setdefault(h.template_id, []).append(h)
             wf_hits: list[ActiveHit] = []
-            for host, hitmap in by_host.items():
+            for (host, port), hitmap in groups.items():
                 per = self.workflow_runner.evaluate_hits(
                     set(hitmap),
                     lambda tid, _m=hitmap: [
                         hh.row for hh in _m.get(tid, [])
                     ],
                 )
-                first = next(iter(hitmap.values()))[0]
                 for wid, sub_ids in sorted(per.items()):
+                    # report at the matched subtemplate's service
+                    anchor = next(
+                        (hitmap[s][0] for s in sub_ids if s in hitmap),
+                        next(iter(hitmap.values()))[0],
+                    )
                     wf_hits.append(
                         ActiveHit(
-                            host=host, port=first.port, template_id=wid,
+                            host=host, port=anchor.port, template_id=wid,
                             path="", extractions=sorted(sub_ids),
-                            tls=first.tls,
+                            tls=anchor.tls,
                         )
                     )
             stats["workflow_hits"] = len(wf_hits)

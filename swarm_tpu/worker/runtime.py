@@ -281,43 +281,6 @@ class JobProcessor:
                 f"[{h.template_id}] [{p}] [{sev.get(h.template_id, 'info')}] "
                 f"{target}{extra}"
             )
-        # nuclei parity: a host scan also executes the corpus's
-        # ssl-protocol templates (nuclei runs them alongside http/
-        # network/dns; the active planner itself skips them)
-        ssl_templates = [
-            t for t in engine.templates if t.protocol == "ssl"
-        ]
-        if ssl_templates:
-            from swarm_tpu.worker import sslscan
-
-            ssl_key = f"activessl::{module.templates_dir}::{probe_key}"
-            ssl_scanner = self._engines.get(ssl_key)
-            if ssl_scanner is None:
-                probe = module.probe or {}
-                ssl_scanner = sslscan.SslScanner(
-                    ssl_templates,
-                    concurrency=int(probe.get("concurrency", 32)),
-                    timeout=float(probe.get("connect_timeout_ms", 4000))
-                    / 1000.0,
-                )
-                self._engines[ssl_key] = ssl_scanner
-            # portless targets follow the module's port fan-out minus
-            # known-plaintext ports (a handshake to 80/8080 can only
-            # burn its timeout); nonstandard TLS ports stay covered.
-            # Nothing TLS-plausible configured → nuclei's default 443.
-            probe = module.probe or {}
-            if "ssl_ports" in probe:  # explicit override: honored as-is
-                tls_ports = [int(p) for p in probe["ssl_ports"]] or [443]
-            else:
-                tls_ports = [
-                    int(p)
-                    for p in probe.get("ports", [443])
-                    if int(p) not in sslscan.PLAINTEXT_PORTS
-                ] or [443]
-            ssl_findings, _ssl_stats = ssl_scanner.scan(
-                target_lines, default_ports=tls_ports
-            )
-            lines.extend(sslscan.format_lines(ssl_findings))
         print(
             f"active scan: {stats['rows_probed']} requests over "
             f"{stats.get('live_targets', 0)} live targets, {len(lines)} hits"
@@ -501,14 +464,54 @@ class JobProcessor:
                 if row is not None:
                     rows.append(row)
             results = engine.match(rows)
+        # workflow gating over the already-matched rows (ops/workflows):
+        # one wf line per row where a trigger gated matching subtemplates
+        wf_lines: list[str] = []
+        if any(t.protocol == "workflow" for t in engine.templates):
+            from swarm_tpu.ops.workflows import WorkflowRunner
+
+            wkey = f"wfrunner::{module.templates_dir}"
+            runner = self._engines.get(wkey)
+            if runner is None:
+                runner = WorkflowRunner(engine.templates, engine=engine)
+                self._engines[wkey] = runner
+            jsonl = module.output_format != "nuclei"
+            for row, rm in zip(rows, results):
+                if not rm.template_ids:
+                    continue  # nothing matched: no workflow can trigger
+                per = runner.evaluate_hits(
+                    set(rm.template_ids), lambda _tid, _r=row: [_r]
+                )
+                for wid, sub_ids in sorted(per.items()):
+                    if jsonl:  # keep the jsonl contract machine-readable
+                        wf_lines.append(
+                            json.dumps(
+                                {
+                                    "workflow": wid,
+                                    "host": row.host,
+                                    "port": row.port,
+                                    "matches": sub_ids,
+                                },
+                                sort_keys=True,
+                            )
+                        )
+                    else:
+                        wf_lines.append(
+                            f"[{wid}] [workflow] {row.host}:{row.port} "
+                            f"[{','.join(sub_ids)}]"
+                        )
         if module.output_format == "nuclei":
             from swarm_tpu.worker import formats
 
             sev, proto = formats.severity_index(engine.templates)
-            return formats.format_nuclei(rows, results, sev, proto).encode()
+            out = formats.format_nuclei(rows, results, sev, proto)
+            if wf_lines:
+                out = out + "\n".join(wf_lines) + "\n"
+            return out.encode()
         out_lines = [
             format_match_line(row, matches) for row, matches in zip(rows, results)
         ]
+        out_lines += wf_lines
         return ("\n".join(out_lines) + "\n").encode() if out_lines else b""
 
     # ------------------------------------------------------------------

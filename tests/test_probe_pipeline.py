@@ -174,8 +174,13 @@ def test_probe_to_match_end_to_end(http_port, tmp_path, monkeypatch):
 
         raw = client.fetch_raw(scan_id)
         lines = [json.loads(l) for l in raw.strip().splitlines()]
-        assert len(lines) == 1
-        hit = lines[0]
+        # one match record + one workflow record (demo-workflow gates
+        # demo-acme-vuln behind the acme-cms tech detection)
+        assert len(lines) == 2
+        wf = [l for l in lines if "workflow" in l]
+        assert wf and wf[0]["workflow"] == "demo-workflow"
+        assert wf[0]["matches"] == ["demo-acme-vuln"]
+        hit = next(l for l in lines if "workflow" not in l)
         assert hit["port"] == http_port
         # demo-panel: title+build words AND status 200; demo-tech: header
         # regex + negative-word matcher

@@ -165,3 +165,36 @@ def test_workflow_fires_in_active_scan(tmp_path):
         assert stats["workflow_hits"] == 1
     finally:
         srv.shutdown()
+
+
+def test_workflow_in_tpu_backend(tmp_path):
+    """The passive fingerprint (tpu) backend also reports workflow
+    gating over its matched rows."""
+    import base64
+    import json as _json
+
+    from swarm_tpu.config import Config
+    from swarm_tpu.worker.modules import ModuleSpec
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    cfg = Config.load(server_url="http://127.0.0.1:1", api_key="k", worker_id="w")
+    proc = JobProcessor(cfg, client=object(), work_dir=str(tmp_path / "wd"))
+    module = ModuleSpec(
+        "fingerprint",
+        {"backend": "tpu", "templates": str(DATA / "templates")},
+    )
+    row = {
+        "host": "10.0.0.1", "port": 80, "status": 200,
+        "body_b64": base64.b64encode(
+            b"<html><body>site powered by AcmeCMS, demo-build 3.11"
+            b"</body></html>").decode(),
+        "header_b64": base64.b64encode(
+            b"HTTP/1.1 200 OK\r\nX-Widget-Version: 4.2").decode(),
+    }
+    out = proc._execute_tpu(module, (_json.dumps(row) + "\n").encode()).decode()
+    assert "demo-acme-vuln" in out
+    # jsonl contract holds: every line parses, workflow record present
+    records = [_json.loads(l) for l in out.strip().splitlines()]
+    wf = [r for r in records if r.get("workflow") == "demo-workflow"]
+    assert wf and wf[0]["matches"] == ["demo-acme-vuln"]
+    assert wf[0]["host"] == "10.0.0.1" and wf[0]["port"] == 80
