@@ -61,7 +61,9 @@ class WorkflowRunner:
             )
         return out
 
-    def evaluate_hits(self, hit_ids: set, row_of) -> dict[str, list[str]]:
+    def evaluate_hits(
+        self, hit_ids: set, row_of, known_names: Optional[dict] = None
+    ) -> dict[str, list[str]]:
         """Workflow gating over an already-matched hit set.
 
         ``row_of(template_id)`` returns the Response list whose matches
@@ -70,7 +72,9 @@ class WorkflowRunner:
         is the production entry for the active scanner, where each
         template's hits came from its own requests' responses.
         """
-        names_cache: dict[str, list[str]] = {}
+        # pre-seeded fired-name lists (e.g. the ssl scanner records its
+        # own named-matcher verdicts) take precedence over re-confirming
+        names_cache: dict[str, list[str]] = dict(known_names or {})
         per: dict[str, list[str]] = {}
         for wf in self.workflows:
             matched = self._eval_workflow(wf, row_of, hit_ids, names_cache)

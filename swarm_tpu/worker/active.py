@@ -644,6 +644,9 @@ class ActiveHit:
     # the response that fired the hit (internal: workflow named-matcher
     # gates re-confirm against it; never rendered into output)
     row: Optional[Response] = None
+    # fired named matchers, when the producing engine knows them (ssl
+    # hits have no Response row to re-confirm against)
+    matcher_names: list[str] = dataclasses.field(default_factory=list)
 
 
 def _uses_oob(t: Template) -> bool:
@@ -791,6 +794,7 @@ class ActiveScanner:
             or self.plan.net_requests
             or self.plan.dns_qtypes
             or self.session_scanner is not None
+            or self.ssl_scanner is not None
         )
         if not targets or not plan_has_work:
             return hits, stats
@@ -857,6 +861,7 @@ class ActiveScanner:
                 ActiveHit(
                     host=f.host, port=f.port, template_id=f.template_id,
                     path="", extractions=f.extractions, tls=True,
+                    matcher_names=f.matcher_names,
                 )
                 for f in ssl_findings
             )
@@ -896,12 +901,23 @@ class ActiveScanner:
                     for h in hs:
                         g.setdefault(h.template_id, []).append(h)
             wf_hits: list[ActiveHit] = []
+            seen_wf: set = set()
             for (host, port), hitmap in groups.items():
+                # ssl hits carry no Response row; their fired matcher
+                # names were recorded by the ssl scanner itself
+                known = {
+                    tid: sorted(
+                        {n for hh in hhs for n in hh.matcher_names}
+                    )
+                    for tid, hhs in hitmap.items()
+                    if any(hh.matcher_names for hh in hhs)
+                }
                 per = self.workflow_runner.evaluate_hits(
                     set(hitmap),
                     lambda tid, _m=hitmap: [
                         hh.row for hh in _m.get(tid, [])
                     ],
+                    known_names=known,
                 )
                 for wid, sub_ids in sorted(per.items()):
                     # report at the matched subtemplate's service
@@ -909,6 +925,12 @@ class ActiveScanner:
                         (hitmap[s][0] for s in sub_ids if s in hitmap),
                         next(iter(hitmap.values()))[0],
                     )
+                    key = (host, anchor.port, wid, tuple(sorted(sub_ids)))
+                    if key in seen_wf:
+                        # a hostwide (port-0) trigger+sub pair joined
+                        # several service groups — report it once
+                        continue
+                    seen_wf.add(key)
                     wf_hits.append(
                         ActiveHit(
                             host=host, port=anchor.port, template_id=wid,

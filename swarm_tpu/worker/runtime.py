@@ -304,6 +304,17 @@ class JobProcessor:
                     f"# [{tid}] [headless-skipped] requires a browser "
                     "engine; not evaluated"
                 )
+            # compact coverage summary: one line per remaining skip
+            # class (file/ssl run under their own modules; sessions
+            # execute the chain classes, so those aren't listed here)
+            for reason, ids in sorted(stats["skipped_templates"].items()):
+                if reason.startswith("protocol-") or reason in (
+                    "oob-interactsh",
+                ):
+                    continue  # surfaced above / handled elsewhere
+                lines.append(
+                    f"# [coverage] {reason}: {ids} templates not executed"
+                )
         return ("\n".join(lines) + "\n").encode() if lines else b""
 
     # ------------------------------------------------------------------

@@ -71,6 +71,9 @@ class SslFinding:
     port: int
     severity: str = "info"
     extractions: list[str] = dataclasses.field(default_factory=list)
+    # named matchers that fired (workflow gates consume these — ssl
+    # docs can't be re-confirmed through the generic cpu oracle)
+    matcher_names: list[str] = dataclasses.field(default_factory=list)
 
 
 def _cert_doc(der: bytes) -> dict:
@@ -202,8 +205,9 @@ class SslScanner:
     # ------------------------------------------------------------------
     def _eval_operation(
         self, op, doc: dict, host: str, port: int
-    ) -> tuple[bool, list[str]]:
-        """(matched, extracted) for one ssl op given a session doc."""
+    ) -> tuple[bool, list[str], list[str]]:
+        """(matched, extracted, fired_matcher_names) for one ssl op
+        given a session doc."""
         body = json.dumps(doc, separators=(",", ":")).encode()
         row = Response(host=host, port=port, body=body, tls=True)
         # internal named extractors feed the dsl environment
@@ -225,8 +229,9 @@ class SslScanner:
         if not op.matchers:
             # extractor-only entries fire when anything extracted
             # (tls-version.yaml / ssl-dns-names.yaml)
-            return bool(out), out
+            return bool(out), out, []
         verdicts: list[bool] = []
+        fired_names: list[str] = []
         for m in op.matchers:
             if m.type == "dsl":
                 vs = []
@@ -246,10 +251,12 @@ class SslScanner:
             else:
                 v = cpu_ref.match_matcher(m, row)
                 verdicts.append(bool(v))
+            if verdicts[-1] and m.name:
+                fired_names.append(m.name)
         matched = (
             all(verdicts) if op.matchers_condition == "and" else any(verdicts)
         )
-        return matched, out
+        return matched, out, fired_names
 
     def _scan_target(self, host: str, port: int) -> list[SslFinding]:
         findings: list[SslFinding] = []
@@ -267,15 +274,17 @@ class SslScanner:
 
         for t in self.templates:
             hits: list[str] = []
+            names: list[str] = []
             matched = False
             for op in t.operations:
                 doc = doc_for(op)
                 if doc is None:
                     continue
-                ok, values = self._eval_operation(op, doc, host, port)
+                ok, values, fired = self._eval_operation(op, doc, host, port)
                 if ok:
                     matched = True
                     hits.extend(values)
+                    names.extend(fired)
             if matched:
                 findings.append(
                     SslFinding(
@@ -284,6 +293,7 @@ class SslScanner:
                         port=port,
                         severity=t.severity,
                         extractions=hits,
+                        matcher_names=sorted(set(names)),
                     )
                 )
         return findings
