@@ -137,18 +137,9 @@ def resolve_device():
     # probe the configured backend in a disposable subprocess first: if
     # the probe can't see a device within its budget, force CPU in this
     # process before jax ever initializes the wedged backend.
-    import subprocess as _sp
-    import sys as _sys
+    from swarm_tpu.utils.backendprobe import probe_backend
 
-    try:
-        probe = _sp.run(
-            [_sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=150,
-        )
-        ok = probe.returncode == 0 and probe.stdout.strip()
-    except _sp.TimeoutExpired:
-        ok = False
+    ok, _platform, _count = probe_backend(timeout=150)
     if not ok:
         log("!!! backend probe hung/failed; forcing JAX_PLATFORMS=cpu")
         os.environ["JAX_PLATFORMS"] = "cpu"

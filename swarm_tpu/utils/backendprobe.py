@@ -1,0 +1,44 @@
+"""Disposable-subprocess backend probe.
+
+The configured accelerator backend can wedge *inside* init — the
+tunnel hangs in a C call that signals cannot interrupt, so an
+in-process ``jax.devices()`` (and even a SIGALRM guard around it) hangs
+forever. The only safe probe from a jax-uninitialized process is a
+disposable subprocess with a hard timeout. Both bench.py and
+``__graft_entry__.dryrun_multichip`` route through here so the
+wedge-handling logic cannot diverge.
+
+Do NOT call this after the current process initialized a backend: the
+child would contend with this process's exclusive accelerator.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+def probe_backend(timeout: float = 150.0) -> tuple[bool, str, int]:
+    """→ (ok, platform, device_count) of the environment-configured JAX
+    backend, probed in a subprocess. ``ok`` False = the probe hung or
+    failed — treat the backend as unusable and force CPU."""
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; d = jax.devices(); print(d[0].platform, len(d))",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False, "", 0
+    out = proc.stdout.strip().split()
+    if proc.returncode != 0 or len(out) != 2:
+        return False, "", 0
+    try:
+        return True, out[0], int(out[1])
+    except ValueError:
+        return False, "", 0

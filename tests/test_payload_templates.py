@@ -183,3 +183,60 @@ def test_default_login_caught_end_to_end(login_server):
     scanner = active.ActiveScanner(engine, {"read_timeout_ms": 3000})
     hits, stats = scanner.run([f"127.0.0.1:{login_server}"])
     assert [h.template_id for h in hits] == ["demo-default-login"]
+
+
+REFERENCE_MINIO = "/root/reference/worker/artifacts/templates/default-logins/minio/minio-default-login.yaml"
+
+
+def test_reference_minio_default_login_caught():
+    """VERDICT r1 #3's done-criterion, with the ACTUAL reference
+    template: a fake minio whose webrpc accepts minioadmin:minioadmin
+    is caught by default-logins/minio/minio-default-login.yaml."""
+    import pathlib
+
+    from swarm_tpu.fingerprints.nuclei import load_template_file
+    from swarm_tpu.ops.engine import MatchEngine
+
+    if not pathlib.Path(REFERENCE_MINIO).is_file():
+        pytest.skip("reference corpus absent")
+
+    class H(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                data = self.request.recv(8192).decode("latin-1")
+                path = data.split(" ", 2)[1] if " " in data else ""
+                body = data.split("\r\n\r\n", 1)[-1]
+                if (
+                    path == "/minio/webrpc"
+                    and '"username":"minioadmin"' in body
+                    and '"password":"minioadmin"' in body
+                ):
+                    out = ('{"jsonrpc":"2.0","id":1,"result":'
+                           '{"token":"x","uiVersion":"2021"}}')
+                    code = "200 OK"
+                else:
+                    out = '{"error":{"message":"denied"}}'
+                    code = "401 Unauthorized"
+                resp = (
+                    f"HTTP/1.1 {code}\r\nContent-Type: application/json"
+                    f"\r\nContent-Length: {len(out)}\r\n"
+                    f"Connection: close\r\n\r\n{out}"
+                )
+                self.request.sendall(resp.encode())
+            except OSError:
+                pass
+
+    srv = _Srv(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    try:
+        t = load_template_file(REFERENCE_MINIO)
+        eng = MatchEngine([t], mesh=None)
+        scanner = active.ActiveScanner(
+            eng, {"ports": [port], "connect_timeout_ms": 2000,
+                  "read_timeout_ms": 2000},
+        )
+        hits, _stats = scanner.run([f"127.0.0.1:{port}"])
+        assert "minio-default-login" in {h.template_id for h in hits}
+    finally:
+        srv.shutdown()
