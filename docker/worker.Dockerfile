@@ -14,7 +14,7 @@ RUN make -C /app/native
 
 COPY swarm_tpu /app/swarm_tpu
 COPY modules /app/modules
-RUN pip install --no-cache-dir requests pyyaml numpy jax
+RUN pip install --no-cache-dir requests pyyaml numpy jax cryptography
 
 RUN mkdir -p /app/downloads
 
