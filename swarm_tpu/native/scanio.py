@@ -80,8 +80,41 @@ def ensure_lib() -> ctypes.CDLL:
         u32p, i32p, i8p,              # addrs, naddrs, status
     ]
     lib.swarm_dns_resolve.restype = i32
+    charpp = ctypes.POINTER(ctypes.c_char_p)
+    lib.sw_pack_rows.argtypes = [charpp, i32p, i32, i32, u8p]
+    lib.sw_pack_rows.restype = None
+    lib.sw_concat3_rows.argtypes = [
+        charpp, i32p, charpp, i32p, u8p, i32, i32, u8p
+    ]
+    lib.sw_concat3_rows.restype = None
     _lib = lib
     return lib
+
+
+def bytes_ptrs(parts) -> "ctypes.Array":
+    """ctypes ``char*`` array pointing INTO the given bytes objects (no
+    copies; the array keeps references so the buffers stay alive)."""
+    return (ctypes.c_char_p * len(parts))(*parts)
+
+
+def pack_rows(ptrs, lens: np.ndarray, width: int, out: np.ndarray) -> None:
+    """Row-wise memcpy from Python bytes pointers into the padded
+    matrix; clips each row at ``width``."""
+    ensure_lib().sw_pack_rows(
+        ptrs, lens, np.int32(len(lens)), np.int32(width), out
+    )
+
+
+def concat3_rows(
+    hptrs, hlens: np.ndarray, bptrs, blens: np.ndarray,
+    concat: np.ndarray, width: int, out: np.ndarray,
+) -> None:
+    """Assemble the 'all' stream (header + CRLF + body, or body alone
+    when ``concat[i]`` is 0) straight from the part pointers."""
+    ensure_lib().sw_concat3_rows(
+        hptrs, hlens, bptrs, blens, concat,
+        np.int32(len(hlens)), np.int32(width), out,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -81,32 +81,39 @@ class ResponseBatch:
         return int(self.status.shape[0])
 
 
-def _encode_stream(
-    parts: Sequence[bytes], width: int
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    n = len(parts)
-    out = np.zeros((n, width), dtype=np.uint8)
-    lens = np.zeros((n,), dtype=np.int32)
-    trunc = np.zeros((n,), dtype=bool)
-    for i, blob in enumerate(parts):
-        if len(blob) > width:
-            trunc[i] = True
-            blob = blob[:width]
-        lens[i] = len(blob)
-        if blob:
-            out[i, : len(blob)] = np.frombuffer(blob, dtype=np.uint8)
-    return out, lens, trunc
-
-
 def round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
-def pick_width(parts: Sequence[bytes], max_width: int, multiple: int = 128) -> int:
-    """Bucket width: smallest lane-aligned width covering the batch,
-    capped at ``max_width`` (beyond which rows are truncated + host-flagged)."""
-    longest = max((len(p) for p in parts), default=0)
-    return max(multiple, min(max_width, round_up(max(longest, 1), multiple)))
+_NATIVE_ENCODER: Optional[bool] = None
+
+
+def _native_encoder_available() -> bool:
+    """One-time decision: a host without the native lib must not pay a
+    failing make-subprocess per batch, and a real binding bug must not
+    silently demote the hot path — the failure is logged once."""
+    global _NATIVE_ENCODER
+    if _NATIVE_ENCODER is None:
+        try:
+            from swarm_tpu.native import scanio as _nat
+
+            _nat.ensure_lib()
+            _NATIVE_ENCODER = True
+        except Exception as e:
+            import sys
+
+            print(
+                f"native encoder unavailable ({e!r}); "
+                "falling back to Python row packing",
+                file=sys.stderr,
+            )
+            _NATIVE_ENCODER = False
+    return _NATIVE_ENCODER
+
+
+def _width_for(lens: np.ndarray, cap: int, multiple: int = 128) -> int:
+    longest = int(lens.max()) if lens.size else 0
+    return max(multiple, min(cap, round_up(max(longest, 1), multiple)))
 
 
 def encode_batch(
@@ -119,31 +126,77 @@ def encode_batch(
 
     ``pad_rows_to`` pads the batch dimension (with empty rows) so the
     jitted kernel sees a small set of static batch shapes.
+
+    Hot path: the three padded matrices are filled by native row-wise
+    memcpy straight from the Python bytes objects (no intermediate
+    joins, and the "all" stream — header + CRLF + body — is assembled
+    in C instead of concatenating 2048 new bytes objects per batch).
+    At TPU device rates this host encode IS the end-to-end ceiling.
     """
     rows = list(rows)
     n_real = len(rows)
     if pad_rows_to is not None and pad_rows_to > n_real:
         rows = rows + [Response()] * (pad_rows_to - n_real)
+    n = len(rows)
 
-    bodies = [r.part("body") for r in rows]
-    headers = [r.part("header") for r in rows]
-    alls = [r.part("all") for r in rows]
+    # Direct attribute access (one pass, no part() dispatch) — MUST stay
+    # in lockstep with model.Response.part(): "body" is the banner when
+    # one is set; "all" is header + CRLF + body except for banner rows
+    # (aliases the banner) and headerless rows (body alone).
+    bodies = [r.body if r.banner is None else r.banner for r in rows]
+    headers = [r.header for r in rows]
+    blens = np.fromiter((len(b) for b in bodies), dtype=np.int64, count=n)
+    hlens = np.fromiter((len(h) for h in headers), dtype=np.int64, count=n)
+    concat = (
+        np.fromiter(
+            (r.banner is None for r in rows), dtype=np.bool_, count=n
+        )
+        & (hlens > 0)
+    ).astype(np.uint8)
+    alens = np.where(concat.astype(bool), hlens + 2 + blens, blens)
 
-    streams: dict[str, np.ndarray] = {}
-    lengths: dict[str, np.ndarray] = {}
-    trunc_any = np.zeros((len(rows),), dtype=bool)
-    for name, parts, cap in (
-        ("body", bodies, max_body),
-        ("header", headers, max_header),
-        ("all", alls, max_body + max_header),
-    ):
-        width = pick_width(parts, cap)
-        arr, lens, trunc = _encode_stream(parts, width)
-        streams[name] = arr
-        lengths[name] = lens
-        trunc_any |= trunc
+    wb = _width_for(blens, max_body)
+    wh = _width_for(hlens, max_header)
+    wa = _width_for(alens, max_body + max_header)
 
-    status = np.array([r.status for r in rows], dtype=np.int32)
+    body_arr = np.zeros((n, wb), dtype=np.uint8)
+    header_arr = np.zeros((n, wh), dtype=np.uint8)
+    all_arr = np.zeros((n, wa), dtype=np.uint8)
+    if _native_encoder_available():
+        from swarm_tpu.native import scanio as _nat
+
+        b32 = blens.astype(np.int32)
+        h32 = hlens.astype(np.int32)
+        bptrs = _nat.bytes_ptrs(bodies)
+        hptrs = _nat.bytes_ptrs(headers)
+        _nat.pack_rows(bptrs, b32, wb, body_arr)
+        _nat.pack_rows(hptrs, h32, wh, header_arr)
+        _nat.concat3_rows(hptrs, h32, bptrs, b32, concat, wa, all_arr)
+    else:
+        # toolchain-less deployment: same content, Python memcpy loop
+        for i, blob in enumerate(bodies):
+            if blob:
+                c = blob[:wb]
+                body_arr[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+        for i, blob in enumerate(headers):
+            if blob:
+                c = blob[:wh]
+                header_arr[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+        for i in range(n):
+            blob = (
+                headers[i] + b"\r\n" + bodies[i] if concat[i] else bodies[i]
+            )[:wa]
+            if blob:
+                all_arr[i, : len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+
+    streams = {"body": body_arr, "header": header_arr, "all": all_arr}
+    lengths = {
+        "body": np.minimum(blens, wb).astype(np.int32),
+        "header": np.minimum(hlens, wh).astype(np.int32),
+        "all": np.minimum(alens, wa).astype(np.int32),
+    }
+    trunc_any = (blens > wb) | (hlens > wh) | (alens > wa)
+    status = np.fromiter((r.status for r in rows), dtype=np.int32, count=n)
     return ResponseBatch(
         streams=streams,
         lengths=lengths,

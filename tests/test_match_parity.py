@@ -152,3 +152,38 @@ def test_parity_reference_technologies_tech_detect():
         )
     )
     assert_parity(templates, rows)
+
+
+def test_encode_batch_matches_part_semantics():
+    """The native fast-path encode must byte-match what Response.part()
+    defines for every stream — including banner rows with a header set
+    (all == banner), headerless rows, and rows clipped by the caps."""
+    import numpy as np
+
+    from swarm_tpu.fingerprints.model import Response
+    from swarm_tpu.ops.encoding import encode_batch
+
+    rows = [
+        Response(host="a", port=80, status=200,
+                 body=b"B" * 300, header=b"H: x" * 10),
+        Response(host="b", port=22, banner=b"SSH-2.0-x\r\n",
+                 header=b"ignored-for-all"),          # all == banner
+        Response(host="c", port=80, body=b"only-body"),  # headerless
+        Response(host="d", port=80, body=b"L" * 5000,
+                 header=b"H" * 2000),                 # double-clipped
+        Response(host="e", port=0),                   # empty row
+    ]
+    batch = encode_batch(rows, max_body=1024, max_header=512)
+    for i, r in enumerate(rows):
+        for stream, cap in (("body", 1024), ("header", 512), ("all", 1536)):
+            want_full = r.part(stream)
+            width = batch.streams[stream].shape[1]
+            want = want_full[:width]
+            got = bytes(batch.streams[stream][i][: len(want)])
+            assert got == want, (i, stream)
+            assert int(batch.lengths[stream][i]) == min(len(want_full), width)
+            # padding stays zero
+            assert not batch.streams[stream][i][len(want):].any()
+    assert bool(batch.truncated[3])      # clipped row flagged
+    assert not bool(batch.truncated[0])
+    assert [int(s) for s in batch.status] == [200, 0, 0, 0, 0]
