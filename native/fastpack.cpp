@@ -1,0 +1,91 @@
+// Python-aware batch packer for the device feed (ops/encoding.py).
+//
+// Consumes the Python list of bytes objects DIRECTLY — no per-element
+// ctypes conversion, no length fromiter on the Python side — and fills
+// the zero-padded row matrices with memcpy. Loaded via ctypes.PyDLL so
+// the GIL is held across the call (these functions touch PyObject*s).
+//
+// Contract mirrors model.Response.part(): callers pass the body stream
+// (banner-aliased), the header stream, and a per-row concat flag; the
+// "all" stream is header + CRLF + body when concat[i], else body.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// parts[i] → (data, len); -1 on a non-bytes element.
+inline int row_bytes(PyObject* list, Py_ssize_t i, const char** data,
+                     Py_ssize_t* len) {
+  PyObject* obj = PyList_GET_ITEM(list, i);  // borrowed
+  if (!PyBytes_Check(obj)) return -1;
+  *data = PyBytes_AS_STRING(obj);
+  *len = PyBytes_GET_SIZE(obj);
+  return 0;
+}
+
+}  // namespace
+
+// Pack a list of bytes into out[n, width] (zero-prefilled by caller),
+// clipping at width; writes each row's FULL length into lens_out.
+// Returns 0, or -1 if any element is not bytes.
+extern "C" int sw_pack_list(PyObject* parts, int32_t width, uint8_t* out,
+                            int64_t* lens_out) {
+  if (!PyList_Check(parts)) return -1;
+  Py_ssize_t n = PyList_GET_SIZE(parts);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* data;
+    Py_ssize_t len;
+    if (row_bytes(parts, i, &data, &len) != 0) return -1;
+    lens_out[i] = int64_t(len);
+    Py_ssize_t c = len < width ? len : width;
+    if (c > 0) std::memcpy(out + size_t(i) * width, data, size_t(c));
+  }
+  return 0;
+}
+
+// The "all" stream: header + CRLF + body when concat[i], else body
+// alone (banner rows / headerless rows) — assembled without creating
+// any intermediate Python objects.
+extern "C" int sw_concat3_list(PyObject* headers, PyObject* bodies,
+                               const uint8_t* concat, int32_t width,
+                               uint8_t* out) {
+  if (!PyList_Check(headers) || !PyList_Check(bodies)) return -1;
+  Py_ssize_t n = PyList_GET_SIZE(bodies);
+  if (PyList_GET_SIZE(headers) != n) return -1;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *hdata, *bdata;
+    Py_ssize_t hlen, blen;
+    if (row_bytes(headers, i, &hdata, &hlen) != 0) return -1;
+    if (row_bytes(bodies, i, &bdata, &blen) != 0) return -1;
+    uint8_t* dst = out + size_t(i) * width;
+    Py_ssize_t pos = 0;
+    if (concat[i]) {
+      Py_ssize_t hc = hlen < width ? hlen : width;
+      if (hc > 0) {
+        std::memcpy(dst, hdata, size_t(hc));
+        pos = hc;
+      }
+      if (pos < width) dst[pos++] = '\r';
+      if (pos < width) dst[pos++] = '\n';
+    }
+    Py_ssize_t room = width - pos;
+    Py_ssize_t bc = blen < room ? blen : room;
+    if (bc > 0) std::memcpy(dst + pos, bdata, size_t(bc));
+  }
+  return 0;
+}
+
+// Lengths-only pass (width selection happens between this and packing).
+extern "C" int sw_lens_list(PyObject* parts, int64_t* lens_out) {
+  if (!PyList_Check(parts)) return -1;
+  Py_ssize_t n = PyList_GET_SIZE(parts);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* obj = PyList_GET_ITEM(parts, i);
+    if (!PyBytes_Check(obj)) return -1;
+    lens_out[i] = int64_t(PyBytes_GET_SIZE(obj));
+  }
+  return 0;
+}

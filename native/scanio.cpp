@@ -683,36 +683,3 @@ int swarm_dns_resolve(const uint8_t* names, const int32_t* name_off,
 
 }  // extern "C"
 
-// Pack rows straight from Python bytes-object pointers (no join copy):
-// parts[i] points at row i's bytes, lens[i] its full length; content is
-// clipped to width. Embedded NULs are fine — lengths are explicit.
-extern "C" void sw_pack_rows(const char** parts, const int32_t* lens,
-                             int32_t n, int32_t width, uint8_t* out) {
-  for (int32_t i = 0; i < n; ++i) {
-    int32_t len = lens[i] < width ? lens[i] : width;
-    if (len > 0) std::memcpy(out + size_t(i) * width, parts[i], size_t(len));
-  }
-}
-
-// The "all" stream (header + CRLF + body, or body alone) assembled
-// row-wise from the same pointer arrays — replaces building 2048
-// concatenated Python bytes per batch. concat[i]=0 copies body only
-// (headerless rows and raw-banner rows, where part("all") == banner).
-extern "C" void sw_concat3_rows(const char** hparts, const int32_t* hlens,
-                                const char** bparts, const int32_t* blens,
-                                const uint8_t* concat, int32_t n,
-                                int32_t width, uint8_t* out) {
-  for (int32_t i = 0; i < n; ++i) {
-    uint8_t* dst = out + size_t(i) * width;
-    int32_t pos = 0;
-    if (concat[i]) {
-      int32_t hc = hlens[i] < width ? hlens[i] : width;
-      if (hc > 0) { std::memcpy(dst, hparts[i], size_t(hc)); pos = hc; }
-      if (pos < width) dst[pos++] = '\r';
-      if (pos < width) dst[pos++] = '\n';
-    }
-    int32_t room = width - pos;
-    int32_t bc = blens[i] < room ? blens[i] : room;
-    if (bc > 0) std::memcpy(dst + pos, bparts[i], size_t(bc));
-  }
-}

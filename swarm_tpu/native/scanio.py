@@ -4,8 +4,10 @@ The native layer replaces the reference's shelled-out scanning binaries
 (``worker/modules/*.json`` → nmap/dnsx/httpx/httprobe, SURVEY.md §2.2)
 with one epoll event loop producing flat numpy buffers — the
 fixed-shape ``(host, port, banner)`` arrays the device match pipeline
-consumes. All calls release the GIL (ctypes does this for foreign
-calls), so a worker can overlap probing with device compute.
+consumes. The libscanio CDLL calls release the GIL (ctypes does this
+for foreign calls), so a worker can overlap probing with device
+compute; the libfastpack batch-packer below is PyDLL-loaded and HOLDS
+the GIL (it walks Python bytes objects).
 """
 
 from __future__ import annotations
@@ -40,8 +42,10 @@ def ensure_lib() -> ctypes.CDLL:
     # .so from an older checkout picks up new symbols); a deployment
     # without a toolchain falls back to the shipped .so
     try:
+        import sys as _sys
+
         subprocess.run(
-            ["make", "-C", str(_NATIVE_DIR)],
+            ["make", "-C", str(_NATIVE_DIR), f"PY={_sys.executable}"],
             check=True,
             capture_output=True,
         )
@@ -80,41 +84,75 @@ def ensure_lib() -> ctypes.CDLL:
         u32p, i32p, i8p,              # addrs, naddrs, status
     ]
     lib.swarm_dns_resolve.restype = i32
-    charpp = ctypes.POINTER(ctypes.c_char_p)
-    lib.sw_pack_rows.argtypes = [charpp, i32p, i32, i32, u8p]
-    lib.sw_pack_rows.restype = None
-    lib.sw_concat3_rows.argtypes = [
-        charpp, i32p, charpp, i32p, u8p, i32, i32, u8p
-    ]
-    lib.sw_concat3_rows.restype = None
     _lib = lib
     return lib
 
 
-def bytes_ptrs(parts) -> "ctypes.Array":
-    """ctypes ``char*`` array pointing INTO the given bytes objects (no
-    copies; the array keeps references so the buffers stay alive)."""
-    return (ctypes.c_char_p * len(parts))(*parts)
+# ---------------------------------------------------------------------------
+# Python-aware batch packer (libfastpack.so via PyDLL — GIL held, the
+# functions walk the bytes lists directly: no per-element conversions).
+# ---------------------------------------------------------------------------
+
+_FASTPACK_PATH = _NATIVE_DIR / "libfastpack.so"
+_fastpack: Optional[ctypes.PyDLL] = None
 
 
-def pack_rows(ptrs, lens: np.ndarray, width: int, out: np.ndarray) -> None:
-    """Row-wise memcpy from Python bytes pointers into the padded
-    matrix; clips each row at ``width``."""
-    ensure_lib().sw_pack_rows(
-        ptrs, lens, np.int32(len(lens)), np.int32(width), out
-    )
+def ensure_fastpack() -> ctypes.PyDLL:
+    global _fastpack
+    if _fastpack is not None:
+        return _fastpack
+    ensure_lib()  # same make invocation builds both shared objects
+    lib = ctypes.PyDLL(str(_FASTPACK_PATH))
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32 = ctypes.c_int32
+    lib.sw_lens_list.argtypes = [ctypes.py_object, i64p]
+    lib.sw_lens_list.restype = ctypes.c_int
+    lib.sw_pack_list.argtypes = [ctypes.py_object, i32, u8p, i64p]
+    lib.sw_pack_list.restype = ctypes.c_int
+    lib.sw_concat3_list.argtypes = [
+        ctypes.py_object, ctypes.py_object, u8p, i32, u8p
+    ]
+    lib.sw_concat3_list.restype = ctypes.c_int
+    _fastpack = lib
+    return lib
 
 
-def concat3_rows(
-    hptrs, hlens: np.ndarray, bptrs, blens: np.ndarray,
-    concat: np.ndarray, width: int, out: np.ndarray,
+def lens_list(parts: list) -> np.ndarray:
+    out = np.empty(len(parts), dtype=np.int64)
+    if ensure_fastpack().sw_lens_list(parts, out) != 0:
+        raise TypeError("parts must be a list of bytes")
+    return out
+
+
+def pack_list(
+    parts: list, width: int, out: np.ndarray,
+    lens: "np.ndarray | None" = None,
+) -> np.ndarray:
+    """Pack a bytes list into the zero-prefilled padded matrix; returns
+    each row's FULL (pre-clip) length. Callers that already hold the
+    length array pass it as ``lens`` (identical overwrite) to skip the
+    throwaway allocation on the hot path."""
+    if lens is None:
+        lens = np.empty(len(parts), dtype=np.int64)
+    if ensure_fastpack().sw_pack_list(parts, np.int32(width), out, lens) != 0:
+        raise TypeError("parts must be a list of bytes")
+    return lens
+
+
+def concat3_list(
+    headers: list, bodies: list, concat: np.ndarray, width: int,
+    out: np.ndarray,
 ) -> None:
     """Assemble the 'all' stream (header + CRLF + body, or body alone
-    when ``concat[i]`` is 0) straight from the part pointers."""
-    ensure_lib().sw_concat3_rows(
-        hptrs, hlens, bptrs, blens, concat,
-        np.int32(len(hlens)), np.int32(width), out,
-    )
+    when ``concat[i]`` is 0) straight from the bytes lists."""
+    if (
+        ensure_fastpack().sw_concat3_list(
+            headers, bodies, concat, np.int32(width), out
+        )
+        != 0
+    ):
+        raise TypeError("headers/bodies must be matching lists of bytes")
 
 
 # ---------------------------------------------------------------------------

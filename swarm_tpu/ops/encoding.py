@@ -97,7 +97,7 @@ def _native_encoder_available() -> bool:
         try:
             from swarm_tpu.native import scanio as _nat
 
-            _nat.ensure_lib()
+            _nat.ensure_fastpack()
             _NATIVE_ENCODER = True
         except Exception as e:
             import sys
@@ -145,8 +145,19 @@ def encode_batch(
     # (aliases the banner) and headerless rows (body alone).
     bodies = [r.body if r.banner is None else r.banner for r in rows]
     headers = [r.header for r in rows]
-    blens = np.fromiter((len(b) for b in bodies), dtype=np.int64, count=n)
-    hlens = np.fromiter((len(h) for h in headers), dtype=np.int64, count=n)
+    native = _native_encoder_available()
+    if native:
+        from swarm_tpu.native import scanio as _nat
+
+        blens = _nat.lens_list(bodies)
+        hlens = _nat.lens_list(headers)
+    else:
+        blens = np.fromiter(
+            (len(b) for b in bodies), dtype=np.int64, count=n
+        )
+        hlens = np.fromiter(
+            (len(h) for h in headers), dtype=np.int64, count=n
+        )
     concat = (
         np.fromiter(
             (r.banner is None for r in rows), dtype=np.bool_, count=n
@@ -162,16 +173,11 @@ def encode_batch(
     body_arr = np.zeros((n, wb), dtype=np.uint8)
     header_arr = np.zeros((n, wh), dtype=np.uint8)
     all_arr = np.zeros((n, wa), dtype=np.uint8)
-    if _native_encoder_available():
-        from swarm_tpu.native import scanio as _nat
-
-        b32 = blens.astype(np.int32)
-        h32 = hlens.astype(np.int32)
-        bptrs = _nat.bytes_ptrs(bodies)
-        hptrs = _nat.bytes_ptrs(headers)
-        _nat.pack_rows(bptrs, b32, wb, body_arr)
-        _nat.pack_rows(hptrs, h32, wh, header_arr)
-        _nat.concat3_rows(hptrs, h32, bptrs, b32, concat, wa, all_arr)
+    if native:
+        # reuse the length arrays computed above (identical overwrite)
+        _nat.pack_list(bodies, wb, body_arr, lens=blens)
+        _nat.pack_list(headers, wh, header_arr, lens=hlens)
+        _nat.concat3_list(headers, bodies, concat, wa, all_arr)
     else:
         # toolchain-less deployment: same content, Python memcpy loop
         for i, blob in enumerate(bodies):
