@@ -199,11 +199,23 @@ def bench_exact_engine(templates) -> tuple:  # (rows_per_sec, CompiledDB)
     log(f"engine compile+first batch: {time.time() - t0:.1f}s")
     for b in batches:
         eng.match_packed(b)  # warm every shape/content path
+    # pipelined feed (the production shape): encode batch i+1 on a
+    # helper thread while the device matches batch i — the host encode
+    # is the end-to-end ceiling at device rates
+    from concurrent.futures import ThreadPoolExecutor
+
     t0 = time.perf_counter()
     n = 0
-    for i in range(ITERS):
-        out = eng.match_packed(batches[i % len(batches)])
-        n += ROWS
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        fut = pool.submit(eng.encode_packed, batches[0])
+        for i in range(ITERS):
+            pre = fut.result()
+            if i + 1 < ITERS:  # no unconsumed encode inside the timing
+                fut = pool.submit(
+                    eng.encode_packed, batches[(i + 1) % len(batches)]
+                )
+            eng.match_packed(batches[i % len(batches)], pre=pre)
+            n += ROWS
     dt = time.perf_counter() - t0
     s = eng.stats
     log(

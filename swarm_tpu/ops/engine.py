@@ -150,11 +150,27 @@ class MatchEngine:
         Built from the packed path; per-row object assembly makes this
         the slower surface — bulk pipelines use :meth:`match_packed`.
         """
+        # dead rows match nothing by contract; filtering them BEFORE
+        # chunking keeps the pipelined pre-encode effective (a chunk
+        # with any dead row would force match_packed to discard the
+        # pre and re-encode the live subset serially)
+        alive = [r for r in responses if r.alive]
+        if len(alive) < len(responses):
+            live_out = iter(self.match(alive))
+            return [
+                next(live_out)
+                if r.alive
+                else RowMatches(template_ids=[], extractions={})
+                for r in responses
+            ]
         out: list[RowMatches] = []
         NT = self.db.num_templates
-        for start in range(0, len(responses), self.batch_rows):
-            rows = responses[start : start + self.batch_rows]
-            packed = self.match_packed(rows)
+        chunks = [
+            responses[s : s + self.batch_rows]
+            for s in range(0, len(responses), self.batch_rows)
+        ]
+        for rows, pre in self._iter_encoded(chunks):
+            packed = self.match_packed(rows, pre=pre)
             per_row_conf = packed.confirms_per_row
             for b in range(len(rows)):
                 tids = [
@@ -179,6 +195,28 @@ class MatchEngine:
         return out
 
     # ------------------------------------------------------------------
+    def _iter_encoded(self, chunks):
+        """Yield (rows, pre_encoded) with the NEXT chunk's host encode
+        overlapping the current chunk's device dispatch + confirmation
+        (the encode is the feed ceiling at device rates; the device
+        wait releases the GIL, so one helper thread recovers it)."""
+        if len(chunks) <= 1:
+            for c in chunks:
+                yield c, None
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        if not self._backend_ready:
+            self._resolve_backend()  # before threads touch the backend
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            fut = pool.submit(self.encode_packed, chunks[0])
+            for i, c in enumerate(chunks):
+                pre = fut.result()
+                if i + 1 < len(chunks):
+                    fut = pool.submit(self.encode_packed, chunks[i + 1])
+                yield c, pre
+
+    # ------------------------------------------------------------------
     def _resolve_backend(self) -> None:
         """First-match mesh resolution (kept out of __init__ so engine
         construction never initializes the JAX backend)."""
@@ -199,6 +237,15 @@ class MatchEngine:
         self._backend_ready = True
 
     # ------------------------------------------------------------------
+    def encode_packed(self, rows: Sequence[Response]):
+        """Public pre-encode for pipelined feeding: callers may encode
+        batch i+1 on another thread while the device matches batch i
+        (the encode is host memcpy work; the device dispatch releases
+        the GIL) and pass the result to :meth:`match_packed` via
+        ``pre``. Thread-safe after the first call resolved the
+        backend."""
+        return self._encode_for_backend(rows)
+
     def _encode_for_backend(self, rows: Sequence[Response]):
         """Encode rows for whichever device backend is active.
 
@@ -237,12 +284,18 @@ class MatchEngine:
         return batch, self.sharded
 
     # ------------------------------------------------------------------
-    def match_packed(self, all_rows: Sequence[Response]) -> PackedMatches:
+    def match_packed(
+        self, all_rows: Sequence[Response], pre=None
+    ) -> PackedMatches:
         """Exact verdict bitsets for up to ``batch_rows`` responses.
 
         The production wire format: one device dispatch, vectorized
         verdict assembly, host work proportional to the number of
         *uncertain fired matchers* — not to rows × templates.
+
+        ``pre`` is an optional :meth:`encode_packed` result for the SAME
+        rows (pipelined feeding); ignored when the batch contains dead
+        rows (the live-subset recursion re-encodes).
         """
         NT = self.db.num_templates
         nbytes = (NT + 7) >> 3
@@ -279,7 +332,12 @@ class MatchEngine:
             )
 
         rows = all_rows
-        batch, matcher = self._encode_for_backend(rows)
+        if pre is not None and len(pre[0].rows) != len(rows):
+            raise ValueError(
+                f"pre-encoded batch is for {len(pre[0].rows)} rows, "
+                f"match_packed got {len(rows)}"
+            )
+        batch, matcher = pre if pre is not None else self._encode_for_backend(rows)
         t0 = time.perf_counter()
         pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
             matcher.match(batch.streams, batch.lengths, batch.status, full=True)

@@ -187,3 +187,83 @@ def test_encode_batch_matches_part_semantics():
     assert bool(batch.truncated[3])      # clipped row flagged
     assert not bool(batch.truncated[0])
     assert [int(s) for s in batch.status] == [200, 0, 0, 0, 0]
+
+
+def test_pipelined_pre_encode_identical():
+    """match() pipelines chunk encodes; results must be bit-identical
+    to serial match_packed, and an explicit pre= must change nothing."""
+    import numpy as np
+
+    from swarm_tpu.fingerprints.nuclei import parse_template
+    import textwrap
+    import yaml
+
+    t = parse_template(yaml.safe_load(textwrap.dedent("""\
+        id: pipe-check
+        requests:
+          - method: GET
+            path: ["{{BaseURL}}/"]
+            matchers:
+              - type: word
+                words: ["pipelined-marker"]
+    """)), source_path="t/p.yaml")
+    from swarm_tpu.fingerprints.model import Response
+    from swarm_tpu.ops.engine import MatchEngine
+
+    eng = MatchEngine([t], mesh=None, batch_rows=8)
+    rows = [
+        Response(host=f"h{i}", port=80, status=200,
+                 body=(b"pipelined-marker" if i % 3 == 0 else b"nope"),
+                 header=b"HTTP/1.1 200 OK")
+        for i in range(30)  # 4 chunks at batch_rows=8 -> pipelined path
+    ]
+    via_match = eng.match(rows)
+    got = [bool(r.template_ids) for r in via_match]
+    assert got == [i % 3 == 0 for i in range(30)]
+    # explicit pre= equals no-pre
+    pre = eng.encode_packed(rows[:8])
+    a = eng.match_packed(rows[:8], pre=pre)
+    b = eng.match_packed(rows[:8])
+    assert (a.bits == b.bits).all()
+
+
+def test_match_dead_rows_keep_pipeline_and_order():
+    import textwrap
+
+    import yaml
+
+    from swarm_tpu.fingerprints.model import Response
+    from swarm_tpu.fingerprints.nuclei import parse_template
+    from swarm_tpu.ops.engine import MatchEngine
+
+    t = parse_template(yaml.safe_load(textwrap.dedent("""\
+        id: dead-mix
+        requests:
+          - method: GET
+            path: ["{{BaseURL}}/"]
+            matchers:
+              - type: word
+                words: ["live-marker"]
+    """)), source_path="t/d.yaml")
+    eng = MatchEngine([t], mesh=None, batch_rows=4)
+    rows = []
+    for i in range(13):
+        if i % 4 == 1:
+            rows.append(Response(host=f"d{i}", alive=False))
+        else:
+            rows.append(Response(host=f"h{i}", status=200,
+                                 body=b"live-marker"))
+    out = eng.match(rows)
+    assert len(out) == 13
+    for i, rm in enumerate(out):
+        if i % 4 == 1:
+            assert rm.template_ids == []  # dead: matches nothing
+        else:
+            assert rm.template_ids == ["dead-mix"]
+    # mismatched pre is rejected at the boundary
+    import pytest as _pytest
+
+    live = [r for r in rows if r.alive]
+    pre = eng.encode_packed(live[:4])
+    with _pytest.raises(ValueError, match="pre-encoded"):
+        eng.match_packed(live[:3], pre=pre)
