@@ -31,6 +31,8 @@ class SwarmServer:
 
     def __init__(self, cfg: Config, queue: Optional[JobQueueService] = None, fleet=None):
         self.cfg = cfg
+        # see _advertise_url: captured before any bind mutates it
+        self._url_was_default = cfg.server_url == Config.server_url
         if queue is None:
             state, blobs, docs = build_stores(cfg)
             fleet = fleet if fleet is not None else build_provider(cfg)
@@ -192,12 +194,28 @@ class SwarmServer:
     # ------------------------------------------------------------------
     # Lifecycle
     # ------------------------------------------------------------------
+    def _advertise_url(self) -> None:
+        """Align cfg.server_url with the actually-bound port when the
+        operator didn't set one: fleet providers hand this URL to the
+        workers they spawn (process cmdline / droplet cloud-init), and
+        the dataclass default would point them at :5001 regardless of
+        --port. An explicit server_url (public address behind NAT)
+        always wins; defaulted-ness is captured at construction so a
+        restart re-aligns to the newly bound port."""
+        if self._url_was_default:
+            host = self.cfg.host
+            if host in ("0.0.0.0", "::", ""):
+                host = "127.0.0.1"
+            self.cfg.server_url = f"http://{host}:{self.port}"
+
     def serve_forever(self) -> None:
         self._httpd = _make_httpd(self)
+        self._advertise_url()
         self._httpd.serve_forever()
 
     def start_background(self) -> threading.Thread:
         self._httpd = _make_httpd(self)
+        self._advertise_url()
         thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         thread.start()
         return thread

@@ -238,3 +238,32 @@ def test_dangling_queue_ids_drop_in_loop(tmp_path):
     q.queue_scan({"module": "echo", "file_content": ["t\n"], "batch_size": 1})
     job = q.next_job("w")
     assert job is not None and not job["job_id"].startswith("ghost")
+
+
+def test_server_advertises_bound_url(tmp_path):
+    """Fleet providers hand cfg.server_url to spawned workers; when the
+    operator didn't set one, the server must align it with the port it
+    actually bound (the default would always say :5001)."""
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="k",
+        blob_root=str(tmp_path / "b"), doc_root=str(tmp_path / "d"),
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    try:
+        assert cfg.server_url == f"http://127.0.0.1:{srv.port}"
+    finally:
+        srv.shutdown()
+
+    # an explicit public URL (NAT) always wins
+    cfg2 = Config(
+        host="0.0.0.0", port=0, api_key="k",
+        server_url="http://scan.example.com:8443",
+        blob_root=str(tmp_path / "b2"), doc_root=str(tmp_path / "d2"),
+    )
+    srv2 = SwarmServer(cfg2)
+    srv2.start_background()
+    try:
+        assert cfg2.server_url == "http://scan.example.com:8443"
+    finally:
+        srv2.shutdown()
