@@ -148,6 +148,13 @@ def resolve_device():
 
     if not ok:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # the probe child pinned the env-selected platform through
+        # jax.config; this process must do the same or it validates one
+        # backend and then initializes another (utils/jaxpin)
+        from swarm_tpu.utils.jaxpin import pin_platform_from_env
+
+        pin_platform_from_env()
 
     from swarm_tpu.utils.xlacache import enable_compilation_cache
 

@@ -33,6 +33,12 @@ _ENV_ALIASES = {
 class Config:
     # --- control plane ---
     server_url: str = "http://127.0.0.1:5001"
+    # True when server_url was derived from the actually-bound port by a
+    # SwarmServer (server/app.py _advertise_url) rather than set by the
+    # operator — a later server instance reusing this Config re-derives
+    # instead of advertising the prior (possibly dead) ephemeral port.
+    # A regular init field so dict-copied Configs keep their derived-ness.
+    server_url_derived: bool = False
     api_key: str = "CHANGE_THIS"
     host: str = "0.0.0.0"
     port: int = 5001

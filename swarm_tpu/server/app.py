@@ -31,8 +31,14 @@ class SwarmServer:
 
     def __init__(self, cfg: Config, queue: Optional[JobQueueService] = None, fleet=None):
         self.cfg = cfg
-        # see _advertise_url: captured before any bind mutates it
-        self._url_was_default = cfg.server_url == Config.server_url
+        # see _advertise_url: captured before any bind mutates it. A URL
+        # a PRIOR server instance derived (cfg.server_url_derived) still
+        # counts as defaulted — a supervisor reusing one Config across
+        # restarts must get a fresh alignment, not the dead previous
+        # port advertised as operator-explicit.
+        self._url_was_default = (
+            cfg.server_url == Config.server_url or cfg.server_url_derived
+        )
         if queue is None:
             state, blobs, docs = build_stores(cfg)
             fleet = fleet if fleet is not None else build_provider(cfg)
@@ -204,9 +210,17 @@ class SwarmServer:
         restart re-aligns to the newly bound port."""
         if self._url_was_default:
             host = self.cfg.host
-            if host in ("0.0.0.0", "::", ""):
+            if host == "::":
+                # v6 wildcard: stay on the bound address family — the
+                # listener may not accept v4-mapped connections
+                # (bindv6only), so 127.0.0.1 could be unreachable
+                host = "[::1]"
+            elif host in ("0.0.0.0", ""):
                 host = "127.0.0.1"
+            elif ":" in host:  # IPv6 literal needs brackets in a URL
+                host = f"[{host}]"
             self.cfg.server_url = f"http://{host}:{self.port}"
+            self.cfg.server_url_derived = True
 
     def serve_forever(self) -> None:
         self._httpd = _make_httpd(self)
@@ -268,6 +282,13 @@ def _make_httpd(server: SwarmServer) -> ThreadingHTTPServer:
         def do_HEAD(self):
             self._run("HEAD")
 
+    if ":" in server.cfg.host:  # IPv6 literal (e.g. "::1", "fd00::1")
+        import socket
+
+        class _V6Server(ThreadingHTTPServer):
+            address_family = socket.AF_INET6
+
+        return _V6Server((server.cfg.host, server.cfg.port), Handler)
     return ThreadingHTTPServer((server.cfg.host, server.cfg.port), Handler)
 
 

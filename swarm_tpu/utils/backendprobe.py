@@ -21,13 +21,31 @@ import sys
 def probe_backend(timeout: float = 150.0) -> tuple[bool, str, int]:
     """→ (ok, platform, device_count) of the environment-configured JAX
     backend, probed in a subprocess. ``ok`` False = the probe hung or
-    failed — treat the backend as unusable and force CPU."""
+    failed — treat the backend as unusable and force CPU.
+
+    The probe runs a real (tiny) computation, not just device
+    enumeration: the tunnel has been observed in a half-dead state
+    where ``jax.devices()`` answers but any dispatched program blocks
+    forever, and enumeration alone would wave that state through.
+    """
     try:
         proc = subprocess.run(
             [
                 sys.executable,
                 "-c",
-                "import jax; d = jax.devices(); print(d[0].platform, len(d))",
+                # pin an env-selected platform through jax.config: site
+                # hooks can register plugin backends that override the
+                # env var alone, and the probe must exercise the same
+                # backend its caller will get. Inlined (self-contained
+                # stdlib+jax child) — keep in lock-step with
+                # utils/jaxpin.pin_platform_from_env, the idiom's home
+                # for in-process users.
+                "import os, jax, jax.numpy as jnp;"
+                " p = os.environ.get('JAX_PLATFORMS');"
+                " p and jax.config.update('jax_platforms', p);"
+                " d = jax.devices();"
+                " (jnp.ones((8, 8)) + 1).block_until_ready();"
+                " print(d[0].platform, len(d))",
             ],
             capture_output=True,
             text=True,

@@ -1,0 +1,30 @@
+"""Make an operator-set JAX_PLATFORMS env var actually stick.
+
+Platform plugins registered by site hooks (the image's sitecustomize
+registers the accelerator backend at interpreter start) can override
+the env var alone, so a process told ``JAX_PLATFORMS=cpu`` would still
+dial the accelerator — and hang forever when its tunnel is wedged.
+``jax.config.update`` wins over both; every entry point that honors the
+env var pins through here so the semantics cannot diverge (worker
+runtime, bench, the graft entry). backendprobe.py's child program
+inlines the same idiom as a self-contained string — keep it in
+lock-step with this helper.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def pin_platform_from_env() -> Optional[str]:
+    """Pin the env-selected platform through jax.config; returns the
+    pinned value, or None when the env leaves platform selection to
+    JAX's default (registered-plugin priority)."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:  # comma-separated priority lists are valid config values
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    return plat or None

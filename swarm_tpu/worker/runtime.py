@@ -593,17 +593,11 @@ def main(argv: Optional[list[str]] = None) -> None:
         modules_dir=args.modules_dir,
         max_jobs=args.max_jobs,
     )
-    # An operator-set JAX_PLATFORMS env must actually stick: platform
-    # plugins registered by site hooks can override the env var, so a
-    # worker told "cpu" would still dial the accelerator (and hang
-    # forever if its tunnel is wedged). config.update wins over both.
-    import os as _os
+    # An operator-set JAX_PLATFORMS env must actually stick: site-hook
+    # platform plugins can override the env var alone (see utils/jaxpin)
+    from swarm_tpu.utils.jaxpin import pin_platform_from_env
 
-    plat = _os.environ.get("JAX_PLATFORMS")
-    if plat:  # comma-separated priority lists are valid config values
-        import jax as _jax
-
-        _jax.config.update("jax_platforms", plat)
+    pin_platform_from_env()
     # multi-host worker: join the DCN process group when configured
     # (SWARM_COORDINATOR/-NUM_PROCESSES/-PROCESS_ID) so the tpu
     # backend's mesh spans every host's chips; no-op single-host

@@ -1,0 +1,59 @@
+"""backendprobe: the disposable-subprocess accelerator health check.
+
+The probe must demand a real computation from the backend (the tunnel
+has a half-dead state where device enumeration answers but dispatched
+programs block forever); these tests pin the live-backend success path
+and the hang/failure fallbacks.
+"""
+
+from __future__ import annotations
+
+import subprocess
+
+from swarm_tpu.utils import backendprobe
+
+
+def test_probe_ok_on_cpu_backend():
+    # conftest forces JAX_PLATFORMS=cpu; the child inherits it, runs the
+    # tiny computation, and reports the virtual device count
+    ok, platform, count = backendprobe.probe_backend(timeout=120)
+    assert ok
+    assert platform == "cpu"
+    assert count >= 1
+
+
+def test_probe_hang_reports_unusable(monkeypatch):
+    def fake_run(*a, **kw):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=kw.get("timeout", 0))
+
+    monkeypatch.setattr(backendprobe.subprocess, "run", fake_run)
+    assert backendprobe.probe_backend(timeout=1) == (False, "", 0)
+
+
+def test_probe_crash_reports_unusable(monkeypatch):
+    def fake_run(*a, **kw):
+        return subprocess.CompletedProcess(a, returncode=1, stdout="", stderr="boom")
+
+    monkeypatch.setattr(backendprobe.subprocess, "run", fake_run)
+    assert backendprobe.probe_backend(timeout=1) == (False, "", 0)
+
+
+def test_probe_program_dispatches_real_computation(monkeypatch):
+    # the program handed to the child must block on a dispatched op,
+    # not just enumerate devices — otherwise the half-dead tunnel
+    # (enumeration answers, dispatch hangs) passes the probe. Capture
+    # the actual argv rather than matching source text.
+    captured = {}
+
+    def fake_run(argv, **kw):
+        captured["program"] = argv[-1]
+        return subprocess.CompletedProcess(argv, returncode=0, stdout="cpu 8", stderr="")
+
+    monkeypatch.setattr(backendprobe.subprocess, "run", fake_run)
+    assert backendprobe.probe_backend(timeout=1) == (True, "cpu", 8)
+    program = captured["program"]
+    assert "block_until_ready" in program
+    assert "jax.devices" in program
+    # the env-selected platform must be pinned through jax.config (site
+    # hooks override the env var alone)
+    assert "jax.config.update" in program

@@ -267,3 +267,82 @@ def test_server_advertises_bound_url(tmp_path):
         assert cfg2.server_url == "http://scan.example.com:8443"
     finally:
         srv2.shutdown()
+
+
+def test_server_realigns_url_when_config_is_reused(tmp_path):
+    """A supervisor may reuse one Config across server restarts; the
+    URL a PRIOR instance derived must not be mistaken for an
+    operator-explicit one, or the new instance would advertise the dead
+    previous port to every spawned worker."""
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="k",
+        blob_root=str(tmp_path / "b"), doc_root=str(tmp_path / "d"),
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    first_url = cfg.server_url
+    first_port = srv.port  # before shutdown clears the bound socket
+    srv.shutdown()
+
+    srv2 = SwarmServer(cfg)  # same cfg object, new ephemeral port
+    srv2.start_background()
+    try:
+        assert cfg.server_url == f"http://127.0.0.1:{srv2.port}"
+        assert cfg.server_url != first_url or srv2.port == first_port
+    finally:
+        srv2.shutdown()
+
+    # an explicit URL that happens to EQUAL a previously derived one is
+    # still explicit: a fresh Config carries server_url_derived=False
+    cfg2 = Config(
+        host="127.0.0.1", port=0, api_key="k", server_url=first_url,
+        blob_root=str(tmp_path / "b2"), doc_root=str(tmp_path / "d2"),
+    )
+    srv3 = SwarmServer(cfg2)
+    srv3.start_background()
+    try:
+        assert cfg2.server_url == first_url
+    finally:
+        srv3.shutdown()
+
+
+def _ipv6_loopback_available() -> bool:
+    import socket
+
+    if not socket.has_ipv6:
+        return False
+    try:
+        s = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+        try:
+            s.bind(("::1", 0))
+        finally:
+            s.close()
+        return True
+    except OSError:
+        return False
+
+
+@pytest.mark.skipif(
+    not _ipv6_loopback_available(), reason="no IPv6 loopback on this host"
+)
+def test_server_binds_and_advertises_ipv6(tmp_path):
+    """An IPv6 literal host must bind (AF_INET6) and be advertised
+    bracketed — an unbracketed v6 URL parses as hostname 'fd00' + bad
+    port and every spawned worker would fail to reach the server."""
+    import urllib.request
+
+    cfg = Config(
+        host="::1", port=0, api_key="k",
+        blob_root=str(tmp_path / "b"), doc_root=str(tmp_path / "d"),
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    try:
+        assert cfg.server_url == f"http://[::1]:{srv.port}"
+        req = urllib.request.Request(
+            cfg.server_url + "/get-statuses",
+            headers={"Authorization": "Bearer k"},
+        )
+        assert urllib.request.urlopen(req).status == 200
+    finally:
+        srv.shutdown()
