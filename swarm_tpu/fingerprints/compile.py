@@ -26,8 +26,11 @@ shells out to nuclei/nmap for this entire layer):
   **host-always** list — evaluated by the exact CPU oracle so overall
   parity stays 100%; the compiler reports how much of the corpus that
   tail is.
-- Out-of-band parts (``interactsh_*``) are constant-False on both
-  engines (no interaction server in either framework's scope).
+- Out-of-band parts (``interactsh_protocol``/``interactsh_request``)
+  lower onto their own tiny device streams (oobp/oobr), filled from
+  ``Response.oob_*`` by the worker's interaction listener
+  (worker/oob.py); rows without interactions carry empty streams, so
+  the no-listener behavior is the old constant-False — exactly.
 
 Uncertainty contract (the parity invariant): a matcher's device bit is
 exact unless its ``uncertain`` bit is set, and uncertain bits can only
@@ -469,18 +472,6 @@ def _lower_contains_call(node):
     if not (node[0] == "call" and node[1] == "contains" and len(node[2]) == 2):
         return None
     hay, needle = node[2]
-    # interactsh_* env vars are constant "" (OOB callbacks are out of
-    # scope, surfaced per-template as oob-skipped): contains over them
-    # is statically False — without this fold the whole op degrades to
-    # a fire-always prefilter (e.g. cves/2022/CVE-2022-26134.yaml)
-    if (
-        hay[0] == "var"
-        and hay[1] in ("interactsh_protocol", "interactsh_request")
-        and needle[0] == "lit"
-        and isinstance(needle[1], str)
-        and needle[1]
-    ):
-        return "never"
     loc = _part_stream_of_var(hay)
     if not (loc and needle[0] == "lit" and isinstance(needle[1], str)):
         return None
@@ -631,7 +622,17 @@ def _part_stream_of_var(node) -> Optional[tuple[str, Optional[str]]]:
         wrap = "lower" if node[1] == "tolower" else "upper"
         node = node[2][0]
     if node[0] == "var":
-        stream = {"body": "body", "header": "header", "all_headers": "header", "raw": "all"}.get(node[1])
+        stream = {
+            "body": "body",
+            "header": "header",
+            "all_headers": "header",
+            "raw": "all",
+            # OOB interaction vars lower onto their own (tiny) streams
+            # — e.g. contains(interactsh_protocol, "dns") in
+            # cves/2022/CVE-2022-26134.yaml-style dsl matchers
+            "interactsh_protocol": "oobp",
+            "interactsh_request": "oobr",
+        }.get(node[1])
         if stream:
             return stream, wrap
     return None
@@ -711,7 +712,12 @@ def lower_dsl(ast) -> Optional[ScalarProgram]:
                         "body": SV_LEN_BODY,
                         "header": SV_LEN_HEADER,
                         "all": SV_LEN_ALL,
-                    }[stream]
+                    }.get(stream)
+                    if lenvar is None:
+                        # no scalar length var for this stream (oob):
+                        # can't express whole-part equality exactly —
+                        # drop to the residue path
+                        continue
                     prog.conjuncts.append(
                         (lenvar, SOP_EQ, float(len(data)))
                     )

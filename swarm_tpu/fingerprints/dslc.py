@@ -331,8 +331,11 @@ def build_env(response) -> dict[str, Any]:
         "host": response.host,
         "port": response.port,
         "duration": response.duration_s,
-        "interactsh_protocol": "",
-        "interactsh_request": "",
+        # OOB interaction vars: filled by the worker's callback
+        # listener (worker/oob.py); empty without one — the matchers
+        # then evaluate False, same as nuclei with OOB disabled
+        "interactsh_protocol": " ".join(response.oob_protocols),
+        "interactsh_request": response.oob_requests,
     }
 
 

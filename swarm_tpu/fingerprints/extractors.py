@@ -50,7 +50,13 @@ def extract_kval(ex: Extractor, response: Response) -> list[str]:
     headers = headers_of(response)
     out = []
     for key in ex.kval:
-        val = headers.get(key.lower().replace("-", "_"))
+        norm = key.lower().replace("-", "_")
+        if norm == "interactsh_ip":
+            # OOB pseudo-kval: "print the remote interaction IP"
+            # (vulnerabilities/other/*-log4j-rce.yaml extractors)
+            out.extend(response.oob_ips)
+            continue
+        val = headers.get(norm)
         if val is not None:
             out.append(val)
     return out

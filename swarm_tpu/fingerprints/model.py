@@ -155,6 +155,14 @@ class Response:
     # requests, and negative matchers must not fire on an empty phantom
     # response.
     alive: bool = True
+    # Out-of-band interactions correlated to this row's request (filled
+    # by worker/oob.py's callback listener after the poll window).
+    # ``interactsh_protocol``/``interactsh_request`` matcher parts read
+    # these; empty = no interaction observed (matchers stay False, the
+    # no-OOB-configured behavior).
+    oob_protocols: tuple = ()  # e.g. ("http",), ("dns", "http")
+    oob_requests: bytes = b""  # raw callback requests, concatenated
+    oob_ips: tuple = ()  # remote addresses (interactsh_ip extractor)
 
     def part(self, name: str) -> bytes:
         # Canonical part aliasing — MUST stay in lockstep with
@@ -172,6 +180,10 @@ class Response:
             return self.header + b"\r\n" + self.body if self.header else self.body
         if name == "host":
             return self.host.encode()
+        if name == "interactsh_protocol":
+            return " ".join(self.oob_protocols).encode()
+        if name == "interactsh_request":
+            return self.oob_requests
         return b""
 
     @property

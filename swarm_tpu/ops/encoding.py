@@ -7,10 +7,12 @@ bound padding waste, and flag rows whose parts were truncated — those
 rows are re-checked on the host so truncation can never cost a match
 (parity invariant).
 
-Part canonicalization: matcher ``part`` names map onto the three
-physical streams; unknown / out-of-band parts (``interactsh_protocol``
-etc.) map to None and their matchers evaluate constant-False on both
-engines, which keeps device and oracle agreeing exactly.
+Part canonicalization: matcher ``part`` names map onto the physical
+streams — body/header/all plus the out-of-band interaction streams
+(``interactsh_protocol`` → oobp, ``interactsh_request`` → oobr, filled
+from Response.oob_* by worker/oob.py's listener). Unknown parts map to
+None and their matchers evaluate constant-False on both engines, which
+keeps device and oracle agreeing exactly.
 """
 
 from __future__ import annotations
@@ -22,15 +24,21 @@ import numpy as np
 
 from swarm_tpu.fingerprints.model import Response
 
-# Physical streams materialized per batch.
-STREAMS = ("body", "header", "all")
+# Physical streams materialized per batch. Order is load-bearing:
+# compiled DBs store indices into this tuple (tiny_stream /
+# rx_seq_stream / size_stream) — append only, never reorder.
+# oobp/oobr carry the out-of-band interaction data (worker/oob.py):
+# observed callback protocols ("http dns") and the raw callback
+# requests. They are tiny next to body/all and zero for rows without
+# interactions, so bulk passive scans pay almost nothing for them.
+STREAMS = ("body", "header", "all", "oobp", "oobr")
 
 # matcher part name -> physical stream. Must agree with
 # model.Response.part(): every alias here returns exactly that stream's
-# bytes from the oracle. Parts absent here return b"" from the oracle
-# (interactsh_* …), so their matchers lower to compile-time constants
-# (word → False, size → 0∈sizes, regex → matches-empty; negation folded
-# in — see compile.lower_matcher). 'host' is oracle-only (real bytes, no
+# bytes from the oracle. Parts absent here return b"" from the oracle,
+# so their matchers lower to compile-time constants (word → False,
+# size → 0∈sizes, regex → matches-empty; negation folded in — see
+# compile.lower_matcher). 'host' is oracle-only (real bytes, no
 # stream): matchers on it are not device-loweable and force host-always.
 PART_TO_STREAM = {
     "body": "body",
@@ -42,6 +50,8 @@ PART_TO_STREAM = {
     "all": "all",
     "raw": "all",
     "response": "all",
+    "interactsh_protocol": "oobp",
+    "interactsh_request": "oobr",
 }
 
 HOST_ONLY_PARTS = frozenset({"host"})
@@ -195,13 +205,59 @@ def encode_batch(
             if blob:
                 all_arr[i, : len(blob)] = np.frombuffer(blob, dtype=np.uint8)
 
-    streams = {"body": body_arr, "header": header_arr, "all": all_arr}
+    # OOB streams. Bulk scans never carry interactions, so the common
+    # case is ONE attribute scan and two width-1 zero placeholders —
+    # no packing, no per-row bookkeeping, ~nothing shipped to device
+    # (the kernel's oob word tables then simply can't hit).
+    has_oob = any(r.oob_protocols or r.oob_requests for r in rows)
+    if not has_oob:
+        wp = wr = 1
+        plens = rlens = np.zeros((n,), dtype=np.int64)
+        oobp_arr = np.zeros((n, 1), dtype=np.uint8)
+        oobr_arr = np.zeros((n, 1), dtype=np.uint8)
+    else:
+        oobps = [
+            " ".join(r.oob_protocols).encode() if r.oob_protocols else b""
+            for r in rows
+        ]
+        oobrs = [r.oob_requests for r in rows]
+        plens = np.fromiter((len(p) for p in oobps), dtype=np.int64, count=n)
+        rlens = np.fromiter((len(q) for q in oobrs), dtype=np.int64, count=n)
+        wp = _width_for(plens, 128)
+        wr = _width_for(rlens, max_body)
+        oobp_arr = np.zeros((n, wp), dtype=np.uint8)
+        oobr_arr = np.zeros((n, wr), dtype=np.uint8)
+        if native:
+            _nat.pack_list(oobps, wp, oobp_arr, lens=plens)
+            _nat.pack_list(oobrs, wr, oobr_arr, lens=rlens)
+        else:
+            for i, blob in enumerate(oobps):
+                if blob:
+                    c = blob[:wp]
+                    oobp_arr[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+            for i, blob in enumerate(oobrs):
+                if blob:
+                    c = blob[:wr]
+                    oobr_arr[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+
+    streams = {
+        "body": body_arr,
+        "header": header_arr,
+        "all": all_arr,
+        "oobp": oobp_arr,
+        "oobr": oobr_arr,
+    }
     lengths = {
         "body": np.minimum(blens, wb).astype(np.int32),
         "header": np.minimum(hlens, wh).astype(np.int32),
         "all": np.minimum(alens, wa).astype(np.int32),
+        "oobp": np.minimum(plens, wp).astype(np.int32),
+        "oobr": np.minimum(rlens, wr).astype(np.int32),
     }
-    trunc_any = (blens > wb) | (hlens > wh) | (alens > wa)
+    trunc_any = (
+        (blens > wb) | (hlens > wh) | (alens > wa)
+        | (plens > wp) | (rlens > wr)
+    )
     status = np.fromiter((r.status for r in rows), dtype=np.int32, count=n)
     return ResponseBatch(
         streams=streams,

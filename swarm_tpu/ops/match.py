@@ -412,8 +412,8 @@ def eval_verdicts(
     # --- status / size matchers ---
     status_ok = (status[:, None, None] == jnp.asarray(db.m_status)[None]).any(-1)
     len_streams = jnp.stack(
-        [lengths["body"], lengths["header"], lengths["all"]], axis=1
-    )  # [B, 3]
+        [lengths[name] for name in STREAMS], axis=1
+    )  # [B, len(STREAMS)]
     size_sel = len_streams[:, db.m_size_stream]  # [B, NM]
     size_ok = (size_sel[:, :, None] == jnp.asarray(db.m_size)[None]).any(-1)
 

@@ -58,19 +58,31 @@ class PlannedRequest:
     headers: tuple[tuple[str, str], ...] = ()
     body: bytes = b""
 
-    def wire(self, host: str, port: int, tls: bool = False) -> bytes:
+    @property
+    def uses_oob(self) -> bool:
+        """Whether wiring this request needs a minted interaction URL."""
+        return (
+            "\x00OOB\x00" in self.path
+            or b"\x00OOB\x00" in self.body
+            or any("\x00OOB\x00" in v for _k, v in self.headers)
+        )
+
+    def wire(
+        self, host: str, port: int, tls: bool = False,
+        oob_url: Optional[str] = None,
+    ) -> bytes:
         host_hdr = _host_hdr(host, port, tls)
         body = _finalize(
-            self.body.decode("latin-1"), host, port, tls
+            self.body.decode("latin-1"), host, port, tls, oob_url
         ).encode("latin-1")
         lines = [
-            f"{self.method} {_finalize(self.path, host, port, tls)} HTTP/1.1",
+            f"{self.method} {_finalize(self.path, host, port, tls, oob_url)} HTTP/1.1",
             f"Host: {host_hdr}",
         ]
         has = {k.lower() for k, _ in self.headers}
         for k, v in self.headers:
             if k.lower() not in ("host", "connection", "content-length"):
-                lines.append(f"{k}: {_finalize(v, host, port, tls)}")
+                lines.append(f"{k}: {_finalize(v, host, port, tls, oob_url)}")
         if "user-agent" not in has:
             lines.append("User-Agent: swarm-tpu/1.0")
         if body:
@@ -105,7 +117,11 @@ class RequestPlan:
     dns_owners: list[set[int]] = dataclasses.field(default_factory=list)
 
 
-def _substitute(text: str, payload_vars: Optional[dict] = None) -> Optional[str]:
+def _substitute(
+    text: str,
+    payload_vars: Optional[dict] = None,
+    oob: bool = False,
+) -> Optional[str]:
     """Resolve standard nuclei placeholders to plan-time markers; None
     if any unknown placeholder remains. Markers are resolved per target
     in ``_finalize`` — the plan itself stays target-free.
@@ -113,7 +129,13 @@ def _substitute(text: str, payload_vars: Optional[dict] = None) -> Optional[str]
     With ``payload_vars`` set (payload-attack expansion), bare variable
     placeholders take the combo's value and expression placeholders
     ({{base64('user:' + token)}}) are evaluated through the dsl
-    engine with the combo as the environment."""
+    engine with the combo as the environment.
+
+    With ``oob`` (an interaction listener is running — worker/oob.py),
+    ``{{interactsh-url}}`` resolves to a marker that the wire layer
+    replaces with a freshly minted per-probe correlation URL; without
+    it the placeholder stays unresolved and the template keeps its
+    honest oob-interactsh skip class."""
 
     def repl(m: re.Match) -> str:
         name = m.group(1).strip()
@@ -142,6 +164,8 @@ def _substitute(text: str, payload_vars: Optional[dict] = None) -> Optional[str]
             return "\x00SCHEME\x00"
         if low.startswith("randstr") or low.startswith("rand_"):
             return _RANDSTR
+        if oob and low == "interactsh-url":
+            return "\x00OOB\x00"
         return m.group(0)  # unknown → leave; caller rejects
 
     out = _PLACEHOLDER_RE.sub(repl, text)
@@ -156,20 +180,27 @@ def _host_hdr(host: str, port: int, tls: bool) -> str:
     return host if port == default else f"{host}:{port}"
 
 
-def _finalize(text: str, host: str, port: int, tls: bool) -> str:
+def _finalize(
+    text: str, host: str, port: int, tls: bool,
+    oob_url: Optional[str] = None,
+) -> str:
     """Per-target resolution of the plan-time markers with the probe's
     actual scheme/port (not defaults). An *interior* BaseURL/RootURL
     (query params, bodies, headers) becomes the absolute URL; a path's
-    leading BaseURL was already stripped at plan time."""
+    leading BaseURL was already stripped at plan time. ``oob_url`` is
+    this probe's minted correlation URL (worker/oob.py)."""
     scheme = "https" if tls else "http"
     hdr = _host_hdr(host, port, tls)
-    return (
+    out = (
         text.replace("\x00BASE\x00", f"{scheme}://{hdr}")
         .replace("\x00HOSTPORT\x00", hdr)
         .replace("\x00HOST\x00", host)
         .replace("\x00PORT\x00", str(port))
         .replace("\x00SCHEME\x00", scheme)
     )
+    if oob_url is not None:
+        out = out.replace("\x00OOB\x00", oob_url)
+    return out
 
 
 # bounded payload fan-out: wordlist files are read up to MAX_PAYLOAD_
@@ -382,6 +413,7 @@ def _classify_dynamic(t: Template, user_vars: Optional[dict] = None) -> str:
 def build_plan(
     templates: Sequence[Template],
     user_vars: Optional[dict] = None,
+    oob: bool = False,
 ) -> RequestPlan:
     """Corpus → deduplicated request table + ownership map.
 
@@ -521,7 +553,7 @@ def build_plan(
                     step_reqs = []
                     step_fail = None
                     for step in op.raw:
-                        sub = _substitute(step, payload_vars)
+                        sub = _substitute(step, payload_vars, oob=oob)
                         if sub is None:
                             step_fail = "dynamic-values"
                             break
@@ -555,13 +587,13 @@ def build_plan(
                 ):
                     unsupported = f"method-{method}"
                     continue
-                body_t = _substitute(op.body or "", payload_vars)
+                body_t = _substitute(op.body or "", payload_vars, oob=oob)
                 if body_t is None:
                     unsupported = "dynamic-values"
                     continue
                 body = body_t.encode("latin-1", "replace")
                 for path_t in op.paths:
-                    sub = _substitute(path_t, payload_vars)
+                    sub = _substitute(path_t, payload_vars, oob=oob)
                     if sub is None:
                         unsupported = "dynamic-values"
                         continue
@@ -581,7 +613,7 @@ def build_plan(
                     headers = []
                     header_ok = True
                     for k, v in op.headers:
-                        hv = _substitute(v, payload_vars)
+                        hv = _substitute(v, payload_vars, oob=oob)
                         if hv is None:
                             header_ok = False  # e.g. "Bearer {{token}}"
                             break
@@ -683,13 +715,52 @@ class ActiveScanner:
         user_vars: Optional[dict] = None,
     ):
         self.engine = engine
-        self.plan = build_plan(engine.templates, user_vars=user_vars)
+        # OOB interaction listener (worker/oob.py): opt-in via the
+        # module's probe spec — "oob": true (defaults) or a config
+        # object {"advertise_host", "http_port", "dns_port", "domain",
+        # "answer_ip", "poll_s"}. With it running, {{interactsh-url}}
+        # templates plan and execute; without it they keep the honest
+        # oob-skipped marker.
+        spec0 = probe_spec or {}
+        oob_spec = spec0.get("oob")
+        self.oob_listener = None
+        self.oob_poll_s = 3.0
+        if oob_spec:
+            from swarm_tpu.worker.oob import shared_listener
+
+            kw = dict(oob_spec) if isinstance(oob_spec, dict) else {}
+            kw.pop("enabled", None)
+            self.oob_poll_s = float(kw.pop("poll_s", 3.0))
+            # process-shared: the runtime caches scanners for process
+            # lifetime, so per-scanner listeners would leak sockets and
+            # EADDRINUSE on fixed ports (worker/oob.py shared_listener)
+            self.oob_listener = shared_listener(**kw)
+        self.plan = build_plan(
+            engine.templates,
+            user_vars=user_vars,
+            oob=self.oob_listener is not None,
+        )
         # honest scope marker: these ids are emitted as oob-skipped in
         # scan output (runtime._execute_active) so "didn't match" and
-        # "can't match without OOB" stay distinguishable in /raw
+        # "can't match without OOB" stay distinguishable in /raw. With
+        # a listener running, only oob templates that STILL could not
+        # plan (e.g. ones also needing session state) keep the marker.
+        planned_ids = {
+            engine.templates[i].id for i in self.plan.planned_templates
+        }
         self.oob_limited = sorted(
-            t.id for t in engine.templates if _uses_oob(t)
+            t.id
+            for t in engine.templates
+            if _uses_oob(t)
+            and (self.oob_listener is None or t.id not in planned_ids)
         )
+        # request indices that need a minted correlation URL at wire time
+        self._oob_reqs = {
+            i for i, r in enumerate(self.plan.requests) if r.uses_oob
+        }
+        # deferred rows awaiting the interaction poll window:
+        # (row, meta, token) triples collected across waves
+        self._pending_oob: list = []
         # session-class templates (extractor chains, indexed-history
         # raw flows) execute statefully per target instead of batching
         session_ids = set(
@@ -866,6 +937,38 @@ class ActiveScanner:
                 for f in ssl_findings
             )
 
+        # OOB drain: wait out the interaction window (a vulnerable
+        # target's callback races our response read), attach each
+        # token's interactions to its probe row, then device-match the
+        # deferred rows in one batch like any other wave
+        if self._pending_oob:
+            import time as _time
+
+            if self.oob_poll_s > 0:
+                _time.sleep(self.oob_poll_s)
+            rows, meta = [], []
+            n_inter = 0
+            for row, m, tok in self._pending_oob:
+                inter = self.oob_listener.poll(tok)
+                self.oob_listener.release(tok)
+                if inter:
+                    n_inter += len(inter)
+                    row.oob_protocols = tuple(
+                        sorted({i.protocol for i in inter})
+                    )
+                    row.oob_requests = b"\n\n".join(
+                        i.raw_request for i in inter
+                    )
+                    row.oob_ips = tuple(
+                        dict.fromkeys(i.remote_addr for i in inter)
+                    )
+                rows.append(row)
+                meta.append(m)
+            self._pending_oob = []
+            stats["oob_probes"] = len(rows)
+            stats["oob_interactions"] = n_inter
+            hits.extend(self._attribute(rows, meta, self._owner_ids))
+
         # one line per finding: a template observed via several requests
         # on the same endpoint (e.g. {{Hostname}} + {{Host}}:<port> both
         # landing on one service) reports once, as nuclei does
@@ -945,6 +1048,11 @@ class ActiveScanner:
         for h in unique:
             h.row = None
         return unique, stats
+
+    def close(self) -> None:
+        """Nothing to release: the OOB listener is process-shared
+        (other scanners may be using it); its daemon threads die with
+        the process. Kept so callers can treat scanners uniformly."""
 
     # ------------------------------------------------------------------
     def _liveness(self, targets):
@@ -1069,9 +1177,23 @@ class ActiveScanner:
         return out, len(work_list)
 
     def _run_wave(self, wave) -> list[ActiveHit]:
+        # mint one correlation token per OOB probe: an interaction can
+        # then be attributed to exactly one (target, request) pair
+        tokens: dict[int, str] = {}
+        if self._oob_reqs and self.oob_listener is not None:
+            for i, (_h, _ip, _p, _t, r_idx) in enumerate(wave):
+                if r_idx in self._oob_reqs:
+                    tokens[i] = self.oob_listener.new_token()
         payloads = [
-            self.plan.requests[r_idx].wire(host, port, tls)
-            for host, _ip, port, tls, r_idx in wave
+            self.plan.requests[r_idx].wire(
+                host, port, tls,
+                oob_url=(
+                    self.oob_listener.url_for(tokens[i])
+                    if i in tokens
+                    else None
+                ),
+            )
+            for i, (host, _ip, port, tls, r_idx) in enumerate(wave)
         ]
         result = scanio.tcp_scan(
             [ip for _h, ip, _p, _t, _r in wave],
@@ -1090,11 +1212,31 @@ class ActiveScanner:
             if int(result.status[i]) != scanio.STATUS_OPEN:
                 continue
             code, header, body = parse_http_response(result.banner(i))
-            rows.append(
-                Response(
-                    host=host, port=port, status=code,
-                    header=header, body=body, tls=t,
-                )
+            row = Response(
+                host=host, port=port, status=code,
+                header=header, body=body, tls=t,
             )
-            meta.append((host, port, t, r_idx, self.plan.requests[r_idx].path))
+            # reported-path form: plan-time markers render as their
+            # target-resolved values; the per-probe OOB token renders
+            # as the canonical placeholder (one line per finding, not
+            # one per minted token)
+            m = (
+                host, port, t, r_idx,
+                _finalize(
+                    self.plan.requests[r_idx].path, host, port, t,
+                    "{{interactsh-url}}",
+                ),
+            )
+            if i in tokens:
+                # OOB probes defer: their matchers need the interaction
+                # poll window to close first (run() drains _pending_oob)
+                self._pending_oob.append((row, m, tokens.pop(i)))
+            else:
+                rows.append(row)
+                meta.append(m)
+        # probes that never produced a row can't be called back in any
+        # attributable way later — release their tokens now
+        if self.oob_listener is not None:
+            for tok in tokens.values():
+                self.oob_listener.release(tok)
         return self._attribute(rows, meta, self._owner_ids)

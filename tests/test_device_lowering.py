@@ -263,8 +263,24 @@ def test_corpus_device_split_does_not_regress():
     assert db.stats["templates_host_always"] == 0
     assert db.num_templates >= 3700
     # op-level prefilters (whole-op host confirm on fire) are the
-    # expensive fallback — keep them rare
-    assert int(db.op_prefilter.sum()) <= 20
+    # expensive fallback — keep them rare. OOB-part prefilters (the
+    # log4j-rce family: literal-less regex over interactsh_request,
+    # AND-gated by a certain word matcher over interactsh_protocol) are
+    # counted separately: they can only engage on rows carrying real
+    # callback interactions, so they cost nothing on bulk scans.
+    pf_ops = np.flatnonzero(db.op_prefilter)
+    oob_pf = sum(
+        1
+        for op_id in pf_ops
+        if any(
+            (m.part or "").startswith("interactsh")
+            for m in db.templates[db.op_src[op_id][0]]
+            .operations[db.op_src[op_id][1]]
+            .matchers
+        )
+    )
+    assert int(db.op_prefilter.sum()) - oob_pf <= 20
+    assert oob_pf <= 15
     # per-matcher residues (confirm-on-fire) are the cheap fallback —
     # bounded so exotic-dsl growth is noticed
     assert int(db.m_residue.sum()) <= 20

@@ -1,0 +1,115 @@
+"""OOBListener: token minting, HTTP/DNS capture, correlation, replies."""
+
+import socket
+import struct
+import urllib.request
+
+from swarm_tpu.worker.oob import OOBListener, _build_a_reply, _parse_qname
+
+
+def _dns_query(name: str, tid: int = 0x1234) -> bytes:
+    q = struct.pack(">HHHHHH", tid, 0x0100, 1, 0, 0, 0)
+    for label in name.split("."):
+        q += bytes([len(label)]) + label.encode()
+    return q + b"\x00" + struct.pack(">HH", 1, 1)  # A IN
+
+
+def test_http_interaction_correlates():
+    with OOBListener() as lst:
+        token = lst.new_token()
+        other = lst.new_token()
+        url = f"http://127.0.0.1:{lst.http_port}/{token}"
+        resp = urllib.request.urlopen(url, timeout=5)
+        assert resp.status == 200
+        got = lst.poll(token)
+        assert len(got) == 1
+        assert got[0].protocol == "http"
+        assert token.encode() in got[0].raw_request
+        assert got[0].raw_request.startswith(b"GET /")
+        # drained; the unrelated token saw nothing
+        assert lst.poll(token) == []
+        assert lst.poll(other) == []
+
+
+def test_http_post_body_and_host_header_correlate():
+    with OOBListener() as lst:
+        token = lst.new_token()
+        # token only in the body, not the path
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{lst.http_port}/x",
+            data=f"cb={token}".encode(),
+            method="POST",
+        )
+        urllib.request.urlopen(req, timeout=5)
+        got = lst.poll(token)
+        assert len(got) == 1 and got[0].protocol == "http"
+        assert f"cb={token}".encode() in got[0].raw_request
+
+
+def test_dns_interaction_and_reply():
+    with OOBListener(domain="oob.test", answer_ip="203.0.113.5") as lst:
+        token = lst.new_token()
+        # ephemeral (non-80/443) http port is appended so http://
+        # callbacks reach the listener; a bare domain needs port 80/443
+        assert lst.url_for(token) == f"{token}.oob.test:{lst.http_port}"
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.settimeout(5)
+        sock.sendto(_dns_query(f"{token}.oob.test"), ("127.0.0.1", lst.dns_port))
+        reply, _ = sock.recvfrom(4096)
+        sock.close()
+        # reply: same id, QR set, one A answer with our configured ip
+        assert reply[:2] == b"\x12\x34"
+        assert reply[2] & 0x80
+        assert socket.inet_aton("203.0.113.5") in reply
+        got = lst.poll(token)
+        assert len(got) == 1
+        assert got[0].protocol == "dns"
+        assert got[0].raw_request == f"{token}.oob.test".encode()
+
+
+def test_https_callback_on_same_port():
+    """The listener's single port auto-detects TLS (templates embed
+    https://{{interactsh-url}} as often as http://)."""
+    import ssl
+
+    with OOBListener() as lst:
+        token = lst.new_token()
+        url = f"https://127.0.0.1:{lst.http_port}/{token}"
+        resp = urllib.request.urlopen(
+            url, timeout=5, context=ssl._create_unverified_context()
+        )
+        assert resp.status == 200
+        got = lst.poll(token)
+        assert len(got) == 1 and got[0].protocol == "http"
+        # and plain HTTP still works on the same port afterwards
+        token2 = lst.new_token()
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{lst.http_port}/{token2}", timeout=5
+        )
+        assert len(lst.poll(token2)) == 1
+
+
+def test_unregistered_token_not_recorded():
+    with OOBListener() as lst:
+        lst.new_token()
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{lst.http_port}/si00000000000000", timeout=5
+        )
+        assert lst.pending() == 0
+
+
+def test_url_forms():
+    lst = OOBListener(advertise_host="192.0.2.8", http_port=0)
+    lst.start()
+    try:
+        token = lst.new_token()
+        assert lst.url_for(token) == f"192.0.2.8:{lst.http_port}/{token}"
+    finally:
+        lst.close()
+
+
+def test_qname_parse_and_reply_builders():
+    pkt = _dns_query("si00112233445566.oob.test")
+    assert _parse_qname(pkt) == b"si00112233445566.oob.test"
+    reply = _build_a_reply(pkt, b"si00112233445566.oob.test", "127.0.0.1")
+    assert reply is not None and reply[:2] == pkt[:2]

