@@ -78,6 +78,173 @@ extern "C" int sw_concat3_list(PyObject* headers, PyObject* bodies,
   return 0;
 }
 
+namespace {
+
+// interned attribute names, created once on first use (the GIL is held
+// — PyDLL contract — so plain statics are safe)
+struct Attrs {
+  PyObject* body;
+  PyObject* header;
+  PyObject* banner;
+  PyObject* status;
+  PyObject* oob_protocols;
+  PyObject* oob_requests;
+};
+
+inline const Attrs& attrs() {
+  static Attrs a = {
+      PyUnicode_InternFromString("body"),
+      PyUnicode_InternFromString("header"),
+      PyUnicode_InternFromString("banner"),
+      PyUnicode_InternFromString("status"),
+      PyUnicode_InternFromString("oob_protocols"),
+      PyUnicode_InternFromString("oob_requests"),
+  };
+  return a;
+}
+
+// Response row → (body bytes [banner-aliased], header bytes, concat).
+// Returns new references in *bobj/*hobj (caller decrefs); -1 on a
+// non-bytes part.
+inline int row_parts(PyObject* row, PyObject** bobj, PyObject** hobj,
+                     int* is_banner) {
+  const Attrs& a = attrs();
+  PyObject* banner = PyObject_GetAttr(row, a.banner);
+  if (banner == nullptr) return -1;
+  *is_banner = (banner != Py_None);
+  if (*is_banner) {
+    *bobj = banner;  // keep the reference
+  } else {
+    Py_DECREF(banner);
+    *bobj = PyObject_GetAttr(row, a.body);
+    if (*bobj == nullptr) return -1;
+  }
+  *hobj = PyObject_GetAttr(row, a.header);
+  if (*hobj == nullptr) {
+    Py_DECREF(*bobj);
+    return -1;
+  }
+  if (!PyBytes_Check(*bobj) || !PyBytes_Check(*hobj)) {
+    Py_DECREF(*bobj);
+    Py_DECREF(*hobj);
+    return -1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+// One metadata pass over the list of Response objects: body/header
+// lengths (banner-aliased), status codes, the per-row concat flag,
+// and — so the packing pass never has to re-walk Python objects — the
+// raw byte POINTERS of each part. The pointers stay valid as long as
+// the rows (which own the bytes objects) stay alive; callers must keep
+// the list untouched between this and sw_rows_pack.
+// Returns -1 on error, else 1 if ANY row carries OOB interaction data
+// (oob_protocols/oob_requests truthy), 0 otherwise.
+extern "C" int sw_rows_meta(PyObject* rows, int64_t* blens, int64_t* hlens,
+                            int32_t* status, uint8_t* concat,
+                            const void** bptr, const void** hptr) {
+  if (!PyList_Check(rows)) return -1;
+  const Attrs& a = attrs();
+  Py_ssize_t n = PyList_GET_SIZE(rows);
+  int has_oob = 0;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* row = PyList_GET_ITEM(rows, i);  // borrowed
+    PyObject *bobj, *hobj;
+    int is_banner;
+    if (row_parts(row, &bobj, &hobj, &is_banner) != 0) return -1;
+    blens[i] = int64_t(PyBytes_GET_SIZE(bobj));
+    hlens[i] = int64_t(PyBytes_GET_SIZE(hobj));
+    bptr[i] = PyBytes_AS_STRING(bobj);
+    hptr[i] = PyBytes_AS_STRING(hobj);
+    concat[i] = uint8_t(!is_banner && hlens[i] > 0);
+    // safe to drop our refs: the row object keeps the bytes alive
+    Py_DECREF(bobj);
+    Py_DECREF(hobj);
+    PyObject* st = PyObject_GetAttr(row, a.status);
+    if (st == nullptr) return -1;
+    long code = PyLong_AsLong(st);
+    Py_DECREF(st);
+    if (code == -1 && PyErr_Occurred()) return -1;
+    status[i] = int32_t(code);
+    if (!has_oob) {
+      PyObject* op = PyObject_GetAttr(row, a.oob_protocols);
+      if (op == nullptr) return -1;
+      int truthy = PyObject_IsTrue(op);
+      Py_DECREF(op);
+      if (truthy < 0) return -1;
+      if (truthy) {
+        has_oob = 1;
+      } else {
+        PyObject* orq = PyObject_GetAttr(row, a.oob_requests);
+        if (orq == nullptr) return -1;
+        truthy = PyObject_IsTrue(orq);
+        Py_DECREF(orq);
+        if (truthy < 0) return -1;
+        if (truthy) has_oob = 1;
+      }
+    }
+  }
+  return has_oob;
+}
+
+namespace {
+
+// memcpy the clipped row then memset the tail — rows land fully
+// initialized, so callers can hand in RECYCLED (dirty) buffers and
+// skip the per-batch zero-fill entirely.
+inline void fill_row(uint8_t* dst, const char* data, Py_ssize_t len,
+                     int32_t width) {
+  Py_ssize_t c = len < width ? len : width;
+  if (c > 0) std::memcpy(dst, data, size_t(c));
+  if (c < width) std::memset(dst + c, 0, size_t(width - c));
+}
+
+}  // namespace
+
+// One packing pass from the pointers sw_rows_meta cached: body, header,
+// and (when wa > 0) the assembled "all" stream, each row fully written
+// (payload + zero tail). Pure memcpy — no Python API — so the GIL is
+// dropped for the sweep and a helper-thread encode overlaps the main
+// thread's Python work (the engine's sparse host confirmation).
+extern "C" int sw_rows_pack(int64_t n, const void** bptr,
+                            const int64_t* blens, const void** hptr,
+                            const int64_t* hlens, const uint8_t* concat,
+                            int32_t wb, uint8_t* body_out, int32_t wh,
+                            uint8_t* header_out, int32_t wa,
+                            uint8_t* all_out) {
+  Py_BEGIN_ALLOW_THREADS;
+  for (int64_t i = 0; i < n; ++i) {
+    const char* bdata = static_cast<const char*>(bptr[i]);
+    Py_ssize_t blen = Py_ssize_t(blens[i]);
+    const char* hdata = static_cast<const char*>(hptr[i]);
+    Py_ssize_t hlen = Py_ssize_t(hlens[i]);
+    fill_row(body_out + size_t(i) * wb, bdata, blen, wb);
+    fill_row(header_out + size_t(i) * wh, hdata, hlen, wh);
+    if (wa > 0) {
+      uint8_t* dst = all_out + size_t(i) * wa;
+      Py_ssize_t pos = 0;
+      if (concat[i]) {
+        Py_ssize_t hc = hlen < wa ? hlen : wa;
+        if (hc > 0) {
+          std::memcpy(dst, hdata, size_t(hc));
+          pos = hc;
+        }
+        if (pos < wa) dst[pos++] = '\r';
+        if (pos < wa) dst[pos++] = '\n';
+      }
+      Py_ssize_t room = wa - pos;
+      Py_ssize_t bc = blen < room ? blen : room;
+      if (bc > 0) std::memcpy(dst + pos, bdata, size_t(bc));
+      pos += bc;
+      if (pos < wa) std::memset(dst + pos, 0, size_t(wa - pos));
+    }
+  }
+  Py_END_ALLOW_THREADS;
+  return 0;
+}
+
 // Lengths-only pass (width selection happens between this and packing).
 extern "C" int sw_lens_list(PyObject* parts, int64_t* lens_out) {
   if (!PyList_Check(parts)) return -1;

@@ -114,6 +114,17 @@ def ensure_fastpack() -> ctypes.PyDLL:
         ctypes.py_object, ctypes.py_object, u8p, i32, u8p
     ]
     lib.sw_concat3_list.restype = ctypes.c_int
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    upp = np.ctypeslib.ndpointer(np.uintp, flags="C_CONTIGUOUS")
+    lib.sw_rows_meta.argtypes = [
+        ctypes.py_object, i64p, i64p, i32p, u8p, upp, upp
+    ]
+    lib.sw_rows_meta.restype = ctypes.c_int
+    lib.sw_rows_pack.argtypes = [
+        ctypes.c_int64, upp, i64p, upp, i64p, u8p,
+        i32, u8p, i32, u8p, i32, u8p,
+    ]
+    lib.sw_rows_pack.restype = ctypes.c_int
     _fastpack = lib
     return lib
 
@@ -138,6 +149,54 @@ def pack_list(
     if ensure_fastpack().sw_pack_list(parts, np.int32(width), out, lens) != 0:
         raise TypeError("parts must be a list of bytes")
     return lens
+
+
+def rows_meta(
+    rows: list,
+    blens: np.ndarray,
+    hlens: np.ndarray,
+    status: np.ndarray,
+    concat: np.ndarray,
+    bptr: np.ndarray,
+    hptr: np.ndarray,
+) -> bool:
+    """One C pass over the Response list: body/header lengths
+    (banner-aliased, matching model.Response.part()), status codes, the
+    per-row concat flag, and the raw byte pointers of each part
+    (``bptr``/``hptr``, np.uintp) for :func:`rows_pack`. The pointers
+    are owned by the rows — keep the list untouched until packing is
+    done. Returns True when any row carries OOB interaction data."""
+    rc = ensure_fastpack().sw_rows_meta(
+        rows, blens, hlens, status, concat, bptr, hptr
+    )
+    if rc < 0:
+        raise TypeError("rows must be Response objects with bytes parts")
+    return bool(rc)
+
+
+def rows_pack(
+    n: int,
+    bptr: np.ndarray,
+    blens: np.ndarray,
+    hptr: np.ndarray,
+    hlens: np.ndarray,
+    concat: np.ndarray,
+    wb: int,
+    body_out: np.ndarray,
+    wh: int,
+    header_out: np.ndarray,
+    wa: int,
+    all_out: np.ndarray,
+) -> None:
+    """Pack body/header/'all' matrices from the pointers
+    :func:`rows_meta` cached, writing every byte of every row
+    (payload + zero tail) — output buffers may be dirty/recycled.
+    Pure memcpy with the GIL released. ``wa`` 0 skips 'all'."""
+    ensure_fastpack().sw_rows_pack(
+        np.int64(n), bptr, blens, hptr, hlens, concat,
+        np.int32(wb), body_out, np.int32(wh), header_out,
+        np.int32(wa), all_out,
+    )
 
 
 def concat3_list(

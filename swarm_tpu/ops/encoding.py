@@ -95,6 +95,72 @@ def round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+class _RotatingPool:
+    """Recycled encode buffers for the engine's pipelined feed.
+
+    Zero-allocating a fresh (B, W) matrix per batch was ~40% of the
+    encode cost (page faults on first touch); the native pack writes
+    every byte of every row (payload + zero tail), so dirty buffers are
+    safe to hand back. The rotation depth outlives the pipeline
+    window with margin: batch i's arrays are live until its device
+    transfer completes, which is before batch i+2 dispatches (the
+    engine blocks on i's results), so the earliest reuse at i+depth
+    can never alias an in-flight transfer — even with two engines
+    drawing interleaved from the shared pool.
+
+    ONLY the engine's hot path opts in (``encode_batch(...,
+    reuse_buffers=True)``): a recycled batch's arrays are OVERWRITTEN
+    ``depth`` same-shape encodes later, so callers that retain batches
+    must use the default allocating path.
+    """
+
+    #: retained-bytes ceiling: production batch shapes vary (last
+    #: partial chunk, alive-subset recursion, active-scan waves), so
+    #: unbounded per-key caching would grow worker RSS forever. LRU
+    #: keys are dropped past the cap — dropping only releases the
+    #: POOL's references; in-flight batches keep their arrays alive
+    #: through their own refs.
+    MAX_BYTES = 256 * 1024 * 1024
+
+    def __init__(self, depth: int = 6):
+        self._depth = depth
+        self._slots: dict = {}  # key -> [bufs, next_idx]; dict order = LRU
+        self._bytes = 0
+        import threading
+
+        self._lock = threading.Lock()
+
+    def get(self, n: int, w: int, role: str) -> np.ndarray:
+        # keyed per stream ROLE: one encode draws several same-width
+        # buffers (wb == wh == wa is common at small widths), and a
+        # shared rotation would hand batch i+1 a buffer batch i is
+        # still feeding to the device
+        key = (n, w, role)
+        with self._lock:
+            slot = self._slots.pop(key, None)
+            if slot is None:
+                slot = [
+                    [np.empty((n, w), dtype=np.uint8) for _ in range(self._depth)],
+                    0,
+                ]
+                self._bytes += n * w * self._depth
+            self._slots[key] = slot  # re-insert: most-recently-used last
+            while self._bytes > self.MAX_BYTES and len(self._slots) > 1:
+                old_key, old_slot = next(iter(self._slots.items()))
+                if old_key == key:
+                    break  # never evict the slot we are handing out
+                del self._slots[old_key]
+                self._bytes -= (
+                    old_key[0] * old_key[1] * len(old_slot[0])
+                )
+            bufs, i = slot
+            slot[1] = (i + 1) % self._depth
+            return bufs[i]
+
+
+_POOL = _RotatingPool()
+
+
 _NATIVE_ENCODER: Optional[bool] = None
 
 
@@ -131,17 +197,35 @@ def encode_batch(
     max_body: int = 4096,
     max_header: int = 1024,
     pad_rows_to: Optional[int] = None,
+    reuse_buffers: bool = False,
+    build_all: bool = True,
 ) -> ResponseBatch:
     """Encode responses into the three padded streams.
 
     ``pad_rows_to`` pads the batch dimension (with empty rows) so the
     jitted kernel sees a small set of static batch shapes.
 
-    Hot path: the three padded matrices are filled by native row-wise
-    memcpy straight from the Python bytes objects (no intermediate
-    joins, and the "all" stream — header + CRLF + body — is assembled
-    in C instead of concatenating 2048 new bytes objects per batch).
-    At TPU device rates this host encode IS the end-to-end ceiling.
+    Hot path: TWO C passes straight over the Response objects — one
+    metadata pass (lengths/status/concat/OOB flags), one packing pass
+    that writes every byte of every row (payload + zero tail) so the
+    matrices come from the recycled buffer pool instead of a fresh
+    zero-fill (``reuse_buffers``; see _RotatingPool for the aliasing
+    contract — engine hot path only). At TPU device rates this host
+    encode IS the end-to-end ceiling.
+
+    Part semantics MUST stay in lockstep with model.Response.part():
+    "body" is the banner when one is set; "all" is header + CRLF + body
+    except for banner rows (aliases the banner) and headerless rows
+    (body alone).
+
+    ``build_all=False`` skips materializing (and shipping) the "all"
+    stream — a width-1 placeholder goes in its place and the device
+    kernel synthesizes the concatenation from the body/header streams
+    plus ``lengths["all_hdr"]`` (ops/match.py ``ensure_all_stream``).
+    The "all" stream is ~half the encode bytes, so the single-device
+    engine path always does this; the seq-sharded path can't (the
+    concatenation would cross shard boundaries), so it keeps host
+    assembly.
     """
     rows = list(rows)
     n_real = len(rows)
@@ -149,47 +233,63 @@ def encode_batch(
         rows = rows + [Response()] * (pad_rows_to - n_real)
     n = len(rows)
 
-    # Direct attribute access (one pass, no part() dispatch) — MUST stay
-    # in lockstep with model.Response.part(): "body" is the banner when
-    # one is set; "all" is header + CRLF + body except for banner rows
-    # (aliases the banner) and headerless rows (body alone).
-    bodies = [r.body if r.banner is None else r.banner for r in rows]
-    headers = [r.header for r in rows]
     native = _native_encoder_available()
     if native:
         from swarm_tpu.native import scanio as _nat
 
-        blens = _nat.lens_list(bodies)
-        hlens = _nat.lens_list(headers)
+        blens = np.empty(n, dtype=np.int64)
+        hlens = np.empty(n, dtype=np.int64)
+        status = np.empty(n, dtype=np.int32)
+        concat = np.empty(n, dtype=np.uint8)
+        bptr = np.empty(n, dtype=np.uintp)
+        hptr = np.empty(n, dtype=np.uintp)
+        has_oob = _nat.rows_meta(
+            rows, blens, hlens, status, concat, bptr, hptr
+        )
+        alens = np.where(concat.astype(bool), hlens + 2 + blens, blens)
+        wb = _width_for(blens, max_body)
+        wh = _width_for(hlens, max_header)
+        wa = _width_for(alens, max_body + max_header) if build_all else 1
+        if reuse_buffers:
+            body_arr = _POOL.get(n, wb, "body")
+            header_arr = _POOL.get(n, wh, "header")
+            all_arr = _POOL.get(n, wa, "all") if build_all else None
+        else:
+            body_arr = np.empty((n, wb), dtype=np.uint8)
+            header_arr = np.empty((n, wh), dtype=np.uint8)
+            all_arr = np.empty((n, wa), dtype=np.uint8) if build_all else None
+        if all_arr is None:
+            all_arr = np.zeros((n, 1), dtype=np.uint8)
+        _nat.rows_pack(
+            n, bptr, blens, hptr, hlens, concat, wb, body_arr,
+            wh, header_arr, wa if build_all else 0, all_arr,
+        )
     else:
+        # toolchain-less deployment: same content, Python loops
+        bodies = [r.body if r.banner is None else r.banner for r in rows]
+        headers = [r.header for r in rows]
         blens = np.fromiter(
             (len(b) for b in bodies), dtype=np.int64, count=n
         )
         hlens = np.fromiter(
             (len(h) for h in headers), dtype=np.int64, count=n
         )
-    concat = (
-        np.fromiter(
-            (r.banner is None for r in rows), dtype=np.bool_, count=n
+        status = np.fromiter(
+            (r.status for r in rows), dtype=np.int32, count=n
         )
-        & (hlens > 0)
-    ).astype(np.uint8)
-    alens = np.where(concat.astype(bool), hlens + 2 + blens, blens)
-
-    wb = _width_for(blens, max_body)
-    wh = _width_for(hlens, max_header)
-    wa = _width_for(alens, max_body + max_header)
-
-    body_arr = np.zeros((n, wb), dtype=np.uint8)
-    header_arr = np.zeros((n, wh), dtype=np.uint8)
-    all_arr = np.zeros((n, wa), dtype=np.uint8)
-    if native:
-        # reuse the length arrays computed above (identical overwrite)
-        _nat.pack_list(bodies, wb, body_arr, lens=blens)
-        _nat.pack_list(headers, wh, header_arr, lens=hlens)
-        _nat.concat3_list(headers, bodies, concat, wa, all_arr)
-    else:
-        # toolchain-less deployment: same content, Python memcpy loop
+        concat = (
+            np.fromiter(
+                (r.banner is None for r in rows), dtype=np.bool_, count=n
+            )
+            & (hlens > 0)
+        ).astype(np.uint8)
+        alens = np.where(concat.astype(bool), hlens + 2 + blens, blens)
+        wb = _width_for(blens, max_body)
+        wh = _width_for(hlens, max_header)
+        wa = _width_for(alens, max_body + max_header) if build_all else 1
+        body_arr = np.zeros((n, wb), dtype=np.uint8)
+        header_arr = np.zeros((n, wh), dtype=np.uint8)
+        all_arr = np.zeros((n, wa), dtype=np.uint8)
         for i, blob in enumerate(bodies):
             if blob:
                 c = blob[:wb]
@@ -198,18 +298,19 @@ def encode_batch(
             if blob:
                 c = blob[:wh]
                 header_arr[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
-        for i in range(n):
-            blob = (
-                headers[i] + b"\r\n" + bodies[i] if concat[i] else bodies[i]
-            )[:wa]
-            if blob:
-                all_arr[i, : len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+        if build_all:
+            for i in range(n):
+                blob = (
+                    headers[i] + b"\r\n" + bodies[i] if concat[i] else bodies[i]
+                )[:wa]
+                if blob:
+                    all_arr[i, : len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+        has_oob = any(r.oob_protocols or r.oob_requests for r in rows)
 
     # OOB streams. Bulk scans never carry interactions, so the common
-    # case is ONE attribute scan and two width-1 zero placeholders —
-    # no packing, no per-row bookkeeping, ~nothing shipped to device
-    # (the kernel's oob word tables then simply can't hit).
-    has_oob = any(r.oob_protocols or r.oob_requests for r in rows)
+    # case is two width-1 zero placeholders — no packing, no per-row
+    # bookkeeping, ~nothing shipped to device (the kernel's oob word
+    # tables then simply can't hit).
     if not has_oob:
         wp = wr = 1
         plens = rlens = np.zeros((n,), dtype=np.int64)
@@ -247,18 +348,31 @@ def encode_batch(
         "oobp": oobp_arr,
         "oobr": oobr_arr,
     }
+    minb = np.minimum(blens, wb)
+    minh = np.minimum(hlens, wh)
+    cat = concat.astype(bool)
+    if build_all:
+        all_len = np.minimum(alens, wa)
+    else:
+        # synthesized layout: clipped header (+CRLF) + clipped body —
+        # the device rebuilds exactly these bytes, so the length must
+        # describe the synthesized stream, not the untruncated original
+        all_len = np.where(cat, minh + 2 + minb, minb)
     lengths = {
-        "body": np.minimum(blens, wb).astype(np.int32),
-        "header": np.minimum(hlens, wh).astype(np.int32),
-        "all": np.minimum(alens, wa).astype(np.int32),
+        "body": minb.astype(np.int32),
+        "header": minh.astype(np.int32),
+        "all": all_len.astype(np.int32),
+        # header-prefix length of the synthesized "all" (0 = body-only:
+        # banner rows and headerless rows) — ops/match.ensure_all_stream
+        "all_hdr": np.where(cat, minh, 0).astype(np.int32),
         "oobp": np.minimum(plens, wp).astype(np.int32),
         "oobr": np.minimum(rlens, wr).astype(np.int32),
     }
     trunc_any = (
-        (blens > wb) | (hlens > wh) | (alens > wa)
+        (blens > wb) | (hlens > wh)
+        | ((alens > wa) if build_all else False)
         | (plens > wp) | (rlens > wr)
     )
-    status = np.fromiter((r.status for r in rows), dtype=np.int32, count=n)
     return ResponseBatch(
         streams=streams,
         lengths=lengths,

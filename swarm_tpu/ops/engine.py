@@ -259,7 +259,18 @@ class MatchEngine:
             self._resolve_backend()
         if self.sharded is None:
             return (
-                encode_batch(rows, max_body=self.max_body, max_header=self.max_header),
+                encode_batch(
+                    rows,
+                    max_body=self.max_body,
+                    max_header=self.max_header,
+                    # engine batches are consumed within the pipeline
+                    # window — recycled buffers are safe (encoding.
+                    # _RotatingPool aliasing contract); the "all"
+                    # stream synthesizes on device (half the encode
+                    # bytes and H2D traffic stay on the host)
+                    reuse_buffers=True,
+                    build_all=False,
+                ),
                 self.device,
             )
         data_ranks = self.sharded.ranks.get("data", 1)
@@ -269,6 +280,7 @@ class MatchEngine:
             max_body=self.max_body,
             max_header=self.max_header,
             pad_rows_to=round_up(len(rows), data_ranks),
+            reuse_buffers=True,
         )
         if seq_ranks > 1:
             halo = self.sharded.halo

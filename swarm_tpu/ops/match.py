@@ -517,9 +517,55 @@ def eval_verdicts(
     return t_value, t_unc
 
 
+def ensure_all_stream(streams: dict, lengths: dict):
+    """Synthesize the "all" stream (header + CRLF + body) on device.
+
+    The host encode may ship a width-1 placeholder instead of the
+    assembled "all" matrix (encode_batch ``build_all=False``) — the
+    concatenation is ~half the host encode bytes and half the H2D
+    transfer, and on device it is two gathers and a select.
+    ``lengths["all_hdr"]`` carries the per-row header-prefix length
+    (0 = body-only: banner rows alias the banner, headerless rows the
+    body — model.Response.part() semantics). Host-built "all"
+    (width > 1, the seq-sharded path) passes through untouched.
+    """
+    allv = streams.get("all")
+    if allv is None or allv.shape[1] > 1 or "all_hdr" not in lengths:
+        return streams
+    body = streams["body"]
+    header = streams["header"]
+    B, Wb = body.shape
+    Wh = header.shape[1]
+    Wa = ((Wb + Wh + 2 + 127) // 128) * 128
+    hl = lengths["all_hdr"].astype(jnp.int32)[:, None]  # 0 = body-only
+    bl = lengths["body"].astype(jnp.int32)[:, None]
+    j = jnp.arange(Wa, dtype=jnp.int32)[None, :]
+    off = jnp.where(hl > 0, hl + 2, 0)
+    is_hdr = j < hl
+    hvals = jnp.take_along_axis(
+        header, jnp.broadcast_to(jnp.minimum(j, Wh - 1), (B, Wa)), axis=1
+    )
+    bpos = j - off
+    is_body = (bpos >= 0) & (bpos < bl)
+    bvals = jnp.take_along_axis(
+        body, jnp.broadcast_to(jnp.clip(bpos, 0, Wb - 1), (B, Wa)), axis=1
+    )
+    is_crlf = (hl > 0) & (j >= hl) & (j < hl + 2)
+    crlf = jnp.where(j == hl, jnp.uint8(13), jnp.uint8(10))
+    synth = jnp.where(
+        is_hdr,
+        hvals,
+        jnp.where(is_crlf, crlf, jnp.where(is_body, bvals, jnp.uint8(0))),
+    )
+    out = dict(streams)
+    out["all"] = synth
+    return out
+
+
 def _match_impl(
     db: fpc.CompiledDB, candidate_k: int, streams, lengths, status, full=False
 ):
+    streams = ensure_all_stream(streams, lengths)
     value_bits, uncertain_bits, overflow = match_slots(
         db, candidate_k, streams, lengths
     )

@@ -120,10 +120,24 @@ class OOBListener:
 
             # one catch-all: every method records an interaction
             def _serve(self) -> None:
+                # everything after the headers is attacker/target-
+                # controlled: a malformed Content-Length or a body that
+                # never arrives must not prevent the record (that would
+                # turn a vulnerable host into a false negative), and a
+                # slow body must not eat the scanner's poll window
                 raw = self.raw_requestline + bytes(self.headers)
-                length = int(self.headers.get("Content-Length") or 0)
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    length = 0
                 if 0 < length <= _MAX_RAW_BYTES:
-                    raw += b"\r\n" + self.rfile.read(length)
+                    try:
+                        self.request.settimeout(2)
+                        body = self.rfile.read(length)
+                        if body:
+                            raw += b"\r\n" + body
+                    except OSError:
+                        pass
                 listener._record("http", raw, self.client_address[0])
                 body = b"<html><head></head><body>ok</body></html>"
                 self.send_response(200)

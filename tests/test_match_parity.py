@@ -189,6 +189,53 @@ def test_encode_batch_matches_part_semantics():
     assert [int(s) for s in batch.status] == [200, 0, 0, 0, 0]
 
 
+def test_device_all_synthesis_matches_host_built():
+    """build_all=False ships a width-1 placeholder and the kernel
+    synthesizes "all" on device (ops/match.ensure_all_stream) — the
+    synthesized bytes and lengths must equal the host-assembled stream
+    for every row shape (concat, banner, banner-with-header,
+    headerless, empty, clipped)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from swarm_tpu.fingerprints.model import Response
+    from swarm_tpu.ops.encoding import encode_batch
+    from swarm_tpu.ops.match import ensure_all_stream
+
+    rows = fuzz_rows(load_corpus(DATA)[0], random.Random(9), 24) + [
+        Response(host="b", port=7, banner=b"SSH-2.0-x"),
+        Response(host="bh", port=7, banner=b"X" * 40, header=b"H: v"),
+        Response(host="nh", port=80, status=200, body=b"plainbody"),
+        Response(host="e", port=80),
+        Response(host="clip", port=80, body=b"L" * 5000, header=b"H" * 900),
+    ]
+    full = encode_batch(rows, max_body=2048, max_header=512)
+    lite = encode_batch(rows, max_body=2048, max_header=512, build_all=False)
+    assert lite.streams["all"].shape[1] == 1
+    synth = ensure_all_stream(
+        {k: jnp.asarray(v) for k, v in lite.streams.items()},
+        {k: jnp.asarray(v) for k, v in lite.lengths.items()},
+    )
+    sa, fa = np.asarray(synth["all"]), full.streams["all"]
+    W = min(sa.shape[1], fa.shape[1])
+    # byte equality holds for every NON-truncated row; truncated rows
+    # (clipped header/body) synthesize from clipped streams and are
+    # host-redone by the engine regardless — both paths flag them
+    ok = ~lite.truncated
+    assert ok.sum() == len(rows) - 1  # only the "clip" row is flagged
+    assert (sa[ok][:, :W] == fa[ok][:, :W]).all()
+    assert not sa[ok][:, W:].any() and not fa[ok][:, W:].any()
+    assert (lite.lengths["all"][ok] == full.lengths["all"][ok]).all()
+    assert (lite.truncated == full.truncated).all()
+    # host-built streams pass through ensure_all_stream untouched
+    same = ensure_all_stream(
+        {k: jnp.asarray(v) for k, v in full.streams.items()},
+        {k: jnp.asarray(v) for k, v in full.lengths.items()},
+    )
+    assert same["all"] is not None and same["all"].shape == fa.shape
+    assert (np.asarray(same["all"]) == fa).all()
+
+
 def test_pipelined_pre_encode_identical():
     """match() pipelines chunk encodes; results must be bit-identical
     to serial match_packed, and an explicit pre= must change nothing."""

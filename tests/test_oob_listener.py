@@ -89,6 +89,48 @@ def test_https_callback_on_same_port():
         assert len(lst.poll(token2)) == 1
 
 
+def test_malformed_content_length_still_records():
+    """Everything after the headers is target-controlled: a bogus
+    Content-Length (or a body that never arrives) must not lose the
+    interaction — that would report a vulnerable host as clean."""
+    import socket as _socket
+
+    with OOBListener() as lst:
+        token = lst.new_token()
+        for payload in (
+            f"GET /{token} HTTP/1.1\r\nHost: x\r\nContent-Length: abc\r\n\r\n",
+            # declared body never sent: read must time out, then record
+            f"POST /{token} HTTP/1.1\r\nHost: x\r\nContent-Length: 50\r\n\r\nshort",
+        ):
+            s = _socket.create_connection(("127.0.0.1", lst.http_port), timeout=10)
+            s.sendall(payload.encode())
+            try:
+                s.recv(256)  # whatever comes back (response or reset)
+            except OSError:
+                pass
+            s.close()
+        got = lst.poll(token)
+        assert len(got) == 2
+        assert all(token.encode() in i.raw_request for i in got)
+
+
+def test_encode_pool_eviction_bounds_memory():
+    from swarm_tpu.ops.encoding import _RotatingPool
+
+    pool = _RotatingPool(depth=2)
+    pool.MAX_BYTES = 1 << 20  # 1 MiB cap for the test
+    for n in range(64, 64 + 40):  # 40 distinct keys of 64 KiB+ each
+        buf = pool.get(n, 1024, "body")
+        assert buf.shape == (n, 1024)
+    assert pool._bytes <= pool.MAX_BYTES + 2 * (64 + 40) * 1024
+    assert len(pool._slots) < 40
+    # the most recent key survives eviction and still rotates
+    a = pool.get(100, 1024, "body")
+    b = pool.get(100, 1024, "body")
+    c = pool.get(100, 1024, "body")
+    assert a is not b and c is a  # depth-2 rotation
+
+
 def test_unregistered_token_not_recorded():
     with OOBListener() as lst:
         lst.new_token()

@@ -153,17 +153,9 @@ def test_oob_parity_synthetic(tmp_path, mesh):
     assert not errors and len(templates) == 4
     rows = _oob_rows() + fuzz_rows(templates, random.Random(3), 20)
     eng = assert_parity(templates, rows, mesh=mesh)
-    # sanity on the oracle itself: the http-callback template must have
-    # fired for the rows carrying an http interaction
+    # sanity on the oracle itself: per-template expectations
     from swarm_tpu.ops import cpu_ref
 
-    hits = [
-        cpu_ref.match_template(templates[0], r).matched
-        if templates[0].id == "oob-http-callback"
-        else None
-        for r in rows[:6]
-    ]
-    del hits  # direct expectations below are clearer per-template
     by_id = {t.id: t for t in templates}
     assert cpu_ref.match_template(by_id["oob-http-callback"], rows[1]).matched
     assert not cpu_ref.match_template(by_id["oob-http-callback"], rows[0]).matched
