@@ -209,11 +209,11 @@ class MatchEngine:
         if not self._backend_ready:
             self._resolve_backend()  # before threads touch the backend
         with ThreadPoolExecutor(max_workers=1) as pool:
-            fut = pool.submit(self.encode_packed, chunks[0])
+            fut = pool.submit(self.encode_packed, chunks[0], True)
             for i, c in enumerate(chunks):
                 pre = fut.result()
                 if i + 1 < len(chunks):
-                    fut = pool.submit(self.encode_packed, chunks[i + 1])
+                    fut = pool.submit(self.encode_packed, chunks[i + 1], True)
                 yield c, pre
 
     # ------------------------------------------------------------------
@@ -237,16 +237,25 @@ class MatchEngine:
         self._backend_ready = True
 
     # ------------------------------------------------------------------
-    def encode_packed(self, rows: Sequence[Response]):
+    def encode_packed(self, rows: Sequence[Response], reuse_buffers: bool = False):
         """Public pre-encode for pipelined feeding: callers may encode
         batch i+1 on another thread while the device matches batch i
         (the encode is host memcpy work; the device dispatch releases
         the GIL) and pass the result to :meth:`match_packed` via
-        ``pre``. Thread-safe after the first call resolved the
-        backend."""
-        return self._encode_for_backend(rows)
+        ``pre``. Thread-safe after the first call resolved the backend.
 
-    def _encode_for_backend(self, rows: Sequence[Response]):
+        ``reuse_buffers=True`` draws the stream matrices from the
+        recycled pool (faster, no zero-fill) — but a pooled batch's
+        arrays are OVERWRITTEN a few same-shape encodes later
+        (encoding._RotatingPool), so only enable it when each encoded
+        batch is matched before more than a couple further encodes
+        (the 1-deep pipelined pattern). The default allocates fresh
+        arrays and is safe to hold indefinitely."""
+        return self._encode_for_backend(rows, reuse_buffers=reuse_buffers)
+
+    def _encode_for_backend(
+        self, rows: Sequence[Response], reuse_buffers: bool = True
+    ):
         """Encode rows for whichever device backend is active.
 
         The sharded backend needs the batch row count divisible by the
@@ -263,12 +272,10 @@ class MatchEngine:
                     rows,
                     max_body=self.max_body,
                     max_header=self.max_header,
-                    # engine batches are consumed within the pipeline
-                    # window — recycled buffers are safe (encoding.
-                    # _RotatingPool aliasing contract); the "all"
-                    # stream synthesizes on device (half the encode
-                    # bytes and H2D traffic stay on the host)
-                    reuse_buffers=True,
+                    # the "all" stream synthesizes on device (half
+                    # the encode bytes and H2D traffic stay on the
+                    # host)
+                    reuse_buffers=reuse_buffers,
                     build_all=False,
                 ),
                 self.device,
@@ -280,19 +287,12 @@ class MatchEngine:
             max_body=self.max_body,
             max_header=self.max_header,
             pad_rows_to=round_up(len(rows), data_ranks),
-            reuse_buffers=True,
+            reuse_buffers=reuse_buffers,
         )
         if seq_ranks > 1:
-            halo = self.sharded.halo
-            for name, arr in batch.streams.items():
-                per_rank = max(
-                    round_up(arr.shape[1], seq_ranks) // seq_ranks, halo
-                )
-                target = round_up(per_rank, 128) * seq_ranks
-                if target > arr.shape[1]:
-                    batch.streams[name] = np.pad(
-                        arr, ((0, 0), (0, target - arr.shape[1]))
-                    )
+            from swarm_tpu.parallel.sharded import pad_streams_for_seq
+
+            pad_streams_for_seq(batch.streams, seq_ranks, self.sharded.halo)
         return batch, self.sharded
 
     # ------------------------------------------------------------------

@@ -109,6 +109,25 @@ def max_entry_len(db: fpc.CompiledDB) -> int:
     return out
 
 
+def pad_streams_for_seq(streams: dict, seq_ranks: int, halo: int) -> None:
+    """Widen streams IN PLACE so each seq rank's slice is at least one
+    halo wide and 128-aligned — the invariant :class:`ShardedMatcher`
+    enforces (narrow streams like the width-1 OOB placeholders must
+    widen before seq sharding). The single shared implementation: the
+    engine's encode path and the multichip dryrun both pad through
+    here, so the rule cannot drift between them again."""
+    import numpy as np
+
+    from swarm_tpu.ops.encoding import round_up
+
+    seq = max(seq_ranks, 1)
+    for name, arr in streams.items():
+        per_rank = max(round_up(arr.shape[1], seq) // seq, halo)
+        target = round_up(per_rank, 128) * seq
+        if target > arr.shape[1]:
+            streams[name] = np.pad(arr, ((0, 0), (0, target - arr.shape[1])))
+
+
 @dataclasses.dataclass
 class ShardedMatcher:
     """Builds and caches the pjit'd sharded match step for one mesh."""
