@@ -214,12 +214,14 @@ def bench_exact_engine(templates) -> tuple:  # (rows_per_sec, CompiledDB)
     t0 = time.perf_counter()
     n = 0
     with ThreadPoolExecutor(max_workers=1) as pool:
-        fut = pool.submit(eng.encode_packed, batches[0])
+        # reuse_buffers: the 1-deep pipeline is exactly the recycled-
+        # pool-safe pattern (each pre is matched before the next encode)
+        fut = pool.submit(eng.encode_packed, batches[0], True)
         for i in range(ITERS):
             pre = fut.result()
             if i + 1 < ITERS:  # no unconsumed encode inside the timing
                 fut = pool.submit(
-                    eng.encode_packed, batches[(i + 1) % len(batches)]
+                    eng.encode_packed, batches[(i + 1) % len(batches)], True
                 )
             eng.match_packed(batches[i % len(batches)], pre=pre)
             n += ROWS

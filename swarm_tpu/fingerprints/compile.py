@@ -210,9 +210,18 @@ def required_literal_set(
         return sorted(alts)
 
     def expansions(seq, ci: bool) -> Optional[list[bytes]]:
-        """All full literal expansions of ``seq`` (lowered, deduped), or
-        None if any part is not literal/branch/class/fixed-repeat.
-        Lowering is sound: the probe always scans the lowered stream."""
+        """All full literal expansions of ``seq`` (lowered, deduped):
+        None if any part is not literal/branch/class/fixed-repeat, []
+        if the sequence is DEAD (can never match — see below).
+        Lowering is sound: the probe always scans the lowered stream.
+
+        Deadness: the oracle matches over the latin-1 decode
+        (cpu_ref._decode), whose code points are all ≤ 0xFF — a
+        case-sensitive LITERAL above 0xFF (e.g. the ⚡ in tech-detect's
+        amp matcher) can never match, so an alternation branch
+        containing one contributes nothing and the LIVE branches'
+        literals remain necessary. Under IGNORECASE this is unsound
+        (U+212A KELVIN SIGN folds to 'k') and stays unsupported."""
         outs = [b""]
 
         def cross(alts: list[bytes]) -> bool:
@@ -222,9 +231,11 @@ def required_literal_set(
 
         for op, arg in seq:
             opname = str(op)
-            if opname == "LITERAL" and 0 <= arg < 256:
+            if opname == "LITERAL" and arg >= 0:
                 if ci and arg >= 0x80:
                     return None  # Unicode folding ≠ ASCII lowering
+                if arg > 0xFF:
+                    return []  # dead: can't occur in latin-1 text
                 if not cross([_lower_ascii(bytes([arg]))]):
                     return None
             elif opname == "IN":
@@ -238,13 +249,21 @@ def required_literal_set(
                 child = expansions(arg[3], child_ci)
                 if child is None or not cross(child):
                     return None
+                if child == []:
+                    return []  # dead group ⇒ dead sequence
             elif opname == "BRANCH":
                 alts = []
+                saw_live = False
                 for branch in arg[1]:
                     exp = expansions(branch, ci)
                     if exp is None:
                         return None
+                    if exp == []:
+                        continue  # dead branch: drop it
+                    saw_live = True
                     alts.extend(exp)
+                if not saw_live:
+                    return []  # every branch dead ⇒ dead sequence
                 if not cross(alts):
                     return None
             elif opname == "MAX_REPEAT" or opname == "MIN_REPEAT":
@@ -254,6 +273,8 @@ def required_literal_set(
                 exp = expansions(child, ci)
                 if exp is None:
                     return None
+                if exp == [] and lo >= 1:
+                    return []  # dead child with a mandatory copy
                 for _ in range(int(lo)):
                     if not cross(exp):
                         return None

@@ -121,24 +121,30 @@ def match_matcher(matcher: Matcher, response: Response) -> Optional[bool]:
     return (not verdict) if matcher.negative else verdict
 
 
-def _extract(op: Operation, response: Response) -> list[str]:
+def extract_one(ex, response: Response) -> list[str]:
+    """One extractor's values for one response row."""
     from swarm_tpu.fingerprints import extractors as ext
 
+    if ex.type != "regex":
+        return ext.extract_structured(ex, response)
+    out: list[str] = []
+    text = _decode(response.part(ex.part))
+    for pattern in ex.regex:
+        try:
+            for m in _compile_cached(pattern).finditer(text):
+                try:
+                    out.append(m.group(ex.group))
+                except IndexError:
+                    out.append(m.group(0))
+        except re.error:
+            continue
+    return out
+
+
+def _extract(op: Operation, response: Response) -> list[str]:
     out: list[str] = []
     for ex in op.extractors:
-        if ex.type != "regex":
-            out.extend(ext.extract_structured(ex, response))
-            continue
-        text = _decode(response.part(ex.part))
-        for pattern in ex.regex:
-            try:
-                for m in _compile_cached(pattern).finditer(text):
-                    try:
-                        out.append(m.group(ex.group))
-                    except IndexError:
-                        out.append(m.group(0))
-            except re.error:
-                continue
+        out.extend(extract_one(ex, response))
     return out
 
 

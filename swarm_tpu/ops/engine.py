@@ -44,7 +44,11 @@ class RowMatches:
 
     template_ids: list
     extractions: dict  # template_id -> list[str]
-    confirmed_on_host: int = 0  # uncertain pairs the host re-checked
+    # uncertain pairs the host re-checked. Under content dedup a
+    # confirm happens once per DISTINCT content and is attributed to
+    # the group's representative row — duplicate members report 0
+    # (the work genuinely wasn't repeated for them).
+    confirmed_on_host: int = 0
 
 
 @dataclasses.dataclass
@@ -62,7 +66,9 @@ class PackedMatches:
     template_ids: list
     extractions: dict
     host_always_matches: list
-    confirms_per_row: dict  # row -> host confirmations spent on it
+    # row -> host confirmations spent on it. Confirms happen once per
+    # DISTINCT content (dedup) and land on the representative row.
+    confirms_per_row: dict
 
 
 @dataclasses.dataclass
@@ -85,6 +91,51 @@ def _iter_set_bits(row_bytes: np.ndarray, limit: int) -> np.ndarray:
     if limit <= 0:
         return np.empty((0,), dtype=np.int64)
     return np.flatnonzero(np.unpackbits(row_bytes, count=limit))
+
+
+_ROWDEP_VAR_RE = None
+
+
+def _is_row_dependent(t: Template) -> bool:
+    """Whether any matcher/extractor reads beyond response content
+    (host/hostname/port/duration/ip dsl vars or the "host" part)."""
+    global _ROWDEP_VAR_RE
+    if _ROWDEP_VAR_RE is None:
+        import re
+
+        _ROWDEP_VAR_RE = re.compile(r"\b(host|hostname|port|duration|ip)\b")
+    for op in t.operations:
+        for m in op.matchers:
+            if (m.part or "") == "host":
+                return True
+            if any(_ROWDEP_VAR_RE.search(e) for e in m.dsl):
+                return True
+        for ex in op.extractors:
+            if (ex.part or "") == "host":
+                return True
+    return False
+
+
+def _dedup_rows(rows: Sequence[Response]):
+    """(uniq_indices, back) — rows keyed by full response CONTENT.
+
+    ``back[i]`` is the unique-slot index of row i. Everything the
+    device and the content-side host walk read is in the key; host/
+    port/duration are deliberately NOT (see MatchEngine._rowdep_t)."""
+    key_of: dict = {}
+    uniq: list[int] = []
+    back = np.empty(len(rows), dtype=np.int64)
+    for i, r in enumerate(rows):
+        k = (
+            r.banner, r.body, r.header, r.status,
+            r.oob_protocols, r.oob_requests, r.oob_ips,
+        )
+        j = key_of.get(k)
+        if j is None:
+            j = key_of[k] = len(uniq)
+            uniq.append(i)
+        back[i] = j
+    return uniq, back
 
 
 class MatchEngine:
@@ -137,11 +188,66 @@ class MatchEngine:
         self._ext_t_idx = [
             i for i, has in enumerate(self._has_extractors) if has
         ]
+        self._ext_cols = np.asarray(self._ext_t_idx, dtype=np.int64)
+        self._ext_masks = (
+            0x80 >> (self._ext_cols & 7)
+        ).astype(np.uint8) if len(self._ext_cols) else np.zeros(0, np.uint8)
         # vectorized per-op matcher-id tables: resolving a giant op
         # (fingerprinthub: 2,897 matchers) must not walk bits in Python
         self._op_m_arr = [
             np.asarray(ids, dtype=np.int64) for ids in db.op_matchers
         ]
+        # content-keyed extraction memo (cross-batch): scan responses
+        # repeat heavily (default pages are byte-identical fleet-wide)
+        # and tech templates with version extractors fire on most rows,
+        # so re-running the same regex/kval over the same bytes per row
+        # dominated the host walk. Keyed per EXTRACTOR on exactly the
+        # content it reads; bounded FIFO (keys hold the part bytes).
+        self._ext_cache: dict = {}
+        # cross-batch confirm memo for part-keyed matcher types
+        # (word/regex/binary/size) — same bounding as _ext_cache
+        self._confirm_cache: dict = {}
+        # ROW-dependent templates: verdicts/extractions that read
+        # beyond the response content (host/port/duration dsl vars,
+        # part "host") — e.g. the takeover family's
+        # !contains(host, "tumblr.com") gates. Content-identical rows
+        # from different hosts can disagree on exactly these templates,
+        # so the content-dedup fast path resolves them per member row
+        # (everything else resolves once per distinct content).
+        # Conservative detection: false positives only cost speed.
+        self._rowdep_t = frozenset(
+            i for i, t in enumerate(db.templates) if _is_row_dependent(t)
+        )
+
+    _EXT_CACHE_MAX = 16384
+
+    @classmethod
+    def _cache_put(cls, cache: dict, key, val) -> None:
+        """Bounded FIFO insert shared by the cross-batch content memos:
+        past the cap, drop the oldest half (dict preserves order)."""
+        if len(cache) >= cls._EXT_CACHE_MAX:
+            for k in list(cache)[: cls._EXT_CACHE_MAX // 2]:
+                del cache[k]
+        cache[key] = val
+
+    def _extract_op(self, op, row: Response) -> list:
+        """cpu_ref._extract with per-extractor content memoization."""
+        out: list = []
+        cache = self._ext_cache
+        for ex in op.extractors:
+            if ex.type in ("regex", "json", "xpath"):
+                key = (id(ex), row.part(ex.part))
+            elif ex.type == "kval":
+                key = (id(ex), row.part("header"), row.oob_ips)
+            else:
+                out.extend(cpu_ref.extract_one(ex, row))
+                continue
+            vals = cache.get(key)
+            if vals is None:
+                vals = cpu_ref.extract_one(ex, row)
+                self._cache_put(cache, key, vals)
+            out.extend(vals)
+        return out
 
     # ------------------------------------------------------------------
     def match(self, responses: Sequence[Response]) -> list[RowMatches]:
@@ -256,44 +362,54 @@ class MatchEngine:
     def _encode_for_backend(
         self, rows: Sequence[Response], reuse_buffers: bool = True
     ):
-        """Encode rows for whichever device backend is active.
+        """Encode rows for whichever device backend is active, CONTENT-
+        DEDUPLICATED: fleet scans see the same default pages on most
+        hosts, so only distinct responses ride the device (and the host
+        walk); verdicts broadcast back per row. Returns
+        ``(batch, matcher, uniq, back, n_source)`` — ``batch`` covers
+        ``rows[i] for i in uniq`` padded up to a 256-row bucket (a
+        bounded set of jit shapes), ``back`` maps each source row to
+        its unique slot.
 
-        The sharded backend needs the batch row count divisible by the
-        'data' axis and every stream width divisible by 'seq' with each
-        per-rank slice at least one halo wide (parallel/sharded.py
-        raises otherwise); padding is zeros, which the length masks
-        already ignore, and padded rows are sliced off the verdicts.
+        The sharded backend additionally needs the row count divisible
+        by the 'data' axis and every stream width divisible by 'seq'
+        with each per-rank slice at least one halo wide
+        (parallel/sharded.py raises otherwise); padding is zeros, which
+        the length masks already ignore, and padded rows are sliced off
+        the verdicts.
         """
         if not self._backend_ready:
             self._resolve_backend()
+        rows = list(rows)
+        uniq, back = _dedup_rows(rows)
+        urows = [rows[i] for i in uniq]
+        n_pad = round_up(max(len(urows), 1), 256)
         if self.sharded is None:
-            return (
-                encode_batch(
-                    rows,
-                    max_body=self.max_body,
-                    max_header=self.max_header,
-                    # the "all" stream synthesizes on device (half
-                    # the encode bytes and H2D traffic stay on the
-                    # host)
-                    reuse_buffers=reuse_buffers,
-                    build_all=False,
-                ),
-                self.device,
+            batch = encode_batch(
+                urows,
+                max_body=self.max_body,
+                max_header=self.max_header,
+                pad_rows_to=n_pad,
+                # the "all" stream synthesizes on device (half the
+                # encode bytes and H2D traffic stay on the host)
+                reuse_buffers=reuse_buffers,
+                build_all=False,
             )
+            return batch, self.device, uniq, back, len(rows)
         data_ranks = self.sharded.ranks.get("data", 1)
         seq_ranks = self.sharded.ranks.get("seq", 1)
         batch = encode_batch(
-            rows,
+            urows,
             max_body=self.max_body,
             max_header=self.max_header,
-            pad_rows_to=round_up(len(rows), data_ranks),
+            pad_rows_to=round_up(n_pad, data_ranks),
             reuse_buffers=reuse_buffers,
         )
         if seq_ranks > 1:
             from swarm_tpu.parallel.sharded import pad_streams_for_seq
 
             pad_streams_for_seq(batch.streams, seq_ranks, self.sharded.halo)
-        return batch, self.sharded
+        return batch, self.sharded, uniq, back, len(rows)
 
     # ------------------------------------------------------------------
     def match_packed(
@@ -344,18 +460,23 @@ class MatchEngine:
             )
 
         rows = all_rows
-        if pre is not None and len(pre[0].rows) != len(rows):
+        enc = pre if pre is not None else self._encode_for_backend(rows)
+        batch, matcher, uniq, back, n_src = enc
+        if n_src != len(rows):
             raise ValueError(
-                f"pre-encoded batch is for {len(pre[0].rows)} rows, "
+                f"pre-encoded batch is for {n_src} rows, "
                 f"match_packed got {len(rows)}"
             )
-        batch, matcher = pre if pre is not None else self._encode_for_backend(rows)
+        # the device and the content-side host walk run over DISTINCT
+        # response contents only (fleet scans repeat default pages on
+        # most hosts); verdicts broadcast back per member at the end
+        urows = [rows[i] for i in uniq]
         t0 = time.perf_counter()
         pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
             matcher.match(batch.streams, batch.lengths, batch.status, full=True)
         )
-        # slice off mesh row padding before the host walk
-        B = len(rows)
+        # slice off bucket/mesh row padding before the host walk
+        B = len(urows)
         pt_value = np.array(np.asarray(pt_value)[:B])  # writable copy
         pt_unc = np.asarray(pt_unc)[:B]
         pop_value = np.asarray(pop_value)[:B]
@@ -363,7 +484,7 @@ class MatchEngine:
         pm_unc = np.asarray(pm_unc)[:B]
         overflow = np.asarray(overflow)[:B]
         self.stats.device_seconds += time.perf_counter() - t0
-        self.stats.rows += B
+        self.stats.rows += len(rows)
         self.stats.batches += 1
 
         # rows needing whole-row reconfirmation (candidate overflow or
@@ -376,11 +497,12 @@ class MatchEngine:
         db = self.db
 
         op_cache: dict = {}  # (b, op_id) -> exact bool
-        # content-keyed matcher memo: scan batches repeat headers and
-        # default pages heavily, and a matcher's verdict depends only on
-        # its part bytes (bytes hashing is cached by CPython, so the
-        # dict lookup is cheap after the first touch per row)
-        part_cache: dict = {}
+        # content-keyed matcher memo — CROSS-batch (self._confirm_cache):
+        # scan batches repeat headers and default pages heavily, and a
+        # matcher's verdict depends only on its part bytes; the slow
+        # confirm regexes (waf-detect's backtracking patterns) then run
+        # once per distinct content, not once per batch
+        part_cache = self._confirm_cache
 
         def confirm_matcher(m_id: int, row: Response) -> bool:
             matcher = self._m_obj[m_id]
@@ -393,7 +515,7 @@ class MatchEngine:
             if v is None:
                 mv = cpu_ref.match_matcher(matcher, row)
                 v = bool(mv) if mv is not None else False
-                part_cache[key] = v
+                self._cache_put(part_cache, key, v)
             return v
 
         def resolve_op(b: int, op_id: int, row: Response) -> bool:
@@ -424,39 +546,61 @@ class MatchEngine:
             op_cache[key] = v
             return v
 
+        # group members per unique slot (for per-member fixups)
+        members: list[list[int]] = [[] for _ in uniq]
+        for i, ub in enumerate(back):
+            members[int(ub)].append(i)
+        rowdep = self._rowdep_t
+        # (unique slot, t_idx) pairs whose verdict must be decided per
+        # MEMBER row (row-dependent template went device-undecided)
+        deferred: list = []
+
         # --- full-row redo (rare): the oracle end to end, extractions
-        # included (the extraction pass below skips these rows) ---
+        # included (the extraction pass below skips these rows).
+        # Content-independent templates run once on the representative;
+        # row-dependent ones run per member in the fixup pass below ---
         redo_rows = np.flatnonzero(row_redo)
-        redo_extractions: dict = {}
+        uredo_extractions: dict = {}  # (unique slot, tid) -> values
         for b in redo_rows:
-            row = rows[b]
+            row = urows[b]
             rowbits = np.zeros((pt_value.shape[1],), dtype=np.uint8)
             for t_idx, template in enumerate(db.templates):
+                if t_idx in rowdep:
+                    deferred.append((int(b), t_idx))
+                    continue
                 res = cpu_ref.match_template(template, row)
                 confirms[b] = confirms.get(b, 0) + 1
                 self.stats.host_confirm_pairs += 1
                 if res.matched:
                     rowbits[t_idx >> 3] |= 0x80 >> (t_idx & 7)
                     if res.extractions:
-                        redo_extractions[(int(b), template.id)] = (
+                        uredo_extractions[(int(b), template.id)] = (
                             res.extractions
                         )
             pt_value[b] = rowbits
 
-        # --- sparse uncertainty resolution ---
+        # --- sparse uncertainty resolution (unique plane) ---
         if not row_redo.all() and pt_unc.any():
             skip = set(redo_rows.tolist())
             for b, byte_i in np.argwhere(pt_unc):
                 if b in skip:
                     continue
                 v = int(pt_unc[b, byte_i])
-                row = rows[b]
+                row = urows[b]
                 base = int(byte_i) * 8
                 for k in range(8):
                     if not (v & (0x80 >> k)):
                         continue
                     t_idx = base + k
                     if t_idx >= NT:
+                        continue
+                    mask = 0x80 >> (t_idx & 7)
+                    if t_idx in rowdep:
+                        # undecided row-dependent template: content-
+                        # identical rows can disagree here — decide per
+                        # member below; clear the broadcast bit
+                        deferred.append((int(b), t_idx))
+                        pt_value[b, byte_i] &= 0xFF ^ mask
                         continue
                     # undecided ⇒ no certain-true op; OR over the
                     # uncertain ops' exact values decides the template
@@ -467,47 +611,105 @@ class MatchEngine:
                         ):
                             hit = True
                             break
-                    mask = 0x80 >> (t_idx & 7)
                     if hit:
                         pt_value[b, byte_i] |= mask
                     else:
                         pt_value[b, byte_i] &= 0xFF ^ mask
 
-        # --- extraction pass: only extractor templates, only hit rows ---
-        extractions: dict = dict(redo_extractions)
+        # --- extraction pass (unique plane): only extractor templates,
+        # only hit rows (one vectorized gather over all extractor
+        # columns at once — a Python loop over ~600 extractor templates
+        # costs more than the actual extractions). Row-dependent
+        # templates are handled in the member fixup pass ---
+        uextractions: dict = dict(uredo_extractions)
         redo_set = set(redo_rows.tolist())
-        for t_idx in self._ext_t_idx:
-            col = pt_value[:, t_idx >> 3] & (0x80 >> (t_idx & 7))
-            for b in np.flatnonzero(col):
+        if len(self._ext_cols):
+            hit_mat = (
+                pt_value[:, self._ext_cols >> 3]
+                & self._ext_masks[None, :]
+            ) != 0  # [B, n_ext]
+            for b, e in np.argwhere(hit_mat):
                 if int(b) in redo_set:
                     continue  # oracle already extracted above
-                row = rows[b]
+                t_idx = int(self._ext_cols[e])
+                if t_idx in rowdep:
+                    continue
+                row = urows[b]
                 parts: list = []
                 for op_id in db.t_ops[t_idx]:
                     if resolve_op(b, op_id, row):
                         parts.extend(
-                            cpu_ref._extract(self._op_obj[op_id], row)
+                            self._extract_op(self._op_obj[op_id], row)
                         )
                 if parts:
-                    extractions[(int(b), db.template_ids[t_idx])] = parts
+                    uextractions[(int(b), db.template_ids[t_idx])] = parts
 
-        # --- host-always tail: templates the compiler couldn't lower ---
+        # --- broadcast the unique plane to the source rows ---
+        bits = pt_value[back] if len(rows) else pt_value[:0]
+        bits = np.ascontiguousarray(bits)
+        extractions = {}
+        for (ub, tid), vals in uextractions.items():
+            for i in members[ub]:
+                extractions[(i, tid)] = vals
+        conf_full: dict = {
+            uniq[ub]: n for ub, n in confirms.items()
+        }
+
+        # --- member fixups: row-dependent templates (takeover family's
+        # host gates, duration checks) decided per actual row via the
+        # oracle; also their certain hits' extractions, which may read
+        # host. Rare by construction — these bits only defer when the
+        # content side actually fired ---
+        seen_pairs = set()
+        for ub, t_idx in deferred:
+            if (ub, t_idx) in seen_pairs:
+                continue
+            seen_pairs.add((ub, t_idx))
+            template = db.templates[t_idx]
+            mask = 0x80 >> (t_idx & 7)
+            byte_i = t_idx >> 3
+            for i in members[ub]:
+                res = cpu_ref.match_template(template, rows[i])
+                conf_full[i] = conf_full.get(i, 0) + 1
+                self.stats.host_confirm_pairs += 1
+                if res.matched:
+                    bits[i, byte_i] |= mask
+                    if res.extractions:
+                        extractions[(i, template.id)] = res.extractions
+                else:
+                    bits[i, byte_i] &= 0xFF ^ mask
+        # certain-set row-dependent templates with extractors: verdict
+        # is content-determined (broadcast is exact) but extraction
+        # values may read the member's host
+        for t_idx in self._ext_t_idx:
+            if t_idx not in rowdep:
+                continue
+            byte_i, mask = t_idx >> 3, 0x80 >> (t_idx & 7)
+            template = db.templates[t_idx]
+            for ub in np.flatnonzero(pt_value[:, byte_i] & mask):
+                for i in members[int(ub)]:
+                    res = cpu_ref.match_template(template, rows[i])
+                    if res.matched and res.extractions:
+                        extractions[(i, template.id)] = res.extractions
+
+        # --- host-always tail: templates the compiler couldn't lower
+        # (exact per actual row — these may read host) ---
         host_always_matches: list = []
         if self.host_always_mode == "full" and db.host_always:
-            for b, row in enumerate(rows):
+            for i, row in enumerate(rows):
                 for template in db.host_always:
                     res = cpu_ref.match_template(template, row)
                     self.stats.host_always_pairs += 1
                     if res.matched:
-                        host_always_matches.append((b, template.id))
+                        host_always_matches.append((i, template.id))
                         if res.extractions:
-                            extractions[(b, template.id)] = res.extractions
+                            extractions[(i, template.id)] = res.extractions
 
         self.stats.host_confirm_seconds += time.perf_counter() - t1
         return PackedMatches(
-            bits=pt_value,
+            bits=bits,
             template_ids=db.template_ids,
             extractions=extractions,
             host_always_matches=host_always_matches,
-            confirms_per_row=confirms,
+            confirms_per_row=conf_full,
         )

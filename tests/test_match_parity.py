@@ -236,6 +236,99 @@ def test_device_all_synthesis_matches_host_built():
     assert (np.asarray(same["all"]) == fa).all()
 
 
+def test_content_dedup_keeps_row_dependent_templates_exact():
+    """The engine deduplicates content-identical rows before the device
+    pass; templates whose matchers read host/duration (the takeover
+    family shape) must still resolve PER ROW — two rows with identical
+    bytes but different hosts can disagree on exactly those templates."""
+    import textwrap
+
+    import yaml
+
+    from swarm_tpu.fingerprints.nuclei import parse_template
+
+    takeover = parse_template(yaml.safe_load(textwrap.dedent("""\
+        id: fake-takeover
+        info: {name: t, severity: high}
+        requests:
+          - method: GET
+            path: ["{{BaseURL}}/"]
+            matchers-condition: and
+            matchers:
+              - type: word
+                words: ["There is no such site hosted here"]
+              - type: dsl
+                dsl:
+                  - '!contains(host, "safe.example")'
+    """)), source_path="t/tk.yaml")
+    plain = parse_template(yaml.safe_load(textwrap.dedent("""\
+        id: plain-tech
+        info: {name: p, severity: info}
+        requests:
+          - method: GET
+            path: ["{{BaseURL}}/"]
+            matchers:
+              - type: word
+                words: ["nginx"]
+    """)), source_path="t/p.yaml")
+    templates = [takeover, plain]
+    body = b"<html>There is no such site hosted here - nginx</html>"
+    # 6 content-identical rows across different hosts, incl. the
+    # excluded domain; plus unrelated noise rows
+    rows = [
+        model.Response(host="a.victim.example", port=80, status=200, body=body),
+        model.Response(host="b.victim.example", port=80, status=200, body=body),
+        model.Response(host="x.safe.example", port=80, status=200, body=body),
+        model.Response(host="c.victim.example", port=80, status=200, body=body),
+        model.Response(host="y.safe.example", port=80, status=200, body=body),
+        model.Response(host="d.victim.example", port=80, status=200, body=body),
+        model.Response(host="n1", port=80, status=200, body=b"just nginx here"),
+        model.Response(host="n2", port=80, status=404, body=b"nothing"),
+    ]
+    eng = assert_parity(templates, rows, mesh=None)
+    got = eng.match(rows)
+    for i, r in enumerate(rows[:6]):
+        want_takeover = "safe.example" not in r.host
+        assert ("fake-takeover" in got[i].template_ids) == want_takeover, r.host
+        assert "plain-tech" in got[i].template_ids
+
+
+def test_content_dedup_extraction_fanout():
+    """Extraction values computed once per distinct content must reach
+    every member row of the group."""
+    import textwrap
+
+    import yaml
+
+    from swarm_tpu.fingerprints.nuclei import parse_template
+
+    t = parse_template(yaml.safe_load(textwrap.dedent("""\
+        id: version-extract
+        info: {name: v, severity: info}
+        requests:
+          - method: GET
+            path: ["{{BaseURL}}/"]
+            matchers:
+              - type: word
+                words: ["ExampleServer"]
+            extractors:
+              - type: regex
+                group: 1
+                regex:
+                  - 'ExampleServer/([0-9.]+)'
+    """)), source_path="t/v.yaml")
+    body = b"<html>ExampleServer/3.14 ready</html>"
+    rows = [
+        model.Response(host=f"h{i}", port=80, status=200, body=body)
+        for i in range(5)
+    ] + [model.Response(host="other", port=80, status=200, body=b"nope")]
+    eng = assert_parity([t], rows, mesh=None)
+    got = eng.match(rows)
+    for i in range(5):
+        assert got[i].extractions.get("version-extract") == ["3.14"], i
+    assert got[5].template_ids == []
+
+
 def test_pipelined_pre_encode_identical():
     """match() pipelines chunk encodes; results must be bit-identical
     to serial match_packed, and an explicit pre= must change nothing."""
