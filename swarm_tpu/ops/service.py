@@ -110,6 +110,8 @@ class ServiceClassifier:
         for idx, (probe_name, _m) in enumerate(self._matches):
             self._by_probe.setdefault(probe_name, []).append(idx)
         self._port_probe_cache: dict[int, ServiceProbe] = {}
+        # (banner, sent_probe) -> classified service fields; bounded
+        self._classify_memo: dict = {}
 
     # ------------------------------------------------------------------
     def _probe_order(self, sent_probe: Optional[str]) -> Optional[list[str]]:
@@ -139,12 +141,27 @@ class ServiceClassifier:
             if not row.alive or not banner:
                 out.append(info)
                 continue
+            # fleet banners repeat heavily (every OpenSSH 8.9 host says
+            # the same bytes): the whole verify/veto walk below is a
+            # pure function of (banner, sent probe), so memo its
+            # service fields across rows and batches
+            sent = sent_probes[i] if sent_probes else None
+            mkey = (banner, sent)
+            memo = self._classify_memo.get(mkey)
+            if memo is not None:
+                (
+                    info.service, info.product, info.version,
+                    info.info, cpe, info.soft,
+                ) = memo
+                info.cpe = list(cpe)  # callers may mutate their copy
+                out.append(info)
+                continue
             cand = {
                 int(tid.rsplit("/", 1)[1])
                 for tid in hits.template_ids
                 if tid.startswith("svc/")
             }
-            probe_order = self._probe_order(sent_probes[i] if sent_probes else None)
+            probe_order = self._probe_order(sent)
             if probe_order is None:
                 ordered = sorted(cand)
             else:
@@ -179,6 +196,16 @@ class ServiceClassifier:
             if not hard_done and soft_hit:
                 info.service = soft_hit.service
                 info.soft = True
+            # tuple-copy cpe: the caller owns (and may mutate) its list.
+            # Bounding shares the engine's memo policy (_cache_put).
+            self.engine._cache_put(
+                self._classify_memo,
+                mkey,
+                (
+                    info.service, info.product, info.version,
+                    info.info, tuple(info.cpe), info.soft,
+                ),
+            )
             out.append(info)
         return out
 
