@@ -278,23 +278,25 @@ class MatchEngine:
         for rows, pre in self._iter_encoded(chunks):
             packed = self.match_packed(rows, pre=pre)
             per_row_conf = packed.confirms_per_row
+            # group sparse side-tables by row ONCE — per-row scans of
+            # the whole extraction dict would be quadratic in fleet
+            # batches where extractor templates fire on most rows
+            extr_by_row: dict = {}
+            for (rb, tid), ext in packed.extractions.items():
+                extr_by_row.setdefault(rb, {})[tid] = ext
+            always_by_row: dict = {}
+            for rb, tid in packed.host_always_matches:
+                always_by_row.setdefault(rb, []).append(tid)
             for b in range(len(rows)):
                 tids = [
                     self.db.template_ids[t]
                     for t in _iter_set_bits(packed.bits[b], NT)
                 ]
-                extr = {
-                    tid: ext
-                    for (rb, tid), ext in packed.extractions.items()
-                    if rb == b
-                }
-                for rb, tid in packed.host_always_matches:
-                    if rb == b:
-                        tids.append(tid)
+                tids.extend(always_by_row.get(b, ()))
                 out.append(
                     RowMatches(
                         template_ids=tids,
-                        extractions=extr,
+                        extractions=extr_by_row.get(b, {}),
                         confirmed_on_host=per_row_conf.get(b, 0),
                     )
                 )
