@@ -167,6 +167,41 @@ def test_oob_parity_synthetic(tmp_path, mesh):
     assert not cpu_ref.match_template(by_id["oob-mixed-body"], rows[1]).matched
 
 
+def test_oob_fields_prevent_content_dedup_merge(tmp_path):
+    """Rows identical except for their OOB interaction data must NOT
+    collapse in the engine's content dedup — the interaction is part of
+    the content key (a vulnerable host's callback row and a clean
+    host's identical page row differ only there)."""
+    templates, errors = load_corpus(_write_corpus(tmp_path))
+    assert not errors
+    from swarm_tpu.ops.engine import MatchEngine, _dedup_rows
+
+    body = b"same page everywhere"
+    rows = [
+        model.Response(host="clean1", port=80, status=200, body=body),
+        model.Response(
+            host="vuln", port=80, status=200, body=body,
+            oob_protocols=("http",),
+            oob_requests=b"GET /si0aaaaaaaaaaaaa HTTP/1.1\r\n\r\n",
+        ),
+        model.Response(host="clean2", port=80, status=200, body=body),
+        model.Response(
+            host="vuln2", port=80, status=200, body=body,
+            oob_protocols=("dns",),
+            oob_requests=b"x.si0bbbbbbbbbbbbb.oob.test",
+        ),
+    ]
+    uniq, back = _dedup_rows(rows)
+    assert len(uniq) == 3  # clean pages merge; each OOB row distinct
+    assert back[0] == back[2] and back[1] != back[0] != back[3]
+
+    eng = MatchEngine(templates, mesh=None)
+    got = eng.match(rows)
+    assert "oob-http-callback" in got[1].template_ids
+    assert got[0].template_ids == [] and got[2].template_ids == []
+    assert "oob-dsl-protocol" in got[3].template_ids
+
+
 @pytest.mark.skipif(not REFERENCE_CORPUS.is_dir(), reason="reference corpus absent")
 def test_oob_parity_reference_log4j_family():
     """The real log4j-rce templates fire from Response.oob_* and agree
