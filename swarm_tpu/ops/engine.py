@@ -80,6 +80,7 @@ class EngineStats:
     host_confirm_pairs: int = 0
     host_always_pairs: int = 0
     overflow_rows: int = 0
+    memo_slots: int = 0  # memo-served slot count, summed per batch
 
 
 def _bit(packed: np.ndarray, b: int, i: int) -> bool:
@@ -117,13 +118,16 @@ def _is_row_dependent(t: Template) -> bool:
 
 
 def _dedup_rows(rows: Sequence[Response]):
-    """(uniq_indices, back) — rows keyed by full response CONTENT.
+    """(uniq_indices, back, keys) — rows keyed by full response CONTENT.
 
-    ``back[i]`` is the unique-slot index of row i. Everything the
-    device and the content-side host walk read is in the key; host/
-    port/duration are deliberately NOT (see MatchEngine._rowdep_t)."""
+    ``back[i]`` is the unique-slot index of row i; ``keys[s]`` is slot
+    s's content key (also the cross-batch verdict-memo key). Everything
+    the device and the content-side host walk read is in the key;
+    host/port/duration are deliberately NOT (see
+    MatchEngine._rowdep_t)."""
     key_of: dict = {}
     uniq: list[int] = []
+    keys: list = []
     back = np.empty(len(rows), dtype=np.int64)
     for i, r in enumerate(rows):
         k = (
@@ -134,8 +138,9 @@ def _dedup_rows(rows: Sequence[Response]):
         if j is None:
             j = key_of[k] = len(uniq)
             uniq.append(i)
+            keys.append(k)
         back[i] = j
-    return uniq, back
+    return uniq, back, keys
 
 
 class MatchEngine:
@@ -207,6 +212,13 @@ class MatchEngine:
         # cross-batch confirm memo for part-keyed matcher types
         # (word/regex/binary/size) — same bounding as _ext_cache
         self._confirm_cache: dict = {}
+        # cross-batch VERDICT memo: content key -> (packed verdict row,
+        # extraction entries, deferred row-dependent template ids).
+        # Fleet batches repeat the same pages batch after batch; known
+        # content skips the encode, the device, and the host walk
+        # entirely. Entries are only stored for fully-resolved
+        # (non-truncated, non-overflow) content. Bounded FIFO.
+        self._verdict_memo: dict = {}
         # ROW-dependent templates: verdicts/extractions that read
         # beyond the response content (host/port/duration dsl vars,
         # part "host") — e.g. the takeover family's
@@ -365,13 +377,20 @@ class MatchEngine:
         self, rows: Sequence[Response], reuse_buffers: bool = True
     ):
         """Encode rows for whichever device backend is active, CONTENT-
-        DEDUPLICATED: fleet scans see the same default pages on most
-        hosts, so only distinct responses ride the device (and the host
-        walk); verdicts broadcast back per row. Returns
-        ``(batch, matcher, uniq, back, n_source)`` — ``batch`` covers
-        ``rows[i] for i in uniq`` padded up to a 256-row bucket (a
-        bounded set of jit shapes), ``back`` maps each source row to
-        its unique slot.
+        DEDUPLICATED two ways: within the batch (fleet scans see the
+        same default pages on most hosts) and ACROSS batches via the
+        bounded verdict memo — content the engine has fully resolved
+        before never rides the device again. Returns
+        ``(batch, matcher, uniq, back, n_source, new_ids, keys,
+        known)``:
+        ``uniq``/``back`` are the in-batch dedup (slot ← source rows),
+        ``keys[s]`` slot s's content key, ``new_ids`` the slots NOT
+        served by the verdict memo, and ``batch`` covers exactly those
+        (padded up to a 256-row bucket for a bounded set of jit
+        shapes) — or None when every slot is known. The trailing
+        ``known`` dict ({slot: memo entry}) snapshots the served
+        entries AT ENCODE TIME so FIFO eviction between a pipelined
+        encode and its match can't lose a verdict.
 
         The sharded backend additionally needs the row count divisible
         by the 'data' axis and every stream width divisible by 'seq'
@@ -383,12 +402,25 @@ class MatchEngine:
         if not self._backend_ready:
             self._resolve_backend()
         rows = list(rows)
-        uniq, back = _dedup_rows(rows)
-        urows = [rows[i] for i in uniq]
-        n_pad = round_up(max(len(urows), 1), 256)
+        uniq, back, keys = _dedup_rows(rows)
+        memo = self._verdict_memo
+        # snapshot known entries NOW: FIFO eviction between a pipelined
+        # encode and its match must not lose a slot's served verdict
+        known: dict = {}
+        new_ids: list = []
+        for s, k in enumerate(keys):
+            entry = memo.get(k)
+            if entry is None:
+                new_ids.append(s)
+            else:
+                known[s] = entry
+        if not new_ids:
+            return None, None, uniq, back, len(rows), new_ids, keys, known
+        nrows = [rows[uniq[s]] for s in new_ids]
+        n_pad = round_up(max(len(nrows), 1), 256)
         if self.sharded is None:
             batch = encode_batch(
-                urows,
+                nrows,
                 max_body=self.max_body,
                 max_header=self.max_header,
                 pad_rows_to=n_pad,
@@ -397,11 +429,11 @@ class MatchEngine:
                 reuse_buffers=reuse_buffers,
                 build_all=False,
             )
-            return batch, self.device, uniq, back, len(rows)
+            return batch, self.device, uniq, back, len(rows), new_ids, keys, known
         data_ranks = self.sharded.ranks.get("data", 1)
         seq_ranks = self.sharded.ranks.get("seq", 1)
         batch = encode_batch(
-            urows,
+            nrows,
             max_body=self.max_body,
             max_header=self.max_header,
             pad_rows_to=round_up(n_pad, data_ranks),
@@ -411,7 +443,7 @@ class MatchEngine:
             from swarm_tpu.parallel.sharded import pad_streams_for_seq
 
             pad_streams_for_seq(batch.streams, seq_ranks, self.sharded.halo)
-        return batch, self.sharded, uniq, back, len(rows)
+        return batch, self.sharded, uniq, back, len(rows), new_ids, keys, known
 
     # ------------------------------------------------------------------
     def match_packed(
@@ -463,35 +495,44 @@ class MatchEngine:
 
         rows = all_rows
         enc = pre if pre is not None else self._encode_for_backend(rows)
-        batch, matcher, uniq, back, n_src = enc
+        batch, matcher, uniq, back, n_src, new_ids, keys, known = enc
         if n_src != len(rows):
             raise ValueError(
                 f"pre-encoded batch is for {n_src} rows, "
                 f"match_packed got {len(rows)}"
             )
         # the device and the content-side host walk run over DISTINCT
-        # response contents only (fleet scans repeat default pages on
-        # most hosts); verdicts broadcast back per member at the end
-        urows = [rows[i] for i in uniq]
-        t0 = time.perf_counter()
-        pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
-            matcher.match(batch.streams, batch.lengths, batch.status, full=True)
-        )
-        # slice off bucket/mesh row padding before the host walk
-        B = len(urows)
-        pt_value = np.array(np.asarray(pt_value)[:B])  # writable copy
-        pt_unc = np.asarray(pt_unc)[:B]
-        pop_value = np.asarray(pop_value)[:B]
-        pop_unc = np.asarray(pop_unc)[:B]
-        pm_unc = np.asarray(pm_unc)[:B]
-        overflow = np.asarray(overflow)[:B]
-        self.stats.device_seconds += time.perf_counter() - t0
+        # NEW response contents only (in-batch dedup + cross-batch
+        # verdict memo); verdicts broadcast back per member at the end
+        nrows = [rows[uniq[s]] for s in new_ids]
+        B = len(nrows)
+        if batch is not None:
+            t0 = time.perf_counter()
+            pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
+                matcher.match(
+                    batch.streams, batch.lengths, batch.status, full=True
+                )
+            )
+            # slice off bucket/mesh row padding before the host walk
+            pt_value = np.array(np.asarray(pt_value)[:B])  # writable copy
+            pt_unc = np.asarray(pt_unc)[:B]
+            pop_value = np.asarray(pop_value)[:B]
+            pop_unc = np.asarray(pop_unc)[:B]
+            pm_unc = np.asarray(pm_unc)[:B]
+            overflow = np.asarray(overflow)[:B]
+            self.stats.device_seconds += time.perf_counter() - t0
+            row_redo = overflow | batch.truncated[:B]
+        else:  # every slot served by the verdict memo
+            nbits = max(nbytes, 1)
+            pt_value = np.zeros((0, nbits), dtype=np.uint8)
+            pt_unc = pop_value = pop_unc = pm_unc = pt_value
+            row_redo = np.zeros((0,), dtype=bool)
         self.stats.rows += len(rows)
         self.stats.batches += 1
+        self.stats.memo_slots += len(uniq) - len(new_ids)
 
         # rows needing whole-row reconfirmation (candidate overflow or
         # stream truncation made word bits unsound for the row)
-        row_redo = overflow | batch.truncated[:B]
         self.stats.overflow_rows += int(row_redo.sum())
 
         t1 = time.perf_counter()
@@ -562,9 +603,9 @@ class MatchEngine:
         # Content-independent templates run once on the representative;
         # row-dependent ones run per member in the fixup pass below ---
         redo_rows = np.flatnonzero(row_redo)
-        uredo_extractions: dict = {}  # (unique slot, tid) -> values
+        uredo_extractions: dict = {}  # (new-subset pos, tid) -> values
         for b in redo_rows:
-            row = urows[b]
+            row = nrows[b]
             rowbits = np.zeros((pt_value.shape[1],), dtype=np.uint8)
             for t_idx, template in enumerate(db.templates):
                 if t_idx in rowdep:
@@ -588,7 +629,7 @@ class MatchEngine:
                 if b in skip:
                     continue
                 v = int(pt_unc[b, byte_i])
-                row = urows[b]
+                row = nrows[b]
                 base = int(byte_i) * 8
                 for k in range(8):
                     if not (v & (0x80 >> k)):
@@ -636,7 +677,7 @@ class MatchEngine:
                 t_idx = int(self._ext_cols[e])
                 if t_idx in rowdep:
                     continue
-                row = urows[b]
+                row = nrows[b]
                 parts: list = []
                 for op_id in db.t_ops[t_idx]:
                     if resolve_op(b, op_id, row):
@@ -646,15 +687,61 @@ class MatchEngine:
                 if parts:
                     uextractions[(int(b), db.template_ids[t_idx])] = parts
 
+        # --- assemble the full unique plane: walked NEW slots + memo-
+        # served known slots; store fully-resolved new content ---
+        U = len(uniq)
+        nbits_row = max(nbytes, 1)
+        ubits = np.zeros((U, nbits_row), dtype=np.uint8)
+        uext_all: dict = {}  # (slot, tid) -> values
+        deferred_slots: list = []  # (slot, t_idx)
+        ext_by_pos: dict = {}
+        for (b, tid), vals in uextractions.items():
+            ext_by_pos.setdefault(int(b), []).append((tid, vals))
+        def_by_pos: dict = {}
+        for b, t_idx in deferred:
+            def_by_pos.setdefault(int(b), []).append(t_idx)
+        redo_pos = set(redo_rows.tolist())
+        for b in range(B):
+            s = new_ids[b]
+            ubits[s] = pt_value[b]
+            for tid, vals in ext_by_pos.get(b, ()):
+                uext_all[(s, tid)] = vals
+            for t_idx in def_by_pos.get(b, ()):
+                deferred_slots.append((s, t_idx))
+            if b not in redo_pos:
+                # deep-freeze what goes in: bits copied out of the
+                # (reused) plane, extraction VALUES tuple-copied —
+                # callers receive mutable lists, and a caller's in-place
+                # edit must never rewrite the cache
+                self._cache_put(
+                    self._verdict_memo,
+                    keys[s],
+                    (
+                        pt_value[b].tobytes(),
+                        tuple(
+                            (tid, tuple(vals))
+                            for tid, vals in ext_by_pos.get(b, ())
+                        ),
+                        tuple(def_by_pos.get(b, ())),
+                    ),
+                )
+        for s, entry in known.items():
+            mb, ment, mdef = entry
+            ubits[s] = np.frombuffer(mb, dtype=np.uint8)
+            for tid, vals in ment:
+                uext_all[(s, tid)] = list(vals)  # thaw per replay
+            for t_idx in mdef:
+                deferred_slots.append((s, t_idx))
+
         # --- broadcast the unique plane to the source rows ---
-        bits = pt_value[back] if len(rows) else pt_value[:0]
+        bits = ubits[back] if len(rows) else ubits[:0]
         bits = np.ascontiguousarray(bits)
         extractions = {}
-        for (ub, tid), vals in uextractions.items():
+        for (ub, tid), vals in uext_all.items():
             for i in members[ub]:
                 extractions[(i, tid)] = vals
         conf_full: dict = {
-            uniq[ub]: n for ub, n in confirms.items()
+            uniq[new_ids[b]]: n for b, n in confirms.items()
         }
 
         # --- member fixups: row-dependent templates (takeover family's
@@ -663,7 +750,7 @@ class MatchEngine:
         # host. Rare by construction — these bits only defer when the
         # content side actually fired ---
         seen_pairs = set()
-        for ub, t_idx in deferred:
+        for ub, t_idx in deferred_slots:
             if (ub, t_idx) in seen_pairs:
                 continue
             seen_pairs.add((ub, t_idx))
@@ -682,13 +769,14 @@ class MatchEngine:
                     bits[i, byte_i] &= 0xFF ^ mask
         # certain-set row-dependent templates with extractors: verdict
         # is content-determined (broadcast is exact) but extraction
-        # values may read the member's host
+        # values may read the member's host — covers memo-served slots
+        # too (their member set is new every batch), hence ubits
         for t_idx in self._ext_t_idx:
             if t_idx not in rowdep:
                 continue
             byte_i, mask = t_idx >> 3, 0x80 >> (t_idx & 7)
             template = db.templates[t_idx]
-            for ub in np.flatnonzero(pt_value[:, byte_i] & mask):
+            for ub in np.flatnonzero(ubits[:, byte_i] & mask):
                 for i in members[int(ub)]:
                     res = cpu_ref.match_template(template, rows[i])
                     if res.matched and res.extractions:

@@ -329,6 +329,69 @@ def test_content_dedup_extraction_fanout():
     assert got[5].template_ids == []
 
 
+def test_cross_batch_verdict_memo_identical_and_skips_device():
+    """Content the engine fully resolved in an earlier batch is served
+    from the verdict memo — no encode, no device pass — with results
+    (bits, extractions, host-gated fixups) identical to a cold engine."""
+    templates, errors = load_corpus(DATA)
+    assert not errors
+    rng = random.Random(21)
+    rows = fuzz_rows(templates, rng, 48)
+    # add host-gated divergence on shared content (takeover shape)
+    import textwrap
+
+    import yaml
+
+    from swarm_tpu.fingerprints.nuclei import parse_template
+
+    gated = parse_template(yaml.safe_load(textwrap.dedent("""\
+        id: memo-gated
+        info: {name: g, severity: low}
+        requests:
+          - method: GET
+            path: ["{{BaseURL}}/"]
+            matchers-condition: and
+            matchers:
+              - type: word
+                words: ["shared-takeover-page"]
+              - type: dsl
+                dsl: ['!contains(host, "safe.example")']
+    """)), source_path="t/g.yaml")
+    templates = templates + [gated]
+    shared = model.Response(
+        host="", port=80, status=200, body=b"the shared-takeover-page body"
+    )
+    import dataclasses as _dc
+
+    rows += [
+        _dc.replace(shared, host="v1.victim.example"),
+        _dc.replace(shared, host="ok.safe.example"),
+    ]
+
+    eng = MatchEngine(templates, mesh=None, batch_rows=64)
+    first = eng.match(rows)
+    dev_batches_after_first = eng.stats.device_seconds
+    memo0 = eng.stats.memo_slots
+
+    # same content again (different host spread on the gated rows)
+    rows2 = list(rows)
+    rows2[-2] = _dc.replace(shared, host="v2.victim.example")
+    rows2[-1] = _dc.replace(shared, host="x.safe.example")
+    second = eng.match(rows2)
+    assert eng.stats.memo_slots > memo0  # memo actually served slots
+    # no NEW content in batch 2 → the device did no additional work
+    assert eng.stats.device_seconds == dev_batches_after_first
+
+    cold = MatchEngine(templates, mesh=None, batch_rows=64)
+    fresh = cold.match(rows2)
+    for b in range(len(rows2)):
+        assert sorted(second[b].template_ids) == sorted(fresh[b].template_ids), b
+        assert second[b].extractions == fresh[b].extractions, b
+    # the host gate still resolves per row THROUGH the memo
+    assert "memo-gated" in second[-2].template_ids
+    assert "memo-gated" not in second[-1].template_ids
+
+
 def test_pipelined_pre_encode_identical():
     """match() pipelines chunk encodes; results must be bit-identical
     to serial match_packed, and an explicit pre= must change nothing."""

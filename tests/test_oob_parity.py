@@ -191,7 +191,7 @@ def test_oob_fields_prevent_content_dedup_merge(tmp_path):
             oob_requests=b"x.si0bbbbbbbbbbbbb.oob.test",
         ),
     ]
-    uniq, back = _dedup_rows(rows)
+    uniq, back, _keys = _dedup_rows(rows)
     assert len(uniq) == 3  # clean pages merge; each OOB row distinct
     assert back[0] == back[2] and back[1] != back[0] != back[3]
 
