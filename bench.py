@@ -253,6 +253,7 @@ def bench_exact_engine(templates) -> tuple:
         fresh.append(batch_rows)
     eng._ext_cache.clear()
     eng._confirm_cache.clear()
+    eng._verdict_memo.clear()
     eng.match_packed(fresh[0])  # warm any new jit width bucket
     t0 = time.perf_counter()
     for b in fresh[1:]:

@@ -199,6 +199,7 @@ def encode_batch(
     pad_rows_to: Optional[int] = None,
     reuse_buffers: bool = False,
     build_all: bool = True,
+    width_multiple: int = 128,
 ) -> ResponseBatch:
     """Encode responses into the three padded streams.
 
@@ -247,9 +248,13 @@ def encode_batch(
             rows, blens, hlens, status, concat, bptr, hptr
         )
         alens = np.where(concat.astype(bool), hlens + 2 + blens, blens)
-        wb = _width_for(blens, max_body)
-        wh = _width_for(hlens, max_header)
-        wa = _width_for(alens, max_body + max_header) if build_all else 1
+        wb = _width_for(blens, max_body, width_multiple)
+        wh = _width_for(hlens, max_header, width_multiple)
+        wa = (
+            _width_for(alens, max_body + max_header, width_multiple)
+            if build_all
+            else 1
+        )
         if reuse_buffers:
             body_arr = _POOL.get(n, wb, "body")
             header_arr = _POOL.get(n, wh, "header")
@@ -284,9 +289,13 @@ def encode_batch(
             & (hlens > 0)
         ).astype(np.uint8)
         alens = np.where(concat.astype(bool), hlens + 2 + blens, blens)
-        wb = _width_for(blens, max_body)
-        wh = _width_for(hlens, max_header)
-        wa = _width_for(alens, max_body + max_header) if build_all else 1
+        wb = _width_for(blens, max_body, width_multiple)
+        wh = _width_for(hlens, max_header, width_multiple)
+        wa = (
+            _width_for(alens, max_body + max_header, width_multiple)
+            if build_all
+            else 1
+        )
         body_arr = np.zeros((n, wb), dtype=np.uint8)
         header_arr = np.zeros((n, wh), dtype=np.uint8)
         all_arr = np.zeros((n, wa), dtype=np.uint8)

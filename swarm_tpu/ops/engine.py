@@ -408,8 +408,13 @@ class MatchEngine:
         # encode and its match must not lose a slot's served verdict
         known: dict = {}
         new_ids: list = []
+        from swarm_tpu.ops.match import lru_fetch
+
         for s, k in enumerate(keys):
-            entry = memo.get(k)
+            # lru_fetch (not plain get): fleet-hot pages must stay
+            # resident — FIFO would evict exactly the entries serving
+            # the most rows
+            entry = lru_fetch(memo, k)
             if entry is None:
                 new_ids.append(s)
             else:
@@ -425,9 +430,13 @@ class MatchEngine:
                 max_header=self.max_header,
                 pad_rows_to=n_pad,
                 # the "all" stream synthesizes on device (half the
-                # encode bytes and H2D traffic stay on the host)
+                # encode bytes and H2D traffic stay on the host);
+                # coarse width buckets bound the compiled-shape set —
+                # every distinct shape costs a compile AND a big
+                # constant-capturing executable (DeviceDB.MAX_COMPILED)
                 reuse_buffers=reuse_buffers,
                 build_all=False,
+                width_multiple=512,
             )
             return batch, self.device, uniq, back, len(rows), new_ids, keys, known
         data_ranks = self.sharded.ranks.get("data", 1)
@@ -438,6 +447,7 @@ class MatchEngine:
             max_header=self.max_header,
             pad_rows_to=round_up(n_pad, data_ranks),
             reuse_buffers=reuse_buffers,
+            width_multiple=512,
         )
         if seq_ranks > 1:
             from swarm_tpu.parallel.sharded import pad_streams_for_seq

@@ -35,6 +35,32 @@ from swarm_tpu.ops import hashing
 from swarm_tpu.ops.encoding import STREAMS
 
 
+#: max live compiled executables per matcher (DeviceDB/ShardedMatcher).
+#: Each distinct batch shape compiles a kernel that CAPTURES the corpus
+#: tables as constants (tens of MB each); unbounded shape churn grows
+#: RSS without limit, while too small a cap thrashes multi-second
+#: recompiles against millisecond batches. Coarse width buckets
+#: (engine width_multiple=512) and 256-row buckets keep the live
+#: working set well under this. Override: SWARM_MAX_COMPILED.
+import os as _os
+
+MAX_COMPILED = int(_os.environ.get("SWARM_MAX_COMPILED", "8"))
+
+
+def lru_fetch(cache: dict, key):
+    """Get + refresh (move-to-back) — dict order is the LRU order."""
+    val = cache.pop(key, None)
+    if val is not None:
+        cache[key] = val
+    return val
+
+
+def lru_store(cache: dict, key, val, cap: int = 0) -> None:
+    while cap and len(cache) >= cap:
+        cache.pop(next(iter(cache)))
+    cache[key] = val
+
+
 class DeviceDB:
     """CompiledDB uploaded to device + the jitted match function.
 
@@ -42,6 +68,8 @@ class DeviceDB:
     function; re-tracing happens per distinct batch shape (width
     buckets keep that to a handful of shapes).
     """
+
+    MAX_COMPILED = MAX_COMPILED  # class alias (ShardedMatcher shares it)
 
     def __init__(self, db: fpc.CompiledDB, candidate_k: int = 128):
         self.db = db
@@ -60,7 +88,7 @@ class DeviceDB:
             tuple(sorted((k, v.shape) for k, v in streams.items())),
             full,
         )
-        fn = self._fn_cache.get(shape_key)
+        fn = lru_fetch(self._fn_cache, shape_key)
         if fn is None:
             impl = functools.partial(
                 _match_impl, self.db, self.candidate_k, full=full
@@ -78,7 +106,7 @@ class DeviceDB:
                 fn = jax.jit(packed_impl)
             else:
                 fn = jax.jit(impl)
-            self._fn_cache[shape_key] = fn
+            lru_store(self._fn_cache, shape_key, fn, self.MAX_COMPILED)
         return fn(
             {k: jnp.asarray(v) for k, v in streams.items()},
             {k: jnp.asarray(v) for k, v in lengths.items()},

@@ -294,13 +294,17 @@ class ShardedMatcher:
             "lengths": tuple(sorted(lengths)),
         }
         cache_key = (shape_key["streams"], full)
-        fn = self._fn_cache.get(cache_key)
+        from swarm_tpu.ops.match import MAX_COMPILED, lru_fetch, lru_store
+
+        fn = lru_fetch(self._fn_cache, cache_key)
         if fn is None:
             fn = self._build(
                 {"streams": {k: None for k in streams}, "lengths": {k: None for k in lengths}},
                 full=full,
             )
-            self._fn_cache[cache_key] = fn
+            # bound live executables like DeviceDB (shape churn would
+            # grow RSS without limit — constants are captured per jit)
+            lru_store(self._fn_cache, cache_key, fn, MAX_COMPILED)
         return fn(
             self._tables_j,
             {k: jnp.asarray(v) for k, v in streams.items()},
