@@ -329,10 +329,13 @@ def test_content_dedup_extraction_fanout():
     assert got[5].template_ids == []
 
 
-def test_cross_batch_verdict_memo_identical_and_skips_device():
+@pytest.mark.parametrize("mesh", ["auto", None], ids=["sharded", "single-device"])
+def test_cross_batch_verdict_memo_identical_and_skips_device(mesh):
     """Content the engine fully resolved in an earlier batch is served
     from the verdict memo — no encode, no device pass — with results
-    (bits, extractions, host-gated fixups) identical to a cold engine."""
+    (bits, extractions, host-gated fixups) identical to a cold engine.
+    Runs on both backends: the memo-only path must behave identically
+    over the 8-device mesh and the single-device kernel."""
     templates, errors = load_corpus(DATA)
     assert not errors
     rng = random.Random(21)
@@ -368,7 +371,7 @@ def test_cross_batch_verdict_memo_identical_and_skips_device():
         _dc.replace(shared, host="ok.safe.example"),
     ]
 
-    eng = MatchEngine(templates, mesh=None, batch_rows=64)
+    eng = MatchEngine(templates, mesh=mesh, batch_rows=64)
     first = eng.match(rows)
     dev_batches_after_first = eng.stats.device_seconds
     memo0 = eng.stats.memo_slots
@@ -382,7 +385,7 @@ def test_cross_batch_verdict_memo_identical_and_skips_device():
     # no NEW content in batch 2 → the device did no additional work
     assert eng.stats.device_seconds == dev_batches_after_first
 
-    cold = MatchEngine(templates, mesh=None, batch_rows=64)
+    cold = MatchEngine(templates, mesh=mesh, batch_rows=64)
     fresh = cold.match(rows2)
     for b in range(len(rows2)):
         assert sorted(second[b].template_ids) == sorted(fresh[b].template_ids), b
