@@ -1,0 +1,118 @@
+"""Engine edge paths: host-always tail through dedup, empty inputs,
+truncation-vs-memo interaction, and listener reply robustness."""
+
+import textwrap
+
+import yaml
+
+from swarm_tpu.fingerprints.model import Response
+from swarm_tpu.fingerprints.nuclei import parse_template
+from swarm_tpu.ops import cpu_ref
+from swarm_tpu.ops.engine import MatchEngine
+
+
+def T(doc: str, path="t/x.yaml"):
+    return parse_template(yaml.safe_load(textwrap.dedent(doc)), source_path=path)
+
+
+HOST_PART_TEMPLATE = """\
+id: host-part-match
+info: {name: h, severity: info}
+requests:
+  - method: GET
+    path: ["{{BaseURL}}/"]
+    matchers:
+      - type: word
+        part: host
+        words: ["internal.corp"]
+"""
+
+BODY_TEMPLATE = """\
+id: body-match
+info: {name: b, severity: info}
+requests:
+  - method: GET
+    path: ["{{BaseURL}}/"]
+    matchers:
+      - type: word
+        words: ["hello-world"]
+"""
+
+
+def test_host_part_matcher_resolves_per_row_through_dedup():
+    """A part-'host' word matcher reads beyond response content;
+    content-identical rows on different hosts must diverge on it for
+    every member of a deduped group (the row-dependent fixup path)."""
+    templates = [T(HOST_PART_TEMPLATE), T(BODY_TEMPLATE)]
+    eng = MatchEngine(templates, mesh=None)
+    # the template is detected as row-dependent (not silently merged)
+    ids = [t.id for t in eng.db.templates]
+    assert "host-part-match" in ids
+    assert ids.index("host-part-match") in eng._rowdep_t
+    body = b"hello-world page"
+    rows = [
+        Response(host="a.internal.corp", port=80, status=200, body=body),
+        Response(host="b.public.example", port=80, status=200, body=body),
+        Response(host="c.internal.corp", port=80, status=200, body=body),
+    ]
+    got = eng.match(rows)
+    for g in got:
+        assert "body-match" in g.template_ids
+    assert "host-part-match" in got[0].template_ids
+    assert "host-part-match" not in got[1].template_ids
+    assert "host-part-match" in got[2].template_ids
+    # and again through the verdict memo (content now known)
+    got2 = eng.match(rows)
+    for a, b in zip(got, got2):
+        assert sorted(a.template_ids) == sorted(b.template_ids)
+
+
+def test_truncated_content_not_memoized():
+    """Truncated rows are host-redone and must NOT enter the verdict
+    memo — a later batch with the same content re-resolves fully."""
+    t = T(BODY_TEMPLATE)
+    eng = MatchEngine([t], mesh=None, max_body=512, max_header=256)
+    big = Response(
+        host="big", port=80, status=200,
+        body=b"x" * 2000 + b"hello-world",  # beyond max_body -> truncated
+    )
+    small = Response(host="s", port=80, status=200, body=b"hello-world")
+    for _ in range(2):
+        got = eng.match([big, small])
+        assert "body-match" in got[0].template_ids  # redo path found it
+        assert "body-match" in got[1].template_ids
+    # the truncated content never entered the memo; the small one did
+    keys = list(eng._verdict_memo)
+    assert any(small.body in k for k in keys)
+    assert not any(big.body in k for k in keys)
+
+
+def test_empty_and_dead_batches():
+    t = T(BODY_TEMPLATE)
+    eng = MatchEngine([t], mesh=None)
+    assert eng.match([]) == []
+    dead = [Response(host=f"d{i}", alive=False) for i in range(5)]
+    got = eng.match(dead)
+    assert all(g.template_ids == [] for g in got)
+    # mixed dead/alive via the packed path
+    mixed = dead + [Response(host="a", port=80, status=200, body=b"hello-world")]
+    got = eng.match(mixed)
+    assert got[-1].template_ids == ["body-match"]
+    assert all(g.template_ids == [] for g in got[:-1])
+
+
+def test_dns_reply_builder_handles_garbage():
+    from swarm_tpu.worker.oob import _build_a_reply, _parse_qname
+
+    assert _parse_qname(b"") is None
+    assert _parse_qname(b"\x00" * 11) is None
+    # a query whose qname claims more bytes than exist
+    bogus = b"\x12\x34" + b"\x01\x00" + b"\x00\x01\x00\x00\x00\x00\x00\x00" + b"\x3fshort"
+    assert _parse_qname(bogus) is None
+    # a degenerate query must not raise; a well-formed one must reply
+    _build_a_reply(b"\x12", b"x", "127.0.0.1")
+    good = (
+        b"\x12\x34\x01\x00\x00\x01\x00\x00\x00\x00\x00\x00"
+        + b"\x01x\x00\x00\x01\x00\x01"
+    )
+    assert _build_a_reply(good, b"x", "127.0.0.1") is not None
