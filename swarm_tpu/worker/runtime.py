@@ -89,6 +89,7 @@ class JobProcessor:
         self.work_dir = Path(work_dir or tempfile.mkdtemp(prefix="swarm_worker_"))
         self.work_dir.mkdir(parents=True, exist_ok=True)
         self._engines: dict[str, object] = {}  # templates_dir -> MatchEngine
+        self._scan_perf_extra: dict = {}  # per-job scan counters (perf fields)
         self.jobs_done = 0
 
     # ------------------------------------------------------------------
@@ -150,6 +151,7 @@ class JobProcessor:
         )
         timer = PhaseTimer()
         self._engine_stats_mark = None
+        self._scan_perf_extra = {}
 
         update(JobStatus.STARTING)
         update(JobStatus.DOWNLOADING)
@@ -208,6 +210,7 @@ class JobProcessor:
             perf["input_bytes"] = len(data)
             perf["output_bytes"] = len(output)
             perf.update(self._engine_perf_delta())
+            perf.update(self._scan_perf_extra)
             update(JobStatus.COMPLETE, perf=perf)
         else:
             update(JobStatus.UPLOAD_FAILED_UNKNOWN)
@@ -285,6 +288,19 @@ class JobProcessor:
             f"active scan: {stats['rows_probed']} requests over "
             f"{stats.get('live_targets', 0)} live targets, {len(lines)} hits"
         )
+        # operator-visible scan counters in the job's perf fields
+        # (/get-statuses -> swarm jobs): targets, probe volume, and OOB
+        # activity so blind-class findings are explainable
+        self._scan_perf_extra = {
+            k: stats[k]
+            for k in (
+                "targets", "live_targets", "rows_probed",
+                "oob_probes", "oob_interactions", "session_hits",
+                "workflow_hits",
+            )
+            if k in stats
+        }
+        self._scan_perf_extra["hits"] = len(lines)
         # Scope honesty, once per scan (chunk 0 only — these are
         # per-scan facts; repeating them in every chunk would flood a
         # sharded scan's merged /raw with duplicates):

@@ -7,7 +7,6 @@ import yaml
 
 from swarm_tpu.fingerprints.model import Response
 from swarm_tpu.fingerprints.nuclei import parse_template
-from swarm_tpu.ops import cpu_ref
 from swarm_tpu.ops.engine import MatchEngine
 
 
