@@ -566,12 +566,14 @@ def main() -> int:
             values[rec["metric"]] = rec["value"]
             # the oracle rate is an input to the speedup ratio, not a
             # headline — don't re-emit it standalone
-            if rec["metric"] != "cpu_oracle_rows_per_sec":
-                print(line, flush=True)
             if rec["metric"] == "cpu_oracle_rows_per_sec":
                 exact = values.get("exact_fingerprints_per_sec_per_chip")
                 oracle = rec["value"]
                 if exact and oracle:
+                    # carry the child's CPU-fallback note (set in the
+                    # phase process, not here) onto the synthesized line
+                    global _EMIT_NOTE
+                    _EMIT_NOTE = rec.get("note", "")
                     emit(
                         "device_vs_cpu_oracle_speedup",
                         exact / oracle,
@@ -582,6 +584,8 @@ def main() -> int:
                     # exact phase failed → no honest numerator; a 0.0x
                     # line would read as a measured regression
                     log("!!! speedup metric skipped (missing exact rate)")
+            else:
+                print(line, flush=True)
     return 1 if failed else 0
 
 

@@ -61,6 +61,37 @@ def lru_store(cache: dict, key, val, cap: int = 0) -> None:
     cache[key] = val
 
 
+def fused_plane_widths(db: "fpc.CompiledDB") -> list:
+    """Byte widths of the six ``full``-mode output planes in fused
+    order: t_value, t_unc, op_value, op_unc, m_unc (packed bits), then
+    the 1-byte overflow column."""
+    # widths mirror eval_verdicts' plane allocations exactly: the
+    # template planes are padded to max(NT, 1) there (an all-host-tail
+    # corpus still emits one packed byte), the op/matcher planes are not
+    nbt = (max(db.num_templates, 1) + 7) >> 3
+    nbo = (db.op_src.shape[0] + 7) >> 3
+    nbm = (db.m_src.shape[0] + 7) >> 3
+    return [nbt, nbt, nbo, nbo, nbm, 1]
+
+
+def split_fused(db: "fpc.CompiledDB", buf: np.ndarray):
+    """Slice one fused host buffer back into the engine's six outputs.
+
+    The ``full`` planes ship as ONE device array (see DeviceDB.match):
+    a single device-to-host read instead of six. Transfer count — not
+    bytes — is what the tunneled-accelerator transport charges for
+    (BASELINE.md, relay sync mode: ~seconds per read), and even on
+    healthy transports one transfer saves five dispatch round-trips.
+    """
+    outs = []
+    off = 0
+    for w in fused_plane_widths(db):
+        outs.append(buf[:, off : off + w])
+        off += w
+    pt, pu, opv, opu, mu, ovf = outs
+    return pt, pu, opv, opu, mu, ovf[:, 0] != 0
+
+
 class DeviceDB:
     """CompiledDB uploaded to device + the jitted match function.
 
@@ -82,7 +113,9 @@ class DeviceDB:
         Returns (t_value [B, NT] bool, t_uncertain [B, NT] bool,
         overflow [B] bool); with ``full`` the op/matcher planes are
         included: (t_value, t_unc, op_value, op_unc, m_unc, overflow)
-        — the engine's sparse-confirmation inputs, packed.
+        — the engine's sparse-confirmation inputs, packed, and already
+        materialized as HOST numpy views of one fused device read
+        (split_fused).
         """
         shape_key = (
             tuple(sorted((k, v.shape) for k, v in streams.items())),
@@ -95,23 +128,27 @@ class DeviceDB:
             )
             if full:
                 # bit-plane outputs ship packed (MSB-first, np.packbits
-                # convention): ~9× less host transfer per batch
+                # convention): ~9× less host transfer per batch — and
+                # FUSED into one array so the host makes exactly one
+                # device read (split_fused slices it back)
                 def packed_impl(streams, lengths, status, _impl=impl):
                     *planes, overflow = _impl(streams, lengths, status)
-                    return (
-                        *[jnp.packbits(p, axis=1) for p in planes],
-                        overflow,
-                    )
+                    parts = [jnp.packbits(p, axis=1) for p in planes]
+                    parts.append(overflow[:, None].astype(jnp.uint8))
+                    return jnp.concatenate(parts, axis=1)
 
                 fn = jax.jit(packed_impl)
             else:
                 fn = jax.jit(impl)
             lru_store(self._fn_cache, shape_key, fn, self.MAX_COMPILED)
-        return fn(
+        out = fn(
             {k: jnp.asarray(v) for k, v in streams.items()},
             {k: jnp.asarray(v) for k, v in lengths.items()},
             jnp.asarray(status),
         )
+        if full:
+            return split_fused(self.db, np.asarray(out))
+        return out
 
 
 def _lower_stream(arr):

@@ -245,8 +245,12 @@ class ShardedMatcher:
             )
             if full:
                 # pack bit planes per data-rank (axis 1 is unsharded, so
-                # packed bytes concatenate cleanly over 'data')
-                out = tuple(jnp.packbits(p, axis=1) for p in out)
+                # packed bytes concatenate cleanly over 'data') and fuse
+                # them with the overflow column into ONE output array —
+                # the host then makes a single device read (split_fused)
+                parts = [jnp.packbits(p, axis=1) for p in out]
+                parts.append(overflow[:, None].astype(jnp.uint8))
+                return jnp.concatenate(parts, axis=1)
             return (*out, overflow)
 
         shard_map = jax.shard_map
@@ -255,7 +259,7 @@ class ShardedMatcher:
         table_specs = [
             {name: P("model") for name in t} for t in self._tables_np
         ]
-        n_out = 6 if full else 3
+        out_specs = P("data") if full else (P("data"),) * 3
         fn = shard_map(
             step,
             mesh=mesh,
@@ -265,7 +269,7 @@ class ShardedMatcher:
                 {k: P("data") for k in shape_key["lengths"]},
                 P("data"),
             ),
-            out_specs=tuple(P("data") for _ in range(n_out)),
+            out_specs=out_specs,
             check_vma=False,
         )
         return jax.jit(fn)
@@ -305,9 +309,14 @@ class ShardedMatcher:
             # bound live executables like DeviceDB (shape churn would
             # grow RSS without limit — constants are captured per jit)
             lru_store(self._fn_cache, cache_key, fn, MAX_COMPILED)
-        return fn(
+        out = fn(
             self._tables_j,
             {k: jnp.asarray(v) for k, v in streams.items()},
             {k: jnp.asarray(v) for k, v in lengths.items()},
             jnp.asarray(status),
         )
+        if full:
+            from swarm_tpu.ops.match import split_fused
+
+            return split_fused(self.db, np.asarray(out))
+        return out

@@ -86,6 +86,17 @@ def test_truncated_content_not_memoized():
     assert not any(big.body in k for k in keys)
 
 
+def test_empty_device_corpus_fused_planes():
+    """num_templates == 0 still round-trips the fused device output:
+    eval_verdicts pads the template planes to one packed byte each, and
+    split_fused's widths must mirror that padding exactly — a mismatch
+    silently shears every later plane (op bits read as template bits)."""
+    eng = MatchEngine([], mesh=None)
+    assert eng.db.num_templates == 0
+    got = eng.match([Response(host="a", port=80, status=200, body=b"x")])
+    assert got[0].template_ids == []
+
+
 def test_empty_and_dead_batches():
     t = T(BODY_TEMPLATE)
     eng = MatchEngine([t], mesh=None)
