@@ -538,6 +538,7 @@ def main() -> int:
     if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
         return run_phase(sys.argv[2])
     values: dict = {}
+    notes: dict = {}
     failed = []
     for phase in PHASES:
         try:
@@ -564,16 +565,26 @@ def main() -> int:
             except json.JSONDecodeError:
                 continue
             values[rec["metric"]] = rec["value"]
+            notes[rec["metric"]] = rec.get("note", "")
             # the oracle rate is an input to the speedup ratio, not a
             # headline — don't re-emit it standalone
             if rec["metric"] == "cpu_oracle_rows_per_sec":
                 exact = values.get("exact_fingerprints_per_sec_per_chip")
                 oracle = rec["value"]
                 if exact and oracle:
-                    # carry the child's CPU-fallback note (set in the
-                    # phase process, not here) onto the synthesized line
+                    # carry a child's CPU-fallback note (set in the
+                    # phase processes, not here) onto the synthesized
+                    # line — the EXACT child's note matters most (its
+                    # rate is the numerator being vouched for), but a
+                    # fallback on either side disqualifies the ratio
+                    # as a chip measurement
                     global _EMIT_NOTE
-                    _EMIT_NOTE = rec.get("note", "")
+                    _EMIT_NOTE = (
+                        notes.get(
+                            "exact_fingerprints_per_sec_per_chip", ""
+                        )
+                        or rec.get("note", "")
+                    )
                     emit(
                         "device_vs_cpu_oracle_speedup",
                         exact / oracle,

@@ -13,6 +13,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 namespace {
 
@@ -89,6 +90,7 @@ struct Attrs {
   PyObject* status;
   PyObject* oob_protocols;
   PyObject* oob_requests;
+  PyObject* oob_ips;
 };
 
 inline const Attrs& attrs() {
@@ -99,6 +101,7 @@ inline const Attrs& attrs() {
       PyUnicode_InternFromString("status"),
       PyUnicode_InternFromString("oob_protocols"),
       PyUnicode_InternFromString("oob_requests"),
+      PyUnicode_InternFromString("oob_ips"),
   };
   return a;
 }
@@ -243,6 +246,257 @@ extern "C" int sw_rows_pack(int64_t n, const void** bptr,
   }
   Py_END_ALLOW_THREADS;
   return 0;
+}
+
+namespace {
+
+// One row's dedup view: content pointers plus the OOB objects.
+// Pointers are borrowed — the rows list keeps everything alive for the
+// duration of the call (same contract as sw_rows_meta).
+struct RowView {
+  const char* ban;
+  Py_ssize_t ban_len;  // -1 when banner is None
+  const char* body;
+  Py_ssize_t body_len;
+  const char* hdr;
+  Py_ssize_t hdr_len;
+  long status;
+  const char* orq;  // oob_requests bytes
+  Py_ssize_t orq_len;
+  PyObject* op;   // oob_protocols tuple
+  PyObject* oip;  // oob_ips tuple
+  uint64_t hash;
+};
+
+inline uint64_t mix64(uint64_t h, uint64_t x) {
+  x *= 0x9E3779B185EBCA87ULL;
+  x ^= x >> 29;
+  h ^= x;
+  h *= 0xC2B2AE3D27D4EB4FULL;
+  return h ^ (h >> 32);
+}
+
+// Cheap content signature: lengths + status + boundary bytes. Identical
+// contents always hash equal; distinct contents that collide are
+// resolved by the full memcmp in rows_equal (exactness never depends on
+// hash quality, only speed does — fleet pages differing mid-body pay
+// one memcmp against their bucket's representative).
+inline uint64_t row_hash(const RowView& r) {
+  uint64_t h = 0x243F6A8885A308D3ULL;
+  h = mix64(h, uint64_t(r.ban_len + 1));
+  h = mix64(h, uint64_t(r.body_len));
+  h = mix64(h, uint64_t(r.hdr_len));
+  h = mix64(h, uint64_t(r.status));
+  h = mix64(h, uint64_t(r.orq_len));
+  uint64_t w;
+  const char* b = r.ban_len >= 0 ? r.ban : r.body;
+  Py_ssize_t blen = r.ban_len >= 0 ? r.ban_len : r.body_len;
+  for (int k = 0; k < 2; ++k) {
+    const char* d = k ? r.hdr : b;
+    Py_ssize_t len = k ? r.hdr_len : blen;
+    if (len >= 8) {
+      std::memcpy(&w, d, 8);
+      h = mix64(h, w);
+      std::memcpy(&w, d + len / 2 - 4, 8);
+      h = mix64(h, w);
+      std::memcpy(&w, d + len - 8, 8);
+      h = mix64(h, w);
+      if (len >= 40) {  // two more probes through the middle
+        std::memcpy(&w, d + len / 4, 8);
+        h = mix64(h, w);
+        std::memcpy(&w, d + (3 * len) / 4 - 8, 8);
+        h = mix64(h, w);
+      }
+    } else if (len > 0) {
+      w = 0;
+      std::memcpy(&w, d, size_t(len));
+      h = mix64(h, w);
+    }
+  }
+  return h ? h : 1;
+}
+
+inline bool bytes_eq(const char* a, Py_ssize_t alen, const char* b,
+                     Py_ssize_t blen) {
+  return alen == blen && (alen == 0 || std::memcmp(a, b, size_t(alen)) == 0);
+}
+
+// Exact equality of the Python dedup key
+// (banner, body, header, status, oob_protocols, oob_requests, oob_ips).
+// Returns 1/0, -1 on a comparison error (OOB tuples compare through
+// Python — str/tuple __eq__ only).
+inline int rows_equal(const RowView& a, const RowView& b) {
+  if (a.status != b.status) return 0;
+  if ((a.ban_len >= 0) != (b.ban_len >= 0)) return 0;
+  if (a.ban_len >= 0 && !bytes_eq(a.ban, a.ban_len, b.ban, b.ban_len))
+    return 0;
+  if (!bytes_eq(a.body, a.body_len, b.body, b.body_len)) return 0;
+  if (!bytes_eq(a.hdr, a.hdr_len, b.hdr, b.hdr_len)) return 0;
+  if (!bytes_eq(a.orq, a.orq_len, b.orq, b.orq_len)) return 0;
+  for (int k = 0; k < 2; ++k) {
+    PyObject* x = k ? a.oip : a.op;
+    PyObject* y = k ? b.oip : b.op;
+    if (x == y) continue;  // same object (the interned empty tuple)
+    if (PyTuple_Check(x) && PyTuple_Check(y) && PyTuple_GET_SIZE(x) == 0 &&
+        PyTuple_GET_SIZE(y) == 0)
+      continue;
+    int eq = PyObject_RichCompareBool(x, y, Py_EQ);
+    if (eq < 0) return -1;
+    if (!eq) return 0;
+  }
+  return 1;
+}
+
+// Attribute fetch through the instance __dict__ when one exists
+// (dataclass rows): PyDict_GetItemWithError returns a BORROWED ref at
+// about half the cost of PyObject_GetAttr. Falls back to GetAttr (and
+// its new-ref protocol) for slotted/property objects. *decref tells
+// the caller whether it owns the result.
+inline PyObject* fast_attr(PyObject* row, PyObject* dict, PyObject* name,
+                           int* decref) {
+  if (dict != nullptr) {
+    PyObject* v = PyDict_GetItemWithError(dict, name);
+    if (v != nullptr) {
+      *decref = 0;
+      return v;
+    }
+    if (PyErr_Occurred()) return nullptr;
+  }
+  *decref = 1;
+  return PyObject_GetAttr(row, name);
+}
+
+// Load one row's dedup view (borrowed pointers; the row keeps its
+// attribute objects alive for the call's duration). Returns 0, -1 on
+// error.
+inline int row_view(PyObject* row, RowView* v) {
+  const Attrs& a = attrs();
+  // instance __dict__ (dataclass rows): borrowed-ref lookups at about
+  // half the PyObject_GetAttr cost; nullptr falls back per-attribute
+  PyObject** dp = _PyObject_GetDictPtr(row);
+  PyObject* dict = dp != nullptr ? *dp : nullptr;
+  int dec;
+  PyObject* obj = fast_attr(row, dict, a.banner, &dec);
+  if (obj == nullptr) return -1;
+  if (obj == Py_None) {
+    v->ban = nullptr;
+    v->ban_len = -1;
+  } else if (PyBytes_Check(obj)) {
+    v->ban = PyBytes_AS_STRING(obj);
+    v->ban_len = PyBytes_GET_SIZE(obj);
+  } else {
+    if (dec) Py_DECREF(obj);
+    return -1;
+  }
+  if (dec) Py_DECREF(obj);
+  obj = fast_attr(row, dict, a.body, &dec);
+  if (obj == nullptr || !PyBytes_Check(obj)) {
+    if (dec) Py_XDECREF(obj);
+    return -1;
+  }
+  v->body = PyBytes_AS_STRING(obj);
+  v->body_len = PyBytes_GET_SIZE(obj);
+  if (dec) Py_DECREF(obj);
+  obj = fast_attr(row, dict, a.header, &dec);
+  if (obj == nullptr || !PyBytes_Check(obj)) {
+    if (dec) Py_XDECREF(obj);
+    return -1;
+  }
+  v->hdr = PyBytes_AS_STRING(obj);
+  v->hdr_len = PyBytes_GET_SIZE(obj);
+  if (dec) Py_DECREF(obj);
+  obj = fast_attr(row, dict, a.status, &dec);
+  if (obj == nullptr) return -1;
+  v->status = PyLong_AsLong(obj);
+  if (dec) Py_DECREF(obj);
+  if (v->status == -1 && PyErr_Occurred()) return -1;
+  obj = fast_attr(row, dict, a.oob_requests, &dec);
+  if (obj == nullptr || !PyBytes_Check(obj)) {
+    if (dec) Py_XDECREF(obj);
+    return -1;
+  }
+  v->orq = PyBytes_AS_STRING(obj);
+  v->orq_len = PyBytes_GET_SIZE(obj);
+  if (dec) Py_DECREF(obj);
+  obj = fast_attr(row, dict, a.oob_protocols, &dec);
+  if (obj == nullptr) return -1;
+  v->op = obj;
+  if (dec) Py_DECREF(obj);
+  obj = fast_attr(row, dict, a.oob_ips, &dec);
+  if (obj == nullptr) return -1;
+  v->oip = obj;
+  if (dec) Py_DECREF(obj);
+  v->hash = row_hash(*v);
+  return 0;
+}
+
+}  // namespace
+
+// Alive-mask pass: out[i] = bool(rows[i].alive). Returns the alive
+// count (callers skip all index work when it equals n), -1 on error.
+extern "C" int64_t sw_rows_alive(PyObject* rows, uint8_t* out) {
+  if (!PyList_Check(rows)) return -1;
+  static PyObject* alive_name = PyUnicode_InternFromString("alive");
+  Py_ssize_t n = PyList_GET_SIZE(rows);
+  int64_t count = 0;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* row = PyList_GET_ITEM(rows, i);
+    PyObject** dp = _PyObject_GetDictPtr(row);
+    int dec;
+    PyObject* a =
+        fast_attr(row, dp != nullptr ? *dp : nullptr, alive_name, &dec);
+    if (a == nullptr) return -1;
+    int truthy = a == Py_True ? 1 : (a == Py_False ? 0 : PyObject_IsTrue(a));
+    if (dec) Py_DECREF(a);
+    if (truthy < 0) return -1;
+    out[i] = uint8_t(truthy);
+    count += truthy;
+  }
+  return count;
+}
+
+// Content dedup over a list of Response rows — the C twin of
+// engine._dedup_rows' Python loop with IDENTICAL key semantics
+// (exact compare; the hash only routes to a bucket). Fills back[n]
+// (row → unique slot) and uniq[<=n] (unique slot → first row index);
+// returns the unique count, or -1 on error.
+extern "C" int64_t sw_rows_dedup(PyObject* rows, int64_t* back,
+                                 int64_t* uniq) {
+  if (!PyList_Check(rows)) return -1;
+  Py_ssize_t n = PyList_GET_SIZE(rows);
+  if (n == 0) return 0;
+  std::vector<RowView> reps;  // representative views by unique slot
+  reps.reserve(64);
+  // open-addressing table of unique-slot ids, pow2 ≥ 2n
+  size_t cap = 16;
+  while (cap < size_t(n) * 2) cap <<= 1;
+  std::vector<int64_t> table(cap, -1);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    RowView v;
+    if (row_view(PyList_GET_ITEM(rows, i), &v) != 0) return -1;
+    size_t slot = size_t(v.hash) & (cap - 1);
+    for (;;) {
+      int64_t u = table[slot];
+      if (u < 0) {
+        table[slot] = int64_t(reps.size());
+        uniq[reps.size()] = int64_t(i);
+        back[i] = int64_t(reps.size());
+        reps.push_back(v);
+        break;
+      }
+      const RowView& rep = reps[size_t(u)];
+      if (rep.hash == v.hash) {
+        int eq = rows_equal(rep, v);
+        if (eq < 0) return -1;
+        if (eq) {
+          back[i] = u;
+          break;
+        }
+      }
+      slot = (slot + 1) & (cap - 1);
+    }
+  }
+  return int64_t(reps.size());
 }
 
 // Lengths-only pass (width selection happens between this and packing).

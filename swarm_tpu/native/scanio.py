@@ -125,6 +125,10 @@ def ensure_fastpack() -> ctypes.PyDLL:
         i32, u8p, i32, u8p, i32, u8p,
     ]
     lib.sw_rows_pack.restype = ctypes.c_int
+    lib.sw_rows_dedup.argtypes = [ctypes.py_object, i64p, i64p]
+    lib.sw_rows_dedup.restype = ctypes.c_int64
+    lib.sw_rows_alive.argtypes = [ctypes.py_object, u8p]
+    lib.sw_rows_alive.restype = ctypes.c_int64
     _fastpack = lib
     return lib
 
@@ -197,6 +201,30 @@ def rows_pack(
         np.int32(wb), body_out, np.int32(wh), header_out,
         np.int32(wa), all_out,
     )
+
+
+def rows_dedup(rows: list) -> "tuple[np.ndarray, np.ndarray]":
+    """Content-dedup a list of Response rows in one C pass — the native
+    twin of engine._dedup_rows with identical key semantics (exact
+    compare on banner/body/header/status/oob fields; the internal hash
+    only picks buckets). Returns ``(uniq, back)``: ``uniq[s]`` is the
+    first row index of unique slot s, ``back[i]`` the slot of row i."""
+    n = len(rows)
+    back = np.empty(n, dtype=np.int64)
+    uniq = np.empty(n, dtype=np.int64)
+    nu = ensure_fastpack().sw_rows_dedup(rows, back, uniq)
+    if nu < 0:
+        raise TypeError("rows must be Response objects with bytes parts")
+    return uniq[:nu], back
+
+
+def rows_alive(rows: list) -> "tuple[int, np.ndarray]":
+    """(alive_count, uint8 mask) in one C pass over Response rows."""
+    mask = np.empty(len(rows), dtype=np.uint8)
+    n = ensure_fastpack().sw_rows_alive(rows, mask)
+    if n < 0:
+        raise TypeError("rows must be Response objects")
+    return int(n), mask
 
 
 def concat3_list(

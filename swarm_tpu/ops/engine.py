@@ -117,23 +117,59 @@ def _is_row_dependent(t: Template) -> bool:
     return False
 
 
+def _content_key(r: Response) -> tuple:
+    """The cross-batch verdict-memo key: everything the device and the
+    content-side host walk read. host/port/duration are deliberately
+    NOT in it (see MatchEngine._rowdep_t)."""
+    return (
+        r.banner, r.body, r.header, r.status,
+        r.oob_protocols, r.oob_requests, r.oob_ips,
+    )
+
+
+def _alive_split(rows: Sequence[Response]):
+    """(n_alive, alive_idx) — ``alive_idx`` is None when every row is
+    alive (the common case pays one C pass and no index building)."""
+    from swarm_tpu.ops.encoding import _native_encoder_available
+
+    if _native_encoder_available() and isinstance(rows, list):
+        from swarm_tpu.native.scanio import rows_alive
+
+        n, mask = rows_alive(rows)
+        if n == len(rows):
+            return n, None
+        return n, np.flatnonzero(mask).tolist()
+    alive_idx = [i for i, r in enumerate(rows) if r.alive]
+    if len(alive_idx) == len(rows):
+        return len(rows), None
+    return len(alive_idx), alive_idx
+
+
 def _dedup_rows(rows: Sequence[Response]):
     """(uniq_indices, back, keys) — rows keyed by full response CONTENT.
 
     ``back[i]`` is the unique-slot index of row i; ``keys[s]`` is slot
-    s's content key (also the cross-batch verdict-memo key). Everything
-    the device and the content-side host walk read is in the key;
-    host/port/duration are deliberately NOT (see
-    MatchEngine._rowdep_t)."""
+    s's content key. The grouping runs as one C pass when the native
+    lib is present (exact compare — same key semantics either way;
+    steady-state fleet batches spend more time in this loop than in all
+    remaining host work, so the Python loop is the fallback, not the
+    path). Key tuples are built per unique slot only.
+    """
+    from swarm_tpu.ops.encoding import _native_encoder_available
+
+    if _native_encoder_available() and isinstance(rows, list):
+        from swarm_tpu.native.scanio import rows_dedup
+
+        uniq_arr, back = rows_dedup(rows)
+        uniq = uniq_arr.tolist()
+        keys = [_content_key(rows[i]) for i in uniq]
+        return uniq, back, keys
     key_of: dict = {}
-    uniq: list[int] = []
-    keys: list = []
+    uniq = []
+    keys = []
     back = np.empty(len(rows), dtype=np.int64)
     for i, r in enumerate(rows):
-        k = (
-            r.banner, r.body, r.header, r.status,
-            r.oob_protocols, r.oob_requests, r.oob_ips,
-        )
+        k = _content_key(r)
         j = key_of.get(k)
         if j is None:
             j = key_of[k] = len(uniq)
@@ -473,8 +509,8 @@ class MatchEngine:
         nbytes = (NT + 7) >> 3
         # dead rows (no response observed) match nothing by contract —
         # drop them before encoding so the device never pays for them
-        alive_idx = [i for i, r in enumerate(all_rows) if r.alive]
-        if len(alive_idx) < len(all_rows):
+        n_alive, alive_idx = _alive_split(all_rows)
+        if n_alive < len(all_rows):
             bits = np.zeros((len(all_rows), max(nbytes, 1)), dtype=np.uint8)
             extractions: dict = {}
             host_always: list = []
@@ -599,10 +635,25 @@ class MatchEngine:
             op_cache[key] = v
             return v
 
-        # group members per unique slot (for per-member fixups)
-        members: list[list[int]] = [[] for _ in uniq]
-        for i, ub in enumerate(back):
-            members[int(ub)].append(i)
+        # lazy member grouping per unique slot (for per-member fixups
+        # and extraction fan-out): one vectorized argsort instead of a
+        # per-row Python append loop, slices materialized only for the
+        # slots actually touched (extraction hits, row-dependent
+        # deferrals) — at fleet steady state that is a small fraction
+        member_order = np.argsort(back, kind="stable")
+        member_bounds = np.searchsorted(
+            back[member_order], np.arange(len(uniq) + 1)
+        )
+        _member_cache: dict = {}
+
+        def members_of(ub: int) -> list:
+            m = _member_cache.get(ub)
+            if m is None:
+                m = member_order[
+                    member_bounds[ub] : member_bounds[ub + 1]
+                ].tolist()
+                _member_cache[ub] = m
+            return m
         rowdep = self._rowdep_t
         # (unique slot, t_idx) pairs whose verdict must be decided per
         # MEMBER row (row-dependent template went device-undecided)
@@ -748,7 +799,7 @@ class MatchEngine:
         bits = np.ascontiguousarray(bits)
         extractions = {}
         for (ub, tid), vals in uext_all.items():
-            for i in members[ub]:
+            for i in members_of(ub):
                 extractions[(i, tid)] = vals
         conf_full: dict = {
             uniq[new_ids[b]]: n for b, n in confirms.items()
@@ -767,7 +818,7 @@ class MatchEngine:
             template = db.templates[t_idx]
             mask = 0x80 >> (t_idx & 7)
             byte_i = t_idx >> 3
-            for i in members[ub]:
+            for i in members_of(ub):
                 res = cpu_ref.match_template(template, rows[i])
                 conf_full[i] = conf_full.get(i, 0) + 1
                 self.stats.host_confirm_pairs += 1
@@ -787,7 +838,7 @@ class MatchEngine:
             byte_i, mask = t_idx >> 3, 0x80 >> (t_idx & 7)
             template = db.templates[t_idx]
             for ub in np.flatnonzero(ubits[:, byte_i] & mask):
-                for i in members[int(ub)]:
+                for i in members_of(int(ub)):
                     res = cpu_ref.match_template(template, rows[i])
                     if res.matched and res.extractions:
                         extractions[(i, template.id)] = res.extractions

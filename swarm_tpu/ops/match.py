@@ -74,6 +74,16 @@ def fused_plane_widths(db: "fpc.CompiledDB") -> list:
     return [nbt, nbt, nbo, nbo, nbm, 1]
 
 
+def fuse_planes(planes, overflow):
+    """Producer half of the fused full-mode output: pack the five bit
+    planes and append the overflow byte column — ONE device array, one
+    host read. Keep in lockstep with split_fused below (shared by both
+    backends so producer and consumer live in this module)."""
+    parts = [jnp.packbits(p, axis=1) for p in planes]
+    parts.append(overflow[:, None].astype(jnp.uint8))
+    return jnp.concatenate(parts, axis=1)
+
+
 def split_fused(db: "fpc.CompiledDB", buf: np.ndarray):
     """Slice one fused host buffer back into the engine's six outputs.
 
@@ -88,6 +98,13 @@ def split_fused(db: "fpc.CompiledDB", buf: np.ndarray):
     for w in fused_plane_widths(db):
         outs.append(buf[:, off : off + w])
         off += w
+    if off != buf.shape[1]:
+        # producer/consumer drift would otherwise shear every plane
+        # after the mismatch silently (op bits read as template bits)
+        raise ValueError(
+            f"fused buffer is {buf.shape[1]} bytes wide, plane widths "
+            f"sum to {off}"
+        )
     pt, pu, opv, opu, mu, ovf = outs
     return pt, pu, opv, opu, mu, ovf[:, 0] != 0
 
@@ -133,9 +150,7 @@ class DeviceDB:
                 # device read (split_fused slices it back)
                 def packed_impl(streams, lengths, status, _impl=impl):
                     *planes, overflow = _impl(streams, lengths, status)
-                    parts = [jnp.packbits(p, axis=1) for p in planes]
-                    parts.append(overflow[:, None].astype(jnp.uint8))
-                    return jnp.concatenate(parts, axis=1)
+                    return fuse_planes(planes, overflow)
 
                 fn = jax.jit(packed_impl)
             else:

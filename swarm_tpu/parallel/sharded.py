@@ -248,9 +248,9 @@ class ShardedMatcher:
                 # packed bytes concatenate cleanly over 'data') and fuse
                 # them with the overflow column into ONE output array —
                 # the host then makes a single device read (split_fused)
-                parts = [jnp.packbits(p, axis=1) for p in out]
-                parts.append(overflow[:, None].astype(jnp.uint8))
-                return jnp.concatenate(parts, axis=1)
+                from swarm_tpu.ops.match import fuse_planes
+
+                return fuse_planes(out, overflow)
             return (*out, overflow)
 
         shard_map = jax.shard_map
