@@ -12,7 +12,9 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -318,7 +320,10 @@ inline uint64_t row_hash(const RowView& r) {
 
 inline bool bytes_eq(const char* a, Py_ssize_t alen, const char* b,
                      Py_ssize_t blen) {
-  return alen == blen && (alen == 0 || std::memcmp(a, b, size_t(alen)) == 0);
+  // pointer equality = same bytes object (Python ==' identity
+  // shortcut); repeated batches over the same objects skip the memcmp
+  return alen == blen &&
+         (alen == 0 || a == b || std::memcmp(a, b, size_t(alen)) == 0);
 }
 
 // Exact equality of the Python dedup key
@@ -497,6 +502,278 @@ extern "C" int64_t sw_rows_dedup(PyObject* rows, int64_t* back,
     }
   }
   return int64_t(reps.size());
+}
+
+// ---------------------------------------------------------------------------
+// Resident verdict cache: the C twin of the engine's cross-batch
+// verdict memo. Keyed by exact response content (owned refs to the
+// row's bytes/tuple attributes; compare = memcmp + Python == for the
+// OOB tuples — identical semantics to engine._content_key). A lookup
+// pass serves known rows by memcpy-ing their packed verdict row
+// straight into the batch's output plane — no per-row Python work —
+// and in-batch-dedups the misses. True LRU, fixed capacity, entries
+// pre-reserved so no reallocation ever invalidates in-flight pointers
+// (the GIL serializes calls; pre-reservation guards the rare
+// GC-finalizer re-entry during list appends).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct MemoEntry {
+  RowView key{};           // views point into the owned objects below
+  PyObject* owned[6] = {}; // banner|NULL, body, header, orq, op, oip
+  PyObject* extras = nullptr;  // engine extras object or NULL
+  uint8_t* bits = nullptr;     // packed verdict row, memo->nb bytes
+  int64_t lru_prev = -1, lru_next = -1;
+  int64_t hnext = -1;  // bucket chain
+  bool live = false;
+};
+
+struct Memo {
+  std::vector<MemoEntry> entries;  // reserved to cap at creation
+  std::vector<int64_t> free_ids;
+  std::vector<int64_t> buckets;    // -1-terminated chains
+  size_t mask;
+  int64_t cap;
+  int32_t nb;
+  int64_t lru_head = -1, lru_tail = -1;  // head = most recent
+};
+
+inline void memo_lru_unlink(Memo* m, int64_t id) {
+  MemoEntry& e = m->entries[size_t(id)];
+  if (e.lru_prev >= 0)
+    m->entries[size_t(e.lru_prev)].lru_next = e.lru_next;
+  else
+    m->lru_head = e.lru_next;
+  if (e.lru_next >= 0)
+    m->entries[size_t(e.lru_next)].lru_prev = e.lru_prev;
+  else
+    m->lru_tail = e.lru_prev;
+}
+
+inline void memo_lru_push_front(Memo* m, int64_t id) {
+  MemoEntry& e = m->entries[size_t(id)];
+  e.lru_prev = -1;
+  e.lru_next = m->lru_head;
+  if (m->lru_head >= 0) m->entries[size_t(m->lru_head)].lru_prev = id;
+  m->lru_head = id;
+  if (m->lru_tail < 0) m->lru_tail = id;
+}
+
+inline void memo_drop_entry(Memo* m, int64_t id) {
+  MemoEntry& e = m->entries[size_t(id)];
+  // unlink from its bucket chain
+  size_t b = size_t(e.key.hash) & m->mask;
+  int64_t* slot = &m->buckets[b];
+  while (*slot != id) slot = &m->entries[size_t(*slot)].hnext;
+  *slot = e.hnext;
+  memo_lru_unlink(m, id);
+  for (auto*& o : e.owned) {
+    Py_XDECREF(o);
+    o = nullptr;
+  }
+  Py_XDECREF(e.extras);
+  e.extras = nullptr;
+  std::free(e.bits);
+  e.bits = nullptr;
+  e.live = false;
+  m->free_ids.push_back(id);
+}
+
+// find the live entry equal to view, or -1; no LRU side effects.
+inline int64_t memo_find(Memo* m, const RowView& v, int* err) {
+  *err = 0;
+  int64_t id = m->buckets[size_t(v.hash) & m->mask];
+  while (id >= 0) {
+    const MemoEntry& e = m->entries[size_t(id)];
+    if (e.key.hash == v.hash) {
+      int eq = rows_equal(e.key, v);
+      if (eq < 0) {
+        *err = 1;
+        return -1;
+      }
+      if (eq) return id;
+    }
+    id = e.hnext;
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" void* sw_memo_new(int64_t cap, int32_t nb) {
+  if (cap < 1 || nb < 1) return nullptr;
+  Memo* m = new Memo();
+  m->cap = cap;
+  m->nb = nb;
+  m->entries.resize(size_t(cap));  // never reallocates after this
+  m->free_ids.reserve(size_t(cap));
+  for (int64_t i = cap - 1; i >= 0; --i) m->free_ids.push_back(i);
+  size_t bsz = 16;
+  while (bsz < size_t(cap) * 2) bsz <<= 1;
+  m->buckets.assign(bsz, -1);
+  m->mask = bsz - 1;
+  return m;
+}
+
+extern "C" void sw_memo_clear(void* mp) {
+  Memo* m = static_cast<Memo*>(mp);
+  if (m == nullptr) return;
+  while (m->lru_head >= 0) memo_drop_entry(m, m->lru_head);
+}
+
+extern "C" void sw_memo_free(void* mp) {
+  Memo* m = static_cast<Memo*>(mp);
+  if (m == nullptr) return;
+  sw_memo_clear(mp);
+  delete m;
+}
+
+extern "C" int64_t sw_memo_len(void* mp) {
+  Memo* m = static_cast<Memo*>(mp);
+  return int64_t(m->cap - int64_t(m->free_ids.size()));
+}
+
+// Probe without side effects: 1 if the row's content is resident.
+extern "C" int sw_memo_contains(void* mp, PyObject* row) {
+  Memo* m = static_cast<Memo*>(mp);
+  RowView v;
+  if (row_view(row, &v) != 0) return -1;
+  int err = 0;
+  int64_t id = memo_find(m, v, &err);
+  if (err) return -1;
+  return id >= 0 ? 1 : 0;
+}
+
+// Insert (or overwrite) one fully-resolved row's verdict. bits_row is
+// memo->nb bytes; extras is the engine's per-content extras object
+// (Py_None stores as "no extras"). Evicts the LRU tail at capacity.
+extern "C" int sw_memo_insert(void* mp, PyObject* row,
+                              const uint8_t* bits_row, PyObject* extras) {
+  Memo* m = static_cast<Memo*>(mp);
+  RowView v;
+  if (row_view(row, &v) != 0) return -1;
+  int err = 0;
+  int64_t id = memo_find(m, v, &err);
+  if (err) return -1;
+  if (id >= 0) memo_drop_entry(m, id);  // overwrite = drop + fresh insert
+  if (m->free_ids.empty()) memo_drop_entry(m, m->lru_tail);
+  id = m->free_ids.back();
+  m->free_ids.pop_back();
+  MemoEntry& e = m->entries[size_t(id)];
+  // own the content objects the view points into (the row object may
+  // die; its attribute objects must not)
+  const Attrs& a = attrs();
+  PyObject* names[6] = {a.banner, a.body,          a.header,
+                        a.oob_requests, a.oob_protocols, a.oob_ips};
+  for (int k = 0; k < 6; ++k) {
+    PyObject* o = PyObject_GetAttr(row, names[k]);
+    if (o == nullptr) {
+      for (int j = 0; j < k; ++j) Py_XDECREF(e.owned[j]);
+      m->free_ids.push_back(id);
+      return -1;
+    }
+    e.owned[k] = o;
+  }
+  e.key = v;
+  e.extras = nullptr;
+  if (extras != nullptr && extras != Py_None) {
+    Py_INCREF(extras);
+    e.extras = extras;
+  }
+  e.bits = static_cast<uint8_t*>(std::malloc(size_t(m->nb)));
+  if (e.bits == nullptr) {
+    for (auto*& o : e.owned) {
+      Py_XDECREF(o);
+      o = nullptr;
+    }
+    Py_XDECREF(e.extras);
+    e.extras = nullptr;
+    m->free_ids.push_back(id);
+    return -1;
+  }
+  std::memcpy(e.bits, bits_row, size_t(m->nb));
+  size_t b = size_t(v.hash) & m->mask;
+  e.hnext = m->buckets[b];
+  m->buckets[b] = id;
+  e.live = true;
+  memo_lru_push_front(m, id);
+  return 0;
+}
+
+// The steady-state hot pass. For each row of the batch:
+//   known content  → its packed verdict row memcpy'd into
+//                    bits_out[i*nb], state[i] = -1, LRU refreshed;
+//                    rows with extras are appended to extras_out as
+//                    (row_index, extras_object) pairs
+//   novel content  → in-batch dedup: state[i] = miss slot id,
+//                    miss_uniq[slot] = first row index with it
+// Returns the miss-slot count, or -1 on error.
+extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
+                                  uint8_t* bits_out, int64_t* state,
+                                  int64_t* miss_uniq,
+                                  PyObject* extras_out) {
+  Memo* m = static_cast<Memo*>(mp);
+  if (!PyList_Check(rows) || !PyList_Check(extras_out)) return -1;
+  Py_ssize_t n = PyList_GET_SIZE(rows);
+  if (n == 0) return 0;
+  // batch-local miss table (open addressing over miss slots)
+  size_t cap = 16;
+  while (cap < size_t(n) * 2) cap <<= 1;
+  std::vector<int64_t> table(cap, -1);
+  std::vector<RowView> miss_views;
+  miss_views.reserve(64);
+  // known rows with extras: collected as plain ids first — the Python
+  // list building at the end is the only allocation point, and entry
+  // ids stay valid across it (entries never move; nothing here evicts)
+  std::vector<std::pair<int64_t, int64_t>> extra_rows;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    RowView v;
+    if (row_view(PyList_GET_ITEM(rows, i), &v) != 0) return -1;
+    int err = 0;
+    int64_t id = memo_find(m, v, &err);
+    if (err) return -1;
+    if (id >= 0) {
+      MemoEntry& e = m->entries[size_t(id)];
+      std::memcpy(bits_out + size_t(i) * m->nb, e.bits, size_t(m->nb));
+      state[i] = -1;
+      if (e.extras != nullptr) extra_rows.emplace_back(i, id);
+      memo_lru_unlink(m, id);
+      memo_lru_push_front(m, id);
+      continue;
+    }
+    // miss: dedup within the batch
+    size_t slot = size_t(v.hash) & (cap - 1);
+    for (;;) {
+      int64_t u = table[slot];
+      if (u < 0) {
+        table[slot] = int64_t(miss_views.size());
+        state[i] = int64_t(miss_views.size());
+        miss_uniq[miss_views.size()] = int64_t(i);
+        miss_views.push_back(v);
+        break;
+      }
+      const RowView& rep = miss_views[size_t(u)];
+      if (rep.hash == v.hash) {
+        int eq = rows_equal(rep, v);
+        if (eq < 0) return -1;
+        if (eq) {
+          state[i] = u;
+          break;
+        }
+      }
+      slot = (slot + 1) & (cap - 1);
+    }
+  }
+  for (const auto& [row_i, id] : extra_rows) {
+    PyObject* pair =
+        Py_BuildValue("(lO)", long(row_i), m->entries[size_t(id)].extras);
+    if (pair == nullptr) return -1;
+    int rc = PyList_Append(extras_out, pair);
+    Py_DECREF(pair);
+    if (rc != 0) return -1;
+  }
+  return int64_t(miss_views.size());
 }
 
 // Lengths-only pass (width selection happens between this and packing).

@@ -80,7 +80,9 @@ class EngineStats:
     host_confirm_pairs: int = 0
     host_always_pairs: int = 0
     overflow_rows: int = 0
-    memo_slots: int = 0  # memo-served slot count, summed per batch
+    # memo-served ROW count, summed per batch (rows whose verdict came
+    # from the cross-batch memo without device or walk work)
+    memo_slots: int = 0
 
 
 def _bit(packed: np.ndarray, b: int, i: int) -> bool:
@@ -255,6 +257,14 @@ class MatchEngine:
         # entirely. Entries are only stored for fully-resolved
         # (non-truncated, non-overflow) content. Bounded FIFO.
         self._verdict_memo: dict = {}
+        # C resident verdict cache (native/scanio.VerdictMemo) — the
+        # production form of _verdict_memo: its lookup pass serves
+        # known rows straight into the batch's bits plane with no
+        # per-row Python work. Lazily created on first encode so
+        # oracle-only engines stay native-free; the dict memo remains
+        # the no-toolchain fallback.
+        self._vmemo = None
+        self._native_memo_ok = None
         # ROW-dependent templates: verdicts/extractions that read
         # beyond the response content (host/port/duration dsl vars,
         # part "host") — e.g. the takeover family's
@@ -416,28 +426,26 @@ class MatchEngine:
         DEDUPLICATED two ways: within the batch (fleet scans see the
         same default pages on most hosts) and ACROSS batches via the
         bounded verdict memo — content the engine has fully resolved
-        before never rides the device again. Returns
-        ``(batch, matcher, uniq, back, n_source, new_ids, keys,
-        known)``:
-        ``uniq``/``back`` are the in-batch dedup (slot ← source rows),
-        ``keys[s]`` slot s's content key, ``new_ids`` the slots NOT
-        served by the verdict memo, and ``batch`` covers exactly those
-        (padded up to a 256-row bucket for a bounded set of jit
-        shapes) — or None when every slot is known. The trailing
-        ``known`` dict ({slot: memo entry}) snapshots the served
-        entries AT ENCODE TIME so FIFO eviction between a pipelined
-        encode and its match can't lose a verdict.
+        before never rides the device again.
 
-        The sharded backend additionally needs the row count divisible
-        by the 'data' axis and every stream width divisible by 'seq'
-        with each per-rank slice at least one halo wide
-        (parallel/sharded.py raises otherwise); padding is zeros, which
-        the length masks already ignore, and padded rows are sliced off
-        the verdicts.
+        Returns a TAGGED tuple. With the native lib present the first
+        element is ``"native"`` (see :meth:`_encode_native` — the C
+        resident cache serves known rows directly into a bits plane);
+        the fallback is ``("py", batch, matcher, uniq, back, n_source,
+        new_ids, keys, known)``: ``uniq``/``back`` are the in-batch
+        dedup (slot ← source rows), ``keys[s]`` slot s's content key,
+        ``new_ids`` the slots NOT served by the verdict memo, and
+        ``batch`` covers exactly those (padded up to a 256-row bucket
+        for a bounded set of jit shapes) — or None when every slot is
+        known. The trailing ``known`` dict ({slot: memo entry})
+        snapshots the served entries AT ENCODE TIME so eviction between
+        a pipelined encode and its match can't lose a verdict.
         """
         if not self._backend_ready:
             self._resolve_backend()
         rows = list(rows)
+        if self._use_native_memo():
+            return self._encode_native(rows, reuse_buffers)
         uniq, back, keys = _dedup_rows(rows)
         memo = self._verdict_memo
         # snapshot known entries NOW: FIFO eviction between a pipelined
@@ -456,8 +464,60 @@ class MatchEngine:
             else:
                 known[s] = entry
         if not new_ids:
-            return None, None, uniq, back, len(rows), new_ids, keys, known
+            return (
+                "py", None, None, uniq, back, len(rows), new_ids, keys, known
+            )
         nrows = [rows[uniq[s]] for s in new_ids]
+        batch, matcher = self._encode_unique(nrows, reuse_buffers)
+        return (
+            "py", batch, matcher, uniq, back, len(rows), new_ids, keys, known
+        )
+
+    def _use_native_memo(self) -> bool:
+        """Whether the C resident verdict cache drives the packed path
+        (native lib present; the Python dict memo is the fallback)."""
+        use = self._native_memo_ok
+        if use is None:
+            from swarm_tpu.ops.encoding import _native_encoder_available
+
+            use = self._native_memo_ok = _native_encoder_available()
+        return use
+
+    def _encode_native(self, rows: list, reuse_buffers: bool):
+        """C-memo encode: ONE native pass serves every known row's
+        packed verdict straight into the batch plane (and collects
+        their extras), in-batch-dedups the misses, and only the miss
+        uniques are encoded for the device. The returned ``bits`` plane
+        is a snapshot — memo eviction between a pipelined encode and
+        its match can't lose a served verdict."""
+        nbits = max((self.db.num_templates + 7) >> 3, 1)
+        if self._vmemo is None:
+            from swarm_tpu.native.scanio import VerdictMemo
+
+            self._vmemo = VerdictMemo(self._EXT_CACHE_MAX, nbits)
+        bits = np.empty((len(rows), nbits), dtype=np.uint8)
+        state, miss_uniq, extras_pairs = self._vmemo.lookup(rows, bits)
+        if not miss_uniq:
+            return (
+                "native", None, None, bits, state, miss_uniq, extras_pairs,
+                len(rows),
+            )
+        nrows = [rows[i] for i in miss_uniq]
+        batch, matcher = self._encode_unique(nrows, reuse_buffers)
+        return (
+            "native", batch, matcher, bits, state, miss_uniq, extras_pairs,
+            len(rows),
+        )
+
+    def _encode_unique(self, nrows: list, reuse_buffers: bool):
+        """Encode the distinct-content rows for the active backend.
+
+        The sharded backend additionally needs the row count divisible
+        by the 'data' axis and every stream width divisible by 'seq'
+        with each per-rank slice at least one halo wide
+        (parallel/sharded.py raises otherwise); padding is zeros, which
+        the length masks already ignore, and padded rows are sliced off
+        the verdicts."""
         n_pad = round_up(max(len(nrows), 1), 256)
         if self.sharded is None:
             batch = encode_batch(
@@ -474,7 +534,7 @@ class MatchEngine:
                 build_all=False,
                 width_multiple=512,
             )
-            return batch, self.device, uniq, back, len(rows), new_ids, keys, known
+            return batch, self.device
         data_ranks = self.sharded.ranks.get("data", 1)
         seq_ranks = self.sharded.ranks.get("seq", 1)
         batch = encode_batch(
@@ -489,102 +549,45 @@ class MatchEngine:
             from swarm_tpu.parallel.sharded import pad_streams_for_seq
 
             pad_streams_for_seq(batch.streams, seq_ranks, self.sharded.halo)
-        return batch, self.sharded, uniq, back, len(rows), new_ids, keys, known
+        return batch, self.sharded
+
 
     # ------------------------------------------------------------------
-    def match_packed(
-        self, all_rows: Sequence[Response], pre=None
-    ) -> PackedMatches:
-        """Exact verdict bitsets for up to ``batch_rows`` responses.
+    def _walk_plane(self, nrows, batch, matcher):
+        """Device dispatch + sparse host resolution over DISTINCT new
+        response contents (the unique content plane).
 
-        The production wire format: one device dispatch, vectorized
-        verdict assembly, host work proportional to the number of
-        *uncertain fired matchers* — not to rows × templates.
-
-        ``pre`` is an optional :meth:`encode_packed` result for the SAME
-        rows (pipelined feeding); ignored when the batch contains dead
-        rows (the live-subset recursion re-encodes).
-        """
+        Returns ``(pt_value, uextractions, deferred, redo_pos,
+        confirms)``: the final content-side verdict bits ``[B, nb]``
+        (row-dependent undecided bits cleared and listed in
+        ``deferred`` as ``(pos, t_idx)`` for per-member resolution),
+        content-side extractions ``{(pos, tid): vals}``, the positions
+        that needed a whole-row oracle redo (truncation/overflow —
+        never memoized), and per-position host-confirm counts."""
         NT = self.db.num_templates
-        nbytes = (NT + 7) >> 3
-        # dead rows (no response observed) match nothing by contract —
-        # drop them before encoding so the device never pays for them
-        n_alive, alive_idx = _alive_split(all_rows)
-        if n_alive < len(all_rows):
-            bits = np.zeros((len(all_rows), max(nbytes, 1)), dtype=np.uint8)
-            extractions: dict = {}
-            host_always: list = []
-            conf: dict = {}
-            if alive_idx:
-                live = self.match_packed([all_rows[i] for i in alive_idx])
-                back = {j: i for j, i in enumerate(alive_idx)}
-                for j, i in enumerate(alive_idx):
-                    bits[i] = live.bits[j]
-                extractions = {
-                    (back[rb], tid): ext
-                    for (rb, tid), ext in live.extractions.items()
-                }
-                host_always = [
-                    (back[rb], tid) for rb, tid in live.host_always_matches
-                ]
-                conf = {
-                    back[rb]: n for rb, n in live.confirms_per_row.items()
-                }
-            self.stats.rows += len(all_rows) - len(alive_idx)
-            return PackedMatches(
-                bits=bits,
-                template_ids=self.db.template_ids,
-                extractions=extractions,
-                host_always_matches=host_always,
-                confirms_per_row=conf,
-            )
-
-        rows = all_rows
-        enc = pre if pre is not None else self._encode_for_backend(rows)
-        batch, matcher, uniq, back, n_src, new_ids, keys, known = enc
-        if n_src != len(rows):
-            raise ValueError(
-                f"pre-encoded batch is for {n_src} rows, "
-                f"match_packed got {len(rows)}"
-            )
-        # the device and the content-side host walk run over DISTINCT
-        # NEW response contents only (in-batch dedup + cross-batch
-        # verdict memo); verdicts broadcast back per member at the end
-        nrows = [rows[uniq[s]] for s in new_ids]
+        db = self.db
         B = len(nrows)
-        if batch is not None:
-            t0 = time.perf_counter()
-            pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
-                matcher.match(
-                    batch.streams, batch.lengths, batch.status, full=True
-                )
+        t0 = time.perf_counter()
+        pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
+            matcher.match(
+                batch.streams, batch.lengths, batch.status, full=True
             )
-            # slice off bucket/mesh row padding before the host walk
-            pt_value = np.array(np.asarray(pt_value)[:B])  # writable copy
-            pt_unc = np.asarray(pt_unc)[:B]
-            pop_value = np.asarray(pop_value)[:B]
-            pop_unc = np.asarray(pop_unc)[:B]
-            pm_unc = np.asarray(pm_unc)[:B]
-            overflow = np.asarray(overflow)[:B]
-            self.stats.device_seconds += time.perf_counter() - t0
-            row_redo = overflow | batch.truncated[:B]
-        else:  # every slot served by the verdict memo
-            nbits = max(nbytes, 1)
-            pt_value = np.zeros((0, nbits), dtype=np.uint8)
-            pt_unc = pop_value = pop_unc = pm_unc = pt_value
-            row_redo = np.zeros((0,), dtype=bool)
-        self.stats.rows += len(rows)
-        self.stats.batches += 1
-        self.stats.memo_slots += len(uniq) - len(new_ids)
-
+        )
+        # slice off bucket/mesh row padding before the host walk
+        pt_value = np.array(np.asarray(pt_value)[:B])  # writable copy
+        pt_unc = np.asarray(pt_unc)[:B]
+        pop_value = np.asarray(pop_value)[:B]
+        pop_unc = np.asarray(pop_unc)[:B]
+        pm_unc = np.asarray(pm_unc)[:B]
+        overflow = np.asarray(overflow)[:B]
+        self.stats.device_seconds += time.perf_counter() - t0
         # rows needing whole-row reconfirmation (candidate overflow or
         # stream truncation made word bits unsound for the row)
+        row_redo = overflow | batch.truncated[:B]
         self.stats.overflow_rows += int(row_redo.sum())
 
         t1 = time.perf_counter()
         confirms: dict = {}
-        db = self.db
-
         op_cache: dict = {}  # (b, op_id) -> exact bool
         # content-keyed matcher memo — CROSS-batch (self._confirm_cache):
         # scan batches repeat headers and default pages heavily, and a
@@ -635,25 +638,6 @@ class MatchEngine:
             op_cache[key] = v
             return v
 
-        # lazy member grouping per unique slot (for per-member fixups
-        # and extraction fan-out): one vectorized argsort instead of a
-        # per-row Python append loop, slices materialized only for the
-        # slots actually touched (extraction hits, row-dependent
-        # deferrals) — at fleet steady state that is a small fraction
-        member_order = np.argsort(back, kind="stable")
-        member_bounds = np.searchsorted(
-            back[member_order], np.arange(len(uniq) + 1)
-        )
-        _member_cache: dict = {}
-
-        def members_of(ub: int) -> list:
-            m = _member_cache.get(ub)
-            if m is None:
-                m = member_order[
-                    member_bounds[ub] : member_bounds[ub + 1]
-                ].tolist()
-                _member_cache[ub] = m
-            return m
         rowdep = self._rowdep_t
         # (unique slot, t_idx) pairs whose verdict must be decided per
         # MEMBER row (row-dependent template went device-undecided)
@@ -748,6 +732,124 @@ class MatchEngine:
                 if parts:
                     uextractions[(int(b), db.template_ids[t_idx])] = parts
 
+        self.stats.host_confirm_seconds += time.perf_counter() - t1
+        return (
+            pt_value,
+            uextractions,
+            deferred,
+            set(redo_rows.tolist()),
+            confirms,
+        )
+
+    # ------------------------------------------------------------------
+    def match_packed(
+        self, all_rows: Sequence[Response], pre=None
+    ) -> PackedMatches:
+        """Exact verdict bitsets for up to ``batch_rows`` responses.
+
+        The production wire format: one device dispatch, vectorized
+        verdict assembly, host work proportional to the number of
+        *uncertain fired matchers* — not to rows × templates.
+
+        ``pre`` is an optional :meth:`encode_packed` result for the SAME
+        rows (pipelined feeding); ignored when the batch contains dead
+        rows (the live-subset recursion re-encodes).
+        """
+        NT = self.db.num_templates
+        nbytes = (NT + 7) >> 3
+        # dead rows (no response observed) match nothing by contract —
+        # drop them before encoding so the device never pays for them
+        n_alive, alive_idx = _alive_split(all_rows)
+        if n_alive < len(all_rows):
+            bits = np.zeros((len(all_rows), max(nbytes, 1)), dtype=np.uint8)
+            extractions: dict = {}
+            host_always: list = []
+            conf: dict = {}
+            if alive_idx:
+                live = self.match_packed([all_rows[i] for i in alive_idx])
+                back = {j: i for j, i in enumerate(alive_idx)}
+                for j, i in enumerate(alive_idx):
+                    bits[i] = live.bits[j]
+                extractions = {
+                    (back[rb], tid): ext
+                    for (rb, tid), ext in live.extractions.items()
+                }
+                host_always = [
+                    (back[rb], tid) for rb, tid in live.host_always_matches
+                ]
+                conf = {
+                    back[rb]: n for rb, n in live.confirms_per_row.items()
+                }
+            self.stats.rows += len(all_rows) - len(alive_idx)
+            return PackedMatches(
+                bits=bits,
+                template_ids=self.db.template_ids,
+                extractions=extractions,
+                host_always_matches=host_always,
+                confirms_per_row=conf,
+            )
+
+        rows = all_rows
+        enc = pre if pre is not None else self._encode_for_backend(rows)
+        if enc[0] == "native":
+            if enc[7] != len(rows):
+                raise ValueError(
+                    f"pre-encoded batch is for {enc[7]} rows, "
+                    f"match_packed got {len(rows)}"
+                )
+            return self._match_packed_native(rows, enc)
+        _tag, batch, matcher, uniq, back, n_src, new_ids, keys, known = enc
+        if n_src != len(rows):
+            raise ValueError(
+                f"pre-encoded batch is for {n_src} rows, "
+                f"match_packed got {len(rows)}"
+            )
+        # the device and the content-side host walk run over DISTINCT
+        # NEW response contents only (in-batch dedup + cross-batch
+        # verdict memo); verdicts broadcast back per member at the end
+        nrows = [rows[uniq[s]] for s in new_ids]
+        B = len(nrows)
+        if batch is not None:
+            pt_value, uextractions, deferred, redo_pos, confirms = (
+                self._walk_plane(nrows, batch, matcher)
+            )
+        else:  # every slot served by the verdict memo
+            pt_value = np.zeros((0, max(nbytes, 1)), dtype=np.uint8)
+            uextractions = {}
+            deferred = []
+            redo_pos = set()
+            confirms = {}
+        self.stats.rows += len(rows)
+        self.stats.batches += 1
+        # memo-served rows = everything not mapped to a walked slot
+        # (same row-count semantics as the native path)
+        if len(new_ids) < len(uniq):
+            self.stats.memo_slots += len(rows) - int(
+                np.isin(back, np.asarray(new_ids, dtype=np.int64)).sum()
+            )
+
+        t1 = time.perf_counter()
+        db = self.db
+        # lazy member grouping per unique slot (for per-member fixups
+        # and extraction fan-out): one vectorized argsort instead of a
+        # per-row Python append loop, slices materialized only for the
+        # slots actually touched (extraction hits, row-dependent
+        # deferrals) — at fleet steady state that is a small fraction
+        member_order = np.argsort(back, kind="stable")
+        member_bounds = np.searchsorted(
+            back[member_order], np.arange(len(uniq) + 1)
+        )
+        _member_cache: dict = {}
+
+        def members_of(ub: int) -> list:
+            m = _member_cache.get(ub)
+            if m is None:
+                m = member_order[
+                    member_bounds[ub] : member_bounds[ub + 1]
+                ].tolist()
+                _member_cache[ub] = m
+            return m
+        rowdep = self._rowdep_t
         # --- assemble the full unique plane: walked NEW slots + memo-
         # served known slots; store fully-resolved new content ---
         U = len(uniq)
@@ -761,7 +863,6 @@ class MatchEngine:
         def_by_pos: dict = {}
         for b, t_idx in deferred:
             def_by_pos.setdefault(int(b), []).append(t_idx)
-        redo_pos = set(redo_rows.tolist())
         for b in range(B):
             s = new_ids[b]
             ubits[s] = pt_value[b]
@@ -843,18 +944,7 @@ class MatchEngine:
                     if res.matched and res.extractions:
                         extractions[(i, template.id)] = res.extractions
 
-        # --- host-always tail: templates the compiler couldn't lower
-        # (exact per actual row — these may read host) ---
-        host_always_matches: list = []
-        if self.host_always_mode == "full" and db.host_always:
-            for i, row in enumerate(rows):
-                for template in db.host_always:
-                    res = cpu_ref.match_template(template, row)
-                    self.stats.host_always_pairs += 1
-                    if res.matched:
-                        host_always_matches.append((i, template.id))
-                        if res.extractions:
-                            extractions[(i, template.id)] = res.extractions
+        host_always_matches = self._host_always_tail(rows, extractions)
 
         self.stats.host_confirm_seconds += time.perf_counter() - t1
         return PackedMatches(
@@ -864,3 +954,159 @@ class MatchEngine:
             host_always_matches=host_always_matches,
             confirms_per_row=conf_full,
         )
+
+    # ------------------------------------------------------------------
+    def _match_packed_native(self, rows, enc) -> PackedMatches:
+        """Assembly for the C-memo encode path (:meth:`_encode_native`).
+
+        Known rows arrived with their packed verdicts already fanned
+        into ``bits`` by the native lookup; only miss uniques walk. The
+        result is bit-identical to the Python-memo path — pinned by
+        tests/test_match_parity.py's memo/dedup suites, which run on
+        whichever path the build provides, and the native-vs-fallback
+        equivalence test."""
+        _tag, batch, matcher, bits, state, miss_uniq, extras_pairs, _n = enc
+        db = self.db
+        self.stats.rows += len(rows)
+        self.stats.batches += 1
+        extractions: dict = {}
+        conf_full: dict = {}
+        deferred_rows: list = []  # (row_i, t_idx) — decide per row
+        if batch is not None:
+            nrows = [rows[i] for i in miss_uniq]
+            B = len(nrows)
+            pt_value, uext, deferred, redo_pos, confirms = (
+                self._walk_plane(nrows, batch, matcher)
+            )
+            t1 = time.perf_counter()
+            self.stats.memo_slots += int((state < 0).sum())
+            # broadcast walked bits to their member rows
+            miss_rows = np.flatnonzero(state >= 0)
+            bits[miss_rows] = pt_value[state[miss_rows]]
+            ext_by_pos: dict = {}
+            for (b, tid), vals in uext.items():
+                ext_by_pos.setdefault(int(b), []).append((tid, vals))
+            def_by_pos: dict = {}
+            for b, t_idx in deferred:
+                def_by_pos.setdefault(int(b), []).append(t_idx)
+            # memo inserts for fully-resolved content (deep-frozen
+            # extras — callers receive thawed list copies, so a
+            # caller's in-place edit can never rewrite the cache;
+            # truncated/overflow positions are never stored)
+            for pos in range(B):
+                if pos in redo_pos:
+                    continue
+                ment = tuple(
+                    (tid, tuple(vals))
+                    for tid, vals in ext_by_pos.get(pos, ())
+                )
+                mdef = tuple(def_by_pos.get(pos, ()))
+                self._vmemo.insert(
+                    nrows[pos],
+                    np.ascontiguousarray(pt_value[pos]),
+                    (ment, mdef) if (ment or mdef) else None,
+                )
+            # member fan-out over miss rows (lazy argsort grouping)
+            order = np.argsort(state, kind="stable")
+            sorted_state = state[order]
+
+            def members_of(pos: int) -> list:
+                lo = np.searchsorted(sorted_state, pos)
+                hi = np.searchsorted(sorted_state, pos + 1)
+                return order[lo:hi].tolist()
+
+            for (pos, tid), vals in uext.items():
+                for i in members_of(int(pos)):
+                    extractions[(i, tid)] = vals
+            for pos, tids in def_by_pos.items():
+                for i in members_of(pos):
+                    for t_idx in tids:
+                        deferred_rows.append((i, t_idx))
+            conf_full = {
+                miss_uniq[pos]: n for pos, n in confirms.items()
+            }
+        else:
+            t1 = time.perf_counter()
+            self.stats.memo_slots += len(rows)
+        # extras served by the memo (known rows): thaw extraction
+        # values per replay, queue row-dependent deferrals
+        for i, (ment, mdef) in extras_pairs:
+            for tid, vals in ment:
+                extractions[(i, tid)] = list(vals)
+            for t_idx in mdef:
+                deferred_rows.append((i, t_idx))
+        # certain-set row-dependent templates with extractors: at this
+        # point the bits plane is content-certain (deferred bits are
+        # cleared), so a set bit broadcasts exactly — but extraction
+        # values may read the row's host → oracle per hit row. Runs
+        # BEFORE the deferred fixups so fixup-set bits don't re-run.
+        rowdep = self._rowdep_t
+        for t_idx in self._ext_t_idx:
+            if t_idx not in rowdep:
+                continue
+            byte_i, mask = t_idx >> 3, 0x80 >> (t_idx & 7)
+            template = db.templates[t_idx]
+            for i in np.flatnonzero(bits[:, byte_i] & mask):
+                res = cpu_ref.match_template(template, rows[int(i)])
+                if res.matched and res.extractions:
+                    extractions[(int(i), template.id)] = res.extractions
+        # row-dependent deferrals (takeover family's host gates,
+        # duration checks): decided per actual row via the oracle
+        for i, t_idx in deferred_rows:
+            template = db.templates[t_idx]
+            mask = 0x80 >> (t_idx & 7)
+            byte_i = t_idx >> 3
+            res = cpu_ref.match_template(template, rows[i])
+            conf_full[i] = conf_full.get(i, 0) + 1
+            self.stats.host_confirm_pairs += 1
+            if res.matched:
+                bits[i, byte_i] |= mask
+                if res.extractions:
+                    extractions[(i, template.id)] = res.extractions
+            else:
+                bits[i, byte_i] &= 0xFF ^ mask
+        host_always_matches = self._host_always_tail(rows, extractions)
+        self.stats.host_confirm_seconds += time.perf_counter() - t1
+        return PackedMatches(
+            bits=bits,
+            template_ids=db.template_ids,
+            extractions=extractions,
+            host_always_matches=host_always_matches,
+            confirms_per_row=conf_full,
+        )
+
+
+    def _host_always_tail(self, rows, extractions: dict) -> list:
+        """Host-always tail shared by both assembly paths: templates
+        the compiler couldn't lower run exactly, per actual row (they
+        may read host). Mutates ``extractions`` in place; returns the
+        (row, template_id) hit list."""
+        host_always_matches: list = []
+        db = self.db
+        if self.host_always_mode == "full" and db.host_always:
+            for i, row in enumerate(rows):
+                for template in db.host_always:
+                    res = cpu_ref.match_template(template, row)
+                    self.stats.host_always_pairs += 1
+                    if res.matched:
+                        host_always_matches.append((i, template.id))
+                        if res.extractions:
+                            extractions[(i, template.id)] = res.extractions
+        return host_always_matches
+
+    # ------------------------------------------------------------------
+    def memo_contains(self, row: Response) -> bool:
+        """Whether the cross-batch verdict memo holds this row's
+        content (works for both the native and the dict memo form)."""
+        if self._vmemo is not None:
+            return self._vmemo.contains(row)
+        return _content_key(row) in self._verdict_memo
+
+    def clear_content_memos(self) -> None:
+        """Drop every cross-batch content memo (bench fresh-content
+        adversarial runs; production never needs this)."""
+        self._ext_cache.clear()
+        self._confirm_cache.clear()
+        self._verdict_memo.clear()
+        if self._vmemo is not None:
+            self._vmemo.clear()

@@ -81,9 +81,8 @@ def test_truncated_content_not_memoized():
         assert "body-match" in got[0].template_ids  # redo path found it
         assert "body-match" in got[1].template_ids
     # the truncated content never entered the memo; the small one did
-    keys = list(eng._verdict_memo)
-    assert any(small.body in k for k in keys)
-    assert not any(big.body in k for k in keys)
+    assert eng.memo_contains(small)
+    assert not eng.memo_contains(big)
 
 
 def test_empty_device_corpus_fused_planes():
@@ -109,6 +108,59 @@ def test_empty_and_dead_batches():
     got = eng.match(mixed)
     assert got[-1].template_ids == ["body-match"]
     assert all(g.template_ids == [] for g in got[:-1])
+
+
+EXTRACT_TEMPLATE = """\
+id: version-extract
+info: {name: v, severity: info}
+requests:
+  - method: GET
+    path: ["{{BaseURL}}/"]
+    matchers:
+      - type: word
+        words: ["server-x"]
+    extractors:
+      - type: regex
+        regex: ["server-x/([0-9.]+)"]
+        group: 1
+"""
+
+
+def test_native_memo_matches_python_memo_path():
+    """The C resident verdict cache and the Python dict memo must
+    produce bit-identical engines: same verdicts, extractions, confirm
+    attribution — across repeats (memo replay), truncation (never
+    memoized), dead rows, and row-dependent host gates."""
+    templates = [
+        T(HOST_PART_TEMPLATE), T(BODY_TEMPLATE),
+        T(EXTRACT_TEMPLATE, path="t/e.yaml"),
+    ]
+    nat = MatchEngine(templates, mesh=None, max_body=512, max_header=256)
+    py = MatchEngine(templates, mesh=None, max_body=512, max_header=256)
+    py._native_memo_ok = False  # force the dict-memo fallback
+    if not nat._use_native_memo():
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    body = b"hello-world from server-x/2.71 build"
+    rows = [
+        Response(host="a.internal.corp", port=80, status=200, body=body),
+        Response(host="b.public.example", port=80, status=200, body=body),
+        Response(host="t", port=80, status=200,
+                 body=b"x" * 900 + b"hello-world"),  # truncated
+        Response(host="dead", alive=False),
+        Response(host="c", port=80, status=200, body=body),
+    ]
+    for batch in (rows, rows, list(reversed(rows))):  # replay + reorder
+        a = nat.match(batch)
+        b = py.match(batch)
+        for i, (x, y) in enumerate(zip(a, b)):
+            assert sorted(x.template_ids) == sorted(y.template_ids), i
+            assert x.extractions == y.extractions, i
+    # both memos hold the small content, neither the truncated row
+    assert nat.memo_contains(rows[0]) and py.memo_contains(rows[0])
+    assert not nat.memo_contains(rows[2])
+    assert not py.memo_contains(rows[2])
 
 
 def test_dns_reply_builder_handles_garbage():
