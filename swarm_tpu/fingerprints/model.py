@@ -110,6 +110,11 @@ class Operation:
     # worker/artifacts/templates/ssl/deprecated-tls.yaml pins per entry.
     ssl_min_version: str = ""
     ssl_max_version: str = ""
+    # headless protocol: the raw browser action list (dicts with
+    # "action"/"args"/"name"), e.g. reference corpus
+    # worker/artifacts/templates/headless/*.yaml. Executed by
+    # worker/headless.py's browserless subset.
+    steps: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

@@ -121,6 +121,8 @@ def _parse_operation(raw: dict, protocol: str) -> Operation:
     if protocol == "dns":
         op.dns_type = str(raw.get("type") or "A").upper()
         op.dns_name = str(raw.get("name") or "{{FQDN}}")
+    if protocol == "headless":
+        op.steps = [s for s in _as_list(raw.get("steps")) if isinstance(s, dict)]
     if protocol == "network":
         for entry in _as_list(raw.get("inputs")):
             if isinstance(entry, dict):

@@ -512,9 +512,10 @@ def build_plan(
         if t.protocol != "http":
             # file and ssl templates run under their dedicated modules
             # (worker/filescan.py, worker/sslscan.py — modules/file.json,
-            # modules/ssl.json), not the active scanner; headless (7
-            # corpus templates) needs a browser engine and is out of
-            # scope — the skip counter keeps that honest per class
+            # modules/ssl.json), not the batch planner; headless
+            # templates in the browserless JS-free subset execute via
+            # worker/headless.py (ActiveScanner removes them from this
+            # skip list), the js-required rest keep the honest marker
             skip(f"protocol-{t.protocol}", t)
             continue
         ok = False
@@ -802,6 +803,35 @@ class ActiveScanner:
                     for p in spec0.get("ports", [443])
                     if int(p) not in sslscan.PLAINTEXT_PORTS
                 ] or [443]
+        # headless-protocol templates: the browserless JS-free subset
+        # (worker/headless.py) executes per live target — form flows
+        # and DOM attribute-collection scripts; js-required ones stay
+        # in the skip list with the honest [headless-skipped] marker
+        self.headless_scanner = None
+        headless_templates = [
+            t for t in engine.templates if t.protocol == "headless"
+        ]
+        if headless_templates:
+            from swarm_tpu.worker import headless as headlesslite
+
+            runnable = [
+                t for t in headless_templates
+                if headlesslite.classify(t) is None
+            ]
+            if runnable:
+                self.headless_scanner = headlesslite.HeadlessScanner(
+                    runnable, probe_spec=probe_spec
+                )
+                runnable_ids = {t.id for t in runnable}
+                kept = [
+                    i
+                    for i in self.plan.skipped.get("protocol-headless", [])
+                    if i not in runnable_ids
+                ]
+                if kept:
+                    self.plan.skipped["protocol-headless"] = kept
+                else:
+                    self.plan.skipped.pop("protocol-headless", None)
         # workflow templates gate which hits report (ops/workflows.py);
         # evaluation reuses this scanner's engine — no extra compile
         self.workflow_runner = None
@@ -866,13 +896,18 @@ class ActiveScanner:
             or self.plan.dns_qtypes
             or self.session_scanner is not None
             or self.ssl_scanner is not None
+            or self.headless_scanner is not None
         )
         if not targets or not plan_has_work:
             return hits, stats
 
         # liveness pre-pass: one connect per target; only live targets
         # fan out over the full request table (and over sessions)
-        need_live = bool(self.plan.requests) or self.session_scanner is not None
+        need_live = (
+            bool(self.plan.requests)
+            or self.session_scanner is not None
+            or self.headless_scanner is not None
+        )
         live = self._liveness(targets) if need_live else []
         stats["live_targets"] = len(live)
 
@@ -935,6 +970,23 @@ class ActiveScanner:
                     matcher_names=f.matcher_names,
                 )
                 for f in ssl_findings
+            )
+
+        # headless pass: the browserless JS-free subset drives form
+        # flows / attribute-collection scripts per live target
+        if self.headless_scanner is not None and live:
+            h_hits = self.headless_scanner.run(live)
+            stats["headless_templates"] = len(
+                self.headless_scanner.templates
+            )
+            stats["headless_hits"] = len(h_hits)
+            hits.extend(
+                ActiveHit(
+                    host=h.host, port=h.port, template_id=h.template_id,
+                    path="", extractions=h.extractions, tls=h.tls,
+                    matcher_names=h.matcher_names,
+                )
+                for h in h_hits
             )
 
         # OOB drain: wait out the interaction window (a vulnerable

@@ -1,0 +1,491 @@
+"""Browserless execution of the JS-free headless-template subset.
+
+nuclei's headless protocol drives a real Chrome over CDP; this
+framework has no browser engine, so the corpus class was previously
+classified out with an honest ``[headless-skipped]`` marker. A
+principled subset needs no JS runtime and executes here:
+
+- **navigation**: ``navigate`` (HTTP fetch with redirects + cookie
+  jar), ``waitload``/``sleep`` (no-ops without a renderer),
+  ``setheader part=request``.
+- **form interaction**: ``click``/``text`` steps addressed by xpath.
+  ``text`` fills the addressed input; ``click`` on a submit control
+  submits its enclosing form (method/action resolution, urlencoded
+  fields), on an anchor navigates its href, on anything else is a
+  focus no-op. This executes the reference corpus's
+  ``headless/dvwa-headless-automatic-login.yaml`` end to end.
+- **DOM attribute-collection scripts** (the
+  ``headless/extract-urls.yaml`` idiom):
+  ``document.querySelectorAll('[src], [href], …')`` mapped over
+  property accessors — emulated exactly over the static DOM, with
+  URL-valued properties (src/href/action) resolved against the page
+  base the way the browser's property getters would.
+
+Anything needing a JS runtime — script ``hook:``s (postmessage
+trackers, prototype-pollution), ``screenshot`` rendering, response
+header rewriting for frame tricks — is classified ``js-required`` by
+:func:`classify` and keeps the honest skip marker. The documented
+bound of the emulation: nodes inserted by page JavaScript are
+invisible (the DOM here is the served HTML, not a rendered tree).
+
+Matchers evaluate on the final page via the exact CPU oracle with
+nuclei's headless part names mapped (``resp``/``page``/``data`` → the
+full response); extractors over a named script's output read the
+emulated script result.
+
+Reference: /root/reference/worker/artifacts/templates/headless/*.yaml
+(7 templates: 2 executable browserlessly, 5 js-required).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Sequence
+from urllib.parse import urljoin, urlencode, urlsplit
+
+from swarm_tpu.fingerprints.model import Response, Template
+from swarm_tpu.ops import cpu_ref
+from swarm_tpu.worker.executor import parse_http_response
+from swarm_tpu.worker.sessions import _request_once
+
+
+# ---------------------------------------------------------------------------
+# classification
+
+_QSA_RE = re.compile(r"querySelectorAll\(\s*['\"]([^'\"]+)['\"]\s*\)")
+_ACCESSOR_RE = re.compile(
+    r"\.map\(\s*(\w+)\s*=>\s*((?:\1\.\w+\s*\|\|\s*)*\1\.\w+)\s*\)"
+)
+
+
+def _attr_collect_spec(code: str) -> Optional[dict]:
+    """Parse the attribute-collection script idiom, or None.
+
+    Recognizes ``[...new Set(Array.from(document.querySelectorAll(
+    '[a], [b]')).map(i => i.a || i.b))].join('SEP')`` (optionally
+    wrapped in literal prefix/suffix concatenation) and returns
+    ``{"attrs": [...], "sep": str, "dedupe": bool, "prefix": str,
+    "suffix": str}``.
+    """
+    qsa = _QSA_RE.search(code)
+    acc = _ACCESSOR_RE.search(code)
+    if not qsa or not acc:
+        return None
+    sel_attrs = re.findall(r"\[\s*(\w+)\s*\]", qsa.group(1))
+    if not sel_attrs:
+        return None
+    var = acc.group(1)
+    attrs = re.findall(re.escape(var) + r"\.(\w+)", acc.group(2))
+    join = re.search(r"\.join\(\s*'((?:\\.|[^'])*)'\s*\)", code)
+    sep = join.group(1).encode().decode("unicode_escape") if join else "\n"
+
+    def literal(pat: str) -> str:
+        m = re.search(pat, code)
+        return (
+            m.group(1).encode().decode("unicode_escape") if m else ""
+        )
+
+    return {
+        "select": sel_attrs,
+        "attrs": attrs or sel_attrs,
+        "sep": sep,
+        "dedupe": "new Set" in code,
+        "prefix": literal(r"return\s+'((?:\\.|[^'])*)'\s*\+"),
+        "suffix": literal(r"\+\s*'((?:\\.|[^'])*)'\s*\n?\s*}?\s*$"),
+    }
+
+
+def classify(t: Template) -> Optional[str]:
+    """None when the template executes browserlessly, else the reason
+    it can't (js-required / unsupported-action-* / no-steps)."""
+    if t.protocol != "headless":
+        return "not-headless"
+    saw_steps = False
+    for op in t.operations:
+        for step in op.steps:
+            saw_steps = True
+            act = str(step.get("action") or "")
+            args = step.get("args") or {}
+            if act in ("navigate", "waitload", "sleep"):
+                continue
+            if act == "setheader":
+                # request headers we can send; response-header
+                # rewriting only matters to a JS runtime's same-origin
+                # machinery
+                if str(args.get("part") or "request") != "request":
+                    return "js-required"
+                continue
+            if act in ("text", "click"):
+                if str(args.get("by") or "") not in ("x", "xpath"):
+                    return "unsupported-selector"
+                continue
+            if act == "script":
+                if args.get("hook") or not _attr_collect_spec(
+                    str(args.get("code") or "")
+                ):
+                    return "js-required"
+                continue
+            return f"unsupported-action-{act or '?'}"
+    if not saw_steps:
+        return "no-steps"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# execution
+
+_DEFAULT_HEADERS = (
+    ("User-Agent", "Mozilla/5.0 (X11; Linux x86_64) swarm-tpu-headless"),
+    ("Accept", "*/*"),
+)
+_URL_PROPS = {"src", "href", "action"}  # browser resolves these
+
+
+@dataclasses.dataclass
+class HeadlessHit:
+    host: str
+    port: int
+    template_id: str
+    extractions: list
+    tls: bool
+    matcher_names: list = dataclasses.field(default_factory=list)
+
+
+class _Page:
+    """One fetched page: parsed DOM + parent links for form lookup."""
+
+    def __init__(self, url: str, status: int, header: bytes, body: bytes):
+        from swarm_tpu.fingerprints.extractors import parse_html
+
+        self.url = url
+        self.status = status
+        self.header = header
+        self.body = body
+        self.root = parse_html(body.decode("utf-8", "replace"))
+        self.parent: dict = {}
+        if self.root is not None:
+            stack = [self.root]
+            while stack:
+                node = stack.pop()
+                for child in node:
+                    self.parent[id(child)] = node
+                    stack.append(child)
+
+    def xpath(self, path: str):
+        from swarm_tpu.fingerprints.extractors import xpath_nodes
+
+        if self.root is None:
+            return None
+        nodes = xpath_nodes(self.root, path)
+        return nodes[0] if nodes else None
+
+    def form_of(self, node):
+        while node is not None:
+            if node.tag == "form":
+                return node
+            node = self.parent.get(id(node))
+        return None
+
+
+class _Session:
+    """Cookie jar + header state for one (target, template) run."""
+
+    def __init__(self, host, ip, port, tls, timeout, connect_timeout):
+        self.host = host
+        self.ip = ip
+        self.port = port
+        self.tls = tls
+        self.timeout = timeout
+        self.connect_timeout = connect_timeout
+        self.cookies: dict = {}
+        self.headers: dict = {}
+        self.page: Optional[_Page] = None
+        default = (tls and port == 443) or (not tls and port == 80)
+        self.base_url = (
+            f"{'https' if tls else 'http'}://{host}"
+            + ("" if default else f":{port}")
+        )
+
+    def fetch(self, url: str, method="GET", body=b"", content_type=None,
+              redirects=5) -> bool:
+        sp = urlsplit(url)
+        path = (sp.path or "/") + (f"?{sp.query}" if sp.query else "")
+        host_hdr = sp.netloc or self.host
+        lines = [f"{method} {path} HTTP/1.1", f"Host: {host_hdr}"]
+        sent = {"host"}
+        for k, v in list(self.headers.items()) + list(_DEFAULT_HEADERS):
+            if k.lower() in sent:
+                continue
+            sent.add(k.lower())
+            lines.append(f"{k}: {v}")
+        if self.cookies:
+            lines.append(
+                "Cookie: "
+                + "; ".join(f"{k}={v}" for k, v in self.cookies.items())
+            )
+        if body or method not in ("GET", "HEAD"):
+            if content_type:
+                lines.append(f"Content-Type: {content_type}")
+            lines.append(f"Content-Length: {len(body)}")
+        lines.append("Connection: close")
+        payload = ("\r\n".join(lines) + "\r\n\r\n").encode() + body
+        raw = _request_once(
+            self.ip or self.host, self.port, self.tls, payload,
+            self.timeout, self.connect_timeout,
+        )
+        if raw is None:
+            return False
+        status, header, rbody = parse_http_response(raw)
+        for m in re.finditer(
+            rb"(?im)^set-cookie:\s*([^=;\s]+)=([^;\r\n]*)", header
+        ):
+            self.cookies[m.group(1).decode("latin-1")] = (
+                m.group(2).decode("latin-1")
+            )
+        loc = re.search(rb"(?im)^location:\s*(\S+)", header)
+        if status in (301, 302, 303, 307, 308) and loc and redirects > 0:
+            target = urljoin(url, loc.group(1).decode("latin-1"))
+            # same-origin only: the jar and socket are bound to the
+            # scan target, and a scanner must not wander off-host
+            if urlsplit(target).netloc in ("", urlsplit(url).netloc):
+                nxt_method = "GET" if status in (301, 302, 303) else method
+                nxt_body = b"" if status in (301, 302, 303) else body
+                return self.fetch(
+                    target, nxt_method, nxt_body, content_type,
+                    redirects - 1,
+                )
+        self.page = _Page(url, status, header, rbody)
+        return True
+
+
+def _run_steps(t: Template, steps, sess: _Session, outputs: dict) -> bool:
+    """Execute one op's step list; False on a dead/failed navigation."""
+    for step in steps:
+        act = str(step.get("action") or "")
+        args = step.get("args") or {}
+        if act in ("waitload", "sleep"):
+            continue
+        if act == "setheader":
+            key, val = str(args.get("key") or ""), str(args.get("value") or "")
+            if key:
+                sess.headers[key] = val
+            continue
+        if act == "navigate":
+            url = str(args.get("url") or "{{BaseURL}}")
+            url = url.replace("{{BaseURL}}", sess.base_url)
+            url = url.replace("{{RootURL}}", sess.base_url)
+            url = url.replace("{{Hostname}}", sess.host)
+            if not sess.fetch(urljoin(sess.base_url + "/", url)):
+                return False
+            continue
+        if act == "text":
+            node = sess.page.xpath(str(args.get("xpath") or "")) if sess.page else None
+            if node is not None:
+                val = str(args.get("value") or "")
+                node.set("value", val)
+                if node.tag.lower() == "textarea":
+                    node.text = val  # itertext() must yield the typed value
+                    for child in list(node):
+                        node.remove(child)
+            continue
+        if act == "click":
+            page = sess.page
+            node = page.xpath(str(args.get("xpath") or "")) if page else None
+            if node is None:
+                continue
+            tag = node.tag.lower()
+            typ = (node.get("type") or "").lower()
+            if tag == "a" and node.get("href"):
+                target = urljoin(page.url, node.get("href"))
+                # same-origin only (matches the redirect policy): the
+                # socket is bound to the scan target, and a foreign
+                # Host header would silently produce vhost mismatches
+                if urlsplit(target).netloc not in (
+                    "", urlsplit(page.url).netloc
+                ):
+                    continue
+                if not sess.fetch(target):
+                    return False
+            elif (tag == "input" and typ in ("submit", "image")) or (
+                tag == "button" and typ in ("", "submit")
+            ):
+                form = page.form_of(node)
+                if form is None:
+                    continue
+                if not _submit(sess, page, form, clicked=node):
+                    return False
+            # any other element: focus — no page effect
+            continue
+        if act == "script":
+            spec = _attr_collect_spec(str(args.get("code") or ""))
+            if spec is not None and sess.page is not None:
+                name = str(step.get("name") or args.get("name") or "script")
+                outputs[name] = _collect_attrs(sess.page, spec)
+            continue
+    return True
+
+
+def _submit(sess: _Session, page: _Page, form, clicked) -> bool:
+    method = (form.get("method") or "get").lower()
+    action = urljoin(page.url, form.get("action") or page.url)
+    if urlsplit(action).netloc not in ("", urlsplit(page.url).netloc):
+        return True  # cross-origin form: out of scan scope, no-op
+    fields: list = []
+    for el in form.iter():
+        name = el.get("name")
+        if not name:
+            continue
+        tag = el.tag.lower()
+        typ = (el.get("type") or "").lower()
+        if tag == "input":
+            if typ in ("submit", "image", "button"):
+                if el is clicked:
+                    fields.append((name, el.get("value") or ""))
+                continue
+            if typ in ("checkbox", "radio") and el.get("checked") is None:
+                continue
+            fields.append((name, el.get("value") or ""))
+        elif tag == "textarea":
+            typed = el.get("value")
+            fields.append(
+                (name, typed if typed is not None else "".join(el.itertext()))
+            )
+        elif tag == "select":
+            opts = [o for o in el.iter() if o.tag.lower() == "option"]
+            sel = next(
+                (o for o in opts if o.get("selected") is not None),
+                opts[0] if opts else None,
+            )
+            if sel is not None:
+                fields.append((name, sel.get("value") or "".join(sel.itertext())))
+    data = urlencode(fields)
+    if method == "post":
+        return sess.fetch(
+            action, "POST", data.encode(),
+            content_type="application/x-www-form-urlencoded",
+        )
+    sep = "&" if urlsplit(action).query else "?"
+    return sess.fetch(action + (sep + data if data else ""))
+
+
+def _collect_attrs(page: _Page, spec: dict) -> str:
+    vals: list = []
+    if page.root is not None:
+        for el in page.root.iter():
+            if not any(el.get(a) is not None for a in spec["select"]):
+                continue
+            for a in spec["attrs"]:
+                raw = el.get(a)
+                if raw:
+                    # browser property getters resolve URL-valued
+                    # attributes against the document base
+                    vals.append(
+                        urljoin(page.url, raw) if a in _URL_PROPS else raw
+                    )
+                    break
+    if spec["dedupe"]:
+        vals = list(dict.fromkeys(vals))
+    return spec["prefix"] + spec["sep"].join(vals) + spec["suffix"]
+
+
+_PART_ALIAS = {"resp": "response", "page": "response", "data": "response"}
+
+
+class HeadlessScanner:
+    """Run the browserless headless subset against live targets.
+
+    Integrated by worker/active.py the same way the ssl/session passes
+    are: templates :func:`classify` accepts execute here; the rest keep
+    the honest skip marker.
+    """
+
+    def __init__(self, templates: Sequence[Template], probe_spec=None):
+        self.templates = [t for t in templates if classify(t) is None]
+        spec = probe_spec or {}
+        self.timeout = float(spec.get("read_timeout_ms", 5000)) / 1000.0
+        self.connect_timeout = (
+            float(spec.get("connect_timeout_ms", 3000)) / 1000.0
+        )
+        self.concurrency = int(spec.get("headless_concurrency", 16))
+
+    def run(self, targets) -> list:
+        """targets: (host, ip, port, tls) tuples (the liveness shape)."""
+        if not self.templates or not targets:
+            return []
+        jobs = [
+            (t, tgt) for tgt in targets for t in self.templates
+        ]
+        with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
+            results = list(pool.map(lambda j: self._exec(*j), jobs))
+        return [h for h in results if h is not None]
+
+    # ------------------------------------------------------------------
+    def _exec(self, t: Template, target) -> Optional[HeadlessHit]:
+        host, ip, port, tls = target
+        sess = _Session(
+            host, ip, port, tls, self.timeout, self.connect_timeout
+        )
+        for op in t.operations:
+            outputs: dict = {}
+            if not _run_steps(t, op.steps, sess, outputs):
+                return None
+            if sess.page is None:
+                return None
+            row = Response(
+                host=host, port=port, status=sess.page.status,
+                body=sess.page.body, header=sess.page.header, tls=tls,
+            )
+            verdicts = []
+            names = []
+            for m in op.matchers:
+                mm = dataclasses.replace(
+                    m, part=_PART_ALIAS.get(m.part or "", m.part)
+                )
+                v = cpu_ref.match_matcher(mm, row)
+                v = bool(v) if v is not None else False
+                verdicts.append(v)
+                if v and m.name:
+                    names.append(m.name)
+            if op.matchers:
+                ok = (
+                    all(verdicts)
+                    if op.matchers_condition == "and"
+                    else any(verdicts)
+                )
+                if not ok:
+                    continue
+            elif not op.extractors:
+                continue
+            extractions: list = []
+            for ex in op.extractors:
+                if ex.part in outputs:
+                    val = outputs[ex.part]
+                    if ex.type == "kval":
+                        # nuclei stores a named script's output under
+                        # its name; kval over that part yields it
+                        if any(
+                            k.lower().replace("-", "_")
+                            == ex.part.lower().replace("-", "_")
+                            for k in ex.kval
+                        ):
+                            extractions.append(val)
+                    elif ex.type == "regex":
+                        for pat in ex.regex:
+                            try:
+                                extractions.extend(
+                                    mo.group(ex.group)
+                                    for mo in re.finditer(pat, val)
+                                )
+                            except (re.error, IndexError):
+                                continue  # RE2-only syntax / bad group
+                    continue
+                extractions.extend(cpu_ref.extract_one(ex, row))
+            if op.matchers or extractions:
+                return HeadlessHit(
+                    host=host, port=port, template_id=t.id,
+                    extractions=extractions, tls=tls,
+                    matcher_names=names,
+                )
+        return None

@@ -314,7 +314,9 @@ class JobProcessor:
                     "checks not evaluated (no out-of-band interaction "
                     "server)"
                 )
-            # headless templates need a browser engine — out of scope
+            # headless templates outside the browserless JS-free
+            # subset (worker/headless.py) need a real browser engine
+            # (JS runtime, renderer, or selectors we don't emulate)
             for tid in scanner.plan.skipped.get("protocol-headless", []):
                 lines.append(
                     f"# [{tid}] [headless-skipped] requires a browser "

@@ -454,6 +454,10 @@ def test_oob_marker_in_scan_output(tmp_path):
         "      - action: navigate\n"
         "        args:\n"
         "          url: \"{{BaseURL}}\"\n"
+        "      - action: script\n"
+        "        args:\n"
+        "          hook: true\n"
+        "          code: \"() => window.foo\"\n"
     )
     # no live targets: zero hits, but the scope markers must still appear
     out = proc._execute_active(module, b"").decode()
