@@ -702,6 +702,8 @@ extern "C" int sw_memo_insert(void* mp, PyObject* row,
 }
 
 // The steady-state hot pass. For each row of the batch:
+//   dead row       → zero verdict row (dead rows match nothing by
+//                    contract), state[i] = -2 — no memo traffic at all
 //   known content  → its packed verdict row memcpy'd into
 //                    bits_out[i*nb], state[i] = -1, LRU refreshed;
 //                    rows with extras are appended to extras_out as
@@ -715,6 +717,7 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
                                   PyObject* extras_out) {
   Memo* m = static_cast<Memo*>(mp);
   if (!PyList_Check(rows) || !PyList_Check(extras_out)) return -1;
+  static PyObject* alive_name = PyUnicode_InternFromString("alive");
   Py_ssize_t n = PyList_GET_SIZE(rows);
   if (n == 0) return 0;
   // batch-local miss table (open addressing over miss slots)
@@ -728,8 +731,25 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
   // ids stay valid across it (entries never move; nothing here evicts)
   std::vector<std::pair<int64_t, int64_t>> extra_rows;
   for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* row = PyList_GET_ITEM(rows, i);
+    {
+      PyObject** dp = _PyObject_GetDictPtr(row);
+      int dec;
+      PyObject* a =
+          fast_attr(row, dp != nullptr ? *dp : nullptr, alive_name, &dec);
+      if (a == nullptr) return -1;
+      int truthy =
+          a == Py_True ? 1 : (a == Py_False ? 0 : PyObject_IsTrue(a));
+      if (dec) Py_DECREF(a);
+      if (truthy < 0) return -1;
+      if (!truthy) {
+        std::memset(bits_out + size_t(i) * m->nb, 0, size_t(m->nb));
+        state[i] = -2;
+        continue;
+      }
+    }
     RowView v;
-    if (row_view(PyList_GET_ITEM(rows, i), &v) != 0) return -1;
+    if (row_view(row, &v) != 0) return -1;
     int err = 0;
     int64_t id = memo_find(m, v, &err);
     if (err) return -1;

@@ -256,12 +256,15 @@ class VerdictMemo:
 
     def lookup(self, rows: list, bits_out: np.ndarray):
         """Serve known rows into ``bits_out`` ([n, row_bytes], any prior
-        content — known rows are fully overwritten, miss rows are NOT
-        touched). Returns ``(state, miss_uniq, extras_pairs)``:
-        ``state[i]`` is -1 for a served row else its miss-slot id,
-        ``miss_uniq[s]`` the first row index of miss slot s, and
-        ``extras_pairs`` a list of ``(row_index, extras_obj)`` for
-        served rows whose entry carries extras."""
+        content — served and dead rows are fully overwritten, miss rows
+        are NOT touched). Returns ``(state, miss_uniq, extras_pairs)``:
+        ``state[i]`` is -1 for a memo-served row, -2 for a DEAD row
+        (``alive`` falsy — zero verdicts written, no memo traffic),
+        else its miss-slot id; ``miss_uniq[s]`` is the first row index
+        of miss slot s, and ``extras_pairs`` a list of
+        ``(row_index, extras_obj)`` for served rows whose entry carries
+        extras. Consumers must treat -1 and -2 distinctly (only -1 is
+        a memo hit; -2 rows are skipped by the host-always tail)."""
         n = len(rows)
         state = np.empty(n, dtype=np.int64)
         miss_uniq = np.empty(max(n, 1), dtype=np.int64)

@@ -752,9 +752,20 @@ class MatchEngine:
         *uncertain fired matchers* — not to rows × templates.
 
         ``pre`` is an optional :meth:`encode_packed` result for the SAME
-        rows (pipelined feeding); ignored when the batch contains dead
-        rows (the live-subset recursion re-encodes).
+        rows (pipelined feeding). The native path handles dead rows
+        inline (the C lookup serves them as zero-verdict rows); on the
+        fallback path a batch with dead rows ignores ``pre`` (the
+        live-subset recursion re-encodes).
         """
+        # native resident-cache path: the C lookup pass already folds
+        # in the dead-row contract, so no alive pre-pass is needed
+        if pre is not None:
+            if pre[0] == "native":
+                return self._match_packed_native(all_rows, pre)
+        elif self._use_native_memo():
+            return self._match_packed_native(
+                all_rows, self._encode_for_backend(all_rows)
+            )
         NT = self.db.num_templates
         nbytes = (NT + 7) >> 3
         # dead rows (no response observed) match nothing by contract —
@@ -791,13 +802,6 @@ class MatchEngine:
 
         rows = all_rows
         enc = pre if pre is not None else self._encode_for_backend(rows)
-        if enc[0] == "native":
-            if enc[7] != len(rows):
-                raise ValueError(
-                    f"pre-encoded batch is for {enc[7]} rows, "
-                    f"match_packed got {len(rows)}"
-                )
-            return self._match_packed_native(rows, enc)
         _tag, batch, matcher, uniq, back, n_src, new_ids, keys, known = enc
         if n_src != len(rows):
             raise ValueError(
@@ -965,7 +969,12 @@ class MatchEngine:
         tests/test_match_parity.py's memo/dedup suites, which run on
         whichever path the build provides, and the native-vs-fallback
         equivalence test."""
-        _tag, batch, matcher, bits, state, miss_uniq, extras_pairs, _n = enc
+        _tag, batch, matcher, bits, state, miss_uniq, extras_pairs, n_src = enc
+        if n_src != len(rows):
+            raise ValueError(
+                f"pre-encoded batch is for {n_src} rows, "
+                f"match_packed got {len(rows)}"
+            )
         db = self.db
         self.stats.rows += len(rows)
         self.stats.batches += 1
@@ -979,7 +988,7 @@ class MatchEngine:
                 self._walk_plane(nrows, batch, matcher)
             )
             t1 = time.perf_counter()
-            self.stats.memo_slots += int((state < 0).sum())
+            self.stats.memo_slots += int((state == -1).sum())
             # broadcast walked bits to their member rows
             miss_rows = np.flatnonzero(state >= 0)
             bits[miss_rows] = pt_value[state[miss_rows]]
@@ -1027,7 +1036,7 @@ class MatchEngine:
             }
         else:
             t1 = time.perf_counter()
-            self.stats.memo_slots += len(rows)
+            self.stats.memo_slots += int((state == -1).sum())
         # extras served by the memo (known rows): thaw extraction
         # values per replay, queue row-dependent deferrals
         for i, (ment, mdef) in extras_pairs:
@@ -1065,7 +1074,9 @@ class MatchEngine:
                     extractions[(i, template.id)] = res.extractions
             else:
                 bits[i, byte_i] &= 0xFF ^ mask
-        host_always_matches = self._host_always_tail(rows, extractions)
+        host_always_matches = self._host_always_tail(
+            rows, extractions, dead_state=state
+        )
         self.stats.host_confirm_seconds += time.perf_counter() - t1
         return PackedMatches(
             bits=bits,
@@ -1076,15 +1087,23 @@ class MatchEngine:
         )
 
 
-    def _host_always_tail(self, rows, extractions: dict) -> list:
+    def _host_always_tail(
+        self, rows, extractions: dict, dead_state=None
+    ) -> list:
         """Host-always tail shared by both assembly paths: templates
         the compiler couldn't lower run exactly, per actual row (they
         may read host). Mutates ``extractions`` in place; returns the
-        (row, template_id) hit list."""
+        (row, template_id) hit list. ``dead_state`` is the native
+        path's state vector — rows marked dead (-2) match nothing by
+        contract and are skipped (the fallback path filters dead rows
+        before assembly, so it passes None).
+        """
         host_always_matches: list = []
         db = self.db
         if self.host_always_mode == "full" and db.host_always:
             for i, row in enumerate(rows):
+                if dead_state is not None and dead_state[i] == -2:
+                    continue
                 for template in db.host_always:
                     res = cpu_ref.match_template(template, row)
                     self.stats.host_always_pairs += 1

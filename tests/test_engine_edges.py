@@ -163,6 +163,35 @@ def test_native_memo_matches_python_memo_path():
     assert not py.memo_contains(rows[2])
 
 
+NEG_HOST_ALWAYS = """\
+id: ha-negative
+info: {name: n, severity: info}
+requests:
+  - method: GET
+    path: ["{{BaseURL}}/"]
+    matchers:
+      - type: word
+        negative: true
+        words: ["absent-token"]
+"""
+
+
+def test_host_always_tail_skips_dead_rows():
+    """Dead rows match nothing by contract — including the host-always
+    tail, whose negative matchers would otherwise fire on a dead row's
+    empty body. The native path folds dead rows into the batch (state
+    -2) instead of pre-filtering, so the tail must skip them itself."""
+    eng = MatchEngine([T(BODY_TEMPLATE)], mesh=None)
+    # fabricate a host-always tail (the reference corpus lowers fully,
+    # so none exists naturally)
+    eng.db.host_always.append(T(NEG_HOST_ALWAYS, path="t/n.yaml"))
+    alive = Response(host="a", port=80, status=200, body=b"plain page")
+    dead = Response(host="d", alive=False)
+    got = eng.match_packed([alive, dead])
+    assert (0, "ha-negative") in got.host_always_matches
+    assert all(rb != 1 for rb, _tid in got.host_always_matches)
+
+
 def test_dns_reply_builder_handles_garbage():
     from swarm_tpu.worker.oob import _build_a_reply, _parse_qname
 
