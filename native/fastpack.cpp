@@ -373,13 +373,10 @@ inline PyObject* fast_attr(PyObject* row, PyObject* dict, PyObject* name,
 
 // Load one row's dedup view (borrowed pointers; the row keeps its
 // attribute objects alive for the call's duration). Returns 0, -1 on
-// error.
-inline int row_view(PyObject* row, RowView* v) {
+// error. ``dict`` is the row's instance __dict__ (or nullptr) when
+// the caller already fetched it; row_view() fetches it itself.
+inline int row_view_dict(PyObject* row, PyObject* dict, RowView* v) {
   const Attrs& a = attrs();
-  // instance __dict__ (dataclass rows): borrowed-ref lookups at about
-  // half the PyObject_GetAttr cost; nullptr falls back per-attribute
-  PyObject** dp = _PyObject_GetDictPtr(row);
-  PyObject* dict = dp != nullptr ? *dp : nullptr;
   int dec;
   PyObject* obj = fast_attr(row, dict, a.banner, &dec);
   if (obj == nullptr) return -1;
@@ -433,6 +430,13 @@ inline int row_view(PyObject* row, RowView* v) {
   if (dec) Py_DECREF(obj);
   v->hash = row_hash(*v);
   return 0;
+}
+
+inline int row_view(PyObject* row, RowView* v) {
+  // instance __dict__ (dataclass rows): borrowed-ref lookups at about
+  // half the PyObject_GetAttr cost; nullptr falls back per-attribute
+  PyObject** dp = _PyObject_GetDictPtr(row);
+  return row_view_dict(row, dp != nullptr ? *dp : nullptr, v);
 }
 
 }  // namespace
@@ -706,17 +710,23 @@ extern "C" int sw_memo_insert(void* mp, PyObject* row,
 //                    contract), state[i] = -2 — no memo traffic at all
 //   known content  → its packed verdict row memcpy'd into
 //                    bits_out[i*nb], state[i] = -1, LRU refreshed;
-//                    rows with extras are appended to extras_out as
-//                    (row_index, extras_object) pairs
+//                    rows with extras get them APPLIED here: each
+//                    entry's extras object is ((tid, vals)..., mdef)
+//                    and the pass writes extr_out[(i, tid)] = list(vals)
+//                    (a fresh thawed list per row — callers may mutate)
+//                    plus (i, t_idx) pairs into deferred_out for the
+//                    row-dependent template ids
 //   novel content  → in-batch dedup: state[i] = miss slot id,
 //                    miss_uniq[slot] = first row index with it
 // Returns the miss-slot count, or -1 on error.
 extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
                                   uint8_t* bits_out, int64_t* state,
-                                  int64_t* miss_uniq,
-                                  PyObject* extras_out) {
+                                  int64_t* miss_uniq, PyObject* extr_out,
+                                  PyObject* deferred_out) {
   Memo* m = static_cast<Memo*>(mp);
-  if (!PyList_Check(rows) || !PyList_Check(extras_out)) return -1;
+  if (!PyList_Check(rows) || !PyDict_Check(extr_out) ||
+      !PyList_Check(deferred_out))
+    return -1;
   static PyObject* alive_name = PyUnicode_InternFromString("alive");
   Py_ssize_t n = PyList_GET_SIZE(rows);
   if (n == 0) return 0;
@@ -732,11 +742,12 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
   std::vector<std::pair<int64_t, int64_t>> extra_rows;
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject* row = PyList_GET_ITEM(rows, i);
+    // one dict fetch serves the alive check AND the row view
+    PyObject** dp = _PyObject_GetDictPtr(row);
+    PyObject* dict = dp != nullptr ? *dp : nullptr;
     {
-      PyObject** dp = _PyObject_GetDictPtr(row);
       int dec;
-      PyObject* a =
-          fast_attr(row, dp != nullptr ? *dp : nullptr, alive_name, &dec);
+      PyObject* a = fast_attr(row, dict, alive_name, &dec);
       if (a == nullptr) return -1;
       int truthy =
           a == Py_True ? 1 : (a == Py_False ? 0 : PyObject_IsTrue(a));
@@ -749,7 +760,7 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
       }
     }
     RowView v;
-    if (row_view(row, &v) != 0) return -1;
+    if (row_view_dict(row, dict, &v) != 0) return -1;
     int err = 0;
     int64_t id = memo_find(m, v, &err);
     if (err) return -1;
@@ -785,13 +796,39 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
       slot = (slot + 1) & (cap - 1);
     }
   }
+  // apply the served rows' extras: extras = (ment, mdef) where ment is
+  // ((tid, vals-tuple)...) and mdef (t_idx...). Entry ids stay valid
+  // across the allocations below (entries never move, nothing evicts).
   for (const auto& [row_i, id] : extra_rows) {
-    PyObject* pair =
-        Py_BuildValue("(lO)", long(row_i), m->entries[size_t(id)].extras);
-    if (pair == nullptr) return -1;
-    int rc = PyList_Append(extras_out, pair);
-    Py_DECREF(pair);
-    if (rc != 0) return -1;
+    PyObject* extras = m->entries[size_t(id)].extras;
+    if (!PyTuple_Check(extras) || PyTuple_GET_SIZE(extras) != 2) return -1;
+    PyObject* ment = PyTuple_GET_ITEM(extras, 0);
+    PyObject* mdef = PyTuple_GET_ITEM(extras, 1);
+    if (!PyTuple_Check(ment) || !PyTuple_Check(mdef)) return -1;
+    for (Py_ssize_t k = 0; k < PyTuple_GET_SIZE(ment); ++k) {
+      PyObject* pair = PyTuple_GET_ITEM(ment, k);  // (tid, vals)
+      if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) return -1;
+      PyObject* key = Py_BuildValue(
+          "(lO)", long(row_i), PyTuple_GET_ITEM(pair, 0));
+      if (key == nullptr) return -1;
+      PyObject* vals = PySequence_List(PyTuple_GET_ITEM(pair, 1));
+      if (vals == nullptr) {
+        Py_DECREF(key);
+        return -1;
+      }
+      int rc = PyDict_SetItem(extr_out, key, vals);
+      Py_DECREF(key);
+      Py_DECREF(vals);
+      if (rc != 0) return -1;
+    }
+    for (Py_ssize_t k = 0; k < PyTuple_GET_SIZE(mdef); ++k) {
+      PyObject* pair = Py_BuildValue(
+          "(lO)", long(row_i), PyTuple_GET_ITEM(mdef, k));
+      if (pair == nullptr) return -1;
+      int rc = PyList_Append(deferred_out, pair);
+      Py_DECREF(pair);
+      if (rc != 0) return -1;
+    }
   }
   return int64_t(miss_views.size());
 }

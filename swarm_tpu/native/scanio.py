@@ -143,7 +143,8 @@ def ensure_fastpack() -> ctypes.PyDLL:
     lib.sw_memo_insert.argtypes = [vp, ctypes.py_object, u8p, ctypes.py_object]
     lib.sw_memo_insert.restype = ctypes.c_int
     lib.sw_memo_lookup.argtypes = [
-        vp, ctypes.py_object, u8p, i64p, i64p, ctypes.py_object
+        vp, ctypes.py_object, u8p, i64p, i64p,
+        ctypes.py_object, ctypes.py_object,
     ]
     lib.sw_memo_lookup.restype = ctypes.c_int64
     _fastpack = lib
@@ -257,26 +258,44 @@ class VerdictMemo:
     def lookup(self, rows: list, bits_out: np.ndarray):
         """Serve known rows into ``bits_out`` ([n, row_bytes], any prior
         content — served and dead rows are fully overwritten, miss rows
-        are NOT touched). Returns ``(state, miss_uniq, extras_pairs)``:
+        are NOT touched). Returns
+        ``(state, miss_uniq, extractions, deferred)``:
         ``state[i]`` is -1 for a memo-served row, -2 for a DEAD row
         (``alive`` falsy — zero verdicts written, no memo traffic),
         else its miss-slot id; ``miss_uniq[s]`` is the first row index
-        of miss slot s, and ``extras_pairs`` a list of
-        ``(row_index, extras_obj)`` for served rows whose entry carries
-        extras. Consumers must treat -1 and -2 distinctly (only -1 is
-        a memo hit; -2 rows are skipped by the host-always tail)."""
+        of miss slot s. Served rows' extras come back APPLIED:
+        ``extractions`` is ``{(row, tid): thawed-list}`` (fresh lists —
+        callers may mutate) and ``deferred`` the ``(row, t_idx)``
+        row-dependent template pairs. Consumers must treat -1 and -2
+        distinctly (only -1 is a memo hit; -2 rows are skipped by the
+        host-always tail). Inserted extras objects MUST be the
+        ``(ment, mdef)`` tuple shape the engine stores (or None)."""
         n = len(rows)
         state = np.empty(n, dtype=np.int64)
         miss_uniq = np.empty(max(n, 1), dtype=np.int64)
-        extras: list = []
+        extractions: dict = {}
+        deferred: list = []
         nm = self._lib.sw_memo_lookup(
-            self._h, rows, bits_out, state, miss_uniq, extras
+            self._h, rows, bits_out, state, miss_uniq, extractions,
+            deferred,
         )
         if nm < 0:
             raise TypeError("rows must be Response objects")
-        return state, miss_uniq[:nm].tolist(), extras
+        return state, miss_uniq[:nm].tolist(), extractions, deferred
 
     def insert(self, row, bits_row: np.ndarray, extras) -> None:
+        # the lookup pass unpacks extras as (ment, mdef) in C — reject
+        # other shapes HERE, at the call that supplied the bad object
+        # (a later lookup would fail far from the cause)
+        if extras is not None and not (
+            isinstance(extras, tuple)
+            and len(extras) == 2
+            and isinstance(extras[0], tuple)
+            and isinstance(extras[1], tuple)
+        ):
+            raise ValueError(
+                "extras must be a (ment, mdef) tuple pair or None"
+            )
         if self._lib.sw_memo_insert(self._h, row, bits_row, extras) != 0:
             raise TypeError("memo insert failed")
 

@@ -276,6 +276,12 @@ class MatchEngine:
         self._rowdep_t = frozenset(
             i for i, t in enumerate(db.templates) if _is_row_dependent(t)
         )
+        # extractor templates that are ALSO row-dependent (their
+        # values may read host): the per-batch certain-set scan walks
+        # only these columns, not all extractor templates
+        self._rowdep_ext_t = [
+            t_idx for t_idx in self._ext_t_idx if t_idx in self._rowdep_t
+        ]
 
     _EXT_CACHE_MAX = 16384
 
@@ -496,16 +502,19 @@ class MatchEngine:
 
             self._vmemo = VerdictMemo(self._EXT_CACHE_MAX, nbits)
         bits = np.empty((len(rows), nbits), dtype=np.uint8)
-        state, miss_uniq, extras_pairs = self._vmemo.lookup(rows, bits)
+        state, miss_uniq, extr_known, deferred_known = (
+            self._vmemo.lookup(rows, bits)
+        )
+        served = (extr_known, deferred_known)
         if not miss_uniq:
             return (
-                "native", None, None, bits, state, miss_uniq, extras_pairs,
+                "native", None, None, bits, state, miss_uniq, served,
                 len(rows),
             )
         nrows = [rows[i] for i in miss_uniq]
         batch, matcher = self._encode_unique(nrows, reuse_buffers)
         return (
-            "native", batch, matcher, bits, state, miss_uniq, extras_pairs,
+            "native", batch, matcher, bits, state, miss_uniq, served,
             len(rows),
         )
 
@@ -937,9 +946,7 @@ class MatchEngine:
         # is content-determined (broadcast is exact) but extraction
         # values may read the member's host — covers memo-served slots
         # too (their member set is new every batch), hence ubits
-        for t_idx in self._ext_t_idx:
-            if t_idx not in rowdep:
-                continue
+        for t_idx in self._rowdep_ext_t:
             byte_i, mask = t_idx >> 3, 0x80 >> (t_idx & 7)
             template = db.templates[t_idx]
             for ub in np.flatnonzero(ubits[:, byte_i] & mask):
@@ -969,7 +976,7 @@ class MatchEngine:
         tests/test_match_parity.py's memo/dedup suites, which run on
         whichever path the build provides, and the native-vs-fallback
         equivalence test."""
-        _tag, batch, matcher, bits, state, miss_uniq, extras_pairs, n_src = enc
+        _tag, batch, matcher, bits, state, miss_uniq, served, n_src = enc
         if n_src != len(rows):
             raise ValueError(
                 f"pre-encoded batch is for {n_src} rows, "
@@ -1037,22 +1044,22 @@ class MatchEngine:
         else:
             t1 = time.perf_counter()
             self.stats.memo_slots += int((state == -1).sum())
-        # extras served by the memo (known rows): thaw extraction
-        # values per replay, queue row-dependent deferrals
-        for i, (ment, mdef) in extras_pairs:
-            for tid, vals in ment:
-                extractions[(i, tid)] = list(vals)
-            for t_idx in mdef:
-                deferred_rows.append((i, t_idx))
+        # extras served by the memo arrive ALREADY applied by the C
+        # lookup: a (row, tid) -> thawed-list dict plus the
+        # row-dependent deferral pairs. At steady state (no walked
+        # extractions yet) the C-built dict is adopted wholesale.
+        extr_known, deferred_known = served
+        if extractions:
+            extractions.update(extr_known)
+        else:
+            extractions = extr_known
+        deferred_rows.extend(deferred_known)
         # certain-set row-dependent templates with extractors: at this
         # point the bits plane is content-certain (deferred bits are
         # cleared), so a set bit broadcasts exactly — but extraction
         # values may read the row's host → oracle per hit row. Runs
         # BEFORE the deferred fixups so fixup-set bits don't re-run.
-        rowdep = self._rowdep_t
-        for t_idx in self._ext_t_idx:
-            if t_idx not in rowdep:
-                continue
+        for t_idx in self._rowdep_ext_t:
             byte_i, mask = t_idx >> 3, 0x80 >> (t_idx & 7)
             template = db.templates[t_idx]
             for i in np.flatnonzero(bits[:, byte_i] & mask):
