@@ -1,0 +1,156 @@
+"""Native fastpack passes: content dedup, alive mask, verdict cache.
+
+These are the C primitives under the exact engine's steady-state path
+(native/fastpack.cpp via swarm_tpu/native/scanio.py). The engine-level
+equivalence suite (tests/test_engine_edges.py) pins end-to-end
+bit-identity; this file pins the primitives directly — randomized
+parity against pure-Python oracles, LRU/eviction behavior, and the
+extras contract."""
+
+import random
+
+import numpy as np
+import pytest
+
+from swarm_tpu.fingerprints.model import Response
+from swarm_tpu.ops.engine import _content_key
+
+pytest.importorskip("swarm_tpu.native.scanio")
+try:
+    from swarm_tpu.native.scanio import (
+        VerdictMemo, ensure_fastpack, rows_alive, rows_dedup,
+    )
+
+    ensure_fastpack()
+except Exception:  # no toolchain and no prebuilt .so
+    pytest.skip("native lib unavailable", allow_module_level=True)
+
+
+def _content_pool():
+    base = bytearray(b"x" * 500)
+    # byte 50 is OUTSIDE every row_hash probe window for len 500
+    # ([0,8), [125,133), [246,254), [367,375), [492,500)) — the two
+    # rows hash identically and only the full memcmp separates them
+    base[50] = ord("q")
+    return [
+        Response(body=b"page-A" * 100, header=b"Server: x\r\n", status=200),
+        # status alone differs
+        Response(body=b"page-A" * 100, header=b"Server: x\r\n", status=404),
+        Response(body=b"page-B" * 100, header=b"Server: x\r\n", status=200),
+        # banner-vs-body distinction (same bytes, different field)
+        Response(banner=b"SSH-2.0", body=b"", header=b"", status=0),
+        Response(banner=None, body=b"SSH-2.0", header=b"", status=0),
+        Response(body=b"", header=b"", status=0),
+        # OOB fields are key dimensions
+        Response(body=b"oob", status=200, oob_protocols=("http",),
+                 oob_requests=b"GET /x", oob_ips=("1.2.3.4",)),
+        Response(body=b"oob", status=200, oob_protocols=("dns",),
+                 oob_requests=b"GET /x", oob_ips=("1.2.3.4",)),
+        # mid-body difference with identical length and boundary bytes
+        # (forces the hash-collision → full-memcmp path)
+        Response(body=b"x" * 500, header=b"", status=200),
+        Response(body=bytes(base), header=b"", status=200),
+    ]
+
+
+def _clone(r: Response) -> Response:
+    """Content-equal copy through fresh byte objects (defeats the
+    same-object shortcut, the production allocation pattern)."""
+    return Response(
+        host=r.host, port=r.port, status=r.status,
+        body=bytes(memoryview(r.body)), header=bytes(memoryview(r.header)),
+        banner=None if r.banner is None else bytes(memoryview(r.banner)),
+        oob_protocols=tuple(r.oob_protocols),
+        oob_requests=bytes(memoryview(r.oob_requests)),
+        oob_ips=tuple(r.oob_ips),
+    )
+
+
+def test_rows_dedup_randomized_parity():
+    rng = random.Random(7)
+    pool = _content_pool()
+    for trial in range(100):
+        rows = [rng.choice(pool) for _ in range(rng.randrange(0, 50))]
+        rows += [_clone(r) for r in rows[:10]]
+        uniq, back = rows_dedup(rows)
+        key_of: dict = {}
+        ouniq: list = []
+        oback: list = []
+        for i, r in enumerate(rows):
+            k = _content_key(r)
+            if k not in key_of:
+                key_of[k] = len(ouniq)
+                ouniq.append(i)
+            oback.append(key_of[k])
+        assert list(uniq) == ouniq, trial
+        assert list(back) == oback, trial
+
+
+def test_rows_alive_mask():
+    rows = [Response(body=b"x", alive=(i % 3 != 0)) for i in range(10)]
+    n, mask = rows_alive(rows)
+    assert n == sum(r.alive for r in rows)
+    assert list(mask) == [int(r.alive) for r in rows]
+
+
+def test_memo_lookup_insert_dedupe_and_extras():
+    m = VerdictMemo(8, 8)
+    r1 = Response(body=b"aaa", header=b"h", status=200)
+    r2 = Response(body=b"bbb", header=b"h", status=200)
+    bits = np.zeros((3, 8), dtype=np.uint8)
+    state, miss, extr, deferred = m.lookup([r1, r2, _clone(r1)], bits)
+    assert list(state) == [0, 1, 0] and miss == [0, 1]
+    assert extr == {} and deferred == []
+    ment = (("t-x", ("v1", "v2")),)
+    mdef = (3,)
+    m.insert(r1, np.arange(8, dtype=np.uint8), (ment, mdef))
+    assert m.contains(_clone(r1)) and not m.contains(r2)
+    bits = np.zeros((3, 8), dtype=np.uint8)
+    state, miss, extr, deferred = m.lookup([r2, _clone(r1), r1], bits)
+    assert list(state) == [0, -1, -1] and miss == [0]
+    assert (bits[1] == np.arange(8)).all() and (bits[2] == np.arange(8)).all()
+    # extras applied per served row, values thawed to fresh lists
+    assert extr == {(1, "t-x"): ["v1", "v2"], (2, "t-x"): ["v1", "v2"]}
+    assert extr[(1, "t-x")] is not extr[(2, "t-x")]
+    assert deferred == [(1, 3), (2, 3)]
+
+
+def test_memo_dead_rows_served_as_zero():
+    m = VerdictMemo(8, 4)
+    live = Response(body=b"live", status=200)
+    m.insert(live, np.full(4, 7, np.uint8), None)
+    dead = Response(host="d", alive=False)
+    bits = np.full((2, 4), 0xEE, dtype=np.uint8)
+    state, miss, extr, deferred = m.lookup([dead, _clone(live)], bits)
+    assert list(state) == [-2, -1] and miss == []
+    assert (bits[0] == 0).all() and (bits[1] == 7).all()
+
+
+def test_memo_lru_eviction_and_overwrite():
+    m = VerdictMemo(4, 4)
+    mk = lambda i: Response(body=b"x%d" % i, status=i)
+    for i in range(6):
+        m.insert(mk(i), np.full(4, i, np.uint8), None)
+    assert len(m) == 4
+    assert not m.contains(mk(0)) and not m.contains(mk(1))  # LRU evicted
+    assert m.contains(mk(5))
+    # touching an entry protects it from the next eviction
+    bits = np.zeros((1, 4), dtype=np.uint8)
+    m.lookup([mk(2)], bits)  # refresh 2 (oldest resident)
+    m.insert(mk(9), np.full(4, 9, np.uint8), None)  # evicts 3, not 2
+    assert m.contains(mk(2)) and not m.contains(mk(3))
+    # overwrite keeps one entry and the new bits win
+    m.insert(mk(5), np.full(4, 0x55, np.uint8), None)
+    assert len(m) == 4
+    m.lookup([_clone(mk(5))], bits)
+    assert (bits[0] == 0x55).all()
+    m.clear()
+    assert len(m) == 0
+
+
+def test_memo_insert_rejects_malformed_extras():
+    m = VerdictMemo(4, 4)
+    r = Response(body=b"x", status=200)
+    with pytest.raises(ValueError):
+        m.insert(r, np.zeros(4, np.uint8), [("tid", [1])])
+    m.insert(r, np.zeros(4, np.uint8), None)  # None is fine
