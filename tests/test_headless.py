@@ -115,6 +115,15 @@ class _Srv(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
 
 
+
+def _serve(handler_cls):
+    """Start a daemon-threaded local server; returns (server, port).
+    Shared scaffolding for every fixture in this file."""
+    srv = _Srv(("127.0.0.1", 0), handler_cls)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
 @pytest.fixture
 def dvwa_server():
     """login.php: GET serves the form; a POST with admin/password and
@@ -166,9 +175,8 @@ def dvwa_server():
             except OSError:
                 pass
 
-    srv = _Srv(("127.0.0.1", 0), H)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
-    yield srv.server_address[1]
+    srv, port = _serve(H)
+    yield port
     srv.shutdown()
 
 
@@ -219,9 +227,8 @@ def urls_server():
             except OSError:
                 pass
 
-    srv = _Srv(("127.0.0.1", 0), H)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
-    yield srv.server_address[1]
+    srv, port = _serve(H)
+    yield port
     srv.shutdown()
 
 
@@ -245,6 +252,129 @@ def test_reference_extract_urls_template(urls_server):
     assert "https://other.example/x" in out
     assert f"{base}/rel/page" in out
     assert f"{base}/post/here" in out
+
+
+FORM_EDGES_PAGE = (
+    b"<html><body>"
+    b"<form action=\"https://elsewhere.example/steal\" method=\"post\">"
+    b"<input type=\"text\" name=\"u\">"
+    b"<input type=\"submit\" name=\"go\" value=\"go\"></form>"
+    b"<form action=\"/note\" method=\"post\">"
+    b"<textarea name=\"msg\">old</textarea>"
+    b"<input type=\"submit\" name=\"send\" value=\"send\"></form>"
+    b"<a href=\"https://offsite.example/x\">leave</a>"
+    b"</body></html>"
+)
+
+TEXTAREA_TEMPLATE = """\
+id: demo-textarea
+info: {name: t, severity: info}
+headless:
+  - steps:
+      - args: {url: "{{BaseURL}}/"}
+        action: navigate
+      - args:
+          by: x
+          value: typed-value
+          xpath: "/html/body/form[2]/textarea"
+        action: text
+      - args:
+          by: x
+          xpath: "/html/body/form[2]/input"
+        action: click
+    matchers:
+      - part: resp
+        type: word
+        words: ["saw: typed-value"]
+"""
+
+CROSS_ORIGIN_TEMPLATE = """\
+id: demo-crossorigin
+info: {name: c, severity: info}
+headless:
+  - steps:
+      - args: {url: "{{BaseURL}}/"}
+        action: navigate
+      - args:
+          by: x
+          xpath: "/html/body/form[1]/input[2]"
+        action: click
+      - args:
+          by: x
+          xpath: "/html/body/a"
+        action: click
+    matchers:
+      - part: resp
+        type: word
+        words: ["leave"]
+"""
+
+
+@pytest.fixture
+def edges_server():
+    """Serves the form-edges page; POST /note echoes the msg field so
+    the textarea's typed value is observable; any cross-origin request
+    reaching this socket would echo 'WRONG-HOST' (the same-origin gate
+    must prevent that)."""
+
+    class H(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                data = self.request.recv(16384).decode("latin-1")
+                line = data.split("\r\n", 1)[0]
+                body = data.split("\r\n\r\n", 1)[-1]
+                if "elsewhere.example" in data or "offsite.example" in data:
+                    out = b"WRONG-HOST"
+                elif line.startswith("POST /note"):
+                    from urllib.parse import parse_qs
+
+                    msg = parse_qs(body).get("msg", [""])[0]
+                    out = b"saw: " + msg.encode()
+                else:
+                    out = FORM_EDGES_PAGE
+                self.request.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Length: %d\r\n"
+                    b"Connection: close\r\n\r\n%s" % (len(out), out)
+                )
+            except OSError:
+                pass
+
+    srv, port = _serve(H)
+    yield port
+    srv.shutdown()
+
+
+def test_textarea_typed_value_reaches_submit(edges_server):
+    t = T(TEXTAREA_TEMPLATE)
+    sc = headless.HeadlessScanner([t])
+    hits = sc.run([("127.0.0.1", "127.0.0.1", edges_server, False)])
+    assert [h.template_id for h in hits] == ["demo-textarea"]
+
+
+def test_cross_origin_click_and_submit_are_gated(edges_server):
+    """A foreign-host form action skips the submit and a foreign-host
+    anchor click is a no-op — the page (which contains 'leave') is
+    still current at the end, and the scan target never receives a
+    mismatched-Host request."""
+    t = T(CROSS_ORIGIN_TEMPLATE)
+    sc = headless.HeadlessScanner([t])
+    hits = sc.run([("127.0.0.1", "127.0.0.1", edges_server, False)])
+    assert [h.template_id for h in hits] == ["demo-crossorigin"]
+
+
+def test_unparseable_page_steps_do_not_crash():
+    """click/text over a page whose DOM failed to build must be no-ops
+    (an adversarial target must never abort the scan thread)."""
+    page = headless._Page("http://t/", 200, b"", b"\x00\xff")
+    page.root = None  # simulate a parse failure
+    sess = headless._Session("t", "t", 80, False, 1.0, 1.0)
+    sess.page = page
+    steps = [
+        {"action": "text", "args": {"by": "x", "xpath": "/html/body/input", "value": "v"}},
+        {"action": "click", "args": {"by": "x", "xpath": "/html/body/a"}},
+    ]
+    t = T(TEXTAREA_TEMPLATE)
+    assert headless._run_steps(t, steps, sess, {}) is True
 
 
 JS_TEMPLATE = """\
