@@ -19,9 +19,11 @@ driver tails):
    step (the kernel ceiling; headline continuity with round 1).
 
 vs_baseline divides by the north-star target 10M fingerprints/sec on a
-v4-8 (4 chips) => 2.5M/sec/chip (BASELINE.json); auxiliary metrics
-report vs_baseline 0.0 (no published reference number exists —
-BASELINE.md documents the absence).
+v4-8 (4 chips) => 2.5M/sec/chip (BASELINE.json) for the exact/device
+metrics; the auxiliary metrics divide by the per-config targets in
+``BASELINES`` (documented in BASELINE.md §"Per-metric targets") so a
+regression in ANY emitted line is driver-visible — no line carries
+vs_baseline 0.0.
 """
 
 from __future__ import annotations
@@ -39,6 +41,28 @@ REFERENCE_CORPUS = Path("/root/reference/worker/artifacts/templates")
 BUNDLED_CORPUS = Path(__file__).parent / "tests" / "data" / "templates"
 
 TARGET_PER_CHIP = 10_000_000 / 4  # north star: 10M/s on a v4-8 (4 chips)
+
+#: Per-metric baseline targets (BASELINE.md §"Per-metric targets").
+#: Every emitted line divides by its target so the driver can detect a
+#: regression in ANY metric, not just the headline (round-2 verdict:
+#: no vs_baseline 0.0 lines).
+BASELINES = {
+    # BASELINE config #2: 10k-banner nmap-service-probes classify.
+    "service_probe_classifications_per_sec": 50_000.0,
+    # BASELINE config #4: masscan-style stream -> classifier, pipelined.
+    "streamed_service_classifications_per_sec": 50_000.0,
+    # BASELINE config #5: internet-wide JARM clustering (round-3 bar).
+    "jarm_cluster_rows_per_sec": 20_000.0,
+    # exact-engine speedup over the per-row CPU oracle (config #1 A/B).
+    "device_vs_cpu_oracle_speedup": 10_000.0,
+    # design-bound fresh-content host walk (round-3 bar: 10x the
+    # round-2 measured 37k).
+    "exact_fresh_content_host_walk_rows_per_sec": 400_000.0,
+    # per-row CPU oracle over the full corpus (r2 measured ~12 rows/s);
+    # input to the speedup ratio, but its standalone line must still
+    # make a regression visible
+    "cpu_oracle_rows_per_sec": 10.0,
+}
 
 ROWS = 2048
 MAX_BODY = 2048
@@ -60,8 +84,10 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
         # 3 decimals, not int: sub-1.0 rates (the per-row CPU oracle)
         # must survive the child→parent JSON round trip
         "value": round(value, 3),
-        "unit": unit,
-        "vs_baseline": round(vs_baseline, 3),
+        # significant figures, not decimals: a tiny-but-real ratio
+        # (CPU-fallback fresh floor ~0.0007) must never round to 0.0 —
+        # that would read as a measured total collapse
+        "vs_baseline": float(f"{vs_baseline:.3g}"),
     }
     if _EMIT_NOTE:
         rec["note"] = _EMIT_NOTE
@@ -503,12 +529,6 @@ def run_phase(phase: str) -> int:
         exact, fresh_rate, fresh_walk, _db = bench_exact_engine(
             templates, db=db
         )
-        emit(
-            "exact_fingerprints_per_sec_per_chip",
-            exact,
-            "fingerprints/sec/chip",
-            exact / TARGET_PER_CHIP,
-        )
         # adversarial floor: every row carries never-seen content, so
         # neither dedup nor the cross-batch memos help
         emit(
@@ -530,24 +550,52 @@ def run_phase(phase: str) -> int:
                 fresh_walk,
                 "rows/sec (host sparse-confirm+extraction on fresh "
                 "content)",
-                0.0,
+                fresh_walk
+                / BASELINES["exact_fresh_content_host_walk_rows_per_sec"],
             )
         else:
             log("!!! fresh host walk unmeasurably small; metric omitted")
+        # the HEADLINE emits LAST within the phase (and the phase runs
+        # last overall) so the driver's tail-parse captures the honest
+        # end-to-end exact metric, not an auxiliary line
+        emit(
+            "exact_fingerprints_per_sec_per_chip",
+            exact,
+            "fingerprints/sec/chip",
+            exact / TARGET_PER_CHIP,
+        )
     elif phase == "service":
         svc = bench_service_classifier()
-        emit("service_probe_classifications_per_sec", svc, "banners/sec", 0.0)
+        emit(
+            "service_probe_classifications_per_sec",
+            svc,
+            "banners/sec",
+            svc / BASELINES["service_probe_classifications_per_sec"],
+        )
     elif phase == "streaming":
         stream = bench_streaming_classifier()
         emit(
-            "streamed_service_classifications_per_sec", stream, "rows/sec", 0.0
+            "streamed_service_classifications_per_sec",
+            stream,
+            "rows/sec",
+            stream / BASELINES["streamed_service_classifications_per_sec"],
         )
     elif phase == "oracle":
         oracle = bench_oracle_ab(templates)
-        emit("cpu_oracle_rows_per_sec", oracle, "rows/sec", 0.0)
+        emit(
+            "cpu_oracle_rows_per_sec",
+            oracle,
+            "rows/sec",
+            oracle / BASELINES["cpu_oracle_rows_per_sec"],
+        )
     elif phase == "jarm":
         jarm = bench_jarm_cluster()
-        emit("jarm_cluster_rows_per_sec", jarm, "fingerprints/sec", 0.0)
+        emit(
+            "jarm_cluster_rows_per_sec",
+            jarm,
+            "fingerprints/sec",
+            jarm / BASELINES["jarm_cluster_rows_per_sec"],
+        )
     elif phase == "device":
         devrate = bench_device_only(db, dev)
         emit(
@@ -562,9 +610,12 @@ def run_phase(phase: str) -> int:
     return 0
 
 
-#: phase order; the LAST phase's metric is the headline line the driver
-#: tails (device-only rate — continuity with round 1's headline).
-PHASES = ["exact", "service", "streaming", "oracle", "jarm", "device"]
+#: phase order; the LAST phase's LAST metric is the headline line the
+#: driver tails — the END-TO-END exact engine rate at 100% parity
+#: (BASELINE.md's declared headline), not an auxiliary or device-only
+#: line. oracle runs before exact so the speedup ratio main()
+#: synthesizes never delays the headline.
+PHASES = ["service", "streaming", "jarm", "device", "oracle", "exact"]
 
 
 def main() -> int:
@@ -586,6 +637,7 @@ def main() -> int:
     values: dict = {}
     notes: dict = {}
     failed = []
+    headline_line = ""
     for phase in PHASES:
         try:
             r = subprocess.run(
@@ -612,37 +664,44 @@ def main() -> int:
                 continue
             values[rec["metric"]] = rec["value"]
             notes[rec["metric"]] = rec.get("note", "")
-            # the oracle rate is an input to the speedup ratio, not a
-            # headline — don't re-emit it standalone
             if rec["metric"] == "cpu_oracle_rows_per_sec":
-                exact = values.get("exact_fingerprints_per_sec_per_chip")
-                oracle = rec["value"]
-                if exact and oracle:
-                    # carry a child's CPU-fallback note (set in the
-                    # phase processes, not here) onto the synthesized
-                    # line — the EXACT child's note matters most (its
-                    # rate is the numerator being vouched for), but a
-                    # fallback on either side disqualifies the ratio
-                    # as a chip measurement
-                    global _EMIT_NOTE
-                    _EMIT_NOTE = (
-                        notes.get(
-                            "exact_fingerprints_per_sec_per_chip", ""
-                        )
-                        or rec.get("note", "")
-                    )
-                    emit(
-                        "device_vs_cpu_oracle_speedup",
-                        exact / oracle,
-                        "x (same rows, same corpus, parity-identical results)",
-                        0.0,
-                    )
-                else:
-                    # exact phase failed → no honest numerator; a 0.0x
-                    # line would read as a measured regression
-                    log("!!! speedup metric skipped (missing exact rate)")
-            else:
-                print(line, flush=True)
+                # input to the speedup ratio synthesized below — not a
+                # standalone headline
+                continue
+            if rec["metric"] == "exact_fingerprints_per_sec_per_chip":
+                # hold the headline back so it is the LAST line emitted
+                # (the driver tail-parses stdout)
+                headline_line = line
+                continue
+            print(line, flush=True)
+    exact = values.get("exact_fingerprints_per_sec_per_chip")
+    oracle = values.get("cpu_oracle_rows_per_sec")
+    if exact and oracle:
+        # carry a child's CPU-fallback note (set in the phase
+        # processes, not here) onto the synthesized line — the EXACT
+        # child's note matters most (its rate is the numerator being
+        # vouched for), but a fallback on either side disqualifies the
+        # ratio as a chip measurement
+        global _EMIT_NOTE
+        _EMIT_NOTE = (
+            notes.get("exact_fingerprints_per_sec_per_chip", "")
+            or notes.get("cpu_oracle_rows_per_sec", "")
+        )
+        speedup = exact / oracle
+        emit(
+            "device_vs_cpu_oracle_speedup",
+            speedup,
+            "x (same rows, same corpus, parity-identical results)",
+            speedup / BASELINES["device_vs_cpu_oracle_speedup"],
+        )
+    else:
+        # a missing side → no honest ratio; a 0.0x line would read as
+        # a measured regression
+        log("!!! speedup metric skipped (missing exact or oracle rate)")
+    if headline_line:
+        print(headline_line, flush=True)
+    else:
+        log("!!! exact headline missing (phase failed?)")
     return 1 if failed else 0
 
 
