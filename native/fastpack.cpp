@@ -371,15 +371,33 @@ inline PyObject* fast_attr(PyObject* row, PyObject* dict, PyObject* name,
   return PyObject_GetAttr(row, name);
 }
 
-// Load one row's dedup view (borrowed pointers; the row keeps its
-// attribute objects alive for the call's duration). Returns 0, -1 on
-// error. ``dict`` is the row's instance __dict__ (or nullptr) when
-// the caller already fetched it; row_view() fetches it itself.
-inline int row_view_dict(PyObject* row, PyObject* dict, RowView* v) {
+// Scope guard for attribute objects a view's interior pointers alias
+// when the fetch fell back to PyObject_GetAttr (property/slotted rows
+// return FRESH objects — decref-ing them while keeping the byte
+// pointers would be a use-after-free). Dataclass rows resolve through
+// the borrowed-ref __dict__ path and never touch this (no allocation,
+// empty destructor loop).
+struct HeldRefs {
+  std::vector<PyObject*> objs;
+  ~HeldRefs() {
+    for (PyObject* o : objs) Py_DECREF(o);
+  }
+  void hold(PyObject* o) { objs.push_back(o); }
+};
+
+// Load one row's dedup view (borrowed pointers; for __dict__-backed
+// rows the row itself keeps the attribute objects alive, and any
+// GetAttr-fallback fetches are pinned in ``held`` until the caller's
+// pass is done with the view). Returns 0, -1 on error. ``dict`` is
+// the row's instance __dict__ (or nullptr) when the caller already
+// fetched it; row_view() fetches it itself.
+inline int row_view_dict(PyObject* row, PyObject* dict, RowView* v,
+                         HeldRefs* held) {
   const Attrs& a = attrs();
   int dec;
   PyObject* obj = fast_attr(row, dict, a.banner, &dec);
   if (obj == nullptr) return -1;
+  if (dec) held->hold(obj);
   if (obj == Py_None) {
     v->ban = nullptr;
     v->ban_len = -1;
@@ -387,56 +405,48 @@ inline int row_view_dict(PyObject* row, PyObject* dict, RowView* v) {
     v->ban = PyBytes_AS_STRING(obj);
     v->ban_len = PyBytes_GET_SIZE(obj);
   } else {
-    if (dec) Py_DECREF(obj);
     return -1;
   }
-  if (dec) Py_DECREF(obj);
   obj = fast_attr(row, dict, a.body, &dec);
-  if (obj == nullptr || !PyBytes_Check(obj)) {
-    if (dec) Py_XDECREF(obj);
-    return -1;
-  }
+  if (obj == nullptr) return -1;
+  if (dec) held->hold(obj);
+  if (!PyBytes_Check(obj)) return -1;
   v->body = PyBytes_AS_STRING(obj);
   v->body_len = PyBytes_GET_SIZE(obj);
-  if (dec) Py_DECREF(obj);
   obj = fast_attr(row, dict, a.header, &dec);
-  if (obj == nullptr || !PyBytes_Check(obj)) {
-    if (dec) Py_XDECREF(obj);
-    return -1;
-  }
+  if (obj == nullptr) return -1;
+  if (dec) held->hold(obj);
+  if (!PyBytes_Check(obj)) return -1;
   v->hdr = PyBytes_AS_STRING(obj);
   v->hdr_len = PyBytes_GET_SIZE(obj);
-  if (dec) Py_DECREF(obj);
   obj = fast_attr(row, dict, a.status, &dec);
   if (obj == nullptr) return -1;
-  v->status = PyLong_AsLong(obj);
+  v->status = PyLong_AsLong(obj);  // converted immediately: safe to drop
   if (dec) Py_DECREF(obj);
   if (v->status == -1 && PyErr_Occurred()) return -1;
   obj = fast_attr(row, dict, a.oob_requests, &dec);
-  if (obj == nullptr || !PyBytes_Check(obj)) {
-    if (dec) Py_XDECREF(obj);
-    return -1;
-  }
+  if (obj == nullptr) return -1;
+  if (dec) held->hold(obj);
+  if (!PyBytes_Check(obj)) return -1;
   v->orq = PyBytes_AS_STRING(obj);
   v->orq_len = PyBytes_GET_SIZE(obj);
-  if (dec) Py_DECREF(obj);
   obj = fast_attr(row, dict, a.oob_protocols, &dec);
   if (obj == nullptr) return -1;
+  if (dec) held->hold(obj);
   v->op = obj;
-  if (dec) Py_DECREF(obj);
   obj = fast_attr(row, dict, a.oob_ips, &dec);
   if (obj == nullptr) return -1;
+  if (dec) held->hold(obj);
   v->oip = obj;
-  if (dec) Py_DECREF(obj);
   v->hash = row_hash(*v);
   return 0;
 }
 
-inline int row_view(PyObject* row, RowView* v) {
+inline int row_view(PyObject* row, RowView* v, HeldRefs* held) {
   // instance __dict__ (dataclass rows): borrowed-ref lookups at about
   // half the PyObject_GetAttr cost; nullptr falls back per-attribute
   PyObject** dp = _PyObject_GetDictPtr(row);
-  return row_view_dict(row, dp != nullptr ? *dp : nullptr, v);
+  return row_view_dict(row, dp != nullptr ? *dp : nullptr, v, held);
 }
 
 }  // namespace
@@ -476,13 +486,14 @@ extern "C" int64_t sw_rows_dedup(PyObject* rows, int64_t* back,
   if (n == 0) return 0;
   std::vector<RowView> reps;  // representative views by unique slot
   reps.reserve(64);
+  HeldRefs held;  // pins fallback-fetched attr objects for the pass
   // open-addressing table of unique-slot ids, pow2 ≥ 2n
   size_t cap = 16;
   while (cap < size_t(n) * 2) cap <<= 1;
   std::vector<int64_t> table(cap, -1);
   for (Py_ssize_t i = 0; i < n; ++i) {
     RowView v;
-    if (row_view(PyList_GET_ITEM(rows, i), &v) != 0) return -1;
+    if (row_view(PyList_GET_ITEM(rows, i), &v, &held) != 0) return -1;
     size_t slot = size_t(v.hash) & (cap - 1);
     for (;;) {
       int64_t u = table[slot];
@@ -603,6 +614,41 @@ inline int64_t memo_find(Memo* m, const RowView& v, int* err) {
   return -1;
 }
 
+// One served row's extras application: extras = (ment, mdef) where
+// ment is ((tid, vals-tuple)...) and mdef (t_idx...). Writes
+// extr_out[(row_i, tid)] = list(vals) (a fresh thawed list — callers
+// may mutate) and appends (row_i, t_idx) pairs to deferred_out.
+inline int apply_row_extras(PyObject* extras, long row_i,
+                            PyObject* extr_out, PyObject* deferred_out) {
+  if (!PyTuple_Check(extras) || PyTuple_GET_SIZE(extras) != 2) return -1;
+  PyObject* ment = PyTuple_GET_ITEM(extras, 0);
+  PyObject* mdef = PyTuple_GET_ITEM(extras, 1);
+  if (!PyTuple_Check(ment) || !PyTuple_Check(mdef)) return -1;
+  for (Py_ssize_t k = 0; k < PyTuple_GET_SIZE(ment); ++k) {
+    PyObject* pair = PyTuple_GET_ITEM(ment, k);  // (tid, vals)
+    if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) return -1;
+    PyObject* key = Py_BuildValue("(lO)", row_i, PyTuple_GET_ITEM(pair, 0));
+    if (key == nullptr) return -1;
+    PyObject* vals = PySequence_List(PyTuple_GET_ITEM(pair, 1));
+    if (vals == nullptr) {
+      Py_DECREF(key);
+      return -1;
+    }
+    int rc = PyDict_SetItem(extr_out, key, vals);
+    Py_DECREF(key);
+    Py_DECREF(vals);
+    if (rc != 0) return -1;
+  }
+  for (Py_ssize_t k = 0; k < PyTuple_GET_SIZE(mdef); ++k) {
+    PyObject* pair = Py_BuildValue("(lO)", row_i, PyTuple_GET_ITEM(mdef, k));
+    if (pair == nullptr) return -1;
+    int rc = PyList_Append(deferred_out, pair);
+    Py_DECREF(pair);
+    if (rc != 0) return -1;
+  }
+  return 0;
+}
+
 }  // namespace
 
 extern "C" void* sw_memo_new(int64_t cap, int32_t nb) {
@@ -642,7 +688,8 @@ extern "C" int64_t sw_memo_len(void* mp) {
 extern "C" int sw_memo_contains(void* mp, PyObject* row) {
   Memo* m = static_cast<Memo*>(mp);
   RowView v;
-  if (row_view(row, &v) != 0) return -1;
+  HeldRefs held;
+  if (row_view(row, &v, &held) != 0) return -1;
   int err = 0;
   int64_t id = memo_find(m, v, &err);
   if (err) return -1;
@@ -656,48 +703,73 @@ extern "C" int sw_memo_insert(void* mp, PyObject* row,
                               const uint8_t* bits_row, PyObject* extras) {
   Memo* m = static_cast<Memo*>(mp);
   RowView v;
-  if (row_view(row, &v) != 0) return -1;
+  HeldRefs held;
+  if (row_view(row, &v, &held) != 0) return -1;
+  // Own the content objects FIRST and build the stored key from them
+  // (the row object may die; its attribute objects must not — and a
+  // property row may hand back fresh byte objects per access, so the
+  // lookup view's pointers are not the buffers being stored).
+  const Attrs& a = attrs();
+  PyObject* names[6] = {a.banner, a.body,          a.header,
+                        a.oob_requests, a.oob_protocols, a.oob_ips};
+  PyObject* owned[6] = {};
+  auto bad_owned = [&]() {
+    for (auto*& o : owned) Py_XDECREF(o);
+    return -1;
+  };
+  for (int k = 0; k < 6; ++k) {
+    owned[k] = PyObject_GetAttr(row, names[k]);
+    if (owned[k] == nullptr) return bad_owned();
+  }
+  RowView kv;
+  if (owned[0] == Py_None) {
+    kv.ban = nullptr;
+    kv.ban_len = -1;
+  } else if (PyBytes_Check(owned[0])) {
+    kv.ban = PyBytes_AS_STRING(owned[0]);
+    kv.ban_len = PyBytes_GET_SIZE(owned[0]);
+  } else {
+    return bad_owned();
+  }
+  if (!PyBytes_Check(owned[1]) || !PyBytes_Check(owned[2]) ||
+      !PyBytes_Check(owned[3]))
+    return bad_owned();
+  kv.body = PyBytes_AS_STRING(owned[1]);
+  kv.body_len = PyBytes_GET_SIZE(owned[1]);
+  kv.hdr = PyBytes_AS_STRING(owned[2]);
+  kv.hdr_len = PyBytes_GET_SIZE(owned[2]);
+  kv.orq = PyBytes_AS_STRING(owned[3]);
+  kv.orq_len = PyBytes_GET_SIZE(owned[3]);
+  kv.op = owned[4];
+  kv.oip = owned[5];
+  kv.status = v.status;
+  kv.hash = row_hash(kv);
+  // overwrite = drop + fresh insert, keyed by the content actually
+  // being STORED (for plain rows kv == v; dropping by v could leave a
+  // duplicate live entry under kv when a property row's content
+  // changed between the two fetches)
   int err = 0;
-  int64_t id = memo_find(m, v, &err);
-  if (err) return -1;
-  if (id >= 0) memo_drop_entry(m, id);  // overwrite = drop + fresh insert
+  int64_t id = memo_find(m, kv, &err);
+  if (err) return bad_owned();
+  if (id >= 0) memo_drop_entry(m, id);
   if (m->free_ids.empty()) memo_drop_entry(m, m->lru_tail);
   id = m->free_ids.back();
   m->free_ids.pop_back();
   MemoEntry& e = m->entries[size_t(id)];
-  // own the content objects the view points into (the row object may
-  // die; its attribute objects must not)
-  const Attrs& a = attrs();
-  PyObject* names[6] = {a.banner, a.body,          a.header,
-                        a.oob_requests, a.oob_protocols, a.oob_ips};
-  for (int k = 0; k < 6; ++k) {
-    PyObject* o = PyObject_GetAttr(row, names[k]);
-    if (o == nullptr) {
-      for (int j = 0; j < k; ++j) Py_XDECREF(e.owned[j]);
-      m->free_ids.push_back(id);
-      return -1;
-    }
-    e.owned[k] = o;
+  e.bits = static_cast<uint8_t*>(std::malloc(size_t(m->nb)));
+  if (e.bits == nullptr) {
+    m->free_ids.push_back(id);
+    return bad_owned();
   }
-  e.key = v;
+  for (int k = 0; k < 6; ++k) e.owned[k] = owned[k];
+  e.key = kv;
   e.extras = nullptr;
   if (extras != nullptr && extras != Py_None) {
     Py_INCREF(extras);
     e.extras = extras;
   }
-  e.bits = static_cast<uint8_t*>(std::malloc(size_t(m->nb)));
-  if (e.bits == nullptr) {
-    for (auto*& o : e.owned) {
-      Py_XDECREF(o);
-      o = nullptr;
-    }
-    Py_XDECREF(e.extras);
-    e.extras = nullptr;
-    m->free_ids.push_back(id);
-    return -1;
-  }
   std::memcpy(e.bits, bits_row, size_t(m->nb));
-  size_t b = size_t(v.hash) & m->mask;
+  size_t b = size_t(kv.hash) & m->mask;
   e.hnext = m->buckets[b];
   m->buckets[b] = id;
   e.live = true;
@@ -736,10 +808,16 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
   std::vector<int64_t> table(cap, -1);
   std::vector<RowView> miss_views;
   miss_views.reserve(64);
-  // known rows with extras: collected as plain ids first — the Python
-  // list building at the end is the only allocation point, and entry
-  // ids stay valid across it (entries never move; nothing here evicts)
-  std::vector<std::pair<int64_t, int64_t>> extra_rows;
+  HeldRefs held;  // pins fallback-fetched attr objects for the pass
+  // known rows with extras: each extras object is INCREF'd at collect
+  // time — the application loop below allocates (Py_BuildValue /
+  // PySequence_List), and a GC-finalizer re-entering this memo could
+  // evict a listed entry, decref-ing its extras out from under us.
+  // Entry ids alone aren't enough; own the object.
+  std::vector<std::pair<int64_t, PyObject*>> extra_rows;
+  auto release_extras = [&]() {
+    for (auto& [row_i, ex] : extra_rows) Py_DECREF(ex);
+  };
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject* row = PyList_GET_ITEM(rows, i);
     // one dict fetch serves the alive check AND the row view
@@ -748,11 +826,17 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
     {
       int dec;
       PyObject* a = fast_attr(row, dict, alive_name, &dec);
-      if (a == nullptr) return -1;
+      if (a == nullptr) {
+        release_extras();
+        return -1;
+      }
       int truthy =
           a == Py_True ? 1 : (a == Py_False ? 0 : PyObject_IsTrue(a));
       if (dec) Py_DECREF(a);
-      if (truthy < 0) return -1;
+      if (truthy < 0) {
+        release_extras();
+        return -1;
+      }
       if (!truthy) {
         std::memset(bits_out + size_t(i) * m->nb, 0, size_t(m->nb));
         state[i] = -2;
@@ -760,15 +844,24 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
       }
     }
     RowView v;
-    if (row_view_dict(row, dict, &v) != 0) return -1;
+    if (row_view_dict(row, dict, &v, &held) != 0) {
+      release_extras();
+      return -1;
+    }
     int err = 0;
     int64_t id = memo_find(m, v, &err);
-    if (err) return -1;
+    if (err) {
+      release_extras();
+      return -1;
+    }
     if (id >= 0) {
       MemoEntry& e = m->entries[size_t(id)];
       std::memcpy(bits_out + size_t(i) * m->nb, e.bits, size_t(m->nb));
       state[i] = -1;
-      if (e.extras != nullptr) extra_rows.emplace_back(i, id);
+      if (e.extras != nullptr) {
+        Py_INCREF(e.extras);
+        extra_rows.emplace_back(i, e.extras);
+      }
       memo_lru_unlink(m, id);
       memo_lru_push_front(m, id);
       continue;
@@ -787,7 +880,10 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
       const RowView& rep = miss_views[size_t(u)];
       if (rep.hash == v.hash) {
         int eq = rows_equal(rep, v);
-        if (eq < 0) return -1;
+        if (eq < 0) {
+          release_extras();
+          return -1;
+        }
         if (eq) {
           state[i] = u;
           break;
@@ -796,40 +892,18 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
       slot = (slot + 1) & (cap - 1);
     }
   }
-  // apply the served rows' extras: extras = (ment, mdef) where ment is
-  // ((tid, vals-tuple)...) and mdef (t_idx...). Entry ids stay valid
-  // across the allocations below (entries never move, nothing evicts).
-  for (const auto& [row_i, id] : extra_rows) {
-    PyObject* extras = m->entries[size_t(id)].extras;
-    if (!PyTuple_Check(extras) || PyTuple_GET_SIZE(extras) != 2) return -1;
-    PyObject* ment = PyTuple_GET_ITEM(extras, 0);
-    PyObject* mdef = PyTuple_GET_ITEM(extras, 1);
-    if (!PyTuple_Check(ment) || !PyTuple_Check(mdef)) return -1;
-    for (Py_ssize_t k = 0; k < PyTuple_GET_SIZE(ment); ++k) {
-      PyObject* pair = PyTuple_GET_ITEM(ment, k);  // (tid, vals)
-      if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) return -1;
-      PyObject* key = Py_BuildValue(
-          "(lO)", long(row_i), PyTuple_GET_ITEM(pair, 0));
-      if (key == nullptr) return -1;
-      PyObject* vals = PySequence_List(PyTuple_GET_ITEM(pair, 1));
-      if (vals == nullptr) {
-        Py_DECREF(key);
-        return -1;
-      }
-      int rc = PyDict_SetItem(extr_out, key, vals);
-      Py_DECREF(key);
-      Py_DECREF(vals);
-      if (rc != 0) return -1;
-    }
-    for (Py_ssize_t k = 0; k < PyTuple_GET_SIZE(mdef); ++k) {
-      PyObject* pair = Py_BuildValue(
-          "(lO)", long(row_i), PyTuple_GET_ITEM(mdef, k));
-      if (pair == nullptr) return -1;
-      int rc = PyList_Append(deferred_out, pair);
-      Py_DECREF(pair);
-      if (rc != 0) return -1;
+  // apply the served rows' extras. Each extras object is OWNED by
+  // this pass (incref'd at collect) so allocation-triggered GC
+  // re-entering the memo and evicting an entry cannot dangle it;
+  // release_extras() covers the whole vector regardless of how far
+  // the loop got.
+  for (const auto& [row_i, extras] : extra_rows) {
+    if (apply_row_extras(extras, long(row_i), extr_out, deferred_out) != 0) {
+      release_extras();
+      return -1;
     }
   }
+  release_extras();
   return int64_t(miss_views.size());
 }
 

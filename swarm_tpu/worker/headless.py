@@ -43,7 +43,7 @@ import dataclasses
 import re
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
-from urllib.parse import urljoin, urlencode, urlsplit
+from urllib.parse import urljoin, urlencode, urlsplit, urlunsplit
 
 from swarm_tpu.fingerprints.model import Response, Template
 from swarm_tpu.ops import cpu_ref
@@ -53,6 +53,35 @@ from swarm_tpu.worker.sessions import _request_once
 
 # ---------------------------------------------------------------------------
 # classification
+
+def _origin(url: str) -> str:
+    """Normalized origin key: lowercased hostname plus the port, with
+    an explicit scheme-default port (:80 on http, :443 on https)
+    collapsed to the implicit form — so a redirect that merely adds the
+    default port is still same-origin, as in real browsers. Scheme
+    stays OUT of the key (the session socket is pinned to one endpoint;
+    an implicit-port http→https redirect rides the same policy the old
+    netloc comparison applied: 'h' == 'h' follows)."""
+    sp = urlsplit(url)
+    if not sp.netloc:
+        return ""
+    host = (sp.hostname or "").lower()
+    try:
+        port = sp.port
+    except ValueError:
+        return sp.netloc.lower()  # unparsable port: compare verbatim
+    default = 443 if sp.scheme.lower() == "https" else 80
+    if port is None or port == default:
+        return host
+    return f"{host}:{port}"
+
+
+def _same_origin(target: str, base: str) -> bool:
+    """True when ``target`` stays on ``base``'s origin (relative URLs
+    always do)."""
+    t = _origin(target)
+    return t == "" or t == _origin(base)
+
 
 _QSA_RE = re.compile(r"querySelectorAll\(\s*['\"]([^'\"]+)['\"]\s*\)")
 _ACCESSOR_RE = re.compile(
@@ -212,7 +241,13 @@ class _Session:
               redirects=5) -> bool:
         sp = urlsplit(url)
         path = (sp.path or "/") + (f"?{sp.query}" if sp.query else "")
+        # browsers omit the scheme-default port from Host: a followed
+        # redirect to http://h:80/... must still send "Host: h" or a
+        # strict name-based vhost silently serves its default site
         host_hdr = sp.netloc or self.host
+        default = 443 if sp.scheme.lower() == "https" else 80
+        if sp.hostname and sp.port == default:
+            host_hdr = sp.hostname
         lines = [f"{method} {path} HTTP/1.1", f"Host: {host_hdr}"]
         sent = {"host"}
         for k, v in list(self.headers.items()) + list(_DEFAULT_HEADERS):
@@ -249,7 +284,7 @@ class _Session:
             target = urljoin(url, loc.group(1).decode("latin-1"))
             # same-origin only: the jar and socket are bound to the
             # scan target, and a scanner must not wander off-host
-            if urlsplit(target).netloc in ("", urlsplit(url).netloc):
+            if _same_origin(target, url):
                 nxt_method = "GET" if status in (301, 302, 303) else method
                 nxt_body = b"" if status in (301, 302, 303) else body
                 return self.fetch(
@@ -302,9 +337,7 @@ def _run_steps(t: Template, steps, sess: _Session, outputs: dict) -> bool:
                 # same-origin only (matches the redirect policy): the
                 # socket is bound to the scan target, and a foreign
                 # Host header would silently produce vhost mismatches
-                if urlsplit(target).netloc not in (
-                    "", urlsplit(page.url).netloc
-                ):
+                if not _same_origin(target, page.url):
                     continue
                 if not sess.fetch(target):
                     return False
@@ -330,7 +363,7 @@ def _run_steps(t: Template, steps, sess: _Session, outputs: dict) -> bool:
 def _submit(sess: _Session, page: _Page, form, clicked) -> bool:
     method = (form.get("method") or "get").lower()
     action = urljoin(page.url, form.get("action") or page.url)
-    if urlsplit(action).netloc not in ("", urlsplit(page.url).netloc):
+    if not _same_origin(action, page.url):
         return True  # cross-origin form: out of scan scope, no-op
     fields: list = []
     for el in form.iter():
@@ -366,8 +399,13 @@ def _submit(sess: _Session, page: _Page, form, clicked) -> bool:
             action, "POST", data.encode(),
             content_type="application/x-www-form-urlencoded",
         )
-    sep = "&" if urlsplit(action).query else "?"
-    return sess.fetch(action + (sep + data if data else ""))
+    # GET submit REPLACES the action's query with the serialized
+    # fields (browser semantics) — appending would produce a request
+    # real Chrome never sends
+    sp = urlsplit(action)
+    return sess.fetch(
+        urlunsplit((sp.scheme, sp.netloc, sp.path, data, ""))
+    )
 
 
 def _collect_attrs(page: _Page, spec: dict) -> str:

@@ -163,6 +163,55 @@ def test_native_memo_matches_python_memo_path():
     assert not py.memo_contains(rows[2])
 
 
+def test_property_rows_through_native_passes():
+    """Rows whose byte attributes are PROPERTIES returning a fresh
+    object per access exercise the C passes' PyObject_GetAttr fallback
+    (no instance-__dict__ hit). The views built there keep interior
+    byte pointers, so the pass must pin the fetched objects for its
+    duration, and the memo's stored key must alias the objects the
+    ENTRY owns — not the lookup view's short-lived buffers
+    (ADVICE r2: fastpack.cpp row_view_dict / sw_memo_insert)."""
+    body = b"hello-world from server-x/2.71 build"
+
+    class FreshBytesRow(Response):
+        # dataclass __init__ assigns through the setters; the getters
+        # hand back a NEW bytes object every access
+        @property
+        def body(self):  # noqa: D102
+            return bytes(memoryview(body))
+
+        @body.setter
+        def body(self, v):
+            pass
+
+        @property
+        def header(self):  # noqa: D102
+            return bytes(memoryview(b"HTTP/1.1 200 OK\r\nServer: x"))
+
+        @header.setter
+        def header(self, v):
+            pass
+
+    templates = [
+        T(BODY_TEMPLATE), T(EXTRACT_TEMPLATE, path="t/e.yaml"),
+    ]
+    eng = MatchEngine(templates, mesh=None, max_body=512, max_header=256)
+    if not eng._use_native_memo():
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    plain = Response(host="p", port=80, status=200, body=body,
+                     header=b"HTTP/1.1 200 OK\r\nServer: x")
+    expect = eng.match([plain])[0]
+    for _ in range(3):  # miss, then memo-served replays
+        rows = [FreshBytesRow(host="p", port=80, status=200) for _ in range(4)]
+        got = eng.match(rows)
+        for g in got:
+            assert sorted(g.template_ids) == sorted(expect.template_ids)
+            assert g.extractions == expect.extractions
+    assert eng.memo_contains(FreshBytesRow(host="p", port=80, status=200))
+
+
 NEG_HOST_ALWAYS = """\
 id: ha-negative
 info: {name: n, severity: info}

@@ -362,6 +362,54 @@ def test_cross_origin_click_and_submit_are_gated(edges_server):
     assert [h.template_id for h in hits] == ["demo-crossorigin"]
 
 
+def test_same_origin_normalizes_default_ports_and_case():
+    """A redirect that adds the scheme's explicit default port (or
+    changes hostname case) is still same-origin, as in real browsers;
+    a real port change is not (ADVICE r2: headless.py netloc gate)."""
+    same = headless._same_origin
+    assert same("http://h:80/x", "http://h/")
+    assert same("http://h/x", "http://h:80/")
+    assert same("https://h:443/x", "https://h/")
+    assert same("http://H/x", "http://h/")
+    assert same("/relative", "http://h/")
+    # implicit-port scheme flip keeps the OLD netloc-gate behavior
+    # ('h' == 'h'): the ubiquitous http -> https redirect still follows
+    assert same("https://h/welcome", "http://h/")
+    assert not same("http://h:8080/x", "http://h/")
+    assert not same("http://other/x", "http://h/")
+
+
+def test_get_submit_replaces_action_query():
+    """GET form submission REPLACES the action's query with the
+    serialized fields — browsers never append to it (ADVICE r2)."""
+    html = (
+        b"<html><body><form action=\"/search?stale=1&x=2\" method=\"get\">"
+        b"<input type=\"text\" name=\"q\" value=\"needle\">"
+        b"<input type=\"submit\" name=\"go\" value=\"go\"></form>"
+        b"</body></html>"
+    )
+    page = headless._Page("http://t/start", 200, b"", html)
+    form = next(
+        el for el in page.root.iter() if el.tag.lower() == "form"
+    )
+    clicked = next(
+        el for el in form.iter()
+        if el.get("type", "").lower() == "submit"
+    )
+    calls = []
+
+    class RecordingSession:
+        def fetch(self, url, *a, **kw):
+            calls.append(url)
+            return True
+
+    assert headless._submit(RecordingSession(), page, form, clicked)
+    assert len(calls) == 1
+    assert "stale" not in calls[0] and "x=2" not in calls[0]
+    assert calls[0].startswith("http://t/search?")
+    assert "q=needle" in calls[0]
+
+
 def test_unparseable_page_steps_do_not_crash():
     """click/text over a page whose DOM failed to build must be no-ops
     (an adversarial target must never abort the scan thread)."""
