@@ -548,6 +548,8 @@ struct Memo {
   std::vector<MemoEntry> entries;  // reserved to cap at creation
   std::vector<int64_t> free_ids;
   std::vector<int64_t> buckets;    // -1-terminated chains
+  std::vector<uint8_t> bits_arena;  // cap*nb — entry i's bits slab is
+                                    // arena + i*nb for its lifetime
   size_t mask;
   int64_t cap;
   int32_t nb;
@@ -589,8 +591,7 @@ inline void memo_drop_entry(Memo* m, int64_t id) {
   }
   Py_XDECREF(e.extras);
   e.extras = nullptr;
-  std::free(e.bits);
-  e.bits = nullptr;
+  // e.bits stays pointed at the entry's arena slab
   e.live = false;
   m->free_ids.push_back(id);
 }
@@ -657,6 +658,9 @@ extern "C" void* sw_memo_new(int64_t cap, int32_t nb) {
   m->cap = cap;
   m->nb = nb;
   m->entries.resize(size_t(cap));  // never reallocates after this
+  m->bits_arena.resize(size_t(cap) * size_t(nb));
+  for (int64_t i = 0; i < cap; ++i)
+    m->entries[size_t(i)].bits = m->bits_arena.data() + size_t(i) * nb;
   m->free_ids.reserve(size_t(cap));
   for (int64_t i = cap - 1; i >= 0; --i) m->free_ids.push_back(i);
   size_t bsz = 16;
@@ -699,9 +703,14 @@ extern "C" int sw_memo_contains(void* mp, PyObject* row) {
 // Insert (or overwrite) one fully-resolved row's verdict. bits_row is
 // memo->nb bytes; extras is the engine's per-content extras object
 // (Py_None stores as "no extras"). Evicts the LRU tail at capacity.
-extern "C" int sw_memo_insert(void* mp, PyObject* row,
-                              const uint8_t* bits_row, PyObject* extras) {
-  Memo* m = static_cast<Memo*>(mp);
+namespace {
+
+// Insert (or overwrite) one fully-resolved row's verdict — the core
+// shared by the single and batch entry points. bits_row is memo->nb
+// bytes; extras is the engine's (ment, mdef) tuple or nullptr/None.
+// Evicts the LRU tail at capacity.
+int memo_insert_one(Memo* m, PyObject* row, const uint8_t* bits_row,
+                    PyObject* extras) {
   RowView v;
   HeldRefs held;
   if (row_view(row, &v, &held) != 0) return -1;
@@ -717,9 +726,14 @@ extern "C" int sw_memo_insert(void* mp, PyObject* row,
     for (auto*& o : owned) Py_XDECREF(o);
     return -1;
   };
+  PyObject** dp = _PyObject_GetDictPtr(row);
+  PyObject* dict = dp != nullptr ? *dp : nullptr;
   for (int k = 0; k < 6; ++k) {
-    owned[k] = PyObject_GetAttr(row, names[k]);
-    if (owned[k] == nullptr) return bad_owned();
+    int dec;
+    PyObject* o = fast_attr(row, dict, names[k], &dec);
+    if (o == nullptr) return bad_owned();
+    if (!dec) Py_INCREF(o);  // entry must OWN its content objects
+    owned[k] = o;
   }
   RowView kv;
   if (owned[0] == Py_None) {
@@ -756,11 +770,6 @@ extern "C" int sw_memo_insert(void* mp, PyObject* row,
   id = m->free_ids.back();
   m->free_ids.pop_back();
   MemoEntry& e = m->entries[size_t(id)];
-  e.bits = static_cast<uint8_t*>(std::malloc(size_t(m->nb)));
-  if (e.bits == nullptr) {
-    m->free_ids.push_back(id);
-    return bad_owned();
-  }
   for (int k = 0; k < 6; ++k) e.owned[k] = owned[k];
   e.key = kv;
   e.extras = nullptr;
@@ -775,6 +784,40 @@ extern "C" int sw_memo_insert(void* mp, PyObject* row,
   e.live = true;
   memo_lru_push_front(m, id);
   return 0;
+}
+
+}  // namespace
+
+extern "C" int sw_memo_insert(void* mp, PyObject* row,
+                              const uint8_t* bits_row, PyObject* extras) {
+  return memo_insert_one(static_cast<Memo*>(mp), row, bits_row, extras);
+}
+
+// Batch insert: one call per walked plane instead of one ctypes
+// round-trip per row. Row i's verdict bits live at
+// bits_base + i*nb (the contiguous [B, nb] plane the walk produced);
+// skip[i] nonzero skips the row (truncation/overflow positions are
+// never stored); extras_list[i] is the (ment, mdef) tuple or None.
+// Returns the number inserted, -1 on error.
+extern "C" int64_t sw_memo_insert_batch(void* mp, PyObject* rows,
+                                        const uint8_t* bits_base,
+                                        const uint8_t* skip,
+                                        PyObject* extras_list) {
+  Memo* m = static_cast<Memo*>(mp);
+  if (!PyList_Check(rows) || !PyList_Check(extras_list)) return -1;
+  Py_ssize_t n = PyList_GET_SIZE(rows);
+  if (PyList_GET_SIZE(extras_list) != n) return -1;
+  int64_t done = 0;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    if (skip[i]) continue;
+    PyObject* ex = PyList_GET_ITEM(extras_list, i);
+    if (memo_insert_one(m, PyList_GET_ITEM(rows, i),
+                        bits_base + size_t(i) * size_t(m->nb),
+                        ex == Py_None ? nullptr : ex) != 0)
+      return -1;
+    ++done;
+  }
+  return done;
 }
 
 // The steady-state hot pass. For each row of the batch:
@@ -905,6 +948,95 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
   }
   release_extras();
   return int64_t(miss_views.size());
+}
+
+// Enumerate set bits of a packed [nrows, nb] verdict plane (MSB-first
+// per byte, bit index = byte*8 + k, only indices < limit). Emits
+// (row, bit) pairs row-major into rs/ts; returns the pair count, or
+// -1 when more than cap pairs exist (caller re-calls with a bigger
+// buffer). One linear pass — replaces a numpy unpackbits+nonzero over
+// the whole plane in the walk's extraction enumeration.
+extern "C" int64_t sw_plane_bits(const uint8_t* plane, int64_t nrows,
+                                 int64_t nb, int64_t limit, int64_t* rs,
+                                 int64_t* ts, int64_t cap) {
+  int64_t n = 0;
+  const uint8_t* p = plane;
+  for (int64_t r = 0; r < nrows; ++r, p += nb) {
+    for (int64_t byte = 0; byte < nb; ++byte) {
+      uint8_t v = p[byte];
+      if (v == 0) continue;
+      int64_t base = byte * 8;
+      for (int k = 0; k < 8 && v != 0; ++k) {
+        uint8_t m = uint8_t(0x80u >> k);
+        if (!(v & m)) continue;
+        v = uint8_t(v & ~m);
+        int64_t t = base + k;
+        if (t >= limit) break;
+        if (n >= cap) return -1;
+        rs[n] = r;
+        ts[n] = t;
+        ++n;
+      }
+    }
+  }
+  return n;
+}
+
+// Extraction-pass driver: enumerate the set bits of the masked
+// extractor plane and resolve each hit template's ops against the
+// packed op-value/op-uncertainty planes (MSB-first bit convention
+// throughout, matching engine._bit). Emits ONLY the (row, template,
+// op, state) tuples that need Python work: state 1 = op certainly
+// true (run the extractors), state 2 = op undecided (resolve_op in
+// Python first). Certainly-false ops and row-dependent / redo-skipped
+// templates never surface. Row-major template order — identical to
+// the walk's original iteration. Returns the tuple count, -1 when cap
+// is too small.
+extern "C" int64_t sw_ext_resolve(
+    const uint8_t* masked, int64_t nrows, int64_t nb, int64_t limit,
+    const uint8_t* rowdep, const uint8_t* skip_rows, const int64_t* indptr,
+    const int64_t* opids, const uint8_t* pop_value, const uint8_t* pop_unc,
+    int64_t pop_nb, int64_t* bs, int64_t* ts, int64_t* ops, uint8_t* states,
+    int64_t cap) {
+  int64_t n = 0;
+  const uint8_t* p = masked;
+  for (int64_t r = 0; r < nrows; ++r, p += nb) {
+    if (skip_rows[r]) continue;
+    const uint8_t* pv = pop_value + r * pop_nb;
+    const uint8_t* pu = pop_unc + r * pop_nb;
+    for (int64_t byte = 0; byte < nb; ++byte) {
+      uint8_t v = p[byte];
+      if (v == 0) continue;
+      int64_t base = byte * 8;
+      for (int k = 0; k < 8 && v != 0; ++k) {
+        uint8_t mk = uint8_t(0x80u >> k);
+        if (!(v & mk)) continue;
+        v = uint8_t(v & ~mk);
+        int64_t t = base + k;
+        if (t >= limit) break;
+        if (rowdep[t]) continue;
+        for (int64_t oi = indptr[t]; oi < indptr[t + 1]; ++oi) {
+          int64_t op = opids[oi];
+          uint8_t bit = uint8_t(0x80u >> (op & 7));
+          uint8_t state;
+          if (pu[op >> 3] & bit) {
+            state = 2;  // undecided: Python resolve_op decides
+          } else if (pv[op >> 3] & bit) {
+            state = 1;  // certainly true: extract
+          } else {
+            continue;  // certainly false
+          }
+          if (n >= cap) return -1;
+          bs[n] = r;
+          ts[n] = t;
+          ops[n] = op;
+          states[n] = state;
+          ++n;
+        }
+      }
+    }
+  }
+  return n;
 }
 
 // Lengths-only pass (width selection happens between this and packing).

@@ -142,6 +142,21 @@ def ensure_fastpack() -> ctypes.PyDLL:
     lib.sw_memo_contains.restype = ctypes.c_int
     lib.sw_memo_insert.argtypes = [vp, ctypes.py_object, u8p, ctypes.py_object]
     lib.sw_memo_insert.restype = ctypes.c_int
+    lib.sw_memo_insert_batch.argtypes = [
+        vp, ctypes.py_object, u8p, u8p, ctypes.py_object,
+    ]
+    lib.sw_memo_insert_batch.restype = ctypes.c_int64
+    lib.sw_plane_bits.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, i64p, i64p,
+        ctypes.c_int64,
+    ]
+    lib.sw_plane_bits.restype = ctypes.c_int64
+    lib.sw_ext_resolve.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, u8p, u8p,
+        i64p, i64p, u8p, u8p, ctypes.c_int64, i64p, i64p, i64p, u8p,
+        ctypes.c_int64,
+    ]
+    lib.sw_ext_resolve.restype = ctypes.c_int64
     lib.sw_memo_lookup.argtypes = [
         vp, ctypes.py_object, u8p, i64p, i64p,
         ctypes.py_object, ctypes.py_object,
@@ -299,6 +314,48 @@ class VerdictMemo:
         if self._lib.sw_memo_insert(self._h, row, bits_row, extras) != 0:
             raise TypeError("memo insert failed")
 
+    def insert_batch(
+        self,
+        rows: list,
+        bits_plane: np.ndarray,
+        skip: np.ndarray,
+        extras_list: list,
+    ) -> int:
+        """Insert every non-skipped row of a walked plane in ONE native
+        call (row i's bits at ``bits_plane[i]``). ``extras_list[i]`` is
+        the (ment, mdef) tuple or None; validated here like
+        :meth:`insert`. Returns the inserted count."""
+        if len(rows) != len(extras_list) or len(rows) != len(skip):
+            raise ValueError("rows/skip/extras_list length mismatch")
+        if (
+            bits_plane.dtype != np.uint8
+            or bits_plane.ndim != 2
+            or bits_plane.shape[0] < len(rows)
+            or bits_plane.shape[1] != self.row_bytes
+        ):
+            raise ValueError(
+                f"bits_plane must be uint8 [>={len(rows)}, "
+                f"{self.row_bytes}]"
+            )
+        for extras in extras_list:
+            if extras is not None and not (
+                isinstance(extras, tuple)
+                and len(extras) == 2
+                and isinstance(extras[0], tuple)
+                and isinstance(extras[1], tuple)
+            ):
+                raise ValueError(
+                    "extras must be a (ment, mdef) tuple pair or None"
+                )
+        if not bits_plane.flags["C_CONTIGUOUS"]:
+            bits_plane = np.ascontiguousarray(bits_plane)
+        n = self._lib.sw_memo_insert_batch(
+            self._h, rows, bits_plane, skip, extras_list
+        )
+        if n < 0:
+            raise TypeError("memo batch insert failed")
+        return int(n)
+
     def contains(self, row) -> bool:
         rc = self._lib.sw_memo_contains(self._h, row)
         if rc < 0:
@@ -316,6 +373,56 @@ class VerdictMemo:
         if h:
             self._lib.sw_memo_free(h)
             self._h = None
+
+
+def plane_bits(plane: np.ndarray, limit: int):
+    """(rows, bits) index arrays of the set bits of a packed uint8
+    [n, nb] plane (MSB-first, bit < limit), row-major — one C pass."""
+    if not plane.flags["C_CONTIGUOUS"]:
+        plane = np.ascontiguousarray(plane)
+    lib = ensure_fastpack()
+    cap = max(256, 2 * int(np.count_nonzero(plane)) * 8)
+    while True:
+        rs = np.empty(cap, dtype=np.int64)
+        ts = np.empty(cap, dtype=np.int64)
+        n = lib.sw_plane_bits(
+            plane, plane.shape[0], plane.shape[1], limit, rs, ts, cap
+        )
+        if n >= 0:
+            return rs[:n], ts[:n]
+        cap *= 4
+
+
+def ext_resolve(
+    masked: np.ndarray,
+    limit: int,
+    rowdep: np.ndarray,
+    skip_rows: np.ndarray,
+    indptr: np.ndarray,
+    opids: np.ndarray,
+    pop_value: np.ndarray,
+    pop_unc: np.ndarray,
+):
+    """(rows, templates, op_ids, states) for every extractor-plane hit
+    whose op needs Python work — state 1 certainly-true (extract),
+    state 2 undecided (resolve first). One C pass (sw_ext_resolve)."""
+    for a in (masked, pop_value, pop_unc):
+        assert a.flags["C_CONTIGUOUS"], "planes must be contiguous"
+    lib = ensure_fastpack()
+    cap = max(256, 16 * int(np.count_nonzero(masked)))
+    while True:
+        bs = np.empty(cap, dtype=np.int64)
+        ts = np.empty(cap, dtype=np.int64)
+        ops = np.empty(cap, dtype=np.int64)
+        states = np.empty(cap, dtype=np.uint8)
+        n = lib.sw_ext_resolve(
+            masked, masked.shape[0], masked.shape[1], limit, rowdep,
+            skip_rows, indptr, opids, pop_value, pop_unc,
+            pop_value.shape[1], bs, ts, ops, states, cap,
+        )
+        if n >= 0:
+            return bs[:n], ts[:n], ops[:n], states[:n]
+        cap *= 4
 
 
 def rows_alive(rows: list) -> "tuple[int, np.ndarray]":

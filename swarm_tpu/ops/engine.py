@@ -26,6 +26,7 @@ nmap/-sV//nuclei (``worker/worker.py:79-84``) as the compute engine.
 from __future__ import annotations
 
 import dataclasses
+import re
 import time
 from typing import Optional, Sequence
 
@@ -33,7 +34,7 @@ import numpy as np
 
 from swarm_tpu.fingerprints.compile import CompiledDB, compile_corpus
 from swarm_tpu.fingerprints.model import Response, Template
-from swarm_tpu.ops import cpu_ref
+from swarm_tpu.ops import cpu_ref, fastre
 from swarm_tpu.ops.encoding import encode_batch, round_up
 from swarm_tpu.ops.match import DeviceDB
 
@@ -83,6 +84,14 @@ class EngineStats:
     # memo-served ROW count, summed per batch (rows whose verdict came
     # from the cross-batch memo without device or walk work)
     memo_slots: int = 0
+    # host-walk sub-phases (all included in host_confirm_seconds):
+    # uncertainty resolution, the extraction pass, memo inserts, and
+    # the member fan-out/fixup assembly — the levers the fresh-content
+    # optimization work tracks individually
+    unc_seconds: float = 0.0
+    ext_seconds: float = 0.0
+    insert_seconds: float = 0.0
+    fixup_seconds: float = 0.0
 
 
 def _bit(packed: np.ndarray, b: int, i: int) -> bool:
@@ -235,11 +244,34 @@ class MatchEngine:
         self._ext_masks = (
             0x80 >> (self._ext_cols & 7)
         ).astype(np.uint8) if len(self._ext_cols) else np.zeros(0, np.uint8)
+        # packed byte mask of extractor-template bits: the ext pass
+        # ANDs it against the verdict plane (contiguous, one pass)
+        # instead of a [B, n_ext] fancy-gather per batch
+        self._ext_byte_mask = np.zeros(
+            (db.num_templates + 7) // 8, dtype=np.uint8
+        )
+        for t_idx in self._ext_t_idx:
+            self._ext_byte_mask[t_idx >> 3] |= 0x80 >> (t_idx & 7)
         # vectorized per-op matcher-id tables: resolving a giant op
         # (fingerprinthub: 2,897 matchers) must not walk bits in Python
         self._op_m_arr = [
             np.asarray(ids, dtype=np.int64) for ids in db.op_matchers
         ]
+        # pre-shifted byte/bit index twins (the uncertain-op gather
+        # runs per (row, op) — recomputing ids>>3 / 7-(ids&7) there
+        # allocated three temporaries per pair)
+        self._op_m_bytes = [ids >> 3 for ids in self._op_m_arr]
+        self._op_m_shift = [
+            (7 - (ids & 7)).astype(np.uint8) for ids in self._op_m_arr
+        ]
+        # python-native twins of the per-template op tables: the walk's
+        # inner loops hash (row, op) keys and index bit planes with
+        # these, and numpy int scalars make every such op ~3x slower
+        self._t_ops_py = [
+            tuple(int(o) for o in ops) for ops in db.t_ops
+        ]
+        self._op_prefilter_py = [bool(x) for x in db.op_prefilter]
+        self._op_cond_and_py = [bool(x) for x in db.op_cond_and]
         # content-keyed extraction memo (cross-batch): scan responses
         # repeat heavily (default pages are byte-identical fleet-wide)
         # and tech templates with version extractors fire on most rows,
@@ -282,6 +314,17 @@ class MatchEngine:
         self._rowdep_ext_t = [
             t_idx for t_idx in self._ext_t_idx if t_idx in self._rowdep_t
         ]
+        # CSR twin of t_ops + rowdep byte mask for the C extraction
+        # driver (native/fastpack.cpp sw_ext_resolve)
+        self._t_ops_indptr = np.zeros(db.num_templates + 1, dtype=np.int64)
+        for i, ops in enumerate(self._t_ops_py):
+            self._t_ops_indptr[i + 1] = self._t_ops_indptr[i] + len(ops)
+        self._t_ops_flat = np.asarray(
+            [o for ops in self._t_ops_py for o in ops], dtype=np.int64
+        )
+        self._rowdep_mask = np.zeros(db.num_templates, dtype=np.uint8)
+        for i in self._rowdep_t:
+            self._rowdep_mask[i] = 1
 
     _EXT_CACHE_MAX = 16384
 
@@ -308,10 +351,124 @@ class MatchEngine:
                 continue
             vals = cache.get(key)
             if vals is None:
-                vals = cpu_ref.extract_one(ex, row)
+                if ex.type == "regex":
+                    vals = self._accel_extract_regex(ex, key[1])
+                else:
+                    vals = cpu_ref.extract_one(ex, row)
                 self._cache_put(cache, key, vals)
             out.extend(vals)
         return out
+
+    @staticmethod
+    def _accel_extract_regex(ex, part: bytes) -> list:
+        """Candidate-anchored regex extraction — byte-identical to
+        cpu_ref.extract_one for type=regex (fuzz-pinned by
+        tests/test_fastre.py); patterns the accelerator can't take
+        fall back to the oracle's finditer loop per pattern."""
+        out: list = []
+        text = None
+        for pattern in ex.regex:
+            if text is None:
+                text = part.decode("latin-1")
+            vals = fastre.finditer_values(pattern, part, text, ex.group)
+            if vals is not None:
+                out.extend(vals)
+                continue
+            # fallback mirrors cpu_ref.extract_one exactly
+            try:
+                for m in cpu_ref._compile_cached(pattern).finditer(text):
+                    try:
+                        out.append(m.group(ex.group))
+                    except IndexError:
+                        out.append(m.group(0))
+            except re.error:
+                continue
+        return out
+
+    def _confirm_operation(self, op, row: Response) -> bool:
+        """Exactly ``cpu_ref.match_operation(op, row)[0]`` with the
+        part-keyed confirm cache and regex prefilter applied per
+        matcher — the superset-lowered ops route here, where the slow
+        literal-less regexes (waf-detect's cloudfront backtracker)
+        otherwise re-scan every confirm."""
+        verdicts = []
+        cache = self._confirm_cache
+        for matcher in op.matchers:
+            if matcher.type in ("word", "regex", "binary", "size"):
+                key = (id(matcher), row.part(matcher.part))
+                v = cache.get(key)
+                if v is None:
+                    raw = (
+                        self._regex_matcher_raw(matcher, key[1])
+                        if matcher.type == "regex"
+                        else None
+                    )
+                    if raw is not None:
+                        v = (not raw) if matcher.negative else raw
+                    else:
+                        mv = cpu_ref.match_matcher(matcher, row)
+                        v = bool(mv) if mv is not None else False
+                    self._cache_put(cache, key, v)
+            else:
+                mv = cpu_ref.match_matcher(matcher, row)
+                v = bool(mv) if mv is not None else False
+            verdicts.append(v)
+        if not verdicts:
+            return False
+        if op.matchers_condition == "and":
+            return all(verdicts)
+        return any(verdicts)
+
+    def _redo_template(self, template, row: Response):
+        """``(matched, extractions)`` — exactly the fields the redo
+        pass reads from ``cpu_ref.match_template``, evaluated through
+        the prefiltered+cached op/extract paths (identical semantics:
+        _confirm_operation ≡ match_operation[0], _extract_op ≡ the
+        oracle's extractor loop with content memoization)."""
+        if not row.alive:
+            return False, []
+        matched = False
+        extractions: list = []
+        for op in template.operations:
+            if self._confirm_operation(op, row):
+                matched = True
+                extractions.extend(self._extract_op(op, row))
+        return matched, extractions
+
+    def _regex_matcher_raw(self, matcher, part: bytes):
+        """The EXACT raw (pre-negation) verdict of a regex matcher over
+        ``part`` — each pattern decided by the cheapest sound means:
+        required-literal absence (bytes.find), candidate-anchored
+        search (ops/fastre.py), or a real ``re.search`` when neither
+        proof applies — with short-circuit under the matcher condition.
+        Returns None when any pattern fails to compile (the oracle's
+        unsupported semantics; caller must fall back)."""
+        if not matcher.regex:
+            return None
+        infos = [fastre.analyze(p) for p in matcher.regex]
+        if not all(i.ok for i in infos):
+            return None
+        lowered = None
+        text = None
+        want_all = matcher.condition == "and"
+        for p, info in zip(matcher.regex, infos):
+            if info.literals:
+                if lowered is None:
+                    lowered = part.lower()
+                if fastre.literals_absent(info, lowered):
+                    if want_all:
+                        return False
+                    continue
+            if text is None:
+                text = part.decode("latin-1")
+            v = fastre.search_bool(p, part, text)
+            if v is None:
+                v = info.rex.search(text) is not None
+            if v and not want_all:
+                return True
+            if not v and want_all:
+                return False
+        return want_all
 
     # ------------------------------------------------------------------
     def match(self, responses: Sequence[Response]) -> list[RowMatches]:
@@ -614,10 +771,27 @@ class MatchEngine:
             key = (m_id, row.part(matcher.part))
             v = part_cache.get(key)
             if v is None:
-                mv = cpu_ref.match_matcher(matcher, row)
-                v = bool(mv) if mv is not None else False
+                # exact per-pattern evaluation with literal/candidate
+                # proofs: most confirms are q-gram collisions whose
+                # slow regex (waf-detect's ~2 ms backtrackers)
+                # certainly can't match — those are decided at
+                # bytes.find speed; unproven patterns get a real
+                # re.search. Negation mirrors cpu_ref.match_matcher.
+                raw = (
+                    self._regex_matcher_raw(matcher, key[1])
+                    if matcher.type == "regex"
+                    else None
+                )
+                if raw is not None:
+                    v = (not raw) if matcher.negative else raw
+                else:
+                    mv = cpu_ref.match_matcher(matcher, row)
+                    v = bool(mv) if mv is not None else False
                 self._cache_put(part_cache, key, v)
             return v
+
+        op_prefilter = self._op_prefilter_py
+        op_cond_and = self._op_cond_and_py
 
         def resolve_op(b: int, op_id: int, row: Response) -> bool:
             key = (b, op_id)
@@ -626,24 +800,29 @@ class MatchEngine:
                 return v
             if not _bit(pop_unc, b, op_id):
                 v = _bit(pop_value, b, op_id)
-            elif db.op_prefilter[op_id]:
+            elif op_prefilter[op_id]:
                 # superset-lowered op: per-matcher bits are weakened, so
-                # fired rows re-run the whole op on the oracle
-                v = cpu_ref.match_operation(self._op_obj[op_id], row)[0]
+                # fired rows re-run the whole op (prefiltered + cached
+                # per matcher — semantics identical to the oracle's
+                # match_operation)
+                v = self._confirm_operation(self._op_obj[op_id], row)
                 confirms[b] = confirms.get(b, 0) + 1
                 self.stats.host_confirm_pairs += 1
             else:
                 # undecided ⇒ certain matchers are neutral; combine the
                 # uncertain ones' exact values under the op condition
                 ids = self._op_m_arr[op_id]
-                bits = (pm_unc[b, ids >> 3] >> (7 - (ids & 7))) & 1
+                bits = (
+                    pm_unc[b, self._op_m_bytes[op_id]]
+                    >> self._op_m_shift[op_id]
+                ) & 1
                 vals = [
                     confirm_matcher(int(m_id), row)
                     for m_id in ids[bits.astype(bool)]
                 ]
                 confirms[b] = confirms.get(b, 0) + len(vals)
                 self.stats.host_confirm_pairs += len(vals)
-                v = all(vals) if db.op_cond_and[op_id] else any(vals)
+                v = all(vals) if op_cond_and[op_id] else any(vals)
             op_cache[key] = v
             return v
 
@@ -658,89 +837,135 @@ class MatchEngine:
         # row-dependent ones run per member in the fixup pass below ---
         redo_rows = np.flatnonzero(row_redo)
         uredo_extractions: dict = {}  # (new-subset pos, tid) -> values
-        for b in redo_rows:
+        for b in redo_rows.tolist():
             row = nrows[b]
             rowbits = np.zeros((pt_value.shape[1],), dtype=np.uint8)
             for t_idx, template in enumerate(db.templates):
                 if t_idx in rowdep:
-                    deferred.append((int(b), t_idx))
+                    deferred.append((b, t_idx))
                     continue
-                res = cpu_ref.match_template(template, row)
+                res_matched, res_ext = self._redo_template(template, row)
                 confirms[b] = confirms.get(b, 0) + 1
                 self.stats.host_confirm_pairs += 1
-                if res.matched:
+                if res_matched:
                     rowbits[t_idx >> 3] |= 0x80 >> (t_idx & 7)
-                    if res.extractions:
-                        uredo_extractions[(int(b), template.id)] = (
-                            res.extractions
-                        )
+                    if res_ext:
+                        uredo_extractions[(b, template.id)] = res_ext
             pt_value[b] = rowbits
 
         # --- sparse uncertainty resolution (unique plane) ---
-        if not row_redo.all() and pt_unc.any():
+        t_unc = time.perf_counter()
+        if not row_redo.all():
             skip = set(redo_rows.tolist())
-            for b, byte_i in np.argwhere(pt_unc):
+            if self._use_native_memo():
+                from swarm_tpu.native.scanio import plane_bits
+
+                ub, ut = plane_bits(np.ascontiguousarray(pt_unc), NT)
+                pairs = zip(ub.tolist(), ut.tolist())
+            else:
+                pairs = (
+                    (b, byte_i * 8 + k)
+                    for b, byte_i in np.argwhere(pt_unc).tolist()
+                    for k in range(8)
+                    if (int(pt_unc[b, byte_i]) & (0x80 >> k))
+                    and byte_i * 8 + k < NT
+                )
+            for b, t_idx in pairs:
                 if b in skip:
                     continue
-                v = int(pt_unc[b, byte_i])
+                byte_i = t_idx >> 3
+                mask = 0x80 >> (t_idx & 7)
                 row = nrows[b]
-                base = int(byte_i) * 8
-                for k in range(8):
-                    if not (v & (0x80 >> k)):
-                        continue
-                    t_idx = base + k
-                    if t_idx >= NT:
-                        continue
-                    mask = 0x80 >> (t_idx & 7)
-                    if t_idx in rowdep:
-                        # undecided row-dependent template: content-
-                        # identical rows can disagree here — decide per
-                        # member below; clear the broadcast bit
-                        deferred.append((int(b), t_idx))
-                        pt_value[b, byte_i] &= 0xFF ^ mask
-                        continue
-                    # undecided ⇒ no certain-true op; OR over the
-                    # uncertain ops' exact values decides the template
-                    hit = False
-                    for op_id in db.t_ops[t_idx]:
-                        if _bit(pop_unc, b, op_id) and resolve_op(
-                            b, op_id, row
-                        ):
-                            hit = True
-                            break
-                    if hit:
-                        pt_value[b, byte_i] |= mask
-                    else:
-                        pt_value[b, byte_i] &= 0xFF ^ mask
+                if t_idx in rowdep:
+                    # undecided row-dependent template: content-
+                    # identical rows can disagree here — decide per
+                    # member below; clear the broadcast bit
+                    deferred.append((b, t_idx))
+                    pt_value[b, byte_i] &= 0xFF ^ mask
+                    continue
+                # undecided ⇒ no certain-true op; OR over the
+                # uncertain ops' exact values decides the template
+                hit = False
+                for op_id in self._t_ops_py[t_idx]:
+                    if _bit(pop_unc, b, op_id) and resolve_op(
+                        b, op_id, row
+                    ):
+                        hit = True
+                        break
+                if hit:
+                    pt_value[b, byte_i] |= mask
+                else:
+                    pt_value[b, byte_i] &= 0xFF ^ mask
 
         # --- extraction pass (unique plane): only extractor templates,
         # only hit rows (one vectorized gather over all extractor
         # columns at once — a Python loop over ~600 extractor templates
         # costs more than the actual extractions). Row-dependent
         # templates are handled in the member fixup pass ---
+        t_ext = time.perf_counter()
+        self.stats.unc_seconds += t_ext - t_unc
         uextractions: dict = dict(uredo_extractions)
         redo_set = set(redo_rows.tolist())
         if len(self._ext_cols):
-            hit_mat = (
-                pt_value[:, self._ext_cols >> 3]
-                & self._ext_masks[None, :]
-            ) != 0  # [B, n_ext]
-            for b, e in np.argwhere(hit_mat):
-                if int(b) in redo_set:
-                    continue  # oracle already extracted above
-                t_idx = int(self._ext_cols[e])
-                if t_idx in rowdep:
-                    continue
-                row = nrows[b]
-                parts: list = []
-                for op_id in db.t_ops[t_idx]:
-                    if resolve_op(b, op_id, row):
-                        parts.extend(
-                            self._extract_op(self._op_obj[op_id], row)
-                        )
-                if parts:
-                    uextractions[(int(b), db.template_ids[t_idx])] = parts
+            emask = self._ext_byte_mask
+            masked = pt_value[:, : len(emask)] & emask[None, :]
+            tids = db.template_ids
+            if self._use_native_memo():
+                # one C pass enumerates the extractor-plane hits AND
+                # resolves op certainty against the packed planes —
+                # Python touches only ops that are certainly-true
+                # (extract) or undecided (resolve_op), in the same
+                # (b-major, t, op) order the Python loop used
+                from swarm_tpu.native.scanio import ext_resolve
 
+                skip_rows = np.zeros(len(nrows), dtype=np.uint8)
+                for rb in redo_set:
+                    skip_rows[rb] = 1
+                bs, ts, opsv, sts = ext_resolve(
+                    masked, NT, self._rowdep_mask, skip_rows,
+                    self._t_ops_indptr, self._t_ops_flat,
+                    np.ascontiguousarray(pop_value),
+                    np.ascontiguousarray(pop_unc),
+                )
+                cur = None
+                parts: list = []
+                for b, t_idx, op_id, st in zip(
+                    bs.tolist(), ts.tolist(), opsv.tolist(), sts.tolist()
+                ):
+                    if cur != (b, t_idx):
+                        if parts:
+                            uextractions[(cur[0], tids[cur[1]])] = parts
+                        cur = (b, t_idx)
+                        parts = []
+                    row = nrows[b]
+                    if st == 2 and not resolve_op(b, op_id, row):
+                        continue
+                    parts.extend(
+                        self._extract_op(self._op_obj[op_id], row)
+                    )
+                if parts:
+                    uextractions[(cur[0], tids[cur[1]])] = parts
+            else:
+                hit_b, hit_t = np.nonzero(
+                    np.unpackbits(masked, axis=1, count=NT)
+                )
+                t_ops = self._t_ops_py
+                for b, t_idx in zip(hit_b.tolist(), hit_t.tolist()):
+                    if b in redo_set:
+                        continue  # oracle already extracted above
+                    if t_idx in rowdep:
+                        continue
+                    row = nrows[b]
+                    parts = []
+                    for op_id in t_ops[t_idx]:
+                        if resolve_op(b, op_id, row):
+                            parts.extend(
+                                self._extract_op(self._op_obj[op_id], row)
+                            )
+                    if parts:
+                        uextractions[(b, tids[t_idx])] = parts
+
+        self.stats.ext_seconds += time.perf_counter() - t_ext
         self.stats.host_confirm_seconds += time.perf_counter() - t1
         return (
             pt_value,
@@ -1008,28 +1233,38 @@ class MatchEngine:
             # memo inserts for fully-resolved content (deep-frozen
             # extras — callers receive thawed list copies, so a
             # caller's in-place edit can never rewrite the cache;
-            # truncated/overflow positions are never stored)
-            for pos in range(B):
-                if pos in redo_pos:
-                    continue
+            # truncated/overflow positions are never stored). One
+            # native call inserts the whole walked plane.
+            t_ins = time.perf_counter()
+            skip = np.zeros(B, dtype=np.uint8)
+            for pos in redo_pos:
+                skip[pos] = 1
+            extras_list: list = [None] * B
+            for pos in ext_by_pos.keys() | def_by_pos.keys():
                 ment = tuple(
                     (tid, tuple(vals))
                     for tid, vals in ext_by_pos.get(pos, ())
                 )
                 mdef = tuple(def_by_pos.get(pos, ()))
-                self._vmemo.insert(
-                    nrows[pos],
-                    np.ascontiguousarray(pt_value[pos]),
-                    (ment, mdef) if (ment or mdef) else None,
-                )
-            # member fan-out over miss rows (lazy argsort grouping)
-            order = np.argsort(state, kind="stable")
-            sorted_state = state[order]
+                if ment or mdef:
+                    extras_list[pos] = (ment, mdef)
+            self._vmemo.insert_batch(nrows, pt_value[:B], skip, extras_list)
+            ins_dt = time.perf_counter() - t_ins
+            self.stats.insert_seconds += ins_dt
+            # member fan-out over miss rows. Fresh-content batches
+            # (every row a unique miss) skip the argsort grouping —
+            # slot s's only member is miss_uniq[s].
+            if len(miss_uniq) == len(rows):
+                def members_of(pos: int) -> tuple:
+                    return (miss_uniq[pos],)
+            else:
+                order = np.argsort(state, kind="stable")
+                sorted_state = state[order]
 
-            def members_of(pos: int) -> list:
-                lo = np.searchsorted(sorted_state, pos)
-                hi = np.searchsorted(sorted_state, pos + 1)
-                return order[lo:hi].tolist()
+                def members_of(pos: int) -> list:
+                    lo = np.searchsorted(sorted_state, pos)
+                    hi = np.searchsorted(sorted_state, pos + 1)
+                    return order[lo:hi].tolist()
 
             for (pos, tid), vals in uext.items():
                 for i in members_of(int(pos)):
@@ -1042,6 +1277,7 @@ class MatchEngine:
                 miss_uniq[pos]: n for pos, n in confirms.items()
             }
         else:
+            ins_dt = 0.0
             t1 = time.perf_counter()
             self.stats.memo_slots += int((state == -1).sum())
         # extras served by the memo arrive ALREADY applied by the C
@@ -1084,7 +1320,12 @@ class MatchEngine:
         host_always_matches = self._host_always_tail(
             rows, extractions, dead_state=state
         )
-        self.stats.host_confirm_seconds += time.perf_counter() - t1
+        now = time.perf_counter()
+        # the insert window sits inside t1..now but is attributed to
+        # insert_seconds — exclude it so the sub-phases sum to the
+        # host_confirm total instead of double-counting
+        self.stats.fixup_seconds += now - t1 - ins_dt
+        self.stats.host_confirm_seconds += now - t1
         return PackedMatches(
             bits=bits,
             template_ids=db.template_ids,

@@ -14,21 +14,31 @@ Axes (the scan-workload analogs of ML parallelism, SURVEY.md §2.4):
 
 Pipeline/expert axes have no analog here (no layered weights, no
 experts) — the reference likewise has nothing to shard (SURVEY.md §2.4).
+
+Topology awareness: the communicating axes (``model``'s psum,
+``seq``'s ppermute ring) should each ride ONE physical ICI axis of the
+slice, not straddle the torus. ``make_mesh`` therefore reads the slice
+shape — from each device's ``.coords`` (real TPU runtimes expose the
+physical mesh coordinate) or the ``SWARM_SLICE_SHAPE`` env hint (e.g.
+``"4x2x2"``, for simulated/CPU meshes) — and lays devices out so every
+mesh axis is a contiguous physical axis (or a product of whole axes,
+for ``data``, which never communicates). Without topology information
+the previous pure-arithmetic split is the fallback.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
-import jax
 import numpy as np
-from jax.sharding import Mesh
 
 AXES = ("data", "model", "seq")
 
 
 def factor_devices(n: int) -> tuple[int, int, int]:
-    """Split n devices into (data, model, seq) — favor data, then model."""
+    """Split n devices into (data, model, seq) — favor data, then
+    model. The topology-blind fallback (no coords, no env hint)."""
     if n <= 1:
         return (1, 1, 1)
     seq = 2 if n % 2 == 0 and n >= 8 else 1
@@ -38,12 +48,119 @@ def factor_devices(n: int) -> tuple[int, int, int]:
     return (data, model, seq)
 
 
+def slice_layout(
+    phys: tuple[int, ...]
+) -> tuple[tuple[int, int, int], tuple[int, ...]]:
+    """Map a physical slice shape onto the (data, model, seq) mesh.
+
+    Returns ``(mesh_shape, axis_perm)``: ``axis_perm`` orders the
+    physical axes as (data..., model, seq) so that a transpose+reshape
+    of the coordinate-ordered device grid keeps each communicating
+    mesh axis on ONE physical ICI axis.
+
+    Policy: the two *smallest* >1 physical axes carry the
+    communicating meshes — ``model`` (psum, the heavier collective)
+    gets the larger of the two, ``seq`` the smaller — and everything
+    else multiplies into ``data`` (no communication, so straddling
+    axes is free). Examples: v4-8 slice (2,2,1) → (2, 2, 1);
+    v4-32 (4,2,2) → (4, 2, 2); v5e-16 (4,4) → (4, 4, 1).
+    """
+    dims = [(d, i) for i, d in enumerate(phys)]
+    nontrivial = sorted((d, i) for d, i in dims if d > 1)
+    model = seq = None
+    if len(nontrivial) >= 3:
+        # two smallest carry comm; model = the larger of those two
+        seq = nontrivial[0]
+        model = nontrivial[1]
+    elif len(nontrivial) == 2:
+        model = nontrivial[0]
+    elif len(nontrivial) == 1:
+        # a 1-D slice: everything is one ring; keep it all data
+        pass
+    data_axes = [
+        i for _d, i in dims
+        if (model is None or i != model[1]) and (seq is None or i != seq[1])
+    ]
+    perm = tuple(
+        data_axes
+        + ([model[1]] if model else [])
+        + ([seq[1]] if seq else [])
+    )
+    data = 1
+    for i in data_axes:
+        data *= phys[i]
+    shape = (data, model[0] if model else 1, seq[0] if seq else 1)
+    return shape, perm
+
+
+def _env_slice_shape() -> Optional[tuple[int, ...]]:
+    raw = os.environ.get("SWARM_SLICE_SHAPE", "").strip().lower()
+    if not raw:
+        return None
+    try:
+        dims = tuple(int(p) for p in raw.replace("*", "x").split("x"))
+    except ValueError:
+        return None
+    return dims if dims and all(d >= 1 for d in dims) else None
+
+
+def detect_slice_shape(devices: Sequence) -> Optional[tuple[int, ...]]:
+    """Physical slice shape: env hint first, else device ``.coords``
+    (present on real TPU devices). None when neither is available or
+    the information doesn't cover exactly these devices."""
+    env = _env_slice_shape()
+    if env is not None:
+        n = 1
+        for d in env:
+            n *= d
+        return env if n == len(devices) else None
+    coords = [getattr(d, "coords", None) for d in devices]
+    if any(c is None for c in coords):
+        return None
+    arr = np.asarray(coords)
+    if arr.ndim != 2:
+        return None
+    shape = tuple(int(m) + 1 for m in arr.max(axis=0))
+    n = 1
+    for d in shape:
+        n *= d
+    # coords must tile the box exactly once (multi-core-per-chip
+    # runtimes repeat coords; that layout needs the env hint instead)
+    if n != len(devices) or len({tuple(c) for c in coords}) != n:
+        return None
+    return shape
+
+
+def _grid_order(devices: Sequence, phys: tuple[int, ...]) -> list:
+    """Devices ordered so reshaping to ``phys`` aligns with physical
+    coordinates (row-major over coords when present, else given
+    order)."""
+    coords = [getattr(d, "coords", None) for d in devices]
+    if any(c is None for c in coords):
+        return list(devices)
+    pairs = zip([tuple(c) for c in coords], devices)
+    return [d for _c, d in sorted(pairs, key=lambda t: t[0])]
+
+
 def make_mesh(
     shape: Optional[tuple[int, int, int]] = None,
     devices: Optional[Sequence] = None,
-) -> Mesh:
+):
+    import jax
+    from jax.sharding import Mesh
+
     devices = list(devices if devices is not None else jax.devices())
     if shape is None:
+        phys = detect_slice_shape(devices)
+        if phys is not None:
+            mesh_shape, perm = slice_layout(phys)
+            grid = np.array(
+                _grid_order(devices, phys), dtype=object
+            ).reshape(phys)
+            arr = np.ascontiguousarray(grid.transpose(perm)).reshape(
+                mesh_shape
+            )
+            return Mesh(arr, AXES)
         shape = factor_devices(len(devices))
     data, model, seq = shape
     count = data * model * seq

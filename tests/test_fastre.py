@@ -1,0 +1,191 @@
+"""fastre equivalence fuzz: the candidate-anchored accelerator must be
+EXACTLY Python-re over the whole reference regex population — the host
+walk's exactness contract rides on it (engine._extract_op /
+_regex_certainly_false).
+
+Reference workload: /root/reference/worker/artifacts/templates
+extraction+matcher regexes (falls back to the bundled test corpus)."""
+
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from swarm_tpu.ops import fastre
+
+REFERENCE_CORPUS = Path("/root/reference/worker/artifacts/templates")
+BUNDLED_CORPUS = Path(__file__).parent / "data" / "templates"
+
+
+def corpus_patterns(limit=4000):
+    corpus = REFERENCE_CORPUS if REFERENCE_CORPUS.is_dir() else BUNDLED_CORPUS
+    from swarm_tpu.fingerprints.nuclei import load_corpus
+
+    templates, _errors = load_corpus(corpus)
+    pats: list = []
+    seen = set()
+    for t in templates:
+        for op in t.operations:
+            for m in op.matchers:
+                for p in m.regex:
+                    if p not in seen:
+                        seen.add(p)
+                        pats.append(p)
+            for ex in op.extractors:
+                for p in getattr(ex, "regex", ()) or ():
+                    if p not in seen:
+                        seen.add(p)
+                        pats.append(p)
+    return pats[:limit]
+
+
+def sample_texts():
+    rng = np.random.default_rng(99)
+    texts = [
+        b"",
+        b"<html><head><title>Welcome to nginx!</title></head><body></body></html>",
+        b"HTTP/1.1 200 OK\r\nServer: Apache/2.4.41 (Ubuntu)\r\n"
+        b"Set-Cookie: sid=abc; path=/\r\nContent-Type: text/html\r\n\r\n",
+        b"User-agent: *\nDisallow: /admin\nAllow: /public/index.php\n",
+        b"d2h5IGhlbGxv bG9uZyBiYXNlNjQ= 10.2.3.4 2026-07-31 v1.2.3-rc",
+        b"<meta name=\"generator\" content=\"WordPress 6.2\">wp-content/x",
+        b"xx.cloudfront.net CloudFront distribution d111111abcdef8",
+        b"\x00\x01\xff\xfe binary\x0abytes\x0d\x0a\x80\x90",
+        bytes(rng.integers(0, 256, size=512, dtype=np.uint8)),
+        bytes(rng.integers(32, 127, size=2048, dtype=np.uint8)),
+    ]
+    # latin-1 upper half + newline-dense + repeated structure
+    texts.append(bytes(range(256)) * 4)
+    texts.append(b"\n".join([b"/path%d sub" % i for i in range(40)]))
+    return texts
+
+
+@pytest.mark.parametrize("group", [0, 1])
+def test_finditer_values_matches_re_over_corpus(group):
+    pats = corpus_patterns()
+    texts = sample_texts()
+    assert pats, "no corpus regexes found"
+    accelerated = 0
+    for p in pats:
+        info = fastre.analyze(p)
+        if not info.ok:
+            continue
+        rex = info.rex
+        for data in texts:
+            text = data.decode("latin-1")
+            got = fastre.finditer_values(p, data, text, group)
+            if got is None:
+                continue
+            accelerated += 1
+            want = []
+            for m in rex.finditer(text):
+                try:
+                    want.append(m.group(group))
+                except IndexError:
+                    want.append(m.group(0))
+            assert got == want, (p, data[:80])
+    assert accelerated > 1000, f"accelerator covered only {accelerated} runs"
+
+
+def test_search_bool_matches_re_over_corpus():
+    pats = corpus_patterns()
+    texts = sample_texts()
+    for p in pats:
+        info = fastre.analyze(p)
+        if not info.ok:
+            continue
+        for data in texts:
+            text = data.decode("latin-1")
+            got = fastre.search_bool(p, data, text)
+            if got is None:
+                continue
+            assert got == (info.rex.search(text) is not None), (p, data[:80])
+
+
+def test_literals_absent_is_sound_over_corpus():
+    """literals_absent=True must imply re.search finds nothing."""
+    pats = corpus_patterns()
+    texts = sample_texts()
+    proved = 0
+    for p in pats:
+        info = fastre.analyze(p)
+        if not info.ok or not info.literals:
+            continue
+        for data in texts:
+            if fastre.literals_absent(info, data.lower()):
+                proved += 1
+                assert info.rex.search(data.decode("latin-1")) is None, p
+    assert proved > 500
+
+
+def test_salted_fresh_content_shapes():
+    """The bench's fresh-content shape: per-row salt prefix + realistic
+    body; run every corpus pattern both ways on a few of them."""
+    rng = np.random.default_rng(7)
+    bodies = []
+    for base in (
+        b"<html><title>404 Not Found</title><center>nginx</center></html>",
+        b"<script>window.grafanaBootData={settings:{buildInfo:"
+        b"{version:\"9.1.0\"}}}</script>",
+    ):
+        salt = bytes(rng.integers(97, 123, size=48, dtype=np.uint8))
+        bodies.append(b"<!-- " + salt + b" -->" + base)
+    for p in corpus_patterns(limit=800):
+        info = fastre.analyze(p)
+        if not info.ok:
+            continue
+        for data in bodies:
+            text = data.decode("latin-1")
+            got = fastre.finditer_values(p, data, text, 1)
+            if got is None:
+                continue
+            want = []
+            for m in info.rex.finditer(text):
+                try:
+                    want.append(m.group(1))
+                except IndexError:
+                    want.append(m.group(0))
+            assert got == want, p
+
+
+HAND_CASES = [
+    # (pattern, text) — edges: anchors, ci scopes, branches, classes,
+    # repeats, boundary effects at ends, overlapping candidates
+    (r"\s(/[a-z]+)", " /abc /def x/y "),
+    (r"(?i)FooBar", "xxfOoBaRxx"),
+    (r"(?i)FooBar", "nothing here"),
+    (r"(a|b)c", "zacbcac"),
+    (r"ab*", "abbbab"),
+    (r"(?:na)+", "banananana"),
+    (r"x$", "x\nyx"),
+    (r"^x", "xy\nx"),
+    (r"\bword\b", "a word, words"),
+    (r"[0-9]{2,4}px", "12px 12345px 1px"),
+    (r"a.c", "a\nc abc"),
+    (r"(?s)a.c", "a\nc abc"),
+    (r"(?s:.end)", "x\nend y"),       # scoped DOTALL reaches '.'
+    (r"(?s)(?-s:.end)", "x\nend y"),  # scoped removal too
+
+    (r"/([^/]+)/", "/a//b/ /c/"),
+    (r"zz", "z" * 100),
+    (r"(?m)^/", "a\n/b\n/c"),
+]
+
+
+@pytest.mark.parametrize("pattern,text", HAND_CASES)
+def test_hand_cases(pattern, text):
+    data = text.encode("latin-1")
+    rex = re.compile(pattern)
+    got_b = fastre.search_bool(pattern, data, text)
+    if got_b is not None:
+        assert got_b == (rex.search(text) is not None), pattern
+    got_f = fastre.finditer_values(pattern, data, text, 1)
+    if got_f is not None:
+        want = []
+        for m in rex.finditer(text):
+            try:
+                want.append(m.group(1))
+            except IndexError:
+                want.append(m.group(0))
+        assert got_f == want, pattern

@@ -1,0 +1,104 @@
+"""Topology-aware mesh layout (parallel/mesh.py).
+
+The communicating axes (model psum, seq ppermute ring) must each sit
+on ONE physical ICI axis of the slice; data (no communication) soaks
+up the rest. Reference scale-out analog: one worker drives a whole
+slice instead of the reference's droplet-per-chunk fleet
+(/root/reference/server/server.py:465-515)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from swarm_tpu.parallel import mesh as M
+
+
+@dataclasses.dataclass(frozen=True)
+class FakeDev:
+    id: int
+    coords: tuple
+
+
+def grid(shape):
+    devs = []
+    for i, c in enumerate(np.ndindex(*shape)):
+        devs.append(FakeDev(id=i, coords=tuple(c)))
+    return devs
+
+
+@pytest.mark.parametrize(
+    "phys,expect",
+    [
+        ((2, 2, 1), (2, 2, 1)),    # v4-8 slice: data x model
+        ((4, 2, 2), (4, 2, 2)),    # v4-32: both comm axes physical
+        ((2, 2, 2), (2, 2, 2)),    # cube: data gets one axis
+        ((4, 4), (4, 4, 1)),       # v5e-16 2-D slice
+        ((8, 1, 1), (8, 1, 1)),    # 1-D ring: all data
+        ((4, 8, 4), (8, 4, 4)),    # data takes the largest axis
+    ],
+)
+def test_slice_layout_shapes(phys, expect):
+    shape, perm = M.slice_layout(phys)
+    assert shape == expect
+    assert sorted(perm) == list(range(len(phys)))
+    n = int(np.prod(phys))
+    assert int(np.prod(shape)) == n
+
+
+def test_detect_from_coords():
+    devs = grid((4, 2, 2))
+    assert M.detect_slice_shape(devs) == (4, 2, 2)
+    # shuffled device order still detects the box
+    rng = np.random.default_rng(3)
+    shuffled = [devs[i] for i in rng.permutation(len(devs))]
+    assert M.detect_slice_shape(shuffled) == (4, 2, 2)
+
+
+def test_detect_rejects_partial_boxes():
+    devs = grid((2, 2, 2))[:6]  # coords don't tile the box
+    assert M.detect_slice_shape(devs) is None
+    assert M.detect_slice_shape([object()]) is None  # no coords
+
+
+def test_env_hint_overrides(monkeypatch):
+    devs = [object()] * 8  # no coords at all
+    monkeypatch.setenv("SWARM_SLICE_SHAPE", "2x2x2")
+    assert M.detect_slice_shape(devs) == (2, 2, 2)
+    monkeypatch.setenv("SWARM_SLICE_SHAPE", "4x4")  # wrong count
+    assert M.detect_slice_shape(devs) is None
+    monkeypatch.setenv("SWARM_SLICE_SHAPE", "bogus")
+    assert M.detect_slice_shape(devs) is None
+
+
+def test_comm_axes_ride_single_physical_axes():
+    """Walking the mesh along model (or seq) must change exactly ONE
+    physical coordinate — the collective stays on one ICI axis."""
+    phys = (4, 2, 2)
+    devs = grid(phys)
+    shape, perm = M.slice_layout(phys)
+    arr = np.array(
+        M._grid_order(devs, phys), dtype=object
+    ).reshape(phys).transpose(perm).reshape(shape)
+    for axis in (1, 2):  # model, seq
+        if shape[axis] == 1:
+            continue
+        moved = np.moveaxis(arr, axis, 0)
+        flat = moved.reshape(shape[axis], -1)
+        for col in range(flat.shape[1]):
+            coords = np.array([d.coords for d in flat[:, col]])
+            varying = (coords.max(axis=0) != coords.min(axis=0)).sum()
+            assert varying == 1, (axis, col, coords)
+
+
+def test_make_mesh_with_env_hint_on_cpu(monkeypatch):
+    """End to end on the 8-device CPU mesh the suite forces."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU conftest")
+    monkeypatch.setenv("SWARM_SLICE_SHAPE", "2x2x2")
+    m = M.make_mesh()
+    assert dict(zip(m.axis_names, m.devices.shape)) == {
+        "data": 2, "model": 2, "seq": 2,
+    }
