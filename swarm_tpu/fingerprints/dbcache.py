@@ -40,6 +40,10 @@ _CODE_FILES = (
     "dslc.py",
     "../ops/hashing.py",
     "../ops/encoding.py",
+    # the service classifier's template construction (_inline_flags,
+    # Matcher wiring) feeds the svcdb entries — a lowering change there
+    # must invalidate them too
+    "../ops/service.py",
 )
 
 
@@ -55,22 +59,33 @@ def _code_salt() -> bytes:
     return h.digest()
 
 
-def corpus_key(templates_dir: str | Path) -> str:
-    """Stable key over the corpus tree + compiler version."""
+def _corpus_material(templates_dir: str | Path) -> bytes:
+    """The corpus tree's identity bytes (path, size, mtime per file)."""
     root = Path(templates_dir)
-    h = hashlib.sha256()
-    h.update(b"v%d|" % _FORMAT_VERSION)
-    h.update(_code_salt())
     entries = sorted(
         p for p in root.rglob("*")
         if p.is_file() and p.suffix in (".yaml", ".yml", ".txt")
     )
+    lines = []
     for p in entries:
         st = p.stat()
-        h.update(
-            f"{p.relative_to(root)}|{st.st_size}|{st.st_mtime_ns}\n".encode()
+        lines.append(
+            f"{p.relative_to(root)}|{st.st_size}|{st.st_mtime_ns}\n"
         )
+    return "".join(lines).encode()
+
+
+def _entry_key(key_material: bytes) -> str:
+    h = hashlib.sha256()
+    h.update(b"v%d|" % _FORMAT_VERSION)
+    h.update(_code_salt())
+    h.update(key_material)
     return h.hexdigest()
+
+
+def corpus_key(templates_dir: str | Path) -> str:
+    """Stable key over the corpus tree + compiler version."""
+    return _entry_key(_corpus_material(templates_dir))
 
 
 def _cache_dir() -> Optional[Path]:
@@ -85,56 +100,71 @@ def _cache_dir() -> Optional[Path]:
     return path
 
 
+def load_or_compile_keyed(tag: str, key_material: bytes, build):
+    """Generic cached compile: ``tag`` groups entries (stale siblings
+    under the SAME tag are evicted on publish — derive it from the
+    artifact's identity, e.g. its path hash, so distinct DBs coexist),
+    ``key_material`` + the compiler-source salt key them, ``build()``
+    produces the picklable value. Used by load_or_compile and by the
+    service classifier to bound the 12k-signature DB compile (~18 s
+    cold) to one pickle load warm."""
+    cache = _cache_dir()
+    if cache is None:
+        return build()
+    key = _entry_key(key_material)
+    entry = cache / f"{tag}-{key}.pkl"
+    if entry.is_file():
+        try:
+            with open(entry, "rb") as fh:
+                return pickle.load(fh)
+        except Exception:
+            try:
+                entry.unlink()
+            except OSError:
+                pass
+    value = build()
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(dir=cache, suffix=".tmp")
+        with os.fdopen(fd, "wb") as fh:
+            pickle.dump(value, fh, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, entry)
+        tmp = None
+        for stale in cache.glob(f"{tag}-*.pkl"):
+            if stale.name != entry.name:
+                stale.unlink(missing_ok=True)
+    except Exception:
+        pass
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return value
+
+
+def path_tag(path: str | Path) -> str:
+    """Entry-group tag from an artifact's resolved path: groups the
+    cache entries per location so publishing a new key evicts the
+    stale siblings (the mtime-sensitive key would otherwise mint an
+    immortal multi-MB pickle per checkout/touch), while distinct
+    locations coexist."""
+    return hashlib.sha256(
+        str(Path(path).resolve()).encode()
+    ).hexdigest()[:16]
+
+
 def load_or_compile(templates_dir: str | Path):
     """→ (templates, CompiledDB), served from the disk cache when the
     corpus+compiler key matches; compiled (and cached) otherwise."""
     from swarm_tpu.fingerprints import load_corpus
     from swarm_tpu.fingerprints.compile import compile_corpus
 
-    cache = _cache_dir()
-    # entries are named <dir-hash>-<content-key>.pkl: the dir hash
-    # groups entries per corpus location so publishing a new key evicts
-    # the stale siblings (the mtime-sensitive key would otherwise mint
-    # an immortal multi-MB pickle per checkout/touch)
-    dir_tag = hashlib.sha256(
-        str(Path(templates_dir).resolve()).encode()
-    ).hexdigest()[:16]
-    key = corpus_key(templates_dir) if cache else ""
-    if cache:
-        entry = cache / f"{dir_tag}-{key}.pkl"
-        if entry.is_file():
-            try:
-                with open(entry, "rb") as fh:
-                    templates, db = pickle.load(fh)
-                return templates, db
-            except Exception:
-                # corrupt/incompatible entry: fall through to recompile
-                try:
-                    entry.unlink()
-                except OSError:
-                    pass
-    templates, _errors = load_corpus(templates_dir)
-    db = compile_corpus(templates)
-    if cache:
-        # atomic publish so a concurrent reader never sees a torn
-        # pickle; ANY failure degrades to no-cache (the compile already
-        # succeeded — a cache write must never fail the scan)
-        tmp = None
-        try:
-            fd, tmp = tempfile.mkstemp(dir=cache, suffix=".tmp")
-            with os.fdopen(fd, "wb") as fh:
-                pickle.dump((templates, db), fh, protocol=pickle.HIGHEST_PROTOCOL)
-            os.replace(tmp, cache / f"{dir_tag}-{key}.pkl")
-            tmp = None
-            for stale in cache.glob(f"{dir_tag}-*.pkl"):
-                if stale.name != f"{dir_tag}-{key}.pkl":
-                    stale.unlink(missing_ok=True)
-        except Exception:
-            pass
-        finally:
-            if tmp is not None:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-    return templates, db
+    def build():
+        templates, _errors = load_corpus(templates_dir)
+        return templates, compile_corpus(templates)
+
+    return load_or_compile_keyed(
+        path_tag(templates_dir), _corpus_material(templates_dir), build
+    )

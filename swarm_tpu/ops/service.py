@@ -70,6 +70,7 @@ class ServiceClassifier:
         db_path: Optional[str] = None,
         **engine_kwargs,
     ):
+        file_backed = probes is None  # cacheable: identity = the DB file
         if probes is None:
             probes, self.skipped_matches = load_probes(db_path)
         else:
@@ -104,6 +105,28 @@ class ServiceClassifier:
                 )
         from swarm_tpu.ops.engine import MatchEngine  # deferred: heavy import
 
+        # bound the compile: 12k signatures cost ~18 s of lowering cold
+        # (the production-scale DB) — key the CompiledDB on the match
+        # population (post-inlining, so a flag-folding change can never
+        # serve stale lowerings) and serve it from the disk cache warm.
+        # Only file-backed DBs cache: the tag is the DB file's identity
+        # so distinct DBs (bundled vs production) keep separate entries
+        # instead of evicting each other.
+        if "db" not in engine_kwargs and file_backed:
+            from swarm_tpu.fingerprints.compile import compile_corpus
+            from swarm_tpu.fingerprints.dbcache import (
+                load_or_compile_keyed,
+                path_tag,
+            )
+
+            key = "\x00".join(
+                f"{p}|{m.service}|{int(m.soft)}|{_inline_flags(m)}"
+                for p, m in self._matches
+            ).encode("utf-8", "surrogateescape")
+            tag = "svcdb-" + (path_tag(db_path) if db_path else "builtin")
+            engine_kwargs["db"] = load_or_compile_keyed(
+                tag, key, lambda: compile_corpus(templates)
+            )
         self.engine = MatchEngine(templates, **engine_kwargs)
         self._compiled = [m.compile() for _probe, m in self._matches]
         self._by_probe: dict[str, list[int]] = {}

@@ -28,11 +28,29 @@ def test_baseline_targets_all_positive():
         assert target > 0, metric
 
 
+def test_emit_record_shape():
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.emit("m", 1728.0, "rows/sec", 0.00069)
+    rec = json.loads(buf.getvalue())
+    assert rec == {
+        "metric": "m", "value": 1728.0, "unit": "rows/sec",
+        "vs_baseline": 0.00069,
+    }
+
+
 def _fake_phase_output(phase: str) -> str:
     lines = {
         "service": [
             {"metric": "service_probe_classifications_per_sec",
              "value": 90000.0, "unit": "banners/sec", "vs_baseline": 1.8},
+        ],
+        "service_full": [
+            {"metric": "service_full_db_classifications_per_sec",
+             "value": 35000.0, "unit": "banners/sec", "vs_baseline": 1.75},
         ],
         "streaming": [
             {"metric": "streamed_service_classifications_per_sec",

@@ -1,0 +1,347 @@
+"""Generate the production-scale service-probes DB.
+
+The reference ships real nmap with its full ``nmap-service-probes``
+(~600 probes / ~12k match signatures — /root/reference/worker/
+Dockerfile:13, worker/modules/nmap.json:2 ``-sV``). This environment
+has no nmap DB and no egress, so the scale DB is GENERATED: the
+hand-written bundled head (``service-probes.txt``, protocol knowledge
+for the services wide scans actually meet) is kept verbatim as the
+high-recall head, and this tool derives a deterministic long tail the
+way nmap's own tail looks — hundreds of per-protocol probes and
+thousands of product signatures with version captures, each emitted
+TOGETHER with an example banner it must classify (the recall corpus),
+so the data is self-validating end to end.
+
+Outputs (committed; rerun this tool to regenerate):
+- swarm_tpu/data/service-probes-large.txt
+- swarm_tpu/data/service-probes-large.recall.json
+
+Determinism: pure combinatorics, no RNG — regenerating produces
+byte-identical output.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DATA = REPO / "swarm_tpu" / "data"
+
+# --- vocabulary -----------------------------------------------------------
+
+VENDORS = [
+    "Nimbus", "Vertex", "BlueOak", "Ironclad", "Sable", "Quorum", "Helix",
+    "Lattice", "Argus", "Meridian", "Cobalt", "Drift", "Keystone", "Onyx",
+    "Pinnacle", "Zephyr", "Granite", "Harbor", "Citadel", "Falcon",
+    "Monarch", "Beacon", "Summit", "Aurora", "Bastion", "Cascade",
+    "Polaris", "Sentinel", "Obsidian", "Redwood", "Caldera", "Typhoon",
+    "Ridgeline", "Vanguard", "Sterling", "Northgate", "Ember", "Solstice",
+]
+
+# bare name LAST: its broader regex must come after the edition
+# variants or first-match-wins shadows them
+EDITIONS = [" Enterprise", " Community", " Pro", " Embedded", ""]
+
+#: banner grammar styles. Each maps (product, vercap) -> how the wire
+#: banner looks and the regex that captures it. ``{P}`` = product
+#: token in the banner, ``{V}`` = example version.
+STYLES = {
+    # SMTP/FTP/NNTP-style numeric greeting
+    "code220": {
+        "banner": b"220 host.example {P} {V} ready\r\n",
+        "regex": r"^220[ -][^\r\n]*{RP} (\d[\w.\-]*)",
+        "regex_nover": r"^220[ -][^\r\n]*{RP}",
+    },
+    # POP3-style +OK greeting
+    "pok": {
+        "banner": b"+OK {P} {V} server ready\r\n",
+        "regex": r"^\+OK [^\r\n]*{RP} (\d[\w.\-]*)",
+        "regex_nover": r"^\+OK [^\r\n]*{RP}",
+    },
+    # IMAP-style * OK greeting
+    "imapok": {
+        "banner": b"* OK {P} {V} ready\r\n",
+        "regex": r"^\* OK [^\r\n]*{RP} (\d[\w.\-]*)",
+        "regex_nover": r"^\* OK [^\r\n]*{RP}",
+    },
+    # HTTP Server header
+    "httpserver": {
+        "banner": (
+            b"HTTP/1.1 200 OK\r\nServer: {P}/{V}\r\n"
+            b"Content-Type: text/html\r\n\r\n<html></html>"
+        ),
+        "regex": r"^HTTP/1\.[01] \d\d\d [^\r\n]*\r\n(?:[^\r\n]+\r\n)*?"
+                 r"Server: {RP}/(\d[\w.\-]*)",
+        "regex_nover": r"^HTTP/1\.[01] \d\d\d [^\r\n]*\r\n(?:[^\r\n]+\r\n)*?"
+                       r"Server: {RP}",
+    },
+    # bare product banner line (telnet-ish consoles, queues)
+    "bareline": {
+        "banner": b"{P} {V}\r\nready.\r\n",
+        "regex": r"^{RP} (\d[\w.\-]*)[\r\n]",
+        "regex_nover": r"^{RP}[ \r\n]",
+    },
+    # JSON status endpoints (modern infra daemons)
+    "jsonver": {
+        "banner": b'{{"name":"{P}","version":"{V}","status":"ok"}}',
+        "regex": r"\"name\":\"{RP}\",\"version\":\"(\d[\w.\-]*)\"",
+        "regex_nover": r"\"name\":\"{RP}\"",
+    },
+    # ident-style tagged reply
+    "tagged": {
+        "banner": b"* {P} {V} (c) vendor\r\n",
+        "regex": r"^\* {RP} (\d[\w.\-]*)",
+        "regex_nover": r"^\* {RP}",
+    },
+}
+
+#: protocol families of the generated tail. ``style`` picks the banner
+#: grammar; ``stems`` are product-name stems the vendor vocabulary
+#: multiplies; ``ports``/``payload`` shape the probe records.
+FAMILIES = [
+    ("ftp", "code220", ["FTPd", "FileServer", "TransferD", "FTPGate",
+                        "XferServer", "DropBox"],
+     "21,2121,2221", None),
+    ("smtp", "code220", ["Mailer", "SMTPd", "MailGate", "Postd",
+                         "RelayD", "MXServer"],
+     "25,465,587", None),
+    ("nntp", "code220", ["NewsServer", "NNTPd", "FeedD"], "119,563", None),
+    ("pop3", "pok", ["PopServer", "MailDrop", "InboxD"], "110,995", None),
+    ("imap", "imapok", ["IMAPd", "MailStore", "MsgVault"], "143,993", None),
+    ("http", "httpserver", ["HTTPd", "WebServer", "Gateway", "Proxy",
+                            "AppServer", "CDN", "EdgeCache", "Balancer"],
+     "80,8080,8000,8888", "GET / HTTP/1.0\\r\\n\\r\\n"),
+    ("telnet", "bareline", ["Console", "TermServer", "ShellGate",
+                            "RemoteMgr"],
+     "23,2323", None),
+    ("sip", "tagged", ["SIPd", "VoiceGate", "PBXCore"], "5060,5061",
+     "OPTIONS sip:test SIP/2.0\\r\\n\\r\\n"),
+    ("rtsp", "tagged", ["MediaServer", "StreamD", "CamRelay"],
+     "554,8554", "OPTIONS / RTSP/1.0\\r\\n\\r\\n"),
+    ("mqtt", "jsonver", ["MQBroker", "IoTBroker", "TelemetryHub"],
+     "1883,8883", None),
+    ("amqp", "jsonver", ["QueueD", "BusServer", "EventRouter"],
+     "5672", None),
+    ("db", "jsonver", ["DBServer", "DataStore", "CacheD", "IndexD",
+                       "SearchCore", "TSEngine"],
+     "9200,5984,8086,7474", "GET / HTTP/1.0\\r\\n\\r\\n"),
+    ("scada", "bareline", ["PLCLink", "TelemetryD", "ModGate",
+                           "FieldBus"],
+     "502,20000,44818", None),
+    ("printer", "bareline", ["PrintServer", "JetD", "LabelMgr"],
+     "9100,515", None),
+    ("nosql", "jsonver", ["KVStore", "DocStore", "GraphD"],
+     "6379,27017,11211", None),
+    ("vpn", "tagged", ["TunnelD", "VPNGate", "MeshLink"],
+     "1194,1723,500", None),
+    ("git", "bareline", ["RepoServer", "SCMd", "CodeHub"], "9418", None),
+    ("backup", "code220", ["BackupD", "ArchiveServer", "SnapVault"],
+     "10000,13720", None),
+    ("monitor", "jsonver", ["MetricsD", "AgentD", "Collector",
+                            "ProbeHub"],
+     "9090,10050,5666", None),
+    ("ldap", "tagged", ["DirServer", "AuthD", "IdentityCore"],
+     "389,636", None),
+]
+
+#: probe-payload flavors per product stem — distinct wire payloads the
+#: way nmap keeps per-protocol probe variants
+FLAVORS = ("", "v2", "tls", "alt", "legacy", "udp")
+
+
+def esc(product: str) -> str:
+    """Regex-escape a product token the way the grammar slots expect."""
+    return re.escape(product)
+
+
+def build():
+    head = (DATA / "service-probes.txt").read_text()
+    out = [
+        "# swarm_tpu production-scale service-probes database.\n"
+        "# GENERATED by tools/gen_service_probes.py (deterministic) —\n"
+        "# hand-written high-recall head (service-probes.txt) plus a\n"
+        "# combinatoric long tail at real nmap-service-probes scale\n"
+        "# (~600 probes / ~12k match signatures with version captures).\n"
+        "# Format: nmap-service-probes (fingerprints/nmap_probes.py).\n",
+        head,
+    ]
+    recall = []
+    n_probes = 0
+    n_matches = 0
+
+    def emit_probe(name, proto, payload, ports, rarity, fallback=None):
+        nonlocal n_probes
+        out.append("\n##############################NEXT PROBE"
+                   "##############################\n")
+        out.append(f"Probe {proto} {name} q|{payload or ''}|\n")
+        out.append("totalwaitms 6000\n")
+        out.append(f"rarity {rarity}\n")
+        out.append(f"ports {ports}\n")
+        if fallback:
+            out.append(f"fallback {fallback}\n")
+        n_probes += 1
+
+    def emit_match(service, regex, fields, soft=False):
+        nonlocal n_matches
+        kind = "softmatch" if soft else "match"
+        out.append(f"{kind} {service} m|{regex}|{fields}\n")
+        n_matches += 1
+
+    # Matches must live under the probe that ELICITS the banner, as in
+    # real nmap: self-announcing greetings (220/+OK/* OK/console lines)
+    # belong to the NULL probe's section, HTTP/JSON responses to
+    # GetRequest's — that is how a real scan (probe_for_port -> NULL on
+    # unknown ports) finds them. A share stays under the per-family
+    # synthetic probes for explicit-probe scans and fallback coverage.
+    SELF_ANNOUNCING = {"code220", "pok", "imapok", "bareline", "tagged"}
+    null_section: list[str] = []
+    getreq_section: list[str] = []
+
+    for fam, style_name, stems, ports, payload in FAMILIES:
+        style = STYLES[style_name]
+        elicit_lines = (
+            null_section if style_name in SELF_ANNOUNCING else getreq_section
+        )
+        elicit_probe = (
+            "NULL" if style_name in SELF_ANNOUNCING else "GetRequest"
+        )
+        # product population: vendor x stem x edition
+        products = []
+        for stem in stems:
+            for vendor in VENDORS:
+                for ed in EDITIONS[:3]:
+                    products.append(f"{vendor} {stem}{ed}")
+        # probe variants: several per family (distinct payload/port
+        # flavors, like nmap's per-protocol probe files)
+        variants = []
+        for vi, stem in enumerate(stems):
+            for flavor in FLAVORS:
+                pname = f"gen-{fam}-{stem}{('-' + flavor) if flavor else ''}"
+                pl = payload
+                if flavor == "v2" and payload:
+                    pl = payload.replace("1.0", "1.1")
+                elif flavor == "alt":
+                    pl = f"{fam.upper()}-PING\\r\\n"
+                elif flavor == "legacy":
+                    pl = f"HELO {fam}\\r\\n"
+                variants.append((pname, pl, flavor))
+        for vi, (pname, pl, flavor) in enumerate(variants):
+            emit_probe(
+                pname, "UDP" if flavor == "udp" else "TCP", pl, ports,
+                rarity=5 + (vi % 5),
+                fallback=variants[0][0] if vi else None,
+            )
+            # spread the product population across the family's probes
+            share = products[vi::len(variants)]
+            for pi, product in enumerate(share):
+                version = f"{(pi % 9) + 1}.{pi % 10}.{(pi * 3) % 10}"
+                rp = esc(product)
+                regex = style["regex"].replace("{RP}", rp)
+                banner = (
+                    style["banner"]
+                    .replace(b"{P}", product.encode())
+                    .replace(b"{V}", version.encode())
+                    .replace(b"{{", b"{")
+                    .replace(b"}}", b"}")
+                )
+                cpe_prod = product.lower().replace(" ", "_")
+                fields = (
+                    f" p/{product}/ v/$1/"
+                    f" cpe:/a:{cpe_prod.split('_')[0]}:{cpe_prod}:$1/"
+                )
+                if pi % 4 == 0:
+                    fields += f" o/{'Linux' if pi % 8 else 'Windows'}/"
+                # ~70% under the eliciting head probe (how a real scan
+                # reaches them), the rest under this synthetic probe
+                to_head = pi % 10 < 7
+                lines = elicit_lines if to_head else None
+                if lines is not None:
+                    lines.append(f"match {fam} m|{regex}|{fields}\n")
+                    if pi % 3 == 0:
+                        nover = style["regex_nover"].replace("{RP}", rp)
+                        lines.append(
+                            f"match {fam} m|{nover}| p/{product}/\n"
+                        )
+                else:
+                    emit_match(fam, regex, fields)
+                    if pi % 3 == 0:
+                        emit_match(
+                            fam,
+                            style["regex_nover"].replace("{RP}", rp),
+                            f" p/{product}/",
+                        )
+                if pi % 7 == 0:
+                    recall.append({
+                        "probe": elicit_probe if to_head else pname,
+                        "banner": base64.b64encode(banner).decode(),
+                        "service": fam,
+                        "product": product,
+                        "version": version,
+                    })
+        # one family softmatch on its primary probe's grammar
+        generic = style["regex_nover"].replace(
+            "{RP}", r"[\w][\w .\-]{0,40}"
+        )
+        emit_match(fam, generic, "", soft=True)
+
+    # the eliciting-probe sections: duplicate-name sections merge by
+    # name for match lookup (fingerprints/nmap_probes.py keeps them as
+    # separate records; ops/service.py accumulates _by_probe[name]), so
+    # the hand-written head's matches keep first-match priority
+    emit_probe("NULL", "TCP", None, "1-65535", rarity=1)
+    out.extend(null_section)
+    n_matches += len(null_section)
+    emit_probe(
+        "GetRequest", "TCP", "GET / HTTP/1.0\\r\\n\\r\\n",
+        "80,8080,8000,8888", rarity=1, fallback="NULL",
+    )
+    out.extend(getreq_section)
+    n_matches += len(getreq_section)
+    # the duplicate sections are continuations, not new probes
+    n_probes -= 2
+
+    text = "".join(out)
+    # self-check 1: the file parses and every generated regex compiles
+    sys.path.insert(0, str(REPO))
+    from swarm_tpu.fingerprints.nmap_probes import load_probes, parse_probes
+
+    probes, skipped = parse_probes(text)
+    assert skipped == 0, f"{skipped} generated matches failed to compile"
+    total_matches = sum(len(p.matches) for p in probes)
+    # self-check 2: every recall banner hard-matches its product+version
+    from swarm_tpu.fingerprints.nmap_probes import substitute_version
+
+    by_name = {p.name: p for p in probes}
+    for entry in recall:
+        banner = base64.b64decode(entry["banner"])
+        hit = None
+        for m in by_name[entry["probe"]].matches:
+            if m.soft:
+                continue
+            rex = m.compile()  # bytes pattern — matches raw banners
+            mo = rex.search(banner) if rex else None
+            if mo:
+                hit = (m, mo)
+                break
+        assert hit, f"recall banner missed: {entry['product']}"
+        m, mo = hit
+        assert m.service == entry["service"]
+        assert substitute_version(m.product, mo) == entry["product"]
+        assert substitute_version(m.version, mo) == entry["version"]
+
+    (DATA / "service-probes-large.txt").write_text(text)
+    (DATA / "service-probes-large.recall.json").write_text(
+        json.dumps(recall, indent=0)
+    )
+    print(
+        f"wrote {len(probes)} probes, {total_matches} match directives "
+        f"({n_matches} generated), {len(recall)} recall banners"
+    )
+
+
+if __name__ == "__main__":
+    build()
