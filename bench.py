@@ -432,7 +432,10 @@ def bench_jarm_cluster() -> float:
     from swarm_tpu.ops import cluster
 
     rng = np.random.default_rng(5)
-    n = 4096
+    # internet-wide framing (BASELINE config #5): batch large — the
+    # per-dispatch cost (relay tax on this harness) amortizes over N
+    # while the O(N^2) tile kernel stays device-resident
+    n = 8192 if ROWS >= 1024 else 1024
     # synthetic JARM-style fingerprints: 64 base TLS stacks + per-host
     # jitter, the shape real fleet clustering sees
     alphabet = np.frombuffer(b"0123456789abcdef", dtype=np.uint8)

@@ -166,7 +166,7 @@ def _parent_kernel(n_ref, a_ref, b_ref, rho_a_ref, rho_b_ref, dmin_ref, pidx_ref
         dmin_ref[:] = jnp.minimum(dmin_ref[:], dmin)
 
 
-def _pallas_counts(bits, n: int, radius: float, tile: int):
+def _pallas_counts(bits, n, radius, tile: int):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -184,14 +184,14 @@ def _pallas_counts(bits, n: int, radius: float, tile: int):
         out_specs=pl.BlockSpec((tile, 1), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((npad, 1), jnp.int32),
     )(
-        jnp.asarray([n], jnp.int32),
-        jnp.asarray([radius], jnp.float32),
+        jnp.asarray(n, jnp.int32).reshape(1),
+        jnp.asarray(radius, jnp.float32).reshape(1),
         bits,
         bits,
     )[:, 0]
 
 
-def _pallas_parent(bits, rho, n: int, tile: int):
+def _pallas_parent(bits, rho, n, tile: int):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -216,7 +216,7 @@ def _pallas_parent(bits, rho, n: int, tile: int):
             jax.ShapeDtypeStruct((npad, 1), jnp.float32),
             jax.ShapeDtypeStruct((npad, 1), jnp.int32),
         ],
-    )(jnp.asarray([n], jnp.int32), bits, bits, rho_col, rho_col)
+    )(jnp.asarray(n, jnp.int32).reshape(1), bits, bits, rho_col, rho_col)
     return dmin[:, 0], pidx[:, 0]
 
 
@@ -224,8 +224,7 @@ def _pallas_parent(bits, rho, n: int, tile: int):
 # XLA fallback (CPU meshes, tests) — same tile math via lax.map
 
 
-@functools.partial(jax.jit, static_argnames=("tile",))
-def _xla_counts(bits, n, radius, tile: int):
+def _xla_counts_inner(bits, n, radius, tile: int):
     npad = bits.shape[0]
     pop = jnp.sum(bits.astype(jnp.float32), axis=1)
     col_valid = jnp.arange(npad) < n
@@ -241,8 +240,7 @@ def _xla_counts(bits, n, radius, tile: int):
     return jax.lax.map(one_tile, jnp.arange(npad // tile)).reshape(-1)
 
 
-@functools.partial(jax.jit, static_argnames=("tile",))
-def _xla_parent(bits, rho, n, tile: int):
+def _xla_parent_inner(bits, rho, n, tile: int):
     npad = bits.shape[0]
     pop = jnp.sum(bits.astype(jnp.float32), axis=1)
     col = jnp.arange(npad)
@@ -273,6 +271,54 @@ def _use_pallas() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Fused, jit-cached device entry points. Uncached, every density_cluster
+# call re-lowered the Pallas kernels from scratch (~seconds per call —
+# the round-2 bench's 1.7-3.1k fp/s was lowering overhead, not compute)
+# and made three dispatch+read round trips; the fused form compiles once
+# per (shape, tile) and reads back one O(N) result set.
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "pallas"))
+def _cluster_device(packed, n, radius, tile: int, pallas: bool):
+    bits = _pad_rows(unpack_bits_jnp(packed), tile)
+    npad = bits.shape[0]
+    if pallas:
+        rho = _pallas_counts(bits, n, radius, tile)
+    else:
+        rho = _xla_counts_inner(bits, n, radius, tile)
+    rho_j = jnp.where(
+        jnp.arange(npad) < n, rho.astype(jnp.float32), -1.0
+    )
+    if pallas:
+        dmin, pidx = _pallas_parent(bits, rho_j, n, tile)
+    else:
+        dmin, pidx = _xla_parent_inner(bits, rho_j, n, tile)
+    return rho, dmin, pidx
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "pallas"))
+def _counts_device(packed, n, radius, tile: int, pallas: bool):
+    bits = _pad_rows(unpack_bits_jnp(packed), tile)
+    if pallas:
+        return _pallas_counts(bits, n, radius, tile)
+    return _xla_counts_inner(bits, n, radius, tile)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "pallas"))
+def _parent_device(packed, rho, n, tile: int, pallas: bool):
+    bits = _pad_rows(unpack_bits_jnp(packed), tile)
+    npad = bits.shape[0]
+    rho_j = jnp.where(
+        jnp.arange(npad) < n,
+        jnp.pad(rho.astype(jnp.float32), (0, npad - rho.shape[0])),
+        -1.0,
+    )
+    if pallas:
+        return _pallas_parent(bits, rho_j, n, tile)
+    return _xla_parent_inner(bits, rho_j, n, tile)
+
+
+# ---------------------------------------------------------------------------
 # Public API
 
 
@@ -284,11 +330,10 @@ def neighbor_counts(
     if n == 0:
         return np.zeros(0, dtype=np.int32)
     tile = min(tile, max(8, 1 << (n - 1).bit_length()))
-    bits = _pad_rows(unpack_bits_jnp(jnp.asarray(packed)), tile)
-    if _use_pallas():
-        rho = _pallas_counts(bits, n, float(radius), tile)
-    else:
-        rho = _xla_counts(bits, jnp.int32(n), jnp.float32(radius), tile)
+    rho = _counts_device(
+        jnp.asarray(packed), jnp.int32(n), jnp.float32(radius), tile,
+        _use_pallas(),
+    )
     return np.asarray(rho[:n])
 
 
@@ -303,13 +348,10 @@ def nearest_denser(
     if n == 0:
         return np.zeros(0, np.float32), np.zeros(0, np.int32)
     tile = min(tile, max(8, 1 << (n - 1).bit_length()))
-    bits = _pad_rows(unpack_bits_jnp(jnp.asarray(packed)), tile)
-    rho_j = jnp.pad(jnp.asarray(rho, jnp.float32), (0, bits.shape[0] - n),
-                    constant_values=-1.0)
-    if _use_pallas():
-        dmin, pidx = _pallas_parent(bits, rho_j, n, tile)
-    else:
-        dmin, pidx = _xla_parent(bits, rho_j, jnp.int32(n), tile)
+    dmin, pidx = _parent_device(
+        jnp.asarray(packed), jnp.asarray(rho, jnp.float32), jnp.int32(n),
+        tile, _use_pallas(),
+    )
     return np.asarray(dmin[:n]), np.asarray(pidx[:n])
 
 
@@ -325,20 +367,34 @@ def density_cluster(
     n = packed.shape[0]
     if n == 0:
         return np.zeros(0, np.int32), np.zeros(0, np.int32)
-    rho = neighbor_counts(packed, radius, tile)
-    delta, parent = nearest_denser(packed, rho, tile)
-    labels = np.full(n, -1, dtype=np.int32)
+    tile = min(tile, max(8, 1 << (n - 1).bit_length()))
+    # one fused dispatch, one device->host read for all three arrays
+    rho_d, dmin_d, pidx_d = _cluster_device(
+        jnp.asarray(packed), jnp.int32(n), jnp.float32(radius), tile,
+        _use_pallas(),
+    )
+    rho = np.asarray(rho_d[:n])
+    delta = np.asarray(dmin_d[:n])
+    parent = np.asarray(pidx_d[:n])
+    # vectorized label pass (the per-row Python loop was ~2-5 ms per
+    # call — visible at bench rates). Peaks seed clusters numbered in
+    # densest-first stable order (same ids as the loop produced);
+    # everyone else resolves to its chain's first peak by pointer
+    # jumping — parents are strictly (denser | equal-rho-lower-index),
+    # so chains are acyclic and terminate at a peak in <= log2(n) hops.
+    peaks = (parent < 0) | (delta > radius)
     order = np.argsort(-rho, kind="stable")  # densest first
-    next_label = 0
-    for i in order:
-        if parent[i] < 0 or delta[i] > radius:
-            labels[i] = next_label
-            next_label += 1
-        else:
-            # parents are strictly denser or equal-rho-lower-index, so the
-            # densest-first stable order always labels them before i
-            assert labels[parent[i]] >= 0, "parent labeled after child"
-            labels[i] = labels[parent[i]]
+    peak_ids = order[peaks[order]]
+    label_of = np.full(n, -1, dtype=np.int32)
+    label_of[peak_ids] = np.arange(len(peak_ids), dtype=np.int32)
+    anchor = np.where(peaks, np.arange(n), parent)
+    while True:
+        nxt = anchor[anchor]
+        if np.array_equal(nxt, anchor):
+            break
+        anchor = nxt
+    labels = label_of[anchor]
+    assert (labels >= 0).all(), "chain did not terminate at a peak"
     return labels, rho
 
 

@@ -16,10 +16,26 @@ orders, 1.1/1.2/1.3 versions, no-overlap probe) and the same
 own, so hashes are self-consistent within the framework rather than
 comparable to upstream JARM strings. The output field is therefore
 named ``jarmx`` (JARM-style, not upstream-comparable) — clustering and
-intra-framework comparison are first-class, feeding public JARM intel
-lists is explicitly not. JA3S is the standard algorithm (md5 of
-"version,cipher,ext-list" in decimals) and matches any compliant
-implementation.
+intra-framework comparison are first-class. JA3S is the standard
+algorithm (md5 of "version,cipher,ext-list" in decimals) and matches
+any compliant implementation.
+
+For interop with public TLS-intel feeds, :func:`upstream_jarm`
+implements the upstream *encoding pipeline* exactly (per-probe raw
+``cipher|version|alpn|extensions`` components; cipher encoded as the
+zero-padded 1-based index into the upstream cipher-order table;
+version as ``"abcdef"[minor]``; tail = sha256 of the concatenated
+``alpn+extensions`` components, first 32 hex chars). The one piece
+this environment cannot supply is the AUTHORITATIVE upstream
+cipher-order table — there is no copy on disk and no egress to fetch
+or verify one, and shipping a reconstructed-from-memory table would
+risk silently non-interoperable hashes while claiming interop.
+Operators provide it via ``SWARM_JARM_CIPHER_TABLE`` (path to a file
+with one lowercase hex cipher per line, in the upstream list's order,
+extracted from the Salesforce jarm repo); with the table installed,
+:class:`TlsFingerprint.jarm` carries the upstream-comparable hash
+alongside ``jarmx``. The encoding layer itself is vector-pinned by
+tests/test_tls_jarm.py.
 
 Fingerprints feed the density-peaks clustering kernel
 (swarm_tpu/ops/cluster.py) — BASELINE.json config #5.
@@ -28,6 +44,7 @@ Fingerprints feed the density-peaks clustering kernel
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 from typing import Optional, Sequence
 
@@ -157,6 +174,104 @@ def ja3s(hello: wire.ServerHello) -> str:
     return hashlib.md5(s.encode()).hexdigest()
 
 
+# --- upstream (Salesforce) JARM encoding pipeline --------------------------
+
+
+def upstream_raw_result(hello: wire.ServerHello) -> str:
+    """One probe's raw component string in the upstream format:
+    ``cipher|version|alpn|ext1-ext2-...`` (lowercase 4-hex fields),
+    empty components for a failed probe."""
+    if not hello.ok:
+        return "|||"
+    exts = "-".join(f"{e:04x}" for e in hello.extensions)
+    return (
+        f"{hello.cipher:04x}|{hello.version:04x}|"
+        f"{hello.alpn.decode('latin1')}|{exts}"
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _cipher_codes(table: tuple) -> dict:
+    """cipher hex -> upstream code, one dict per table (the hot
+    fingerprint path must not re-scan the table per probe)."""
+    return {c: f"{i + 1:x}".zfill(2) for i, c in enumerate(table)}
+
+
+def _upstream_cipher_code(cipher_hex: str, table: Sequence[str]) -> str:
+    if not cipher_hex:
+        return "00"
+    codes = _cipher_codes(tuple(table))
+    # upstream cipher_bytes' search loop falls through to
+    # count = len(table) + 1 when the cipher is absent — mirror it
+    return codes.get(cipher_hex, f"{len(table) + 1:x}".zfill(2))
+
+
+def _upstream_version_code(version_hex: str) -> str:
+    if not version_hex:
+        return "0"
+    minor = int(version_hex[3], 16)
+    if minor > 5:
+        # upstream's "abcdef"[minor] would throw here too — it can only
+        # ever see versions its own probes negotiated. A server feeding
+        # us junk (0x4141) has no upstream-comparable encoding at all.
+        raise ValueError(f"version {version_hex!r} outside JARM's domain")
+    return "abcdef"[minor]
+
+
+def upstream_jarm(raw_results: Sequence[str], table: Sequence[str]) -> str:
+    """Upstream JARM hash from 10 raw component strings + the upstream
+    cipher-order ``table`` (lowercase 4-hex entries, upstream order).
+
+    Exact upstream scheme: 3 chars per probe (2-hex 1-based cipher
+    index, 1-char version letter) + first 32 hex chars of sha256 over
+    the concatenated ``alpn + extensions`` components."""
+    assert len(raw_results) == NUM_PROBES
+    if all(r == "|||" for r in raw_results):
+        return "0" * 62
+    fuzzy = []
+    alpns_and_ext = []
+    for raw in raw_results:
+        cipher_hex, version_hex, alpn, exts = raw.split("|", 3)
+        fuzzy.append(_upstream_cipher_code(cipher_hex, table))
+        fuzzy.append(_upstream_version_code(version_hex))
+        alpns_and_ext.append(alpn)
+        alpns_and_ext.append(exts)
+    # upstream hashes UNCONDITIONALLY once any probe succeeded —
+    # an extension-less server gets sha256("")[:32] ("e3b0c442…"),
+    # not zeros
+    joined = "".join(alpns_and_ext)
+    tail = hashlib.sha256(joined.encode()).hexdigest()[:32]
+    return "".join(fuzzy) + tail
+
+
+_UPSTREAM_TABLE: Optional[tuple] = None
+_UPSTREAM_TABLE_LOADED = False
+
+
+def upstream_cipher_table() -> Optional[tuple]:
+    """The operator-supplied upstream cipher-order table, or None.
+
+    Read once from ``SWARM_JARM_CIPHER_TABLE`` (one lowercase hex
+    cipher per line, in the Salesforce list's order)."""
+    global _UPSTREAM_TABLE, _UPSTREAM_TABLE_LOADED
+    if not _UPSTREAM_TABLE_LOADED:
+        _UPSTREAM_TABLE_LOADED = True
+        import os
+
+        path = os.environ.get("SWARM_JARM_CIPHER_TABLE", "")
+        if path:
+            try:
+                with open(path) as fh:
+                    _UPSTREAM_TABLE = tuple(
+                        ln.strip().lower()
+                        for ln in fh
+                        if ln.strip() and not ln.strip().startswith("#")
+                    )
+            except OSError:
+                _UPSTREAM_TABLE = None
+    return _UPSTREAM_TABLE
+
+
 @dataclasses.dataclass
 class TlsFingerprint:
     host: str
@@ -165,11 +280,16 @@ class TlsFingerprint:
     ja3s: str  # from the first successful probe
     alive: bool  # at least one probe produced a ServerHello
     open: bool = False  # TCP port accepted a connection
+    # upstream-comparable JARM — only when the operator installed the
+    # authoritative cipher table (SWARM_JARM_CIPHER_TABLE); "" otherwise
+    jarm: str = ""
 
     def line(self) -> str:
         if self.alive:
+            up = f" jarm={self.jarm}" if self.jarm else ""
             return (
-                f"{self.host}:{self.port} jarmx={self.jarmx} ja3s={self.ja3s or '-'}"
+                f"{self.host}:{self.port} jarmx={self.jarmx}"
+                f" ja3s={self.ja3s or '-'}{up}"
             )
         # the port-open fact from the socket layer survives even when no
         # probe elicited TLS — an open non-TLS service is not "dead"
@@ -183,6 +303,15 @@ def fingerprint_from_banners(
     hellos = [wire.parse_server_flight(b) if b else wire.NO_HELLO for b in banners]
     first_ok = next((h for h in hellos if h.ok), None)
     jh = jarm_hash(hellos)
+    table = upstream_cipher_table()
+    up = ""
+    if table:
+        try:
+            up = upstream_jarm(
+                [upstream_raw_result(h) for h in hellos], table
+            )
+        except ValueError:
+            up = ""  # junk server version: no upstream encoding exists
     return TlsFingerprint(
         host=host,
         port=port,
@@ -190,4 +319,5 @@ def fingerprint_from_banners(
         ja3s=ja3s(first_ok) if first_ok else "",
         alive=jh != EMPTY_JARM,
         open=open_,
+        jarm=up,
     )

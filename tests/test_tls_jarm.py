@@ -338,3 +338,93 @@ def test_jarm_module_end_to_end(tls_server, tmp_path):
         assert "[open not-tls]" in lines[2]  # open port, no TLS behind it
     finally:
         plain.close()
+
+
+# --- upstream JARM encoding pipeline (round 3) ------------------------------
+
+
+def test_upstream_jarm_hand_vector():
+    """The upstream encoding scheme, pinned against a hand-derived
+    vector: cipher = zero-padded 1-based table index, version =
+    'abcdef'[minor], tail = sha256 over concatenated alpn+extensions
+    components (sha256('h20000-0017')[:32] precomputed)."""
+    table = ["0004", "c02f", "1301"]
+    raws = ["c02f|0303|h2|0000-0017"] + ["|||"] * 9
+    got = jarm.upstream_jarm(raws, table)
+    assert got == ("02d" + "000" * 9 + "4f1efebd0ecc8d4d0ad6781ec63846ad")
+    assert len(got) == 62
+
+
+def test_upstream_jarm_edges():
+    table = ["c02f"]
+    # all probes failed -> the canonical null hash
+    assert jarm.upstream_jarm(["|||"] * 10, table) == "0" * 62
+    # unknown cipher falls through to len(table)+1 (upstream's search
+    # loop semantics); version 0304 -> 'e'
+    got = jarm.upstream_jarm(["beef|0304||"] + ["|||"] * 9, table)
+    assert got.startswith("02e" + "000" * 9)
+    # upstream hashes unconditionally once any probe succeeded:
+    # empty alpn+ext concatenation -> sha256("")[:32]
+    assert got.endswith("e3b0c44298fc1c149afbf4c8996fb924")
+
+
+def test_upstream_jarm_junk_version_degrades_gracefully(tmp_path,
+                                                        monkeypatch):
+    """A server feeding a version outside JARM's domain (junk minor
+    nibble) has no upstream encoding — the jarm field stays empty and
+    the in-framework fields survive."""
+    with pytest.raises(ValueError):
+        jarm.upstream_jarm(["c02f|0306||"] + ["|||"] * 9, ["c02f"])
+    tab = tmp_path / "t.txt"
+    tab.write_text("c02f\n")
+    monkeypatch.setenv("SWARM_JARM_CIPHER_TABLE", str(tab))
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE", None)
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE_LOADED", False)
+    hello = wire.ServerHello(
+        version=0x0306, legacy_version=wire.TLS12, cipher=0xC02F,
+        extensions=(), alpn=b"",
+    )
+    monkeypatch.setattr(
+        wire, "parse_server_flight", lambda b: hello
+    )
+    fp = jarm.fingerprint_from_banners(
+        "h", 443, [b"x"] * jarm.NUM_PROBES
+    )
+    assert fp.jarm == "" and fp.alive and fp.jarmx
+
+
+def test_upstream_table_skips_indented_comments(tmp_path, monkeypatch):
+    tab = tmp_path / "t.txt"
+    tab.write_text("c02f\n   # indented comment\n1301\n")
+    monkeypatch.setenv("SWARM_JARM_CIPHER_TABLE", str(tab))
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE", None)
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE_LOADED", False)
+    assert jarm.upstream_cipher_table() == ("c02f", "1301")
+
+
+def test_upstream_raw_result_format():
+    h = wire.ServerHello(
+        version=wire.TLS12, legacy_version=wire.TLS12, cipher=0xC02F,
+        extensions=(0x0000, 0x0017), alpn=b"h2",
+    )
+    assert jarm.upstream_raw_result(h) == "c02f|0303|h2|0000-0017"
+    assert jarm.upstream_raw_result(wire.NO_HELLO) == "|||"
+
+
+def test_upstream_table_gates_the_field(tmp_path, monkeypatch):
+    """No table -> jarmx only; operator-installed table -> the jarm
+    field appears, computed through the upstream pipeline."""
+    banners = [b""] * jarm.NUM_PROBES
+    monkeypatch.delenv("SWARM_JARM_CIPHER_TABLE", raising=False)
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE", None)
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE_LOADED", False)
+    fp = jarm.fingerprint_from_banners("h", 443, banners)
+    assert fp.jarm == ""
+    tab = tmp_path / "table.txt"
+    tab.write_text("# upstream order\nc02f\n1301\n")
+    monkeypatch.setenv("SWARM_JARM_CIPHER_TABLE", str(tab))
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE", None)
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE_LOADED", False)
+    assert jarm.upstream_cipher_table() == ("c02f", "1301")
+    fp = jarm.fingerprint_from_banners("h", 443, banners)
+    assert fp.jarm == "0" * 62  # all probes failed -> null hash
