@@ -203,12 +203,19 @@ def _finalize(
     return out
 
 
-# bounded payload fan-out: wordlist files are read up to MAX_PAYLOAD_
-# VALUES lines and attack combinations cap at MAX_PAYLOAD_COMBOS per
-# operation — the reference shells out to nuclei which walks the full
-# 89k-line lists; a scanning *fleet* bounds per-job work instead
-MAX_PAYLOAD_VALUES = 100
-MAX_PAYLOAD_COMBOS = 200
+# payload fan-out bounds. nuclei walks wordlists in full (the corpus
+# drives the 89,810-line helpers/wordlists/wordpress-plugins.txt —
+# SURVEY §2.3), so the defaults now cover that scale; the env knobs
+# let an operator bound per-job work instead. Hitting either bound is
+# surfaced in plan stats (payload_truncated) — never a silent cap.
+import os as _os
+
+MAX_PAYLOAD_VALUES = int(
+    _os.environ.get("SWARM_MAX_PAYLOAD_VALUES", "100000")
+)
+MAX_PAYLOAD_COMBOS = int(
+    _os.environ.get("SWARM_MAX_PAYLOAD_COMBOS", "100000")
+)
 
 
 def _payload_values(
@@ -532,6 +539,10 @@ def build_plan(
                 if combos is None:
                     unsupported = "payload-values"
                     continue
+                if len(combos) >= MAX_PAYLOAD_COMBOS:
+                    # cap reached: surfaced, never silent (the rest of
+                    # the wordlist/product did not run)
+                    skip("payload-truncated", t)
             else:
                 combos = [None]
             if user_vars:
