@@ -175,11 +175,7 @@ def required_literal_set(
     Unicode there, device lowering is ASCII-only.
     """
     try:
-        import re._parser as sre_parse  # py3.11+
-    except ImportError:  # pragma: no cover
-        import sre_parse  # type: ignore
-    try:
-        tree = sre_parse.parse(pattern)
+        tree = regexlin.parse_quiet(pattern)
     except re.error:
         return None
 
@@ -406,11 +402,7 @@ def full_literal_expansions(
     uncertain prefilters.
     """
     try:
-        import re._parser as sre_parse  # py3.11+
-    except ImportError:  # pragma: no cover
-        import sre_parse  # type: ignore
-    try:
-        tree = sre_parse.parse(pattern)
+        tree = regexlin.parse_quiet(pattern)
     except re.error:
         return None
     ci = bool(tree.state.flags & re.IGNORECASE)
@@ -1241,7 +1233,7 @@ def compile_corpus(
             # host-confirmed prefilter op.
             try:
                 for pattern in m.regex:
-                    re.compile(pattern)
+                    dslc.compile_cached(pattern)
             except re.error:
                 rec["negative"] = False
                 return rec
@@ -1251,7 +1243,9 @@ def compile_corpus(
                 # compile-time constant (e.g. `.*` matches empty)
                 results = []
                 for pattern in m.regex:
-                    results.append(re.search(pattern, "") is not None)
+                    results.append(
+                        dslc.compile_cached(pattern).search("") is not None
+                    )
                 if not results:
                     return None
                 value = all(results) if m.condition == "and" else any(results)

@@ -18,11 +18,35 @@ import base64 as _b64
 import hashlib
 import re
 import time as _time
+import warnings
 from typing import Any, Callable, Optional
 
 
 class DslError(ValueError):
     pass
+
+
+# Backslashes that do NOT start a recognized escape sequence stay
+# literal ("\d" in a dsl regex string). unicode_escape currently warns
+# on them and will eventually raise — pre-doubling the invalid ones
+# pins today's pass-through semantics, warning-free and future-proof.
+# One pass, consuming each escape atomically (a "\\-" must not have its
+# second backslash re-examined as the start of an invalid "\-").
+_ESC_SCAN = re.compile(
+    r"\\(?:(\n|[\\'\"abfnrtv]|[0-7]{1,3}|x[0-9a-fA-F]{2}"
+    r"|u[0-9a-fA-F]{4}|U[0-9a-fA-F]{8}|N\{[^}]+\})|(.)|$)",
+    re.DOTALL,
+)
+
+
+def _unescape_literal(body: str) -> str:
+    def fix(m: "re.Match[str]") -> str:
+        if m.group(1) is not None:
+            return m.group(0)  # recognized escape — decode below
+        if m.group(2) is not None:
+            return "\\\\" + m.group(2)  # invalid — backslash is literal
+        return "\\\\"  # lone trailing backslash
+    return _ESC_SCAN.sub(fix, body).encode().decode("unicode_escape")
 
 
 # ---------------------------------------------------------------------------
@@ -118,7 +142,8 @@ class _Parser:
             return ("lit", float(val) if "." in val else int(val))
         if kind == "str":
             body = val[1:-1]
-            body = body.encode().decode("unicode_escape") if "\\" in body else body
+            if "\\" in body:
+                body = _unescape_literal(body)
             return ("lit", body)
         if kind == "name":
             if val in ("true", "false"):
@@ -174,10 +199,17 @@ _REGEX_CACHE: dict[str, "re.Pattern[str]"] = {}
 
 def compile_cached(pattern: str) -> "re.Pattern[str]":
     """Unbounded pattern→compiled cache shared by the DSL evaluator and
-    the CPU oracle (the corpus outgrows re's 512-entry internal cache)."""
+    the CPU oracle (the corpus outgrows re's 512-entry internal cache).
+
+    FutureWarnings ("possible nested set" — corpus patterns with
+    literal '[[') are suppressed: the patterns are upstream template
+    text whose current semantics are exactly what the oracle must
+    reproduce, and the nag re-fires on every corpus compile."""
     compiled = _REGEX_CACHE.get(pattern)
     if compiled is None:
-        compiled = _REGEX_CACHE[pattern] = re.compile(pattern)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", FutureWarning)
+            compiled = _REGEX_CACHE[pattern] = re.compile(pattern)
     return compiled
 
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import warnings
 from pathlib import Path
 from typing import Optional
 
@@ -55,7 +56,11 @@ class ServiceMatch:
         if "i" in self.flags:
             f |= re.IGNORECASE
         try:
-            return re.compile(self.pattern.encode("latin-1"), f)
+            # nmap DB patterns with literal '[[' trip re's nested-set
+            # FutureWarning; their current semantics are the contract
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", FutureWarning)
+                return re.compile(self.pattern.encode("latin-1"), f)
         except (re.error, UnicodeEncodeError):
             return None
 

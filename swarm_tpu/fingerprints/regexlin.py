@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import warnings
 from typing import Optional
 
 import numpy as np
@@ -43,6 +44,19 @@ try:  # py3.11+
 except ImportError:  # pragma: no cover
     import sre_parse  # type: ignore
     import sre_constants as sre_c  # type: ignore
+
+
+def parse_quiet(pattern: str):
+    """``sre_parse.parse`` with the nested-set FutureWarning silenced.
+
+    Corpus patterns contain literal ``[[`` (e.g. ``[[:alpha:]]`` POSIX
+    classes written for PCRE engines); their *current* Python-re
+    semantics are exactly what every lowering here must reproduce, and
+    the warning re-fires on each corpus compile otherwise. Shared by
+    all sre-tree walks (regexlin, fastre, compile)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", FutureWarning)
+        return sre_parse.parse(pattern)
 
 MAX_POSITIONS = 96  # 3 uint32 state lanes
 MAX_SEQUENCES = 48  # branch-expansion cap per pattern
@@ -219,6 +233,10 @@ def _expand(
             cross([[(_class_mask(arg, ci), K_ONE)]])
         elif name == "SUBPATTERN":
             _gid, add_flags, del_flags, sub = arg
+            if add_flags & re.ASCII:
+                # scoped (?a:) — same Unicode-vs-ASCII mask hazard as
+                # the top-level guard in compile_linear
+                raise _Unsupported("ascii-flag scope")
             sub_ci = (ci or bool(add_flags & re.IGNORECASE)) and not bool(
                 del_flags & re.IGNORECASE
             )
@@ -305,13 +323,19 @@ def compile_linear(pattern: str) -> Optional[tuple[list[LinearPattern], bool]]:
     (word boundary). Interior assertions reject.
     """
     try:
-        tree = sre_parse.parse(pattern)
+        tree = parse_quiet(pattern)
     except re.error:
         return None
     ci = bool(tree.state.flags & re.IGNORECASE)
     dotall = bool(tree.state.flags & re.DOTALL)
     if tree.state.flags & re.MULTILINE:
         return None  # ^/$ become per-line — out of scope
+    if tree.state.flags & re.ASCII:
+        # class/category masks below are computed under Unicode
+        # semantics; (?a) flips \w/\s/[^...] membership for bytes
+        # >= 0x80 — lowering would be a silent false negative on the
+        # exact no-host-confirm device path. Keep the host path.
+        return None
     toks = list(tree)
     anchored = start_wb = end_wb = False
     end_mode = END_NONE

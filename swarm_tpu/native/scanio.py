@@ -405,9 +405,14 @@ def ext_resolve(
 ):
     """(rows, templates, op_ids, states) for every extractor-plane hit
     whose op needs Python work — state 1 certainly-true (extract),
-    state 2 undecided (resolve first). One C pass (sw_ext_resolve)."""
-    for a in (masked, pop_value, pop_unc):
-        assert a.flags["C_CONTIGUOUS"], "planes must be contiguous"
+    state 2 undecided (resolve first). One C pass (sw_ext_resolve).
+
+    Planes are normalized (not asserted) to C order: callers hand in
+    arrays derived from device read-backs whose layout XLA chooses, so
+    F-ordered inputs are legal here and copied row-major once."""
+    masked = np.ascontiguousarray(masked)
+    pop_value = np.ascontiguousarray(pop_value)
+    pop_unc = np.ascontiguousarray(pop_unc)
     lib = ensure_fastpack()
     cap = max(256, 16 * int(np.count_nonzero(masked)))
     while True:

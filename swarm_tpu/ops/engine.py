@@ -395,11 +395,15 @@ class MatchEngine:
         cache = self._confirm_cache
         for matcher in op.matchers:
             if matcher.type in ("word", "regex", "binary", "size"):
-                key = (id(matcher), row.part(matcher.part))
+                # 'op'-namespaced: the walk's confirm_matcher keys this
+                # same dict by small-int matcher index — unnamespaced,
+                # correctness would rest on id() never colliding with it
+                part = row.part(matcher.part)
+                key = ("op", id(matcher), part)
                 v = cache.get(key)
                 if v is None:
                     raw = (
-                        self._regex_matcher_raw(matcher, key[1])
+                        self._regex_matcher_raw(matcher, part)
                         if matcher.type == "regex"
                         else None
                     )
@@ -739,8 +743,12 @@ class MatchEngine:
                 batch.streams, batch.lengths, batch.status, full=True
             )
         )
-        # slice off bucket/mesh row padding before the host walk
-        pt_value = np.array(np.asarray(pt_value)[:B])  # writable copy
+        # slice off bucket/mesh row padding before the host walk.
+        # np.array(order="C"): ALWAYS a writable copy (the row-redo
+        # pass writes rowbits back) AND row-major — XLA may hand back
+        # F-ordered planes, which would poison every derived array
+        # handed to the native pass (order-'K' copies preserve F)
+        pt_value = np.array(np.asarray(pt_value)[:B], order="C")
         pt_unc = np.asarray(pt_unc)[:B]
         pop_value = np.asarray(pop_value)[:B]
         pop_unc = np.asarray(pop_unc)[:B]
@@ -768,7 +776,8 @@ class MatchEngine:
                 # dsl/status/kval read beyond matcher.part — not cacheable
                 mv = cpu_ref.match_matcher(matcher, row)
                 return bool(mv) if mv is not None else False
-            key = (m_id, row.part(matcher.part))
+            part = row.part(matcher.part)
+            key = ("m", m_id, part)
             v = part_cache.get(key)
             if v is None:
                 # exact per-pattern evaluation with literal/candidate
@@ -778,7 +787,7 @@ class MatchEngine:
                 # bytes.find speed; unproven patterns get a real
                 # re.search. Negation mirrors cpu_ref.match_matcher.
                 raw = (
-                    self._regex_matcher_raw(matcher, key[1])
+                    self._regex_matcher_raw(matcher, part)
                     if matcher.type == "regex"
                     else None
                 )

@@ -38,7 +38,7 @@ from typing import Optional
 
 import numpy as np
 
-from swarm_tpu.fingerprints import regexlin
+from swarm_tpu.fingerprints import dslc, regexlin
 from swarm_tpu.fingerprints.compile import required_literal_set
 
 try:  # py3.11+
@@ -95,13 +95,19 @@ def _prefix_classes(pattern: str) -> list:
     complex. Returns [] when no mandatory prefix is derivable.
     """
     try:
-        tree = sre_parse.parse(pattern)
+        tree = regexlin.parse_quiet(pattern)
     except re.error:
         return []
     if tree.state.flags & re.MULTILINE:
         # MULTILINE only changes ^/$ semantics; AT tokens stop the
         # walk anyway, so masks stay valid — no special handling
         pass
+    if tree.state.flags & re.ASCII:
+        # class/category masks below are computed under Unicode
+        # semantics; (?a) flips what \w/\s/[^...] match for bytes
+        # >= 0x80, so a mask-driven scan would silently drop matches.
+        # No corpus pattern uses (?a) today — force the exact fallback.
+        return []
     ci = bool(tree.state.flags & re.IGNORECASE)
     dotall = bool(tree.state.flags & re.DOTALL)
 
@@ -144,6 +150,8 @@ def _prefix_classes(pattern: str) -> list:
                     masks.append(m)
                 elif name == "SUBPATTERN":
                     _gid, add_f, del_f, sub = arg
+                    if add_f & re.ASCII:
+                        break  # scoped (?a:) — same mask hazard as above
                     sub_ci = (ci or bool(add_f & re.IGNORECASE)) and not bool(
                         del_f & re.IGNORECASE
                     )
@@ -198,7 +206,9 @@ def analyze(pattern: str) -> PatternInfo:
     if info is not None:
         return info
     try:
-        rex = re.compile(pattern)
+        # dslc.compile_cached: one warning-suppressed compile + one
+        # shared pattern cache with the DSL evaluator / CPU oracle
+        rex = dslc.compile_cached(pattern)
         ok = True
     except re.error:
         rex, ok = None, False

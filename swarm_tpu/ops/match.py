@@ -92,7 +92,14 @@ def split_fused(db: "fpc.CompiledDB", buf: np.ndarray):
     bytes — is what the tunneled-accelerator transport charges for
     (BASELINE.md, relay sync mode: ~seconds per read), and even on
     healthy transports one transfer saves five dispatch round-trips.
+
+    The buffer is normalized to C order here: XLA owns the device
+    layout and is free to hand back a Fortran-ordered result (observed
+    on TPU for corpus-scale plane shapes), and every downstream
+    consumer — plane slicing, packbits math, the native sw_ext_resolve
+    pass — assumes row-major.
     """
+    buf = np.ascontiguousarray(buf)
     outs = []
     off = 0
     for w in fused_plane_widths(db):

@@ -110,6 +110,10 @@ class RequestPlan:
     owners: list[set[int]]  # request idx -> template indices
     skipped: dict[str, list[str]]  # reason -> template ids
     planned_templates: set[int]  # template indices with ≥1 request
+    # templates that RAN but with a truncated payload set (cap hit with
+    # values actually dropped) — distinct from skipped: these produced
+    # requests, just not the whole wordlist/product
+    payload_truncated: list[str] = dataclasses.field(default_factory=list)
     net_requests: list[NetRequest] = dataclasses.field(default_factory=list)
     net_owners: list[set[int]] = dataclasses.field(default_factory=list)
     # dns protocol: record types to query, each owned by its templates
@@ -220,13 +224,16 @@ MAX_PAYLOAD_COMBOS = int(
 
 def _payload_values(
     spec, template_path: Optional[str]
-) -> Optional[list[str]]:
-    """One payload variable's value list; file refs resolve against the
-    template's ancestors (the corpus root holds helpers/wordlists)."""
+) -> "tuple[Optional[list[str]], bool]":
+    """One payload variable's (value list, truncated); file refs resolve
+    against the template's ancestors (the corpus root holds
+    helpers/wordlists). ``truncated`` is True only when values were
+    actually dropped at the MAX_PAYLOAD_VALUES cap."""
     if isinstance(spec, list):
-        return [str(v) for v in spec[:MAX_PAYLOAD_VALUES]]
+        vals = [str(v) for v in spec[:MAX_PAYLOAD_VALUES]]
+        return vals, len(spec) > len(vals)
     if not isinstance(spec, str):
-        return None
+        return None, False
     import pathlib
 
     cand: list[pathlib.Path] = []
@@ -237,43 +244,57 @@ def _payload_values(
         try:
             if path.is_file():
                 out = []
+                truncated = False
                 with open(path, "r", encoding="utf-8", errors="replace") as f:
                     for line in f:
                         line = line.rstrip("\n")
+                        if len(out) >= MAX_PAYLOAD_VALUES:
+                            if line:
+                                truncated = True  # a value WAS dropped
+                                break
+                            continue
                         if line:
                             out.append(line)
-                        if len(out) >= MAX_PAYLOAD_VALUES:
-                            break
-                return out
+                return out, truncated
         except OSError:
             continue
-    return None
+    return None, False
 
 
-def _payload_combos(op, template_path: Optional[str]) -> Optional[list[dict]]:
-    """Attack-mode expansion → bounded list of var→value dicts.
+def _payload_combos(
+    op, template_path: Optional[str]
+) -> tuple[Optional[list[dict]], bool]:
+    """Attack-mode expansion → (bounded list of var→value dicts,
+    truncated) — truncated is True only when combos were actually
+    dropped, so an exactly-cap-sized product isn't misreported.
 
     batteringram: one shared value stream; pitchfork: zip the lists;
     clusterbomb: cartesian product (capped)."""
     lists: dict[str, list[str]] = {}
+    values_truncated = False
     for var, spec in op.payloads.items():
-        vals = _payload_values(spec, template_path)
+        vals, v_trunc = _payload_values(spec, template_path)
         if vals is None or not vals:
-            return None
+            return None, False
+        values_truncated = values_truncated or v_trunc
         lists[str(var)] = vals
     if not lists:
-        return []
+        return [], False
     mode = (op.attack or "batteringram").lower()
     names = list(lists)
     combos: list[dict] = []
     if mode == "clusterbomb" and len(names) > 1:
         import itertools
 
+        total = 1
+        for n in names:
+            total *= len(lists[n])
         for values in itertools.product(*(lists[n] for n in names)):
             combos.append(dict(zip(names, values)))
             if len(combos) >= MAX_PAYLOAD_COMBOS:
                 break
     elif mode == "pitchfork" and len(names) > 1:
+        total = min(len(lists[n]) for n in names)
         for values in zip(*(lists[n] for n in names)):
             combos.append(dict(zip(names, values)))
             if len(combos) >= MAX_PAYLOAD_COMBOS:
@@ -281,11 +302,14 @@ def _payload_combos(op, template_path: Optional[str]) -> Optional[list[dict]]:
     else:
         # batteringram (or single-var): one value stream, every var
         # takes the same value (nuclei's batteringram semantics)
+        total = len(lists[names[0]])
         for v in lists[names[0]]:
             combos.append({n: v for n in names})
             if len(combos) >= MAX_PAYLOAD_COMBOS:
                 break
-    return combos
+    # either bound counts: values dropped at the per-variable cap are
+    # as truncated as combos dropped at the product cap
+    return combos, values_truncated or total > len(combos)
 
 
 _INDEXED_VAR_RE = re.compile(
@@ -432,6 +456,7 @@ def build_plan(
     owners: list[set[int]] = []
     skipped: dict[str, list[str]] = {}
     planned: set[int] = set()
+    payload_truncated: list[str] = []
 
     current_added: list[list[int]] = [[]]  # per-template http indices
 
@@ -535,14 +560,15 @@ def build_plan(
             # combo — every combo's response batch-matches on device
             # and any hit attributes to the template
             if op.payloads:
-                combos = _payload_combos(op, t.source_path)
+                combos, truncated = _payload_combos(op, t.source_path)
                 if combos is None:
                     unsupported = "payload-values"
                     continue
-                if len(combos) >= MAX_PAYLOAD_COMBOS:
-                    # cap reached: surfaced, never silent (the rest of
-                    # the wordlist/product did not run)
-                    skip("payload-truncated", t)
+                if truncated and t.id not in payload_truncated:
+                    # cap hit with values dropped: surfaced, never
+                    # silent — but the template still RUNS, so this is
+                    # its own stats channel, not a skip
+                    payload_truncated.append(t.id)
             else:
                 combos = [None]
             if user_vars:
@@ -667,6 +693,7 @@ def build_plan(
         owners=[owners[i] for i in keep],
         skipped=skipped,
         planned_templates=planned,
+        payload_truncated=payload_truncated,
         net_requests=list(net_dedup),
         net_owners=net_owners_list,
         dns_qtypes=dns_qtypes_list,
@@ -900,6 +927,7 @@ class ActiveScanner:
                 or k not in ("extractor-chain", "multi-step-condition")
             },
             "oob_limited": len(self.oob_limited),
+            "payload_truncated": len(self.plan.payload_truncated),
         }
         plan_has_work = (
             self.plan.requests

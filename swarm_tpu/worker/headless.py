@@ -246,8 +246,14 @@ class _Session:
         # strict name-based vhost silently serves its default site
         host_hdr = sp.netloc or self.host
         default = 443 if sp.scheme.lower() == "https" else 80
-        if sp.hostname and sp.port == default:
-            host_hdr = sp.hostname
+        try:
+            if sp.hostname and sp.port == default:
+                host_hdr = sp.hostname
+        except ValueError:
+            # malformed/out-of-range port in a caller URL: keep the
+            # verbatim netloc Host header (mirrors _origin) instead of
+            # crashing the whole run()
+            pass
         lines = [f"{method} {path} HTTP/1.1", f"Host: {host_hdr}"]
         sent = {"host"}
         for k, v in list(self.headers.items()) + list(_DEFAULT_HEADERS):

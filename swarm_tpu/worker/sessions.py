@@ -204,7 +204,8 @@ class SessionScanner:
         combos: list = [None]
         for op in t.operations:
             if op.payloads:
-                combos = planner._payload_combos(op, t.source_path) or [None]
+                combos, _trunc = planner._payload_combos(op, t.source_path)
+                combos = combos or [None]
                 break
         for combo in combos:
             hit = self._run_combo(

@@ -23,6 +23,7 @@ import dataclasses
 import json
 import socket
 import ssl as pyssl
+import warnings
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
 
@@ -33,13 +34,23 @@ from swarm_tpu.ops import cpu_ref
 # nuclei version-pin names → python ssl constants. SSLv3 has no
 # client-side support in modern OpenSSL: a pin we cannot dial is an
 # automatic no-match for that operation (same observable result as
-# "server refused the old protocol").
-_VERSIONS = {
-    "tls10": pyssl.TLSVersion.TLSv1,
-    "tls11": pyssl.TLSVersion.TLSv1_1,
-    "tls12": pyssl.TLSVersion.TLSv1_2,
-    "tls13": pyssl.TLSVersion.TLSv1_3,
-}
+# "server refused the old protocol"). TLSv1/TLSv1_1 are deprecated
+# enum members and may disappear from a future Python — resolve them
+# defensively so a missing member degrades to the same un-dialable-pin
+# no-match instead of an ImportError time-bomb.
+_VERSIONS = {}
+for _pin, _member in (
+    ("tls10", "TLSv1"),
+    ("tls11", "TLSv1_1"),
+    ("tls12", "TLSv1_2"),
+    ("tls13", "TLSv1_3"),
+):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        _v = getattr(pyssl.TLSVersion, _member, None)
+    if _v is not None:
+        _VERSIONS[_pin] = _v
+del _pin, _member, _v
 
 # Ports that are KNOWN plaintext protocols: the ssl fan-out excludes
 # these from a module's probe ports (a TLS handshake there can only
@@ -130,10 +141,14 @@ def handshake(
     except pyssl.SSLError:
         pass
     try:
-        if min_version:
-            ctx.minimum_version = _VERSIONS[min_version]
-        if max_version:
-            ctx.maximum_version = _VERSIONS[max_version]
+        # legacy pins are deliberate here (probing what the SERVER
+        # still speaks) — the client-side deprecation nag is noise
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            if min_version:
+                ctx.minimum_version = _VERSIONS[min_version]
+            if max_version:
+                ctx.maximum_version = _VERSIONS[max_version]
     except (KeyError, ValueError):
         return None  # pin not dialable on this client (e.g. sslv3)
     try:

@@ -170,7 +170,23 @@ HAND_CASES = [
     (r"/([^/]+)/", "/a//b/ /c/"),
     (r"zz", "z" * 100),
     (r"(?m)^/", "a\n/b\n/c"),
+    # ASCII-flag semantics: [^\w] under (?a) matches '\xb5' (µ), under
+    # Unicode it doesn't — mask-driven scans must fall back, not miss
+    (r"(?a)[^\w]X", "\xb5X"),
+    (r"(?a:\W)X", "\xb5X"),
 ]
+
+
+def test_ascii_flag_forces_fallback():
+    """(?a) flips class/category membership for bytes >= 0x80; the
+    prefix-class fast path must decline (None), never return a wrong
+    verdict (ADVICE r3: silent false negative on '\\xb5X')."""
+    text = "\xb5X"
+    data = text.encode("latin-1")
+    assert re.search(r"(?a)[^\w]X", text) is not None  # the ground truth
+    for pat in (r"(?a)[^\w]X", r"(?a:\W)X"):
+        assert fastre.search_bool(pat, data, text) is None, pat
+        assert fastre.finditer_values(pat, data, text, 0) is None, pat
 
 
 @pytest.mark.parametrize("pattern,text", HAND_CASES)

@@ -1,0 +1,163 @@
+"""Device read-back layout regressions.
+
+XLA owns the on-device layout and may hand back Fortran-ordered host
+buffers (observed on real TPU at corpus-scale plane shapes — the
+BENCH_r03 crash: ``planes must be contiguous`` at the sw_ext_resolve
+boundary, from a (304, 464) plane with strides (1, 304)). Layout is
+the compiler's choice, not a contract, so every consumer below the
+read-back boundary must accept any layout and produce identical bits.
+
+These tests pin that: split_fused normalizes the fused buffer,
+ext_resolve normalizes its plane inputs, and the full match_packed
+native path produces bit-identical verdicts when every device plane is
+forced Fortran-ordered (simulating the TPU layout on CPU, where XLA
+happens to return C order for these shapes).
+"""
+
+import random
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from swarm_tpu.fingerprints import load_corpus
+from swarm_tpu.fingerprints.model import Response
+from swarm_tpu.ops import match as match_mod
+from swarm_tpu.ops.engine import MatchEngine
+
+DATA = Path(__file__).parent / "data" / "templates"
+REFERENCE_CORPUS = Path("/root/reference/worker/artifacts/templates")
+
+
+def _f_ordered(a):
+    """Fortran-ordered copy of 2-D arrays; pass-through otherwise."""
+    a = np.asarray(a)
+    return np.asfortranarray(a) if a.ndim == 2 else a
+
+
+def test_split_fused_accepts_fortran_buffer():
+    """split_fused must yield identical planes for C- and F-ordered
+    fused buffers, and its outputs must be safe to hand to the native
+    pass (C-strided)."""
+    templates, errors = load_corpus(DATA)
+    assert templates and not errors
+    eng = MatchEngine(templates, mesh=None)
+    db = eng.db
+    widths = match_mod.fused_plane_widths(db)
+    rng = np.random.default_rng(7)
+    buf_c = np.ascontiguousarray(
+        rng.integers(0, 256, size=(304, sum(widths)), dtype=np.uint8)
+    )
+    buf_f = np.asfortranarray(buf_c)
+    assert not buf_f.flags["C_CONTIGUOUS"]  # the TPU shape that crashed
+    outs_c = match_mod.split_fused(db, buf_c)
+    outs_f = match_mod.split_fused(db, buf_f)
+    for pc, pf in zip(outs_c, outs_f):
+        np.testing.assert_array_equal(np.asarray(pc), np.asarray(pf))
+
+
+def test_ext_resolve_accepts_fortran_planes():
+    """The native sw_ext_resolve boundary normalizes (not asserts)
+    plane layout: F-ordered inputs are legal and bit-identical."""
+    pytest.importorskip("swarm_tpu.native.scanio")
+    from swarm_tpu.native.scanio import ensure_fastpack, ext_resolve
+
+    try:
+        ensure_fastpack()
+    except Exception:
+        pytest.skip("native fastpack unavailable")
+    rng = np.random.default_rng(3)
+    n_rows, nt = 304, 3700
+    nb = (nt + 7) >> 3
+    masked = rng.integers(0, 256, size=(n_rows, nb), dtype=np.uint8)
+    # sparse: keep the hit count realistic
+    masked &= rng.integers(0, 256, size=(n_rows, nb), dtype=np.uint8) < 8
+    n_ops = 64
+    nbo = (n_ops + 7) >> 3
+    rowdep = np.zeros(nb, dtype=np.uint8)
+    skip = np.zeros(n_rows, dtype=np.uint8)
+    # each template owns one op, cycling over the op table
+    indptr = np.arange(nt + 1, dtype=np.int64)
+    opids = (np.arange(nt, dtype=np.int64)) % n_ops
+    pop_value = rng.integers(0, 256, size=(n_rows, nbo), dtype=np.uint8)
+    pop_unc = rng.integers(0, 256, size=(n_rows, nbo), dtype=np.uint8)
+    got_c = ext_resolve(
+        masked, nt, rowdep, skip, indptr, opids, pop_value, pop_unc
+    )
+    got_f = ext_resolve(
+        np.asfortranarray(masked), nt, rowdep, skip, indptr, opids,
+        np.asfortranarray(pop_value), np.asfortranarray(pop_unc),
+    )
+    for c, f in zip(got_c, got_f):
+        np.testing.assert_array_equal(c, f)
+
+
+@pytest.mark.skipif(
+    not REFERENCE_CORPUS.is_dir(), reason="reference corpus absent"
+)
+def test_match_packed_native_path_fortran_planes_corpus_scale():
+    """End-to-end: match_packed through the native path on a reference
+    corpus DB at a ≥256-row batch, with EVERY device plane forced
+    Fortran-ordered — must not crash and must be bit-identical to the
+    C-ordered run. This is the exact failure mode of BENCH_r03
+    (engine.py host walk → sw_ext_resolve contiguity assert)."""
+    # network + a technologies slice: extractor templates (detect-rsyncd
+    # etc.) route through the ext_resolve pass that crashed
+    templates, _ = load_corpus(REFERENCE_CORPUS / "network")
+    tech, _ = load_corpus(REFERENCE_CORPUS / "technologies", limit=120)
+    templates = templates + tech
+    assert len(templates) >= 100
+    eng = MatchEngine(templates, mesh=None)
+    if not eng._use_native_memo():
+        pytest.skip("native memo path unavailable")
+
+    rng = random.Random(11)
+    words = []
+    for t in templates:
+        for _op, m in t.all_matchers():
+            words.extend(w for w in getattr(m, "words", ()) or () if w)
+    words = [w for w in words if 3 <= len(w) <= 40][:400]
+    rows = []
+    for i in range(256):
+        body = bytearray()
+        for _ in range(rng.randint(0, 4)):
+            body += rng.choice(words).encode("utf-8", "ignore") + b" "
+        body += bytes(rng.randrange(32, 127) for _ in range(rng.randint(0, 80)))
+        rows.append(
+            Response(
+                host=f"h{i}.example",
+                port=80,
+                status=rng.choice([200, 200, 200, 301, 404, 503]),
+                body=bytes(body),
+                header=b"Server: "
+                + rng.choice([b"nginx", b"Apache", b"rsyncd"])
+                + b"\r\n",
+            )
+        )
+
+    baseline = eng.match_packed(rows)
+    # the batch must actually fire templates, else the walk is a no-op
+    # and the regression proves nothing
+    assert baseline.bits.any()
+
+    # simulate the TPU layout: every 2-D plane the device hands back
+    # becomes Fortran-ordered before the engine's host walk sees it
+    orig = match_mod.split_fused
+
+    def forder_split(db, buf):
+        return tuple(_f_ordered(p) for p in orig(db, buf))
+
+    # fresh content so the verdict memo can't serve cached bits
+    if eng._vmemo is not None:
+        eng._vmemo.clear()
+    eng._verdict_memo.clear()
+    eng._confirm_cache.clear()
+    match_mod.split_fused, saved = forder_split, orig
+    try:
+        again = eng.match_packed(rows)
+    finally:
+        match_mod.split_fused = saved
+
+    np.testing.assert_array_equal(baseline.bits, again.bits)
+    assert baseline.extractions == again.extractions
+    assert baseline.host_always_matches == again.host_always_matches

@@ -91,6 +91,26 @@ def test_batteringram_single_stream():
     ]
 
 
+def test_exact_cap_product_is_not_flagged_truncated(monkeypatch):
+    """A payload product of exactly the cap size dropped nothing —
+    it must not be reported truncated (ADVICE r3), and truncation is
+    its own stats channel, never a 'skipped' entry (the template runs)."""
+    monkeypatch.setattr(active, "MAX_PAYLOAD_COMBOS", 4)
+    t = T(LOGIN_TEMPLATE.replace("attack: pitchfork", "attack: clusterbomb"))
+    plan = active.build_plan([t])  # 2x2 product == cap exactly
+    assert len(plan.requests) == 4
+    assert plan.payload_truncated == []
+    assert "payload-truncated" not in plan.skipped
+
+    monkeypatch.setattr(active, "MAX_PAYLOAD_COMBOS", 3)
+    plan = active.build_plan([t])  # 2x2 product, one combo dropped
+    assert len(plan.requests) == 3
+    assert plan.payload_truncated == ["demo-default-login"]
+    # truncated-but-ran: the template still planned its requests
+    assert 0 in plan.planned_templates
+    assert "payload-truncated" not in plan.skipped
+
+
 def test_wordlist_file_payloads(tmp_path):
     words = tmp_path / "helpers" / "wordlists" / "paths.txt"
     words.parent.mkdir(parents=True)
@@ -111,9 +131,44 @@ def test_wordlist_file_payloads(tmp_path):
     }
     t = parse_template(doc, source_path=str(tdir / "demo-fuzz.yaml"))
     plan = active.build_plan([t])
-    # bounded fan-out: MAX_PAYLOAD_VALUES lines, not the whole file
-    assert len(plan.requests) == active.MAX_PAYLOAD_VALUES
+    # bounded fan-out: at most MAX_PAYLOAD_VALUES lines — with the
+    # default cap (100k, env-overridable) the whole 500-line file fans out
+    assert len(plan.requests) == min(500, active.MAX_PAYLOAD_VALUES)
     assert plan.requests[0].path == "/w0"
+
+
+def test_wordlist_file_payloads_env_clamp(tmp_path, monkeypatch):
+    """SWARM_MAX_PAYLOAD_VALUES clamps the file fan-out."""
+    words = tmp_path / "helpers" / "wordlists" / "paths.txt"
+    words.parent.mkdir(parents=True)
+    words.write_text("".join(f"w{i}\n" for i in range(500)))
+    tdir = tmp_path / "fuzzing"
+    tdir.mkdir()
+    doc = {
+        "id": "demo-fuzz-clamped",
+        "info": {"name": "n", "severity": "info"},
+        "requests": [
+            {
+                "method": "GET",
+                "path": ["{{BaseURL}}/{{path}}"],
+                "payloads": {"path": "helpers/wordlists/paths.txt"},
+                "matchers": [{"type": "status", "status": [200]}],
+            }
+        ],
+    }
+    t = parse_template(doc, source_path=str(tdir / "demo.yaml"))
+    monkeypatch.setattr(active, "MAX_PAYLOAD_VALUES", 37)
+    plan = active.build_plan([t])
+    assert len(plan.requests) == 37
+    assert plan.requests[0].path == "/w0"
+    # values dropped at the per-variable cap surface as truncation too
+    # (the product cap never triggered here)
+    assert plan.payload_truncated == ["demo-fuzz-clamped"]
+    # exactly-cap-sized wordlist: nothing dropped, no flag
+    monkeypatch.setattr(active, "MAX_PAYLOAD_VALUES", 500)
+    plan = active.build_plan([t])
+    assert len(plan.requests) == 500
+    assert plan.payload_truncated == []
 
 
 def test_expression_payload_placeholder():

@@ -83,3 +83,9 @@ def test_rejects_out_of_scope():
     assert compile_linear(r"a" * 200) is None  # > MAX_POSITIONS
     assert compile_linear(r"x?") is None  # matches empty
     assert compile_linear(r"(?m)^line") is None  # multiline anchors
+    # (?a) flips class membership for bytes >= 0x80 (µ is \w under
+    # Unicode, not under ASCII) — masks are Unicode-semantics, so
+    # lowering would be a silent false negative on the exact device
+    # path (same hazard fastre._prefix_classes guards against)
+    assert compile_linear(r"(?a)[^\w]X") is None
+    assert compile_linear(r"(?a:\W)X") is None
