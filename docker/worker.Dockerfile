@@ -16,7 +16,24 @@ COPY swarm_tpu /app/swarm_tpu
 COPY modules /app/modules
 RUN pip install --no-cache-dir requests pyyaml numpy jax cryptography
 
+# Template corpus baked into the image (reference parity:
+# worker/Dockerfile:11 ships artifacts/ wholesale). The default bundles
+# the in-repo snapshot; production builds pass the full nuclei-template
+# tree:  docker build --build-arg TEMPLATES_SRC=path/to/templates ...
+# Template-backed modules resolve ${SWARM_TEMPLATES_DIR} and fail
+# loudly when the directory is missing (swarm_tpu/worker/modules.py).
+ARG TEMPLATES_SRC=tests/data/templates
+COPY ${TEMPLATES_SRC} /app/artifacts/templates
+ENV SWARM_TEMPLATES_DIR=/app/artifacts/templates
+
 RUN mkdir -p /app/downloads
+
+# Build-time self-check: the corpus must load and contain templates —
+# an image with an empty/bogus corpus dir must not build.
+RUN python -c "from swarm_tpu.fingerprints import load_corpus; \
+t, _ = load_corpus('/app/artifacts/templates'); \
+assert t, 'bundled template corpus is empty'; \
+print('bundled corpus ok:', len(t), 'templates')"
 
 # Reference CMD shape (worker/Dockerfile:20-21): config via env vars.
 CMD ["sh", "-c", "python -m swarm_tpu.worker \

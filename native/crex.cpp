@@ -1,0 +1,413 @@
+// crex — a small exact backtracking regex VM over byte strings.
+//
+// Purpose: the fresh-content host walk's cost is dominated by Python
+// `re` extraction/confirm scans (swarm_tpu/ops/fastre.py docstring;
+// BASELINE.md "Fresh-content host walk").  This VM executes the
+// conservative pattern subset the Python compiler (ops/crexc.py)
+// lowers — byte classes, ordered alternation, greedy/lazy repeats,
+// capturing groups, end/boundary anchors — with Python-re backtracking
+// semantics (leftmost, preference-ordered), so finditer/search run
+// entirely in C at memory speed instead of per-candidate Python.
+//
+// Exactness contract: the compiler only emits programs whose semantics
+// this VM reproduces exactly (everything else falls back to Python
+// `re`); equivalence over the corpus regex population is fuzz-pinned
+// by tests/test_fastre.py and tests/test_crex.py.
+//
+// Replaces compute the reference delegates to nuclei's Go regexp
+// (/root/reference/worker/modules/nuclei.json), e.g. the extractor in
+// worker/artifacts/templates/miscellaneous/robots-txt-endpoint.yaml.
+//
+// Pure C ABI — loaded with ctypes.CDLL, so calls release the GIL
+// (the walk can shard across host threads with real parallelism).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+enum Op : int32_t {
+    OP_CHAR = 0,   // a = byte value
+    OP_CLASS = 1,  // a = mask index
+    OP_SPLIT = 2,  // a = preferred pc, b = alternative pc
+    OP_JMP = 3,    // a = pc
+    OP_SAVE = 4,   // a = save slot
+    OP_MATCH = 5,
+    OP_REPG = 6,   // a = mask, b = min, c = max (-1 = inf)  greedy
+    OP_REPL = 7,   // a = mask, b = min, c = max (-1 = inf)  lazy
+    OP_AT = 8,     // a = kind, b = word-mask index (boundaries)
+};
+
+enum AtKind : int32_t {
+    AT_BOS = 0,  // \A  (and ^ without MULTILINE)
+    AT_EOS = 1,  // \Z
+    AT_EOD = 2,  // $ without MULTILINE: end, or just before final \n
+    AT_WB = 3,   // \b
+    AT_NWB = 4,  // \B
+    AT_BOL = 5,  // ^ with MULTILINE
+    AT_EOL = 6,  // $ with MULTILINE
+};
+
+constexpr int MAXF = 8192;   // backtrack frames
+constexpr int MAXT = 8192;   // save-slot trail entries
+constexpr int MAXS = 64;     // save slots (group idx <= 31)
+
+struct Frame {
+    int32_t pc;     // SPLIT: resume pc.  REP: pc of the REP instr.
+    int32_t pos;    // SPLIT: resume pos. REP: entry pos (start).
+    int32_t trail;  // trail length at push
+    int32_t count;  // -1 = SPLIT frame; else current REP consumption
+};
+
+struct TrailEnt {
+    int32_t slot;
+    int32_t old;
+};
+
+static inline bool in_mask(const uint8_t* masks, int32_t idx, uint8_t b) {
+    return (masks[(size_t)idx * 32 + (b >> 3)] >> (b & 7)) & 1;
+}
+
+// Attempt an anchored match at `pos`.  Returns end offset (>= pos),
+// -1 no match, -2 resource limit (caller must fall back to Python re).
+static int32_t match_at(const int32_t* prog, const uint8_t* masks,
+                        const uint8_t* d, int32_t len, int32_t pos,
+                        int32_t* saves, int64_t* budget) {
+    Frame stack[MAXF];
+    TrailEnt trail[MAXT];
+    int nf = 0, nt = 0;
+    int32_t pc = 0;
+    for (;;) {
+        if (--(*budget) < 0) return -2;
+        const int32_t* I = prog + 4 * (size_t)pc;
+        switch (I[0]) {
+            case OP_CHAR:
+                if (pos < len && d[pos] == (uint8_t)I[1]) { ++pos; ++pc; continue; }
+                break;  // fail
+            case OP_CLASS:
+                if (pos < len && in_mask(masks, I[1], d[pos])) { ++pos; ++pc; continue; }
+                break;
+            case OP_SPLIT:
+                if (nf >= MAXF) return -2;
+                stack[nf++] = {I[2], pos, (int32_t)nt, -1};
+                pc = I[1];
+                continue;
+            case OP_JMP:
+                pc = I[1];
+                continue;
+            case OP_SAVE:
+                if (nt >= MAXT) return -2;
+                trail[nt++] = {I[1], saves[I[1]]};
+                saves[I[1]] = pos;
+                ++pc;
+                continue;
+            case OP_MATCH:
+                return pos;
+            case OP_REPG: {
+                int32_t maxc = I[3] < 0 ? INT32_MAX : I[3];
+                int32_t k = 0;
+                while (k < maxc && pos + k < len && in_mask(masks, I[1], d[pos + k]))
+                    ++k;
+                if (k < I[2]) break;  // fail
+                if (nf >= MAXF) return -2;
+                stack[nf++] = {pc, pos, (int32_t)nt, k};
+                pos += k;
+                ++pc;
+                continue;
+            }
+            case OP_REPL: {
+                int32_t k = I[2];
+                if (pos + k > len) break;
+                bool ok = true;
+                for (int32_t j = 0; j < k; ++j)
+                    if (!in_mask(masks, I[1], d[pos + j])) { ok = false; break; }
+                if (!ok) break;
+                if (nf >= MAXF) return -2;
+                stack[nf++] = {pc, pos, (int32_t)nt, k};
+                pos += k;
+                ++pc;
+                continue;
+            }
+            case OP_AT: {
+                bool ok = false;
+                switch (I[1]) {
+                    case AT_BOS: ok = pos == 0; break;
+                    case AT_EOS: ok = pos == len; break;
+                    case AT_EOD:
+                        ok = pos == len || (pos == len - 1 && d[pos] == '\n');
+                        break;
+                    case AT_BOL: ok = pos == 0 || d[pos - 1] == '\n'; break;
+                    case AT_EOL: ok = pos == len || d[pos] == '\n'; break;
+                    case AT_WB:
+                    case AT_NWB: {
+                        bool wl = pos > 0 && in_mask(masks, I[2], d[pos - 1]);
+                        bool wr = pos < len && in_mask(masks, I[2], d[pos]);
+                        ok = (wl != wr) == (I[1] == AT_WB);
+                        break;
+                    }
+                    default: return -2;
+                }
+                if (ok) { ++pc; continue; }
+                break;
+            }
+            default:
+                return -2;  // corrupt program
+        }
+        // ---- fail: backtrack ----
+        for (;;) {
+            if (nf == 0) return -1;
+            Frame& f = stack[nf - 1];
+            if (f.count < 0) {  // SPLIT alternative
+                while (nt > f.trail) { --nt; saves[trail[nt].slot] = trail[nt].old; }
+                pc = f.pc;
+                pos = f.pos;
+                --nf;
+                break;
+            }
+            const int32_t* R = prog + 4 * (size_t)f.pc;
+            if (R[0] == OP_REPG) {
+                if (f.count > R[2]) {
+                    --f.count;
+                    while (nt > f.trail) { --nt; saves[trail[nt].slot] = trail[nt].old; }
+                    pos = f.pos + f.count;
+                    pc = f.pc + 1;
+                    break;
+                }
+            } else {  // OP_REPL — try one longer
+                int32_t maxc = R[3] < 0 ? INT32_MAX : R[3];
+                if (f.count < maxc && f.pos + f.count < len &&
+                    in_mask(masks, R[1], d[f.pos + f.count])) {
+                    ++f.count;
+                    while (nt > f.trail) { --nt; saves[trail[nt].slot] = trail[nt].old; }
+                    pos = f.pos + f.count;
+                    pc = f.pc + 1;
+                    break;
+                }
+            }
+            while (nt > f.trail) { --nt; saves[trail[nt].slot] = trail[nt].old; }
+            --nf;  // frame exhausted, keep unwinding
+        }
+    }
+}
+
+// Scan plan: mandatory byte-membership tables for the first (and when
+// derivable, second) match position, so the position loop runs at
+// table-lookup speed instead of one VM attempt per byte.  Mirrors
+// fastre's two-byte candidate prefilter (same soundness argument: a
+// match must consume these classes at offsets 0/1).
+struct ScanPlan {
+    uint8_t t1[256];  // candidate first bytes (all-1 = no fast path)
+    uint8_t t2[256];
+    bool has1, has2;
+    int32_t c1, c2;   // the single member byte when a table has exactly
+                      // one (-1 otherwise) — unlocks memchr scanning
+    int32_t anchor;   // -1 none, else AT kind gating match starts
+};
+
+static void build_plan(const int32_t* prog, const uint8_t* masks,
+                       ScanPlan* pl) {
+    pl->has1 = pl->has2 = false;
+    pl->c1 = pl->c2 = -1;
+    pl->anchor = -1;
+    int pc = 0;
+    // leading SAVEs never consume; a leading BOS/BOL gates positions
+    while (prog[4 * pc] == OP_SAVE) ++pc;
+    if (prog[4 * pc] == OP_AT &&
+        (prog[4 * pc + 1] == AT_BOS || prog[4 * pc + 1] == AT_BOL)) {
+        pl->anchor = prog[4 * pc + 1];
+        ++pc;
+        while (prog[4 * pc] == OP_SAVE) ++pc;
+    }
+    int32_t nfixed = 0;  // bytes certainly consumed so far (0 or 1)
+    for (int slot = 0; slot < 2; ++slot) {
+        const int32_t* I = prog + 4 * pc;
+        uint8_t* t = slot == 0 ? pl->t1 : pl->t2;
+        int32_t midx = -1, ch = -1;
+        bool exact_one = false;
+        if (I[0] == OP_CHAR) { ch = I[1]; exact_one = true; }
+        else if (I[0] == OP_CLASS) { midx = I[1]; exact_one = true; }
+        else if ((I[0] == OP_REPG || I[0] == OP_REPL) && I[2] >= 1)
+            midx = I[1];  // first byte in class; width not fixed
+        else
+            break;
+        int nset = 0, only = -1;
+        for (int b = 0; b < 256; ++b) {
+            t[b] = ch >= 0 ? (uint8_t)(b == ch)
+                           : (uint8_t)in_mask(masks, midx, (uint8_t)b);
+            if (t[b]) { ++nset; only = b; }
+        }
+        if (slot == 0) {
+            pl->has1 = true;
+            pl->c1 = nset == 1 ? only : -1;
+        } else {
+            pl->has2 = true;
+            pl->c2 = nset == 1 ? only : -1;
+        }
+        if (!exact_one) break;  // next position unknown
+        nfixed += 1;
+        ++pc;
+        while (prog[4 * pc] == OP_SAVE) ++pc;
+        if (prog[4 * pc] == OP_AT) break;  // boundary between: stop
+    }
+    (void)nfixed;
+}
+
+// Advance `pos` to the next possible match start per the plan
+// (`len + 1` = no further start possible).
+static int32_t plan_skip(const ScanPlan* pl, const uint8_t* d, int32_t len,
+                         int32_t pos) {
+    if (pl->anchor == AT_BOS) return pos == 0 ? 0 : len + 1;
+    if (pl->anchor == AT_BOL && pos > 0) {
+        const void* p = memchr(d + pos - 1, '\n', (size_t)(len - (pos - 1)));
+        pos = p ? (int32_t)((const uint8_t*)p - d) + 1 : len + 1;
+        if (pos > len) return len + 1;
+    }
+    if (!pl->has1) return pos;
+    if (pl->has2) {
+        if (pl->c1 >= 0) {
+            // fixed first byte: memchr it, verify the second table
+            while (pos + 1 < len) {
+                const void* p =
+                    memchr(d + pos, pl->c1, (size_t)(len - 1 - pos));
+                if (!p) return len + 1;
+                int32_t q = (int32_t)((const uint8_t*)p - d);
+                if (pl->t2[d[q + 1]]) return q;
+                pos = q + 1;
+            }
+            return len + 1;
+        }
+        // NOTE: memchr on a fixed SECOND byte was measured 2-4x slower
+        // than this loop on realistic HTML (dense '/' makes memchr
+        // restart every few bytes); only a fixed FIRST byte wins above.
+        while (pos + 1 < len && !(pl->t1[d[pos]] && pl->t2[d[pos + 1]]))
+            ++pos;
+        return pos + 1 < len ? pos : len + 1;
+    }
+    if (pl->c1 >= 0) {
+        const void* p = memchr(d + pos, pl->c1, (size_t)(len - pos));
+        return p ? (int32_t)((const uint8_t*)p - d) : len + 1;
+    }
+    while (pos < len && !pl->t1[d[pos]]) ++pos;
+    return pos < len ? pos : len + 1;
+}
+
+}  // namespace
+
+namespace {
+
+// Shared finditer core: non-overlapping leftmost matches (Python
+// re.finditer semantics incl. the empty-match +1 advance).  Writes
+// (start, end) pairs of group `g2/2` into out[off..]; returns the
+// match count, -2 on resource exhaustion, -3 on cap overflow.
+int64_t finditer_core(const int32_t* prog, const uint8_t* masks,
+                      const ScanPlan* plan, const uint8_t* data,
+                      int32_t len, int32_t g2, int32_t nsaves,
+                      int32_t* out, int64_t off, int64_t cap,
+                      int64_t step_budget) {
+    int32_t saves[MAXS];
+    int64_t n = 0;
+    int64_t budget = step_budget;
+    int32_t pos = 0;
+    while (pos <= len) {
+        int32_t start = plan_skip(plan, data, len, pos);
+        if (start > len) break;
+        for (int32_t i = 0; i < nsaves; ++i) saves[i] = -1;
+        int32_t end = match_at(prog, masks, data, len, start, saves, &budget);
+        if (end == -2) return -2;
+        if (end < 0) {
+            pos = start + 1;
+            continue;
+        }
+        if (off + n >= cap) return -3;
+        if (g2 == 0) {
+            out[2 * (off + n)] = start;
+            out[2 * (off + n) + 1] = end;
+        } else {
+            out[2 * (off + n)] = saves[g2];
+            out[2 * (off + n) + 1] = saves[g2 + 1];
+        }
+        ++n;
+        pos = (end == start) ? start + 1 : end;
+    }
+    return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Single-content finditer.  Returns match count, -2 on resource
+// exhaustion (caller falls back to Python re), -3 on cap overflow.
+int64_t sw_crex_finditer(const int32_t* prog, int32_t nprog,
+                         const uint8_t* masks, const uint8_t* data,
+                         int32_t len, int32_t g2, int32_t nsaves,
+                         int32_t* out, int64_t cap, int64_t step_budget) {
+    (void)nprog;
+    if (nsaves > MAXS) return -2;
+    ScanPlan plan;
+    build_plan(prog, masks, &plan);
+    return finditer_core(prog, masks, &plan, data, len, g2, nsaves,
+                         out, 0, cap, step_budget);
+}
+
+// Batched finditer: ONE dispatch runs the same pattern over `nitems`
+// contents (the per-batch extraction shape — dispatch overhead was
+// the dominant cost of per-call crex at walk rates).  Span pairs for
+// all items are written contiguously; counts[i] is item i's match
+// count, or -1 when THAT item exhausted its step budget/frames (the
+// caller re-runs just that item under Python re).  Returns the total
+// span count, or -3 when `cap` overflowed (caller grows and retries).
+int64_t sw_crex_finditer_batch(const int32_t* prog, int32_t nprog,
+                               const uint8_t* masks,
+                               const char* const* datas,
+                               const int32_t* lens, int32_t nitems,
+                               int32_t g2, int32_t nsaves,
+                               int32_t* out, int64_t cap,
+                               int64_t* counts, int64_t step_budget) {
+    (void)nprog;
+    if (nsaves > MAXS) {
+        for (int32_t i = 0; i < nitems; ++i) counts[i] = -1;
+        return 0;
+    }
+    ScanPlan plan;
+    build_plan(prog, masks, &plan);
+    int64_t total = 0;
+    for (int32_t i = 0; i < nitems; ++i) {
+        int64_t n = finditer_core(
+            prog, masks, &plan, (const uint8_t*)datas[i], lens[i], g2,
+            nsaves, out, total, cap, step_budget);
+        if (n == -3) return -3;
+        if (n < 0) {
+            counts[i] = -1;
+            continue;
+        }
+        counts[i] = n;
+        total += n;
+    }
+    return total;
+}
+
+// search: 1 if a match exists anywhere, 0 if none, -2 resource limit.
+int32_t sw_crex_search(const int32_t* prog, int32_t nprog,
+                       const uint8_t* masks, const uint8_t* data,
+                       int32_t len, int32_t nsaves, int64_t step_budget) {
+    (void)nprog;
+    if (nsaves > MAXS) return -2;
+    int32_t saves[MAXS];
+    int64_t budget = step_budget;
+    ScanPlan plan;
+    build_plan(prog, masks, &plan);
+    int32_t pos = 0;
+    while (pos <= len) {
+        int32_t start = plan_skip(&plan, data, len, pos);
+        if (start > len) return 0;
+        for (int32_t i = 0; i < nsaves; ++i) saves[i] = -1;
+        int32_t end = match_at(prog, masks, data, len, start, saves, &budget);
+        if (end == -2) return -2;
+        if (end >= 0) return 1;
+        pos = start + 1;
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -18,7 +18,8 @@ import base64 as _b64
 import hashlib
 import re
 import time as _time
-import warnings
+
+from swarm_tpu.fingerprints.regexlin import quiet_warnings
 from typing import Any, Callable, Optional
 
 
@@ -201,14 +202,14 @@ def compile_cached(pattern: str) -> "re.Pattern[str]":
     """Unbounded pattern→compiled cache shared by the DSL evaluator and
     the CPU oracle (the corpus outgrows re's 512-entry internal cache).
 
-    FutureWarnings ("possible nested set" — corpus patterns with
-    literal '[[') are suppressed: the patterns are upstream template
-    text whose current semantics are exactly what the oracle must
-    reproduce, and the nag re-fires on every corpus compile."""
+    The nested-set FutureWarning family ("possible nested set" —
+    corpus patterns with literal '[[') is suppressed through
+    regexlin.quiet_warnings, the lock-serialized guard (compiles also
+    run from worker thread pools, where bare catch_warnings races on
+    the process-global filter list)."""
     compiled = _REGEX_CACHE.get(pattern)
     if compiled is None:
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore", FutureWarning)
+        with quiet_warnings():
             compiled = _REGEX_CACHE[pattern] = re.compile(pattern)
     return compiled
 

@@ -26,8 +26,9 @@ from __future__ import annotations
 
 import dataclasses
 import re
-import warnings
 from pathlib import Path
+
+from swarm_tpu.fingerprints.regexlin import quiet_warnings
 from typing import Optional
 
 BUNDLED_DB = Path(__file__).resolve().parent.parent / "data" / "service-probes.txt"
@@ -58,8 +59,7 @@ class ServiceMatch:
         try:
             # nmap DB patterns with literal '[[' trip re's nested-set
             # FutureWarning; their current semantics are the contract
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore", FutureWarning)
+            with quiet_warnings():
                 return re.compile(self.pattern.encode("latin-1"), f)
         except (re.error, UnicodeEncodeError):
             return None

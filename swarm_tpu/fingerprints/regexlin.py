@@ -33,7 +33,9 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import threading
 import warnings
+from contextlib import contextmanager
 from typing import Optional
 
 import numpy as np
@@ -46,6 +48,27 @@ except ImportError:  # pragma: no cover
     import sre_constants as sre_c  # type: ignore
 
 
+_WARN_LOCK = threading.Lock()
+
+
+@contextmanager
+def quiet_warnings(category=FutureWarning):
+    """Thread-correct narrow warning suppression.
+
+    ``catch_warnings`` saves/restores the PROCESS-GLOBAL filter list;
+    unsynchronized enter/exit from worker thread pools can interleave
+    so a temporary ignore-filter is restored as the permanent state
+    (or a concurrent compile warns nondeterministically). The shared
+    lock serializes the save/mutate/restore window. A module-import
+    ``filterwarnings`` is no alternative: pytest wraps every test in
+    its own catch_warnings that resets to configured filters, which
+    would resurface the noise the suite must stay free of."""
+    with _WARN_LOCK:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", category)
+            yield
+
+
 def parse_quiet(pattern: str):
     """``sre_parse.parse`` with the nested-set FutureWarning silenced.
 
@@ -54,8 +77,7 @@ def parse_quiet(pattern: str):
     semantics are exactly what every lowering here must reproduce, and
     the warning re-fires on each corpus compile otherwise. Shared by
     all sre-tree walks (regexlin, fastre, compile)."""
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore", FutureWarning)
+    with quiet_warnings():
         return sre_parse.parse(pattern)
 
 MAX_POSITIONS = 96  # 3 uint32 state lanes

@@ -1,0 +1,318 @@
+"""crex compiler: Python ``re`` pattern -> native VM program.
+
+Lowers a conservative sre-parse-tree subset to the flat instruction
+format ``native/crex.cpp`` executes: byte classes, ordered alternation
+(preference-first SPLIT), greedy/lazy repeats (single-class repeats as
+counted REP instructions, general bounded repeats unrolled, unbounded
+general repeats as SPLIT loops), capturing groups (SAVE slots), and
+end/boundary anchors. Anything outside the subset — backreferences,
+lookarounds, (?a) semantics, empty-matchable loop bodies, oversized
+programs — returns None and the caller stays on Python ``re``.
+
+Exactness: masks are built by the same machinery the device lowering
+trusts (``regexlin._class_mask`` — per-byte membership matching re's
+latin-1 semantics), and the VM's backtracking order (leftmost start,
+preference-ordered alternatives, longest-first greedy) is Python re's
+own strategy, so results are byte-identical for the supported subset.
+Equivalence is fuzz-pinned over the corpus regex population by
+tests/test_crex.py and tests/test_fastre.py.
+
+Replaces compute the reference runs through nuclei's Go regexp
+(/root/reference/worker/modules/nuclei.json); the hot shapes are the
+corpus extraction regexes, e.g. templates/miscellaneous/
+robots-txt-endpoint.yaml's path extractor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import numpy as np
+
+from swarm_tpu.fingerprints.regexlin import (
+    _class_mask,
+    _case_fold,
+    _category_mask,
+    _Unsupported,
+    parse_quiet,
+)
+
+# instruction opcodes — keep in lockstep with native/crex.cpp
+OP_CHAR, OP_CLASS, OP_SPLIT, OP_JMP, OP_SAVE, OP_MATCH = 0, 1, 2, 3, 4, 5
+OP_REPG, OP_REPL, OP_AT = 6, 7, 8
+AT_BOS, AT_EOS, AT_EOD, AT_WB, AT_NWB, AT_BOL, AT_EOL = 0, 1, 2, 3, 4, 5, 6
+
+MAX_PROG = 768      # instructions
+MAX_GROUP = 31      # save slots 2..63 (group 0 handled by the driver)
+_MAXREPEAT = 2**32 - 1  # sre MAXREPEAT compares equal to this
+
+_DOT = np.ones(256, dtype=bool)
+_DOT[ord("\n")] = False
+_DOTALL = np.ones(256, dtype=bool)
+
+
+@dataclasses.dataclass
+class CrexProgram:
+    prog: np.ndarray       # int32 [n, 4] flattened C-order
+    masks: np.ndarray      # uint8 [n_masks, 32] bitsets
+    n_saves: int           # save slots used (2 * (max group + 1))
+    group_exists: dict     # gid -> True for groups the pattern defines
+
+
+class _Compiler:
+    def __init__(self):
+        self.instrs: list[list[int]] = []
+        self.masks: list[bytes] = []
+        self._mask_idx: dict[bytes, int] = {}
+        self.max_group = 0
+
+    def emit(self, op: int, a: int = 0, b: int = 0, c: int = 0) -> int:
+        if len(self.instrs) >= MAX_PROG:
+            raise _Unsupported("program too large")
+        self.instrs.append([op, a, b, c])
+        return len(self.instrs) - 1
+
+    def mask_id(self, mask: np.ndarray) -> int:
+        key = np.packbits(mask, bitorder="little").tobytes()
+        idx = self._mask_idx.get(key)
+        if idx is None:
+            idx = self._mask_idx[key] = len(self.masks)
+            self.masks.append(key)
+        return idx
+
+    # ---- tree walk ----
+
+    def compile_seq(self, seq, ci: bool, dotall: bool, multiline: bool):
+        for op, arg in seq:
+            name = str(op)
+            if name == "LITERAL":
+                if arg > 255:
+                    # cannot occur in latin-1 text; the whole pattern
+                    # can never match — emit an impossible class
+                    self.emit(OP_CLASS, self.mask_id(np.zeros(256, bool)))
+                elif ci:
+                    m = np.zeros(256, dtype=bool)
+                    m[arg] = True
+                    self.emit(OP_CLASS, self.mask_id(_case_fold(m)))
+                else:
+                    self.emit(OP_CHAR, arg)
+            elif name == "NOT_LITERAL":
+                m = np.zeros(256, dtype=bool)
+                if 0 <= arg <= 255:
+                    m[arg] = True
+                if ci:
+                    m = _case_fold(m)
+                self.emit(OP_CLASS, self.mask_id(~m))
+            elif name == "IN":
+                self.emit(OP_CLASS, self.mask_id(_class_mask(arg, ci)))
+            elif name == "ANY":
+                self.emit(OP_CLASS, self.mask_id(_DOTALL if dotall else _DOT))
+            elif name == "SUBPATTERN":
+                gid, add_f, del_f, sub = arg
+                if add_f & re.ASCII:
+                    raise _Unsupported("(?a:) scope")
+                sub_ci = (ci or bool(add_f & re.IGNORECASE)) and not bool(
+                    del_f & re.IGNORECASE
+                )
+                sub_dotall = (dotall or bool(add_f & re.DOTALL)) and not bool(
+                    del_f & re.DOTALL
+                )
+                sub_ml = (multiline or bool(add_f & re.MULTILINE)) and not bool(
+                    del_f & re.MULTILINE
+                )
+                if gid is not None:
+                    if gid > MAX_GROUP:
+                        raise _Unsupported("too many groups")
+                    self.max_group = max(self.max_group, gid)
+                    self.emit(OP_SAVE, 2 * gid)
+                self.compile_seq(sub, sub_ci, sub_dotall, sub_ml)
+                if gid is not None:
+                    self.emit(OP_SAVE, 2 * gid + 1)
+            elif name == "BRANCH":
+                branches = arg[1]
+                jmps = []
+                for i, br in enumerate(branches):
+                    if i < len(branches) - 1:
+                        sp = self.emit(OP_SPLIT)
+                    else:
+                        sp = None
+                    start = len(self.instrs)
+                    self.compile_seq(br, ci, dotall, multiline)
+                    if i < len(branches) - 1:
+                        jmps.append(self.emit(OP_JMP))
+                        self.instrs[sp][1] = start
+                        self.instrs[sp][2] = len(self.instrs)
+                after = len(self.instrs)
+                for j in jmps:
+                    self.instrs[j][1] = after
+            elif name in ("MAX_REPEAT", "MIN_REPEAT"):
+                lo, hi, sub = arg
+                if hi >= _MAXREPEAT:
+                    hi = -1  # unbounded
+                self.compile_repeat(
+                    lo, hi, sub, name == "MIN_REPEAT", ci, dotall, multiline
+                )
+            elif name == "AT":
+                at = str(arg).rsplit(".", 1)[-1]
+                wb = self.mask_id(_category_mask("CATEGORY_WORD"))
+                if at in ("AT_BEGINNING",):
+                    self.emit(OP_AT, AT_BOL if multiline else AT_BOS)
+                elif at == "AT_BEGINNING_STRING":
+                    self.emit(OP_AT, AT_BOS)
+                elif at == "AT_END":
+                    self.emit(OP_AT, AT_EOL if multiline else AT_EOD)
+                elif at == "AT_END_STRING":
+                    self.emit(OP_AT, AT_EOS)
+                elif at == "AT_BOUNDARY":
+                    self.emit(OP_AT, AT_WB, wb)
+                elif at == "AT_NON_BOUNDARY":
+                    self.emit(OP_AT, AT_NWB, wb)
+                else:
+                    raise _Unsupported(f"anchor {at}")
+            else:
+                # GROUPREF / ASSERT / ASSERT_NOT / GROUPREF_EXISTS /
+                # ATOMIC_GROUP / POSSESSIVE repeats / ...
+                raise _Unsupported(f"op {name}")
+
+    def _single_class(self, sub, ci: bool, dotall: bool):
+        """The class mask when ``sub`` is one single-byte item, else
+        None (drives the counted-REP fast instruction)."""
+        if len(sub) != 1:
+            return None
+        op, arg = sub[0]
+        name = str(op)
+        if name == "LITERAL":
+            if arg > 255:
+                return np.zeros(256, dtype=bool)
+            m = np.zeros(256, dtype=bool)
+            m[arg] = True
+            return _case_fold(m) if ci else m
+        if name == "NOT_LITERAL":
+            m = np.zeros(256, dtype=bool)
+            if 0 <= arg <= 255:
+                m[arg] = True
+            if ci:
+                m = _case_fold(m)
+            return ~m
+        if name == "IN":
+            return _class_mask(arg, ci)
+        if name == "ANY":
+            return _DOTALL if dotall else _DOT
+        return None
+
+    def compile_repeat(self, lo, hi, sub, lazy, ci, dotall, multiline):
+        mask = self._single_class(sub, ci, dotall)
+        if mask is not None:
+            self.emit(OP_REPL if lazy else OP_REPG,
+                      self.mask_id(mask), lo, hi)
+            return
+        # general body
+        if _can_empty(sub):
+            # an empty-matchable body inside a repeat needs Python re's
+            # empty-iteration break rule — out of subset
+            raise _Unsupported("empty-matchable repeat body")
+        for _ in range(lo):
+            self.compile_seq(sub, ci, dotall, multiline)
+        if hi < 0:
+            # unbounded: L: SPLIT(body, after); body; JMP L
+            l0 = len(self.instrs)
+            sp = self.emit(OP_SPLIT)
+            self.compile_seq(sub, ci, dotall, multiline)
+            self.emit(OP_JMP, l0)
+            after = len(self.instrs)
+            if lazy:
+                self.instrs[sp][1], self.instrs[sp][2] = after, sp + 1
+            else:
+                self.instrs[sp][1], self.instrs[sp][2] = sp + 1, after
+        else:
+            splits = []
+            for _ in range(hi - lo):
+                splits.append(self.emit(OP_SPLIT))
+                self.compile_seq(sub, ci, dotall, multiline)
+            after = len(self.instrs)
+            for sp in splits:
+                if lazy:
+                    self.instrs[sp][1], self.instrs[sp][2] = after, sp + 1
+                else:
+                    self.instrs[sp][1], self.instrs[sp][2] = sp + 1, after
+
+
+def _can_empty(seq) -> bool:
+    """Whether ``seq`` can match the empty string (conservative: any
+    unknown construct counts as maybe-empty)."""
+    for op, arg in seq:
+        name = str(op)
+        if name in ("LITERAL", "NOT_LITERAL", "IN", "ANY"):
+            return False  # consumes a byte: the sequence can't be empty
+        if name == "AT":
+            continue
+        if name in ("MAX_REPEAT", "MIN_REPEAT"):
+            lo, _hi, sub = arg
+            if lo > 0 and not _can_empty(sub):
+                return False
+            continue
+        if name == "SUBPATTERN":
+            _g, _af, _df, sub = arg
+            if not _can_empty(sub):
+                return False
+            continue
+        if name == "BRANCH":
+            if not any(_can_empty(b) for b in arg[1]):
+                return False
+            continue
+        return True  # unknown: assume it may be empty
+    return True
+
+
+_COMPILE_CACHE: dict = {}
+_CACHE_MAX = 16384
+
+
+def compile_crex(pattern: str) -> Optional[CrexProgram]:
+    """Pattern -> native VM program, or None when out of subset."""
+    hit = _COMPILE_CACHE.get(pattern)
+    if hit is not None or pattern in _COMPILE_CACHE:
+        return hit
+    out = _compile(pattern)
+    if len(_COMPILE_CACHE) < _CACHE_MAX:
+        _COMPILE_CACHE[pattern] = out
+    return out
+
+
+def _compile(pattern: str) -> Optional[CrexProgram]:
+    try:
+        tree = parse_quiet(pattern)
+    except re.error:
+        return None
+    flags = tree.state.flags
+    if flags & (re.ASCII | re.LOCALE):
+        return None  # mask semantics are Unicode-for-latin-1 only
+    ci = bool(flags & re.IGNORECASE)
+    dotall = bool(flags & re.DOTALL)
+    multiline = bool(flags & re.MULTILINE)
+    c = _Compiler()
+    try:
+        c.compile_seq(list(tree), ci, dotall, multiline)
+        c.emit(OP_MATCH)
+    except _Unsupported:
+        return None
+    except re.error:
+        return None
+    prog = np.array(c.instrs, dtype=np.int32).reshape(-1, 4)
+    masks = (
+        np.frombuffer(b"".join(c.masks), dtype=np.uint8).reshape(-1, 32)
+        if c.masks
+        else np.zeros((1, 32), dtype=np.uint8)
+    )
+    groups = {g: True for g in range(1, c.max_group + 1)}
+    return CrexProgram(
+        prog=np.ascontiguousarray(prog),
+        masks=np.ascontiguousarray(masks),
+        n_saves=2 * (c.max_group + 1),
+        group_exists=groups,
+    )
+
+
+__all__ = ["compile_crex", "CrexProgram", "MAX_PROG"]

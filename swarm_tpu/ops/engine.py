@@ -92,6 +92,12 @@ class EngineStats:
     ext_seconds: float = 0.0
     insert_seconds: float = 0.0
     fixup_seconds: float = 0.0
+    # ext-phase sub-splits (all included in ext_seconds): the native
+    # hit-enumeration C pass, undecided-op host confirms, and the
+    # extraction proper (batched crex + oracle fallbacks)
+    ext_enum_seconds: float = 0.0
+    ext_resolve_seconds: float = 0.0
+    ext_extract_seconds: float = 0.0
 
 
 def _bit(packed: np.ndarray, b: int, i: int) -> bool:
@@ -357,6 +363,131 @@ class MatchEngine:
                     vals = cpu_ref.extract_one(ex, row)
                 self._cache_put(cache, key, vals)
             out.extend(vals)
+        return out
+
+    def _extract_pending(self, pending: list, nrows: list) -> dict:
+        """(b, t_idx) -> ordered extraction values for the native
+        walk's resolved hit list.
+
+        Semantics are exactly ``_extract_op`` applied in hit order —
+        same content-keyed memo, same extractor/pattern ordering, same
+        oracle fallbacks — but every crex-able regex extraction runs as
+        ONE batched native dispatch per distinct (pattern, group) over
+        all pending contents (native/crex.cpp sw_crex_finditer_batch):
+        at fresh-content walk rates the per-call dispatch overhead was
+        the dominant extraction cost."""
+        out: dict = {}
+        if not pending:
+            return out
+        import os as _os
+
+        if _os.environ.get("SWARM_EXT_BATCH", "1") == "0":
+            # measurement escape hatch: per-hit _extract_op calls
+            for b, t_idx, op_id in pending:
+                vals = out.setdefault((b, t_idx), [])
+                vals.extend(self._extract_op(self._op_obj[op_id], nrows[b]))
+            return out
+        from swarm_tpu.ops import fastre as _fastre
+
+        cache = self._ext_cache
+        segs: dict = {}   # (b, t_idx) -> [("v", vals) | ("k", key)]
+        fills: dict = {}  # key -> {"ex", "part", "by_pat"}
+        tasks: dict = {}  # (pattern, group) -> {"cp", "items", "parts"}
+        for b, t_idx, op_id in pending:
+            row = nrows[b]
+            seg = segs.setdefault((b, t_idx), [])
+            for ex in self._op_obj[op_id].extractors:
+                if ex.type in ("regex", "json", "xpath"):
+                    key = (id(ex), row.part(ex.part))
+                elif ex.type == "kval":
+                    key = (id(ex), row.part("header"), row.oob_ips)
+                else:
+                    seg.append(("v", cpu_ref.extract_one(ex, row)))
+                    continue
+                vals = cache.get(key)
+                if vals is not None:
+                    seg.append(("v", vals))
+                    continue
+                if key in fills:
+                    seg.append(("k", key))
+                    continue
+                if ex.type != "regex":
+                    vals = cpu_ref.extract_one(ex, row)
+                    self._cache_put(cache, key, vals)
+                    seg.append(("v", vals))
+                    continue
+                part = key[1]
+                infos = [_fastre.analyze(p) for p in ex.regex]
+                if not isinstance(ex.group, int) or not all(
+                    i.ok and i.cprog is not None for i in infos
+                ):
+                    vals = self._accel_extract_regex(ex, part)
+                    self._cache_put(cache, key, vals)
+                    seg.append(("v", vals))
+                    continue
+                fills[key] = {
+                    "ex": ex, "part": part, "by_pat": [None] * len(ex.regex),
+                }
+                for p_idx, info in enumerate(infos):
+                    t = tasks.setdefault(
+                        (ex.regex[p_idx], ex.group),
+                        {"cp": info.cprog, "items": [], "parts": []},
+                    )
+                    t["items"].append((key, p_idx))
+                    t["parts"].append(part)
+                seg.append(("k", key))
+
+        import time as _time
+
+        _dbg = _os.environ.get("SWARM_EXT_DEBUG")
+        if _dbg:
+            _tA = _time.perf_counter()
+            print(f"    extA hits={len(pending)} keys={len(fills)} "
+                  f"tasks={len(tasks)} segs={len(segs)}", flush=True)
+        done: dict = {}
+        if fills:
+            from swarm_tpu.native import crex as ncrex
+
+            failed: set = set()
+            for (pattern, group), t in tasks.items():
+                res = ncrex.finditer_spans_batch(t["cp"], t["parts"], group)
+                if _dbg:
+                    nsp = sum(len(s) for s in res if s) if res else -1
+                    print(f"    extB {pattern[:40]!r} items="
+                          f"{len(t['parts'])} spans={nsp} "
+                          f"none={res is None}", flush=True)
+                if res is None:
+                    failed.update(k for k, _p in t["items"])
+                    continue
+                for (key, p_idx), spans in zip(t["items"], res):
+                    if spans is None:
+                        failed.add(key)  # per-item native budget hit
+                        continue
+                    f = fills[key]
+                    text = f.get("text")
+                    if text is None:
+                        text = f["text"] = f["part"].decode("latin-1")
+                    f["by_pat"][p_idx] = [
+                        None if s < 0 else text[s:e] for s, e in spans
+                    ]
+            for key, f in fills.items():
+                if key in failed:
+                    # any pattern short of native resources: the whole
+                    # extractor re-runs on the exact per-call path
+                    vals = self._accel_extract_regex(f["ex"], f["part"])
+                else:
+                    vals = [v for pv in f["by_pat"] for v in pv]
+                self._cache_put(cache, key, vals)
+                done[key] = vals
+
+        if _dbg:
+            print(f"    extC batchcalls {_time.perf_counter()-_tA:.4f}s "
+                  f"failed={len(failed) if fills else 0}", flush=True)
+        for bt, seg in segs.items():
+            vals = []
+            for kind, v in seg:
+                vals.extend(v if kind == "v" else done[v])
+            out[bt] = vals
         return out
 
     @staticmethod
@@ -936,24 +1067,30 @@ class MatchEngine:
                     np.ascontiguousarray(pop_value),
                     np.ascontiguousarray(pop_unc),
                 )
-                cur = None
-                parts: list = []
+                t_sub = time.perf_counter()
+                self.stats.ext_enum_seconds += t_sub - t_ext
+                # certainty resolution stays in (b-major, t, op) order;
+                # the regex extractions themselves then run BATCHED —
+                # one native dispatch per distinct pattern over every
+                # pending content (per-call overhead dominated the
+                # fresh-content walk at per-hit rates)
+                pending: list = []
                 for b, t_idx, op_id, st in zip(
                     bs.tolist(), ts.tolist(), opsv.tolist(), sts.tolist()
                 ):
-                    if cur != (b, t_idx):
-                        if parts:
-                            uextractions[(cur[0], tids[cur[1]])] = parts
-                        cur = (b, t_idx)
-                        parts = []
-                    row = nrows[b]
-                    if st == 2 and not resolve_op(b, op_id, row):
+                    if st == 2 and not resolve_op(b, op_id, nrows[b]):
                         continue
-                    parts.extend(
-                        self._extract_op(self._op_obj[op_id], row)
-                    )
-                if parts:
-                    uextractions[(cur[0], tids[cur[1]])] = parts
+                    pending.append((b, t_idx, op_id))
+                t_sub2 = time.perf_counter()
+                self.stats.ext_resolve_seconds += t_sub2 - t_sub
+                for (b, t_idx), vals in self._extract_pending(
+                    pending, nrows
+                ).items():
+                    if vals:
+                        uextractions[(b, tids[t_idx])] = vals
+                self.stats.ext_extract_seconds += (
+                    time.perf_counter() - t_sub2
+                )
             else:
                 hit_b, hit_t = np.nonzero(
                     np.unpackbits(masked, axis=1, count=NT)

@@ -85,6 +85,10 @@ class PatternInfo:
     # partner class as a 256-byte membership table (bytes indexing is
     # ~5x cheaper than a numpy bool-mask scalar lookup per candidate)
     partner_table: Optional[bytes] = None
+    # native VM program (ops/crexc + native/crex.cpp) — when set, the
+    # whole finditer/search runs in one GIL-released C call; None keeps
+    # the candidate-scan + anchored re.match path
+    cprog: Optional[object] = None
 
 
 def _prefix_classes(pattern: str) -> list:
@@ -214,7 +218,14 @@ def analyze(pattern: str) -> PatternInfo:
         rex, ok = None, False
     literals = required_literal_set(pattern, min_len=4) if ok else None
     prefix = _prefix_classes(pattern) if ok else []
-    info = PatternInfo(ok=ok, rex=rex, literals=literals, prefix=prefix)
+    cprog = None
+    if ok:
+        from swarm_tpu.ops.crexc import compile_crex
+
+        cprog = compile_crex(pattern)
+    info = PatternInfo(
+        ok=ok, rex=rex, literals=literals, prefix=prefix, cprog=cprog
+    )
     if prefix:
         counts = [int(m.sum()) for m in prefix]
         if len(prefix) == 2 and counts[0] == 1 and counts[1] == 1:
@@ -325,7 +336,16 @@ def finditer_values(
     the extraction loop's semantics (cpu_ref.extract_one) — or None
     when the pattern can't be accelerated (caller falls back)."""
     info = analyze(pattern)
-    if not info.ok or not info.prefix:
+    if not info.ok:
+        return None
+    if info.cprog is not None and isinstance(group, int):
+        from swarm_tpu.native import crex as ncrex
+
+        spans = ncrex.finditer_spans(info.cprog, data, group)
+        if spans is not None:
+            return [None if s < 0 else text[s:e] for s, e in spans]
+        # resource fallback: keep going on the candidate path below
+    if not info.prefix:
         return None
     cands = _candidates(info, data)
     if cands is None:
@@ -355,7 +375,15 @@ def search_bool(pattern: str, data: bytes, text: str) -> Optional[bool]:
     """Exactly ``re.search(pattern, text) is not None``, or None when
     not acceleratable."""
     info = analyze(pattern)
-    if not info.ok or not info.prefix:
+    if not info.ok:
+        return None
+    if info.cprog is not None:
+        from swarm_tpu.native import crex as ncrex
+
+        got = ncrex.search(info.cprog, data)
+        if got is not None:
+            return got
+    if not info.prefix:
         return None
     cands = _candidates(info, data)
     if cands is None:

@@ -140,11 +140,36 @@ class ShardedMatcher:
         self.ranks = {name: int(self.mesh.shape[name]) for name in self.mesh.axis_names}
         self.halo = max_entry_len(self.db) if self.ranks.get("seq", 1) > 1 else 0
         self._tables_np = shard_tables_np(self.db, self.ranks.get("model", 1))
+        # multi-host (jax.distributed) meshes span devices this process
+        # cannot address: inputs must become GLOBAL jax.Arrays (every
+        # process holds the full host copy; each device takes its
+        # slice) and outputs gather back host-local. Single-process
+        # meshes keep the plain local-array path.
+        self.multiprocess = any(
+            d.process_index != jax.process_index()
+            for d in self.mesh.devices.flat
+        )
         # constant after construction — upload once, not per match call
-        self._tables_j = [
-            {k: jnp.asarray(v) for k, v in t.items()} for t in self._tables_np
-        ]
+        if self.multiprocess:
+            self._tables_j = [
+                {k: self._global(v, P("model")) for k, v in t.items()}
+                for t in self._tables_np
+            ]
+        else:
+            self._tables_j = [
+                {k: jnp.asarray(v) for k, v in t.items()}
+                for t in self._tables_np
+            ]
         self._fn_cache: dict = {}
+
+    def _global(self, arr, spec):
+        """Host copy -> global array laid out per ``spec`` over the
+        (possibly multi-process) mesh."""
+        arr = np.asarray(arr)
+        sharding = NamedSharding(self.mesh, spec)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
 
     # ------------------------------------------------------------------
     def _build(self, shape_key, full: bool = False):
@@ -309,12 +334,37 @@ class ShardedMatcher:
             # bound live executables like DeviceDB (shape churn would
             # grow RSS without limit — constants are captured per jit)
             lru_store(self._fn_cache, cache_key, fn, MAX_COMPILED)
-        out = fn(
-            self._tables_j,
-            {k: jnp.asarray(v) for k, v in streams.items()},
-            {k: jnp.asarray(v) for k, v in lengths.items()},
-            jnp.asarray(status),
-        )
+        if self.multiprocess:
+            args = (
+                self._tables_j,
+                {k: self._global(v, P("data", "seq")) for k, v in streams.items()},
+                {k: self._global(v, P("data")) for k, v in lengths.items()},
+                self._global(status, P("data")),
+            )
+        else:
+            args = (
+                self._tables_j,
+                {k: jnp.asarray(v) for k, v in streams.items()},
+                {k: jnp.asarray(v) for k, v in lengths.items()},
+                jnp.asarray(status),
+            )
+        out = fn(*args)
+        if self.multiprocess:
+            # global -> host-local (replicated) so every process can
+            # read the full result; riding DCN once per batch
+            from jax.experimental import multihost_utils
+
+            if full:
+                out = multihost_utils.global_array_to_host_local_array(
+                    out, self.mesh, P()
+                )
+            else:
+                out = tuple(
+                    multihost_utils.global_array_to_host_local_array(
+                        o, self.mesh, P()
+                    )
+                    for o in out
+                )
         if full:
             from swarm_tpu.ops.match import split_fused
 

@@ -255,20 +255,35 @@ def upstream_cipher_table() -> Optional[tuple]:
     cipher per line, in the Salesforce list's order)."""
     global _UPSTREAM_TABLE, _UPSTREAM_TABLE_LOADED
     if not _UPSTREAM_TABLE_LOADED:
-        _UPSTREAM_TABLE_LOADED = True
         import os
 
         path = os.environ.get("SWARM_JARM_CIPHER_TABLE", "")
         if path:
+            # the operator EXPLICITLY configured upstream comparability;
+            # a broken table must fail loudly, not silently produce
+            # non-comparable hashes (round-3 verdict, Missing #5)
             try:
                 with open(path) as fh:
-                    _UPSTREAM_TABLE = tuple(
+                    entries = tuple(
                         ln.strip().lower()
                         for ln in fh
                         if ln.strip() and not ln.strip().startswith("#")
                     )
-            except OSError:
-                _UPSTREAM_TABLE = None
+            except OSError as e:
+                raise RuntimeError(
+                    f"SWARM_JARM_CIPHER_TABLE={path!r} is unreadable: {e}"
+                ) from e
+            bad = [c for c in entries if len(c) != 4
+                   or any(ch not in "0123456789abcdef" for ch in c)]
+            if bad or not entries:
+                raise RuntimeError(
+                    f"SWARM_JARM_CIPHER_TABLE={path!r} is malformed: "
+                    f"{'empty' if not entries else 'bad entries '}"
+                    f"{bad[:3]} (want one lowercase 4-hex cipher per "
+                    "line, upstream order)"
+                )
+            _UPSTREAM_TABLE = entries
+        _UPSTREAM_TABLE_LOADED = True
     return _UPSTREAM_TABLE
 
 

@@ -21,20 +21,37 @@ principled subset needs no JS runtime and executes here:
   URL-valued properties (src/href/action) resolved against the page
   base the way the browser's property getters would.
 
-Anything needing a JS runtime — script ``hook:``s (postmessage
-trackers, prototype-pollution), ``screenshot`` rendering, response
-header rewriting for frame tricks — is classified ``js-required`` by
-:func:`classify` and keeps the honest skip marker. The documented
-bound of the emulation: nodes inserted by page JavaScript are
-invisible (the DOM here is the served HTML, not a rendered tree).
+- **API-instrumentation hooks** (the postmessage-tracker /
+  postmessage-outgoing-tracker / window-name-domxss idiom): the hook
+  script installs a wrapper that logs when the PAGE's own code calls
+  the instrumented API at load time (``addEventListener('message')``,
+  ``postMessage(.., '*')``, a ``window.name`` flow into
+  eval/document.write/innerHTML). Without a JS runtime the same
+  load-time facts are read statically from the page's actual script
+  content — inline ``<script>`` bodies, ``on*`` handler attributes,
+  and same-origin external scripts (fetched) — and the synthesized
+  ``window.alerts`` entries are serialized the way nuclei's Go side
+  prints the evaluated value (``map[k:v]``/space-joined arrays), so
+  the corpus matchers/extractors run unmodified. Documented bound:
+  registrations created only by DYNAMIC code paths (script-built
+  script tags, eval'd registrations) are invisible, exactly as DOM
+  nodes built by JS are below.
+
+Anything else needing a JS runtime — prototype-pollution's
+location-driven pollution loop, ``screenshot`` rendering — is
+classified ``js-required`` by :func:`classify` and keeps the honest
+skip marker. The documented bound of the emulation: nodes inserted by
+page JavaScript are invisible (the DOM here is the served HTML, not a
+rendered tree).
 
 Matchers evaluate on the final page via the exact CPU oracle with
 nuclei's headless part names mapped (``resp``/``page``/``data`` → the
-full response); extractors over a named script's output read the
-emulated script result.
+full response); matchers/extractors over a named script's output read
+the emulated script result.
 
 Reference: /root/reference/worker/artifacts/templates/headless/*.yaml
-(7 templates: 2 executable browserlessly, 5 js-required).
+(7 templates: 2 executable browserlessly + 3 hook-emulated,
+2 js-required).
 """
 
 from __future__ import annotations
@@ -126,12 +143,44 @@ def _attr_collect_spec(code: str) -> Optional[dict]:
     }
 
 
+#: window.alerts read-back idiom closing every hook template
+_ALERTS_READ_RE = re.compile(r"^\s*window\.alerts\s*;?\s*$")
+
+
+def _hook_spec(code: str) -> Optional[dict]:
+    """Classify a ``hook: true`` script by the instrumentation it
+    installs, or None when the hook's behavior can't be emulated
+    statically (e.g. prototype-pollution's location-driven loop).
+
+    Recognition is structural (what APIs the wrapper intercepts), not
+    textual equality — upstream reformatting of the same hook keeps
+    working; genuinely different hooks stay js-required."""
+    if "location" in code and "__proto__" in code:
+        return None  # pollution check navigates with polluted URLs
+    if (
+        "Window.prototype.addEventListener" in code
+        and re.search(r"type\s*===?\s*['\"]message['\"]", code)
+    ):
+        return {"kind": "listen-message"}
+    if re.search(r"window\.postMessage\s*=", code) and re.search(
+        r"origin\s*==?=?\s*['\"]\*['\"]", code
+    ):
+        return {"kind": "post-star"}
+    if "window.name" in code and re.search(
+        r"innerHTML|document\.write|eval", code
+    ):
+        return {"kind": "window-name-sink"}
+    return None
+
+
 def classify(t: Template) -> Optional[str]:
     """None when the template executes browserlessly, else the reason
     it can't (js-required / unsupported-action-* / no-steps)."""
     if t.protocol != "headless":
         return "not-headless"
     saw_steps = False
+    needs_js_env = False  # response-header rewrites etc.
+    saw_hook = saw_alerts_read = False
     for op in t.operations:
         for step in op.steps:
             saw_steps = True
@@ -141,24 +190,36 @@ def classify(t: Template) -> Optional[str]:
                 continue
             if act == "setheader":
                 # request headers we can send; response-header
-                # rewriting only matters to a JS runtime's same-origin
-                # machinery
+                # rewriting (CSP relaxation for the hook's injected
+                # frames) only matters to a JS runtime — a no-op under
+                # hook emulation, js-required otherwise
                 if str(args.get("part") or "request") != "request":
-                    return "js-required"
+                    needs_js_env = True
                 continue
             if act in ("text", "click"):
                 if str(args.get("by") or "") not in ("x", "xpath"):
                     return "unsupported-selector"
                 continue
             if act == "script":
-                if args.get("hook") or not _attr_collect_spec(
-                    str(args.get("code") or "")
-                ):
-                    return "js-required"
-                continue
+                code = str(args.get("code") or "")
+                if args.get("hook"):
+                    if _hook_spec(code) is None:
+                        return "js-required"
+                    saw_hook = True
+                    continue
+                if _attr_collect_spec(code) is not None:
+                    continue
+                if _ALERTS_READ_RE.match(code):
+                    saw_alerts_read = True
+                    continue
+                return "js-required"
             return f"unsupported-action-{act or '?'}"
     if not saw_steps:
         return "no-steps"
+    if saw_hook and not saw_alerts_read:
+        return "js-required"  # hook without the known read-back idiom
+    if needs_js_env and not saw_hook:
+        return "js-required"
     return None
 
 
@@ -231,6 +292,7 @@ class _Session:
         self.cookies: dict = {}
         self.headers: dict = {}
         self.page: Optional[_Page] = None
+        self.hooks: list = []  # installed hook-emulation specs
         default = (tls and port == 443) or (not tls and port == 80)
         self.base_url = (
             f"{'https' if tls else 'http'}://{host}"
@@ -300,6 +362,16 @@ class _Session:
         self.page = _Page(url, status, header, rbody)
         return True
 
+    def fetch_resource(self, url: str) -> Optional["_Page"]:
+        """Subresource fetch (external scripts): same request machinery
+        and cookie jar, but the session's page state is untouched."""
+        saved = self.page
+        try:
+            ok = self.fetch(url)
+            return self.page if ok else None
+        finally:
+            self.page = saved
+
 
 def _run_steps(t: Template, steps, sess: _Session, outputs: dict) -> bool:
     """Execute one op's step list; False on a dead/failed navigation."""
@@ -309,6 +381,8 @@ def _run_steps(t: Template, steps, sess: _Session, outputs: dict) -> bool:
         if act in ("waitload", "sleep"):
             continue
         if act == "setheader":
+            if str(args.get("part") or "request") != "request":
+                continue  # response rewriting: no-op without a renderer
             key, val = str(args.get("key") or ""), str(args.get("value") or "")
             if key:
                 sess.headers[key] = val
@@ -358,7 +432,17 @@ def _run_steps(t: Template, steps, sess: _Session, outputs: dict) -> bool:
             # any other element: focus — no page effect
             continue
         if act == "script":
-            spec = _attr_collect_spec(str(args.get("code") or ""))
+            code = str(args.get("code") or "")
+            if args.get("hook"):
+                hook = _hook_spec(code)
+                if hook is not None:
+                    sess.hooks.append(hook)
+                continue
+            if _ALERTS_READ_RE.match(code):
+                name = str(step.get("name") or args.get("name") or "alerts")
+                outputs[name] = _emulate_alerts(sess)
+                continue
+            spec = _attr_collect_spec(code)
             if spec is not None and sess.page is not None:
                 name = str(step.get("name") or args.get("name") or "script")
                 outputs[name] = _collect_attrs(sess.page, spec)
@@ -434,6 +518,131 @@ def _collect_attrs(page: _Page, spec: dict) -> str:
     return spec["prefix"] + spec["sep"].join(vals) + spec["suffix"]
 
 
+# ---------------------------------------------------------------------------
+# hook emulation: static load-time instrumentation of the page's
+# actual script content (see module docstring for the honesty bound)
+
+_MAX_EXT_SCRIPTS = 5
+_MAX_SCRIPT_BYTES = 512 * 1024
+
+
+def _go_fmt(v) -> str:
+    """Serialize the way nuclei's Go side prints an Evaluate result
+    (fmt.Sprint of the JSON-decoded value): maps as ``map[k:v ...]``
+    with sorted keys, arrays space-joined in brackets — the corpus's
+    ``part: alerts`` word matchers are written against THIS shape
+    (e.g. ``at Window.addEventListener``, ``sink:``)."""
+    if isinstance(v, dict):
+        return "map[" + " ".join(
+            f"{k}:{_go_fmt(x)}" for k, x in sorted(v.items())
+        ) + "]"
+    if isinstance(v, (list, tuple)):
+        return "[" + " ".join(_go_fmt(x) for x in v) + "]"
+    return str(v)
+
+
+def _page_scripts(sess: "_Session") -> list:
+    """(label, text) of every load-time script the page runs: inline
+    ``<script>`` bodies, ``on*`` handler attributes, and same-origin
+    external scripts (fetched, bounded)."""
+    page = sess.page
+    out: list = []
+    if page is None or page.root is None:
+        return out
+    ext: list = []
+    for el in page.root.iter():
+        tag = str(getattr(el, "tag", "")).lower()
+        for attr, val in (el.attrib or {}).items():
+            if attr.lower().startswith("on") and val:
+                out.append((f"{page.url}#{attr.lower()}", val))
+        if tag != "script":
+            continue
+        src = el.get("src")
+        if src:
+            target = urljoin(page.url, src)
+            if _same_origin(target, page.url) and target not in ext:
+                ext.append(target)
+            continue
+        text = (el.text or "") + "".join(
+            (c.tail or "") for c in el
+        )
+        if text.strip():
+            out.append((page.url, text))
+    for target in ext[:_MAX_EXT_SCRIPTS]:
+        res = sess.fetch_resource(target)
+        if res is not None and res.body:
+            out.append(
+                (target, res.body[:_MAX_SCRIPT_BYTES].decode("latin-1"))
+            )
+    return out
+
+
+_LISTEN_RE = re.compile(r"addEventListener\s*\(\s*['\"]message['\"]")
+_ONMESSAGE_RE = re.compile(r"\bonmessage\s*=")
+_POSTMSG_RE = re.compile(r"\bpostMessage\s*\(")
+_NAME_ALIAS_RE = re.compile(
+    r"(?:var|let|const)\s+(\w+)\s*=\s*window\.name\b"
+)
+
+
+def _window_name_sinks(text: str) -> list:
+    """(sink, snippet) for flows of window.name into eval /
+    document.write / innerHTML — direct or via one local alias."""
+    names = [r"window\.name"]
+    names += [re.escape(m.group(1)) for m in _NAME_ALIAS_RE.finditer(text)]
+    out = []
+    for name in names:
+        for sink, pat in (
+            ("eval", rf"\beval\s*\(\s*[^;\n]*?\b{name}\b"),
+            ("document.write", rf"document\.write\s*\(\s*[^;\n]*?\b{name}\b"),
+            ("innerHTML", rf"\.innerHTML\s*[+]?=\s*[^;\n]*?\b{name}\b"),
+        ):
+            for m in re.finditer(pat, text):
+                out.append((sink, m.group(0)[:120]))
+    return out
+
+
+def _emulate_alerts(sess: "_Session") -> str:
+    """The ``window.alerts`` array the installed hooks would hold after
+    load, synthesized from the page's static script content."""
+    page = sess.page
+    if page is None or not sess.hooks:
+        return "[]"
+    scripts = _page_scripts(sess)
+    alerts: list = []
+    for hook in sess.hooks:
+        kind = hook["kind"]
+        if kind == "listen-message":
+            for label, text in scripts:
+                n = len(_LISTEN_RE.findall(text)) + len(
+                    _ONMESSAGE_RE.findall(text)
+                )
+                alerts.extend(
+                    [f"at Window.addEventListener ({label})",
+                     f"at {page.url}"]
+                    for _ in range(n)
+                )
+        elif kind == "post-star":
+            for label, text in scripts:
+                for m in _POSTMSG_RE.finditer(text):
+                    window = text[m.end(): m.end() + 200]
+                    if re.search(r"['\"]\*['\"]", window):
+                        alerts.append({
+                            "args": {"origin": "*"},
+                            "stack": [f"at window.postMessage ({label})"],
+                        })
+        elif kind == "window-name-sink":
+            for label, text in scripts:
+                for sink, snippet in _window_name_sinks(text):
+                    alerts.append({
+                        "code": snippet,
+                        "sink": sink,
+                        "source": "window.name",
+                        "stack": [f"at {label}"],
+                    })
+    return _go_fmt(alerts)
+
+
 _PART_ALIAS = {"resp": "response", "page": "response", "data": "response"}
 
 
@@ -484,10 +693,20 @@ class HeadlessScanner:
             verdicts = []
             names = []
             for m in op.matchers:
-                mm = dataclasses.replace(
-                    m, part=_PART_ALIAS.get(m.part or "", m.part)
-                )
-                v = cpu_ref.match_matcher(mm, row)
+                if (m.part or "") in outputs:
+                    # matcher over a named script's emulated output
+                    # (part: alerts) — same oracle, output as content
+                    mm = dataclasses.replace(m, part="body")
+                    out_row = Response(
+                        host=host, port=port, status=sess.page.status,
+                        body=outputs[m.part].encode("utf-8", "replace"),
+                    )
+                    v = cpu_ref.match_matcher(mm, out_row)
+                else:
+                    mm = dataclasses.replace(
+                        m, part=_PART_ALIAS.get(m.part or "", m.part)
+                    )
+                    v = cpu_ref.match_matcher(mm, row)
                 v = bool(v) if v is not None else False
                 verdicts.append(v)
                 if v and m.name:

@@ -39,14 +39,46 @@ class ModuleSpec:
         self.raw = raw
         self.backend = raw.get("backend", "command")
         self.command_template: Optional[str] = raw.get("command")
-        templates = raw.get("templates")
         # allow $SWARM_TEMPLATES_DIR-style indirection in module files
-        self.templates_dir: Optional[str] = (
-            os.path.expandvars(templates) if templates else None
-        )
+        self._templates_raw: Optional[str] = raw.get("templates")
+        self._templates_resolved: Optional[str] = None
         self.input_format: str = raw.get("input_format", "jsonl")
         self.output_format: str = raw.get("output_format", "matches_jsonl")
         self.probe: dict = raw.get("probe", {})
+
+    @property
+    def templates_dir(self) -> Optional[str]:
+        """Resolved template-corpus path, verified to exist.
+
+        A template-backed module whose corpus is unresolvable (unset
+        SWARM_TEMPLATES_DIR, or a path that isn't a directory) must
+        fail LOUDLY at job time — the reference worker ships the whole
+        corpus in its image (/root/reference/worker/Dockerfile:11) and
+        nuclei errors out without templates; silently matching nothing
+        would look like a clean empty scan.
+
+        Validation runs once per spec (the runtime reads this several
+        times per job); the first success is cached."""
+        if self._templates_raw is None:
+            return None
+        if self._templates_resolved is not None:
+            return self._templates_resolved
+        d = os.path.expandvars(self._templates_raw)
+        if "$" in d:
+            raise ValueError(
+                f"module {self.name}: templates path "
+                f"{self._templates_raw!r} references an unset "
+                "environment variable (set SWARM_TEMPLATES_DIR or bake "
+                "the corpus into the image — docker/worker.Dockerfile "
+                "TEMPLATES_SRC)"
+            )
+        if not os.path.isdir(d):
+            raise ValueError(
+                f"module {self.name}: templates directory {d!r} does "
+                "not exist (corpus not bundled/mounted?)"
+            )
+        self._templates_resolved = d
+        return d
 
     def command(self, input_path: str, output_path: str) -> str:
         """Substitute {input}/{output} (reference worker.py:27-33)."""

@@ -23,7 +23,8 @@ import dataclasses
 import json
 import socket
 import ssl as pyssl
-import warnings
+
+from swarm_tpu.fingerprints.regexlin import quiet_warnings
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
 
@@ -45,8 +46,7 @@ for _pin, _member in (
     ("tls12", "TLSv1_2"),
     ("tls13", "TLSv1_3"),
 ):
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore", DeprecationWarning)
+    with quiet_warnings(DeprecationWarning):
         _v = getattr(pyssl.TLSVersion, _member, None)
     if _v is not None:
         _VERSIONS[_pin] = _v
@@ -142,9 +142,10 @@ def handshake(
         pass
     try:
         # legacy pins are deliberate here (probing what the SERVER
-        # still speaks) — the client-side deprecation nag is noise
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore", DeprecationWarning)
+        # still speaks); quiet_warnings is the lock-serialized guard —
+        # this runs in a ThreadPoolExecutor, where bare catch_warnings
+        # would race on the process-global filter list
+        with quiet_warnings(DeprecationWarning):
             if min_version:
                 ctx.minimum_version = _VERSIONS[min_version]
             if max_version:

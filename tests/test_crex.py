@@ -1,0 +1,212 @@
+"""crex (native regex VM) exactness vs Python re.
+
+The VM (native/crex.cpp + ops/crexc.py) must be byte-identical to
+``re`` for every pattern it accepts — spans, group participation,
+finditer non-overlap order, search verdicts — over adversarial
+content. Patterns outside the subset must compile to None (fallback),
+never to a wrong program.
+
+Reference workload: the corpus regex population the engine extracts/
+confirms with (e.g. /root/reference/worker/artifacts/templates/
+miscellaneous/robots-txt-endpoint.yaml).
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import warnings
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from swarm_tpu.native import crex as ncrex
+from swarm_tpu.ops.crexc import compile_crex
+
+REFERENCE_CORPUS = Path("/root/reference/worker/artifacts/templates")
+BUNDLED_CORPUS = Path(__file__).parent / "data" / "templates"
+
+pytestmark = pytest.mark.skipif(
+    ncrex.ensure_crex() is None, reason="native crex unavailable"
+)
+
+
+def ref_spans(pattern, text, group):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", FutureWarning)
+        rex = re.compile(pattern)
+    out = []
+    for m in rex.finditer(text):
+        try:
+            out.append(m.span(group))
+        except IndexError:
+            out.append(m.span(0))
+    return out
+
+
+def check(pattern, data: bytes, group=0):
+    cp = compile_crex(pattern)
+    if cp is None:
+        return False
+    text = data.decode("latin-1")
+    spans = ncrex.finditer_spans(cp, data, group)
+    if spans is None:
+        return False  # resource fallback — allowed, not wrong
+    assert spans == ref_spans(pattern, text, group), (pattern, data[:80])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", FutureWarning)
+        want = re.search(pattern, text) is not None
+    got = ncrex.search(cp, data)
+    assert got is None or got == want, (pattern, data[:80])
+    return True
+
+
+HAND = [
+    # (pattern, text, group)
+    (r"(?m:\s(/[[:alpha:]]+[[:graph:]]+))",
+     "User-agent: *\nDisallow: /admin/s\nAllow: /p x /a1 \t/Zz", 1),
+    (r"Grafana ([v0-9.]+)", "Grafana v9.1.0 and Grafana v8", 1),
+    (r"(?i)server: ?(nginx|apache)[/ ]?([\d.]*)", "Server: NGINX/1.18.0", 2),
+    (r"a(b(c)?)*d", "abcbd abd ad abcbcd", 2),
+    (r"x+?y", "xxxy xy", 0),
+    (r"x*?y", "xxxy y", 0),
+    (r"(a|ab)(c|bcd)", "abcd", 0),          # preference order
+    (r"(a+)(a*)", "aaaa", 2),               # greedy split
+    (r"(?:ab|a)(?:b)?c", "abc abbc ac", 0),
+    (r"[^>]*>", "<tag attr=1>rest>", 0),
+    (r"\bcat\B", "cats cat concat", 0),
+    (r"(?s)a.c", "a\nc abc", 0),
+    (r"a.c", "a\nc abc", 0),
+    (r"^x|y$", "xab\ncdy", 0),
+    (r"(?m)^x|y$", "xab\nxcdy\ny", 0),
+    (r"\d{2,4}px", "1px 12px 12345px", 0),
+    (r"q{0,2}u", "qqqu u qu", 0),
+    (r"(ab){2,3}", "ababababab", 0),
+    (r"(a)|(b)", "ab", 1),
+    (r"(a)|(b)", "ab", 2),
+    (r"\Z", "abc", 0),                      # empty match at end
+    (r"(?i)[a-f]{3}", "AbC dEf xyz \xc0\xe0", 0),
+    (r"[\xe0-\xff]+", "caf\xe9 na\xefve \xfc", 0),
+    (r"\w+", "w\xb5rd \xff9 a_b", 0),       # unicode word incl. µ
+    (r"v=([a-z0-9-._]+)", "v=1.2-a_b. v=", 1),
+    (r"/([^/]+)/", "/a//b/ /c/", 1),
+    (r"(x?)(y)", "y xy", 1),                # empty group participation
+    (r"TOKEN[\-|_A-Z0-9]{4}", "TOKEN-A_Z9 TOKENabcd", 0),
+    (r"a$", "a\n", 0),                      # $ before trailing newline
+    (r"a\Z", "a\n", 0),                     # \Z does not
+]
+
+
+@pytest.mark.parametrize("case", HAND, ids=[c[0][:30] for c in HAND])
+def test_hand_cases(case):
+    pattern, text, group = case
+    assert check(pattern, text.encode("latin-1"), group), (
+        f"pattern unexpectedly out of subset: {pattern}"
+    )
+
+
+def test_out_of_subset_rejected():
+    for pat in (
+        r"(a)\1",            # backreference
+        r"(?=ahead)x",       # lookahead
+        r"(?<=b)x",          # lookbehind
+        r"(?a)\w+",          # ASCII semantics
+        r"(?:a?)*x",         # empty-matchable unbounded body
+        r"(?P<n>a)(?(n)b|c)",  # conditional
+    ):
+        assert compile_crex(pat) is None, pat
+
+
+def test_unparticipated_group_spans():
+    cp = compile_crex(r"(a)?(b)")
+    spans = ncrex.finditer_spans(cp, b"b ab", 1)
+    assert spans == ref_spans(r"(a)?(b)", "b ab", 1) == [(-1, -1), (2, 3)]
+
+
+def corpus_patterns():
+    corpus = REFERENCE_CORPUS if REFERENCE_CORPUS.is_dir() else BUNDLED_CORPUS
+    from swarm_tpu.fingerprints.nuclei import load_corpus
+
+    templates, _errors = load_corpus(corpus)
+    pats, seen = [], set()
+    for t in templates:
+        for op in t.operations:
+            for m in op.matchers:
+                for p in m.regex:
+                    if p not in seen:
+                        seen.add(p)
+                        pats.append(p)
+            for ex in op.extractors:
+                for p in getattr(ex, "regex", ()) or ():
+                    if p not in seen:
+                        seen.add(p)
+                        pats.append(p)
+    return pats
+
+
+def fuzz_texts():
+    rng = np.random.default_rng(7)
+    texts = [
+        b"",
+        b"<html><head><title>Welcome to nginx!</title></head></html>",
+        b"HTTP/1.1 200 OK\r\nServer: Apache/2.4.41 (Ubuntu)\r\n"
+        b"Set-Cookie: sid=abc; path=/\r\nX: y\r\n\r\nbody v1.2.3",
+        b"User-agent: *\nDisallow: /admin\nAllow: /public/index.php\n",
+        b"\x00\x01\xff\xfe bin\x0abytes\x0d\x0a\x80\x90\xb5X",
+        bytes(rng.integers(0, 256, size=768, dtype=np.uint8)),
+        bytes(rng.integers(32, 127, size=1024, dtype=np.uint8)),
+        bytes(range(256)),
+        b"\n".join(b"/path%d sub" % i for i in range(30)),
+    ]
+    return texts
+
+
+@pytest.mark.parametrize("group", [0, 1])
+def test_corpus_equivalence(group):
+    """Every corpus pattern crex accepts must agree with re on every
+    fuzz text — spans AND search — plus content synthesized from the
+    pattern's own literals (so matches actually occur)."""
+    pats = corpus_patterns()
+    assert pats
+    texts = fuzz_texts()
+    rng = random.Random(13)
+    compiled = checked = 0
+    for p in pats:
+        cp = compile_crex(p)
+        if cp is None:
+            continue
+        compiled += 1
+        # synthesize likely-matching content from pattern literals
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", FutureWarning)
+            lit = re.sub(r"\\[wWdDsSbBAZ]|[\^\$\|\(\)\[\]\{\}\*\+\?\\]", "",
+                         p)
+        extra = ("x " + lit + " /a1 9.9.9 " + lit.lower()).encode(
+            "latin-1", "replace"
+        )
+        for data in texts + [extra]:
+            if check(p, data, group):
+                checked += 1
+        # one random splice of the literal into binary noise
+        base = bytearray(
+            bytes(rng.randrange(256) for _ in range(200))
+        )
+        pos = rng.randrange(0, 100)
+        base[pos:pos] = lit.encode("latin-1", "replace")[:40]
+        check(p, bytes(base), group)
+    assert compiled > 400, f"crex compiled only {compiled} corpus patterns"
+    assert checked > compiled * 5
+
+
+def test_compiles_the_hot_walk_patterns():
+    """The patterns that dominate the fresh-content walk must stay on
+    the native path (BASELINE.md 'Fresh-content host walk')."""
+    for p in (
+        r"(?m:\s(/[[:alpha:]]+[[:graph:]]+))",
+        r'(?i)<meta\s+?name="?generator"?\s+?content="([^"]+?)"',
+        r"<h1>RouterOS v(.+)<\/h1>",
+        r"Grafana ([v0-9.]+)",
+        r"v=([a-z0-9-._]+)",
+    ):
+        assert compile_crex(p) is not None, p

@@ -242,3 +242,31 @@ def test_prewarm_builds_engine(tmp_path):
     assert any(k.startswith("active::") for k in proc._engines)
     assert proc.prewarm("cmd") is False       # nothing to warm
     assert proc.prewarm("missing") is False   # load failure is contained
+
+
+def test_template_backed_module_fails_loudly_without_corpus(
+    tmp_path, monkeypatch
+):
+    """A template-backed module with an unset ${SWARM_TEMPLATES_DIR} or
+    a missing directory raises at access — never a silent empty-corpus
+    scan (the reference image ships the corpus wholesale,
+    /root/reference/worker/Dockerfile:11)."""
+    import pytest as _pytest
+
+    monkeypatch.delenv("SWARM_TEMPLATES_DIR", raising=False)
+    spec = ModuleSpec("active", {"backend": "active",
+                                 "templates": "${SWARM_TEMPLATES_DIR}"})
+    with _pytest.raises(ValueError, match="unset"):
+        _ = spec.templates_dir
+
+    monkeypatch.setenv("SWARM_TEMPLATES_DIR", str(tmp_path / "nope"))
+    with _pytest.raises(ValueError, match="does not exist"):
+        _ = spec.templates_dir
+
+    good = tmp_path / "corpus"
+    good.mkdir()
+    monkeypatch.setenv("SWARM_TEMPLATES_DIR", str(good))
+    assert spec.templates_dir == str(good)
+
+    # non-template modules are unaffected
+    assert ModuleSpec("dnsx", {"backend": "probe"}).templates_dir is None

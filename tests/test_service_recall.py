@@ -1,0 +1,191 @@
+"""Non-circular service-detection recall (round-3 verdict, Weak #6).
+
+The production-scale DB's recall corpus is emitted by the same
+generator that wrote its signatures — fine as a perf harness, useless
+as a quality claim. This suite measures the BUNDLED head DB against a
+hand-written adversarial set of real-world banner shapes (transcribed
+from protocol knowledge: RFC greetings, vendor banner formats, wire
+preambles — NOT from tools/gen_service_probes.py), including odd
+spacing, multi-line greetings, truncations, and binary protocols.
+
+Also proves the SYSTEM_DB pickup path with a real-format
+nmap-service-probes file (the reference installs real nmap for -sV:
+/root/reference/worker/Dockerfile:13, worker/modules/nmap.json).
+
+The measured recall numbers are reported in BASELINE.md §"Service
+detection quality".
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from swarm_tpu.fingerprints.model import Response
+from swarm_tpu.fingerprints import nmap_probes
+from swarm_tpu.ops.service import ServiceClassifier
+
+BUNDLED = str(nmap_probes.BUNDLED_DB)
+LARGE = str(Path(BUNDLED).parent / "service-probes-large.txt")
+
+# (banner, port, want_service, want_product_fragment | None)
+# Product fragment None = service-level expectation only (softmatch ok).
+# HTTP responses arrive from the GetRequest probe in a real scan (nmap
+# probe-selection semantics); banner-on-connect services from NULL —
+# _probe_for() assigns accordingly, mirroring the scanner's flow.
+ADVERSARIAL = [
+    # --- SSH: version-suffix zoo, truncation, unusual vendors
+    (b"SSH-2.0-OpenSSH_8.9p1 Ubuntu-3ubuntu0.10\r\n", 22, "ssh", "OpenSSH"),
+    (b"SSH-2.0-OpenSSH_for_Windows_8.1\r\n", 22, "ssh", "OpenSSH"),
+    (b"SSH-2.0-OpenSSH_7.4\n", 22, "ssh", "OpenSSH"),  # bare \n
+    (b"SSH-2.0-dropbear_2022.83\r\n", 22, "ssh", "Dropbear"),
+    (b"SSH-1.99-Cisco-1.25\r\n", 22, "ssh", "Cisco"),
+    (b"SSH-2.0-ROSSSH\r\n", 22, "ssh", "MikroTik"),
+    (b"SSH-2.0-billsSSH_3.6.3q3\r\n", 2222, "ssh", None),  # soft only
+    # --- HTTP: header case, proxies, weird servers
+    (b"HTTP/1.1 200 OK\r\nServer: nginx/1.18.0 (Ubuntu)\r\n"
+     b"Content-Type: text/html\r\n\r\n<html>", 80, "http", "nginx"),
+    (b"HTTP/1.1 403 Forbidden\r\nDate: x\r\n"
+     b"Server: Apache/2.4.41 (Ubuntu)\r\n\r\n", 443, "http", "Apache"),
+    (b"HTTP/1.1 200 OK\r\nServer: Microsoft-IIS/10.0\r\n\r\n", 80,
+     "http", "IIS"),
+    (b"HTTP/1.0 400 Bad Request\r\nServer: cloudflare\r\n\r\n", 80,
+     "http", None),
+    (b"HTTP/1.1 200 OK\r\nServer: openresty/1.21.4.1\r\n\r\n", 8080,
+     "http", "openresty"),
+    (b"HTTP/1.1 502 Bad Gateway\r\nserver: envoy\r\n\r\n", 9000,
+     "http", None),  # lowercase header name
+    (b"HTTP/1.1 200 OK\r\nServer: lighttpd/1.4.59\r\n\r\n", 80,
+     "http", "lighttpd"),
+    # --- SMTP: continuation lines, vendor formats, date tails
+    (b"220 mail.example.com ESMTP Postfix (Ubuntu)\r\n", 25,
+     "smtp", "Postfix"),
+    (b"220-mx1.example.com ESMTP Exim 4.94.2 Thu, 31 Jul 2026\r\n"
+     b"220-Hi there\r\n220 ok\r\n", 25, "smtp", "Exim"),
+    (b"220 srv.example.net ESMTP Sendmail 8.15.2/8.15.2;"
+     b" Thu, 31 Jul 2026 09:00:00\r\n", 25, "smtp", "Sendmail"),
+    (b"220 mx.google.com ESMTP abc123 - gsmtp\r\n", 25, "smtp", None),
+    # --- FTP: parens, multiline 220-, vendor strings
+    (b"220 (vsFTPd 3.0.3)\r\n", 21, "ftp", "vsftpd"),
+    (b"220 ProFTPD 1.3.5e Server (Debian) [::ffff:10.0.0.5]\r\n", 21,
+     "ftp", "ProFTPD"),
+    (b"220-FileZilla Server 1.4.1\r\n220 Please visit https://...\r\n",
+     21, "ftp", "FileZilla"),
+    (b"220 Microsoft FTP Service\r\n", 21, "ftp", "Microsoft"),
+    (b"220 Welcome to Pure-FTPd [privsep] [TLS]\r\n", 21, "ftp",
+     "Pure-FTPd"),
+    # --- mail retrieval
+    (b"+OK Dovecot (Ubuntu) ready.\r\n", 110, "pop3", "Dovecot"),
+    (b"* OK [CAPABILITY IMAP4rev1 SASL-IR LOGIN-REFERRALS] "
+     b"Dovecot ready.\r\n", 143, "imap", "Dovecot"),
+    (b"+OK Microsoft Exchange Server 2010 POP3 service ready\r\n",
+     110, "pop3", "Exchange"),
+    # --- databases / caches (binary preambles)
+    (b"J\x00\x00\x00\x0a8.0.36\x00\x08\x00\x00\x00abcdefgh\x00\xff\xf7",
+     3306, "mysql", "MySQL"),
+    (b"n\x00\x00\x00\x0a5.5.5-10.6.12-MariaDB-0ubuntu0.22.04.1\x00"
+     b"\x04\x00\x00\x00", 3306, "mysql", "MariaDB"),
+    (b"E\x00\x00\x00\xffj\x04Host '10.0.0.9' is not allowed to connect"
+     b" to this MySQL server", 3306, "mysql", "MySQL"),
+    (b"-NOAUTH Authentication required.\r\n", 6379, "redis", "Redis"),
+    (b"-ERR unknown command 'HELP'\r\n", 6379, "redis", "Redis"),
+    (b"ERROR\r\n", 11211, "memcached", "Memcached"),
+    # --- misc TCP services
+    (b"\xff\xfd\x18\xff\xfd \xff\xfd#\xff\xfd'", 23, "telnet", None),
+    (b"@RSYNCD: 31.0\n", 873, "rsync", None),
+    (b"SSH-2.0-", 22, "ssh", None),  # truncated at the worst point
+]
+
+
+@pytest.fixture(scope="module")
+def head_classifier():
+    return ServiceClassifier(db_path=BUNDLED)
+
+
+def _probe_for(banner: bytes) -> str:
+    return "GetRequest" if banner.startswith(b"HTTP/") else "NULL"
+
+
+def _recall(classifier, cases):
+    rows = [
+        Response(host=f"h{i}.example", port=port, banner=banner)
+        for i, (banner, port, _s, _p) in enumerate(cases)
+    ]
+    infos = classifier.classify(
+        rows, sent_probes=[_probe_for(b) for b, _p2, _s, _pr in cases]
+    )
+    svc_hits = prod_hits = prod_total = 0
+    misses = []
+    for (banner, port, want_s, want_p), info in zip(cases, infos):
+        if info.service == want_s:
+            svc_hits += 1
+        else:
+            misses.append((banner[:40], want_s, info.service))
+        if want_p is not None:
+            prod_total += 1
+            if info.product and want_p.lower() in info.product.lower():
+                prod_hits += 1
+    return svc_hits, prod_hits, prod_total, misses
+
+
+def test_adversarial_recall_head_db(head_classifier):
+    svc, prod, prod_total, misses = _recall(head_classifier, ADVERSARIAL)
+    n = len(ADVERSARIAL)
+    print(f"\nhead-DB adversarial recall: service {svc}/{n} "
+          f"({svc/n:.0%}), product {prod}/{prod_total} "
+          f"({prod/prod_total:.0%}); misses: {misses}")
+    # floors pin today's measured quality; raise them as the DB grows —
+    # regressions below these mean real-world detection got worse
+    assert svc / n >= 0.90, misses
+    assert prod / prod_total >= 0.85, misses
+
+
+def test_adversarial_recall_large_db_not_worse_on_services():
+    """The generated 12.3k-signature DB layers ON TOP of real shapes —
+    it must not regress service-level recall vs the head DB on banners
+    its generator never saw."""
+    if not Path(LARGE).is_file():
+        pytest.skip("large DB absent")
+    clf = ServiceClassifier(db_path=LARGE)
+    svc, _prod, _pt, misses = _recall(clf, ADVERSARIAL)
+    assert svc / len(ADVERSARIAL) >= 0.85, misses
+
+
+def test_system_db_pickup_real_format(tmp_path, monkeypatch):
+    """With no explicit db_path, the classifier prefers an installed
+    nmap-service-probes file (nmap_probes.SYSTEM_DB) — exercised with a
+    real-format file incl. payload escapes, sslports, fallback and
+    version-info templates."""
+    sysdb = tmp_path / "nmap-service-probes"
+    sysdb.write_text(
+        "# test system DB (real nmap-service-probes format)\n"
+        "Exclude T:9100-9107\n"
+        "Probe TCP NULL q||\n"
+        "totalwaitms 6000\n"
+        "rarity 1\n"
+        "ports 1-65535\n"
+        "match marker-svc m|^MARKER-([\\d.]+) ready| p/MarkerD/ v/$1/"
+        " cpe:/a:marker:markerd:$1/\n"
+        "softmatch marker-svc m|^MARKER|\n"
+        "\n"
+        "Probe TCP GenericLines q|\\r\\n\\r\\n|\n"
+        "rarity 2\n"
+        "ports 1000-2000\n"
+        "sslports 1443\n"
+        "fallback NULL\n"
+        "match other m|^OTHER (\\w+)|s p/OtherD/ i/mode $1/\n",
+        encoding="latin-1",
+    )
+    monkeypatch.setattr(nmap_probes, "SYSTEM_DB", sysdb)
+    clf = ServiceClassifier()  # no db_path: must pick up SYSTEM_DB
+    rows = [
+        Response(host="a", port=5555, banner=b"MARKER-2.1 ready\r\n"),
+        Response(host="b", port=1500, banner=b"OTHER verbose\nrest"),
+        Response(host="c", port=5555, banner=b"MARKERx\r\n"),
+    ]
+    infos = clf.classify(rows)
+    assert infos[0].service == "marker-svc"
+    assert infos[0].product == "MarkerD" and infos[0].version == "2.1"
+    assert infos[1].service == "other" and infos[1].product == "OtherD"
+    assert infos[2].service == "marker-svc"  # softmatch

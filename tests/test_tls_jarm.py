@@ -428,3 +428,55 @@ def test_upstream_table_gates_the_field(tmp_path, monkeypatch):
     assert jarm.upstream_cipher_table() == ("c02f", "1301")
     fp = jarm.fingerprint_from_banners("h", 443, banners)
     assert fp.jarm == "0" * 62  # all probes failed -> null hash
+
+
+def test_upstream_table_end_to_end_real_flights(tmp_path, monkeypatch):
+    """Operator path, no mocks: install a synthetic table, feed REAL
+    server-flight bytes through the wire parser — BOTH the
+    upstream-comparable ``jarm`` and the in-framework ``jarmx``
+    populate, and the fuzzy head encodes the table's cipher order
+    (round-3 verdict, Missing #5 / Next #9)."""
+    tab = tmp_path / "table.txt"
+    tab.write_text("# upstream order\n1301\nc02f\nc030\n")
+    monkeypatch.setenv("SWARM_JARM_CIPHER_TABLE", str(tab))
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE", None)
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE_LOADED", False)
+    banners = [
+        synth_server_hello(cipher=0xC02F),      # -> table index 2 ("02")
+        synth_server_hello(cipher=0x1301, supported_version=wire.TLS13),
+    ] + [b""] * (jarm.NUM_PROBES - 2)
+    fp = jarm.fingerprint_from_banners("h", 443, banners)
+    assert fp.alive
+    assert fp.jarmx and fp.jarmx != jarm.EMPTY_JARM
+    assert len(fp.jarm) == 62
+    # probe 1: cipher c02f = table index 2, TLS1.2 (0303) -> 'd';
+    # probe 2: 1301 = index 1, TLS1.3 (0304) -> 'e'; rest failed (000)
+    assert fp.jarm.startswith("02d" + "01e" + "000" * 8)
+    # tail is the sha256 fragment over alpn+extension components
+    assert fp.jarm[30:] != "0" * 32
+
+
+def test_upstream_table_malformed_fails_loudly(tmp_path, monkeypatch):
+    """A configured-but-broken table is a config error, not a silent
+    downgrade to non-comparable hashes."""
+    tab = tmp_path / "bad.txt"
+    tab.write_text("c02f\nnot-hex\n")
+    monkeypatch.setenv("SWARM_JARM_CIPHER_TABLE", str(tab))
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE", None)
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE_LOADED", False)
+    with pytest.raises(RuntimeError, match="malformed"):
+        jarm.upstream_cipher_table()
+
+    monkeypatch.setenv("SWARM_JARM_CIPHER_TABLE", str(tmp_path / "absent"))
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE", None)
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE_LOADED", False)
+    with pytest.raises(RuntimeError, match="unreadable"):
+        jarm.upstream_cipher_table()
+
+    tab2 = tmp_path / "empty.txt"
+    tab2.write_text("# only comments\n")
+    monkeypatch.setenv("SWARM_JARM_CIPHER_TABLE", str(tab2))
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE", None)
+    monkeypatch.setattr(jarm, "_UPSTREAM_TABLE_LOADED", False)
+    with pytest.raises(RuntimeError, match="malformed"):
+        jarm.upstream_cipher_table()

@@ -1,0 +1,114 @@
+"""Profile the fresh-content host walk (bench.py's
+exact_fresh_content_host_walk metric) in isolation: device outputs are
+whatever the CPU backend produces; only host_confirm_seconds matters.
+
+Usage: python tools/profile_walk.py [--rows 3072] [--iters 8] [--cprofile]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the image's sitecustomize preselects an accelerator platform; the env
+# var alone does not stick (see .claude/skills/verify: Gotchas)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=3072)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--cprofile", action="store_true")
+    ap.add_argument("--corpus", default="/root/reference/worker/artifacts/templates")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from bench import realistic_rows
+    from swarm_tpu.fingerprints import load_corpus
+    from swarm_tpu.fingerprints.model import Response
+    from swarm_tpu.ops.engine import MatchEngine
+
+    t0 = time.time()
+    templates, errors = load_corpus(args.corpus)
+    print(f"corpus: {len(templates)} templates ({time.time()-t0:.1f}s)")
+
+    eng = MatchEngine(
+        templates, mesh=None, batch_rows=args.rows,
+        max_body=4096, max_header=1024,
+    )
+
+    rng = np.random.default_rng(4242)
+    batches = []
+    for i in range(args.iters + 1):
+        rows = realistic_rows(args.rows, seed=1000 + i)
+        for r in rows:
+            salt = bytes(rng.integers(97, 123, size=48, dtype=np.uint8))
+            r.body = b"<!-- %s -->" % salt + r.body
+        batches.append(rows)
+
+    t0 = time.time()
+    eng.match_packed(batches[0])
+    print(f"compile+first batch: {time.time()-t0:.1f}s")
+    eng.clear_content_memos()
+    eng.match_packed(batches[0])  # warm
+
+    s = eng.stats
+    h0, u0, e0, i0, f0 = (
+        s.host_confirm_seconds, s.unc_seconds, s.ext_seconds,
+        s.insert_seconds, s.fixup_seconds,
+    )
+    prof = None
+    if args.cprofile:
+        import cProfile
+
+        prof = cProfile.Profile()
+        prof.enable()
+    n = args.iters * args.rows
+    best = None
+    rounds = int(os.environ.get("ROUNDS", "5"))
+    for _ in range(rounds):
+        # fresh content every round: the memos must keep missing
+        eng.clear_content_memos()
+        h0, u0, e0, i0, f0 = (
+            s.host_confirm_seconds, s.unc_seconds, s.ext_seconds,
+            s.insert_seconds, s.fixup_seconds,
+        )
+        t0 = time.perf_counter()
+        for b in batches[1:]:
+            eng.match_packed(b)
+        wall = time.perf_counter() - t0
+        walk = s.host_confirm_seconds - h0
+        cur = (walk, wall, s.unc_seconds - u0, s.ext_seconds - e0,
+               s.insert_seconds - i0, s.fixup_seconds - f0)
+        print(f"  round: walk {walk*1e3:.1f} ms ({n/walk:.0f} rows/s)")
+        if best is None or cur[0] < best[0]:
+            best = cur
+    if prof is not None:
+        prof.disable()
+    walk, wall, unc, ext, ins, fix = best
+    print(f"rows: {n}  wall {wall:.3f}s  BEST walk {walk*1e3:.1f} ms "
+          f"({n/walk:.0f} rows/s)")
+    print(f"  unc    {unc*1e3:8.1f} ms")
+    print(f"  ext    {ext*1e3:8.1f} ms "
+          f"(enum {s.ext_enum_seconds*1e3:.1f} resolve "
+          f"{s.ext_resolve_seconds*1e3:.1f} extract "
+          f"{s.ext_extract_seconds*1e3:.1f} — cumulative)")
+    print(f"  insert {ins*1e3:8.1f} ms")
+    print(f"  fixup  {fix*1e3:8.1f} ms")
+    if prof is not None:
+        import pstats
+
+        st = pstats.Stats(prof)
+        st.sort_stats("cumulative").print_stats(35)
+
+
+if __name__ == "__main__":
+    main()
