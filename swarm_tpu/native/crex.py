@@ -31,6 +31,9 @@ _LIB_PATH = _NATIVE_DIR / "libcrex.so"
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
+# first use can come from several extraction-pool threads at once: the
+# make invocation and the CDLL load must happen exactly once
+_load_lock = threading.Lock()
 
 STEP_BUDGET = 4_000_000  # per finditer/search call, then fallback
 _BUDGET = ctypes.c_int64(STEP_BUDGET)
@@ -38,7 +41,18 @@ _BUDGET = ctypes.c_int64(STEP_BUDGET)
 
 def ensure_crex() -> Optional[ctypes.CDLL]:
     """Load libcrex.so (building via make on first use); None when the
-    native lib is unavailable (Python fallback runs)."""
+    native lib is unavailable (Python fallback runs). Thread-safe:
+    concurrent first calls serialize on _load_lock."""
+    global _lib, _lib_failed
+    if _lib is not None:
+        return _lib
+    if _lib_failed:
+        return None
+    with _load_lock:
+        return _ensure_crex_locked()
+
+
+def _ensure_crex_locked() -> Optional[ctypes.CDLL]:
     global _lib, _lib_failed
     if _lib is not None:
         return _lib
@@ -70,11 +84,21 @@ def ensure_crex() -> Optional[ctypes.CDLL]:
     return lib
 
 
-def _bind(cp) -> None:
-    """Cache raw pointers + scalar fields on the program object."""
-    cp._pp = cp.prog.ctypes.data_as(ctypes.c_void_p)
-    cp._mp = cp.masks.ctypes.data_as(ctypes.c_void_p)
-    cp._nprog = int(cp.prog.shape[0])
+def _bind(cp) -> tuple:
+    """Cache raw pointers + scalar fields on the program object.
+
+    Published as ONE tuple attribute (atomic assignment): programs are
+    shared across the extraction pool's threads via analyze()'s
+    memoized PatternInfo, and a multi-attribute guard could observe a
+    half-bound object. Benign if two threads race the build — both
+    tuples are equivalent and either assignment wins whole."""
+    bound = (
+        cp.prog.ctypes.data_as(ctypes.c_void_p),
+        cp.masks.ctypes.data_as(ctypes.c_void_p),
+        int(cp.prog.shape[0]),
+    )
+    cp._bound = bound
+    return bound
 
 
 _scratch = threading.local()
@@ -96,15 +120,14 @@ def finditer_spans(cp, data: bytes, group: int) -> Optional[list]:
     lib = ensure_crex()
     if lib is None:
         return None
-    if not hasattr(cp, "_pp"):
-        _bind(cp)
+    pp, mp, nprog = getattr(cp, "_bound", None) or _bind(cp)
     # unknown group index -> whole match (re.finditer IndexError
     # semantics, mirrored by fastre.finditer_values' except clause)
     g2 = 2 * group if group and group in cp.group_exists else 0
     cap = len(data) + 2
     out = _out_buf(2 * cap)
     n = lib.sw_crex_finditer(
-        cp._pp, cp._nprog, cp._mp, data, len(data), g2, cp.n_saves,
+        pp, nprog, mp, data, len(data), g2, cp.n_saves,
         _scratch.ptr, ctypes.c_int64(cap), _BUDGET,
     )
     if n < 0:
@@ -123,8 +146,7 @@ def finditer_spans_batch(
     lib = ensure_crex()
     if lib is None or not parts:
         return None if lib is None else []
-    if not hasattr(cp, "_pp"):
-        _bind(cp)
+    pp, mp, nprog = getattr(cp, "_bound", None) or _bind(cp)
     g2 = 2 * group if group and group in cp.group_exists else 0
     n = len(parts)
     datas = (ctypes.c_char_p * n)(*parts)
@@ -136,7 +158,7 @@ def finditer_spans_batch(
     while True:
         out = np.empty(2 * cap, dtype=np.int32)
         total = lib.sw_crex_finditer_batch(
-            cp._pp, cp._nprog, cp._mp, datas, lens_p, n, g2, cp.n_saves,
+            pp, nprog, mp, datas, lens_p, n, g2, cp.n_saves,
             out.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(cap),
             counts_p, _BUDGET,
         )
@@ -165,10 +187,9 @@ def search(cp, data: bytes) -> Optional[bool]:
     lib = ensure_crex()
     if lib is None:
         return None
-    if not hasattr(cp, "_pp"):
-        _bind(cp)
+    pp, mp, nprog = getattr(cp, "_bound", None) or _bind(cp)
     rc = lib.sw_crex_search(
-        cp._pp, cp._nprog, cp._mp, data, len(data), cp.n_saves, _BUDGET,
+        pp, nprog, mp, data, len(data), cp.n_saves, _BUDGET,
     )
     if rc < 0:
         return None

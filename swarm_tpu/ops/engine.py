@@ -365,6 +365,29 @@ class MatchEngine:
             out.extend(vals)
         return out
 
+    def _ext_pool(self):
+        """Shared thread pool for the GIL-released native extraction
+        batches — sized by SWARM_EXT_THREADS (default: spare cores up
+        to 4; 0/1 disables). None when threading is off."""
+        pool = getattr(self, "_ext_pool_obj", None)
+        if pool is not None:
+            return pool or None
+        import os as _os
+
+        n = _os.environ.get("SWARM_EXT_THREADS")
+        workers = (
+            int(n) if n else min(4, max(1, (_os.cpu_count() or 1) - 1))
+        )
+        if workers <= 1:
+            self._ext_pool_obj = ()  # sentinel: decided, disabled
+            return None
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._ext_pool_obj = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="swarm-ext"
+        )
+        return self._ext_pool_obj
+
     def _extract_pending(self, pending: list, nrows: list) -> dict:
         """(b, t_idx) -> ordered extraction values for the native
         walk's resolved hit list.
@@ -449,8 +472,24 @@ class MatchEngine:
             from swarm_tpu.native import crex as ncrex
 
             failed: set = set()
-            for (pattern, group), t in tasks.items():
-                res = ncrex.finditer_spans_batch(t["cp"], t["parts"], group)
+            task_list = list(tasks.items())
+            # the batch C calls release the GIL: on hosts with spare
+            # cores the per-pattern scans run concurrently (disjoint
+            # outputs, no shared mutable state inside the call)
+            pool = self._ext_pool()
+            if pool is not None and len(task_list) > 1:
+                results = list(pool.map(
+                    lambda kv: ncrex.finditer_spans_batch(
+                        kv[1]["cp"], kv[1]["parts"], kv[0][1]
+                    ),
+                    task_list,
+                ))
+            else:
+                results = [
+                    ncrex.finditer_spans_batch(t["cp"], t["parts"], group)
+                    for (_pat, group), t in task_list
+                ]
+            for ((pattern, group), t), res in zip(task_list, results):
                 if _dbg:
                     nsp = sum(len(s) for s in res if s) if res else -1
                     print(f"    extB {pattern[:40]!r} items="

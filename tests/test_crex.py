@@ -167,6 +167,10 @@ def test_corpus_equivalence(group):
     """Every corpus pattern crex accepts must agree with re on every
     fuzz text — spans AND search — plus content synthesized from the
     pattern's own literals (so matches actually occur)."""
+    if not REFERENCE_CORPUS.is_dir():
+        # the bundled fallback has ~2 regexes: the coverage floor
+        # below would fail vacuously instead of measuring anything
+        pytest.skip("reference corpus absent")
     pats = corpus_patterns()
     assert pats
     texts = fuzz_texts()

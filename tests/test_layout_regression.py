@@ -161,3 +161,40 @@ def test_match_packed_native_path_fortran_planes_corpus_scale():
     np.testing.assert_array_equal(baseline.bits, again.bits)
     assert baseline.extractions == again.extractions
     assert baseline.host_always_matches == again.host_always_matches
+
+
+def test_threaded_extraction_batches_bit_identical(monkeypatch):
+    """SWARM_EXT_THREADS>1 runs the per-pattern native batches on a
+    thread pool (GIL released in C) — results must be identical to the
+    serial path."""
+    templates, _ = load_corpus(REFERENCE_CORPUS / "network")
+    misc, _ = load_corpus(REFERENCE_CORPUS / "miscellaneous")
+    templates = templates + misc
+    rows = [
+        Response(
+            host=f"h{i}.x", port=80, status=200,
+            body=(b"User-agent: *\nDisallow: /admin%d/s\n"
+                  b"Allow: /p%d v=9.%d" % (i, i, i)),
+            header=b"Server: nginx\r\n",
+        )
+        for i in range(64)
+    ]
+    # rsyncd rows: detect-rsyncd's extractor is NOT internal, so the
+    # extraction-output path is exercised (robots' is internal-only)
+    rows += [
+        Response(host=f"r{i}.x", port=873, status=0,
+                 banner=b"@RSYNCD: 31.%d\nERROR: protocol startup error\n"
+                 % i)
+        for i in range(8)
+    ]
+
+    def run(threads):
+        monkeypatch.setenv("SWARM_EXT_THREADS", threads)
+        eng = MatchEngine(templates, mesh=None)
+        return eng.match_packed(list(rows))
+
+    serial = run("1")
+    threaded = run("3")
+    np.testing.assert_array_equal(serial.bits, threaded.bits)
+    assert serial.extractions == threaded.extractions
+    assert serial.extractions  # the batch path must actually fire

@@ -135,10 +135,11 @@ def test_adversarial_recall_head_db(head_classifier):
     print(f"\nhead-DB adversarial recall: service {svc}/{n} "
           f"({svc/n:.0%}), product {prod}/{prod_total} "
           f"({prod/prod_total:.0%}); misses: {misses}")
-    # floors pin today's measured quality; raise them as the DB grows —
+    # floors pin today's measured quality (35/35 service, 28/28
+    # product after the MariaDB-ordering fix); raise as the DB grows —
     # regressions below these mean real-world detection got worse
     assert svc / n >= 0.90, misses
-    assert prod / prod_total >= 0.85, misses
+    assert prod / prod_total >= 0.95, misses
 
 
 def test_adversarial_recall_large_db_not_worse_on_services():

@@ -1,0 +1,21 @@
+#!/bin/sh
+# End-of-round / pre-snapshot ritual (round-3 verdict, Next #2):
+# NEVER snapshot red — the full suite and the bench must both pass
+# before any round-closing commit.
+#
+#   sh tools/preflight.sh            # suite + full bench
+#   sh tools/preflight.sh --quick    # suite + exact phase only
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== preflight: pytest =="
+python -m pytest tests/ -q
+
+echo "== preflight: bench =="
+if [ "$1" = "--quick" ]; then
+    python bench.py --phase exact
+else
+    python bench.py
+fi
+
+echo "== preflight: OK =="
