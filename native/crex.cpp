@@ -36,6 +36,9 @@ enum Op : int32_t {
     OP_REPG = 6,   // a = mask, b = min, c = max (-1 = inf)  greedy
     OP_REPL = 7,   // a = mask, b = min, c = max (-1 = inf)  lazy
     OP_AT = 8,     // a = kind, b = word-mask index (boundaries)
+    OP_LOOP = 9,   // a = loop head pc, b = iteration-entry mark slot:
+                   // loop again only if the iteration consumed bytes
+                   // (Python's empty-iteration break rule for repeats)
 };
 
 enum AtKind : int32_t {
@@ -69,14 +72,22 @@ static inline bool in_mask(const uint8_t* masks, int32_t idx, uint8_t b) {
 }
 
 // Attempt an anchored match at `pos`.  Returns end offset (>= pos),
-// -1 no match, -2 resource limit (caller must fall back to Python re).
+// -1 no match, -2 step budget exhausted (expensive: a real
+// backtracking blowup), -4 frame/trail overflow (cheap: content too
+// large for the fixed stacks; fails in ~0.1 ms).  Callers fall back
+// to Python re on either, but only -2 should count toward the
+// budget circuit breaker.
+// With `nonempty`, a zero-width match is treated as a failed branch
+// and backtracking continues — the re.finditer rule that an empty
+// match at position p is followed by a retry at p that must consume.
 static int32_t match_at(const int32_t* prog, const uint8_t* masks,
                         const uint8_t* d, int32_t len, int32_t pos,
-                        int32_t* saves, int64_t* budget) {
+                        int32_t* saves, int64_t* budget, bool nonempty) {
     Frame stack[MAXF];
     TrailEnt trail[MAXT];
     int nf = 0, nt = 0;
     int32_t pc = 0;
+    const int32_t start0 = pos;
     for (;;) {
         if (--(*budget) < 0) return -2;
         const int32_t* I = prog + 4 * (size_t)pc;
@@ -88,7 +99,7 @@ static int32_t match_at(const int32_t* prog, const uint8_t* masks,
                 if (pos < len && in_mask(masks, I[1], d[pos])) { ++pos; ++pc; continue; }
                 break;
             case OP_SPLIT:
-                if (nf >= MAXF) return -2;
+                if (nf >= MAXF) return -4;
                 stack[nf++] = {I[2], pos, (int32_t)nt, -1};
                 pc = I[1];
                 continue;
@@ -96,20 +107,25 @@ static int32_t match_at(const int32_t* prog, const uint8_t* masks,
                 pc = I[1];
                 continue;
             case OP_SAVE:
-                if (nt >= MAXT) return -2;
+                if (nt >= MAXT) return -4;
                 trail[nt++] = {I[1], saves[I[1]]};
                 saves[I[1]] = pos;
                 ++pc;
                 continue;
             case OP_MATCH:
+                if (nonempty && pos == start0) break;  // zero-width: fail
                 return pos;
+            case OP_LOOP:
+                if (saves[I[2]] == pos) { ++pc; continue; }  // no progress
+                pc = I[1];
+                continue;
             case OP_REPG: {
                 int32_t maxc = I[3] < 0 ? INT32_MAX : I[3];
                 int32_t k = 0;
                 while (k < maxc && pos + k < len && in_mask(masks, I[1], d[pos + k]))
                     ++k;
                 if (k < I[2]) break;  // fail
-                if (nf >= MAXF) return -2;
+                if (nf >= MAXF) return -4;
                 stack[nf++] = {pc, pos, (int32_t)nt, k};
                 pos += k;
                 ++pc;
@@ -122,7 +138,7 @@ static int32_t match_at(const int32_t* prog, const uint8_t* masks,
                 for (int32_t j = 0; j < k; ++j)
                     if (!in_mask(masks, I[1], d[pos + j])) { ok = false; break; }
                 if (!ok) break;
-                if (nf >= MAXF) return -2;
+                if (nf >= MAXF) return -4;
                 stack[nf++] = {pc, pos, (int32_t)nt, k};
                 pos += k;
                 ++pc;
@@ -308,13 +324,19 @@ int64_t finditer_core(const int32_t* prog, const uint8_t* masks,
     int64_t n = 0;
     int64_t budget = step_budget;
     int32_t pos = 0;
+    // Python 3.7+ finditer rule: after an EMPTY match at p, the next
+    // attempt happens at p again but must consume at least one byte
+    // (e.g. (a??){3} on "a" yields (0,0), (0,1), (1,1)).
+    int32_t forbid_empty_at = -1;
     while (pos <= len) {
         int32_t start = plan_skip(plan, data, len, pos);
         if (start > len) break;
         for (int32_t i = 0; i < nsaves; ++i) saves[i] = -1;
-        int32_t end = match_at(prog, masks, data, len, start, saves, &budget);
-        if (end == -2) return -2;
+        int32_t end = match_at(prog, masks, data, len, start, saves,
+                               &budget, start == forbid_empty_at);
+        if (end == -2 || end == -4) return end;
         if (end < 0) {
+            forbid_empty_at = -1;
             pos = start + 1;
             continue;
         }
@@ -327,7 +349,13 @@ int64_t finditer_core(const int32_t* prog, const uint8_t* masks,
             out[2 * (off + n) + 1] = saves[g2 + 1];
         }
         ++n;
-        pos = (end == start) ? start + 1 : end;
+        if (end == start) {
+            forbid_empty_at = start;  // retry here, non-empty only
+            pos = start;
+        } else {
+            forbid_empty_at = -1;
+            pos = end;
+        }
     }
     return n;
 }
@@ -343,7 +371,7 @@ int64_t sw_crex_finditer(const int32_t* prog, int32_t nprog,
                          int32_t len, int32_t g2, int32_t nsaves,
                          int32_t* out, int64_t cap, int64_t step_budget) {
     (void)nprog;
-    if (nsaves > MAXS) return -2;
+    if (nsaves > MAXS) return -4;
     ScanPlan plan;
     build_plan(prog, masks, &plan);
     return finditer_core(prog, masks, &plan, data, len, g2, nsaves,
@@ -354,9 +382,16 @@ int64_t sw_crex_finditer(const int32_t* prog, int32_t nprog,
 // contents (the per-batch extraction shape — dispatch overhead was
 // the dominant cost of per-call crex at walk rates).  Span pairs for
 // all items are written contiguously; counts[i] is item i's match
-// count, or -1 when THAT item exhausted its step budget/frames (the
-// caller re-runs just that item under Python re).  Returns the total
-// span count, or -3 when `cap` overflowed (caller grows and retries).
+// count, or negative when the item did not complete natively:
+//   -1  not attempted (an earlier item exhausted its step budget —
+//       the batch bails rather than burn a fresh multi-second budget
+//       per item inside one GIL-released call)
+//   -2  THIS item exhausted the step budget (breaker-countable)
+//   -4  THIS item overflowed the frame/trail stacks (cheap, content-
+//       size-driven; later items still run)
+// The caller re-runs every negative item under exact Python re.
+// Returns the total span count, or -3 when `cap` overflowed (caller
+// grows and retries).
 int64_t sw_crex_finditer_batch(const int32_t* prog, int32_t nprog,
                                const uint8_t* masks,
                                const char* const* datas,
@@ -366,7 +401,7 @@ int64_t sw_crex_finditer_batch(const int32_t* prog, int32_t nprog,
                                int64_t* counts, int64_t step_budget) {
     (void)nprog;
     if (nsaves > MAXS) {
-        for (int32_t i = 0; i < nitems; ++i) counts[i] = -1;
+        for (int32_t i = 0; i < nitems; ++i) counts[i] = -4;
         return 0;
     }
     ScanPlan plan;
@@ -377,8 +412,13 @@ int64_t sw_crex_finditer_batch(const int32_t* prog, int32_t nprog,
             prog, masks, &plan, (const uint8_t*)datas[i], lens[i], g2,
             nsaves, out, total, cap, step_budget);
         if (n == -3) return -3;
-        if (n < 0) {
-            counts[i] = -1;
+        if (n == -2) {
+            counts[i] = -2;
+            for (int32_t j = i + 1; j < nitems; ++j) counts[j] = -1;
+            return total;
+        }
+        if (n == -4) {
+            counts[i] = -4;  // cheap structural failure: keep going
             continue;
         }
         counts[i] = n;
@@ -392,7 +432,7 @@ int32_t sw_crex_search(const int32_t* prog, int32_t nprog,
                        const uint8_t* masks, const uint8_t* data,
                        int32_t len, int32_t nsaves, int64_t step_budget) {
     (void)nprog;
-    if (nsaves > MAXS) return -2;
+    if (nsaves > MAXS) return -4;
     int32_t saves[MAXS];
     int64_t budget = step_budget;
     ScanPlan plan;
@@ -402,8 +442,9 @@ int32_t sw_crex_search(const int32_t* prog, int32_t nprog,
         int32_t start = plan_skip(&plan, data, len, pos);
         if (start > len) return 0;
         for (int32_t i = 0; i < nsaves; ++i) saves[i] = -1;
-        int32_t end = match_at(prog, masks, data, len, start, saves, &budget);
-        if (end == -2) return -2;
+        int32_t end = match_at(prog, masks, data, len, start, saves,
+                               &budget, false);
+        if (end == -2 || end == -4) return end;
         if (end >= 0) return 1;
         pos = start + 1;
     }

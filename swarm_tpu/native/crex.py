@@ -38,6 +38,24 @@ _load_lock = threading.Lock()
 STEP_BUDGET = 4_000_000  # per finditer/search call, then fallback
 _BUDGET = ctypes.c_int64(STEP_BUDGET)
 
+#: budget exhaustions tolerated per program before the VM stops being
+#: tried for that pattern — burning the full step budget costs real
+#: time (tens to hundreds of ms) per call before the exact re
+#: fallback runs, so a pattern that keeps blowing up (catastrophic
+#: backtracking shapes) must not pay that tax on every row. Cheap
+#: frame/trail-stack overflows (content-size-driven, ~0.1 ms, C code
+#: -4) deliberately do NOT count: short contents still run natively.
+MAX_BUDGET_FAILS = 3
+
+
+def usable(cp) -> bool:
+    """Whether the native VM should still be tried for this program."""
+    return cp is not None and getattr(cp, "_budget_fails", 0) < MAX_BUDGET_FAILS
+
+
+def _note_budget_fail(cp) -> None:
+    cp._budget_fails = getattr(cp, "_budget_fails", 0) + 1
+
 
 def ensure_crex() -> Optional[ctypes.CDLL]:
     """Load libcrex.so (building via make on first use); None when the
@@ -124,13 +142,17 @@ def finditer_spans(cp, data: bytes, group: int) -> Optional[list]:
     # unknown group index -> whole match (re.finditer IndexError
     # semantics, mirrored by fastre.finditer_values' except clause)
     g2 = 2 * group if group and group in cp.group_exists else 0
-    cap = len(data) + 2
+    # worst case under the empty-match retry rule: one empty and one
+    # non-empty match per position, plus the trailing empty
+    cap = 2 * len(data) + 3
     out = _out_buf(2 * cap)
     n = lib.sw_crex_finditer(
         pp, nprog, mp, data, len(data), g2, cp.n_saves,
         _scratch.ptr, ctypes.c_int64(cap), _BUDGET,
     )
     if n < 0:
+        if n == -2:
+            _note_budget_fail(cp)
         return None
     flat = out[: 2 * n].tolist()
     return list(zip(flat[0::2], flat[1::2]))
@@ -140,9 +162,14 @@ def finditer_spans_batch(
     cp, parts: "list[bytes]", group: int
 ) -> Optional[list]:
     """Per-item span lists for ONE pattern over many contents — one
-    GIL-released dispatch for the whole batch. Items that exhaust the
-    native budget come back as None entries (caller falls back to re
-    for just those); returns None only when the lib is unavailable."""
+    GIL-released dispatch for the whole batch. Items that did not
+    complete natively come back as None entries — the caller must
+    re-run exactly those under Python ``re``. A step-budget blowup on
+    one item bails the REST of the batch too (all later items None,
+    not attempted: burning a fresh budget per item inside one call
+    would block the pool for minutes); cheap frame/trail overflows
+    only fail their own item. Returns None only when the lib itself is
+    unavailable."""
     lib = ensure_crex()
     if lib is None or not parts:
         return None if lib is None else []
@@ -169,8 +196,10 @@ def finditer_spans_batch(
     flat = out[: 2 * total].tolist()
     res: list = []
     off = 0
+    budget_fail = False
     for c in counts.tolist():
         if c < 0:
+            budget_fail = budget_fail or c == -2
             res.append(None)
             continue
         res.append(
@@ -178,6 +207,8 @@ def finditer_spans_batch(
                      flat[2 * off + 1 : 2 * (off + c) : 2]))
         )
         off += c
+    if budget_fail:
+        _note_budget_fail(cp)  # once per call, not per item
     return res
 
 
@@ -192,8 +223,13 @@ def search(cp, data: bytes) -> Optional[bool]:
         pp, nprog, mp, data, len(data), cp.n_saves, _BUDGET,
     )
     if rc < 0:
+        if rc == -2:
+            _note_budget_fail(cp)
         return None
     return bool(rc)
 
 
-__all__ = ["ensure_crex", "finditer_spans", "search", "STEP_BUDGET"]
+__all__ = [
+    "ensure_crex", "finditer_spans", "finditer_spans_batch", "search",
+    "usable", "MAX_BUDGET_FAILS", "STEP_BUDGET",
+]

@@ -41,11 +41,12 @@ from swarm_tpu.fingerprints.regexlin import (
 
 # instruction opcodes — keep in lockstep with native/crex.cpp
 OP_CHAR, OP_CLASS, OP_SPLIT, OP_JMP, OP_SAVE, OP_MATCH = 0, 1, 2, 3, 4, 5
-OP_REPG, OP_REPL, OP_AT = 6, 7, 8
+OP_REPG, OP_REPL, OP_AT, OP_LOOP = 6, 7, 8, 9
 AT_BOS, AT_EOS, AT_EOD, AT_WB, AT_NWB, AT_BOL, AT_EOL = 0, 1, 2, 3, 4, 5, 6
 
 MAX_PROG = 768      # instructions
 MAX_GROUP = 31      # save slots 2..63 (group 0 handled by the driver)
+MAX_SLOTS = 64      # total save slots (group pairs + loop marks)
 _MAXREPEAT = 2**32 - 1  # sre MAXREPEAT compares equal to this
 
 _DOT = np.ones(256, dtype=bool)
@@ -67,6 +68,14 @@ class _Compiler:
         self.masks: list[bytes] = []
         self._mask_idx: dict[bytes, int] = {}
         self.max_group = 0
+        self.n_loops = 0  # loop-mark slots, allocated from MAX_SLOTS down
+
+    def loop_slot(self) -> int:
+        self.n_loops += 1
+        slot = MAX_SLOTS - self.n_loops
+        # group-pair slots grow from 0, loop marks from the top —
+        # overlap is checked at finalize (compile_crex)
+        return slot
 
     def emit(self, op: int, a: int = 0, b: int = 0, c: int = 0) -> int:
         if len(self.instrs) >= MAX_PROG:
@@ -208,35 +217,62 @@ class _Compiler:
             self.emit(OP_REPL if lazy else OP_REPG,
                       self.mask_id(mask), lo, hi)
             return
-        # general body
-        if _can_empty(sub):
-            # an empty-matchable body inside a repeat needs Python re's
-            # empty-iteration break rule — out of subset
-            raise _Unsupported("empty-matchable repeat body")
+        # general body. Bounded repeats with empty-matchable bodies
+        # unroll to finite SPLIT chains — Python verifiably runs
+        # trailing empty iterations there (((a)|){2} on "a" leaves
+        # group 1 at the empty (1,1)), exactly what the preference
+        # encoding produces. Unbounded ones additionally need Python's
+        # empty-iteration break rule: a mark slot records each
+        # iteration's entry position and OP_LOOP exits when the body
+        # consumed nothing (else the SPLIT loop would spin forever).
         for _ in range(lo):
             self.compile_seq(sub, ci, dotall, multiline)
         if hi < 0:
-            # unbounded: L: SPLIT(body, after); body; JMP L
+            mark = self.loop_slot() if _can_empty(sub) else None
             l0 = len(self.instrs)
             sp = self.emit(OP_SPLIT)
+            if mark is not None:
+                self.emit(OP_SAVE, mark)
             self.compile_seq(sub, ci, dotall, multiline)
-            self.emit(OP_JMP, l0)
+            if mark is not None:
+                self.emit(OP_LOOP, l0, mark)
+            else:
+                self.emit(OP_JMP, l0)
             after = len(self.instrs)
             if lazy:
                 self.instrs[sp][1], self.instrs[sp][2] = after, sp + 1
             else:
                 self.instrs[sp][1], self.instrs[sp][2] = sp + 1, after
         else:
+            # optional copies carry the same zero-width protection as
+            # CPython's >=min repeat phase: an optional copy that
+            # consumed nothing skips the REMAINING copies (but itself
+            # counts — ((a)|){2} on "a" keeps the trailing empty
+            # iteration; (?:(?:a|)(?:|b)){0,2} on "ba" must not let an
+            # empty copy 1 spawn a copy 2). Mandatory (count < min)
+            # copies are unprotected, as in CPython.
+            mark = self.loop_slot() if _can_empty(sub) else None
             splits = []
+            skip_jmps = []
             for _ in range(hi - lo):
                 splits.append(self.emit(OP_SPLIT))
+                if mark is not None:
+                    self.emit(OP_SAVE, mark)
                 self.compile_seq(sub, ci, dotall, multiline)
+                if mark is not None:
+                    lp = self.emit(OP_LOOP, 0, mark)
+                    skip_jmps.append(self.emit(OP_JMP))  # empty: done
+                    # progress: continue at the next copy (== `after`
+                    # for the final copy, by construction)
+                    self.instrs[lp][1] = len(self.instrs)
             after = len(self.instrs)
             for sp in splits:
                 if lazy:
                     self.instrs[sp][1], self.instrs[sp][2] = after, sp + 1
                 else:
                     self.instrs[sp][1], self.instrs[sp][2] = sp + 1, after
+            for j in skip_jmps:
+                self.instrs[j][1] = after
 
 
 def _can_empty(seq) -> bool:
@@ -300,6 +336,9 @@ def _compile(pattern: str) -> Optional[CrexProgram]:
         return None
     except re.error:
         return None
+    group_slots = 2 * (c.max_group + 1)
+    if group_slots > MAX_SLOTS - c.n_loops:
+        return None  # group pairs and loop marks would collide
     prog = np.array(c.instrs, dtype=np.int32).reshape(-1, 4)
     masks = (
         np.frombuffer(b"".join(c.masks), dtype=np.uint8).reshape(-1, 32)
@@ -310,7 +349,7 @@ def _compile(pattern: str) -> Optional[CrexProgram]:
     return CrexProgram(
         prog=np.ascontiguousarray(prog),
         masks=np.ascontiguousarray(masks),
-        n_saves=2 * (c.max_group + 1),
+        n_saves=MAX_SLOTS if c.n_loops else group_slots,
         group_exists=groups,
     )
 

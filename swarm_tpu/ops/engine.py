@@ -410,6 +410,7 @@ class MatchEngine:
                 vals = out.setdefault((b, t_idx), [])
                 vals.extend(self._extract_op(self._op_obj[op_id], nrows[b]))
             return out
+        from swarm_tpu.native import crex as ncrex
         from swarm_tpu.ops import fastre as _fastre
 
         cache = self._ext_cache
@@ -442,7 +443,7 @@ class MatchEngine:
                 part = key[1]
                 infos = [_fastre.analyze(p) for p in ex.regex]
                 if not isinstance(ex.group, int) or not all(
-                    i.ok and i.cprog is not None for i in infos
+                    i.ok and ncrex.usable(i.cprog) for i in infos
                 ):
                     vals = self._accel_extract_regex(ex, part)
                     self._cache_put(cache, key, vals)
@@ -469,8 +470,6 @@ class MatchEngine:
                   f"tasks={len(tasks)} segs={len(segs)}", flush=True)
         done: dict = {}
         if fills:
-            from swarm_tpu.native import crex as ncrex
-
             failed: set = set()
             task_list = list(tasks.items())
             # the batch C calls release the GIL: on hosts with spare

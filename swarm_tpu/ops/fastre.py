@@ -338,13 +338,14 @@ def finditer_values(
     info = analyze(pattern)
     if not info.ok:
         return None
-    if info.cprog is not None and isinstance(group, int):
+    if isinstance(group, int):
         from swarm_tpu.native import crex as ncrex
 
-        spans = ncrex.finditer_spans(info.cprog, data, group)
-        if spans is not None:
-            return [None if s < 0 else text[s:e] for s, e in spans]
-        # resource fallback: keep going on the candidate path below
+        if ncrex.usable(info.cprog):
+            spans = ncrex.finditer_spans(info.cprog, data, group)
+            if spans is not None:
+                return [None if s < 0 else text[s:e] for s, e in spans]
+            # resource fallback: keep going on the candidate path below
     if not info.prefix:
         return None
     cands = _candidates(info, data)
@@ -377,9 +378,9 @@ def search_bool(pattern: str, data: bytes, text: str) -> Optional[bool]:
     info = analyze(pattern)
     if not info.ok:
         return None
-    if info.cprog is not None:
-        from swarm_tpu.native import crex as ncrex
+    from swarm_tpu.native import crex as ncrex
 
+    if ncrex.usable(info.cprog):
         got = ncrex.search(info.cprog, data)
         if got is not None:
             return got
