@@ -163,10 +163,40 @@ def test_match_packed_native_path_fortran_planes_corpus_scale():
     assert baseline.host_always_matches == again.host_always_matches
 
 
+def _rsync_rows(n: int) -> list:
+    """Rows firing detect-rsyncd, whose extractor is NOT internal —
+    they exercise the extraction-output path (robots' is
+    internal-only)."""
+    return [
+        Response(host=f"r{i}.x", port=873, status=0,
+                 banner=b"@RSYNCD: 31.%d\nERROR: protocol startup error\n"
+                 % i)
+        for i in range(n)
+    ]
+
+
+def _assert_native_extraction_live(pattern=r"RSYNCD: \d\d.\d"):
+    """Guard against vacuous equivalence tests: the compared fast path
+    must actually be the native VM, not a silent Python fallback."""
+    from swarm_tpu.native import crex as ncrex
+    from swarm_tpu.ops import fastre
+
+    assert ncrex.ensure_crex() is not None, "libcrex must be loadable"
+    info = fastre.analyze(pattern)
+    assert info.cprog is not None and ncrex.usable(info.cprog), pattern
+
+
+def _run_with_env(monkeypatch, templates, rows, var: str, value: str):
+    monkeypatch.setenv(var, value)
+    eng = MatchEngine(templates, mesh=None)
+    return eng.match_packed(list(rows))
+
+
 def test_threaded_extraction_batches_bit_identical(monkeypatch):
     """SWARM_EXT_THREADS>1 runs the per-pattern native batches on a
     thread pool (GIL released in C) — results must be identical to the
     serial path."""
+    _assert_native_extraction_live()
     templates, _ = load_corpus(REFERENCE_CORPUS / "network")
     misc, _ = load_corpus(REFERENCE_CORPUS / "miscellaneous")
     templates = templates + misc
@@ -178,23 +208,25 @@ def test_threaded_extraction_batches_bit_identical(monkeypatch):
             header=b"Server: nginx\r\n",
         )
         for i in range(64)
-    ]
-    # rsyncd rows: detect-rsyncd's extractor is NOT internal, so the
-    # extraction-output path is exercised (robots' is internal-only)
-    rows += [
-        Response(host=f"r{i}.x", port=873, status=0,
-                 banner=b"@RSYNCD: 31.%d\nERROR: protocol startup error\n"
-                 % i)
-        for i in range(8)
-    ]
+    ] + _rsync_rows(8)
 
-    def run(threads):
-        monkeypatch.setenv("SWARM_EXT_THREADS", threads)
-        eng = MatchEngine(templates, mesh=None)
-        return eng.match_packed(list(rows))
-
-    serial = run("1")
-    threaded = run("3")
+    serial = _run_with_env(monkeypatch, templates, rows,
+                           "SWARM_EXT_THREADS", "1")
+    threaded = _run_with_env(monkeypatch, templates, rows,
+                             "SWARM_EXT_THREADS", "3")
     np.testing.assert_array_equal(serial.bits, threaded.bits)
     assert serial.extractions == threaded.extractions
     assert serial.extractions  # the batch path must actually fire
+
+
+def test_percall_escape_hatch_bit_identical(monkeypatch):
+    """SWARM_EXT_BATCH=0 (the per-call measurement hatch) must stay
+    bit-identical to the batched default — it shares the oracle
+    semantics through _extract_op."""
+    _assert_native_extraction_live()
+    templates, _ = load_corpus(REFERENCE_CORPUS / "network")
+    rows = _rsync_rows(24)
+    a = _run_with_env(monkeypatch, templates, rows, "SWARM_EXT_BATCH", "1")
+    b = _run_with_env(monkeypatch, templates, rows, "SWARM_EXT_BATCH", "0")
+    np.testing.assert_array_equal(a.bits, b.bits)
+    assert a.extractions == b.extractions and a.extractions
