@@ -44,7 +44,11 @@ OP_CHAR, OP_CLASS, OP_SPLIT, OP_JMP, OP_SAVE, OP_MATCH = 0, 1, 2, 3, 4, 5
 OP_REPG, OP_REPL, OP_AT, OP_LOOP = 6, 7, 8, 9
 AT_BOS, AT_EOS, AT_EOD, AT_WB, AT_NWB, AT_BOL, AT_EOL = 0, 1, 2, 3, 4, 5, 6
 
-MAX_PROG = 768      # instructions
+MAX_PROG = 2048     # instructions (the corpus's largest lowerable
+                    # pattern, technologies' el-table alternation,
+                    # needs 1,233; a program is 16 B/instr of compile-
+                    # time memory and size does not slow the VM's
+                    # per-attempt execution)
 MAX_GROUP = 31      # save slots 2..63 (group 0 handled by the driver)
 MAX_SLOTS = 64      # total save slots (group pairs + loop marks)
 _MAXREPEAT = 2**32 - 1  # sre MAXREPEAT compares equal to this
