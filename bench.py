@@ -78,6 +78,17 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _env_float(name: str, default: float) -> float:
+    """Env override as float; a malformed value must not kill the
+    run (the probe-deadline knobs exist to PREVENT total-loss runs)."""
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        log(f"!!! ignoring malformed {name}={raw!r}; using {default}")
+        return default
+
+
 _EMIT_NOTE = ""  # set when the run is NOT on accelerator hardware
 
 
@@ -169,9 +180,20 @@ def resolve_device():
     # probe the configured backend in a disposable subprocess first: if
     # the probe can't see a device within its budget, force CPU in this
     # process before jax ever initializes the wedged backend.
-    from swarm_tpu.utils.backendprobe import probe_backend
+    from swarm_tpu.utils.backendprobe import probe_backend_retry
 
-    ok, _platform, _count = probe_backend(timeout=150)
+    # Per-phase retry budget: generous when the parent's pre-probe saw
+    # the accelerator (a mid-run blip must not wipe one phase), a single
+    # cheap attempt when it did not (the tunnel may have recovered —
+    # check, but don't stall 7 phases on a dead link). Round-4 lesson:
+    # ONE failed 150 s probe must never be terminal for the whole run.
+    parent_saw = os.environ.get("SWARM_BENCH_PARENT_PROBE", "") == "ok"
+    deadline = _env_float(
+        "SWARM_BENCH_PHASE_PROBE_DEADLINE", 600.0 if parent_saw else 150.0
+    )
+    ok, _platform, _count = probe_backend_retry(
+        attempt_timeout=150, deadline=deadline, log=log
+    )
     if not ok:
         log("!!! backend probe hung/failed; forcing JAX_PLATFORMS=cpu")
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -656,6 +678,24 @@ def main() -> int:
 
     if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
         return run_phase(sys.argv[2])
+    # Pre-probe with a long retry window BEFORE any phase runs: the
+    # round-3/round-4 record was erased by transient tunnel outages at
+    # probe time, so a bench run now waits out an outage (re-probing
+    # every ~1-3.5 min, default up to 30 min) rather than committing
+    # the whole run to CPU on one failed attempt. The parent never
+    # initializes jax itself (the probe is subprocess-based), so this
+    # is safe before spawning phase children.
+    from swarm_tpu.utils.backendprobe import probe_backend_retry
+
+    pre_deadline = _env_float("SWARM_BENCH_PROBE_DEADLINE", 1800.0)
+    pre_ok, pre_platform, _ = probe_backend_retry(
+        attempt_timeout=150, deadline=pre_deadline, log=log
+    )
+    os.environ["SWARM_BENCH_PARENT_PROBE"] = "ok" if pre_ok else "failed"
+    log(
+        f"parent pre-probe: {'ok on ' + pre_platform if pre_ok else 'FAILED'}"
+        " — phases re-probe individually"
+    )
     values: dict = {}
     notes: dict = {}
     failed = []

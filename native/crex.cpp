@@ -24,6 +24,14 @@
 #include <cstdint>
 #include <cstring>
 
+// ABI version — bump on ANY change to the opcode set, instruction
+// encoding, or driver return codes, in lockstep with CREX_ABI in
+// swarm_tpu/ops/crexc.py. The ctypes loader refuses a library whose
+// version differs (a stale .so next to a newer compiler silently
+// returns wrong matches otherwise — the opcode numbering already
+// changed once mid-series when OP_LOOP and the -4 status landed).
+constexpr int32_t CREX_ABI_VERSION = 3;
+
 namespace {
 
 enum Op : int32_t {
@@ -363,6 +371,9 @@ int64_t finditer_core(const int32_t* prog, const uint8_t* masks,
 }  // namespace
 
 extern "C" {
+
+// ABI handshake for the ctypes loader (see CREX_ABI_VERSION above).
+int32_t sw_crex_abi(void) { return CREX_ABI_VERSION; }
 
 // Single-content finditer.  Returns match count, -2 on resource
 // exhaustion (caller falls back to Python re), -3 on cap overflow.

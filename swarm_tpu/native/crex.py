@@ -93,6 +93,20 @@ def _ensure_crex_locked() -> Optional[ctypes.CDLL]:
     except OSError:
         _lib_failed = True
         return None
+    # ABI handshake: a stale .so (make failed above but an old build
+    # survived on disk) silently returns WRONG matches if the opcode
+    # numbering moved — refuse anything but the compiler's version
+    from swarm_tpu.ops.crexc import CREX_ABI
+
+    try:
+        abi_fn = lib.sw_crex_abi
+        abi_fn.restype = ctypes.c_int32
+        abi = abi_fn()
+    except AttributeError:  # pre-handshake build: stale by definition
+        abi = -1
+    if abi != CREX_ABI:
+        _lib_failed = True
+        return None
     # no argtypes on purpose: pointers are pre-bound c_void_p, scalars
     # plain ints (see module docstring) — validation cost is the point
     lib.sw_crex_finditer.restype = ctypes.c_int64
@@ -142,14 +156,25 @@ def finditer_spans(cp, data: bytes, group: int) -> Optional[list]:
     # unknown group index -> whole match (re.finditer IndexError
     # semantics, mirrored by fastre.finditer_values' except clause)
     g2 = 2 * group if group and group in cp.group_exists else 0
-    # worst case under the empty-match retry rule: one empty and one
-    # non-empty match per position, plus the trailing empty
-    cap = 2 * len(data) + 3
-    out = _out_buf(2 * cap)
-    n = lib.sw_crex_finditer(
-        pp, nprog, mp, data, len(data), g2, cp.n_saves,
-        _scratch.ptr, ctypes.c_int64(cap), _BUDGET,
-    )
+    # realistic match counts are tiny: start from a small cap and grow
+    # on the -3 overflow return (mirrors finditer_spans_batch) instead
+    # of pre-sizing for the ~16x-content-size theoretical worst case —
+    # the per-thread scratch persists, so worst-case pre-sizing left a
+    # lasting RSS spike per pool thread on multi-MB parts. The hard
+    # ceiling (one empty + one non-empty match per position, plus the
+    # trailing empty) bounds the retry loop.
+    hard_cap = 2 * len(data) + 3
+    cap = min(4096, hard_cap)
+    while True:
+        out = _out_buf(2 * cap)
+        n = lib.sw_crex_finditer(
+            pp, nprog, mp, data, len(data), g2, cp.n_saves,
+            _scratch.ptr, ctypes.c_int64(cap), _BUDGET,
+        )
+        if n == -3 and cap < hard_cap:
+            cap = min(cap * 4, hard_cap)
+            continue
+        break
     if n < 0:
         if n == -2:
             _note_budget_fail(cp)

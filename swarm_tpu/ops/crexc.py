@@ -42,6 +42,26 @@ from swarm_tpu.fingerprints.regexlin import (
 # instruction opcodes — keep in lockstep with native/crex.cpp
 OP_CHAR, OP_CLASS, OP_SPLIT, OP_JMP, OP_SAVE, OP_MATCH = 0, 1, 2, 3, 4, 5
 OP_REPG, OP_REPL, OP_AT, OP_LOOP = 6, 7, 8, 9
+
+#: ABI version — bump on ANY change to the opcode set, instruction
+#: encoding, or driver return codes, in lockstep with
+#: CREX_ABI_VERSION in native/crex.cpp. native/crex.py verifies the
+#: loaded .so reports this value and refuses a stale build.
+CREX_ABI = 3
+
+_INT32_MAX = 2**31 - 1
+
+#: Codepoints > 0xFF that Python re's IGNORECASE folds INTO latin-1
+#: (so a latin-1 byte can match them): chars whose single-char
+#: ``str.lower`` lands < 0x100 (K→k, Å→å, ẞ→ß, Ÿ→ÿ) plus the
+#: ``re._casefix._EXTRA_CASES`` pairs that cross the byte boundary
+#: (ı↔i, ſ↔s, μ↔µ). Patterns touching these under (?i) stay on exact
+#: Python re; every OTHER >0xFF char can never match latin-1 text and
+#: lowers to an impossible class. tests/test_crex.py re-derives this
+#: set from the running interpreter (unicode-data drift guard).
+CI_LATIN1_FOLDERS = frozenset(
+    {0x131, 0x178, 0x17F, 0x1E9E, 0x212A, 0x212B, 0x3BC}
+)
 AT_BOS, AT_EOS, AT_EOD, AT_WB, AT_NWB, AT_BOL, AT_EOL = 0, 1, 2, 3, 4, 5, 6
 
 MAX_PROG = 2048     # instructions (the corpus's largest lowerable
@@ -64,6 +84,15 @@ class CrexProgram:
     masks: np.ndarray      # uint8 [n_masks, 32] bitsets
     n_saves: int           # save slots used (2 * (max group + 1))
     group_exists: dict     # gid -> True for groups the pattern defines
+
+
+def _guard_ci_fold(arg: int, ci: bool, what: str) -> None:
+    """Shared rejection for (?i) literals that fold INTO latin-1
+    (kelvin K matches k, long-s matches s) — only Python re gets
+    those right. One guard for all four literal sites (compile_seq
+    and _single_class, LITERAL and NOT_LITERAL)."""
+    if ci and arg in CI_LATIN1_FOLDERS:
+        raise _Unsupported(f"latin-1-folding {what} under (?i)")
 
 
 class _Compiler:
@@ -102,6 +131,7 @@ class _Compiler:
             name = str(op)
             if name == "LITERAL":
                 if arg > 255:
+                    _guard_ci_fold(arg, ci, "literal")
                     # cannot occur in latin-1 text; the whole pattern
                     # can never match — emit an impossible class
                     self.emit(OP_CLASS, self.mask_id(np.zeros(256, bool)))
@@ -112,6 +142,7 @@ class _Compiler:
                 else:
                     self.emit(OP_CHAR, arg)
             elif name == "NOT_LITERAL":
+                _guard_ci_fold(arg, ci, "not-literal")
                 m = np.zeros(256, dtype=bool)
                 if 0 <= arg <= 255:
                     m[arg] = True
@@ -119,6 +150,7 @@ class _Compiler:
                     m = _case_fold(m)
                 self.emit(OP_CLASS, self.mask_id(~m))
             elif name == "IN":
+                _guard_ci_nonlatin(arg, ci)
                 self.emit(OP_CLASS, self.mask_id(_class_mask(arg, ci)))
             elif name == "ANY":
                 self.emit(OP_CLASS, self.mask_id(_DOTALL if dotall else _DOT))
@@ -191,18 +223,22 @@ class _Compiler:
 
     def _single_class(self, sub, ci: bool, dotall: bool):
         """The class mask when ``sub`` is one single-byte item, else
-        None (drives the counted-REP fast instruction)."""
+        None (drives the counted-REP fast instruction). Raises
+        _Unsupported for the same (?i) non-latin-1 fold cases
+        compile_seq rejects."""
         if len(sub) != 1:
             return None
         op, arg = sub[0]
         name = str(op)
         if name == "LITERAL":
             if arg > 255:
+                _guard_ci_fold(arg, ci, "literal")
                 return np.zeros(256, dtype=bool)
             m = np.zeros(256, dtype=bool)
             m[arg] = True
             return _case_fold(m) if ci else m
         if name == "NOT_LITERAL":
+            _guard_ci_fold(arg, ci, "not-literal")
             m = np.zeros(256, dtype=bool)
             if 0 <= arg <= 255:
                 m[arg] = True
@@ -210,12 +246,18 @@ class _Compiler:
                 m = _case_fold(m)
             return ~m
         if name == "IN":
+            _guard_ci_nonlatin(arg, ci)
             return _class_mask(arg, ci)
         if name == "ANY":
             return _DOTALL if dotall else _DOT
         return None
 
     def compile_repeat(self, lo, hi, sub, lazy, ci, dotall, multiline):
+        if lo > _INT32_MAX or hi > _INT32_MAX:
+            # re accepts counts up to 2**32-2; they don't fit the
+            # int32 instruction fields (and an a{3000000000} unroll
+            # would be absurd anyway) — stay on Python re
+            raise _Unsupported("repeat bound exceeds int32")
         mask = self._single_class(sub, ci, dotall)
         if mask is not None:
             self.emit(OP_REPL if lazy else OP_REPG,
@@ -277,6 +319,26 @@ class _Compiler:
                     self.instrs[sp][1], self.instrs[sp][2] = sp + 1, after
             for j in skip_jmps:
                 self.instrs[j][1] = after
+
+
+def _guard_ci_nonlatin(items, ci: bool) -> None:
+    """Reject class items that (?i)-fold non-latin-1 chars into the
+    byte domain: ``(?i)[\\u212a]`` matches ``k`` and a range spanning
+    past 0xFF can contain such members ((?i)[\\u2100-\\u2200] matches
+    ``k`` under re, large or small) — ``_class_mask`` clamps them
+    away, so these patterns must stay on exact Python ``re``. Members
+    outside ``CI_LATIN1_FOLDERS`` can never match latin-1 text and
+    the clamp is exact for them."""
+    if not ci:
+        return
+    for op, arg in items:
+        name = str(op)
+        if name == "LITERAL" and arg in CI_LATIN1_FOLDERS:
+            raise _Unsupported("latin-1-folding class literal under (?i)")
+        if name == "RANGE" and arg[1] > 255 and any(
+            arg[0] <= d <= arg[1] for d in CI_LATIN1_FOLDERS
+        ):
+            raise _Unsupported("latin-1-folding class range under (?i)")
 
 
 def _can_empty(seq) -> bool:
@@ -343,7 +405,12 @@ def _compile(pattern: str) -> Optional[CrexProgram]:
     group_slots = 2 * (c.max_group + 1)
     if group_slots > MAX_SLOTS - c.n_loops:
         return None  # group pairs and loop marks would collide
-    prog = np.array(c.instrs, dtype=np.int32).reshape(-1, 4)
+    try:
+        prog = np.array(c.instrs, dtype=np.int32).reshape(-1, 4)
+    except OverflowError:
+        # belt for any count that escaped into an int32 field (the
+        # compile_repeat bound guard is the primary defense)
+        return None
     masks = (
         np.frombuffer(b"".join(c.masks), dtype=np.uint8).reshape(-1, 32)
         if c.masks

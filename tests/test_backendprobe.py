@@ -57,3 +57,87 @@ def test_probe_program_dispatches_real_computation(monkeypatch):
     # the env-selected platform must be pinned through jax.config (site
     # hooks override the env var alone)
     assert "jax.config.update" in program
+
+
+# --- probe_backend_retry: the round-5 outage-survival loop. Round 3/4
+# lost their entire chip perf record to ONE failed probe; the retry
+# wrapper must keep probing until the deadline and log every attempt.
+
+
+def test_retry_returns_immediately_on_success(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        backendprobe, "probe_backend",
+        lambda timeout: calls.append(timeout) or (True, "tpu", 1),
+    )
+    monkeypatch.setattr(
+        backendprobe.time, "sleep",
+        lambda s: (_ for _ in ()).throw(AssertionError("slept on success")),
+    )
+    assert backendprobe.probe_backend_retry(deadline=600) == (True, "tpu", 1)
+    assert len(calls) == 1
+
+
+def test_retry_survives_transient_outage(monkeypatch):
+    # attempts 1-2 fail (the transient outage), attempt 3 sees the
+    # device — the run must NOT commit to CPU after the first failure
+    results = iter([(False, "", 0), (False, "", 0), (True, "tpu", 4)])
+    attempts = []
+    sleeps = []
+    monkeypatch.setattr(
+        backendprobe, "probe_backend",
+        lambda timeout: attempts.append(timeout) or next(results),
+    )
+    monkeypatch.setattr(backendprobe.time, "sleep", sleeps.append)
+    logged = []
+    ok, platform, count = backendprobe.probe_backend_retry(
+        attempt_timeout=150, deadline=1800, wait=60, log=logged.append
+    )
+    assert (ok, platform, count) == (True, "tpu", 4)
+    assert len(attempts) == 3
+    assert sleeps == [60, 60]
+    # every attempt logged: 2 failures + 1 success
+    assert len(logged) == 3
+    assert sum("FAILED" in line for line in logged) == 2
+
+
+def test_retry_gives_up_at_deadline(monkeypatch):
+    monkeypatch.setattr(
+        backendprobe, "probe_backend", lambda timeout: (False, "", 0)
+    )
+    fake_now = [0.0]
+    monkeypatch.setattr(
+        backendprobe.time, "monotonic", lambda: fake_now[0]
+    )
+
+    def fake_sleep(s):
+        fake_now[0] += s
+
+    monkeypatch.setattr(backendprobe.time, "sleep", fake_sleep)
+    logged = []
+    ok, _, _ = backendprobe.probe_backend_retry(
+        attempt_timeout=150, deadline=300, wait=60, log=logged.append
+    )
+    assert not ok
+    # 0s, 60s, 120s, 180s, 240s attempts fit; the next sleep would
+    # leave < wait before the 300s deadline, so the loop stops
+    assert len(logged) == 5
+
+
+def test_retry_single_attempt_when_deadline_small(monkeypatch):
+    # deadline <= attempt budget degrades to exactly one probe (the
+    # parent-saw-nothing per-phase configuration)
+    calls = []
+    monkeypatch.setattr(
+        backendprobe, "probe_backend",
+        lambda timeout: calls.append(timeout) or (False, "", 0),
+    )
+    monkeypatch.setattr(
+        backendprobe.time, "sleep",
+        lambda s: (_ for _ in ()).throw(AssertionError("must not sleep")),
+    )
+    ok, _, _ = backendprobe.probe_backend_retry(
+        attempt_timeout=150, deadline=150, wait=60
+    )
+    assert not ok
+    assert len(calls) == 1

@@ -345,3 +345,122 @@ def test_stack_overflow_does_not_trip_breaker():
     res = ncrex.finditer_spans_batch(cp, [long_page, b"abx"], 0)
     assert res[0] is None and res[1] == [(0, 3)]
     assert ncrex.usable(cp)
+
+
+# --- round-5 advisor regressions: (?i) latin-1 folds, int32 repeat
+# bounds, stale-library ABI handshake, scratch growth
+
+
+def test_ci_latin1_folders_matches_interpreter():
+    """CI_LATIN1_FOLDERS is hardcoded (a lazy full-unicode scan would
+    tax every corpus compile); re-derive it from the RUNNING
+    interpreter so unicode-data drift in a future Python fails loudly
+    here instead of silently breaking exactness."""
+    import sys
+
+    import re._casefix as casefix
+
+    from swarm_tpu.ops.crexc import CI_LATIN1_FOLDERS
+
+    derived = set()
+    for cp in range(256, sys.maxunicode + 1):
+        low = chr(cp).lower()
+        if len(low) == 1 and ord(low) < 256:
+            derived.add(cp)
+    for k, v in casefix._EXTRA_CASES.items():
+        if k > 255 and any(x < 256 for x in v):
+            derived.add(k)
+    assert derived == set(CI_LATIN1_FOLDERS)
+
+
+def test_ci_latin1_folding_patterns_stay_on_python_re():
+    """(?i)K matches 'k' under re but never under a byte-class VM
+    — every latin-1-folding shape must refuse to lower. Non-folding
+    >0xFF chars (CJK) still lower: they can never match latin-1 text,
+    and the corpus contains such patterns (the XOOPS title regex)."""
+    from swarm_tpu.ops.crexc import CI_LATIN1_FOLDERS
+
+    for cp in sorted(CI_LATIN1_FOLDERS):
+        c = chr(cp)
+        assert compile_crex(f"(?i){c}") is None, hex(cp)
+        assert compile_crex(f"(?i)[^{c}]") is None, hex(cp)
+        assert compile_crex(f"(?i)[{c}]") is None, hex(cp)
+        assert compile_crex(f"(?i){c}{{2,5}}") is None, hex(cp)
+    # ranges spanning a folder reject; ranges that don't, lower
+    assert compile_crex("(?i)[℀-∀]") is None  # contains K, A
+    assert compile_crex("(?i)[一-鿿]") is not None  # CJK only
+    # non-folding >0xFF literal under (?i): compiles, never matches —
+    # exactly re's verdict on latin-1 text
+    cp = compile_crex("(?i)(<title>安裝)")
+    assert cp is not None
+    assert ncrex.search(cp, b"<title>An") is False
+    assert re.search("(?i)(<title>安裝)", "<title>An") is None
+    # without (?i) the folding chars are plain never-match literals
+    assert compile_crex("K") is not None
+
+
+def test_huge_repeat_bounds_fall_back():
+    """re accepts counts up to 2**32-2; they don't fit int32
+    instruction fields — compile_crex must return None (fallback), not
+    crash with OverflowError from the int32 program array."""
+    for pat in (
+        r"a{3000000000}",
+        r"a{2,4294967294}",
+        r"(ab){3000000000}",
+        r"x{2147483646,4294967294}",
+    ):
+        assert compile_crex(pat) is None, pat
+    # boundary: int32-max-representable bounds still compile
+    assert compile_crex(r"a{2147483647}") is not None
+
+
+def test_abi_handshake_refuses_stale_library(monkeypatch):
+    """A stale libcrex.so (make failed, old build on disk) must be
+    refused: opcode numbering changed mid-series once already, and a
+    mismatched VM silently returns wrong matches."""
+    from swarm_tpu.ops.crexc import CREX_ABI
+
+    # the real library reports the compiler's ABI
+    lib = ncrex.ensure_crex()
+    assert lib is not None
+    assert lib.sw_crex_abi() == CREX_ABI
+
+    class _StaleLib:
+        def __getattr__(self, name):  # no sw_crex_abi symbol at all
+            raise AttributeError(name)
+
+    monkeypatch.setattr(ncrex, "_lib", None)
+    monkeypatch.setattr(ncrex, "_lib_failed", False)
+    monkeypatch.setattr(ncrex.ctypes, "CDLL", lambda path: _StaleLib())
+    monkeypatch.setattr(
+        ncrex.subprocess, "run", lambda *a, **k: None
+    )
+    assert ncrex.ensure_crex() is None
+    assert ncrex._lib_failed
+
+    class _WrongAbiLib:
+        class _Fn:
+            restype = None
+
+            def __call__(self):
+                return 999999
+
+        sw_crex_abi = _Fn()
+
+    monkeypatch.setattr(ncrex, "_lib", None)
+    monkeypatch.setattr(ncrex, "_lib_failed", False)
+    monkeypatch.setattr(ncrex.ctypes, "CDLL", lambda path: _WrongAbiLib())
+    assert ncrex.ensure_crex() is None
+    assert ncrex._lib_failed
+
+
+def test_finditer_spans_grows_scratch_on_overflow():
+    """The span scratch starts small (4096) and grows on the C -3
+    overflow return instead of pre-sizing ~16x the content length —
+    a match count past the initial cap must still come back complete
+    and re-identical."""
+    cp = compile_crex(r"a")
+    n = 20_000  # > initial 4096 cap: forces at least one -3 retry
+    data = b"a" * n
+    spans = ncrex.finditer_spans(cp, data, 0)
+    assert spans == [(i, i + 1) for i in range(n)]
