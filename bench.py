@@ -49,7 +49,7 @@ TARGET_PER_CHIP = 10_000_000 / 4  # north star: 10M/s on a v4-8 (4 chips)
 BASELINES = {
     # BASELINE config #2: 10k-banner nmap-service-probes classify.
     "service_probe_classifications_per_sec": 50_000.0,
-    # config #2 at production DB scale (485 probes / 12.3k signatures,
+    # config #2 at production DB scale (487 probes / 12.3k signatures,
     # data/service-probes-large.txt) — nmap -sV's real signature count
     "service_full_db_classifications_per_sec": 20_000.0,
     # BASELINE config #4: masscan-style stream -> classifier, pipelined.
@@ -610,7 +610,7 @@ def run_phase(phase: str) -> int:
         emit(
             "service_full_db_classifications_per_sec",
             svc,
-            "banners/sec (485 probes / 12.3k signatures)",
+            "banners/sec (487 probes / 12.3k signatures)",
             svc / BASELINES["service_full_db_classifications_per_sec"],
         )
     elif phase == "streaming":

@@ -1495,11 +1495,79 @@ def compile_corpus(
             return const_true_unc()
         return const_true_unc()
 
+    def lower_extraction_prefilter(op) -> dict:
+        """Pseudo-matcher for an operation with extractors but NO
+        matchers: nuclei reports such templates iff any extractor
+        extracts (reference worker/artifacts/templates/exposures/
+        tokens/generic/credentials-disclosure.yaml:20-24 — the
+        exposures/tokens family's entire mechanism). Device value is a
+        superset prefilter: any extraction regex's required literals
+        present ⇒ uncertain (host runs the extractors to decide, via
+        engine._confirm_operation's extractor-only branch); no literal
+        present ⇒ exactly non-matching, no host walk. Non-regex
+        extractors or literal-less patterns degrade to fire-always
+        (every row host-confirmed — correct, just slower); the whole
+        reference http population lowers to real literal sets
+        (tests/test_extractor_only.py pins that)."""
+        slot_ids: list[int] = []
+        ok = True
+        for ex in op.extractors:
+            if ex.type != "regex" or not ex.regex:
+                ok = False
+                break
+            stream = stream_for_part(ex.part or "body")
+            if stream is None:
+                ok = False
+                break
+            for p in ex.regex:
+                s = None
+                for ml in (4, 3, 2):
+                    s = required_literal_set(p, min_len=ml)
+                    if s is not None:
+                        break
+                if s is None:
+                    ok = False
+                    break
+                slot_ids.extend(slots.get(lit, stream, True) for lit in s)
+            if not ok:
+                break
+        rec = const_true_unc()
+        if ok and slot_ids:
+            # "any extractor extracts" is an OR over patterns, so the
+            # union of per-pattern necessary literals is necessary for
+            # the op — same soundness argument as the OR branch of
+            # lower_matcher_superset's regex path
+            rec["kind"] = MK_REGEX_PREFILTER
+            rec["cond_and"] = False
+            rec["slots"] = slot_ids
+        rec["pseudo_ext"] = True
+        return rec
+
     for template in templates:
         if template.protocol == "workflow" or not template.operations:
             continue
         lowered_ops: list[dict] = []
         for op_local, op in enumerate(template.operations):
+            if not op.matchers:
+                # extractor-only op: matches iff extraction succeeds —
+                # but only for the protocol families THIS engine
+                # executes. file/ssl/headless extractor-only templates
+                # are owned by their subsystems (worker/filescan.py:79,
+                # worker/sslscan.py:246, worker/headless.py), which
+                # already implement extraction-implies-match; lowering
+                # them here would double-claim them against http rows.
+                if op.extractors and template.protocol in (
+                    "http", "network", "dns",
+                ):
+                    lowered_ops.append(
+                        {
+                            "cond_and": False,
+                            "matchers": [lower_extraction_prefilter(op)],
+                            "prefilter": True,
+                            "op_local": op_local,
+                        }
+                    )
+                continue
             recs = []
             exact = True
             for m in op.matchers:
@@ -1539,8 +1607,15 @@ def compile_corpus(
                 m_ids.append(len(matchers))
                 # provenance back to the source nuclei matcher so the
                 # host can re-evaluate exactly this matcher (engine's
-                # sparse confirmation path) instead of the whole template
-                rec["src"] = (t_idx, lop["op_local"], m_local)
+                # sparse confirmation path) instead of the whole template.
+                # A synthesized extraction prefilter has no source
+                # matcher: m_local = -1 (the op is always a prefilter,
+                # so confirmation re-runs the whole op, never this slot)
+                rec["src"] = (
+                    t_idx,
+                    lop["op_local"],
+                    -1 if rec.get("pseudo_ext") else m_local,
+                )
                 matchers.append(rec)
             ops.append(
                 {
@@ -1553,7 +1628,8 @@ def compile_corpus(
             op_ids.append(len(ops) - 1)
             prefiltered |= lop["prefilter"]
         if not op_ids:
-            # no matchers anywhere: never matches (same as oracle)
+            # no matchers and no extractors anywhere: never matches
+            # (same as oracle)
             continue
         t_ops.append(op_ids)
         kept_templates.append(template)

@@ -164,7 +164,13 @@ def match_operation(
         if v and matcher.name:
             names.append(matcher.name)
     if not verdicts:
-        matched = False
+        # extractor-only operation: nuclei reports such templates iff
+        # any extractor extracts — the whole mechanism of the
+        # exposures/tokens family (reference worker/artifacts/templates/
+        # exposures/tokens/generic/credentials-disclosure.yaml:20-24,
+        # ~600 regexes and no matchers). An op with neither matchers
+        # nor extractors still never matches.
+        matched = bool(op.extractors) and bool(_extract(op, response))
     elif op.matchers_condition == "and":
         matched = all(verdicts)
     else:

@@ -229,9 +229,13 @@ class MatchEngine:
         self.mesh = None
         self._candidate_k = candidate_k
         db = self.db
-        # device matcher/op id → source objects for sparse confirmation
+        # device matcher/op id → source objects for sparse confirmation.
+        # m == -1 is a synthesized extraction prefilter (extractor-only
+        # op, compile.lower_extraction_prefilter): no source matcher —
+        # its op is always prefiltered, so confirmation goes through
+        # _confirm_operation, never the per-matcher path
         self._m_obj = [
-            db.templates[t].operations[o].matchers[m]
+            db.templates[t].operations[o].matchers[m] if m >= 0 else None
             for t, o, m in db.m_src
         ] if db.templates else []
         self._op_obj = [
@@ -560,6 +564,13 @@ class MatchEngine:
         matcher — the superset-lowered ops route here, where the slow
         literal-less regexes (waf-detect's cloudfront backtracker)
         otherwise re-scan every confirm."""
+        if not op.matchers:
+            # extractor-only operation: matches iff any extractor
+            # extracts (nuclei semantics; cpu_ref.match_operation's
+            # empty-verdicts branch is the oracle twin). _extract_op's
+            # content-keyed memo makes the later extraction pass a
+            # cache hit on the same values.
+            return bool(op.extractors) and bool(self._extract_op(op, row))
         verdicts = []
         cache = self._confirm_cache
         for matcher in op.matchers:

@@ -37,12 +37,21 @@ principled subset needs no JS runtime and executes here:
   script tags, eval'd registrations) are invisible, exactly as DOM
   nodes built by JS are below.
 
-Anything else needing a JS runtime — prototype-pollution's
-location-driven pollution loop, ``screenshot`` rendering — is
-classified ``js-required`` by :func:`classify` and keeps the honest
-skip marker. The documented bound of the emulation: nodes inserted by
-page JavaScript are invisible (the DOM here is the served HTML, not a
-rendered tree).
+- **prototype-pollution probing** (the PPScan hook in
+  prototype-pollution-check.yaml): the hook's location-driven loop is
+  replayed for real — the polluted-query URL and the bare-path page
+  for the fragment probe are both fetched through the session — and
+  the ``Object.prototype`` observation is a static property model
+  over the probe page's load-time scripts (does any parse a
+  location-derived string into object keys with a prototype-unguarded
+  merge: deparam/parseQuery, split('&') + bracket assignment, deep
+  extend). See the property-model section below for the bound.
+
+Anything else needing a JS runtime — ``screenshot`` rendering,
+CVE-2022-0776's bespoke scripting — is classified ``js-required`` by
+:func:`classify` and keeps the honest skip marker. The documented
+bound of the emulation: nodes inserted by page JavaScript are
+invisible (the DOM here is the served HTML, not a rendered tree).
 
 Matchers evaluate on the final page via the exact CPU oracle with
 nuclei's headless part names mapped (``resp``/``page``/``data`` → the
@@ -50,8 +59,8 @@ full response); matchers/extractors over a named script's output read
 the emulated script result.
 
 Reference: /root/reference/worker/artifacts/templates/headless/*.yaml
-(7 templates: 2 executable browserlessly + 3 hook-emulated,
-2 js-required).
+plus cves/2022/CVE-2022-0776.yaml (8 headless templates: 2 executable
+browserlessly + 4 hook-emulated, 2 honestly skipped).
 """
 
 from __future__ import annotations
@@ -155,8 +164,27 @@ def _hook_spec(code: str) -> Optional[dict]:
     Recognition is structural (what APIs the wrapper intercepts), not
     textual equality — upstream reformatting of the same hook keeps
     working; genuinely different hooks stay js-required."""
+    if "Object.prototype" in code and "__proto__" in code:
+        # PPScan's location-driven pollution loop (prototype-pollution-
+        # check.yaml): probe markers/payload parsed from the hook so an
+        # upstream token rotation keeps working; a structurally
+        # different pollution hook stays js-required
+        q = re.search(
+            r"searchParams\.append\(\s*['\"]__proto__\[(\w+)\]['\"]\s*,"
+            r"\s*['\"](\w+)['\"]",
+            code,
+        )
+        h = re.search(r"hash\s*=\s*['\"]__proto__\[(\w+)\]=(\w+)", code)
+        if q and h and "location" in code:
+            return {
+                "kind": "proto-pollution",
+                "qmark": q.group(1),
+                "hmark": h.group(1),
+                "value": q.group(2),
+            }
+        return None
     if "location" in code and "__proto__" in code:
-        return None  # pollution check navigates with polluted URLs
+        return None  # unrecognized pollution-style hook
     if (
         "Window.prototype.addEventListener" in code
         and re.search(r"type\s*===?\s*['\"]message['\"]", code)
@@ -541,11 +569,12 @@ def _go_fmt(v) -> str:
     return str(v)
 
 
-def _page_scripts(sess: "_Session") -> list:
+def _page_scripts(sess: "_Session", page: Optional["_Page"] = None) -> list:
     """(label, text) of every load-time script the page runs: inline
     ``<script>`` bodies, ``on*`` handler attributes, and same-origin
-    external scripts (fetched, bounded)."""
-    page = sess.page
+    external scripts (fetched, bounded). ``page`` defaults to the
+    session's current page (probe passes hand in a fetched page)."""
+    page = page if page is not None else sess.page
     out: list = []
     if page is None or page.root is None:
         return out
@@ -602,6 +631,115 @@ def _window_name_sinks(text: str) -> list:
     return out
 
 
+# --- prototype-pollution property model -----------------------------------
+#
+# PPScan (the hook in prototype-pollution-check.yaml) detects pollution
+# dynamically: navigate with __proto__'d query params, then again with
+# a __proto__'d fragment, and check Object.prototype for the payload.
+# Without a JS runtime the navigation half runs for real (both probe
+# URLs are fetched through the session) and the observation half is a
+# static property model over the probe page's load-time scripts: a
+# script pollutes Object.prototype from the URL iff it parses a
+# location-derived string into object keys with a prototype-unguarded
+# merge (deparam/parseQuery, split('&') + bracket assignment, or a
+# deep extend over the split) — the client-side parser classes PPScan
+# exists to catch. Documented bound: parsers reached only through
+# dynamically built code are invisible, same as DOM nodes built by JS.
+
+_POLLUTE_PARSE_RE = re.compile(
+    r"\bdeparam\s*\(|\.parseQuery\s*\(|\bparse_str\s*\("
+)
+_POLLUTE_SPLIT_RE = re.compile(
+    r"\.split\(\s*(?:['\"][&;=]['\"]|/[^/\n]*[&;][^/\n]*/)\s*\)"
+)
+# any computed-key assignment — `obj[k] =`, `obj[keys[i]] =` (nested
+# brackets included, hence the lookback on the closing bracket only)
+_POLLUTE_ASSIGN_RE = re.compile(r"\]\s*=(?![=>])")
+_POLLUTE_EXTEND_RE = re.compile(r"\bextend\s*\(\s*true\s*,")
+_POLLUTE_GUARD_RE = re.compile(
+    r"hasOwnProperty\s*\(|['\"]__proto__['\"]|Object\.create\(\s*null\s*\)"
+    r"|['\"]constructor['\"]"
+)
+_LOC_SEARCH_RE = re.compile(
+    r"location\.search|location\.href|document\.URL\b"
+    r"|window\.location(?![\w.])"
+)
+_LOC_HASH_RE = re.compile(
+    r"location\.hash|location\.href|document\.URL\b"
+    r"|window\.location(?![\w.])"
+)
+
+
+def _pollution_script_model(text: str) -> set:
+    """Which location sources (``search`` / ``hash``) this script
+    parses into object keys with a prototype-UNguarded merge; empty
+    when the script doesn't parse the URL or guards its keys."""
+    if _POLLUTE_GUARD_RE.search(text):
+        return set()
+    vulnerable = bool(
+        _POLLUTE_PARSE_RE.search(text)
+        or (
+            _POLLUTE_SPLIT_RE.search(text)
+            and _POLLUTE_ASSIGN_RE.search(text)
+        )
+        or (
+            _POLLUTE_EXTEND_RE.search(text)
+            and _POLLUTE_SPLIT_RE.search(text)
+        )
+    )
+    if not vulnerable:
+        return set()
+    out = set()
+    if _LOC_SEARCH_RE.search(text):
+        out.add("search")
+    if _LOC_HASH_RE.search(text):
+        out.add("hash")
+    return out
+
+
+def _pollution_probe(sess: "_Session", hook: dict) -> list:
+    """Run PPScan's two navigations for real and apply the property
+    model; returns the ``logger(location.href)`` values a polluted run
+    would record (URLs carrying the __proto__ markers)."""
+    page = sess.page
+    if page is None:
+        return []
+    val = hook["value"]
+    out: list = []
+    sp = urlsplit(page.url)
+    # probe 1: searchParams.append on the current URL (query reaches
+    # the server — the polluted page may differ from the base page)
+    extra = (
+        f"__proto__[{hook['qmark']}]={val}"
+        f"&__proto__.{hook['qmark']}={val}"
+    )
+    q = f"{sp.query}&{extra}" if sp.query else extra
+    qurl = urlunsplit((sp.scheme, sp.netloc, sp.path or "/", q, ""))
+    qpage = sess.fetch_resource(qurl)
+    if qpage is not None:
+        srcs: set = set()
+        for _label, text in _page_scripts(sess, page=qpage):
+            srcs |= _pollution_script_model(text)
+        if "search" in srcs:
+            out.append(qurl)
+    # probe 2: origin + pathname with the markers in the FRAGMENT —
+    # never sent on the wire, so the fetched page is the bare path and
+    # only hash/href-reading parsers can see the payload
+    hurl = urlunsplit((sp.scheme, sp.netloc, sp.path or "/", "", ""))
+    hfrag = (
+        f"__proto__[{hook['hmark']}]={val}"
+        f"&__proto__.{hook['hmark']}={val}&dummy"
+    )
+    hpage = sess.fetch_resource(hurl)
+    if hpage is not None:
+        srcs = set()
+        for _label, text in _page_scripts(sess, page=hpage):
+            srcs |= _pollution_script_model(text)
+        if "hash" in srcs:
+            out.append(hurl + "#" + hfrag)
+    return out
+
+
 def _emulate_alerts(sess: "_Session") -> str:
     """The ``window.alerts`` array the installed hooks would hold after
     load, synthesized from the page's static script content."""
@@ -640,6 +778,8 @@ def _emulate_alerts(sess: "_Session") -> str:
                         "source": "window.name",
                         "stack": [f"at {label}"],
                     })
+        elif kind == "proto-pollution":
+            alerts.extend(_pollution_probe(sess, hook))
     return _go_fmt(alerts)
 
 

@@ -91,6 +91,13 @@ def test_main_emits_exact_headline_last(monkeypatch, capsys):
         )
 
     monkeypatch.setattr(subprocess, "run", fake_run)
+    # the parent pre-probe (round-5 outage-retry) probes the backend
+    # before the phase loop — stub it so this test stays device-free
+    from swarm_tpu.utils import backendprobe
+
+    monkeypatch.setattr(
+        backendprobe, "probe_backend", lambda timeout: (True, "cpu", 1)
+    )
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     rc = bench.main()
     assert rc == 0
@@ -124,6 +131,11 @@ def test_main_headline_survives_aux_phase_failure(monkeypatch, capsys):
         )
 
     monkeypatch.setattr(subprocess, "run", fake_run)
+    from swarm_tpu.utils import backendprobe
+
+    monkeypatch.setattr(
+        backendprobe, "probe_backend", lambda timeout: (True, "cpu", 1)
+    )
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     rc = bench.main()
     assert rc == 1  # failure reported in the exit code

@@ -261,7 +261,9 @@ def test_corpus_device_split_does_not_regress():
     db = compile_corpus(templates)
     assert len(templates) >= 3900
     assert db.stats["templates_host_always"] == 0
-    assert db.num_templates >= 3700
+    # 3708 matcher-bearing + 42 extractor-only (40 http + 2 dns; the
+    # exposures/tokens family et al. — round-5 semantics fix)
+    assert db.num_templates >= 3750
     # op-level prefilters (whole-op host confirm on fire) are the
     # expensive fallback — keep them rare. OOB-part prefilters (the
     # log4j-rce family: literal-less regex over interactsh_request,
@@ -269,18 +271,29 @@ def test_corpus_device_split_does_not_regress():
     # counted separately: they can only engage on rows carrying real
     # callback interactions, so they cost nothing on bulk scans.
     pf_ops = np.flatnonzero(db.op_prefilter)
-    oob_pf = sum(
-        1
-        for op_id in pf_ops
-        if any(
-            (m.part or "").startswith("interactsh")
-            for m in db.templates[db.op_src[op_id][0]]
+    oob_pf = 0
+    ext_pf = 0
+    for op_id in pf_ops:
+        op = (
+            db.templates[db.op_src[op_id][0]]
             .operations[db.op_src[op_id][1]]
-            .matchers
         )
-    )
-    assert int(db.op_prefilter.sum()) - oob_pf <= 20
+        if not op.matchers:
+            # synthesized extraction prefilter (extractor-only op):
+            # literal-gated, so it engages only on rows carrying one of
+            # the extraction regexes' required literals — cheap, and
+            # the host work it triggers IS the extraction output the
+            # template owes anyway
+            ext_pf += 1
+        elif any((m.part or "").startswith("interactsh") for m in op.matchers):
+            oob_pf += 1
+    assert int(db.op_prefilter.sum()) - oob_pf - ext_pf <= 20
     assert oob_pf <= 15
+    # the 40 http + 2 dns extractor-only templates, every one lowered
+    # with a real literal prefilter (a fire-always degrade would walk
+    # every row for that template — test_extractor_only.py pins the
+    # literal sets too)
+    assert ext_pf == 42
     # per-matcher residues (confirm-on-fire) are the cheap fallback —
     # bounded so exotic-dsl growth is noticed
     assert int(db.m_residue.sum()) <= 20

@@ -1,10 +1,13 @@
 """Browserless headless-template subset (worker/headless.py).
 
-Covers: classification of the REAL reference headless corpus (2
-executable / 5 js-required), the dvwa-style form login flow end to end
-against a local server (click/text/submit + cookie jar + redirect),
-and the extract-urls attribute-collection script emulation with URL
-resolution.
+Covers: classification of the REAL reference headless corpus (6 of 8
+execute: 2 browserless + 4 hook-emulated incl. prototype-pollution;
+screenshot + CVE-2022-0776 honestly skipped), the dvwa-style form
+login flow end to end against a local server (click/text/submit +
+cookie jar + redirect), the extract-urls attribute-collection script
+emulation with URL resolution, and the PPScan pollution probe
+(real navigations + static property model) with positive, hash-probe,
+and guarded/clean negative verdicts.
 """
 
 import socketserver
@@ -40,15 +43,16 @@ def test_reference_corpus_classification():
     assert verdicts["dvwa-headless-automatic-login"] is None
     assert verdicts["extract-urls"] is None
     assert verdicts["screenshot"] == "unsupported-action-screenshot"
-    # hook-emulated since round 4 (static load-time instrumentation)
+    # hook-emulated since round 4 (static load-time instrumentation);
+    # prototype-pollution joined in round 5 (real probe navigations +
+    # static pollution property model)
     for hooked in (
         "postmessage-tracker",
         "postmessage-outgoing-tracker",
         "window-name-domxss",
+        "prototype-pollution-check",
     ):
         assert verdicts[hooked] is None, hooked
-    # location-driven pollution needs a real navigator: stays honest
-    assert verdicts["prototype-pollution-check"] == "js-required"
 
 
 def test_attr_collect_spec_parses_extract_urls_idiom():
@@ -576,3 +580,126 @@ def test_hooked_templates_silent_on_clean_page(hooked_server):
     finally:
         headless._Session = orig
     assert hits == []
+
+
+# --- prototype-pollution-check (round 5): real probe navigations +
+# static pollution property model over the probe page's scripts
+
+VULN_DEPARAM_PAGE = (b"<html><head><script>\n"
+    b"// jquery-deparam-style query parser (the PPScan target class)\n"
+    b"var params = {};\n"
+    b"var q = location.search.substring(1);\n"
+    b"q.split('&').forEach(function(pair) {\n"
+    b"  var kv = pair.split('=');\n"
+    b"  var keys = kv[0].split('[').map(function(s){return s.replace(']','');});\n"
+    b"  var obj = params;\n"
+    b"  for (var i = 0; i < keys.length - 1; i++) {\n"
+    b"    if (!obj[keys[i]]) { obj[keys[i]] = {}; }\n"
+    b"    obj = obj[keys[i]];\n"
+    b"  }\n"
+    b"  obj[keys[keys.length-1]] = decodeURIComponent(kv[1] || '');\n"
+    b"});\n"
+    b"</script></head><body>app</body></html>")
+
+VULN_HASH_PAGE = (b"<html><head><script>\n"
+    b"var opts = {};\n"
+    b"var frag = location.hash.slice(1);\n"
+    b"frag.split('&').forEach(function(pair) {\n"
+    b"  var kv = pair.split('=');\n"
+    b"  opts[kv[0]] = kv[1];\n"
+    b"});\n"
+    b"</script></head><body>hash app</body></html>")
+
+GUARDED_PAGE = (b"<html><head><script>\n"
+    b"var params = {};\n"
+    b"location.search.slice(1).split('&').forEach(function(pair) {\n"
+    b"  var kv = pair.split('=');\n"
+    b"  if (kv[0] === '__proto__' || !params.hasOwnProperty) return;\n"
+    b"  params[kv[0]] = kv[1];\n"
+    b"});\n"
+    b"</script></head><body>guarded</body></html>")
+
+PLAIN_PAGE = b"<html><body>No scripts here at all.</body></html>"
+
+
+@pytest.fixture
+def pollution_server():
+    class H(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                req = self.request.recv(8192).decode("latin-1", "replace")
+                path = req.split(" ", 2)[1] if " " in req else "/"
+                if path.startswith("/hash"):
+                    body = VULN_HASH_PAGE
+                elif path.startswith("/guarded"):
+                    body = GUARDED_PAGE
+                elif path.startswith("/clean"):
+                    body = PLAIN_PAGE
+                else:
+                    body = VULN_DEPARAM_PAGE
+                self.request.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: text/html\r\n"
+                    b"Content-Length: %d\r\nConnection: close\r\n\r\n%s"
+                    % (len(body), body)
+                )
+            except OSError:
+                pass
+
+    srv, port = _serve(H)
+    yield port
+    srv.shutdown()
+
+
+def test_prototype_pollution_real_verdict(pollution_server):
+    """The REAL prototype-pollution-check template fires on a page
+    whose own script deparams location.search into nested object keys
+    (the PPScan-vulnerable class): the probe navigation runs, the
+    property model observes the unguarded merge, and the alert is the
+    polluted location.href — so the corpus word matcher (__proto__)
+    and kval extractor run unmodified."""
+    t = _load_ref("prototype-pollution-check")
+    sc = headless.HeadlessScanner([t])
+    hits = sc.run([("127.0.0.1", "127.0.0.1", pollution_server, False)])
+    assert len(hits) == 1 and hits[0].template_id == "prototype-pollution-check"
+    out = hits[0].extractions[0]
+    assert "__proto__[" in out  # logger(location.href) with the marker
+    assert "ddcb362f1d60" in out  # the hook's payload value, from YAML
+
+
+def test_prototype_pollution_hash_probe(pollution_server):
+    """A parser reading location.hash (never sent on the wire) is
+    caught by the fragment probe; the alert URL carries the hash
+    marker, not the query marker."""
+    t = _load_ref("prototype-pollution-check")
+    # point BaseURL at /hash via a template copy with a rewritten path
+    import copy
+
+    t2 = copy.deepcopy(t)
+    for op in t2.operations:
+        for step in op.steps:
+            if str(step.get("action")) == "navigate":
+                step["args"]["url"] = "{{BaseURL}}/hash"
+    sc = headless.HeadlessScanner([t2])
+    hits = sc.run([("127.0.0.1", "127.0.0.1", pollution_server, False)])
+    assert len(hits) == 1
+    out = hits[0].extractions[0]
+    assert "#__proto__[" in out
+    assert "&dummy" in out
+
+
+def test_prototype_pollution_negative_pages(pollution_server):
+    """No verdict on a script-free page, and no verdict on a parser
+    that guards its keys (hasOwnProperty / __proto__ filter) — the
+    property model must not flag safe parsers."""
+    t = _load_ref("prototype-pollution-check")
+    import copy
+
+    for path in ("/clean", "/guarded"):
+        t2 = copy.deepcopy(t)
+        for op in t2.operations:
+            for step in op.steps:
+                if str(step.get("action")) == "navigate":
+                    step["args"]["url"] = "{{BaseURL}}" + path
+        sc = headless.HeadlessScanner([t2])
+        hits = sc.run([("127.0.0.1", "127.0.0.1", pollution_server, False)])
+        assert hits == [], (path, hits)

@@ -29,6 +29,22 @@ from swarm_tpu.ops.service import ServiceClassifier
 BUNDLED = str(nmap_probes.BUNDLED_DB)
 LARGE = str(Path(BUNDLED).parent / "service-probes-large.txt")
 
+def _snmp_reply(descr: bytes) -> bytes:
+    """A well-formed SNMPv2c GetResponse for sysDescr: version +
+    community + response PDU with request-id, error-status noError
+    (``02 01 00`` — mandatory NULs), error-index, and a varbind whose
+    OID 1.3.6.1.2.1.1.1.0 also ends in ``\x00``. Directives that
+    cannot cross NULs die on this shape (round-5 review finding)."""
+    vb = (b"\x06\x08\x2b\x06\x01\x02\x01\x01\x01\x00"
+          b"\x04" + bytes([len(descr)]) + descr)
+    vbl = b"\x30" + bytes([len(vb)])
+    pdu_body = (b"\x02\x01\x01\x02\x01\x00\x02\x01\x00"
+                + b"\x30" + bytes([len(vb) + 2]) + vbl + vb)
+    pdu = b"\xa2" + bytes([len(pdu_body)]) + pdu_body
+    msg = b"\x02\x01\x01\x04\x06public" + pdu
+    return b"\x30" + bytes([len(msg)]) + msg
+
+
 # (banner, port, want_service, want_product_fragment | None)
 # Product fragment None = service-level expectation only (softmatch ok).
 # HTTP responses arrive from the GetRequest probe in a real scan (nmap
@@ -95,6 +111,157 @@ ADVERSARIAL = [
     (b"\xff\xfd\x18\xff\xfd \xff\xfd#\xff\xfd'", 23, "telnet", None),
     (b"@RSYNCD: 31.0\n", 873, "rsync", None),
     (b"SSH-2.0-", 22, "ssh", None),  # truncated at the worst point
+    # ------------------------------------------------------------------
+    # round-5 widening (verdict Next #7): RDP, VNC, SMB, LDAP, MQTT,
+    # AMQP, SNMP + broader vendor variety on the existing protocols.
+    # 5-tuples name the eliciting probe for responses that only exist
+    # because that probe was sent (nmap probe-selection semantics).
+    # --- RDP: negotiation responses (TerminalServerCookie probe)
+    (b"\x03\x00\x00\x13\x0e\xd0\x00\x00\x124\x00\x02\x1f\x08\x00"
+     b"\x02\x00\x00\x00", 3389, "ms-wbt-server", "Terminal Services",
+     "TerminalServerCookie"),  # NLA/CredSSP selected
+    (b"\x03\x00\x00\x13\x0e\xd0\x00\x00\x124\x00\x02\x00\x08\x00"
+     b"\x01\x00\x00\x00", 3389, "ms-wbt-server", "Terminal Services",
+     "TerminalServerCookie"),  # TLS selected
+    (b"\x03\x00\x00\x13\x0e\xd0\x00\x00\x124\x00\x03\x00\x08\x00"
+     b"\x05\x00\x00\x00", 3389, "ms-wbt-server", "Terminal Services",
+     "TerminalServerCookie"),  # negotiation failure
+    (b"\x03\x00\x00\x0b\x06\xd0\x00\x00\x124\x00", 3389,
+     "ms-wbt-server", None, "TerminalServerCookie"),  # pre-NLA short CC
+    # --- VNC: vendor-pinned RFB versions (banner on connect)
+    (b"RFB 003.008\n", 5900, "vnc", "VNC"),
+    (b"RFB 003.889\n", 5900, "vnc", "Apple"),
+    (b"RFB 005.000\n", 5900, "vnc", "RealVNC"),
+    (b"RFB 004.001\n", 5901, "vnc", "RealVNC"),
+    (b"RFB 003.003\n", 5900, "vnc", "VNC"),
+    # --- SMB (SMBProgNeg probe): SMB1 and SMB2/3 negotiate responses
+    (b"\x00\x00\x00\x55\xffSMBr\x00\x00\x00\x00\x88\x01\xc8\x00\x00"
+     b"\x00\x00\x00\x00\x00\x00\x00\x00\x00\x00\xff\xfe\x00\x00\x00\x00",
+     445, "microsoft-ds", "SMB", "SMBProgNeg"),
+    (b"\x00\x00\x00\x41\xfeSMB\x40\x00\x00\x00\x00\x00\x00\x00\x00\x00"
+     b"\x01\x00", 445, "microsoft-ds", "SMB2", "SMBProgNeg"),
+    (b"\x83\x00\x00\x01\x8f", 139, "netbios-ssn", "NetBIOS",
+     "SMBProgNeg"),
+    # --- LDAP (LDAPBindReq probe): bind responses, BER forms
+    (b"0\x0c\x02\x01\x01a\x07\x0a\x01\x00\x04\x00\x04\x00", 389,
+     "ldap", "LDAP", "LDAPBindReq"),  # anonymous bind ok
+    (b"0\x2b\x02\x01\x01a\x26\x0a\x01\x31\x04\x00\x04\x1fInvalid "
+     b"credentials here padding", 389, "ldap", "LDAP", "LDAPBindReq"),
+    (b"0\x1a\x02\x01\x01a\x15\x0a\x01\x35\x04\x00\x04\x0eunwilling here",
+     636, "ldap", "LDAP", "LDAPBindReq"),
+    (b"0\x84\x00\x00\x00\x10\x02\x01\x01a\x84\x00\x00\x00\x07\x0a\x01"
+     b"\x00\x04\x00\x04\x00", 3268, "ldap", "LDAP", "LDAPBindReq"),
+    # --- MQTT (MQTTConnect probe): CONNACK return codes
+    (b"\x20\x02\x00\x00", 1883, "mqtt", "MQTT", "MQTTConnect"),
+    (b"\x20\x02\x00\x04", 1883, "mqtt", "MQTT", "MQTTConnect"),
+    (b"\x20\x02\x00\x05", 8883, "mqtt", "MQTT", "MQTTConnect"),
+    (b"\x20\x02\x00\x01", 1883, "mqtt", "MQTT", "MQTTConnect"),
+    # --- AMQP (AMQPHeader probe): Connection.Start frames + echoes
+    (b"\x01\x00\x00\x00\x00\x01\x00\x00\x0a\x00\x0a\x00\x09\x00\x00"
+     b"\x00\x60\x07productS\x00\x00\x00\x08RabbitMQ\x07versionS\x00\x00"
+     b"\x00\x063.12.1\x08platformS\x00\x00\x00\x0fErlang/OTP 25.3",
+     5672, "amqp", "RabbitMQ", "AMQPHeader"),
+    (b"AMQP\x00\x00\x09\x01", 5672, "amqp", "AMQP", "AMQPHeader"),
+    (b"AMQP\x03\x01\x00\x00", 5671, "amqp", "AMQP", "AMQPHeader"),
+    (b"\x01\x00\x00\x00\x00\x00\x40\x00\x0a\x00\x0a\x00\x09 Apache Qpid"
+     b" broker properties", 5672, "amqp", "Qpid", "AMQPHeader"),
+    # --- SNMP (UDP SNMPv2cPublic): sysDescr product shapes inside
+    # WELL-FORMED GetResponse BER (error-status 02 01 00 and the
+    # sysDescr OID both contain mandatory NULs — the vendor directives
+    # must cross them; crafted-banner-only recall masked dead patterns)
+    (_snmp_reply(b"Linux edge-host 5.15.0-91-generic #101-Ubuntu SMP"),
+     161, "snmp", "net-snmp", "SNMPv2cPublic"),
+    (_snmp_reply(b"Cisco IOS Software, C2960X Software "
+                 b"(C2960X-UNIVERSALK9-M), Version 15.2(7)E7"),
+     161, "snmp", "Cisco", "SNMPv2cPublic"),
+    (_snmp_reply(b"RouterOS RB4011iGS+"),
+     161, "snmp", "MikroTik", "SNMPv2cPublic"),
+    (_snmp_reply(b"Hardware: Intel64 Family 6 - "
+                 b"Software: Windows Version 6.3"),
+     161, "snmp", "Windows", "SNMPv2cPublic"),
+    (_snmp_reply(b"HP ETHERNET MULTI-ENVIRONMENT,JETDIRECT,JD153"),
+     161, "snmp", "JetDirect", "SNMPv2cPublic"),
+    # --- more SSH vendors
+    (b"SSH-2.0-libssh_0.9.6\r\n", 22, "ssh", "libssh"),
+    (b"SSH-2.0-Go\r\n", 22, "ssh", "Golang"),
+    (b"SSH-2.0-AsyncSSH_2.13.1\r\n", 2222, "ssh", "AsyncSSH"),
+    (b"SSH-2.0-paramiko_3.1.0\r\n", 22, "ssh", "Paramiko"),
+    (b"SSH-2.0-mod_sftp\r\n", 22, "ssh", "ProFTPD"),
+    # --- more HTTP products (GetRequest probe)
+    (b"HTTP/1.1 200 OK\r\nServer: Caddy\r\n\r\n", 80, "http", "Caddy"),
+    (b"HTTP/1.1 200 OK\r\nServer: Apache-Coyote/1.1\r\n\r\n", 8080,
+     "http", "Tomcat"),
+    (b"HTTP/1.1 200 OK\r\nServer: Jetty(9.4.48.v20220622)\r\n\r\n",
+     8080, "http", "Jetty"),
+    (b"HTTP/1.1 404 Not Found\r\nServer: LiteSpeed\r\n\r\n", 80,
+     "http", "LiteSpeed"),
+    (b"HTTP/1.1 200 OK\r\nServer: Tengine\r\n\r\n", 80, "http",
+     "Tengine"),
+    (b"HTTP/1.1 200 OK\r\nServer: WEBrick/1.7.0 (Ruby/3.0.2)\r\n\r\n",
+     3000, "http", "WEBrick"),
+    (b"HTTP/1.1 200 OK\r\nServer: Kestrel\r\n\r\n", 5000, "http",
+     "Kestrel"),
+    (b"HTTP/1.1 401 Unauthorized\r\nServer: MiniServ/1.990\r\n\r\n",
+     10000, "http", "Webmin"),
+    (b"HTTP/1.1 200 OK\r\nServer: GoAhead-Webs\r\n\r\n", 80, "http",
+     "GoAhead"),
+    (b"HTTP/1.1 200 OK\r\nServer: Boa/0.94.14rc21\r\n\r\n", 80,
+     "http", "Boa"),
+    (b"HTTP/1.1 200 OK\r\nServer: gunicorn/20.1.0\r\n\r\n", 8000,
+     "http", "gunicorn"),
+    (b"HTTP/1.1 200 OK\r\nServer: Werkzeug/2.2.2 Python/3.10.6\r\n\r\n",
+     5000, "http", "Werkzeug"),
+    (b"HTTP/1.1 200 OK\r\nX-Jenkins: 2.401.1\r\nServer: Jetty"
+     b"(10.0.13)\r\n\r\n", 8080, "http", "Jetty"),
+    (b"HTTP/1.1 200 OK\r\n\r\n{\"tagline\" : \"You Know, for Search\"}",
+     9200, "elasticsearch", "Elasticsearch"),
+    (b"HTTP/1.1 200 OK\r\nContent-Type: text/html\r\n\r\n"
+     b"<html><head><title>Grafana</title></head></html>", 3000,
+     "grafana", "Grafana"),
+    # --- mail: more vendor shapes
+    (b"220 mail.ex.org ESMTP OpenSMTPD\r\n", 25, "smtp", "OpenSMTPD"),
+    (b"220 mx.ex.org ESMTP MailEnable Service, Version: 10.1.4\r\n",
+     25, "smtp", "MailEnable"),
+    (b"+OK Courier POP3 ready\r\n", 110, "pop3", "Courier"),
+    (b"* OK IMAP4rev1 Zimbra 9.0.0 ready\r\n", 143, "imap", "Zimbra"),
+    # --- databases / caches: more shapes
+    (b"L\x00\x00\x00\x0a9.2.0\x00\x12\x00\x00\x00abcdefgh\x00\xff\xf7",
+     3306, "mysql", "MySQL"),
+    (b"STAT pid 1234\r\nSTAT uptime 99\r\nEND\r\n", 11211,
+     "memcached", "Memcached", "MemcachedVersion"),
+    (b"VERSION 1.6.17\r\n", 11211, "memcached", "Memcached",
+     "MemcachedVersion"),
+    (b"+PONG\r\n", 6379, "redis", "Redis", "RedisPING"),
+    (b"Zookeeper version: 3.8.1--1, built on 2023", 2181, "zookeeper",
+     "ZooKeeper", "ZookeeperStat"),
+    (b"E\x00\x00\x00\x66SFATAL\x00C0A000\x00Munsupported frontend "
+     b"protocol", 5432, "postgresql", "PostgreSQL", "PostgresStartup"),
+    # --- messaging: more shapes (banner on connect)
+    (b"INFO {\"server_id\":\"ND2YR\",\"version\":\"2.9.15\"}\r\n",
+     4222, "nats", "NATS"),
+    (b"UNKNOWN_COMMAND\r\n", 11300, "beanstalkd", "beanstalkd"),
+    (b":irc.ex.net NOTICE AUTH :*** Looking up your hostname\r\n",
+     6667, "irc", "ircd"),
+    # --- telnet vendor prompts
+    (b"\xff\xfb\x01\xff\xfb\x03MikroTik v6.49.7 (stable)\r\nLogin: ",
+     23, "telnet", "MikroTik"),
+    (b"\xff\xfd\x01BusyBox v1.35.0 built-in shell (ash)\r\nlogin: ",
+     23, "telnet", "BusyBox"),
+    # --- misc
+    (b"( success ( 2 2 ( ) ( edit-pipeline svndiff1 ) ) )", 3690,
+     "svn", "Subversion", "SVNGreeting"),
+    (b"\x4e\x00\x0e10.0.0.5:1099", 1099, "java-rmi", "RMI",
+     "JavaRMI"),
+    (b"HTTP/1.1 200 OK\r\nContent-Type: application/ipp\r\n\r\n", 631,
+     "ipp", "IPP"),
+    (b"RTSP/1.0 200 OK\r\nCSeq: 1\r\nServer: GStreamer RTSP server\r\n"
+     b"\r\n", 554, "rtsp", None, "RTSPRequest"),
+    (b"SIP/2.0 200 OK\r\nVia: SIP/2.0/TCP nm;branch=z9hG4bK\r\n\r\n",
+     5060, "sip", None, "SIPOptions"),
+    (b"\x05\x00", 1080, "socks5", "SOCKS5"),
+    (b"TS3\r\n", 10011, "teamspeak", "TeamSpeak"),
+    (b"@PJL INFO STATUS\r\nCODE=10001\r\n", 9100, "printer",
+     "JetDirect"),
 ]
 
 
@@ -103,21 +270,31 @@ def head_classifier():
     return ServiceClassifier(db_path=BUNDLED)
 
 
-def _probe_for(banner: bytes) -> str:
-    return "GetRequest" if banner.startswith(b"HTTP/") else "NULL"
+def _case(c):
+    """Normalize a 4- or 5-tuple case to (banner, port, svc, prod,
+    probe): the optional 5th element names the probe whose response
+    this banner is (binary protocols only answer their own probe);
+    unnamed cases infer GetRequest for HTTP, NULL otherwise."""
+    banner, port, want_s, want_p = c[:4]
+    probe = (
+        c[4] if len(c) > 4
+        else ("GetRequest" if banner.startswith(b"HTTP/") else "NULL")
+    )
+    return banner, port, want_s, want_p, probe
 
 
 def _recall(classifier, cases):
+    norm = [_case(c) for c in cases]
     rows = [
         Response(host=f"h{i}.example", port=port, banner=banner)
-        for i, (banner, port, _s, _p) in enumerate(cases)
+        for i, (banner, port, _s, _p, _pr) in enumerate(norm)
     ]
     infos = classifier.classify(
-        rows, sent_probes=[_probe_for(b) for b, _p2, _s, _pr in cases]
+        rows, sent_probes=[pr for _b, _p2, _s, _pr2, pr in norm]
     )
     svc_hits = prod_hits = prod_total = 0
     misses = []
-    for (banner, port, want_s, want_p), info in zip(cases, infos):
+    for (banner, port, want_s, want_p, _probe), info in zip(norm, infos):
         if info.service == want_s:
             svc_hits += 1
         else:
@@ -135,10 +312,12 @@ def test_adversarial_recall_head_db(head_classifier):
     print(f"\nhead-DB adversarial recall: service {svc}/{n} "
           f"({svc/n:.0%}), product {prod}/{prod_total} "
           f"({prod/prod_total:.0%}); misses: {misses}")
-    # floors pin today's measured quality (35/35 service, 28/28
-    # product after the MariaDB-ordering fix); raise as the DB grows —
-    # regressions below these mean real-world detection got worse
-    assert svc / n >= 0.90, misses
+    # floors pin today's measured quality (round 5: 107/107 service,
+    # 97/97 product over the widened RDP/VNC/SMB/LDAP/MQTT/AMQP/SNMP +
+    # vendor-variety set); raise as the DB grows — regressions below
+    # these mean real-world detection got worse
+    assert n >= 100  # the set itself must stay adversarially wide
+    assert svc / n >= 0.97, misses
     assert prod / prod_total >= 0.95, misses
 
 
@@ -190,3 +369,39 @@ def test_system_db_pickup_real_format(tmp_path, monkeypatch):
     assert infos[0].product == "MarkerD" and infos[0].version == "2.1"
     assert infos[1].service == "other" and infos[1].product == "OtherD"
     assert infos[2].service == "marker-svc"  # softmatch
+
+
+def test_version_and_info_detail_on_widened_protocols(head_classifier):
+    """The round-5 directives carry CONFIG detail (version capture,
+    security-layer/auth-policy info) — assert it explicitly so a
+    directive regressing to its generic sibling (same service/product,
+    no detail) fails here instead of hiding behind product recall.
+    The review round caught exactly that: NUL-blind patterns that
+    could never match well-formed replies while crafted banners kept
+    recall at 100%."""
+    rabbit = (b"\x01\x00\x00\x00\x00\x01\x00\x00\x0a\x00\x0a\x00\x09"
+              b"\x00\x00\x00\x60\x07productS\x00\x00\x00\x08RabbitMQ"
+              b"\x07versionS\x00\x00\x00\x063.12.1")
+    rdp_nla = (b"\x03\x00\x00\x13\x0e\xd0\x00\x00\x124\x00\x02\x1f"
+               b"\x08\x00\x02\x00\x00\x00")
+    cases = [
+        (rabbit, 5672, "AMQPHeader"),
+        (rdp_nla, 3389, "TerminalServerCookie"),
+        (_snmp_reply(b"Linux edge 5.15.0-91-generic #101-Ubuntu SMP"),
+         161, "SNMPv2cPublic"),
+        (b"\x20\x02\x00\x05", 1883, "MQTTConnect"),
+        (b"0\x0c\x02\x01\x01a\x07\x0a\x01\x00\x04\x00\x04\x00", 389,
+         "LDAPBindReq"),
+    ]
+    infos = head_classifier.classify(
+        [Response(host=f"d{i}", port=p, banner=b)
+         for i, (b, p, _pr) in enumerate(cases)],
+        sent_probes=[pr for _b, _p, pr in cases],
+    )
+    amqp, rdp, snmp, mqtt, ldap = infos
+    assert amqp.product == "RabbitMQ" and amqp.version == "3.12.1"
+    assert "NLA" in (rdp.info or "")
+    assert snmp.product == "net-snmp"
+    assert "host edge" in (snmp.info or "")  # i/host $1/ captured
+    assert "not authorized" in (mqtt.info or "")
+    assert "anonymous bind ok" in (ldap.info or "")
