@@ -382,6 +382,21 @@ def required_literal_set(
     return best[0]
 
 
+def required_literal_ladder(
+    pattern: str, min_lens: tuple = (4, 3, 2)
+) -> Optional[list]:
+    """``required_literal_set`` at the first ``min_len`` that yields a
+    set — the shared relax ladder for every literal gate (device
+    superset lowering, extraction prefilters, fastre's host gate), so
+    the host and device can never disagree about which literals a
+    pattern requires."""
+    for ml in min_lens:
+        s = required_literal_set(pattern, min_len=ml)
+        if s is not None:
+            return s
+    return None
+
+
 def required_literal(pattern: str, min_len: int = 4) -> Optional[bytes]:
     """Single required literal (longest member of a singleton set)."""
     lits = required_literal_set(pattern, min_len=min_len, max_alts=1)
@@ -1076,6 +1091,9 @@ class CompiledDB:
     # host-side provenance (sparse confirmation, engine.py): device ids
     # back to source template/operation/matcher indices + ragged lists
     m_src: np.ndarray  # int32 [NM, 3] (template_idx, op_local, matcher_local)
+    # (extractor_local, pattern_idx) for synthesized per-pattern
+    # extraction prefilters; (-1, -1) for everything else
+    m_ext_src: np.ndarray  # int32 [NM, 2]
     op_src: np.ndarray  # int32 [NOP, 2] (template_idx, op_local)
     op_matchers: list  # list[list[int]] op id → device matcher ids
     t_ops: list  # list[list[int]] template id → device op ids
@@ -1447,14 +1465,7 @@ def compile_corpus(
                 # relax the length floor before giving up: a 2–3 byte
                 # anchor (binary protocol magic like "N\x00\x0e") takes
                 # the exact tiny-slot path and still beats fire-always
-                def relaxed(p):
-                    for ml in (4, 3, 2):
-                        s = required_literal_set(p, min_len=ml)
-                        if s is not None:
-                            return s
-                    return None
-
-                lit_sets = [relaxed(p) for p in m.regex]
+                lit_sets = [required_literal_ladder(p) for p in m.regex]
                 if m.condition == "and" or len(m.regex) == 1:
                     # any single pattern's set is already necessary —
                     # the union of the available ones is sound (weaker)
@@ -1495,53 +1506,50 @@ def compile_corpus(
             return const_true_unc()
         return const_true_unc()
 
-    def lower_extraction_prefilter(op) -> dict:
-        """Pseudo-matcher for an operation with extractors but NO
+    def lower_extraction_prefilter(op) -> Optional[list]:
+        """Pseudo-matchers for an operation with extractors but NO
         matchers: nuclei reports such templates iff any extractor
         extracts (reference worker/artifacts/templates/exposures/
         tokens/generic/credentials-disclosure.yaml:20-24 — the
-        exposures/tokens family's entire mechanism). Device value is a
-        superset prefilter: any extraction regex's required literals
-        present ⇒ uncertain (host runs the extractors to decide, via
-        engine._confirm_operation's extractor-only branch); no literal
-        present ⇒ exactly non-matching, no host walk. Non-regex
-        extractors or literal-less patterns degrade to fire-always
-        (every row host-confirmed — correct, just slower); the whole
-        reference http population lowers to real literal sets
+        exposures/tokens family's entire mechanism).
+
+        One MK_REGEX_PREFILTER pseudo-matcher PER extraction pattern,
+        carrying that pattern's required literals: the device q-gram
+        pass then reports WHICH patterns could match (the pm-plane
+        uncertainty bits), so a fired multi-hundred-pattern extractor
+        costs the host only the one or two literal-hit patterns — the
+        gram work rides the kernel the corpus matchers already use,
+        instead of a per-fire host scan over every pattern. No literal
+        present anywhere ⇒ every pseudo-matcher is certain-false and
+        the op resolves with zero host work. ``pseudo_ext`` on each
+        rec records (extractor_local, pattern_idx) provenance
+        (db.m_ext_src) for the engine's per-pattern confirm and the
+        extraction pass's bit-driven gating.
+
+        Returns None when any extractor is non-regex or any pattern
+        has no required literal — the caller degrades to ONE
+        fire-always prefilter rec for the whole op (every row
+        host-confirmed — correct, just slower). The whole reference
+        http/dns population lowers per-pattern
         (tests/test_extractor_only.py pins that)."""
-        slot_ids: list[int] = []
-        ok = True
-        for ex in op.extractors:
+        recs: list = []
+        for ex_local, ex in enumerate(op.extractors):
             if ex.type != "regex" or not ex.regex:
-                ok = False
-                break
+                return None
             stream = stream_for_part(ex.part or "body")
             if stream is None:
-                ok = False
-                break
-            for p in ex.regex:
-                s = None
-                for ml in (4, 3, 2):
-                    s = required_literal_set(p, min_len=ml)
-                    if s is not None:
-                        break
+                return None
+            for p_idx, p in enumerate(ex.regex):
+                s = required_literal_ladder(p)
                 if s is None:
-                    ok = False
-                    break
-                slot_ids.extend(slots.get(lit, stream, True) for lit in s)
-            if not ok:
-                break
-        rec = const_true_unc()
-        if ok and slot_ids:
-            # "any extractor extracts" is an OR over patterns, so the
-            # union of per-pattern necessary literals is necessary for
-            # the op — same soundness argument as the OR branch of
-            # lower_matcher_superset's regex path
-            rec["kind"] = MK_REGEX_PREFILTER
-            rec["cond_and"] = False
-            rec["slots"] = slot_ids
-        rec["pseudo_ext"] = True
-        return rec
+                    return None
+                rec = const_true_unc()
+                rec["kind"] = MK_REGEX_PREFILTER
+                rec["cond_and"] = False
+                rec["slots"] = [slots.get(lit, stream, True) for lit in s]
+                rec["pseudo_ext"] = (ex_local, p_idx)
+                recs.append(rec)
+        return recs or None
 
     for template in templates:
         if template.protocol == "workflow" or not template.operations:
@@ -1559,14 +1567,32 @@ def compile_corpus(
                 if op.extractors and template.protocol in (
                     "http", "network", "dns",
                 ):
-                    lowered_ops.append(
-                        {
-                            "cond_and": False,
-                            "matchers": [lower_extraction_prefilter(op)],
-                            "prefilter": True,
-                            "op_local": op_local,
-                        }
-                    )
+                    recs = lower_extraction_prefilter(op)
+                    if recs is not None:
+                        # per-pattern matchers, OR'd; NOT an op-level
+                        # prefilter — the walk confirms exactly the
+                        # pattern-matchers whose literals fired
+                        lowered_ops.append(
+                            {
+                                "cond_and": False,
+                                "matchers": recs,
+                                "prefilter": False,
+                                "op_local": op_local,
+                            }
+                        )
+                    else:
+                        # degrade: one fire-always rec, whole-op
+                        # host confirm on every row (correct, slower)
+                        fallback = const_true_unc()
+                        fallback["pseudo_ext"] = (-1, -1)
+                        lowered_ops.append(
+                            {
+                                "cond_and": False,
+                                "matchers": [fallback],
+                                "prefilter": True,
+                                "op_local": op_local,
+                            }
+                        )
                 continue
             recs = []
             exact = True
@@ -1893,6 +1919,14 @@ def compile_corpus(
     m_src = np.zeros((NM, 3), dtype=np.int32)
     for i, rec in enumerate(matchers):
         m_src[i] = rec["src"]
+    # per-pattern extraction provenance: matcher id -> (extractor_local,
+    # pattern_idx) for synthesized extraction prefilters, (-1, -1)
+    # otherwise (incl. the fire-always degrade, which confirms whole-op)
+    m_ext_src = np.full((NM, 2), -1, dtype=np.int32)
+    for i, rec in enumerate(matchers):
+        pe = rec.get("pseudo_ext")
+        if isinstance(pe, tuple):
+            m_ext_src[i] = pe
     op_src = np.zeros((NOP, 2), dtype=np.int32)
     for i, o in enumerate(ops):
         op_src[i] = o["src"]
@@ -1963,6 +1997,7 @@ def compile_corpus(
         t_op_buckets=t_op_buckets,
         t_prefilter=t_prefilter,
         m_src=m_src,
+        m_ext_src=m_ext_src,
         op_src=op_src,
         op_matchers=op_matchers,
         t_ops=[list(o) for o in t_ops],

@@ -112,6 +112,7 @@ def _ensure_crex_locked() -> Optional[ctypes.CDLL]:
     lib.sw_crex_finditer.restype = ctypes.c_int64
     lib.sw_crex_finditer_batch.restype = ctypes.c_int64
     lib.sw_crex_search.restype = ctypes.c_int32
+    lib.sw_crex_exists.restype = ctypes.c_int32
     _lib = lib
     return lib
 
@@ -237,6 +238,48 @@ def finditer_spans_batch(
     return res
 
 
+def exists(cp, data: bytes) -> Optional[bool]:
+    """Linear-time ``re.search(pattern, text) is not None``. ``cp``
+    must come from crexc.compile_crex_nfa (counter-free).
+
+    Two native tiers, both exact and budget-free: the lazy DFA
+    (subset construction with byte equivalence classes, built once
+    per pattern and cached on the program object — ~ns/byte steady
+    state) for anchor-free programs, then the bitset Thompson scan
+    (O(len x program)) for the rest or when the DFA hits its state
+    cap. Returns None when the lib is unavailable or the program
+    isn't simulable (caller falls back)."""
+    lib = ensure_crex()
+    if lib is None or cp is None:
+        return None
+    pp, mp, nprog = getattr(cp, "_bound", None) or _bind(cp)
+    dfa = getattr(cp, "_dfa", None)
+    if dfa is None:
+        # 0 (NULL) = program doesn't qualify (anchors) — remembered so
+        # the attempt isn't repeated. A racing second build constructs
+        # one redundant context; attribute assignment is atomic and
+        # both get finalizers, so neither leaks.
+        lib.sw_crex_dfa_new.restype = ctypes.c_void_p
+        dfa = lib.sw_crex_dfa_new(pp, nprog, mp) or 0
+        if dfa:
+            # the context must die WITH the program object: a program
+            # from a saturated compile cache is throwaway, and an
+            # orphaned context would leak its state tables
+            import weakref
+
+            weakref.finalize(cp, lib.sw_crex_dfa_free,
+                             ctypes.c_void_p(dfa))
+        cp._dfa = dfa
+    if dfa:
+        rc = lib.sw_crex_dfa_exists(ctypes.c_void_p(dfa), data, len(data))
+        if rc >= 0:
+            return bool(rc)
+    rc = lib.sw_crex_exists(pp, nprog, mp, data, len(data))
+    if rc < 0:
+        return None
+    return bool(rc)
+
+
 def search(cp, data: bytes) -> Optional[bool]:
     """``re.search(pattern, text) is not None`` — or None on resource
     exhaustion (caller falls back)."""
@@ -255,6 +298,6 @@ def search(cp, data: bytes) -> Optional[bool]:
 
 
 __all__ = [
-    "ensure_crex", "finditer_spans", "finditer_spans_batch", "search",
-    "usable", "MAX_BUDGET_FAILS", "STEP_BUDGET",
+    "ensure_crex", "exists", "finditer_spans", "finditer_spans_batch",
+    "search", "usable", "MAX_BUDGET_FAILS", "STEP_BUDGET",
 ]

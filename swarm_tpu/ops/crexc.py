@@ -47,7 +47,7 @@ OP_REPG, OP_REPL, OP_AT, OP_LOOP = 6, 7, 8, 9
 #: encoding, or driver return codes, in lockstep with
 #: CREX_ABI_VERSION in native/crex.cpp. native/crex.py verifies the
 #: loaded .so reports this value and refuses a stale build.
-CREX_ABI = 3
+CREX_ABI = 4
 
 _INT32_MAX = 2**31 - 1
 
@@ -96,12 +96,16 @@ def _guard_ci_fold(arg: int, ci: bool, what: str) -> None:
 
 
 class _Compiler:
-    def __init__(self):
+    def __init__(self, counted_reps: bool = True):
         self.instrs: list[list[int]] = []
         self.masks: list[bytes] = []
         self._mask_idx: dict[bytes, int] = {}
         self.max_group = 0
         self.n_loops = 0  # loop-mark slots, allocated from MAX_SLOTS down
+        # False: lower single-class repeats as unrolled SPLIT chains
+        # instead of counted OP_REPG/OP_REPL — the NFA existence scan
+        # (native sw_crex_exists) cannot simulate counters
+        self.counted_reps = counted_reps
 
     def loop_slot(self) -> int:
         self.n_loops += 1
@@ -259,7 +263,7 @@ class _Compiler:
             # would be absurd anyway) — stay on Python re
             raise _Unsupported("repeat bound exceeds int32")
         mask = self._single_class(sub, ci, dotall)
-        if mask is not None:
+        if mask is not None and self.counted_reps:
             self.emit(OP_REPL if lazy else OP_REPG,
                       self.mask_id(mask), lo, hi)
             return
@@ -383,7 +387,28 @@ def compile_crex(pattern: str) -> Optional[CrexProgram]:
     return out
 
 
-def _compile(pattern: str) -> Optional[CrexProgram]:
+def compile_crex_nfa(pattern: str) -> Optional[CrexProgram]:
+    """Pattern -> counter-free program for the linear-time NFA
+    existence scan (native sw_crex_exists): single-class repeats
+    unroll like general bodies instead of emitting counted OP_REP
+    instructions. Oversized unrolls (huge {m,n}) fall out via
+    MAX_PROG -> None, and the caller stays on the backtracking /
+    Python-re paths."""
+    hit = _NFA_CACHE.get(pattern)
+    if hit is not None or pattern in _NFA_CACHE:
+        return hit
+    out = _compile(pattern, counted_reps=False)
+    if len(_NFA_CACHE) < _CACHE_MAX:
+        _NFA_CACHE[pattern] = out
+    return out
+
+
+_NFA_CACHE: dict = {}
+
+
+def _compile(
+    pattern: str, counted_reps: bool = True
+) -> Optional[CrexProgram]:
     try:
         tree = parse_quiet(pattern)
     except re.error:
@@ -394,7 +419,7 @@ def _compile(pattern: str) -> Optional[CrexProgram]:
     ci = bool(flags & re.IGNORECASE)
     dotall = bool(flags & re.DOTALL)
     multiline = bool(flags & re.MULTILINE)
-    c = _Compiler()
+    c = _Compiler(counted_reps=counted_reps)
     try:
         c.compile_seq(list(tree), ci, dotall, multiline)
         c.emit(OP_MATCH)
@@ -425,4 +450,4 @@ def _compile(pattern: str) -> Optional[CrexProgram]:
     )
 
 
-__all__ = ["compile_crex", "CrexProgram", "MAX_PROG"]
+__all__ = ["compile_crex", "compile_crex_nfa", "CrexProgram", "MAX_PROG"]

@@ -238,6 +238,40 @@ class MatchEngine:
             db.templates[t].operations[o].matchers[m] if m >= 0 else None
             for t, o, m in db.m_src
         ] if db.templates else []
+        # per-pattern extraction-prefilter provenance (m == -1 rows):
+        # matcher id -> (extractor_local, pattern_idx), (-1, -1) for
+        # real matchers and the fire-always degrade
+        ext_src = getattr(db, "m_ext_src", None)
+        self._m_ext_src_py = (
+            [(int(a), int(b)) for a, b in ext_src]
+            if ext_src is not None
+            else [(-1, -1)] * len(self._m_obj)
+        )
+        # matcher id -> owning op id (per-pattern confirm needs the op
+        # object; built once from the op->matchers table)
+        self._m_op_id = [0] * len(self._m_obj)
+        for op_id_, ids_ in enumerate(db.op_matchers):
+            for m_ in ids_:
+                self._m_op_id[int(m_)] = op_id_
+        # ops lowered as per-pattern extraction prefilters: op id ->
+        # tuple of (extractor_local, pattern_idx) aligned with the op's
+        # matcher ids — the walk turns the device pm-uncertainty bits
+        # into the extraction pass's live-pattern hints
+        self._op_ext_pats = {}
+        for op_id_, ids_ in enumerate(db.op_matchers):
+            pats = [self._m_ext_src_py[int(m_)] for m_ in ids_]
+            if pats and all(p[0] >= 0 for p in pats):
+                self._op_ext_pats[op_id_] = tuple(pats)
+        # templates whose EVERY op is a per-pattern extraction
+        # prefilter: their verdict IS "any extraction non-empty", so
+        # the walk defers their uncertain bits to the batched
+        # extraction pass (one native dispatch per distinct pattern)
+        # instead of paying a per-(row, pattern) confirm round trip
+        self._pseudo_t = frozenset(
+            t_idx for t_idx, t_op_ids in enumerate(db.t_ops)
+            if len(t_op_ids)
+            and all(int(op) in self._op_ext_pats for op in t_op_ids)
+        )
         self._op_obj = [
             db.templates[t].operations[o] for t, o in db.op_src
         ] if db.templates else []
@@ -392,9 +426,18 @@ class MatchEngine:
         )
         return self._ext_pool_obj
 
-    def _extract_pending(self, pending: list, nrows: list) -> dict:
+    def _extract_pending(
+        self, pending: list, nrows: list, hints: Optional[dict] = None
+    ) -> dict:
         """(b, t_idx) -> ordered extraction values for the native
         walk's resolved hit list.
+
+        ``hints``: optional {(b, op_id): {ex_local: [p_idx, ...]}}
+        of LIVE patterns for per-pattern extraction-prefilter ops
+        (from the device pm-uncertainty bits): non-live patterns are
+        exact no-matches and are skipped with no host work at all —
+        every structure here is sized by the live count, never the
+        op's full pattern population (credentials-disclosure: 689).
 
         Semantics are exactly ``_extract_op`` applied in hit order —
         same content-keyed memo, same extractor/pattern ordering, same
@@ -424,7 +467,8 @@ class MatchEngine:
         for b, t_idx, op_id in pending:
             row = nrows[b]
             seg = segs.setdefault((b, t_idx), [])
-            for ex in self._op_obj[op_id].extractors:
+            hint = hints.get((b, op_id)) if hints else None
+            for ex_local, ex in enumerate(self._op_obj[op_id].extractors):
                 if ex.type in ("regex", "json", "xpath"):
                     key = (id(ex), row.part(ex.part))
                 elif ex.type == "kval":
@@ -445,21 +489,63 @@ class MatchEngine:
                     seg.append(("v", vals))
                     continue
                 part = key[1]
-                infos = [_fastre.analyze(p) for p in ex.regex]
+                # live-pattern discovery, cheapest proof first:
+                # device pm-bit hint (zero host work) when this op is
+                # a per-pattern extraction prefilter, else the
+                # per-pattern literal gate (exact either way: a
+                # pattern whose necessary literals are absent cannot
+                # match) — the fired-template cost is proportional to
+                # patterns whose literal actually occurred, not the
+                # extractor's full pattern count
+                if hint is not None:
+                    live = hint.get(ex_local, [])
+                else:
+                    lowered = part.lower()
+                    live = []
+                    for p_idx, p in enumerate(ex.regex):
+                        info = _fastre.analyze(p)
+                        if info.ok and info.literals and (
+                            _fastre.literals_absent(info, lowered)
+                        ):
+                            continue
+                        live.append(p_idx)
+                if live:
+                    # linear-time existence pre-gate: a pattern the
+                    # lazy DFA proves absent needs NO finditer at all
+                    # (the literal/pm-bit gates only prove gram
+                    # presence; most gram hits are not matches, and a
+                    # missing match costs the backtracker/re its worst
+                    # case — 2-19 ms for leading-repeat shapes vs ~6 us
+                    # here)
+                    kept = []
+                    for p_idx in live:
+                        nfa = _fastre.analyze(ex.regex[p_idx]).nfa
+                        if nfa is not None and ncrex.exists(
+                            nfa, part
+                        ) is False:
+                            continue
+                        kept.append(p_idx)
+                    live = kept
+                if not live:
+                    self._cache_put(cache, key, [])
+                    seg.append(("v", []))
+                    continue
+                infos = {p: _fastre.analyze(ex.regex[p]) for p in live}
                 if not isinstance(ex.group, int) or not all(
-                    i.ok and ncrex.usable(i.cprog) for i in infos
+                    i.ok and ncrex.usable(i.cprog)
+                    for i in infos.values()
                 ):
                     vals = self._accel_extract_regex(ex, part)
                     self._cache_put(cache, key, vals)
                     seg.append(("v", vals))
                     continue
                 fills[key] = {
-                    "ex": ex, "part": part, "by_pat": [None] * len(ex.regex),
+                    "ex": ex, "part": part, "live": live, "by_pat": {},
                 }
-                for p_idx, info in enumerate(infos):
+                for p_idx in live:
                     t = tasks.setdefault(
                         (ex.regex[p_idx], ex.group),
-                        {"cp": info.cprog, "items": [], "parts": []},
+                        {"cp": infos[p_idx].cprog, "items": [], "parts": []},
                     )
                     t["items"].append((key, p_idx))
                     t["parts"].append(part)
@@ -518,7 +604,8 @@ class MatchEngine:
                     # extractor re-runs on the exact per-call path
                     vals = self._accel_extract_regex(f["ex"], f["part"])
                 else:
-                    vals = [v for pv in f["by_pat"] for v in pv]
+                    by_pat = f["by_pat"]
+                    vals = [v for p in f["live"] for v in by_pat[p]]
                 self._cache_put(cache, key, vals)
                 done[key] = vals
 
@@ -537,10 +624,31 @@ class MatchEngine:
         """Candidate-anchored regex extraction — byte-identical to
         cpu_ref.extract_one for type=regex (fuzz-pinned by
         tests/test_fastre.py); patterns the accelerator can't take
-        fall back to the oracle's finditer loop per pattern."""
+        fall back to the oracle's finditer loop per pattern.
+
+        Per-pattern literal gate: a pattern whose necessary literals
+        are all absent CANNOT match — skipping it is exact and turns a
+        fired multi-hundred-pattern extractor (credentials-disclosure:
+        689 regexes) into a few bytes.find calls plus the one or two
+        patterns whose literal actually occurred."""
+        from swarm_tpu.native import crex as _ncrex
+
         out: list = []
         text = None
+        lowered = None
         for pattern in ex.regex:
+            info = fastre.analyze(pattern)
+            if info.ok and info.literals:
+                if lowered is None:
+                    lowered = part.lower()
+                if fastre.literals_absent(info, lowered):
+                    continue
+            # linear-time existence pre-gate (same proof as the
+            # batched path): no match => no values, skip the finditer
+            if info.nfa is not None and _ncrex.exists(
+                info.nfa, part
+            ) is False:
+                continue
             if text is None:
                 text = part.decode("latin-1")
             vals = fastre.finditer_values(pattern, part, text, ex.group)
@@ -557,6 +665,35 @@ class MatchEngine:
             except re.error:
                 continue
         return out
+
+    def _confirm_ext_pattern(self, m_id: int, row: Response) -> bool:
+        """Exact verdict of ONE synthesized extraction-prefilter
+        matcher: does this extraction pattern match the row's part
+        (any match ⇒ the extractor extracts ⇒ the op matches — group
+        participation doesn't matter for the bool). Content-keyed
+        cache shared with the matcher confirms."""
+        op = self._op_obj[self._m_op_id[m_id]]
+        ex_local, p_idx = self._m_ext_src_py[m_id]
+        if ex_local < 0:  # fire-always degrade: whole-op confirm
+            return self._confirm_operation(op, row)
+        ex = op.extractors[ex_local]
+        pattern = ex.regex[p_idx]
+        part = row.part(ex.part)
+        key = ("pe", m_id, part)
+        cache = self._confirm_cache
+        v = cache.get(key)
+        if v is None:
+            info = fastre.analyze(pattern)
+            if not info.ok:
+                v = False  # invalid under re: extract_one yields nothing
+            else:
+                text = part.decode("latin-1")
+                sv = fastre.search_bool(pattern, part, text)
+                if sv is None:
+                    sv = info.rex.search(text) is not None
+                v = bool(sv)
+            self._cache_put(cache, key, v)
+        return v
 
     def _confirm_operation(self, op, row: Response) -> bool:
         """Exactly ``cpu_ref.match_operation(op, row)[0]`` with the
@@ -952,6 +1089,9 @@ class MatchEngine:
 
         def confirm_matcher(m_id: int, row: Response) -> bool:
             matcher = self._m_obj[m_id]
+            if matcher is None:
+                # synthesized extraction prefilter: per-pattern verdict
+                return self._confirm_ext_pattern(m_id, row)
             if matcher.type not in ("word", "regex", "binary", "size"):
                 # dsl/status/kval read beyond matcher.part — not cacheable
                 mv = cpu_ref.match_matcher(matcher, row)
@@ -1044,9 +1184,13 @@ class MatchEngine:
 
         # --- sparse uncertainty resolution (unique plane) ---
         t_unc = time.perf_counter()
+        use_native = self._use_native_memo()
+        # (b, t_idx) pairs whose verdict is decided by the extraction
+        # pass below (pseudo-ext templates on the native path)
+        pseudo_pending: list = []
         if not row_redo.all():
             skip = set(redo_rows.tolist())
-            if self._use_native_memo():
+            if use_native:
                 from swarm_tpu.native.scanio import plane_bits
 
                 ub, ut = plane_bits(np.ascontiguousarray(pt_unc), NT)
@@ -1059,11 +1203,24 @@ class MatchEngine:
                     if (int(pt_unc[b, byte_i]) & (0x80 >> k))
                     and byte_i * 8 + k < NT
                 )
+            pseudo_t = self._pseudo_t
             for b, t_idx in pairs:
                 if b in skip:
                     continue
                 byte_i = t_idx >> 3
                 mask = 0x80 >> (t_idx & 7)
+                if (
+                    use_native
+                    and t_idx in pseudo_t
+                    and t_idx not in rowdep
+                ):
+                    # verdict == extraction non-emptiness: decided by
+                    # the batched extraction pass (bit set there on
+                    # extraction); per-pair confirm calls cost ~10x
+                    # the batched native scan at walk rates
+                    pseudo_pending.append((b, t_idx))
+                    pt_value[b, byte_i] &= 0xFF ^ mask
+                    continue
                 row = nrows[b]
                 if t_idx in rowdep:
                     # undecided row-dependent template: content-
@@ -1130,13 +1287,61 @@ class MatchEngine:
                     if st == 2 and not resolve_op(b, op_id, nrows[b]):
                         continue
                     pending.append((b, t_idx, op_id))
+                # deferred pseudo-ext verdicts ride the same batch:
+                # each uncertain op with >= 1 live pattern joins the
+                # pending list; its (b, t) verdict bit is set below
+                # iff the batched extraction produced values
+                pseudo_set = set()
+                for b, t_idx in pseudo_pending:
+                    pseudo_set.add((b, t_idx))
+                    for op_id in self._t_ops_py[t_idx]:
+                        if _bit(pop_unc, b, op_id):
+                            pending.append((b, t_idx, op_id))
+                if pseudo_pending:
+                    self.stats.host_confirm_pairs += len(pseudo_pending)
+                    for b, _t in pseudo_pending:
+                        confirms[b] = confirms.get(b, 0) + 1
+                # live-pattern hints for per-pattern extraction
+                # prefilters: the device pm-uncertainty bits already
+                # say WHICH patterns' literals occurred — the
+                # extraction pass then skips every other pattern with
+                # no host scanning at all (certain-false bits are an
+                # exact no-match proof)
+                # hints are {ex_local: [p_idx, ...]} with only LIVE
+                # patterns (flatnonzero is ascending and matcher order
+                # is (ex_local, p_idx)-ascending, so lists stay in
+                # pattern order) — consumers never touch the op's full
+                # pattern population. The pm-plane gather batches per
+                # op across all its pending rows: one 2D fancy-index
+                # instead of a per-(row, op) 689-element gather.
+                hints: dict = {}
+                by_op: dict = {}
+                for b, _t_idx, op_id in pending:
+                    if op_id in self._op_ext_pats:
+                        by_op.setdefault(op_id, set()).add(b)
+                for op_id, bset in by_op.items():
+                    rows_ = sorted(bset)
+                    bits2 = (
+                        pm_unc[np.ix_(rows_, self._op_m_bytes[op_id])]
+                        >> self._op_m_shift[op_id][None, :]
+                    ) & 1
+                    pats = self._op_ext_pats[op_id]
+                    for ri, b in enumerate(rows_):
+                        live_by_ex: dict = {}
+                        for k in np.flatnonzero(bits2[ri]).tolist():
+                            el, pi = pats[k]
+                            live_by_ex.setdefault(el, []).append(pi)
+                        hints[(b, op_id)] = live_by_ex
                 t_sub2 = time.perf_counter()
                 self.stats.ext_resolve_seconds += t_sub2 - t_sub
                 for (b, t_idx), vals in self._extract_pending(
-                    pending, nrows
+                    pending, nrows, hints
                 ).items():
                     if vals:
                         uextractions[(b, tids[t_idx])] = vals
+                        if (b, t_idx) in pseudo_set:
+                            # fused verdict: extraction fired
+                            pt_value[b, t_idx >> 3] |= 0x80 >> (t_idx & 7)
                 self.stats.ext_extract_seconds += (
                     time.perf_counter() - t_sub2
                 )

@@ -39,7 +39,7 @@ from typing import Optional
 import numpy as np
 
 from swarm_tpu.fingerprints import dslc, regexlin
-from swarm_tpu.fingerprints.compile import required_literal_set
+from swarm_tpu.fingerprints.compile import required_literal_ladder
 
 try:  # py3.11+
     import re._parser as sre_parse
@@ -89,6 +89,9 @@ class PatternInfo:
     # whole finditer/search runs in one GIL-released C call; None keeps
     # the candidate-scan + anchored re.match path
     cprog: Optional[object] = None
+    # counter-free program for the linear-time NFA existence scan
+    # (crexc.compile_crex_nfa); None when out of subset / oversized
+    nfa: Optional[object] = None
 
 
 def _prefix_classes(pattern: str) -> list:
@@ -216,15 +219,23 @@ def analyze(pattern: str) -> PatternInfo:
         ok = True
     except re.error:
         rex, ok = None, False
-    literals = required_literal_set(pattern, min_len=4) if ok else None
+    # necessary-literal ladder: prefer 4-byte grams, relax to 3/2 for
+    # patterns without one (email-style classes) — a necessary set at
+    # ANY length is sound, and extraction gating (engine
+    # _accel_extract_regex/_extract_pending) needs SOME set to skip
+    # non-matching patterns of multi-hundred-pattern extractors
+    literals = required_literal_ladder(pattern) if ok else None
     prefix = _prefix_classes(pattern) if ok else []
     cprog = None
+    nfa = None
     if ok:
-        from swarm_tpu.ops.crexc import compile_crex
+        from swarm_tpu.ops.crexc import compile_crex, compile_crex_nfa
 
         cprog = compile_crex(pattern)
+        nfa = compile_crex_nfa(pattern)
     info = PatternInfo(
-        ok=ok, rex=rex, literals=literals, prefix=prefix, cprog=cprog
+        ok=ok, rex=rex, literals=literals, prefix=prefix, cprog=cprog,
+        nfa=nfa,
     )
     if prefix:
         counts = [int(m.sum()) for m in prefix]
@@ -380,6 +391,14 @@ def search_bool(pattern: str, data: bytes, text: str) -> Optional[bool]:
         return None
     from swarm_tpu.native import crex as ncrex
 
+    # linear-time NFA existence first: worst-case-bounded (no budget,
+    # no backtracking) — the leading-unbounded-repeat shapes that send
+    # the backtracker O(n^2) (email-extractor: 19 ms/row) answer in
+    # tens of microseconds here, and existence IS search's verdict
+    if info.nfa is not None:
+        got = ncrex.exists(info.nfa, data)
+        if got is not None:
+            return got
     if ncrex.usable(info.cprog):
         got = ncrex.search(info.cprog, data)
         if got is not None:

@@ -464,3 +464,126 @@ def test_finditer_spans_grows_scratch_on_overflow():
     data = b"a" * n
     spans = ncrex.finditer_spans(cp, data, 0)
     assert spans == [(i, i + 1) for i in range(n)]
+
+
+# --- round-5: linear-time existence (lazy DFA + bitset NFA) ---------
+
+
+def test_exists_differential_hand_cases():
+    """exists() answers exactly `re.search is not None` — the verdict
+    tier that replaces catastrophic backtracking (the email-extractor
+    shape: 19 ms backtracker / 2.2 ms re -> ~6 us here). Greedy vs
+    lazy, anchors, boundaries, empty matches: existence is language
+    membership, so every HAND case must agree with re."""
+    from swarm_tpu.ops.crexc import compile_crex_nfa
+
+    covered = 0
+    for pattern, text, _group in HAND:
+        cp = compile_crex_nfa(pattern)
+        if cp is None:
+            continue
+        data = text.encode("latin-1")
+        got = ncrex.exists(cp, data)
+        if got is None:
+            continue
+        covered += 1
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", FutureWarning)
+            want = re.search(pattern, text) is not None
+        assert got == want, (pattern, text)
+        # negative content too (prefix that usually kills the match)
+        neg = data[: max(1, len(data) // 3)]
+        gotn = ncrex.exists(cp, neg)
+        if gotn is not None:
+            assert gotn == (re.search(pattern, neg.decode("latin-1"))
+                            is not None), (pattern, neg)
+    assert covered >= 30  # the subset must actually cover the cases
+
+
+def test_exists_email_shape_linear():
+    """The leading-unbounded-class shape that degenerates under
+    backtracking: exists() must answer correctly on both polarities
+    and fast enough to be a per-row verdict (no budget involved)."""
+    from swarm_tpu.ops.crexc import compile_crex_nfa
+
+    p = (r"[a-zA-Z0-9-_.]{4,}@[A-Za-z0-9_-]+[.]"
+         r"(com|org|net|io|gov|co)")
+    cp = compile_crex_nfa(p)
+    assert cp is not None
+    junk = bytes(random.Random(7).choices(range(97, 123), k=4000))
+    assert ncrex.exists(cp, junk) is False
+    assert ncrex.exists(cp, junk + b" x ab-c.d@ex-1.io y") is True
+    assert re.search(p, junk.decode("latin-1")) is None
+
+
+@pytest.mark.skipif(
+    not REFERENCE_CORPUS.is_dir(), reason="reference corpus absent"
+)
+def test_exists_differential_corpus_fuzz():
+    """Corpus-population differential: exists() vs re.search over
+    fuzzed contents seeded with corpus words — zero divergence
+    allowed."""
+    rng = random.Random(99)
+    pats = [p for p in corpus_patterns()]
+    rng.shuffle(pats)
+    from swarm_tpu.ops.crexc import compile_crex_nfa
+
+    checked = 0
+    for p in pats[:400]:
+        cp = compile_crex_nfa(p)
+        if cp is None:
+            continue
+        for _ in range(3):
+            n = rng.randint(0, 160)
+            data = bytes(rng.choices(range(32, 127), k=n))
+            if rng.random() < 0.4:
+                # seed fragments of the pattern itself (hit-biased)
+                frag = p[rng.randint(0, max(0, len(p) - 8)):][:8]
+                frag = re.sub(r"[\\\[\](){}|?*+^$.]", "", frag)
+                data += frag.encode("latin-1", "ignore")
+            got = ncrex.exists(cp, data)
+            if got is None:
+                continue
+            checked += 1
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", FutureWarning)
+                want = re.search(p, data.decode("latin-1")) is not None
+            assert got == want, (p, data)
+    assert checked >= 500
+
+
+def test_dfa_context_frees_with_program():
+    """exists() ties each lazy-DFA context's lifetime to its program
+    object via a weakref finalizer — a throwaway program (saturated
+    compile cache) must free its native context instead of leaking."""
+    import gc
+    import weakref
+
+    from swarm_tpu.ops.crexc import _compile
+
+    cp = _compile("abc[0-9]+def", counted_reps=False)  # uncached object
+    assert ncrex.exists(cp, b"xx abc123def yy") is True
+    assert getattr(cp, "_dfa", 0)
+    ref = weakref.ref(cp)
+    fin = [f for f in [getattr(cp, "__weakref__", None)] if f]
+    del cp, fin
+    gc.collect()
+    assert ref() is None  # finalizer ran; sw_crex_dfa_free was invoked
+
+
+def test_exists_unknown_anchor_fails_safe():
+    """A program with an out-of-range anchor kind must return None
+    (unsupported), never a silent no-match verdict — sibling branches
+    would otherwise lose their states mid-closure."""
+    import numpy as np
+
+    from swarm_tpu.ops.crexc import _compile
+
+    cp = _compile("(xyz|abc)", counted_reps=False)
+    prog = np.array(cp.prog, copy=True)
+    # corrupt: turn the first instruction into an unknown-anchor AT
+    corrupt = np.array(prog, copy=True)
+    corrupt[0] = (8, 99, 0, 0)  # OP_AT kind 99
+    cp2 = type(cp)(prog=np.ascontiguousarray(corrupt), masks=cp.masks,
+                   n_saves=cp.n_saves, group_exists=cp.group_exists)
+    assert ncrex.exists(cp2, b"zzz abc zzz") is None

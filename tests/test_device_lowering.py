@@ -272,28 +272,36 @@ def test_corpus_device_split_does_not_regress():
     # callback interactions, so they cost nothing on bulk scans.
     pf_ops = np.flatnonzero(db.op_prefilter)
     oob_pf = 0
-    ext_pf = 0
     for op_id in pf_ops:
         op = (
             db.templates[db.op_src[op_id][0]]
             .operations[db.op_src[op_id][1]]
         )
-        if not op.matchers:
-            # synthesized extraction prefilter (extractor-only op):
-            # literal-gated, so it engages only on rows carrying one of
-            # the extraction regexes' required literals — cheap, and
-            # the host work it triggers IS the extraction output the
-            # template owes anyway
-            ext_pf += 1
-        elif any((m.part or "").startswith("interactsh") for m in op.matchers):
+        # a PREFILTERED extractor-only op would be the fire-always
+        # degrade (whole-op confirm on every row) — the corpus must
+        # never need it: every extraction pattern lowers per-pattern
+        assert op.matchers, (
+            f"extractor-only op {op_id} degraded to fire-always"
+        )
+        if any((m.part or "").startswith("interactsh") for m in op.matchers):
             oob_pf += 1
-    assert int(db.op_prefilter.sum()) - oob_pf - ext_pf <= 20
+    assert int(db.op_prefilter.sum()) - oob_pf <= 20
     assert oob_pf <= 15
-    # the 40 http + 2 dns extractor-only templates, every one lowered
-    # with a real literal prefilter (a fire-always degrade would walk
-    # every row for that template — test_extractor_only.py pins the
-    # literal sets too)
-    assert ext_pf == 42
+    # the 40 http + 2 dns extractor-only templates lower as
+    # per-pattern extraction prefilters: NON-prefilter ops whose
+    # matchers are all synthesized (m_ext_src >= 0), so the device
+    # pm bits name the live patterns and the walk never scans the
+    # full pattern population of a fired extractor
+    ext_ops = 0
+    ext_pat_matchers = 0
+    for op_id in range(len(db.op_matchers)):
+        ids = db.op_matchers[op_id]
+        if ids and all(db.m_ext_src[m][0] >= 0 for m in ids):
+            ext_ops += 1
+            ext_pat_matchers += len(ids)
+            assert not db.op_prefilter[op_id]
+    assert ext_ops == 42
+    assert ext_pat_matchers >= 750  # one matcher per extraction pattern
     # per-matcher residues (confirm-on-fire) are the cheap fallback —
     # bounded so exotic-dsl growth is noticed
     assert int(db.m_residue.sum()) <= 20
