@@ -587,3 +587,34 @@ def test_exists_unknown_anchor_fails_safe():
     cp2 = type(cp)(prog=np.ascontiguousarray(corrupt), masks=cp.masks,
                    n_saves=cp.n_saves, group_exists=cp.group_exists)
     assert ncrex.exists(cp2, b"zzz abc zzz") is None
+
+
+def test_exists_thread_safety_under_lazy_construction():
+    """The lazy DFA builds shared state on first scans; concurrent
+    exists() calls from the extraction pool must stay re-identical
+    while construction races (context mutex)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from swarm_tpu.ops.crexc import compile_crex_nfa
+
+    p = r"tok_[a-z0-9]{8,}|key-[0-9]{4}-[0-9]{4}|[a-z]{6,}@[a-z]+\.(io|net)"
+    cp = compile_crex_nfa(p)
+    assert cp is not None
+    rng = random.Random(5)
+    contents = []
+    for i in range(200):
+        body = bytes(rng.choices(range(97, 123), k=rng.randint(50, 900)))
+        if i % 3 == 0:
+            body += rng.choice(
+                [b" tok_abcdef12 ", b" key-1234-5678 ", b" person@site.io "]
+            )
+        contents.append(body)
+    want = [re.search(p, c.decode("latin-1")) is not None for c in contents]
+
+    def scan_all(_seed):
+        return [ncrex.exists(cp, c) for c in contents]
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        results = list(pool.map(scan_all, range(8)))
+    for got in results:
+        assert got == want
