@@ -47,9 +47,19 @@ principled subset needs no JS runtime and executes here:
   merge: deparam/parseQuery, split('&') + bracket assignment, deep
   extend). See the property-model section below for the bound.
 
-Anything else needing a JS runtime — ``screenshot`` rendering,
-CVE-2022-0776's bespoke scripting — is classified ``js-required`` by
-:func:`classify` and keeps the honest skip marker. The documented
+- **library version-check scripts** (CVE-2022-0776's RevealJS
+  probe): ``return (X.VERSION <op> "lit" || ...)`` evaluates against
+  the VERSION value in the page's actual library source — only
+  scripts that DEFINE the global are consulted, with one identifier
+  hop for minified dists — under JS string-comparison semantics.
+  Documented bound: library sources are fetched same-origin only
+  (like every script surface here), so a CDN-hosted library yields no
+  verdict (silent, never a guess); a page without the library yields
+  no output, matching the browser's ReferenceError.
+
+Anything else needing a JS runtime — ``screenshot`` rendering — is
+classified ``js-required`` by :func:`classify` and keeps the honest
+skip marker. The documented
 bound of the emulation: nodes inserted by page JavaScript are
 invisible (the DOM here is the served HTML, not a rendered tree).
 
@@ -59,8 +69,9 @@ full response); matchers/extractors over a named script's output read
 the emulated script result.
 
 Reference: /root/reference/worker/artifacts/templates/headless/*.yaml
-plus cves/2022/CVE-2022-0776.yaml (8 headless templates: 2 executable
-browserlessly + 4 hook-emulated, 2 honestly skipped).
+plus cves/2022/CVE-2022-0776.yaml (8 headless templates: 7 execute —
+2 browserless + 4 hook-emulated + 1 version-check; screenshot stays
+honestly skipped).
 """
 
 from __future__ import annotations
@@ -155,6 +166,110 @@ def _attr_collect_spec(code: str) -> Optional[dict]:
 #: window.alerts read-back idiom closing every hook template
 _ALERTS_READ_RE = re.compile(r"^\s*window\.alerts\s*;?\s*$")
 
+#: library version-check idiom (CVE-2022-0776's RevealJS probe):
+#: `return (X.VERSION <op> "lit" || X.VERSION <op> "lit" ...)` — a
+#: boolean over a library global's VERSION string, which is a LOAD-TIME
+#: fact readable from the page's actual script source (the same
+#: honesty class as the hook emulation)
+_VERSION_TERM_RE = re.compile(
+    r"(\w+)\.VERSION\s*(<=|>=|<|>|===|==)\s*['\"]([^'\"]+)['\"]"
+)
+
+
+def _version_check_spec(code: str) -> Optional[dict]:
+    """Parse the version-comparison script shape, or None.
+
+    Accepts a single `return (...)` expression whose every term is
+    `GLOBAL.VERSION <op> "literal"` over ONE global, joined by || / &&
+    (JS precedence: && binds tighter). Anything else stays
+    js-required."""
+    m = re.search(r"return\s*\(?(.+?)\)?\s*;?\s*}?\s*$", code, re.S)
+    if not m:
+        return None
+    expr = m.group(1).strip()
+    # strip balanced outer parens
+    while expr.startswith("(") and expr.endswith(")"):
+        expr = expr[1:-1].strip()
+    or_groups = []
+    globals_seen = set()
+    for part in expr.split("||"):
+        and_terms = []
+        for term in part.split("&&"):
+            tm = _VERSION_TERM_RE.fullmatch(term.strip())
+            if tm is None:
+                return None
+            globals_seen.add(tm.group(1))
+            and_terms.append((tm.group(2), tm.group(3)))
+        or_groups.append(and_terms)
+    if len(globals_seen) != 1 or not or_groups:
+        return None
+    return {"global": globals_seen.pop(), "or_groups": or_groups}
+
+
+_VERSION_LITERAL_RE = re.compile(
+    r"\bVERSION\s*[:=]\s*['\"]([0-9][\w.\-]*)['\"]"
+)
+#: minified dists hoist the value: ``VERSION:t`` with ``t="4.2.1"``
+#: elsewhere — resolved with a single identifier hop
+_VERSION_IDENT_RE = re.compile(r"\bVERSION\s*[:=]\s*([A-Za-z_$][\w$]*)\b")
+
+
+def _script_version_of(text: str) -> Optional[str]:
+    """The VERSION value a script carries: a direct string literal,
+    or one identifier hop (``VERSION:t`` + ``t="4.2.1"``)."""
+    vm = _VERSION_LITERAL_RE.search(text)
+    if vm:
+        return vm.group(1)
+    im = _VERSION_IDENT_RE.search(text)
+    if im:
+        ident = re.escape(im.group(1))
+        lit = re.search(
+            rf"\b{ident}\s*=\s*['\"]([0-9][\w.\-]*)['\"]", text
+        )
+        if lit:
+            return lit.group(1)
+    return None
+
+
+def _eval_version_check(sess: "_Session", spec: dict) -> Optional[str]:
+    """Evaluate the version comparison against the VERSION value in
+    the page's load-time scripts. Only scripts that DEFINE the library
+    global (``var/let/const/window.GLOBAL =`` / ``GLOBAL:`` / UMD
+    export) are consulted — a script that merely calls into the
+    library must not donate an unrelated object's VERSION. A page
+    without a fetchable defining script (no library, or the library on
+    a cross-origin CDN — the emulation's standing same-origin bound)
+    yields None: no output, like the browser's ReferenceError. JS
+    string comparison is lexicographic over code units, exactly
+    Python's str comparison for this ASCII domain."""
+    g = re.escape(spec["global"])
+    define_re = re.compile(
+        rf"(?:\b(?:var|let|const)\s+{g}\b|window\.{g}\s*=|"
+        rf"\b{g}\s*=\s*|[{{,]\s*{g}\s*:|exports\.{g}\s*=)"
+    )
+    version = None
+    for _label, text in _page_scripts(sess):
+        if not define_re.search(text):
+            continue
+        version = _script_version_of(text)
+        if version is not None:
+            break
+    if version is None:
+        return None
+    ops = {
+        "<=": lambda a, b: a <= b,
+        ">=": lambda a, b: a >= b,
+        "<": lambda a, b: a < b,
+        ">": lambda a, b: a > b,
+        "==": lambda a, b: a == b,
+        "===": lambda a, b: a == b,
+    }
+    result = any(
+        all(ops[op](version, lit) for op, lit in and_terms)
+        for and_terms in spec["or_groups"]
+    )
+    return "true" if result else "false"
+
 
 def _hook_spec(code: str) -> Optional[dict]:
     """Classify a ``hook: true`` script by the instrumentation it
@@ -239,6 +354,8 @@ def classify(t: Template) -> Optional[str]:
                     continue
                 if _ALERTS_READ_RE.match(code):
                     saw_alerts_read = True
+                    continue
+                if _version_check_spec(code) is not None:
                     continue
                 return "js-required"
             return f"unsupported-action-{act or '?'}"
@@ -474,6 +591,18 @@ def _run_steps(t: Template, steps, sess: _Session, outputs: dict) -> bool:
             if spec is not None and sess.page is not None:
                 name = str(step.get("name") or args.get("name") or "script")
                 outputs[name] = _collect_attrs(sess.page, spec)
+                continue
+            vspec = _version_check_spec(code)
+            if vspec is not None and sess.page is not None:
+                verdict = _eval_version_check(sess, vspec)
+                if verdict is not None:
+                    name = str(
+                        step.get("name") or args.get("name") or "script"
+                    )
+                    outputs[name] = verdict
+                # library absent: no output — the matcher over this
+                # part cannot fire, matching the browser's thrown
+                # ReferenceError producing no result
             continue
     return True
 

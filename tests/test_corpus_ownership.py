@@ -85,8 +85,8 @@ def test_every_template_claimed_exactly_once():
     assert counts["workflows"] == 187
     assert counts["filescan"] == 76
     assert counts["sslscan"] == 5
-    # 6 of 8 headless templates execute browserlessly (round-4/5 hook
-    # emulation); the rest carry explicit reasons
+    # 7 of 8 headless templates execute (round-4/5 hook emulation +
+    # the version-check class); screenshot carries its explicit reason
     assert counts["headless"] >= 5
     headless_skips = {
         c: n for c, n in counts.items() if c.startswith("skip:headless")

@@ -1,8 +1,8 @@
 """Browserless headless-template subset (worker/headless.py).
 
-Covers: classification of the REAL reference headless corpus (6 of 8
-execute: 2 browserless + 4 hook-emulated incl. prototype-pollution;
-screenshot + CVE-2022-0776 honestly skipped), the dvwa-style form
+Covers: classification of the REAL reference headless corpus (7 of 8
+execute: 2 browserless + 4 hook-emulated incl. prototype-pollution +
+CVE-2022-0776's version-check; screenshot honestly skipped), the dvwa-style form
 login flow end to end against a local server (click/text/submit +
 cookie jar + redirect), the extract-urls attribute-collection script
 emulation with URL resolution, and the PPScan pollution probe
@@ -703,3 +703,132 @@ def test_prototype_pollution_negative_pages(pollution_server):
         sc = headless.HeadlessScanner([t2])
         hits = sc.run([("127.0.0.1", "127.0.0.1", pollution_server, False)])
         assert hits == [], (path, hits)
+
+
+# --- CVE-2022-0776 (round 5): library version-check script class
+
+REVEAL_JS = (b"/*! reveal.js 4.2.1 */\n"
+    b"var t=\"4.2.1\";\n"
+    b"const VERSION = '4.2.1';\n"
+    b"var Reveal = {VERSION: VERSION, initialize: function(){}};\n"
+    b"window.Reveal = Reveal;\n")
+
+REVEAL_SAFE_JS = REVEAL_JS.replace(b"4.2.1", b"4.3.0")
+
+REVEAL_PAGE = (b"<html><head><script src=\"/dist/reveal.js\"></script>"
+               b"</head><body class=\"reveal\">slides</body></html>")
+
+
+@pytest.fixture
+def reveal_server():
+    state = {"js": REVEAL_JS}
+
+    class H(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                req = self.request.recv(8192).decode("latin-1", "replace")
+                path = req.split(" ", 2)[1] if " " in req else "/"
+                if path.startswith("/dist/reveal.js"):
+                    body = state["js"]
+                    ctype = b"text/javascript"
+                elif path.startswith("/plain"):
+                    body = b"<html><body>no slides here</body></html>"
+                    ctype = b"text/html"
+                else:
+                    body = REVEAL_PAGE
+                    ctype = b"text/html"
+                self.request.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: %s\r\n"
+                    b"Content-Length: %d\r\nConnection: close\r\n\r\n%s"
+                    % (ctype, len(body), body)
+                )
+            except OSError:
+                pass
+
+    srv, port = _serve(H)
+    yield port, state
+    srv.shutdown()
+
+
+def test_cve_2022_0776_version_check_executes(reveal_server):
+    """The REAL RevealJS postMessage-XSS template executes: the
+    Reveal.VERSION comparison evaluates against the version literal in
+    the page's actual reveal.js source — vulnerable version fires,
+    patched version and a reveal-free page stay silent."""
+    port, state = reveal_server
+    t = _load_ref_cve("CVE-2022-0776")
+    assert headless.classify(t) is None
+    sc = headless.HeadlessScanner([t])
+    hits = sc.run([("127.0.0.1", "127.0.0.1", port, False)])
+    assert [h.template_id for h in hits] == ["CVE-2022-0776"]
+    # patched library: comparison is false -> silent
+    state["js"] = REVEAL_SAFE_JS
+    sc2 = headless.HeadlessScanner([t])
+    assert sc2.run([("127.0.0.1", "127.0.0.1", port, False)]) == []
+
+
+def test_version_check_absent_library_is_silent(reveal_server):
+    """A page that never loads the library produces NO script output
+    (the browser would throw ReferenceError): template silent."""
+    port, _state = reveal_server
+    t = _load_ref_cve("CVE-2022-0776")
+    import copy
+
+    t2 = copy.deepcopy(t)
+    for op in t2.operations:
+        for step in op.steps:
+            if str(step.get("action")) == "navigate":
+                step["args"]["url"] = "{{BaseURL}}/plain"
+    sc = headless.HeadlessScanner([t2])
+    assert sc.run([("127.0.0.1", "127.0.0.1", port, False)]) == []
+
+
+def _load_ref_cve(name):
+    import pathlib
+
+    p = pathlib.Path(
+        "/root/reference/worker/artifacts/templates/cves/2022"
+    ) / f"{name}.yaml"
+    if not p.is_file():
+        pytest.skip("reference corpus unavailable")
+    from swarm_tpu.fingerprints.nuclei import load_template_file
+
+    return load_template_file(p)
+
+
+def test_version_check_spec_parsing():
+    ok = headless._version_check_spec(
+        '() => {\nreturn (Reveal.VERSION <= "3.8.0" || '
+        'Reveal.VERSION < "4.3.0")\n}')
+    assert ok == {
+        "global": "Reveal",
+        "or_groups": [[("<=", "3.8.0")], [("<", "4.3.0")]],
+    }
+    # mixed globals / non-version terms stay js-required
+    assert headless._version_check_spec(
+        'return (Reveal.VERSION < "4" || Foo.VERSION < "2")') is None
+    assert headless._version_check_spec(
+        'return (document.cookie < "4")') is None
+
+
+def test_version_check_minified_and_misattribution(reveal_server):
+    """Minified dists hoist the VERSION value behind an identifier
+    (``VERSION:t`` + ``t="4.2.1"``) — resolved with one hop; and a
+    script that merely CALLS the library while carrying an unrelated
+    object's VERSION must not donate it (only defining scripts are
+    consulted)."""
+    port, state = reveal_server
+    t = _load_ref_cve("CVE-2022-0776")
+    # minified shape, vulnerable
+    state["js"] = (b"!function(){var t=\"4.2.1\";var e={VERSION:t};"
+                   b"window.Reveal=e}();")
+    sc = headless.HeadlessScanner([t])
+    hits = sc.run([("127.0.0.1", "127.0.0.1", port, False)])
+    assert [h.template_id for h in hits] == ["CVE-2022-0776"]
+    # patched library + an unrelated VERSION in a non-defining script:
+    # must stay silent (no misattribution)
+    state["js"] = (b"!function(){var t=\"4.7.0\";var e={VERSION:t};"
+                   b"window.Reveal=e}();"
+                   b"\n// consumer script would be inline on the page")
+    sc2 = headless.HeadlessScanner([t])
+    assert sc2.run([("127.0.0.1", "127.0.0.1", port, False)]) == []
