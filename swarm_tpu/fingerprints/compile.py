@@ -153,7 +153,8 @@ def _litset_score(cand: list[bytes]) -> tuple[int, int]:
 
 
 def required_literal_set(
-    pattern: str, min_len: int = 4, max_alts: int = MAX_LITERAL_ALTS
+    pattern: str, min_len: int = 4, max_alts: int = MAX_LITERAL_ALTS,
+    collect: Optional[list] = None,
 ) -> Optional[list[bytes]]:
     """A set S of lowered byte literals such that **every** match of
     ``pattern`` contains at least one s ∈ S as a substring.
@@ -163,9 +164,15 @@ def required_literal_set(
     expansions (so ``(?:InvalidURI|NoSuchBucket)`` and case-permutation
     chains like ``(f|F)(i|I)…`` both resolve — the latter collapses to
     one literal after ASCII lowering, since the probe always runs on
-    the lowered stream). Non-literal nodes flush the run set as a
-    candidate. Returns the best candidate (longest minimum member, then
-    fewest members) with every member ≥ min_len, or None.
+    the lowered stream). Optional nodes (``X?``) multiply the run set
+    by {""} ∪ expansions(X) so adjacency survives (``db[_-]?pw`` →
+    {dbpw, db_pw, db-pw}, not {db}); where a group/alternation has no
+    full expansion, its literal *prefix* expansions extend the runs
+    before the flush (``[.](com|co.uk)`` → {.com, .co}) — a prefix is
+    forced contiguous with the consumed left context, so the combined
+    runs stay necessary. Other non-literal nodes flush the run set as
+    a candidate. Returns the best candidate (longest minimum member,
+    then fewest members) with every member ≥ min_len, or None.
 
     Soundness: a run set is only considered when every member reflects
     a byte sequence forced by one complete alternation path; ASCII
@@ -173,7 +180,13 @@ def required_literal_set(
     (non-A-Z bytes are untouched on both sides). Runs collected under
     case-insensitivity with non-ASCII bytes are rejected — Python folds
     Unicode there, device lowering is ASCII-only.
-    """
+
+    ``collect``: optional list; every candidate that clears ``min_len``
+    from a *mandatory* position (top-level concatenation, mandatory
+    group bodies, branch-union sets — never branch-local sets) is
+    appended. Each collected set is independently necessary, so the
+    list is a CNF (AND of OR-sets) usable as a host-side gate
+    (``required_literal_cnf``)."""
     try:
         tree = regexlin.parse_quiet(pattern)
     except re.error:
@@ -181,27 +194,40 @@ def required_literal_set(
 
     global_ci = bool(tree.state.flags & re.IGNORECASE)
     best: list[Optional[list[bytes]]] = [None]
+    # >0 ⇒ inside a branch-local walk: candidates there are necessary
+    # only for that branch, not the whole pattern — never collected
+    branch_local = [0]
 
     def consider(cand: list[bytes]) -> None:
         if not cand or any(len(c) < min_len for c in cand):
             return
+        if collect is not None and not branch_local[0]:
+            collect.append(sorted(cand))
         cur = best[0]
         if cur is None or _litset_score(cand) > _litset_score(cur):
             best[0] = cand
 
     def class_alts(arg, ci: bool) -> Optional[list[bytes]]:
-        """Small literal character class [Gg] → its (lowered) bytes."""
+        """Small literal character class [Gg] → its (lowered) bytes.
+        ``\\d`` expands to 0-9: over the latin-1 decode the oracle
+        matches on (cpu_ref._decode), every code point is ≤ 0xFF and
+        the only Nd-category ones are ASCII digits, so the expansion
+        is exact."""
         alts = set()
         for kind, val in arg:
-            if str(kind) != "LITERAL" or not (0 <= val < 256):
+            skind = str(kind)
+            if skind == "CATEGORY" and str(val) == "CATEGORY_DIGIT":
+                alts.update(b"0123456789"[i : i + 1] for i in range(10))
+            elif skind != "LITERAL" or not (0 <= val < 256):
                 return None
-            if ci and val >= 0x80:
-                # Python folds Unicode over the latin-1 decode; ASCII
-                # lowering can't reproduce that, so the set would not
-                # be necessary
-                return None
-            alts.add(_lower_ascii(bytes([val])))
-            if len(alts) > 4:
+            else:
+                if ci and val >= 0x80:
+                    # Python folds Unicode over the latin-1 decode;
+                    # ASCII lowering can't reproduce that, so the set
+                    # would not be necessary
+                    return None
+                alts.add(_lower_ascii(bytes([val])))
+            if len(alts) > max_alts:
                 return None
         return sorted(alts)
 
@@ -264,6 +290,17 @@ def required_literal_set(
                     return None
             elif opname == "MAX_REPEAT" or opname == "MIN_REPEAT":
                 lo, hi, child = arg
+                if lo == 0 and int(hi) == 1:
+                    # optional: each match contains zero or one copy —
+                    # {""} ∪ expansions keeps the sequence literal
+                    exp = expansions(child, ci)
+                    if exp is None:
+                        return None
+                    if exp == []:
+                        continue  # dead optional: only the 0-copy path
+                    if not cross([b""] + exp):
+                        return None
+                    continue
                 if lo != hi:
                     return None
                 exp = expansions(child, ci)
@@ -280,11 +317,84 @@ def required_literal_set(
                 return None
         return outs
 
+    def prefix_exps(seq, ci: bool) -> Optional[list[bytes]]:
+        """Literal expansions of the longest expandable PREFIX of
+        ``seq`` (every member ≥ 1 byte), or None. Every match of the
+        sequence *starts* with one member, so extending the current
+        runs by these preserves necessity-with-adjacency even when the
+        tail of the sequence has no full expansion."""
+
+        def crossed(base: list[bytes], alts: list[bytes]):
+            new = sorted({o + a for o in base for a in alts})
+            return new if len(new) <= max_alts else None
+
+        outs = [b""]
+        for op, arg in seq:
+            opname = str(op)
+            nxt = None
+            stop_after = False
+            if opname == "AT":
+                continue
+            elif opname == "LITERAL" and 0 <= arg <= 0xFF:
+                if not (ci and arg >= 0x80):
+                    nxt = crossed(outs, [_lower_ascii(bytes([arg]))])
+            elif opname == "IN":
+                alts = class_alts(arg, ci)
+                if alts is not None:
+                    nxt = crossed(outs, alts)
+            elif opname == "SUBPATTERN":
+                child_ci = (
+                    ci or bool(arg[1] & re.IGNORECASE)
+                ) and not bool(arg[2] & re.IGNORECASE)
+                exp = expansions(arg[3], child_ci)
+                if exp is not None and exp != []:
+                    nxt = crossed(outs, exp)
+                if nxt is None:
+                    child = prefix_exps(arg[3], child_ci)
+                    if child is not None:
+                        nxt = crossed(outs, child)
+                    stop_after = True  # tail of a partial group unknown
+            elif opname == "BRANCH":
+                exp = expansions([(op, arg)], ci)
+                if exp is not None and exp != []:
+                    nxt = crossed(outs, exp)
+                if nxt is None:
+                    pres = [prefix_exps(b, ci) for b in arg[1]]
+                    if all(p is not None for p in pres):
+                        union = sorted({m for p in pres for m in p})
+                        nxt = crossed(outs, union)
+                    stop_after = True
+            elif opname == "MAX_REPEAT" or opname == "MIN_REPEAT":
+                lo, hi, child = arg
+                if lo >= 1:
+                    exp = expansions(child, ci)
+                    if exp is not None and exp != []:
+                        nxt = crossed(outs, exp)
+                        if nxt is not None and lo == hi:
+                            for _ in range(int(lo) - 1):
+                                nxt = crossed(nxt, exp)
+                                if nxt is None:
+                                    break
+                        else:
+                            stop_after = True  # variable tail
+            if nxt is None:
+                break
+            outs = nxt
+            if stop_after:
+                break
+        if outs == [b""] or not all(outs):
+            return None
+        return outs
+
     def nec_set(seq, ci: bool) -> Optional[list[bytes]]:
-        """Best necessary literal set of a subsequence (its own walk)."""
+        """Best necessary literal set of a subsequence (its own walk).
+        Branch-local: candidates found here are necessary only for one
+        alternation branch, so CNF collection is suspended."""
         saved = best[0]
         best[0] = None
+        branch_local[0] += 1
         walk(seq, ci)
+        branch_local[0] -= 1
         out = best[0]
         best[0] = saved
         return out
@@ -334,6 +444,13 @@ def required_literal_set(
                 if exp is not None:
                     extend(exp)
                 else:
+                    # partial group: its literal prefix is forced
+                    # contiguous with the consumed left context —
+                    # extend before flushing so e.g. [.](com|co.uk)
+                    # keeps the dot (".com"/".co", not "com"/"co")
+                    pre = prefix_exps(arg[3], child_ci)
+                    if pre is not None:
+                        extend(pre)
                     flush()
                     walk(arg[3], child_ci)
                     flush()
@@ -342,6 +459,11 @@ def required_literal_set(
                 if exp is not None:
                     extend(exp)
                     continue
+                pres = [prefix_exps(b, ci) for b in arg[1]]
+                if all(p is not None for p in pres):
+                    union = sorted({m for p in pres for m in p})
+                    if len(union) <= max_alts:
+                        extend(union)
                 flush()
                 # every branch with its own necessary set → the union
                 # is necessary for the alternation as a whole
@@ -366,6 +488,17 @@ def required_literal_set(
                     else:
                         flush()
                         walk(child, ci)
+                        flush()
+                elif lo == 0 and int(hi) == 1:
+                    # optional node: every match contains zero or one
+                    # copy — {""} ∪ expansions keeps runs adjacent
+                    # (db[_-]?pw → dbpw|db_pw|db-pw)
+                    exp = expansions(child, ci)
+                    if exp is not None and exp != []:
+                        extend([b""] + exp)
+                    elif exp == []:
+                        pass  # dead optional: only the 0-copy path
+                    else:
                         flush()
                 else:
                     flush()
@@ -395,6 +528,39 @@ def required_literal_ladder(
         if s is not None:
             return s
     return None
+
+
+def required_literal_cnf(
+    pattern: str, min_len: int = 1, max_groups: int = 8
+) -> Optional[list[list[bytes]]]:
+    """Every *independently necessary* literal OR-set of ``pattern``
+    (CNF: a match must contain ≥1 member of EVERY group). The groups
+    come from mandatory positions of the parse walk — top-level
+    concatenation segments, mandatory group bodies, and branch-union
+    sets — never from inside a single alternation branch.
+
+    A conjunctive host gate over all groups is strictly stronger than
+    the single best set (``[a-z0-9]{4,}@[a-z0-9]+[.](com|…)`` requires
+    BOTH "@" AND one of ".com"/".org"/… — either absence is an exact
+    no-match proof), while each group alone stays sound for the device
+    prefilter. Deduped, best-scored first, capped at ``max_groups``."""
+    groups: list = []
+    required_literal_set(pattern, min_len=min_len, collect=groups)
+    if not groups:
+        return None
+    seen = set()
+    uniq = []
+    for g in groups:
+        key = tuple(g)
+        if key in seen:
+            continue
+        # a group that is a superset of an already-kept group adds no
+        # pruning power in the absent-check direction; keep it anyway
+        # only if distinct — the cap keeps the gate cheap
+        seen.add(key)
+        uniq.append(g)
+    uniq.sort(key=_litset_score, reverse=True)
+    return uniq[:max_groups]
 
 
 def required_literal(pattern: str, min_len: int = 4) -> Optional[bytes]:

@@ -275,6 +275,23 @@ class MatchEngine:
         self._op_obj = [
             db.templates[t].operations[o] for t, o in db.op_src
         ] if db.templates else []
+        # all-regex extractor ops: precomputed (id(ex), ex, part) plan
+        # — _extract_pending's unified inline loop rides it for both
+        # cache hits AND misses (per-hit analyze() lookups and the
+        # segs/fills bookkeeping cost more than the actual literal
+        # gates at walk rates). Pattern infos resolve lazily on an
+        # op's FIRST fired hit (_op_plan_infos): analyzing every
+        # pattern of every extractor op up front would tax engine
+        # construction for ops a scan never fires.
+        self._op_ext_plan = {}
+        self._op_ext_infos: dict = {}  # op_id -> per-ex infos tuples
+        for op_id_, op_ in enumerate(self._op_obj):
+            if op_.extractors and all(
+                ex.type == "regex" for ex in op_.extractors
+            ):
+                self._op_ext_plan[op_id_] = tuple(
+                    (id(ex), ex, ex.part) for ex in op_.extractors
+                )
         # templates with extractors need a host pass on *hits* even when
         # the verdict itself was device-certain, so extraction output
         # stays bit-identical to the oracle
@@ -426,6 +443,68 @@ class MatchEngine:
         )
         return self._ext_pool_obj
 
+    def _resolve_regex_ex(
+        self, ex, ex_local, key, hint, infos,
+        cache, fills, tasks, ncrex, _fastre,
+    ) -> tuple:
+        """Resolve one regex extractor against one content part:
+        ``("v", vals)`` when decided inline (and memoized), ``("k",
+        key)`` when a batched finditer task was registered in
+        ``fills``/``tasks``. Gate order is cheapest-proof-first and
+        every gate is a necessary-condition proof (skipping a pattern
+        is exact): device pm-bit hint → required-literal CNF
+        (the device bit only proves ONE group's gram presence; the
+        other mandatory groups, e.g. the '@' an email must contain,
+        are a few bytes.find) → lazy-DFA existence (a proven-absent
+        pattern runs no finditer at all; a missing match costs the
+        backtracker/re its worst case — 2-19 ms for leading-repeat
+        shapes vs ~6 µs here)."""
+        part = key[1]
+        if hint is not None:
+            live = hint.get(ex_local, [])
+            if live:
+                lowered = part.lower()
+                live = [
+                    p
+                    for p in live
+                    if not _fastre.literals_absent(infos[p], lowered)
+                ]
+        else:
+            lowered = part.lower()
+            live = []
+            for p_idx, info in enumerate(infos):
+                if info.ok and (info.literals or info.cnf) and (
+                    _fastre.literals_absent(info, lowered)
+                ):
+                    continue
+                live.append(p_idx)
+        if live:
+            kept = []
+            for p_idx in live:
+                nfa = infos[p_idx].nfa
+                if nfa is not None and ncrex.exists(nfa, part) is False:
+                    continue
+                kept.append(p_idx)
+            live = kept
+        if not live:
+            self._cache_put(cache, key, [])
+            return ("v", [])
+        if not isinstance(ex.group, int) or not all(
+            infos[p].ok and ncrex.usable(infos[p].cprog) for p in live
+        ):
+            vals = self._accel_extract_regex(ex, part)
+            self._cache_put(cache, key, vals)
+            return ("v", vals)
+        fills[key] = {"ex": ex, "part": part, "live": live, "by_pat": {}}
+        for p_idx in live:
+            t = tasks.setdefault(
+                (ex.regex[p_idx], ex.group),
+                {"cp": infos[p_idx].cprog, "items": [], "parts": []},
+            )
+            t["items"].append((key, p_idx))
+            t["parts"].append(part)
+        return ("k", key)
+
     def _extract_pending(
         self, pending: list, nrows: list, hints: Optional[dict] = None
     ) -> dict:
@@ -464,92 +543,116 @@ class MatchEngine:
         segs: dict = {}   # (b, t_idx) -> [("v", vals) | ("k", key)]
         fills: dict = {}  # key -> {"ex", "part", "by_pat"}
         tasks: dict = {}  # (pattern, group) -> {"cp", "items", "parts"}
+        # Unified inline loop. All-regex ops (the population, incl.
+        # every per-pattern-prefilter pseudo op) resolve each extractor
+        # in place with precomputed PatternInfos — cache hit, literal/
+        # CNF gate, DFA existence, [] short-circuit — and only rows
+        # that create a batch task (or share a key with one) touch the
+        # segs/fills bookkeeping. Mixed/non-regex ops keep the general
+        # per-extractor walk. A (b, t) whose hits span both modes keeps
+        # extraction order by replaying its inline values into the seg
+        # list at transition time.
+        plan_map = self._op_ext_plan
+        cache_put = self._cache_put
+        fast_acc: dict = {}  # (b, t_idx) -> [vals, ...] in hit order
+
+        def to_seg(bt) -> list:
+            seg = segs.get(bt)
+            if seg is None:
+                prior = fast_acc.pop(bt, None)
+                seg = segs[bt] = (
+                    [("v", v) for v in prior] if prior else []
+                )
+            return seg
+
         for b, t_idx, op_id in pending:
+            bt = (b, t_idx)
             row = nrows[b]
-            seg = segs.setdefault((b, t_idx), [])
+            plan = plan_map.get(op_id)
             hint = hints.get((b, op_id)) if hints else None
-            for ex_local, ex in enumerate(self._op_obj[op_id].extractors):
-                if ex.type in ("regex", "json", "xpath"):
-                    key = (id(ex), row.part(ex.part))
-                elif ex.type == "kval":
-                    key = (id(ex), row.part("header"), row.oob_ips)
-                else:
-                    seg.append(("v", cpu_ref.extract_one(ex, row)))
-                    continue
-                vals = cache.get(key)
-                if vals is not None:
-                    seg.append(("v", vals))
-                    continue
-                if key in fills:
-                    seg.append(("k", key))
-                    continue
-                if ex.type != "regex":
-                    vals = cpu_ref.extract_one(ex, row)
-                    self._cache_put(cache, key, vals)
-                    seg.append(("v", vals))
-                    continue
-                part = key[1]
-                # live-pattern discovery, cheapest proof first:
-                # device pm-bit hint (zero host work) when this op is
-                # a per-pattern extraction prefilter, else the
-                # per-pattern literal gate (exact either way: a
-                # pattern whose necessary literals are absent cannot
-                # match) — the fired-template cost is proportional to
-                # patterns whose literal actually occurred, not the
-                # extractor's full pattern count
-                if hint is not None:
-                    live = hint.get(ex_local, [])
-                else:
-                    lowered = part.lower()
-                    live = []
-                    for p_idx, p in enumerate(ex.regex):
-                        info = _fastre.analyze(p)
-                        if info.ok and info.literals and (
-                            _fastre.literals_absent(info, lowered)
-                        ):
-                            continue
-                        live.append(p_idx)
-                if live:
-                    # linear-time existence pre-gate: a pattern the
-                    # lazy DFA proves absent needs NO finditer at all
-                    # (the literal/pm-bit gates only prove gram
-                    # presence; most gram hits are not matches, and a
-                    # missing match costs the backtracker/re its worst
-                    # case — 2-19 ms for leading-repeat shapes vs ~6 us
-                    # here)
-                    kept = []
-                    for p_idx in live:
-                        nfa = _fastre.analyze(ex.regex[p_idx]).nfa
-                        if nfa is not None and ncrex.exists(
-                            nfa, part
-                        ) is False:
-                            continue
-                        kept.append(p_idx)
-                    live = kept
-                if not live:
-                    self._cache_put(cache, key, [])
-                    seg.append(("v", []))
-                    continue
-                infos = {p: _fastre.analyze(ex.regex[p]) for p in live}
-                if not isinstance(ex.group, int) or not all(
-                    i.ok and ncrex.usable(i.cprog)
-                    for i in infos.values()
+            if plan is None:
+                # mixed/non-regex extractors: general per-ex walk
+                seg = to_seg(bt)
+                for ex_local, ex in enumerate(
+                    self._op_obj[op_id].extractors
                 ):
-                    vals = self._accel_extract_regex(ex, part)
-                    self._cache_put(cache, key, vals)
-                    seg.append(("v", vals))
-                    continue
-                fills[key] = {
-                    "ex": ex, "part": part, "live": live, "by_pat": {},
-                }
-                for p_idx in live:
-                    t = tasks.setdefault(
-                        (ex.regex[p_idx], ex.group),
-                        {"cp": infos[p_idx].cprog, "items": [], "parts": []},
+                    if ex.type in ("regex", "json", "xpath"):
+                        key = (id(ex), row.part(ex.part))
+                    elif ex.type == "kval":
+                        key = (id(ex), row.part("header"), row.oob_ips)
+                    else:
+                        seg.append(("v", cpu_ref.extract_one(ex, row)))
+                        continue
+                    vals = cache.get(key)
+                    if vals is not None:
+                        seg.append(("v", vals))
+                        continue
+                    if key in fills:
+                        seg.append(("k", key))
+                        continue
+                    if ex.type != "regex":
+                        vals = cpu_ref.extract_one(ex, row)
+                        cache_put(cache, key, vals)
+                        seg.append(("v", vals))
+                        continue
+                    # regex inside a mixed op: same inline resolution,
+                    # infos from the shared analyze cache
+                    infos = tuple(
+                        _fastre.analyze(p) for p in ex.regex
                     )
-                    t["items"].append((key, p_idx))
-                    t["parts"].append(part)
-                seg.append(("k", key))
+                    entry = self._resolve_regex_ex(
+                        ex, ex_local, key, hint, infos,
+                        cache, fills, tasks, ncrex, _fastre,
+                    )
+                    seg.append(entry)
+                continue
+            # all-regex plan: inline resolution, no seg unless a task
+            entries = None   # created only when a "k" entry appears
+            chunks = None
+            infos_op = self._op_ext_infos.get(op_id)
+            if infos_op is None:
+                infos_op = self._op_ext_infos[op_id] = tuple(
+                    tuple(_fastre.analyze(p) for p in e.regex)
+                    for _i, e, _pn in plan
+                )
+            for ex_local, (id_ex, ex, part_name) in enumerate(plan):
+                infos = infos_op[ex_local]
+                part = row.part(part_name)
+                key = (id_ex, part)
+                vals = cache.get(key)
+                if vals is None and key not in fills:
+                    entry = self._resolve_regex_ex(
+                        ex, ex_local, key, hint, infos,
+                        cache, fills, tasks, ncrex, _fastre,
+                    )
+                    if entry[0] == "v":
+                        vals = entry[1]
+                    # else: falls through to the "k" handling below
+                elif vals is None:
+                    entry = ("k", key)
+                if vals is not None:
+                    if entries is not None:
+                        entries.append(("v", vals))
+                    elif vals:
+                        if chunks is None:
+                            chunks = []
+                        chunks.append(vals)
+                    continue
+                # task-backed key: this (b, t) needs seg ordering
+                if entries is None:
+                    entries = [("v", c) for c in chunks] if chunks else []
+                    chunks = None
+                entries.append(entry)
+            if entries is not None:
+                to_seg(bt).extend(entries)
+            elif chunks:
+                acc = fast_acc.get(bt)
+                if acc is None and bt in segs:
+                    segs[bt].extend(("v", c) for c in chunks)
+                else:
+                    if acc is None:
+                        fast_acc[bt] = acc = []
+                    acc.extend(chunks)
 
         import time as _time
 
@@ -617,6 +720,13 @@ class MatchEngine:
             for kind, v in seg:
                 vals.extend(v if kind == "v" else done[v])
             out[bt] = vals
+        for bt, chunks in fast_acc.items():
+            # fresh list: cached value lists must never be aliased into
+            # per-batch results (downstream consumers own `vals`)
+            vals = []
+            for c in chunks:
+                vals.extend(c)
+            out[bt] = vals
         return out
 
     @staticmethod
@@ -638,7 +748,7 @@ class MatchEngine:
         lowered = None
         for pattern in ex.regex:
             info = fastre.analyze(pattern)
-            if info.ok and info.literals:
+            if info.ok and (info.literals or info.cnf):
                 if lowered is None:
                     lowered = part.lower()
                 if fastre.literals_absent(info, lowered):
@@ -773,7 +883,7 @@ class MatchEngine:
         text = None
         want_all = matcher.condition == "and"
         for p, info in zip(matcher.regex, infos):
-            if info.literals:
+            if info.literals or info.cnf:
                 if lowered is None:
                     lowered = part.lower()
                 if fastre.literals_absent(info, lowered):
@@ -1326,12 +1436,16 @@ class MatchEngine:
                         >> self._op_m_shift[op_id][None, :]
                     ) & 1
                     pats = self._op_ext_pats[op_id]
-                    for ri, b in enumerate(rows_):
-                        live_by_ex: dict = {}
-                        for k in np.flatnonzero(bits2[ri]).tolist():
-                            el, pi = pats[k]
-                            live_by_ex.setdefault(el, []).append(pi)
-                        hints[(b, op_id)] = live_by_ex
+                    for b in rows_:
+                        hints[(b, op_id)] = {}
+                    # one nonzero over the whole (rows × matchers)
+                    # plane instead of a per-row flatnonzero
+                    ris, ks = np.nonzero(bits2)
+                    for ri, k in zip(ris.tolist(), ks.tolist()):
+                        el, pi = pats[k]
+                        hints[(rows_[ri], op_id)].setdefault(
+                            el, []
+                        ).append(pi)
                 t_sub2 = time.perf_counter()
                 self.stats.ext_resolve_seconds += t_sub2 - t_sub
                 for (b, t_idx), vals in self._extract_pending(

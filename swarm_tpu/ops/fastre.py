@@ -39,7 +39,10 @@ from typing import Optional
 import numpy as np
 
 from swarm_tpu.fingerprints import dslc, regexlin
-from swarm_tpu.fingerprints.compile import required_literal_ladder
+from swarm_tpu.fingerprints.compile import (
+    required_literal_cnf,
+    required_literal_ladder,
+)
 
 try:  # py3.11+
     import re._parser as sre_parse
@@ -71,6 +74,10 @@ class PatternInfo:
     # mandatory prefix byte classes (bool[256] each), len 0..2; the
     # EMPTY list means "no usable prefix" -> no candidate scan
     prefix: list
+    # CNF of required-literal groups: every match contains >= 1 member
+    # of EVERY group (strictly stronger absent-proof than `literals`;
+    # None when the walk yields no mandatory groups)
+    cnf: Optional[list] = None
     # index (0 or 1) of the narrower prefix class, its member bytes
     # (when narrow enough for find loops), and the partner class
     scan_pos: int = 0
@@ -225,6 +232,12 @@ def analyze(pattern: str) -> PatternInfo:
     # _accel_extract_regex/_extract_pending) needs SOME set to skip
     # non-matching patterns of multi-hundred-pattern extractors
     literals = required_literal_ladder(pattern) if ok else None
+    cnf = required_literal_cnf(pattern) if ok else None
+    if cnf and literals:
+        # the ladder's set usually reappears among the CNF groups —
+        # drop the value-equal duplicate so literals_absent never
+        # re-scans the same group
+        cnf = [g for g in cnf if g != literals] or None
     prefix = _prefix_classes(pattern) if ok else []
     cprog = None
     nfa = None
@@ -235,7 +248,7 @@ def analyze(pattern: str) -> PatternInfo:
         nfa = compile_crex_nfa(pattern)
     info = PatternInfo(
         ok=ok, rex=rex, literals=literals, prefix=prefix, cprog=cprog,
-        nfa=nfa,
+        nfa=nfa, cnf=cnf,
     )
     if prefix:
         counts = [int(m.sum()) for m in prefix]
@@ -266,12 +279,18 @@ def analyze(pattern: str) -> PatternInfo:
 
 def literals_absent(info: PatternInfo, lowered: bytes) -> bool:
     """True when the pattern CERTAINLY has no match in the part whose
-    ASCII-lowered bytes are ``lowered`` (every match must contain one
-    of the required literals, and none is present)."""
+    ASCII-lowered bytes are ``lowered``: some required-literal group
+    (every match must contain one of its members) is fully absent.
+    Groups are rarity-ordered, so the first check is the most likely
+    proof; the single `literals` set rides first for continuity."""
     lits = info.literals
-    if not lits:
-        return False
-    return all(lowered.find(lit) < 0 for lit in lits)
+    if lits and all(lowered.find(lit) < 0 for lit in lits):
+        return True
+    if info.cnf:
+        for group in info.cnf:
+            if all(lowered.find(lit) < 0 for lit in group):
+                return True
+    return False
 
 
 def _candidates(info: PatternInfo, data: bytes) -> Optional[list]:

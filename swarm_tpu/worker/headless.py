@@ -176,26 +176,49 @@ _VERSION_TERM_RE = re.compile(
 )
 
 
+def _strip_outer_parens(expr: str) -> str:
+    """Remove outer parens only when THE opening paren closes at the
+    very end — ``(A) || (B)`` must not lose its per-term parens."""
+    while expr.startswith("(") and expr.endswith(")"):
+        depth = 0
+        wraps = True
+        for i, ch in enumerate(expr):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0 and i != len(expr) - 1:
+                    wraps = False
+                    break
+                if depth < 0:
+                    wraps = False
+                    break
+        if not wraps or depth != 0:
+            break
+        expr = expr[1:-1].strip()
+    return expr
+
+
 def _version_check_spec(code: str) -> Optional[dict]:
     """Parse the version-comparison script shape, or None.
 
     Accepts a single `return (...)` expression whose every term is
     `GLOBAL.VERSION <op> "literal"` over ONE global, joined by || / &&
-    (JS precedence: && binds tighter). Anything else stays
+    (JS precedence: && binds tighter); parens may wrap the whole
+    expression and/or individual terms. Anything else stays
     js-required."""
-    m = re.search(r"return\s*\(?(.+?)\)?\s*;?\s*}?\s*$", code, re.S)
+    m = re.search(r"return\s*(.+?)\s*;?\s*}?\s*$", code, re.S)
     if not m:
         return None
-    expr = m.group(1).strip()
-    # strip balanced outer parens
-    while expr.startswith("(") and expr.endswith(")"):
-        expr = expr[1:-1].strip()
+    expr = _strip_outer_parens(m.group(1).strip())
     or_groups = []
     globals_seen = set()
     for part in expr.split("||"):
         and_terms = []
+        part = _strip_outer_parens(part.strip())
         for term in part.split("&&"):
-            tm = _VERSION_TERM_RE.fullmatch(term.strip())
+            term = _strip_outer_parens(term.strip())
+            tm = _VERSION_TERM_RE.fullmatch(term)
             if tm is None:
                 return None
             globals_seen.add(tm.group(1))
@@ -214,20 +237,54 @@ _VERSION_LITERAL_RE = re.compile(
 _VERSION_IDENT_RE = re.compile(r"\bVERSION\s*[:=]\s*([A-Za-z_$][\w$]*)\b")
 
 
-def _script_version_of(text: str) -> Optional[str]:
-    """The VERSION value a script carries: a direct string literal,
-    or one identifier hop (``VERSION:t`` + ``t="4.2.1"``)."""
-    vm = _VERSION_LITERAL_RE.search(text)
-    if vm:
-        return vm.group(1)
-    im = _VERSION_IDENT_RE.search(text)
-    if im:
+_QUALIFIER_RE = re.compile(r"([A-Za-z_$][\w$]*)\s*\.\s*$")
+
+
+def _script_version_of(
+    text: str, g: str, define_pos: int
+) -> Optional[str]:
+    """The VERSION value script ``text`` carries FOR global ``g``
+    (whose define site starts at ``define_pos``): an explicit
+    ``g.VERSION = "lit"`` wins; otherwise VERSION literals (direct or
+    one identifier hop, ``VERSION:t`` + ``t="4.2.1"``) are candidates
+    — except those qualified with ANOTHER global (``Plugin.VERSION``
+    in a bundle must not donate Reveal's version). Among candidates,
+    the first at/after the define site is the defining object's own;
+    with none there, a script-wide UNIQUE value is still unambiguous.
+    Multiple distinct values before the define site → None (fail
+    closed: no verdict rather than a guessed one)."""
+    m = re.search(
+        rf"\b{re.escape(g)}\.VERSION\s*=\s*['\"]([0-9][\w.\-]*)['\"]",
+        text,
+    )
+    if m:
+        return m.group(1)
+    vals: list = []
+    for vm in _VERSION_LITERAL_RE.finditer(text):
+        qm = _QUALIFIER_RE.search(text, 0, vm.start())
+        if qm and qm.group(1) != g:
+            continue
+        vals.append((vm.start(), vm.group(1)))
+    # identifier hops are candidates ALONGSIDE direct literals — a
+    # pre-define literal of another object must not shadow the target's
+    # own hoisted ``VERSION:t``
+    for im in _VERSION_IDENT_RE.finditer(text):
+        qm = _QUALIFIER_RE.search(text, 0, im.start())
+        if qm and qm.group(1) != g:
+            continue
         ident = re.escape(im.group(1))
         lit = re.search(
             rf"\b{ident}\s*=\s*['\"]([0-9][\w.\-]*)['\"]", text
         )
         if lit:
-            return lit.group(1)
+            vals.append((im.start(), lit.group(1)))
+    vals.sort()
+    for pos, val in vals:
+        if pos >= define_pos:
+            return val
+    distinct = {v for _pos, v in vals}
+    if len(distinct) == 1:
+        return distinct.pop()
     return None
 
 
@@ -243,15 +300,18 @@ def _eval_version_check(sess: "_Session", spec: dict) -> Optional[str]:
     string comparison is lexicographic over code units, exactly
     Python's str comparison for this ASCII domain."""
     g = re.escape(spec["global"])
+    # `=(?![=])`: an assignment defines, a comparison (`Reveal ==`)
+    # merely consults — consumers must not be treated as define sites
     define_re = re.compile(
-        rf"(?:\b(?:var|let|const)\s+{g}\b|window\.{g}\s*=|"
-        rf"\b{g}\s*=\s*|[{{,]\s*{g}\s*:|exports\.{g}\s*=)"
+        rf"(?:\b(?:var|let|const)\s+{g}\b|window\.{g}\s*=(?![=])|"
+        rf"\b{g}\s*=(?![=])|[{{,]\s*{g}\s*:|exports\.{g}\s*=(?![=]))"
     )
     version = None
     for _label, text in _page_scripts(sess):
-        if not define_re.search(text):
+        dm = define_re.search(text)
+        if dm is None:
             continue
-        version = _script_version_of(text)
+        version = _script_version_of(text, spec["global"], dm.start())
         if version is not None:
             break
     if version is None:

@@ -809,6 +809,59 @@ def test_version_check_spec_parsing():
         'return (Reveal.VERSION < "4" || Foo.VERSION < "2")') is None
     assert headless._version_check_spec(
         'return (document.cookie < "4")') is None
+    # per-term parens (and double wrapping) parse — stripping outer
+    # parens must be balance-aware, not textual
+    for src in (
+        'return (Reveal.VERSION <= "3.8.0") || (Reveal.VERSION < "4.3.0")',
+        'return ((Reveal.VERSION <= "3.8.0") || (Reveal.VERSION < "4.3.0"))',
+    ):
+        ok2 = headless._version_check_spec(src)
+        assert ok2 == {
+            "global": "Reveal",
+            "or_groups": [[("<=", "3.8.0")], [("<", "4.3.0")]],
+        }, src
+
+
+def test_version_attribution_in_bundles():
+    """A concatenated bundle where ANOTHER library's VERSION literal
+    precedes the target's define site must resolve the target's own
+    version (first candidate at/after the define), and a pure consumer
+    (`Reveal ===`) must not count as a define site."""
+    bundle = (
+        'Plugin.VERSION="1.0.0";var t="4.3.0";window.Reveal={VERSION:t};'
+    )
+    spec = {"global": "Reveal", "or_groups": [[("<", "4.3.0")]]}
+    g = "Reveal"
+    import re as _re
+
+    define_re = _re.compile(
+        r"(?:\b(?:var|let|const)\s+Reveal\b|window\.Reveal\s*=(?![=])|"
+        r"\bReveal\s*=(?![=])|[{,]\s*Reveal\s*:|exports\.Reveal\s*=(?![=]))"
+    )
+    dm = define_re.search(bundle)
+    assert dm is not None
+    # Plugin.VERSION (another global's) is skipped; VERSION:t after the
+    # define resolves through the identifier hop to 4.3.0
+    assert headless._script_version_of(bundle, g, dm.start()) == "4.3.0"
+    # a comparison is not a define site
+    consumer = 'if (Reveal === undefined) { v = "0.0.1"; }'
+    assert define_re.search(consumer) is None
+    # two distinct unqualified VERSIONs, none at/after a (synthetic)
+    # late define position, is ambiguous -> None (fail closed)
+    amb = 'x={VERSION:"1.0"};y={VERSION:"2.0"};'
+    assert headless._script_version_of(amb, g, len(amb)) is None
+    # a pre-define direct literal of ANOTHER object must not shadow
+    # the target's own identifier-hopped version after the define
+    shadow = (
+        'var a={VERSION:"1.0.0"};var t="4.7.0";'
+        'window.Reveal={VERSION:t};'
+    )
+    dm2 = define_re.search(shadow)
+    assert dm2 is not None
+    assert (
+        headless._script_version_of(shadow, g, dm2.start()) == "4.7.0"
+    )
+    del spec
 
 
 def test_version_check_minified_and_misattribution(reveal_server):
