@@ -44,6 +44,7 @@ from __future__ import annotations
 import binascii
 import dataclasses
 import re
+import sys
 from typing import Optional
 
 import numpy as np
@@ -85,6 +86,7 @@ SOP_EQ, SOP_NE, SOP_LT, SOP_GT, SOP_LE, SOP_GE, SOP_TRUE = range(7)
 
 MAX_SCALAR_CONJUNCTS = 6
 MAX_GROUP = 8  # max word slots sharing one (table, h1) group
+HARD_GROUP = 64  # degrade ceiling when gram shedding fails (see below)
 
 # Rough byte-commonness weights for picking the rarest q-gram of a word.
 # Calibrated for the actual haystacks (HTML bodies, HTTP headers):
@@ -146,10 +148,22 @@ def _lit_rarity(lit: bytes) -> int:
     return len(lit)
 
 
-def _litset_score(cand: list[bytes]) -> tuple[int, int]:
-    """(min member rarity, -member count): every member must be rare
-    for the set to prune, since any member firing routes to confirm."""
-    return (min(_lit_rarity(c) for c in cand), -len(cand))
+# Beyond this many bytes a literal's extra length adds no pruning power
+# (a 16-byte exact substring is already as discriminating as any), so
+# member COUNT becomes the deciding cost: a digit-crossing expansion
+# that multiplies one signature into 10 near-identical word slots
+# ("…reposerver pro 0".."…pro 9") must lose to the single two-bytes-
+# shorter run — the 10 slots share every rare gram, overflow one
+# word-table hash group, and buy nothing.
+_RARITY_CAP = 16
+
+
+def _litset_score(cand: list[bytes]) -> tuple[int, int, int]:
+    """(capped min member rarity, -member count, true min rarity):
+    every member must be rare for the set to prune, since any member
+    firing routes to confirm; past _RARITY_CAP, fewer members wins."""
+    r = min(_lit_rarity(c) for c in cand)
+    return (min(r, _RARITY_CAP), -len(cand), r)
 
 
 def required_literal_set(
@@ -415,6 +429,16 @@ def required_literal_set(
 
         def extend(alts: list[bytes]) -> None:
             nonlocal runs
+            if len(alts) > 1:
+                # The pre-extension runs are already a sound necessary
+                # set (bytes forced by the consumed prefix — necessity
+                # holds for any prefix of the walk). A multiplying
+                # extension can score WORSE than what it extends: ten
+                # digit variants of one signature tail share every rare
+                # gram and overflow a word-table hash group, where the
+                # one-member run prunes just as hard. Offer the cheap
+                # set; _litset_score picks.
+                runs_candidate()
             new = sorted({r + a for r in runs for a in alts})
             if len(new) > max_alts:
                 flush()
@@ -1927,9 +1951,27 @@ def compile_corpus(
             e_sufh2.append(sh2)
         max_group = max(entry_count)
         if max_group > MAX_GROUP:
-            raise ValueError(
-                f"word-table group overflow ({max_group} > {MAX_GROUP}); "
-                "raise MAX_GROUP or diversify gram offsets"
+            # A pathological slot population (many near-identical
+            # literals sharing every rare gram) can defeat the shedding
+            # loop. Correctness never depends on the bound — every
+            # entry hit is byte-verified in the kernel — so degrade:
+            # this one table's unrolled verify loop grows to the actual
+            # group size (device cost, not a verdict risk). Crashing
+            # the compile would lose the whole DB to save device time.
+            # The degrade is itself bounded: past HARD_GROUP the unroll
+            # would dominate XLA compile and the hot loop, so that
+            # stays a loud failure.
+            if max_group > HARD_GROUP:
+                raise ValueError(
+                    f"word-table group overflow ({max_group} > hard cap "
+                    f"{HARD_GROUP}); diversify gram offsets or split "
+                    "the slot population"
+                )
+            print(
+                f"[compile] word-table group overflow ({max_group} > "
+                f"{MAX_GROUP}) on table {(stream, lowered, q)}; "
+                f"unrolling that table's verify loop to {max_group}",
+                file=sys.stderr,
             )
         # Bloom carries every entry's (h1, h2) pair so a probe can only
         # pass where some entry's gram might start.

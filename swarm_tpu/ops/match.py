@@ -326,7 +326,9 @@ def match_slots(
         # Per-entry (not first-hit-per-window) verification matters:
         # words sharing their chosen gram land in one h1 group and can
         # all pass the hash checks at one window — each needs its own
-        # byte compare. max_group ≤ 8 bounds the extra gathers.
+        # byte compare. max_group (≤ compile.MAX_GROUP normally; up to
+        # compile.HARD_GROUP when gram shedding degrades) bounds the
+        # extra gathers.
         for g in range(table.max_group):
             e = jnp.minimum(e_start + g, entry_h2.shape[0] - 1)
             in_group = found & (g < e_count)

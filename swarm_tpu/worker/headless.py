@@ -240,6 +240,31 @@ _VERSION_IDENT_RE = re.compile(r"\bVERSION\s*[:=]\s*([A-Za-z_$][\w$]*)\b")
 _QUALIFIER_RE = re.compile(r"([A-Za-z_$][\w$]*)\s*\.\s*$")
 
 
+def _qualifier_before(text: str, pos: int) -> Optional[str]:
+    """Identifier qualifying a match at ``pos`` (``ident .`` directly
+    before it), from a bounded lookbehind window — the qualifier is a
+    few tokens, and an unbounded ``$``-anchored search re-scans the
+    whole prefix per candidate (O(n·k) on minified bundles)."""
+    qm = _QUALIFIER_RE.search(text, max(0, pos - 64), pos)
+    return qm.group(1) if qm else None
+
+
+def _aliases_of(text: str, g: str) -> set:
+    """Local identifiers the script assigns TO global ``g`` (UMD shape
+    ``!function(e){e.VERSION="3.8.0"; window.Reveal = e}({})``): a
+    ``VERSION`` literal qualified by such an alias belongs to ``g``
+    itself, not to another library in the bundle."""
+    return {
+        am.group(1)
+        for am in re.finditer(
+            rf"(?:\bwindow\s*\.\s*)?{re.escape(g)}\s*=(?![=])\s*"
+            rf"([A-Za-z_$][\w$]*)\b",
+            text,
+        )
+        if am.group(1) != g
+    }
+
+
 def _script_version_of(
     text: str, g: str, define_pos: int
 ) -> Optional[str]:
@@ -259,18 +284,19 @@ def _script_version_of(
     )
     if m:
         return m.group(1)
+    ok_quals = {g} | _aliases_of(text, g)
     vals: list = []
     for vm in _VERSION_LITERAL_RE.finditer(text):
-        qm = _QUALIFIER_RE.search(text, 0, vm.start())
-        if qm and qm.group(1) != g:
+        q = _qualifier_before(text, vm.start())
+        if q is not None and q not in ok_quals:
             continue
         vals.append((vm.start(), vm.group(1)))
     # identifier hops are candidates ALONGSIDE direct literals — a
     # pre-define literal of another object must not shadow the target's
     # own hoisted ``VERSION:t``
     for im in _VERSION_IDENT_RE.finditer(text):
-        qm = _QUALIFIER_RE.search(text, 0, im.start())
-        if qm and qm.group(1) != g:
+        q = _qualifier_before(text, im.start())
+        if q is not None and q not in ok_quals:
             continue
         ident = re.escape(im.group(1))
         lit = re.search(

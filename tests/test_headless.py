@@ -830,7 +830,6 @@ def test_version_attribution_in_bundles():
     bundle = (
         'Plugin.VERSION="1.0.0";var t="4.3.0";window.Reveal={VERSION:t};'
     )
-    spec = {"global": "Reveal", "or_groups": [[("<", "4.3.0")]]}
     g = "Reveal"
     import re as _re
 
@@ -861,7 +860,13 @@ def test_version_attribution_in_bundles():
     assert (
         headless._script_version_of(shadow, g, dm2.start()) == "4.7.0"
     )
-    del spec
+    # UMD alias shape: the VERSION literal is qualified by the local
+    # export alias (later assigned to the global) — it belongs to the
+    # target, not to "another global"
+    umd = '!function(e){e.VERSION="3.8.0";window.Reveal=e}({});'
+    dm3 = define_re.search(umd)
+    assert dm3 is not None
+    assert headless._script_version_of(umd, g, dm3.start()) == "3.8.0"
 
 
 def test_version_check_minified_and_misattribution(reveal_server):
