@@ -93,6 +93,7 @@ struct Attrs {
   PyObject* oob_protocols;
   PyObject* oob_requests;
   PyObject* oob_ips;
+  PyObject* alive;
 };
 
 inline const Attrs& attrs() {
@@ -104,6 +105,7 @@ inline const Attrs& attrs() {
       PyUnicode_InternFromString("oob_protocols"),
       PyUnicode_InternFromString("oob_requests"),
       PyUnicode_InternFromString("oob_ips"),
+      PyUnicode_InternFromString("alive"),
   };
   return a;
 }
@@ -284,6 +286,11 @@ inline uint64_t mix64(uint64_t h, uint64_t x) {
 // hash quality, only speed does — fleet pages differing mid-body pay
 // one memcmp against their bucket's representative).
 inline uint64_t row_hash(const RowView& r) {
+  // Three probe REGIONS per stream (start 16B, middle 8B, end 8B) —
+  // each probe of cold content is a DRAM miss, so regions are the
+  // unit of cost. Boundary bytes + lengths separate real fleet
+  // content; anything they can't separate costs one extra memcmp in
+  // the (sequential, prefetch-friendly) verify, never a verdict.
   uint64_t h = 0x243F6A8885A308D3ULL;
   h = mix64(h, uint64_t(r.ban_len + 1));
   h = mix64(h, uint64_t(r.body_len));
@@ -296,19 +303,20 @@ inline uint64_t row_hash(const RowView& r) {
   for (int k = 0; k < 2; ++k) {
     const char* d = k ? r.hdr : b;
     Py_ssize_t len = k ? r.hdr_len : blen;
-    if (len >= 8) {
+    if (len >= 16) {
       std::memcpy(&w, d, 8);
+      h = mix64(h, w);
+      std::memcpy(&w, d + 8, 8);  // same cache line as the first
       h = mix64(h, w);
       std::memcpy(&w, d + len / 2 - 4, 8);
       h = mix64(h, w);
       std::memcpy(&w, d + len - 8, 8);
       h = mix64(h, w);
-      if (len >= 40) {  // two more probes through the middle
-        std::memcpy(&w, d + len / 4, 8);
-        h = mix64(h, w);
-        std::memcpy(&w, d + (3 * len) / 4 - 8, 8);
-        h = mix64(h, w);
-      }
+    } else if (len >= 8) {
+      std::memcpy(&w, d, 8);
+      h = mix64(h, w);
+      std::memcpy(&w, d + len - 8, 8);
+      h = mix64(h, w);
     } else if (len > 0) {
       w = 0;
       std::memcpy(&w, d, size_t(len));
@@ -385,6 +393,184 @@ struct HeldRefs {
   void hold(PyObject* o) { objs.push_back(o); }
 };
 
+// One row's attribute objects gathered by a single dense-dict scan.
+struct RawRow {
+  PyObject* body = nullptr;
+  PyObject* header = nullptr;
+  PyObject* banner = nullptr;
+  PyObject* status = nullptr;
+  PyObject* op = nullptr;   // oob_protocols
+  PyObject* orq = nullptr;  // oob_requests
+  PyObject* oip = nullptr;  // oob_ips
+  PyObject* alive = nullptr;
+};
+
+// ONE PyDict_Next walk over the instance __dict__ replaces eight
+// hashed PyDict_GetItem probes per row: dataclass __init__ stores
+// every field with a compile-interned name, so the dict's dense entry
+// array pointer-compares against the interned Attrs names directly.
+// The if-chain is ordered by Response's field declaration order (=
+// dict insertion order), so most entries exit on an early compare.
+// Returns true only when every attribute was found — subclasses or
+// instances with deleted fields fall back to the hashed path, whose
+// GetAttr fallback resolves class defaults. ``idx``, when non-null,
+// records each attribute's PyDict_Next ITERATION index, and the scan
+// reports whether the iteration was dense (pos advanced by exactly 1
+// per entry) — the precondition for the split-dict fast read below.
+inline bool scan_row_dict(PyObject* dict, RawRow* r, int8_t* idx = nullptr,
+                          bool* dense = nullptr, int* n_iter = nullptr) {
+  const Attrs& a = attrs();
+  int found = 0;
+  Py_ssize_t pos = 0, prev = 0, it = 0;
+  bool is_dense = true;
+  PyObject *k, *v;
+  while (PyDict_Next(dict, &pos, &k, &v)) {
+    if (pos != prev + 1) is_dense = false;  // engine skipped a slot
+    prev = pos;
+    int8_t slot = -1;
+    if (k == a.status) { r->status = v; slot = 3; ++found; }
+    else if (k == a.body) { r->body = v; slot = 0; ++found; }
+    else if (k == a.header) { r->header = v; slot = 1; ++found; }
+    else if (k == a.banner) { r->banner = v; slot = 2; ++found; }
+    else if (k == a.alive) { r->alive = v; slot = 7; ++found; }
+    else if (k == a.oob_protocols) { r->op = v; slot = 4; ++found; }
+    else if (k == a.oob_requests) { r->orq = v; slot = 5; ++found; }
+    else if (k == a.oob_ips) { r->oip = v; slot = 6; ++found; }
+    if (slot >= 0 && idx != nullptr) idx[slot] = int8_t(it);
+    ++it;
+  }
+  if (dense != nullptr) *dense = is_dense;
+  if (n_iter != nullptr) *n_iter = int(it);
+  return found == 8;
+}
+
+// ---------------------------------------------------------------------
+// CPython 3.12 split-dict fast read. Instances of one dataclass share
+// one PyDictKeysObject; for a split dict (ma_values != NULL) the dense
+// values array is indexed by entry order, which is exactly the
+// PyDict_Next iteration order when no slot was skipped. So: learn the
+// 8 attribute indices ONCE per distinct ma_keys via a verified scan,
+// then read subsequent rows' attribute objects with 8 array loads —
+// no hashing, no per-entry call overhead. Guards per row: same
+// ma_keys pointer, split layout, same live count. Any deviation (and
+// any non-3.12 build) falls back to the PyDict_Next scan; a deleted
+// attribute converts the dict to combined layout (ma_values == NULL),
+// which the guard catches.
+// ---------------------------------------------------------------------
+#if PY_VERSION_HEX >= 0x030C0000 && PY_VERSION_HEX < 0x030D0000 && \
+    !defined(Py_LIMITED_API)
+#define SW_SPLITDICT_FAST 1
+// cpython/dictobject.h defines PyDictObject; PyDictValues is opaque
+// there — its definition (a bare dense array, values[0] first) is
+// replicated from the 3.12 internals and verified at runtime before
+// first use (sw_splitdict_selfcheck below + per-call first-row check).
+struct SwDictValues {
+  PyObject* values[1];
+};
+struct SplitDictPlan {
+  PyDictKeysObject* keys = nullptr;  // identity of the shared layout
+  Py_ssize_t used = 0;
+  int8_t idx[8] = {};
+  bool valid = false;
+};
+
+inline bool splitdict_read(PyObject* dict, const SplitDictPlan& plan,
+                           RawRow* r) {
+  PyDictObject* d = reinterpret_cast<PyDictObject*>(dict);
+  if (d->ma_keys != plan.keys || d->ma_values == nullptr ||
+      d->ma_used != plan.used)
+    return false;
+  PyObject** vals =
+      reinterpret_cast<SwDictValues*>(d->ma_values)->values;
+  PyObject* o;
+  // any NULL (unset slot) → fall back; guards above make this rare
+  if ((o = vals[plan.idx[0]]) == nullptr) return false;
+  r->body = o;
+  if ((o = vals[plan.idx[1]]) == nullptr) return false;
+  r->header = o;
+  if ((o = vals[plan.idx[2]]) == nullptr) return false;
+  r->banner = o;
+  if ((o = vals[plan.idx[3]]) == nullptr) return false;
+  r->status = o;
+  if ((o = vals[plan.idx[4]]) == nullptr) return false;
+  r->op = o;
+  if ((o = vals[plan.idx[5]]) == nullptr) return false;
+  r->orq = o;
+  if ((o = vals[plan.idx[6]]) == nullptr) return false;
+  r->oip = o;
+  if ((o = vals[plan.idx[7]]) == nullptr) return false;
+  r->alive = o;
+  return true;
+}
+
+// Build a plan from one row's dict: scan (recording iteration
+// indices), require dense iteration and a split layout, then VERIFY
+// the layout assumption by re-reading every attribute through the
+// plan and pointer-comparing against the scan's objects. A CPython
+// whose PyDictValues layout differs can't pass the verification, so
+// the fast path self-disables instead of reading wrong objects.
+// Returns whether the SCAN filled ``scanned`` (the caller's real
+// question); plan->valid reports whether the fast read verified.
+inline bool splitdict_learn(PyObject* dict, SplitDictPlan* plan,
+                            RawRow* scanned) {
+  PyDictObject* d = reinterpret_cast<PyDictObject*>(dict);
+  bool dense = false;
+  int n_iter = 0;
+  RawRow r;
+  if (!scan_row_dict(dict, &r, plan->idx, &dense, &n_iter)) return false;
+  *scanned = r;
+  if (!dense || d->ma_values == nullptr || d->ma_used != n_iter)
+    return true;
+  plan->keys = d->ma_keys;
+  plan->used = d->ma_used;
+  RawRow check;
+  if (!splitdict_read(dict, *plan, &check)) return true;
+  if (check.body != r.body || check.header != r.header ||
+      check.banner != r.banner || check.status != r.status ||
+      check.op != r.op || check.orq != r.orq || check.oip != r.oip ||
+      check.alive != r.alive)
+    return true;
+  plan->valid = true;
+  return true;
+}
+#else
+#define SW_SPLITDICT_FAST 0
+struct SplitDictPlan {
+  bool valid = false;
+};
+#endif
+
+// RawRow → RowView with the same type checks and hash as the hashed
+// path (borrowed pointers; the row's __dict__ keeps them alive).
+// Returns 0, -1 on a type error (identical failure surface to the
+// hashed path — a non-bytes body errors either way).
+inline int view_from_raw(const RawRow& r, RowView* v) {
+  if (r.banner == Py_None) {
+    v->ban = nullptr;
+    v->ban_len = -1;
+  } else if (PyBytes_Check(r.banner)) {
+    v->ban = PyBytes_AS_STRING(r.banner);
+    v->ban_len = PyBytes_GET_SIZE(r.banner);
+  } else {
+    return -1;
+  }
+  if (!PyBytes_Check(r.body) || !PyBytes_Check(r.header) ||
+      !PyBytes_Check(r.orq))
+    return -1;
+  v->body = PyBytes_AS_STRING(r.body);
+  v->body_len = PyBytes_GET_SIZE(r.body);
+  v->hdr = PyBytes_AS_STRING(r.header);
+  v->hdr_len = PyBytes_GET_SIZE(r.header);
+  v->status = PyLong_AsLong(r.status);
+  if (v->status == -1 && PyErr_Occurred()) return -1;
+  v->orq = PyBytes_AS_STRING(r.orq);
+  v->orq_len = PyBytes_GET_SIZE(r.orq);
+  v->op = r.op;
+  v->oip = r.oip;
+  v->hash = row_hash(*v);
+  return 0;
+}
+
 // Load one row's dedup view (borrowed pointers; for __dict__-backed
 // rows the row itself keeps the attribute objects alive, and any
 // GetAttr-fallback fetches are pinned in ``held`` until the caller's
@@ -393,6 +579,10 @@ struct HeldRefs {
 // fetched it; row_view() fetches it itself.
 inline int row_view_dict(PyObject* row, PyObject* dict, RowView* v,
                          HeldRefs* held) {
+  if (dict != nullptr) {
+    RawRow r;
+    if (scan_row_dict(dict, &r)) return view_from_raw(r, v);
+  }
   const Attrs& a = attrs();
   int dec;
   PyObject* obj = fast_attr(row, dict, a.banner, &dec);
@@ -541,6 +731,7 @@ struct MemoEntry {
   uint8_t* bits = nullptr;     // packed verdict row, memo->nb bytes
   int64_t lru_prev = -1, lru_next = -1;
   int64_t hnext = -1;  // bucket chain
+  uint64_t epoch = 0;  // last lookup CALL that touched this entry
   bool live = false;
 };
 
@@ -554,6 +745,12 @@ struct Memo {
   int64_t cap;
   int32_t nb;
   int64_t lru_head = -1, lru_tail = -1;  // head = most recent
+  // LRU refresh granularity: one list surgery per entry per lookup
+  // call. Within one batch an entry hit k times pays the (random-
+  // memory) unlink/push pointer chase once, not k times — recency
+  // below batch granularity can't change eviction order anyway, since
+  // eviction only ever happens in later calls.
+  uint64_t epoch = 0;
 };
 
 inline void memo_lru_unlink(Memo* m, int64_t id) {
@@ -782,6 +979,7 @@ int memo_insert_one(Memo* m, PyObject* row, const uint8_t* bits_row,
   e.hnext = m->buckets[b];
   m->buckets[b] = id;
   e.live = true;
+  e.epoch = m->epoch;
   memo_lru_push_front(m, id);
   return 0;
 }
@@ -845,6 +1043,10 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
   static PyObject* alive_name = PyUnicode_InternFromString("alive");
   Py_ssize_t n = PyList_GET_SIZE(rows);
   if (n == 0) return 0;
+  ++m->epoch;  // LRU refresh cadence anchor (see Memo::epoch)
+  SplitDictPlan plan;   // per-call: rows keep the keys object alive
+  bool plan_tried = false;
+  (void)plan_tried;
   // batch-local miss table (open addressing over miss slots)
   size_t cap = 16;
   while (cap < size_t(n) * 2) cap <<= 1;
@@ -862,13 +1064,75 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
     for (auto& [row_i, ex] : extra_rows) Py_DECREF(ex);
   };
   for (Py_ssize_t i = 0; i < n; ++i) {
+#if SW_SPLITDICT_FAST
+    // Software pipeline: fresh batches' content bytes are DRAM-cold
+    // and the hash/verify reads are dependent loads — prefetch the
+    // row PF ahead (its dict header, values line, and its body/header
+    // content boundary lines) so those misses overlap this row's work.
+    constexpr Py_ssize_t PF = 8;
+    if (plan.valid && i + PF < n) {
+      PyObject* prow = PyList_GET_ITEM(rows, i + PF);
+      PyObject** pdp = _PyObject_GetDictPtr(prow);
+      PyObject* pdict = pdp != nullptr ? *pdp : nullptr;
+      if (pdict != nullptr) {
+        PyDictObject* pd = reinterpret_cast<PyDictObject*>(pdict);
+        if (pd->ma_keys == plan.keys && pd->ma_values != nullptr &&
+            pd->ma_used == plan.used) {
+          PyObject** pvals =
+              reinterpret_cast<SwDictValues*>(pd->ma_values)->values;
+          PyObject* ob = pvals[plan.idx[0]];   // body
+          PyObject* oh = pvals[plan.idx[1]];   // header
+          if (ob != nullptr && PyBytes_Check(ob)) {
+            const char* d = PyBytes_AS_STRING(ob);
+            Py_ssize_t l = PyBytes_GET_SIZE(ob);
+            if (l > 0) {
+              __builtin_prefetch(d);
+              __builtin_prefetch(d + (l > 1 ? l - 1 : 0));
+              if (l >= 128) __builtin_prefetch(d + l / 2);
+            }
+          }
+          if (oh != nullptr && PyBytes_Check(oh)) {
+            const char* d = PyBytes_AS_STRING(oh);
+            Py_ssize_t l = PyBytes_GET_SIZE(oh);
+            if (l > 0) {
+              __builtin_prefetch(d);
+              __builtin_prefetch(d + (l > 1 ? l - 1 : 0));
+            }
+          }
+        } else {
+          __builtin_prefetch(pd);
+        }
+      }
+    }
+#endif
     PyObject* row = PyList_GET_ITEM(rows, i);
-    // one dict fetch serves the alive check AND the row view
+    // fastest first: the split-dict plan (8 array loads), then the
+    // dense-dict scan, then the hashed-lookup path below. The plan is
+    // learned from the first servable row of THIS call (keys object
+    // kept alive by the rows themselves, so no dangling identity).
     PyObject** dp = _PyObject_GetDictPtr(row);
     PyObject* dict = dp != nullptr ? *dp : nullptr;
+    RawRow raw;
+    bool scanned = false;
+    if (dict != nullptr) {
+#if SW_SPLITDICT_FAST
+      if (plan.valid) {
+        scanned = splitdict_read(dict, plan, &raw) ||
+                  scan_row_dict(dict, &raw);
+      } else if (!plan_tried) {
+        plan_tried = true;
+        scanned = splitdict_learn(dict, &plan, &raw);
+      } else {
+        scanned = scan_row_dict(dict, &raw);
+      }
+#else
+      scanned = scan_row_dict(dict, &raw);
+#endif
+    }
     {
-      int dec;
-      PyObject* a = fast_attr(row, dict, alive_name, &dec);
+      int dec = 0;
+      PyObject* a = scanned ? raw.alive
+                            : fast_attr(row, dict, alive_name, &dec);
       if (a == nullptr) {
         release_extras();
         return -1;
@@ -887,7 +1151,9 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
       }
     }
     RowView v;
-    if (row_view_dict(row, dict, &v, &held) != 0) {
+    int vrc = scanned ? view_from_raw(raw, &v)
+                      : row_view_dict(row, dict, &v, &held);
+    if (vrc != 0) {
       release_extras();
       return -1;
     }
@@ -905,8 +1171,15 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
         Py_INCREF(e.extras);
         extra_rows.emplace_back(i, e.extras);
       }
-      memo_lru_unlink(m, id);
-      memo_lru_push_front(m, id);
+      // Refresh the LRU position only when the entry's last refresh
+      // is ≥8 calls old: with capacity far above the live set the
+      // eviction order below batch granularity is irrelevant, and the
+      // unlink/push is the pass's only random-memory pointer chase.
+      if (m->epoch - e.epoch >= 8) {
+        e.epoch = m->epoch;
+        memo_lru_unlink(m, id);
+        memo_lru_push_front(m, id);
+      }
       continue;
     }
     // miss: dedup within the batch

@@ -358,6 +358,8 @@ class MatchEngine:
         # the no-toolchain fallback.
         self._vmemo = None
         self._native_memo_ok = None
+        self._bits_ring: list = []  # rotating verdict planes (see
+        self._bits_ring_i = 0       # _encode_native reuse_buffers)
         # ROW-dependent templates: verdicts/extractions that read
         # beyond the response content (host/port/duration dsl vars,
         # part "host") — e.g. the takeover family's
@@ -1089,7 +1091,24 @@ class MatchEngine:
             from swarm_tpu.native.scanio import VerdictMemo
 
             self._vmemo = VerdictMemo(self._EXT_CACHE_MAX, nbits)
-        bits = np.empty((len(rows), nbits), dtype=np.uint8)
+        if reuse_buffers:
+            # A fresh ~1 MB np.empty per batch lands on mmap'd pages
+            # whose first-touch faults cost more than the lookup pass
+            # itself — rotate a ring instead. Ring depth 8 honors the
+            # documented recycled-pool contract (each batch consumed
+            # within a couple of further encodes; PackedMatches.bits
+            # aliases the ring, so callers holding many results copy).
+            shape = (len(rows), nbits)
+            ring = self._bits_ring
+            if not ring or ring[0].shape != shape:
+                ring = self._bits_ring = [
+                    np.empty(shape, dtype=np.uint8) for _ in range(8)
+                ]
+                self._bits_ring_i = 0
+            bits = ring[self._bits_ring_i]
+            self._bits_ring_i = (self._bits_ring_i + 1) % len(ring)
+        else:
+            bits = np.empty((len(rows), nbits), dtype=np.uint8)
         state, miss_uniq, extr_known, deferred_known = (
             self._vmemo.lookup(rows, bits)
         )
