@@ -92,7 +92,10 @@ def _env_float(name: str, default: float) -> float:
 _EMIT_NOTE = ""  # set when the run is NOT on accelerator hardware
 
 
-def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+def emit(
+    metric: str, value: float, unit: str, vs_baseline: float,
+    extra: dict | None = None,
+) -> None:
     rec = {
         "metric": metric,
         # 3 decimals, not int: sub-1.0 rates (the per-row CPU oracle)
@@ -104,6 +107,8 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
         # that would read as a measured total collapse
         "vs_baseline": float(f"{vs_baseline:.3g}"),
     }
+    if extra:
+        rec.update(extra)
     if _EMIT_NOTE:
         rec["note"] = _EMIT_NOTE
     print(json.dumps(rec), flush=True)
@@ -245,7 +250,7 @@ def resolve_device():
 
 def bench_exact_engine(templates, db=None) -> tuple:
     # → (steady_rows_per_sec, fresh_floor_rows_per_sec,
-    #    fresh_host_walk_rows_per_sec, CompiledDB)
+    #    fresh_host_walk_rows_per_sec, CompiledDB, engine_stats_snapshot)
     from swarm_tpu.ops.engine import MatchEngine
 
     eng = MatchEngine(
@@ -344,7 +349,12 @@ def bench_exact_engine(templates, db=None) -> tuple:
     walk_s = eng.stats.host_confirm_seconds - h0
     fresh_walk_rate = fresh_iters * ROWS / walk_s if walk_s > 0 else 0.0
     log(f"fresh-content host walk: {fresh_walk_rate:.0f} rows/s")
-    return n / dt, fresh_rate, fresh_walk_rate, eng.db
+    # kernel-counter snapshot riding along in the emitted JSON: BENCH_*
+    # files carry device/host/memo counters from now on (telemetry PR)
+    from swarm_tpu.telemetry.engine_export import engine_stats_snapshot
+
+    stats_snap = engine_stats_snapshot(eng)
+    return n / dt, fresh_rate, fresh_walk_rate, eng.db, stats_snap
 
 
 def bench_service_classifier(db_path: str = "") -> float:
@@ -555,7 +565,7 @@ def run_phase(phase: str) -> int:
         need_corpus=phase in ("exact", "oracle", "device")
     )
     if phase == "exact":
-        exact, fresh_rate, fresh_walk, _db = bench_exact_engine(
+        exact, fresh_rate, fresh_walk, _db, engine_stats = bench_exact_engine(
             templates, db=db
         )
         # adversarial floor: every row carries never-seen content, so
@@ -592,6 +602,7 @@ def run_phase(phase: str) -> int:
             exact,
             "fingerprints/sec/chip",
             exact / TARGET_PER_CHIP,
+            extra={"engine_stats": engine_stats},
         )
     elif phase == "service":
         svc = bench_service_classifier()

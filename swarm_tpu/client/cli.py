@@ -21,6 +21,9 @@ import requests
 from swarm_tpu.client.tables import Table
 from swarm_tpu.config import Config
 from swarm_tpu.datamodel import parse_job_id
+from swarm_tpu.telemetry import emit_event, new_trace_id
+from swarm_tpu.telemetry.events import TRACE_HEADER
+from swarm_tpu.telemetry.metrics import parse_exposition
 
 
 class JobClient:
@@ -29,6 +32,9 @@ class JobClient:
         self.timeout = timeout
         self.session = requests.Session()
         self.session.headers["Authorization"] = f"Bearer {api_key}"
+        #: trace ID of the most recent submission (scan/stream): the
+        #: correlation key every layer's event lines carry for it
+        self.last_trace_id: Optional[str] = None
 
     # ------------------------------------------------------------------
     def start_scan(
@@ -38,6 +44,7 @@ class JobClient:
         chunk_index: int,
         batch_size,
         scan_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> tuple[int, str]:
         with open(path, "r") as f:
             file_content = f.readlines()
@@ -48,8 +55,26 @@ class JobClient:
             "scan_id": scan_id,
             "chunk_index": chunk_index,
         }
-        resp = self.session.post(f"{self.base}/queue", json=data, timeout=self.timeout)
+        trace_id = trace_id or new_trace_id()
+        self.last_trace_id = trace_id
+        emit_event(
+            "scan.submit",
+            trace_id=trace_id,
+            module=module,
+            lines=len(file_content),
+            batch_size=int(float(batch_size)),
+        )
+        resp = self.session.post(
+            f"{self.base}/queue",
+            json=data,
+            headers={TRACE_HEADER: trace_id},
+            timeout=self.timeout,
+        )
         return resp.status_code, resp.text
+
+    def get_metrics_text(self) -> Optional[str]:
+        resp = self.session.get(f"{self.base}/metrics", timeout=self.timeout)
+        return resp.text if resp.status_code == 200 else None
 
     def get_statuses(self) -> Optional[dict]:
         resp = self.session.get(f"{self.base}/get-statuses", timeout=self.timeout)
@@ -157,6 +182,21 @@ def render_jobs(statuses: dict) -> str:
     return str(table)
 
 
+def render_metrics(text: str) -> str:
+    """Pretty-print a /metrics exposition body as tables: one row per
+    sample, histograms summarized to count/sum/p-ish buckets."""
+    samples = parse_exposition(text)
+    table = Table(["Metric", "Labels", "Value"])
+    for name, labels, value in samples:
+        label_str = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        if isinstance(value, float) and value.is_integer():
+            shown = str(int(value))
+        else:
+            shown = f"{value:.6g}"
+        table.add_row([name, label_str, shown])
+    return str(table)
+
+
 def render_scans(statuses: dict) -> str:
     table = Table(
         ["Scan ID", "Chunks", "Complete", "%", "Workers", "Module", "Started",
@@ -181,7 +221,7 @@ def render_scans(statuses: dict) -> str:
 # ---------------------------------------------------------------------------
 
 ACTIONS = [
-    "scan", "workers", "scans", "jobs", "spinup", "terminate",
+    "scan", "workers", "scans", "jobs", "metrics", "spinup", "terminate",
     "cat", "stream", "recycle", "reset",
 ]
 
@@ -245,6 +285,18 @@ def _run_action(args, cfg: Config, client: JobClient) -> int:
         print(f"Start Scan Response: {text}")
         return 0 if code == 200 else 1
 
+    if args.action == "metrics":
+        text = client.get_metrics_text()
+        if text is None:
+            print("Failed to retrieve metrics")
+            return 1
+        try:
+            print(render_metrics(text))
+        except ValueError as e:
+            print(f"Malformed metrics exposition: {e}")
+            return 1
+        return 0
+
     if args.action in ("workers", "scans", "jobs"):
         statuses = client.get_statuses()
         if statuses is None:
@@ -297,6 +349,14 @@ def _run_action(args, cfg: Config, client: JobClient) -> int:
         chunk: list[str] = []
         chunk_index = 0
         batch = 0 if args.batch_size == "auto" else int(float(args.batch_size))
+        # one trace for the whole streamed scan: every flushed chunk's
+        # jobs correlate under it
+        trace_id = new_trace_id()
+        client.last_trace_id = trace_id
+        emit_event(
+            "scan.stream_start", trace_id=trace_id,
+            scan_id=args.scan_id, module=args.module,
+        )
 
         def flush(lines: list[str]) -> None:
             nonlocal chunk_index
@@ -310,6 +370,7 @@ def _run_action(args, cfg: Config, client: JobClient) -> int:
                     "scan_id": args.scan_id,
                     "chunk_index": chunk_index,
                 },
+                headers={TRACE_HEADER: trace_id},
                 timeout=client.timeout,
             )
             print(f"Uploading chunk {chunk_index}: {resp.status_code}")

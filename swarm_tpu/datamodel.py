@@ -154,14 +154,26 @@ class Job:
     # §5 "tracing": timing exported through the same status API fields).
     # Extra key to the reference client, which ignores unknown fields.
     perf: Optional[dict] = None
+    # scan-scoped correlation ID (telemetry.events): minted by the
+    # client, carried via the X-Swarm-Trace header into /queue, stored
+    # here, and handed back out through /get-job so every layer's event
+    # lines for one scan share it. Extra wire key to the reference.
+    trace_id: Optional[str] = None
 
     @classmethod
-    def create(cls, scan_id: str, chunk_index: int, module: str) -> "Job":
+    def create(
+        cls,
+        scan_id: str,
+        chunk_index: int,
+        module: str,
+        trace_id: Optional[str] = None,
+    ) -> "Job":
         return cls(
             job_id=job_id_for(scan_id, chunk_index),
             scan_id=scan_id,
             chunk_index=chunk_index,
             module=module,
+            trace_id=trace_id,
         )
 
     def to_wire(self) -> dict[str, Any]:

@@ -388,6 +388,11 @@ class MatchEngine:
         self._rowdep_mask = np.zeros(db.num_templates, dtype=np.uint8)
         for i in self._rowdep_t:
             self._rowdep_mask[i] = 1
+        # export this engine's stats to /metrics: weakref-tracked, read
+        # only at scrape time — zero cost on the match hot path
+        from swarm_tpu.telemetry.engine_export import register_engine
+
+        register_engine(self)
 
     _EXT_CACHE_MAX = 16384
 

@@ -8,7 +8,11 @@ over HTTP instead of needing direct S3 credentials:
 
     GET  /get-input-chunk/<scan>/<chunk>     (reference worker hits S3)
     POST /put-output-chunk/<scan>/<chunk>
-    GET  /healthz                            (unauthenticated liveness)
+    GET  /healthz                            (unauthenticated liveness:
+                                              uptime, queue depth,
+                                              jobs by state)
+    GET  /metrics                            (unauthenticated Prometheus
+                                              text exposition)
 """
 
 from __future__ import annotations
@@ -16,6 +20,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 from urllib.parse import parse_qs, urlparse
@@ -24,6 +29,29 @@ from swarm_tpu.config import Config
 from swarm_tpu.server.fleet import build_provider
 from swarm_tpu.server.queue import JobQueueService
 from swarm_tpu.stores import build_stores
+from swarm_tpu.telemetry import REGISTRY
+from swarm_tpu.telemetry.events import header_trace_id, new_trace_id
+from swarm_tpu.telemetry.metrics import CONTENT_TYPE as _METRICS_CTYPE
+
+_HTTP_REQUESTS = REGISTRY.counter(
+    "swarm_http_requests_total",
+    "HTTP requests handled by the C2 server",
+    ("route", "method", "code"),
+)
+_HTTP_LATENCY = REGISTRY.histogram(
+    "swarm_http_request_seconds",
+    "C2 server request handling latency",
+    ("route",),
+)
+_QUEUE_DEPTH = REGISTRY.gauge(
+    "swarm_queue_depth", "Jobs waiting in the dispatch queue"
+)
+_JOBS_BY_STATE = REGISTRY.gauge(
+    "swarm_jobs_by_state", "Job records by current status", ("status",)
+)
+_UPTIME = REGISTRY.gauge(
+    "swarm_server_uptime_seconds", "Seconds since the C2 server started"
+)
 
 
 class SwarmServer:
@@ -31,6 +59,7 @@ class SwarmServer:
 
     def __init__(self, cfg: Config, queue: Optional[JobQueueService] = None, fleet=None):
         self.cfg = cfg
+        self.started_at = time.time()
         # see _advertise_url: captured before any bind mutates it. A URL
         # a PRIOR server instance derived (cfg.server_url_derived) still
         # counts as defaulted — a supervisor reusing one Config across
@@ -45,30 +74,61 @@ class SwarmServer:
             queue = JobQueueService(cfg, state, blobs, docs, fleet=fleet)
         self.queue = queue
         self.fleet = fleet if fleet is not None else queue.fleet
-        self._routes: list[tuple[str, re.Pattern, Callable]] = []
+        self._routes: list[tuple[str, re.Pattern, Callable, str]] = []
         self._register_routes()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # scrape-time queue gauges: depth + jobs-by-state read from the
+        # state store only when /metrics (or snapshot()) renders, never
+        # on the dispatch hot path. Weakref'd so servers a test drops
+        # without shutdown() don't stay scrapable forever; removed
+        # explicitly on shutdown.
+        self._seen_states: set[str] = set()
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _collector() -> None:
+            srv = ref()
+            if srv is not None:
+                srv._collect_queue_gauges()
+
+        self._collector = _collector
+        REGISTRY.add_collector(self._collector)
+
+    def _collect_queue_gauges(self) -> None:
+        _UPTIME.set(time.time() - self.started_at)
+        _QUEUE_DEPTH.set(self.queue.queue_depth())
+        counts = self.queue.jobs_by_state()
+        for status in self._seen_states - set(counts):
+            _JOBS_BY_STATE.labels(status=status).set(0)
+        for status, n in counts.items():
+            _JOBS_BY_STATE.labels(status=status).set(n)
+        self._seen_states |= set(counts)
 
     # ------------------------------------------------------------------
     def _register_routes(self) -> None:
-        r = self._routes.append
-        r(("GET", re.compile(r"^/healthz$"), self._healthz))
-        r(("GET", re.compile(r"^/get-statuses$"), self._get_statuses))
-        r(("POST", re.compile(r"^/update-job/(?P<job_id>[^/]+)$"), self._update_job))
-        r(("GET", re.compile(r"^/get-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$"), self._get_chunk))
-        r(("GET", re.compile(r"^/get-latest-chunk$"), self._get_latest_chunk))
-        r(("GET", re.compile(r"^/parse_job/(?P<job_id>[^/]+)$"), self._parse_job))
-        r(("GET", re.compile(r"^/raw/(?P<scan_id>[^/]+)$"), self._raw))
-        r(("POST", re.compile(r"^/queue$"), self._queue_job))
-        r(("GET", re.compile(r"^/get-job$"), self._get_job))
-        r(("POST", re.compile(r"^/spin-up$"), self._spin_up))
-        r(("POST", re.compile(r"^/spin-down$"), self._spin_down))
-        r(("POST", re.compile(r"^/reset$"), self._reset))
-        r(("GET", re.compile(r"^/get-input-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$"), self._get_input_chunk))
-        r(("POST", re.compile(r"^/put-output-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$"), self._put_output_chunk))
+        def r(method, pattern, handler, name):
+            self._routes.append((method, re.compile(pattern), handler, name))
+
+        r("GET", r"^/healthz$", self._healthz, "/healthz")
+        r("GET", r"^/metrics$", self._metrics, "/metrics")
+        r("GET", r"^/get-statuses$", self._get_statuses, "/get-statuses")
+        r("POST", r"^/update-job/(?P<job_id>[^/]+)$", self._update_job, "/update-job")
+        r("GET", r"^/get-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$", self._get_chunk, "/get-chunk")
+        r("GET", r"^/get-latest-chunk$", self._get_latest_chunk, "/get-latest-chunk")
+        r("GET", r"^/parse_job/(?P<job_id>[^/]+)$", self._parse_job, "/parse_job")
+        r("GET", r"^/raw/(?P<scan_id>[^/]+)$", self._raw, "/raw")
+        r("POST", r"^/queue$", self._queue_job, "/queue")
+        r("GET", r"^/get-job$", self._get_job, "/get-job")
+        r("POST", r"^/spin-up$", self._spin_up, "/spin-up")
+        r("POST", r"^/spin-down$", self._spin_down, "/spin-down")
+        r("POST", r"^/reset$", self._reset, "/reset")
+        r("GET", r"^/get-input-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$", self._get_input_chunk, "/get-input-chunk")
+        r("POST", r"^/put-output-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$", self._put_output_chunk, "/put-output-chunk")
 
     # ------------------------------------------------------------------
-    # Handlers — signatures: (match, query, body_bytes) -> (code, body, ctype)
+    # Handlers — signatures:
+    #   (match, query, body_bytes, headers) -> (code, body, ctype)
     # ------------------------------------------------------------------
     @staticmethod
     def _json(code: int, payload: Any) -> tuple[int, bytes, str]:
@@ -78,13 +138,26 @@ class SwarmServer:
     def _text(code: int, text: str) -> tuple[int, bytes, str]:
         return code, text.encode(), "text/html; charset=utf-8"
 
-    def _healthz(self, m, q, body):
-        return self._json(200, {"status": "ok"})
+    def _healthz(self, m, q, body, h):
+        # real liveness, not a static ok: load balancers and tests can
+        # assert the queue is actually reachable behind this process
+        return self._json(
+            200,
+            {
+                "status": "ok",
+                "uptime_seconds": round(time.time() - self.started_at, 3),
+                "queue_depth": self.queue.queue_depth(),
+                "jobs_by_state": self.queue.jobs_by_state(),
+            },
+        )
 
-    def _get_statuses(self, m, q, body):
+    def _metrics(self, m, q, body, h):
+        return 200, REGISTRY.render().encode(), _METRICS_CTYPE
+
+    def _get_statuses(self, m, q, body, h):
         return self._json(200, self.queue.statuses())
 
-    def _update_job(self, m, q, body):
+    def _update_job(self, m, q, body, h):
         try:
             changes = json.loads(body or b"{}")
         except ValueError:
@@ -93,45 +166,49 @@ class SwarmServer:
             return self._json(200, {"message": "Job status updated"})
         return self._json(404, {"message": "Job not found"})
 
-    def _get_chunk(self, m, q, body):
+    def _get_chunk(self, m, q, body, h):
         content = self.queue.output_chunk(m["scan_id"], int(m["chunk_id"]))
         if content is None:
             return self._json(404, {"message": "Chunk not found"})
         return self._json(200, {"contents": content})
 
-    def _get_latest_chunk(self, m, q, body):
+    def _get_latest_chunk(self, m, q, body, h):
         job_id = self.queue.latest_completed_job_id()
         if job_id is None:
             return self._text(204, "")
         return self._text(200, job_id)
 
-    def _parse_job(self, m, q, body):
+    def _parse_job(self, m, q, body, h):
         if self.queue.parse_job(m["job_id"]):
             return self._json(200, {"message": "Job parsed and inserted into mongodb"})
         return self._json(404, {"message": "Job not found"})
 
-    def _raw(self, m, q, body):
+    def _raw(self, m, q, body, h):
         return self._text(200, self.queue.raw_scan(m["scan_id"]))
 
-    def _queue_job(self, m, q, body):
+    def _queue_job(self, m, q, body, h):
         try:
             job_data = json.loads(body or b"{}")
         except ValueError:
             return self._text(400, "Invalid JSON")
+        # trace propagation: honor the client's X-Swarm-Trace, mint one
+        # for clients that don't send it (reference client) so every job
+        # record carries a usable correlation id either way
+        trace_id = header_trace_id(h) or new_trace_id()
         try:
-            self.queue.queue_scan(job_data)
+            self.queue.queue_scan(job_data, trace_id=trace_id)
         except ValueError as e:
             return self._text(400, str(e))
         return self._text(200, "Job queued successfully")
 
-    def _get_job(self, m, q, body):
+    def _get_job(self, m, q, body, h):
         worker_id = (q.get("worker_id") or [None])[0]
         job = self.queue.next_job(worker_id or "unknown")
         if job is None:
             return self._text(204, "")
         return self._json(200, job)
 
-    def _spin_up(self, m, q, body):
+    def _spin_up(self, m, q, body, h):
         try:
             data = json.loads(body or b"{}")
         except ValueError:
@@ -146,7 +223,7 @@ class SwarmServer:
             202, {"message": f"Spinning up {nodes} droplets with prefix {prefix}"}
         )
 
-    def _spin_down(self, m, q, body):
+    def _spin_down(self, m, q, body, h):
         try:
             data = json.loads(body or b"{}")
         except ValueError:
@@ -157,45 +234,68 @@ class SwarmServer:
         self.fleet.teardown_async(prefix)
         return self._json(202, {"message": f"Spinning down droplets with prefix {prefix}"})
 
-    def _reset(self, m, q, body):
+    def _reset(self, m, q, body, h):
         self.queue.reset()
         return self._json(200, {"message": "Redis database reset"})
 
-    def _get_input_chunk(self, m, q, body):
+    def _get_input_chunk(self, m, q, body, h):
         data = self.queue.input_chunk(m["scan_id"], int(m["chunk_id"]))
         if data is None:
             return self._json(404, {"message": "Chunk not found"})
         return 200, data, "application/octet-stream"
 
-    def _put_output_chunk(self, m, q, body):
+    def _put_output_chunk(self, m, q, body, h):
         self.queue.put_output_chunk(m["scan_id"], int(m["chunk_id"]), body or b"")
         return self._json(200, {"message": "stored"})
 
     # ------------------------------------------------------------------
     # Dispatch
     # ------------------------------------------------------------------
-    UNAUTHENTICATED = {"/healthz"}
+    UNAUTHENTICATED = {"/healthz", "/metrics"}
 
     def dispatch(
         self, method: str, path: str, query: dict, headers: dict, body: bytes
     ) -> tuple[int, bytes, str]:
+        t0 = time.perf_counter()
         parsed_path = path.rstrip("/") or "/"
         if parsed_path not in self.UNAUTHENTICATED:
             auth = headers.get("Authorization", "")
             if not auth.startswith("Bearer "):
-                return self._json(401, {"message": "Authentication required"})
+                return self._observed(
+                    "_unauthorized", method, t0,
+                    self._json(401, {"message": "Authentication required"}),
+                )
             if auth.split(" ", 1)[1] != self.cfg.api_key:
-                return self._json(401, {"message": "Unauthorized"})
-        for route_method, pattern, handler in self._routes:
+                return self._observed(
+                    "_unauthorized", method, t0,
+                    self._json(401, {"message": "Unauthorized"}),
+                )
+        for route_method, pattern, handler, route_name in self._routes:
             if route_method != method:
                 continue
             match = pattern.match(path)
             if match:
                 try:
-                    return handler(match.groupdict(), query, body)
+                    result = handler(match.groupdict(), query, body, headers)
                 except Exception as e:  # route crash → 500, keep serving
-                    return self._json(500, {"message": f"{type(e).__name__}: {e}"})
-        return self._json(404, {"message": "Not found"})
+                    result = self._json(
+                        500, {"message": f"{type(e).__name__}: {e}"}
+                    )
+                return self._observed(route_name, method, t0, result)
+        return self._observed(
+            "_unmatched", method, t0, self._json(404, {"message": "Not found"})
+        )
+
+    @staticmethod
+    def _observed(
+        route: str, method: str, t0: float, result: tuple[int, bytes, str]
+    ) -> tuple[int, bytes, str]:
+        """Record request count + latency for one dispatched request."""
+        _HTTP_REQUESTS.labels(
+            route=route, method=method, code=str(result[0])
+        ).inc()
+        _HTTP_LATENCY.labels(route=route).observe(time.perf_counter() - t0)
+        return result
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -240,6 +340,14 @@ class SwarmServer:
         return self._httpd.server_address[1]
 
     def shutdown(self) -> None:
+        REGISTRY.remove_collector(self._collector)
+        # zero the by-state children this server populated: the gauge is
+        # process-global, and a later server instance (supervisor
+        # restart, sequential test fixtures) must not keep reporting the
+        # dead store's counts as live state
+        for status in self._seen_states:
+            _JOBS_BY_STATE.labels(status=status).set(0)
+        self._seen_states.clear()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -36,6 +36,33 @@ from swarm_tpu.datamodel import (
     rollup_scans,
 )
 from swarm_tpu.stores import BlobStore, DocStore, StateStore
+from swarm_tpu.telemetry import REGISTRY, emit_event
+
+# Queue-service metric families (process-wide; multiple in-process
+# services share them, which matches the one-service-per-server reality)
+_JOBS_QUEUED = REGISTRY.counter(
+    "swarm_queue_jobs_queued_total", "Jobs accepted into the queue"
+)
+_JOBS_DISPATCHED = REGISTRY.counter(
+    "swarm_queue_jobs_dispatched_total", "Jobs leased out to workers"
+)
+_JOBS_REQUEUED = REGISTRY.counter(
+    "swarm_queue_jobs_requeued_total", "Jobs requeued after lease expiry"
+)
+_JOBS_TERMINAL = REGISTRY.counter(
+    "swarm_queue_jobs_terminal_total",
+    "Jobs reaching a terminal status",
+    ("status",),
+)
+_JOB_PHASE_SECONDS = REGISTRY.histogram(
+    "swarm_job_phase_seconds",
+    "Per-phase worker seconds as reported in completed jobs' perf",
+    ("phase",),
+)
+_JOB_ROWS = REGISTRY.counter(
+    "swarm_queue_rows_processed_total",
+    "Rows processed as reported in completed jobs' perf",
+)
 
 
 class JobQueueService:
@@ -53,11 +80,45 @@ class JobQueueService:
         self.docs = docs
         self.fleet = fleet
         self._lock = threading.Lock()
+        self._jobs_generation = 0
+        self._by_state_cache: tuple[float, int, dict[str, int]] = (0.0, -1, {})
+
+    # ------------------------------------------------------------------
+    # Telemetry snapshots (scrape-time: /metrics and /healthz)
+    # ------------------------------------------------------------------
+    #: jobs_by_state cache TTL — the scan is O(all job records), and it
+    #: feeds UNAUTHENTICATED endpoints (/healthz probes every few
+    #: seconds, Prometheus scrapes): within the TTL, repeated probes of
+    #: an UNCHANGED job table cost zero backend reads. Any local job
+    #: mutation bumps the generation and invalidates immediately, so
+    #: the cache never hides a transition.
+    BY_STATE_TTL_S = 2.0
+
+    def queue_depth(self) -> int:
+        """Jobs currently waiting in the dispatch list (O(1) llen)."""
+        return self.state.llen("job_queue")
+
+    def jobs_by_state(self) -> dict[str, int]:
+        """Status → count over every job record (probe-storm-cached)."""
+        now = time.monotonic()
+        cached_at, gen, counts = self._by_state_cache
+        if gen == self._jobs_generation and now - cached_at < self.BY_STATE_TTL_S:
+            return dict(counts)
+        gen = self._jobs_generation
+        counts = {}
+        for _job_id, raw in self.state.hgetall("jobs").items():
+            try:
+                status = json.loads(raw).get("status") or "unknown"
+            except ValueError:
+                status = "unparseable"
+            counts[status] = counts.get(status, 0) + 1
+        self._by_state_cache = (now, gen, counts)
+        return dict(counts)
 
     # ------------------------------------------------------------------
     # Submission (reference queue_job, server.py:414-461)
     # ------------------------------------------------------------------
-    def queue_scan(self, job_data: dict) -> dict:
+    def queue_scan(self, job_data: dict, trace_id: Optional[str] = None) -> dict:
         module = job_data.get("module")
         if not module:
             raise ValueError("Module must be provided")
@@ -77,14 +138,24 @@ class JobQueueService:
             self.blobs.put(
                 chunk_input_key(scan_id, chunk_index), "\n".join(chunk).encode()
             )
-            job = Job.create(scan_id, chunk_index, module)
+            job = Job.create(scan_id, chunk_index, module, trace_id=trace_id)
             self._put_job(job)
             self.state.rpush("job_queue", job.job_id)
             queued += 1
+            _JOBS_QUEUED.inc()
+            emit_event(
+                "job.queued",
+                trace_id=trace_id,
+                job_id=job.job_id,
+                scan_id=scan_id,
+                module=module,
+                chunk_index=chunk_index,
+            )
         return {"scan_id": scan_id, "chunks": queued}
 
     def _put_job(self, job: Job) -> None:
         self.state.hset("jobs", job.job_id, job.to_json())
+        self._jobs_generation += 1
 
     def _get_job_record(self, job_id: str) -> Optional[Job]:
         raw = self.state.hget("jobs", job_id)
@@ -126,6 +197,14 @@ class JobQueueService:
             worker.polls_with_no_jobs = 0
             worker.status = WorkerStatus.ACTIVE
             self._save_worker(worker)
+            _JOBS_DISPATCHED.inc()
+            emit_event(
+                "job.dispatch",
+                trace_id=job.trace_id,
+                job_id=job.job_id,
+                worker_id=worker_id,
+                attempts=job.attempts,
+            )
             return job.to_wire()
 
         worker.polls_with_no_jobs += 1
@@ -168,12 +247,22 @@ class JobQueueService:
             if job.attempts >= self.cfg.max_attempts:
                 job.status = JobStatus.CMD_FAILED
                 self._put_job(job)
+                _JOBS_TERMINAL.labels(status=JobStatus.CMD_FAILED).inc()
+                emit_event(
+                    "job.lease_exhausted", trace_id=job.trace_id,
+                    job_id=job_id, attempts=job.attempts,
+                )
                 continue
             job.status = JobStatus.QUEUED
             job.worker_id = None
             job.lease_expires_at = None
             self._put_job(job)
             self.state.rpush("job_queue", job.job_id)
+            _JOBS_REQUEUED.inc()
+            emit_event(
+                "job.requeued", trace_id=job.trace_id, job_id=job_id,
+                attempts=job.attempts,
+            )
 
     def _load_worker(self, worker_id: str) -> WorkerInfo:
         raw = self.state.hget("workers", worker_id)
@@ -220,6 +309,39 @@ class JobQueueService:
             updated.lease_expires_at = None
             self.state.hdel("leases", job_id)
         self._put_job(updated)
+        if updated.status in JobStatus.TERMINAL and updated.status != job.status:
+            _JOBS_TERMINAL.labels(status=updated.status).inc()
+            # fold the worker-reported perf sample into the fleet-wide
+            # phase histograms: remote workers' /metrics aren't scraped
+            # by this server, but their phase timings flow through the
+            # same status API the reference used for timestamps
+            perf = updated.perf if isinstance(updated.perf, dict) else {}
+            if updated.status == JobStatus.COMPLETE:
+                # finiteness-guarded: json.loads accepts Infinity/NaN,
+                # and one such perf value from a buggy worker would
+                # wedge a monotonic counter / histogram sum for the
+                # life of the process
+                import math
+
+                for phase in ("download", "execute", "upload"):
+                    v = perf.get(f"{phase}_s")
+                    if isinstance(v, (int, float)) and math.isfinite(v):
+                        _JOB_PHASE_SECONDS.labels(phase=phase).observe(v)
+                rows = perf.get("rows")
+                if (
+                    isinstance(rows, (int, float))
+                    and math.isfinite(rows)
+                    and rows > 0
+                ):
+                    _JOB_ROWS.inc(rows)
+            emit_event(
+                "job.terminal",
+                trace_id=updated.trace_id,
+                job_id=job_id,
+                status=updated.status,
+                worker_id=updated.worker_id,
+                perf=perf or None,
+            )
         return True
 
     # ------------------------------------------------------------------
@@ -320,3 +442,4 @@ class JobQueueService:
     def reset(self) -> None:
         """Flush all queue/scan state (reference /reset, server.py:550-554)."""
         self.state.flushall()
+        self._jobs_generation += 1

@@ -1,0 +1,30 @@
+"""Fleet-wide telemetry: metrics registry + correlated event tracing.
+
+Two complementary planes (neither exists in the reference, whose only
+observability is ``print()`` plus job timestamps — SURVEY.md §5):
+
+- :mod:`swarm_tpu.telemetry.metrics` — a process-wide, thread-safe
+  registry of counters/gauges/histograms with label support and
+  Prometheus text-format exposition, served from the C2 server's
+  ``GET /metrics`` route and scraped by ``swarm metrics``.
+- :mod:`swarm_tpu.telemetry.events` — structured JSON event lines
+  (``ts, trace_id, job_id, phase, …``) emitted by every layer, keyed by
+  a trace ID the client mints per scan and the server propagates via
+  the ``X-Swarm-Trace`` header into each job record, so one grep
+  reconstructs a whole scan's lifecycle across client → server →
+  worker → engine.
+"""
+
+from swarm_tpu.telemetry.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    get_registry,
+)
+from swarm_tpu.telemetry.events import (  # noqa: F401
+    emit_event,
+    new_trace_id,
+    subscribe,
+)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from typing import Iterator, Optional
 
@@ -24,9 +25,15 @@ PROFILE_ENV = "SWARM_PROFILE_DIR"
 
 
 class PhaseTimer:
-    """Accumulates named wall-clock phases → a flat perf dict."""
+    """Accumulates named wall-clock phases → a flat perf dict.
+
+    Thread-safe: worker sessions tick phases from the streaming thread
+    while the telemetry scraper snapshots mid-job, so every mutation
+    holds the lock and :meth:`snapshot` hands out copies.
+    """
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self.seconds: dict[str, float] = {}
         self.counters: dict[str, float] = {}
 
@@ -36,16 +43,24 @@ class PhaseTimer:
         try:
             yield
         finally:
-            self.seconds[name] = (
-                self.seconds.get(name, 0.0) + time.perf_counter() - t0
-            )
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.seconds[name] = self.seconds.get(name, 0.0) + dt
 
     def count(self, name: str, value: float) -> None:
-        self.counters[name] = self.counters.get(name, 0.0) + value
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def snapshot(self) -> tuple[dict[str, float], dict[str, float]]:
+        """Point-in-time ``(seconds, counters)`` copies — never mutates,
+        safe to call from any thread mid-job."""
+        with self._lock:
+            return dict(self.seconds), dict(self.counters)
 
     def perf(self) -> dict:
-        out: dict = {f"{k}_s": round(v, 6) for k, v in self.seconds.items()}
-        for k, v in self.counters.items():
+        seconds, counters = self.snapshot()
+        out: dict = {f"{k}_s": round(v, 6) for k, v in seconds.items()}
+        for k, v in counters.items():
             out[k] = int(v) if float(v).is_integer() else v
         return out
 

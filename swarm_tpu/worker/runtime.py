@@ -28,12 +28,38 @@ import requests
 
 from swarm_tpu.config import Config
 from swarm_tpu.datamodel import SCAN_ID_RE, JobStatus
+from swarm_tpu.telemetry import REGISTRY, emit_event
 from swarm_tpu.utils.trace import PhaseTimer, maybe_device_profile
 from swarm_tpu.worker.modules import (
     ModuleRegistry,
     ModuleSpec,
     format_match_line,
     parse_response_line,
+)
+
+# Worker-side metric families (exposed on /metrics when the worker runs
+# in-process with the server; remote workers' phase timings additionally
+# reach the server via the job perf fields → swarm_job_phase_seconds)
+_PHASE_SECONDS = REGISTRY.histogram(
+    "swarm_worker_phase_seconds",
+    "Per-phase job pipeline seconds measured on the worker",
+    ("phase",),
+)
+_JOBS_PROCESSED = REGISTRY.counter(
+    "swarm_worker_jobs_total",
+    "Jobs processed by this worker, by outcome",
+    ("outcome",),
+)
+_LAST_POLL = REGISTRY.gauge(
+    "swarm_worker_last_poll_timestamp",
+    "Unix time of this worker's most recent /get-job poll (heartbeat)",
+)
+_ROWS_PER_SEC = REGISTRY.gauge(
+    "swarm_worker_rows_per_second",
+    "Rows/sec of the most recently completed device job",
+)
+_ROWS_TOTAL = REGISTRY.counter(
+    "swarm_worker_rows_total", "Device-engine rows processed by this worker"
 )
 
 
@@ -122,6 +148,7 @@ class JobProcessor:
         """The infinite poll loop (reference worker.py:113-126)."""
         while True:
             try:
+                _LAST_POLL.set(time.time())
                 job = self.client.get_job(self.cfg.worker_id)
                 if job:
                     self.process_chunk(job)
@@ -141,17 +168,55 @@ class JobProcessor:
     def process_chunk(self, job: dict) -> None:
         job_id = job.get("job_id") or f"{job['scan_id']}_{job['chunk_index']}"
         scan_id, chunk_index = job["scan_id"], int(job["chunk_index"])
+        trace_id = job.get("trace_id")
         # defense in depth: the server validates scan ids, but these flow
         # into filesystem paths and {input}/{output} command substitution
         if not SCAN_ID_RE.match(str(scan_id)):
             self.client.update_job(job_id, {"status": JobStatus.CMD_FAILED})
+            _JOBS_PROCESSED.labels(outcome=JobStatus.CMD_FAILED).inc()
             return
-        update = lambda status, **extra: self.client.update_job(
-            job_id, {"status": status, **extra}, worker_id=self.cfg.worker_id
-        )
         timer = PhaseTimer()
+
+        def update(status, **extra):
+            ok = self.client.update_job(
+                job_id,
+                {"status": status, **extra},
+                worker_id=self.cfg.worker_id,
+            )
+            if status not in JobStatus.TERMINAL:
+                emit_event(
+                    "job.phase",
+                    trace_id=trace_id,
+                    job_id=job_id,
+                    worker_id=self.cfg.worker_id,
+                    phase=status,
+                )
+            if status in JobStatus.TERMINAL:
+                # one observation per phase per job: the job-level
+                # latency distributions /metrics serves
+                seconds, _counters = timer.snapshot()
+                for phase, secs in seconds.items():
+                    _PHASE_SECONDS.labels(phase=phase).observe(secs)
+                _JOBS_PROCESSED.labels(outcome=status).inc()
+                emit_event(
+                    "job.worker_done",
+                    trace_id=trace_id,
+                    job_id=job_id,
+                    worker_id=self.cfg.worker_id,
+                    status=status,
+                    perf=extra.get("perf"),
+                )
+            return ok
+
         self._engine_stats_mark = None
         self._scan_perf_extra = {}
+        emit_event(
+            "job.start",
+            trace_id=trace_id,
+            job_id=job_id,
+            worker_id=self.cfg.worker_id,
+            module=job.get("module"),
+        )
 
         update(JobStatus.STARTING)
         update(JobStatus.DOWNLOADING)
@@ -211,6 +276,18 @@ class JobProcessor:
             perf["output_bytes"] = len(output)
             perf.update(self._engine_perf_delta())
             perf.update(self._scan_perf_extra)
+            rows = perf.get("rows")
+            exec_s = perf.get("execute_s")
+            import math
+
+            if (
+                isinstance(rows, (int, float))
+                and math.isfinite(rows)
+                and rows > 0
+            ):
+                _ROWS_TOTAL.inc(rows)
+                if exec_s and math.isfinite(exec_s):
+                    _ROWS_PER_SEC.set(rows / exec_s)
             update(JobStatus.COMPLETE, perf=perf)
         else:
             update(JobStatus.UPLOAD_FAILED_UNKNOWN)

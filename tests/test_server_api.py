@@ -61,6 +61,108 @@ def test_auth_required(api):
     bad = {"Authorization": "Bearer wrong"}
     assert requests.get(api.base + "/get-statuses", headers=bad).status_code == 401
     assert requests.get(api.base + "/healthz").status_code == 200
+    assert requests.get(api.base + "/metrics").status_code == 200
+
+
+def test_healthz_reports_real_liveness(api):
+    hz = requests.get(api.base + "/healthz").json()
+    assert hz["status"] == "ok"
+    assert hz["uptime_seconds"] >= 0
+    assert hz["queue_depth"] == 0
+    assert hz["jobs_by_state"] == {}
+
+    _queue_scan(api)  # 30 lines / batch 10 -> 3 queued jobs
+    api.get("/get-job", params={"worker_id": "hw"})  # one leased out
+    hz = requests.get(api.base + "/healthz").json()
+    assert hz["queue_depth"] == 2
+    assert hz["jobs_by_state"] == {"queued": 2, "in progress": 1}
+
+
+def test_metrics_exposition_covers_families(api):
+    from swarm_tpu.telemetry.metrics import parse_exposition
+
+    _queue_scan(api)
+    api.get("/get-job", params={"worker_id": "mw"})
+    resp = requests.get(api.base + "/metrics")  # unauthenticated
+    assert resp.status_code == 200
+    assert resp.headers["Content-Type"].startswith("text/plain")
+    samples = parse_exposition(resp.text)  # raises on any malformed line
+    names = {name for name, _l, _v in samples}
+    for family in (
+        "swarm_server_uptime_seconds",
+        "swarm_queue_depth",
+        "swarm_http_requests_total",
+        "swarm_http_request_seconds_bucket",
+        "swarm_http_request_seconds_sum",
+        "swarm_queue_jobs_queued_total",
+        "swarm_queue_jobs_dispatched_total",
+        "swarm_events_total",
+    ):
+        assert family in names, family
+    # queue gauges reflect THIS server's state (collector ran at scrape)
+    by_key = {}
+    for name, labels, value in samples:
+        by_key[(name, tuple(sorted(labels.items())))] = value
+    assert by_key[("swarm_queue_depth", ())] == 2
+    assert by_key[("swarm_jobs_by_state", (("status", "in progress"),))] == 1
+    # the /queue route's request counter saw our POST
+    route_counts = [
+        v for (n, labels), v in by_key.items()
+        if n == "swarm_http_requests_total"
+        and dict(labels).get("route") == "/queue"
+        and dict(labels).get("code") == "200"
+    ]
+    assert route_counts and route_counts[0] >= 1
+
+
+def test_queue_honors_trace_header(api):
+    resp = api.post(
+        "/queue",
+        json={"module": "echo", "file_content": ["t\n"], "batch_size": 1},
+        headers={**api.headers, "X-Swarm-Trace": "feedface" * 4},
+    )
+    assert resp.status_code == 200
+    jobs = api.get("/get-statuses").json()["jobs"]
+    [job] = jobs.values()
+    assert job["trace_id"] == "feedface" * 4
+    # and /get-job hands it back out to the worker
+    leased = api.get("/get-job", params={"worker_id": "tw"}).json()
+    assert leased["trace_id"] == "feedface" * 4
+
+
+def test_nonfinite_perf_does_not_poison_metrics(api):
+    """json.loads accepts Infinity/NaN; one hostile perf sample must not
+    wedge the monotonic rows counter or histogram sums forever."""
+    from swarm_tpu.telemetry import REGISTRY
+
+    _queue_scan(api, lines=1, batch=1)
+    job = api.get("/get-job", params={"worker_id": "evil"}).json()
+    r = api.post(
+        f"/update-job/{job['job_id']}",
+        data='{"status": "complete", "perf": {"rows": Infinity, '
+             '"execute_s": NaN, "download_s": 0.5}}',
+        headers={**api.headers, "Content-Type": "application/json"},
+    )
+    assert r.status_code == 200
+    snap = REGISTRY.snapshot()
+    rows_total = snap["swarm_queue_rows_processed_total"]["samples"][0]["value"]
+    assert rows_total != float("inf")
+    for s in snap["swarm_job_phase_seconds"]["samples"]:
+        assert s["value"]["sum"] == s["value"]["sum"]  # not NaN
+    # the finite phase value still landed
+    dl = [
+        s for s in snap["swarm_job_phase_seconds"]["samples"]
+        if s["labels"]["phase"] == "download"
+    ]
+    assert dl and dl[0]["value"]["count"] >= 1
+
+
+def test_queue_mints_trace_when_header_absent(api):
+    # reference clients don't send X-Swarm-Trace; the server mints one
+    # so job records always carry a usable correlation id
+    _queue_scan(api, lines=1, batch=1)
+    [job] = api.get("/get-statuses").json()["jobs"].values()
+    assert job["trace_id"] and len(job["trace_id"]) == 32
 
 
 def test_queue_and_dispatch_cycle(api):

@@ -1,0 +1,338 @@
+"""Telemetry subsystem: registry semantics, exposition format, events.
+
+The metrics plane every layer reports through (server routes, queue,
+worker phases, engine kernels) — registry correctness here, the wired
+instrumentation in test_server_api.py / test_tracing.py.
+"""
+
+import json
+import threading
+
+import pytest
+
+from swarm_tpu.telemetry import events as ev
+from swarm_tpu.telemetry.metrics import (
+    CONTENT_TYPE,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    escape_label_value,
+    parse_exposition,
+)
+from swarm_tpu.utils.trace import PhaseTimer
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_inc_and_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "reqs", ("route",))
+    c.labels(route="/a").inc()
+    c.labels(route="/a").inc(2)
+    c.labels(route="/b").inc()
+    assert c.labels(route="/a").value == 3
+    assert c.labels(route="/b").value == 1
+    with pytest.raises(ValueError):
+        c.labels(route="/a").inc(-1)  # counters never decrease
+
+
+def test_unlabeled_counter_and_gauge():
+    reg = MetricsRegistry()
+    c = reg.counter("t_jobs_total", "jobs")
+    c.inc()
+    c.inc(4)
+    g = reg.gauge("t_depth", "depth")
+    g.set(7)
+    g.inc(-2)
+    text = reg.render()
+    assert "t_jobs_total 5" in text
+    assert "t_depth 5" in text
+
+
+def test_get_or_create_same_family_and_mismatch_raises():
+    reg = MetricsRegistry()
+    a = reg.counter("t_shared_total", "x", ("k",))
+    b = reg.counter("t_shared_total", "x", ("k",))
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("t_shared_total", "x", ("k",))  # kind mismatch
+    with pytest.raises(ValueError):
+        reg.counter("t_shared_total", "x", ("other",))  # label mismatch
+
+
+def test_kind_misuse_raises():
+    reg = MetricsRegistry()
+    with pytest.raises(TypeError):
+        reg.counter("t_c_total")._unlabeled().set(1)
+    with pytest.raises(TypeError):
+        reg.gauge("t_g")._unlabeled().observe(1)
+    with pytest.raises(TypeError):
+        reg.histogram("t_h")._unlabeled().inc()
+
+
+def test_invalid_names_rejected():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.counter("9bad", "x")
+    with pytest.raises(ValueError):
+        reg.counter("ok_total", "x", ("bad-label",))
+    with pytest.raises(ValueError):
+        reg.counter("ok2_total", "x", ("__reserved",))
+
+
+def test_histogram_buckets_cumulative_sum_count():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", "lat", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h._unlabeled().observe(v)
+    text = reg.render()
+    assert 't_lat_seconds_bucket{le="0.1"} 1' in text
+    assert 't_lat_seconds_bucket{le="1"} 3' in text
+    assert 't_lat_seconds_bucket{le="10"} 4' in text
+    assert 't_lat_seconds_bucket{le="+Inf"} 5' in text
+    assert "t_lat_seconds_count 5" in text
+    assert "t_lat_seconds_sum 56.05" in text
+
+
+def test_histogram_labeled_children_independent():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_ph_seconds", "ph", ("phase",), buckets=(1.0,))
+    h.labels(phase="download").observe(0.5)
+    h.labels(phase="execute").observe(2.0)
+    snap = reg.snapshot()["t_ph_seconds"]
+    by_phase = {s["labels"]["phase"]: s["value"] for s in snap["samples"]}
+    assert by_phase["download"]["count"] == 1
+    assert by_phase["execute"]["buckets"]["1"] == 0  # over the top bucket
+
+
+def test_label_escaping_roundtrip():
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    reg = MetricsRegistry()
+    c = reg.counter("t_esc_total", "esc", ("v",))
+    hostile = 'quote:" backslash:\\ newline:\n end'
+    c.labels(v=hostile).inc()
+    text = reg.render()
+    # one logical line per sample even with a newline in the value
+    sample_lines = [l for l in text.splitlines() if l.startswith("t_esc_total{")]
+    assert len(sample_lines) == 1
+    parsed = parse_exposition(text)
+    [(name, labels, value)] = [s for s in parsed if s[0] == "t_esc_total"]
+    assert labels["v"] == hostile
+    assert value == 1
+
+
+def test_exposition_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("g_requests_total", "Total requests", ("code",))
+    c.labels(code="200").inc(2)
+    g = reg.gauge("g_depth", "Queue depth")
+    g.set(3)
+    h = reg.histogram("g_lat_seconds", "Latency", buckets=(0.5,))
+    h._unlabeled().observe(0.25)
+    assert reg.render() == (
+        "# HELP g_depth Queue depth\n"
+        "# TYPE g_depth gauge\n"
+        "g_depth 3\n"
+        "# HELP g_lat_seconds Latency\n"
+        "# TYPE g_lat_seconds histogram\n"
+        'g_lat_seconds_bucket{le="0.5"} 1\n'
+        'g_lat_seconds_bucket{le="+Inf"} 1\n'
+        "g_lat_seconds_sum 0.25\n"
+        "g_lat_seconds_count 1\n"
+        "# HELP g_requests_total Total requests\n"
+        "# TYPE g_requests_total counter\n"
+        'g_requests_total{code="200"} 2\n'
+    )
+
+
+def test_parse_exposition_rejects_malformed():
+    with pytest.raises(ValueError, match="line 1"):
+        parse_exposition("not a metric line at all!\n")
+    with pytest.raises(ValueError, match="line 2"):
+        parse_exposition("ok_total 1\nbad{unclosed 2\n")
+    with pytest.raises(ValueError):
+        parse_exposition('x{l="v"} notanumber\n')
+    with pytest.raises(ValueError):
+        parse_exposition("# TYPE x notakind\n")
+
+
+def test_collectors_run_at_render_and_errors_isolated():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_collected", "c")
+    calls = []
+
+    def ok():
+        calls.append(1)
+        g.set(len(calls))
+
+    def broken():
+        raise RuntimeError("scrape must survive this")
+
+    reg.add_collector(broken)
+    reg.add_collector(ok)
+    assert "t_collected 1" in reg.render()
+    assert "t_collected 2" in reg.render()
+    reg.remove_collector(ok)
+    reg.render()
+    assert len(calls) == 2
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+    c = reg.counter("t_mt_total", "mt", ("w",))
+    h = reg.histogram("t_mt_seconds", "mt", buckets=(0.5, 1.0))
+
+    def work(i):
+        for _ in range(500):
+            c.labels(w=str(i % 4)).inc()
+            h._unlabeled().observe(0.25)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(
+        s["value"] for s in reg.snapshot()["t_mt_total"]["samples"]
+    )
+    assert total == 8 * 500
+    assert reg.snapshot()["t_mt_seconds"]["samples"][0]["value"]["count"] == 8 * 500
+
+
+def test_content_type_constant():
+    assert CONTENT_TYPE.startswith("text/plain")
+
+
+# ---------------------------------------------------------------------------
+# Events
+# ---------------------------------------------------------------------------
+
+def test_emit_event_subscribers_and_counter():
+    seen = []
+    unsub = ev.subscribe(seen.append)
+    try:
+        rec = ev.emit_event(
+            "test.ping", trace_id="t1", job_id="j1", phase="x", skipme=None
+        )
+    finally:
+        unsub()
+    assert seen == [rec]
+    assert rec["event"] == "test.ping"
+    assert rec["trace_id"] == "t1" and rec["job_id"] == "j1"
+    assert "skipme" not in rec  # None fields dropped
+    assert "ts" in rec
+    # unsubscribed: no further delivery
+    ev.emit_event("test.ping")
+    assert len(seen) == 1
+
+
+def test_emit_event_file_sink(tmp_path, monkeypatch):
+    sink = tmp_path / "events.jsonl"
+    monkeypatch.setenv(ev.ENV_SINK, str(sink))
+    ev.emit_event("test.sink", trace_id="abc123")
+    ev.emit_event("test.sink", trace_id="abc123")
+    lines = sink.read_text().strip().splitlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[0])
+    assert rec["event"] == "test.sink" and rec["trace_id"] == "abc123"
+
+
+def test_new_trace_id_unique_and_hex():
+    a, b = ev.new_trace_id(), ev.new_trace_id()
+    assert a != b
+    assert len(a) == 32 and int(a, 16) >= 0
+
+
+def test_header_trace_id_case_insensitive():
+    assert ev.header_trace_id({"X-Swarm-Trace": "abc"}) == "abc"
+    assert ev.header_trace_id({"x-swarm-trace": "abc"}) == "abc"
+    assert ev.header_trace_id({"X-SWARM-TRACE": " abc "}) == "abc"
+    assert ev.header_trace_id({"X-Swarm-Trace": ""}) is None
+    assert ev.header_trace_id({"Other": "x"}) is None
+
+
+def test_header_trace_id_rejects_hostile_values():
+    # invalid values are dropped (caller mints a fresh id): a hostile
+    # header must not smuggle blobs/control chars into job records
+    for bad in ("x" * 65, "a b", "a\nb", 'a"b', "トレース", "a;b"):
+        assert ev.header_trace_id({"X-Swarm-Trace": bad}) is None, bad
+    assert ev.header_trace_id({"X-Swarm-Trace": "A-Z_09" }) == "A-Z_09"
+    assert ev.header_trace_id({"X-Swarm-Trace": ev.new_trace_id()})
+
+
+def test_broken_subscriber_isolated():
+    def boom(_rec):
+        raise RuntimeError("no")
+
+    seen = []
+    u1 = ev.subscribe(boom)
+    u2 = ev.subscribe(seen.append)
+    try:
+        ev.emit_event("test.iso")
+    finally:
+        u1()
+        u2()
+    assert len(seen) == 1
+
+
+# ---------------------------------------------------------------------------
+# PhaseTimer (satellite: thread safety + non-mutating snapshot)
+# ---------------------------------------------------------------------------
+
+def test_phase_timer_snapshot_does_not_mutate():
+    t = PhaseTimer()
+    with t.phase("download"):
+        pass
+    t.count("rows", 10)
+    s1, c1 = t.snapshot()
+    s1["download"] = 999.0  # mutating the copy must not leak back
+    c1["rows"] = 999
+    s2, c2 = t.snapshot()
+    assert s2["download"] < 100
+    assert c2["rows"] == 10
+    assert t.perf()["rows"] == 10
+
+
+def test_phase_timer_concurrent_ticks():
+    t = PhaseTimer()
+    stop = threading.Event()
+    errors = []
+
+    def ticker(name):
+        try:
+            while not stop.is_set():
+                with t.phase(name):
+                    pass
+                t.count("rows", 1)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def scraper():
+        try:
+            while not stop.is_set():
+                seconds, counters = t.snapshot()
+                assert all(v >= 0 for v in seconds.values())
+                t.perf()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=ticker, args=("stream",)),
+        threading.Thread(target=ticker, args=("probe",)),
+        threading.Thread(target=scraper),
+    ]
+    for th in threads:
+        th.start()
+    import time as _time
+
+    _time.sleep(0.2)
+    stop.set()
+    for th in threads:
+        th.join()
+    assert not errors
+    seconds, counters = t.snapshot()
+    assert set(seconds) == {"stream", "probe"}
+    assert counters["rows"] > 0

@@ -1,10 +1,13 @@
-"""Tracing/profiling: phase timers, perf propagation, scan rollup.
+"""Tracing/profiling: phase timers, perf propagation, scan rollup, and
+end-to-end trace-ID correlation.
 
 The reference has zero observability beyond prints + two timestamps
 (SURVEY.md §5); this framework reports per-job perf samples through the
-same status-update path and aggregates them into the scan rollup.
-"""
+same status-update path, aggregates them into the scan rollup, and
+correlates every layer's structured events under one client-minted
+trace ID (telemetry PR)."""
 
+import json
 import time
 
 from swarm_tpu.datamodel import Job, JobStatus, rollup_scans
@@ -80,6 +83,104 @@ def test_rollup_no_perf_stays_none():
     scans = rollup_scans({j.job_id: j.to_wire()})
     assert scans[0]["rows_processed"] is None
     assert scans[0]["rows_per_second"] is None
+
+
+def test_trace_id_propagates_end_to_end(tmp_path, monkeypatch):
+    """One scan, one trace ID, observed at every layer: the client's
+    submit event, the server's job record, the worker's completion
+    event — with nonzero phase histograms for the job (the acceptance
+    contract of the telemetry PR)."""
+    from swarm_tpu.client.cli import JobClient
+    from swarm_tpu.config import Config
+    from swarm_tpu.server.app import SwarmServer
+    from swarm_tpu.telemetry import REGISTRY, subscribe
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    modules_dir = tmp_path / "modules"
+    modules_dir.mkdir()
+    (modules_dir / "echo.json").write_text(
+        json.dumps({"command": "cat {input} > {output}"})
+    )
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="tracekey",
+        blob_root=str(tmp_path / "blobs"), doc_root=str(tmp_path / "docs"),
+        modules_dir=str(modules_dir),
+        poll_interval_idle_s=0.05, poll_interval_busy_s=0.01,
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    cfg.server_url = f"http://127.0.0.1:{srv.port}"
+
+    events = []
+    unsubscribe = subscribe(events.append)
+    try:
+        scan_file = tmp_path / "targets.txt"
+        scan_file.write_text("alpha\nbeta\n")
+        client = JobClient(cfg.resolve_url(), cfg.api_key)
+        code, _text = client.start_scan(str(scan_file), "echo", 0, 0)
+        assert code == 200
+        trace_id = client.last_trace_id
+        assert trace_id
+
+        wcfg = Config(**{**cfg.__dict__, "max_jobs": 1, "worker_id": "trace-w"})
+        proc = JobProcessor(wcfg)
+        proc.process_jobs()
+        assert proc.jobs_done == 1
+
+        # --- the same trace ID at all three layers ---
+        by_event = {}
+        for e in events:
+            by_event.setdefault(e["event"], []).append(e)
+        # 1. client submit event
+        [submit] = by_event["scan.submit"]
+        assert submit["trace_id"] == trace_id
+        # 2. server job record (via the status API, like any operator)
+        statuses = client.get_statuses()
+        [job] = statuses["jobs"].values()
+        assert job["trace_id"] == trace_id
+        assert job["status"] == "complete"
+        # 3. worker completion event, with the perf sample attached
+        done = [
+            e for e in by_event["job.worker_done"]
+            if e["trace_id"] == trace_id and e["status"] == "complete"
+        ]
+        assert done and done[0]["job_id"] == job["job_id"]
+        assert done[0]["perf"]["download_s"] >= 0
+        # server-side terminal event carries it too
+        assert any(
+            e["trace_id"] == trace_id and e["status"] == "complete"
+            for e in by_event["job.terminal"]
+        )
+        # queue-side lifecycle events under the same trace
+        assert any(e["trace_id"] == trace_id for e in by_event["job.queued"])
+        assert any(e["trace_id"] == trace_id for e in by_event["job.dispatch"])
+
+        # --- nonzero phase histograms for that job on /metrics ---
+        import requests as _requests
+
+        text = _requests.get(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ).text
+        from swarm_tpu.telemetry.metrics import parse_exposition
+
+        samples = {
+            (name, tuple(sorted(labels.items()))): value
+            for name, labels, value in parse_exposition(text)
+        }
+        for family in ("swarm_worker_phase_seconds", "swarm_job_phase_seconds"):
+            for phase in ("download", "execute", "upload"):
+                key = (f"{family}_count", (("phase", phase),))
+                assert samples.get(key, 0) >= 1, (family, phase)
+        # worker outcome counter saw the completion
+        assert (
+            samples[("swarm_worker_jobs_total", (("outcome", "complete"),))] >= 1
+        )
+        # registry snapshot agrees (what `swarm metrics` renders)
+        snap = REGISTRY.snapshot()
+        assert snap["swarm_worker_phase_seconds"]["type"] == "histogram"
+    finally:
+        unsubscribe()
+        srv.shutdown()
 
 
 def test_compilation_cache_enable(tmp_path, monkeypatch):

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Preflight /metrics validator: boot an in-process server, exercise a
+tiny scan lifecycle, scrape GET /metrics, and fail on any malformed
+exposition line (strict parse via telemetry.metrics.parse_exposition).
+
+Run by tools/preflight.sh; exits nonzero on:
+- /metrics unreachable or non-200
+- any line that is not valid Prometheus text format 0.0.4
+- a missing core metric family (server/queue/event planes)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REQUIRED_FAMILIES = (
+    "swarm_server_uptime_seconds",
+    "swarm_queue_depth",
+    "swarm_jobs_by_state",
+    "swarm_http_requests_total",
+    "swarm_http_request_seconds",
+    "swarm_queue_jobs_queued_total",
+    "swarm_queue_jobs_dispatched_total",
+    "swarm_events_total",
+)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import requests
+
+    from swarm_tpu.config import Config
+    from swarm_tpu.server.app import SwarmServer
+    from swarm_tpu.telemetry.metrics import parse_exposition
+
+    tmp = tempfile.mkdtemp(prefix="swarm_metrics_check_")
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="preflight",
+        blob_root=os.path.join(tmp, "blobs"),
+        doc_root=os.path.join(tmp, "docs"),
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    auth = {"Authorization": "Bearer preflight"}
+    try:
+        # drive one tiny lifecycle so route/queue/job families populate
+        r = requests.post(
+            base + "/queue",
+            json={"module": "echo", "file_content": ["t\n"], "batch_size": 1},
+            headers={**auth, "X-Swarm-Trace": "preflighttrace"},
+            timeout=10,
+        )
+        if r.status_code != 200:
+            print(f"FAIL: /queue returned {r.status_code}", file=sys.stderr)
+            return 1
+        requests.get(
+            base + "/get-job", params={"worker_id": "pf"}, headers=auth,
+            timeout=10,
+        )
+        hz = requests.get(base + "/healthz", timeout=10).json()
+        for key in ("status", "uptime_seconds", "queue_depth", "jobs_by_state"):
+            if key not in hz:
+                print(f"FAIL: /healthz missing {key!r}: {hz}", file=sys.stderr)
+                return 1
+
+        resp = requests.get(base + "/metrics", timeout=10)
+        if resp.status_code != 200:
+            print(f"FAIL: /metrics returned {resp.status_code}", file=sys.stderr)
+            return 1
+        ctype = resp.headers.get("Content-Type", "")
+        if not ctype.startswith("text/plain"):
+            print(f"FAIL: /metrics content-type {ctype!r}", file=sys.stderr)
+            return 1
+        try:
+            samples = parse_exposition(resp.text)
+        except ValueError as e:
+            print(f"FAIL: malformed exposition: {e}", file=sys.stderr)
+            return 1
+        names = {name for name, _labels, _v in samples}
+        base_names = {n.rsplit("_bucket", 1)[0] for n in names} | {
+            n[: -len(suffix)]
+            for n in names
+            for suffix in ("_sum", "_count")
+            if n.endswith(suffix)
+        } | names
+        missing = [f for f in REQUIRED_FAMILIES if f not in base_names]
+        if missing:
+            print(f"FAIL: missing metric families: {missing}", file=sys.stderr)
+            return 1
+        print(
+            f"metrics check OK: {len(samples)} well-formed samples, "
+            f"{len(names)} series"
+        )
+        return 0
+    finally:
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

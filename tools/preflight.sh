@@ -11,6 +11,11 @@ cd "$(dirname "$0")/.."
 echo "== preflight: pytest =="
 python -m pytest tests/ -q
 
+echo "== preflight: metrics exposition =="
+# boots an in-process server, scrapes /metrics, fails on any malformed
+# line or missing core family (telemetry PR contract)
+python tools/check_metrics.py
+
 echo "== preflight: bench =="
 if [ "$1" = "--quick" ]; then
     python bench.py --phase exact
