@@ -897,6 +897,50 @@ extern "C" int sw_memo_contains(void* mp, PyObject* row) {
   return id >= 0 ? 1 : 0;
 }
 
+// Batched side-effect-free probe: out[i] = 1 iff rows[i]'s content is
+// resident. One call per chunk instead of one ctypes round-trip per
+// row — the scheduler's memo-split classification runs at steady-state
+// feed rates, where per-call marshalling dominated the probe itself.
+// Rows with a falsy ``alive`` probe as not-resident (the scheduler
+// never routes dead rows to the memo). Returns n, or -1 on error.
+extern "C" int64_t sw_memo_contains_batch(void* mp, PyObject* rows,
+                                          uint8_t* out) {
+  Memo* m = static_cast<Memo*>(mp);
+  if (!PyList_Check(rows)) return -1;
+  static PyObject* alive_name = PyUnicode_InternFromString("alive");
+  Py_ssize_t n = PyList_GET_SIZE(rows);
+  HeldRefs held;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* row = PyList_GET_ITEM(rows, i);
+    // dead rows probe as not-resident: their (empty) content may
+    // genuinely be cached from an alive row, but a dead row must
+    // resolve to zero verdicts, never a memo entry — same contract
+    // as sw_memo_lookup's state -2 path
+    {
+      PyObject** dp = _PyObject_GetDictPtr(row);
+      PyObject* dict = dp != nullptr ? *dp : nullptr;
+      int dec = 0;
+      PyObject* a = fast_attr(row, dict, alive_name, &dec);
+      if (a == nullptr) return -1;
+      int truthy =
+          a == Py_True ? 1 : (a == Py_False ? 0 : PyObject_IsTrue(a));
+      if (dec) Py_DECREF(a);
+      if (truthy < 0) return -1;
+      if (!truthy) {
+        out[i] = 0;
+        continue;
+      }
+    }
+    RowView v;
+    if (row_view(row, &v, &held) != 0) return -1;
+    int err = 0;
+    int64_t id = memo_find(m, v, &err);
+    if (err) return -1;
+    out[i] = id >= 0 ? 1 : 0;
+  }
+  return int64_t(n);
+}
+
 // Insert (or overwrite) one fully-resolved row's verdict. bits_row is
 // memo->nb bytes; extras is the engine's per-content extras object
 // (Py_None stores as "no extras"). Evicts the LRU tail at capacity.
@@ -1171,11 +1215,15 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
         Py_INCREF(e.extras);
         extra_rows.emplace_back(i, e.extras);
       }
-      // Refresh the LRU position only when the entry's last refresh
-      // is ≥8 calls old: with capacity far above the live set the
-      // eviction order below batch granularity is irrelevant, and the
-      // unlink/push is the pass's only random-memory pointer chase.
-      if (m->epoch - e.epoch >= 8) {
+      // Refresh the LRU position once per lookup CALL (epoch
+      // granularity): an entry hit k times within one batch pays the
+      // random-memory unlink/push pointer chase once, not k times —
+      // recency below call granularity can't change eviction order,
+      // since eviction only happens in later calls. But every hot
+      // lookup in a LATER call MUST refresh: a coarser cadence (the
+      // old >=8-call lag) let inserts evict entries that were served
+      // within the lag window (test_memo_lru_eviction_and_overwrite).
+      if (e.epoch != m->epoch) {
         e.epoch = m->epoch;
         memo_lru_unlink(m, id);
         memo_lru_push_front(m, id);

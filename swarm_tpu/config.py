@@ -83,6 +83,11 @@ class Config:
     engine_batch_rows: int = 2048
     engine_row_width: int = 1024
     mesh_data_axis: int = 0  # 0 = all available devices on the data axis
+    # continuous-batching scheduler (swarm_tpu/sched, docs/PIPELINE.md):
+    # "on" routes device-batch execution through prefetch + padding
+    # buckets + bounded in-flight submission; "off" keeps the direct
+    # path. Env: SWARM_PIPELINE. Results are bit-identical either way.
+    pipeline: str = "off"
 
     def resolve_url(self) -> str:
         return self.server_url.rstrip("/")

@@ -140,6 +140,8 @@ def ensure_fastpack() -> ctypes.PyDLL:
     lib.sw_memo_len.restype = ctypes.c_int64
     lib.sw_memo_contains.argtypes = [vp, ctypes.py_object]
     lib.sw_memo_contains.restype = ctypes.c_int
+    lib.sw_memo_contains_batch.argtypes = [vp, ctypes.py_object, u8p]
+    lib.sw_memo_contains_batch.restype = ctypes.c_int64
     lib.sw_memo_insert.argtypes = [vp, ctypes.py_object, u8p, ctypes.py_object]
     lib.sw_memo_insert.restype = ctypes.c_int
     lib.sw_memo_insert_batch.argtypes = [
@@ -355,6 +357,17 @@ class VerdictMemo:
         if n < 0:
             raise TypeError("memo batch insert failed")
         return int(n)
+
+    def contains_batch(self, rows: list) -> np.ndarray:
+        """uint8 mask: ``mask[i]`` nonzero iff ``rows[i]``'s content is
+        resident — one native call for the whole chunk, no LRU side
+        effects (the scheduler's plan-time memo split)."""
+        mask = np.zeros(max(len(rows), 1), dtype=np.uint8)
+        if rows:
+            rc = self._lib.sw_memo_contains_batch(self._h, rows, mask)
+            if rc < 0:
+                raise TypeError("rows must be Response objects")
+        return mask[: len(rows)]
 
     def contains(self, row) -> bool:
         rc = self._lib.sw_memo_contains(self._h, row)

@@ -35,7 +35,7 @@ import numpy as np
 from swarm_tpu.fingerprints.compile import CompiledDB, compile_corpus
 from swarm_tpu.fingerprints.model import Response, Template
 from swarm_tpu.ops import cpu_ref, fastre
-from swarm_tpu.ops.encoding import encode_batch, round_up
+from swarm_tpu.ops.encoding import _RotatingPool, encode_batch, round_up
 from swarm_tpu.ops.match import DeviceDB
 
 
@@ -61,6 +61,13 @@ class PackedMatches:
     maps the column index to ids. ``extractions`` is sparse:
     ``(row, template_id) -> list[str]``. ``host_always_matches`` lists
     (row, template_id) hits from the host-only tail, if any.
+
+    Buffer lifetime: when the batch was encoded with
+    ``reuse_buffers=True`` (the pipelined feed), ``bits`` ALIASES a
+    recycled per-shape plane that is overwritten 8 same-shape encodes
+    later — consume (or ``.copy()``) it before encoding that many
+    further batches. The default allocating encode path hands back a
+    plane the caller owns indefinitely.
     """
 
     bits: np.ndarray  # uint8 [B, ceil(NT/8)]
@@ -207,6 +214,7 @@ class MatchEngine:
         host_always: str = "full",  # "full" (exact) | "skip" (device-only)
         mesh="auto",  # "auto" | None | jax.sharding.Mesh
         db: Optional[CompiledDB] = None,  # precompiled (fingerprints/dbcache)
+        pipeline: Optional[str] = None,  # "on" | "off" | None → SWARM_PIPELINE
     ):
         self.templates = list(templates)
         self.db = db if db is not None else compile_corpus(self.templates)
@@ -216,6 +224,18 @@ class MatchEngine:
         self.batch_rows = batch_rows
         self.host_always_mode = host_always
         self.stats = EngineStats()
+        # continuous-batching scheduler flag (swarm_tpu/sched): "on"
+        # routes bulk :meth:`match` calls through the prefetch/bucket/
+        # backpressure pipeline; None defers to SWARM_PIPELINE
+        # (default off so existing callers keep the direct path)
+        if pipeline is None:
+            import os as _os
+
+            pipeline = _os.environ.get("SWARM_PIPELINE", "off")
+        self.pipeline = (
+            "on" if str(pipeline).lower() in ("on", "1", "true") else "off"
+        )
+        self._sched = None  # lazy BatchScheduler (pipeline="on")
         # Multi-chip: shard each batch dp×tp×sp across the local mesh
         # (the production analog of the reference's chunk-per-worker
         # scale-out, server/server.py:465-515 — here one worker drives a
@@ -358,8 +378,11 @@ class MatchEngine:
         # the no-toolchain fallback.
         self._vmemo = None
         self._native_memo_ok = None
-        self._bits_ring: list = []  # rotating verdict planes (see
-        self._bits_ring_i = 0       # _encode_native reuse_buffers)
+        # recycled verdict planes for reuse_buffers encodes, keyed PER
+        # SHAPE (see _encode_native): alternating batch shapes (bucket
+        # scheduler, partial final chunks) each keep their own depth-8
+        # rotation instead of re-allocating 8 planes on every change
+        self._bits_pool = _RotatingPool(depth=8)
         # ROW-dependent templates: verdicts/extractions that read
         # beyond the response content (host/port/duration dsl vars,
         # part "host") — e.g. the takeover family's
@@ -909,12 +932,29 @@ class MatchEngine:
         return want_all
 
     # ------------------------------------------------------------------
+    def scheduler(self):
+        """This engine's continuous-batching scheduler (lazily built;
+        exists regardless of the ``pipeline`` flag so callers can drive
+        it explicitly for A/B runs)."""
+        if self._sched is None:
+            from swarm_tpu.sched import BatchScheduler
+
+            self._sched = BatchScheduler(self)
+        return self._sched
+
     def match(self, responses: Sequence[Response]) -> list[RowMatches]:
         """Per-row exact match sets (compat/active-scanner form).
 
         Built from the packed path; per-row object assembly makes this
         the slower surface — bulk pipelines use :meth:`match_packed`.
+        With ``pipeline="on"`` multi-row calls route through the
+        continuous-batching scheduler (swarm_tpu/sched): memo-known
+        rows short-circuit out of device batches, fresh rows are
+        re-binned into padding buckets, encode/dispatch/walk overlap —
+        results are bit-identical either way (tests/test_sched.py).
         """
+        if self.pipeline == "on" and len(responses) > 1:
+            return self.scheduler().match_rows(responses)
         # dead rows match nothing by contract; filtering them BEFORE
         # chunking keeps the pipelined pre-encode effective (a chunk
         # with any dead row would force match_packed to discard the
@@ -929,36 +969,65 @@ class MatchEngine:
                 for r in responses
             ]
         out: list[RowMatches] = []
-        NT = self.db.num_templates
         chunks = [
             responses[s : s + self.batch_rows]
             for s in range(0, len(responses), self.batch_rows)
         ]
         for rows, pre in self._iter_encoded(chunks):
             packed = self.match_packed(rows, pre=pre)
-            per_row_conf = packed.confirms_per_row
-            # group sparse side-tables by row ONCE — per-row scans of
-            # the whole extraction dict would be quadratic in fleet
-            # batches where extractor templates fire on most rows
-            extr_by_row: dict = {}
-            for (rb, tid), ext in packed.extractions.items():
-                extr_by_row.setdefault(rb, {})[tid] = ext
-            always_by_row: dict = {}
-            for rb, tid in packed.host_always_matches:
-                always_by_row.setdefault(rb, []).append(tid)
-            for b in range(len(rows)):
-                tids = [
-                    self.db.template_ids[t]
+            out.extend(self.rowmatches_from_packed(packed, len(rows)))
+        return out
+
+    def rowmatches_from_packed(self, packed: PackedMatches, n: int) -> list:
+        """Per-row RowMatches assembly from one PackedMatches — THE
+        single assembly used by both :meth:`match` and the scheduler
+        (swarm_tpu/sched), so the pipelined path can never drift from
+        the direct one. Per row: template ids ascending by template
+        index, then the host-always tail.
+
+        The verdict-plane scan runs as ONE native pass over the whole
+        batch when the C lib is present — a per-row np.unpackbits costs
+        more than the typical row's entire hit set at steady-state feed
+        rates. Sparse side-tables are grouped by row ONCE (per-row
+        scans of the whole extraction dict would be quadratic in fleet
+        batches where extractor templates fire on most rows)."""
+        NT = self.db.num_templates
+        conf = packed.confirms_per_row
+        extr_by_row: dict = {}
+        for (rb, tid), ext in packed.extractions.items():
+            extr_by_row.setdefault(rb, {})[tid] = ext
+        always_by_row: dict = {}
+        for rb, tid in packed.host_always_matches:
+            always_by_row.setdefault(rb, []).append(tid)
+        tid_names = self.db.template_ids
+        tids_by_row: dict = {}
+        if n and self._use_native_memo():
+            from swarm_tpu.native.scanio import plane_bits
+
+            rs, ts = plane_bits(
+                np.ascontiguousarray(packed.bits[:n]), NT
+            )
+            for r, t in zip(rs.tolist(), ts.tolist()):
+                tids_by_row.setdefault(r, []).append(tid_names[t])
+        else:
+            for b in range(n):
+                hit = [
+                    tid_names[t]
                     for t in _iter_set_bits(packed.bits[b], NT)
                 ]
-                tids.extend(always_by_row.get(b, ()))
-                out.append(
-                    RowMatches(
-                        template_ids=tids,
-                        extractions=extr_by_row.get(b, {}),
-                        confirmed_on_host=per_row_conf.get(b, 0),
-                    )
+                if hit:
+                    tids_by_row[b] = hit
+        out = []
+        for b in range(n):
+            tids = tids_by_row.get(b, [])
+            tids.extend(always_by_row.get(b, ()))
+            out.append(
+                RowMatches(
+                    template_ids=tids,
+                    extractions=extr_by_row.get(b, {}),
+                    confirmed_on_host=conf.get(b, 0),
                 )
+            )
         return out
 
     # ------------------------------------------------------------------
@@ -1099,19 +1168,15 @@ class MatchEngine:
         if reuse_buffers:
             # A fresh ~1 MB np.empty per batch lands on mmap'd pages
             # whose first-touch faults cost more than the lookup pass
-            # itself — rotate a ring instead. Ring depth 8 honors the
-            # documented recycled-pool contract (each batch consumed
-            # within a couple of further encodes; PackedMatches.bits
-            # aliases the ring, so callers holding many results copy).
-            shape = (len(rows), nbits)
-            ring = self._bits_ring
-            if not ring or ring[0].shape != shape:
-                ring = self._bits_ring = [
-                    np.empty(shape, dtype=np.uint8) for _ in range(8)
-                ]
-                self._bits_ring_i = 0
-            bits = ring[self._bits_ring_i]
-            self._bits_ring_i = (self._bits_ring_i + 1) % len(ring)
+            # itself — draw from the per-shape rotating pool instead.
+            # Depth 8 honors the documented recycled-plane contract
+            # (each batch consumed within a few further encodes;
+            # PackedMatches.bits aliases the pool, so callers holding
+            # many results copy). Keying per shape means the bucketed
+            # scheduler's alternating batch shapes — and the partial
+            # final chunk — each rotate their own ring instead of
+            # re-allocating all 8 planes on every shape change.
+            bits = self._bits_pool.get(len(rows), nbits, "vbits")
         else:
             bits = np.empty((len(rows), nbits), dtype=np.uint8)
         state, miss_uniq, extr_known, deferred_known = (
@@ -1174,9 +1239,14 @@ class MatchEngine:
 
 
     # ------------------------------------------------------------------
-    def _walk_plane(self, nrows, batch, matcher):
+    def _walk_plane(self, nrows, batch, matcher, pending=None):
         """Device dispatch + sparse host resolution over DISTINCT new
         response contents (the unique content plane).
+
+        ``pending`` is an already-launched device computation from
+        :meth:`begin_packed` (DeviceDB.dispatch): the walk then only
+        pays the blocking host read, and the kernel ran while the
+        caller walked a previous batch.
 
         Returns ``(pt_value, uextractions, deferred, redo_pos,
         confirms)``: the final content-side verdict bits ``[B, nb]``
@@ -1189,11 +1259,16 @@ class MatchEngine:
         db = self.db
         B = len(nrows)
         t0 = time.perf_counter()
-        pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
-            matcher.match(
-                batch.streams, batch.lengths, batch.status, full=True
+        if pending is not None:
+            pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
+                matcher.collect(pending)
             )
-        )
+        else:
+            pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
+                matcher.match(
+                    batch.streams, batch.lengths, batch.status, full=True
+                )
+            )
         # slice off bucket/mesh row padding before the host walk.
         # np.array(order="C"): ALWAYS a writable copy (the row-redo
         # pass writes rowbits back) AND row-major — XLA may hand back
@@ -1514,6 +1589,42 @@ class MatchEngine:
         )
 
     # ------------------------------------------------------------------
+    def begin_packed(self, all_rows: Sequence[Response], pre=None):
+        """Start a batch WITHOUT blocking on the device: encode (or
+        adopt ``pre``, an :meth:`encode_packed` result for the same
+        rows) and launch the device kernel asynchronously. Returns an
+        opaque in-flight handle for :meth:`finish_packed`.
+
+        This is the continuous-batching scheduler's submission surface:
+        with bounded in-flight handles the device crunches batch i+1
+        while the host walks batch i. The split is only effective on
+        the native-memo single-device path (DeviceDB.dispatch); other
+        configurations defer all work to finish time — same results,
+        no overlap."""
+        if pre is None and self._use_native_memo():
+            pre = self._encode_for_backend(all_rows)
+        if pre is None or pre[0] != "native":
+            return ("deferred", all_rows, pre, None)
+        batch, matcher = pre[1], pre[2]
+        pending = None
+        if batch is not None and hasattr(matcher, "dispatch"):
+            t0 = time.perf_counter()
+            pending = matcher.dispatch(
+                batch.streams, batch.lengths, batch.status
+            )
+            self.stats.device_seconds += time.perf_counter() - t0
+        return ("native", all_rows, pre, pending)
+
+    def finish_packed(self, handle) -> PackedMatches:
+        """Complete a :meth:`begin_packed` batch: block on the device
+        read, run the sparse host walk, assemble exact verdicts —
+        bit-identical to :meth:`match_packed` on the same rows."""
+        tag, rows, pre, pending = handle
+        if tag == "deferred":
+            return self.match_packed(rows, pre=pre)
+        return self._match_packed_native(rows, pre, pending=pending)
+
+    # ------------------------------------------------------------------
     def match_packed(
         self, all_rows: Sequence[Response], pre=None
     ) -> PackedMatches:
@@ -1730,7 +1841,7 @@ class MatchEngine:
         )
 
     # ------------------------------------------------------------------
-    def _match_packed_native(self, rows, enc) -> PackedMatches:
+    def _match_packed_native(self, rows, enc, pending=None) -> PackedMatches:
         """Assembly for the C-memo encode path (:meth:`_encode_native`).
 
         Known rows arrived with their packed verdicts already fanned
@@ -1755,7 +1866,7 @@ class MatchEngine:
             nrows = [rows[i] for i in miss_uniq]
             B = len(nrows)
             pt_value, uext, deferred, redo_pos, confirms = (
-                self._walk_plane(nrows, batch, matcher)
+                self._walk_plane(nrows, batch, matcher, pending=pending)
             )
             t1 = time.perf_counter()
             self.stats.memo_slots += int((state == -1).sum())
@@ -1906,6 +2017,24 @@ class MatchEngine:
         if self._vmemo is not None:
             return self._vmemo.contains(row)
         return _content_key(row) in self._verdict_memo
+
+    def memo_known_mask(self, rows: list) -> np.ndarray:
+        """uint8 residency mask over ``rows`` (no LRU side effects) —
+        ONE native pass when the C memo drives the packed path, else
+        the dict probe. The scheduler's plan-time memo split runs at
+        feed rates, where a per-row ctypes round trip dominated the
+        probe itself."""
+        if self._vmemo is not None:
+            return self._vmemo.contains_batch(rows)
+        memo = self._verdict_memo
+        # alive gate mirrors the native pass: a dead row's (empty)
+        # content may genuinely be resident from an alive row, but a
+        # dead row must resolve to zero verdicts, never a memo entry
+        return np.fromiter(
+            (r.alive and _content_key(r) in memo for r in rows),
+            dtype=np.uint8,
+            count=len(rows),
+        )
 
     def clear_content_memos(self) -> None:
         """Drop every cross-batch content memo (bench fresh-content

@@ -141,6 +141,18 @@ class DeviceDB:
         materialized as HOST numpy views of one fused device read
         (split_fused).
         """
+        out = self.dispatch(streams, lengths, status, full=full)
+        if full:
+            return self.collect(out)
+        return out
+
+    def dispatch(self, streams: dict, lengths: dict, status, full: bool = True):
+        """Async half of :meth:`match`: launch the jitted kernel and
+        return the (device-resident, still-computing) fused output
+        WITHOUT a host transfer. JAX dispatch is asynchronous, so the
+        kernel crunches while the caller does other host work — the
+        continuous-batching scheduler dispatches batch i+1 here before
+        walking batch i's verdicts. :meth:`collect` finalizes."""
         shape_key = (
             tuple(sorted((k, v.shape) for k, v in streams.items())),
             full,
@@ -163,14 +175,16 @@ class DeviceDB:
             else:
                 fn = jax.jit(impl)
             lru_store(self._fn_cache, shape_key, fn, self.MAX_COMPILED)
-        out = fn(
+        return fn(
             {k: jnp.asarray(v) for k, v in streams.items()},
             {k: jnp.asarray(v) for k, v in lengths.items()},
             jnp.asarray(status),
         )
-        if full:
-            return split_fused(self.db, np.asarray(out))
-        return out
+
+    def collect(self, out):
+        """Blocking half of the full-mode split: one host read of the
+        fused plane array, sliced into the engine's six outputs."""
+        return split_fused(self.db, np.asarray(out))
 
 
 def _lower_stream(arr):

@@ -1,0 +1,177 @@
+"""Padding-bucket batch planner for the continuous-batching scheduler.
+
+XLA compiles one executable per distinct batch shape, and every row in
+a batch pays the batch's padded width. A chunk-shaped feed therefore
+leaks time two ways: a single long row drags every short row up to the
+cap width, and partial final chunks ship mostly-padding batches. The
+planner re-bins incoming rows into a SMALL FIXED set of
+(rows × max-stream-length) shapes:
+
+- width classes are multiples of ``width_multiple`` (512 → 1024 →
+  1536 → …, capped at the engine's stream caps), keyed by the row's
+  body/banner length and header length — the same rounding as
+  ``encoding._width_for``, so a bucket's encoded width IS its class
+  and each bucket pins exactly one compiled shape;
+- a bucket flushes when it reaches ``rows_target`` rows (a full,
+  width-homogeneous device batch) or at end of stream (the partial
+  final flush, which pays padding only once per bucket per scan
+  instead of once per chunk);
+- memo-known rows never enter width buckets at all — their content
+  won't ride the device, so they queue in arrival order and flush as
+  lookup-only batches (``kind="memo"``).
+
+The encode path draws its matrices from ``encoding._RotatingPool``
+keyed per (rows, width, role) — each bucket shape rotates its own
+recycled buffers, so alternating buckets never re-fault fresh pages.
+
+Shape budget: ``DeviceDB.MAX_COMPILED`` (8 by default) bounds the jit
+cache. The class ladder admits ``max_body/512`` body classes, but a
+real scan mix keeps a handful live — and crucially no MORE shapes than
+the direct per-chunk path, whose per-batch max lands on the same
+512-multiple ladder unpredictably; the planner makes each live shape
+deterministic and reused. Bucket labels are ``w<body>h<header>`` and
+surface in the scheduler's telemetry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional
+
+
+def width_class(n: int, multiple: int = 512, cap: int = 4096) -> int:
+    """Smallest multiple of ``multiple`` that holds ``n`` bytes, capped
+    at ``cap`` (rows past the cap truncate on device and host-redo
+    exactly — same contract as encoding). EXACTLY mirrors
+    ``encoding._width_for`` for a batch whose longest row is ``n``:
+    every row in a bucket has length ≤ the class and > class-multiple
+    (for the batch max), so the encoded width IS the class — the
+    planned bucket pins the compiled shape. (A coarser ladder, e.g.
+    powers of two, would NOT pin it: a w2048 bucket whose batch max
+    happened to be 1100 would encode at 1536 and leak extra jit
+    shapes.)"""
+    if n <= multiple:
+        return multiple
+    w = ((n + multiple - 1) // multiple) * multiple
+    return min(w, cap)
+
+
+@dataclasses.dataclass
+class PlannedBatch:
+    """One scheduler submission: rows + their global ids, in arrival
+    order within the batch."""
+
+    # scheduler-global row ids aligned with rows, ascending; a range
+    # marks a whole-chunk batch (the speculative steady-state path —
+    # the scheduler then adopts results per chunk with no per-row
+    # bookkeeping)
+    ids: object  # list[int] | range
+    rows: list
+    bucket: str  # "w<body>h<header>" | "memo"
+    kind: str  # "fresh" | "memo"
+    final: bool = False  # end-of-stream partial flush
+
+    @property
+    def fill_rows(self) -> float:
+        """Row occupancy of the padded device batch this will become
+        (the engine pads unique rows up to a 256 multiple)."""
+        n = len(self.rows)
+        padded = max(256, ((n + 255) // 256) * 256)
+        return n / padded
+
+
+class BucketPlanner:
+    """Stateful binner: ``add_fresh``/``add_known`` return a full
+    :class:`PlannedBatch` when a bucket fills; ``flush_all`` drains the
+    partial tails. Buckets accumulate ACROSS chunk boundaries — that is
+    the continuous-batching part; the scheduler re-associates results
+    with chunks afterwards."""
+
+    def __init__(
+        self,
+        rows_target: int = 1024,
+        width_multiple: int = 512,
+        max_body: int = 4096,
+        max_header: int = 1024,
+    ):
+        self.rows_target = max(1, int(rows_target))
+        self.width_multiple = width_multiple
+        self.max_body = max_body
+        self.max_header = max_header
+        self._fresh: dict = {}  # (wb, wh) -> [ids, rows]
+        self._memo_ids: list = []
+        self._memo_rows: list = []
+
+    # ------------------------------------------------------------------
+    def bucket_of(self, row) -> tuple:
+        """(body width class, header width class) — in lockstep with
+        ``encoding.encode_batch`` part semantics ("body" is the banner
+        when one is set)."""
+        blob = row.body if row.banner is None else row.banner
+        wb = width_class(len(blob), self.width_multiple, self.max_body)
+        wh = width_class(
+            len(row.header), self.width_multiple, self.max_header
+        )
+        return wb, wh
+
+    # ------------------------------------------------------------------
+    def add_fresh(self, gid: int, row) -> Optional[PlannedBatch]:
+        key = self.bucket_of(row)
+        slot = self._fresh.get(key)
+        if slot is None:
+            slot = self._fresh[key] = ([], [])
+        slot[0].append(gid)
+        slot[1].append(row)
+        if len(slot[0]) >= self.rows_target:
+            del self._fresh[key]
+            return PlannedBatch(
+                ids=slot[0], rows=slot[1],
+                bucket=f"w{key[0]}h{key[1]}", kind="fresh",
+            )
+        return None
+
+    def add_known(self, gid: int, row) -> Optional[PlannedBatch]:
+        self._memo_ids.append(gid)
+        self._memo_rows.append(row)
+        if len(self._memo_ids) >= self.rows_target:
+            out = PlannedBatch(
+                ids=self._memo_ids, rows=self._memo_rows,
+                bucket="memo", kind="memo",
+            )
+            self._memo_ids, self._memo_rows = [], []
+            return out
+        return None
+
+    # ------------------------------------------------------------------
+    def flush_all(self) -> Iterator[PlannedBatch]:
+        """Drain every partial bucket (end of stream). Fresh tails
+        flush largest-first so the widest compiled shape warms before
+        narrower ones reuse its row-pad class."""
+        for key in sorted(self._fresh, reverse=True):
+            ids, rows = self._fresh.pop(key)
+            yield PlannedBatch(
+                ids=ids, rows=rows,
+                bucket=f"w{key[0]}h{key[1]}", kind="fresh", final=True,
+            )
+        if self._memo_ids:
+            yield PlannedBatch(
+                ids=self._memo_ids, rows=self._memo_rows,
+                bucket="memo", kind="memo", final=True,
+            )
+            self._memo_ids, self._memo_rows = [], []
+
+    # ------------------------------------------------------------------
+    def occupancy(self) -> dict:
+        """bucket label -> rows currently pending (telemetry gauge)."""
+        out = {
+            f"w{k[0]}h{k[1]}": len(v[0]) for k, v in self._fresh.items()
+        }
+        if self._memo_ids:
+            out["memo"] = len(self._memo_ids)
+        return out
+
+    @property
+    def pending_rows(self) -> int:
+        return sum(len(v[0]) for v in self._fresh.values()) + len(
+            self._memo_ids
+        )

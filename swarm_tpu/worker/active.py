@@ -1138,6 +1138,14 @@ class ActiveScanner:
         # keep every matched response body alive in the hit list
         for h in unique:
             h.row = None
+        # wave-loop batching mode: with pipeline="on" every _attribute
+        # device pass above rode the continuous-batching scheduler
+        # (memo short-circuit + padding buckets + bounded in-flight) —
+        # surface its feed-health counters next to the probe stats
+        stats["pipeline"] = getattr(self.engine, "pipeline", "off")
+        sched = getattr(self.engine, "_sched", None)
+        if sched is not None:
+            stats["sched"] = sched.stats.snapshot()
         return unique, stats
 
     def close(self) -> None:

@@ -249,17 +249,74 @@ def _qualifier_before(text: str, pos: int) -> Optional[str]:
     return qm.group(1) if qm else None
 
 
-def _aliases_of(text: str, g: str) -> set:
-    """Local identifiers the script assigns TO global ``g`` (UMD shape
-    ``!function(e){e.VERSION="3.8.0"; window.Reveal = e}({})``): a
-    ``VERSION`` literal qualified by such an alias belongs to ``g``
-    itself, not to another library in the bundle."""
+def _matched_brace_pairs(text: str) -> tuple:
+    """``(starts_sorted, ends_sorted, pairs)`` of the matched ``{...}``
+    blocks — the best-effort block structure behind module-window
+    scoping. ``pairs`` is the raw ``(start, end)`` list; the sorted
+    twins answer depth queries via bisect. Braces inside string/regex
+    literals can unbalance the scan; the consumers below all fail OPEN
+    (toward the pre-scoping whole-script behavior) in that case."""
+    stack: list = []
+    pairs: list = []
+    for i, ch in enumerate(text):
+        if ch == "{":
+            stack.append(i)
+        elif ch == "}" and stack:
+            pairs.append((stack.pop(), i + 1))
+    starts = sorted(s for s, _e in pairs)
+    ends = sorted(e for _s, e in pairs)
+    return starts, ends, pairs
+
+
+def _module_window(text: str, pos: int, structure=None) -> tuple:
+    """``(lo, hi)`` bounds of the OUTERMOST balanced ``{...}`` block
+    containing ``pos`` — the enclosing module/factory body in a
+    concatenated UMD bundle. Identifiers (factory params like the
+    ubiquitous minified ``e``) are only meaningful inside their own
+    factory, so alias resolution must not cross this boundary.
+    Outermost, not innermost: the export assignment is routinely
+    wrapped in a guard (``if(typeof window!=="undefined"){window.X=e}``)
+    whose inner block would exclude the rest of the factory body —
+    sibling factories in a concatenated bundle are still separate
+    top-level blocks either way. When ``pos`` sits at top level (or
+    the brace scan is unbalanced) the whole script is the window."""
+    _starts, _ends, pairs = (
+        structure if structure is not None else _matched_brace_pairs(text)
+    )
+    best = (0, len(text))
+    for s, e in pairs:
+        if s <= pos < e and (best == (0, len(text)) or s < best[0]):
+            best = (s, e)
+    return best
+
+
+def _aliases_of(
+    text: str, g: str, define_pos: int = 0, window: Optional[tuple] = None
+) -> set:
+    """Local identifiers the enclosing module assigns TO global ``g``
+    (UMD shape ``!function(e){e.VERSION="3.8.0"; window.Reveal = e}({})``):
+    a ``VERSION`` literal qualified by such an alias belongs to ``g``
+    itself, not to another library in the bundle.
+
+    Two containment rules keep minified bundles from donating another
+    module's VERSION to the target: the global is anchored with
+    ``(?<![\\w$.])`` (``MyReveal = e`` and ``Plugin.Reveal = e`` are
+    not assignments to the global), and the search is scoped to the
+    module/factory block enclosing ``define_pos`` — a second factory
+    reusing the same minified parameter name (``e``) must not have its
+    parameter accepted as an alias of this module's export.
+    ``window`` is an optional precomputed ``_module_window`` result
+    (the brace scan is O(len(text)); callers that also need the window
+    pass it in instead of paying the scan twice)."""
+    lo, hi = window if window is not None else _module_window(
+        text, define_pos
+    )
     return {
         am.group(1)
         for am in re.finditer(
-            rf"(?:\bwindow\s*\.\s*)?{re.escape(g)}\s*=(?![=])\s*"
-            rf"([A-Za-z_$][\w$]*)\b",
-            text,
+            rf"(?<![\w$.])(?:window\s*\.\s*)?(?<![\w$]){re.escape(g)}"
+            rf"\s*=(?![=])\s*([A-Za-z_$][\w$]*)\b",
+            text[lo:hi],
         )
         if am.group(1) != g
     }
@@ -284,11 +341,37 @@ def _script_version_of(
     )
     if m:
         return m.group(1)
-    ok_quals = {g} | _aliases_of(text, g)
+    import bisect as _bisect
+
+    structure = _matched_brace_pairs(text)
+    starts, ends, _pairs = structure
+    lo, hi = window = _module_window(text, define_pos, structure)
+    aliases = _aliases_of(text, g, define_pos, window=window)
+
+    def qual_ok(q: Optional[str], pos: int) -> bool:
+        # g itself qualifies anywhere. An alias qualifies inside its
+        # own module window — the same minified identifier in a
+        # SIBLING factory is a different object — and at TOP LEVEL:
+        # a top-level module body shares one scope with its (possibly
+        # guard-wrapped) export assignment, so scoping it to the guard
+        # block would drop the module's own VERSION.
+        if q is None or q == g:
+            return True
+        if q not in aliases:
+            return False
+        if lo <= pos < hi:
+            return True
+        # depth 0 = inside no matched block (matched pairs nest, so
+        # started-minus-ended counts the enclosing blocks)
+        return (
+            _bisect.bisect_right(starts, pos)
+            - _bisect.bisect_right(ends, pos)
+        ) == 0
+
     vals: list = []
     for vm in _VERSION_LITERAL_RE.finditer(text):
         q = _qualifier_before(text, vm.start())
-        if q is not None and q not in ok_quals:
+        if q is not None and not qual_ok(q, vm.start()):
             continue
         vals.append((vm.start(), vm.group(1)))
     # identifier hops are candidates ALONGSIDE direct literals — a
@@ -296,7 +379,7 @@ def _script_version_of(
     # own hoisted ``VERSION:t``
     for im in _VERSION_IDENT_RE.finditer(text):
         q = _qualifier_before(text, im.start())
-        if q is not None and q not in ok_quals:
+        if q is not None and not qual_ok(q, im.start()):
             continue
         ident = re.escape(im.group(1))
         lit = re.search(

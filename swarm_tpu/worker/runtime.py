@@ -310,6 +310,12 @@ class JobProcessor:
             out["mesh"] = "x".join(
                 f"{ax}{int(mesh.shape[ax])}" for ax in mesh.axis_names
             )
+        # scheduler mode + feed health ride the job perf fields so
+        # operators can see the A/B state per job (/get-statuses)
+        out["pipeline"] = getattr(engine, "pipeline", "off")
+        sched = getattr(engine, "_sched", None)
+        if sched is not None:
+            out["sched"] = sched.stats.snapshot()
         return out
 
     # ------------------------------------------------------------------
@@ -532,9 +538,13 @@ class JobProcessor:
             from swarm_tpu.ops.engine import MatchEngine
 
             # disk-cached corpus compile (+ persistent XLA cache): a
-            # warm worker builds the full-corpus engine in ~a second
+            # warm worker builds the full-corpus engine in ~a second.
+            # cfg.pipeline routes bulk matching through the continuous-
+            # batching scheduler (swarm_tpu/sched) when "on".
             templates, db = load_or_compile(templates_dir)
-            engine = MatchEngine(templates, db=db)
+            engine = MatchEngine(
+                templates, db=db, pipeline=self.cfg.pipeline
+            )
             self._engines[templates_dir] = engine
         return engine
 
@@ -563,6 +573,37 @@ class JobProcessor:
                 probe_spec=module.probe,
                 wave_targets=int(module.raw.get("wave_targets", 1024)),
             )
+        elif engine.pipeline == "on":
+            # continuous-batching path (docs/PIPELINE.md): line decode
+            # runs on the scheduler's prefetch thread — chunk i+1
+            # parses while chunk i's batch rides the device — and rows
+            # are re-binned into padding buckets with memo short-
+            # circuiting. Results are bit-identical to the direct path.
+            lines = text.splitlines()
+            step = engine.batch_rows
+            payloads = [
+                (ci, lines[s : s + step])
+                for ci, s in enumerate(range(0, len(lines), step))
+            ] or [(0, [])]
+            rows_by_chunk: dict = {}
+
+            def decode(payload):
+                ci, chunk_lines = payload
+                out = []
+                for line in chunk_lines:
+                    row = parse_response_line(line)
+                    if row is not None:
+                        out.append(row)
+                rows_by_chunk[ci] = out
+                return out
+
+            rows = []
+            results = []
+            for ci, res in enumerate(
+                engine.scheduler().run(payloads, decode=decode)
+            ):
+                rows.extend(rows_by_chunk.pop(ci))
+                results.extend(res)
         else:
             rows = []
             for line in text.splitlines():

@@ -869,6 +869,72 @@ def test_version_attribution_in_bundles():
     assert headless._script_version_of(umd, g, dm3.start()) == "3.8.0"
 
 
+def test_alias_scoping_in_minified_umd_bundles():
+    """UMD alias containment (the misattribution class): the alias
+    search is anchored (``MyReveal = e`` / ``Foo.Reveal = e`` are not
+    assignments to the global) and scoped to the module/factory block
+    enclosing the define site — a sibling factory reusing the same
+    minified parameter name must not have its parameter accepted as an
+    alias, nor donate its own VERSION to the target."""
+    from swarm_tpu.worker import headless
+    import re as _re
+
+    g = "Reveal"
+    # two concatenated minified factories, both using param `e`
+    bundle = (
+        '!function(e){e.VERSION="1.0.0";window.Plugin=e}({});'
+        '!function(e){e.VERSION="3.8.0";window.Reveal=e}({});'
+    )
+    dm = _re.search(r"window\.Reveal\s*=", bundle)
+    assert headless._aliases_of(bundle, g, dm.start()) == {"e"}
+    # the first factory's `e.VERSION` is outside Reveal's module window
+    # → only Reveal's own 3.8.0 survives as a candidate
+    assert headless._script_version_of(bundle, g, dm.start()) == "3.8.0"
+    # anchoring: look-alike identifiers and other objects' properties
+    # must not donate aliases
+    t2 = (
+        'var MyReveal = q; Foo.Reveal = z; '
+        'window.Reveal = e; e.VERSION="2.2.2";'
+    )
+    assert headless._aliases_of(t2, g, t2.index("window")) == {"e"}
+    # window-qualified assignment still registers, plain too
+    t3 = "{Reveal = w; window.Reveal = w;}"
+    assert headless._aliases_of(t3, g, 1) == {"w"}
+    # unbalanced braces fail open to the whole script (never worse
+    # than the pre-scoping behavior)
+    t4 = 'var s="{"; Reveal = e; e.VERSION="5.0.0";'
+    assert "e" in headless._aliases_of(t4, g, t4.index("Reveal"))
+    # guard-wrapped export (standard UMD boilerplate): the window is
+    # the OUTERMOST enclosing block — the factory body, not the inner
+    # if-block — so the factory's own VERSION still attributes
+    guarded = (
+        '!function(e){if(typeof window!=="undefined")'
+        '{window.Reveal=e}e.VERSION="4.0.6"}({});'
+    )
+    dmg = _re.search(r"window\.Reveal\s*=", guarded)
+    assert (
+        headless._script_version_of(guarded, g, dmg.start()) == "4.0.6"
+    )
+    # and guard-wrapped exports inside CONCATENATED factories still
+    # scope per factory
+    both = (
+        '!function(e){if(1){window.Plugin=e}e.VERSION="1.0.0"}({});'
+        '!function(e){if(1){window.Reveal=e}e.VERSION="3.9.1"}({});'
+    )
+    dmb = _re.search(r"window\.Reveal\s*=", both)
+    assert headless._script_version_of(both, g, dmb.start()) == "3.9.1"
+    # top-level module body + guard-wrapped export (common non-UMD
+    # bundler output): the top-level VERSION shares the export's scope
+    # and must still attribute — block scoping applies only to
+    # factory-local identifiers
+    toplvl = (
+        'var e={};e.VERSION="3.8.0";'
+        'if(typeof window!=="undefined"){window.Reveal=e}'
+    )
+    dmt = _re.search(r"window\.Reveal\s*=", toplvl)
+    assert headless._script_version_of(toplvl, g, dmt.start()) == "3.8.0"
+
+
 def test_version_check_minified_and_misattribution(reveal_server):
     """Minified dists hoist the VERSION value behind an identifier
     (``VERSION:t`` + ``t="4.2.1"``) — resolved with one hop; and a

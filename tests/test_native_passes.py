@@ -148,6 +148,29 @@ def test_memo_lru_eviction_and_overwrite():
     assert len(m) == 0
 
 
+def test_memo_contains_batch_mask_and_dead_rows():
+    m = VerdictMemo(8, 4)
+    live = Response(body=b"resident", status=200)
+    m.insert(live, np.full(4, 3, np.uint8), None)
+    # a dead row with content byte-equal to a resident ALIVE row must
+    # probe as not-resident (dead rows match nothing by contract)
+    dead_twin = _clone(live)
+    dead_twin.alive = False
+    miss = Response(body=b"novel", status=200)
+    mask = m.contains_batch([_clone(live), miss, dead_twin])
+    assert list(mask) == [1, 0, 0]
+    assert m.contains_batch([]).shape == (0,)
+    # no LRU side effects: capacity-4 memo, probe entry 0, then insert
+    # 4 more — entry 0 must still be evicted as LRU tail
+    m2 = VerdictMemo(2, 4)
+    a, b = Response(body=b"a"), Response(body=b"b")
+    m2.insert(a, np.zeros(4, np.uint8), None)
+    m2.insert(b, np.zeros(4, np.uint8), None)
+    m2.contains_batch([_clone(a)])  # probe must NOT refresh a
+    m2.insert(Response(body=b"c"), np.zeros(4, np.uint8), None)
+    assert not m2.contains(_clone(a)) and m2.contains(_clone(b))
+
+
 def test_memo_insert_rejects_malformed_extras():
     m = VerdictMemo(4, 4)
     r = Response(body=b"x", status=200)

@@ -9,12 +9,24 @@ set -e
 cd "$(dirname "$0")/.."
 
 echo "== preflight: pytest =="
-python -m pytest tests/ -q
+# test_sched.py runs in its own dedicated step below — not twice
+python -m pytest tests/ -q --ignore=tests/test_sched.py
 
 echo "== preflight: metrics exposition =="
 # boots an in-process server, scrapes /metrics, fails on any malformed
 # line or missing core family (telemetry PR contract)
 python tools/check_metrics.py
+
+echo "== preflight: scheduler parity =="
+# pipeline=on must be bit-identical to pipeline=off (docs/PIPELINE.md)
+python -m pytest tests/test_sched.py -q
+
+echo "== preflight: bench smoke (pipeline A/B, both modes) =="
+# CI-fast A/B on the bundled corpus; rc gates on verdict identity only.
+# Forced to the CPU backend unless the operator pinned one — the smoke
+# validates feed mechanics and parity, not chip throughput.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=off python bench.py --smoke
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=on python bench.py --smoke
 
 echo "== preflight: bench =="
 if [ "$1" = "--quick" ]; then
