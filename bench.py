@@ -69,6 +69,17 @@ BASELINES = {
     # over pipeline=off on the same chunked fresh-content feed. Target
     # 1.0 = parity; the whole point is vs_baseline > 1.
     "pipeline_ab_fresh_speedup": 1.0,
+    # TIME baselines (two-phase corpus-as-arguments kernel,
+    # docs/DEVICE_MATCH.md): the PRE-change records — 124 s first-shape
+    # compile (MULTICHIP_r05 slow_operation_alarm floor) and 14.2 s
+    # END-TO-END per 2048-row fresh batch (BENCH_r05: 143 rows/s/chip).
+    # Lower is better, so these lines emit vs_baseline = baseline /
+    # value (> 1 = improvement). The fresh line's VALUE is the total
+    # per-batch wall (like-for-like with the 14.2 s record); the
+    # device-only half rides in the line's extra fields so future
+    # BENCH_* records can track it against itself.
+    "device_compile_seconds": 124.0,
+    "fresh_batch_device_ms": 14200.0,
 }
 
 ROWS = 2048
@@ -426,7 +437,10 @@ def bench_pipeline_ab(eng, chunk_rows: int = 0, n_chunks: int = 8) -> dict:
 
 def bench_exact_engine(templates, db=None) -> tuple:
     # → (steady_rows_per_sec, fresh_floor_rows_per_sec,
-    #    fresh_host_walk_rows_per_sec, MatchEngine, engine_stats_snapshot)
+    #    fresh_host_walk_rows_per_sec, MatchEngine, engine_stats_snapshot,
+    #    device_record)  — device_record carries the two-phase kernel's
+    #    headline times: first-shape compile seconds and per-fresh-batch
+    #    device ms (ISSUE 3 BENCH trajectory metrics)
     from swarm_tpu.ops.engine import MatchEngine
 
     eng = MatchEngine(
@@ -441,7 +455,16 @@ def bench_exact_engine(templates, db=None) -> tuple:
     warm = [realistic_rows(ROWS, seed=s) for s in range(nb)]
     t0 = time.time()
     eng.match_packed(warm[0])
-    log(f"engine compile+first batch: {time.time() - t0:.1f}s")
+    first_batch_s = time.time() - t0
+    # compile attribution from the DeviceDB spy: wall time of dispatches
+    # that built a new executable (first width bucket = the cold cost a
+    # worker pays per corpus; the args kernel makes it corpus-free)
+    compile_s = getattr(eng.device, "compile_seconds", 0.0) or first_batch_s
+    log(
+        f"engine compile+first batch: {first_batch_s:.1f}s "
+        f"(device compile {compile_s:.1f}s, "
+        f"{getattr(eng.device, 'compile_count', 0)} executables)"
+    )
     for b in warm:
         eng.match_packed(b)  # warm every shape/content path
     # the timed batches repeat the warm CONTENT through fresh objects —
@@ -510,13 +533,27 @@ def bench_exact_engine(templates, db=None) -> tuple:
     eng.clear_content_memos()
     eng.match_packed(fresh[0])  # warm any new jit width bucket
     h0 = eng.stats.host_confirm_seconds
+    d0 = eng.stats.device_seconds
     t0 = time.perf_counter()
     for b in fresh[1:]:
         tb = time.perf_counter()
         eng.match_packed(b)
         log(f"  fresh batch: {(time.perf_counter() - tb) * 1e3:.1f} ms")
-    fresh_rate = fresh_iters * ROWS / (time.perf_counter() - t0)
+    fresh_wall = time.perf_counter() - t0
+    fresh_rate = fresh_iters * ROWS / fresh_wall
     log(f"fresh-content floor: {fresh_rate:.0f} rows/s")
+    # per-fresh-batch times: TOTAL wall (like-for-like with the
+    # pre-change BENCH_r05 record) and the device half (dispatch +
+    # blocking fused read — the milliseconds the two-phase kernel is
+    # accountable for; tracked against itself across BENCH_* records)
+    fresh_batch_ms = fresh_wall / fresh_iters * 1e3
+    fresh_device_ms = (
+        (eng.stats.device_seconds - d0) / fresh_iters * 1e3
+    )
+    log(
+        f"fresh batch: {fresh_batch_ms:.1f} ms total, "
+        f"{fresh_device_ms:.1f} ms device"
+    )
     # the floor's DESIGN-bound component: on this harness the end-to-
     # end fresh rate is dominated by the tunneled relay's per-dispatch
     # sync-mode tax (BASELINE.md), which no deployment on a directly
@@ -530,7 +567,20 @@ def bench_exact_engine(templates, db=None) -> tuple:
     from swarm_tpu.telemetry.engine_export import engine_stats_snapshot
 
     stats_snap = engine_stats_snapshot(eng)
-    return n / dt, fresh_rate, fresh_walk_rate, eng, stats_snap
+    # re-read at record time: the warm/fresh loops may have compiled
+    # further width buckets after the first-batch snapshot — seconds
+    # and count must cover the same set of executables
+    compile_s = getattr(eng.device, "compile_seconds", 0.0) or compile_s
+    device_record = {
+        "device_compile_seconds": round(compile_s, 3),
+        "device_compile_count": int(
+            getattr(eng.device, "compile_count", 0)
+        ),
+        "fresh_batch_ms": round(fresh_batch_ms, 3),
+        "fresh_batch_device_ms": round(fresh_device_ms, 3),
+        "fresh_batch_rows": ROWS,
+    }
+    return n / dt, fresh_rate, fresh_walk_rate, eng, stats_snap, device_record
 
 
 def bench_service_classifier(db_path: str = "") -> float:
@@ -664,10 +714,9 @@ def bench_jarm_cluster() -> float:
 
 def bench_device_only(db, dev) -> float:
     import jax
-    import jax.numpy as jnp
 
     from swarm_tpu.ops.encoding import encode_batch
-    from swarm_tpu.ops.match import _match_impl
+    from swarm_tpu.ops.match import DeviceDB
 
     log(
         f"corpus: {db.stats['templates_in']} templates -> "
@@ -681,24 +730,31 @@ def bench_device_only(db, dev) -> float:
     lengths = {k: jax.device_put(v, dev) for k, v in batch.lengths.items()}
     status = jax.device_put(batch.status, dev)
 
-    def step(streams, lengths, status):
-        t_value, t_unc, overflow = _match_impl(db, 128, streams, lengths, status)
-        return jnp.packbits(t_value, axis=1), jnp.packbits(t_unc, axis=1), overflow
-
-    fn = jax.jit(step)
+    # the production two-phase kernel (corpus arrays as device-resident
+    # arguments — docs/DEVICE_MATCH.md), full-mode fused output
+    matcher = DeviceDB(db)
     t0 = time.time()
-    out = fn(streams, lengths, status)
+    out = matcher.dispatch(streams, lengths, status)
     jax.block_until_ready(out)
-    log(f"device compile+first call: {time.time() - t0:.1f}s")
+    log(
+        f"device compile+first call: {time.time() - t0:.1f}s "
+        f"(compile {matcher.compile_seconds:.1f}s)"
+    )
     for _ in range(WARMUP):
-        out = fn(streams, lengths, status)
+        out = matcher.dispatch(streams, lengths, status)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        out = fn(streams, lengths, status)
+        out = matcher.dispatch(streams, lengths, status)
     jax.block_until_ready(out)
     per_batch = (time.perf_counter() - t0) / ITERS
     log(f"device steady state: {per_batch * 1e3:.2f} ms / {ROWS} rows")
+    # per-phase attribution of one batch → stderr table + telemetry
+    phases = matcher.profile_phases(streams, lengths, status)
+    log(
+        "device phase ms: "
+        + "  ".join(f"{k}={v:.2f}" for k, v in phases.items())
+    )
     return ROWS / per_batch
 
 
@@ -741,8 +797,33 @@ def run_phase(phase: str) -> int:
         need_corpus=phase in ("exact", "oracle", "device")
     )
     if phase == "exact":
-        exact, fresh_rate, fresh_walk, eng, engine_stats = bench_exact_engine(
-            templates, db=db
+        (
+            exact, fresh_rate, fresh_walk, eng, engine_stats, device_rec,
+        ) = bench_exact_engine(templates, db=db)
+        # two-phase kernel trajectory metrics (ISSUE 3): TIME values,
+        # lower is better — vs_baseline is baseline/value so >1 means
+        # faster than the pre-change record and a regression is a
+        # driver-visible ratio collapse
+        emit(
+            "device_compile_seconds",
+            device_rec["device_compile_seconds"],
+            "s (first-shape compile+dispatch; lower is better)",
+            BASELINES["device_compile_seconds"]
+            / max(device_rec["device_compile_seconds"], 1e-9),
+            extra={"compile_count": device_rec["device_compile_count"]},
+        )
+        emit(
+            "fresh_batch_device_ms",
+            device_rec["fresh_batch_ms"],
+            "ms/batch (total fresh %d-row batch wall, like-for-like "
+            "with the pre-change record; device half in extra)"
+            % device_rec["fresh_batch_rows"],
+            BASELINES["fresh_batch_device_ms"]
+            / max(device_rec["fresh_batch_ms"], 1e-9),
+            extra={
+                "device_ms": device_rec["fresh_batch_device_ms"],
+                "rows": device_rec["fresh_batch_rows"],
+            },
         )
         # continuous-batching A/B (same engine, same corpus, chunked
         # feed): rides in the headline extra so BENCH_* files track

@@ -84,6 +84,11 @@ class EngineStats:
     rows: int = 0
     batches: int = 0
     device_seconds: float = 0.0
+    # cumulative compile wall time of the device matcher (DeviceDB
+    # compile_seconds passthrough — new batch shapes only; the
+    # corpus-as-arguments kernel makes this corpus-size-free)
+    device_compile_seconds: float = 0.0
+    device_compiles: int = 0
     host_confirm_seconds: float = 0.0
     host_confirm_pairs: int = 0
     host_always_pairs: int = 0
@@ -1214,8 +1219,9 @@ class MatchEngine:
                 # the "all" stream synthesizes on device (half the
                 # encode bytes and H2D traffic stay on the host);
                 # coarse width buckets bound the compiled-shape set —
-                # every distinct shape costs a compile AND a big
-                # constant-capturing executable (DeviceDB.MAX_COMPILED)
+                # the args kernel (docs/DEVICE_MATCH.md) makes each
+                # shape's executable corpus-free, but a compile is
+                # still a compile
                 reuse_buffers=reuse_buffers,
                 build_all=False,
                 width_multiple=512,
@@ -1281,6 +1287,12 @@ class MatchEngine:
         pm_unc = np.asarray(pm_unc)[:B]
         overflow = np.asarray(overflow)[:B]
         self.stats.device_seconds += time.perf_counter() - t0
+        # compile-time attribution rides the DeviceDB counters (zero on
+        # the sharded matcher, which compiles per mesh shape instead)
+        self.stats.device_compile_seconds = getattr(
+            matcher, "compile_seconds", 0.0
+        )
+        self.stats.device_compiles = getattr(matcher, "compile_count", 0)
         # rows needing whole-row reconfirmation (candidate overflow or
         # stream truncation made word bits unsound for the row)
         row_redo = overflow | batch.truncated[:B]

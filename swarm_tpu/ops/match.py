@@ -35,13 +35,14 @@ from swarm_tpu.ops import hashing
 from swarm_tpu.ops.encoding import STREAMS
 
 
-#: max live compiled executables per matcher (DeviceDB/ShardedMatcher).
-#: Each distinct batch shape compiles a kernel that CAPTURES the corpus
-#: tables as constants (tens of MB each); unbounded shape churn grows
-#: RSS without limit, while too small a cap thrashes multi-second
-#: recompiles against millisecond batches. Coarse width buckets
-#: (engine width_multiple=512) and 256-row buckets keep the live
-#: working set well under this. Override: SWARM_MAX_COMPILED.
+#: max live compiled step functions on the SHARDED matcher (its pjit
+#: cache is still bounded per shape). The single-device DeviceDB's
+#: executables take the corpus as arguments (docs/DEVICE_MATCH.md) so
+#: a shape entry is small and all buckets of a width class share one;
+#: its jit cache is only dropped wholesale past 4x this bound (see
+#: DeviceDB.dispatch's shape-churn guard). Coarse width buckets
+#: (engine width_multiple=512) and 256-row buckets keep the live shape
+#: set tiny either way. Override: SWARM_MAX_COMPILED.
 import os as _os
 
 MAX_COMPILED = int(_os.environ.get("SWARM_MAX_COMPILED", "8"))
@@ -116,21 +117,116 @@ def split_fused(db: "fpc.CompiledDB", buf: np.ndarray):
     return pt, pu, opv, opu, mu, ovf[:, 0] != 0
 
 
+_DEV_METRICS: dict = {}
+
+
+def _device_metrics() -> dict:
+    """Lazy device-kernel metric families (kept out of import time so
+    oracle-only users never touch the registry)."""
+    if not _DEV_METRICS:
+        from swarm_tpu.telemetry import REGISTRY
+
+        _DEV_METRICS["compile_seconds"] = REGISTRY.counter(
+            "swarm_device_compile_seconds_total",
+            "Seconds spent compiling device match executables",
+        )
+        _DEV_METRICS["compiles"] = REGISTRY.counter(
+            "swarm_device_compile_total",
+            "Device match executable compilations (new batch shapes)",
+        )
+        _DEV_METRICS["phase_ms"] = REGISTRY.gauge(
+            "swarm_device_phase_ms",
+            "Device match per-phase milliseconds from the most recent "
+            "instrumented batch (DeviceDB.profile_phases)",
+            ("phase",),
+        )
+    return _DEV_METRICS
+
+
 class DeviceDB:
     """CompiledDB uploaded to device + the jitted match function.
 
-    The numpy tables become jnp constants captured in the traced
-    function; re-tracing happens per distinct batch shape (width
-    buckets keep that to a handful of shapes).
+    The corpus arrays are uploaded ONCE (the argument-layout pytree,
+    compile.build_device_layout) and passed to a single jitted kernel
+    as device-resident arguments on every call. The traced program is
+    corpus-size-free: all width buckets of a shape class share one
+    executable per batch shape, compile time no longer scales with the
+    corpus, and the persistent XLA cache (utils/xlacache.py) hits
+    across corpus refreshes. The arrays are never donated — every
+    subsequent call reuses them in place.
+
+    ``compile_seconds`` / ``compile_count`` accumulate the wall time of
+    calls that triggered a fresh executable (measured at the dispatch
+    boundary — dispatch is async, so this is compile + launch, not
+    compute).
     """
 
-    MAX_COMPILED = MAX_COMPILED  # class alias (ShardedMatcher shares it)
+    MAX_COMPILED = MAX_COMPILED  # legacy alias (sharded path shares it)
 
     def __init__(self, db: fpc.CompiledDB, candidate_k: int = 128):
         self.db = db
         self.candidate_k = candidate_k
-        self._fn_cache: dict = {}
+        self.compile_seconds = 0.0
+        self.compile_count = 0
+        self._meta = None
+        self._arrays = None  # device-resident argument pytree
+        self._fn_cache: dict = {}  # full flag -> shape-polymorphic jit fn
 
+    # ------------------------------------------------------------------
+    def _ensure_layout(self):
+        if self._arrays is None:
+            meta, arrays_np = fpc.build_device_layout(self.db)
+            self._meta = meta
+            # upload once; jnp.asarray leaves numpy → device committed
+            self._arrays = jax.tree_util.tree_map(jnp.asarray, arrays_np)
+        return self._meta, self._arrays
+
+    def _kernel(self, full: bool):
+        fn = self._fn_cache.get(full)
+        if fn is None:
+            db, k = self.db, self.candidate_k
+            meta, _ = self._ensure_layout()
+
+            def kernel(arrays, streams, lengths, status):
+                out = _match_impl_args(
+                    db, meta, k, arrays, streams, lengths, status, full=full
+                )
+                if full:
+                    # bit-plane outputs ship packed (MSB-first,
+                    # np.packbits convention): ~9× less host transfer —
+                    # and FUSED into one array so the host makes exactly
+                    # one device read (split_fused slices it back)
+                    *planes, overflow = out
+                    return fuse_planes(planes, overflow)
+                return out
+
+            fn = jax.jit(kernel)
+            self._fn_cache[full] = fn
+        return fn
+
+    def executable_count(self, full: bool = True) -> int:
+        """Live compiled executables for the ``full``-mode kernel (the
+        compile-count spy the width-bucket tests use)."""
+        fn = self._fn_cache.get(full)
+        if fn is None or not hasattr(fn, "_cache_size"):
+            return 0
+        return int(fn._cache_size())
+
+    def lowered_text(
+        self, streams: dict, lengths: dict, status, full: bool = True
+    ) -> str:
+        """StableHLO text of the kernel for these shapes — the
+        corpus-constants regression test inspects this."""
+        meta, arrays = self._ensure_layout()
+        fn = self._kernel(full)
+        return fn.lower(
+            arrays,
+            {k: jnp.asarray(v) for k, v in streams.items()},
+            {k: jnp.asarray(v) for k, v in lengths.items()},
+            jnp.asarray(status),
+        ).as_text()
+
+    # ------------------------------------------------------------------
     def match(self, streams: dict, lengths: dict, status, full: bool = False):
         """streams: name → uint8 [B, W]; lengths: name → int32 [B].
 
@@ -153,38 +249,179 @@ class DeviceDB:
         kernel crunches while the caller does other host work — the
         continuous-batching scheduler dispatches batch i+1 here before
         walking batch i's verdicts. :meth:`collect` finalizes."""
-        shape_key = (
-            tuple(sorted((k, v.shape) for k, v in streams.items())),
-            full,
-        )
-        fn = lru_fetch(self._fn_cache, shape_key)
-        if fn is None:
-            impl = functools.partial(
-                _match_impl, self.db, self.candidate_k, full=full
-            )
-            if full:
-                # bit-plane outputs ship packed (MSB-first, np.packbits
-                # convention): ~9× less host transfer per batch — and
-                # FUSED into one array so the host makes exactly one
-                # device read (split_fused slices it back)
-                def packed_impl(streams, lengths, status, _impl=impl):
-                    *planes, overflow = _impl(streams, lengths, status)
-                    return fuse_planes(planes, overflow)
+        import time as _time
 
-                fn = jax.jit(packed_impl)
-            else:
-                fn = jax.jit(impl)
-            lru_store(self._fn_cache, shape_key, fn, self.MAX_COMPILED)
-        return fn(
+        _meta, arrays = self._ensure_layout()
+        fn = self._kernel(full)
+        spy = hasattr(fn, "_cache_size")
+        n0 = fn._cache_size() if spy else -1
+        t0 = _time.perf_counter()
+        out = fn(
+            arrays,
             {k: jnp.asarray(v) for k, v in streams.items()},
             {k: jnp.asarray(v) for k, v in lengths.items()},
             jnp.asarray(status),
         )
+        if spy:
+            grew = fn._cache_size() - n0
+            if grew > 0:
+                dt = _time.perf_counter() - t0
+                self.compile_seconds += dt
+                self.compile_count += grew
+                m = _device_metrics()
+                m["compile_seconds"].inc(dt)
+                m["compiles"].inc(grew)
+                # shape-churn guard: jax.jit never evicts entries, so
+                # adversarial width/row variety would grow the cache
+                # without bound. Executables are corpus-free (small),
+                # hence the generous 4x bound; past it the whole cache
+                # drops — a rare recompile beats unbounded RSS.
+                if fn._cache_size() > 4 * self.MAX_COMPILED and hasattr(
+                    fn, "clear_cache"
+                ):
+                    fn.clear_cache()
+        return out
 
     def collect(self, out):
         """Blocking half of the full-mode split: one host read of the
         fused plane array, sliced into the engine's six outputs."""
         return split_fused(self.db, np.asarray(out))
+
+    # ------------------------------------------------------------------
+    def profile_phases(self, streams: dict, lengths: dict, status) -> dict:
+        """Per-phase device milliseconds for ONE batch — the
+        attribution surface behind ``tools/profile_device.py`` and the
+        ``swarm_device_phase_ms`` gauges.
+
+        Runs each phase as its own jitted call with a blocking sync
+        between phases, so the numbers attribute where fresh-batch
+        milliseconds go (prefilter / gather / verify / regex lanes /
+        verdict / transfer). This is NOT the fused production dispatch:
+        phase boundaries forbid cross-phase fusion, so the sum is an
+        upper bound on the fused kernel's time. ``verify`` is reported
+        as (full phase B) − (hash-screen-only phase B).
+        """
+        import time as _time
+
+        db, k = self.db, self.candidate_k
+        meta, arrays = self._ensure_layout()
+        s_j = {k2: jnp.asarray(v) for k2, v in streams.items()}
+        l_j = {k2: jnp.asarray(v) for k2, v in lengths.items()}
+        st_j = jnp.asarray(status)
+        ns = db.num_slots
+
+        def run(fn, *a):
+            r = fn(*a)
+            jax.block_until_ready(r)
+            t0 = _time.perf_counter()
+            r = fn(*a)  # timed second call: steady-state, post-compile
+            jax.block_until_ready(r)
+            return r, (_time.perf_counter() - t0) * 1e3
+
+        budget = global_candidate_budget(k, len(meta.table_stream))
+
+        @jax.jit
+        def f_pre(arrays, streams, lengths):
+            streams = ensure_all_stream(streams, lengths)
+            ctx = _StreamCtx(streams, lengths)
+            col, overflow, _cs = prefilter_candidates(
+                meta, arrays["tab"], ctx, budget
+            )
+            return col, overflow
+
+        # col_starts is shape-static: rebuild from the (post-"all"-
+        # synthesis) stream widths without tracing anything
+        s_full = ensure_all_stream(s_j, l_j)
+        T = len(meta.table_stream)
+        col_starts = np.zeros(T + 1, dtype=np.int32)
+        for t in range(T):
+            col_starts[t + 1] = (
+                col_starts[t] + s_full[meta.table_stream[t]].shape[1]
+            )
+
+        def make_verify(byte_verify):
+            @jax.jit
+            def f_ver(arrays, streams, lengths, col):
+                streams = ensure_all_stream(streams, lengths)
+                ctx = _StreamCtx(streams, lengths)
+                return verify_candidates(
+                    meta,
+                    arrays["tab"],
+                    arrays["slot_bytes"],
+                    arrays["slot_len"],
+                    ctx,
+                    col,
+                    col_starts,
+                    ns,
+                    byte_verify=byte_verify,
+                )
+            return f_ver
+
+        @jax.jit
+        def f_tiny(arrays, streams, lengths, vbits):
+            streams = ensure_all_stream(streams, lengths)
+            ctx = _StreamCtx(streams, lengths)
+            return tiny_slot_bits(
+                meta, arrays["tiny_bytes"], arrays["tiny_slot"], ctx, vbits
+            )
+
+        @jax.jit
+        def f_rx(arrays, streams, lengths, vbits):
+            from swarm_tpu.ops.regexdev import regex_verify
+
+            streams = ensure_all_stream(streams, lengths)
+            B = next(iter(streams.values())).shape[0]
+            return regex_verify(
+                db, streams, lengths, vbits,
+                k_pairs=db.rx_k_pairs(B), arrays=arrays["rx"],
+            )
+
+        @jax.jit
+        def f_verdict(arrays, streams, lengths, status, vbits, ubits, rx):
+            streams = ensure_all_stream(streams, lengths)
+            digest = None
+            if meta.has_md5 and "body" in streams:
+                from swarm_tpu.ops.md5 import md5_words
+
+                digest = md5_words(streams["body"], lengths["body"])
+            planes = eval_verdicts(
+                db, vbits, ubits, lengths, status, full=True,
+                md5_digest=digest, rx=rx, arrays=arrays["verdict"],
+            )
+            return fuse_planes(
+                planes, jnp.zeros((planes[0].shape[0],), dtype=bool)
+            )
+
+        phases: dict = {}
+        if T:
+            (col, _ovf), phases["prefilter"] = run(f_pre, arrays, s_j, l_j)
+            _, gather_ms = run(make_verify(False), arrays, s_j, l_j, col)
+            (vbits, ubits), full_ms = run(
+                make_verify(True), arrays, s_j, l_j, col
+            )
+            phases["gather"] = gather_ms
+            phases["verify"] = max(full_ms - gather_ms, 0.0)
+        else:
+            B = next(iter(s_j.values())).shape[0]
+            vbits = jnp.zeros((B, max(ns, 1)), dtype=bool)
+            ubits = jnp.zeros((B, max(ns, 1)), dtype=bool)
+            phases["prefilter"] = phases["gather"] = phases["verify"] = 0.0
+        vbits, phases["tiny"] = run(f_tiny, arrays, s_j, l_j, vbits)
+        rx = None
+        if meta.n_rx:
+            rx, phases["regex"] = run(f_rx, arrays, s_j, l_j, vbits)
+        else:
+            phases["regex"] = 0.0
+        fused, phases["verdict"] = run(
+            f_verdict, arrays, s_j, l_j, st_j, vbits, ubits, rx
+        )
+        t0 = _time.perf_counter()
+        np.asarray(fused)
+        phases["transfer"] = (_time.perf_counter() - t0) * 1e3
+        gauge = _device_metrics()["phase_ms"]
+        for name, ms in phases.items():
+            gauge.labels(phase=name).set(ms)
+        return phases
 
 
 def _lower_stream(arr):
@@ -418,6 +655,469 @@ def match_slots(
     return value_bits, uncertain_bits, overflow
 
 
+# ---------------------------------------------------------------------------
+# Two-phase fresh-content kernel (corpus as device-resident ARGUMENTS)
+# ---------------------------------------------------------------------------
+#
+# match_slots above is the legacy/reference kernel: a Python loop over
+# word tables, each table's arrays inlined as XLA constants and a dense
+# per-table top_k over every window. The functions below are the
+# production path (docs/DEVICE_MATCH.md):
+#
+#   phase A  prefilter_candidates — ONE fused bloom/q-gram probe over
+#            the whole batch across ALL tables at once (stacked
+#            table-major arrays from compile.stack_tables_np), then a
+#            single per-row top_k over the concatenated (table, window)
+#            candidate axis;
+#   phase B  verify_candidates — only the surviving (row, window,
+#            table) candidates are gathered: per-candidate binary
+#            search into the stacked h1 groups, 128-bit hash screen,
+#            and the byte verify — work sized by the SURVIVOR budget,
+#            not by tables × windows.
+#
+# Every corpus array arrives as a traced argument (the layout pytree),
+# so the compiled program is corpus-size-free: one executable serves
+# every width bucket of a shape class AND every corpus refresh, and the
+# persistent XLA cache (utils/xlacache.py) keys stop covering corpus
+# bytes. Candidate-overflow contract: a row whose fired windows exceed
+# the global budget K sets ``overflow`` and is re-run exactly on the
+# host (engine row redo) — a strict superset of the legacy per-table
+# condition, so soundness is unchanged.
+
+
+def global_candidate_budget(candidate_k: int, n_tables: int) -> int:
+    """Per-row candidate budget for the global (cross-table) top_k.
+
+    The legacy kernel budgeted ``candidate_k`` PER TABLE (worst case
+    ``candidate_k × T``); phase B's cost is proportional to the budget
+    on EVERY batch, so the global budget scales sub-linearly with the
+    table count instead: ×1 for ≤2 tables up to ×4 for ≥8. A noisy row
+    that fires a moderate number of windows in several tables stays on
+    device (no overflow host-redo cliff), while the gather-verify
+    stays survivor-sized rather than worst-case-sized."""
+    return candidate_k * max(1, min(n_tables, 8) // 2)
+
+
+class _StreamCtx:
+    """Per-trace stream/hash caches shared by both kernel phases."""
+
+    def __init__(self, streams: dict, lengths: dict, pos_offset=0):
+        self.streams = streams
+        self.lengths = lengths
+        self.pos_offset = pos_offset
+        self._lowered: dict = {}
+        self._hashes: dict = {}
+
+    def stream(self, name: str, lowered: bool):
+        if not lowered:
+            return self.streams[name]
+        if name not in self._lowered:
+            self._lowered[name] = _lower_stream(self.streams[name])
+        return self._lowered[name]
+
+    def hashes(self, name: str, lowered: bool, q: int):
+        key = (name, lowered, q)
+        if key not in self._hashes:
+            self._hashes[key] = hashing.window_hashes_jnp(
+                self.stream(name, lowered), q
+            )
+        return self._hashes[key]
+
+    def offset(self, name: str):
+        if isinstance(self.pos_offset, dict):
+            return self.pos_offset[name]
+        return self.pos_offset
+
+
+def _combo_groups(meta: "fpc.DeviceLayoutMeta"):
+    """Tables grouped by (stream, lowered, q) — the distinct hash
+    passes — in first-appearance order. Static."""
+    groups: dict = {}
+    for t in range(len(meta.table_stream)):
+        key = (meta.table_stream[t], meta.table_lowered[t], meta.table_q[t])
+        groups.setdefault(key, []).append(t)
+    return groups
+
+
+def prefilter_candidates(
+    meta: "fpc.DeviceLayoutMeta",
+    tab: dict,
+    ctx: _StreamCtx,
+    candidate_k: int,
+    back_halo: int = 0,
+    fwd_halo: int = 0,
+):
+    """Phase A: fused stacked bloom probe → per-row global top_k.
+
+    Returns ``(col [B, K] int32, overflow [B] bool, col_starts
+    np[T+1])``: ``col`` indexes the concatenated table-major
+    (table, window) candidate axis, -1 = no candidate. ``overflow``
+    marks rows with more fired windows than K (host row-redo)."""
+    some = next(iter(ctx.streams.values()))
+    B = some.shape[0]
+    T = len(meta.table_stream)
+    flags_by_table: list = [None] * T
+    w_by_table = [0] * T
+    for (sname, lowered, q), tids in _combo_groups(meta).items():
+        h1, h2 = ctx.hashes(sname, lowered, q)
+        We = h1.shape[1]
+        W = We - back_halo - fwd_halo
+        h1w = h1[:, back_halo : back_halo + W]
+        h2w = h2[:, back_halo : back_halo + W]
+        # stacked probe: one gather with a leading table axis instead
+        # of a bloom_probe per table
+        bloom = tab["bloom"][np.asarray(tids, dtype=np.int32)]  # [Tg, BW]
+        mask = jnp.uint32(hashing.BLOOM_BITS - 1)
+        i1 = (h1w & mask).astype(jnp.int32)
+        i2 = (h2w & mask).astype(jnp.int32)
+        w1 = bloom[:, i1 >> 5]  # [Tg, B, W]
+        w2 = bloom[:, i2 >> 5]
+        b1 = (w1 >> (i1 & 31).astype(jnp.uint32)[None]) & 1
+        b2 = (w2 >> (i2 & 31).astype(jnp.uint32)[None]) & 1
+        fl = (b1 & b2) == 1  # [Tg, B, W]
+        # windows starting past slen - q can't begin a real gram
+        positions = jnp.arange(W, dtype=jnp.int32)
+        gpositions = positions + ctx.offset(sname)
+        slen = ctx.lengths[sname]
+        fl = fl & (
+            gpositions[None, None, :] <= (slen - q)[None, :, None]
+        )
+        for j, t in enumerate(tids):
+            flags_by_table[t] = fl[j]
+            w_by_table[t] = W
+    col_starts = np.zeros(T + 1, dtype=np.int32)
+    for t in range(T):
+        col_starts[t + 1] = col_starts[t] + w_by_table[t]
+    c_total = int(col_starts[-1])
+    flags_cat = jnp.concatenate(
+        [flags_by_table[t] for t in range(T)], axis=1
+    )  # [B, C]
+    K = max(1, min(candidate_k, c_total))
+    cols = jnp.arange(c_total, dtype=jnp.int32)
+    vals = jnp.where(flags_cat, cols[None, :] + 1, 0)
+    top_vals, _ = jax.lax.top_k(vals, K)
+    col = top_vals - 1  # [B, K]; -1 = invalid
+    overflow = jnp.sum(flags_cat, axis=1) > K
+    return col, overflow, col_starts
+
+
+def verify_candidates(
+    meta: "fpc.DeviceLayoutMeta",
+    tab: dict,
+    slot_bytes_j,
+    slot_len_j,
+    ctx: _StreamCtx,
+    col,
+    col_starts: np.ndarray,
+    num_slots: int,
+    back_halo: int = 0,
+    fwd_halo: int = 0,
+    byte_verify: bool = True,
+):
+    """Phase B: sparse gather-verify over the surviving candidates.
+
+    Per candidate: decode (table, window), fetch the window's rolling
+    hashes, binary-search the table's sorted h1 groups (stacked
+    [T, Gmax] layout — ~log2(Gmax) scalar gathers instead of a
+    searchsorted against a gathered [B, K, Gmax] plane), screen the
+    group's entries by the 128 hash bits, byte-verify survivors.
+
+    ``byte_verify=False`` stops after the hash screen (the profiling
+    tool's "gather" phase) — the returned planes then over-approximate
+    and must not be used for verdicts.
+
+    → (value_bits [B, NS] bool, uncertain_bits [B, NS] bool)
+    """
+    some = next(iter(ctx.streams.values()))
+    B = some.shape[0]
+    T = len(meta.table_stream)
+    K = col.shape[1]
+    value_bits = jnp.zeros((B, max(num_slots, 1)), dtype=bool)
+    uncertain_bits = jnp.zeros((B, max(num_slots, 1)), dtype=bool)
+
+    valid = col >= 0
+    colc = jnp.maximum(col, 0)
+    col_starts_j = jnp.asarray(col_starts)
+    tid = (
+        jnp.searchsorted(col_starts_j, colc, side="right").astype(jnp.int32)
+        - 1
+    )
+    pos = colc - col_starts_j[tid]  # local window coordinate
+    cpos = pos + back_halo  # extended coordinate
+
+    # --- per-candidate static table attributes, via tiny [T] tables ---
+    combos = list(_combo_groups(meta))
+    t_combo = np.array(
+        [
+            combos.index(
+                (meta.table_stream[t], meta.table_lowered[t], meta.table_q[t])
+            )
+            for t in range(T)
+        ],
+        dtype=np.int32,
+    )
+    vstreams = sorted(
+        {(meta.table_stream[t], meta.table_lowered[t]) for t in range(T)}
+    )
+    t_vs = np.array(
+        [
+            vstreams.index((meta.table_stream[t], meta.table_lowered[t]))
+            for t in range(T)
+        ],
+        dtype=np.int32,
+    )
+    t_we = np.array(
+        [ctx.streams[meta.table_stream[t]].shape[1] for t in range(T)],
+        dtype=np.int32,
+    )
+    cand_combo = jnp.asarray(t_combo)[tid]
+    cand_vs = jnp.asarray(t_vs)[tid]
+    cand_we = jnp.asarray(t_we)[tid]
+    cand_slen = jnp.take_along_axis(
+        jnp.stack(
+            [ctx.lengths[meta.table_stream[t]] for t in range(T)], axis=1
+        ),
+        tid,
+        axis=1,
+    )
+    cand_goff = jnp.stack(
+        [
+            jnp.asarray(ctx.offset(meta.table_stream[t]), dtype=jnp.int32)
+            for t in range(T)
+        ]
+    )[tid]
+
+    def hash_at(positions):
+        """(h1, h2) of each candidate's stream at ``positions`` —
+        gather from each combo's hash plane, select by combo id."""
+        out1 = jnp.zeros((B, K), dtype=jnp.uint32)
+        out2 = jnp.zeros((B, K), dtype=jnp.uint32)
+        for ci_, (sname, lowered, q) in enumerate(combos):
+            h1, h2 = ctx.hashes(sname, lowered, q)
+            p = jnp.clip(positions, 0, h1.shape[1] - 1)
+            sel = cand_combo == ci_
+            out1 = jnp.where(sel, jnp.take_along_axis(h1, p, axis=1), out1)
+            out2 = jnp.where(sel, jnp.take_along_axis(h2, p, axis=1), out2)
+        return out1, out2
+
+    h1c, h2c = hash_at(cpos)
+
+    # --- binary search the stacked sorted h1 groups ---
+    group_h1 = tab["group_h1"]
+    gmax = group_h1.shape[1]
+    ng = tab["n_groups"][tid]
+    lo = jnp.zeros_like(colc)
+    hi = ng
+    for _ in range(max(gmax, 1).bit_length() + 1):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        v = group_h1[tid, jnp.minimum(mid, gmax - 1)]
+        right = active & (v < h1c)
+        lo = jnp.where(right, mid + 1, lo)
+        hi = jnp.where(active & ~right, mid, hi)
+    gidx = jnp.minimum(lo, gmax - 1)
+    found = valid & (lo < ng) & (group_h1[tid, gidx] == h1c)
+    e_start = tab["entry_start"][tid, gidx]
+    e_count = tab["entry_count"][tid, gidx]
+
+    emax = tab["entry_h2"].shape[1]
+    offs = jnp.arange(fpc.VERIFY_WIDTH, dtype=jnp.int32)
+    b_idx = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None], (B, K))
+
+    # EVERY entry hit is byte-verified (the compile.py contract) — see
+    # match_slots for the per-entry rationale; max_group here is the
+    # global bound (per-candidate e_count masks shorter groups).
+    for g in range(meta.max_group):
+        e = jnp.minimum(e_start + g, emax - 1)
+        in_group = found & (g < e_count)
+        h2_ok = tab["entry_h2"][tid, e] == h2c
+        # suffix-gram check from the same rolling-hash arrays; the
+        # suffix may live in the halo region (sequence parallelism)
+        spos = cpos + tab["entry_suf_delta"][tid, e]
+        s1, s2 = hash_at(spos)
+        suf_ok = (
+            (s1 == tab["entry_suf_h1"][tid, e])
+            & (s2 == tab["entry_suf_h2"][tid, e])
+            & (spos >= 0)
+            & (spos < cand_we)
+        )
+        entry_off_e = tab["entry_off"][tid, e]
+        entry_len_e = tab["entry_len"][tid, e]
+        # global bounds: word fully inside the true part bytes
+        gstart = (cpos - back_halo) + cand_goff - entry_off_e
+        fits = (gstart >= 0) & (gstart + entry_len_e <= cand_slen)
+        # extended-view bounds (buffer edges / halo limits)
+        fits = fits & (cpos - entry_off_e >= 0) & (
+            cpos - entry_off_e + entry_len_e <= cand_we
+        )
+        hit = in_group & h2_ok & suf_ok & fits
+        slot = tab["entry_slot"][tid, e]
+        if byte_verify:
+            start = cpos - entry_off_e  # extended coord of word start
+            lv = jnp.minimum(entry_len_e, fpc.VERIFY_WIDTH)
+            idx = start[:, :, None] + offs[None, None, :]  # [B, K, V]
+            expected = slot_bytes_j[slot]  # [B, K, V]
+            pos_ok = offs[None, None, :] < lv[:, :, None]
+            eq = jnp.zeros((B, K), dtype=bool)
+            for vi, (sname, lowered) in enumerate(vstreams):
+                sv = ctx.stream(sname, lowered)
+                idx_c = jnp.clip(idx, 0, sv.shape[1] - 1)
+                gathered = jnp.take_along_axis(
+                    sv, idx_c.reshape(B, -1), axis=1
+                ).reshape(B, K, fpc.VERIFY_WIDTH)
+                eq_v = ((gathered == expected) | ~pos_ok).all(-1)
+                eq = jnp.where(cand_vs == vi, eq_v, eq)
+            fired = hit & eq
+        else:
+            fired = hit
+        long = slot_len_j[slot] > fpc.VERIFY_WIDTH
+        value_bits = value_bits.at[b_idx, slot].max(fired)
+        uncertain_bits = uncertain_bits.at[b_idx, slot].max(fired & long)
+    return value_bits, uncertain_bits
+
+
+def tiny_slot_bits(
+    meta: "fpc.DeviceLayoutMeta",
+    tiny_bytes_j,
+    tiny_slot_j,
+    ctx: _StreamCtx,
+    value_bits,
+    back_halo: int = 0,
+):
+    """Tiny slots (1–3 bytes): dense shifted compare — exact, same
+    logic as the legacy path but with the pattern bytes and slot ids
+    as traced arguments."""
+    shift_cache: dict = {}
+    for i, (length, stream_name, lowered) in enumerate(meta.tiny):
+        skey = (stream_name, lowered)
+        if skey not in shift_cache:
+            shift_cache[skey] = _shifted(
+                ctx.stream(stream_name, lowered), hashing.TINY_MAX
+            )
+        shifts = shift_cache[skey]
+        We_t = shifts[0].shape[1]
+        # global coordinates (halo positions are valid too — the byte
+        # compare is exact and the OR across shards dedupes)
+        gpositions = (
+            jnp.arange(We_t, dtype=jnp.int32)
+            - back_halo
+            + ctx.offset(stream_name)
+        )
+        eq = jnp.ones_like(shifts[0], dtype=bool)
+        for j in range(length):
+            eq = eq & (shifts[j] == tiny_bytes_j[i, j])
+        slen = ctx.lengths[stream_name]
+        eq = eq & (gpositions[None, :] >= 0)
+        eq = eq & (gpositions[None, :] <= (slen - length)[:, None])
+        # window must lie inside this view's real bytes (an all-zero
+        # tiny pattern must not match the zero padding / halo edge)
+        local = jnp.arange(We_t, dtype=jnp.int32)
+        eq = eq & (local[None, :] + length <= We_t)
+        hit = eq.any(axis=1)
+        value_bits = value_bits.at[:, tiny_slot_j[i]].max(hit)
+    return value_bits
+
+
+def match_slots_args(
+    db: fpc.CompiledDB,
+    meta: "fpc.DeviceLayoutMeta",
+    arrays: dict,
+    candidate_k: int,
+    streams,
+    lengths,
+    pos_offset=0,
+    back_halo: int = 0,
+    fwd_halo: int = 0,
+):
+    """Two-phase twin of :func:`match_slots`: same contract — (value,
+    uncertain, overflow) slot planes with the superset/uncertainty
+    invariants — with every corpus array a traced argument and the
+    candidate budget global per row instead of per table. Sequence
+    parallelism (halo-extended streams, global lengths/offsets) works
+    exactly as in the legacy kernel."""
+    ns = db.num_slots
+    some = next(iter(streams.values()))
+    B = some.shape[0]
+    ctx = _StreamCtx(streams, lengths, pos_offset)
+    if len(meta.table_stream):
+        budget = global_candidate_budget(
+            candidate_k, len(meta.table_stream)
+        )
+        col, overflow, col_starts = prefilter_candidates(
+            meta, arrays["tab"], ctx, budget, back_halo, fwd_halo
+        )
+        value_bits, uncertain_bits = verify_candidates(
+            meta,
+            arrays["tab"],
+            arrays["slot_bytes"],
+            arrays["slot_len"],
+            ctx,
+            col,
+            col_starts,
+            ns,
+            back_halo,
+            fwd_halo,
+        )
+    else:
+        value_bits = jnp.zeros((B, max(ns, 1)), dtype=bool)
+        uncertain_bits = jnp.zeros((B, max(ns, 1)), dtype=bool)
+        overflow = jnp.zeros((B,), dtype=bool)
+    value_bits = tiny_slot_bits(
+        meta, arrays["tiny_bytes"], arrays["tiny_slot"], ctx,
+        value_bits, back_halo,
+    )
+    return value_bits, uncertain_bits, overflow
+
+
+def _match_impl_args(
+    db: fpc.CompiledDB,
+    meta: "fpc.DeviceLayoutMeta",
+    candidate_k: int,
+    arrays: dict,
+    streams,
+    lengths,
+    status,
+    full=False,
+):
+    """Argument-driven twin of :func:`_match_impl` — the jitted body
+    DeviceDB dispatches (corpus pytree first, so the executable is
+    corpus-free)."""
+    streams = ensure_all_stream(streams, lengths)
+    value_bits, uncertain_bits, overflow = match_slots_args(
+        db, meta, arrays, candidate_k, streams, lengths
+    )
+    digest = None
+    if meta.has_md5 and "body" in streams:
+        from swarm_tpu.ops.md5 import md5_words
+
+        digest = md5_words(streams["body"], lengths["body"])
+    rx = None
+    if meta.n_rx:
+        from swarm_tpu.ops.regexdev import regex_verify
+
+        B = next(iter(streams.values())).shape[0]
+        rx = regex_verify(
+            db,
+            streams,
+            lengths,
+            value_bits,
+            k_pairs=db.rx_k_pairs(B),
+            arrays=arrays["rx"],
+        )
+    out = eval_verdicts(
+        db,
+        value_bits,
+        uncertain_bits,
+        lengths,
+        status,
+        full=full,
+        md5_digest=digest,
+        rx=rx,
+        arrays=arrays["verdict"],
+    )
+    return (*out, overflow)
+
+
 def eval_verdicts(
     db: fpc.CompiledDB,
     value_bits,
@@ -427,6 +1127,7 @@ def eval_verdicts(
     full=False,
     md5_digest=None,
     rx=None,
+    arrays: Optional[dict] = None,
 ):
     """Slot bits + scalars → (t_value, t_uncertain) [B, NT] bool.
 
@@ -444,7 +1145,18 @@ def eval_verdicts(
     cleared. This is what keeps host confirmation sparse — e.g. a
     status-matcher miss certain-falsifies an AND op and no regex
     sibling ever needs host evaluation.
+
+    ``arrays`` is the verdict half of the argument layout
+    (``compile.verdict_arrays_np``): pass the device-resident pytree
+    (DeviceDB/ShardedMatcher) and the traced program stays corpus-free;
+    omit it and the same arrays are baked in as constants — the legacy
+    reference path, byte-identical by construction since both routes
+    run this one function.
     """
+    if arrays is None:
+        arrays = jax.tree_util.tree_map(
+            jnp.asarray, fpc.verdict_arrays_np(db)
+        )
     B = status.shape[0]
     NM = db.m_kind.shape[0]
 
@@ -459,11 +1171,10 @@ def eval_verdicts(
     # --- slot reductions (vacuously true when a matcher has no slots) ---
     slot_red = jnp.ones((B, NM), dtype=bool)
     m_unc = jnp.zeros((B, NM), dtype=bool)
-    cond_and = jnp.asarray(db.m_cond_and)
-    for bucket in db.m_slot_buckets:
-        gv = value_bits[:, bucket.idx]  # [B, nb, w]
-        gu = uncertain_bits[:, bucket.idx]
-        rows = jnp.asarray(bucket.rows)
+    cond_and = arrays["m_cond_and"]
+    for rows, idx in arrays["m_slot_buckets"]:
+        gv = value_bits[:, idx]  # [B, nb, w]
+        gu = uncertain_bits[:, idx]
         is_and = cond_and[rows][None, :]
         red = jnp.where(is_and, gv.all(-1), gv.any(-1))
         # Kleene: a certain-hit slot decides OR; a missed slot is always
@@ -483,10 +1194,9 @@ def eval_verdicts(
     # the whole conjunction false.
     neg_present = jnp.zeros((B, NM), dtype=bool)
     neg_decided_false = jnp.zeros((B, NM), dtype=bool)
-    for bucket in db.m_negslot_buckets:
-        gv = value_bits[:, bucket.idx]
-        gu = uncertain_bits[:, bucket.idx]
-        rows = jnp.asarray(bucket.rows)
+    for rows, idx in arrays["m_negslot_buckets"]:
+        gv = value_bits[:, idx]
+        gu = uncertain_bits[:, idx]
         neg_present = neg_present.at[:, rows].set(gv.any(-1))
         neg_decided_false = neg_decided_false.at[:, rows].set(
             (gv & ~gu).any(-1)
@@ -494,9 +1204,8 @@ def eval_verdicts(
         m_unc = m_unc.at[:, rows].max(gu.any(-1))
 
     # --- scalar programs ---
-    var_id = db.m_scalar[:, :, 0].astype(np.int32)  # [NM, C] static
-    op_id = db.m_scalar[:, :, 1].astype(np.int32)
-    cmp_val = jnp.asarray(db.m_scalar[:, :, 2])  # [NM, C] f32
+    var_id = arrays["scalar_var"]  # [NM, C]
+    cmp_val = arrays["scalar_cmp"]  # [NM, C] f32
     v = svars[:, var_id]  # [B, NM, C]
     checks = [
         v == cmp_val,  # SOP_EQ
@@ -507,25 +1216,29 @@ def eval_verdicts(
         v >= cmp_val,
         jnp.ones_like(v, dtype=bool),  # SOP_TRUE
     ]
-    conj = jnp.select(
-        [op_id[None] == i for i in range(len(checks))], checks, default=False
-    )
+    # host-precomputed one-hot op selection (compile.scalar_onehot_np):
+    # exactly one check is selected per conjunct, so OR-accumulating
+    # the masked checks IS the select — with no [NM, C] id-compare
+    # planes left for XLA's constant folder to chew on
+    onehot = arrays["scalar_onehot"]  # [NCHECKS, NM, C] bool
+    conj = jnp.zeros_like(v, dtype=bool)
+    for i, c in enumerate(checks):
+        conj = conj | (onehot[i][None] & c)
     scalar_ok = conj.all(-1)  # [B, NM]
 
     # --- status / size matchers ---
-    status_ok = (status[:, None, None] == jnp.asarray(db.m_status)[None]).any(-1)
+    status_ok = (status[:, None, None] == arrays["m_status"][None]).any(-1)
     len_streams = jnp.stack(
         [lengths[name] for name in STREAMS], axis=1
     )  # [B, len(STREAMS)]
-    size_sel = len_streams[:, db.m_size_stream]  # [B, NM]
-    size_ok = (size_sel[:, :, None] == jnp.asarray(db.m_size)[None]).any(-1)
+    size_sel = len_streams[:, arrays["m_size_stream"]]  # [B, NM]
+    size_ok = (size_sel[:, :, None] == arrays["m_size"][None]).any(-1)
 
-    kind = db.m_kind  # static numpy
-    is_regex_prefilter = jnp.asarray(kind == fpc.MK_REGEX_PREFILTER)
-    is_words = jnp.asarray((kind == fpc.MK_WORDS) | (kind == fpc.MK_REGEX_PREFILTER))
-    is_scalar = jnp.asarray(kind == fpc.MK_SCALAR_DSL)
-    is_status = jnp.asarray(kind == fpc.MK_STATUS)
-    is_size = jnp.asarray(kind == fpc.MK_SIZE)
+    is_regex_prefilter = arrays["is_rx_prefilter"]
+    is_words = arrays["is_words"]
+    is_scalar = arrays["is_scalar"]
+    is_status = arrays["is_status"]
+    is_size = arrays["is_size"]
 
     # device md5 digest equality (md5(body) == "<hex>" dsl conjuncts).
     # Fail CLOSED without a digest: the matcher keeps its superset value
@@ -533,14 +1246,14 @@ def eval_verdicts(
     # costs host confirms — never silent false hits.
     has_md5 = bool(db.m_md5_check.any())
     if md5_digest is not None:
-        md5_ok = (~jnp.asarray(db.m_md5_check))[None, :] | (
+        md5_ok = (~arrays["m_md5_check"])[None, :] | (
             md5_digest[:, None, :].astype(jnp.uint32)
-            == jnp.asarray(db.m_md5)[None]
+            == arrays["m_md5"][None]
         ).all(-1)
     else:
         md5_ok = jnp.ones((B, NM), dtype=bool)
         if has_md5:
-            m_unc = m_unc | jnp.asarray(db.m_md5_check)[None, :]
+            m_unc = m_unc | arrays["m_md5_check"][None, :]
 
     m_value = jnp.zeros((B, NM), dtype=bool)
     m_value = jnp.where(is_words[None, :], slot_red, m_value)
@@ -559,7 +1272,7 @@ def eval_verdicts(
         is_scalar[None, :] & (~scalar_ok | ~md5_ok | neg_decided_false)
     )
     # md5-style residues: a scalar pass still needs host confirmation
-    m_unc = m_unc | (jnp.asarray(db.m_residue)[None, :] & m_value)
+    m_unc = m_unc | (arrays["m_residue"][None, :] & m_value)
     # regex prefilters are *semantically* uncertain when fired: the
     # required literal being byte-verified present does not prove the
     # regex matches, so the fired bit always needs host confirmation
@@ -570,21 +1283,20 @@ def eval_verdicts(
     # only budget-overflow pairs stay uncertain.
     if rx is not None and len(db.rx_m_ids):
         rx_value, rx_unc = rx
-        ids = jnp.asarray(db.rx_m_ids)
+        ids = arrays["rx_m_ids"]
         m_value = m_value.at[:, ids].set(rx_value)
         m_unc = m_unc.at[:, ids].set(rx_unc)
     # negation after uncertainty capture
-    m_value = m_value ^ jnp.asarray(db.m_negative)[None, :]
+    m_value = m_value ^ arrays["m_negative"][None, :]
 
     # --- operations ---
     NOP = db.op_cond_and.shape[0]
     op_value = jnp.zeros((B, NOP), dtype=bool)
     op_unc = jnp.zeros((B, NOP), dtype=bool)
-    op_cond = jnp.asarray(db.op_cond_and)
-    for bucket in db.op_m_buckets:
-        gv = m_value[:, bucket.idx]
-        gu = m_unc[:, bucket.idx]
-        rows = jnp.asarray(bucket.rows)
+    op_cond = arrays["op_cond_and"]
+    for rows, idx in arrays["op_m_buckets"]:
+        gv = m_value[:, idx]
+        gu = m_unc[:, idx]
         is_and = op_cond[rows][None, :]
         red = jnp.where(is_and, gv.all(-1), gv.any(-1))
         # Kleene: certain-true matcher decides OR; certain-false decides
@@ -599,17 +1311,16 @@ def eval_verdicts(
     # refinement above does not apply — the op is uncertain exactly when
     # it fired, certain-false otherwise, and fired rows are
     # host-confirmed at op granularity.
-    is_pref = jnp.asarray(db.op_prefilter)[None, :]
+    is_pref = arrays["op_prefilter"][None, :]
     op_unc = jnp.where(is_pref, op_value, op_unc)
 
     # --- templates: OR over their operations ---
     NT = max(db.num_templates, 1)
     t_value = jnp.zeros((B, NT), dtype=bool)
     t_unc = jnp.zeros((B, NT), dtype=bool)
-    for bucket in db.t_op_buckets:
-        gv = op_value[:, bucket.idx]
-        gu = op_unc[:, bucket.idx]
-        rows = jnp.asarray(bucket.rows)
+    for rows, idx in arrays["t_op_buckets"]:
+        gv = op_value[:, idx]
+        gu = op_unc[:, idx]
         t_value = t_value.at[:, rows].set(gv.any(-1))
         # Kleene: any certain-true op decides the template-level OR
         t_unc = t_unc.at[:, rows].set(

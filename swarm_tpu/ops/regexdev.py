@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from swarm_tpu.fingerprints import compile as fpc
 from swarm_tpu.ops.encoding import STREAMS
@@ -36,12 +35,18 @@ def regex_verify(
     lengths: dict,
     value_bits,
     k_pairs: int,
+    arrays: dict | None = None,
 ):
     """→ (rx_value [B, NRXM] bool, rx_unc [B, NRXM] bool).
 
     ``value_bits`` are the post-combine slot bits (the literal
     prefilters gate which pairs run). ``streams`` must be the FULL
     per-row byte streams (sequence-sharded callers gather first).
+
+    ``arrays`` is the rx half of the argument layout
+    (``compile.rx_arrays_np``) — pass the device-resident pytree and
+    the program/bytemap tables stay out of the compiled executable;
+    omit it for the legacy constants behavior.
     """
     NRXM = len(db.rx_m_ids)
     some = next(iter(streams.values()))
@@ -49,20 +54,22 @@ def regex_verify(
     if NRXM == 0:
         z = jnp.zeros((B, 1), dtype=bool)
         return z, z
+    if arrays is None:
+        import jax as _jax
+
+        arrays = _jax.tree_util.tree_map(
+            jnp.asarray, fpc.rx_arrays_np(db)
+        )
 
     # --- fired gate, per sequence: OR over the owning pattern's
     # literal slots; literal-less sequences scan every row (rationed
     # by the compiler's rx_always_budget) ---
-    seq_matcher = jnp.asarray(db.rx_seq_matcher)
+    seq_matcher = arrays["seq_matcher"]
     NSEQ = db.rx_seq_matcher.shape[0]
-    fired_seq = jnp.broadcast_to(
-        jnp.asarray(db.rx_seq_always)[None, :], (B, NSEQ)
-    )
-    for bucket in db.rx_seq_slot_buckets:
-        gv = value_bits[:, bucket.idx]
-        fired_seq = fired_seq.at[:, jnp.asarray(bucket.rows)].max(
-            gv.any(-1)
-        )
+    fired_seq = jnp.broadcast_to(arrays["seq_always"][None, :], (B, NSEQ))
+    for rows, idx_b in arrays["slot_buckets"]:
+        gv = value_bits[:, idx_b]
+        fired_seq = fired_seq.at[:, rows].max(gv.any(-1))
 
     # --- compact fired pairs under a fixed budget ---
     flat = fired_seq.reshape(-1)
@@ -73,18 +80,10 @@ def regex_verify(
     pair_b = safe // NSEQ
     pair_s = safe % NSEQ
 
-    # --- stacked stream variants (static set, from the compiled db) ---
-    variants = sorted(
-        {
-            (int(s), bool(c))
-            for s, c in zip(db.rx_seq_stream, db.rx_seq_ci)
-        }
-    )
-    var_of_seq = np.zeros((max(NSEQ, 1),), dtype=np.int32)
-    for si in range(NSEQ):
-        var_of_seq[si] = variants.index(
-            (int(db.rx_seq_stream[si]), bool(db.rx_seq_ci[si]))
-        )
+    # --- stacked stream variants (static SET from the compiled db;
+    # the per-seq variant ids ride the argument pytree) ---
+    variants = fpc.rx_variants(db)
+    var_of_seq = arrays["var_of_seq"]
     w_max = max(streams[STREAMS[s]].shape[1] for s, _ in variants)
     bufs = []
     lens = []
@@ -101,21 +100,21 @@ def regex_verify(
     stacked = jnp.stack(bufs, axis=1)  # [B, V, w_max]
     len_stack = jnp.stack(lens, axis=1)  # [B, V]
 
-    pair_var = jnp.asarray(var_of_seq)[pair_s]
+    pair_var = var_of_seq[pair_s]
     pair_bytes = stacked[pair_b, pair_var]  # [K, w_max]
     pair_len = len_stack[pair_b, pair_var]  # [K]
 
     # --- per-pair program masks ([K, L] state lanes) ---
-    bytemap = jnp.asarray(db.rx_bytemap)  # [NSEQ, 256, L]
+    bytemap = arrays["bytemap"]  # [NSEQ, 256, L]
     L = db.rx_bytemap.shape[2]
-    seed = jnp.asarray(db.rx_seed)[pair_s]  # [K, L]
-    skip = jnp.asarray(db.rx_skip)[pair_s]
-    accept = jnp.asarray(db.rx_accept)[pair_s]
-    sloop = jnp.asarray(db.rx_self)[pair_s]
-    anchored = jnp.asarray(db.rx_anchored)[pair_s][:, None]  # [K, 1]
-    end_mode = jnp.asarray(db.rx_end_mode)[pair_s]  # [K]
-    start_wb = jnp.asarray(db.rx_start_wb)[pair_s]
-    end_wb = jnp.asarray(db.rx_end_wb)[pair_s]
+    seed = arrays["seed"][pair_s]  # [K, L]
+    skip = arrays["skip"][pair_s]
+    accept = arrays["accept"][pair_s]
+    sloop = arrays["self"][pair_s]
+    anchored = arrays["anchored"][pair_s][:, None]  # [K, 1]
+    end_mode = arrays["end_mode"][pair_s]  # [K]
+    start_wb = arrays["start_wb"][pair_s]
+    end_wb = arrays["end_wb"][pair_s]
     r_closure = int(db.rx_max_skip_run)
 
     from swarm_tpu.fingerprints.regexlin import (

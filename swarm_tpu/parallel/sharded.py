@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from swarm_tpu.fingerprints import compile as fpc
 from swarm_tpu.ops import hashing
-from swarm_tpu.ops.match import eval_verdicts, match_slots
+from swarm_tpu.ops.match import eval_verdicts, match_slots_args
 from swarm_tpu.ops.md5 import md5_words
 
 
@@ -101,6 +101,58 @@ def shard_tables_np(db: fpc.CompiledDB, ranks: int) -> list[dict]:
     return stacked
 
 
+def shard_stacked_np(db: fpc.CompiledDB, ranks: int) -> dict:
+    """Model-sharded twin of ``compile.stack_tables_np``: one stacked
+    table-major pytree per rank, with a leading [ranks] axis to shard
+    over 'model'. Built on :func:`shard_tables_np` (same slicing, same
+    per-rank blooms, same sentinels) and padded to rank-global
+    Gmax/Emax so every rank's executable sees one shape."""
+    per_table = shard_tables_np(db, ranks)
+    T = len(per_table)
+    if T == 0:
+        base = fpc.stack_tables_np([])
+        return {
+            k: np.repeat(v[None], ranks, axis=0) for k, v in base.items()
+        }
+    gmax = max(t["group_h1"].shape[1] for t in per_table)
+    emax = max(t["entry_h2"].shape[1] for t in per_table)
+    out = {
+        "group_h1": np.full((ranks, T, gmax), 0xFFFFFFFF, dtype=np.uint32),
+        "entry_start": np.zeros((ranks, T, gmax), dtype=np.int32),
+        "entry_count": np.zeros((ranks, T, gmax), dtype=np.int32),
+        "entry_h2": np.zeros((ranks, T, emax), dtype=np.uint32),
+        "entry_slot": np.zeros((ranks, T, emax), dtype=np.int32),
+        "entry_off": np.zeros((ranks, T, emax), dtype=np.int32),
+        "entry_len": np.full((ranks, T, emax), 1 << 30, dtype=np.int32),
+        "entry_suf_delta": np.zeros((ranks, T, emax), dtype=np.int32),
+        "entry_suf_h1": np.zeros((ranks, T, emax), dtype=np.uint32),
+        "entry_suf_h2": np.zeros((ranks, T, emax), dtype=np.uint32),
+        "bloom": np.zeros(
+            (ranks, T, hashing.BLOOM_WORDS), dtype=np.uint32
+        ),
+        "n_groups": np.zeros((ranks, T), dtype=np.int32),
+    }
+    for t_idx, arrs in enumerate(per_table):
+        g = arrs["group_h1"].shape[1]
+        e = arrs["entry_h2"].shape[1]
+        for name in (
+            "group_h1", "entry_start", "entry_count",
+        ):
+            out[name][:, t_idx, :g] = arrs[name]
+        for name in (
+            "entry_h2", "entry_slot", "entry_off", "entry_len",
+            "entry_suf_delta", "entry_suf_h1", "entry_suf_h2",
+        ):
+            out[name][:, t_idx, :e] = arrs[name]
+        out["bloom"][:, t_idx] = arrs["bloom"]
+        # real (unpadded) group counts per rank — the binary-search
+        # bound. Derived from the slices shard_tables_np actually
+        # built (every real group has >= 1 entry, padding has 0), so
+        # any future change to its slicing rule stays in lockstep.
+        out["n_groups"][:, t_idx] = (arrs["entry_count"] > 0).sum(axis=1)
+    return out
+
+
 def max_entry_len(db: fpc.CompiledDB) -> int:
     out = int(hashing.GRAM_LONG)
     for table in db.tables:
@@ -139,7 +191,21 @@ class ShardedMatcher:
     def __post_init__(self):
         self.ranks = {name: int(self.mesh.shape[name]) for name in self.mesh.axis_names}
         self.halo = max_entry_len(self.db) if self.ranks.get("seq", 1) > 1 else 0
-        self._tables_np = shard_tables_np(self.db, self.ranks.get("model", 1))
+        # the SAME argument-pytree convention as DeviceDB
+        # (docs/DEVICE_MATCH.md): per-rank stacked word tables shard
+        # over 'model'; the verdict/rx/slot arrays replicate. Uploaded
+        # once here, passed as jit arguments every call — the compiled
+        # step is corpus-size-free on the sharded path too.
+        self.meta = fpc.layout_meta(self.db)
+        self._tab_np = shard_stacked_np(self.db, self.ranks.get("model", 1))
+        self._rep_np = {
+            "slot_bytes": self.db.slot_bytes,
+            "slot_len": self.db.slot_len,
+            "tiny_bytes": self.db.tiny_bytes,
+            "tiny_slot": self.db.tiny_slot,
+            "verdict": fpc.verdict_arrays_np(self.db),
+            "rx": fpc.rx_arrays_np(self.db),
+        }
         # multi-host (jax.distributed) meshes span devices this process
         # cannot address: inputs must become GLOBAL jax.Arrays (every
         # process holds the full host copy; each device takes its
@@ -151,15 +217,15 @@ class ShardedMatcher:
         )
         # constant after construction — upload once, not per match call
         if self.multiprocess:
-            self._tables_j = [
-                {k: self._global(v, P("model")) for k, v in t.items()}
-                for t in self._tables_np
-            ]
+            self._tab_j = {
+                k: self._global(v, P("model")) for k, v in self._tab_np.items()
+            }
+            self._rep_j = jax.tree_util.tree_map(
+                lambda a: self._global(a, P()), self._rep_np
+            )
         else:
-            self._tables_j = [
-                {k: jnp.asarray(v) for k, v in t.items()}
-                for t in self._tables_np
-            ]
+            self._tab_j = {k: jnp.asarray(v) for k, v in self._tab_np.items()}
+            self._rep_j = jax.tree_util.tree_map(jnp.asarray, self._rep_np)
         self._fn_cache: dict = {}
 
     def _global(self, arr, spec):
@@ -174,10 +240,11 @@ class ShardedMatcher:
     # ------------------------------------------------------------------
     def _build(self, shape_key, full: bool = False):
         db, halo = self.db, self.halo
+        meta = self.meta
         seq_ranks = self.ranks.get("seq", 1)
         candidate_k = self.candidate_k
 
-        def step(tables, streams, lengths, status):
+        def step(tab, rep, streams, lengths, status):
             # --- halo exchange over 'seq' (no-op when unsharded) ---
             back = fwd = 0
             offsets = 0
@@ -202,13 +269,22 @@ class ShardedMatcher:
                 streams_ext = ext
                 back = fwd = halo
 
-            # --- probe with this rank's table slices ---
-            value_bits, uncertain_bits, overflow = match_slots(
+            # --- probe with this rank's table slices (two-phase
+            # argument-driven kernel, ops/match.py) ---
+            arrays = {
+                "tab": {k: v[0] for k, v in tab.items()},
+                "slot_bytes": rep["slot_bytes"],
+                "slot_len": rep["slot_len"],
+                "tiny_bytes": rep["tiny_bytes"],
+                "tiny_slot": rep["tiny_slot"],
+            }
+            value_bits, uncertain_bits, overflow = match_slots_args(
                 db,
+                meta,
+                arrays,
                 candidate_k,
                 streams_ext,
                 lengths,
-                table_arrays=[{k: v[0] for k, v in t.items()} for t in tables],
                 pos_offset=offsets,
                 back_halo=back,
                 fwd_halo=fwd,
@@ -257,6 +333,7 @@ class ShardedMatcher:
                     lengths,
                     value_bits,
                     k_pairs=db.rx_k_pairs(status.shape[0]),
+                    arrays=rep["rx"],
                 )
             out = eval_verdicts(
                 db,
@@ -267,6 +344,7 @@ class ShardedMatcher:
                 full=full,
                 md5_digest=digest,
                 rx=rx,
+                arrays=rep["verdict"],
             )
             if full:
                 # pack bit planes per data-rank (axis 1 is unsharded, so
@@ -278,24 +356,32 @@ class ShardedMatcher:
                 return fuse_planes(out, overflow)
             return (*out, overflow)
 
-        shard_map = jax.shard_map
+        # jax.shard_map landed post-0.4.x; older jax ships it under
+        # experimental with check_rep instead of check_vma
+        try:
+            smap = jax.shard_map
+            smap_kwargs = {"check_vma": False}
+        except AttributeError:
+            from jax.experimental.shard_map import shard_map as smap
+
+            smap_kwargs = {"check_rep": False}
         mesh = self.mesh
         stream_spec = {k: P("data", "seq") for k in shape_key["streams"]}
-        table_specs = [
-            {name: P("model") for name in t} for t in self._tables_np
-        ]
+        tab_specs = {name: P("model") for name in self._tab_np}
+        rep_specs = jax.tree_util.tree_map(lambda _a: P(), self._rep_np)
         out_specs = P("data") if full else (P("data"),) * 3
-        fn = shard_map(
+        fn = smap(
             step,
             mesh=mesh,
             in_specs=(
-                table_specs,
+                tab_specs,
+                rep_specs,
                 stream_spec,
                 {k: P("data") for k in shape_key["lengths"]},
                 P("data"),
             ),
             out_specs=out_specs,
-            check_vma=False,
+            **smap_kwargs,
         )
         return jax.jit(fn)
 
@@ -336,14 +422,16 @@ class ShardedMatcher:
             lru_store(self._fn_cache, cache_key, fn, MAX_COMPILED)
         if self.multiprocess:
             args = (
-                self._tables_j,
+                self._tab_j,
+                self._rep_j,
                 {k: self._global(v, P("data", "seq")) for k, v in streams.items()},
                 {k: self._global(v, P("data")) for k, v in lengths.items()},
                 self._global(status, P("data")),
             )
         else:
             args = (
-                self._tables_j,
+                self._tab_j,
+                self._rep_j,
                 {k: jnp.asarray(v) for k, v in streams.items()},
                 {k: jnp.asarray(v) for k, v in lengths.items()},
                 jnp.asarray(status),

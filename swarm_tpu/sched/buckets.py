@@ -24,8 +24,11 @@ The encode path draws its matrices from ``encoding._RotatingPool``
 keyed per (rows, width, role) — each bucket shape rotates its own
 recycled buffers, so alternating buckets never re-fault fresh pages.
 
-Shape budget: ``DeviceDB.MAX_COMPILED`` (8 by default) bounds the jit
-cache. The class ladder admits ``max_body/512`` body classes, but a
+Shape budget: the two-phase args kernel (docs/DEVICE_MATCH.md) takes
+the corpus as device-resident arguments, so every bucket of one width
+class shares ONE compiled executable and a shape entry is small
+(``DeviceDB.MAX_COMPILED`` still bounds the sharded matcher's pjit
+cache). The class ladder admits ``max_body/512`` body classes, but a
 real scan mix keeps a handful live — and crucially no MORE shapes than
 the direct per-chunk path, whose per-batch max lands on the same
 512-multiple ladder unpredictably; the planner makes each live shape

@@ -38,6 +38,11 @@ def _gauges() -> dict:
                 "swarm_engine_device_seconds",
                 "Seconds spent in device kernel dispatch",
             ),
+            device_compile_seconds=g(
+                "swarm_engine_device_compile_seconds",
+                "Seconds spent compiling device match executables "
+                "(new batch shapes)",
+            ),
             host_confirm_seconds=g(
                 "swarm_engine_host_confirm_seconds",
                 "Seconds spent in the sparse host confirmation walk",
@@ -85,7 +90,7 @@ def _collect() -> None:
     with _lock:
         engines = list(_engines)
     rows = batches = confirm_pairs = always_pairs = overflow = memo = 0
-    dev_s = confirm_s = 0.0
+    dev_s = confirm_s = compile_s = 0.0
     capacity = 0
     for eng in engines:
         s = eng.stats
@@ -96,12 +101,14 @@ def _collect() -> None:
         overflow += s.overflow_rows
         memo += s.memo_slots
         dev_s += s.device_seconds
+        compile_s += getattr(s, "device_compile_seconds", 0.0)
         confirm_s += s.host_confirm_seconds
         capacity += s.batches * getattr(eng, "batch_rows", 0)
     g["engines"].set(len(engines))
     g["rows"].set(rows)
     g["batches"].set(batches)
     g["device_seconds"].set(dev_s)
+    g["device_compile_seconds"].set(compile_s)
     g["host_confirm_seconds"].set(confirm_s)
     g["host_confirm_pairs"].set(confirm_pairs)
     g["host_always_pairs"].set(always_pairs)

@@ -18,6 +18,59 @@ from typing import Optional
 
 DEFAULT_CACHE_DIR = "~/.cache/swarm_tpu/xla"
 _active_dir: Optional[str] = None
+_metrics_installed = False
+
+#: jax.monitoring event names the persistent cache emits (jax/_src/
+#: compiler.py + compilation_cache.py) — one listener maps them onto
+#: the swarm counters.
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _cache_counters():
+    from swarm_tpu.telemetry import REGISTRY
+
+    hit = REGISTRY.counter(
+        "swarm_xla_cache_hit_total",
+        "Persistent XLA compilation cache hits (executable deserialized "
+        "instead of recompiled)",
+    )
+    miss = REGISTRY.counter(
+        "swarm_xla_cache_miss_total",
+        "Persistent XLA compilation cache misses (fresh compile written "
+        "back to the cache)",
+    )
+    return hit, miss
+
+
+def _cache_event_listener(event: str, **_kw) -> None:
+    """jax.monitoring → telemetry bridge (module-level so tests can
+    drive it with synthetic events)."""
+    hit, miss = _cache_counters()
+    if event == _HIT_EVENT:
+        hit.inc()
+    elif event == _MISS_EVENT:
+        miss.inc()
+
+
+def install_cache_metrics() -> bool:
+    """Idempotently register the swarm_xla_cache_{hit,miss}_total
+    counters on JAX's monitoring stream. Separate from
+    :func:`enable_compilation_cache` so fleet code can re-assert the
+    wiring; returns whether the listener is installed. Without these,
+    a fleet restart can't tell whether the persistent cache actually
+    served (the whole point of shipping it)."""
+    global _metrics_installed
+    if _metrics_installed:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:  # pragma: no cover - jax always present here
+        return False
+    _cache_counters()  # register the families even before any event
+    monitoring.register_event_listener(_cache_event_listener)
+    _metrics_installed = True
+    return True
 
 
 def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
@@ -52,5 +105,6 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
     # aren't worth the disk round-trip
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    install_cache_metrics()  # hit/miss counters ride every enable
     _active_dir = str(path)
     return _active_dir

@@ -738,9 +738,17 @@ def main(argv: Optional[list[str]] = None) -> None:
     # (SWARM_COORDINATOR/-NUM_PROCESSES/-PROCESS_ID) so the tpu
     # backend's mesh spans every host's chips; no-op single-host
     from swarm_tpu.parallel.multihost import maybe_initialize_distributed
-    from swarm_tpu.utils.xlacache import enable_compilation_cache
+    from swarm_tpu.utils.xlacache import (
+        enable_compilation_cache,
+        install_cache_metrics,
+    )
 
     enable_compilation_cache()  # warm restarts skip the corpus recompile
+    # swarm_xla_cache_{hit,miss}_total: fleet restarts must show on
+    # /metrics whether the persistent cache is actually serving —
+    # installed even when the cache dir is disabled (counters then
+    # simply stay dark, instead of silently missing from the scrape)
+    install_cache_metrics()
     if maybe_initialize_distributed():
         print("multi-host: jax.distributed initialized")
     proc = JobProcessor(cfg)

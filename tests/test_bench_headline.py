@@ -28,6 +28,13 @@ def test_baseline_targets_all_positive():
         assert target > 0, metric
 
 
+def test_two_phase_time_baselines_present():
+    # ISSUE 3: the BENCH trajectory must track the kernel's compile and
+    # fresh-batch device time against the pre-change records
+    assert bench.BASELINES["device_compile_seconds"] == 124.0
+    assert bench.BASELINES["fresh_batch_device_ms"] == 14200.0
+
+
 def test_emit_record_shape():
     import io
     from contextlib import redirect_stdout

@@ -1,0 +1,317 @@
+"""Two-phase corpus-as-arguments match kernel (docs/DEVICE_MATCH.md).
+
+Pins the ISSUE-3 acceptance contracts:
+
+- plane parity: the argument-driven prefilter→gather-verify kernel is
+  bit-identical to the pre-change packed kernel (value/uncertain/op/
+  matcher planes AND overflow), including halo-extended seq-sharded
+  stream views;
+- engine exactness survives candidate overflow (global budget rows
+  host-redo);
+- corpus arrays are jit ARGUMENTS: no corpus-sized constants in the
+  lowered HLO (and the legacy path, which inlines them, is the
+  positive control for the scan);
+- width buckets of one shape class share ONE compiled executable
+  (compile-count spy);
+- swarm_xla_cache_{hit,miss}_total counters observe the persistent
+  compilation cache's monitoring events.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swarm_tpu.fingerprints import load_corpus
+from swarm_tpu.fingerprints.compile import (
+    build_device_layout,
+    compile_corpus,
+)
+from swarm_tpu.ops.encoding import encode_batch
+from swarm_tpu.ops.match import (
+    DeviceDB,
+    _match_impl,
+    fuse_planes,
+    match_slots,
+    match_slots_args,
+    split_fused,
+)
+
+from test_match_parity import fuzz_rows
+
+DATA = "tests/data/templates"
+
+
+@pytest.fixture(scope="module")
+def world():
+    templates, errors = load_corpus(DATA)
+    assert templates and not errors
+    db = compile_corpus(templates)
+    rows = fuzz_rows(templates, random.Random(57), 16)
+    batch = encode_batch(rows, max_body=512, max_header=512, pad_rows_to=16)
+    return templates, db, rows, batch
+
+
+def _legacy_full(db, batch):
+    def ref(streams, lengths, status):
+        *planes, overflow = _match_impl(
+            db, 128, streams, lengths, status, full=True
+        )
+        return fuse_planes(planes, overflow)
+
+    out = jax.jit(ref)(
+        {k: jnp.asarray(v) for k, v in batch.streams.items()},
+        {k: jnp.asarray(v) for k, v in batch.lengths.items()},
+        jnp.asarray(batch.status),
+    )
+    return split_fused(db, np.asarray(out))
+
+
+def test_planes_bit_equal_to_legacy_kernel(world):
+    """New args kernel ≡ pre-change constants kernel: every packed
+    plane and the overflow column, bit for bit."""
+    _t, db, _rows, batch = world
+    dev = DeviceDB(db)
+    new = dev.match(batch.streams, batch.lengths, batch.status, full=True)
+    old = _legacy_full(db, batch)
+    names = ("t_value", "t_unc", "op_value", "op_unc", "m_unc", "overflow")
+    for name, a, b in zip(names, new, old):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=name
+        )
+
+
+def test_slot_planes_match_legacy_with_halos(world):
+    """Halo-extended view (the seq-sharded calling convention):
+    value/uncertain planes AND overflow bit-equal between the legacy
+    per-table kernel and the two-phase kernel on identical inputs."""
+    _t, db, _rows, batch = world
+    meta, arrays_np = build_device_layout(db)
+    arrays = jax.tree_util.tree_map(jnp.asarray, arrays_np)
+    halo = 24
+    ext = {
+        k: np.pad(v, ((0, 0), (halo, halo)))
+        for k, v in batch.streams.items()
+    }
+    lengths = batch.lengths
+    for pos_offset in (0, {k: 3 for k in batch.streams}):
+        def legacy(streams):
+            return match_slots(
+                db, 128, streams, lengths,
+                pos_offset=pos_offset, back_halo=halo, fwd_halo=halo,
+            )
+
+        def args_path(streams):
+            return match_slots_args(
+                db, meta, arrays, 128, streams, lengths,
+                pos_offset=pos_offset, back_halo=halo, fwd_halo=halo,
+            )
+
+        ext_j = {k: jnp.asarray(v) for k, v in ext.items()}
+        lv, lu, lo = (np.asarray(x) for x in jax.jit(legacy)(ext_j))
+        av, au, ao = (np.asarray(x) for x in jax.jit(args_path)(ext_j))
+        np.testing.assert_array_equal(av, lv)
+        np.testing.assert_array_equal(au, lu)
+        np.testing.assert_array_equal(ao, lo)
+
+
+def test_engine_oracle_parity_on_two_phase_path(world):
+    """End-to-end MatchEngine (two-phase device path) ≡ CPU oracle."""
+    from swarm_tpu.ops import cpu_ref
+    from swarm_tpu.ops.engine import MatchEngine
+
+    templates, db, rows, _batch = world
+    eng = MatchEngine(
+        templates, mesh=None, batch_rows=16, max_body=512, max_header=512,
+        db=db,
+    )
+    got = eng.match(rows)
+    for b, row in enumerate(rows):
+        want = {
+            t.id for t in eng.db.templates
+            if cpu_ref.match_template(t, row).matched
+        }
+        assert set(got[b].template_ids) == want, (b, got[b].template_ids)
+
+
+def test_overflow_budget_is_sound(world):
+    """The global candidate budget: a row with more fired windows than
+    K sets overflow, and the engine's host redo keeps verdicts exact."""
+    from swarm_tpu.fingerprints.model import Response
+    from swarm_tpu.ops import cpu_ref
+    from swarm_tpu.ops.engine import MatchEngine
+
+    templates, db, _rows, _batch = world
+    # stuff one body with many real gram hits (corpus words repeated)
+    words = [
+        m.words[0].encode()
+        for t in templates
+        for _, m in t.all_matchers()
+        if m.words
+    ][:4]
+    stuffed = b" ".join(words * 16)
+    rows = [
+        Response(host="a", port=80, status=200, body=stuffed,
+                 header=b"HTTP/1.1 200 OK\r\nServer: nginx"),
+        Response(host="b", port=80, status=200, body=b"plain",
+                 header=b"HTTP/1.1 200 OK"),
+    ]
+    batch = encode_batch(rows, max_body=2048, max_header=256, pad_rows_to=2)
+    tight = DeviceDB(db, candidate_k=2)
+    _tv, _tu, ovf = tight.match(batch.streams, batch.lengths, batch.status)
+    assert bool(np.asarray(ovf)[0]), "stuffed row must overflow K=2"
+    # engine with the same tight budget: overflow rows re-run on host,
+    # so the final verdicts still match the oracle exactly
+    eng = MatchEngine(
+        templates, mesh=None, batch_rows=4, max_body=2048, max_header=256,
+        db=db, candidate_k=2,
+    )
+    got = eng.match(rows)
+    for b, row in enumerate(rows):
+        want = {
+            t.id for t in eng.db.templates
+            if cpu_ref.match_template(t, row).matched
+        }
+        assert set(got[b].template_ids) == want
+
+
+# ---------------------------------------------------------------------------
+# HLO constants / executable sharing
+# ---------------------------------------------------------------------------
+
+def _max_constant_elems(hlo_text: str) -> int:
+    """Largest constant tensor (element count) in a StableHLO dump."""
+    biggest = 0
+    for line in hlo_text.splitlines():
+        if "constant" not in line:
+            continue
+        for m in re.finditer(r"tensor<([0-9]+(?:x[0-9]+)*)x?[a-z]", line):
+            dims = [int(d) for d in m.group(1).split("x") if d]
+            n = 1
+            for d in dims:
+                n *= d
+            biggest = max(biggest, n)
+    return biggest
+
+
+def test_no_corpus_sized_constants_in_lowered_hlo(world):
+    """Corpus arrays are jit arguments, not constants: the lowered
+    program of the args kernel contains no corpus-sized constant —
+    asserted against the largest table's footprint (every table's
+    bloom alone is BLOOM_WORDS=8192 words). The legacy kernel is the
+    positive control: it MUST show such constants, proving the scan
+    actually sees them."""
+    from swarm_tpu.ops import hashing
+
+    _t, db, _rows, batch = world
+    dev = DeviceDB(db)
+    txt = dev.lowered_text(batch.streams, batch.lengths, batch.status)
+    floor = min(
+        hashing.BLOOM_WORDS,
+        max(int(t.entry_h2.shape[0]) for t in db.tables) or 1 << 30,
+    )
+    # anything at/above half a bloom is corpus data; the kernel's real
+    # constants (iota offsets, col starts, md5 round tables) are tiny
+    assert _max_constant_elems(txt) < max(floor, 4096), (
+        "corpus-sized constant leaked into the args kernel HLO"
+    )
+
+    def ref(streams, lengths, status):
+        return _match_impl(db, 128, streams, lengths, status, full=True)
+
+    legacy_txt = jax.jit(ref).lower(
+        {k: jnp.asarray(v) for k, v in batch.streams.items()},
+        {k: jnp.asarray(v) for k, v in batch.lengths.items()},
+        jnp.asarray(batch.status),
+    ).as_text()
+    assert _max_constant_elems(legacy_txt) >= hashing.BLOOM_WORDS, (
+        "positive control failed: legacy kernel should inline the bloom"
+    )
+
+
+def test_width_buckets_share_one_executable(world):
+    """Two batches whose raw widths differ but land in the same padded
+    width class must reuse ONE compiled executable (the compile-count
+    spy) — and a genuinely new shape compiles exactly one more."""
+    from swarm_tpu.fingerprints.model import Response
+
+    _t, db, _rows, _batch = world
+
+    def batch_of(body_len: int, n: int):
+        rows = [
+            Response(
+                host=f"h{i}", port=80, status=200,
+                body=bytes([97 + (i % 26)]) * body_len,
+                header=b"HTTP/1.1 200 OK\r\nServer: nginx",
+            )
+            for i in range(n)
+        ]
+        return encode_batch(
+            rows, max_body=1024, max_header=256, pad_rows_to=8,
+            width_multiple=512,
+        )
+
+    dev = DeviceDB(db)
+    b1 = batch_of(100, 8)  # both bodies pad to the 512 class
+    b2 = batch_of(300, 8)
+    assert {k: v.shape for k, v in b1.streams.items()} == {
+        k: v.shape for k, v in b2.streams.items()
+    }
+    dev.match(b1.streams, b1.lengths, b1.status, full=True)
+    assert dev.executable_count(full=True) == 1
+    assert dev.compile_count == 1
+    dev.match(b2.streams, b2.lengths, b2.status, full=True)
+    assert dev.executable_count(full=True) == 1, (
+        "same width class must not recompile"
+    )
+    assert dev.compile_count == 1
+    b3 = batch_of(600, 8)  # 1024 width class: one genuinely new shape
+    dev.match(b3.streams, b3.lengths, b3.status, full=True)
+    assert dev.executable_count(full=True) == 2
+    assert dev.compile_count == 2
+    assert dev.compile_seconds > 0.0
+
+
+def test_profile_phases_reports_all_phases(world):
+    _t, db, _rows, batch = world
+    dev = DeviceDB(db)
+    phases = dev.profile_phases(batch.streams, batch.lengths, batch.status)
+    for name in (
+        "prefilter", "gather", "verify", "tiny", "regex", "verdict",
+        "transfer",
+    ):
+        assert name in phases
+        assert phases[name] >= 0.0
+    from swarm_tpu.telemetry import REGISTRY
+
+    text = REGISTRY.render()
+    assert "swarm_device_phase_ms" in text
+
+
+# ---------------------------------------------------------------------------
+# Persistent-cache hit/miss counters (utils/xlacache.py)
+# ---------------------------------------------------------------------------
+
+
+def test_xla_cache_counters_observe_monitoring_events():
+    from swarm_tpu.telemetry import REGISTRY
+    from swarm_tpu.utils import xlacache
+
+    assert xlacache.install_cache_metrics() is True
+    assert xlacache.install_cache_metrics() is True  # idempotent
+    hit, miss = xlacache._cache_counters()
+    h0, m0 = hit.labels().value, miss.labels().value
+    xlacache._cache_event_listener(xlacache._HIT_EVENT)
+    xlacache._cache_event_listener(xlacache._MISS_EVENT)
+    xlacache._cache_event_listener(xlacache._MISS_EVENT)
+    xlacache._cache_event_listener("/jax/unrelated/event")
+    assert hit.labels().value == h0 + 1
+    assert miss.labels().value == m0 + 2
+    text = REGISTRY.render()
+    assert "swarm_xla_cache_hit_total" in text
+    assert "swarm_xla_cache_miss_total" in text

@@ -21,6 +21,14 @@ echo "== preflight: scheduler parity =="
 # pipeline=on must be bit-identical to pipeline=off (docs/PIPELINE.md)
 python -m pytest tests/test_sched.py -q
 
+echo "== preflight: device microbench floor =="
+# two-phase kernel (docs/DEVICE_MATCH.md): the CPU-backend fresh
+# microbench must stay within 2x of the recorded floor
+# (tools/device_floor.json; SWARM_FLOOR_SKIP=1 on known-noisy hosts)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    SWARM_BENCH_CORPUS="tests/data/templates" \
+    python tools/profile_device.py --check-floor
+
 echo "== preflight: bench smoke (pipeline A/B, both modes) =="
 # CI-fast A/B on the bundled corpus; rc gates on verdict identity only.
 # Forced to the CPU backend unless the operator pinned one — the smoke

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Per-phase device match timing + fresh-microbench floor gate.
+
+Attribution tool for the two-phase match kernel (docs/DEVICE_MATCH.md):
+runs ONE batch through `DeviceDB.profile_phases` and prints where the
+fresh-batch milliseconds go (prefilter / gather / verify / tiny /
+regex / verdict / transfer), plus the fused production dispatch time
+for the same batch.
+
+Floor gate (preflight): ``--check-floor`` re-measures the CPU-backend
+fresh microbench and fails (rc 1) when the fused per-batch time
+regressed more than ``SWARM_FLOOR_FACTOR`` (default 2.0) over the
+recorded floor in ``tools/device_floor.json``. Record a new floor with
+``--record-floor`` after an intentional perf change. Set
+``SWARM_FLOOR_SKIP=1`` to bypass on known-noisy hosts.
+
+    python tools/profile_device.py                # phase table
+    python tools/profile_device.py --check-floor  # preflight gate
+    python tools/profile_device.py --record-floor # refresh the floor
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+FLOOR_PATH = Path(__file__).parent / "device_floor.json"
+ROWS = int(os.environ.get("SWARM_PROFILE_ROWS", "256"))
+MAX_BODY = 1024
+MAX_HEADER = 512
+REPS = 3
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _build():
+    # CPU backend unless the operator pinned one: the floor gate is a
+    # host-relative regression check, not a chip benchmark
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import bench
+    from swarm_tpu.fingerprints.dbcache import load_or_compile
+    from swarm_tpu.ops.encoding import encode_batch
+    from swarm_tpu.ops.match import DeviceDB
+
+    corpus = Path(
+        os.environ.get("SWARM_BENCH_CORPUS", "")
+        or (
+            bench.REFERENCE_CORPUS
+            if bench.REFERENCE_CORPUS.is_dir()
+            else bench.BUNDLED_CORPUS
+        )
+    )
+    templates, db = load_or_compile(corpus)
+    log(f"corpus: {len(templates)} templates ({corpus})")
+    rows = bench.realistic_rows(ROWS, seed=31)
+    batch = encode_batch(
+        rows, max_body=MAX_BODY, max_header=MAX_HEADER, pad_rows_to=ROWS
+    )
+    return DeviceDB(db), batch
+
+
+def _fused_ms(matcher, batch) -> float:
+    """Median fused dispatch+collect ms per batch (post-compile)."""
+    times = []
+    matcher.match(
+        batch.streams, batch.lengths, batch.status, full=True
+    )  # compile + warm
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        matcher.match(batch.streams, batch.lengths, batch.status, full=True)
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    matcher, batch = _build()
+
+    fused_ms = _fused_ms(matcher, batch)
+    phases = matcher.profile_phases(
+        batch.streams, batch.lengths, batch.status
+    )
+    width = max(len(k) for k in phases)
+    print(f"device match, {ROWS} rows x body<={MAX_BODY} (one batch):")
+    for name, ms in phases.items():
+        print(f"  {name:<{width}}  {ms:10.3f} ms")
+    print(f"  {'[phase sum]':<{width}}  {sum(phases.values()):10.3f} ms")
+    print(f"  {'fused dispatch':<{width}}  {fused_ms:10.3f} ms")
+    print(
+        f"  compile: {matcher.compile_seconds:.2f}s over "
+        f"{matcher.compile_count} executable(s)"
+    )
+
+    if "--record-floor" in argv:
+        rec = {
+            "fused_fresh_batch_ms": round(fused_ms, 3),
+            "rows": ROWS,
+            "max_body": MAX_BODY,
+            "backend": os.environ.get("JAX_PLATFORMS", ""),
+            "corpus_templates": len(matcher.db.template_ids),
+        }
+        FLOOR_PATH.write_text(json.dumps(rec, indent=2) + "\n")
+        log(f"floor recorded: {rec} -> {FLOOR_PATH}")
+        return 0
+
+    if "--check-floor" in argv:
+        if os.environ.get("SWARM_FLOOR_SKIP") == "1":
+            log("floor check skipped (SWARM_FLOOR_SKIP=1)")
+            return 0
+        if not FLOOR_PATH.exists():
+            log(f"no recorded floor at {FLOOR_PATH}; run --record-floor")
+            return 0  # missing floor is not a failure — first run records
+        floor = json.loads(FLOOR_PATH.read_text())
+        current = {
+            "corpus_templates": len(matcher.db.template_ids),
+            "rows": ROWS,
+            "max_body": MAX_BODY,
+            "backend": os.environ.get("JAX_PLATFORMS", ""),
+        }
+        mismatched = {
+            k: (floor.get(k), v)
+            for k, v in current.items()
+            if floor.get(k) != v
+        }
+        if mismatched:
+            log(
+                "floor check skipped: recorded floor does not match this "
+                f"configuration ({mismatched}); re-record with "
+                "--record-floor"
+            )
+            return 0
+        factor = float(os.environ.get("SWARM_FLOOR_FACTOR", "2.0"))
+        limit = floor["fused_fresh_batch_ms"] * factor
+        if fused_ms > limit:
+            log(
+                f"FLOOR REGRESSION: fused fresh batch {fused_ms:.1f} ms > "
+                f"{factor}x recorded floor "
+                f"{floor['fused_fresh_batch_ms']:.1f} ms"
+            )
+            return 1
+        log(
+            f"floor ok: {fused_ms:.1f} ms <= {factor}x "
+            f"{floor['fused_fresh_batch_ms']:.1f} ms"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
