@@ -938,13 +938,54 @@ def run_phase(phase: str) -> int:
     return 0
 
 
+def _bench_resilience_overhead() -> dict | None:
+    """Measured fault-free cost of the resilience layer's two hot-path
+    touch points (docs/RESILIENCE.md): an unarmed fault_point call and
+    the retrying-transport facade over a no-op inner client. Skipped
+    (None) when a fault plan is armed — the numbers would measure the
+    plan, not the no-op path."""
+    from swarm_tpu.resilience.faults import active_plan, fault_point
+    from swarm_tpu.resilience.transport import RetryingServerClient
+
+    if active_plan() is not None:
+        return None
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fault_point("bench.noop")
+    fp_ns = (time.perf_counter() - t0) / n * 1e9
+
+    class _Inner:
+        def get_job(self, worker_id):
+            return None
+
+    inner = _Inner()
+    wrapped = RetryingServerClient(inner)
+    m = 20_000
+    t0 = time.perf_counter()
+    for _ in range(m):
+        inner.get_job("w")
+    raw_ns = (time.perf_counter() - t0) / m * 1e9
+    t0 = time.perf_counter()
+    for _ in range(m):
+        wrapped.get_job("w")
+    wrapped_ns = (time.perf_counter() - t0) / m * 1e9
+    return {
+        "fault_point_ns": round(fp_ns, 1),
+        "transport_wrap_ns": round(max(wrapped_ns - raw_ns, 0.0), 1),
+    }
+
+
 def run_smoke() -> int:
     """CI-fast pipeline A/B (tools/preflight.sh): bundled corpus,
     tiny batches, no subprocess phases. Honors SWARM_PIPELINE as the
     engine's configured mode (recorded in the emitted line) and always
     A/Bs both modes. rc 1 on any verdict mismatch between modes — the
     exactness contract is the gate; speed is recorded, not gated
-    (preflight machines are noisy)."""
+    (preflight machines are noisy). Under SWARM_FAULT_PLAN this doubles
+    as the chaos smoke: injected faults must leave the A/B verdicts
+    identical (rc-gated), and the fault-free runs additionally record
+    the resilience layer's measured no-op overhead."""
     global ROWS, ITERS
     ROWS, ITERS = 256, 2
     os.environ.setdefault("SWARM_BENCH_CORPUS", str(BUNDLED_CORPUS))
@@ -965,13 +1006,45 @@ def run_smoke() -> int:
     speed = ab["fresh"]["on"]["rows_per_sec"] / max(
         ab["fresh"]["off"]["rows_per_sec"], 1e-9
     )
+    from swarm_tpu.resilience.faults import active_plan
+
+    plan = active_plan()
     emit(
         "smoke_pipeline_ab_fresh_speedup",
         speed,
         "x (pipeline on/off, bundled-corpus smoke)",
         speed,
-        extra={"pipeline": eng.pipeline, "ab": ab},
+        extra={
+            "pipeline": eng.pipeline,
+            "ab": ab,
+            "fault_plan": plan.spec if plan is not None else "",
+            "degraded_batches": eng.stats.degraded_batches,
+            "device_faults": eng.stats.device_faults,
+        },
     )
+    if plan is not None:
+        # chaos smoke contract: the injected faults must actually have
+        # fired (a typo'd plan silently testing nothing is a failure)
+        fired = sum(c["fired"] for c in plan.snapshot().values())
+        log(
+            f"chaos smoke: plan {plan.spec!r} fired {fired} fault(s), "
+            f"{eng.stats.degraded_batches} degraded batch(es)"
+        )
+        if not fired:
+            log("!!! fault plan armed but nothing fired — smoke FAILED")
+            return 1
+    else:
+        # fault-free run: record the resilience layer's measured no-op
+        # cost (the "provably costs nothing on the happy path" gate)
+        overhead = _bench_resilience_overhead()
+        if overhead is not None:
+            emit(
+                "resilience_faultfree_overhead_ns",
+                overhead["fault_point_ns"],
+                "ns/call (unarmed fault_point; transport wrap in extra)",
+                1.0,
+                extra=overhead,
+            )
     if not ok:
         log("!!! pipeline A/B verdict mismatch — smoke FAILED")
     return 0 if ok else 1

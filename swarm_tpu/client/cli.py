@@ -128,6 +128,20 @@ class JobClient:
         resp = self.session.post(f"{self.base}/reset", timeout=self.timeout)
         return resp.status_code, resp.text
 
+    def get_healthz(self) -> Optional[dict]:
+        resp = self.session.get(f"{self.base}/healthz", timeout=self.timeout)
+        return resp.json() if resp.status_code == 200 else None
+
+    def dead_letter_jobs(self) -> Optional[list]:
+        resp = self.session.get(f"{self.base}/dead-letter", timeout=self.timeout)
+        return resp.json()["jobs"] if resp.status_code == 200 else None
+
+    def requeue_job(self, job_id: str) -> tuple[int, str]:
+        resp = self.session.post(
+            f"{self.base}/requeue-job/{job_id}", timeout=self.timeout
+        )
+        return resp.status_code, resp.text
+
 
 # ---------------------------------------------------------------------------
 # Views
@@ -197,6 +211,43 @@ def render_metrics(text: str) -> str:
     return str(table)
 
 
+def render_dead_letter(jobs: list) -> str:
+    """Quarantined jobs with their failure provenance (one line per
+    job; the history is compacted to status×count)."""
+    table = Table(
+        ["Job ID", "Module", "Attempts", "Failure History"]
+    )
+    for j in jobs:
+        history = j.get("failure_history") or []
+        counts: dict[str, int] = {}
+        for f in history:
+            counts[f.get("status", "?")] = counts.get(f.get("status", "?"), 0) + 1
+        summary = ", ".join(f"{k} x{v}" for k, v in sorted(counts.items()))
+        table.add_row(
+            [j.get("job_id"), j.get("module"), j.get("attempts"), summary]
+        )
+    return str(table)
+
+
+def render_resilience_summary(health: dict) -> str:
+    """One-glance degradation readout (dead-letter depth + breaker
+    states) from unauthenticated /healthz — no Prometheus needed."""
+    breakers = health.get("breakers") or {}
+    not_closed = {k: v for k, v in breakers.items() if v != "closed"}
+    lines = [
+        f"dead-letter jobs: {health.get('dead_letter_jobs', 0)}",
+        "breakers: "
+        + (
+            ", ".join(f"{k}={v}" for k, v in sorted(not_closed.items()))
+            or f"all closed ({len(breakers)} tracked)"
+        ),
+    ]
+    plan = health.get("fault_plan")
+    if plan:
+        lines.append(f"fault plan ACTIVE: {plan}")
+    return "\n".join(lines)
+
+
 def render_scans(statuses: dict) -> str:
     table = Table(
         ["Scan ID", "Chunks", "Complete", "%", "Workers", "Module", "Started",
@@ -221,8 +272,8 @@ def render_scans(statuses: dict) -> str:
 # ---------------------------------------------------------------------------
 
 ACTIONS = [
-    "scan", "workers", "scans", "jobs", "metrics", "spinup", "terminate",
-    "cat", "stream", "recycle", "reset",
+    "scan", "workers", "scans", "jobs", "metrics", "dead-letter", "spinup",
+    "terminate", "cat", "stream", "recycle", "reset",
 ]
 
 
@@ -240,6 +291,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--prefix", help="node name prefix (spinup/terminate)")
     parser.add_argument("--nodes", type=int, help="node count (spinup)")
     parser.add_argument("--scan-id", help="scan id (cat/stream)")
+    parser.add_argument("--job-id", help="job id (dead-letter --requeue)")
+    parser.add_argument("--requeue", action="store_true",
+                        help="requeue the quarantined --job-id (dead-letter)")
     parser.add_argument("--autoscale", action="store_true")
     parser.add_argument("--tail", action="store_true", help="follow completed chunks")
     args = parser.parse_args(argv)
@@ -290,11 +344,32 @@ def _run_action(args, cfg: Config, client: JobClient) -> int:
         if text is None:
             print("Failed to retrieve metrics")
             return 1
+        # degradation at a glance (dead-letter depth, breaker states)
+        # before the full exposition table — docs/RESILIENCE.md
+        health = client.get_healthz()
+        if health is not None:
+            print(render_resilience_summary(health))
         try:
             print(render_metrics(text))
         except ValueError as e:
             print(f"Malformed metrics exposition: {e}")
             return 1
+        return 0
+
+    if args.action == "dead-letter":
+        if args.requeue:
+            if not args.job_id:
+                print("--job-id is required for dead-letter --requeue")
+                return 1
+            code, text = client.requeue_job(args.job_id)
+            print(code, text)
+            return 0 if code == 200 else 1
+        jobs = client.dead_letter_jobs()
+        if jobs is None:
+            print("Failed to retrieve dead-letter jobs")
+            return 1
+        print(f"Dead-letter jobs: {len(jobs)}")
+        print(render_dead_letter(jobs))
         return 0
 
     if args.action in ("workers", "scans", "jobs"):

@@ -69,6 +69,28 @@ class Config:
     lease_seconds: float = 600.0
     max_attempts: int = 3
 
+    # --- resilience (docs/RESILIENCE.md) ---
+    # seeded fault-injection plan (resilience/faults grammar); empty =
+    # fault points are no-ops. Env: SWARM_FAULT_PLAN.
+    fault_plan: str = ""
+    # worker-reported failed terminal states requeue (bounded by
+    # max_attempts) instead of going terminal on the first attempt;
+    # exhausted jobs land in dead-letter quarantine either way
+    retry_failed: bool = True
+    # retrying transport (jittered exponential backoff + per-operation
+    # circuit breakers around the worker's ServerClient)
+    transport_retries: int = 3
+    transport_backoff_s: float = 0.2
+    transport_backoff_max_s: float = 5.0
+    transport_breaker_threshold: int = 5
+    transport_breaker_cooldown_s: float = 10.0
+    # lease heartbeat: renewal cadence while a chunk executes
+    # (0 = lease_seconds / 3)
+    heartbeat_interval_s: float = 0.0
+    # disk spool for completed output chunks when the server is
+    # unreachable ("" = <worker work_dir>/spool)
+    spool_dir: str = ""
+
     # --- fleet orchestration ---
     fleet_provider: str = "null"  # "null" | "digitalocean" | "process"
     fleet_api_token: str = ""
@@ -136,6 +158,8 @@ class Config:
                 value = int(value)
             elif field.type in ("float", float) and not isinstance(value, float):
                 value = float(value)
+            elif field.type in ("bool", bool) and not isinstance(value, bool):
+                value = str(value).strip().lower() in ("1", "true", "yes", "on")
             coerced[name] = value
         return cls(**coerced)
 

@@ -44,6 +44,10 @@ class JobStatus:
     UPLOAD_FAILED_NOT_FOUND = "upload failed - file not found"
     UPLOAD_FAILED_CREDENTIALS = "upload failed - credentials"
     UPLOAD_FAILED_UNKNOWN = "upload failed - unknown"
+    # Quarantine (new vs reference): a job that exhausted max_attempts
+    # parks here WITH its failure history instead of silently going
+    # terminal-failed. Operators inspect/requeue via `swarm dead-letter`.
+    DEAD_LETTER = "dead letter"
 
     TERMINAL = frozenset(
         {
@@ -52,9 +56,16 @@ class JobStatus:
             UPLOAD_FAILED_NOT_FOUND,
             UPLOAD_FAILED_CREDENTIALS,
             UPLOAD_FAILED_UNKNOWN,
+            DEAD_LETTER,
         }
     )
     FAILED = frozenset(TERMINAL - {COMPLETE})
+    # leased statuses: dispatched and not yet terminal — lease
+    # enforcement must cover ALL of these (a worker dying mid-execute
+    # leaves the job in "executing", not "in progress")
+    ACTIVE = frozenset(
+        {IN_PROGRESS, STARTING, DOWNLOADING, EXECUTING, UPLOADING}
+    )
     ALL = frozenset(
         {
             QUEUED,
@@ -159,6 +170,10 @@ class Job:
     # here, and handed back out through /get-job so every layer's event
     # lines for one scan share it. Extra wire key to the reference.
     trace_id: Optional[str] = None
+    # failure provenance: one entry per failed attempt / lease expiry
+    # ({ts, worker_id, status}), carried into the dead-letter state so
+    # quarantined jobs explain themselves. Extra wire key.
+    failure_history: Optional[list] = None
 
     @classmethod
     def create(

@@ -96,6 +96,11 @@ class EngineStats:
     # memo-served ROW count, summed per batch (rows whose verdict came
     # from the cross-batch memo without device or walk work)
     memo_slots: int = 0
+    # device-degraded mode (docs/RESILIENCE.md): device-path failures
+    # observed, and batches that ran on the exact CPU-oracle fallback
+    # (results stay bit-identical — only throughput degrades)
+    device_faults: int = 0
+    degraded_batches: int = 0
     # host-walk sub-phases (all included in host_confirm_seconds):
     # uncertainty resolution, the extraction pass, memo inserts, and
     # the member fan-out/fixup assembly — the levers the fresh-content
@@ -220,6 +225,8 @@ class MatchEngine:
         mesh="auto",  # "auto" | None | jax.sharding.Mesh
         db: Optional[CompiledDB] = None,  # precompiled (fingerprints/dbcache)
         pipeline: Optional[str] = None,  # "on" | "off" | None → SWARM_PIPELINE
+        device_breaker_threshold: int = 2,
+        device_breaker_cooldown_s: float = 60.0,
     ):
         self.templates = list(templates)
         self.db = db if db is not None else compile_corpus(self.templates)
@@ -416,6 +423,20 @@ class MatchEngine:
         self._rowdep_mask = np.zeros(db.num_templates, dtype=np.uint8)
         for i in self._rowdep_t:
             self._rowdep_mask[i] = 1
+        # device-degraded mode (docs/RESILIENCE.md): a device-path
+        # failure (XLA compile error, OOM, persistent-cache corruption
+        # — or an injected device.dispatch fault) trips a per-shape-
+        # class breaker and the batch falls back to the exact CPU
+        # oracle; verdicts stay bit-identical, only throughput
+        # degrades. The breaker cooldown periodically retries the
+        # device path, so a transient fault self-heals.
+        from swarm_tpu.resilience.breaker import BreakerBoard
+
+        self._device_breakers = BreakerBoard(
+            "engine.device",
+            threshold=device_breaker_threshold,
+            cooldown_s=device_breaker_cooldown_s,
+        )
         # export this engine's stats to /metrics: weakref-tracked, read
         # only at scrape time — zero cost on the match hot path
         from swarm_tpu.telemetry.engine_export import register_engine
@@ -1245,6 +1266,47 @@ class MatchEngine:
 
 
     # ------------------------------------------------------------------
+    # Device-degraded mode helpers (docs/RESILIENCE.md)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _shape_class(batch) -> str:
+        """Breaker key: one breaker per compiled batch shape class
+        (rows × per-stream widths), so a width bucket whose executable
+        is poisoned degrades alone while other shapes stay on device."""
+        streams = getattr(batch, "streams", None) or {}
+        parts = [
+            f"{name}{arr.shape[-1]}" for name, arr in sorted(streams.items())
+        ]
+        rows = next(iter(streams.values())).shape[0] if streams else 0
+        return f"r{rows}." + ".".join(parts)
+
+    def _note_device_fault(self, breaker, exc: BaseException) -> None:
+        self.stats.device_faults += 1
+        breaker.record_failure()
+        print(
+            f"device path failed ({type(exc).__name__}: {exc}); "
+            f"falling back to CPU oracle "
+            f"[breaker {breaker.name}: {breaker.state}]"
+        )
+
+    def _oracle_planes(self, B: int):
+        """Synthesized device output for a degraded batch: zero value/
+        uncertainty planes plus an all-true overflow vector, which the
+        walk treats as 'redo every row on the host oracle' — exactness
+        is the redo path's existing contract."""
+        db = self.db
+        ntb = max((db.num_templates + 7) >> 3, 1)
+        nob = max((len(db.op_matchers) + 7) >> 3, 1)
+        nmb = max((len(db.m_src) + 7) >> 3, 1)
+        return (
+            np.zeros((B, ntb), dtype=np.uint8),
+            np.zeros((B, ntb), dtype=np.uint8),
+            np.zeros((B, nob), dtype=np.uint8),
+            np.zeros((B, nob), dtype=np.uint8),
+            np.zeros((B, nmb), dtype=np.uint8),
+            np.ones((B,), dtype=bool),
+        )
+
     def _walk_plane(self, nrows, batch, matcher, pending=None):
         """Device dispatch + sparse host resolution over DISTINCT new
         response contents (the unique content plane).
@@ -1265,16 +1327,30 @@ class MatchEngine:
         db = self.db
         B = len(nrows)
         t0 = time.perf_counter()
+        planes = None
+        breaker = self._device_breakers.get(self._shape_class(batch))
         if pending is not None:
-            pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
-                matcher.collect(pending)
-            )
-        else:
-            pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = (
-                matcher.match(
+            try:
+                planes = matcher.collect(pending)
+                breaker.record_success()
+            except Exception as e:
+                self._note_device_fault(breaker, e)
+        elif breaker.allow():
+            try:
+                planes = matcher.match(
                     batch.streams, batch.lengths, batch.status, full=True
                 )
-            )
+                breaker.record_success()
+            except Exception as e:
+                self._note_device_fault(breaker, e)
+        if planes is None:
+            # degraded mode: the all-overflow plane routes every row
+            # through the whole-row oracle redo below — the same exact
+            # path truncated/overflowed rows always take, so verdicts
+            # and extractions are bit-identical to the device path
+            planes = self._oracle_planes(B)
+            self.stats.degraded_batches += 1
+        pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = planes
         # slice off bucket/mesh row padding before the host walk.
         # np.array(order="C"): ALWAYS a writable copy (the row-redo
         # pass writes rowbits back) AND row-major — XLA may hand back
@@ -1620,11 +1696,18 @@ class MatchEngine:
         batch, matcher = pre[1], pre[2]
         pending = None
         if batch is not None and hasattr(matcher, "dispatch"):
-            t0 = time.perf_counter()
-            pending = matcher.dispatch(
-                batch.streams, batch.lengths, batch.status
-            )
-            self.stats.device_seconds += time.perf_counter() - t0
+            breaker = self._device_breakers.get(self._shape_class(batch))
+            if breaker.allow():
+                t0 = time.perf_counter()
+                try:
+                    pending = matcher.dispatch(
+                        batch.streams, batch.lengths, batch.status
+                    )
+                except Exception as e:
+                    # async launch failed: degrade this batch (the walk
+                    # re-tries the sync path only if the breaker allows)
+                    self._note_device_fault(breaker, e)
+                self.stats.device_seconds += time.perf_counter() - t0
         return ("native", all_rows, pre, pending)
 
     def finish_packed(self, handle) -> PackedMatches:

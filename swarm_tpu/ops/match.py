@@ -251,6 +251,12 @@ class DeviceDB:
         walking batch i's verdicts. :meth:`collect` finalizes."""
         import time as _time
 
+        from swarm_tpu.resilience.faults import fault_point
+
+        # device-path chaos lever (docs/RESILIENCE.md): stands in for
+        # XLA compile errors / OOM / cache corruption; MatchEngine
+        # catches the failure and degrades to the exact CPU oracle
+        fault_point("device.dispatch")
         _meta, arrays = self._ensure_layout()
         fn = self._kernel(full)
         spy = hasattr(fn, "_cache_size")

@@ -387,6 +387,12 @@ class ShardedMatcher:
 
     # ------------------------------------------------------------------
     def match(self, streams: dict, lengths: dict, status, full: bool = False):
+        from swarm_tpu.resilience.faults import fault_point
+
+        # same fault point as DeviceDB.dispatch: "the device path
+        # failed" is one failure class whichever matcher serves it
+        # (MatchEngine degrades to the CPU oracle either way)
+        fault_point("device.dispatch")
         seq_ranks = self.ranks.get("seq", 1)
         if seq_ranks > 1:
             for name, arr in streams.items():

@@ -1,0 +1,43 @@
+"""Fault-injection-driven resilience layer (docs/RESILIENCE.md).
+
+The fleet must keep converging on exact results while processes, links
+and devices fail — the partial-failure discipline distributed ML
+runtimes treat as table stakes (TensorFlow's dataflow layer,
+arXiv:1605.08695; MLPerf-scale TPU-pod runs, arXiv:1909.09756). Five
+cooperating pieces, all testable on CPU via the deterministic fault
+harness:
+
+- :mod:`swarm_tpu.resilience.faults` — named fault points threaded
+  through server, stores, worker runtime, scheduler and ops engine,
+  driven by a seeded plan (``SWARM_FAULT_PLAN``); no-ops when unset.
+- :mod:`swarm_tpu.resilience.breaker` — circuit breakers with a
+  process-wide board so ``/healthz`` can surface open breakers.
+- :mod:`swarm_tpu.resilience.transport` — typed
+  :class:`TransportError` plus :class:`RetryingServerClient` (jittered
+  exponential backoff + per-operation breakers).
+- :mod:`swarm_tpu.resilience.spool` — disk spool for completed output
+  chunks: an unreachable server never loses finished work; replay is
+  idempotent via the queue's fencing token.
+- :mod:`swarm_tpu.resilience.heartbeat` — background lease renewal so
+  long chunks stop racing the server's ``_requeue_expired``.
+"""
+
+from swarm_tpu.resilience.breaker import (  # noqa: F401
+    BreakerBoard,
+    CircuitBreaker,
+    breaker_states,
+)
+from swarm_tpu.resilience.faults import (  # noqa: F401
+    FaultInjected,
+    FaultPlan,
+    active_plan,
+    clear_plan,
+    fault_point,
+    install_plan,
+)
+from swarm_tpu.resilience.heartbeat import LeaseHeartbeat  # noqa: F401
+from swarm_tpu.resilience.spool import OutputSpool  # noqa: F401
+from swarm_tpu.resilience.transport import (  # noqa: F401
+    RetryingServerClient,
+    TransportError,
+)

@@ -1,0 +1,168 @@
+"""Circuit breakers with a process-wide board for operator visibility.
+
+Closed → (``threshold`` consecutive failures) → open → (``cooldown_s``
+elapses) → half-open: exactly one probe call is allowed through; its
+outcome closes or re-opens the breaker. Breakers register on a global
+board so ``/healthz`` and ``swarm metrics`` can show degradation
+without scraping Prometheus; state transitions also drive the
+``swarm_resilience_breaker_open`` gauge.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+from swarm_tpu.telemetry import REGISTRY
+
+_BOARD_LOCK = threading.Lock()
+# name → live instances: several objects may legitimately share a name
+# (two workers' transport boards, two engines with the same batch
+# shape) — the board must not let the last registration shadow an open
+# earlier one. WeakSet so the board never extends breaker lifetime.
+_BOARD: dict[str, "weakref.WeakSet[CircuitBreaker]"] = {}
+
+_BREAKER_OPEN = REGISTRY.gauge(
+    "swarm_resilience_breaker_open",
+    "1 while the named circuit breaker is open (0 closed/half-open)",
+    ("name",),
+)
+_BREAKER_TRANSITIONS = REGISTRY.counter(
+    "swarm_resilience_breaker_transitions_total",
+    "Circuit-breaker state transitions",
+    ("name", "state"),
+)
+
+#: severity order for same-named aggregation (worst state wins)
+_SEVERITY = {"closed": 0, "half-open": 1, "open": 2}
+
+
+def breaker_states(prefix: str = "") -> dict[str, str]:
+    """Name → state snapshot of every registered breaker IN THIS
+    PROCESS (the /healthz surface — remote workers report theirs via
+    completed jobs' ``breakers_open`` perf field and their own
+    /metrics). Same-named instances aggregate to the worst state, so
+    one open breaker can't hide behind a later-registered closed
+    twin."""
+    with _BOARD_LOCK:
+        items = [(name, list(refs)) for name, refs in _BOARD.items()]
+    out: dict[str, str] = {}
+    for name, brs in items:
+        if not name.startswith(prefix) or not brs:
+            continue
+        out[name] = max((br.state for br in brs), key=_SEVERITY.__getitem__)
+    return out
+
+
+def reset_board() -> None:
+    """Drop all registered breakers (test isolation)."""
+    with _BOARD_LOCK:
+        _BOARD.clear()
+
+
+class CircuitBreaker:
+    """One named breaker. ``allow()`` gates the protected call;
+    ``record_success``/``record_failure`` report its outcome."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(
+        self,
+        name: str,
+        threshold: int = 5,
+        cooldown_s: float = 30.0,
+        clock=time.monotonic,
+    ):
+        self.name = name
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+        self._probe_out = False  # half-open: one probe in flight
+        with _BOARD_LOCK:
+            _BOARD.setdefault(name, weakref.WeakSet()).add(self)
+
+    # ------------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        if (
+            self._state == self.OPEN
+            and self._clock() - self._opened_at >= self.cooldown_s
+        ):
+            self._transition(self.HALF_OPEN)
+            self._probe_out = False
+
+    def _transition(self, state: str) -> None:
+        if state == self._state:
+            return
+        self._state = state
+        _BREAKER_OPEN.labels(name=self.name).set(1 if state == self.OPEN else 0)
+        _BREAKER_TRANSITIONS.labels(name=self.name, state=state).inc()
+
+    # ------------------------------------------------------------------
+    def allow(self) -> bool:
+        """Whether the protected call may proceed right now. In
+        half-open state exactly one caller gets True (the probe)."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.HALF_OPEN and not self._probe_out:
+                self._probe_out = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_out = False
+            self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            self._probe_out = False
+            if self._state == self.HALF_OPEN or self._failures >= self.threshold:
+                self._opened_at = self._clock()
+                self._transition(self.OPEN)
+
+
+class BreakerBoard:
+    """Lazily-created breakers sharing one config, keyed by name
+    suffix — the per-operation transport breakers and the engine's
+    per-shape-class device breakers."""
+
+    def __init__(self, prefix: str, threshold: int = 5, cooldown_s: float = 30.0):
+        self.prefix = prefix
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def get(self, key: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                br = self._breakers[key] = CircuitBreaker(
+                    f"{self.prefix}.{key}",
+                    threshold=self.threshold,
+                    cooldown_s=self.cooldown_s,
+                )
+            return br
+
+    def states(self) -> dict[str, str]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {k: br.state for k, br in items}
+
+    def any_open(self) -> bool:
+        return any(s != CircuitBreaker.CLOSED for s in self.states().values())

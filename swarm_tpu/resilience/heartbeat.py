@@ -1,0 +1,90 @@
+"""Lease heartbeats: background renewal while a chunk executes.
+
+The queue requeues in-progress jobs whose lease lapsed
+(``server/queue.py _requeue_expired``). A long device chunk could
+outlive its lease and get double-executed; the heartbeat ticker renews
+the lease from a daemon thread (``POST /renew-lease/<job_id>``) for as
+long as the chunk runs, and stops the moment the job reaches a terminal
+state — or the moment the server says the lease is no longer ours
+(renewal of a requeued/re-leased job is rejected, at which point
+continuing to execute is wasted work the fencing token will discard).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from swarm_tpu.resilience.transport import TransportError
+from swarm_tpu.telemetry import REGISTRY
+
+_RENEWALS = REGISTRY.counter(
+    "swarm_resilience_lease_renewals_total",
+    "Lease-heartbeat renewal attempts",
+    ("outcome",),
+)
+
+
+class LeaseHeartbeat:
+    """Context manager: renew ``job_id``'s lease every ``interval_s``
+    until exit (or until the server rejects a renewal)."""
+
+    def __init__(self, client, job_id: str, worker_id: str, interval_s: float):
+        self.client = client
+        self.job_id = job_id
+        self.worker_id = worker_id
+        self.interval_s = max(0.05, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: False once the server refused a renewal: the lease is no
+        #: longer ours (expired + re-leased, or the job went terminal)
+        self.lease_ok = True
+        self.renewals = 0
+
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        m = _RENEWALS
+        while not self._stop.wait(self.interval_s):
+            try:
+                ok = self.client.renew_lease(self.job_id, self.worker_id)
+            except TransportError:
+                # server unreachable: keep ticking — the lease may still
+                # be live on the server, and the next tick may land
+                m.labels(outcome="error").inc()
+                continue
+            except Exception:
+                m.labels(outcome="error").inc()
+                continue
+            if ok:
+                self.renewals += 1
+                m.labels(outcome="renewed").inc()
+            else:
+                self.lease_ok = False
+                m.labels(outcome="rejected").inc()
+                return  # not ours anymore; stop renewing
+
+    def start(self) -> "LeaseHeartbeat":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run,
+                name=f"lease-hb-{self.job_id}",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def __enter__(self) -> "LeaseHeartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,133 @@
+"""Disk spool for completed output chunks.
+
+When the server is unreachable at upload time the worker has already
+paid for the chunk's compute; dropping the bytes wastes the work and
+forces a double execution after lease expiry. The spool persists the
+finished chunk (payload + completion metadata) and replays it on the
+next successful server contact:
+
+- ``put_output_chunk`` is an idempotent overwrite of the same blob key,
+  and the completion update carries the worker's fencing token — if the
+  lease expired and the job was re-leased elsewhere, the queue rejects
+  the stale completion and the entry is dropped (the work was redone by
+  the new assignee). Double-replay of the same entry is therefore a
+  strict no-op.
+- Entries survive worker restarts (files under ``spool_dir``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Optional
+
+from swarm_tpu.resilience.transport import TransportError
+from swarm_tpu.telemetry import REGISTRY
+
+_SPOOLED = REGISTRY.counter(
+    "swarm_resilience_spooled_chunks_total",
+    "Completed output chunks spooled to disk (server unreachable)",
+)
+_REPLAYED = REGISTRY.counter(
+    "swarm_resilience_spool_replayed_total",
+    "Spool replay outcomes",
+    ("outcome",),
+)
+
+
+class OutputSpool:
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    def put(
+        self,
+        job_id: str,
+        scan_id: str,
+        chunk_index: int,
+        worker_id: str,
+        data: bytes,
+        perf: Optional[dict] = None,
+    ) -> None:
+        """Persist one finished chunk. Data first, then meta — a
+        replay only trusts entries whose meta file exists, so a crash
+        mid-put leaves no half entry visible."""
+        (self.root / f"{job_id}.bin").write_bytes(data)
+        meta = {
+            "job_id": job_id,
+            "scan_id": scan_id,
+            "chunk_index": int(chunk_index),
+            "worker_id": worker_id,
+            "perf": perf,
+            "spooled_at": time.time(),
+        }
+        (self.root / f"{job_id}.json").write_text(json.dumps(meta))
+        _SPOOLED.inc()
+
+    def entries(self) -> list[dict]:
+        out = []
+        for meta_path in sorted(self.root.glob("*.json")):
+            try:
+                out.append(json.loads(meta_path.read_text()))
+            except (ValueError, OSError):
+                continue
+        return out
+
+    def __len__(self) -> int:
+        return len(list(self.root.glob("*.json")))
+
+    def _drop(self, job_id: str) -> None:
+        for suffix in (".json", ".bin"):
+            try:
+                (self.root / f"{job_id}{suffix}").unlink()
+            except FileNotFoundError:
+                pass
+
+    # ------------------------------------------------------------------
+    def replay(self, client, status_complete: str = "complete") -> int:
+        """Push every spooled chunk through ``client``; returns the
+        number of entries cleared. Stops early on TransportError (the
+        server went away again — keep the rest for next time)."""
+        cleared = 0
+        for meta in self.entries():
+            job_id = meta["job_id"]
+            data_path = self.root / f"{job_id}.bin"
+            try:
+                data = data_path.read_bytes()
+            except OSError:
+                self._drop(job_id)  # orphan meta: nothing to upload
+                continue
+            try:
+                # ownership probe BEFORE touching the blob: renewing the
+                # lease succeeds only while the job is still ours — a
+                # re-leased/terminal job must not have its stored chunk
+                # overwritten with our stale bytes (the new assignee may
+                # have produced legitimately different output for
+                # nondeterministic modules). A successful renewal also
+                # covers the replay window against expiry.
+                ok = client.renew_lease(job_id, meta.get("worker_id"))
+                if ok:
+                    ok = client.put_output_chunk(
+                        meta["scan_id"], meta["chunk_index"], data
+                    )
+                if ok:
+                    # fencing token rides along: a re-leased job's queue
+                    # record rejects this stale completion (False) and
+                    # the entry is dropped — the new assignee owns it
+                    changes = {"status": status_complete}
+                    if meta.get("perf"):
+                        changes["perf"] = meta["perf"]
+                    ok = client.update_job(
+                        job_id, changes, worker_id=meta.get("worker_id")
+                    )
+            except TransportError:
+                _REPLAYED.labels(outcome="deferred").inc()
+                break
+            self._drop(job_id)
+            cleared += 1
+            _REPLAYED.labels(
+                outcome="completed" if ok else "fenced"
+            ).inc()
+        return cleared

@@ -437,6 +437,12 @@ class BatchScheduler:
             return out
 
         def submit(pb: PlannedBatch, pre) -> Iterator[list]:
+            # chaos lever (docs/RESILIENCE.md): a failing submission
+            # propagates to the caller (worker execute → requeue path);
+            # device-path faults inside begin_packed degrade in-engine
+            from swarm_tpu.resilience.faults import fault_point
+
+            fault_point("sched.submit", detail=pb.kind)
             handle = engine.begin_packed(pb.rows, pre=pre)
             inflight.append((pb, handle))
             _INFLIGHT.set(len(inflight))

@@ -26,6 +26,7 @@ from typing import Any, Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from swarm_tpu.config import Config
+from swarm_tpu.datamodel import JobStatus
 from swarm_tpu.server.fleet import build_provider
 from swarm_tpu.server.queue import JobQueueService
 from swarm_tpu.stores import build_stores
@@ -60,6 +61,12 @@ class SwarmServer:
     def __init__(self, cfg: Config, queue: Optional[JobQueueService] = None, fleet=None):
         self.cfg = cfg
         self.started_at = time.time()
+        from swarm_tpu.resilience.faults import active_plan, install_plan
+
+        if cfg.fault_plan:
+            install_plan(cfg.fault_plan)  # deterministic chaos (tests/soak)
+        else:
+            active_plan()  # registers the armed-state gauge for /metrics
         # see _advertise_url: captured before any bind mutates it. A URL
         # a PRIOR server instance derived (cfg.server_url_derived) still
         # counts as defaulted — a supervisor reusing one Config across
@@ -114,6 +121,9 @@ class SwarmServer:
         r("GET", r"^/metrics$", self._metrics, "/metrics")
         r("GET", r"^/get-statuses$", self._get_statuses, "/get-statuses")
         r("POST", r"^/update-job/(?P<job_id>[^/]+)$", self._update_job, "/update-job")
+        r("POST", r"^/renew-lease/(?P<job_id>[^/]+)$", self._renew_lease, "/renew-lease")
+        r("GET", r"^/dead-letter$", self._dead_letter, "/dead-letter")
+        r("POST", r"^/requeue-job/(?P<job_id>[^/]+)$", self._requeue_job, "/requeue-job")
         r("GET", r"^/get-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$", self._get_chunk, "/get-chunk")
         r("GET", r"^/get-latest-chunk$", self._get_latest_chunk, "/get-latest-chunk")
         r("GET", r"^/parse_job/(?P<job_id>[^/]+)$", self._parse_job, "/parse_job")
@@ -140,16 +150,46 @@ class SwarmServer:
 
     def _healthz(self, m, q, body, h):
         # real liveness, not a static ok: load balancers and tests can
-        # assert the queue is actually reachable behind this process
+        # assert the queue is actually reachable behind this process.
+        # Resilience surface (docs/RESILIENCE.md): dead-letter count and
+        # in-process breaker states show degradation without Prometheus.
+        from swarm_tpu.resilience.breaker import breaker_states
+        from swarm_tpu.resilience.faults import active_plan
+
+        by_state = self.queue.jobs_by_state()
+        plan = active_plan()
         return self._json(
             200,
             {
                 "status": "ok",
                 "uptime_seconds": round(time.time() - self.started_at, 3),
                 "queue_depth": self.queue.queue_depth(),
-                "jobs_by_state": self.queue.jobs_by_state(),
+                "jobs_by_state": by_state,
+                "dead_letter_jobs": by_state.get(JobStatus.DEAD_LETTER, 0),
+                "breakers": breaker_states(),
+                "fault_plan": plan.spec if plan is not None else "",
             },
         )
+
+    def _renew_lease(self, m, q, body, h):
+        try:
+            data = json.loads(body or b"{}")
+        except ValueError:
+            return self._json(400, {"message": "Invalid JSON"})
+        expiry = self.queue.renew_lease(m["job_id"], data.get("worker_id"))
+        if expiry is None:
+            # the lease is not this worker's to renew (requeued,
+            # re-leased, terminal, or unknown job)
+            return self._json(409, {"message": "Lease not renewable"})
+        return self._json(200, {"lease_expires_at": expiry})
+
+    def _dead_letter(self, m, q, body, h):
+        return self._json(200, {"jobs": self.queue.dead_letter_jobs()})
+
+    def _requeue_job(self, m, q, body, h):
+        if self.queue.requeue_dead_letter(m["job_id"]):
+            return self._json(200, {"message": "Job requeued"})
+        return self._json(404, {"message": "Job not in dead-letter"})
 
     def _metrics(self, m, q, body, h):
         return 200, REGISTRY.render().encode(), _METRICS_CTYPE

@@ -49,6 +49,20 @@ _JOBS_DISPATCHED = REGISTRY.counter(
 _JOBS_REQUEUED = REGISTRY.counter(
     "swarm_queue_jobs_requeued_total", "Jobs requeued after lease expiry"
 )
+_JOBS_RETRIED = REGISTRY.counter(
+    "swarm_queue_jobs_retried_total",
+    "Jobs requeued after a worker-reported failure",
+    ("status",),
+)
+_JOBS_DEAD_LETTER = REGISTRY.counter(
+    "swarm_queue_jobs_dead_letter_total",
+    "Jobs quarantined after exhausting max_attempts",
+)
+_LEASE_RENEWALS = REGISTRY.counter(
+    "swarm_queue_lease_renewals_total",
+    "Lease renewal requests",
+    ("outcome",),
+)
 _JOBS_TERMINAL = REGISTRY.counter(
     "swarm_queue_jobs_terminal_total",
     "Jobs reaching a terminal status",
@@ -186,14 +200,21 @@ class JobQueueService:
                 # lease-expiry requeue) — never re-lease those
                 job = None
 
+            if job is not None:
+                # lease assignment stays under the store lock: between
+                # the pop and the IN_PROGRESS write a concurrent
+                # update/renew must not observe a half-dispatched job
+                job.status = JobStatus.IN_PROGRESS
+                job.started_at = now
+                job.worker_id = worker_id
+                job.lease_expires_at = now + self.cfg.lease_seconds
+                job.attempts += 1
+                self._put_job(job)
+                self.state.hset(
+                    "leases", job.job_id, str(job.lease_expires_at)
+                )
+
         if job is not None:
-            job.status = JobStatus.IN_PROGRESS
-            job.started_at = now
-            job.worker_id = worker_id
-            job.lease_expires_at = now + self.cfg.lease_seconds
-            job.attempts += 1
-            self._put_job(job)
-            self.state.hset("leases", job.job_id, str(job.lease_expires_at))
             worker.polls_with_no_jobs = 0
             worker.status = WorkerStatus.ACTIVE
             self._save_worker(worker)
@@ -238,20 +259,23 @@ class JobQueueService:
             except (ValueError, KeyError, TypeError):
                 self.state.hdel("leases", job_id)
                 continue
-            if job.status != JobStatus.IN_PROGRESS or job.lease_expires_at is None:
+            # any ACTIVE status is leased: a worker dying mid-execution
+            # leaves "executing" (not "in progress"), and its job must
+            # still come back — restricting to IN_PROGRESS silently
+            # lost every job whose worker died after the first status
+            # update (resilience PR regression find)
+            if job.status not in JobStatus.ACTIVE or job.lease_expires_at is None:
                 self.state.hdel("leases", job_id)
                 continue
             if job.lease_expires_at >= now:
                 continue
             self.state.hdel("leases", job_id)
+            self._record_failure(job, "lease expired")
             if job.attempts >= self.cfg.max_attempts:
-                job.status = JobStatus.CMD_FAILED
-                self._put_job(job)
-                _JOBS_TERMINAL.labels(status=JobStatus.CMD_FAILED).inc()
-                emit_event(
-                    "job.lease_exhausted", trace_id=job.trace_id,
-                    job_id=job_id, attempts=job.attempts,
-                )
+                # quarantine, not a silent terminal failure: the job
+                # parks in dead-letter WITH its failure history and can
+                # be inspected/requeued (`swarm dead-letter`)
+                self._quarantine(job, reason="lease_exhausted")
                 continue
             job.status = JobStatus.QUEUED
             job.worker_id = None
@@ -263,6 +287,103 @@ class JobQueueService:
                 "job.requeued", trace_id=job.trace_id, job_id=job_id,
                 attempts=job.attempts,
             )
+
+    @staticmethod
+    def _record_failure(job: Job, status: str) -> None:
+        history = list(job.failure_history or ())
+        history.append(
+            {"ts": time.time(), "worker_id": job.worker_id, "status": status}
+        )
+        job.failure_history = history
+
+    def _quarantine(self, job: Job, reason: str) -> None:
+        """Move a job to the dead-letter state (caller holds the lock
+        and has already recorded the triggering failure)."""
+        job.status = JobStatus.DEAD_LETTER
+        job.worker_id = None
+        job.lease_expires_at = None
+        self._put_job(job)
+        self.state.hdel("leases", job.job_id)
+        _JOBS_TERMINAL.labels(status=JobStatus.DEAD_LETTER).inc()
+        _JOBS_DEAD_LETTER.inc()
+        emit_event(
+            "job.dead_letter",
+            trace_id=job.trace_id,
+            job_id=job.job_id,
+            attempts=job.attempts,
+            reason=reason,
+            failures=job.failure_history,
+        )
+
+    # ------------------------------------------------------------------
+    # Lease heartbeats (resilience PR): POST /renew-lease/<job_id>
+    # ------------------------------------------------------------------
+    def renew_lease(self, job_id: str, worker_id: Optional[str]) -> Optional[float]:
+        """Extend a live lease for its current assignee. Returns the
+        new expiry, or None when the renewal is rejected — unknown job,
+        a job that was requeued/re-leased (fencing), or one already
+        terminal. Rejection tells the worker the job is no longer its
+        own."""
+        now = time.time()
+        with self._lock:
+            job = self._get_job_record(job_id)
+            if (
+                job is None
+                or job.status in JobStatus.TERMINAL
+                or job.status == JobStatus.QUEUED
+                or job.lease_expires_at is None
+                or worker_id is None
+                or job.worker_id != worker_id
+            ):
+                _LEASE_RENEWALS.labels(outcome="rejected").inc()
+                return None
+            job.lease_expires_at = now + self.cfg.lease_seconds
+            self._put_job(job)
+            self.state.hset("leases", job_id, str(job.lease_expires_at))
+        _LEASE_RENEWALS.labels(outcome="renewed").inc()
+        emit_event(
+            "job.lease_renewed",
+            trace_id=job.trace_id,
+            job_id=job_id,
+            worker_id=worker_id,
+            lease_expires_at=job.lease_expires_at,
+        )
+        return job.lease_expires_at
+
+    # ------------------------------------------------------------------
+    # Dead-letter surface (resilience PR)
+    # ------------------------------------------------------------------
+    def dead_letter_jobs(self) -> list[dict]:
+        """Wire records of every quarantined job (failure history
+        included) — the `swarm dead-letter` inspection surface."""
+        out = []
+        for _job_id, raw in self.state.hgetall("jobs").items():
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if rec.get("status") == JobStatus.DEAD_LETTER:
+                out.append(rec)
+        return sorted(out, key=lambda r: r.get("job_id") or "")
+
+    def requeue_dead_letter(self, job_id: str) -> bool:
+        """Operator action: put a quarantined job back in the queue
+        with a fresh attempt budget (history is kept)."""
+        with self._lock:
+            job = self._get_job_record(job_id)
+            if job is None or job.status != JobStatus.DEAD_LETTER:
+                return False
+            job.status = JobStatus.QUEUED
+            job.worker_id = None
+            job.lease_expires_at = None
+            job.attempts = 0
+            self._put_job(job)
+            self.state.rpush("job_queue", job.job_id)
+        _JOBS_REQUEUED.inc()
+        emit_event(
+            "job.dead_letter_requeued", trace_id=job.trace_id, job_id=job_id
+        )
+        return True
 
     def _load_worker(self, worker_id: str) -> WorkerInfo:
         raw = self.state.hget("workers", worker_id)
@@ -280,6 +401,14 @@ class JobQueueService:
     # Status transitions (reference update_job, server.py:308-335)
     # ------------------------------------------------------------------
     def update_job(self, job_id: str, changes: dict) -> bool:
+        # one lock over load → check → write: the fencing decision and
+        # the dead-letter/requeue transition must be atomic against a
+        # concurrent dispatch or _requeue_expired (satellite: a zombie
+        # whose lease expired must never complete a re-leased job)
+        with self._lock:
+            return self._update_job_locked(job_id, changes)
+
+    def _update_job_locked(self, job_id: str, changes: dict) -> bool:
         job = self._get_job_record(job_id)
         if job is None:
             return False
@@ -297,6 +426,41 @@ class JobQueueService:
             # terminal states never regress (duplicate 'completed' pushes
             # would make the client tail re-emit chunks)
             return False
+        # Poison-job discipline: a worker-reported failed terminal state
+        # consumes one attempt. With budget left the job requeues (the
+        # reference went terminal on the first hiccup); an exhausted job
+        # is quarantined in dead-letter with its failure history.
+        # FENCED updates only: an unfenced (reference-worker) failure
+        # can come from a zombie whose job was already re-leased —
+        # requeuing it would put an actively-executing job back in the
+        # queue and double-execute it. Unfenced failures keep the
+        # reference's terminal wire behavior below.
+        new_status = changes.get("status")
+        if (
+            self.cfg.retry_failed
+            and fence is not None
+            and new_status in JobStatus.FAILED
+            and new_status != JobStatus.DEAD_LETTER
+        ):
+            self._record_failure(job, new_status)
+            self.state.hdel("leases", job_id)
+            if job.attempts >= self.cfg.max_attempts:
+                self._quarantine(job, reason="attempts_exhausted")
+            else:
+                job.status = JobStatus.QUEUED
+                job.worker_id = None
+                job.lease_expires_at = None
+                self._put_job(job)
+                self.state.rpush("job_queue", job.job_id)
+                _JOBS_RETRIED.labels(status=new_status).inc()
+                emit_event(
+                    "job.retry",
+                    trace_id=job.trace_id,
+                    job_id=job_id,
+                    attempts=job.attempts,
+                    status=new_status,
+                )
+            return True
         wire = job.to_wire()
         for key, value in changes.items():
             if key in wire and key is not None:

@@ -79,6 +79,11 @@ class MemoryStateStore(StateStore):
         self._lists: dict[str, deque[str]] = {}
 
     def hset(self, name, key, value):
+        # chaos lever (docs/RESILIENCE.md): a failing state-store write
+        # surfaces as a 500 from whatever route attempted it
+        from swarm_tpu.resilience.faults import fault_point
+
+        fault_point("store.hset", detail=name)
         with self._lock:
             self._hashes.setdefault(name, {})[key] = value
 
@@ -216,6 +221,9 @@ class LocalBlobStore(BlobStore):
         return p
 
     def put(self, key, data):
+        from swarm_tpu.resilience.faults import fault_point
+
+        fault_point("store.blob_put", detail=key)
         p = self._path(key)
         with self._lock:
             p.parent.mkdir(parents=True, exist_ok=True)

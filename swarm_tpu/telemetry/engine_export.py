@@ -71,6 +71,20 @@ def _gauges() -> dict:
                 "swarm_engine_batch_fill",
                 "Mean fraction of batch capacity actually filled",
             ),
+            degraded=g(
+                "swarm_engine_degraded",
+                "Engines currently running with an open device breaker "
+                "(CPU-oracle fallback; results stay exact)",
+            ),
+            degraded_batches=g(
+                "swarm_engine_degraded_batches",
+                "Batches served by the CPU-oracle fallback after a "
+                "device-path failure",
+            ),
+            device_faults=g(
+                "swarm_engine_device_faults",
+                "Device-path failures observed (compile/OOM/dispatch)",
+            ),
         )
     return _G
 
@@ -90,6 +104,7 @@ def _collect() -> None:
     with _lock:
         engines = list(_engines)
     rows = batches = confirm_pairs = always_pairs = overflow = memo = 0
+    degraded = degraded_batches = device_faults = 0
     dev_s = confirm_s = compile_s = 0.0
     capacity = 0
     for eng in engines:
@@ -104,6 +119,11 @@ def _collect() -> None:
         compile_s += getattr(s, "device_compile_seconds", 0.0)
         confirm_s += s.host_confirm_seconds
         capacity += s.batches * getattr(eng, "batch_rows", 0)
+        degraded_batches += getattr(s, "degraded_batches", 0)
+        device_faults += getattr(s, "device_faults", 0)
+        board = getattr(eng, "_device_breakers", None)
+        if board is not None and board.any_open():
+            degraded += 1
     g["engines"].set(len(engines))
     g["rows"].set(rows)
     g["batches"].set(batches)
@@ -116,6 +136,9 @@ def _collect() -> None:
     g["memo_rows"].set(memo)
     g["memo_hit_rate"].set(memo / rows if rows else 0.0)
     g["batch_fill"].set(rows / capacity if capacity else 0.0)
+    g["degraded"].set(degraded)
+    g["degraded_batches"].set(degraded_batches)
+    g["device_faults"].set(device_faults)
 
 
 def engine_stats_snapshot(engine) -> dict:

@@ -28,6 +28,13 @@ import requests
 
 from swarm_tpu.config import Config
 from swarm_tpu.datamodel import SCAN_ID_RE, JobStatus
+from swarm_tpu.resilience.faults import fault_point, install_plan
+from swarm_tpu.resilience.heartbeat import LeaseHeartbeat
+from swarm_tpu.resilience.spool import OutputSpool
+from swarm_tpu.resilience.transport import (
+    RetryingServerClient,
+    TransportError,
+)
 from swarm_tpu.telemetry import REGISTRY, emit_event
 from swarm_tpu.utils.trace import PhaseTimer, maybe_device_profile
 from swarm_tpu.worker.modules import (
@@ -61,10 +68,21 @@ _ROWS_PER_SEC = REGISTRY.gauge(
 _ROWS_TOTAL = REGISTRY.counter(
     "swarm_worker_rows_total", "Device-engine rows processed by this worker"
 )
+_POLL_ERRORS = REGISTRY.counter(
+    "swarm_worker_poll_errors_total",
+    "Polls that failed with a transport error (server down ≠ idle queue)",
+)
 
 
 class ServerClient:
-    """HTTP client for the worker-facing server API."""
+    """HTTP client for the worker-facing server API.
+
+    Failure typing (docs/RESILIENCE.md): connection failures and 5xx
+    responses raise :class:`TransportError` so callers can tell "server
+    down" from "queue empty" / contract rejections — previously a dead
+    server looked exactly like an idle queue. Each operation declares a
+    ``transport.*`` fault point for the injection harness.
+    """
 
     def __init__(self, server_url: str, api_key: str, timeout: float = 30.0):
         self.base = server_url.rstrip("/")
@@ -72,31 +90,50 @@ class ServerClient:
         self.session = requests.Session()
         self.session.headers["Authorization"] = f"Bearer {api_key}"
 
+    def _request(self, op: str, method: str, path: str, detail=None, **kw):
+        fault_point(f"transport.{op}", detail=detail, exc=TransportError)
+        try:
+            resp = self.session.request(
+                method, f"{self.base}{path}", timeout=self.timeout, **kw
+            )
+        except requests.RequestException as e:
+            raise TransportError(f"{op}: {e}") from e
+        if resp.status_code >= 500:
+            raise TransportError(f"{op}: server error {resp.status_code}")
+        return resp
+
     def get_job(self, worker_id: str) -> Optional[dict]:
-        resp = self.session.get(
-            f"{self.base}/get-job", params={"worker_id": worker_id}, timeout=self.timeout
+        resp = self._request(
+            "get_job", "GET", "/get-job", params={"worker_id": worker_id}
         )
         return resp.json() if resp.status_code == 200 else None
 
     def update_job(self, job_id: str, changes: dict, worker_id: Optional[str] = None) -> bool:
         if worker_id is not None:
             changes = {**changes, "worker_id": worker_id}  # fencing token
-        resp = self.session.post(
-            f"{self.base}/update-job/{job_id}", json=changes, timeout=self.timeout
+        resp = self._request(
+            "update_job", "POST", f"/update-job/{job_id}", json=changes
         )
         return resp.status_code == 200
 
     def get_input_chunk(self, scan_id: str, chunk_index: int) -> Optional[bytes]:
-        resp = self.session.get(
-            f"{self.base}/get-input-chunk/{scan_id}/{chunk_index}", timeout=self.timeout
+        resp = self._request(
+            "get_chunk", "GET", f"/get-input-chunk/{scan_id}/{chunk_index}"
         )
         return resp.content if resp.status_code == 200 else None
 
     def put_output_chunk(self, scan_id: str, chunk_index: int, data: bytes) -> bool:
-        resp = self.session.post(
-            f"{self.base}/put-output-chunk/{scan_id}/{chunk_index}",
-            data=data,
-            timeout=self.timeout,
+        resp = self._request(
+            "put_chunk", "POST", f"/put-output-chunk/{scan_id}/{chunk_index}",
+            detail=f"{scan_id}_{chunk_index}", data=data,
+        )
+        return resp.status_code == 200
+
+    def renew_lease(self, job_id: str, worker_id: str) -> bool:
+        """Heartbeat one lease; False = the lease is no longer ours."""
+        resp = self._request(
+            "renew_lease", "POST", f"/renew-lease/{job_id}",
+            detail=job_id, json={"worker_id": worker_id},
         )
         return resp.status_code == 200
 
@@ -110,13 +147,30 @@ class JobProcessor:
         work_dir: Optional[str] = None,
     ):
         self.cfg = cfg
-        self.client = client or ServerClient(cfg.resolve_url(), cfg.api_key)
+        if cfg.fault_plan:
+            install_plan(cfg.fault_plan)  # deterministic chaos (tests/soak)
+        if client is None:
+            # production default: retrying transport (jittered backoff +
+            # per-operation breakers) over the raw HTTP client
+            client = RetryingServerClient(
+                ServerClient(cfg.resolve_url(), cfg.api_key),
+                retries=cfg.transport_retries,
+                backoff_s=cfg.transport_backoff_s,
+                backoff_max_s=cfg.transport_backoff_max_s,
+                breaker_threshold=cfg.transport_breaker_threshold,
+                breaker_cooldown_s=cfg.transport_breaker_cooldown_s,
+            )
+        self.client = client
         self.registry = registry or ModuleRegistry(cfg.modules_dir)
         self.work_dir = Path(work_dir or tempfile.mkdtemp(prefix="swarm_worker_"))
         self.work_dir.mkdir(parents=True, exist_ok=True)
+        self.spool = OutputSpool(cfg.spool_dir or self.work_dir / "spool")
         self._engines: dict[str, object] = {}  # templates_dir -> MatchEngine
         self._scan_perf_extra: dict = {}  # per-job scan counters (perf fields)
         self.jobs_done = 0
+        #: cooperative shutdown for threaded workers (chaos soak test)
+        self.stop_requested = False
+        self._last_heartbeat: Optional[LeaseHeartbeat] = None
 
     # ------------------------------------------------------------------
     def prewarm(self, module_name: str) -> bool:
@@ -146,10 +200,25 @@ class JobProcessor:
     # ------------------------------------------------------------------
     def process_jobs(self) -> None:
         """The infinite poll loop (reference worker.py:113-126)."""
-        while True:
+        while not self.stop_requested:
             try:
                 _LAST_POLL.set(time.time())
                 job = self.client.get_job(self.cfg.worker_id)
+            except TransportError as e:
+                # server down is NOT "queue empty": count it distinctly
+                # (the retry layer already burned its backoff budget)
+                _POLL_ERRORS.inc()
+                print(f"server unreachable: {e}")
+                time.sleep(self.cfg.poll_interval_idle_s)
+                continue
+            except Exception as e:
+                print(f"error getting job: {e}")
+                time.sleep(self.cfg.poll_interval_idle_s)
+                continue
+            # the poll proved the server reachable: flush any finished
+            # chunks spooled while it was down (idempotent via fencing)
+            self._replay_spool()
+            try:
                 if job:
                     self.process_chunk(job)
                     # max_jobs bounds *attempts*: a failing job must not
@@ -160,9 +229,20 @@ class JobProcessor:
                 else:
                     time.sleep(self.cfg.poll_interval_idle_s)
             except Exception as e:
-                print(f"error getting job: {e}")
+                print(f"error processing job: {e}")
                 time.sleep(self.cfg.poll_interval_idle_s)
             time.sleep(self.cfg.poll_interval_busy_s)
+
+    def _replay_spool(self) -> None:
+        if not len(self.spool):
+            return
+        try:
+            cleared = self.spool.replay(self.client)
+        except Exception as e:
+            print(f"spool replay failed: {e}")
+            return
+        if cleared:
+            print(f"spool: replayed {cleared} finished chunk(s)")
 
     # ------------------------------------------------------------------
     def process_chunk(self, job: dict) -> None:
@@ -178,11 +258,19 @@ class JobProcessor:
         timer = PhaseTimer()
 
         def update(status, **extra):
-            ok = self.client.update_job(
-                job_id,
-                {"status": status, **extra},
-                worker_id=self.cfg.worker_id,
-            )
+            try:
+                ok = self.client.update_job(
+                    job_id,
+                    {"status": status, **extra},
+                    worker_id=self.cfg.worker_id,
+                )
+            except TransportError as e:
+                # server unreachable mid-job: a lost phase update is
+                # harmless (the lease covers us); a lost COMPLETE is
+                # handled by the caller via the spool. None ≠ False —
+                # False means the server actively rejected (fencing).
+                print(f"update {status!r} undeliverable: {e}")
+                ok = None
             if status not in JobStatus.TERMINAL:
                 emit_event(
                     "job.phase",
@@ -218,6 +306,27 @@ class JobProcessor:
             module=job.get("module"),
         )
 
+        # lease heartbeat: renew from a background ticker while the
+        # chunk runs so a long batch never races the server's
+        # _requeue_expired into a double execution (docs/RESILIENCE.md)
+        hb = LeaseHeartbeat(
+            self.client,
+            job_id,
+            self.cfg.worker_id,
+            self.cfg.heartbeat_interval_s or self.cfg.lease_seconds / 3.0,
+        )
+        self._last_heartbeat = hb
+        hb.start()
+        try:
+            self._run_chunk(job, job_id, scan_id, chunk_index, timer, update)
+        finally:
+            hb.stop()
+
+    def _run_chunk(
+        self, job: dict, job_id: str, scan_id: str, chunk_index: int,
+        timer: PhaseTimer, update,
+    ) -> None:
+        """Download → execute → upload under an active heartbeat."""
         update(JobStatus.STARTING)
         update(JobStatus.DOWNLOADING)
         with timer.phase("download"):
@@ -236,6 +345,9 @@ class JobProcessor:
 
         try:
             with timer.phase("execute"), maybe_device_profile(job_id):
+                # chaos lever: fail (or delay) this chunk's execution —
+                # detail carries the job id so a plan can poison one job
+                fault_point("executor.run", detail=job_id)
                 if module.backend == "tpu":
                     output = self._execute_tpu(module, data)
                 elif module.backend == "probe":
@@ -265,17 +377,34 @@ class JobProcessor:
             return
 
         update(JobStatus.UPLOADING)
+        unreachable = False
         with timer.phase("upload"):
             try:
                 ok = self.client.put_output_chunk(scan_id, chunk_index, output)
+            except TransportError:
+                # server unreachable after the retry budget: the chunk's
+                # compute is paid for — never lose it (spool below)
+                ok = False
+                unreachable = True
             except requests.RequestException:
                 ok = False
-        if ok:
+        if ok or unreachable:
             perf = timer.perf()
             perf["input_bytes"] = len(data)
             perf["output_bytes"] = len(output)
             perf.update(self._engine_perf_delta())
             perf.update(self._scan_perf_extra)
+            # this worker's non-closed breakers (transport + device)
+            # ride the perf fields to the server, so /get-statuses
+            # shows remote-fleet degradation the server-side /healthz
+            # breaker board (process-local) cannot see
+            from swarm_tpu.resilience.breaker import breaker_states
+
+            open_breakers = {
+                k: v for k, v in breaker_states().items() if v != "closed"
+            }
+            if open_breakers:
+                perf["breakers_open"] = open_breakers
             rows = perf.get("rows")
             exec_s = perf.get("execute_s")
             import math
@@ -288,9 +417,36 @@ class JobProcessor:
                 _ROWS_TOTAL.inc(rows)
                 if exec_s and math.isfinite(exec_s):
                     _ROWS_PER_SEC.set(rows / exec_s)
-            update(JobStatus.COMPLETE, perf=perf)
+            done = True
+            if ok:
+                done = update(JobStatus.COMPLETE, perf=perf)
+            if unreachable or done is None:
+                # finished work outlives the outage: spool the output +
+                # completion and replay on reconnect — idempotent, and
+                # the fencing token discards it if the job was re-leased
+                self._spool_finished(
+                    job_id, scan_id, chunk_index, output, perf
+                )
         else:
             update(JobStatus.UPLOAD_FAILED_UNKNOWN)
+
+    def _spool_finished(
+        self, job_id: str, scan_id: str, chunk_index: int,
+        output: bytes, perf: dict,
+    ) -> None:
+        self.spool.put(
+            job_id, scan_id, chunk_index, self.cfg.worker_id, output,
+            perf=perf,
+        )
+        _JOBS_PROCESSED.labels(outcome="spooled").inc()
+        emit_event(
+            "job.spooled",
+            job_id=job_id,
+            worker_id=self.cfg.worker_id,
+            scan_id=scan_id,
+            chunk_index=chunk_index,
+        )
+        print(f"server unreachable; spooled finished chunk {job_id}")
 
     def _engine_perf_delta(self) -> dict:
         """Device-engine stats accumulated during this job (tpu backend

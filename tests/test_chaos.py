@@ -1,0 +1,212 @@
+"""Chaos soak (docs/RESILIENCE.md capstone): a multi-chunk end-to-end
+scan under a seeded fault plan — dropped polls, uploads failing through
+the whole retry budget (spool + replay), dead heartbeats + an
+over-lease chunk (expiry/re-lease/fencing), one poisoned job, one
+device fault — must complete with verdicts BIT-IDENTICAL to the
+fault-free run, and the poison job must land in dead-letter.
+
+Two real worker threads drive the real HTTP server; the only
+non-production piece is the deterministic fault plan.
+"""
+
+import base64
+import json
+import threading
+import time
+
+import pytest
+
+from swarm_tpu.client.cli import JobClient
+from swarm_tpu.config import Config
+from swarm_tpu.resilience.faults import clear_plan, install_plan
+from swarm_tpu.server.app import SwarmServer
+from swarm_tpu.worker.runtime import JobProcessor
+
+TEMPLATES = "tests/data/templates"
+
+FAULT_PLAN = (
+    "seed=7;"
+    "transport.get_job:2,5;"          # dropped polls (retried)
+    # chunk 0's upload fails past the whole retry budget (initial + 2
+    # retries) → spooled, then replayed on the next successful poll
+    "transport.put_chunk/victimscan_1_0:1-3;"
+    # the slow chunk's heartbeats are dead → its lease CAN lapse
+    # (scoped by job id so the spool's ownership-probe renewal for
+    # chunk 0 still works)
+    "transport.renew_lease/victimscan_1_2:*;"
+    "executor.run/poisonscan*:*;"     # the poison job always fails
+    "executor.run/victimscan_1_2:1:sleep=1.2;"  # chunk outlives its lease
+    "device.dispatch:1"               # one device-path fault (degrade)
+)
+
+
+@pytest.fixture
+def stack(tmp_path, monkeypatch):
+    monkeypatch.setenv("SWARM_TEMPLATES_DIR", TEMPLATES)
+    modules_dir = tmp_path / "modules"
+    modules_dir.mkdir()
+    (modules_dir / "fingerprint.json").write_text(
+        json.dumps({"backend": "tpu", "templates": "${SWARM_TEMPLATES_DIR}"})
+    )
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="chaoskey",
+        blob_root=str(tmp_path / "blobs"), doc_root=str(tmp_path / "docs"),
+        modules_dir=str(modules_dir),
+        poll_interval_idle_s=0.03, poll_interval_busy_s=0.01,
+        lease_seconds=0.5, max_attempts=3,
+        transport_retries=2, transport_backoff_s=0.01,
+        transport_backoff_max_s=0.05,
+        transport_breaker_threshold=50, transport_breaker_cooldown_s=0.2,
+        heartbeat_interval_s=0.1,
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    cfg.server_url = f"http://127.0.0.1:{srv.port}"
+    yield cfg, srv, tmp_path
+    clear_plan()
+    srv.shutdown()
+
+
+def _victim_rows():
+    rows = [
+        {"host": f"10.0.0.{i}", "port": 443, "status": 200,
+         "body": f"<title>Demo Admin</title> demo-build 7.{i} page {i}"}
+        for i in range(6)
+    ]
+    rows.append(
+        {"host": "10.0.9.1", "port": 7777,
+         "banner_b64": base64.b64encode(b"DEMOD: 2 service ready").decode()}
+    )
+    rows.append({"host": "10.0.9.2", "port": 80, "status": 200,
+                 "body": "hello world"})
+    return rows
+
+
+def _submit(client, tmp_path, scan_id, rows, batch):
+    f = tmp_path / f"{scan_id}.jsonl"
+    f.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    code, _ = client.start_scan(str(f), "fingerprint", 0, batch, scan_id=scan_id)
+    assert code == 200
+
+
+def _worker(cfg, worker_id):
+    wcfg = Config(**{**cfg.__dict__, "worker_id": worker_id})
+    return JobProcessor(wcfg)
+
+
+def test_chaos_soak_bit_identical_and_quarantines(stack):
+    cfg, srv, tmp_path = stack
+    client = JobClient(cfg.resolve_url(), cfg.api_key)
+
+    # --- fault-free baseline: same content, no plan ---
+    _submit(client, tmp_path, "victimbase_1", _victim_rows(), batch=2)
+    base_worker = _worker(cfg, "base-w")
+    base_worker.cfg.max_jobs = 4
+    base_worker.process_jobs()
+    baseline_raw = client.fetch_raw("victimbase_1")
+    assert baseline_raw  # 4 chunks of real output
+
+    # --- arm the plan, submit victim + poison, unleash two workers ---
+    plan = install_plan(FAULT_PLAN)
+    _submit(client, tmp_path, "victimscan_1", _victim_rows(), batch=2)
+    _submit(client, tmp_path, "poisonscan_1",
+            [{"host": "10.1.0.1", "port": 80, "status": 200, "body": "x"}],
+            batch=1)
+    workers = [_worker(cfg, "w0"), _worker(cfg, "w1")]
+    threads = [
+        threading.Thread(target=w.process_jobs, daemon=True) for w in workers
+    ]
+    for t in threads:
+        t.start()
+
+    try:
+        deadline = time.time() + 120
+        victim_done = poison_dead = False
+        while time.time() < deadline and not (victim_done and poison_dead):
+            time.sleep(0.2)
+            statuses = client.get_statuses()
+            if statuses is None:
+                continue
+            for scan in statuses.get("scans", []):
+                if scan["scan_id"] == "victimscan_1":
+                    victim_done = scan["percent_complete"] == 100.0
+            poison = statuses["jobs"].get("poisonscan_1_0")
+            poison_dead = bool(poison) and poison["status"] == "dead letter"
+        assert victim_done, "victim scan did not complete under chaos"
+        assert poison_dead, "poison job did not reach dead-letter"
+    finally:
+        for w in workers:
+            w.stop_requested = True
+        for t in threads:
+            t.join(timeout=30)
+
+    # --- capstone: verdicts bit-identical to the fault-free run ---
+    chaos_raw = client.fetch_raw("victimscan_1")
+    assert chaos_raw == baseline_raw.replace("victimbase_1", "victimscan_1")
+
+    # --- the poison job carries its provenance and is CLI-requeueable ---
+    [dead] = client.dead_letter_jobs()
+    assert dead["job_id"] == "poisonscan_1_0"
+    assert len(dead["failure_history"]) == cfg.max_attempts
+    assert all(f["status"] == "cmd failed" for f in dead["failure_history"])
+
+    # --- every injected failure mode actually fired ---
+    snap = plan.snapshot()
+    assert snap["transport.get_job"]["fired"] == 2
+    assert snap["transport.put_chunk/victimscan_1_0"]["fired"] == 3
+    assert snap["transport.renew_lease/victimscan_1_2"]["fired"] >= 1
+    assert snap["executor.run/poisonscan*"]["fired"] == cfg.max_attempts
+    assert snap["executor.run/victimscan_1_2"]["fired"] == 1
+    assert snap["device.dispatch"]["fired"] == 1
+
+    # --- the spool caught the upload that failed past its retries ---
+    # (already drained by replay at this point; assert via telemetry)
+    from swarm_tpu.telemetry import REGISTRY
+
+    metrics = {}
+    for line in REGISTRY.render().splitlines():
+        if line and not line.startswith("#"):
+            name = line.split("{")[0].split(" ")[0]
+            try:
+                metrics[name] = metrics.get(name, 0.0) + float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    assert metrics.get("swarm_resilience_spooled_chunks_total", 0) >= 1
+    assert metrics.get("swarm_resilience_spool_replayed_total", 0) >= 1
+
+    # --- operator surface: /healthz shows quarantine + breakers ---
+    health = client.get_healthz()
+    assert health["dead_letter_jobs"] == 1
+    assert isinstance(health["breakers"], dict)
+    assert health["fault_plan"] == FAULT_PLAN
+
+    # --- with the plan cleared, fault points return to no-ops ---
+    clear_plan()
+    from swarm_tpu.resilience.faults import fault_point
+
+    fault_point("transport.get_job")  # must not raise
+
+
+def test_dead_letter_requeue_completes_after_poison_lifts(stack):
+    """Operator story: inspect the quarantined job, requeue it once the
+    underlying cause is fixed (plan cleared), and watch it complete."""
+    cfg, srv, tmp_path = stack
+    client = JobClient(cfg.resolve_url(), cfg.api_key)
+    install_plan("executor.run/poisonscan*:*")
+    _submit(client, tmp_path, "poisonscan_9",
+            [{"host": "10.1.0.2", "port": 80, "status": 200, "body": "y"}],
+            batch=1)
+    w = _worker(cfg, "wq")
+    w.cfg.max_jobs = cfg.max_attempts
+    w.process_jobs()  # burns all attempts → dead letter
+    [dead] = client.dead_letter_jobs()
+    assert dead["job_id"] == "poisonscan_9_0"
+    clear_plan()  # "the bug is fixed"
+    code, _ = client.requeue_job("poisonscan_9_0")
+    assert code == 200
+    w2 = _worker(cfg, "wq2")
+    w2.cfg.max_jobs = 1
+    w2.process_jobs()
+    statuses = client.get_statuses()
+    assert statuses["jobs"]["poisonscan_9_0"]["status"] == "complete"
+    assert client.dead_letter_jobs() == []

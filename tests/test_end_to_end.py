@@ -118,7 +118,10 @@ def test_cli_actions_render(stack, capsys):
     assert cli_main(["reset"] + base_args) == 0
 
 
-def test_worker_cmd_failed_on_bad_module(stack):
+def test_worker_failed_module_retries_then_dead_letters(stack):
+    """A worker-reported 'cmd failed' consumes one attempt and
+    requeues; exhausting max_attempts parks the job in dead-letter
+    quarantine with its failure history (docs/RESILIENCE.md)."""
     cfg, srv, tmp_path = stack
     (tmp_path / "modules" / "boom.json").write_text(json.dumps({"command": "exit 3"}))
     scan_file = tmp_path / "t.txt"
@@ -127,11 +130,17 @@ def test_worker_cmd_failed_on_bad_module(stack):
     client.start_scan(str(scan_file), "boom", 0, 1)
     wcfg = Config(**{**cfg.__dict__, "max_jobs": 1, "worker_id": "w-fail"})
     proc = JobProcessor(wcfg)
-    job = proc.client.get_job("w-fail")
-    proc.process_chunk(job)
+    for attempt in range(1, cfg.max_attempts + 1):
+        job = proc.client.get_job("w-fail")
+        assert job is not None and job["attempts"] == attempt
+        proc.process_chunk(job)
+    assert proc.client.get_job("w-fail") is None  # quarantined, not requeued
     statuses = client.get_statuses()
     [job_rec] = statuses["jobs"].values()
-    assert job_rec["status"] == "cmd failed"
+    assert job_rec["status"] == "dead letter"
+    assert [
+        f["status"] for f in job_rec["failure_history"]
+    ] == ["cmd failed"] * cfg.max_attempts
 
 
 def test_cli_stream_and_cat(stack, monkeypatch, capsys):

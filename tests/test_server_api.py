@@ -269,13 +269,20 @@ def test_lease_requeue(tmp_path):
     assert rejob["job_id"] == job["job_id"]
     assert rejob["worker_id"] == "healthy-worker"
     assert rejob["attempts"] == 2
-    # exhaust attempts -> terminal cmd failed
+    # exhaust attempts -> dead-letter quarantine with failure history
     _time.sleep(0.25)
     assert q.next_job("w3") is not None
     _time.sleep(0.25)
     assert q.next_job("w4") is None
     raw = json.loads(q.state.hget("jobs", job["job_id"]))
-    assert raw["status"] == "cmd failed"
+    assert raw["status"] == "dead letter"
+    assert len(raw["failure_history"]) == 3  # one 'lease expired' per loss
+    assert all(f["status"] == "lease expired" for f in raw["failure_history"])
+    # operator requeue puts it back with a fresh attempt budget
+    assert q.requeue_dead_letter(job["job_id"])
+    redo = q.next_job("w5")
+    assert redo is not None and redo["attempts"] == 1
+    assert q.update_job(job["job_id"], {"status": "complete", "worker_id": "w5"})
 
 
 def test_204_keepalive_connection_reuse(api):

@@ -26,6 +26,9 @@ REQUIRED_FAMILIES = (
     "swarm_queue_jobs_queued_total",
     "swarm_queue_jobs_dispatched_total",
     "swarm_events_total",
+    # resilience plane (docs/RESILIENCE.md): the plan-armed gauge is
+    # unlabeled so it always renders a sample
+    "swarm_resilience_fault_plan_active",
 )
 
 

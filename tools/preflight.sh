@@ -32,9 +32,19 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
 echo "== preflight: bench smoke (pipeline A/B, both modes) =="
 # CI-fast A/B on the bundled corpus; rc gates on verdict identity only.
 # Forced to the CPU backend unless the operator pinned one — the smoke
-# validates feed mechanics and parity, not chip throughput.
+# validates feed mechanics and parity, not chip throughput. The
+# fault-free runs also record the resilience layer's no-op overhead
+# (resilience_faultfree_overhead_ns).
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=off python bench.py --smoke
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=on python bench.py --smoke
+
+echo "== preflight: chaos smoke (seeded fault plan, docs/RESILIENCE.md) =="
+# injected device + scheduler faults must leave verdicts bit-identical
+# (device-degraded mode falls back to the exact CPU oracle); rc gates
+# on verdict identity AND on the plan actually firing
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=on \
+    SWARM_FAULT_PLAN="seed=7;device.dispatch:1,3" \
+    python bench.py --smoke
 
 echo "== preflight: bench =="
 if [ "$1" = "--quick" ]; then
