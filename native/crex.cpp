@@ -811,6 +811,31 @@ int32_t sw_crex_exists(const int32_t* prog, int32_t nprog,
     return 0;
 }
 
+// Batched existence: ONE GIL-released dispatch answers
+// `re.search(pattern, text) is not None` for many contents — the
+// walk's confirm rates are ctypes-dispatch-bound the same way
+// extraction was before finditer_batch.  Tier order per item mirrors
+// native/crex.py exists(): the lazy DFA when a handle is supplied
+// (state-cap misses fall through), then the bitset Thompson scan.
+// out[i] = 1/0 exact verdict, or -1 when the program isn't simulable
+// for that item (caller re-runs exactly those under Python re).
+// Thread-safe across pool threads: the DFA serializes on its context
+// mutex and the bitset scan is stateless.
+void sw_crex_exists_batch(void* dfa, const int32_t* prog, int32_t nprog,
+                          const uint8_t* masks, const char* const* datas,
+                          const int32_t* lens, int32_t nitems,
+                          int8_t* out) {
+    for (int32_t i = 0; i < nitems; ++i) {
+        int32_t rc = -1;
+        if (dfa != nullptr)
+            rc = sw_crex_dfa_exists(dfa, (const uint8_t*)datas[i], lens[i]);
+        if (rc < 0)
+            rc = sw_crex_exists(prog, nprog, masks,
+                                (const uint8_t*)datas[i], lens[i]);
+        out[i] = rc < 0 ? (int8_t)-1 : (int8_t)rc;
+    }
+}
+
 // Single-content finditer.  Returns match count, -2 on resource
 // exhaustion (caller falls back to Python re), -3 on cap overflow.
 int64_t sw_crex_finditer(const int32_t* prog, int32_t nprog,

@@ -664,6 +664,98 @@ extern "C" int64_t sw_rows_alive(PyObject* rows, uint8_t* out) {
   return count;
 }
 
+namespace {
+
+// Substring probe with optional ASCII-case-insensitive compare. The
+// needle arrives PRE-LOWERED (Python bytes.lower() semantics: A-Z
+// only); the haystack byte is lowered on the fly, so verdicts match
+// `needle in part.lower()` exactly. Empty needle matches everything
+// (Python `b"" in x` contract).
+inline bool needle_in(const uint8_t* hay, size_t hlen, const uint8_t* nd,
+                      size_t nlen, bool ci) {
+  if (nlen == 0) return true;
+  if (nlen > hlen) return false;
+  if (!ci) {
+#if defined(__GLIBC__) || defined(_GNU_SOURCE)
+    return memmem(hay, hlen, nd, nlen) != nullptr;
+#else
+    const uint8_t first = nd[0];
+    const size_t last = hlen - nlen;
+    for (size_t i = 0; i <= last; ++i) {
+      if (hay[i] != first) continue;
+      if (std::memcmp(hay + i, nd, nlen) == 0) return true;
+    }
+    return false;
+#endif
+  }
+  const uint8_t first = nd[0];
+  const size_t last = hlen - nlen;
+  for (size_t i = 0; i <= last; ++i) {
+    uint8_t c = hay[i];
+    if (c >= 'A' && c <= 'Z') c |= 0x20;
+    if (c != first) continue;
+    size_t j = 1;
+    for (; j < nlen; ++j) {
+      uint8_t h = hay[i + j];
+      if (h >= 'A' && h <= 'Z') h |= 0x20;
+      if (h != nd[j]) break;
+    }
+    if (j == nlen) return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+// Batched word/binary-matcher confirm: the condition-combined RAW
+// verdict (pre-negation — the caller applies matcher.negative) of ONE
+// matcher's needle list over many content parts, in one pass with the
+// GIL released. ``parts`` is a Python list of bytes (the rows'
+// matcher-part views, gathered by the walk's plan phase and kept
+// alive by the caller for the duration of the call); needle k spans
+// blob[offs[k] .. offs[k+1]). With ``ci`` the needles must arrive
+// pre-lowered and the haystack is ASCII-lowered on the fly — verdicts
+// are bit-identical to cpu_ref.match_matcher's word path. The
+// condition combine matches the oracle (all/any over the needle
+// list); callers never pass an empty needle list (the oracle defines
+// that as False before the combine). Returns 0, -1 on a non-bytes
+// part.
+extern "C" int sw_confirm_needles_batch(
+    PyObject* parts, const uint8_t* blob, const int64_t* offs,
+    int32_t n_needles, int32_t ci, int32_t cond_and, uint8_t* out) {
+  if (!PyList_Check(parts) || n_needles < 0) return -1;
+  Py_ssize_t n = PyList_GET_SIZE(parts);
+  std::vector<const uint8_t*> ptr((size_t)n);
+  std::vector<Py_ssize_t> plen((size_t)n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* obj = PyList_GET_ITEM(parts, i);  // borrowed
+    if (!PyBytes_Check(obj)) return -1;
+    ptr[size_t(i)] = reinterpret_cast<const uint8_t*>(PyBytes_AS_STRING(obj));
+    plen[size_t(i)] = PyBytes_GET_SIZE(obj);
+  }
+  Py_BEGIN_ALLOW_THREADS;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    bool v = cond_and != 0;  // and-identity; or-identity is false
+    for (int32_t k = 0; k < n_needles; ++k) {
+      bool hit = needle_in(ptr[size_t(i)], size_t(plen[size_t(i)]),
+                           blob + offs[k], size_t(offs[k + 1] - offs[k]),
+                           ci != 0);
+      if (cond_and) {
+        if (!hit) {
+          v = false;
+          break;
+        }
+      } else if (hit) {
+        v = true;
+        break;
+      }
+    }
+    out[i] = uint8_t(v);
+  }
+  Py_END_ALLOW_THREADS;
+  return 0;
+}
+
 // Content dedup over a list of Response rows — the C twin of
 // engine._dedup_rows' Python loop with IDENTICAL key semantics
 // (exact compare; the hash only routes to a bucket). Fills back[n]
@@ -1181,8 +1273,23 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
         release_extras();
         return -1;
       }
-      int truthy =
-          a == Py_True ? 1 : (a == Py_False ? 0 : PyObject_IsTrue(a));
+      int truthy;
+      if (a == Py_True) {
+        truthy = 1;
+      } else if (a == Py_False) {
+        truthy = 0;
+      } else {
+        // Non-bool alive: PyObject_IsTrue runs arbitrary __bool__,
+        // which can mutate the row's __dict__ and leave the scan's
+        // borrowed raw.body/raw.header pointers dangling. Short-circuit
+        // only on the Py_True/Py_False identities above; after a real
+        // __bool__ call, drop the scanned view and re-fetch the dict so
+        // the RowView below reads post-mutation objects.
+        truthy = PyObject_IsTrue(a);
+        scanned = false;
+        dp = _PyObject_GetDictPtr(row);
+        dict = dp != nullptr ? *dp : nullptr;
+      }
       if (dec) Py_DECREF(a);
       if (truthy < 0) {
         release_extras();

@@ -113,6 +113,12 @@ def _ensure_crex_locked() -> Optional[ctypes.CDLL]:
     lib.sw_crex_finditer_batch.restype = ctypes.c_int64
     lib.sw_crex_search.restype = ctypes.c_int32
     lib.sw_crex_exists.restype = ctypes.c_int32
+    try:
+        lib.sw_crex_exists_batch.restype = None
+    except AttributeError:
+        # pre-batch .so survived a failed make: the per-call exists()
+        # path still works, only the batched walk dispatch degrades
+        pass
     _lib = lib
     return lib
 
@@ -238,6 +244,27 @@ def finditer_spans_batch(
     return res
 
 
+def _dfa_handle(cp, lib, pp, mp, nprog) -> int:
+    """The program's lazy-DFA context handle (0 = doesn't qualify),
+    built once and cached on the program object. A racing second
+    build constructs one redundant context; attribute assignment is
+    atomic and both get finalizers, so neither leaks."""
+    dfa = getattr(cp, "_dfa", None)
+    if dfa is None:
+        lib.sw_crex_dfa_new.restype = ctypes.c_void_p
+        dfa = lib.sw_crex_dfa_new(pp, nprog, mp) or 0
+        if dfa:
+            # the context must die WITH the program object: a program
+            # from a saturated compile cache is throwaway, and an
+            # orphaned context would leak its state tables
+            import weakref
+
+            weakref.finalize(cp, lib.sw_crex_dfa_free,
+                             ctypes.c_void_p(dfa))
+        cp._dfa = dfa
+    return dfa
+
+
 def exists(cp, data: bytes) -> Optional[bool]:
     """Linear-time ``re.search(pattern, text) is not None``. ``cp``
     must come from crexc.compile_crex_nfa (counter-free).
@@ -253,23 +280,7 @@ def exists(cp, data: bytes) -> Optional[bool]:
     if lib is None or cp is None:
         return None
     pp, mp, nprog = getattr(cp, "_bound", None) or _bind(cp)
-    dfa = getattr(cp, "_dfa", None)
-    if dfa is None:
-        # 0 (NULL) = program doesn't qualify (anchors) — remembered so
-        # the attempt isn't repeated. A racing second build constructs
-        # one redundant context; attribute assignment is atomic and
-        # both get finalizers, so neither leaks.
-        lib.sw_crex_dfa_new.restype = ctypes.c_void_p
-        dfa = lib.sw_crex_dfa_new(pp, nprog, mp) or 0
-        if dfa:
-            # the context must die WITH the program object: a program
-            # from a saturated compile cache is throwaway, and an
-            # orphaned context would leak its state tables
-            import weakref
-
-            weakref.finalize(cp, lib.sw_crex_dfa_free,
-                             ctypes.c_void_p(dfa))
-        cp._dfa = dfa
+    dfa = _dfa_handle(cp, lib, pp, mp, nprog)
     if dfa:
         rc = lib.sw_crex_dfa_exists(ctypes.c_void_p(dfa), data, len(data))
         if rc >= 0:
@@ -278,6 +289,36 @@ def exists(cp, data: bytes) -> Optional[bool]:
     if rc < 0:
         return None
     return bool(rc)
+
+
+def exists_batch(cp, parts: "list[bytes]") -> Optional["np.ndarray"]:
+    """Per-part exact ``re.search is not None`` verdicts for ONE
+    counter-free program — one GIL-released dispatch for the whole
+    row group (the walk's batched regex confirm; per-call dispatch
+    overhead dominated at confirm rates the same way it did for
+    extraction). Returns an int8 array: 1/0 exact verdict, -1 = that
+    part needs the Python fallback. None when the lib (or the batch
+    symbol) is unavailable — caller falls back wholesale."""
+    lib = ensure_crex()
+    if lib is None or cp is None:
+        return None
+    fn = getattr(lib, "sw_crex_exists_batch", None)
+    if fn is None:
+        return None
+    n = len(parts)
+    if n == 0:
+        return np.zeros(0, dtype=np.int8)
+    pp, mp, nprog = getattr(cp, "_bound", None) or _bind(cp)
+    dfa = _dfa_handle(cp, lib, pp, mp, nprog)
+    datas = (ctypes.c_char_p * n)(*parts)
+    lens = np.fromiter((len(p) for p in parts), dtype=np.int32, count=n)
+    out = np.empty(n, dtype=np.int8)
+    fn(
+        ctypes.c_void_p(dfa) if dfa else None, pp, nprog, mp, datas,
+        lens.ctypes.data_as(ctypes.c_void_p), n,
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out
 
 
 def search(cp, data: bytes) -> Optional[bool]:
@@ -298,6 +339,7 @@ def search(cp, data: bytes) -> Optional[bool]:
 
 
 __all__ = [
-    "ensure_crex", "exists", "finditer_spans", "finditer_spans_batch",
-    "search", "usable", "MAX_BUDGET_FAILS", "STEP_BUDGET",
+    "ensure_crex", "exists", "exists_batch", "finditer_spans",
+    "finditer_spans_batch", "search", "usable", "MAX_BUDGET_FAILS",
+    "STEP_BUDGET",
 ]

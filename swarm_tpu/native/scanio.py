@@ -164,6 +164,15 @@ def ensure_fastpack() -> ctypes.PyDLL:
         ctypes.py_object, ctypes.py_object,
     ]
     lib.sw_memo_lookup.restype = ctypes.c_int64
+    try:
+        lib.sw_confirm_needles_batch.argtypes = [
+            ctypes.py_object, u8p, i64p, i32, i32, i32, u8p,
+        ]
+        lib.sw_confirm_needles_batch.restype = ctypes.c_int
+    except AttributeError:
+        # stale pre-batch .so (make failed but an old build survived):
+        # the walk's batched word confirm degrades to the Python path
+        pass
     _fastpack = lib
     return lib
 
@@ -441,6 +450,32 @@ def ext_resolve(
         if n >= 0:
             return bs[:n], ts[:n], ops[:n], states[:n]
         cap *= 4
+
+
+def confirm_needles_batch(
+    parts: list, needles: "list[bytes]", ci: bool, cond_and: bool,
+) -> Optional[np.ndarray]:
+    """Raw (pre-negation) and/or-combined needle verdicts of ONE
+    word/binary matcher over a list of part bytes — one C pass with
+    the GIL released (the walk's batched confirm). ``needles`` must be
+    pre-lowered when ``ci`` (bytes.lower() semantics); never call with
+    an empty needle list (the oracle defines that as False before the
+    combine — handle it in the caller). Returns a uint8 verdict array,
+    or None when the batch symbol is missing (stale .so — caller falls
+    back to the serial confirm)."""
+    lib = ensure_fastpack()
+    fn = getattr(lib, "sw_confirm_needles_batch", None)
+    if fn is None or not needles:
+        return None
+    n = len(parts)
+    offs = np.zeros(len(needles) + 1, dtype=np.int64)
+    np.cumsum([len(nd) for nd in needles], out=offs[1:])
+    blob = np.frombuffer(b"".join(needles) or b"\0", dtype=np.uint8)
+    out = np.empty(max(n, 1), dtype=np.uint8)
+    if fn(parts, blob, offs, len(needles),
+          1 if ci else 0, 1 if cond_and else 0, out) != 0:
+        raise TypeError("parts must be a list of bytes")
+    return out[:n]
 
 
 def rows_alive(rows: list) -> "tuple[int, np.ndarray]":

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -115,6 +116,15 @@ class EngineStats:
     ext_enum_seconds: float = 0.0
     ext_resolve_seconds: float = 0.0
     ext_extract_seconds: float = 0.0
+    # batched-confirm walk (docs/HOST_WALK.md): (row, matcher)/(row, op)
+    # pairs whose verdict was precomputed by the row-parallel native
+    # passes, the dispatch rounds that ran, the plan+dispatch wall
+    # (included in host_confirm_seconds via unc/ext), and the worker
+    # pool width (0 = batching inline or disabled)
+    walk_batched_pairs: int = 0
+    walk_batch_rounds: int = 0
+    walk_precompute_seconds: float = 0.0
+    walk_pool_threads: int = 0
 
 
 def _bit(packed: np.ndarray, b: int, i: int) -> bool:
@@ -227,6 +237,7 @@ class MatchEngine:
         pipeline: Optional[str] = None,  # "on" | "off" | None → SWARM_PIPELINE
         device_breaker_threshold: int = 2,
         device_breaker_cooldown_s: float = 60.0,
+        walk_threads: Optional[int] = None,  # None → SWARM_WALK_THREADS
     ):
         self.templates = list(templates)
         self.db = db if db is not None else compile_corpus(self.templates)
@@ -248,6 +259,15 @@ class MatchEngine:
             "on" if str(pipeline).lower() in ("on", "1", "true") else "off"
         )
         self._sched = None  # lazy BatchScheduler (pipeline="on")
+        # guards the stats fields BOTH the submit thread (begin_packed)
+        # and the scheduler's walk worker (finish_packed → _walk_plane)
+        # update — unsynchronized float += across threads loses updates
+        self._stats_lock = threading.Lock()
+        # row-parallel batched confirm walk (docs/HOST_WALK.md):
+        # explicit arg > SWARM_WALK_THREADS > SWARM_EXT_THREADS (compat)
+        # > spare cores. 0 = serial reference walk; 1 = batched native
+        # passes, inline; >=2 adds the worker pool.
+        self._walk_threads_arg = walk_threads
         # Multi-chip: shard each batch dp×tp×sp across the local mesh
         # (the production analog of the reference's chunk-per-worker
         # scale-out, server/server.py:465-515 — here one worker drives a
@@ -357,6 +377,20 @@ class MatchEngine:
         self._op_m_shift = [
             (7 - (ids & 7)).astype(np.uint8) for ids in self._op_m_arr
         ]
+        # CSR twin of op_matchers for the batched walk's vectorized
+        # candidate expansion (one fancy index over the whole batch's
+        # candidate ops instead of a per-op gather)
+        self._op_m_indptr = np.zeros(
+            len(self._op_m_arr) + 1, dtype=np.int64
+        )
+        for i, ids in enumerate(self._op_m_arr):
+            self._op_m_indptr[i + 1] = self._op_m_indptr[i] + len(ids)
+        self._op_m_flat = (
+            np.concatenate(self._op_m_arr)
+            if self._op_m_arr
+            else np.zeros(0, dtype=np.int64)
+        ).astype(np.int64, copy=False)
+        self._op_m_counts = np.diff(self._op_m_indptr)
         # python-native twins of the per-template op tables: the walk's
         # inner loops hash (row, op) keys and index bit planes with
         # these, and numpy int scalars make every such op ~3x slower
@@ -448,10 +482,16 @@ class MatchEngine:
     @classmethod
     def _cache_put(cls, cache: dict, key, val) -> None:
         """Bounded FIFO insert shared by the cross-batch content memos:
-        past the cap, drop the oldest half (dict preserves order)."""
+        past the cap, drop the oldest half (dict preserves order).
+        Thread-tolerant under the GIL for the walk pool's fallback
+        tasks: each dict op is atomic, the key snapshot tolerates
+        concurrent inserts, and eviction uses pop (two racing evictors
+        must not KeyError on a key the other already dropped). Values
+        for one key are always identical (pure content functions), so
+        a double insert is benign."""
         if len(cache) >= cls._EXT_CACHE_MAX:
             for k in list(cache)[: cls._EXT_CACHE_MAX // 2]:
-                del cache[k]
+                cache.pop(k, None)
         cache[key] = val
 
     def _extract_op(self, op, row: Response) -> list:
@@ -476,28 +516,56 @@ class MatchEngine:
             out.extend(vals)
         return out
 
-    def _ext_pool(self):
-        """Shared thread pool for the GIL-released native extraction
-        batches — sized by SWARM_EXT_THREADS (default: spare cores up
-        to 4; 0/1 disables). None when threading is off."""
-        pool = getattr(self, "_ext_pool_obj", None)
+    @property
+    def walk_threads(self) -> int:
+        """Effective walk worker count: constructor arg >
+        ``SWARM_WALK_THREADS`` > ``SWARM_EXT_THREADS`` (compat) >
+        spare cores capped at 4. 0 disables the batched walk entirely
+        (the serial reference path); 1 runs the batched native passes
+        inline; >=2 row-shards them across the worker pool."""
+        n = self._walk_threads_arg
+        if n is None:
+            import os as _os
+
+            env = _os.environ.get("SWARM_WALK_THREADS") or _os.environ.get(
+                "SWARM_EXT_THREADS"
+            )
+            n = int(env) if env else min(
+                4, max(1, (_os.cpu_count() or 1) - 1)
+            )
+        return max(0, int(n))
+
+    def configure_walk(self, threads: Optional[int]) -> None:
+        """Re-point the walk pool at runtime (bench A/B, tests): shuts
+        any existing pool down, then re-decides lazily on next use.
+        ``None`` restores env-derived sizing."""
+        pool = getattr(self, "_walk_pool_obj", None)
+        if pool:
+            pool.shutdown(wait=True)
+        self._walk_pool_obj = None
+        self._walk_threads_arg = threads
+        self.stats.walk_pool_threads = 0
+
+    def _walk_pool(self):
+        """Shared row-sharded worker pool for the walk's GIL-released
+        native batches — confirm passes AND extraction finditer
+        batches (what used to be the extraction-only ``_ext_pool``).
+        Sized by :attr:`walk_threads`; None when threading is off
+        (batched passes then run inline on the walk thread)."""
+        pool = getattr(self, "_walk_pool_obj", None)
         if pool is not None:
             return pool or None
-        import os as _os
-
-        n = _os.environ.get("SWARM_EXT_THREADS")
-        workers = (
-            int(n) if n else min(4, max(1, (_os.cpu_count() or 1) - 1))
-        )
+        workers = self.walk_threads
         if workers <= 1:
-            self._ext_pool_obj = ()  # sentinel: decided, disabled
+            self._walk_pool_obj = ()  # sentinel: decided, disabled
             return None
         from concurrent.futures import ThreadPoolExecutor
 
-        self._ext_pool_obj = ThreadPoolExecutor(
-            max_workers=workers, thread_name_prefix="swarm-ext"
+        self._walk_pool_obj = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="swarm-walk"
         )
-        return self._ext_pool_obj
+        self.stats.walk_pool_threads = workers
+        return self._walk_pool_obj
 
     def _resolve_regex_ex(
         self, ex, ex_local, key, hint, infos,
@@ -724,7 +792,7 @@ class MatchEngine:
             # the batch C calls release the GIL: on hosts with spare
             # cores the per-pattern scans run concurrently (disjoint
             # outputs, no shared mutable state inside the call)
-            pool = self._ext_pool()
+            pool = self._walk_pool()
             if pool is not None and len(task_list) > 1:
                 results = list(pool.map(
                     lambda kv: ncrex.finditer_spans_batch(
@@ -921,6 +989,44 @@ class MatchEngine:
                 matched = True
                 extractions.extend(self._extract_op(op, row))
         return matched, extractions
+
+    def _confirm_matcher_serial(self, m_id: int, row: Response) -> bool:
+        """Exact verdict of ONE device matcher for one row — the
+        serial reference confirm (content-keyed cache + per-pattern
+        proofs). The batched walk's precomputed planes must agree with
+        this bit for bit (tests/test_walk_parallel.py); pairs the
+        native passes can't answer re-run here."""
+        matcher = self._m_obj[m_id]
+        if matcher is None:
+            # synthesized extraction prefilter: per-pattern verdict
+            return self._confirm_ext_pattern(m_id, row)
+        if matcher.type not in ("word", "regex", "binary", "size"):
+            # dsl/status/kval read beyond matcher.part — not cacheable
+            mv = cpu_ref.match_matcher(matcher, row)
+            return bool(mv) if mv is not None else False
+        part = row.part(matcher.part)
+        key = ("m", m_id, part)
+        cache = self._confirm_cache
+        v = cache.get(key)
+        if v is None:
+            # exact per-pattern evaluation with literal/candidate
+            # proofs: most confirms are q-gram collisions whose slow
+            # regex (waf-detect's ~2 ms backtrackers) certainly can't
+            # match — those are decided at bytes.find speed; unproven
+            # patterns get a real re.search. Negation mirrors
+            # cpu_ref.match_matcher.
+            raw = (
+                self._regex_matcher_raw(matcher, part)
+                if matcher.type == "regex"
+                else None
+            )
+            if raw is not None:
+                v = (not raw) if matcher.negative else raw
+            else:
+                mv = cpu_ref.match_matcher(matcher, row)
+                v = bool(mv) if mv is not None else False
+            self._cache_put(cache, key, v)
+        return v
 
     def _regex_matcher_raw(self, matcher, part: bytes):
         """The EXACT raw (pre-negation) verdict of a regex matcher over
@@ -1183,9 +1289,17 @@ class MatchEngine:
         """C-memo encode: ONE native pass serves every known row's
         packed verdict straight into the batch plane (and collects
         their extras), in-batch-dedups the misses, and only the miss
-        uniques are encoded for the device. The returned ``bits`` plane
-        is a snapshot — memo eviction between a pipelined encode and
-        its match can't lose a served verdict."""
+        uniques are encoded for the device.
+
+        The returned ``bits`` plane snapshots the MEMO STATE (eviction
+        between a pipelined encode and its match can't lose a served
+        verdict) — but with ``reuse_buffers=True`` its STORAGE is
+        drawn from the per-shape rotating pool and is overwritten 8
+        same-shape encodes later. The ``PackedMatches.bits`` a match
+        assembles from it aliases this plane, so results that outlive
+        the 1-deep pipelined consume pattern must ``.copy()`` (the
+        recycling contract documented on :class:`PackedMatches`); the
+        default allocating path hands back a plane the caller owns."""
         nbits = max((self.db.num_templates + 7) >> 3, 1)
         if self._vmemo is None:
             from swarm_tpu.native.scanio import VerdictMemo
@@ -1281,7 +1395,11 @@ class MatchEngine:
         return f"r{rows}." + ".".join(parts)
 
     def _note_device_fault(self, breaker, exc: BaseException) -> None:
-        self.stats.device_faults += 1
+        # under the scheduler's walk offload this runs on the submit
+        # thread (begin_packed) AND the walk worker (_walk_plane) —
+        # same cross-thread contract as device_seconds
+        with self._stats_lock:
+            self.stats.device_faults += 1
         breaker.record_failure()
         print(
             f"device path failed ({type(exc).__name__}: {exc}); "
@@ -1306,6 +1424,464 @@ class MatchEngine:
             np.zeros((B, nmb), dtype=np.uint8),
             np.ones((B,), dtype=bool),
         )
+
+    def _gather_confirm_candidates(
+        self, pt_value, pt_unc, pop_value, pop_unc, pm_unc, skip
+    ):
+        """Every (row, matcher) / prefiltered (row, op) pair the walk's
+        serial loops COULD resolve, gathered from the device planes in
+        one pass. Overapproximates the extraction pass's undecided ops
+        via ``(pt_value | pt_unc) & ext_mask`` (the post-resolution
+        extractor plane is a subset: value bits only appear there if
+        they were set before the walk or uncertain) — extra pairs cost
+        speculative native scans, never accounting, because
+        ``host_confirm_pairs`` counts resolve_op calls, which this
+        never changes. Returns ``(by_matcher {m_id: [b, ...]},
+        op_pairs [(b, op_id), ...])``."""
+        from swarm_tpu.native.scanio import ext_resolve, plane_bits
+
+        NT = self.db.num_templates
+        rowdep = self._rowdep_t
+        pseudo_t = self._pseudo_t
+        seen_ops: set = set()
+        op_cands: list = []  # (b, op_id) in need of a verdict
+        # uncertain-template pairs: genuinely sparse, Python loop is fine
+        ub, ut = plane_bits(np.ascontiguousarray(pt_unc), NT)
+        for b, t_idx in zip(ub.tolist(), ut.tolist()):
+            if b in skip or t_idx in rowdep or t_idx in pseudo_t:
+                continue
+            for op_id in self._t_ops_py[t_idx]:
+                if not _bit(pop_unc, b, op_id):
+                    continue
+                key = (b, op_id)
+                if key not in seen_ops:
+                    seen_ops.add(key)
+                    op_cands.append(key)
+        # extractor-plane hits can be DENSE (tech templates fire on
+        # most fleet rows): reuse the extraction pass's C driver over
+        # the overapproximated plane and keep only its undecided
+        # (state 2) ops — Python never touches the certain hits
+        if len(self._ext_cols):
+            emask = self._ext_byte_mask
+            masked = (
+                pt_value[:, : len(emask)] | pt_unc[:, : len(emask)]
+            ) & emask[None, :]
+            skip_rows = np.zeros(len(pt_value), dtype=np.uint8)
+            for rb in skip:
+                skip_rows[rb] = 1
+            bs, ts, opsv, sts = ext_resolve(
+                masked, NT, self._rowdep_mask, skip_rows,
+                self._t_ops_indptr, self._t_ops_flat,
+                np.ascontiguousarray(pop_value),
+                np.ascontiguousarray(pop_unc),
+            )
+            und = sts == 2
+            for b, t_idx, op_id in zip(
+                bs[und].tolist(), ts[und].tolist(), opsv[und].tolist()
+            ):
+                if t_idx in pseudo_t:
+                    continue  # decided by the extraction pass, never
+                    # resolve_op'd — no confirms behind them
+                key = (b, op_id)
+                if key not in seen_ops:
+                    seen_ops.add(key)
+                    op_cands.append(key)
+        by_matcher: dict = {}
+        op_pairs: list = []
+        mm_bs: list = []
+        mm_ops: list = []
+        for b, op_id in op_cands:
+            if self._op_prefilter_py[op_id]:
+                op_pairs.append((b, op_id))
+            else:
+                mm_bs.append(b)
+                mm_ops.append(op_id)
+        if mm_ops:
+            # vectorized op → uncertain-matcher expansion: ONE fancy
+            # index over the unpacked pm plane for the whole batch's
+            # candidate ops (a per-op numpy gather costs ~8 us each —
+            # thousands of pairs on the reference corpus)
+            NM = len(self._m_obj)
+            ops_arr = np.asarray(mm_ops, dtype=np.int64)
+            bs_arr = np.asarray(mm_bs, dtype=np.int64)
+            # unpack ONLY the candidate rows' pm bits: the full [B, NM]
+            # plane is multi-MB at production batch sizes while the
+            # candidate set is typically a handful of rows
+            rows_u, row_local = np.unique(bs_arr, return_inverse=True)
+            pm_bits = np.unpackbits(
+                np.ascontiguousarray(pm_unc[rows_u]), axis=1, count=NM
+            )
+            counts = self._op_m_counts[ops_arr]
+            total = int(counts.sum())
+            if total:
+                b_all = np.repeat(bs_arr, counts)
+                # flat matcher ids of each candidate op, concatenated:
+                # global position − local slice start + CSR offset
+                idx = (
+                    np.arange(total, dtype=np.int64)
+                    - np.repeat(np.cumsum(counts) - counts, counts)
+                    + np.repeat(self._op_m_indptr[ops_arr], counts)
+                )
+                m_all = self._op_m_flat[idx]
+                sel = pm_bits[
+                    np.repeat(row_local, counts), m_all
+                ].astype(bool)
+                for b, m in zip(
+                    b_all[sel].tolist(), m_all[sel].tolist()
+                ):
+                    by_matcher.setdefault(m, []).append(b)
+        return by_matcher, op_pairs
+
+    #: distinct parts per pooled native shard — small enough that a
+    #: 4-worker pool sees work from one big matcher group, large
+    #: enough that per-task dispatch stays negligible
+    _WALK_SHARD = 256
+    #: below this many pending pairs the batch machinery costs more
+    #: than the serial loops it would feed — skip it (results are
+    #: identical either way; only where verdicts come from changes)
+    _WALK_MIN_PAIRS = 16
+
+    def _precompute_confirms(
+        self, nrows, pt_value, pt_unc, pop_value, pop_unc, pm_unc, skip
+    ):
+        """Row-parallel batched confirm (docs/HOST_WALK.md).
+
+        Plan phase (this thread): gather the batch's pending pairs,
+        group them BY MATCHER, short-circuit pairs the cross-batch
+        ``_confirm_cache`` already holds, and content-dedup the rest
+        per matcher (distinct part bytes, not rows, are the unit of
+        work — repeated internet content confirms once). Dispatch
+        phase: each (matcher, part-shard) group resolves in one
+        GIL-released native pass — ``sw_confirm_needles_batch`` for
+        word/binary, crex DFA/NFA ``exists_batch`` per regex pattern —
+        sharded across the walk pool; pairs the native passes can't
+        answer exactly (unsupported patterns, dsl/status/kval, stale
+        .so) re-run on the serial reference path inside pooled
+        fallback tasks, so every verdict is bit-identical to
+        ``_confirm_matcher_serial``. Merge phase (this thread): fold
+        each task's private verdicts and cache inserts back into the
+        shared ``_confirm_cache`` (per-thread shards merged at batch
+        end — worker tasks never mutate the shared dict mid-flight).
+
+        Returns ``({(b, m_id): bool}, {(b, op_id): bool})``.
+        """
+        t0 = time.perf_counter()
+        by_matcher, op_pairs = self._gather_confirm_candidates(
+            pt_value, pt_unc, pop_value, pop_unc, pm_unc, skip
+        )
+        pre_m: dict = {}
+        pre_op: dict = {}
+        n_pending = sum(map(len, by_matcher.values())) + len(op_pairs)
+        if n_pending < self._WALK_MIN_PAIRS:
+            # near-empty batch: the serial loops resolve a handful of
+            # pairs faster than the group/dispatch/merge machinery
+            # costs — the stats time below still records the plan
+            self.stats.walk_precompute_seconds += (
+                time.perf_counter() - t0
+            )
+            return pre_m, pre_op
+        from swarm_tpu.native import crex as ncrex
+        from swarm_tpu.native.scanio import confirm_needles_batch
+
+        cache = self._confirm_cache
+        parts_of: dict = {}  # (b, part_name) -> bytes
+
+        def row_part(b: int, name) -> bytes:
+            key = (b, name)
+            p = parts_of.get(key)
+            if p is None:
+                p = parts_of[key] = nrows[b].part(name)
+            return p
+
+        tasks: list = []      # zero-arg callables -> (verdicts, inserts)
+        fallback: list = []   # (b, m_id) pairs for the serial reference
+
+        def needle_task(m_id, matcher, part_rows, needles, ci, cond_and):
+            neg = bool(matcher.negative)
+
+            def run():
+                parts = [p for p, _bs in part_rows]
+                raw = confirm_needles_batch(parts, needles, ci, cond_and)
+                verdicts: dict = {}
+                inserts: list = []
+                if raw is None:  # stale .so: serial reference per pair
+                    for p, bs_ in part_rows:
+                        for b in bs_:
+                            verdicts[(b, m_id)] = (
+                                self._confirm_matcher_serial(m_id, nrows[b])
+                            )
+                    return verdicts, inserts, 0
+                native = 0
+                for (p, bs_), rv in zip(part_rows, raw.tolist()):
+                    v = (not rv) if neg else bool(rv)
+                    inserts.append((("m", m_id, p), v))
+                    for b in bs_:
+                        verdicts[(b, m_id)] = v
+                        native += 1
+                return verdicts, inserts, native
+
+            return run
+
+        def regex_task(m_id, matcher, part_rows, infos):
+            neg = bool(matcher.negative)
+            want_all = matcher.condition == "and"
+
+            def run():
+                verdicts: dict = {}
+                inserts: list = []
+                # pattern waterfall over still-undecided distinct
+                # parts: exact per-pattern existence short-circuits
+                # under the matcher condition exactly like
+                # _regex_matcher_raw's loop (evaluation order is the
+                # pattern order either way, so the combine is
+                # identical); any non-exact item falls back whole.
+                pending = list(part_rows)
+                decided: list = []  # (part, bs, raw)
+                bad: list = []
+                for info in infos:
+                    if not pending:
+                        break
+                    res = ncrex.exists_batch(
+                        info.nfa, [p for p, _bs in pending]
+                    )
+                    if res is None:
+                        bad.extend(pending)
+                        pending = []
+                        break
+                    nxt: list = []
+                    for (p, bs_), rv in zip(pending, res.tolist()):
+                        if rv < 0:
+                            bad.append((p, bs_))
+                        elif want_all and not rv:
+                            decided.append((p, bs_, False))
+                        elif not want_all and rv:
+                            decided.append((p, bs_, True))
+                        else:
+                            nxt.append((p, bs_))
+                    pending = nxt
+                # patterns exhausted without a short-circuit: the
+                # combine's identity value (all -> True, any -> False)
+                decided.extend((p, bs_, want_all) for p, bs_ in pending)
+                native = 0
+                for p, bs_, raw in decided:
+                    v = (not raw) if neg else raw
+                    inserts.append((("m", m_id, p), v))
+                    for b in bs_:
+                        verdicts[(b, m_id)] = v
+                        native += 1
+                for p, bs_ in bad:
+                    for b in bs_:
+                        verdicts[(b, m_id)] = (
+                            self._confirm_matcher_serial(m_id, nrows[b])
+                        )
+                return verdicts, inserts, native
+
+            return run
+
+        def ext_pattern_task(m_id, pattern, part_rows, info):
+            def run():
+                verdicts: dict = {}
+                inserts: list = []
+                native = 0
+                res = (
+                    ncrex.exists_batch(info.nfa, [p for p, _b in part_rows])
+                    if info.ok
+                    else None
+                )
+                for idx, (p, bs_) in enumerate(part_rows):
+                    is_native = False
+                    if not info.ok:
+                        v = False  # invalid under re: extracts nothing
+                    elif res is not None and res[idx] >= 0:
+                        v = bool(res[idx])
+                        is_native = True
+                    else:
+                        text = p.decode("latin-1")
+                        sv = fastre.search_bool(pattern, p, text)
+                        if sv is None:
+                            sv = info.rex.search(text) is not None
+                        v = bool(sv)
+                    inserts.append((("pe", m_id, p), v))
+                    for b in bs_:
+                        verdicts[(b, m_id)] = v
+                        if is_native:
+                            native += 1
+                return verdicts, inserts, native
+
+            return run
+
+        def shard(part_rows: list) -> list:
+            n = self._WALK_SHARD
+            return [
+                part_rows[i : i + n] for i in range(0, len(part_rows), n)
+            ] or [[]]
+
+        def dedup_misses(m_id, bs, part_name, cache_tag) -> list:
+            """Cache-serve what the cross-batch memo holds; group the
+            misses by DISTINCT part bytes → [(part, [b, ...]), ...]."""
+            by_part: dict = {}
+            for b in bs:
+                p = row_part(b, part_name)
+                v = cache.get((cache_tag, m_id, p))
+                if v is not None:
+                    pre_m[(b, m_id)] = v
+                else:
+                    by_part.setdefault(p, []).append(b)
+            return list(by_part.items())
+
+        for m_id, bs in by_matcher.items():
+            matcher = self._m_obj[m_id]
+            if matcher is None:
+                op = self._op_obj[self._m_op_id[m_id]]
+                ex_local, p_idx = self._m_ext_src_py[m_id]
+                if ex_local < 0:  # fire-always degrade: whole-op path
+                    fallback.extend((b, m_id) for b in bs)
+                    continue
+                ex = op.extractors[ex_local]
+                pattern = ex.regex[p_idx]
+                part_rows = dedup_misses(m_id, bs, ex.part, "pe")
+                info = fastre.analyze(pattern)
+                # one task per matcher, not per shard: the pattern's
+                # lazy-DFA context serializes on its mutex, so sharding
+                # ONE pattern across threads only buys lock ping-pong —
+                # distinct matchers still run concurrently
+                if part_rows:
+                    tasks.append(
+                        ext_pattern_task(m_id, pattern, part_rows, info)
+                    )
+                continue
+            mtype = matcher.type
+            if mtype in ("word", "binary"):
+                if mtype == "word":
+                    ci = bool(matcher.case_insensitive)
+                    needles = [
+                        w.encode("utf-8", "surrogateescape")
+                        for w in matcher.words
+                    ]
+                    if ci:
+                        needles = [nd.lower() for nd in needles]
+                else:
+                    ci = False
+                    import binascii as _ba
+
+                    try:
+                        needles = [
+                            _ba.unhexlify(re.sub(r"\s", "", hx))
+                            for hx in matcher.binary
+                        ]
+                    except (_ba.Error, ValueError):
+                        # oracle's unsupported path (verdict False):
+                        # keep it on the serial reference
+                        fallback.extend((b, m_id) for b in bs)
+                        continue
+                if not needles:
+                    # empty needle list is False before the combine
+                    # (cpu_ref), then negation applies
+                    v = bool(matcher.negative)
+                    for b in bs:
+                        pre_m[(b, m_id)] = v
+                    continue
+                part_rows = dedup_misses(m_id, bs, matcher.part, "m")
+                cond_and = matcher.condition == "and"
+                for sh in shard(part_rows):
+                    if sh:
+                        tasks.append(
+                            needle_task(m_id, matcher, sh, needles, ci,
+                                        cond_and)
+                        )
+            elif mtype == "regex":
+                infos = [fastre.analyze(p) for p in matcher.regex]
+                if not matcher.regex or not all(i.ok for i in infos):
+                    # raw would be None (no patterns / a pattern the
+                    # oracle can't compile): serial reference keeps
+                    # the oracle-fallback semantics exact
+                    fallback.extend((b, m_id) for b in bs)
+                    continue
+                part_rows = dedup_misses(m_id, bs, matcher.part, "m")
+                # per-matcher task (no shards): see the DFA-mutex note
+                # on the ext-prefilter branch above
+                if part_rows:
+                    tasks.append(regex_task(m_id, matcher, part_rows, infos))
+            elif mtype == "size":
+                sizes = matcher.size
+                neg = bool(matcher.negative)
+                want_all = matcher.condition == "and"
+                for b in bs:
+                    p = row_part(b, matcher.part)
+                    key = ("m", m_id, p)
+                    v = cache.get(key)
+                    if v is None:
+                        if not sizes:
+                            raw = False
+                        elif want_all:
+                            raw = all(len(p) == s for s in sizes)
+                        else:
+                            raw = any(len(p) == s for s in sizes)
+                        v = (not raw) if neg else raw
+                        self._cache_put(cache, key, v)
+                    pre_m[(b, m_id)] = v
+            else:
+                # dsl/status/kval read beyond the part — serial pairs
+                fallback.extend((b, m_id) for b in bs)
+
+        if fallback:
+            def fallback_task(pairs):
+                def run():
+                    return (
+                        {
+                            (b, m): self._confirm_matcher_serial(
+                                m, nrows[b]
+                            )
+                            for b, m in pairs
+                        },
+                        (),
+                        0,
+                    )
+
+                return run
+
+            tasks.append(fallback_task(fallback))
+        if op_pairs:
+            def op_task(pairs):
+                def run():
+                    return (
+                        {
+                            ("op", b, o): self._confirm_operation(
+                                self._op_obj[o], nrows[b]
+                            )
+                            for b, o in pairs
+                        },
+                        (),
+                        0,
+                    )
+
+                return run
+
+            tasks.append(op_task(op_pairs))
+
+        pool = self._walk_pool() if tasks else None
+        if pool is not None and len(tasks) > 1:
+            results = list(pool.map(lambda f: f(), tasks))
+        else:
+            results = [f() for f in tasks]
+        native_pairs = 0
+        for verdicts, inserts, native in results:
+            native_pairs += native
+            for key, v in verdicts.items():
+                if len(key) == 3:  # ("op", b, op_id) from op_task
+                    pre_op[(key[1], key[2])] = v
+                else:
+                    pre_m[key] = v
+            for ck, v in inserts:
+                self._cache_put(cache, ck, v)
+        # ONLY pairs the grouped native passes actually decided — not
+        # cache-served, plan-inline (size/empty-needle), or serial-
+        # fallback pairs — so the gauge attributes real native load
+        self.stats.walk_batched_pairs += native_pairs
+        if tasks:
+            self.stats.walk_batch_rounds += 1
+        self.stats.walk_precompute_seconds += time.perf_counter() - t0
+        return pre_m, pre_op
 
     def _walk_plane(self, nrows, batch, matcher, pending=None):
         """Device dispatch + sparse host resolution over DISTINCT new
@@ -1362,7 +1938,8 @@ class MatchEngine:
         pop_unc = np.asarray(pop_unc)[:B]
         pm_unc = np.asarray(pm_unc)[:B]
         overflow = np.asarray(overflow)[:B]
-        self.stats.device_seconds += time.perf_counter() - t0
+        with self._stats_lock:
+            self.stats.device_seconds += time.perf_counter() - t0
         # compile-time attribution rides the DeviceDB counters (zero on
         # the sharded matcher, which compiles per mesh shape instead)
         self.stats.device_compile_seconds = getattr(
@@ -1377,44 +1954,20 @@ class MatchEngine:
         t1 = time.perf_counter()
         confirms: dict = {}
         op_cache: dict = {}  # (b, op_id) -> exact bool
-        # content-keyed matcher memo — CROSS-batch (self._confirm_cache):
-        # scan batches repeat headers and default pages heavily, and a
-        # matcher's verdict depends only on its part bytes; the slow
-        # confirm regexes (waf-detect's backtracking patterns) then run
-        # once per distinct content, not once per batch
-        part_cache = self._confirm_cache
+        # precomputed verdict planes from the row-parallel batched
+        # confirm (docs/HOST_WALK.md): filled after the redo pass below,
+        # consulted first by confirm_matcher/resolve_op. The resolution
+        # structure (loops, short-circuits, counting) is untouched —
+        # only where a pair's verdict COMES FROM changes, so verdicts
+        # and host_confirm_pairs stay bit-identical to the serial walk.
+        pre_m: dict = {}   # (b, m_id) -> exact bool
+        pre_op: dict = {}  # (b, op_id) -> exact bool (prefiltered ops)
 
-        def confirm_matcher(m_id: int, row: Response) -> bool:
-            matcher = self._m_obj[m_id]
-            if matcher is None:
-                # synthesized extraction prefilter: per-pattern verdict
-                return self._confirm_ext_pattern(m_id, row)
-            if matcher.type not in ("word", "regex", "binary", "size"):
-                # dsl/status/kval read beyond matcher.part — not cacheable
-                mv = cpu_ref.match_matcher(matcher, row)
-                return bool(mv) if mv is not None else False
-            part = row.part(matcher.part)
-            key = ("m", m_id, part)
-            v = part_cache.get(key)
-            if v is None:
-                # exact per-pattern evaluation with literal/candidate
-                # proofs: most confirms are q-gram collisions whose
-                # slow regex (waf-detect's ~2 ms backtrackers)
-                # certainly can't match — those are decided at
-                # bytes.find speed; unproven patterns get a real
-                # re.search. Negation mirrors cpu_ref.match_matcher.
-                raw = (
-                    self._regex_matcher_raw(matcher, part)
-                    if matcher.type == "regex"
-                    else None
-                )
-                if raw is not None:
-                    v = (not raw) if matcher.negative else raw
-                else:
-                    mv = cpu_ref.match_matcher(matcher, row)
-                    v = bool(mv) if mv is not None else False
-                self._cache_put(part_cache, key, v)
-            return v
+        def confirm_matcher(b: int, m_id: int, row: Response) -> bool:
+            v = pre_m.get((b, m_id))
+            if v is not None:
+                return v
+            return self._confirm_matcher_serial(m_id, row)
 
         op_prefilter = self._op_prefilter_py
         op_cond_and = self._op_cond_and_py
@@ -1430,8 +1983,11 @@ class MatchEngine:
                 # superset-lowered op: per-matcher bits are weakened, so
                 # fired rows re-run the whole op (prefiltered + cached
                 # per matcher — semantics identical to the oracle's
-                # match_operation)
-                v = self._confirm_operation(self._op_obj[op_id], row)
+                # match_operation); the batched walk may have resolved
+                # it already
+                v = pre_op.get(key)
+                if v is None:
+                    v = self._confirm_operation(self._op_obj[op_id], row)
                 confirms[b] = confirms.get(b, 0) + 1
                 self.stats.host_confirm_pairs += 1
             else:
@@ -1443,7 +1999,7 @@ class MatchEngine:
                     >> self._op_m_shift[op_id]
                 ) & 1
                 vals = [
-                    confirm_matcher(int(m_id), row)
+                    confirm_matcher(b, int(m_id), row)
                     for m_id in ids[bits.astype(bool)]
                 ]
                 confirms[b] = confirms.get(b, 0) + len(vals)
@@ -1482,6 +2038,15 @@ class MatchEngine:
         # --- sparse uncertainty resolution (unique plane) ---
         t_unc = time.perf_counter()
         use_native = self._use_native_memo()
+        # row-parallel batched confirm (docs/HOST_WALK.md): resolve the
+        # whole batch's pending (row, matcher) pairs with grouped
+        # GIL-released native passes BEFORE the serial-structured loops
+        # below consume them. walk_threads=0 keeps the reference walk.
+        if use_native and not row_redo.all() and self.walk_threads > 0:
+            pre_m, pre_op = self._precompute_confirms(
+                nrows, pt_value, pt_unc, pop_value, pop_unc, pm_unc,
+                set(redo_rows.tolist()),
+            )
         # (b, t_idx) pairs whose verdict is decided by the extraction
         # pass below (pseudo-ext templates on the native path)
         pseudo_pending: list = []
@@ -1707,7 +2272,8 @@ class MatchEngine:
                     # async launch failed: degrade this batch (the walk
                     # re-tries the sync path only if the breaker allows)
                     self._note_device_fault(breaker, e)
-                self.stats.device_seconds += time.perf_counter() - t0
+                with self._stats_lock:
+                    self.stats.device_seconds += time.perf_counter() - t0
         return ("native", all_rows, pre, pending)
 
     def finish_packed(self, handle) -> PackedMatches:

@@ -159,6 +159,13 @@ class DeviceDB:
     calls that triggered a fresh executable (measured at the dispatch
     boundary — dispatch is async, so this is compile + launch, not
     compute).
+
+    Cross-thread hand-off (docs/HOST_WALK.md): with the scheduler's
+    walk offload, :meth:`dispatch` runs on the submit thread while the
+    walk worker calls :meth:`collect` on an earlier batch's output —
+    JAX serializes the device work itself, and the compile spy's
+    counters update under ``_counter_lock`` so a dispatch racing a
+    scrape (or a second engine) can't lose increments.
     """
 
     MAX_COMPILED = MAX_COMPILED  # legacy alias (sharded path shares it)
@@ -168,6 +175,9 @@ class DeviceDB:
         self.candidate_k = candidate_k
         self.compile_seconds = 0.0
         self.compile_count = 0
+        import threading as _threading
+
+        self._counter_lock = _threading.Lock()
         self._meta = None
         self._arrays = None  # device-resident argument pytree
         self._fn_cache: dict = {}  # full flag -> shape-polymorphic jit fn
@@ -272,8 +282,9 @@ class DeviceDB:
             grew = fn._cache_size() - n0
             if grew > 0:
                 dt = _time.perf_counter() - t0
-                self.compile_seconds += dt
-                self.compile_count += grew
+                with self._counter_lock:
+                    self.compile_seconds += dt
+                    self.compile_count += grew
                 m = _device_metrics()
                 m["compile_seconds"].inc(dt)
                 m["compiles"].inc(grew)

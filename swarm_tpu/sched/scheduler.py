@@ -33,6 +33,13 @@ Results are exact and bit-identical to the direct path: every batch
 goes through the same ``match_packed`` walk, only the batching/overlap
 changes (pinned by tests/test_sched.py's parity suite).
 
+Stage 2's walk half can additionally be OFFLOADED (docs/HOST_WALK.md):
+with ``walk_offload`` on (auto: spare core + the engine's batched walk
+enabled), ``finish_packed`` runs on a dedicated walk worker — batch
+N's sparse confirm/extract walk fans out over the engine's walk pool
+while THIS thread already encodes and dispatches batch N+1, so the
+device submit path never blocks on host confirmation.
+
 Telemetry (swarm_tpu/telemetry REGISTRY):
 - ``swarm_sched_batches_total{bucket,kind}`` — bucket occupancy
 - ``swarm_sched_rows_total{source}`` — fresh / memo / dead split
@@ -40,6 +47,7 @@ Telemetry (swarm_tpu/telemetry REGISTRY):
 - ``swarm_sched_prefetch_stall_seconds_total`` — submit loop starved
 - ``swarm_sched_inflight_depth`` — current in-flight device batches
 - ``swarm_sched_bucket_rows{bucket}`` — pending rows per bucket
+- ``swarm_sched_walk_offloaded_total`` — walks run on the walk worker
 """
 
 from __future__ import annotations
@@ -83,6 +91,11 @@ _BUCKET_ROWS = REGISTRY.gauge(
     "Rows pending in each padding bucket (set at plan time)",
     ("bucket",),
 )
+_WALK_OFFLOADED = REGISTRY.counter(
+    "swarm_sched_walk_offloaded_total",
+    "Host walks handed to the scheduler's walk worker instead of "
+    "blocking the device-submit thread (docs/HOST_WALK.md)",
+)
 
 
 @dataclasses.dataclass
@@ -110,10 +123,19 @@ class SchedulerConfig:
     #: in-flight overlap still applies); "auto" = thread only when the
     #: host has a core to give it
     prefetch: str = "auto"
+    #: "on" = hand each batch's host walk (finish_packed) to a
+    #: dedicated walk worker so the submit thread keeps dispatching
+    #: device batches while batch N's walk runs (docs/HOST_WALK.md);
+    #: "off" = walk on the submit thread (the pre-offload behavior);
+    #: "auto" = offload when a spare core exists and the engine's
+    #: batched walk is enabled
+    walk_offload: str = "auto"
 
     def __post_init__(self):
-        # queue_depth + inflight + the encode in progress must stay
-        # under the recycled-pool depth (see encoding._RotatingPool)
+        # queue_depth + inflight + the offloaded walk + the encode in
+        # progress must stay under the recycled-pool depth (see
+        # encoding._RotatingPool; the walk slot is charged against
+        # inflight in run())
         self.inflight = max(1, min(int(self.inflight), 3))
         self.queue_depth = max(1, min(int(self.queue_depth), 2))
 
@@ -129,6 +151,7 @@ class SchedStats:
     device_batches: int = 0
     stall_seconds: float = 0.0
     wall_seconds: float = 0.0
+    offloaded_walks: int = 0  # walks run on the walk worker
 
     @property
     def fill_ratio(self) -> float:
@@ -144,6 +167,7 @@ class SchedStats:
             "fill_ratio": round(self.fill_ratio, 4),
             "stall_seconds": round(self.stall_seconds, 4),
             "wall_seconds": round(self.wall_seconds, 4),
+            "offloaded_walks": self.offloaded_walks,
         }
 
 
@@ -189,6 +213,23 @@ class BatchScheduler:
                 ok = False
             self._overlap_helps = ok
         return ok
+
+    def _walk_offload_ok(self) -> bool:
+        """Whether to hand each batch's host walk to a dedicated walk
+        worker (docs/HOST_WALK.md): the submit thread then keeps
+        dispatching batch N+1's device phase while batch N's walk runs
+        on host threads. Explicit on/off wins; auto offloads when a
+        spare core exists and the engine's batched walk is enabled
+        (``walk_threads`` 0 means the operator pinned the serial
+        reference walk — honor it end to end)."""
+        mode = getattr(self.config, "walk_offload", "auto")
+        if mode == "on":
+            return True
+        if mode == "off":
+            return False
+        return (os.cpu_count() or 1) >= 3 and getattr(
+            self.engine, "walk_threads", 0
+        ) != 0
 
     def _use_thread(self) -> bool:
         """Prefetch-thread policy: threading buys decode/encode overlap
@@ -386,11 +427,23 @@ class BatchScheduler:
 
         inflight: list = []  # FIFO of (PlannedBatch, handle)
         inflight_cap = cfg.inflight if self._device_overlap_ok() else 1
+        walk_exec = None
+        walking: list = []  # FIFO of (PlannedBatch, Future) — offloaded
+        if self._walk_offload_ok():
+            from concurrent.futures import ThreadPoolExecutor
+
+            walk_exec = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="swarm-sched-walk"
+            )
+            # the offloaded walk keeps one extra encoded batch alive:
+            # its slot is charged against the in-flight budget so the
+            # recycled encode planes (encoding._RotatingPool depth)
+            # can never rotate back under an unwalked batch
+            inflight_cap = max(1, min(inflight_cap, 2))
+
         next_yield = [0]
 
-        def finish_oldest() -> None:
-            pb, handle = inflight.pop(0)
-            _INFLIGHT.set(len(inflight))
+        def finish_batch(pb: PlannedBatch, handle) -> None:
             packed = engine.finish_packed(handle)
             per = _rowmatches_of(engine, packed, len(pb.ids))
             ids = pb.ids  # ascending (arrival order within the bucket)
@@ -419,6 +472,31 @@ class BatchScheduler:
                         k2 += 1
                     chunk_left[cid] -= k2 - k
                     k = k2
+
+        def drain_walks(limit: int) -> None:
+            # .result() re-raises a walk failure on the submit thread —
+            # a failing walk must fail the run, not vanish in a worker
+            while len(walking) > limit:
+                _pb, fut = walking.pop(0)
+                fut.result()
+
+        def finish_oldest() -> None:
+            pb, handle = inflight.pop(0)
+            _INFLIGHT.set(len(inflight))
+            if walk_exec is not None:
+                # batch N's walk runs on the walk worker (whose batched
+                # confirm/extract passes fan out over the engine's walk
+                # pool) while this thread keeps encoding + dispatching
+                # batch N+1 — the device never waits for the walk. One
+                # walk in flight: the worker serializes walks, and the
+                # bound keeps the encode-plane budget exact.
+                walking.append((pb, walk_exec.submit(finish_batch, pb,
+                                                     handle)))
+                stats.offloaded_walks += 1
+                _WALK_OFFLOADED.inc()
+                drain_walks(1)
+            else:
+                finish_batch(pb, handle)
 
         def ready_chunks() -> list:
             out = []
@@ -473,6 +551,7 @@ class BatchScheduler:
                     )
                 while inflight:
                     finish_oldest()
+                drain_walks(0)
                 for res in ready_chunks():
                     yield res
                 return
@@ -517,6 +596,7 @@ class BatchScheduler:
                     yield from submit(pb, pre)
                 while inflight:
                     finish_oldest()
+                drain_walks(0)
                 # the producer put(_DONE) after flush_all, so joining
                 # here is bounded
                 thread.join()
@@ -528,4 +608,7 @@ class BatchScheduler:
                 stop.set()
                 thread.join()
         finally:
+            if walk_exec is not None:
+                # bounded: at most one walk is ever queued on the worker
+                walk_exec.shutdown(wait=True)
             stats.wall_seconds += time.perf_counter() - t_run0

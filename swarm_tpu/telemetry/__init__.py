@@ -28,3 +28,7 @@ from swarm_tpu.telemetry.events import (  # noqa: F401
     new_trace_id,
     subscribe,
 )
+
+# swarm_walk_* families register at import time so every process's
+# /metrics carries them (docs/HOST_WALK.md; check_metrics contract)
+from swarm_tpu.telemetry import walk_export  # noqa: E402,F401

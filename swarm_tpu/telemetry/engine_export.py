@@ -100,6 +100,8 @@ def register_engine(engine) -> None:
 
 
 def _collect() -> None:
+    from swarm_tpu.telemetry import walk_export as we
+
     g = _gauges()
     with _lock:
         engines = list(_engines)
@@ -107,6 +109,9 @@ def _collect() -> None:
     degraded = degraded_batches = device_faults = 0
     dev_s = confirm_s = compile_s = 0.0
     capacity = 0
+    walk_pairs = walk_rounds = walk_pool = 0
+    walk_pre_s = 0.0
+    phase_s = {"unc": 0.0, "ext": 0.0, "insert": 0.0, "fixup": 0.0}
     for eng in engines:
         s = eng.stats
         rows += s.rows
@@ -121,9 +126,23 @@ def _collect() -> None:
         capacity += s.batches * getattr(eng, "batch_rows", 0)
         degraded_batches += getattr(s, "degraded_batches", 0)
         device_faults += getattr(s, "device_faults", 0)
+        walk_pairs += getattr(s, "walk_batched_pairs", 0)
+        walk_rounds += getattr(s, "walk_batch_rounds", 0)
+        walk_pre_s += getattr(s, "walk_precompute_seconds", 0.0)
+        walk_pool = max(walk_pool, getattr(s, "walk_pool_threads", 0))
+        phase_s["unc"] += getattr(s, "unc_seconds", 0.0)
+        phase_s["ext"] += getattr(s, "ext_seconds", 0.0)
+        phase_s["insert"] += getattr(s, "insert_seconds", 0.0)
+        phase_s["fixup"] += getattr(s, "fixup_seconds", 0.0)
         board = getattr(eng, "_device_breakers", None)
         if board is not None and board.any_open():
             degraded += 1
+    we.WALK_POOL_THREADS.set(walk_pool)
+    we.WALK_BATCHED_PAIRS.set(walk_pairs)
+    we.WALK_BATCH_ROUNDS.set(walk_rounds)
+    we.WALK_PRECOMPUTE_SECONDS.set(walk_pre_s)
+    for ph, v in phase_s.items():
+        we.WALK_PHASE_SECONDS.labels(phase=ph).set(v)
     g["engines"].set(len(engines))
     g["rows"].set(rows)
     g["batches"].set(batches)

@@ -244,9 +244,19 @@ def _qualifier_before(text: str, pos: int) -> Optional[str]:
     """Identifier qualifying a match at ``pos`` (``ident .`` directly
     before it), from a bounded lookbehind window — the qualifier is a
     few tokens, and an unbounded ``$``-anchored search re-scans the
-    whole prefix per candidate (O(n·k) on minified bundles)."""
-    qm = _QUALIFIER_RE.search(text, max(0, pos - 64), pos)
-    return qm.group(1) if qm else None
+    whole prefix per candidate (O(n·k) on minified bundles). The
+    256-byte window covers the long qualified chains real minified
+    bundles produce (the old 64-byte window clipped them); a match
+    that begins EXACTLY at a clipped window's start may be the tail of
+    a longer identifier the window cut in half — discard it rather
+    than attribute the VERSION to a truncated name."""
+    lo = max(0, pos - 256)
+    qm = _QUALIFIER_RE.search(text, lo, pos)
+    if qm is None:
+        return None
+    if lo > 0 and qm.start(1) == lo:
+        return None  # possibly truncated identifier
+    return qm.group(1)
 
 
 def _matched_brace_pairs(text: str) -> tuple:

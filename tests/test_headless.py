@@ -869,6 +869,48 @@ def test_version_attribution_in_bundles():
     assert headless._script_version_of(umd, g, dm3.start()) == "3.8.0"
 
 
+def test_qualifier_lookbehind_long_identifier():
+    """ADVICE round 5: the qualifier lookbehind window is 256 bytes —
+    a long (but real) minified identifier chain inside the window must
+    resolve in full, and a match that begins EXACTLY at a clipped
+    window's start (possibly the tail of a longer identifier the
+    window cut) is discarded instead of misattributed."""
+    long_ident = "Q" * 100  # > the old 64-byte window, < 256
+    text = "pad. " + long_ident + '.VERSION="1.2.3"'
+    pos = text.index("VERSION")
+    assert headless._qualifier_before(text, pos) == long_ident
+
+    # identifier longer than the whole window: the match starts at the
+    # clipped window boundary — a truncated name, so no qualifier
+    monster = "Z" * 300 + '.VERSION="9.9.9"'
+    mpos = monster.index("VERSION")
+    assert mpos > 256  # the window is genuinely clipped
+    assert headless._qualifier_before(monster, mpos) is None
+
+    # short prefix (window start is 0): a qualifier that begins at
+    # offset 0 is NOT truncated — it must still resolve
+    short = 'Acme.VERSION="2.0"'
+    spos = short.index("VERSION")
+    assert headless._qualifier_before(short, spos) == "Acme"
+
+    # qualified VERSION of another object inside a bundle still
+    # attributes correctly through the widened window (regression for
+    # the 64->256 widening: the long-ident qualifier used to come back
+    # truncated and dodge the alias/global containment checks)
+    bundle = (
+        'var t="4.3.0";window.Reveal={VERSION:t};'
+        + "OtherLibraryWithAVeryLongMinifiedExportName" * 2
+        + '.VERSION="7.7.7";'
+    )
+    import re as _re
+
+    define_re = _re.compile(r"window\.Reveal\s*=(?![=])")
+    dm = define_re.search(bundle)
+    assert dm is not None
+    assert headless._script_version_of(bundle, "Reveal", dm.start()) \
+        == "4.3.0"
+
+
 def test_alias_scoping_in_minified_umd_bundles():
     """UMD alias containment (the misattribution class): the alias
     search is anchored (``MyReveal = e`` / ``Foo.Reveal = e`` are not

@@ -1,0 +1,428 @@
+"""Row-parallel batched host walk (docs/HOST_WALK.md): parity twins,
+native confirm passes, cache concurrency, scheduler walk offload.
+
+The batched walk's contract is BIT-IDENTITY with the serial reference
+walk — same verdict planes, same extraction values, same
+``host_confirm_pairs`` accounting — at every pool size. These tests pin
+it on the bundled corpus plus the walk-stress templates (bench.py),
+which restore the uncertainty profile (long prefix-verified words,
+case-insensitive words, regex prefilters, binary needles, extractor-
+only ops) the demo corpus alone lacks.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bench import walk_stress_rows, walk_stress_templates  # noqa: E402
+from swarm_tpu.fingerprints import load_corpus  # noqa: E402
+from swarm_tpu.fingerprints.model import Response  # noqa: E402
+from swarm_tpu.ops import cpu_ref  # noqa: E402
+from swarm_tpu.ops.engine import MatchEngine  # noqa: E402
+
+BUNDLED = os.path.join(os.path.dirname(__file__), "data", "templates")
+
+
+def _templates():
+    templates, errors = load_corpus(BUNDLED)
+    assert templates, errors
+    return list(templates) + walk_stress_templates()
+
+
+def _engine(threads, templates=None, batch_rows=192):
+    return MatchEngine(
+        templates if templates is not None else _templates(),
+        mesh=None, batch_rows=batch_rows, max_body=2048, max_header=512,
+        walk_threads=threads,
+    )
+
+
+# ---------------------------------------------------------------------------
+# parity twins: threaded/batched vs the serial reference walk
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("threads", [1, 4])
+def test_walk_parity_vs_serial(threads):
+    """Bit-identical verdicts, extraction values, host-always tail,
+    per-row confirm attribution AND total host_confirm_pairs at pool
+    sizes 0 (serial reference) vs 1 (batched inline) vs 4 (pooled)."""
+    templates = _templates()
+    rows = walk_stress_rows(192, seed=42)
+    ref_eng = _engine(0, templates)
+    ref = ref_eng.match_packed(list(rows))
+    eng = _engine(threads, templates)
+    got = eng.match_packed(list(rows))
+    np.testing.assert_array_equal(ref.bits, got.bits)
+    assert ref.extractions == got.extractions
+    assert ref.host_always_matches == got.host_always_matches
+    assert ref.confirms_per_row == got.confirms_per_row
+    assert (
+        ref_eng.stats.host_confirm_pairs == eng.stats.host_confirm_pairs
+    )
+    # non-vacuous: the serial walk did real confirm work and the
+    # batched walk actually precomputed pairs for it
+    assert ref_eng.stats.host_confirm_pairs > 0
+    assert eng.stats.walk_batched_pairs > 0
+    assert eng.stats.walk_batch_rounds > 0
+    assert ref_eng.stats.walk_batched_pairs == 0
+
+
+def test_walk_parity_warm_confirm_cache():
+    """Second batch with repeated content: the batched walk must serve
+    from (and fill) the shared confirm cache exactly like the serial
+    walk — same verdicts, and the cross-batch short-circuit intact."""
+    templates = _templates()
+    rows = walk_stress_rows(128, seed=9)
+    out = {}
+    for threads in (0, 1):
+        eng = _engine(threads, templates, batch_rows=128)
+        first = eng.match_packed(list(rows))
+        again = eng.match_packed(
+            [
+                Response(
+                    host=r.host, port=r.port, status=r.status,
+                    body=bytes(memoryview(r.body)),
+                    header=bytes(memoryview(r.header)),
+                    banner=None if r.banner is None
+                    else bytes(memoryview(r.banner)),
+                )
+                for r in rows
+            ]
+        )
+        out[threads] = (first.bits.copy(), again.bits.copy(),
+                        first.extractions, again.extractions)
+    np.testing.assert_array_equal(out[0][0], out[1][0])
+    np.testing.assert_array_equal(out[0][1], out[1][1])
+    assert out[0][2] == out[1][2]
+    assert out[0][3] == out[1][3]
+
+
+def test_walk_matches_cpu_oracle():
+    """The batched walk agrees with the per-row CPU oracle on the
+    stress workload (the absolute exactness anchor, not just the
+    serial-twin relative one)."""
+    templates = _templates()
+    rows = walk_stress_rows(48, seed=3)
+    eng = _engine(2, templates, batch_rows=48)
+    packed = eng.match_packed(list(rows))
+    per_row = eng.rowmatches_from_packed(packed, len(rows))
+    for row, rm in zip(rows, per_row):
+        expect = sorted(
+            t.id for t in templates
+            if cpu_ref.match_template(t, row).matched
+        )
+        assert sorted(rm.template_ids) == expect
+
+
+# ---------------------------------------------------------------------------
+# native confirm passes
+# ---------------------------------------------------------------------------
+
+
+def test_confirm_needles_batch_vs_python():
+    """The C needle pass is bit-identical to the Python contract
+    (`needle in part` / ci over bytes.lower()) under fuzzed content."""
+    from swarm_tpu.native.scanio import confirm_needles_batch
+
+    rng = np.random.default_rng(7)
+    parts = [
+        bytes(rng.integers(32, 127, size=rng.integers(0, 200),
+                           dtype=np.uint8))
+        for _ in range(64)
+    ]
+    parts += [b"", b"NeEdLe-X", b"prefix needle-x suffix", b"needle-"]
+    cases = [
+        ([b"needle-x"], False, False),
+        ([b"needle-x", b"absent!"], False, True),
+        ([b"needle-x", b"fix "], False, False),
+        ([b"needle-x"], True, False),   # ci: pre-lowered needle
+        ([b""], False, True),
+    ]
+    for needles, ci, cond_and in cases:
+        got = confirm_needles_batch(list(parts), needles, ci, cond_and)
+        assert got is not None
+        for p, v in zip(parts, got.tolist()):
+            hay = p.lower() if ci else p
+            hits = [nd in hay for nd in needles]
+            want = all(hits) if cond_and else any(hits)
+            assert bool(v) == want, (needles, ci, cond_and, p)
+
+
+def test_crex_exists_batch_vs_re():
+    from swarm_tpu.native import crex as ncrex
+    from swarm_tpu.ops import fastre
+
+    patterns = [
+        r"demo-build ([0-9.]+)",
+        r"stress-svc3/(\d+\.\d+)",
+        r"[a-z]+@[a-z]+\.(com|net)",
+    ]
+    rng = np.random.default_rng(11)
+    contents = [
+        b"x demo-build 1.2 y", b"stress-svc3/9.4", b"bob@host.com",
+        b"", b"demo-build x", b"almost bob@host.org",
+    ] + [
+        bytes(rng.integers(32, 127, size=80, dtype=np.uint8))
+        for _ in range(20)
+    ]
+    ran = 0
+    for pat in patterns:
+        info = fastre.analyze(pat)
+        res = ncrex.exists_batch(info.nfa, contents)
+        if res is None:
+            continue
+        ran += 1
+        for c, v in zip(contents, res.tolist()):
+            if v < 0:
+                continue  # caller-falls-back contract, not a verdict
+            want = re.search(pat, c.decode("latin-1")) is not None
+            assert bool(v) == want, (pat, c)
+    assert ran > 0  # the native path must actually be exercised
+
+
+# ---------------------------------------------------------------------------
+# shared confirm cache under the pool
+# ---------------------------------------------------------------------------
+
+
+def test_cache_put_concurrent_eviction():
+    """_cache_put from many threads around the eviction boundary must
+    never raise and must keep every surviving value correct (the
+    per-thread-shard merge and the pooled fallback tasks both insert
+    concurrently)."""
+    cache: dict = {}
+    errors: list = []
+
+    def hammer(tid: int):
+        try:
+            for i in range(6000):
+                key = ("m", tid, i % 4096)
+                MatchEngine._cache_put(cache, key, (tid, i % 4096))
+                got = cache.get(key)
+                # a concurrent evictor may have dropped it, but a
+                # present value must be one a writer actually put
+                assert got is None or got[1] == i % 4096
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(cache) <= MatchEngine._EXT_CACHE_MAX
+
+
+# ---------------------------------------------------------------------------
+# memo lookup: mutating alive.__bool__ (native/fastpack.cpp satellite)
+# ---------------------------------------------------------------------------
+
+
+class _MutatingAlive:
+    """alive whose truthiness REPLACES the row's body mid-lookup — the
+    borrowed scan pointers captured before the check must be refetched
+    (a stale view would key the memo on freed/old bytes)."""
+
+    def __init__(self, row, new_body: bytes):
+        self._row = row
+        self._new_body = new_body
+
+    def __bool__(self):
+        self._row.body = self._new_body
+        return True
+
+
+def test_memo_lookup_refetches_after_mutating_bool():
+    from swarm_tpu.native.scanio import VerdictMemo
+
+    memo = VerdictMemo(64, 2)
+    known = Response(host="a", port=80, status=200, body=b"KNOWN-BODY",
+                     header=b"H: 1\r\n")
+    bits = np.array([0xAB, 0x01], dtype=np.uint8)
+    memo.insert(known, bits, None)
+
+    tricky = Response(host="b", port=80, status=200, body=b"OLD-BODY",
+                      header=b"H: 1\r\n")
+    tricky.alive = _MutatingAlive(tricky, b"KNOWN-BODY")
+    out = np.zeros((1, 2), dtype=np.uint8)
+    state, miss, extr, deferred = memo.lookup([tricky], out)
+    # post-mutation content is KNOWN-BODY → the lookup must see the
+    # refetched attributes and serve the memo hit (a stale pre-__bool__
+    # view would miss — or worse, read dangling pointers)
+    assert state[0] == -1 and not miss
+    np.testing.assert_array_equal(out[0], bits)
+
+
+# ---------------------------------------------------------------------------
+# scheduler walk offload
+# ---------------------------------------------------------------------------
+
+
+class _StubDB:
+    template_ids: list = []
+
+
+class _StubPacked:
+    template_ids: list = []
+    extractions: dict = {}
+    host_always_matches: list = []
+    confirms_per_row: dict = {}
+
+    def __init__(self, n):
+        self.bits = np.zeros((n, 1), dtype=np.uint8)
+
+
+class _SlowWalkEngine:
+    """Scheduler-facing stub whose walk (finish_packed) is slow:
+    records begin timestamps and walk windows so the test can assert
+    device submits land INSIDE walk windows (the offload contract)."""
+
+    batch_rows = 8
+    max_body = 4096
+    max_header = 1024
+    db = _StubDB()
+    walk_threads = 2  # advertise a batched walk (offload "auto" gate)
+
+    def __init__(self, walk_s: float = 0.05):
+        self.walk_s = walk_s
+        self.begin_times: list = []
+        self.walk_windows: list = []
+        self.lock = threading.Lock()
+
+    def _use_native_memo(self):
+        return False
+
+    def memo_known_mask(self, rows):
+        return np.zeros(len(rows), dtype=np.uint8)
+
+    def encode_packed(self, rows, reuse_buffers=False):
+        return ("stub", list(rows))
+
+    def begin_packed(self, rows, pre=None):
+        with self.lock:
+            self.begin_times.append(time.perf_counter())
+        return ("h", list(rows), pre)
+
+    def finish_packed(self, handle):
+        _tag, rows, _pre = handle
+        t0 = time.perf_counter()
+        time.sleep(self.walk_s)
+        with self.lock:
+            self.walk_windows.append((t0, time.perf_counter()))
+        return _StubPacked(len(rows))
+
+    def rowmatches_from_packed(self, packed, n):
+        from swarm_tpu.ops.engine import RowMatches
+
+        return [
+            RowMatches(template_ids=[], extractions={}) for _ in range(n)
+        ]
+
+
+def test_walk_offload_does_not_block_submit():
+    from swarm_tpu.sched import BatchScheduler
+    from swarm_tpu.sched.scheduler import SchedulerConfig
+
+    eng = _SlowWalkEngine()
+    sched = BatchScheduler(
+        eng,
+        SchedulerConfig(
+            rows_target=8, inflight=2, prefetch="inline",
+            walk_offload="on",
+        ),
+    )
+    sched._overlap_helps = True
+    chunks = [[Response(host=f"h{i}-{j}", port=80, status=200,
+                        body=b"x", alive=True) for j in range(8)]
+              for i in range(6)]
+    total = 0
+    for res in sched.run(chunks):
+        total += len(res)
+    assert total == 48
+    assert sched.stats.offloaded_walks > 0
+    # the offload contract: at least one device submit happened WHILE
+    # a walk was running — the submit thread was not blocked on it
+    overlapped = any(
+        any(t0 < bt < t1 for bt in eng.begin_times)
+        for t0, t1 in eng.walk_windows
+    )
+    assert overlapped, (eng.begin_times, eng.walk_windows)
+
+
+def test_walk_offload_off_keeps_serial_order():
+    """walk_offload='off' restores the pre-offload behavior: every
+    walk completes on the submit thread before the next submit."""
+    from swarm_tpu.sched import BatchScheduler
+    from swarm_tpu.sched.scheduler import SchedulerConfig
+
+    eng = _SlowWalkEngine(walk_s=0.01)
+    sched = BatchScheduler(
+        eng,
+        SchedulerConfig(
+            rows_target=8, inflight=1, prefetch="inline",
+            walk_offload="off",
+        ),
+    )
+    sched._overlap_helps = True
+    chunks = [[Response(host=f"h{i}-{j}", port=80, status=200,
+                        body=b"x", alive=True) for j in range(8)]
+              for i in range(4)]
+    total = sum(len(res) for res in sched.run(chunks))
+    assert total == 32
+    assert sched.stats.offloaded_walks == 0
+
+
+def test_walk_offload_propagates_walk_failure():
+    from swarm_tpu.sched import BatchScheduler
+    from swarm_tpu.sched.scheduler import SchedulerConfig
+
+    class _FailingWalkEngine(_SlowWalkEngine):
+        def finish_packed(self, handle):
+            raise RuntimeError("walk exploded")
+
+    eng = _FailingWalkEngine(walk_s=0.0)
+    sched = BatchScheduler(
+        eng,
+        SchedulerConfig(rows_target=8, inflight=1, prefetch="inline",
+                        walk_offload="on"),
+    )
+    sched._overlap_helps = True
+    chunks = [[Response(host=f"h{j}", port=80, status=200, body=b"x",
+                        alive=True) for j in range(8)]
+              for _ in range(3)]
+    with pytest.raises(RuntimeError, match="walk exploded"):
+        for _res in sched.run(chunks):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# engine pool lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_configure_walk_repoints_pool():
+    eng = _engine(4, batch_rows=32)
+    assert eng.walk_threads == 4
+    assert eng._walk_pool() is not None
+    eng.configure_walk(0)
+    assert eng.walk_threads == 0
+    assert eng._walk_pool() is None
+    eng.configure_walk(2)
+    assert eng.walk_threads == 2
+    assert eng._walk_pool() is not None
+    eng.configure_walk(None)  # env-derived default; no env set here →
+    # spare-core sizing, at least batching stays enabled
+    assert eng.walk_threads >= 1

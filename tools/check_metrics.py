@@ -29,6 +29,12 @@ REQUIRED_FAMILIES = (
     # resilience plane (docs/RESILIENCE.md): the plan-armed gauge is
     # unlabeled so it always renders a sample
     "swarm_resilience_fault_plan_active",
+    # host-walk plane (docs/HOST_WALK.md): registered at telemetry
+    # import (walk_export), phase labels pre-seeded — all three render
+    # samples even in an engine-free process like this server
+    "swarm_walk_pool_threads",
+    "swarm_walk_batched_pairs",
+    "swarm_walk_phase_seconds",
 )
 
 

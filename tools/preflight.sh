@@ -29,6 +29,14 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     SWARM_BENCH_CORPUS="tests/data/templates" \
     python tools/profile_device.py --check-floor
 
+echo "== preflight: host-walk floor =="
+# batched confirm/extract walk (docs/HOST_WALK.md): the bundled-corpus
+# + stress-template walk rate must stay within SWARM_FLOOR_FACTOR of
+# the recorded floor (tools/walk_floor.json; SWARM_FLOOR_SKIP=1 on
+# known-noisy hosts)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python tools/profile_walk.py --check-floor
+
 echo "== preflight: bench smoke (pipeline A/B, both modes) =="
 # CI-fast A/B on the bundled corpus; rc gates on verdict identity only.
 # Forced to the CPU backend unless the operator pinned one — the smoke
