@@ -84,6 +84,12 @@ BASELINES = {
     # BENCH_* records can track it against itself.
     "device_compile_seconds": 124.0,
     "fresh_batch_device_ms": 14200.0,
+    # donated+compacted split-phase dispatch A/B (docs/DEVICE_MATCH.md,
+    # ISSUE 6): the production dispatch (staging pool + donate_argnums
+    # + survivor-compacted phase B) over the legacy fused arm on the
+    # same fresh encoded batches, gated on bit-identical fused planes
+    # every repeat (1.0 = parity; the tentpole's point is > 1).
+    "fresh_dispatch_ab_speedup": 1.0,
 }
 
 ROWS = 2048
@@ -436,6 +442,84 @@ def bench_pipeline_ab(eng, chunk_rows: int = 0, n_chunks: int = 8) -> dict:
         "fresh": {"off": fresh_off, "on": fresh_on},
         "verdicts_identical": bool(identical),
         "sched": sched_snap,  # bucket fill + prefetch stall counters
+    }
+
+
+def bench_dispatch_ab(db, n_batches: int = 3, reps: int = 3) -> dict:
+    """Paired A/B of the production dispatch (staging pool + donated
+    buffers + survivor-compacted phase B, docs/DEVICE_MATCH.md) against
+    the legacy fused single-kernel arm — same corpus, same fresh
+    encoded batches, device path only (no host walk), so the ratio
+    isolates what the ISSUE-6 tentpole changed. Interleaved paired
+    repeats with the median-ratio pair reported (host drift hits both
+    sides of a pair alike and cancels); every repeat's fused planes are
+    compared bit for bit — a dispatch variant that changed results
+    would be a bug, not a speedup."""
+    import time as _time
+
+    from swarm_tpu.ops.encoding import encode_batch
+    from swarm_tpu.ops.match import DeviceDB
+
+    rows_n = min(ROWS, 512)
+    rng = np.random.default_rng(777)
+    batches = []
+    for i in range(n_batches):
+        rows = realistic_rows(rows_n, seed=500 + i)
+        for r in rows:
+            salt = bytes(rng.integers(97, 123, size=48, dtype=np.uint8))
+            r.body = b"<!-- %s -->" % salt + r.body
+        batches.append(
+            encode_batch(
+                rows, max_body=MAX_BODY, max_header=MAX_HEADER,
+                pad_rows_to=rows_n,
+            )
+        )
+    new = DeviceDB(db)  # compaction + donation (production defaults)
+    old = DeviceDB(db, compact=False, donate=False)  # legacy fused arm
+
+    def run(dev):
+        t0 = _time.perf_counter()
+        outs = [
+            dev.match(b.streams, b.lengths, b.status, full=True)
+            for b in batches
+        ]
+        return outs, (_time.perf_counter() - t0) * 1e3 / n_batches
+
+    run(new)  # compile + warm both arms outside the timing
+    run(old)
+    identical = True
+    pairs: list = []
+    for _rep in range(reps):
+        outs_o, ms_o = run(old)
+        outs_n, ms_n = run(new)
+        pairs.append((ms_o, ms_n))
+        for po, pn in zip(outs_o, outs_n):
+            for a, b in zip(po, pn):
+                if not np.array_equal(np.asarray(a), np.asarray(b)):
+                    identical = False
+    pairs.sort(key=lambda p: p[0] / max(p[1], 1e-9))
+    ms_o, ms_n = pairs[len(pairs) // 2]
+    # the identity gate is REAL: a plane mismatch means the compacted
+    # path is a correctness bug, so report no speedup at all (0.0 tanks
+    # the vs_baseline ratio instead of celebrating broken output)
+    speedup = ms_o / max(ms_n, 1e-9) if identical else 0.0
+    lc = dict(new.last_compact)
+    log(
+        f"dispatch A/B ({n_batches}x{rows_n} rows): legacy "
+        f"{ms_o:.1f} ms/batch -> compacted+donated {ms_n:.1f} ms/batch "
+        f"({speedup:.2f}x; phase B at k={lc.get('verify_k')} of budget "
+        f"{lc.get('budget')}); planes "
+        f"{'identical' if identical else 'MISMATCH'}"
+    )
+    return {
+        "rows": rows_n,
+        "n_batches": n_batches,
+        "legacy_ms_per_batch": round(ms_o, 3),
+        "compacted_ms_per_batch": round(ms_n, 3),
+        "speedup": round(speedup, 3),
+        "identical": bool(identical),
+        # the "phase B launches at survivor size" evidence
+        "last_compact": lc,
     }
 
 
@@ -842,6 +926,21 @@ def bench_exact_engine(templates, db=None) -> tuple:
     walk_s = eng.stats.host_confirm_seconds - h0
     fresh_walk_rate = fresh_iters * ROWS / walk_s if walk_s > 0 else 0.0
     log(f"fresh-content host walk: {fresh_walk_rate:.0f} rows/s")
+    # per-phase attribution of one fresh-shaped batch → the headline's
+    # device_phase_ms map (BENCH_* records show which phase a device
+    # change moved — the ISSUE-6 attribution requirement)
+    from swarm_tpu.ops.encoding import encode_batch as _encode_batch
+
+    prof_n = min(ROWS, 256)
+    pb = _encode_batch(
+        fresh[-1][:prof_n], max_body=MAX_BODY, max_header=MAX_HEADER,
+        pad_rows_to=prof_n,
+    )
+    phases = eng.device.profile_phases(pb.streams, pb.lengths, pb.status)
+    log(
+        "device phase ms: "
+        + "  ".join(f"{k}={v:.2f}" for k, v in phases.items())
+    )
     # kernel-counter snapshot riding along in the emitted JSON: BENCH_*
     # files carry device/host/memo counters from now on (telemetry PR)
     from swarm_tpu.telemetry.engine_export import engine_stats_snapshot
@@ -859,6 +958,12 @@ def bench_exact_engine(templates, db=None) -> tuple:
         "fresh_batch_ms": round(fresh_batch_ms, 3),
         "fresh_batch_device_ms": round(fresh_device_ms, 3),
         "fresh_batch_rows": ROWS,
+        "device_phase_ms": {k: round(v, 3) for k, v in phases.items()},
+        # survivor-compaction evidence from the profiled batch: phase B
+        # launched at verify_k of budget (docs/DEVICE_MATCH.md ladder)
+        "last_compact": dict(
+            getattr(eng.device, "last_compact", {}) or {}
+        ),
     }
     return n / dt, fresh_rate, fresh_walk_rate, eng, stats_snap, device_record
 
@@ -1105,6 +1210,18 @@ def run_phase(phase: str) -> int:
                 "rows": device_rec["fresh_batch_rows"],
             },
         )
+        # donated+compacted dispatch A/B (docs/DEVICE_MATCH.md): the
+        # ISSUE-6 tentpole's device-path win, isolated from the host
+        # walk and gated on bit-identical fused planes
+        dab = bench_dispatch_ab(db)
+        emit(
+            "fresh_dispatch_ab_speedup",
+            dab["speedup"],
+            "x (donation+compaction vs legacy fused dispatch, "
+            "bit-identical planes)",
+            dab["speedup"] / BASELINES["fresh_dispatch_ab_speedup"],
+            extra={"dispatch_ab": dab},
+        )
         # continuous-batching A/B (same engine, same corpus, chunked
         # feed): rides in the headline extra so BENCH_* files track
         # the pipeline=on vs =off record per round
@@ -1172,6 +1289,14 @@ def run_phase(phase: str) -> int:
                 "engine_stats": engine_stats,
                 # scheduler A/B record: both runs + bucket-fill/stall
                 "pipeline_ab": ab,
+                # per-phase device attribution + survivor-compaction
+                # evidence (which phase did ISSUE 6 move, and at what
+                # phase-B width) — docs/DEVICE_MATCH.md
+                "device_phase_ms": device_rec.get("device_phase_ms"),
+                "last_compact": device_rec.get("last_compact"),
+                # the dispatch A/B record rides here too so one JSON
+                # line carries the whole device-path story
+                "dispatch_ab": dab,
             },
         )
     elif phase == "service":

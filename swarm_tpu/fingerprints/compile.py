@@ -1377,6 +1377,28 @@ def _word_payloads(matcher: Matcher) -> Optional[list[bytes]]:
 # instead of a per-table Python loop.
 
 
+#: base rung of the survivor-compaction bucket ladder (ops/match.py,
+#: docs/DEVICE_MATCH.md): phase B launches at the smallest power-of-two
+#: candidate width that covers the batch's survivors, so a sparse fleet
+#: batch (typically 0-2 fired windows per row) verifies at width 8
+#: instead of the worst-case global budget. Power-of-two rungs bound the
+#: live phase-B executable count at log2(budget / 8) + 1 per shape
+#: class.
+SURVIVOR_LADDER_MIN = 8
+
+
+def survivor_bucket(n_survivors: int, budget: int) -> int:
+    """Phase-B candidate width for a batch whose worst row fired
+    ``n_survivors`` windows: the smallest ladder rung covering them,
+    clamped to the global candidate ``budget`` (rows past the budget
+    overflow to the host row-redo — the exactness escape hatch, so a
+    width above the budget could never matter)."""
+    k = SURVIVOR_LADDER_MIN
+    while k < n_survivors:
+        k <<= 1
+    return max(1, min(k, budget))
+
+
 @dataclasses.dataclass(frozen=True)
 class DeviceLayoutMeta:
     """Static (trace-time) facts about a CompiledDB — everything the

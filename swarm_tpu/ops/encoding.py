@@ -102,11 +102,16 @@ class _RotatingPool:
     encode cost (page faults on first touch); the native pack writes
     every byte of every row (payload + zero tail), so dirty buffers are
     safe to hand back. The rotation depth outlives the pipeline
-    window with margin: batch i's arrays are live until its device
-    transfer completes, which is before batch i+2 dispatches (the
-    engine blocks on i's results), so the earliest reuse at i+depth
-    can never alias an in-flight transfer — even with two engines
-    drawing interleaved from the shared pool.
+    window with margin: the scheduler's accounting holds at most
+    queue_depth (2) + in-flight device batches (3) + the offloaded
+    walk (1) + the encode in progress (1) = 7 encoded batches alive at
+    once (sched/scheduler.py), so the earliest reuse at i+depth (8)
+    can never alias an in-flight transfer. That accounting is PER
+    ENGINE: the pool is module-global, and the margin only covers one
+    engine's pipeline at a time — the worker satisfies this by running
+    one scheduler pass to completion per chunk (an engine's batches
+    drain before another engine dispatches); concurrent same-shape
+    pipelining from two engines is outside the reuse contract.
 
     ONLY the engine's hot path opts in (``encode_batch(...,
     reuse_buffers=True)``): a recycled batch's arrays are OVERWRITTEN
@@ -122,7 +127,7 @@ class _RotatingPool:
     #: through their own refs.
     MAX_BYTES = 256 * 1024 * 1024
 
-    def __init__(self, depth: int = 6):
+    def __init__(self, depth: int = 8):
         self._depth = depth
         self._slots: dict = {}  # key -> [bufs, next_idx]; dict order = LRU
         self._bytes = 0

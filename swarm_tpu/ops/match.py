@@ -24,11 +24,25 @@ signal and only ever need host confirmation when something *fired*.
 from __future__ import annotations
 
 import functools
+import threading
+import warnings
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# The dispatch path donates the staged per-batch uploads and the
+# inter-phase rank plane (DeviceDB._phase_b). The kernel's outputs are
+# deliberately tiny packed-bit planes, so XLA usually CANNOT alias a
+# donated input into an output and warns about it at compile time —
+# that is expected, not a bug: donation here buys early buffer release
+# (staged batches free at kernel launch instead of at collect, which
+# bounds device footprint with ≥2 batches in flight), not output
+# aliasing. Filter exactly that message.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 from swarm_tpu.fingerprints import compile as fpc
 from swarm_tpu.ops import hashing
@@ -122,9 +136,12 @@ _DEV_METRICS: dict = {}
 
 def _device_metrics() -> dict:
     """Lazy device-kernel metric families (kept out of import time so
-    oracle-only users never touch the registry)."""
+    oracle-only users never touch the registry). The staging/compaction
+    families live in :mod:`swarm_tpu.telemetry.device_export` (created
+    at telemetry import so every process's ``/metrics`` renders them);
+    this merges both maps."""
     if not _DEV_METRICS:
-        from swarm_tpu.telemetry import REGISTRY
+        from swarm_tpu.telemetry import REGISTRY, device_export
 
         _DEV_METRICS["compile_seconds"] = REGISTRY.counter(
             "swarm_device_compile_seconds_total",
@@ -132,7 +149,7 @@ def _device_metrics() -> dict:
         )
         _DEV_METRICS["compiles"] = REGISTRY.counter(
             "swarm_device_compile_total",
-            "Device match executable compilations (new batch shapes)",
+            "Device match dispatches that compiled a new executable",
         )
         _DEV_METRICS["phase_ms"] = REGISTRY.gauge(
             "swarm_device_phase_ms",
@@ -140,47 +157,143 @@ def _device_metrics() -> dict:
             "instrumented batch (DeviceDB.profile_phases)",
             ("phase",),
         )
+        _DEV_METRICS["staged_batches"] = device_export.STAGED_BATCHES
+        _DEV_METRICS["staged_bytes"] = device_export.STAGED_BYTES
+        _DEV_METRICS["donated"] = device_export.DONATED_DISPATCHES
+        _DEV_METRICS["compacted"] = device_export.COMPACTED_DISPATCHES
+        _DEV_METRICS["survivor_max"] = device_export.SURVIVOR_MAX
+        _DEV_METRICS["verify_k"] = device_export.VERIFY_K
     return _DEV_METRICS
 
 
+def _env_flag(name: str, default: bool) -> bool:
+    raw = _os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    return raw not in ("0", "off", "false", "no")
+
+
+class _StagingPool:
+    """Per-batch device-upload staging for dispatch.
+
+    Every dispatch uploads fresh ``streams``/``lengths``/``status``
+    host arrays; this is the single place that upload happens. With
+    ``donate_argnums`` on the consuming kernel (DeviceDB's phase B) the
+    staged buffers are handed back to XLA's allocator the moment the
+    kernel runs, so the next same-shape upload and the kernel's own
+    outputs reuse that memory instead of allocate-upload-free on every
+    dispatch; on the non-donated arms the staged set dies with the
+    launch, which is the same lifetime the legacy path had. Host
+    arrays are always copied on upload (jnp.asarray), so donation can
+    never invalidate caller-owned numpy (the engine's recycled encode
+    planes keep rotating untouched).
+
+    Accounting only — no aliasing decisions live here: ``uploads`` /
+    ``bytes`` back the ``swarm_device_staged_*`` families, updated
+    under a lock because dispatch runs on both the scheduler's submit
+    thread and the walk-offload worker.
+    """
+
+    def __init__(self):
+        self.uploads = 0
+        self.bytes = 0
+        self._lock = threading.Lock()
+
+    def stage(self, streams: dict, lengths: dict, status):
+        """Upload one batch; returns (streams, lengths, status) as
+        device arrays (pass-through for already-device inputs) plus
+        the staged host byte count for this batch."""
+        s_j = {k: jnp.asarray(v) for k, v in streams.items()}
+        l_j = {k: jnp.asarray(v) for k, v in lengths.items()}
+        st_j = jnp.asarray(status)
+        n_bytes = int(
+            sum(getattr(v, "nbytes", 0) for v in streams.values())
+            + sum(getattr(v, "nbytes", 0) for v in lengths.values())
+            + int(getattr(status, "nbytes", 0))
+        )
+        with self._lock:
+            self.uploads += 1
+            self.bytes += n_bytes
+        return s_j, l_j, st_j, n_bytes
+
+
 class DeviceDB:
-    """CompiledDB uploaded to device + the jitted match function.
+    """CompiledDB uploaded to device + the jitted match kernels.
 
     The corpus arrays are uploaded ONCE (the argument-layout pytree,
-    compile.build_device_layout) and passed to a single jitted kernel
-    as device-resident arguments on every call. The traced program is
+    compile.build_device_layout) and passed to the jitted kernels as
+    device-resident arguments on every call. The traced programs are
     corpus-size-free: all width buckets of a shape class share one
     executable per batch shape, compile time no longer scales with the
     corpus, and the persistent XLA cache (utils/xlacache.py) hits
-    across corpus refreshes. The arrays are never donated — every
-    subsequent call reuses them in place.
+    across corpus refreshes. The corpus arrays are never donated —
+    every subsequent call reuses them in place.
 
-    ``compile_seconds`` / ``compile_count`` accumulate the wall time of
-    calls that triggered a fresh executable (measured at the dispatch
-    boundary — dispatch is async, so this is compile + launch, not
-    compute).
+    Production dispatch is SPLIT-PHASE with survivor compaction
+    (docs/DEVICE_MATCH.md): a standing phase-A executable (stacked
+    bloom probe → survivor rank plane + per-batch max survivor count)
+    runs first; the host reads back ONE scalar (the max), rounds it up
+    the power-of-two bucket ladder (compile.survivor_bucket), and
+    launches phase B — candidate extraction, gather-verify, tiny,
+    regex, verdict lowering — at that compacted width instead of the
+    global candidate budget. Per-batch ``streams``/``lengths``/
+    ``status`` go through the dispatch staging pool and are DONATED to
+    phase B (with the inter-phase rank plane), so XLA reuses the
+    staged buffers for kernel outputs across batches. Both knobs are
+    runtime-flippable (``compact`` / ``donate`` attributes; env
+    ``SWARM_DEVICE_COMPACT`` / ``SWARM_DEVICE_DONATE``); the fused
+    non-donated single-kernel arm is kept as the legacy reference twin
+    (the bench's dispatch A/B baseline), bit-identical by construction
+    since both routes share one verify + verdict lowering.
+
+    ``compile_seconds`` / ``compile_count`` accumulate the wall time
+    and count of DISPATCHES that triggered at least one fresh
+    executable (measured at the dispatch boundary — launch + phase-A
+    wait included, not phase-B compute).
 
     Cross-thread hand-off (docs/HOST_WALK.md): with the scheduler's
     walk offload, :meth:`dispatch` runs on the submit thread while the
     walk worker calls :meth:`collect` on an earlier batch's output —
-    JAX serializes the device work itself, and the compile spy's
-    counters update under ``_counter_lock`` so a dispatch racing a
-    scrape (or a second engine) can't lose increments.
-    """
+    JAX serializes the device work itself, and the WHOLE compile-spy
+    read/launch/read/evict sequence runs under ``_counter_lock`` so
+    two dispatching threads can neither lose increments nor
+    mis-attribute another thread's compile (the read-before/read-after
+    pair is atomic now)."""
 
     MAX_COMPILED = MAX_COMPILED  # legacy alias (sharded path shares it)
 
-    def __init__(self, db: fpc.CompiledDB, candidate_k: int = 128):
+    def __init__(
+        self,
+        db: fpc.CompiledDB,
+        candidate_k: int = 128,
+        compact: Optional[bool] = None,
+        donate: Optional[bool] = None,
+    ):
         self.db = db
         self.candidate_k = candidate_k
+        self.compact = (
+            _env_flag("SWARM_DEVICE_COMPACT", True)
+            if compact is None
+            else bool(compact)
+        )
+        self.donate = (
+            _env_flag("SWARM_DEVICE_DONATE", True)
+            if donate is None
+            else bool(donate)
+        )
         self.compile_seconds = 0.0
         self.compile_count = 0
-        import threading as _threading
-
-        self._counter_lock = _threading.Lock()
+        #: most recent compacted dispatch: survivor_max / verify_k /
+        #: budget (the "phase B launches at survivor size" evidence —
+        #: bench and tools/profile_device surface it)
+        self.last_compact: dict = {}
+        self.staging = _StagingPool()
+        self._counter_lock = threading.Lock()
         self._meta = None
         self._arrays = None  # device-resident argument pytree
-        self._fn_cache: dict = {}  # full flag -> shape-polymorphic jit fn
+        # full flag -> fused jit fn (legacy arm); "A" -> phase A;
+        # ("B", full, donate_streams) -> phase B
+        self._fn_cache: dict = {}
 
     # ------------------------------------------------------------------
     def _ensure_layout(self):
@@ -191,50 +304,174 @@ class DeviceDB:
             self._arrays = jax.tree_util.tree_map(jnp.asarray, arrays_np)
         return self._meta, self._arrays
 
+    def _budget(self) -> int:
+        meta, _ = self._ensure_layout()
+        return global_candidate_budget(
+            self.candidate_k, len(meta.table_stream)
+        )
+
     def _kernel(self, full: bool):
+        """The fused single-kernel arm (legacy reference twin)."""
+        # double-checked under _counter_lock: two threads first-touching
+        # the same shape class must share ONE jitted wrapper, or each
+        # compiles its own twin and the spy double-counts the compile
         fn = self._fn_cache.get(full)
-        if fn is None:
+        if fn is not None:
+            return fn
+        with self._counter_lock:
+            fn = self._fn_cache.get(full)
+            if fn is None:
+                db, k = self.db, self.candidate_k
+                meta, _ = self._ensure_layout()
+
+                def kernel(arrays, streams, lengths, status):
+                    out = _match_impl_args(
+                        db, meta, k, arrays, streams, lengths, status,
+                        full=full,
+                    )
+                    if full:
+                        # bit-plane outputs ship packed (MSB-first,
+                        # np.packbits convention): ~9× less host
+                        # transfer — and FUSED into one array so the
+                        # host makes exactly one device read
+                        # (split_fused slices it back)
+                        *planes, overflow = out
+                        return fuse_planes(planes, overflow)
+                    return out
+
+                fn = jax.jit(kernel)
+                self._fn_cache[full] = fn
+        return fn
+
+    def _phase_a(self):
+        """Standing phase-A executable: staged streams → survivor rank
+        plane, overflow vector, and the batch's max survivor count
+        (the ONE scalar the host reads between phases). Built once;
+        jit's shape cache serves every width bucket."""
+        fn = self._fn_cache.get("A")
+        if fn is not None:
+            return fn
+        with self._counter_lock:
+            fn = self._fn_cache.get("A")
+            if fn is None:
+                meta, _ = self._ensure_layout()
+                budget = self._budget()
+
+                def kernel_a(arrays, streams, lengths):
+                    streams = ensure_all_stream(streams, lengths)
+                    ctx = _StreamCtx(streams, lengths)
+                    cnt, _cs = prefilter_counts(meta, arrays["tab"], ctx)
+                    n_surv = cnt[:, -1]
+                    K = max(1, min(budget, cnt.shape[1]))
+                    overflow = n_surv > K
+                    nmax = jnp.max(jnp.minimum(n_surv, K))
+                    return cnt, overflow, nmax
+
+                fn = jax.jit(kernel_a)
+                self._fn_cache["A"] = fn
+        return fn
+
+    def _phase_b(self, full: bool, donate_streams: bool):
+        """Phase-B executable family: survivor extraction at the
+        static ladder width ``kc`` + gather-verify + tiny + regex +
+        verdict lowering. The staged per-batch uploads and the
+        inter-phase rank plane are donated so XLA reuses their buffers
+        for outputs (``donate_streams=False`` — caller-owned device
+        inputs — still donates the rank plane, which this DB owns)."""
+        key = ("B", full, donate_streams)
+        fn = self._fn_cache.get(key)
+        if fn is not None:
+            return fn
+        with self._counter_lock:
+            fn = self._fn_cache.get(key)
+            if fn is not None:
+                return fn
             db, k = self.db, self.candidate_k
             meta, _ = self._ensure_layout()
 
-            def kernel(arrays, streams, lengths, status):
-                out = _match_impl_args(
-                    db, meta, k, arrays, streams, lengths, status, full=full
+            def kernel_b(kc, arrays, streams, lengths, status, cnt,
+                         overflow):
+                streams = ensure_all_stream(streams, lengths)
+                ctx = _StreamCtx(streams, lengths)
+                budget = max(
+                    1,
+                    min(
+                        global_candidate_budget(k, len(meta.table_stream)),
+                        cnt.shape[1],
+                    ),
+                )
+                col = compact_candidates(cnt, kc, budget)
+                col_starts = _col_starts_of(meta, streams)
+                value_bits, uncertain_bits = verify_candidates(
+                    meta,
+                    arrays["tab"],
+                    arrays["slot_bytes"],
+                    arrays["slot_len"],
+                    ctx,
+                    col,
+                    col_starts,
+                    db.num_slots,
+                )
+                value_bits = tiny_slot_bits(
+                    meta, arrays["tiny_bytes"], arrays["tiny_slot"], ctx,
+                    value_bits,
+                )
+                out = _finish_match(
+                    db, meta, arrays, streams, lengths, status,
+                    value_bits, uncertain_bits, overflow, full=full,
                 )
                 if full:
-                    # bit-plane outputs ship packed (MSB-first,
-                    # np.packbits convention): ~9× less host transfer —
-                    # and FUSED into one array so the host makes exactly
-                    # one device read (split_fused slices it back)
-                    *planes, overflow = out
-                    return fuse_planes(planes, overflow)
+                    *planes, ovf = out
+                    return fuse_planes(planes, ovf)
                 return out
 
-            fn = jax.jit(kernel)
-            self._fn_cache[full] = fn
+            donate = (
+                (2, 3, 4, 5, 6) if donate_streams else (5, 6)
+            )  # streams, lengths, status, cnt, overflow | cnt, overflow
+            fn = jax.jit(kernel_b, static_argnums=(0,), donate_argnums=donate)
+            self._fn_cache[key] = fn
         return fn
 
     def executable_count(self, full: bool = True) -> int:
-        """Live compiled executables for the ``full``-mode kernel (the
-        compile-count spy the width-bucket tests use)."""
-        fn = self._fn_cache.get(full)
-        if fn is None or not hasattr(fn, "_cache_size"):
-            return 0
-        return int(fn._cache_size())
+        """Live compiled executables serving the ``full``-mode verify
+        (the compile-count spy the width-bucket tests use): the phase-B
+        family on the compacted path plus the fused legacy arm."""
+        n = 0
+        for key in (full, ("B", full, True), ("B", full, False)):
+            fn = self._fn_cache.get(key)
+            if fn is not None and hasattr(fn, "_cache_size"):
+                n += int(fn._cache_size())
+        return n
 
     def lowered_text(
         self, streams: dict, lengths: dict, status, full: bool = True
     ) -> str:
-        """StableHLO text of the kernel for these shapes — the
-        corpus-constants regression test inspects this."""
+        """StableHLO text of the production kernel(s) for these shapes
+        — the corpus-constants regression test inspects this. On the
+        compacted path this is phase A and phase B concatenated (both
+        must be corpus-free); with ``compact`` off, the fused twin."""
         meta, arrays = self._ensure_layout()
-        fn = self._kernel(full)
-        return fn.lower(
-            arrays,
-            {k: jnp.asarray(v) for k, v in streams.items()},
-            {k: jnp.asarray(v) for k, v in lengths.items()},
-            jnp.asarray(status),
-        ).as_text()
+        s_j = {k: jnp.asarray(v) for k, v in streams.items()}
+        l_j = {k: jnp.asarray(v) for k, v in lengths.items()}
+        st_j = jnp.asarray(status)
+        if not (self.compact and len(meta.table_stream)):
+            fn = self._kernel(full)
+            return fn.lower(arrays, s_j, l_j, st_j).as_text()
+        fa = self._phase_a()
+        # inspection only — lower phase B against shape avatars of
+        # phase A's outputs (corpus-freeness holds at every ladder
+        # rung, so the smallest one serves) instead of executing the
+        # prefilter on device just to render text
+        cnt_s, overflow_s, _ = jax.eval_shape(fa, arrays, s_j, l_j)
+        kc = fpc.survivor_bucket(0, self._budget())
+        fb = self._phase_b(full, self.donate)
+        return (
+            fa.lower(arrays, s_j, l_j).as_text()
+            + "\n"
+            + fb.lower(
+                kc, arrays, s_j, l_j, st_j, cnt_s, overflow_s
+            ).as_text()
+        )
 
     # ------------------------------------------------------------------
     def match(self, streams: dict, lengths: dict, status, full: bool = False):
@@ -252,51 +489,125 @@ class DeviceDB:
             return self.collect(out)
         return out
 
-    def dispatch(self, streams: dict, lengths: dict, status, full: bool = True):
-        """Async half of :meth:`match`: launch the jitted kernel and
-        return the (device-resident, still-computing) fused output
-        WITHOUT a host transfer. JAX dispatch is asynchronous, so the
-        kernel crunches while the caller does other host work — the
-        continuous-batching scheduler dispatches batch i+1 here before
-        walking batch i's verdicts. :meth:`collect` finalizes."""
+    @staticmethod
+    def _all_host(streams: dict, lengths: dict, status) -> bool:
+        """Whether every input leaf is host numpy — the donation
+        precondition. Caller-owned DEVICE arrays must never be donated
+        (the caller may reuse them next call; donation would hand it a
+        deleted buffer), so those dispatches take the non-donated
+        phase-B variant instead."""
+        leaves = (
+            list(streams.values()) + list(lengths.values()) + [status]
+        )
+        return all(isinstance(v, np.ndarray) for v in leaves)
+
+    def _spied_launch(self, fns: list, launch):
+        """Run ``launch()`` with the compile spy held atomically: the
+        cache-size read-before/read-after pair, the counter updates,
+        and the shape-churn eviction all happen under ``_counter_lock``
+        so concurrent dispatching threads (scheduler submit + walk
+        offload, or two engines) can't interleave and lose or
+        double-count a compile. The lock does serialize concurrent
+        dispatches on one DeviceDB for the duration of ``launch()``
+        (incl. the compacted path's phase-A scalar sync) — accepted:
+        production has a single dispatching thread per DB (the walk
+        offload thread only collects), so the lock is uncontended
+        there, and attribution under the 4x eviction cannot be made
+        race-free with snapshot-outside-lock reads."""
         import time as _time
 
+        spies = [fn for fn in fns if hasattr(fn, "_cache_size")]
+        with self._counter_lock:
+            n0 = sum(fn._cache_size() for fn in spies)
+            t0 = _time.perf_counter()
+            out = launch()
+            grew = sum(fn._cache_size() for fn in spies) - n0
+            if grew > 0:
+                dt = _time.perf_counter() - t0
+                self.compile_seconds += dt
+                self.compile_count += 1
+                m = _device_metrics()
+                m["compile_seconds"].inc(dt)
+                m["compiles"].inc(1)
+                # shape-churn guard: jax.jit never evicts entries, so
+                # adversarial width/row variety would grow the caches
+                # without bound. Executables are corpus-free (small),
+                # hence the generous 4x bound; past it the whole cache
+                # drops — a rare recompile beats unbounded RSS.
+                for fn in spies:
+                    if fn._cache_size() > 4 * self.MAX_COMPILED and hasattr(
+                        fn, "clear_cache"
+                    ):
+                        fn.clear_cache()
+        return out
+
+    def dispatch(self, streams: dict, lengths: dict, status, full: bool = True):
+        """Async half of :meth:`match`: stage the batch, launch the
+        kernel(s), and return the (device-resident, still-computing)
+        fused output WITHOUT a full host transfer. JAX dispatch is
+        asynchronous, so the kernels crunch while the caller does other
+        host work — the continuous-batching scheduler dispatches batch
+        i+1 here before walking batch i's verdicts. :meth:`collect`
+        finalizes.
+
+        On the compacted path the only blocking point is the phase-A
+        max-survivor scalar read (4 bytes) that picks phase B's ladder
+        width; phase B itself is launched asynchronously at that
+        width."""
         from swarm_tpu.resilience.faults import fault_point
 
         # device-path chaos lever (docs/RESILIENCE.md): stands in for
         # XLA compile errors / OOM / cache corruption; MatchEngine
         # catches the failure and degrades to the exact CPU oracle
         fault_point("device.dispatch")
-        _meta, arrays = self._ensure_layout()
-        fn = self._kernel(full)
-        spy = hasattr(fn, "_cache_size")
-        n0 = fn._cache_size() if spy else -1
-        t0 = _time.perf_counter()
-        out = fn(
-            arrays,
-            {k: jnp.asarray(v) for k, v in streams.items()},
-            {k: jnp.asarray(v) for k, v in lengths.items()},
-            jnp.asarray(status),
+        meta, arrays = self._ensure_layout()
+        if not (self.compact and len(meta.table_stream)):
+            # fused legacy/reference arm (also the no-tables corpus,
+            # where there is nothing to compact)
+            fn = self._kernel(full)
+            s_j, l_j, st_j, staged = self.staging.stage(
+                streams, lengths, status
+            )
+            m = _device_metrics()
+            m["staged_batches"].inc(1)
+            m["staged_bytes"].inc(staged)
+            return self._spied_launch(
+                [fn], lambda: fn(arrays, s_j, l_j, st_j)
+            )
+
+        donate_streams = self.donate and self._all_host(
+            streams, lengths, status
         )
-        if spy:
-            grew = fn._cache_size() - n0
-            if grew > 0:
-                dt = _time.perf_counter() - t0
-                with self._counter_lock:
-                    self.compile_seconds += dt
-                    self.compile_count += grew
-                m = _device_metrics()
-                m["compile_seconds"].inc(dt)
-                m["compiles"].inc(grew)
-                # shape-churn guard: jax.jit never evicts entries, so
-                # adversarial width/row variety would grow the cache
-                # without bound. Executables are corpus-free (small),
-                # hence the generous 4x bound; past it the whole cache
-                # drops — a rare recompile beats unbounded RSS.
-                if fn._cache_size() > 4 * self.MAX_COMPILED and hasattr(
-                    fn, "clear_cache"
-                ):
-                    fn.clear_cache()
+        fa = self._phase_a()
+        fb = self._phase_b(full, donate_streams)
+        s_j, l_j, st_j, staged = self.staging.stage(
+            streams, lengths, status
+        )
+        budget = self._budget()
+        m = _device_metrics()
+
+        def launch():
+            cnt, overflow, nmax = fa(arrays, s_j, l_j)
+            # the ONE host sync between phases: a scalar read that
+            # sizes phase B to live work instead of worst-case budget
+            kc = fpc.survivor_bucket(int(nmax), budget)
+            out = fb(kc, arrays, s_j, l_j, st_j, cnt, overflow)
+            self.last_compact = {
+                "survivor_max": int(nmax),
+                "verify_k": kc,
+                "budget": budget,
+            }
+            return out
+
+        out = self._spied_launch([fa, fb], launch)
+        m["staged_batches"].inc(1)
+        m["staged_bytes"].inc(staged)
+        m["compacted"].inc(1)
+        if donate_streams:
+            m["donated"].inc(1)
+        lc = self.last_compact
+        m["survivor_max"].set(lc["survivor_max"])
+        m["verify_k"].set(lc["verify_k"])
         return out
 
     def collect(self, out):
@@ -312,12 +623,16 @@ class DeviceDB:
 
         Runs each phase as its own jitted call with a blocking sync
         between phases, so the numbers attribute where fresh-batch
-        milliseconds go (prefilter / gather / verify / regex lanes /
-        verdict / transfer). This is NOT the fused production dispatch:
-        phase boundaries forbid cross-phase fusion, so the sum is an
-        upper bound on the fused kernel's time. ``verify`` is reported
+        milliseconds go (prefilter / compact / gather / verify / regex
+        lanes / verdict / transfer). Phase boundaries mirror the
+        production split-phase dispatch: ``prefilter`` is the standing
+        phase-A rank-plane kernel, ``compact`` the survivor extraction
+        at the batch's measured ladder width, and gather/verify run AT
+        THAT WIDTH — ``self.last_compact`` records the
+        survivor_max/verify_k/budget evidence. ``verify`` is reported
         as (full phase B) − (hash-screen-only phase B).
         """
+        import functools as _functools
         import time as _time
 
         db, k = self.db, self.candidate_k
@@ -341,20 +656,20 @@ class DeviceDB:
         def f_pre(arrays, streams, lengths):
             streams = ensure_all_stream(streams, lengths)
             ctx = _StreamCtx(streams, lengths)
-            col, overflow, _cs = prefilter_candidates(
-                meta, arrays["tab"], ctx, budget
-            )
-            return col, overflow
+            cnt, _cs = prefilter_counts(meta, arrays["tab"], ctx)
+            n_surv = cnt[:, -1]
+            K = max(1, min(budget, cnt.shape[1]))
+            return cnt, n_surv > K, jnp.max(jnp.minimum(n_surv, K))
+
+        @_functools.partial(jax.jit, static_argnums=(1,))
+        def f_compact(cnt, kc):
+            K = max(1, min(budget, cnt.shape[1]))
+            return compact_candidates(cnt, kc, K)
 
         # col_starts is shape-static: rebuild from the (post-"all"-
         # synthesis) stream widths without tracing anything
         s_full = ensure_all_stream(s_j, l_j)
-        T = len(meta.table_stream)
-        col_starts = np.zeros(T + 1, dtype=np.int32)
-        for t in range(T):
-            col_starts[t + 1] = (
-                col_starts[t] + s_full[meta.table_stream[t]].shape[1]
-            )
+        col_starts = _col_starts_of(meta, s_full)
 
         def make_verify(byte_verify):
             @jax.jit
@@ -410,8 +725,16 @@ class DeviceDB:
             )
 
         phases: dict = {}
+        T = len(meta.table_stream)
         if T:
-            (col, _ovf), phases["prefilter"] = run(f_pre, arrays, s_j, l_j)
+            (cnt, _ovf, nmax), phases["prefilter"] = run(
+                f_pre, arrays, s_j, l_j
+            )
+            kc = fpc.survivor_bucket(int(nmax), budget)
+            self.last_compact = {
+                "survivor_max": int(nmax), "verify_k": kc, "budget": budget,
+            }
+            col, phases["compact"] = run(f_compact, cnt, kc)
             _, gather_ms = run(make_verify(False), arrays, s_j, l_j, col)
             (vbits, ubits), full_ms = run(
                 make_verify(True), arrays, s_j, l_j, col
@@ -422,7 +745,8 @@ class DeviceDB:
             B = next(iter(s_j.values())).shape[0]
             vbits = jnp.zeros((B, max(ns, 1)), dtype=bool)
             ubits = jnp.zeros((B, max(ns, 1)), dtype=bool)
-            phases["prefilter"] = phases["gather"] = phases["verify"] = 0.0
+            phases["prefilter"] = phases["compact"] = 0.0
+            phases["gather"] = phases["verify"] = 0.0
         vbits, phases["tiny"] = run(f_tiny, arrays, s_j, l_j, vbits)
         rx = None
         if meta.n_rx:
@@ -756,22 +1080,36 @@ def _combo_groups(meta: "fpc.DeviceLayoutMeta"):
     return groups
 
 
-def prefilter_candidates(
+def _col_starts_of(meta: "fpc.DeviceLayoutMeta", streams: dict) -> np.ndarray:
+    """Per-table start offsets on the concatenated candidate axis,
+    rebuilt from the (post-``ensure_all_stream``) stream widths —
+    shape-static, so safe to call on tracers inside a jit."""
+    T = len(meta.table_stream)
+    cs = np.zeros(T + 1, dtype=np.int32)
+    for t in range(T):
+        cs[t + 1] = cs[t] + streams[meta.table_stream[t]].shape[1]
+    return cs
+
+
+def prefilter_counts(
     meta: "fpc.DeviceLayoutMeta",
     tab: dict,
     ctx: _StreamCtx,
-    candidate_k: int,
     back_halo: int = 0,
     fwd_halo: int = 0,
 ):
-    """Phase A: fused stacked bloom probe → per-row global top_k.
+    """Phase A core: fused stacked bloom probe → survivor RANK plane.
 
-    Returns ``(col [B, K] int32, overflow [B] bool, col_starts
-    np[T+1])``: ``col`` indexes the concatenated table-major
-    (table, window) candidate axis, -1 = no candidate. ``overflow``
-    marks rows with more fired windows than K (host row-redo)."""
-    some = next(iter(ctx.streams.values()))
-    B = some.shape[0]
+    Returns ``(cnt [B, C] int32, col_starts np[T+1])``: ``cnt`` is the
+    inclusive running count of fired windows along the concatenated
+    table-major (table, window) candidate axis — ``cnt[b, -1]`` is row
+    b's total survivor count, and the j-th survivor's column is the
+    first index where ``cnt`` reaches j+1 (compact_candidates' binary
+    search). The rank plane replaces the former per-row ``top_k`` over
+    the full candidate axis: top_k lowers to a whole-axis sort (the
+    dominant fresh-batch phase on the CPU backend, ~70% of the fused
+    kernel), while the cumulative count is a single linear scan and the
+    extraction cost moves to phase B where it is survivor-sized."""
     T = len(meta.table_stream)
     flags_by_table: list = [None] * T
     w_by_table = [0] * T
@@ -805,16 +1143,66 @@ def prefilter_candidates(
     col_starts = np.zeros(T + 1, dtype=np.int32)
     for t in range(T):
         col_starts[t + 1] = col_starts[t] + w_by_table[t]
-    c_total = int(col_starts[-1])
     flags_cat = jnp.concatenate(
         [flags_by_table[t] for t in range(T)], axis=1
     )  # [B, C]
+    cnt = jnp.cumsum(flags_cat.astype(jnp.int32), axis=1)
+    return cnt, col_starts
+
+
+def compact_candidates(cnt, kc: int, budget: int):
+    """Survivor compaction: extract the first ``kc`` fired columns per
+    row from the phase-A rank plane.
+
+    The j-th survivor's column is the first index where the running
+    count reaches j+1 — a vectorized binary search over the
+    non-decreasing ``cnt`` rows (~log2(C) gathers of [B, kc] elements,
+    survivor-sized work instead of candidate-axis-sized). Entries past
+    ``min(n_survivors, budget)`` are -1; rows with more than ``budget``
+    fired windows keep their first ``budget`` candidates and are
+    flagged for the host row-redo by the caller (selection order
+    changed from the former top_k's descending-column to ascending —
+    candidate order never reaches the slot planes, and overflow rows
+    are re-run exactly on the host either way).
+
+    → ``col [B, kc] int32`` indexing the concatenated table-major
+    candidate axis, -1 = no candidate.
+    """
+    B, C = cnt.shape
+    target = jnp.arange(1, kc + 1, dtype=jnp.int32)[None, :]  # [1, kc]
+    lo = jnp.zeros((B, kc), dtype=jnp.int32)
+    hi = jnp.full((B, kc), C, dtype=jnp.int32)
+    for _ in range(max(C, 2).bit_length() + 1):
+        mid = (lo + hi) >> 1
+        v = jnp.take_along_axis(cnt, jnp.minimum(mid, C - 1), axis=1)
+        go_right = v < target
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+    n_surv = cnt[:, -1:]
+    return jnp.where(target <= jnp.minimum(n_surv, budget), lo, -1)
+
+
+def prefilter_candidates(
+    meta: "fpc.DeviceLayoutMeta",
+    tab: dict,
+    ctx: _StreamCtx,
+    candidate_k: int,
+    back_halo: int = 0,
+    fwd_halo: int = 0,
+):
+    """Phase A (fused-path form): stacked bloom probe → the first K
+    fired windows per row (prefilter_counts + compact_candidates at
+    the full budget).
+
+    Returns ``(col [B, K] int32, overflow [B] bool, col_starts
+    np[T+1])``: ``col`` indexes the concatenated table-major
+    (table, window) candidate axis, -1 = no candidate. ``overflow``
+    marks rows with more fired windows than K (host row-redo)."""
+    cnt, col_starts = prefilter_counts(meta, tab, ctx, back_halo, fwd_halo)
+    c_total = int(col_starts[-1])
     K = max(1, min(candidate_k, c_total))
-    cols = jnp.arange(c_total, dtype=jnp.int32)
-    vals = jnp.where(flags_cat, cols[None, :] + 1, 0)
-    top_vals, _ = jax.lax.top_k(vals, K)
-    col = top_vals - 1  # [B, K]; -1 = invalid
-    overflow = jnp.sum(flags_cat, axis=1) > K
+    col = compact_candidates(cnt, K, K)
+    overflow = cnt[:, -1] > K
     return col, overflow, col_starts
 
 
@@ -1086,23 +1474,23 @@ def match_slots_args(
     return value_bits, uncertain_bits, overflow
 
 
-def _match_impl_args(
+def _finish_match(
     db: fpc.CompiledDB,
     meta: "fpc.DeviceLayoutMeta",
-    candidate_k: int,
     arrays: dict,
     streams,
     lengths,
     status,
+    value_bits,
+    uncertain_bits,
+    overflow,
     full=False,
 ):
-    """Argument-driven twin of :func:`_match_impl` — the jitted body
-    DeviceDB dispatches (corpus pytree first, so the executable is
-    corpus-free)."""
-    streams = ensure_all_stream(streams, lengths)
-    value_bits, uncertain_bits, overflow = match_slots_args(
-        db, meta, arrays, candidate_k, streams, lengths
-    )
+    """Shared tail of every args-kernel route — device md5, device
+    regex verify, verdict lowering — factored so the fused twin
+    (:func:`_match_impl_args`) and the split survivor-compacted path
+    (DeviceDB's phase B) run literally the same lowering and parity
+    can't drift. ``streams`` must already be post-``ensure_all_stream``."""
     digest = None
     if meta.has_md5 and "body" in streams:
         from swarm_tpu.ops.md5 import md5_words
@@ -1133,6 +1521,31 @@ def _match_impl_args(
         arrays=arrays["verdict"],
     )
     return (*out, overflow)
+
+
+def _match_impl_args(
+    db: fpc.CompiledDB,
+    meta: "fpc.DeviceLayoutMeta",
+    candidate_k: int,
+    arrays: dict,
+    streams,
+    lengths,
+    status,
+    full=False,
+):
+    """Argument-driven twin of :func:`_match_impl` — the fused jitted
+    body (corpus pytree first, so the executable is corpus-free).
+    DeviceDB's legacy/reference dispatch arm and ShardedMatcher run
+    this; the production single-device path splits the same phases
+    around survivor compaction (DeviceDB.dispatch)."""
+    streams = ensure_all_stream(streams, lengths)
+    value_bits, uncertain_bits, overflow = match_slots_args(
+        db, meta, arrays, candidate_k, streams, lengths
+    )
+    return _finish_match(
+        db, meta, arrays, streams, lengths, status,
+        value_bits, uncertain_bits, overflow, full=full,
+    )
 
 
 def eval_verdicts(

@@ -103,8 +103,12 @@ class SchedulerConfig:
     #: rows per planned batch; 0 = the engine's batch_rows
     rows_target: int = 0
     #: device batches in flight (begun, not yet walked). Bounded so the
-    #: recycled encode buffers (_RotatingPool depth 6 / verdict planes
-    #: depth 8) can never alias an unconsumed batch.
+    #: recycled encode buffers (_RotatingPool depth 8 / verdict planes
+    #: depth 8) can never alias an unconsumed batch. On an accelerator
+    #: backend the effective depth stays ≥2 even with the walk offload
+    #: armed (the whole point: device batches hide the host walk); on
+    #: the CPU fallback it still collapses to 1 (see
+    #: _device_overlap_ok).
     inflight: int = 2
     #: encoded batches buffered between prefetch and submission — the
     #: backpressure bound intake stalls against
@@ -132,11 +136,12 @@ class SchedulerConfig:
     walk_offload: str = "auto"
 
     def __post_init__(self):
-        # queue_depth + inflight + the offloaded walk + the encode in
-        # progress must stay under the recycled-pool depth (see
-        # encoding._RotatingPool; the walk slot is charged against
-        # inflight in run())
-        self.inflight = max(1, min(int(self.inflight), 3))
+        # queue_depth (≤2) + inflight (≤4) + the offloaded walk (1) +
+        # the encode in progress (1) must stay at or under the
+        # recycled-pool depth 8 (see encoding._RotatingPool; the walk
+        # slot is charged against inflight in run(), which caps the
+        # offloaded total at 2+3+1+1=7)
+        self.inflight = max(1, min(int(self.inflight), 4))
         self.queue_depth = max(1, min(int(self.queue_depth), 2))
 
 
@@ -437,9 +442,13 @@ class BatchScheduler:
             )
             # the offloaded walk keeps one extra encoded batch alive:
             # its slot is charged against the in-flight budget so the
-            # recycled encode planes (encoding._RotatingPool depth)
-            # can never rotate back under an unwalked batch
-            inflight_cap = max(1, min(inflight_cap, 2))
+            # recycled encode planes (encoding._RotatingPool depth 8)
+            # can never rotate back under an unwalked batch. Cap 3 (not
+            # the former 2): on an accelerator the submit thread must
+            # keep ≥2 device batches genuinely in flight WHILE a walk
+            # runs — with the deeper pool the accounting still closes
+            # (queue 2 + inflight 3 + walk 1 + encode 1 = 7 ≤ 8).
+            inflight_cap = max(1, min(inflight_cap, 3))
 
         next_yield = [0]
 

@@ -29,6 +29,8 @@ from swarm_tpu.telemetry.events import (  # noqa: F401
     subscribe,
 )
 
-# swarm_walk_* families register at import time so every process's
-# /metrics carries them (docs/HOST_WALK.md; check_metrics contract)
+# swarm_walk_* / swarm_device_* staging families register at import
+# time so every process's /metrics carries them (docs/HOST_WALK.md,
+# docs/DEVICE_MATCH.md; check_metrics contract)
 from swarm_tpu.telemetry import walk_export  # noqa: E402,F401
+from swarm_tpu.telemetry import device_export  # noqa: E402,F401

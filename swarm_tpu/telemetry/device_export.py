@@ -1,0 +1,59 @@
+"""Always-registered ``swarm_device_*`` staging/compaction families
+(docs/DEVICE_MATCH.md).
+
+The split-phase device dispatch's staging-pool and survivor-compaction
+counters live on each :class:`~swarm_tpu.ops.match.DeviceDB`; these
+are the scrape-time surface. They are created at telemetry import time
+— not on first kernel dispatch — so EVERY process's ``/metrics``
+carries the families with a rendered sample (``tools/check_metrics.py``
+requires them on a server that has no engine at all). The compile-time
+families (``swarm_device_compile_*``, ``swarm_device_phase_ms``)
+remain lazily created in :mod:`swarm_tpu.ops.match` — they only exist
+in processes that actually dispatch.
+"""
+
+from __future__ import annotations
+
+from swarm_tpu.telemetry.metrics import REGISTRY
+
+#: batches staged to the device through the dispatch staging pool
+#: (every dispatch stages exactly once)
+STAGED_BATCHES = REGISTRY.counter(
+    "swarm_device_staged_batches_total",
+    "Batches staged to the device through the dispatch staging pool",
+)
+STAGED_BYTES = REGISTRY.counter(
+    "swarm_device_staged_bytes_total",
+    "Host bytes staged to the device (streams + lengths + status)",
+)
+#: dispatches whose staged uploads were DONATED to the phase-B kernel
+#: (XLA reuses the buffers for outputs); the complement went through
+#: the non-donated variant (caller-owned device inputs, or donation
+#: disabled via SWARM_DEVICE_DONATE=0)
+DONATED_DISPATCHES = REGISTRY.counter(
+    "swarm_device_donated_dispatches_total",
+    "Dispatches whose staged per-batch buffers were donated to the "
+    "kernel",
+)
+#: dispatches through the survivor-compacted split-phase path (the
+#: complement ran the fused legacy arm: SWARM_DEVICE_COMPACT=0, or a
+#: corpus with no word tables)
+COMPACTED_DISPATCHES = REGISTRY.counter(
+    "swarm_device_compacted_dispatches_total",
+    "Dispatches through the survivor-compacted split-phase kernel",
+)
+#: the most recent compacted batch's max per-row survivor count — what
+#: the ladder rounded up to pick the phase-B width
+SURVIVOR_MAX = REGISTRY.gauge(
+    "swarm_device_survivor_max",
+    "Max per-row prefilter survivors in the most recent compacted "
+    "batch",
+)
+#: the most recent compacted batch's phase-B candidate width (ladder
+#: rung); compare against the global candidate budget to see the
+#: compaction win
+VERIFY_K = REGISTRY.gauge(
+    "swarm_device_verify_k",
+    "Phase-B candidate width (survivor ladder rung) of the most "
+    "recent compacted batch",
+)

@@ -27,7 +27,46 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache for the suite (utils/xlacache.py —
+# the same corpus kernels are re-jitted by many test modules from
+# fresh DeviceDB/MatchEngine instances; deserializing an identical
+# program beats recompiling it, and the tier-1 wall stays inside its
+# timeout). Content-keyed, so staleness is impossible; a second run on
+# the same machine starts warm. SWARM_TEST_XLA_CACHE_DIR= (empty)
+# disables.
+if "SWARM_XLA_CACHE_DIR" not in os.environ:
+    from swarm_tpu.utils import xlacache  # noqa: E402
+
+    # per-user default path: a fixed world-shared /tmp dir would be
+    # unwritable (or poisonable) for the second user on a shared host
+    xlacache.enable_compilation_cache(
+        os.environ.get(
+            "SWARM_TEST_XLA_CACHE_DIR",
+            os.path.join(
+                tempfile.gettempdir(),
+                f"swarm_test_xla_cache_{os.getuid()}",
+            ),
+        )
+    )
+    # the suite compiles MANY sub-second kernels repeatedly across
+    # modules (fresh jit closures per DeviceDB/engine instance) —
+    # cache those too, not just the >1s production kernels
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
+
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    # jax warns at compile time when donated buffers can't alias into
+    # outputs; EXPECTED on the split-phase dispatch (outputs are tiny
+    # packed planes — donation buys early staged-buffer release, not
+    # aliasing; docs/DEVICE_MATCH.md). ops/match.py filters it at
+    # module scope for production processes; pytest re-applies its own
+    # filters per test, so mirror the filter here.
+    config.addinivalue_line(
+        "filterwarnings",
+        "ignore:Some donated buffers were not usable",
+    )
 
 
 @pytest.fixture

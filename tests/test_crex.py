@@ -351,6 +351,11 @@ def test_stack_overflow_does_not_trip_breaker():
 # bounds, stale-library ABI handshake, scratch growth
 
 
+@pytest.mark.skipif(
+    __import__("sys").version_info < (3, 11),
+    reason="pre-existing env gap (ROADMAP housekeeping): re._casefix is a\n"
+    "CPython 3.11+ internal module; this image runs 3.10",
+)
 def test_ci_latin1_folders_matches_interpreter():
     """CI_LATIN1_FOLDERS is hardcoded (a lazy full-unicode scan would
     tax every corpus compile); re-derive it from the RUNNING

@@ -1,0 +1,248 @@
+"""Donated staging buffers + survivor compaction (docs/DEVICE_MATCH.md).
+
+Pins the ISSUE-6 dispatch-path contracts:
+
+- donation parity: ≥3 consecutive fresh batches through the donated
+  split-phase path are bit-identical to the non-donated fused
+  reference twin. Donation bugs classically corrupt the *previous*
+  batch (XLA hands a donated buffer to the next computation while a
+  stale reader still points at it), so every batch carries distinct
+  content and all dispatches are in flight before the first collect;
+- survivor compaction is sound at candidate_k=2: overflow rows flag
+  for the host row-redo and every plane stays bit-equal to the
+  uncompacted kernel;
+- a sparse-survivor batch launches phase B at the ladder width, not
+  the global budget (the "verify work scales with survivors"
+  acceptance evidence);
+- the compile spy is atomic under two dispatching threads (the
+  read-before/launch/read-after/evict sequence runs under
+  ``_counter_lock`` — the scheduler's walk offload dispatches and
+  collects on different threads).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from swarm_tpu.fingerprints import load_corpus
+from swarm_tpu.fingerprints.compile import (
+    SURVIVOR_LADDER_MIN,
+    compile_corpus,
+    survivor_bucket,
+)
+from swarm_tpu.ops.encoding import encode_batch
+from swarm_tpu.ops.match import DeviceDB
+
+from test_match_parity import fuzz_rows
+
+DATA = "tests/data/templates"
+PLANES = ("t_value", "t_unc", "op_value", "op_unc", "m_unc", "overflow")
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    templates, errors = load_corpus(DATA)
+    assert templates and not errors
+    return templates, compile_corpus(templates)
+
+
+def _fresh_batch(templates, seed: int, n: int = 8):
+    rows = fuzz_rows(templates, random.Random(seed), n)
+    return encode_batch(
+        rows, max_body=512, max_header=256, pad_rows_to=n,
+        width_multiple=512,
+    )
+
+
+def test_survivor_bucket_ladder():
+    assert survivor_bucket(0, 128) == SURVIVOR_LADDER_MIN
+    assert survivor_bucket(SURVIVOR_LADDER_MIN, 128) == SURVIVOR_LADDER_MIN
+    assert survivor_bucket(SURVIVOR_LADDER_MIN + 1, 128) == (
+        SURVIVOR_LADDER_MIN * 2
+    )
+    assert survivor_bucket(100, 128) == 128  # next rung past the budget
+    assert survivor_bucket(5, 2) == 2  # budget clamp (overflow redoes)
+    assert survivor_bucket(0, 1) == 1
+
+
+def test_three_batch_donated_parity(corpus):
+    """≥3 consecutive fresh batches, ALL dispatched before the first
+    collect (the donated staged buffers of batch i are released to XLA
+    while i+1 and i+2 still compute), bit-identical to the non-donated
+    fused reference twin. Then the staged-buffer reuse round-trip: the
+    first batch re-dispatched after the others must reproduce its own
+    planes exactly (same shape class → same reclaimed buffers)."""
+    from swarm_tpu.telemetry import device_export
+
+    templates, db = corpus
+    don = DeviceDB(db)
+    assert don.compact and don.donate, "defaults must exercise the tentpole"
+    ref = DeviceDB(db, compact=False, donate=False)
+    batches = [_fresh_batch(templates, seed) for seed in (101, 202, 303)]
+    d0 = device_export.DONATED_DISPATCHES.labels().value
+    c0 = device_export.COMPACTED_DISPATCHES.labels().value
+    outs = [
+        don.dispatch(b.streams, b.lengths, b.status, full=True)
+        for b in batches
+    ]
+    first = None
+    for i, (b, out) in enumerate(zip(batches, outs)):
+        got = don.collect(out)
+        if i == 0:
+            first = got
+        want = ref.match(b.streams, b.lengths, b.status, full=True)
+        for name, a, w in zip(PLANES, got, want):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(w),
+                err_msg=f"batch {i} plane {name}",
+            )
+    assert don.staging.uploads == len(batches)
+    assert don.staging.bytes > 0
+    assert device_export.DONATED_DISPATCHES.labels().value == d0 + 3
+    assert device_export.COMPACTED_DISPATCHES.labels().value == c0 + 3
+    # staged-buffer reuse: batch 0 again through buffers XLA has
+    # already reclaimed — results must round-trip bit-identically
+    b0 = batches[0]
+    again = don.match(b0.streams, b0.lengths, b0.status, full=True)
+    for name, x, y in zip(PLANES, first, again):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=name
+        )
+
+
+def _stuffed_rows(templates):
+    from swarm_tpu.fingerprints.model import Response
+
+    words = [
+        m.words[0].encode()
+        for t in templates
+        for _, m in t.all_matchers()
+        if m.words
+    ][:4]
+    stuffed = b" ".join(words * 16)
+    return [
+        Response(host="a", port=80, status=200, body=stuffed,
+                 header=b"HTTP/1.1 200 OK\r\nServer: nginx"),
+        Response(host="b", port=80, status=200, body=b"plain",
+                 header=b"HTTP/1.1 200 OK"),
+    ]
+
+
+def test_compaction_overflow_sound_at_candidate_k2(corpus):
+    """candidate_k=2: the stuffed row overflows the budget on the
+    compacted path exactly as on the uncompacted twin, every plane
+    bit-equal — the host row-redo escape hatch stays reachable and
+    correct at the tightest budget."""
+    templates, db = corpus
+    rows = _stuffed_rows(templates)
+    batch = encode_batch(rows, max_body=2048, max_header=256, pad_rows_to=2)
+    tight = DeviceDB(db, candidate_k=2)
+    ref = DeviceDB(db, candidate_k=2, compact=False, donate=False)
+    got = tight.match(batch.streams, batch.lengths, batch.status, full=True)
+    want = ref.match(batch.streams, batch.lengths, batch.status, full=True)
+    for name, a, w in zip(PLANES, got, want):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(w), err_msg=name
+        )
+    assert bool(np.asarray(got[-1])[0]), "stuffed row must overflow K=2"
+    lc = tight.last_compact
+    assert lc["verify_k"] <= lc["budget"], lc
+    # the engine's end-to-end host row-redo under the same tight budget
+    # runs (on the compacted default path) in
+    # tests/test_two_phase.py::test_overflow_budget_is_sound — not
+    # duplicated here to keep the tier-1 wall bounded.
+
+
+def test_sparse_batch_verifies_at_ladder_width_not_budget(corpus):
+    """A normal (sparse-survivor) batch must launch phase B at the
+    bottom ladder rungs — far below the global budget — and record the
+    evidence in ``last_compact`` and the ``swarm_device_verify_k``
+    gauge."""
+    from swarm_tpu.telemetry import device_export
+
+    templates, db = corpus
+    dev = DeviceDB(db)
+    batch = _fresh_batch(templates, 77)
+    dev.match(batch.streams, batch.lengths, batch.status, full=True)
+    lc = dev.last_compact
+    assert lc, "compacted dispatch must record last_compact"
+    assert lc["verify_k"] == survivor_bucket(
+        lc["survivor_max"], lc["budget"]
+    )
+    assert lc["verify_k"] < lc["budget"], (
+        "sparse batch must verify below the global budget", lc
+    )
+    assert device_export.VERIFY_K.labels().value == lc["verify_k"]
+    assert device_export.SURVIVOR_MAX.labels().value == lc["survivor_max"]
+
+
+def test_compile_spy_atomic_under_two_threads(corpus):
+    """Two threads dispatching concurrently (the walk-offload threading
+    shape): compile attribution is exact — one counted compile per
+    genuinely new shape class, none lost or double-counted — because
+    the whole spy/launch/evict sequence holds ``_counter_lock``. Then
+    the eviction half on the SAME DeviceDB: with the 4×MAX_COMPILED
+    shape-churn bound forced to zero every dispatch drops the caches
+    and recompiles, and each must still be attributed exactly once — a
+    cross-thread ``clear_cache`` between another thread's
+    read-before/read-after would lose the attribution."""
+    templates, db = corpus
+    dev = DeviceDB(db)
+    b1 = _fresh_batch(templates, 5, n=4)
+    b2 = _fresh_batch(templates, 6, n=8)  # distinct row-count shape
+
+    def spawn(worker, args_list):
+        barrier = threading.Barrier(len(args_list))
+        errors: list = []
+
+        def runner(*a):
+            try:
+                barrier.wait()
+                worker(*a)
+            except Exception as e:  # pragma: no cover - failure surface
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=runner, args=a) for a in args_list
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+    def dispatch_twice(batch):
+        for _ in range(2):
+            out = dev.dispatch(
+                batch.streams, batch.lengths, batch.status, full=True
+            )
+            dev.collect(out)
+
+    spawn(dispatch_twice, [(b1,), (b2,)])
+    # exactly one attributed compile per shape class, regardless of
+    # interleaving (each dispatch compiles phase A + phase B together)
+    assert dev.compile_count == 2
+    assert dev.compile_seconds > 0.0
+
+    # eviction half: zero bound + a NEW shape → the first dispatch
+    # compiles (grew > 0) and drops the caches; the second then finds
+    # them empty and recompiles — every dispatch compiles, counts, and
+    # evicts, atomically
+    dev.MAX_COMPILED = 0
+    b3 = _fresh_batch(templates, 7, n=16)  # genuinely new shape class
+
+    def dispatch_once(batch):
+        out = dev.dispatch(
+            batch.streams, batch.lengths, batch.status, full=True
+        )
+        dev.collect(out)
+
+    spawn(dispatch_once, [(b3,), (b3,)])
+    assert dev.compile_count == 4, (
+        "every dispatch recompiles under the zero bound and each must "
+        "be counted exactly once"
+    )

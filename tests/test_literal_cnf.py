@@ -22,6 +22,9 @@ weak sets made ~every fresh row fire the host walk (round-5 bench:
 """
 
 import re
+from pathlib import Path
+
+import pytest
 
 from swarm_tpu.fingerprints.compile import (
     required_literal_cnf,
@@ -124,6 +127,11 @@ def test_necessity_on_matching_strings():
             )
 
 
+@pytest.mark.skipif(
+    not Path("/root/reference/worker/artifacts/templates").is_dir(),
+    reason="pre-existing env gap (ROADMAP housekeeping): /root/reference\n"
+    "corpus absent — the (pattern, seed) sample population comes from it",
+)
 def test_literal_sets_still_necessary_over_corpus_sample():
     """Every corpus extraction pattern: anywhere re.search matches one
     of our seeded texts, literals_absent must be False (same invariant

@@ -17,6 +17,17 @@ import pytest
 
 from swarm_tpu.native import scanio
 
+#: pre-existing environment gap (ROADMAP housekeeping): the native
+#: engine dlopens libssl.so.3 (OpenSSL 3), but this image ships only
+#: libssl.so.1.1 + NSS's libssl3.so — TLS-dependent tests skip with
+#: this reason instead of failing. The probes stay in the suite so a
+#: fixed image turns them back on automatically.
+needs_libssl = pytest.mark.skipif(
+    not scanio.tls_available(),
+    reason="libssl.so.3 not loadable in this image (only libssl 1.1 / "
+    "NSS present); native TLS handshakes cannot run",
+)
+
 
 @pytest.fixture(scope="module")
 def https_server(tmp_path_factory):
@@ -80,9 +91,25 @@ REQ = b"GET / HTTP/1.1\r\nHost: localhost\r\nConnection: close\r\n\r\n"
 
 
 def test_tls_available():
-    assert scanio.tls_available(), "libssl not loadable in this image"
+    """The environment probe itself: if the image ships the OpenSSL 3
+    soname the native layer dlopens, scanio MUST report TLS available —
+    guarded by an INDEPENDENT ctypes load (not tls_available(), which
+    would make this a tautology that can never fail)."""
+    import ctypes
+
+    try:
+        ctypes.CDLL("libssl.so.3")
+    except OSError:
+        pytest.skip(
+            "libssl.so.3 not loadable in this image (only libssl 1.1 / "
+            "NSS present); native TLS handshakes cannot run"
+        )
+    assert scanio.tls_available(), (
+        "image ships libssl.so.3 but the native TLS loader failed"
+    )
 
 
+@needs_libssl
 def test_tls_scan_decrypts_response(https_server):
     r = scanio.tcp_scan(
         ["127.0.0.1"], [https_server], [REQ],
@@ -101,6 +128,7 @@ def test_tls_to_plain_port_reports_tls_failed(plain_server):
     assert int(r.status[0]) == scanio.STATUS_TLS_FAILED
 
 
+@needs_libssl
 def test_mixed_tls_and_plain_wave(https_server, plain_server):
     r = scanio.tcp_scan(
         ["127.0.0.1"] * 3,
@@ -115,6 +143,7 @@ def test_mixed_tls_and_plain_wave(https_server, plain_server):
     assert int(r.status[2]) == scanio.STATUS_CLOSED
 
 
+@needs_libssl
 def test_executor_probes_https(https_server, monkeypatch):
     """The http probe path wraps 443/8443 in TLS; patch tls_port to
     treat the test port as TLS so the full parse path is exercised."""

@@ -4,6 +4,8 @@ import socket
 import struct
 import urllib.request
 
+import pytest
+
 from swarm_tpu.worker.oob import OOBListener, _build_a_reply, _parse_qname
 
 
@@ -70,6 +72,15 @@ def test_dns_interaction_and_reply():
 def test_https_callback_on_same_port():
     """The listener's single port auto-detects TLS (templates embed
     https://{{interactsh-url}} as often as http://)."""
+    # pre-existing env gap (ROADMAP housekeeping): the listener's
+    # self-signed server cert needs the python 'cryptography'
+    # package (worker/oob._self_signed_tls_context); without it the
+    # port serves plain HTTP and the client handshake cannot start
+    pytest.importorskip(
+        "cryptography",
+        reason="python 'cryptography' package absent in this image (OOB\n"
+        "listener cannot mint its self-signed TLS cert)",
+    )
     import ssl
 
     with OOBListener() as lst:

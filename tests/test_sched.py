@@ -228,6 +228,44 @@ def test_dead_rows_resolve_without_engine_traffic():
     assert sched.stats.dead_rows == 2 and sched.stats.fresh_rows == 1
 
 
+def test_accelerator_drives_inflight_ge2_with_walk_offload():
+    """On an accelerator backend the submit thread must keep ≥2 device
+    batches genuinely in flight WHILE the offloaded walk runs (the
+    ISSUE-6 overlap acceptance), and the recycled-plane accounting
+    stays closed: begun-but-unwalked batches never exceed the offload
+    cap (3) plus the single offloaded walk."""
+    eng = _StubEngine()
+    sched = BatchScheduler(
+        eng,
+        SchedulerConfig(
+            rows_target=8, inflight=4, walk_offload="on",
+            prefetch="inline",
+        ),
+    )
+    sched._overlap_helps = True  # accelerator backend
+    chunks = [[_row(50) for _ in range(5)] for _ in range(30)]
+    total = sum(len(r) for r in sched.run(chunks))
+    assert total == 150
+    assert eng.inflight == 0
+    assert eng.max_inflight >= 2, "overlap must actually happen"
+    assert eng.max_inflight <= 4  # cap 3 + the one offloaded walk
+    assert sched.stats.offloaded_walks > 0
+
+
+def test_cpu_fallback_still_collapses_inflight_to_1():
+    """The CPU backend's XLA threads ARE the walk's cores: in-flight
+    must still collapse to 1 there, whatever the configured depth."""
+    eng = _StubEngine()
+    sched = BatchScheduler(
+        eng, SchedulerConfig(rows_target=8, inflight=4, prefetch="inline")
+    )
+    sched._overlap_helps = False  # CPU fallback
+    chunks = [[_row(50) for _ in range(5)] for _ in range(10)]
+    total = sum(len(r) for r in sched.run(chunks))
+    assert total == 50
+    assert eng.max_inflight <= 1
+
+
 def test_producer_error_propagates():
     eng = _StubEngine()
     sched = BatchScheduler(

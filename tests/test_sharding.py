@@ -243,6 +243,24 @@ def test_two_process_distributed_match(tmp_path):
         outs.append(log.read())
         log.close()
     for rank, (p, out) in enumerate(zip(procs, outs)):
+        if (
+            p.returncode != 0
+            and "Multiprocess computations aren't implemented on the "
+            "CPU backend" in out
+        ):
+            # pre-existing environment gap (ROADMAP housekeeping): the
+            # installed jaxlib's CPU backend has no multiprocess
+            # collective support, so the two-process DCN stand-in
+            # cannot execute here at all. Skip with the capability
+            # reason — any OTHER failure still fails the test, and an
+            # image with a collectives-enabled jaxlib (or a real
+            # accelerator) runs it again automatically.
+            pytest.skip(
+                "jaxlib CPU backend lacks multiprocess collectives "
+                "(XlaRuntimeError: 'Multiprocess computations aren't "
+                "implemented on the CPU backend') — 2-process "
+                "distributed match cannot run in this image"
+            )
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
         assert f"rank {rank} ok" in out
 

@@ -35,6 +35,17 @@ REQUIRED_FAMILIES = (
     "swarm_walk_pool_threads",
     "swarm_walk_batched_pairs",
     "swarm_walk_phase_seconds",
+    # device-dispatch staging/compaction plane (docs/DEVICE_MATCH.md):
+    # registered at telemetry import (device_export) — unlabeled
+    # counters/gauges render zero samples even in an engine-free
+    # process; the lazy compile-time families are deliberately NOT
+    # required here
+    "swarm_device_staged_batches_total",
+    "swarm_device_staged_bytes_total",
+    "swarm_device_donated_dispatches_total",
+    "swarm_device_compacted_dispatches_total",
+    "swarm_device_survivor_max",
+    "swarm_device_verify_k",
 )
 
 
