@@ -96,18 +96,32 @@ struct Attrs {
   PyObject* alive;
 };
 
-inline const Attrs& attrs() {
-  static Attrs a = {
-      PyUnicode_InternFromString("body"),
-      PyUnicode_InternFromString("header"),
-      PyUnicode_InternFromString("banner"),
-      PyUnicode_InternFromString("status"),
-      PyUnicode_InternFromString("oob_protocols"),
-      PyUnicode_InternFromString("oob_requests"),
-      PyUnicode_InternFromString("oob_ips"),
-      PyUnicode_InternFromString("alive"),
-  };
-  return a;
+// Returns nullptr when interning failed (OOM at first use) — callers
+// bail with their error return instead of handing a NULL name to
+// PyObject_GetAttr, which would crash. Once failed, stays failed: the
+// Python side falls back to its pure-Python packer on the error.
+inline const Attrs* attrs() {
+  static Attrs a;
+  static bool ok = [] {
+    const char* names[8] = {"body",          "header",       "banner",
+                            "status",        "oob_protocols", "oob_requests",
+                            "oob_ips",       "alive"};
+    PyObject* objs[8];
+    for (int i = 0; i < 8; ++i) {
+      objs[i] = PyUnicode_InternFromString(names[i]);
+      if (objs[i] == nullptr) return false;
+    }
+    a.body = objs[0];
+    a.header = objs[1];
+    a.banner = objs[2];
+    a.status = objs[3];
+    a.oob_protocols = objs[4];
+    a.oob_requests = objs[5];
+    a.oob_ips = objs[6];
+    a.alive = objs[7];
+    return true;
+  }();
+  return ok ? &a : nullptr;
 }
 
 // Response row → (body bytes [banner-aliased], header bytes, concat).
@@ -115,7 +129,9 @@ inline const Attrs& attrs() {
 // non-bytes part.
 inline int row_parts(PyObject* row, PyObject** bobj, PyObject** hobj,
                      int* is_banner) {
-  const Attrs& a = attrs();
+  const Attrs* ap = attrs();
+  if (ap == nullptr) return -1;
+  const Attrs& a = *ap;
   PyObject* banner = PyObject_GetAttr(row, a.banner);
   if (banner == nullptr) return -1;
   *is_banner = (banner != Py_None);
@@ -153,7 +169,9 @@ extern "C" int sw_rows_meta(PyObject* rows, int64_t* blens, int64_t* hlens,
                             int32_t* status, uint8_t* concat,
                             const void** bptr, const void** hptr) {
   if (!PyList_Check(rows)) return -1;
-  const Attrs& a = attrs();
+  const Attrs* ap = attrs();
+  if (ap == nullptr) return -1;
+  const Attrs& a = *ap;
   Py_ssize_t n = PyList_GET_SIZE(rows);
   int has_oob = 0;
   for (Py_ssize_t i = 0; i < n; ++i) {
@@ -419,7 +437,9 @@ struct RawRow {
 // per entry) — the precondition for the split-dict fast read below.
 inline bool scan_row_dict(PyObject* dict, RawRow* r, int8_t* idx = nullptr,
                           bool* dense = nullptr, int* n_iter = nullptr) {
-  const Attrs& a = attrs();
+  const Attrs* ap = attrs();
+  if (ap == nullptr) return false;
+  const Attrs& a = *ap;
   int found = 0;
   Py_ssize_t pos = 0, prev = 0, it = 0;
   bool is_dense = true;
@@ -583,7 +603,9 @@ inline int row_view_dict(PyObject* row, PyObject* dict, RowView* v,
     RawRow r;
     if (scan_row_dict(dict, &r)) return view_from_raw(r, v);
   }
-  const Attrs& a = attrs();
+  const Attrs* ap = attrs();
+  if (ap == nullptr) return -1;
+  const Attrs& a = *ap;
   int dec;
   PyObject* obj = fast_attr(row, dict, a.banner, &dec);
   if (obj == nullptr) return -1;
@@ -646,6 +668,7 @@ inline int row_view(PyObject* row, RowView* v, HeldRefs* held) {
 extern "C" int64_t sw_rows_alive(PyObject* rows, uint8_t* out) {
   if (!PyList_Check(rows)) return -1;
   static PyObject* alive_name = PyUnicode_InternFromString("alive");
+  if (alive_name == nullptr) return -1;
   Py_ssize_t n = PyList_GET_SIZE(rows);
   int64_t count = 0;
   for (Py_ssize_t i = 0; i < n; ++i) {
@@ -1000,6 +1023,7 @@ extern "C" int64_t sw_memo_contains_batch(void* mp, PyObject* rows,
   Memo* m = static_cast<Memo*>(mp);
   if (!PyList_Check(rows)) return -1;
   static PyObject* alive_name = PyUnicode_InternFromString("alive");
+  if (alive_name == nullptr) return -1;
   Py_ssize_t n = PyList_GET_SIZE(rows);
   HeldRefs held;
   for (Py_ssize_t i = 0; i < n; ++i) {
@@ -1051,7 +1075,9 @@ int memo_insert_one(Memo* m, PyObject* row, const uint8_t* bits_row,
   // (the row object may die; its attribute objects must not — and a
   // property row may hand back fresh byte objects per access, so the
   // lookup view's pointers are not the buffers being stored).
-  const Attrs& a = attrs();
+  const Attrs* ap = attrs();
+  if (ap == nullptr) return -1;
+  const Attrs& a = *ap;
   PyObject* names[6] = {a.banner, a.body,          a.header,
                         a.oob_requests, a.oob_protocols, a.oob_ips};
   PyObject* owned[6] = {};
@@ -1177,6 +1203,7 @@ extern "C" int64_t sw_memo_lookup(void* mp, PyObject* rows,
       !PyList_Check(deferred_out))
     return -1;
   static PyObject* alive_name = PyUnicode_InternFromString("alive");
+  if (alive_name == nullptr) return -1;
   Py_ssize_t n = PyList_GET_SIZE(rows);
   if (n == 0) return 0;
   ++m->epoch;  // LRU refresh cadence anchor (see Memo::epoch)

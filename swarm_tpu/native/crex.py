@@ -19,6 +19,7 @@ for that (pattern, content) pair; exactness is never traded for speed.
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 import threading
 from pathlib import Path
@@ -26,11 +27,16 @@ from typing import Optional
 
 import numpy as np
 
-_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_SRC_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+# alternate-build override (tools/sanitize_natives.sh) — mirrors
+# native/scanio.py: load prebuilt .so from the named dir, skip make.
+# Snapshot ONCE at import (empty = unset), same as the path itself.
+_DIR_OVERRIDDEN = bool(os.environ.get("SWARM_NATIVE_DIR"))
+_NATIVE_DIR = Path(os.environ.get("SWARM_NATIVE_DIR") or _SRC_NATIVE_DIR)
 _LIB_PATH = _NATIVE_DIR / "libcrex.so"
 
-_lib: Optional[ctypes.CDLL] = None
-_lib_failed = False
+_lib: Optional[ctypes.CDLL] = None  # guarded-by: _load_lock
+_lib_failed = False  # guarded-by: _load_lock
 # first use can come from several extraction-pool threads at once: the
 # make invocation and the CDLL load must happen exactly once
 _load_lock = threading.Lock()
@@ -70,24 +76,34 @@ def ensure_crex() -> Optional[ctypes.CDLL]:
         return _ensure_crex_locked()
 
 
-def _ensure_crex_locked() -> Optional[ctypes.CDLL]:
+def _ensure_crex_locked() -> Optional[ctypes.CDLL]:  # requires-lock: _load_lock
     global _lib, _lib_failed
     if _lib is not None:
         return _lib
     if _lib_failed:
         return None
-    try:
-        import sys as _sys
-
-        subprocess.run(
-            ["make", "-C", str(_NATIVE_DIR), f"PY={_sys.executable}"],
-            check=True,
-            capture_output=True,
-        )
-    except (OSError, subprocess.CalledProcessError):
+    if _DIR_OVERRIDDEN:
         if not _LIB_PATH.exists():
-            _lib_failed = True
-            return None
+            # deliberate prebuilt set named but crex missing from it —
+            # fail LOUDLY like scanio does, or a sanitizer run would
+            # quietly fall back to the pure-Python engine and report
+            # green with zero coverage of crex.cpp
+            raise FileNotFoundError(
+                f"SWARM_NATIVE_DIR set but {_LIB_PATH} does not exist"
+            )
+    else:
+        try:
+            import sys as _sys
+
+            subprocess.run(
+                ["make", "-C", str(_SRC_NATIVE_DIR), f"PY={_sys.executable}"],
+                check=True,
+                capture_output=True,
+            )
+        except (OSError, subprocess.CalledProcessError):
+            if not _LIB_PATH.exists():
+                _lib_failed = True
+                return None
     try:
         lib = ctypes.CDLL(str(_LIB_PATH))
     except OSError:

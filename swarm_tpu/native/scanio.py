@@ -14,9 +14,11 @@ from __future__ import annotations
 
 import ctypes
 import dataclasses
+import os
 import socket
 import struct
 import subprocess
+import threading
 from pathlib import Path
 from typing import Optional, Sequence
 
@@ -28,30 +30,58 @@ STATUS_TIMEOUT = 2
 STATUS_ERROR = 3
 STATUS_TLS_FAILED = 5  # TCP connected, TLS handshake failed / unavailable
 
-_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_SRC_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+# Alternate-build override (tools/sanitize_natives.sh): point every
+# loader at a directory of DELIBERATELY prebuilt .so (e.g. the
+# ASan+UBSan set) and skip the auto-make — the operator built exactly
+# what they want loaded. Captured ONCE at import (empty = unset): the
+# path and the skip-make decision must come from the same snapshot, or
+# setting the var after import would skip the build yet silently
+# dlopen the source-tree .so.
+_DIR_OVERRIDDEN = bool(os.environ.get("SWARM_NATIVE_DIR"))
+_NATIVE_DIR = Path(os.environ.get("SWARM_NATIVE_DIR") or _SRC_NATIVE_DIR)
 _LIB_PATH = _NATIVE_DIR / "libscanio.so"
-_lib: Optional[ctypes.CDLL] = None
+_lib: Optional[ctypes.CDLL] = None  # guarded-by: _load_lock
+# first use can come from several engine/walk-pool threads at once:
+# the make invocation and the dlopen must happen exactly once (same
+# contract as native/crex.py; concurrent `make` can corrupt the .so
+# another thread is mid-dlopen on)
+_load_lock = threading.Lock()
 
 
 def ensure_lib() -> ctypes.CDLL:
-    """Load libscanio.so, building it with make on first use."""
+    """Load libscanio.so, building it with make on first use.
+    Thread-safe: concurrent first calls serialize on _load_lock."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _load_lock:
+        return _ensure_lib_locked()
+
+
+def _ensure_lib_locked() -> ctypes.CDLL:  # requires-lock: _load_lock
     global _lib
     if _lib is not None:
         return _lib
     # invoke make when possible (mtime-incremental, so a stale prebuilt
     # .so from an older checkout picks up new symbols); a deployment
     # without a toolchain falls back to the shipped .so
-    try:
-        import sys as _sys
+    if not _DIR_OVERRIDDEN:
+        try:
+            import sys as _sys
 
-        subprocess.run(
-            ["make", "-C", str(_NATIVE_DIR), f"PY={_sys.executable}"],
-            check=True,
-            capture_output=True,
+            subprocess.run(
+                ["make", "-C", str(_SRC_NATIVE_DIR), f"PY={_sys.executable}"],
+                check=True,
+                capture_output=True,
+            )
+        except (OSError, subprocess.CalledProcessError):
+            if not _LIB_PATH.exists():
+                raise
+    elif not _LIB_PATH.exists():
+        raise FileNotFoundError(
+            f"SWARM_NATIVE_DIR set but {_LIB_PATH} does not exist"
         )
-    except (OSError, subprocess.CalledProcessError):
-        if not _LIB_PATH.exists():
-            raise
     lib = ctypes.CDLL(str(_LIB_PATH))
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
     u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
@@ -94,14 +124,23 @@ def ensure_lib() -> ctypes.CDLL:
 # ---------------------------------------------------------------------------
 
 _FASTPACK_PATH = _NATIVE_DIR / "libfastpack.so"
-_fastpack: Optional[ctypes.PyDLL] = None
+_fastpack: Optional[ctypes.PyDLL] = None  # guarded-by: _load_lock
 
 
 def ensure_fastpack() -> ctypes.PyDLL:
+    """Thread-safe like :func:`ensure_lib` (one dlopen, ever)."""
     global _fastpack
     if _fastpack is not None:
         return _fastpack
     ensure_lib()  # same make invocation builds both shared objects
+    with _load_lock:
+        return _ensure_fastpack_locked()
+
+
+def _ensure_fastpack_locked() -> ctypes.PyDLL:  # requires-lock: _load_lock
+    global _fastpack
+    if _fastpack is not None:
+        return _fastpack
     lib = ctypes.PyDLL(str(_FASTPACK_PATH))
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")

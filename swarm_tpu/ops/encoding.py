@@ -129,8 +129,9 @@ class _RotatingPool:
 
     def __init__(self, depth: int = 8):
         self._depth = depth
-        self._slots: dict = {}  # key -> [bufs, next_idx]; dict order = LRU
-        self._bytes = 0
+        # key -> [bufs, next_idx]; dict order = LRU
+        self._slots: dict = {}  # guarded-by: _lock (reads)
+        self._bytes = 0  # guarded-by: _lock (reads)
         import threading
 
         self._lock = threading.Lock()

@@ -262,7 +262,7 @@ class MatchEngine:
         # guards the stats fields BOTH the submit thread (begin_packed)
         # and the scheduler's walk worker (finish_packed → _walk_plane)
         # update — unsynchronized float += across threads loses updates
-        self._stats_lock = threading.Lock()
+        self._stats_lock = threading.Lock()  # guards: stats.device_seconds, stats.device_faults
         # row-parallel batched confirm walk (docs/HOST_WALK.md):
         # explicit arg > SWARM_WALK_THREADS > SWARM_EXT_THREADS (compat)
         # > spare cores. 0 = serial reference walk; 1 = batched native

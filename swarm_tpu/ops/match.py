@@ -195,8 +195,8 @@ class _StagingPool:
     """
 
     def __init__(self):
-        self.uploads = 0
-        self.bytes = 0
+        self.uploads = 0  # guarded-by: _lock
+        self.bytes = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def stage(self, streams: dict, lengths: dict, status):
@@ -281,19 +281,21 @@ class DeviceDB:
             if donate is None
             else bool(donate)
         )
-        self.compile_seconds = 0.0
-        self.compile_count = 0
+        self.compile_seconds = 0.0  # guarded-by: _counter_lock
+        self.compile_count = 0  # guarded-by: _counter_lock
         #: most recent compacted dispatch: survivor_max / verify_k /
         #: budget (the "phase B launches at survivor size" evidence —
         #: bench and tools/profile_device surface it)
-        self.last_compact: dict = {}
+        self.last_compact: dict = {}  # guarded-by: _counter_lock
         self.staging = _StagingPool()
         self._counter_lock = threading.Lock()
         self._meta = None
         self._arrays = None  # device-resident argument pytree
         # full flag -> fused jit fn (legacy arm); "A" -> phase A;
-        # ("B", full, donate_streams) -> phase B
-        self._fn_cache: dict = {}
+        # ("B", full, donate_streams) -> phase B. Writes only under the
+        # lock; the double-checked fast-path .get() reads are benign
+        # (dict get is atomic, a miss just takes the locked slow path)
+        self._fn_cache: dict = {}  # guarded-by: _counter_lock
 
     # ------------------------------------------------------------------
     def _ensure_layout(self):
@@ -324,6 +326,9 @@ class DeviceDB:
                 db, k = self.db, self.candidate_k
                 meta, _ = self._ensure_layout()
 
+                # jit-captures: db, meta, k, full (host metadata +
+                # scalars — trace-static by construction; the corpus
+                # rides the `arrays` ARGUMENT, never the closure)
                 def kernel(arrays, streams, lengths, status):
                     out = _match_impl_args(
                         db, meta, k, arrays, streams, lengths, status,
@@ -357,6 +362,8 @@ class DeviceDB:
                 meta, _ = self._ensure_layout()
                 budget = self._budget()
 
+                # jit-captures: meta, budget (layout metadata + a
+                # python int; both trace-static)
                 def kernel_a(arrays, streams, lengths):
                     streams = ensure_all_stream(streams, lengths)
                     ctx = _StreamCtx(streams, lengths)
@@ -389,6 +396,8 @@ class DeviceDB:
             db, k = self.db, self.candidate_k
             meta, _ = self._ensure_layout()
 
+            # jit-captures: db, meta, k, full (same contract as the
+            # fused kernel: metadata and scalars only)
             def kernel_b(kc, arrays, streams, lengths, status, cnt,
                          overflow):
                 streams = ensure_all_stream(streams, lengths)
@@ -586,14 +595,17 @@ class DeviceDB:
         budget = self._budget()
         m = _device_metrics()
 
+        # requires-lock: _counter_lock (invoked via _spied_launch)
         def launch():
             cnt, overflow, nmax = fa(arrays, s_j, l_j)
             # the ONE host sync between phases: a scalar read that
             # sizes phase B to live work instead of worst-case budget
-            kc = fpc.survivor_bucket(int(nmax), budget)
+            # host-sync-ok: the blessed 4-byte phase-A survivor scalar
+            n_live = int(nmax)
+            kc = fpc.survivor_bucket(n_live, budget)
             out = fb(kc, arrays, s_j, l_j, st_j, cnt, overflow)
             self.last_compact = {
-                "survivor_max": int(nmax),
+                "survivor_max": n_live,
                 "verify_k": kc,
                 "budget": budget,
             }
@@ -653,7 +665,7 @@ class DeviceDB:
         budget = global_candidate_budget(k, len(meta.table_stream))
 
         @jax.jit
-        def f_pre(arrays, streams, lengths):
+        def f_pre(arrays, streams, lengths):  # jit-captures: meta, budget
             streams = ensure_all_stream(streams, lengths)
             ctx = _StreamCtx(streams, lengths)
             cnt, _cs = prefilter_counts(meta, arrays["tab"], ctx)
@@ -662,7 +674,7 @@ class DeviceDB:
             return cnt, n_surv > K, jnp.max(jnp.minimum(n_surv, K))
 
         @_functools.partial(jax.jit, static_argnums=(1,))
-        def f_compact(cnt, kc):
+        def f_compact(cnt, kc):  # jit-captures: budget
             K = max(1, min(budget, cnt.shape[1]))
             return compact_candidates(cnt, kc, K)
 
@@ -672,6 +684,7 @@ class DeviceDB:
         col_starts = _col_starts_of(meta, s_full)
 
         def make_verify(byte_verify):
+            # jit-captures: meta, col_starts, ns, byte_verify
             @jax.jit
             def f_ver(arrays, streams, lengths, col):
                 streams = ensure_all_stream(streams, lengths)
@@ -690,7 +703,7 @@ class DeviceDB:
             return f_ver
 
         @jax.jit
-        def f_tiny(arrays, streams, lengths, vbits):
+        def f_tiny(arrays, streams, lengths, vbits):  # jit-captures: meta
             streams = ensure_all_stream(streams, lengths)
             ctx = _StreamCtx(streams, lengths)
             return tiny_slot_bits(
@@ -698,7 +711,7 @@ class DeviceDB:
             )
 
         @jax.jit
-        def f_rx(arrays, streams, lengths, vbits):
+        def f_rx(arrays, streams, lengths, vbits):  # jit-captures: db
             from swarm_tpu.ops.regexdev import regex_verify
 
             streams = ensure_all_stream(streams, lengths)
@@ -708,6 +721,7 @@ class DeviceDB:
                 k_pairs=db.rx_k_pairs(B), arrays=arrays["rx"],
             )
 
+        # jit-captures: db, meta
         @jax.jit
         def f_verdict(arrays, streams, lengths, status, vbits, ubits, rx):
             streams = ensure_all_stream(streams, lengths)
@@ -731,6 +745,8 @@ class DeviceDB:
                 f_pre, arrays, s_j, l_j
             )
             kc = fpc.survivor_bucket(int(nmax), budget)
+            # unguarded-ok: profile_phases is an offline single-threaded
+            # attribution path (never races dispatch)
             self.last_compact = {
                 "survivor_max": int(nmax), "verify_k": kc, "budget": budget,
             }

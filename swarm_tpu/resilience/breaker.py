@@ -16,7 +16,7 @@ import weakref
 
 from swarm_tpu.telemetry import REGISTRY
 
-_BOARD_LOCK = threading.Lock()
+_BOARD_LOCK = threading.Lock()  # guards: _BOARD (reads)
 # name → live instances: several objects may legitimately share a name
 # (two workers' transport boards, two engines with the same batch
 # shape) — the board must not let the last registration shadow an open
@@ -78,7 +78,7 @@ class CircuitBreaker:
         self.threshold = max(1, int(threshold))
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _failures (reads), _state (reads), _opened_at (reads), _probe_out (reads)
         self._failures = 0
         self._state = self.CLOSED
         self._opened_at = 0.0
@@ -93,7 +93,7 @@ class CircuitBreaker:
             self._maybe_half_open()
             return self._state
 
-    def _maybe_half_open(self) -> None:
+    def _maybe_half_open(self) -> None:  # requires-lock: _lock
         if (
             self._state == self.OPEN
             and self._clock() - self._opened_at >= self.cooldown_s
@@ -101,7 +101,7 @@ class CircuitBreaker:
             self._transition(self.HALF_OPEN)
             self._probe_out = False
 
-    def _transition(self, state: str) -> None:
+    def _transition(self, state: str) -> None:  # requires-lock: _lock
         if state == self._state:
             return
         self._state = state
@@ -145,7 +145,7 @@ class BreakerBoard:
         self.prefix = prefix
         self.threshold = threshold
         self.cooldown_s = cooldown_s
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _breakers (reads)
         self._breakers: dict[str, CircuitBreaker] = {}
 
     def get(self, key: str) -> CircuitBreaker:

@@ -124,7 +124,7 @@ class FaultPlan:
         self.spec = spec
         self.seed = 0
         self._clauses: list[_Clause] = []
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _rng (reads)
         for raw in spec.split(";"):
             raw = raw.strip()
             if not raw:
@@ -181,7 +181,10 @@ class FaultPlan:
 # ---------------------------------------------------------------------------
 
 _UNSET = object()
-_active = _UNSET
+# writes only: the fault_point()/active_plan() fast path reads _active
+# lock-free by design (one global load; a stale read costs one extra
+# _resolve_env round, never a wrong verdict)
+_active = _UNSET  # guarded-by: _state_lock
 _state_lock = threading.Lock()
 
 

@@ -78,7 +78,7 @@ class RetryingServerClient:
             threshold=breaker_threshold,
             cooldown_s=breaker_cooldown_s,
         )
-        self._rng = random.Random(seed)
+        self._rng = random.Random(seed)  # guarded-by: _rng_lock (reads)
         self._rng_lock = threading.Lock()
         self._sleep = sleep
 

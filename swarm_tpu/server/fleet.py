@@ -34,7 +34,7 @@ class RateLimiter:
 
     def __init__(self, per_minute: int):
         self.per_minute = max(1, per_minute)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _stamps (reads)
         self._stamps: list[float] = []
 
     def acquire(self) -> None:
@@ -86,7 +86,7 @@ class ProcessProvider(FleetProvider):
     def __init__(self, cfg, extra_args: Optional[list[str]] = None):
         self.cfg = cfg
         self.extra_args = extra_args or []
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _procs (reads)
         self._procs: dict[str, subprocess.Popen] = {}
 
     def spin_up(self, prefix, nodes):

@@ -94,6 +94,13 @@ class JobQueueService:
         self.docs = docs
         self.fleet = fleet
         self._lock = threading.Lock()
+        # generation/cache get their OWN lock: _put_job runs inside
+        # `with self._lock` on some paths (PR 4 made dispatch/update
+        # atomic) and bare on others — a second small lock avoids both
+        # the deadlock and the lost-increment race between request
+        # threads (a lost bump could serve a stale by-state cache for
+        # a full TTL after a real transition)
+        self._gen_lock = threading.Lock()  # guards: _jobs_generation, _by_state_cache
         self._jobs_generation = 0
         self._by_state_cache: tuple[float, int, dict[str, int]] = (0.0, -1, {})
 
@@ -116,9 +123,14 @@ class JobQueueService:
         """Status → count over every job record (probe-storm-cached)."""
         now = time.monotonic()
         cached_at, gen, counts = self._by_state_cache
-        if gen == self._jobs_generation and now - cached_at < self.BY_STATE_TTL_S:
+        with self._gen_lock:
+            fresh = (
+                gen == self._jobs_generation
+                and now - cached_at < self.BY_STATE_TTL_S
+            )
+            gen = self._jobs_generation
+        if fresh:
             return dict(counts)
-        gen = self._jobs_generation
         counts = {}
         for _job_id, raw in self.state.hgetall("jobs").items():
             try:
@@ -126,7 +138,8 @@ class JobQueueService:
             except ValueError:
                 status = "unparseable"
             counts[status] = counts.get(status, 0) + 1
-        self._by_state_cache = (now, gen, counts)
+        with self._gen_lock:
+            self._by_state_cache = (now, gen, counts)
         return dict(counts)
 
     # ------------------------------------------------------------------
@@ -169,7 +182,8 @@ class JobQueueService:
 
     def _put_job(self, job: Job) -> None:
         self.state.hset("jobs", job.job_id, job.to_json())
-        self._jobs_generation += 1
+        with self._gen_lock:
+            self._jobs_generation += 1
 
     def _get_job_record(self, job_id: str) -> Optional[Job]:
         raw = self.state.hget("jobs", job_id)
@@ -606,4 +620,5 @@ class JobQueueService:
     def reset(self) -> None:
         """Flush all queue/scan state (reference /reset, server.py:550-554)."""
         self.state.flushall()
-        self._jobs_generation += 1
+        with self._gen_lock:
+            self._jobs_generation += 1

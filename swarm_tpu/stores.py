@@ -74,7 +74,7 @@ class MemoryStateStore(StateStore):
     """Embedded thread-safe state store (hashes + lists)."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()  # guards: _hashes (reads), _lists (reads)
         self._hashes: dict[str, dict[str, str]] = {}
         self._lists: dict[str, deque[str]] = {}
 
@@ -267,7 +267,7 @@ class MemoryBlobStore(BlobStore):
     """In-memory blob store for tests."""
 
     def __init__(self) -> None:
-        self._blobs: dict[str, bytes] = {}
+        self._blobs: dict[str, bytes] = {}  # guarded-by: _lock (reads)
         self._lock = threading.Lock()
 
     def put(self, key, data):
@@ -357,7 +357,7 @@ class DocStore:
 
 class _MemoryCollection(DocCollection):
     def __init__(self) -> None:
-        self._docs: list[dict] = []
+        self._docs: list[dict] = []  # guarded-by: _lock (reads)
         self._lock = threading.Lock()
 
     @staticmethod
@@ -382,7 +382,7 @@ class _MemoryCollection(DocCollection):
 
 class MemoryDocStore(DocStore):
     def __init__(self) -> None:
-        self._collections: dict[str, _MemoryCollection] = {}
+        self._collections: dict[str, _MemoryCollection] = {}  # guarded-by: _lock (reads)
         self._lock = threading.Lock()
 
     def collection(self, name):
@@ -432,7 +432,7 @@ class LocalDocStore(DocStore):
     def __init__(self, root: str | Path) -> None:
         self._root = Path(root)
         self._lock = threading.Lock()
-        self._collections: dict[str, _JsonlCollection] = {}
+        self._collections: dict[str, _JsonlCollection] = {}  # guarded-by: _lock (reads)
 
     def collection(self, name):
         safe = name.replace("/", "_")

@@ -21,8 +21,8 @@ import weakref
 from swarm_tpu.telemetry.metrics import REGISTRY
 
 _lock = threading.Lock()
-_engines: "weakref.WeakSet" = weakref.WeakSet()
-_collector_added = False
+_engines: "weakref.WeakSet" = weakref.WeakSet()  # guarded-by: _lock (reads)
+_collector_added = False  # guarded-by: _lock (reads)
 
 _G = {}
 

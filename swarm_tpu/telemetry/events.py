@@ -54,7 +54,7 @@ TRACE_ID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
 ENV_SINK = "SWARM_EVENTS"
 
 _lock = threading.Lock()
-_subscribers: list[Callable[[dict], None]] = []
+_subscribers: list[Callable[[dict], None]] = []  # guarded-by: _lock (reads)
 
 _EVENTS_TOTAL = _metrics.REGISTRY.counter(
     "swarm_events_total", "Structured telemetry events emitted", ("event",)

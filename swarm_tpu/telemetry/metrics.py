@@ -88,7 +88,9 @@ class _Metric:
         self.help = help_text
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
-        self._data: dict[tuple, object] = {}
+        # writes only — the unlabeled () child is created here and read
+        # lock-free (the key is never removed)
+        self._data: dict[tuple, object] = {}  # guarded-by: _lock
         if not self.labelnames:
             self._data[()] = self._new_child()
 
@@ -338,7 +340,7 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()  # guards: _metrics (reads), _collectors (reads)
         self._metrics: dict[str, _Metric] = {}
         self._collectors: list[Callable[[], None]] = []
 

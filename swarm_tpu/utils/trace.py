@@ -33,7 +33,7 @@ class PhaseTimer:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: seconds (reads), counters (reads)
         self.seconds: dict[str, float] = {}
         self.counters: dict[str, float] = {}
 

@@ -81,7 +81,7 @@ class OOBListener:
         self.answer_ip = answer_ip or (
             advertise_host if _is_ipv4(advertise_host) else "127.0.0.1"
         )
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _interactions (reads)
         self._interactions: dict[bytes, list[Interaction]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._dns_sock: Optional[socket.socket] = None
@@ -283,7 +283,7 @@ class OOBListener:
 # distinct OOB config serves every scanner that asks for it (tokens are
 # minted per probe, so sharing cannot cross-correlate scans).
 
-_SHARED: dict = {}
+_SHARED: dict = {}  # guarded-by: _SHARED_LOCK (reads)
 _SHARED_LOCK = threading.Lock()
 
 

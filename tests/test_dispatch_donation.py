@@ -246,3 +246,31 @@ def test_compile_spy_atomic_under_two_threads(corpus):
         "every dispatch recompiles under the zero bound and each must "
         "be counted exactly once"
     )
+
+
+def test_compile_spy_invariant_is_declared_to_the_analyzer():
+    """The runtime atomicity test above and swarmlint's guards pass pin
+    the SAME invariant from two sides: the test catches a lost update
+    on the paths it exercises, the static pass (docs/ANALYSIS.md)
+    polices every write site — including ones added after this test was
+    written. So the ``_counter_lock``-guarded fields must carry their
+    guard annotations, and the module must be clean under the pass."""
+    from pathlib import Path
+
+    from tools.swarmlint import guards
+
+    src = Path(__file__).resolve().parents[1] / "swarm_tpu/ops/match.py"
+    declared = guards.guarded_paths(src)
+    for field in (
+        "compile_seconds", "compile_count", "last_compact", "_fn_cache",
+    ):
+        assert declared.get(("DeviceDB", field)) == "_counter_lock", (
+            f"DeviceDB.{field} lost its '# guarded-by: _counter_lock' "
+            f"annotation — the static pass no longer pins the compile-"
+            f"spy atomicity this file's runtime test asserts"
+        )
+    # the staging accounting rides the same threading shape
+    assert declared.get(("_StagingPool", "uploads")) == "_lock"
+    assert declared.get(("_StagingPool", "bytes")) == "_lock"
+    findings, _mg = guards.check_file(src)
+    assert findings == [], [f.render() for f in findings]

@@ -1,0 +1,2 @@
+# Marks tools/ as a package so `python -m tools.swarmlint` works from
+# the repo root regardless of namespace-package resolution order.

@@ -7,13 +7,19 @@ Run by tools/preflight.sh; exits nonzero on:
 - /metrics unreachable or non-200
 - any line that is not valid Prometheus text format 0.0.4
 - a missing core metric family (server/queue/event planes)
+- docs/OBSERVABILITY.md drift, in EITHER direction: a family the code
+  registers that the doc never mentions, or a family the doc mentions
+  that no code registers (both fail preflight exactly like a missing
+  family does — the doc is part of the telemetry contract)
 """
 
 from __future__ import annotations
 
 import os
+import re
 import sys
 import tempfile
+from pathlib import Path
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -49,8 +55,79 @@ REQUIRED_FAMILIES = (
 )
 
 
+REPO = Path(__file__).resolve().parents[1]
+OBSERVABILITY_MD = REPO / "docs" / "OBSERVABILITY.md"
+
+#: swarm_-prefixed string literals in the tree that are NOT metric
+#: families (module paths etc.) — keep tiny; growing it means a name
+#: collided with the family namespace and should probably be renamed
+NOT_FAMILIES = {"swarm_tpu"}
+
+_FAMILY_RE = re.compile(r"swarm_[a-z0-9_]+[a-z0-9]")
+_LITERAL_RE = re.compile(r"\"(swarm_[a-z0-9_]+[a-z0-9])\"")
+
+
+def code_families() -> set[str]:
+    """Every metric family the code can register, including the lazy
+    ones (ops/match.py's compile-time counters only exist in processes
+    that dispatch): all swarm_-prefixed double-quoted literals in
+    swarm_tpu/ — family names are always literal at their registration
+    site, and nothing else in the package quotes a swarm_[a-z_]* string
+    (module paths are dotted, env vars upper-case)."""
+    out: set[str] = set()
+    for p in (REPO / "swarm_tpu").rglob("*.py"):
+        if "__pycache__" in p.parts:
+            continue
+        for m in _LITERAL_RE.finditer(p.read_text()):
+            name = m.group(1)
+            if name not in NOT_FAMILIES:
+                out.add(name)
+    return out
+
+
+def doc_families() -> set[str]:
+    """Every family OBSERVABILITY.md mentions (prose or table;
+    `{label}` suffixes stripped by the token regex)."""
+    text = OBSERVABILITY_MD.read_text()
+    return {
+        m.group(0)
+        for m in _FAMILY_RE.finditer(text)
+        if m.group(0) not in NOT_FAMILIES
+    }
+
+
+def check_doc_drift() -> "tuple[list[str], int]":
+    """Both directions of code↔doc drift; returns (failure messages,
+    number of families found in code)."""
+    in_code = code_families()
+    in_doc = doc_families()
+    problems = []
+    undocumented = sorted(in_code - in_doc)
+    if undocumented:
+        problems.append(
+            "families registered in code but absent from "
+            f"docs/OBSERVABILITY.md: {undocumented}"
+        )
+    phantom = sorted(in_doc - in_code)
+    if phantom:
+        problems.append(
+            "families documented in docs/OBSERVABILITY.md but not "
+            f"registered anywhere in swarm_tpu/: {phantom}"
+        )
+    return problems, len(in_code)
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    drift, n_code = check_doc_drift()
+    if drift:
+        for p in drift:
+            print(f"FAIL: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"doc cross-check OK: {n_code} families in code "
+        f"all documented; no phantom doc entries"
+    )
     import requests
 
     from swarm_tpu.config import Config

@@ -8,6 +8,17 @@
 set -e
 cd "$(dirname "$0")/.."
 
+echo "== preflight: swarmlint (static analysis, docs/ANALYSIS.md) =="
+# three passes — lock discipline, jit hygiene, native audit — diffed
+# against the justified-suppressions baseline; any NEW finding fails
+python -m tools.swarmlint
+
+echo "== preflight: ASan/UBSan native audit (docs/ANALYSIS.md) =="
+# rebuild the three .so under ASan+UBSan and rerun the native-pass
+# equivalence tests against them; SWARM_SANITIZE_SKIP=1 skips LOUDLY
+# on hosts without compiler/libasan support
+sh tools/sanitize_natives.sh
+
 echo "== preflight: pytest =="
 # test_sched.py runs in its own dedicated step below — not twice
 python -m pytest tests/ -q --ignore=tests/test_sched.py

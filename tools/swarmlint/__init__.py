@@ -1,0 +1,24 @@
+"""swarmlint — repo-specific static analysis (docs/ANALYSIS.md).
+
+Three passes, one entry point (``python -m tools.swarmlint``):
+
+- ``guards``      lock-discipline checker over the guard-annotation
+                  convention (every annotated shared field's writes —
+                  and declared reads — sit under its lock)
+- ``jithygiene``  JAX trace/dispatch hygiene over the device modules
+                  (undeclared closure captures, donated-buffer
+                  use-after-dispatch, unblessed host syncs)
+- ``native_audit``lexical CPython-API audit over native/*.cpp
+                  (GIL-released PyObject use, unchecked failable
+                  returns)
+
+Findings diff against ``tools/swarmlint/baseline.json`` — only new
+violations fail; every baselined one needs a written reason.
+"""
+
+from tools.swarmlint.common import (  # noqa: F401
+    Baseline,
+    DiffResult,
+    Finding,
+    diff_against_baseline,
+)

@@ -1,0 +1,193 @@
+"""swarmlint entry point — run all three passes, diff against the
+baseline, exit non-zero on any NEW finding (docs/ANALYSIS.md).
+
+    python -m tools.swarmlint                 # full run (preflight step)
+    python -m tools.swarmlint --json          # machine-readable findings
+    python -m tools.swarmlint --no-baseline   # raw findings, no diff
+    python -m tools.swarmlint --update-baseline
+        # rewrite baseline.json from the current findings; existing
+        # reasons are preserved, new entries get reason "" which the
+        # next plain run REJECTS until a human writes one
+
+Pass-scoping for tests / spot checks:
+
+    python -m tools.swarmlint --pass guards --paths swarm_tpu/stores.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+# Allow running as `python tools/swarmlint/__main__.py` too
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from tools.swarmlint import guards, jithygiene, native_audit  # noqa: E402
+from tools.swarmlint.common import (  # noqa: E402
+    BASELINE_PATH,
+    REPO_ROOT,
+    Baseline,
+    Finding,
+    diff_against_baseline,
+)
+
+PASSES = ("guards", "jit", "native")
+
+
+def default_paths(which: str) -> list[Path]:
+    if which == "guards":
+        return [
+            p
+            for p in (REPO_ROOT / "swarm_tpu").rglob("*.py")
+            if "__pycache__" not in p.parts
+        ]
+    if which == "jit":
+        return [
+            REPO_ROOT / t
+            for t in jithygiene.DEFAULT_TARGETS
+            if (REPO_ROOT / t).exists()
+        ]
+    if which == "native":
+        return sorted((REPO_ROOT / "native").glob("*.cpp"))
+    raise ValueError(which)
+
+
+def collect(passes, paths_override=None) -> list[Finding]:
+    findings: list[Finding] = []
+    for which in passes:
+        paths = (
+            [Path(p) for p in paths_override]
+            if paths_override
+            else default_paths(which)
+        )
+        if which == "guards":
+            findings.extend(guards.run(paths))
+        elif which == "jit":
+            findings.extend(jithygiene.run(paths))
+        elif which == "native":
+            findings.extend(native_audit.run(paths))
+    # nested defs are reachable from several enclosing walks (e.g. a
+    # jitted def inside a factory inside a method) — report each site once
+    seen: set[tuple] = set()
+    unique: list[Finding] = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.detail)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="swarmlint")
+    ap.add_argument(
+        "--pass", dest="passes", action="append", choices=PASSES,
+        help="run only this pass (repeatable; default: all three)",
+    )
+    ap.add_argument(
+        "--paths", nargs="+",
+        help="override the scanned files (use with --pass)",
+    )
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="report raw findings without the baseline diff",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite baseline.json from current findings (reasons "
+        "preserved; new entries need a human-written reason before "
+        "the next run passes)",
+    )
+    ap.add_argument(
+        "--baseline", type=Path, default=BASELINE_PATH,
+        help="alternate baseline file (tests exercise the workflow "
+        "against a temp file; the preflight run uses the default)",
+    )
+    args = ap.parse_args(argv)
+    passes = args.passes or list(PASSES)
+
+    findings = collect(passes, args.paths)
+
+    if args.update_baseline:
+        old = Baseline.load(args.baseline)
+        bl = Baseline()
+        for f in findings:
+            prev = old.entries.get(f.fingerprint, {})
+            bl.entries[f.fingerprint] = {
+                "fingerprint": f.fingerprint,
+                "rule": f.rule,
+                "location": f"{f.path}:{f.symbol or '<module>'}",
+                "message": f.message,
+                "reason": prev.get("reason", ""),
+            }
+        bl.save(args.baseline)
+        print(
+            f"swarmlint: baseline rewritten with {len(bl.entries)} "
+            f"entries -> {args.baseline}"
+        )
+        blank = [
+            e for e in bl.entries.values() if not e["reason"].strip()
+        ]
+        if blank:
+            print(
+                f"swarmlint: {len(blank)} entries need a written "
+                f"reason before the next run passes:"
+            )
+            for e in blank:
+                print(f"  {e['fingerprint']}  {e['location']}")
+        return 0
+
+    if args.no_baseline:
+        for f in findings:
+            print(f.render())
+        if args.json:
+            print(json.dumps([f.__dict__ for f in findings], indent=2))
+        return 1 if findings else 0
+
+    res = diff_against_baseline(findings, Baseline.load(args.baseline))
+    if args.json:
+        print(json.dumps({
+            "new": [f.__dict__ for f in res.new],
+            "suppressed": len(res.suppressed),
+            "unjustified": res.unjustified,
+            "stale": res.stale,
+        }, indent=2))
+    if res.new:
+        print(
+            f"swarmlint: {len(res.new)} NEW finding(s) "
+            f"(not in baseline.json):", file=sys.stderr,
+        )
+        for f in res.new:
+            print("  " + f.render(), file=sys.stderr)
+    if res.unjustified:
+        print(
+            f"swarmlint: {len(res.unjustified)} baselined finding(s) "
+            f"have no written reason:", file=sys.stderr,
+        )
+        for e in res.unjustified:
+            print(
+                f"  {e['fingerprint']}  {e.get('location', '?')}",
+                file=sys.stderr,
+            )
+    if res.stale:
+        print(
+            f"swarmlint: note: {len(res.stale)} stale baseline "
+            f"entr{'y' if len(res.stale) == 1 else 'ies'} no longer "
+            f"fire (run --update-baseline to prune):"
+        )
+        for e in res.stale:
+            print(f"  {e['fingerprint']}  {e.get('location', '?')}")
+    if res.ok:
+        print(
+            f"swarmlint OK: {len(res.suppressed)} baselined, "
+            f"0 new findings across passes: {', '.join(passes)}"
+        )
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,184 @@
+"""Shared plumbing for the swarmlint passes (docs/ANALYSIS.md).
+
+A *finding* is one violation of a checked invariant. Findings carry a
+stable ``fingerprint`` (file + rule + enclosing symbol + detail — NO
+line numbers, so ordinary edits above a baselined site don't churn the
+baseline) and diff against ``tools/swarmlint/baseline.json``: only NEW
+findings fail the run; every baselined finding must carry a written
+reason (an empty reason is itself an error — "baselined because it was
+there" is not a justification).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # e.g. "guard-write", "jit-capture", "gil-pyapi"
+    path: str          # repo-relative posix path
+    line: int          # 1-based (display only — not fingerprinted)
+    symbol: str        # enclosing class.func / function, "" at top level
+    message: str       # human sentence naming the violated invariant
+    detail: str = ""   # stable discriminator (attr/lock/API name…)
+
+    @property
+    def fingerprint(self) -> str:
+        raw = "|".join((self.rule, self.path, self.symbol, self.detail))
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return (
+            f"{self.path}:{self.line}: {self.rule}{sym}: {self.message} "
+            f"(fingerprint {self.fingerprint})"
+        )
+
+
+def rel(path: Path | str) -> str:
+    p = Path(path).resolve()
+    try:
+        return p.relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+# ---------------------------------------------------------------------------
+# Comment harvesting (the annotation conventions ride comments)
+# ---------------------------------------------------------------------------
+
+class CommentMap(dict):
+    """line number -> comment text, plus the set of comment-ONLY lines
+    (``only``) so annotation lookups can walk a leading comment block
+    without absorbing a trailing comment that belongs to other code."""
+
+    def __init__(self):
+        super().__init__()
+        self.only: set[int] = set()
+
+
+def comment_map(source: str) -> CommentMap:
+    out = CommentMap()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string.lstrip("#").strip()
+    except (tokenize.TokenizeError, IndentationError, SyntaxError):
+        pass
+    for i, line in enumerate(source.splitlines(), 1):
+        if line.lstrip().startswith("#"):
+            out.only.add(i)
+    return out
+
+
+def annotation_on(
+    comments: dict[int, str], line: int, tag: str
+) -> Optional[str]:
+    """Return the payload of ``# <tag>: ...`` attached to ``line`` —
+    trailing on the line itself or anywhere in the contiguous
+    comment-ONLY block directly above it. Returns None when absent,
+    "" when present but empty. The payload must fit on the tagged
+    comment line (a parenthetical may spill over — parsers strip from
+    the first '(')."""
+    only = getattr(comments, "only", set())
+    candidates = [line]
+    ln = line - 1
+    while ln in only:
+        candidates.append(ln)
+        ln -= 1
+    for ln in candidates:
+        text = comments.get(ln)
+        if text is None:
+            continue
+        # allow several tags on one comment, '；'-free: split on ';'
+        for part in text.split(";"):
+            part = part.strip()
+            if part.startswith(tag + ":"):
+                return part[len(tag) + 1 :].strip()
+            if part == tag:
+                return ""
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Baseline:
+    entries: dict[str, dict] = field(default_factory=dict)  # fp -> entry
+
+    @classmethod
+    def load(cls, path: Path = BASELINE_PATH) -> "Baseline":
+        if not path.exists():
+            return cls()
+        data = json.loads(path.read_text())
+        entries = {}
+        for e in data.get("findings", []):
+            entries[e["fingerprint"]] = e
+        return cls(entries)
+
+    def save(self, path: Path = BASELINE_PATH) -> None:
+        payload = {
+            "_comment": (
+                "swarmlint suppression baseline (docs/ANALYSIS.md): only "
+                "findings NOT listed here fail the run. Every entry needs "
+                "a non-empty reason."
+            ),
+            "findings": sorted(
+                self.entries.values(), key=lambda e: e["fingerprint"]
+            ),
+        }
+        path.write_text(json.dumps(payload, indent=2) + "\n")
+
+
+@dataclass
+class DiffResult:
+    new: list[Finding]
+    suppressed: list[Finding]
+    unjustified: list[dict]   # baselined hits whose reason is empty
+    stale: list[dict]         # baseline entries that no longer fire
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.unjustified
+
+
+def diff_against_baseline(
+    findings: Iterable[Finding], baseline: Baseline
+) -> DiffResult:
+    new: list[Finding] = []
+    suppressed: list[Finding] = []
+    unjustified: list[dict] = []
+    seen: set[str] = set()
+    for f in findings:
+        seen.add(f.fingerprint)
+        entry = baseline.entries.get(f.fingerprint)
+        if entry is None:
+            new.append(f)
+        else:
+            if not str(entry.get("reason", "")).strip():
+                unjustified.append(entry)
+            suppressed.append(f)
+    stale = [
+        e for fp, e in baseline.entries.items() if fp not in seen
+    ]
+    return DiffResult(new, suppressed, unjustified, stale)
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    return raw not in ("0", "off", "false", "no")

@@ -1,0 +1,500 @@
+"""Lock-discipline checker (docs/ANALYSIS.md §guard annotations).
+
+Annotation grammar (comments, trailing on the line or on the comment
+line directly above it):
+
+``# guarded-by: <lock>`` on an attribute/global initialization line —
+    every WRITE to that attribute anywhere in the module must happen
+    while ``<lock>`` is held. Suffix ``(reads)`` extends the contract
+    to read sites.
+
+``# guards: a, b.c (reads), d`` on the lock's own init line — the list
+    form, equivalent to a guarded-by on each named dotted path. This is
+    the only way to guard a path whose initialization the lock owner
+    doesn't write (e.g. dataclass-default stats fields:
+    ``self._stats_lock = threading.Lock()  # guards: stats.device_seconds``).
+
+``# requires-lock: <lock>`` on a ``def`` line — the body is analyzed
+    as if ``<lock>`` were held (the documented caller contract). Direct
+    ``self.method()`` / bare-name calls to a requires-lock function are
+    themselves checked: they must occur while the lock is held.
+
+``# unguarded-ok: <reason>`` on a site line — waives that one site
+    (reason mandatory; an empty reason is a finding).
+
+Semantics and limits (deliberate, documented):
+- "held" is lexical: the site sits inside a ``with <expr>:`` whose
+  terminal name equals the lock name (``with self._lock`` holds
+  ``_lock``; ``with _BOARD_LOCK`` holds ``_BOARD_LOCK``). Lock identity
+  is BY NAME within a module — two same-named locks on different
+  objects are indistinguishable to this pass.
+- Function boundaries reset the held set: a closure defined inside a
+  ``with`` block runs later, NOT under the lock. ``requires-lock``
+  is the escape hatch for helpers invoked under a caller's lock.
+- ``__init__`` / ``__new__`` / ``__post_init__`` bodies are exempt
+  (construction precedes publication), as are module-level statements
+  (import time is single-threaded).
+- Writes are: assignment / augmented / annotated-assignment / del of
+  the exact dotted path, subscript stores through it
+  (``self._jobs[k] = v``), and calls to known mutator methods on it
+  (``self._subs.append(x)``). Reads (when declared) are any other
+  Load of the path.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from tools.swarmlint.common import (
+    Finding,
+    annotation_on,
+    comment_map,
+    rel,
+)
+
+RULE_WRITE = "guard-write"
+RULE_READ = "guard-read"
+RULE_CALL = "guard-call"
+RULE_CONFIG = "guard-config"
+
+#: method names that mutate the common containers in place
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "appendix", "rotate",
+}
+
+INIT_METHODS = {"__init__", "__new__", "__post_init__", "__set_name__"}
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def _dotted_path(node: ast.AST) -> Optional[tuple[str, ...]]:
+    """Name/Attribute chain -> path tuple. ``self.a.b`` -> ("self","a","b");
+    ``x`` -> ("x",). None for anything else (calls, subscripts...)."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """The name a ``with`` subject 'holds': terminal attribute or bare
+    name. Calls (``with open(f)``) hold nothing."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+@dataclass
+class GuardSpec:
+    lock: str
+    reads: bool
+    cls: Optional[str]          # owning class name, None = module level
+    path: tuple[str, ...]       # attr path SANS the self/cls root
+    decl_line: int
+
+
+@dataclass
+class ModuleGuards:
+    path: Path
+    specs: list[GuardSpec] = field(default_factory=list)
+    lock_names: set[str] = field(default_factory=set)
+    #: (class or None, func name) -> lock required by annotation
+    requires: dict[tuple[Optional[str], str], str] = field(
+        default_factory=dict
+    )
+
+
+def _parse_guard_list(payload: str) -> list[tuple[tuple[str, ...], bool]]:
+    """'a, b.c (reads), d' -> [(('a',),False), (('b','c'),True), ...]"""
+    out = []
+    for item in payload.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        reads = False
+        if item.endswith("(reads)"):
+            reads = True
+            item = item[: -len("(reads)")].strip()
+        out.append((tuple(item.split(".")), reads))
+    return out
+
+
+def _collect(tree: ast.Module, comments: dict[int, str], path: Path,
+             findings: list[Finding]) -> ModuleGuards:
+    """First walk: harvest lock declarations + annotations."""
+    mg = ModuleGuards(path)
+    rp = rel(path)
+
+    class Collector(ast.NodeVisitor):
+        def __init__(self):
+            self.cls: Optional[str] = None
+
+        def visit_ClassDef(self, node: ast.ClassDef):
+            prev, self.cls = self.cls, node.name
+            self.generic_visit(node)
+            self.cls = prev
+
+        def _handle_assign(self, node, targets, line):
+            # lock declarations: X = threading.Lock() (any factory)
+            value = getattr(node, "value", None)
+            is_lock = (
+                isinstance(value, ast.Call)
+                and _terminal_name(value.func) in LOCK_FACTORIES
+            )
+            names = [
+                p for p in (_dotted_path(t) for t in targets) if p
+            ]
+            if is_lock:
+                for p in names:
+                    mg.lock_names.add(p[-1])
+            # guards: list form on the lock line
+            payload = annotation_on(comments, line, "guards")
+            if payload is not None:
+                if not is_lock or not names:
+                    findings.append(Finding(
+                        RULE_CONFIG, rp, line, self.cls or "",
+                        "'# guards:' must annotate a lock assignment",
+                        detail=f"guards@{payload[:40]}",
+                    ))
+                else:
+                    lock = names[0][-1]
+                    for gpath, reads in _parse_guard_list(payload):
+                        mg.specs.append(GuardSpec(
+                            lock, reads, self.cls
+                            if names[0][0] in ("self", "cls") else None,
+                            gpath, line,
+                        ))
+            # guarded-by: on an attribute/global init line
+            payload = annotation_on(comments, line, "guarded-by")
+            if payload is not None:
+                reads = False
+                if payload.endswith("(reads)"):
+                    reads = True
+                    payload = payload[: -len("(reads)")].strip()
+                if not payload:
+                    findings.append(Finding(
+                        RULE_CONFIG, rp, line, self.cls or "",
+                        "'# guarded-by:' needs a lock name",
+                    ))
+                for p in names:
+                    if p[0] in ("self", "cls"):
+                        mg.specs.append(GuardSpec(
+                            payload, reads, self.cls, p[1:], line
+                        ))
+                    else:
+                        mg.specs.append(GuardSpec(
+                            payload, reads, None, p, line
+                        ))
+
+        def visit_Assign(self, node: ast.Assign):
+            self._handle_assign(node, node.targets, node.lineno)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node: ast.AnnAssign):
+            self._handle_assign(node, [node.target], node.lineno)
+            self.generic_visit(node)
+
+        def _handle_def(self, node):
+            payload = annotation_on(comments, node.lineno, "requires-lock")
+            if payload:
+                # lock name only — an explanatory parenthetical may follow
+                payload = payload.split("(")[0].strip()
+                mg.requires[(self.cls, node.name)] = payload
+            prev, self.cls = self.cls, self.cls  # defs don't change class
+            self.generic_visit(node)
+            self.cls = prev
+
+        visit_FunctionDef = _handle_def
+        visit_AsyncFunctionDef = _handle_def
+
+    Collector().visit(tree)
+    # unknown-lock sanity: every annotation must reference a lock that
+    # exists in this module (catches typos in the convention itself)
+    for spec in mg.specs:
+        if spec.lock not in mg.lock_names:
+            findings.append(Finding(
+                RULE_CONFIG, rp, spec.decl_line, spec.cls or "",
+                f"guard annotation references unknown lock "
+                f"{spec.lock!r} (no Lock()/RLock() assignment with "
+                f"that name in this module)",
+                detail=f"unknown-lock:{spec.lock}:{'.'.join(spec.path)}",
+            ))
+    for (cls, fn), lock in mg.requires.items():
+        if lock not in mg.lock_names:
+            findings.append(Finding(
+                RULE_CONFIG, rp, 1, f"{cls or ''}.{fn}".strip("."),
+                f"requires-lock references unknown lock {lock!r}",
+                detail=f"unknown-reqlock:{lock}",
+            ))
+    return mg
+
+
+class _SiteChecker(ast.NodeVisitor):
+    """Second walk: verify every write/declared-read/requires-call site."""
+
+    def __init__(self, mg: ModuleGuards, comments: dict[int, str],
+                 findings: list[Finding]):
+        self.mg = mg
+        self.comments = comments
+        self.findings = findings
+        self.rp = rel(mg.path)
+        self.cls: Optional[str] = None
+        self.func_stack: list[str] = []
+        self.held_stack: list[set[str]] = [set()]
+        # sites already reported as writes (don't re-flag the Load half
+        # of an AugAssign as a read)
+        self._claimed: set[int] = set()
+
+    # -- helpers ------------------------------------------------------
+    @property
+    def held(self) -> set[str]:
+        return self.held_stack[-1]
+
+    def _symbol(self) -> str:
+        parts = ([self.cls] if self.cls else []) + self.func_stack
+        return ".".join(parts)
+
+    def _in_init(self) -> bool:
+        # __init__ bodies AND module/class-level statements predate
+        # publication to other threads (imports are single-threaded).
+        # The exemption does NOT extend into defs/lambdas nested inside
+        # __init__ — a closure handed to threading.Thread/Timer in the
+        # constructor runs after publication, on another thread (same
+        # reset-at-function-boundary rule as the held set)
+        if not self.func_stack:
+            return True
+        return (
+            len(self.func_stack) == 1
+            and self.func_stack[0] in INIT_METHODS
+        )
+
+    def _waived(self, line: int) -> bool:
+        payload = annotation_on(self.comments, line, "unguarded-ok")
+        if payload is None:
+            return False
+        if not payload:
+            self.findings.append(Finding(
+                RULE_CONFIG, self.rp, line, self._symbol(),
+                "'# unguarded-ok:' needs a reason",
+            ))
+        return True
+
+    def _specs_for(self, node: ast.AST) -> list[GuardSpec]:
+        p = _dotted_path(node)
+        if not p:
+            return []
+        out = []
+        for spec in self.mg.specs:
+            if spec.cls is not None:
+                if (
+                    p[0] in ("self", "cls")
+                    and p[1:] == spec.path
+                    and self.cls == spec.cls
+                ):
+                    out.append(spec)
+            elif p == spec.path:
+                out.append(spec)
+        return out
+
+    def _check_write(self, node: ast.AST, line: int, kind: str):
+        for spec in self._specs_for(node):
+            if spec.lock in self.held or self._in_init():
+                continue
+            if self._waived(line):
+                continue
+            self.findings.append(Finding(
+                RULE_WRITE, self.rp, line, self._symbol(),
+                f"{kind} of {'.'.join(spec.path)} outside "
+                f"'with {spec.lock}'",
+                detail=f"{'.'.join(spec.path)}:{kind}:{self._symbol()}",
+            ))
+        self._claimed.add(id(node))
+
+    # -- scope / context ----------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef):
+        prev, self.cls = self.cls, node.name
+        prev_funcs, self.func_stack = self.func_stack, []
+        self.generic_visit(node)
+        self.cls, self.func_stack = prev, prev_funcs
+
+    def _visit_def(self, node):
+        self.func_stack.append(node.name)
+        req = self.mg.requires.get((self.cls, node.name))
+        self.held_stack.append({req} if req else set())
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held_stack.pop()
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self.held_stack.append(set())
+        self.generic_visit(node)
+        self.held_stack.pop()
+
+    def visit_With(self, node: ast.With):
+        added = set()
+        for item in node.items:
+            name = _terminal_name(item.context_expr)
+            if name:
+                added.add(name)
+            self.visit(item.context_expr)
+        self.held_stack.append(self.held | added)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- write sites ---------------------------------------------------
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._target_write(t, node.lineno)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        self._target_write(node.target, node.lineno)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._target_write(node.target, node.lineno, aug=True)
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            self._target_write(t, node.lineno, kind="del")
+        # no value to visit
+
+    def _target_write(self, target: ast.AST, line: int,
+                      aug: bool = False, kind: str = "write"):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._target_write(elt, line, aug=aug, kind=kind)
+            return
+        if isinstance(target, (ast.Subscript,)):
+            # self._jobs[k] = v  -> write through the container path
+            self._check_write(target.value, line, "subscript-store")
+            self.visit(target.slice)
+            return
+        if isinstance(target, (ast.Attribute, ast.Name)):
+            self._check_write(target, line, kind)
+            # the Load half of `self.x += 1` is covered by the write
+            if isinstance(target, ast.Attribute):
+                self._claimed.add(id(target.value))
+            return
+        self.visit(target)
+
+    def visit_Call(self, node: ast.Call):
+        # mutator method on a guarded path: self._subs.append(x)
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in MUTATORS
+        ):
+            specs = self._specs_for(func.value)
+            if specs:
+                self._check_write(func.value, node.lineno,
+                                  f"mutation ({func.attr})")
+        # requires-lock call-site check: self.m() / m()
+        callee: Optional[tuple[Optional[str], str]] = None
+        if isinstance(func, ast.Attribute):
+            root = _dotted_path(func)
+            if root and root[0] in ("self", "cls") and len(root) == 2:
+                callee = (self.cls, root[1])
+        elif isinstance(func, ast.Name):
+            callee = (None, func.id)
+        if callee is not None:
+            req = self.mg.requires.get(callee)
+            if (
+                req is not None
+                and req not in self.held
+                and not self._in_init()
+                and not self._waived(node.lineno)
+            ):
+                self.findings.append(Finding(
+                    RULE_CALL, self.rp, node.lineno, self._symbol(),
+                    f"call to {callee[1]}() which requires "
+                    f"'{req}' held",
+                    detail=f"call:{callee[1]}:{self._symbol()}",
+                ))
+        self.generic_visit(node)
+
+    # -- declared reads -----------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute):
+        self._maybe_read(node)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        self._maybe_read(node)
+
+    def _maybe_read(self, node: ast.AST):
+        if id(node) in self._claimed:
+            return
+        if not isinstance(getattr(node, "ctx", None), ast.Load):
+            return
+        for spec in self._specs_for(node):
+            if not spec.reads:
+                continue
+            if spec.lock in self.held or self._in_init():
+                continue
+            if self._waived(node.lineno):
+                continue
+            self.findings.append(Finding(
+                RULE_READ, self.rp, node.lineno, self._symbol(),
+                f"read of {'.'.join(spec.path)} outside "
+                f"'with {spec.lock}' (declared reads-guarded)",
+                detail=f"{'.'.join(spec.path)}:read:{self._symbol()}",
+            ))
+            return
+
+
+def check_file(path: Path) -> tuple[list[Finding], ModuleGuards]:
+    source = path.read_text()
+    findings: list[Finding] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        findings.append(Finding(
+            RULE_CONFIG, rel(path), e.lineno or 1, "",
+            f"syntax error: {e.msg}",
+        ))
+        return findings, ModuleGuards(path)
+    comments = comment_map(source)
+    mg = _collect(tree, comments, path, findings)
+    if mg.specs or mg.requires:
+        _SiteChecker(mg, comments, findings).visit(tree)
+    return findings, mg
+
+
+def run(paths: list[Path]) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in sorted(paths):
+        fs, _mg = check_file(p)
+        findings.extend(fs)
+    return findings
+
+
+def guarded_paths(path: Path) -> dict[tuple[Optional[str], str], str]:
+    """(class, dotted path) -> lock — the annotation surface for a
+    module. Tests use this to pin that an invariant is DECLARED (e.g.
+    test_dispatch_donation asserts the compile-spy fields carry
+    ``_counter_lock`` annotations)."""
+    _fs, mg = check_file(path)
+    return {
+        (s.cls, ".".join(s.path)): s.lock for s in mg.specs
+    }

@@ -1,0 +1,623 @@
+"""JAX jit-hygiene lint (docs/ANALYSIS.md §jit hygiene).
+
+Three rules over the device modules (``ops/match.py``,
+``fingerprints/compile.py``, ``ops/regexdev.py`` by default — the
+files where a hygiene slip becomes a silent 100x):
+
+**jit-capture** — a closure handed to ``jax.jit`` (decorator,
+``jax.jit(fn)``, or ``functools.partial(jax.jit, ...)``) may only
+close over names explicitly declared on the def line:
+
+    def kernel(arrays, streams):  # jit-captures: db, meta, k
+        ...
+
+Every capture is a trace-time CONSTANT: a corpus-sized array captured
+here gets burned into the executable — exactly the ``pred[1,NM,6]``
+constant-fold regression PR 3 chased through HLO text. Declaring a
+capture is the author asserting it is small and shape-static. The
+static pass generalizes the HLO constant-scan test: the scan proves
+one batch shape clean at runtime; the lint proves no UNDECLARED
+capture exists on any path.
+
+**jit-capture-array** — a declared-or-not capture whose binding is
+visibly an array upload (``jnp.asarray(...)``, ``jax.device_put``,
+``tree_map(jnp.asarray, ...)``) is flagged regardless of declaration —
+that is never trace-static. Only the baseline (with a written reason)
+can carry one of these.
+
+**donated-use** — for jitted callables created with ``donate_argnums``
+the pass records the donated positions (literal tuples, or a
+conditional of literal tuples like match.py's
+``(2,3,4,5,6) if donate_streams else (5,6)`` — the UNION is checked),
+then resolves direct call sites and flags any later read of a variable
+passed at a donated position before it is rebound: after dispatch the
+buffer may already be XLA's. Factory methods that build-and-cache a
+donating jit (``_phase_b``) are resolved one level deep:
+``fb = self._phase_b(...); fb(kc, a, s, l, st, cnt, ovf)`` checks
+``s/l/st/cnt/ovf``. Waive a deliberate post-dispatch read with
+``# donated-ok: <reason>``.
+
+**host-sync** — ``float()`` / ``int()`` / ``bool()`` / ``np.asarray``
+/ ``np.array`` / ``.item()`` / ``.tolist()`` applied to a value
+produced by a jitted call forces a blocking device→host transfer.
+The production dispatch path is allowed exactly one (the 4-byte
+phase-A survivor scalar); every such site must carry
+``# host-sync-ok: <reason>`` naming why the sync is part of the
+design. Inside a jitted body the same calls are flagged
+unconditionally (``host-sync-traced``) — they either fail at trace
+time or silently constant-fold.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from tools.swarmlint.common import (
+    Finding,
+    annotation_on,
+    comment_map,
+    rel,
+)
+
+RULE_CAPTURE = "jit-capture"
+RULE_CAPTURE_ARRAY = "jit-capture-array"
+RULE_DONATED = "donated-use"
+RULE_SYNC = "host-sync"
+RULE_SYNC_TRACED = "host-sync-traced"
+RULE_CONFIG = "jit-config"
+
+DEFAULT_TARGETS = (
+    "swarm_tpu/ops/match.py",
+    "swarm_tpu/ops/regexdev.py",
+    "swarm_tpu/fingerprints/compile.py",
+)
+
+SYNC_CALLS = {"float", "int", "bool"}
+SYNC_NP_ATTRS = {"asarray", "array", "packbits"}
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+ARRAYISH_CALLS = {
+    ("jnp", "asarray"), ("jax", "device_put"), ("jnp", "array"),
+}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / jit — possibly wrapped in functools.partial."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    return False
+
+
+def _jit_call_of(node: ast.Call) -> Optional[ast.Call]:
+    """If ``node`` is jax.jit(...) or partial(jax.jit, ...), return the
+    call that carries jit's kwargs (donate_argnums etc.)."""
+    if _is_jit_expr(node.func):
+        return node
+    # functools.partial(jax.jit, static_argnums=...)
+    fn = node.func
+    if (
+        isinstance(fn, ast.Attribute) and fn.attr == "partial"
+        or isinstance(fn, ast.Name) and fn.id in ("partial", "_partial")
+    ):
+        if node.args and _is_jit_expr(node.args[0]):
+            return node
+    return None
+
+
+def _literal_int_tuple(node: ast.AST) -> Optional[tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _donate_positions(call: ast.Call,
+                      local_assigns: dict[str, list[ast.AST]]) -> set[int]:
+    """Union of possible donate_argnums values at this jit call."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        lit = _literal_int_tuple(v)
+        if lit is not None:
+            return set(lit)
+        if isinstance(v, ast.IfExp):
+            a = _literal_int_tuple(v.body)
+            b = _literal_int_tuple(v.orelse)
+            if a is not None and b is not None:
+                return set(a) | set(b)
+        if isinstance(v, ast.Name):
+            out: set[int] = set()
+            for src in local_assigns.get(v.id, []):
+                lit = _literal_int_tuple(src)
+                if lit is not None:
+                    out |= set(lit)
+                elif isinstance(src, ast.IfExp):
+                    a = _literal_int_tuple(src.body)
+                    b = _literal_int_tuple(src.orelse)
+                    if a is not None and b is not None:
+                        out |= set(a) | set(b)
+            if out:
+                return out
+    return set()
+
+
+class _ScopeNames(ast.NodeVisitor):
+    """Names BOUND inside a function (params, assigns, for/with/except
+    targets, comprehension vars, nested def/class names, imports)."""
+
+    def __init__(self):
+        self.bound: set[str] = set()
+        self.loaded: set[str] = set()
+        self.load_lines: dict[str, int] = {}
+
+    def collect(self, fn) -> "_ScopeNames":
+        a = fn.args
+        for arg in (
+            a.posonlyargs + a.args + a.kwonlyargs
+            + ([a.vararg] if a.vararg else [])
+            + ([a.kwarg] if a.kwarg else [])
+        ):
+            self.bound.add(arg.arg)
+        for stmt in fn.body:
+            self.visit(stmt)
+        return self
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.bound.add(node.id)
+        else:
+            self.loaded.add(node.id)
+            self.load_lines.setdefault(node.id, node.lineno)
+
+    def visit_FunctionDef(self, node):
+        self.bound.add(node.name)
+        # walk nested bodies too — a capture used only by an inner
+        # closure is still a capture of the jitted outer one
+        inner = _ScopeNames().collect(node)
+        self.loaded |= inner.loaded - inner.bound
+        for k, v in inner.load_lines.items():
+            self.load_lines.setdefault(k, v)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        inner = _ScopeNames()
+        for arg in node.args.args:
+            inner.bound.add(arg.arg)
+        inner.visit(node.body)
+        self.loaded |= inner.loaded - inner.bound
+        for k, v in inner.load_lines.items():
+            self.load_lines.setdefault(k, v)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.bound.add(node.name)
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            self.bound.add((alias.asname or alias.name).split(".")[0])
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        for alias in node.names:
+            self.bound.add(alias.asname or alias.name)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if node.name:
+            self.bound.add(node.name)
+        self.generic_visit(node)
+
+
+def _module_globals(tree: ast.Module) -> set[str]:
+    import builtins
+
+    out: set[str] = set(vars(builtins))
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(node, ast.AnnAssign):
+            if isinstance(node.target, ast.Name):
+                out.add(node.target.id)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                out.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                out.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Try):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+                elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+                    for alias in sub.names:
+                        out.add(
+                            (alias.asname or alias.name).split(".")[0]
+                        )
+    return out
+
+
+@dataclass
+class _FnInfo:
+    """Per enclosing-function analysis state."""
+    node: ast.AST
+    # name -> assignment value nodes (in this function, any order)
+    assigns: dict[str, list[ast.AST]] = field(default_factory=dict)
+    # local jitted-callable names -> donated positions (may be empty)
+    jit_vars: dict[str, set[int]] = field(default_factory=dict)
+    # local names bound from a jit-factory method call
+    factory_vars: dict[str, set[int]] = field(default_factory=dict)
+
+
+def _collect_assigns(fn) -> dict[str, list[ast.AST]]:
+    out: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                out.setdefault(node.target.id, []).append(node.value)
+    return out
+
+
+def _value_is_arrayish(value: ast.AST) -> bool:
+    """Visibly a device/host array upload — jnp.asarray(...),
+    jax.device_put(...), tree_map(jnp.asarray, ...)."""
+    for node in ast.walk(value):
+        if not isinstance(node, (ast.Call, ast.Attribute)):
+            continue
+        target = node.func if isinstance(node, ast.Call) else node
+        p: list[str] = []
+        cur = target
+        while isinstance(cur, ast.Attribute):
+            p.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            p.append(cur.id)
+            p.reverse()
+            for mod, attr in ARRAYISH_CALLS:
+                if mod in p and attr in p:
+                    return True
+    return False
+
+
+class JitChecker:
+    def __init__(self, path: Path, source: str):
+        self.path = path
+        self.rp = rel(path)
+        self.source = source
+        self.tree = ast.parse(source)
+        self.comments = comment_map(source)
+        self.globals = _module_globals(self.tree)
+        self.findings: list[Finding] = []
+        #: methods of this module whose body builds a jax.jit —
+        #: "jit factories" (match.py's _kernel/_phase_a/_phase_b).
+        #: name -> union of donated positions across their jit calls
+        self.factories: dict[str, set[int]] = {}
+
+    # ------------------------------------------------------------------
+    def run(self) -> list[Finding]:
+        self._index_factories()
+        self._walk_functions(self.tree, parents=[])
+        return self.findings
+
+    def _index_factories(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            assigns = _collect_assigns(node)
+            donated: Optional[set[int]] = None
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    jc = _jit_call_of(sub)
+                    if jc is not None:
+                        d = _donate_positions(jc, assigns)
+                        donated = (donated or set()) | d
+            if donated is not None:
+                self.factories[node.name] = donated
+
+    # ------------------------------------------------------------------
+    def _walk_functions(self, node, parents):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(child, parents)
+                self._walk_functions(child, parents + [child])
+            else:
+                self._walk_functions(child, parents)
+
+    # ------------------------------------------------------------------
+    def _check_function(self, fn, parents):
+        # closures see their enclosing scopes: merge parent assigns
+        # (outermost first) so `launch()` inside `dispatch()` resolves
+        # the jitted fa/fb bound one level up
+        merged: dict[str, list[ast.AST]] = {}
+        for p in parents:
+            merged.update(_collect_assigns(p))
+        merged.update(_collect_assigns(fn))
+        info = _FnInfo(fn, merged)
+        self._find_jit_defs(fn, info, nested=bool(parents))
+        self._check_donation_and_sync(fn, info)
+
+    def _symbol(self, fn) -> str:
+        return fn.name
+
+    # -- rule 1+2: captures -------------------------------------------
+    def _find_jit_defs(self, fn, info: _FnInfo, nested: bool):
+        """Find jit applications whose subject is a def nested in fn."""
+        local_defs = {
+            n.name: n
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        }
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            jc = _jit_call_of(node)
+            if jc is None:
+                continue
+            # subject: jax.jit(kernel) positional, or decorator handled
+            # below via the def's decorator_list
+            subject: Optional[ast.AST] = None
+            args = jc.args
+            if _is_jit_expr(jc.func):
+                subject = args[0] if args else None
+            elif len(args) >= 2:
+                subject = args[1]  # partial(jax.jit, kernel?) — rare
+            donated = _donate_positions(jc, info.assigns)
+            target_def = None
+            if isinstance(subject, ast.Name) and subject.id in local_defs:
+                target_def = local_defs[subject.id]
+            elif isinstance(subject, ast.Lambda):
+                self._check_captures_lambda(subject, fn, jc.lineno)
+            if target_def is not None:
+                self._check_captures(target_def, fn)
+            # record local jitted vars for donation checking
+            # (assignment form: fn_var = jax.jit(...))
+        # decorator form: @jax.jit / @partial(jax.jit, ...)
+        for name, d in local_defs.items():
+            for dec in d.decorator_list:
+                decall = (
+                    _jit_call_of(dec) if isinstance(dec, ast.Call) else None
+                )
+                if decall is not None or _is_jit_expr(dec):
+                    self._check_captures(d, fn)
+
+    def _declared_captures(self, d) -> Optional[set[str]]:
+        payload = annotation_on(self.comments, d.lineno, "jit-captures")
+        if payload is None:
+            # also accept the annotation on the decorator line(s)
+            for dec in d.decorator_list:
+                payload = annotation_on(
+                    self.comments, dec.lineno, "jit-captures"
+                )
+                if payload is not None:
+                    break
+        if payload is None:
+            return None
+        # names only — an explanatory parenthetical may follow
+        payload = payload.split("(")[0]
+        return {p.strip() for p in payload.split(",") if p.strip()}
+
+    def _check_captures(self, d, enclosing):
+        scope = _ScopeNames().collect(d)
+        free = scope.loaded - scope.bound - self.globals - {d.name}
+        declared = self._declared_captures(d) or set()
+        enclosing_assigns = _collect_assigns(enclosing)
+        for name in sorted(free):
+            line = scope.load_lines.get(name, d.lineno)
+            arrayish = any(
+                _value_is_arrayish(v)
+                for v in enclosing_assigns.get(name, [])
+            )
+            if arrayish:
+                self.findings.append(Finding(
+                    RULE_CAPTURE_ARRAY, self.rp, line, d.name,
+                    f"jitted closure captures {name!r}, which is bound "
+                    f"from an array upload in {enclosing.name}() — "
+                    f"captured arrays constant-fold into the "
+                    f"executable (pass it as an argument)",
+                    detail=f"{d.name}:{name}",
+                ))
+            elif name not in declared:
+                self.findings.append(Finding(
+                    RULE_CAPTURE, self.rp, line, d.name,
+                    f"jitted closure captures {name!r} without a "
+                    f"'# jit-captures:' declaration on the def — "
+                    f"captures are trace-time constants",
+                    detail=f"{d.name}:{name}",
+                ))
+
+    def _check_captures_lambda(self, lam: ast.Lambda, enclosing, line):
+        scope = _ScopeNames()
+        for arg in lam.args.args:
+            scope.bound.add(arg.arg)
+        scope.visit(lam.body)
+        free = scope.loaded - scope.bound - self.globals
+        for name in sorted(free):
+            self.findings.append(Finding(
+                RULE_CAPTURE, self.rp, line, enclosing.name,
+                f"jitted lambda captures {name!r} — captures are "
+                f"trace-time constants (declare via a named def with "
+                f"'# jit-captures:' or pass as an argument)",
+                detail=f"<lambda>:{name}",
+            ))
+
+    # -- rules 3+4: donation + host sync -------------------------------
+    def _check_donation_and_sync(self, fn, info: _FnInfo):
+        # jitted/factory-bound locals in THIS function
+        jit_vars: dict[str, set[int]] = {}
+        device_vars: set[str] = set()
+        for name, values in info.assigns.items():
+            for v in values:
+                if isinstance(v, ast.Call):
+                    jc = _jit_call_of(v)
+                    if jc is not None:
+                        jit_vars[name] = _donate_positions(jc, info.assigns)
+                        continue
+                    # factory: x = self._phase_b(...) / x = _factory(...)
+                    callee = None
+                    if isinstance(v.func, ast.Attribute):
+                        callee = v.func.attr
+                    elif isinstance(v.func, ast.Name):
+                        callee = v.func.id
+                    if callee in self.factories:
+                        jit_vars[name] = set(self.factories[callee])
+        if not jit_vars:
+            return
+        # linear scan of all calls in source order
+        calls = sorted(
+            (n for n in ast.walk(fn) if isinstance(n, ast.Call)),
+            key=lambda n: (n.lineno, n.col_offset),
+        )
+        donated_after: dict[str, tuple[int, str]] = {}
+        for call in calls:
+            fname = None
+            if isinstance(call.func, ast.Name):
+                fname = call.func.id
+            if fname in jit_vars:
+                # results of a jitted call are device values
+                self._track_device_results(fn, call, device_vars)
+                for pos in jit_vars[fname]:
+                    if pos < len(call.args):
+                        arg = call.args[pos]
+                        key = self._lvalue_key(arg)
+                        if key:
+                            donated_after[key] = (call.lineno, fname)
+        if donated_after:
+            self._flag_donated_reads(fn, donated_after)
+        if device_vars:
+            self._flag_host_syncs(fn, device_vars)
+
+    @staticmethod
+    def _lvalue_key(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ):
+            return f"{node.value.id}.{node.attr}"
+        return None
+
+    def _track_device_results(self, fn, call: ast.Call,
+                              device_vars: set[str]):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and node.value is call:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        device_vars.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for elt in t.elts:
+                            if isinstance(elt, ast.Name):
+                                device_vars.add(elt.id)
+
+    def _flag_donated_reads(self, fn, donated: dict[str, tuple[int, str]]):
+        rebind: dict[str, int] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store,)
+            ):
+                key = node.id
+                if key in donated and node.lineno > donated[key][0]:
+                    rebind[key] = min(
+                        rebind.get(key, node.lineno), node.lineno
+                    )
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Name, ast.Attribute)):
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            key = self._lvalue_key(node)
+            if key is None or key not in donated:
+                continue
+            dline, fname = donated[key]
+            if node.lineno <= dline:
+                continue
+            if key in rebind and node.lineno >= rebind[key]:
+                continue
+            if annotation_on(self.comments, node.lineno, "donated-ok"):
+                continue
+            self.findings.append(Finding(
+                RULE_DONATED, self.rp, node.lineno, fn.name,
+                f"{key!r} was donated to {fname}() and read "
+                f"afterwards — the buffer may already be reused by "
+                f"XLA",
+                detail=f"{fn.name}:{key}",
+            ))
+
+    def _flag_host_syncs(self, fn, device_vars: set[str]):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = None
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in SYNC_CALLS
+                and node.args
+            ):
+                arg = node.args[0]
+                key = self._lvalue_key(arg)
+                if key in device_vars:
+                    hit = f"{node.func.id}({key})"
+            elif isinstance(node.func, ast.Attribute):
+                fa = node.func
+                if (
+                    fa.attr in SYNC_NP_ATTRS
+                    and isinstance(fa.value, ast.Name)
+                    and fa.value.id in ("np", "numpy")
+                    and node.args
+                ):
+                    key = self._lvalue_key(node.args[0])
+                    if key in device_vars:
+                        hit = f"np.{fa.attr}({key})"
+                elif fa.attr in SYNC_METHODS:
+                    key = self._lvalue_key(fa.value)
+                    if key in device_vars:
+                        hit = f"{key}.{fa.attr}()"
+            if hit is None:
+                continue
+            if annotation_on(self.comments, node.lineno, "host-sync-ok"):
+                continue
+            self.findings.append(Finding(
+                RULE_SYNC, self.rp, node.lineno, fn.name,
+                f"{hit} blocks on a device value mid-pipeline — every "
+                f"sync must carry '# host-sync-ok: <reason>' (the "
+                f"dispatch path budgets exactly one 4-byte sync)",
+                detail=f"{fn.name}:{hit}",
+            ))
+
+
+def check_file(path: Path) -> list[Finding]:
+    try:
+        return JitChecker(path, path.read_text()).run()
+    except SyntaxError as e:
+        return [Finding(
+            RULE_CONFIG, rel(path), e.lineno or 1, "",
+            f"syntax error: {e.msg}",
+        )]
+
+
+def run(paths: list[Path]) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in sorted(paths):
+        findings.extend(check_file(p))
+    return findings

@@ -1,0 +1,278 @@
+"""Native audit: lexical checker over ``native/*.cpp``
+(docs/ANALYSIS.md §native audit).
+
+Two rules:
+
+**gil-pyapi / gil-deref** — inside a ``Py_BEGIN_ALLOW_THREADS`` ..
+``Py_END_ALLOW_THREADS`` span the GIL is NOT held: any CPython API
+call, and any dereference of an identifier declared ``PyObject*`` in
+the same file, races the interpreter (another thread may be mutating
+or collecting the object). The shipped pattern — extract raw
+pointers/lengths from borrowed objects BEFORE releasing, touch only
+plain buffers inside — is what the rule enforces. ``Py_ssize_t``
+(a typedef, not a call) is exempt; waive a reviewed site with
+``// gil-ok: <reason>``.
+
+**unchecked-ret** — calls to failable CPython APIs whose result is
+visibly dropped or never tested. NULL-returning allocators
+(``PyList_New``, ``Py_BuildValue``, ``PyUnicode_InternFromString``…)
+and negative-returning setters (``PyDict_SetItem``, ``PyList_Append``,
+``PyObject_IsTrue``…) both count. "Checked" is lexical: the call sits
+in a condition/return/ternary, or its result lands in a variable that
+is tested within the next few lines. ``PyLong_AsLong`` /
+``PyDict_GetItemWithError`` are only checked by a nearby
+``PyErr_Occurred()``/NULL test. Waive with ``// retcheck-ok: <reason>``.
+
+The checker is lexical by design — no libclang in the image, and the
+three sources are plain C-with-classes where line-level heuristics are
+reliable. Strings and comments are stripped before matching so
+commentary can't trip it.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tools.swarmlint.common import Finding, rel
+
+RULE_GIL_API = "gil-pyapi"
+RULE_GIL_DEREF = "gil-deref"
+RULE_UNCHECKED = "unchecked-ret"
+
+#: Py* tokens that are safe without the GIL (types/macros, the span
+#: delimiters themselves, and the GIL re-acquire macros)
+GIL_SAFE = {
+    "Py_ssize_t", "Py_BEGIN_ALLOW_THREADS", "Py_END_ALLOW_THREADS",
+    "Py_BLOCK_THREADS", "Py_UNBLOCK_THREADS", "PyObject",
+}
+
+#: APIs returning NULL on failure
+NULL_ON_ERROR = {
+    "PyList_New", "PyDict_New", "PyTuple_New", "PySet_New",
+    "PyBytes_FromStringAndSize", "PyBytes_FromString",
+    "PyUnicode_FromString", "PyUnicode_FromStringAndSize",
+    "PyUnicode_InternFromString", "PyLong_FromLong",
+    "PyLong_FromLongLong", "PyLong_FromSsize_t", "PyFloat_FromDouble",
+    "Py_BuildValue", "PySequence_List", "PySequence_Tuple",
+    "PyObject_GetAttr", "PyObject_GetAttrString",
+    "PyObject_Call", "PyObject_CallObject", "PyObject_CallFunction",
+    "PyObject_Str", "PyObject_Repr", "PyDict_Keys", "PyDict_Values",
+    "PyList_GetItem", "PyTuple_GetItem",
+}
+
+#: APIs returning a negative int on failure
+NEG_ON_ERROR = {
+    "PyList_Append", "PyList_SetItem", "PyList_Insert",
+    "PyDict_SetItem", "PyDict_SetItemString", "PyDict_DelItem",
+    "PySet_Add", "PyObject_SetAttr", "PyObject_SetAttrString",
+    "PyObject_IsTrue", "PyObject_IsInstance", "PyObject_RichCompareBool",
+    "PySequence_SetItem", "PyTuple_SetItem",
+}
+
+#: error is only observable via PyErr_Occurred (or a NULL probe whose
+#: meaning is ambiguous without it)
+ERRQUERY_ONLY = {"PyLong_AsLong", "PyLong_AsSsize_t", "PyFloat_AsDouble",
+                 "PyDict_GetItemWithError"}
+
+FAILABLE = NULL_ON_ERROR | NEG_ON_ERROR | ERRQUERY_ONLY
+
+_CALL_RE = re.compile(r"\b(Py[A-Za-z_][A-Za-z0-9_]*)\s*\(")
+_DECL_RE = re.compile(r"\bPyObject\s*\*+\s*([A-Za-z_][A-Za-z0-9_]*)")
+_DECL_MULTI_RE = re.compile(r"\*\s*([A-Za-z_][A-Za-z0-9_]*)")
+_FUNC_RE = re.compile(
+    r"^[A-Za-z_][\w<>:*&\s\"]*\b([A-Za-z_][A-Za-z0-9_]*)\s*\([^;]*$"
+)
+
+
+def _strip(source: str) -> list[str]:
+    """Source lines with string literals, char literals, // and /* */
+    comments blanked (lengths preserved so columns stay honest) —
+    but with `gil-ok`/`retcheck-ok` waivers harvested first."""
+    out = []
+    in_block = False
+    for line in source.splitlines():
+        buf = []
+        i, n = 0, len(line)
+        in_str = None
+        while i < n:
+            c = line[i]
+            if in_block:
+                if line.startswith("*/", i):
+                    in_block = False
+                    buf.append("  ")
+                    i += 2
+                else:
+                    buf.append(" ")
+                    i += 1
+                continue
+            if in_str:
+                if c == "\\" and i + 1 < n:
+                    buf.append("  ")
+                    i += 2
+                    continue
+                if c == in_str:
+                    in_str = None
+                    buf.append(c)
+                else:
+                    buf.append(" ")
+                i += 1
+                continue
+            if c in "\"'":
+                in_str = c
+                buf.append(c)
+                i += 1
+                continue
+            if line.startswith("//", i):
+                buf.append(" " * (n - i))
+                break
+            if line.startswith("/*", i):
+                in_block = True
+                buf.append("  ")
+                i += 2
+                continue
+            buf.append(c)
+            i += 1
+        out.append("".join(buf))
+    return out
+
+
+def _waivers(source: str, tag: str) -> set[int]:
+    out = set()
+    for i, line in enumerate(source.splitlines(), 1):
+        m = re.search(r"//\s*" + re.escape(tag) + r":\s*(.*)", line)
+        if m and m.group(1).strip():
+            out.add(i)
+    return out
+
+
+def _enclosing_function(lines: list[str], lineno: int) -> str:
+    """Nearest preceding plausible function definition name."""
+    for i in range(lineno - 1, -1, -1):
+        line = lines[i]
+        if line and not line[0].isspace():
+            m = _FUNC_RE.match(line.rstrip())
+            if m and m.group(1) not in (
+                "if", "for", "while", "switch", "return",
+            ):
+                return m.group(1)
+    return ""
+
+
+def check_file(path: Path) -> list[Finding]:
+    source = path.read_text()
+    raw_lines = source.splitlines()
+    lines = _strip(source)
+    rp = rel(path)
+    gil_ok = _waivers(source, "gil-ok")
+    ret_ok = _waivers(source, "retcheck-ok")
+    findings: list[Finding] = []
+
+    # PyObject* identifiers declared anywhere in the file
+    py_objs: set[str] = set()
+    for line in lines:
+        for m in _DECL_RE.finditer(line):
+            py_objs.add(m.group(1))
+            # comma-continued declarations: PyObject *a, *b;
+            rest = line[m.end():]
+            head = rest.split(";")[0].split("=")[0]
+            for m2 in _DECL_MULTI_RE.finditer(head):
+                py_objs.add(m2.group(1))
+
+    # ---- GIL-released spans ----------------------------------------
+    released = False
+    for idx, line in enumerate(lines, 1):
+        if "Py_BEGIN_ALLOW_THREADS" in line:
+            released = True
+            continue
+        if "Py_END_ALLOW_THREADS" in line:
+            released = False
+            continue
+        if not released:
+            continue
+        sym = _enclosing_function(lines, idx)
+        if idx not in gil_ok:
+            for m in _CALL_RE.finditer(line):
+                name = m.group(1)
+                if name in GIL_SAFE:
+                    continue
+                findings.append(Finding(
+                    RULE_GIL_API, rp, idx, sym,
+                    f"CPython API {name}() called inside a GIL-released "
+                    f"span — the interpreter may be running concurrently",
+                    detail=f"{sym}:{name}",
+                ))
+            for m in re.finditer(r"\b([A-Za-z_][A-Za-z0-9_]*)\s*->", line):
+                name = m.group(1)
+                if name in py_objs:
+                    findings.append(Finding(
+                        RULE_GIL_DEREF, rp, idx, sym,
+                        f"PyObject* {name!r} dereferenced inside a "
+                        f"GIL-released span (borrowed object may be "
+                        f"mutated or collected concurrently)",
+                        detail=f"{sym}:{name}",
+                    ))
+
+    # ---- unchecked returns -----------------------------------------
+    n = len(lines)
+    for idx, line in enumerate(lines, 1):
+        for m in _CALL_RE.finditer(line):
+            name = m.group(1)
+            if name not in FAILABLE:
+                continue
+            if idx in ret_ok:
+                continue
+            pre = line[: m.start()]
+            stripped_pre = pre.strip()
+            # already inside a test/return/ternary on the same line?
+            if re.search(
+                r"(\bif\b|\bwhile\b|\breturn\b|\?|==|!=|!\s*$|&&|\|\|)",
+                stripped_pre,
+            ):
+                continue
+            if stripped_pre.endswith(("(void)",)):
+                continue
+            sym = _enclosing_function(lines, idx)
+            # assigned to a simple variable? (aggregate initializers —
+            # `static Attrs a = { Call(), Call(), }` — don't match and
+            # fall through to the flag: no per-call check is possible)
+            am = re.search(
+                r"([A-Za-z_][A-Za-z0-9_]*(?:\[[^\]]*\])?)\s*=\s*$",
+                stripped_pre,
+            )
+            if am is not None:
+                var = am.group(1)
+                window = " ".join(lines[idx : min(n, idx + 6)])
+                window = line[m.end():] + " " + window
+                if name in ERRQUERY_ONLY:
+                    # NULL/-1 is a legal value for these — only
+                    # PyErr_Occurred() disambiguates
+                    if re.search(r"PyErr_Occurred\s*\(", window):
+                        continue
+                else:
+                    v = re.escape(var)
+                    checked = re.search(
+                        r"\b" + v
+                        + r"\s*(==|!=|<|>)\s*(nullptr|NULL|0|-1)",
+                        window,
+                    ) or re.search(
+                        r"(!\s*" + v + r"|\bif\s*\(\s*" + v
+                        + r"|return\s+" + v + r")", window
+                    )
+                    if checked:
+                        continue
+            findings.append(Finding(
+                RULE_UNCHECKED, rp, idx, sym,
+                f"return of {name}() is not checked (allocation/"
+                f"attribute failure would propagate NULL or a stale "
+                f"error indicator)",
+                detail=f"{sym}:{name}",
+            ))
+    return findings
+
+
+def run(paths: list[Path]) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in sorted(paths):
+        findings.extend(check_file(p))
+    return findings
