@@ -84,6 +84,12 @@ BASELINES = {
     # BENCH_* records can track it against itself.
     "device_compile_seconds": 124.0,
     "fresh_batch_device_ms": 14200.0,
+    # pod-scale sharded serving (docs/SHARDING.md, ISSUE 8): data-axis
+    # scaling efficiency of the mesh dispatch/collect path — rows/s at
+    # mesh (R,1,1) vs the 1-device rate (per-chip on accelerators,
+    # rate parity on the shared-silicon host-platform mesh). The
+    # acceptance floor is ≥0.7 linear.
+    "sharded_data_axis_efficiency": 0.7,
     # donated+compacted split-phase dispatch A/B (docs/DEVICE_MATCH.md,
     # ISSUE 6): the production dispatch (staging pool + donate_argnums
     # + survivor-compacted phase B) over the legacy fused arm on the
@@ -1143,6 +1149,170 @@ def bench_device_only(db, dev) -> float:
     return ROWS / per_batch
 
 
+def _shard_shapes(n_dev: int) -> list:
+    """Mesh shapes the sharded phase measures: the data-axis ladder
+    (2, 4, … up to every device) plus one 3-axis factorization when
+    the slice is big enough — the (2,2,2)/(8,1,1) pair the parity
+    suite pins (tests/test_shard_serving.py)."""
+    shapes = []
+    r = 2
+    while r <= n_dev:
+        if n_dev % r == 0:
+            shapes.append((r, 1, 1))
+        r *= 2
+    if n_dev >= 8 and n_dev % 8 == 0:
+        shapes.append((2, 2, 2))
+    return shapes
+
+
+def bench_sharded_serving(db) -> dict:
+    """Per-mesh-shape serving throughput on the mesh path
+    (docs/SHARDING.md): the split-phase compacted ``ShardedMatcher``
+    dispatch/collect split at in-flight depth 2, identity-gated
+    against the single-device ``DeviceDB`` planes every shape. The
+    data-axis scaling-efficiency figure compares rows/s at mesh
+    (R,1,1) against the 1-device rate: on a real accelerator slice
+    that is per-chip scaling (rate_R / (R·rate_1)); on the
+    host-platform CPU mesh all "devices" share the same silicon, so
+    the figure is rate_R / rate_1 — 1.0 means sharding costs nothing,
+    and the ≥0.7 acceptance bounds the psum/placement overhead."""
+    import jax
+
+    from swarm_tpu.ops.encoding import encode_batch
+    from swarm_tpu.ops.match import DeviceDB
+    from swarm_tpu.parallel.mesh import make_mesh
+    from swarm_tpu.parallel.sharded import (
+        ShardedMatcher,
+        max_entry_len,
+        pad_streams_for_seq,
+    )
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    record: dict = {
+        "platform": platform,
+        "n_devices": n_dev,
+        "rows": ROWS,
+        "templates": db.num_templates,
+        "ok": True,
+        "skipped": False,
+        "per_mesh": {},
+    }
+    if n_dev < 2:
+        log("!!! sharded phase: <2 devices visible; recording skip")
+        record.update(ok=False, skipped=True, reason="<2 devices")
+        return record
+
+    rows = realistic_rows(ROWS, seed=23)
+    batch = encode_batch(
+        rows, max_body=MAX_BODY, max_header=MAX_HEADER, pad_rows_to=ROWS,
+        width_multiple=512,
+    )
+
+    def serve_rate(matcher, streams, lengths, status):
+        """Steady-state rows/s through dispatch/collect at in-flight
+        depth 2 — the scheduler's serving pattern (batch i's collect
+        overlaps batch i+1's dispatch)."""
+        matcher.collect(
+            matcher.dispatch(streams, lengths, status, full=True)
+        )  # compile + warm
+        for _ in range(WARMUP):
+            matcher.collect(
+                matcher.dispatch(streams, lengths, status, full=True)
+            )
+        t0 = time.perf_counter()
+        pending = matcher.dispatch(streams, lengths, status, full=True)
+        for _ in range(ITERS - 1):
+            nxt = matcher.dispatch(streams, lengths, status, full=True)
+            matcher.collect(pending)
+            pending = nxt
+        matcher.collect(pending)
+        return ROWS * ITERS / (time.perf_counter() - t0)
+
+    single = DeviceDB(db)
+    rate_1 = serve_rate(single, batch.streams, batch.lengths, batch.status)
+    want = single.match(batch.streams, batch.lengths, batch.status, full=True)
+    record["single_device_rows_per_sec"] = round(rate_1, 1)
+    log(f"sharded phase: 1-device serve {rate_1:.0f} rows/s")
+
+    identical = True
+    best_data = None
+    for shape in _shard_shapes(n_dev):
+        mesh = make_mesh(shape)
+        matcher = ShardedMatcher(db, mesh)
+        streams = dict(batch.streams)
+        if shape[2] > 1:
+            streams = {k: v.copy() for k, v in streams.items()}
+            pad_streams_for_seq(streams, shape[2], max_entry_len(db))
+        got = matcher.collect(
+            matcher.dispatch(streams, batch.lengths, batch.status, full=True)
+        )
+        # identity gate: value planes bit-equal; overflow exact on
+        # data-only meshes, safe-direction when the candidate space is
+        # model/seq-sharded (per-rank k can only overflow less)
+        shape_ok = all(
+            np.array_equal(np.asarray(a), np.asarray(w))
+            for a, w in zip(got[:5], want[:5])
+        )
+        ovf_g, ovf_w = np.asarray(got[5]), np.asarray(want[5])
+        if shape[1] > 1 or shape[2] > 1:
+            shape_ok = shape_ok and np.array_equal(ovf_g | ovf_w, ovf_w)
+        else:
+            shape_ok = shape_ok and np.array_equal(ovf_g, ovf_w)
+        rate = serve_rate(matcher, streams, batch.lengths, batch.status)
+        key = "x".join(str(d) for d in shape)
+        record["per_mesh"][key] = {
+            "rows_per_sec": round(rate, 1),
+            "vs_single_device": round(rate / max(rate_1, 1e-9), 3),
+            "identity": "bit-equal" if shape_ok else "MISMATCH",
+            "survivor_max": matcher.last_compact.get("survivor_max"),
+            "verify_k": matcher.last_compact.get("verify_k"),
+            "compile_seconds": round(matcher.compile_seconds, 2),
+        }
+        log(
+            f"sharded phase: mesh {key} serve {rate:.0f} rows/s "
+            f"({rate / max(rate_1, 1e-9):.2f}x 1-device); planes "
+            f"{'identical' if shape_ok else 'MISMATCH'}"
+        )
+        identical = identical and shape_ok
+        if shape[1] == 1 and shape[2] == 1:
+            if best_data is None or rate > best_data[1]:
+                best_data = (shape[0], rate)
+
+    record["ok"] = identical
+    if best_data is not None:
+        R, rate_r = best_data
+        if platform == "cpu":
+            # host-platform mesh: every virtual device is the same
+            # silicon, so linear scaling is rate parity — the figure
+            # measures pure sharding overhead
+            eff = rate_r / max(rate_1, 1e-9)
+            basis = "host-platform (rate_R / rate_1)"
+        else:
+            eff = rate_r / max(R * rate_1, 1e-9)
+            basis = "per-chip (rate_R / (R*rate_1))"
+        record["data_axis_scaling"] = {
+            "R": R,
+            "rows_per_sec": round(rate_r, 1),
+            "efficiency": round(eff, 3),
+            "basis": basis,
+        }
+    return record
+
+
+def _write_multichip(record: dict) -> str:
+    """MULTICHIP_r06.json: the measured pod-scale serving record the
+    ROADMAP tracks (SWARM_MULTICHIP_OUT overrides the path)."""
+    out = os.environ.get("SWARM_MULTICHIP_OUT", "") or str(
+        Path(__file__).parent / "MULTICHIP_r06.json"
+    )
+    with open(out, "w") as fh:
+        json.dump(record, fh, indent=2)
+        fh.write("\n")
+    log(f"sharded phase: record written to {out}")
+    return out
+
+
 def _setup_phase(need_corpus: bool):
     """Per-phase process setup: backend + (optionally) corpus. Returns
     (templates, db, dev) — templates/db None when not needed."""
@@ -1178,8 +1348,27 @@ def _setup_phase(need_corpus: bool):
 
 def run_phase(phase: str) -> int:
     """One bench phase in this process. Emits its JSON metric lines."""
+    if phase in ("sharded", "shard_smoke"):
+        # the mesh path needs >1 device: force the virtual host-
+        # platform mesh BEFORE jax initializes (a no-op for real
+        # accelerator backends — the flag only shapes the CPU
+        # platform), so CPU-only boxes still exercise sharded serving.
+        # Scoped to these phases' SUBPROCESSES on purpose: the flag
+        # also reshapes XLA's CPU thread pools, and the other smoke/
+        # bench clauses must keep their single-device measurement basis
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    if phase == "shard_smoke":
+        global ROWS, ITERS
+        ROWS, ITERS = 256, 2
+        os.environ.setdefault("SWARM_BENCH_CORPUS", str(BUNDLED_CORPUS))
+        os.environ.setdefault("SWARM_BENCH_PHASE_PROBE_DEADLINE", "20")
     templates, db, dev = _setup_phase(
-        need_corpus=phase in ("exact", "oracle", "device")
+        need_corpus=phase in ("exact", "oracle", "device", "sharded",
+                              "shard_smoke")
     )
     if phase == "exact":
         (
@@ -1351,6 +1540,46 @@ def run_phase(phase: str) -> int:
             "fingerprints/sec/chip",
             devrate / TARGET_PER_CHIP,
         )
+    elif phase == "sharded":
+        rec = bench_sharded_serving(db)
+        rec["multichip_out"] = _write_multichip(rec)
+        if rec.get("skipped"):
+            return 0
+        scaling = rec.get("data_axis_scaling") or {}
+        if scaling:
+            emit(
+                "sharded_data_axis_efficiency",
+                scaling["efficiency"],
+                f"ratio ({scaling['basis']}; >=0.7 acceptance)",
+                scaling["efficiency"]
+                / BASELINES["sharded_data_axis_efficiency"],
+                extra={"sharded": rec},
+            )
+            emit(
+                "sharded_serving_rows_per_sec",
+                scaling["rows_per_sec"],
+                f"rows/sec ({scaling['R']}-way data mesh, full-corpus "
+                "dispatch/collect serve, identity-gated)",
+                scaling["rows_per_sec"] / TARGET_PER_CHIP,
+            )
+        if not rec["ok"]:
+            # identity gate is REAL: a sharded plane mismatch is a
+            # correctness bug, not a throughput datapoint
+            log("!!! sharded serving planes MISMATCH — phase FAILED")
+            return 1
+    elif phase == "shard_smoke":
+        # run_smoke's child: engine-level sharded-vs-single verdict
+        # identity on the forced 8-device host-platform mesh
+        ok, rec = _smoke_shard_clause(templates, db)
+        emit(
+            "smoke_shard_identity",
+            1.0 if ok else 0.0,
+            "bool (sharded mesh engine vs single-device verdict "
+            "identity)",
+            1.0 if ok else 0.0,
+            extra={"shard_smoke": rec},
+        )
+        return 0 if ok else 1
     else:
         log(f"unknown phase {phase!r}")
         return 2
@@ -1392,6 +1621,63 @@ def _bench_resilience_overhead() -> dict | None:
     return {
         "fault_point_ns": round(fp_ns, 1),
         "transport_wrap_ns": round(max(wrapped_ns - raw_ns, 0.0), 1),
+    }
+
+
+def _smoke_shard_clause(templates, db) -> "tuple[bool, dict]":
+    """shard_smoke (docs/SHARDING.md): run the sharded serving path on
+    the host-platform mesh and gate on verdict identity with the
+    single-device engine — placement, dispatch/collect split, psum and
+    host redo all exercised on every CPU-only box. Returns
+    ``(ok, record)``; ok also covers "the mesh actually engaged"."""
+    import jax
+
+    from swarm_tpu.ops.engine import MatchEngine
+    from swarm_tpu.parallel.mesh import make_mesh
+    from swarm_tpu.telemetry import shard_export
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        # the forced host-platform flag didn't take (jax was already
+        # initialized) — loud, but not a verdict failure
+        log("!!! shard smoke SKIPPED: only 1 device visible")
+        return True, {"skipped": True, "n_devices": n_dev}
+    mesh = make_mesh()
+    eng = MatchEngine(
+        templates, mesh=mesh, batch_rows=ROWS, max_body=MAX_BODY,
+        max_header=MAX_HEADER, db=db,
+    )
+    single = MatchEngine(
+        templates, mesh=None, batch_rows=ROWS, max_body=MAX_BODY,
+        max_header=MAX_HEADER, db=db,
+    )
+    # a full chunk plus a partial one (13 rows: per-rank placement +
+    # mesh row padding + the gather-back index all engage)
+    rows = realistic_rows(64, seed=3)
+    d0 = shard_export.SHARD_DISPATCHES.labels().value
+    ok = True
+    for chunk in (rows[:48], rows[48:61]):
+        got = eng.match(chunk)
+        want = single.match(chunk)
+        for g, w in zip(got, want):
+            if (
+                sorted(g.template_ids) != sorted(w.template_ids)
+                or g.extractions != w.extractions
+            ):
+                ok = False
+    dispatches = shard_export.SHARD_DISPATCHES.labels().value - d0
+    engaged = eng.sharded is not None and dispatches > 0
+    mesh_shape = dict(eng.sharded.ranks) if eng.sharded else {}
+    log(
+        f"shard smoke: mesh {mesh_shape} dispatches={dispatches} "
+        f"verdicts {'identical' if ok else 'MISMATCH'}"
+    )
+    if not engaged:
+        log("!!! shard smoke: mesh path did not engage — smoke FAILED")
+    return ok and engaged, {
+        "mesh": mesh_shape,
+        "dispatches": int(dispatches),
+        "identical": bool(ok),
     }
 
 
@@ -1437,6 +1723,29 @@ def run_smoke() -> int:
         wab["speedup"],
         extra={"walk_ab": wab},
     )
+    # shard smoke: the sharded serving path on the 8-device host-
+    # platform mesh, rc-gated on verdict identity (docs/SHARDING.md).
+    # Runs in its OWN subprocess: the forced device-count flag also
+    # reshapes XLA's CPU thread pools, and the A/B clauses above must
+    # keep the single-device measurement basis preflight has recorded
+    # all along.
+    import subprocess as _subprocess
+
+    try:
+        r = _subprocess.run(
+            [sys.executable, __file__, "--phase", "shard_smoke"],
+            stdout=_subprocess.PIPE,
+            text=True,
+            timeout=900,
+        )
+        shard_ok = r.returncode == 0
+        for line in r.stdout.splitlines():
+            if line.strip().startswith("{"):
+                print(line, flush=True)
+    except _subprocess.TimeoutExpired:
+        log("!!! shard smoke timed out — smoke FAILED")
+        shard_ok = False
+    ok = ok and shard_ok
     from swarm_tpu.resilience.faults import active_plan
 
     plan = active_plan()
@@ -1477,7 +1786,7 @@ def run_smoke() -> int:
                 extra=overhead,
             )
     if not ok:
-        log("!!! pipeline/walk A/B verdict mismatch — smoke FAILED")
+        log("!!! pipeline/walk/shard verdict mismatch — smoke FAILED")
     return 0 if ok else 1
 
 
@@ -1487,8 +1796,8 @@ def run_smoke() -> int:
 #: line. oracle runs before exact so the speedup ratio main()
 #: synthesizes never delays the headline.
 PHASES = [
-    "service", "service_full", "streaming", "jarm", "device", "oracle",
-    "exact",
+    "service", "service_full", "streaming", "jarm", "device", "sharded",
+    "oracle", "exact",
 ]
 
 

@@ -85,6 +85,12 @@ class ResponseBatch:
     status: np.ndarray
     truncated: np.ndarray
     rows: list  # original Response objects (host fallback + reporting)
+    #: sharded-placement map (docs/SHARDING.md): position of the i-th
+    #: REAL row in the encoded batch, when real rows were interleaved
+    #: into per-data-rank blocks so every mesh rank gets its share of
+    #: live work. None = real rows occupy the leading positions (the
+    #: single-device layout).
+    row_index: Optional[np.ndarray] = None
 
     @property
     def batch_size(self) -> int:

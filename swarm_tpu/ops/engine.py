@@ -223,6 +223,38 @@ def _dedup_rows(rows: Sequence[Response]):
     return uniq, back, keys
 
 
+def _place_rows_per_rank(nrows: list, padded: int, ranks: int):
+    """Spread ``nrows`` real rows over ``ranks`` contiguous per-rank
+    blocks of ``padded // ranks`` slots (docs/SHARDING.md placement
+    rule): rank r gets ``floor(n/R)`` or ``ceil(n/R)`` real rows at the
+    head of its block, padding fills the tails. Sharding a [B] batch
+    over 'data' is contiguous blocks, so without this a partial bucket
+    lands every real row on rank 0 and the rest of the mesh matches
+    pure padding.
+
+    Returns ``(placed_rows, row_index)``: a ``padded``-length row list
+    (pad slots are empty Responses — zero-length, matched by nothing)
+    and the position of each real row in it (``row_index[i]`` is where
+    real row i landed; relative order is preserved within and across
+    blocks, so verdict planes gather back with one fancy index)."""
+    n = len(nrows)
+    per = padded // ranks
+    base, extra = divmod(n, ranks)
+    placed: list = [None] * padded
+    row_index = np.empty(n, dtype=np.int64)
+    i = 0
+    for r in range(ranks):
+        take = base + (1 if r < extra else 0)
+        for j in range(take):
+            pos = r * per + j
+            placed[pos] = nrows[i]
+            row_index[i] = pos
+            i += 1
+    pad = Response()
+    placed = [row if row is not None else pad for row in placed]
+    return placed, row_index
+
+
 class MatchEngine:
     def __init__(
         self,
@@ -1364,19 +1396,48 @@ class MatchEngine:
             return batch, self.device
         data_ranks = self.sharded.ranks.get("data", 1)
         seq_ranks = self.sharded.ranks.get("seq", 1)
+        P = round_up(n_pad, data_ranks)
+        row_index = None
+        encode_rows = nrows
+        if data_ranks > 1 and nrows:
+            # scheduler-aware placement (docs/SHARDING.md): real rows
+            # interleave into per-data-rank blocks, so a partially
+            # filled bucket spreads its LIVE rows across every rank
+            # instead of handing rank 0 all the work and ranks 1..R-1
+            # pure padding (sharding over 'data' is contiguous blocks)
+            encode_rows, row_index = _place_rows_per_rank(
+                nrows, P, data_ranks
+            )
         batch = encode_batch(
-            nrows,
+            encode_rows,
             max_body=self.max_body,
             max_header=self.max_header,
-            pad_rows_to=round_up(n_pad, data_ranks),
+            pad_rows_to=P,
             reuse_buffers=reuse_buffers,
             width_multiple=512,
         )
+        if row_index is not None:
+            batch.row_index = row_index
+            from swarm_tpu.telemetry import shard_export
+
+            per = P // data_ranks
+            counts = np.bincount(row_index // per, minlength=data_ranks)
+            shard_export.RANK_FILL.set(float(counts.min()) / per)
         if seq_ranks > 1:
             from swarm_tpu.parallel.sharded import pad_streams_for_seq
 
             pad_streams_for_seq(batch.streams, seq_ranks, self.sharded.halo)
         return batch, self.sharded
+
+    def data_ranks(self) -> int:
+        """'data' mesh-axis size of the active backend (1 = single
+        device). The scheduler's bucket planner reads this so planned
+        row counts fill per shard (docs/SHARDING.md)."""
+        if not self._backend_ready:
+            self._resolve_backend()
+        if self.sharded is None:
+            return 1
+        return int(self.sharded.ranks.get("data", 1))
 
 
     # ------------------------------------------------------------------
@@ -1927,28 +1988,40 @@ class MatchEngine:
             planes = self._oracle_planes(B)
             self.stats.degraded_batches += 1
         pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = planes
-        # slice off bucket/mesh row padding before the host walk.
+        # slice off bucket/mesh row padding before the host walk: the
+        # leading B positions on the single-device layout, a fancy-
+        # index gather when the sharded placement interleaved real
+        # rows into per-data-rank blocks (batch.row_index). Degraded-
+        # mode oracle planes are already B rows — identity either way.
+        ridx = getattr(batch, "row_index", None)
+
+        def _rows_view(a):
+            a = np.asarray(a)
+            if ridx is not None and a.shape[0] != B:
+                return a[ridx]
+            return a[:B]
+
         # np.array(order="C"): ALWAYS a writable copy (the row-redo
         # pass writes rowbits back) AND row-major — XLA may hand back
         # F-ordered planes, which would poison every derived array
         # handed to the native pass (order-'K' copies preserve F)
-        pt_value = np.array(np.asarray(pt_value)[:B], order="C")
-        pt_unc = np.asarray(pt_unc)[:B]
-        pop_value = np.asarray(pop_value)[:B]
-        pop_unc = np.asarray(pop_unc)[:B]
-        pm_unc = np.asarray(pm_unc)[:B]
-        overflow = np.asarray(overflow)[:B]
+        pt_value = np.array(_rows_view(pt_value), order="C")
+        pt_unc = _rows_view(pt_unc)
+        pop_value = _rows_view(pop_value)
+        pop_unc = _rows_view(pop_unc)
+        pm_unc = _rows_view(pm_unc)
+        overflow = _rows_view(overflow)
         with self._stats_lock:
             self.stats.device_seconds += time.perf_counter() - t0
-        # compile-time attribution rides the DeviceDB counters (zero on
-        # the sharded matcher, which compiles per mesh shape instead)
+        # compile-time attribution rides the matcher's counters (the
+        # sharded matcher carries the same spy fields per mesh shape)
         self.stats.device_compile_seconds = getattr(
             matcher, "compile_seconds", 0.0
         )
         self.stats.device_compiles = getattr(matcher, "compile_count", 0)
         # rows needing whole-row reconfirmation (candidate overflow or
         # stream truncation made word bits unsound for the row)
-        row_redo = overflow | batch.truncated[:B]
+        row_redo = overflow | _rows_view(batch.truncated)
         self.stats.overflow_rows += int(row_redo.sum())
 
         t1 = time.perf_counter()

@@ -173,6 +173,16 @@ def _env_flag(name: str, default: bool) -> bool:
     return raw not in ("0", "off", "false", "no")
 
 
+def host_batch_leaves(streams: dict, lengths: dict, status) -> bool:
+    """Whether every per-batch input leaf is host numpy — the donation
+    precondition shared by :class:`DeviceDB` and the sharded matcher
+    (parallel/sharded.py). Caller-owned DEVICE arrays must never be
+    donated (the caller may reuse them next call; donation would hand
+    it a deleted buffer)."""
+    leaves = list(streams.values()) + list(lengths.values()) + [status]
+    return all(isinstance(v, np.ndarray) for v in leaves)
+
+
 class _StagingPool:
     """Per-batch device-upload staging for dispatch.
 
@@ -211,10 +221,16 @@ class _StagingPool:
             + sum(getattr(v, "nbytes", 0) for v in lengths.values())
             + int(getattr(status, "nbytes", 0))
         )
+        self.account(n_bytes)
+        return s_j, l_j, st_j, n_bytes
+
+    def account(self, n_bytes: int) -> None:
+        """Record one staged batch whose upload happened elsewhere (the
+        sharded matcher's multi-process path builds global jax.Arrays
+        itself — the accounting contract stays in this one place)."""
         with self._lock:
             self.uploads += 1
             self.bytes += n_bytes
-        return s_j, l_j, st_j, n_bytes
 
 
 class DeviceDB:
@@ -500,15 +516,11 @@ class DeviceDB:
 
     @staticmethod
     def _all_host(streams: dict, lengths: dict, status) -> bool:
-        """Whether every input leaf is host numpy — the donation
-        precondition. Caller-owned DEVICE arrays must never be donated
-        (the caller may reuse them next call; donation would hand it a
-        deleted buffer), so those dispatches take the non-donated
-        phase-B variant instead."""
-        leaves = (
-            list(streams.values()) + list(lengths.values()) + [status]
-        )
-        return all(isinstance(v, np.ndarray) for v in leaves)
+        """Donation precondition — see :func:`host_batch_leaves` (the
+        module helper, shared with the sharded matcher); dispatches
+        with caller-owned device inputs take the non-donated phase-B
+        variant instead."""
+        return host_batch_leaves(streams, lengths, status)
 
     def _spied_launch(self, fns: list, launch):
         """Run ``launch()`` with the compile spy held atomically: the

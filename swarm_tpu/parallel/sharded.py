@@ -1,6 +1,6 @@
 """The sharded match step: dp × tp × sp over a device mesh.
 
-This is the multi-chip execution path (the reference scaled by adding
+This is the multi-chip SERVING path (the reference scaled by adding
 droplets; this scales by sharding one batch across a TPU slice):
 
 - **data**: rows sharded; no cross-shard traffic until result gather.
@@ -16,12 +16,30 @@ droplets; this scales by sharding one batch across a TPU slice):
 
 The verdict stage runs replicated on every (model, seq) rank after the
 psum — it is tiny next to the probe stage.
+
+Production dispatch is SPLIT-PHASE with survivor compaction, the mesh
+twin of ``DeviceDB.dispatch`` (docs/SHARDING.md, docs/DEVICE_MATCH.md):
+a standing phase-A executable runs every rank's stacked bloom probe
+into a survivor RANK plane, ``pmax``-reduces the batch's max survivor
+count across the whole mesh, and the host reads back that ONE 4-byte
+scalar to pick phase B's ladder width (``compile.survivor_bucket``);
+phase B extracts/verifies at survivor size, psums the bit planes, and
+runs the replicated verdict tail. Per-batch uploads go through the
+dispatch staging pool and are DONATED to phase B together with the
+inter-phase rank plane; the fused single-kernel pjit step is kept as
+the bit-identical reference twin (``SWARM_SHARD_COMPACT=0`` /
+``SWARM_SHARD_DONATE=0``, or the ``compact=``/``donate=`` args).
+``dispatch``/``collect`` split the blocking host read out of the
+launch, so the continuous-batching scheduler keeps ≥2 mesh batches in
+flight exactly as on the single-device path.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
+import time
 from typing import Optional
 
 import jax
@@ -31,7 +49,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from swarm_tpu.fingerprints import compile as fpc
 from swarm_tpu.ops import hashing
-from swarm_tpu.ops.match import eval_verdicts, match_slots_args
+from swarm_tpu.ops.match import (
+    MAX_COMPILED,
+    _StagingPool,
+    _StreamCtx,
+    _col_starts_of,
+    _env_flag,
+    compact_candidates,
+    eval_verdicts,
+    fuse_planes,
+    global_candidate_budget,
+    host_batch_leaves,
+    lru_fetch,
+    lru_store,
+    match_slots_args,
+    prefilter_counts,
+    split_fused,
+    tiny_slot_bits,
+    verify_candidates,
+)
 from swarm_tpu.ops.md5 import md5_words
 
 
@@ -180,15 +216,54 @@ def pad_streams_for_seq(streams: dict, seq_ranks: int, halo: int) -> None:
             streams[name] = np.pad(arr, ((0, 0), (0, target - arr.shape[1])))
 
 
+_SHARD_METRICS = None
+
+
+def _shard_metrics():
+    """Lazy ``swarm_shard_*`` family handle (kept out of import time so
+    oracle-only users never touch the registry; the families themselves
+    register at telemetry import — telemetry/shard_export.py)."""
+    global _SHARD_METRICS
+    if _SHARD_METRICS is None:
+        from swarm_tpu.telemetry import shard_export
+
+        _SHARD_METRICS = shard_export
+    return _SHARD_METRICS
+
+
 @dataclasses.dataclass
 class ShardedMatcher:
-    """Builds and caches the pjit'd sharded match step for one mesh."""
+    """Builds and caches the pjit'd sharded match step for one mesh.
+
+    Serving surface (docs/SHARDING.md): :meth:`dispatch` launches the
+    split-phase compacted kernels asynchronously (the only blocking
+    point is the 4-byte pmax'd max-survivor scalar between phases);
+    :meth:`collect` pays the one fused host read. ``MatchEngine.
+    begin_packed``/``finish_packed`` route here exactly as they do to
+    ``DeviceDB``, so the scheduler's in-flight budget and walk offload
+    apply unchanged on the mesh. The fused single-kernel step stays as
+    the bit-identical reference twin (``compact=False``), and
+    ``donate=False`` keeps the staged uploads alive past the launch.
+    """
 
     db: fpc.CompiledDB
     mesh: Mesh
     candidate_k: int = 128
+    compact: Optional[bool] = None
+    donate: Optional[bool] = None
 
     def __post_init__(self):
+        if self.compact is None:
+            self.compact = _env_flag("SWARM_SHARD_COMPACT", True)
+        if self.donate is None:
+            self.donate = _env_flag("SWARM_SHARD_DONATE", True)
+        self.staging = _StagingPool()
+        self.compile_seconds = 0.0  # guarded-by: _counter_lock
+        self.compile_count = 0  # guarded-by: _counter_lock
+        #: most recent compacted dispatch: survivor_max / verify_k /
+        #: budget (the "phase B launches at survivor size" evidence)
+        self.last_compact: dict = {}  # guarded-by: _counter_lock
+        self._counter_lock = threading.Lock()
         self.ranks = {name: int(self.mesh.shape[name]) for name in self.mesh.axis_names}
         self.halo = max_entry_len(self.db) if self.ranks.get("seq", 1) > 1 else 0
         # the SAME argument-pytree convention as DeviceDB
@@ -226,7 +301,9 @@ class ShardedMatcher:
         else:
             self._tab_j = {k: jnp.asarray(v) for k, v in self._tab_np.items()}
             self._rep_j = jax.tree_util.tree_map(jnp.asarray, self._rep_np)
-        self._fn_cache: dict = {}
+        self._fn_cache: dict = {}  # guarded-by: _counter_lock
+        for ax, size in self.ranks.items():
+            _shard_metrics().MESH_AXIS.labels(axis=ax).set(size)
 
     def _global(self, arr, spec):
         """Host copy -> global array laid out per ``spec`` over the
@@ -238,39 +315,149 @@ class ShardedMatcher:
         )
 
     # ------------------------------------------------------------------
-    def _build(self, shape_key, full: bool = False):
-        db, halo = self.db, self.halo
-        meta = self.meta
+    # trace-time building blocks shared by the fused twin and the
+    # split-phase kernels — one implementation, so parity can't drift
+    # ------------------------------------------------------------------
+    def _smap(self):
+        """(shard_map, kwargs) — jax.shard_map landed post-0.4.x; older
+        jax ships it under experimental with check_rep instead of
+        check_vma."""
+        try:
+            smap = jax.shard_map
+            return smap, {"check_vma": False}
+        except AttributeError:
+            from jax.experimental.shard_map import shard_map as smap
+
+            return smap, {"check_rep": False}
+
+    def _specs(self, streams: dict, lengths: dict):
+        """(tab, rep, streams, lengths) partition specs for one batch
+        shape — corpus slices over 'model', replicated verdict/rx,
+        rows over 'data', response bytes over 'seq'."""
+        return (
+            {name: P("model") for name in self._tab_np},
+            jax.tree_util.tree_map(lambda _a: P(), self._rep_np),
+            {k: P("data", "seq") for k in streams},
+            {k: P("data") for k in lengths},
+        )
+
+    def _exchange_halos(self, streams: dict):
+        """Halo exchange over 'seq' (trace-time no-op when unsharded):
+        each rank borrows ``halo`` bytes from both neighbors via
+        ppermute so words spanning shard boundaries verify on exactly
+        the rank that owns their gram position. Returns
+        ``(streams_ext, offsets, back, fwd)``."""
         seq_ranks = self.ranks.get("seq", 1)
+        if seq_ranks <= 1:
+            return streams, 0, 0, 0
+        halo = self.halo
+        seq_index = jax.lax.axis_index("seq")
+        ext: dict = {}
+        offsets: dict = {}
+        for name, local in streams.items():
+            fwd_halo = jax.lax.ppermute(
+                local[:, :halo],
+                "seq",
+                [(r, r - 1) for r in range(1, seq_ranks)],
+            )
+            back_halo = jax.lax.ppermute(
+                local[:, -halo:],
+                "seq",
+                [(r, r + 1) for r in range(seq_ranks - 1)],
+            )
+            ext[name] = jnp.concatenate([back_halo, local, fwd_halo], axis=1)
+            offsets[name] = seq_index * local.shape[1]
+        return ext, offsets, halo, halo
+
+    def _combine_finish(
+        self, value_bits, uncertain_bits, overflow, streams, lengths,
+        status, rep, full,
+    ):
+        """Shared tail of every sharded route: psum the per-rank bit
+        planes over the communicating axes, then the replicated verdict
+        stage (device md5, device regex verify, verdict lowering) and
+        the fused-plane pack. Runs at trace time inside the step."""
+        db = self.db
+        seq_ranks = self.ranks.get("seq", 1)
+        combine_axes = tuple(
+            ax for ax in ("model", "seq") if self.ranks.get(ax, 1) > 1
+        )
+        if combine_axes:
+            value_bits = (
+                jax.lax.psum(value_bits.astype(jnp.int32), combine_axes) > 0
+            )
+            uncertain_bits = (
+                jax.lax.psum(uncertain_bits.astype(jnp.int32), combine_axes)
+                > 0
+            )
+            overflow = (
+                jax.lax.psum(overflow.astype(jnp.int32), combine_axes) > 0
+            )
+
+        # device md5 (ops/md5.py): the block chain is sequential in
+        # the byte dimension, so a seq-sharded body is re-gathered
+        # (tiled over ICI) just for the digest — cheap next to the
+        # probe stage, and only when the corpus compares digests
+        def full_stream(name):
+            local = streams[name]
+            if seq_ranks > 1:
+                return jax.lax.all_gather(local, "seq", axis=1, tiled=True)
+            return local
+
+        digest = None
+        if bool(db.m_md5_check.any()) and "body" in streams:
+            digest = md5_words(full_stream("body"), lengths["body"])
+        # device regex verify over the combined slot bits: like md5
+        # it needs whole rows, so used streams gather over 'seq'
+        rx = None
+        if len(db.rx_m_ids):
+            from swarm_tpu.ops.encoding import STREAMS
+            from swarm_tpu.ops.regexdev import regex_verify
+
+            used = {STREAMS[int(s)] for s in db.rx_seq_stream}
+            gathered = {n: full_stream(n) for n in used}
+            rx = regex_verify(
+                db,
+                gathered,
+                lengths,
+                value_bits,
+                k_pairs=db.rx_k_pairs(status.shape[0]),
+                arrays=rep["rx"],
+            )
+        out = eval_verdicts(
+            db,
+            value_bits,
+            uncertain_bits,
+            lengths,
+            status,
+            full=full,
+            md5_digest=digest,
+            rx=rx,
+            arrays=rep["verdict"],
+        )
+        if full:
+            # pack bit planes per data-rank (axis 1 is unsharded, so
+            # packed bytes concatenate cleanly over 'data') and fuse
+            # them with the overflow column into ONE output array —
+            # the host then makes a single device read (split_fused)
+            return fuse_planes(out, overflow)
+        return (*out, overflow)
+
+    # ------------------------------------------------------------------
+    # executable builders (one per batch shape, LRU-bounded)
+    # ------------------------------------------------------------------
+    def _build_fused(self, streams: dict, lengths: dict, full: bool):
+        """The fused single-kernel pjit step — the legacy reference
+        twin (``compact=False``, or a corpus with no word tables)."""
+        db = self.db
+        meta = self.meta
         candidate_k = self.candidate_k
 
+        # jit-captures: self, db, meta, candidate_k, full (host metadata
+        # + scalars — trace-static; the corpus rides the tab/rep
+        # ARGUMENTS, never the closure)
         def step(tab, rep, streams, lengths, status):
-            # --- halo exchange over 'seq' (no-op when unsharded) ---
-            back = fwd = 0
-            offsets = 0
-            streams_ext = streams
-            if seq_ranks > 1:
-                seq_index = jax.lax.axis_index("seq")
-                ext = {}
-                offsets = {}
-                for name, local in streams.items():
-                    fwd_halo = jax.lax.ppermute(
-                        local[:, :halo],
-                        "seq",
-                        [(r, r - 1) for r in range(1, seq_ranks)],
-                    )
-                    back_halo = jax.lax.ppermute(
-                        local[:, -halo:],
-                        "seq",
-                        [(r, r + 1) for r in range(seq_ranks - 1)],
-                    )
-                    ext[name] = jnp.concatenate([back_halo, local, fwd_halo], axis=1)
-                    offsets[name] = seq_index * local.shape[1]
-                streams_ext = ext
-                back = fwd = halo
-
-            # --- probe with this rank's table slices (two-phase
-            # argument-driven kernel, ops/match.py) ---
+            streams_ext, offsets, back, fwd = self._exchange_halos(streams)
             arrays = {
                 "tab": {k: v[0] for k, v in tab.items()},
                 "slot_bytes": rep["slot_bytes"],
@@ -289,178 +476,334 @@ class ShardedMatcher:
                 back_halo=back,
                 fwd_halo=fwd,
             )
-
-            # --- combine pattern-space + byte-space partial bits ---
-            combine_axes = tuple(
-                ax
-                for ax in ("model", "seq")
-                if self.ranks.get(ax, 1) > 1
+            return self._combine_finish(
+                value_bits, uncertain_bits, overflow, streams, lengths,
+                status, rep, full,
             )
-            if combine_axes:
-                value_bits = jax.lax.psum(value_bits.astype(jnp.int32), combine_axes) > 0
-                uncertain_bits = (
-                    jax.lax.psum(uncertain_bits.astype(jnp.int32), combine_axes) > 0
-                )
-                overflow = jax.lax.psum(overflow.astype(jnp.int32), combine_axes) > 0
 
-            # device md5 (ops/md5.py): the block chain is sequential in
-            # the byte dimension, so a seq-sharded body is re-gathered
-            # (tiled over ICI) just for the digest — cheap next to the
-            # probe stage, and only when the corpus compares digests
-            def full_stream(name):
-                local = streams[name]
-                if seq_ranks > 1:
-                    return jax.lax.all_gather(
-                        local, "seq", axis=1, tiled=True
-                    )
-                return local
-
-            digest = None
-            if bool(db.m_md5_check.any()) and "body" in streams:
-                digest = md5_words(full_stream("body"), lengths["body"])
-            # device regex verify over the combined slot bits: like md5
-            # it needs whole rows, so used streams gather over 'seq'
-            rx = None
-            if len(db.rx_m_ids):
-                from swarm_tpu.ops.encoding import STREAMS
-                from swarm_tpu.ops.regexdev import regex_verify
-
-                used = {STREAMS[int(s)] for s in db.rx_seq_stream}
-                gathered = {n: full_stream(n) for n in used}
-                rx = regex_verify(
-                    db,
-                    gathered,
-                    lengths,
-                    value_bits,
-                    k_pairs=db.rx_k_pairs(status.shape[0]),
-                    arrays=rep["rx"],
-                )
-            out = eval_verdicts(
-                db,
-                value_bits,
-                uncertain_bits,
-                lengths,
-                status,
-                full=full,
-                md5_digest=digest,
-                rx=rx,
-                arrays=rep["verdict"],
-            )
-            if full:
-                # pack bit planes per data-rank (axis 1 is unsharded, so
-                # packed bytes concatenate cleanly over 'data') and fuse
-                # them with the overflow column into ONE output array —
-                # the host then makes a single device read (split_fused)
-                from swarm_tpu.ops.match import fuse_planes
-
-                return fuse_planes(out, overflow)
-            return (*out, overflow)
-
-        # jax.shard_map landed post-0.4.x; older jax ships it under
-        # experimental with check_rep instead of check_vma
-        try:
-            smap = jax.shard_map
-            smap_kwargs = {"check_vma": False}
-        except AttributeError:
-            from jax.experimental.shard_map import shard_map as smap
-
-            smap_kwargs = {"check_rep": False}
-        mesh = self.mesh
-        stream_spec = {k: P("data", "seq") for k in shape_key["streams"]}
-        tab_specs = {name: P("model") for name in self._tab_np}
-        rep_specs = jax.tree_util.tree_map(lambda _a: P(), self._rep_np)
+        smap, smap_kwargs = self._smap()
+        tab_specs, rep_specs, stream_spec, lengths_spec = self._specs(
+            streams, lengths
+        )
         out_specs = P("data") if full else (P("data"),) * 3
         fn = smap(
             step,
-            mesh=mesh,
+            mesh=self.mesh,
             in_specs=(
-                tab_specs,
-                rep_specs,
-                stream_spec,
-                {k: P("data") for k in shape_key["lengths"]},
-                P("data"),
+                tab_specs, rep_specs, stream_spec, lengths_spec, P("data"),
             ),
             out_specs=out_specs,
             **smap_kwargs,
         )
         return jax.jit(fn)
 
+    def _build_phase_a(self, streams: dict, lengths: dict):
+        """Standing sharded phase A: per-rank stacked bloom probe →
+        survivor RANK plane + per-rank overflow + the globally
+        ``pmax``'d max survivor count (the ONE scalar the host reads
+        between phases). The rank plane and overflow keep an explicit
+        leading (model, seq) axis — every rank's candidate space is
+        distinct, and phase B slices its own plane back out."""
+        meta = self.meta
+        budget = global_candidate_budget(
+            self.candidate_k, len(meta.table_stream)
+        )
+
+        # jit-captures: self, meta, budget (layout metadata + a python
+        # int; both trace-static)
+        def step_a(tab, streams, lengths):
+            streams_ext, offsets, back, fwd = self._exchange_halos(streams)
+            ctx = _StreamCtx(streams_ext, lengths, offsets)
+            cnt, _cs = prefilter_counts(
+                meta, {k: v[0] for k, v in tab.items()}, ctx, back, fwd
+            )
+            n_surv = cnt[:, -1]
+            K = max(1, min(budget, cnt.shape[1]))
+            overflow = n_surv > K
+            nmax = jnp.max(jnp.minimum(n_surv, K))
+            # global max across the whole mesh: rows over 'data', each
+            # rank's own candidate space over 'model'/'seq' — the host
+            # reads ONE replicated scalar however the mesh factors
+            nmax = jax.lax.pmax(nmax, tuple(self.mesh.axis_names))
+            return cnt[None], overflow[None], nmax
+
+        smap, smap_kwargs = self._smap()
+        tab_specs, _rep_specs, stream_spec, lengths_spec = self._specs(
+            streams, lengths
+        )
+        rank_spec = P(("model", "seq"), "data")
+        fn = smap(
+            step_a,
+            mesh=self.mesh,
+            in_specs=(tab_specs, stream_spec, lengths_spec),
+            out_specs=(rank_spec, rank_spec, P()),
+            **smap_kwargs,
+        )
+        return jax.jit(fn)
+
+    def _build_phase_b(
+        self, streams: dict, lengths: dict, kc: int, full: bool,
+        donate_streams: bool,
+    ):
+        """Sharded phase B at the static ladder rung ``kc``: per-rank
+        survivor extraction from the phase-A rank plane, gather-verify
+        + tiny at survivor size, psum, and the replicated verdict tail.
+        The staged per-batch uploads and the inter-phase rank plane are
+        DONATED so XLA reuses their buffers (``donate_streams=False``
+        — caller-owned device inputs — still donates the rank plane,
+        which this matcher owns)."""
+        db = self.db
+        meta = self.meta
+        budget = global_candidate_budget(
+            self.candidate_k, len(meta.table_stream)
+        )
+
+        # jit-captures: self, db, meta, budget, kc, full (metadata and
+        # scalars only — kc is the ladder rung this executable serves)
+        def step_b(tab, rep, streams, lengths, status, cnt_r, ovf_r):
+            streams_ext, offsets, back, fwd = self._exchange_halos(streams)
+            ctx = _StreamCtx(streams_ext, lengths, offsets)
+            tabr = {k: v[0] for k, v in tab.items()}
+            cnt = cnt_r[0]
+            overflow = ovf_r[0]
+            K = max(1, min(budget, cnt.shape[1]))
+            col = compact_candidates(cnt, kc, K)
+            # candidate axis = LOCAL window coordinates (pre-halo
+            # widths), exactly what prefilter_counts concatenated
+            col_starts = _col_starts_of(meta, streams)
+            value_bits, uncertain_bits = verify_candidates(
+                meta,
+                tabr,
+                rep["slot_bytes"],
+                rep["slot_len"],
+                ctx,
+                col,
+                col_starts,
+                db.num_slots,
+                back,
+                fwd,
+            )
+            value_bits = tiny_slot_bits(
+                meta, rep["tiny_bytes"], rep["tiny_slot"], ctx, value_bits,
+                back,
+            )
+            return self._combine_finish(
+                value_bits, uncertain_bits, overflow, streams, lengths,
+                status, rep, full,
+            )
+
+        smap, smap_kwargs = self._smap()
+        tab_specs, rep_specs, stream_spec, lengths_spec = self._specs(
+            streams, lengths
+        )
+        rank_spec = P(("model", "seq"), "data")
+        out_specs = P("data") if full else (P("data"),) * 3
+        fn = smap(
+            step_b,
+            mesh=self.mesh,
+            in_specs=(
+                tab_specs, rep_specs, stream_spec, lengths_spec, P("data"),
+                rank_spec, rank_spec,
+            ),
+            out_specs=out_specs,
+            **smap_kwargs,
+        )
+        donate = (
+            (2, 3, 4, 5, 6) if donate_streams else (5, 6)
+        )  # streams, lengths, status, cnt, overflow | cnt, overflow
+        return jax.jit(fn, donate_argnums=donate)
+
     # ------------------------------------------------------------------
-    def match(self, streams: dict, lengths: dict, status, full: bool = False):
+    def _get_fn(self, key, builder):
+        """(fn, freshly_built) from the LRU-bounded executable cache.
+        Ladder rungs multiply the live entries (one pjit per
+        (shape, kc) pair), hence the same generous 4x churn bound
+        DeviceDB applies to its jit caches. Runs under
+        ``_counter_lock``: with the walk offload armed, the submit
+        thread (dispatch) and the walk worker (a degraded batch's
+        sync-path retry) can reach this cache concurrently, and
+        ``lru_fetch``'s refresh pops/reinserts — an unlocked race
+        could evict the same key twice or compile twin wrappers.
+        Building the wrapper under the lock is cheap (jit/shard_map
+        construction only; XLA compiles at first call)."""
+        with self._counter_lock:
+            fn = lru_fetch(self._fn_cache, key)
+            fresh = fn is None
+            if fresh:
+                fn = builder()
+                lru_store(self._fn_cache, key, fn, 4 * MAX_COMPILED)
+        return fn, fresh
+
+    def _check_seq_widths(self, streams: dict) -> None:
+        seq_ranks = self.ranks.get("seq", 1)
+        if seq_ranks <= 1:
+            return
+        for name, arr in streams.items():
+            per_rank = arr.shape[1] // seq_ranks
+            if arr.shape[1] % seq_ranks:
+                raise ValueError(
+                    f"stream {name!r} width {arr.shape[1]} not divisible "
+                    f"by seq ranks {seq_ranks}"
+                )
+            if per_rank < self.halo:
+                # the halo slices local[:, :halo] would silently come
+                # up short and misalign every window coordinate
+                raise ValueError(
+                    f"stream {name!r}: per-rank width {per_rank} < halo "
+                    f"{self.halo} (longest table entry); widen the "
+                    f"stream or lower the seq factor"
+                )
+
+    def _stage(self, streams: dict, lengths: dict, status):
+        """Upload one batch through the dispatch staging pool: always a
+        COPY (plain ``jnp.asarray`` single-process, global jax.Arrays
+        spanning the mesh multi-process), so phase-B donation can never
+        corrupt caller-owned numpy — the engine's recycled encode
+        planes keep rotating untouched."""
+        if not self.multiprocess:
+            s_j, l_j, st_j, _staged = self.staging.stage(
+                streams, lengths, status
+            )
+            return s_j, l_j, st_j
+        s_j = {
+            k: self._global(v, P("data", "seq")) for k, v in streams.items()
+        }
+        l_j = {k: self._global(v, P("data")) for k, v in lengths.items()}
+        st_j = self._global(status, P("data"))
+        self.staging.account(
+            int(
+                sum(getattr(v, "nbytes", 0) for v in streams.values())
+                + sum(getattr(v, "nbytes", 0) for v in lengths.values())
+                + int(getattr(status, "nbytes", 0))
+            )
+        )
+        return s_j, l_j, st_j
+
+    def _note_launch(self, fresh: bool, t0: float) -> None:
+        """Compile accounting at the dispatch boundary (same contract
+        as DeviceDB's spy: wall time of dispatches that built at least
+        one new executable)."""
+        if not fresh:
+            return
+        with self._counter_lock:
+            self.compile_seconds += time.perf_counter() - t0
+            self.compile_count += 1
+
+    def _dispatch_metrics(self, streams: dict, halo_exchanges: int = 1) -> None:
+        m = _shard_metrics()
+        m.SHARD_DISPATCHES.inc(1)
+        B = int(next(iter(streams.values())).shape[0])
+        ns = max(self.db.num_slots, 1)
+        if any(self.ranks.get(ax, 1) > 1 for ax in ("model", "seq")):
+            # value + uncertain + overflow int32 lanes entering the
+            # cross-rank psum (docs/SHARDING.md: B × NS bits per step)
+            m.PSUM_BYTES.inc(B * (2 * ns + 1) * 4)
+        if self.ranks.get("seq", 1) > 1:
+            # the split-phase path pays the exchange in BOTH phases
+            # (each kernel re-derives its extended stream views rather
+            # than shipping [B, W+2h] buffers across the phase
+            # boundary), so the counter charges every ppermute round
+            m.HALO_BYTES.inc(
+                halo_exchanges * 2 * self.halo * B * len(streams)
+            )
+
+    # ------------------------------------------------------------------
+    def dispatch(self, streams: dict, lengths: dict, status, full: bool = True):
+        """Async half of :meth:`match`: stage the batch, launch the
+        sharded kernel(s), and return the (device-resident, still-
+        computing) output WITHOUT a full host transfer — the
+        continuous-batching scheduler dispatches batch i+1 here before
+        walking batch i's verdicts; :meth:`collect` finalizes.
+
+        On the compacted path the only blocking point is the phase-A
+        max-survivor scalar read (4 bytes, ``pmax``'d across the whole
+        mesh) that picks phase B's ladder width."""
         from swarm_tpu.resilience.faults import fault_point
 
         # same fault point as DeviceDB.dispatch: "the device path
         # failed" is one failure class whichever matcher serves it
         # (MatchEngine degrades to the CPU oracle either way)
         fault_point("device.dispatch")
-        seq_ranks = self.ranks.get("seq", 1)
-        if seq_ranks > 1:
-            for name, arr in streams.items():
-                per_rank = arr.shape[1] // seq_ranks
-                if arr.shape[1] % seq_ranks:
-                    raise ValueError(
-                        f"stream {name!r} width {arr.shape[1]} not divisible "
-                        f"by seq ranks {seq_ranks}"
-                    )
-                if per_rank < self.halo:
-                    # the halo slices local[:, :halo] would silently come
-                    # up short and misalign every window coordinate
-                    raise ValueError(
-                        f"stream {name!r}: per-rank width {per_rank} < halo "
-                        f"{self.halo} (longest table entry); widen the "
-                        f"stream or lower the seq factor"
-                    )
-        shape_key = {
-            "streams": tuple(sorted((k, v.shape) for k, v in streams.items())),
-            "lengths": tuple(sorted(lengths)),
-        }
-        cache_key = (shape_key["streams"], full)
-        from swarm_tpu.ops.match import MAX_COMPILED, lru_fetch, lru_store
+        self._check_seq_widths(streams)
+        skey = tuple(sorted((k, v.shape) for k, v in streams.items()))
+        lkey = tuple(sorted(lengths))
+        t0 = time.perf_counter()
+        s_j, l_j, st_j = self._stage(streams, lengths, status)
+        if not (self.compact and len(self.meta.table_stream)):
+            # fused legacy/reference arm (also the no-tables corpus,
+            # where there is nothing to compact)
+            fn, fresh = self._get_fn(
+                ("fused", skey, lkey, full),
+                lambda: self._build_fused(streams, lengths, full),
+            )
+            out = fn(self._tab_j, self._rep_j, s_j, l_j, st_j)
+            self._note_launch(fresh, t0)
+            self._dispatch_metrics(streams)
+            return out
 
-        fn = lru_fetch(self._fn_cache, cache_key)
-        if fn is None:
-            fn = self._build(
-                {"streams": {k: None for k in streams}, "lengths": {k: None for k in lengths}},
-                full=full,
-            )
-            # bound live executables like DeviceDB (shape churn would
-            # grow RSS without limit — constants are captured per jit)
-            lru_store(self._fn_cache, cache_key, fn, MAX_COMPILED)
+        donate_streams = self.donate and host_batch_leaves(
+            streams, lengths, status
+        )
+        fa, fresh_a = self._get_fn(
+            ("A", skey, lkey), lambda: self._build_phase_a(streams, lengths)
+        )
+        cnt, ovf, nmax = fa(self._tab_j, s_j, l_j)
+        # the ONE host sync between phases: the globally pmax'd
+        # survivor scalar that sizes phase B to live work — the second
+        # blessed 4-byte sync (tools/swarmlint jit-hygiene contract)
+        n_live = int(nmax)  # host-sync-ok: the blessed sharded 4-byte phase-A survivor scalar
+        budget = global_candidate_budget(
+            self.candidate_k, len(self.meta.table_stream)
+        )
+        kc = fpc.survivor_bucket(n_live, budget)
+        fb, fresh_b = self._get_fn(
+            ("B", skey, lkey, kc, full, donate_streams),
+            lambda: self._build_phase_b(
+                streams, lengths, kc, full, donate_streams
+            ),
+        )
+        out = fb(self._tab_j, self._rep_j, s_j, l_j, st_j, cnt, ovf)
+        self._note_launch(fresh_a or fresh_b, t0)
+        with self._counter_lock:
+            self.last_compact = {
+                "survivor_max": n_live,
+                "verify_k": kc,
+                "budget": budget,
+            }
+        m = _shard_metrics()
+        m.SURVIVOR_MAX.set(n_live)
+        self._dispatch_metrics(streams, halo_exchanges=2)
+        return out
+
+    def collect(self, out):
+        """Blocking half of the full-mode split: one host read of the
+        fused plane array (gathered host-local over DCN first on
+        multi-process meshes), sliced into the engine's six outputs."""
         if self.multiprocess:
-            args = (
-                self._tab_j,
-                self._rep_j,
-                {k: self._global(v, P("data", "seq")) for k, v in streams.items()},
-                {k: self._global(v, P("data")) for k, v in lengths.items()},
-                self._global(status, P("data")),
+            from jax.experimental import multihost_utils
+
+            out = multihost_utils.global_array_to_host_local_array(
+                out, self.mesh, P()
             )
-        else:
-            args = (
-                self._tab_j,
-                self._rep_j,
-                {k: jnp.asarray(v) for k, v in streams.items()},
-                {k: jnp.asarray(v) for k, v in lengths.items()},
-                jnp.asarray(status),
-            )
-        out = fn(*args)
+        return split_fused(self.db, np.asarray(out))
+
+    # ------------------------------------------------------------------
+    def match(self, streams: dict, lengths: dict, status, full: bool = False):
+        """Synchronous convenience: :meth:`dispatch` + the blocking
+        read. ``full=False`` returns the (t_value, t_unc, overflow)
+        device tuple exactly as before the split."""
+        out = self.dispatch(streams, lengths, status, full=full)
+        if full:
+            return self.collect(out)
         if self.multiprocess:
             # global -> host-local (replicated) so every process can
             # read the full result; riding DCN once per batch
             from jax.experimental import multihost_utils
 
-            if full:
-                out = multihost_utils.global_array_to_host_local_array(
-                    out, self.mesh, P()
+            out = tuple(
+                multihost_utils.global_array_to_host_local_array(
+                    o, self.mesh, P()
                 )
-            else:
-                out = tuple(
-                    multihost_utils.global_array_to_host_local_array(
-                        o, self.mesh, P()
-                    )
-                    for o in out
-                )
-        if full:
-            from swarm_tpu.ops.match import split_fused
-
-            return split_fused(self.db, np.asarray(out))
+                for o in out
+            )
         return out

@@ -73,13 +73,20 @@ class PlannedBatch:
     bucket: str  # "w<body>h<header>" | "memo"
     kind: str  # "fresh" | "memo"
     final: bool = False  # end-of-stream partial flush
+    #: 'data' mesh-axis size of the engine backend (docs/SHARDING.md):
+    #: the engine rounds the padded batch up to a multiple of it, and
+    #: fill accounting must charge that mesh padding too
+    data_ranks: int = 1
 
     @property
     def fill_rows(self) -> float:
         """Row occupancy of the padded device batch this will become
-        (the engine pads unique rows up to a 256 multiple)."""
+        (the engine pads unique rows up to a 256 multiple, then up to
+        a multiple of the 'data' axis on a mesh backend)."""
         n = len(self.rows)
         padded = max(256, ((n + 255) // 256) * 256)
+        r = max(1, int(self.data_ranks))
+        padded = ((padded + r - 1) // r) * r
         return n / padded
 
 
@@ -96,8 +103,17 @@ class BucketPlanner:
         width_multiple: int = 512,
         max_body: int = 4096,
         max_header: int = 1024,
+        data_ranks: int = 1,
     ):
+        self.data_ranks = max(1, int(data_ranks))
+        # mesh-aware placement (docs/SHARDING.md): a full bucket must
+        # divide evenly over the 'data' axis so every rank's block is
+        # the same share of REAL rows — a 2048-row bucket on an 8-way
+        # data axis flushes at 2048 (256 real rows per rank), never at
+        # a count that leaves one rank mostly padding
         self.rows_target = max(1, int(rows_target))
+        r = self.data_ranks
+        self.rows_target = ((self.rows_target + r - 1) // r) * r
         self.width_multiple = width_multiple
         self.max_body = max_body
         self.max_header = max_header
@@ -130,6 +146,7 @@ class BucketPlanner:
             return PlannedBatch(
                 ids=slot[0], rows=slot[1],
                 bucket=f"w{key[0]}h{key[1]}", kind="fresh",
+                data_ranks=self.data_ranks,
             )
         return None
 
@@ -139,7 +156,7 @@ class BucketPlanner:
         if len(self._memo_ids) >= self.rows_target:
             out = PlannedBatch(
                 ids=self._memo_ids, rows=self._memo_rows,
-                bucket="memo", kind="memo",
+                bucket="memo", kind="memo", data_ranks=self.data_ranks,
             )
             self._memo_ids, self._memo_rows = [], []
             return out
@@ -155,11 +172,13 @@ class BucketPlanner:
             yield PlannedBatch(
                 ids=ids, rows=rows,
                 bucket=f"w{key[0]}h{key[1]}", kind="fresh", final=True,
+                data_ranks=self.data_ranks,
             )
         if self._memo_ids:
             yield PlannedBatch(
                 ids=self._memo_ids, rows=self._memo_rows,
                 bucket="memo", kind="memo", final=True,
+                data_ranks=self.data_ranks,
             )
             self._memo_ids, self._memo_rows = [], []
 

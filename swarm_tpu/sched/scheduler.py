@@ -280,10 +280,16 @@ class BatchScheduler:
         cfg = self.config
         stats = self.stats
         target = cfg.rows_target or engine.batch_rows
+        # mesh-aware placement (docs/SHARDING.md): a sharded backend's
+        # bucket targets round up to the 'data' axis size so full
+        # buckets fill PER RANK; single-device (and stub) engines
+        # report 1 and nothing changes
+        data_ranks = getattr(engine, "data_ranks", lambda: 1)()
         planner = BucketPlanner(
             rows_target=target,
             max_body=engine.max_body,
             max_header=engine.max_header,
+            data_ranks=data_ranks,
         )
         # chunk bookkeeping (prefetch registers, submission completes;
         # the lock only matters in threaded mode)
@@ -354,6 +360,7 @@ class BatchScheduler:
                             pb = PlannedBatch(
                                 ids=range(gid, gid + len(rows)),
                                 rows=rows, bucket="memo", kind="memo",
+                                data_ranks=data_ranks,
                             )
                             gid += len(rows)
                             yield pb, spec_pre

@@ -1,0 +1,60 @@
+"""Always-registered ``swarm_shard_*`` metric families (docs/SHARDING.md).
+
+The mesh serving path's counters are the scrape-time surface of
+:class:`~swarm_tpu.parallel.sharded.ShardedMatcher`. They are created
+at telemetry import time — not on first sharded dispatch — so EVERY
+process's ``/metrics`` carries the families with a rendered sample
+(``tools/check_metrics.py`` requires them on a server that has no mesh
+at all; a fleet operator can then tell "no mesh configured" from
+"family missing" at a glance).
+"""
+
+from __future__ import annotations
+
+from swarm_tpu.telemetry.metrics import REGISTRY
+
+#: mesh axis sizes of the most recently constructed ShardedMatcher
+#: (data × model × seq — docs/SHARDING.md; 0 = no mesh in this process)
+MESH_AXIS = REGISTRY.gauge(
+    "swarm_shard_mesh_axis_size",
+    "Mesh axis size of the live sharded matcher (0 = unsharded)",
+    ("axis",),
+)
+#: min per-data-rank real-row occupancy of the most recent sharded
+#: batch (the scheduler-aware placement target: every rank should hold
+#: ~1/R of the batch's REAL rows, not one rank all-real + R-1 all-pad)
+RANK_FILL = REGISTRY.gauge(
+    "swarm_shard_rank_fill_ratio",
+    "Min per-data-rank real-row fill of the most recent sharded batch",
+)
+#: slot/overflow plane bytes entering the cross-rank psum per dispatch
+#: (global rows × (2·slots + overflow) int32 lanes; 0 when the mesh has
+#: no communicating model/seq axis)
+PSUM_BYTES = REGISTRY.counter(
+    "swarm_shard_psum_bytes_total",
+    "Bit-plane bytes combined over ICI by the sharded match psum",
+)
+#: ppermute halo-exchange bytes per dispatch (2 × halo × rows per
+#: stream; 0 on seq-unsharded meshes)
+HALO_BYTES = REGISTRY.counter(
+    "swarm_shard_halo_bytes_total",
+    "Response-stream bytes exchanged as seq-axis ppermute halos",
+)
+SHARD_DISPATCHES = REGISTRY.counter(
+    "swarm_shard_dispatches_total",
+    "Batches dispatched through the sharded mesh matcher",
+)
+#: the most recent compacted sharded batch's global max per-row
+#: survivor count (the pmax'd scalar the host reads between phases)
+SURVIVOR_MAX = REGISTRY.gauge(
+    "swarm_shard_survivor_max",
+    "Max per-row prefilter survivors (global pmax) in the most recent "
+    "compacted sharded batch",
+)
+
+# pre-seed the axis labels so the family always renders samples (a
+# labeled family with no observed combos renders no lines, which would
+# read as "family missing" to the exposition check)
+for _ax in ("data", "model", "seq"):
+    MESH_AXIS.labels(axis=_ax).set(0)
+del _ax

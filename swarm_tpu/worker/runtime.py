@@ -692,7 +692,18 @@ class JobProcessor:
         if engine is None:
             from swarm_tpu.fingerprints.dbcache import load_or_compile
             from swarm_tpu.ops.engine import MatchEngine
+            from swarm_tpu.parallel.multihost import (
+                maybe_initialize_distributed,
+            )
 
+            # multi-host engine bring-up (docs/SHARDING.md): join the
+            # DCN process group BEFORE the engine's auto-mesh resolves,
+            # so jax.devices() spans every host's chips and the mesh is
+            # slice-wide. Idempotent and a no-op without the
+            # SWARM_COORDINATOR/-NUM_PROCESSES/-PROCESS_ID triplet —
+            # embedded workers (started without main()) get the same
+            # bring-up as the CLI path.
+            maybe_initialize_distributed()
             # disk-cached corpus compile (+ persistent XLA cache): a
             # warm worker builds the full-corpus engine in ~a second.
             # cfg.pipeline routes bulk matching through the continuous-

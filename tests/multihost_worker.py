@@ -48,11 +48,59 @@ def build_world():
     return db, batch
 
 
+def probe() -> None:
+    """Capability probe (SWARM_MH_PROBE=1): form the 2-process group
+    and run ONE tiny cross-process psum. Exercises exactly the
+    capability the full tests need — a jaxlib whose backend lacks
+    multiprocess collectives fails here in seconds with the
+    characteristic XlaRuntimeError, and the parent skips the heavy
+    cases with that reason instead of timing them out."""
+    import jax
+
+    from swarm_tpu.parallel.multihost import maybe_initialize_distributed
+
+    assert maybe_initialize_distributed(), "distributed init did not run"
+    assert jax.process_count() == 2, jax.process_count()
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("data",))
+    try:
+        smap = jax.shard_map
+        kw = {"check_vma": False}
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as smap
+
+        kw = {"check_rep": False}
+    fn = jax.jit(
+        smap(
+            lambda x: jax.lax.psum(x.sum(), "data"),
+            mesh=mesh,
+            in_specs=P("data"),
+            out_specs=P(),
+            **kw,
+        )
+    )
+    arr = np.ones((len(devices),), dtype=np.int32)
+    x = jax.make_array_from_callback(
+        arr.shape, NamedSharding(mesh, P("data")), lambda idx: arr[idx]
+    )
+    total = int(np.asarray(fn(x)))
+    assert total == len(devices), total
+    print(f"probe rank {jax.process_index()} ok", flush=True)
+
+
 def main() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+    if os.environ.get("SWARM_MH_PROBE"):
+        probe()
+        return
 
     from swarm_tpu.parallel.multihost import maybe_initialize_distributed
 
@@ -77,12 +125,28 @@ def main() -> None:
     assert matcher.multiprocess
     tv, tu, ov = matcher.match(batch.streams, batch.lengths, batch.status)
 
+    # the serving split (docs/SHARDING.md): dispatch launches the
+    # split-phase compacted kernels across BOTH processes' devices,
+    # collect gathers the fused plane host-local over the DCN stand-in
+    pending = matcher.dispatch(
+        batch.streams, batch.lengths, batch.status, full=True
+    )
+    planes = matcher.collect(pending)
+
     out_path = os.environ["SWARM_MH_OUT"]
     np.savez(
         f"{out_path}.rank{jax.process_index()}",
         t_value=np.asarray(tv),
         t_unc=np.asarray(tu),
         overflow=np.asarray(ov),
+        **{
+            f"full_{name}": np.asarray(p)
+            for name, p in zip(
+                ("t_value", "t_unc", "op_value", "op_unc", "m_unc",
+                 "overflow"),
+                planes,
+            )
+        },
     )
     print(f"rank {jax.process_index()} ok", flush=True)
 

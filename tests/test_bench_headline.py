@@ -72,6 +72,15 @@ def _fake_phase_output(phase: str) -> str:
              "value": 9.5e7, "unit": "fingerprints/sec/chip",
              "vs_baseline": 38.0},
         ],
+        "sharded": [
+            {"metric": "sharded_data_axis_efficiency", "value": 0.91,
+             "unit": "ratio (per-chip (rate_R / (R*rate_1)); >=0.7 "
+             "acceptance)", "vs_baseline": 1.3},
+            {"metric": "sharded_serving_rows_per_sec", "value": 3.1e8,
+             "unit": "rows/sec (4-way data mesh, full-corpus "
+             "dispatch/collect serve, identity-gated)",
+             "vs_baseline": 124.0},
+        ],
         "oracle": [
             {"metric": "cpu_oracle_rows_per_sec", "value": 12.0,
              "unit": "rows/sec", "vs_baseline": 1.0},

@@ -134,6 +134,88 @@ def test_engine_explicit_mesh_shapes():
 # Multi-host initialization hook (parallel/multihost.py)
 # ---------------------------------------------------------------------------
 
+#: one probe per session for the multiprocess cases below: (ok, reason).
+#: jaxlib's CPU backend may lack multiprocess-collective support — the
+#: probe pays one tiny 2-process psum instead of timing out every heavy
+#: case, and all multiprocess tests share its verdict.
+_MULTIPROC_PROBE: "tuple[bool, str] | None" = None
+
+
+def _multiprocess_collectives_supported(tmp_path) -> "tuple[bool, str]":
+    global _MULTIPROC_PROBE
+    if _MULTIPROC_PROBE is not None:
+        return _MULTIPROC_PROBE
+    import os
+    import socket
+    import subprocess
+    import sys as _sys
+    from pathlib import Path as _Path
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = _Path(__file__).parent / "multihost_worker.py"
+    procs, logs = [], []
+    for rank in (0, 1):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+            SWARM_COORDINATOR=f"127.0.0.1:{port}",
+            SWARM_NUM_PROCESSES="2",
+            SWARM_PROCESS_ID=str(rank),
+            SWARM_MH_PROBE="1",
+        )
+        log = open(tmp_path / f"probe{rank}.log", "w+")
+        logs.append(log)
+        procs.append(
+            subprocess.Popen(
+                [_sys.executable, str(worker)],
+                env=env,
+                stdout=log,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    try:
+        for p in procs:
+            p.wait(timeout=240)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        _MULTIPROC_PROBE = (False, "2-process collective probe timed out")
+        return _MULTIPROC_PROBE
+    out = ""
+    for log in logs:
+        log.seek(0)
+        out += log.read()
+        log.close()
+    if all(p.returncode == 0 for p in procs):
+        _MULTIPROC_PROBE = (True, "")
+    elif (
+        "Multiprocess computations aren't implemented on the CPU backend"
+        in out
+    ):
+        # pre-existing environment gap (ROADMAP housekeeping): the
+        # installed jaxlib's CPU backend has no multiprocess collective
+        # support. An image with a collectives-enabled jaxlib (or a
+        # real accelerator) passes the probe and runs the heavy cases
+        # again automatically.
+        _MULTIPROC_PROBE = (
+            False,
+            "jaxlib CPU backend lacks multiprocess collectives "
+            "(XlaRuntimeError: 'Multiprocess computations aren't "
+            "implemented on the CPU backend')",
+        )
+    else:
+        _MULTIPROC_PROBE = (
+            False,
+            f"2-process collective probe failed:\n{out[-2000:]}",
+        )
+    return _MULTIPROC_PROBE
+
 
 def test_multihost_noop_without_env():
     from swarm_tpu.parallel.multihost import maybe_initialize_distributed
@@ -181,12 +263,28 @@ def test_multihost_partial_config_fails_loudly():
         )
 
 
+def _require_multiprocess_collectives(tmp_path):
+    """Shared gate for the heavy multiprocess cases: skip LOUDLY on
+    the known capability gap, fail on anything else (a broken probe is
+    a real failure, not an environment reason)."""
+    ok, reason = _multiprocess_collectives_supported(tmp_path)
+    if ok:
+        return
+    if "lacks multiprocess collectives" in reason:
+        pytest.skip(
+            f"{reason} — 2-process distributed cases cannot run in "
+            "this image"
+        )
+    pytest.fail(reason)
+
+
 def test_two_process_distributed_match(tmp_path):
     """REAL multi-host: two OS processes form a jax.distributed group
     over localhost, span one (2,2,2) mesh across both processes'
-    devices (psum + ppermute halos ride the DCN stand-in), and the
-    sharded match is bit-identical to a single-process run — the
-    executable analog of the reference's multi-droplet scale-out
+    devices (psum + ppermute halos ride the DCN stand-in), and both
+    the sharded match AND the serving dispatch/collect split are
+    bit-identical to a single-process run — the executable analog of
+    the reference's multi-droplet scale-out
     (/root/reference/server/server.py:47-162; round-3 verdict,
     Missing #4)."""
     import os
@@ -194,6 +292,8 @@ def test_two_process_distributed_match(tmp_path):
     import subprocess
     import sys as _sys
     from pathlib import Path as _Path
+
+    _require_multiprocess_collectives(tmp_path)
 
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -243,24 +343,8 @@ def test_two_process_distributed_match(tmp_path):
         outs.append(log.read())
         log.close()
     for rank, (p, out) in enumerate(zip(procs, outs)):
-        if (
-            p.returncode != 0
-            and "Multiprocess computations aren't implemented on the "
-            "CPU backend" in out
-        ):
-            # pre-existing environment gap (ROADMAP housekeeping): the
-            # installed jaxlib's CPU backend has no multiprocess
-            # collective support, so the two-process DCN stand-in
-            # cannot execute here at all. Skip with the capability
-            # reason — any OTHER failure still fails the test, and an
-            # image with a collectives-enabled jaxlib (or a real
-            # accelerator) runs it again automatically.
-            pytest.skip(
-                "jaxlib CPU backend lacks multiprocess collectives "
-                "(XlaRuntimeError: 'Multiprocess computations aren't "
-                "implemented on the CPU backend') — 2-process "
-                "distributed match cannot run in this image"
-            )
+        # the probe above vouched for collective support — any failure
+        # here is a real one
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
         assert f"rank {rank} ok" in out
 
@@ -268,9 +352,21 @@ def test_two_process_distributed_match(tmp_path):
 
     db, batch = build_world()
     uv, uu, uo = _run_unsharded(db, batch)
+    dev = DeviceDB(db)
+    full = dev.match(batch.streams, batch.lengths, batch.status, full=True)
+    full_names = ("t_value", "t_unc", "op_value", "op_unc", "m_unc")
     for rank in (0, 1):
         got = np.load(f"{out_base}.rank{rank}.npz")
         np.testing.assert_array_equal(got["t_value"], uv)
         np.testing.assert_array_equal(got["t_unc"], uu)
         # sharded ranks can only overflow less (k candidates each)
         np.testing.assert_array_equal(got["overflow"] | uo, uo)
+        # serving split (dispatch → collect): full planes match the
+        # single-device read, overflow in the safe direction
+        for name, want in zip(full_names, full):
+            np.testing.assert_array_equal(
+                got[f"full_{name}"], np.asarray(want), err_msg=name
+            )
+        np.testing.assert_array_equal(
+            got["full_overflow"] | np.asarray(full[5]), np.asarray(full[5])
+        )

@@ -278,6 +278,59 @@ def test_jit_capture_controls(tmp_path):
     assert [f.detail for f in arrays] == ["kernel:table"]
 
 
+WRAPPED_JIT_FIXTURE = '''
+import jax
+from jax.experimental.shard_map import shard_map
+
+
+def build_wrapped_undeclared(db, mesh, specs):
+    meta = db["meta"]
+
+    def step(streams):
+        return streams + meta
+
+    fn = shard_map(step, mesh=mesh, in_specs=specs, out_specs=specs)
+    return jax.jit(fn)
+
+
+def build_wrapped_declared(db, mesh, specs):
+    meta = db["meta"]
+
+    def step(streams):  # jit-captures: meta (small layout tuple)
+        return streams + meta
+
+    fn = shard_map(step, mesh=mesh, in_specs=specs, out_specs=specs)
+    return jax.jit(fn)
+
+
+def build_wrapped_inline(db, mesh, specs):
+    meta = db["meta"]
+
+    def step(streams):
+        return streams + meta
+
+    return jax.jit(
+        shard_map(step, mesh=mesh, in_specs=specs, out_specs=specs)
+    )
+'''
+
+
+def test_wrapped_jit_subject_captures(tmp_path):
+    """``jax.jit(shard_map(step, ...))`` — the sharded matcher's shape
+    — still checks ``step``'s captures: the transform doesn't stop
+    them becoming trace-time constants. One wrapper level resolves
+    both through a bound name and inline."""
+    p = _write(tmp_path, "fix_wrapped.py", WRAPPED_JIT_FIXTURE)
+    findings = jithygiene.check_file(p)
+    caps = _by_rule(findings, jithygiene.RULE_CAPTURE)
+    # undeclared fires through the bound name AND inline; the declared
+    # twin is silent
+    assert [(f.symbol, f.detail) for f in caps] == [
+        ("step", "step:meta"),  # build_wrapped_undeclared
+        ("step", "step:meta"),  # build_wrapped_inline
+    ], [f.render() for f in caps]
+
+
 DONATE_FIXTURE = '''
 import jax
 import numpy as np

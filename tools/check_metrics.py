@@ -52,6 +52,15 @@ REQUIRED_FAMILIES = (
     "swarm_device_compacted_dispatches_total",
     "swarm_device_survivor_max",
     "swarm_device_verify_k",
+    # sharded mesh serving plane (docs/SHARDING.md): registered at
+    # telemetry import (shard_export), axis labels pre-seeded — every
+    # family renders samples even in a mesh-free process
+    "swarm_shard_mesh_axis_size",
+    "swarm_shard_rank_fill_ratio",
+    "swarm_shard_psum_bytes_total",
+    "swarm_shard_halo_bytes_total",
+    "swarm_shard_dispatches_total",
+    "swarm_shard_survivor_max",
 )
 
 

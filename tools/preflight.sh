@@ -48,11 +48,14 @@ echo "== preflight: host-walk floor =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python tools/profile_walk.py --check-floor
 
-echo "== preflight: bench smoke (pipeline A/B, both modes) =="
+echo "== preflight: bench smoke (pipeline A/B + shard smoke, both modes) =="
 # CI-fast A/B on the bundled corpus; rc gates on verdict identity only.
 # Forced to the CPU backend unless the operator pinned one — the smoke
-# validates feed mechanics and parity, not chip throughput. The
-# fault-free runs also record the resilience layer's no-op overhead
+# validates feed mechanics and parity, not chip throughput. Includes
+# the shard_smoke clause (docs/SHARDING.md): the sharded serving path
+# on the forced 8-device host-platform mesh must be verdict-identical
+# to the single-device engine on every CPU-only box. The fault-free
+# runs also record the resilience layer's no-op overhead
 # (resilience_faultfree_overhead_ns).
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=off python bench.py --smoke
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=on python bench.py --smoke

@@ -73,6 +73,7 @@ DEFAULT_TARGETS = (
     "swarm_tpu/ops/match.py",
     "swarm_tpu/ops/regexdev.py",
     "swarm_tpu/fingerprints/compile.py",
+    "swarm_tpu/parallel/sharded.py",
 )
 
 SYNC_CALLS = {"float", "int", "bool"}
@@ -321,6 +322,11 @@ class JitChecker:
         return self.findings
 
     def _index_factories(self):
+        # EVERY method whose body builds a jax.jit is a factory — a
+        # non-donating one (match.py's _kernel/_phase_a, sharded.py's
+        # _build_phase_a) still hands back a jitted callable whose
+        # results are device values, so the host-sync rule must track
+        # them (the max-survivor scalar reads are exactly this shape)
         for node in ast.walk(self.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
@@ -389,6 +395,15 @@ class JitChecker:
                 target_def = local_defs[subject.id]
             elif isinstance(subject, ast.Lambda):
                 self._check_captures_lambda(subject, fn, jc.lineno)
+            elif subject is not None:
+                # wrapped subjects: jax.jit(shard_map(step, ...)) hands
+                # jit a TRANSFORM of a local def — the def's captures
+                # still become trace-time constants, so resolve through
+                # one wrapper level (a Call argument naming a local
+                # def, or a Name bound from such a Call — the sharded
+                # matcher's `fn = smap(step, ...); jax.jit(fn)` shape)
+                for wrapped in self._defs_behind(subject, info, local_defs):
+                    self._check_captures(wrapped, fn)
             if target_def is not None:
                 self._check_captures(target_def, fn)
             # record local jitted vars for donation checking
@@ -401,6 +416,26 @@ class JitChecker:
                 )
                 if decall is not None or _is_jit_expr(dec):
                     self._check_captures(d, fn)
+
+    @staticmethod
+    def _defs_behind(subject: ast.AST, info: "_FnInfo",
+                     local_defs: dict) -> list:
+        """Local defs reachable through ONE wrapper level from a jit
+        subject: direct Call arguments that name a local def, plus a
+        Name whose assignment is such a Call."""
+        calls: list[ast.Call] = []
+        if isinstance(subject, ast.Call):
+            calls.append(subject)
+        elif isinstance(subject, ast.Name):
+            for v in info.assigns.get(subject.id, []):
+                if isinstance(v, ast.Call):
+                    calls.append(v)
+        out = []
+        for call in calls:
+            for arg in call.args:
+                if isinstance(arg, ast.Name) and arg.id in local_defs:
+                    out.append(local_defs[arg.id])
+        return out
 
     def _declared_captures(self, d) -> Optional[set[str]]:
         payload = annotation_on(self.comments, d.lineno, "jit-captures")
