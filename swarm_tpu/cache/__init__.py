@@ -1,0 +1,12 @@
+"""Fleet-wide content-addressed result cache (docs/CACHING.md)."""
+
+from swarm_tpu.cache.tier import (  # noqa: F401
+    ResultCacheClient,
+    SharedResultTier,
+    build_result_cache,
+    confirm_digest,
+    corpus_digest,
+    decode_entry,
+    encode_entry,
+    row_digest,
+)

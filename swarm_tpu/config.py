@@ -91,6 +91,29 @@ class Config:
     # unreachable ("" = <worker work_dir>/spool)
     spool_dir: str = ""
 
+    # --- fleet result cache (docs/CACHING.md) ---
+    # shared content-addressed result tier behind the per-engine memo:
+    # "off" (default) leaves every path unchanged; "memory" shares one
+    # embedded tier across this process's engines; "redis" goes
+    # fleet-wide over the state-store adapter. Env: SWARM_CACHE_BACKEND.
+    cache_backend: str = "off"
+    # tier Redis URL ("" = reuse redis_url)
+    cache_url: str = ""
+    # blob-spill directory for oversized values on the redis backend
+    # ("" = state store only; the memory backend spills to an embedded
+    # blob store regardless)
+    cache_spill_dir: str = ""
+    # promote the batched walk's confirm cache as the tier's second
+    # value family
+    cache_confirm: bool = True
+    # write freshly walked results back to the tier (off = read-only
+    # consumer)
+    cache_writeback: bool = True
+    # breaker around every tier op: a dead backend degrades the scan
+    # to L1-only, it never blocks it
+    cache_breaker_threshold: int = 3
+    cache_breaker_cooldown_s: float = 30.0
+
     # --- fleet orchestration ---
     fleet_provider: str = "null"  # "null" | "digitalocean" | "process"
     fleet_api_token: str = ""

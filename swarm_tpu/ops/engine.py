@@ -38,6 +38,7 @@ from swarm_tpu.fingerprints.model import Response, Template
 from swarm_tpu.ops import cpu_ref, fastre
 from swarm_tpu.ops.encoding import _RotatingPool, encode_batch, round_up
 from swarm_tpu.ops.match import DeviceDB
+from swarm_tpu.telemetry.memo_export import L1_HITS, L1_MISSES
 
 
 @dataclasses.dataclass
@@ -456,6 +457,21 @@ class MatchEngine:
         # the no-toolchain fallback.
         self._vmemo = None
         self._native_memo_ok = None
+        # fleet-wide shared result tier (docs/CACHING.md): when a
+        # ResultCacheClient is attached, the memos above become the L1
+        # in front of it — lookups go L1 → shared tier → device, fresh
+        # walk results batch-write back after finish_packed, and the
+        # batched walk's confirm cache promotes into the tier's second
+        # value family. None (the default) keeps every path unchanged.
+        self._result_cache = None
+        # row ids the scheduler's prefetch stage already consulted the
+        # shared tier for (hits landed in the L1, misses are
+        # suppressed client-side): the encode-time consult skips them
+        # so a fresh row's content is sha256'd once per batch, not
+        # twice. id() keys are safe here because a stale entry can
+        # only SKIP a consult (the row is computed locally) — it can
+        # never serve wrong data. Bounded FIFO via _cache_put.
+        self._shared_seen: dict = {}
         # recycled verdict planes for reuse_buffers encodes, keyed PER
         # SHAPE (see _encode_native): alternating batch shapes (bucket
         # scheduler, partial final chunks) each keep their own depth-8
@@ -1297,6 +1313,31 @@ class MatchEngine:
                 new_ids.append(s)
             else:
                 known[s] = entry
+        # L1 accounting + shared tier (docs/CACHING.md) — the dict-memo
+        # fallback honors the same L1 → shared → device hierarchy as
+        # the native path (slot-granular here: this IS the dedup plane)
+        if len(known):
+            L1_HITS.inc(len(known))
+        if new_ids:
+            L1_MISSES.inc(len(new_ids))
+        if new_ids and self._result_cache is not None:
+            seen = self._shared_seen
+            if self._serve_shared(
+                [
+                    rows[uniq[s]]
+                    for s in new_ids
+                    if not seen or id(rows[uniq[s]]) not in seen
+                ],
+                into_native=False,
+            ):
+                still = []
+                for s in new_ids:
+                    entry = lru_fetch(memo, keys[s])
+                    if entry is None:
+                        still.append(s)
+                    else:
+                        known[s] = entry
+                new_ids = still
         if not new_ids:
             return (
                 "py", None, None, uniq, back, len(rows), new_ids, keys, known
@@ -1333,10 +1374,7 @@ class MatchEngine:
         recycling contract documented on :class:`PackedMatches`); the
         default allocating path hands back a plane the caller owns."""
         nbits = max((self.db.num_templates + 7) >> 3, 1)
-        if self._vmemo is None:
-            from swarm_tpu.native.scanio import VerdictMemo
-
-            self._vmemo = VerdictMemo(self._EXT_CACHE_MAX, nbits)
+        self._ensure_vmemo(nbits)
         if reuse_buffers:
             # A fresh ~1 MB np.empty per batch lands on mmap'd pages
             # whose first-touch faults cost more than the lookup pass
@@ -1354,6 +1392,33 @@ class MatchEngine:
         state, miss_uniq, extr_known, deferred_known = (
             self._vmemo.lookup(rows, bits)
         )
+        # L1 accounting (docs/CACHING.md): row-granular, BEFORE the
+        # shared tier serves anything — a shared hit is not an L1 hit
+        if len(rows):
+            n_hit = int((state == -1).sum())
+            n_miss = int((state >= 0).sum())
+            if n_hit:
+                L1_HITS.inc(n_hit)
+            if n_miss:
+                L1_MISSES.inc(n_miss)
+        # shared tier behind the L1: serve the miss slots' contents
+        # from the fleet cache, then re-run the lookup so served rows
+        # resolve exactly like locally-known content (one extra native
+        # pass, paid only when the tier actually held something). Rows
+        # the scheduler prefetch already consulted are skipped — their
+        # hits are in the L1 and their misses suppressed, so re-asking
+        # would only re-hash the content.
+        if miss_uniq and self._result_cache is not None:
+            seen = self._shared_seen
+            cand = [
+                rows[i]
+                for i in miss_uniq
+                if not seen or id(rows[i]) not in seen
+            ]
+            if cand and self._serve_shared(cand, into_native=True):
+                state, miss_uniq, extr_known, deferred_known = (
+                    self._vmemo.lookup(rows, bits)
+                )
         served = (extr_known, deferred_known)
         if not miss_uniq:
             return (
@@ -1645,6 +1710,15 @@ class MatchEngine:
         from swarm_tpu.native.scanio import confirm_needles_batch
 
         cache = self._confirm_cache
+        # confirm-family promotion (docs/CACHING.md): the shared tier's
+        # second value family serves/absorbs the part-keyed confirm
+        # verdicts around the batched native passes — local cache
+        # first, one batched tier lookup per matcher group, and every
+        # merged insert batch-writes back at the end
+        shared = self._result_cache
+        if shared is not None and not shared.confirm:
+            shared = None
+        shared_inserts: list = []
         parts_of: dict = {}  # (b, part_name) -> bytes
 
         def row_part(b: int, name) -> bytes:
@@ -1778,8 +1852,11 @@ class MatchEngine:
             ] or [[]]
 
         def dedup_misses(m_id, bs, part_name, cache_tag) -> list:
-            """Cache-serve what the cross-batch memo holds; group the
-            misses by DISTINCT part bytes → [(part, [b, ...]), ...]."""
+            """Cache-serve what the cross-batch memo holds, then the
+            shared tier (one batched lookup per matcher group); group
+            the remaining misses by DISTINCT part bytes →
+            [(part, [b, ...]), ...]. A tier-served verdict also lands
+            in the local cache so the next batch never re-asks."""
             by_part: dict = {}
             for b in bs:
                 p = row_part(b, part_name)
@@ -1788,6 +1865,14 @@ class MatchEngine:
                     pre_m[(b, m_id)] = v
                 else:
                     by_part.setdefault(p, []).append(b)
+            if by_part and shared is not None:
+                got = shared.lookup_confirms(
+                    [(cache_tag, m_id, p) for p in by_part]
+                )
+                for key, v in got.items():
+                    for b in by_part.pop(key[2]):
+                        pre_m[(b, m_id)] = v
+                    self._cache_put(cache, key, v)
             return list(by_part.items())
 
         for m_id, bs in by_matcher.items():
@@ -1880,6 +1965,10 @@ class MatchEngine:
                             raw = any(len(p) == s for s in sizes)
                         v = (not raw) if neg else raw
                         self._cache_put(cache, key, v)
+                        # NOT promoted to the tier: the size branch
+                        # decides inline (a length compare) and never
+                        # consults the confirm family, so sharing
+                        # these would be write-only tier traffic
                     pre_m[(b, m_id)] = v
             else:
                 # dsl/status/kval read beyond the part — serial pairs
@@ -1935,6 +2024,13 @@ class MatchEngine:
                     pre_m[key] = v
             for ck, v in inserts:
                 self._cache_put(cache, ck, v)
+                shared_inserts.append((ck, v))
+        # batch-promote this round's freshly decided confirms into the
+        # tier's confirm family — every insert key here is one of the
+        # shareable ("m"|"pe", m_id, part) namespaces by construction
+        # (the per-object "op"-tagged keys never reach the insert lists)
+        if shared_inserts and shared is not None:
+            shared.writeback_confirms(shared_inserts)
         # ONLY pairs the grouped native passes actually decided — not
         # cache-served, plan-inline (size/empty-needle), or serial-
         # fallback pairs — so the gauge attributes real native load
@@ -2484,6 +2580,7 @@ class MatchEngine:
         def_by_pos: dict = {}
         for b, t_idx in deferred:
             def_by_pos.setdefault(int(b), []).append(t_idx)
+        shared_wb: list = []
         for b in range(B):
             s = new_ids[b]
             ubits[s] = pt_value[b]
@@ -2496,18 +2593,22 @@ class MatchEngine:
                 # (reused) plane, extraction VALUES tuple-copied —
                 # callers receive mutable lists, and a caller's in-place
                 # edit must never rewrite the cache
-                self._cache_put(
-                    self._verdict_memo,
-                    keys[s],
-                    (
-                        pt_value[b].tobytes(),
-                        tuple(
-                            (tid, tuple(vals))
-                            for tid, vals in ext_by_pos.get(b, ())
-                        ),
-                        tuple(def_by_pos.get(b, ())),
+                entry = (
+                    pt_value[b].tobytes(),
+                    tuple(
+                        (tid, tuple(vals))
+                        for tid, vals in ext_by_pos.get(b, ())
                     ),
+                    tuple(def_by_pos.get(b, ())),
                 )
+                self._cache_put(self._verdict_memo, keys[s], entry)
+                shared_wb.append(
+                    (nrows[b], entry[0], (entry[1], entry[2]))
+                )
+        # shared-tier writeback, dict-memo twin of the native path's
+        # (docs/CACHING.md)
+        if shared_wb and self._result_cache is not None:
+            self._result_cache.writeback_rows(shared_wb)
         for s, entry in known.items():
             mb, ment, mdef = entry
             ubits[s] = np.frombuffer(mb, dtype=np.uint8)
@@ -2632,6 +2733,24 @@ class MatchEngine:
                 if ment or mdef:
                     extras_list[pos] = (ment, mdef)
             self._vmemo.insert_batch(nrows, pt_value[:B], skip, extras_list)
+            # shared-tier writeback (docs/CACHING.md): the same fully-
+            # resolved planes the L1 just absorbed batch-write to the
+            # fleet tier — truncated/overflow positions stay local-only
+            # exactly like the L1 (never memoized anywhere). Runs after
+            # finish_packed's walk, off the dispatch path; a fenced or
+            # degraded put drops silently (the tier is an accelerator,
+            # never a dependency).
+            if (
+                self._result_cache is not None
+                and self._result_cache.writeback
+            ):
+                self._result_cache.writeback_rows(
+                    [
+                        (nrows[b], pt_value[b].tobytes(), extras_list[b])
+                        for b in range(B)
+                        if not skip[b]
+                    ]
+                )
             ins_dt = time.perf_counter() - t_ins
             self.stats.insert_seconds += ins_dt
             # member fan-out over miss rows. Fresh-content batches
@@ -2743,6 +2862,94 @@ class MatchEngine:
                         if res.extractions:
                             extractions[(i, template.id)] = res.extractions
         return host_always_matches
+
+    # ------------------------------------------------------------------
+    # Shared result tier (docs/CACHING.md): L1 → shared → device
+    # ------------------------------------------------------------------
+    def attach_result_cache(self, client) -> None:
+        """Attach a fleet-wide content-addressed result tier
+        (:class:`swarm_tpu.cache.ResultCacheClient`). The client is
+        bound to this engine's corpus digest, so entries can only be
+        exchanged between engines compiled from identical templates
+        (and identical lowering code — the epoch covers both). ``None``
+        detaches."""
+        if client is not None:
+            from swarm_tpu.cache.tier import corpus_digest
+
+            client.bind_corpus(corpus_digest(self.templates))
+        self._result_cache = client
+
+    def _ensure_vmemo(self, nbits: int):
+        """The C resident verdict cache, created on first need (both
+        the encode path and the scheduler-prefetch shared serve can be
+        the first toucher)."""
+        if self._vmemo is None:
+            from swarm_tpu.native.scanio import VerdictMemo
+
+            self._vmemo = VerdictMemo(self._EXT_CACHE_MAX, nbits)
+        return self._vmemo
+
+    def _serve_shared(self, cand: list, into_native: bool) -> int:
+        """Serve shared-tier verdict entries for L1-missed rows: each
+        hit is inserted into the L1 (native memo or dict memo), so the
+        caller's re-lookup serves it exactly like locally-computed
+        known content — verdicts can't differ between a shared hit and
+        a local walk because the entry IS a walked result for the same
+        content under the same corpus epoch. Entries whose plane width
+        doesn't match this corpus are dropped (foreign layout — treat
+        as a miss, never as data)."""
+        client = self._result_cache
+        if client is None or not cand:
+            return 0
+        entries = client.lookup_rows(cand)
+        if not entries:
+            return 0
+        nbits = max((self.db.num_templates + 7) >> 3, 1)
+        n = 0
+        for pos, (mb, ment, mdef) in entries.items():
+            if len(mb) != nbits:
+                continue
+            extras = (ment, mdef) if (ment or mdef) else None
+            if into_native:
+                self._ensure_vmemo(nbits).insert(
+                    cand[pos],
+                    np.frombuffer(mb, dtype=np.uint8).copy(),
+                    extras,
+                )
+            else:
+                self._cache_put(
+                    self._verdict_memo, _content_key(cand[pos]),
+                    (mb, ment, mdef),
+                )
+            n += 1
+        return n
+
+    def prefetch_shared_memo(self, rows: Sequence) -> int:
+        """Pipeline the shared-tier lookup into the scheduler's
+        prefetch stage (docs/CACHING.md): rows the L1 doesn't know are
+        batch-looked-up in the shared tier and the hits inserted into
+        the L1 BEFORE plan-time classification, so a fleet-known row
+        rides the memo lane (no bucket, no device batch slot) and a
+        shared miss costs nothing on the dispatch path — the remote
+        round trip overlapped the in-flight device batches. Returns
+        the number of contents served. No-op without an attached
+        tier."""
+        if self._result_cache is None or not rows:
+            return 0
+        rows = list(rows)
+        known = self.memo_known_mask(rows)
+        cand = [
+            r
+            for i, r in enumerate(rows)
+            if not known[i] and getattr(r, "alive", True)
+        ]
+        if not cand:
+            return 0
+        # remember what this stage consulted (hits AND misses): the
+        # encode-time consult skips these rows instead of re-hashing
+        for r in cand:
+            self._cache_put(self._shared_seen, id(r), None)
+        return self._serve_shared(cand, into_native=self._use_native_memo())
 
     # ------------------------------------------------------------------
     def memo_contains(self, row: Response) -> bool:

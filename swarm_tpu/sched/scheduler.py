@@ -320,6 +320,16 @@ class BatchScheduler:
             add_known = planner.add_known
             add_fresh = planner.add_fresh
             use_native = engine._use_native_memo()
+            # fleet result tier (docs/CACHING.md): when the engine has
+            # one attached, the shared lookup rides THIS stage — rows
+            # the tier knows are in the L1 before classification, so
+            # they take the memo lane (no bucket, no device slot) and
+            # the remote round trip overlaps the in-flight batches
+            # rather than the dispatch path. Stub engines (tests) may
+            # not expose the hook.
+            prefetch_shared = getattr(
+                engine, "prefetch_shared_memo", None
+            )
             for chunk in chunks:
                 rows = list(decode(chunk) if decode else chunk)
                 with self._lock:
@@ -328,6 +338,8 @@ class BatchScheduler:
                     chunk_len.append(len(rows))
                     chunk_left.append(len(rows))
                 stats.chunks += 1
+                if memo_split and rows and prefetch_shared is not None:
+                    prefetch_shared(rows)
                 known = None
                 state = None
                 spec_pre = None

@@ -42,6 +42,28 @@ class StateStore:
     def hget(self, name: str, key: str) -> Optional[str]:
         raise NotImplementedError
 
+    def hmget(self, name: str, keys: list[str]) -> list[Optional[str]]:
+        """Batched hget — ONE wire round trip on backends that support
+        it (the result-cache tier's lookup path, docs/CACHING.md). The
+        default loops hget, so adapters only override for speed."""
+        return [self.hget(name, k) for k in keys]
+
+    def hset_many(self, name: str, mapping: dict[str, str]) -> None:
+        """Batched hset — ONE wire round trip on backends that support
+        it (the result-cache tier's writeback path: a walked plane's
+        worth of entries must not cost one RTT per row). The default
+        loops hset, so adapters only override for speed."""
+        for key, value in mapping.items():
+            self.hset(name, key, value)
+
+    def hincr(self, name: str, key: str, by: int = 1) -> int:
+        """Atomically add ``by`` to an integer hash field (missing = 0)
+        and return the new value — the fencing-token counter and epoch
+        generation of the result-cache tier (docs/CACHING.md). Must be
+        atomic WITHIN the backend (Redis HINCRBY; the embedded store's
+        read-modify-write runs under its lock)."""
+        raise NotImplementedError
+
     def hkeys(self, name: str) -> list[str]:
         raise NotImplementedError
 
@@ -90,6 +112,22 @@ class MemoryStateStore(StateStore):
     def hget(self, name, key):
         with self._lock:
             return self._hashes.get(name, {}).get(key)
+
+    def hmget(self, name, keys):
+        with self._lock:
+            h = self._hashes.get(name, {})
+            return [h.get(k) for k in keys]
+
+    def hset_many(self, name, mapping):
+        with self._lock:
+            self._hashes.setdefault(name, {}).update(mapping)
+
+    def hincr(self, name, key, by=1):
+        with self._lock:
+            h = self._hashes.setdefault(name, {})
+            value = int(h.get(key, "0")) + int(by)
+            h[key] = str(value)
+            return value
 
     def hkeys(self, name):
         with self._lock:
@@ -150,6 +188,15 @@ class RedisStateStore(StateStore):
 
     def hget(self, name, key):
         return self._d(self._r.hget(name, key))
+
+    def hmget(self, name, keys):
+        return [self._d(v) for v in self._r.hmget(name, keys)]
+
+    def hset_many(self, name, mapping):
+        self._r.hset(name, mapping=mapping)
+
+    def hincr(self, name, key, by=1):
+        return int(self._r.hincrby(name, key, by))
 
     def hkeys(self, name):
         return [k.decode() for k in self._r.hkeys(name)]

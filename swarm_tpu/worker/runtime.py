@@ -712,6 +712,19 @@ class JobProcessor:
             engine = MatchEngine(
                 templates, db=db, pipeline=self.cfg.pipeline
             )
+            # fleet-wide result tier (docs/CACHING.md): rows any worker
+            # has ever resolved short-circuit before device dispatch.
+            # SWARM_CACHE_BACKEND=off (default) skips this entirely; a
+            # tier that can't be built must not kill engine bring-up —
+            # the cache is an accelerator, never a dependency.
+            from swarm_tpu.cache import build_result_cache
+
+            try:
+                client = build_result_cache(self.cfg)
+                if client is not None:
+                    engine.attach_result_cache(client)
+            except Exception as e:
+                print(f"result cache unavailable ({e}); running L1-only")
             self._engines[templates_dir] = engine
         return engine
 

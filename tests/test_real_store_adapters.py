@@ -31,8 +31,13 @@ class _FakeRedisClient:
     def _b(v) -> bytes:
         return v if isinstance(v, bytes) else str(v).encode()
 
-    def hset(self, name, key, value):
-        self.h.setdefault(name, {})[self._b(key)] = self._b(value)
+    def hset(self, name, key=None, value=None, mapping=None):
+        h = self.h.setdefault(name, {})
+        if mapping:
+            for k, v in mapping.items():
+                h[self._b(k)] = self._b(v)
+        if key is not None:
+            h[self._b(key)] = self._b(value)
 
     def hget(self, name, key):
         return self.h.get(name, {}).get(self._b(key))
@@ -45,6 +50,17 @@ class _FakeRedisClient:
 
     def hdel(self, name, key):
         self.h.get(name, {}).pop(self._b(key), None)
+
+    def hmget(self, name, keys):
+        h = self.h.get(name, {})
+        return [h.get(self._b(k)) for k in keys]
+
+    def hincrby(self, name, key, amount=1):
+        h = self.h.setdefault(name, {})
+        k = self._b(key)
+        value = int(h.get(k, b"0")) + int(amount)
+        h[k] = str(value).encode()
+        return value
 
     def rpush(self, name, value):
         self.l.setdefault(name, []).append(self._b(value))
@@ -196,6 +212,15 @@ def test_redis_adapter_contract(fake_backends):
     assert store.hgetall("jobs")["j2"] == "x"
     store.hdel("jobs", "j2")
     assert "j2" not in store.hkeys("jobs")
+    # result-cache tier surface (docs/CACHING.md): batched get +
+    # atomic counter ride HMGET/HINCRBY on a real Redis
+    assert store.hmget("jobs", ["j1", "nope"]) == [
+        '{"status": "queued"}', None,
+    ]
+    store.hset_many("cache:v", {"d1": "a", "d2": "b"})
+    assert store.hmget("cache:v", ["d1", "d2"]) == ["a", "b"]
+    assert store.hincr("cache:meta", "fence_next") == 1
+    assert store.hincr("cache:meta", "fence_next", 3) == 4
     store.rpush("job_queue", "a")
     store.rpush("job_queue", "b")
     store.lpush("job_queue", "front")

@@ -1,0 +1,594 @@
+"""Fleet-wide content-addressed result cache (swarm_tpu/cache,
+docs/CACHING.md).
+
+Contracts pinned here:
+
+1. **Bit-parity in every tier state** — verdicts AND extractions with
+   the tier on, off, cold, warm, and failing mid-scan are identical to
+   the tierless engine (on both the native-memo and dict-memo L1s).
+2. **Fencing** — a superseded writer's puts are rejected before AND
+   after the write; the tier never keeps a stale worker's bytes.
+3. **Epoch invalidation** — a corpus refresh (different digest) and an
+   operator ``bump_epoch`` each make every old entry unreachable.
+4. **Degraded mode** — a dead backend trips the breaker and the scan
+   completes L1-only, bit-identical, without re-touching the store.
+5. **Confirm promotion** — the batched walk's confirm verdicts round-
+   trip through the tier's second value family to a fresh engine.
+6. **Cross-"worker" propagation** — content one engine lifetime
+   resolved short-circuits a second lifetime's device dispatch, on the
+   direct path and through the scheduler's prefetch stage.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import bench as bench_mod
+from swarm_tpu.cache import (
+    ResultCacheClient,
+    SharedResultTier,
+    confirm_digest,
+    corpus_digest,
+    decode_entry,
+    encode_entry,
+    row_digest,
+)
+from swarm_tpu.fingerprints import load_corpus
+from swarm_tpu.fingerprints.model import Response
+from swarm_tpu.ops.engine import MatchEngine
+from swarm_tpu.resilience.faults import clear_plan, install_plan
+from swarm_tpu.stores import MemoryBlobStore, MemoryStateStore
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    templates, errors = load_corpus("tests/data/templates")
+    assert templates
+    return templates
+
+
+@pytest.fixture(scope="module")
+def stress_corpus(corpus):
+    """Bundled corpus + confirm-heavy stress templates (the bundled
+    demo corpus alone yields ~zero uncertain confirm pairs, so the
+    confirm-family tests ride the bench's stress families)."""
+    return list(corpus) + bench_mod.walk_stress_templates()
+
+
+def _tier():
+    return SharedResultTier(MemoryStateStore(), MemoryBlobStore())
+
+
+def _client(tier, worker="w", **kw):
+    return ResultCacheClient(tier, worker_id=worker, **kw)
+
+
+def _rows(n, seed=7, unique=True):
+    rows = bench_mod.realistic_rows(n, seed=seed)
+    if unique:
+        rng = np.random.default_rng(seed + 1)
+        for i, r in enumerate(rows):
+            salt = bytes(rng.integers(97, 123, size=24, dtype=np.uint8))
+            r.body = b"<!-- u%d %s -->" % (i, salt) + r.body
+    return rows
+
+
+#: id(templates) -> (templates, CompiledDB): each corpus variant
+#: compiles ONCE for the whole module (the templates ref pins the list
+#: so an id can never be reused while its entry lives) — this module
+#: builds ~50 engines and per-engine corpus compiles would dominate
+#: its tier-1 wall
+_DB_CACHE: dict = {}
+
+
+def _engine(templates, client=None, **kw):
+    kw.setdefault("mesh", None)
+    kw.setdefault("batch_rows", 32)
+    if "db" not in kw:
+        entry = _DB_CACHE.get(id(templates))
+        if entry is None or entry[0] is not templates:
+            from swarm_tpu.fingerprints.compile import compile_corpus
+
+            entry = _DB_CACHE[id(templates)] = (
+                templates, compile_corpus(templates),
+            )
+        kw["db"] = entry[1]
+    eng = MatchEngine(templates, **kw)
+    if client is not None:
+        eng.attach_result_cache(client)
+    return eng
+
+
+def _same(a, b):
+    assert bench_mod._verdicts_equal(a, b)
+
+
+@pytest.fixture(scope="module")
+def ref_engine(corpus):
+    """Shared tierless reference for `want` computations — engine
+    reuse is free here (the L1 memo serves bit-identical results) and
+    each fresh engine costs a device-kernel re-trace."""
+    return _engine(corpus)
+
+
+@pytest.fixture(scope="module")
+def stress_ref(stress_corpus):
+    return _engine(stress_corpus, batch_rows=64)
+
+
+# ----------------------------------------------------------------------
+# store primitives + wire format
+# ----------------------------------------------------------------------
+
+
+def test_state_store_hmget_hincr():
+    s = MemoryStateStore()
+    s.hset("h", "a", "1")
+    assert s.hmget("h", ["a", "missing"]) == ["1", None]
+    assert s.hincr("c", "n") == 1
+    assert s.hincr("c", "n", 5) == 6
+    # atomic under contention: two threads x 200 increments lose none
+    def spin():
+        for _ in range(200):
+            s.hincr("c", "race")
+
+    ts = [threading.Thread(target=spin) for _ in range(2)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert s.hget("c", "race") == "400"
+
+
+def test_entry_wire_roundtrip():
+    ment = (("tid-a", ("v1", "v\xe92")), ("tid-b", ()))
+    mdef = (3, 17)
+    raw = encode_entry(b"\x01\x02\xff", ment, mdef)
+    assert decode_entry(raw) == (b"\x01\x02\xff", ment, mdef)
+    # malformed payloads are misses, never exceptions
+    assert decode_entry("not json") is None
+    assert decode_entry('{"b":"!!!","e":[],"d":[]}') is None
+
+
+def test_row_digest_reads_exactly_the_content_key():
+    base = Response(body=b"B", header=b"H", status=200, host="a", port=80)
+    # host/port/duration are NOT part of the content address
+    assert row_digest(base) == row_digest(
+        Response(body=b"B", header=b"H", status=200, host="z", port=443)
+    )
+    for other in (
+        Response(body=b"B2", header=b"H", status=200),
+        Response(body=b"B", header=b"H2", status=200),
+        Response(body=b"B", header=b"H", status=404),
+        Response(body=b"B", header=b"H", status=200, banner=b"B"),
+        Response(body=b"B", header=b"H", status=200, oob_protocols=("dns",)),
+    ):
+        assert row_digest(base) != row_digest(other)
+    # element boundaries are length-prefixed, never separator-joined:
+    # ("a\x1fb",) and ("a", "b") are DIFFERENT content
+    assert row_digest(
+        Response(body=b"B", oob_protocols=("a\x1fb",))
+    ) != row_digest(Response(body=b"B", oob_protocols=("a", "b")))
+
+
+def test_blob_spill_roundtrip():
+    tier = SharedResultTier(
+        MemoryStateStore(), MemoryBlobStore(), spill_bytes=16
+    )
+    tok = tier.acquire_writer("w")
+    big = "x" * 200
+    assert tier.put_many("v", "e1", [("d1", big), ("d2", "small")], "w", tok) == (
+        "stored", 2,
+    )
+    assert tier.get_many("v", "e1", ["d1", "d2"]) == {
+        "d1": big, "d2": "small",
+    }
+
+
+# ----------------------------------------------------------------------
+# fencing
+# ----------------------------------------------------------------------
+
+
+def test_fencing_rejects_stale_writer():
+    tier = _tier()
+    t1 = tier.acquire_writer("worker-1")
+    t2 = tier.acquire_writer("worker-1")  # restart supersedes
+    assert t2 > t1
+    assert tier.put_many("v", "e", [("d", "v")], "worker-1", t1) == (
+        "fenced", 0,
+    )
+    assert tier.get_many("v", "e", ["d"]) == {}
+    assert tier.put_many("v", "e", [("d", "v")], "worker-1", t2) == (
+        "stored", 1,
+    )
+    # revocation with no successor rejects too
+    tier.fence_writer("worker-1")
+    assert tier.put_many("v", "e", [("d2", "v")], "worker-1", t2) == (
+        "fenced", 0,
+    )
+
+
+def test_fencing_mid_write_supersession_reports_fenced():
+    """A writer superseded MID-write learns it was fenced (never
+    claims success). Its landed bytes are deliberately NOT unwound:
+    within an epoch entries are pure content functions, so they are
+    value-identical to what the live successor would store — an unwind
+    could only ever delete the successor's valid concurrent write for
+    the same digest."""
+    tier = _tier()
+    token = tier.acquire_writer("w")
+    state = tier._state
+    real_hset_many = state.hset_many
+    fired = []
+
+    def hset_and_supersede(name, mapping):
+        real_hset_many(name, mapping)
+        if name.startswith("swarm:cache:v:") and not fired:
+            fired.append(True)
+            tier.acquire_writer("w")  # the successor arrives mid-write
+
+    state.hset_many = hset_and_supersede
+    try:
+        out = tier.put_many(
+            "v", "e", [("d1", "x"), ("d2", "y")], "w", token
+        )
+    finally:
+        state.hset_many = real_hset_many
+    assert out == ("fenced", 0)
+    # the value-identical entries remain live for every reader
+    assert tier.get_many("v", "e", ["d1", "d2"]) == {"d1": "x", "d2": "y"}
+    # and the now-stale token keeps being rejected up front
+    assert tier.put_many("v", "e", [("d3", "z")], "w", token) == (
+        "fenced", 0,
+    )
+    assert tier.get_many("v", "e", ["d3"]) == {}
+
+
+def test_engine_writebacks_fenced_after_supersession(corpus):
+    tier = _tier()
+    client = _client(tier, worker="w9")
+    eng = _engine(corpus, client)
+    # supersede this client's identity AFTER it bound (same worker id +
+    # same corpus digest = the restarted successor)
+    tier.acquire_writer(f"w9:{corpus_digest(corpus)[:8]}")
+    eng.match(_rows(8))
+    c = client.counters()
+    assert c["shared_misses"] > 0
+    # nothing this stale engine wrote is visible to a fresh reader
+    fresh = _client(tier, worker="w10")
+    eng2 = _engine(corpus, fresh)
+    eng2.match(_rows(8))
+    assert fresh.counters()["shared_hits"] == 0
+
+
+def test_same_identity_clients_share_one_process_token(corpus):
+    """Two clients in ONE process deriving the same writer identity
+    (same worker id, same corpus) are the same live writer: they share
+    the process token instead of superseding — and silently fencing —
+    each other."""
+    tier = _tier()
+    rows_a, rows_b = _rows(5, seed=31), _rows(5, seed=32)
+    ca = _client(tier, worker="tw")
+    _engine(corpus, ca).match(bench_mod._clone_rows(rows_a))
+    cb = _client(tier, worker="tw")
+    _engine(corpus, cb).match(bench_mod._clone_rows(rows_b))
+    reader = _client(tier, worker="reader")
+    eng = _engine(corpus, reader)
+    eng.match(bench_mod._clone_rows(rows_a))
+    eng.match(bench_mod._clone_rows(rows_b))
+    # BOTH same-identity writers' content is in the tier
+    assert reader.counters()["verdict_hits"] == len(rows_a) + len(rows_b)
+
+
+def test_writeback_clears_recent_miss_suppression(corpus):
+    """Content this client wrote back is provably in the tier — its
+    digest must leave the recent-miss suppression set, or recurring
+    content evicted from the L1 would be re-walked forever."""
+    from swarm_tpu.cache import row_digest
+
+    tier = _tier()
+    client = _client(tier, worker="rm")
+    rows = _rows(5, seed=41)
+    _engine(corpus, client).match(bench_mod._clone_rows(rows))
+    assert client.counters()["shared_misses"] >= len(rows)
+    for r in rows:
+        assert row_digest(r) not in client._recent_miss
+
+
+# ----------------------------------------------------------------------
+# parity: on / off / cold / warm / mid-scan-failed, both L1 forms
+# ----------------------------------------------------------------------
+
+
+def test_tier_parity_cold_warm_cross_engine(corpus, ref_engine):
+    rows = _rows(14)
+    want = ref_engine.match(bench_mod._clone_rows(rows))
+
+    tier = _tier()
+    ca = _client(tier, worker="wa")
+    got_cold = _engine(corpus, ca).match(bench_mod._clone_rows(rows))
+    _same(got_cold, want)
+    assert ca.counters()["shared_misses"] > 0
+
+    # second engine LIFETIME: fresh L1, warm tier — every distinct
+    # content short-circuits before device dispatch
+    cb = _client(tier, worker="wb")
+    engb = _engine(corpus, cb)
+    got_warm = engb.match(bench_mod._clone_rows(rows))
+    _same(got_warm, want)
+    cc = cb.counters()
+    assert cc["shared_hits"] > 0 and cc["shared_misses"] == 0
+    assert engb.stats.memo_slots == len(rows)
+    assert engb.stats.host_confirm_pairs == 0
+
+
+def test_tier_parity_dict_memo_fallback(corpus, ref_engine):
+    """The dict-memo L1 (no native lib) honors the same hierarchy."""
+    rows = _rows(12)
+    want = ref_engine.match(bench_mod._clone_rows(rows))
+    tier = _tier()
+    for worker in ("da", "db"):
+        client = _client(tier, worker=worker)
+        eng = _engine(corpus, client)
+        eng._native_memo_ok = False  # pin the fallback path
+        got = eng.match(bench_mod._clone_rows(rows))
+        _same(got, want)
+    assert client.counters()["shared_hits"] > 0
+
+
+def test_tier_parity_with_dead_rows_and_dup_content(corpus, ref_engine):
+    rows = _rows(10)
+    rows[7] = bench_mod._clone_rows([rows[1]])[0]  # duplicate content
+
+    def feed():
+        # _clone_rows doesn't carry `alive` — mark the dead twin per
+        # clone: content identical to row 0 (and tier-resident after
+        # the first lifetime) but dead rows must never be served
+        out = bench_mod._clone_rows(rows)
+        out[3] = Response(alive=False, body=rows[0].body)
+        return out
+
+    want = ref_engine.match(feed())
+    tier = _tier()
+    _engine(corpus, _client(tier, worker="p1")).match(feed())
+    got = _engine(corpus, _client(tier, worker="p2")).match(feed())
+    _same(got, want)
+    assert not got[3].template_ids  # dead row stays verdict-free
+
+
+class _FlakyStore(MemoryStateStore):
+    """Fails every op after ``fail_after`` calls — the mid-scan backend
+    death."""
+
+    def __init__(self, fail_after):
+        super().__init__()
+        self.calls = 0
+        self.fail_after = fail_after
+
+    def _maybe_fail(self):
+        self.calls += 1
+        if self.calls > self.fail_after:
+            raise ConnectionError("backend died mid-scan")
+
+    def hget(self, name, key):
+        self._maybe_fail()
+        return super().hget(name, key)
+
+    def hmget(self, name, keys):
+        self._maybe_fail()
+        return super().hmget(name, keys)
+
+    def hset(self, name, key, value):
+        self._maybe_fail()
+        return super().hset(name, key, value)
+
+    def hset_many(self, name, mapping):
+        self._maybe_fail()
+        return super().hset_many(name, mapping)
+
+    def hincr(self, name, key, by=1):
+        self._maybe_fail()
+        return super().hincr(name, key, by)
+
+
+def test_tier_mid_scan_failure_degrades_bit_identical(corpus, ref_engine):
+    rows = _rows(14)
+    want = ref_engine.match(bench_mod._clone_rows(rows))
+    store = _FlakyStore(fail_after=6)
+    tier = SharedResultTier(store, MemoryBlobStore())
+    client = _client(tier, worker="flaky", breaker_threshold=1)
+    got = _engine(corpus, client).match(bench_mod._clone_rows(rows))
+    _same(got, want)
+    assert client.counters()["breaker"] != "closed"
+
+
+def test_breaker_degrades_to_l1_only_and_stops_touching_store(corpus, ref_engine):
+    class _DeadStore(MemoryStateStore):
+        def __init__(self):
+            super().__init__()
+            self.calls = 0
+
+        def hget(self, name, key):
+            self.calls += 1
+            raise ConnectionError("down")
+
+        def hset_many(self, name, mapping):
+            self.calls += 1
+            raise ConnectionError("down")
+
+        hmget = hset = hincr = hget
+
+    store = _DeadStore()
+    client = _client(
+        SharedResultTier(store), worker="dead", breaker_threshold=1,
+        breaker_cooldown_s=3600.0,
+    )
+    eng = _engine(corpus, client)
+    rows = _rows(10)
+    want = ref_engine.match(bench_mod._clone_rows(rows))
+    got = eng.match(bench_mod._clone_rows(rows))
+    _same(got, want)
+    calls_after_trip = store.calls
+    eng.match(_rows(8, seed=99))  # second scan: breaker open, no I/O
+    assert store.calls == calls_after_trip
+    assert client.counters()["breaker"] == "open"
+
+
+def test_chaos_faulted_tier_completes_bit_identical(corpus, ref_engine):
+    """SWARM_FAULT_PLAN's cache.get / cache.put levers: a faulted tier
+    trips the breaker and the scan completes L1-only, bit-identical —
+    the chaos-soak clause for the cache subsystem."""
+    rows = _rows(12)
+    want = ref_engine.match(bench_mod._clone_rows(rows))
+    plan = install_plan("seed=3;cache.get:1-2;cache.put:1")
+    try:
+        tier = _tier()
+        client = _client(tier, worker="chaos", breaker_threshold=2)
+        got = _engine(corpus, client).match(bench_mod._clone_rows(rows))
+        _same(got, want)
+        snap = plan.snapshot()
+        assert sum(c["fired"] for c in snap.values()) > 0
+    finally:
+        clear_plan()
+    # after the plan clears, the same tier serves normally again
+    client2 = _client(tier, worker="chaos2")
+    got2 = _engine(corpus, client2).match(bench_mod._clone_rows(rows))
+    _same(got2, want)
+
+
+# ----------------------------------------------------------------------
+# epoch invalidation
+# ----------------------------------------------------------------------
+
+
+def test_epoch_bump_invalidates(corpus, ref_engine):
+    rows = _rows(8)
+    tier = _tier()
+    _engine(corpus, _client(tier, worker="e1")).match(
+        bench_mod._clone_rows(rows)
+    )
+    warm = _client(tier, worker="e2")
+    _engine(corpus, warm).match(bench_mod._clone_rows(rows))
+    assert warm.counters()["shared_hits"] > 0
+
+    tier.bump_epoch()
+    cold = _client(tier, worker="e3")
+    eng = _engine(corpus, cold)
+    got = eng.match(bench_mod._clone_rows(rows))
+    c = cold.counters()
+    assert c["shared_hits"] == 0 and c["shared_misses"] > 0
+    assert c["epoch"].endswith(".g1")
+    want = ref_engine.match(bench_mod._clone_rows(rows))
+    _same(got, want)
+
+
+def test_epoch_bump_propagates_to_live_clients(corpus):
+    """An operator ``bump_epoch`` reaches RUNNING clients within the
+    epoch TTL — live-fleet invalidation needs no restart. (TTL expiry
+    simulated by back-dating the client's last epoch read.)"""
+    tier = _tier()
+    client = _client(tier, worker="ttl")
+    eng = _engine(corpus, client)
+    eng.match(bench_mod._clone_rows(_rows(4, seed=51)))
+    assert client.counters()["epoch"].endswith(".g0")
+    tier.bump_epoch()
+    with client._lock:
+        client._epoch_read_at = -1e9
+    eng.match(_rows(4, seed=52))
+    assert client.counters()["epoch"].endswith(".g1")
+
+
+def test_corpus_refresh_changes_epoch(corpus):
+    """A refreshed corpus (different content digest) reads a different
+    key namespace — stale entries are unreachable, not served."""
+    rows = _rows(6)
+    tier = _tier()
+    _engine(corpus, _client(tier, worker="c1")).match(
+        bench_mod._clone_rows(rows)
+    )
+    refreshed = list(corpus) + bench_mod.walk_stress_templates()[:1]
+    assert corpus_digest(refreshed) != corpus_digest(corpus)
+    client = _client(tier, worker="c2")
+    _engine(refreshed, client).match(bench_mod._clone_rows(rows))
+    assert client.counters()["shared_hits"] == 0
+
+
+def test_corpus_digest_is_content_stable(corpus):
+    # same templates, fresh list object → same digest (cross-process
+    # stability rides on dataclass repr determinism)
+    assert corpus_digest(list(corpus)) == corpus_digest(corpus)
+
+
+# ----------------------------------------------------------------------
+# confirm-family promotion
+# ----------------------------------------------------------------------
+
+
+def test_confirm_promotion_roundtrip(stress_corpus, stress_ref):
+    """A confirm-heavy feed resolved by engine A leaves its confirm
+    verdicts in the tier; a fresh engine B with a DIFFERENT feed of the
+    same contents-per-part serves them from the tier's confirm family
+    (the verdict family can't shortcut B's rows: they are new
+    compositions, so only promoted confirms explain the hits)."""
+    rows = bench_mod.walk_stress_rows(32, seed=11)
+    want = stress_ref.match(bench_mod._clone_rows(rows))
+    tier = _tier()
+    ca = _client(tier, worker="cfA")
+    enga = _engine(stress_corpus, ca, batch_rows=64)
+    _same(enga.match(bench_mod._clone_rows(rows)), want)
+    assert enga.stats.host_confirm_pairs > 0
+
+    # verdict-family entries exist for the SAME contents; engine B's
+    # feed reuses the part bytes inside fresh row compositions, so the
+    # verdict family misses but the confirm family hits
+    rows_b = bench_mod._clone_rows(rows)
+    for i, r in enumerate(rows_b):
+        r.header = r.header + b"\r\nX-Recompose: %d" % i
+    cb = _client(tier, worker="cfB")
+    engb = _engine(stress_corpus, cb, batch_rows=64)
+    want_b = stress_ref.match(bench_mod._clone_rows(rows_b))
+    _same(engb.match(bench_mod._clone_rows(rows_b)), want_b)
+    assert cb.counters()["shared_hits"] > 0
+
+
+def test_confirm_digest_distinguishes_namespaces():
+    assert confirm_digest(("m", 3, b"p")) != confirm_digest(("pe", 3, b"p"))
+    assert confirm_digest(("m", 3, b"p")) != confirm_digest(("m", 4, b"p"))
+    assert confirm_digest(("m", 3, b"p")) != confirm_digest(("m", 3, b"q"))
+
+
+def test_confirm_family_can_be_disabled(stress_corpus, stress_ref):
+    rows = bench_mod.walk_stress_rows(24, seed=5)
+    tier = _tier()
+    ca = _client(tier, worker="nca")
+    _engine(stress_corpus, ca, batch_rows=32).match(
+        bench_mod._clone_rows(rows)
+    )
+    cb = _client(tier, worker="ncb", confirm=False)
+    engb = _engine(stress_corpus, cb, batch_rows=32)
+    want = stress_ref.match(bench_mod._clone_rows(rows))
+    _same(engb.match(bench_mod._clone_rows(rows)), want)
+
+
+# ----------------------------------------------------------------------
+# scheduler prefetch integration
+# ----------------------------------------------------------------------
+
+
+def test_scheduler_prefetch_rides_memo_lane(corpus, ref_engine):
+    rows = _rows(28, seed=21)
+    want = ref_engine.match(bench_mod._clone_rows(rows))
+    tier = _tier()
+    _engine(corpus, _client(tier, worker="s1")).match(
+        bench_mod._clone_rows(rows)
+    )
+    client = _client(tier, worker="s2")
+    eng = _engine(corpus, client, pipeline="on", batch_rows=16)
+    got = eng.match(bench_mod._clone_rows(rows))
+    _same(got, want)
+    snap = eng.scheduler().stats.snapshot()
+    # every tier-known row classified onto the memo lane at PLAN time:
+    # no fresh buckets, no device batch slots spent
+    assert snap["memo_rows"] == len(rows)
+    assert snap["fresh_rows"] == 0
+    assert client.counters()["shared_hits"] > 0

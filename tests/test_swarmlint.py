@@ -659,6 +659,7 @@ def test_lock_using_modules_carry_guard_annotations():
         "swarm_tpu/utils/trace.py",
         "swarm_tpu/native/scanio.py",
         "swarm_tpu/native/crex.py",
+        "swarm_tpu/cache/tier.py",
     ]
     bare = []
     for m in expected:

@@ -61,6 +61,15 @@ REQUIRED_FAMILIES = (
     "swarm_shard_halo_bytes_total",
     "swarm_shard_dispatches_total",
     "swarm_shard_survivor_max",
+    # content-addressed result cache (docs/CACHING.md): registered at
+    # telemetry import (memo_export), label combos pre-seeded and the
+    # latency histogram unlabeled — every family renders samples even
+    # in a tier-free process
+    "swarm_memo_lookups_total",
+    "swarm_memo_writebacks_total",
+    "swarm_memo_shared_hit_ratio",
+    "swarm_memo_shared_lookup_seconds",
+    "swarm_memo_epoch_generation",
 )
 
 

@@ -61,11 +61,13 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=off python bench.py --smoke
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=on python bench.py --smoke
 
 echo "== preflight: chaos smoke (seeded fault plan, docs/RESILIENCE.md) =="
-# injected device + scheduler faults must leave verdicts bit-identical
-# (device-degraded mode falls back to the exact CPU oracle); rc gates
-# on verdict identity AND on the plan actually firing
+# injected device + result-cache faults must leave verdicts
+# bit-identical (device-degraded mode falls back to the exact CPU
+# oracle; a faulted cache.get/cache.put trips the tier breaker and the
+# scan degrades to L1-only, docs/CACHING.md); rc gates on verdict
+# identity AND on the plan actually firing
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=on \
-    SWARM_FAULT_PLAN="seed=7;device.dispatch:1,3" \
+    SWARM_FAULT_PLAN="seed=7;device.dispatch:1,3;cache.get:2,4;cache.put:1" \
     python bench.py --smoke
 
 echo "== preflight: bench =="
