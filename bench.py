@@ -1848,6 +1848,110 @@ def _smoke_shard_clause(templates, db) -> "tuple[bool, dict]":
     }
 
 
+def _smoke_gateway_clause() -> "tuple[bool, dict]":
+    """Gateway smoke (docs/GATEWAY.md): three tenants against a REAL
+    in-process server — one tenant rate-limited into 429s — drained by
+    a real worker over the bundled corpus. The gate is cross-tenant
+    VERDICT IDENTITY (same content, different tenants, byte-identical
+    /raw) plus shed-count > 0 (the abusive tenant actually observed
+    backpressure); shed/admit counts are recorded, not gated."""
+    import tempfile
+    import threading as _threading
+
+    import requests as _requests
+
+    from swarm_tpu.client.cli import JobClient
+    from swarm_tpu.config import Config
+    from swarm_tpu.server.app import SwarmServer
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    tmp = tempfile.mkdtemp(prefix="swarm_gateway_smoke_")
+    modules_dir = os.path.join(tmp, "modules")
+    os.makedirs(modules_dir)
+    corpus = os.environ.get("SWARM_BENCH_CORPUS", str(BUNDLED_CORPUS))
+    with open(os.path.join(modules_dir, "fingerprint.json"), "w") as f:
+        json.dump({"backend": "tpu", "templates": corpus}, f)
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="gwsmoke",
+        blob_root=os.path.join(tmp, "blobs"),
+        doc_root=os.path.join(tmp, "docs"),
+        modules_dir=modules_dir,
+        poll_interval_idle_s=0.02, poll_interval_busy_s=0.01,
+        gateway_tenant_rate=2.0, gateway_tenant_burst=2,
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    cfg.server_url = f"http://127.0.0.1:{srv.port}"
+    lines = [
+        json.dumps(
+            {"host": f"10.9.0.{i}", "port": 443, "status": 200,
+             "body": f"<title>Demo Admin</title> demo-build 7.{i} page {i}"}
+        ) + "\n"
+        for i in range(4)
+    ]
+
+    def submit(tenant: str, scan_id: str) -> int:
+        return _requests.post(
+            f"{cfg.resolve_url()}/queue",
+            json={"module": "fingerprint", "file_content": lines,
+                  "batch_size": 2, "scan_id": scan_id, "chunk_index": 0},
+            headers={"Authorization": f"Bearer {cfg.api_key}",
+                     "X-Swarm-Tenant": tenant},
+            timeout=30,
+        ).status_code
+
+    try:
+        codes = [submit("alpha", "gwa_1"), submit("beta", "gwb_1")]
+        noisy_codes = [submit("noisy", f"gwn{k}_1") for k in range(6)]
+        admitted_noisy = [k for k, c in enumerate(noisy_codes) if c == 200]
+        shed = noisy_codes.count(429)
+        scans = ["gwa_1", "gwb_1"] + [f"gwn{k}_1" for k in admitted_noisy]
+        worker = JobProcessor(Config(**{**cfg.__dict__, "worker_id": "gw-w"}))
+        wt = _threading.Thread(target=worker.process_jobs, daemon=True)
+        wt.start()
+        client = JobClient(cfg.resolve_url(), cfg.api_key)
+        deadline = time.time() + 180
+        pending = set(scans)
+        while time.time() < deadline and pending:
+            time.sleep(0.2)
+            statuses = client.get_statuses()
+            if statuses is None:
+                continue
+            done = {
+                s["scan_id"] for s in statuses.get("scans", [])
+                if s["percent_complete"] == 100.0
+            }
+            pending -= done
+        worker.stop_requested = True
+        wt.join(timeout=30)
+        ref = client.fetch_raw("gwa_1")
+        identical = (
+            not pending
+            and bool(ref)
+            and all(
+                client.fetch_raw(s) == ref.replace("gwa_1", s)
+                for s in scans[1:]
+            )
+        )
+        rec = {
+            "admitted": codes + [c for c in noisy_codes if c == 200],
+            "shed_429": shed,
+            "admitted_noisy": len(admitted_noisy),
+            "scans_completed": len(scans) - len(pending),
+            "identical": identical,
+        }
+        ok = identical and shed > 0 and all(c == 200 for c in codes)
+        log(
+            f"gateway smoke: {len(scans)} admitted scans complete, "
+            f"{shed} shed (429), verdicts identical={identical}"
+        )
+        if not ok:
+            log(f"!!! gateway smoke FAILED: {rec}")
+        return ok, rec
+    finally:
+        srv.shutdown()
+
+
 def run_smoke() -> int:
     """CI-fast pipeline A/B (tools/preflight.sh): bundled corpus,
     tiny batches, no subprocess phases. Honors SWARM_PIPELINE as the
@@ -1906,6 +2010,18 @@ def run_smoke() -> int:
         "bundled-corpus smoke)",
         ded["speedup"],
         extra={"dedup": ded},
+    )
+    # gateway smoke (docs/GATEWAY.md): 3 tenants (one rate-limited)
+    # against a real server + worker — rc-gated on cross-tenant verdict
+    # identity AND on the abusive tenant observing at least one shed
+    gw_ok, gw_rec = _smoke_gateway_clause()
+    ok = ok and gw_ok
+    emit(
+        "smoke_gateway_shed_count",
+        float(gw_rec["shed_429"]),
+        " sheds (429) observed by the rate-limited smoke tenant",
+        float(gw_rec["shed_429"]),
+        extra={"gateway": gw_rec},
     )
     # shard smoke: the sharded serving path on the 8-device host-
     # platform mesh, rc-gated on verdict identity (docs/SHARDING.md).
@@ -1971,8 +2087,8 @@ def run_smoke() -> int:
             )
     if not ok:
         log(
-            "!!! pipeline/walk/shard/dedup verdict mismatch — smoke "
-            "FAILED"
+            "!!! pipeline/walk/shard/dedup/gateway verdict mismatch — "
+            "smoke FAILED"
         )
     return 0 if ok else 1
 

@@ -55,6 +55,7 @@ from typing import Optional, Sequence
 
 from swarm_tpu.telemetry.memo_export import (
     MEMO_EPOCH,
+    MEMO_EVICTIONS,
     MEMO_HIT_RATIO,
     MEMO_LOOKUP_SECONDS,
     MEMO_WRITEBACKS,
@@ -202,11 +203,53 @@ class SharedResultTier:
     _BLOB_SENTINEL = "@blob"
 
     def __init__(self, state, blobs=None, prefix: str = "swarm:cache",
-                 spill_bytes: int = 8192):
+                 spill_bytes: int = 8192, ttl_s: float = 0.0,
+                 max_entries: int = 0):
         self._state = state
         self._blobs = blobs
         self._prefix = prefix
         self._spill = int(spill_bytes)
+        self.configure_policy(ttl_s, max_entries)
+
+    # -- TTL/size policy (docs/CACHING.md) -----------------------------
+    def configure_policy(self, ttl_s: float = 0.0, max_entries: int = 0) -> None:
+        """Optional retention policy, per value-family namespace.
+        Both default OFF (0) — today's behavior (backend eviction +
+        epoch bumps) unless the operator configures it. ``ttl_s``
+        expires entries lazily at lookup; ``max_entries`` bounds EACH
+        ``{family}:{epoch}`` hash at write time, oldest-first. Only
+        entries written while a policy is active carry a write stamp
+        and participate; concurrent evictions are idempotent hdels."""
+        self._ttl_s = float(ttl_s)
+        self._max_entries = int(max_entries)
+
+    def _policy_active(self) -> bool:
+        return self._ttl_s > 0 or self._max_entries > 0
+
+    def _ts_name(self, family: str, epoch: str) -> str:
+        """Side hash of write timestamps (digest → unix seconds): the
+        entry wire format stays untouched, so flipping the policy on
+        or off can never strand or corrupt existing values."""
+        return f"{self._prefix}:ts:{family}:{epoch}"
+
+    def _evict(self, family: str, epoch: str, digests, reason: str) -> int:
+        name = self._hash_name(family, epoch)
+        ts_name = self._ts_name(family, epoch)
+        n = 0
+        for digest in digests:
+            # a spilled value's blob becomes unreachable garbage, the
+            # same reclamation story as a stale epoch's namespace
+            self._state.hdel(name, digest)
+            self._state.hdel(ts_name, digest)
+            n += 1
+        if n:
+            MEMO_EVICTIONS.labels(reason=reason).inc(n)
+        return n
+
+    def entry_count(self, family: str, epoch: str) -> int:
+        """Policy-tracked entries in one family namespace (test/ops
+        surface; entries written with the policy off aren't counted)."""
+        return len(self._state.hkeys(self._ts_name(family, epoch)))
 
     # -- epoch ---------------------------------------------------------
     def epoch_generation(self) -> int:
@@ -253,9 +296,38 @@ class SharedResultTier:
         if not digests:
             return {}
         name = self._hash_name(family, epoch)
+        expired: set = set()
+        if self._ttl_s > 0:
+            # lazy TTL expiry: stamps ride a side hash, one extra
+            # hmget per batched lookup only while the policy is on; an
+            # expired entry is dropped and served as a miss
+            now = time.time()
+            ts_name = self._ts_name(family, epoch)
+            stamps = self._state.hmget(ts_name, digests)
+            stale: dict = {}
+            for digest, raw_ts in zip(digests, stamps):
+                if raw_ts is None:
+                    continue  # pre-policy entry: no stamp, no expiry
+                try:
+                    if now - float(raw_ts) > self._ttl_s:
+                        stale[digest] = raw_ts
+                except ValueError:
+                    stale[digest] = raw_ts
+            if stale:
+                # re-read just before deleting: a concurrent writer may
+                # have refreshed the entry between the two reads, and
+                # deleting THAT would destroy a fresh value. The
+                # residual window after this check is benign (an
+                # entry loss = one recompute, never a wrong verdict).
+                recheck = self._state.hmget(ts_name, list(stale))
+                expired = {
+                    d for d, ts in zip(stale, recheck) if ts == stale[d]
+                }
+                if expired:
+                    self._evict(family, epoch, expired, "ttl")
         out: dict = {}
         for digest, raw in zip(digests, self._state.hmget(name, digests)):
-            if raw is None:
+            if raw is None or digest in expired:
                 continue
             if raw == self._BLOB_SENTINEL:
                 if self._blobs is None:
@@ -302,6 +374,19 @@ class SharedResultTier:
         # ONE state-store round trip for the whole batch (hset_many) —
         # a walked plane's writeback must not cost one RTT per row
         self._state.hset_many(name, mapping)
+        if self._policy_active():
+            now = str(time.time())
+            self._state.hset_many(
+                self._ts_name(family, epoch), {d: now for d in mapping}
+            )
+            if self._max_entries > 0:
+                stamps = self._state.hgetall(self._ts_name(family, epoch))
+                excess = len(stamps) - self._max_entries
+                if excess > 0:
+                    oldest = sorted(
+                        stamps, key=lambda d: (float(stamps[d]), d)
+                    )[:excess]
+                    self._evict(family, epoch, oldest, "size")
         if self.writer_token(writer_id) != token:
             return "fenced", 0
         return "stored", len(mapping)
@@ -768,6 +853,13 @@ def build_result_cache(cfg) -> Optional[ResultCacheClient]:
         )
     else:
         raise ValueError(f"unknown cache_backend {backend!r}")
+    # TTL/size policy (docs/CACHING.md): the tier objects are process
+    # singletons per backend, so the most recent configuration wins —
+    # defaults (0/0) keep today's behavior untouched
+    tier.configure_policy(
+        getattr(cfg, "cache_ttl_s", 0.0),
+        getattr(cfg, "cache_max_entries", 0),
+    )
     return ResultCacheClient(
         tier,
         worker_id=cfg.worker_id,

@@ -27,11 +27,22 @@ from swarm_tpu.telemetry.metrics import parse_exposition
 
 
 class JobClient:
-    def __init__(self, server_url: str, api_key: str, timeout: float = 60.0):
+    def __init__(
+        self,
+        server_url: str,
+        api_key: str,
+        timeout: float = 60.0,
+        tenant: Optional[str] = None,
+    ):
         self.base = server_url.rstrip("/")
         self.timeout = timeout
         self.session = requests.Session()
         self.session.headers["Authorization"] = f"Bearer {api_key}"
+        if tenant:
+            # tenant identity rides every request (docs/GATEWAY.md);
+            # absent = the server's default tenant, the reference wire
+            # behavior
+            self.session.headers["X-Swarm-Tenant"] = tenant
         #: trace ID of the most recent submission (scan/stream): the
         #: correlation key every layer's event lines carry for it
         self.last_trace_id: Optional[str] = None
@@ -135,6 +146,95 @@ class JobClient:
     def dead_letter_jobs(self) -> Optional[list]:
         resp = self.session.get(f"{self.base}/dead-letter", timeout=self.timeout)
         return resp.json()["jobs"] if resp.status_code == 200 else None
+
+    def get_tenants(self) -> Optional[dict]:
+        resp = self.session.get(f"{self.base}/tenants", timeout=self.timeout)
+        return resp.json()["tenants"] if resp.status_code == 200 else None
+
+    # ------------------------------------------------------------------
+    def stream_results(
+        self,
+        scan_id: str,
+        from_chunk: int = 0,
+        max_reconnects: int = 8,
+        reconnect_delay_s: float = 0.5,
+    ):
+        """Follow a scan's results as the server pushes them: yields
+        ``(chunk_index, text)`` in chunk order from ``GET /stream/
+        <scan_id>`` (NDJSON, docs/GATEWAY.md).
+
+        Resume discipline: the cursor is "last delivered chunk + 1".
+        On ANY disconnect — server restart, idle-timeout record, a
+        dropped connection — the client reconnects with ``?from=
+        <cursor>`` and continues from exactly the last acked chunk;
+        the server serves already-stored chunks from the idempotent
+        chunk store, so nothing is lost or duplicated. The reconnect
+        budget resets on every delivered chunk (progress heals it)."""
+        cursor = int(from_chunk)
+        failures = 0
+        while True:
+            ended = saw_timeout = False
+            try:
+                resp = self.session.get(
+                    f"{self.base}/stream/{scan_id}",
+                    params={"from": cursor},
+                    stream=True,
+                    timeout=self.timeout,
+                )
+                if resp.status_code != 200:
+                    raise requests.HTTPError(f"/stream: {resp.status_code}")
+                for line in resp.iter_lines():
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    event = rec.get("event")
+                    if event == "end":
+                        ended = True
+                        break
+                    if event == "timeout":
+                        saw_timeout = True
+                        break  # reconnect from the cursor
+                    if event == "skipped":
+                        cursor = int(rec["chunk"]) + 1
+                        continue
+                    if "chunk" in rec and "data" in rec:
+                        cursor = int(rec["chunk"]) + 1
+                        failures = 0
+                        yield rec["chunk"], rec["data"]
+            except requests.exceptions.ReadTimeout:
+                # inter-record silence past OUR read timeout, on a
+                # connection the server accepted: a healthy-but-slow
+                # scan, not a failure (the server's own idle record
+                # may be minutes away) — reconnect without burning the
+                # budget; a truly dead server fails the reconnect with
+                # a ConnectionError and burns it there
+                time.sleep(reconnect_delay_s)
+                continue
+            except (requests.RequestException, ValueError, OSError):
+                failures += 1
+                if failures > max_reconnects:
+                    raise
+                time.sleep(reconnect_delay_s)
+                continue
+            if ended:
+                return
+            if saw_timeout:
+                # a HEALTHY server bounding its handler lifetime while
+                # the scan is simply slow — follow indefinitely (tail
+                # -f semantics); only real disconnects burn the budget
+                time.sleep(reconnect_delay_s)
+                continue
+            # server closed WITHOUT an end/timeout record (restart,
+            # dropped connection): that's a failure — never silently
+            # truncate a live stream with a clean exit
+            failures += 1
+            if failures > max_reconnects:
+                raise requests.ConnectionError(
+                    f"/stream/{scan_id}: disconnected without an end "
+                    f"record after {max_reconnects} reconnects "
+                    f"(next chunk {cursor})"
+                )
+            time.sleep(reconnect_delay_s)
 
     def requeue_job(self, job_id: str) -> tuple[int, str]:
         resp = self.session.post(
@@ -248,6 +348,23 @@ def render_resilience_summary(health: dict) -> str:
     return "\n".join(lines)
 
 
+def render_tenants(tenants: dict) -> str:
+    """Per-tenant gateway readout: depth, admission outcomes, states
+    (`swarm tenants` — docs/GATEWAY.md)."""
+    table = Table(
+        ["Tenant", "Queue Depth", "Admitted", "Shed", "Jobs by State"]
+    )
+    for tenant, t in sorted(tenants.items()):
+        states = ", ".join(
+            f"{s}: {n}" for s, n in sorted((t.get("jobs_by_state") or {}).items())
+        )
+        table.add_row(
+            [tenant, t.get("queue_depth"), t.get("admitted"), t.get("shed"),
+             states]
+        )
+    return str(table)
+
+
 def render_scans(statuses: dict) -> str:
     table = Table(
         ["Scan ID", "Chunks", "Complete", "%", "Workers", "Module", "Started",
@@ -272,8 +389,8 @@ def render_scans(statuses: dict) -> str:
 # ---------------------------------------------------------------------------
 
 ACTIONS = [
-    "scan", "workers", "scans", "jobs", "metrics", "dead-letter", "spinup",
-    "terminate", "cat", "stream", "recycle", "reset",
+    "scan", "workers", "scans", "jobs", "metrics", "dead-letter", "tenants",
+    "spinup", "terminate", "cat", "stream", "recycle", "reset",
 ]
 
 
@@ -291,6 +408,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--prefix", help="node name prefix (spinup/terminate)")
     parser.add_argument("--nodes", type=int, help="node count (spinup)")
     parser.add_argument("--scan-id", help="scan id (cat/stream)")
+    parser.add_argument("--tenant", default=None,
+                        help="tenant id sent as X-Swarm-Tenant (gateway)")
+    parser.add_argument("--from-chunk", type=int, default=0,
+                        help="resume cursor for stream follow mode")
     parser.add_argument("--job-id", help="job id (dead-letter --requeue)")
     parser.add_argument("--requeue", action="store_true",
                         help="requeue the quarantined --job-id (dead-letter)")
@@ -299,7 +420,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     cfg = Config.load(path=args.config, server_url=args.server_url, api_key=args.api_key)
-    client = JobClient(cfg.resolve_url(), cfg.api_key)
+    client = JobClient(cfg.resolve_url(), cfg.api_key, tenant=args.tenant)
 
     if args.configure:
         cfg.save(args.config)
@@ -416,11 +537,31 @@ def _run_action(args, cfg: Config, client: JobClient) -> int:
         print(client.spin_up(args.prefix, args.nodes))
         return 0
 
-    if args.action == "stream":
-        # stdin → rolling 10-line chunks → /queue (reference client/swarm:316-334)
-        if not args.scan_id or not args.module:
-            print("Both scan-id and module are required for stream")
+    if args.action == "tenants":
+        tenants = client.get_tenants()
+        if tenants is None:
+            print("Failed to retrieve tenants")
             return 1
+        print(f"Tenants: {len(tenants)}")
+        print(render_tenants(tenants))
+        return 0
+
+    if args.action == "stream":
+        if not args.scan_id:
+            print("scan-id is required for stream")
+            return 1
+        if not args.module:
+            # FOLLOW mode (docs/GATEWAY.md): real server-push result
+            # streaming over /stream/<scan_id> — chunks print the
+            # moment they land, resumable via --from-chunk (the old
+            # behavior polled `cat`; submission mode below is the
+            # reference's stdin contract and still requires --module)
+            for _chunk, text in client.stream_results(
+                args.scan_id, from_chunk=args.from_chunk
+            ):
+                sys.stdout.write(text if text.endswith("\n") else text + "\n")
+                sys.stdout.flush()
+            return 0
         chunk: list[str] = []
         chunk_index = 0
         batch = 0 if args.batch_size == "auto" else int(float(args.batch_size))

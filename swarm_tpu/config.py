@@ -113,6 +113,53 @@ class Config:
     # to L1-only, it never blocks it
     cache_breaker_threshold: int = 3
     cache_breaker_cooldown_s: float = 30.0
+    # TTL/size policy for the shared namespaces (docs/CACHING.md): 0 =
+    # today's behavior (backend eviction + epoch bumps only). TTL is
+    # per entry; the max-entry bound applies to EACH value-family
+    # namespace independently, oldest entries evicted first.
+    cache_ttl_s: float = 0.0
+    cache_max_entries: int = 0
+
+    # --- multi-tenant gateway (docs/GATEWAY.md) ---
+    # per-tenant token bucket: submissions/second refill (0 = unlimited,
+    # the single-operator default) and burst capacity
+    gateway_tenant_rate: float = 0.0
+    gateway_tenant_burst: int = 64
+    # max jobs waiting in ONE tenant's dispatch queue before its
+    # submissions shed (0 = unbounded)
+    gateway_tenant_queue_max: int = 0
+    # queue depth that maps to composite pressure 1.0 (0 disables the
+    # depth component)
+    gateway_queue_high: int = 0
+    # composite pressure at/over which every submission sheds
+    gateway_shed_pressure: float = 1.0
+    # Retry-After seconds advertised on pressure/queue-full sheds
+    # (rate sheds compute the exact token wait instead)
+    gateway_retry_after_s: float = 1.0
+    # tenant-id cardinality cap: a NEW tenant past this sheds with
+    # reason "tenant_limit" (tenant ids are client data — without a
+    # bound, rotating fresh ids would mint a fresh token bucket per
+    # request and grow per-tenant state without limit)
+    gateway_max_tenants: int = 1024
+    # a worker's reported in-flight saturation decays after this many
+    # seconds (a dead worker's last report must not pin pressure)
+    gateway_saturation_ttl_s: float = 60.0
+    # registry slots free after this much tenant inactivity, so a past
+    # id-rotation flood can't deny new tenants until restart
+    gateway_tenant_ttl_s: float = 3600.0
+    # /stream/<scan_id>: poll cadence for new chunks and the idle
+    # window after which the server closes the stream (client resumes
+    # with ?from=<cursor>)
+    gateway_stream_poll_s: float = 0.05
+    gateway_stream_idle_timeout_s: float = 300.0
+    # queue-depth-driven autoscale advisor (server/fleet.py): target
+    # waiting-jobs-per-node ratio, node bounds, and whether POST
+    # /autoscale may actually apply the recommendation (default:
+    # dry-run — recommend only)
+    gateway_autoscale_jobs_per_node: int = 4
+    gateway_autoscale_min_nodes: int = 0
+    gateway_autoscale_max_nodes: int = 8
+    gateway_autoscale_apply: bool = False
 
     # --- fleet orchestration ---
     fleet_provider: str = "null"  # "null" | "digitalocean" | "process"

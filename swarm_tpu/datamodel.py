@@ -174,6 +174,11 @@ class Job:
     # ({ts, worker_id, status}), carried into the dead-letter state so
     # quarantined jobs explain themselves. Extra wire key.
     failure_history: Optional[list] = None
+    # submitting tenant (gateway PR, docs/GATEWAY.md): None = the
+    # default tenant — reference submissions carry no tenant header and
+    # land there, so legacy job records round-trip unchanged. Extra
+    # wire key the reference client ignores.
+    tenant: Optional[str] = None
 
     @classmethod
     def create(
@@ -182,6 +187,7 @@ class Job:
         chunk_index: int,
         module: str,
         trace_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> "Job":
         return cls(
             job_id=job_id_for(scan_id, chunk_index),
@@ -189,6 +195,7 @@ class Job:
             chunk_index=chunk_index,
             module=module,
             trace_id=trace_id,
+            tenant=tenant,
         )
 
     def to_wire(self) -> dict[str, Any]:

@@ -1,0 +1,23 @@
+"""Multi-tenant admission-controlled gateway (docs/GATEWAY.md).
+
+The serving tier's front door: every ``/queue`` submission carries a
+tenant id (``X-Swarm-Tenant`` header; absent = the ``default`` tenant,
+preserving the reference wire contract), and admission is decided by
+:class:`~swarm_tpu.gateway.admission.AdmissionController` — per-tenant
+token buckets, bounded per-tenant queues, and a composite backpressure
+signal (queue depth, worker-reported in-flight saturation, breaker
+states) that sheds with ``429 + Retry-After`` instead of letting
+overload turn into silent queue growth. Results stream back over
+``GET /stream/<scan_id>`` as NDJSON push
+(:mod:`swarm_tpu.gateway.streaming`), and the queue-depth-driven
+autoscale advisor lives in :class:`swarm_tpu.server.fleet.
+AutoscaleAdvisor`.
+"""
+
+from swarm_tpu.gateway.admission import (  # noqa: F401
+    AdmissionController,
+    Decision,
+    PressureSnapshot,
+    TokenBucket,
+)
+from swarm_tpu.gateway.streaming import stream_scan  # noqa: F401

@@ -29,11 +29,24 @@ class LeaseHeartbeat:
     """Context manager: renew ``job_id``'s lease every ``interval_s``
     until exit (or until the server rejects a renewal)."""
 
-    def __init__(self, client, job_id: str, worker_id: str, interval_s: float):
+    def __init__(
+        self,
+        client,
+        job_id: str,
+        worker_id: str,
+        interval_s: float,
+        saturation_fn=None,
+    ):
         self.client = client
         self.job_id = job_id
         self.worker_id = worker_id
         self.interval_s = max(0.05, float(interval_s))
+        #: optional 0..1 in-flight saturation provider: when set (and
+        #: returning a value), each renewal carries it so the gateway's
+        #: admission pressure sees accelerator saturation BEFORE the
+        #: queue backs up (docs/GATEWAY.md). None keeps the original
+        #: wire shape — stub clients without the kwarg stay compatible.
+        self.saturation_fn = saturation_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         #: False once the server refused a renewal: the lease is no
@@ -45,8 +58,16 @@ class LeaseHeartbeat:
     def _run(self) -> None:
         m = _RENEWALS
         while not self._stop.wait(self.interval_s):
+            kw = {}
+            if self.saturation_fn is not None:
+                try:
+                    saturation = self.saturation_fn()
+                except Exception:
+                    saturation = None
+                if saturation is not None:
+                    kw["saturation"] = saturation
             try:
-                ok = self.client.renew_lease(self.job_id, self.worker_id)
+                ok = self.client.renew_lease(self.job_id, self.worker_id, **kw)
             except TransportError:
                 # server unreachable: keep ticking — the lease may still
                 # be live on the server, and the next tick may land

@@ -123,8 +123,8 @@ class RetryingServerClient:
     def put_output_chunk(self, scan_id, chunk_index, data) -> bool:
         return self._call("put_output_chunk", scan_id, chunk_index, data)
 
-    def renew_lease(self, job_id, worker_id) -> bool:
-        return self._call("renew_lease", job_id, worker_id)
+    def renew_lease(self, job_id, worker_id, **kw) -> bool:
+        return self._call("renew_lease", job_id, worker_id, **kw)
 
     def __getattr__(self, name):
         # non-op attributes (base, session, timeout, …) proxy through

@@ -26,12 +26,19 @@ from typing import Any, Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from swarm_tpu.config import Config
-from swarm_tpu.datamodel import JobStatus
-from swarm_tpu.server.fleet import build_provider
+from swarm_tpu.datamodel import SCAN_ID_RE, JobStatus
+from swarm_tpu.gateway.admission import (
+    DEFAULT_TENANT,
+    AdmissionController,
+    PressureSnapshot,
+)
+from swarm_tpu.gateway.streaming import stream_scan
+from swarm_tpu.server.fleet import AutoscaleAdvisor, build_provider
 from swarm_tpu.server.queue import JobQueueService
 from swarm_tpu.stores import build_stores
 from swarm_tpu.telemetry import REGISTRY
 from swarm_tpu.telemetry.events import header_trace_id, new_trace_id
+from swarm_tpu.telemetry.gateway_export import GATEWAY_QUEUED
 from swarm_tpu.telemetry.metrics import CONTENT_TYPE as _METRICS_CTYPE
 
 _HTTP_REQUESTS = REGISTRY.counter(
@@ -81,6 +88,12 @@ class SwarmServer:
             queue = JobQueueService(cfg, state, blobs, docs, fleet=fleet)
         self.queue = queue
         self.fleet = fleet if fleet is not None else queue.fleet
+        # multi-tenant front door (docs/GATEWAY.md): admission control
+        # + the queue-depth-driven autoscale advisor (dry-run default)
+        self.gateway = AdmissionController.from_config(cfg)
+        self.autoscaler = AutoscaleAdvisor.from_config(
+            self.queue, self.fleet, cfg
+        )
         self._routes: list[tuple[str, re.Pattern, Callable, str]] = []
         self._register_routes()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -90,6 +103,7 @@ class SwarmServer:
         # without shutdown() don't stay scrapable forever; removed
         # explicitly on shutdown.
         self._seen_states: set[str] = set()
+        self._seen_tenants: set[str] = set()
         import weakref
 
         ref = weakref.ref(self)
@@ -111,6 +125,12 @@ class SwarmServer:
         for status, n in counts.items():
             _JOBS_BY_STATE.labels(status=status).set(n)
         self._seen_states |= set(counts)
+        depths = self.queue.tenant_depths()
+        for tenant in self._seen_tenants - set(depths):
+            GATEWAY_QUEUED.labels(tenant=tenant).set(0)
+        for tenant, n in depths.items():
+            GATEWAY_QUEUED.labels(tenant=tenant).set(n)
+        self._seen_tenants |= set(depths)
 
     # ------------------------------------------------------------------
     def _register_routes(self) -> None:
@@ -130,6 +150,10 @@ class SwarmServer:
         r("GET", r"^/raw/(?P<scan_id>[^/]+)$", self._raw, "/raw")
         r("POST", r"^/queue$", self._queue_job, "/queue")
         r("GET", r"^/get-job$", self._get_job, "/get-job")
+        r("GET", r"^/stream/(?P<scan_id>[^/]+)$", self._stream, "/stream")
+        r("GET", r"^/tenants$", self._tenants, "/tenants")
+        r("GET", r"^/autoscale$", self._autoscale_recommend, "/autoscale")
+        r("POST", r"^/autoscale$", self._autoscale_apply, "/autoscale")
         r("POST", r"^/spin-up$", self._spin_up, "/spin-up")
         r("POST", r"^/spin-down$", self._spin_down, "/spin-down")
         r("POST", r"^/reset$", self._reset, "/reset")
@@ -158,6 +182,7 @@ class SwarmServer:
 
         by_state = self.queue.jobs_by_state()
         plan = active_plan()
+        snap = self._pressure_snapshot()
         return self._json(
             200,
             {
@@ -168,6 +193,13 @@ class SwarmServer:
                 "dead_letter_jobs": by_state.get(JobStatus.DEAD_LETTER, 0),
                 "breakers": breaker_states(),
                 "fault_plan": plan.spec if plan is not None else "",
+                # gateway surface (docs/GATEWAY.md): load shed starts
+                # at pressure >= gateway_shed_pressure. COUNT only —
+                # tenant ids are client data and this endpoint is
+                # unauthenticated; the id list lives on authenticated
+                # GET /tenants
+                "pressure": round(self.gateway.pressure(snap), 4),
+                "tenant_count": len(self.queue.tenants()),
             },
         )
 
@@ -176,6 +208,13 @@ class SwarmServer:
             data = json.loads(body or b"{}")
         except ValueError:
             return self._json(400, {"message": "Invalid JSON"})
+        # heartbeats double as the saturation feed: a worker whose
+        # scheduler is stalling on a full in-flight window says so here,
+        # and admission pressure rises BEFORE the queue does
+        if data.get("worker_id") and "saturation" in data:
+            self.gateway.note_saturation(
+                data["worker_id"], data.get("saturation")
+            )
         expiry = self.queue.renew_lease(m["job_id"], data.get("worker_id"))
         if expiry is None:
             # the lease is not this worker's to renew (requeued,
@@ -202,9 +241,35 @@ class SwarmServer:
             changes = json.loads(body or b"{}")
         except ValueError:
             return self._json(400, {"message": "Invalid JSON"})
+        self._note_perf_saturation(changes)
         if self.queue.update_job(m["job_id"], changes):
             return self._json(200, {"message": "Job status updated"})
         return self._json(404, {"message": "Job not found"})
+
+    def _note_perf_saturation(self, changes: dict) -> None:
+        """Fold a completed job's perf fields into the admission
+        pressure signal: the worker's explicit ``inflight_saturation``
+        when present, else the scheduler snapshot's stall/wall ratio
+        (stall = the submit thread waited on a FULL in-flight window —
+        i.e. the accelerator is saturated)."""
+        worker_id = changes.get("worker_id")
+        perf = changes.get("perf")
+        if not worker_id or not isinstance(perf, dict):
+            return
+        saturation = perf.get("inflight_saturation")
+        if saturation is None:
+            sched = perf.get("sched")
+            if isinstance(sched, dict):
+                wall = sched.get("wall_seconds")
+                stall = sched.get("stall_seconds")
+                if (
+                    isinstance(wall, (int, float))
+                    and isinstance(stall, (int, float))
+                    and wall > 0
+                ):
+                    saturation = stall / wall
+        if saturation is not None:
+            self.gateway.note_saturation(worker_id, saturation)
 
     def _get_chunk(self, m, q, body, h):
         content = self.queue.output_chunk(m["scan_id"], int(m["chunk_id"]))
@@ -226,20 +291,144 @@ class SwarmServer:
     def _raw(self, m, q, body, h):
         return self._text(200, self.queue.raw_scan(m["scan_id"]))
 
+    @staticmethod
+    def _header(h: dict, name: str) -> Optional[str]:
+        """Case-insensitive header lookup (clients vary in casing)."""
+        lname = name.lower()
+        for key, value in h.items():
+            if key.lower() == lname:
+                return value
+        return None
+
+    def _pressure_snapshot(self) -> PressureSnapshot:
+        """One deterministic observation of the serving tier's load —
+        the sole dynamic input of a shed decision (docs/GATEWAY.md)."""
+        from swarm_tpu.resilience.breaker import breaker_states
+
+        by_state = self.queue.jobs_by_state()  # probe-storm-cached
+        active = sum(
+            n for status, n in by_state.items() if status in JobStatus.ACTIVE
+        )
+        open_breakers = sum(
+            1 for state in breaker_states().values() if state != "closed"
+        )
+        # queue_depth is one llen PER TENANT LIST — only pay for it
+        # when the depth component is actually enabled (queue_high 0,
+        # the default, disables it); the admission hot path must not
+        # scale with tenant count
+        depth = (
+            self.queue.queue_depth() if self.gateway.queue_high > 0
+            else by_state.get(JobStatus.QUEUED, 0)
+        )
+        return PressureSnapshot(
+            queue_depth=depth,
+            active_jobs=active,
+            saturation=self.gateway.fleet_saturation(),
+            open_breakers=open_breakers,
+        )
+
     def _queue_job(self, m, q, body, h):
         try:
             job_data = json.loads(body or b"{}")
         except ValueError:
             return self._text(400, "Invalid JSON")
+        # tenant model (docs/GATEWAY.md): X-Swarm-Tenant names the
+        # submitting tenant; absent = the default tenant, preserving
+        # the reference wire contract
+        tenant = (self._header(h, "X-Swarm-Tenant") or "").strip() or DEFAULT_TENANT
+        # shape-validate BEFORE admission: a malformed submission is a
+        # 400, never a consumed rate token or an "admitted" count
+        try:
+            _module, _scan_id, tenant = JobQueueService.validate_scan(
+                job_data, tenant
+            )
+        except ValueError as e:
+            return self._text(400, str(e))
+        # admission control: shed, never block — a 429 with Retry-After
+        # is the overload story, not a growing queue
+        decision = self.gateway.decide(
+            tenant,
+            self._pressure_snapshot(),
+            time.monotonic(),
+            tenant_depth=self.queue.tenant_depth(tenant),
+        )
+        if not decision.admitted:
+            retry_after = max(0.0, decision.retry_after_s)
+            import math
+
+            return (
+                429,
+                json.dumps(
+                    {
+                        "message": "Request shed by admission control",
+                        "reason": decision.reason,
+                        "retry_after_s": round(retry_after, 3),
+                        "pressure": round(decision.pressure, 4),
+                    }
+                ).encode(),
+                "application/json",
+                {"Retry-After": str(max(1, math.ceil(retry_after)))},
+            )
         # trace propagation: honor the client's X-Swarm-Trace, mint one
         # for clients that don't send it (reference client) so every job
         # record carries a usable correlation id either way
         trace_id = header_trace_id(h) or new_trace_id()
         try:
-            self.queue.queue_scan(job_data, trace_id=trace_id)
+            self.queue.queue_scan(job_data, trace_id=trace_id, tenant=tenant)
         except ValueError as e:
             return self._text(400, str(e))
         return self._text(200, "Job queued successfully")
+
+    def _stream(self, m, q, body, h):
+        """Server-push NDJSON results (gateway/streaming.py): the body
+        is a GENERATOR — the HTTP layer writes it chunked as records
+        arrive, so the client sees chunk i the moment it lands."""
+        scan_id = m["scan_id"]
+        if not SCAN_ID_RE.match(scan_id):
+            return self._json(400, {"message": "Invalid scan_id"})
+        try:
+            from_chunk = int((q.get("from") or ["0"])[0])
+        except ValueError:
+            return self._json(400, {"message": "Invalid from cursor"})
+        gen = stream_scan(
+            self.queue,
+            scan_id,
+            from_chunk=max(0, from_chunk),
+            poll_s=self.cfg.gateway_stream_poll_s,
+            idle_timeout_s=self.cfg.gateway_stream_idle_timeout_s,
+        )
+        return 200, gen, "application/x-ndjson"
+
+    def _tenants(self, m, q, body, h):
+        """Per-tenant operator surface: queue depth, jobs by state,
+        admission counters (`swarm tenants`)."""
+        depths = self.queue.tenant_depths()
+        by_tenant = self.queue.jobs_by_tenant()
+        admission = self.gateway.snapshot()
+        out = {}
+        for tenant in sorted(set(depths) | set(by_tenant) | set(admission)):
+            counts = admission.get(tenant, {})
+            out[tenant] = {
+                "queue_depth": depths.get(tenant, 0),
+                "jobs_by_state": by_tenant.get(tenant, {}),
+                "admitted": counts.get("admitted", 0),
+                "shed": counts.get("shed", 0),
+            }
+        return self._json(200, {"tenants": out})
+
+    def _autoscale_recommend(self, m, q, body, h):
+        prefix = (q.get("prefix") or ["node"])[0]
+        return self._json(200, self.autoscaler.recommend(prefix))
+
+    def _autoscale_apply(self, m, q, body, h):
+        try:
+            data = json.loads(body or b"{}")
+        except ValueError:
+            return self._json(400, {"message": "Invalid JSON"})
+        prefix = data.get("prefix") or "node"
+        # dry-run unless the operator armed gateway_autoscale_apply —
+        # the advisor itself refuses to touch the provider otherwise
+        return self._json(200, self.autoscaler.apply(prefix))
 
     def _get_job(self, m, q, body, h):
         worker_id = (q.get("worker_id") or [None])[0]
@@ -295,7 +484,11 @@ class SwarmServer:
 
     def dispatch(
         self, method: str, path: str, query: dict, headers: dict, body: bytes
-    ) -> tuple[int, bytes, str]:
+    ) -> tuple[int, Any, str, dict]:
+        """Returns ``(code, payload, content_type, extra_headers)``.
+        Handlers may return 3- or 4-tuples (``_observed`` normalizes);
+        a non-bytes payload is an ITERATOR of byte chunks that the HTTP
+        layer writes with chunked transfer encoding (/stream)."""
         t0 = time.perf_counter()
         parsed_path = path.rstrip("/") or "/"
         if parsed_path not in self.UNAUTHENTICATED:
@@ -328,13 +521,18 @@ class SwarmServer:
 
     @staticmethod
     def _observed(
-        route: str, method: str, t0: float, result: tuple[int, bytes, str]
-    ) -> tuple[int, bytes, str]:
-        """Record request count + latency for one dispatched request."""
+        route: str, method: str, t0: float, result: tuple
+    ) -> tuple[int, Any, str, dict]:
+        """Record request count + latency for one dispatched request
+        and normalize the handler result to the 4-tuple form (for a
+        streaming body the latency covers dispatch, not the stream's
+        lifetime — the generator hasn't run yet)."""
         _HTTP_REQUESTS.labels(
             route=route, method=method, code=str(result[0])
         ).inc()
         _HTTP_LATENCY.labels(route=route).observe(time.perf_counter() - t0)
+        if len(result) == 3:
+            return (result[0], result[1], result[2], {})
         return result
 
     # ------------------------------------------------------------------
@@ -362,15 +560,28 @@ class SwarmServer:
             self.cfg.server_url = f"http://{host}:{self.port}"
             self.cfg.server_url_derived = True
 
+    #: serve_forever's shutdown-check cadence. The stdlib default
+    #: (0.5 s) makes every shutdown() block up to half a second —
+    #: across a test suite with dozens of server fixtures that is
+    #: tens of wasted wall-seconds; 50 ms of idle select cost is
+    #: unmeasurable next to request handling.
+    POLL_INTERVAL_S = 0.05
+
     def serve_forever(self) -> None:
         self._httpd = _make_httpd(self)
         self._advertise_url()
-        self._httpd.serve_forever()
+        self._httpd.serve_forever(poll_interval=self.POLL_INTERVAL_S)
 
     def start_background(self) -> threading.Thread:
         self._httpd = _make_httpd(self)
         self._advertise_url()
-        thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        httpd = self._httpd  # bind now: shutdown() may None the attr
+        thread = threading.Thread(
+            target=lambda: httpd.serve_forever(
+                poll_interval=self.POLL_INTERVAL_S
+            ),
+            daemon=True,
+        )
         thread.start()
         return thread
 
@@ -388,6 +599,9 @@ class SwarmServer:
         for status in self._seen_states:
             _JOBS_BY_STATE.labels(status=status).set(0)
         self._seen_states.clear()
+        for tenant in self._seen_tenants:
+            GATEWAY_QUEUED.labels(tenant=tenant).set(0)
+        self._seen_tenants.clear()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -406,20 +620,56 @@ def _make_httpd(server: SwarmServer) -> ThreadingHTTPServer:
             query = parse_qs(parsed.query)
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
-            code, payload, ctype = server.dispatch(
+            code, payload, ctype, extra = server.dispatch(
                 method, parsed.path, query, dict(self.headers), body
             )
             if code == 204:
                 # 204 is bodyless by spec; a body here would linger in the
                 # socket and corrupt the next keep-alive request
                 payload = b""
+            if not isinstance(payload, (bytes, bytearray)):
+                self._stream_body(method, code, payload, ctype, extra)
+                return
             self.send_response(code)
             self.send_header("Content-Type", ctype)
+            for key, value in extra.items():
+                self.send_header(key, value)
             if code != 204:
                 self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             if payload and method != "HEAD":
                 self.wfile.write(payload)
+
+        def _stream_body(self, method, code, chunks, ctype, extra) -> None:
+            """Write an iterator payload with chunked transfer encoding
+            (HTTP/1.1): each yielded record flushes immediately, so a
+            /stream client sees results as they land. A client that
+            disconnects mid-stream just ends the generator; the broken
+            socket is dropped, never reused for keep-alive. (Only GET
+            routes produce generator payloads — HEAD requests match no
+            GET route in dispatch and 404 before reaching here.)"""
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            for key, value in extra.items():
+                self.send_header(key, value)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                for part in chunks:
+                    part = bytes(part)
+                    if not part:
+                        continue
+                    self.wfile.write(
+                        f"{len(part):X}\r\n".encode() + part + b"\r\n"
+                    )
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionError, OSError):
+                self.close_connection = True
+            finally:
+                close = getattr(chunks, "close", None)
+                if close is not None:
+                    close()
 
         def do_GET(self):
             self._run("GET")
@@ -430,14 +680,26 @@ def _make_httpd(server: SwarmServer) -> ThreadingHTTPServer:
         def do_HEAD(self):
             self._run("HEAD")
 
+    class _Server(ThreadingHTTPServer):
+        def handle_error(self, request, client_address):
+            # a /stream client hanging up mid-push (or any keep-alive
+            # peer resetting) is normal operation, not a server error —
+            # the stdlib default would dump a traceback per disconnect
+            import sys as _sys
+
+            exc = _sys.exc_info()[1]
+            if isinstance(exc, (BrokenPipeError, ConnectionError)):
+                return
+            super().handle_error(request, client_address)
+
     if ":" in server.cfg.host:  # IPv6 literal (e.g. "::1", "fd00::1")
         import socket
 
-        class _V6Server(ThreadingHTTPServer):
+        class _V6Server(_Server):
             address_family = socket.AF_INET6
 
         return _V6Server((server.cfg.host, server.cfg.port), Handler)
-    return ThreadingHTTPServer((server.cfg.host, server.cfg.port), Handler)
+    return _Server((server.cfg.host, server.cfg.port), Handler)
 
 
 def main(argv: Optional[list[str]] = None) -> None:

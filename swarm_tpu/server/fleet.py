@@ -185,9 +185,15 @@ class DigitalOceanProvider(FleetProvider):
         ]
 
     def spin_up(self, prefix, nodes):
+        # ensure-up like ProcessProvider: DO allows duplicate droplet
+        # names, so re-creating a live name would double-bill and
+        # corrupt list_nodes/scale-down arithmetic — skip names that
+        # already exist (one listing call per spin_up)
+        live = set(self.list_nodes(prefix))
         threads = [
             threading.Thread(target=self._create_one, args=(n,), daemon=True)
             for n in generate_node_names(prefix, nodes)
+            if n not in live
         ]
         for t in threads:
             t.start()
@@ -207,6 +213,91 @@ class DigitalOceanProvider(FleetProvider):
 
     def list_nodes(self, prefix):
         return [d["name"] for d in self._droplets(prefix)]
+
+
+class AutoscaleAdvisor:
+    """Queue-depth-driven worker autoscaling (docs/GATEWAY.md).
+
+    Closes the control loop the PR 1 gauges opened: the recommendation
+    is a pure function of queue depth (``swarm_queue_depth``'s source)
+    against a target waiting-jobs-per-node ratio, clamped to
+    ``[min_nodes, max_nodes]``. DRY-RUN BY DEFAULT — ``recommend()``
+    only reads; ``apply()`` touches the provider exclusively when the
+    operator set ``gateway_autoscale_apply`` (scale-down tears down the
+    highest-numbered nodes by name, matching ``generate_node_names``'s
+    ``prefix1..prefixN`` scheme)."""
+
+    def __init__(
+        self,
+        queue,
+        provider: FleetProvider,
+        jobs_per_node: int = 4,
+        min_nodes: int = 0,
+        max_nodes: int = 8,
+        apply_enabled: bool = False,
+    ):
+        self.queue = queue
+        self.provider = provider
+        self.jobs_per_node = max(1, int(jobs_per_node))
+        self.min_nodes = max(0, int(min_nodes))
+        self.max_nodes = max(self.min_nodes, int(max_nodes))
+        self.apply_enabled = bool(apply_enabled)
+
+    @classmethod
+    def from_config(cls, queue, provider, cfg) -> "AutoscaleAdvisor":
+        return cls(
+            queue,
+            provider,
+            jobs_per_node=getattr(cfg, "gateway_autoscale_jobs_per_node", 4),
+            min_nodes=getattr(cfg, "gateway_autoscale_min_nodes", 0),
+            max_nodes=getattr(cfg, "gateway_autoscale_max_nodes", 8),
+            apply_enabled=getattr(cfg, "gateway_autoscale_apply", False),
+        )
+
+    def recommend(self, prefix: str = "node") -> dict:
+        """Read-only recommendation against the live queue gauges."""
+        import math
+
+        depth = self.queue.queue_depth()
+        current = len(self.provider.list_nodes(prefix))
+        target = min(
+            max(math.ceil(depth / self.jobs_per_node), self.min_nodes),
+            self.max_nodes,
+        )
+        if target > current:
+            action = "spin-up"
+        elif target < current:
+            action = "spin-down"
+        else:
+            action = "hold"
+        return {
+            "prefix": prefix,
+            "queue_depth": depth,
+            "current_nodes": current,
+            "target_nodes": target,
+            "action": action,
+            "dry_run": not self.apply_enabled,
+        }
+
+    def apply(self, prefix: str = "node") -> dict:
+        """Execute the recommendation (no-op while dry-run).
+
+        Scale-up passes the TARGET, not the delta: ``spin_up(prefix,
+        N)`` generates the fixed names ``prefix1..prefixN`` (reference
+        naming scheme), so providers ensure-up to N — already-live
+        names are skipped, never duplicated. Passing a delta would
+        regenerate ``prefix1..prefixΔ`` and collide with the live
+        nodes instead of adding new ones."""
+        rec = self.recommend(prefix)
+        if not self.apply_enabled or rec["action"] == "hold":
+            return rec
+        if rec["action"] == "spin-up":
+            self.provider.spin_up(prefix, rec["target_nodes"])
+        else:
+            for i in range(rec["target_nodes"] + 1, rec["current_nodes"] + 1):
+                self.provider.teardown_async(f"{prefix}{i}")
+        rec["applied"] = True
+        return rec
 
 
 def build_provider(cfg) -> FleetProvider:

@@ -17,6 +17,7 @@ Fixes over the reference (SURVEY.md §5 "no retry or requeue"):
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from typing import Any, Optional
@@ -35,6 +36,7 @@ from swarm_tpu.datamodel import (
     job_id_for,
     rollup_scans,
 )
+from swarm_tpu.gateway.admission import DEFAULT_TENANT
 from swarm_tpu.stores import BlobStore, DocStore, StateStore
 from swarm_tpu.telemetry import REGISTRY, emit_event
 
@@ -103,6 +105,53 @@ class JobQueueService:
         self._gen_lock = threading.Lock()  # guards: _jobs_generation, _by_state_cache
         self._jobs_generation = 0
         self._by_state_cache: tuple[float, int, dict[str, int]] = (0.0, -1, {})
+        # weighted-fair dispatch cursor (docs/GATEWAY.md): next_job
+        # serves tenant queues round-robin starting AFTER the tenant it
+        # served last, so a deep queue from one tenant can never starve
+        # the others (equal weights; the cursor only moves on a serve)
+        self._rr_cursor = 0  # guarded-by: _lock
+
+    # ------------------------------------------------------------------
+    # Tenant queues (docs/GATEWAY.md)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _queue_list(tenant: Optional[str]) -> str:
+        """Dispatch-list key for one tenant. The default tenant keeps
+        the reference's bare ``job_queue`` list so legacy tooling (and
+        a real Redis populated by the reference server) interoperates
+        unchanged; other tenants get their own bounded list."""
+        if not tenant or tenant == DEFAULT_TENANT:
+            return "job_queue"
+        return f"job_queue:t:{tenant}"
+
+    def _queue_names(self) -> list[str]:
+        """Every dispatch list, default first then registered tenants
+        in sorted order (a stable rotation order for the fair cursor)."""
+        names = ["job_queue"]
+        for tenant in sorted(self.state.hkeys("tenants")):
+            if tenant != DEFAULT_TENANT:
+                names.append(self._queue_list(tenant))
+        return names
+
+    def tenants(self) -> list[str]:
+        """Registered tenants (default always listed first)."""
+        rest = sorted(
+            t for t in self.state.hkeys("tenants") if t != DEFAULT_TENANT
+        )
+        return [DEFAULT_TENANT] + rest
+
+    def tenant_depths(self) -> dict[str, int]:
+        """Waiting-job depth per tenant (O(1) llen per tenant)."""
+        out = {DEFAULT_TENANT: self.state.llen("job_queue")}
+        for tenant in self.tenants():
+            if tenant != DEFAULT_TENANT:
+                out[tenant] = self.state.llen(self._queue_list(tenant))
+        return out
+
+    def tenant_depth(self, tenant: Optional[str]) -> int:
+        """ONE tenant's waiting-job depth — O(1), for the admission
+        hot path (the all-tenant map is O(tenants) store calls)."""
+        return self.state.llen(self._queue_list(tenant))
 
     # ------------------------------------------------------------------
     # Telemetry snapshots (scrape-time: /metrics and /healthz)
@@ -116,8 +165,9 @@ class JobQueueService:
     BY_STATE_TTL_S = 2.0
 
     def queue_depth(self) -> int:
-        """Jobs currently waiting in the dispatch list (O(1) llen)."""
-        return self.state.llen("job_queue")
+        """Jobs currently waiting across ALL tenants' dispatch lists
+        (O(tenants) llen calls)."""
+        return sum(self.state.llen(n) for n in self._queue_names())
 
     def jobs_by_state(self) -> dict[str, int]:
         """Status → count over every job record (probe-storm-cached)."""
@@ -142,10 +192,82 @@ class JobQueueService:
             self._by_state_cache = (now, gen, counts)
         return dict(counts)
 
+    def jobs_by_tenant(self) -> dict[str, dict[str, int]]:
+        """Tenant → (status → count) over every job record.
+
+        Snapshot-then-render: the ONE ``hgetall`` copies the raw hash
+        under the store's own lock; every ``json.loads`` runs on that
+        snapshot afterwards, so neither the dispatch lock nor the
+        store lock is ever held across serialization — a huge job
+        table cannot stall submits or dispatches while it renders."""
+        raw_jobs = self.state.hgetall("jobs")  # the snapshot
+        out: dict[str, dict[str, int]] = {}
+        for raw in raw_jobs.values():
+            try:
+                rec = json.loads(raw)
+                status = rec.get("status") or "unknown"
+                tenant = rec.get("tenant") or DEFAULT_TENANT
+            except ValueError:
+                status, tenant = "unparseable", DEFAULT_TENANT
+            per = out.setdefault(tenant, {})
+            per[status] = per.get(status, 0) + 1
+        return out
+
+    # ------------------------------------------------------------------
+    # Streaming support (gateway/streaming.py reads these; neither may
+    # hold queue locks — the stream generator polls them in a loop)
+    # ------------------------------------------------------------------
+    def scan_chunk_states(self, scan_id: str) -> dict[int, str]:
+        """Chunk index → job status for one scan (snapshot-then-render,
+        like :meth:`jobs_by_tenant`)."""
+        out: dict[int, str] = {}
+        for _job_id, raw in self.state.hgetall("jobs").items():
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if rec.get("scan_id") != scan_id:
+                continue
+            try:
+                out[int(rec.get("chunk_index"))] = rec.get("status") or "unknown"
+            except (TypeError, ValueError):
+                continue
+        return out
+
+    def chunk_status(self, scan_id: str, chunk_index: int) -> Optional[str]:
+        """ONE chunk's job status — a single hget, the stream
+        generator's hot-loop probe (the full scan_chunk_states render
+        is O(all jobs) and reserved for the rare gap/end decision)."""
+        raw = self.state.hget("jobs", job_id_for(scan_id, chunk_index))
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw).get("status") or "unknown"
+        except ValueError:
+            return "unparseable"
+
+    def stored_output_chunks(self, scan_id: str) -> set[int]:
+        """Chunk indices present in the durable output store — the
+        restart-resume source of truth for /stream."""
+        out: set[int] = set()
+        for key in self.blobs.list(f"{scan_id}/output/"):
+            m = re.search(r"chunk_(\d+)\.txt$", key)
+            if m:
+                out.add(int(m.group(1)))
+        return out
+
     # ------------------------------------------------------------------
     # Submission (reference queue_job, server.py:414-461)
     # ------------------------------------------------------------------
-    def queue_scan(self, job_data: dict, trace_id: Optional[str] = None) -> dict:
+    @staticmethod
+    def validate_scan(
+        job_data: dict, tenant: Optional[str] = None
+    ) -> tuple[str, str, str]:
+        """Shape-validate one submission WITHOUT side effects; returns
+        ``(module, scan_id, tenant)`` or raises ValueError. The
+        gateway runs this BEFORE admission so a malformed request
+        never burns a tenant's rate token or counts as admitted;
+        queue_scan re-uses it so the two sites cannot drift."""
         module = job_data.get("module")
         if not module:
             raise ValueError("Module must be provided")
@@ -154,20 +276,44 @@ class JobQueueService:
         scan_id = job_data.get("scan_id") or generate_scan_id(module)
         if not SCAN_ID_RE.match(str(scan_id)):
             raise ValueError("Invalid scan_id")
+        tenant = tenant or DEFAULT_TENANT
+        if not SCAN_ID_RE.match(tenant):
+            raise ValueError("Invalid tenant")
+        # the numeric fields must coerce exactly the way queue_scan
+        # will coerce them — a submission that would 400 downstream
+        # must fail HERE, before it can burn an admission token
+        try:
+            int(float(job_data.get("batch_size") or 0))
+            int(job_data.get("chunk_index") or 0)
+        except (TypeError, ValueError):
+            raise ValueError("Invalid batch_size or chunk_index")
+        return str(module), str(scan_id), tenant
+
+    def queue_scan(
+        self,
+        job_data: dict,
+        trace_id: Optional[str] = None,
+        tenant: Optional[str] = None,
+    ) -> dict:
+        module, scan_id, tenant = self.validate_scan(job_data, tenant)
         file_content = job_data.get("file_content") or []
         lines = [l.rstrip("\n") for l in file_content]
         batch_size = int(float(job_data.get("batch_size") or 0))
         base_index = int(job_data.get("chunk_index") or 0)
 
+        self.state.hset("tenants", tenant, "1")
+        queue_list = self._queue_list(tenant)
         queued = 0
         for offset, chunk in enumerate(chunk_generator(lines, batch_size)):
             chunk_index = base_index + offset
             self.blobs.put(
                 chunk_input_key(scan_id, chunk_index), "\n".join(chunk).encode()
             )
-            job = Job.create(scan_id, chunk_index, module, trace_id=trace_id)
+            job = Job.create(
+                scan_id, chunk_index, module, trace_id=trace_id, tenant=tenant
+            )
             self._put_job(job)
-            self.state.rpush("job_queue", job.job_id)
+            self.state.rpush(queue_list, job.job_id)
             queued += 1
             _JOBS_QUEUED.inc()
             emit_event(
@@ -177,6 +323,7 @@ class JobQueueService:
                 scan_id=scan_id,
                 module=module,
                 chunk_index=chunk_index,
+                tenant=tenant,
             )
         return {"scan_id": scan_id, "chunks": queued}
 
@@ -200,19 +347,30 @@ class JobQueueService:
         job: Optional[Job] = None
         with self._lock:
             self._requeue_expired(now)
-            # loop (not recursion): drop dangling ids from queue/hash
-            # desync (e.g. /reset racing a submit) without blowing the stack
-            while True:
-                job_id = self.state.lpop("job_queue")
-                if job_id is None:
+            # weighted-fair dequeue (docs/GATEWAY.md): scan the tenant
+            # lists round-robin from the cursor, serve the first
+            # non-empty one, park the cursor AFTER it — one tenant's
+            # backlog can delay another by at most (tenants - 1) serves
+            names = self._queue_names()
+            for k in range(len(names)):
+                name = names[(self._rr_cursor + k) % len(names)]
+                # loop (not recursion): drop dangling ids from queue/
+                # hash desync (e.g. /reset racing a submit) without
+                # blowing the stack
+                while True:
+                    job_id = self.state.lpop(name)
+                    if job_id is None:
+                        break
+                    job = self._get_job_record(job_id)
+                    if job is not None and job.status == JobStatus.QUEUED:
+                        break
+                    # dangling id, or a job that left QUEUED while its
+                    # id was still in the list (e.g. completed unfenced
+                    # after a lease-expiry requeue) — never re-lease
+                    job = None
+                if job is not None:
+                    self._rr_cursor = (self._rr_cursor + k + 1) % len(names)
                     break
-                job = self._get_job_record(job_id)
-                if job is not None and job.status == JobStatus.QUEUED:
-                    break
-                # dangling id, or a job that left QUEUED while its id was
-                # still in the list (e.g. completed unfenced after a
-                # lease-expiry requeue) — never re-lease those
-                job = None
 
             if job is not None:
                 # lease assignment stays under the store lock: between
@@ -295,7 +453,10 @@ class JobQueueService:
             job.worker_id = None
             job.lease_expires_at = None
             self._put_job(job)
-            self.state.rpush("job_queue", job.job_id)
+            # a requeue goes back to ITS tenant's list: lease recovery
+            # must not launder an abusive tenant's jobs into another
+            # tenant's dispatch share
+            self.state.rpush(self._queue_list(job.tenant), job.job_id)
             _JOBS_REQUEUED.inc()
             emit_event(
                 "job.requeued", trace_id=job.trace_id, job_id=job_id,
@@ -392,7 +553,7 @@ class JobQueueService:
             job.lease_expires_at = None
             job.attempts = 0
             self._put_job(job)
-            self.state.rpush("job_queue", job.job_id)
+            self.state.rpush(self._queue_list(job.tenant), job.job_id)
         _JOBS_REQUEUED.inc()
         emit_event(
             "job.dead_letter_requeued", trace_id=job.trace_id, job_id=job_id
@@ -465,7 +626,7 @@ class JobQueueService:
                 job.worker_id = None
                 job.lease_expires_at = None
                 self._put_job(job)
-                self.state.rpush("job_queue", job.job_id)
+                self.state.rpush(self._queue_list(job.tenant), job.job_id)
                 _JOBS_RETRIED.labels(status=new_status).inc()
                 emit_event(
                     "job.retry",
@@ -526,14 +687,20 @@ class JobQueueService:
     # Status aggregation (reference get_statuses, server.py:219-305)
     # ------------------------------------------------------------------
     def statuses(self) -> dict:
+        # snapshot-then-render: both hgetall calls copy under the
+        # store's internal lock only; parsing, rollup and the doc-store
+        # writes below run on the snapshots with NO queue/store lock
+        # held (a slow doc backend must not stall dispatch)
+        raw_workers = self.state.hgetall("workers")
+        raw_jobs = self.state.hgetall("jobs")
         workers = {}
-        for worker_id, raw in self.state.hgetall("workers").items():
+        for worker_id, raw in raw_workers.items():
             try:
                 workers[worker_id] = json.loads(raw)
             except ValueError:
                 continue
         jobs = {}
-        for job_id, raw in self.state.hgetall("jobs").items():
+        for job_id, raw in raw_jobs.items():
             try:
                 jobs[job_id] = json.loads(raw)
             except ValueError:
@@ -542,7 +709,18 @@ class JobQueueService:
         for scan in scans:
             if scan["percent_complete"] == 100:
                 self._persist_scan_summary(scan)
-        return {"workers": workers, "jobs": jobs, "scans": scans}
+        # per-tenant rollup from the SAME snapshot (one parse pass is
+        # plenty: the records are already dicts here)
+        tenants: dict[str, dict[str, int]] = {}
+        for rec in jobs.values():
+            tenant = rec.get("tenant") or DEFAULT_TENANT
+            status = rec.get("status") or "unknown"
+            per = tenants.setdefault(tenant, {})
+            per[status] = per.get(status, 0) + 1
+        return {
+            "workers": workers, "jobs": jobs, "scans": scans,
+            "tenants": tenants,
+        }
 
     def _persist_scan_summary(self, scan: dict) -> None:
         coll = self.docs.collection("scans")
@@ -620,5 +798,7 @@ class JobQueueService:
     def reset(self) -> None:
         """Flush all queue/scan state (reference /reset, server.py:550-554)."""
         self.state.flushall()
+        with self._lock:
+            self._rr_cursor = 0
         with self._gen_lock:
             self._jobs_generation += 1

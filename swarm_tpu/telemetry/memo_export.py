@@ -46,6 +46,20 @@ for _f in ("verdict", "confirm"):
         MEMO_WRITEBACKS.labels(family=_f, outcome=_o)
 del _f, _o
 
+#: TTL/size-policy evictions (docs/CACHING.md): ``ttl`` = an entry
+#: whose age exceeded ``cache_ttl_s`` was dropped at lookup (lazy
+#: expiry, counted as a miss), ``size`` = the oldest entries were
+#: dropped at write time to honor ``cache_max_entries`` per family
+#: namespace. Zero forever under the default policy-off config.
+MEMO_EVICTIONS = REGISTRY.counter(
+    "swarm_memo_evictions_total",
+    "Shared result-tier entries evicted by the TTL/size policy",
+    ("reason",),
+)
+for _r in ("ttl", "size"):
+    MEMO_EVICTIONS.labels(reason=_r)
+del _r
+
 #: process-lifetime shared hit ratio (hits / (hits + misses) over
 #: every client in the process; 0 until the first shared lookup)
 MEMO_HIT_RATIO = REGISTRY.gauge(

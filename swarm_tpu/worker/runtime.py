@@ -129,11 +129,19 @@ class ServerClient:
         )
         return resp.status_code == 200
 
-    def renew_lease(self, job_id: str, worker_id: str) -> bool:
-        """Heartbeat one lease; False = the lease is no longer ours."""
+    def renew_lease(
+        self, job_id: str, worker_id: str, saturation: Optional[float] = None
+    ) -> bool:
+        """Heartbeat one lease; False = the lease is no longer ours.
+        ``saturation`` (0..1, optional) reports the scheduler's
+        in-flight saturation so the gateway's admission pressure rises
+        before the queue does (docs/GATEWAY.md)."""
+        body = {"worker_id": worker_id}
+        if saturation is not None:
+            body["saturation"] = saturation
         resp = self._request(
             "renew_lease", "POST", f"/renew-lease/{job_id}",
-            detail=job_id, json={"worker_id": worker_id},
+            detail=job_id, json=body,
         )
         return resp.status_code == 200
 
@@ -171,6 +179,10 @@ class JobProcessor:
         #: cooperative shutdown for threaded workers (chaos soak test)
         self.stop_requested = False
         self._last_heartbeat: Optional[LeaseHeartbeat] = None
+        #: most recently observed scheduler in-flight saturation (0..1;
+        #: None until a pipelined engine reports) — heartbeats carry it
+        #: to the gateway's admission pressure signal
+        self._last_saturation: Optional[float] = None
 
     # ------------------------------------------------------------------
     def prewarm(self, module_name: str) -> bool:
@@ -314,6 +326,7 @@ class JobProcessor:
             job_id,
             self.cfg.worker_id,
             self.cfg.heartbeat_interval_s or self.cfg.lease_seconds / 3.0,
+            saturation_fn=lambda: self._last_saturation,
         )
         self._last_heartbeat = hb
         hb.start()
@@ -471,7 +484,17 @@ class JobProcessor:
         out["pipeline"] = getattr(engine, "pipeline", "off")
         sched = getattr(engine, "_sched", None)
         if sched is not None:
-            out["sched"] = sched.stats.snapshot()
+            snap = sched.stats.snapshot()
+            out["sched"] = snap
+            # stall/wall = the fraction of scheduler wall time the
+            # submit thread waited on a FULL in-flight window — the
+            # honest "accelerator is saturated" scalar the gateway's
+            # admission pressure consumes (perf here, heartbeats live)
+            wall = snap.get("wall_seconds") or 0.0
+            if wall > 0:
+                saturation = min(1.0, snap.get("stall_seconds", 0.0) / wall)
+                out["inflight_saturation"] = round(saturation, 4)
+                self._last_saturation = saturation
         return out
 
     # ------------------------------------------------------------------

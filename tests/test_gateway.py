@@ -1,0 +1,532 @@
+"""Multi-tenant gateway (docs/GATEWAY.md): tenant model, admission
+control, weighted-fair dispatch, tenants/autoscale surfaces.
+
+The lease/fencing/dead-letter semantics underneath the tenant queues
+must be UNCHANGED — the tenant-scoped regression tests here pin that.
+"""
+
+import json
+import time
+
+import pytest
+import requests
+
+from swarm_tpu.config import Config
+from swarm_tpu.datamodel import JobStatus
+from swarm_tpu.gateway.admission import (
+    AdmissionController,
+    PressureSnapshot,
+    TokenBucket,
+)
+from swarm_tpu.server.app import SwarmServer
+from swarm_tpu.server.fleet import AutoscaleAdvisor
+from swarm_tpu.server.queue import JobQueueService
+
+
+# ---------------------------------------------------------------------------
+# Unit: token bucket + admission determinism
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_deterministic_refill():
+    b = TokenBucket(rate=2.0, burst=2)
+    assert b.take(0.0) == (True, 0.0)
+    assert b.take(0.0) == (True, 0.0)
+    ok, wait = b.take(0.0)
+    assert not ok and wait == pytest.approx(0.5)
+    # half a second later exactly one token has refilled
+    assert b.take(0.5) == (True, 0.0)
+    ok, wait = b.take(0.5)
+    assert not ok and wait == pytest.approx(0.5)
+
+
+def test_token_bucket_zero_rate_is_unlimited():
+    b = TokenBucket(rate=0.0, burst=1)
+    for _ in range(100):
+        assert b.take(0.0) == (True, 0.0)
+
+
+def test_admission_decisions_replay_identically():
+    """Same (snapshot, now, depth) sequence → same decisions on a
+    fresh controller: shedding is a pure function of the signal."""
+
+    def run():
+        ctl = AdmissionController(
+            tenant_rate=1.0, tenant_burst=2, tenant_queue_max=5,
+            queue_high=10, shed_pressure=1.0,
+        )
+        seq = [
+            ("a", PressureSnapshot(queue_depth=0), 0.0, 0),
+            ("a", PressureSnapshot(queue_depth=0), 0.0, 0),
+            ("a", PressureSnapshot(queue_depth=0), 0.0, 0),   # bucket empty
+            ("a", PressureSnapshot(queue_depth=0), 1.0, 0),   # refilled
+            ("b", PressureSnapshot(queue_depth=12), 5.0, 0),  # over queue_high
+            ("b", PressureSnapshot(queue_depth=0), 5.0, 7),   # tenant queue full
+            ("b", PressureSnapshot(saturation=1.0), 9.0, 0),  # saturated fleet
+        ]
+        return [
+            (d.admitted, d.reason)
+            for d in (ctl.decide(t, s, now, depth) for t, s, now, depth in seq)
+        ]
+
+    first, second = run(), run()
+    assert first == second
+    assert first == [
+        (True, "ok"), (True, "ok"), (False, "rate"), (True, "ok"),
+        (False, "pressure"), (False, "queue_full"), (False, "pressure"),
+    ]
+
+
+def test_pressure_components():
+    ctl = AdmissionController(queue_high=10)
+    assert ctl.pressure(PressureSnapshot()) == 0.0
+    assert ctl.pressure(PressureSnapshot(queue_depth=5)) == pytest.approx(0.5)
+    assert ctl.pressure(PressureSnapshot(saturation=0.8)) == pytest.approx(0.8)
+    # an open breaker floors pressure at the degraded level without
+    # shedding on its own under the default threshold
+    p = ctl.pressure(PressureSnapshot(open_breakers=2))
+    assert 0.0 < p < 1.0
+    ctl.note_saturation("w1", 0.3)
+    ctl.note_saturation("w2", 0.9)
+    assert ctl.fleet_saturation() == pytest.approx(0.9)
+    ctl.note_saturation("w2", float("nan"))  # ignored, not poisoned
+    assert ctl.fleet_saturation() == pytest.approx(0.9)
+
+
+# ---------------------------------------------------------------------------
+# Queue: weighted-fair dispatch + tenant-preserving requeue
+# ---------------------------------------------------------------------------
+
+
+def _service(tmp_path, **cfg_kw) -> JobQueueService:
+    cfg = Config(
+        blob_root=str(tmp_path / "blobs"), doc_root=str(tmp_path / "docs"),
+        **cfg_kw,
+    )
+    from swarm_tpu.stores import build_stores
+
+    state, blobs, docs = build_stores(cfg)
+    return JobQueueService(cfg, state, blobs, docs)
+
+
+def _submit(q, tenant, scan_id, lines=1, batch=1):
+    q.queue_scan(
+        {
+            "module": "echo",
+            "file_content": [f"t{i}\n" for i in range(lines)],
+            "batch_size": batch,
+            "scan_id": scan_id,
+        },
+        tenant=tenant,
+    )
+
+
+def test_fair_dequeue_no_tenant_starvation(tmp_path):
+    """A 50-deep backlog from one tenant delays another tenant's single
+    job by at most one rotation — never by the whole backlog."""
+    q = _service(tmp_path)
+    _submit(q, "abusive", "abusive_1", lines=50, batch=1)
+    _submit(q, "victim", "victim_1", lines=1, batch=1)
+    served = [q.next_job(f"w{i}")["scan_id"] for i in range(3)]
+    assert "victim_1" in served, f"victim starved: {served}"
+    # every tenant's jobs still drain completely
+    seen = set(served)
+    while True:
+        job = q.next_job("w")
+        if job is None:
+            break
+        seen.add(job["scan_id"])
+    assert seen == {"abusive_1", "victim_1"}
+
+
+def test_fair_dequeue_round_robin_interleaves(tmp_path):
+    q = _service(tmp_path)
+    _submit(q, "a", "aa_1", lines=4, batch=1)
+    _submit(q, "b", "bb_1", lines=4, batch=1)
+    order = [q.next_job("w")["scan_id"] for i in range(8)]
+    # strict alternation once both queues are non-empty
+    assert order[:4].count("aa_1") == 2 and order[:4].count("bb_1") == 2
+
+
+def test_requeue_preserves_tenant_queue(tmp_path):
+    """Lease expiry puts the job back on ITS tenant's list, and the
+    dead-letter/fencing path is byte-for-byte the pre-gateway one."""
+    q = _service(tmp_path, lease_seconds=0.1, max_attempts=3)
+    _submit(q, "acme", "acmescan_1")
+    job = q.next_job("dying")
+    assert job["tenant"] == "acme"
+    time.sleep(0.15)
+    rejob = q.next_job("healthy")
+    assert rejob is not None and rejob["job_id"] == job["job_id"]
+    assert rejob["attempts"] == 2
+    assert q.state.llen("job_queue:t:acme") == 0
+    # zombie's fenced update still rejected under tenant queues
+    assert not q.update_job(
+        job["job_id"], {"status": "cmd failed", "worker_id": "dying"}
+    )
+    # exhaust → dead-letter → operator requeue → back on the TENANT list
+    time.sleep(0.15)
+    assert q.next_job("w3") is not None
+    time.sleep(0.15)
+    assert q.next_job("w4") is None
+    raw = json.loads(q.state.hget("jobs", job["job_id"]))
+    assert raw["status"] == JobStatus.DEAD_LETTER
+    assert q.requeue_dead_letter(job["job_id"])
+    assert q.state.llen("job_queue:t:acme") == 1
+    redo = q.next_job("w5")
+    assert redo["attempts"] == 1 and redo["tenant"] == "acme"
+    assert q.update_job(
+        job["job_id"], {"status": "complete", "worker_id": "w5"}
+    )
+    assert q.state.llen("completed") == 1
+
+
+def test_worker_failure_retry_preserves_tenant_queue(tmp_path):
+    q = _service(tmp_path, max_attempts=3)
+    _submit(q, "acme", "acmescan_2")
+    job = q.next_job("w1")
+    assert q.update_job(
+        job["job_id"], {"status": "cmd failed", "worker_id": "w1"}
+    )
+    assert q.state.llen("job_queue:t:acme") == 1  # retried to its own list
+
+
+def test_jobs_by_tenant_snapshot(tmp_path):
+    q = _service(tmp_path)
+    _submit(q, "a", "aa_2", lines=2, batch=1)
+    _submit(q, "b", "bb_2", lines=1, batch=1)
+    q.next_job("w")  # one of tenant a's jobs leases out (fair: a first)
+    by_tenant = q.jobs_by_tenant()
+    assert by_tenant["a"] == {"queued": 1, "in progress": 1}
+    assert by_tenant["b"] == {"queued": 1}
+    st = q.statuses()
+    assert st["tenants"]["a"]["in progress"] == 1
+    depths = q.tenant_depths()
+    assert depths["a"] == 1 and depths["b"] == 1
+
+
+def test_default_tenant_keeps_reference_list(tmp_path):
+    """No tenant header → the bare job_queue list, byte-compatible
+    with the reference wire layout (and legacy rpush tooling)."""
+    q = _service(tmp_path)
+    _submit(q, None, "legacy_1")
+    assert q.state.llen("job_queue") == 1
+    job = q.next_job("w")
+    assert job["tenant"] == "default"
+
+
+# ---------------------------------------------------------------------------
+# API: admission at the front door
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def gateway_server(tmp_path):
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="gk",
+        blob_root=str(tmp_path / "blobs"), doc_root=str(tmp_path / "docs"),
+        # one token per 5 s: even a slow CI box can't refill a tenant's
+        # bucket mid-test, so the shed sequence is deterministic
+        gateway_tenant_rate=0.2, gateway_tenant_burst=2,
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def _post_queue(srv, tenant=None, scan_id=None):
+    headers = {"Authorization": "Bearer gk"}
+    if tenant:
+        headers["X-Swarm-Tenant"] = tenant
+    return requests.post(
+        f"http://127.0.0.1:{srv.port}/queue",
+        json={"module": "echo", "file_content": ["x\n"], "batch_size": 1,
+              "scan_id": scan_id},
+        headers=headers,
+        timeout=10,
+    )
+
+
+def test_rate_shed_429_with_retry_after(gateway_server):
+    codes = [
+        _post_queue(gateway_server, "noisy", f"noisy_{i}").status_code
+        for i in range(4)
+    ]
+    assert codes[:2] == [200, 200]
+    assert 429 in codes[2:]
+    resp = _post_queue(gateway_server, "noisy", "noisy_9")
+    assert resp.status_code == 429
+    assert int(resp.headers["Retry-After"]) >= 1
+    body = resp.json()
+    assert body["reason"] == "rate" and body["retry_after_s"] > 0
+    # another tenant is untouched by noisy's empty bucket
+    assert _post_queue(gateway_server, "calm", "calm_1").status_code == 200
+
+
+def test_invalid_tenant_rejected(gateway_server):
+    resp = _post_queue(gateway_server, "../evil", "e_1")
+    assert resp.status_code == 400
+
+
+def test_malformed_submission_burns_no_rate_token(gateway_server):
+    """Validation runs BEFORE admission: 400s must not consume the
+    tenant's tokens or count as admitted."""
+    base = f"http://127.0.0.1:{gateway_server.port}"
+    headers = {"Authorization": "Bearer gk", "X-Swarm-Tenant": "strict"}
+    for _ in range(5):  # would drain the burst-2 bucket if counted
+        r = requests.post(
+            base + "/queue",
+            json={"file_content": ["x\n"], "batch_size": 1},  # no module
+            headers=headers, timeout=10,
+        )
+        assert r.status_code == 400
+    # both burst tokens still available
+    assert _post_queue(gateway_server, "strict", "st_1").status_code == 200
+    assert _post_queue(gateway_server, "strict", "st_2").status_code == 200
+    tenants = requests.get(
+        base + "/tenants", headers={"Authorization": "Bearer gk"}, timeout=10
+    ).json()["tenants"]
+    assert tenants["strict"]["admitted"] == 2 and tenants["strict"]["shed"] == 0
+
+
+def test_tenants_endpoint_and_cli(gateway_server, capsys):
+    _post_queue(gateway_server, "acme", "acme_5")
+    for i in range(3):
+        _post_queue(gateway_server, "noisy", f"nz_{i}")
+    base = f"http://127.0.0.1:{gateway_server.port}"
+    data = requests.get(
+        base + "/tenants", headers={"Authorization": "Bearer gk"}, timeout=10
+    ).json()["tenants"]
+    assert data["acme"]["admitted"] == 1 and data["acme"]["queue_depth"] == 1
+    assert data["noisy"]["shed"] >= 1
+    # CLI action renders the same surface
+    from swarm_tpu.client.cli import main as cli_main
+
+    rc = cli_main(["tenants", "--server-url", base, "--api-key", "gk"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "acme" in out and "noisy" in out
+
+
+def test_healthz_exposes_pressure_not_tenant_ids(gateway_server):
+    _post_queue(gateway_server, "acme", "acme_6")
+    hz = requests.get(
+        f"http://127.0.0.1:{gateway_server.port}/healthz", timeout=10
+    ).json()
+    assert "pressure" in hz and hz["pressure"] >= 0.0
+    # unauthenticated endpoint: COUNT only — tenant ids are client
+    # data and live on the authenticated /tenants surface
+    assert hz["tenant_count"] >= 2  # default + acme
+    assert "tenants" not in hz
+
+
+def test_gateway_metric_families_render(gateway_server):
+    from swarm_tpu.telemetry.metrics import parse_exposition
+
+    _post_queue(gateway_server, "acme", "acme_7")
+    for i in range(4):
+        _post_queue(gateway_server, "noisy", f"nz2_{i}")
+    text = requests.get(
+        f"http://127.0.0.1:{gateway_server.port}/metrics", timeout=10
+    ).text
+    samples = parse_exposition(text)
+    by_name: dict = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    admitted = {
+        l.get("tenant"): v for l, v in by_name["swarm_gateway_admitted_total"]
+    }
+    assert admitted.get("acme", 0) >= 1
+    shed = [
+        v for l, v in by_name["swarm_gateway_shed_total"]
+        if l.get("tenant") == "noisy"
+    ]
+    assert sum(shed) >= 1
+    assert "swarm_gateway_pressure" in by_name
+    assert "swarm_gateway_queued_by_tenant" in by_name
+    assert "swarm_gateway_stream_bytes_total" in by_name
+
+
+def test_saturation_reaches_admission_via_heartbeat_and_perf(tmp_path):
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="gk",
+        blob_root=str(tmp_path / "b"), doc_root=str(tmp_path / "d"),
+        gateway_shed_pressure=0.9,
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        auth = {"Authorization": "Bearer gk"}
+        assert _post_queue_cfg(base, auth, "t", "sat_1").status_code == 200
+        job = requests.get(
+            base + "/get-job", params={"worker_id": "w1"}, headers=auth,
+            timeout=10,
+        ).json()
+        # heartbeat carries saturation (rejected renewals still feed it)
+        requests.post(
+            base + f"/renew-lease/{job['job_id']}",
+            json={"worker_id": "w1", "saturation": 0.95},
+            headers=auth, timeout=10,
+        )
+        assert srv.gateway.fleet_saturation() == pytest.approx(0.95)
+        # saturated fleet → pressure >= threshold → shed
+        resp = _post_queue_cfg(base, auth, "t", "sat_2")
+        assert resp.status_code == 429
+        assert resp.json()["reason"] == "pressure"
+        # a completed job's perf sched snapshot also feeds it
+        requests.post(
+            base + f"/update-job/{job['job_id']}",
+            json={
+                "status": "complete", "worker_id": "w1",
+                "perf": {"sched": {"wall_seconds": 10.0, "stall_seconds": 1.0}},
+            },
+            headers=auth, timeout=10,
+        )
+        assert srv.gateway.fleet_saturation() == pytest.approx(0.1)
+    finally:
+        srv.shutdown()
+
+
+def _post_queue_cfg(base, auth, tenant, scan_id):
+    return requests.post(
+        base + "/queue",
+        json={"module": "echo", "file_content": ["x\n"], "batch_size": 1,
+              "scan_id": scan_id},
+        headers={**auth, "X-Swarm-Tenant": tenant},
+        timeout=10,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Autoscale advisor (dry-run by default)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProvider:
+    """Mimics ProcessProvider's ensure-semantics: spin_up(prefix, N)
+    generates the FIXED names prefix1..prefixN and skips live ones."""
+
+    def __init__(self):
+        self.nodes: list[str] = []
+        self.calls: list[tuple] = []
+
+    def list_nodes(self, prefix):
+        return [n for n in self.nodes if n.startswith(prefix)]
+
+    def spin_up(self, prefix, n):
+        self.calls.append(("up", prefix, n))
+        from swarm_tpu.server.fleet import generate_node_names
+
+        for name in generate_node_names(prefix, n):
+            if name not in self.nodes:
+                self.nodes.append(name)
+
+    def spin_down(self, prefix):
+        self.calls.append(("down", prefix))
+        self.nodes = [n for n in self.nodes if not n.startswith(prefix)]
+
+    def teardown_async(self, prefix):
+        self.spin_down(prefix)  # synchronous for tests
+
+
+def test_autoscale_recommend_and_dry_run(tmp_path):
+    q = _service(tmp_path)
+    _submit(q, None, "auto_1", lines=9, batch=1)
+    provider = _FakeProvider()
+    adv = AutoscaleAdvisor(
+        q, provider, jobs_per_node=4, min_nodes=0, max_nodes=8,
+        apply_enabled=False,
+    )
+    rec = adv.recommend("node")
+    assert rec == {
+        "prefix": "node", "queue_depth": 9, "current_nodes": 0,
+        "target_nodes": 3, "action": "spin-up", "dry_run": True,
+    }
+    # dry-run: apply() recommends but NEVER touches the provider
+    out = adv.apply("node")
+    assert out["dry_run"] and provider.calls == []
+
+
+def test_autoscale_apply_scales_up_and_down(tmp_path):
+    q = _service(tmp_path)
+    _submit(q, None, "auto_2", lines=9, batch=1)
+    provider = _FakeProvider()
+    adv = AutoscaleAdvisor(
+        q, provider, jobs_per_node=4, min_nodes=0, max_nodes=2,
+        apply_enabled=True,
+    )
+    out = adv.apply("node")
+    assert out["applied"] and out["target_nodes"] == 2  # clamped at max
+    assert provider.list_nodes("node") == ["node1", "node2"]
+    # drain the queue → scale to min, tearing down highest names first
+    while q.next_job("w") is not None:
+        pass
+    out = adv.apply("node")
+    assert out["action"] == "spin-down" and out["applied"]
+    assert provider.list_nodes("node") == []
+
+
+def test_autoscale_grows_a_nonzero_fleet(tmp_path):
+    """Scale-up must ADD nodes past the live ones — an ensure-up to
+    the TARGET (prefix1..prefixN naming), never a delta regenerating
+    the same low names and adding nothing."""
+    q = _service(tmp_path)
+    _submit(q, None, "auto_4", lines=16, batch=1)
+    provider = _FakeProvider()
+    provider.nodes = ["node1", "node2"]  # already-live fleet
+    adv = AutoscaleAdvisor(
+        q, provider, jobs_per_node=4, min_nodes=0, max_nodes=8,
+        apply_enabled=True,
+    )
+    out = adv.apply("node")
+    assert out["current_nodes"] == 2 and out["target_nodes"] == 4
+    assert provider.list_nodes("node") == ["node1", "node2", "node3", "node4"]
+
+
+def test_tenant_cardinality_cap_sheds_new_ids():
+    """Rotating fresh tenant ids must not mint a fresh token bucket
+    per request: past the cap a NEW id sheds with tenant_limit while
+    known tenants keep their normal admission."""
+    ctl = AdmissionController(tenant_rate=0.1, tenant_burst=1, max_tenants=2)
+    snap = PressureSnapshot()
+    assert ctl.decide("a", snap, 0.0).admitted
+    assert ctl.decide("b", snap, 0.0).admitted
+    rotated = [ctl.decide(f"fresh{i}", snap, 0.0) for i in range(5)]
+    assert all(
+        not d.admitted and d.reason == "tenant_limit" for d in rotated
+    )
+    # known tenants are unaffected by the cap (their bucket still rules)
+    again = ctl.decide("a", snap, 0.0)
+    assert not again.admitted and again.reason == "rate"  # bucket empty
+    assert ctl.decide("a", snap, 100.0).admitted  # refilled
+    # the default tenant (reference contract) can NEVER be locked out
+    assert ctl.decide("default", snap, 100.0).admitted
+    # registry slots free after tenant_ttl_s of inactivity: a past
+    # rotation flood must not deny new tenants until process restart
+    late = ctl.decide("newcomer", snap, 100.0 + ctl.tenant_ttl_s + 1.0)
+    assert late.admitted, late
+
+
+def test_saturation_reports_decay():
+    """A dead worker's last saturation report must not pin fleet
+    pressure forever — reports expire after saturation_ttl_s."""
+    ctl = AdmissionController(saturation_ttl_s=60.0)
+    ctl.note_saturation("w1", 0.95, now=1000.0)
+    assert ctl.fleet_saturation(now=1030.0) == pytest.approx(0.95)
+    assert ctl.fleet_saturation(now=1061.0) == 0.0
+    # a fresh report from a live worker re-raises it
+    ctl.note_saturation("w2", 0.4, now=1062.0)
+    assert ctl.fleet_saturation(now=1070.0) == pytest.approx(0.4)
+
+
+def test_autoscale_route(gateway_server):
+    base = f"http://127.0.0.1:{gateway_server.port}"
+    auth = {"Authorization": "Bearer gk"}
+    _post_queue(gateway_server, "acme", "auto_3")
+    rec = requests.get(base + "/autoscale", headers=auth, timeout=10).json()
+    assert rec["dry_run"] and rec["queue_depth"] >= 1
+    applied = requests.post(
+        base + "/autoscale", json={"prefix": "n"}, headers=auth, timeout=10
+    ).json()
+    assert applied["dry_run"] and "applied" not in applied

@@ -592,3 +592,106 @@ def test_scheduler_prefetch_rides_memo_lane(corpus, ref_engine):
     assert snap["memo_rows"] == len(rows)
     assert snap["fresh_rows"] == 0
     assert client.counters()["shared_hits"] > 0
+
+
+# ----------------------------------------------------------------------
+# TTL/size policy (docs/CACHING.md; default OFF = today's behavior)
+# ----------------------------------------------------------------------
+
+
+def _eviction_counts():
+    from swarm_tpu.telemetry import REGISTRY
+
+    out = {"ttl": 0.0, "size": 0.0}
+    for s in REGISTRY.snapshot()["swarm_memo_evictions_total"]["samples"]:
+        out[s["labels"]["reason"]] = s["value"]
+    return out
+
+
+def test_policy_off_by_default_writes_no_stamps():
+    from swarm_tpu.stores import MemoryStateStore as _MS
+
+    state = _MS()
+    tier = SharedResultTier(state, MemoryBlobStore())
+    tok = tier.acquire_writer("w")
+    assert tier.put_many("v", "e.g0", [("d1", "x")], "w", tok) == ("stored", 1)
+    # no side hash, no policy accounting — byte-for-byte the old path
+    assert state.hgetall("swarm:cache:ts:v:e.g0") == {}
+    assert tier.get_many("v", "e.g0", ["d1"]) == {"d1": "x"}
+    assert tier.entry_count("v", "e.g0") == 0
+
+
+def test_ttl_expires_lazily_and_counts_eviction():
+    import time as _time
+
+    from swarm_tpu.stores import MemoryStateStore as _MS
+
+    state = _MS()
+    tier = SharedResultTier(state, MemoryBlobStore(), ttl_s=30.0)
+    tok = tier.acquire_writer("w")
+    tier.put_many("v", "e.g0", [("d1", "x"), ("d2", "y")], "w", tok)
+    # fresh entries serve normally
+    assert tier.get_many("v", "e.g0", ["d1", "d2"]) == {"d1": "x", "d2": "y"}
+    before = _eviction_counts()
+    # age d1 past the TTL by rewriting its stamp (the tier reads wall
+    # time; the stamp is the injectable half)
+    state.hset("swarm:cache:ts:v:e.g0", "d1", str(_time.time() - 120.0))
+    got = tier.get_many("v", "e.g0", ["d1", "d2"])
+    assert got == {"d2": "y"}  # expired = a miss, never an exception
+    # lazy expiry really deleted the entry AND its stamp
+    assert state.hget("swarm:cache:v:e.g0", "d1") is None
+    assert state.hget("swarm:cache:ts:v:e.g0", "d1") is None
+    after = _eviction_counts()
+    assert after["ttl"] == before["ttl"] + 1
+
+
+def test_max_entries_bound_evicts_oldest_per_family():
+    import time as _time
+
+    from swarm_tpu.stores import MemoryStateStore as _MS
+
+    state = _MS()
+    tier = SharedResultTier(state, MemoryBlobStore(), max_entries=3)
+    tok = tier.acquire_writer("w")
+    before = _eviction_counts()
+    tier.put_many("v", "e.g0", [("a", "1"), ("b", "2"), ("c", "3")], "w", tok)
+    # age a and b so the eviction order is deterministic
+    old = str(_time.time() - 60.0)
+    state.hset("swarm:cache:ts:v:e.g0", "a", old)
+    state.hset("swarm:cache:ts:v:e.g0", "b", old)
+    tier.put_many("v", "e.g0", [("d", "4"), ("e", "5")], "w", tok)
+    got = tier.get_many("v", "e.g0", ["a", "b", "c", "d", "e"])
+    assert got == {"c": "3", "d": "4", "e": "5"}
+    assert tier.entry_count("v", "e.g0") == 3
+    after = _eviction_counts()
+    assert after["size"] == before["size"] + 2
+    # the bound is PER family namespace: the confirm family is untouched
+    tier.put_many("c", "e.g0", [("x", "1")], "w", tok)
+    assert tier.get_many("c", "e.g0", ["x"]) == {"x": "1"}
+
+
+def test_policy_via_config_and_parity_under_ttl(corpus, ref_engine):
+    """build_result_cache wires SWARM_CACHE_TTL_S/MAX_ENTRIES onto the
+    tier, and a policy-bounded tier stays bit-identical (an eviction is
+    just a miss → recompute → writeback)."""
+    from swarm_tpu.cache import build_result_cache
+    from swarm_tpu.cache.tier import _memory_tier
+    from swarm_tpu.config import Config as _Cfg
+
+    cfg = _Cfg(cache_backend="memory", cache_ttl_s=900.0, cache_max_entries=8)
+    client = build_result_cache(cfg)
+    assert client is not None
+    tier = _memory_tier()
+    assert tier._ttl_s == 900.0 and tier._max_entries == 8
+    try:
+        rows = _rows(24, seed=33)
+        want = ref_engine.match(bench_mod._clone_rows(rows))
+        # the bounded tier evicts aggressively (8-entry cap, 24 rows):
+        # an eviction is just a miss → recompute → writeback, so the
+        # policy can never change a verdict
+        eng = _engine(corpus, client, batch_rows=8)
+        _same(eng.match(bench_mod._clone_rows(rows)), want)
+    finally:
+        # the memory tier is a process singleton — restore policy-off
+        # for every other test in the suite
+        tier.configure_policy(0.0, 0)

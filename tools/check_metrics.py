@@ -70,6 +70,15 @@ REQUIRED_FAMILIES = (
     "swarm_memo_shared_hit_ratio",
     "swarm_memo_shared_lookup_seconds",
     "swarm_memo_epoch_generation",
+    "swarm_memo_evictions_total",
+    # multi-tenant gateway (docs/GATEWAY.md): registered at telemetry
+    # import (gateway_export), default-tenant combos pre-seeded —
+    # every family renders samples even before the first tenant
+    "swarm_gateway_admitted_total",
+    "swarm_gateway_shed_total",
+    "swarm_gateway_queued_by_tenant",
+    "swarm_gateway_pressure",
+    "swarm_gateway_stream_bytes_total",
 )
 
 
