@@ -1952,6 +1952,148 @@ def _smoke_gateway_clause() -> "tuple[bool, dict]":
         srv.shutdown()
 
 
+def _smoke_restart_clause() -> "tuple[bool, dict]":
+    """Restart smoke (docs/DURABILITY.md): one mid-scan server restart
+    against the durable queue journal. A real worker drains a scan
+    while the server is torn down and rebuilt on the same port with a
+    FRESH state store + the same blob store (journal + chunks); the
+    gate is verdict identity vs a restart-free baseline run plus zero
+    lost jobs (every chunk complete, nothing dead-lettered)."""
+    import tempfile
+    import threading as _threading
+
+    from swarm_tpu.client.cli import JobClient
+    from swarm_tpu.config import Config
+    from swarm_tpu.server.app import SwarmServer
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    tmp = tempfile.mkdtemp(prefix="swarm_restart_smoke_")
+    modules_dir = os.path.join(tmp, "modules")
+    os.makedirs(modules_dir)
+    corpus = os.environ.get("SWARM_BENCH_CORPUS", str(BUNDLED_CORPUS))
+    with open(os.path.join(modules_dir, "fingerprint.json"), "w") as f:
+        json.dump({"backend": "tpu", "templates": corpus}, f)
+    lines = [
+        json.dumps(
+            {"host": f"10.8.0.{i}", "port": 443, "status": 200,
+             "body": f"<title>Demo Admin</title> demo-build 8.{i} page {i}"}
+        ) + "\n"
+        for i in range(8)
+    ]
+    n_chunks = len(lines)  # batch_size 1 → one job per row
+
+    def _cfg(root: str) -> Config:
+        return Config(
+            host="127.0.0.1", port=0, api_key="rssmoke",
+            blob_root=os.path.join(tmp, root, "blobs"),
+            doc_root=os.path.join(tmp, root, "docs"),
+            modules_dir=modules_dir,
+            poll_interval_idle_s=0.02, poll_interval_busy_s=0.01,
+            transport_retries=2, transport_backoff_s=0.02,
+            transport_backoff_max_s=0.1,
+            transport_breaker_threshold=500,
+            lease_seconds=5.0, heartbeat_interval_s=0.25,
+        )
+
+    def _drain(cfg: Config, scan_id: str, max_jobs: int) -> str:
+        worker = JobProcessor(
+            Config(**{**cfg.__dict__, "worker_id": f"rs-{scan_id}",
+                      "max_jobs": max_jobs})
+        )
+        worker.process_jobs()
+        return JobClient(cfg.resolve_url(), cfg.api_key).fetch_raw(scan_id)
+
+    def _submit(cfg: Config, scan_id: str) -> None:
+        f = os.path.join(tmp, f"{scan_id}.jsonl")
+        with open(f, "w") as fh:
+            fh.writelines(lines)
+        code, _ = JobClient(cfg.resolve_url(), cfg.api_key).start_scan(
+            f, "fingerprint", 0, 1, scan_id=scan_id
+        )
+        assert code == 200
+
+    # --- restart-free baseline ---
+    base_cfg = _cfg("base")
+    base_srv = SwarmServer(base_cfg)
+    base_srv.start_background()
+    base_cfg.server_url = f"http://127.0.0.1:{base_srv.port}"
+    try:
+        _submit(base_cfg, "rsbase_1")
+        baseline_raw = _drain(base_cfg, "rsbase_1", n_chunks)
+    finally:
+        base_srv.shutdown()
+
+    # --- live run with one mid-scan restart ---
+    cfg = _cfg("live")
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    port = srv.port
+    cfg.server_url = f"http://127.0.0.1:{port}"
+    client = JobClient(cfg.resolve_url(), cfg.api_key)
+    srv2 = None
+    worker = JobProcessor(Config(**{**cfg.__dict__, "worker_id": "rs-live"}))
+    wt = _threading.Thread(target=worker.process_jobs, daemon=True)
+    try:
+        _submit(cfg, "rsmoke_1")
+        wt.start()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            statuses = client.get_statuses()
+            done = sum(
+                1 for j in (statuses or {}).get("jobs", {}).values()
+                if j.get("status") == "complete"
+            )
+            if done >= 2:
+                break
+            time.sleep(0.05)
+        restarted_mid_scan = done < n_chunks
+        srv.shutdown()  # the restart: in-memory job table dies here
+        srv2 = SwarmServer(Config(**{**cfg.__dict__, "port": port}))
+        srv2.start_background()
+        complete = False
+        while time.time() < deadline and not complete:
+            time.sleep(0.1)
+            statuses = client.get_statuses()
+            if statuses is None:
+                continue
+            jobs = statuses.get("jobs", {})
+            complete = len(jobs) == n_chunks and all(
+                j.get("status") == "complete" for j in jobs.values()
+            )
+        worker.stop_requested = True
+        wt.join(timeout=30)
+        raw = client.fetch_raw("rsmoke_1")
+        health = client.get_healthz() or {}
+        identical = bool(baseline_raw) and raw == baseline_raw.replace(
+            "rsbase_1", "rsmoke_1"
+        )
+        rec = {
+            "identical": identical,
+            "all_complete": complete,
+            "restarted_mid_scan": restarted_mid_scan,
+            "generation": health.get("generation"),
+            "recovery": health.get("recovery"),
+            "dead_letter": health.get("dead_letter_jobs"),
+        }
+        ok = (
+            identical and complete
+            and int(health.get("generation") or 0) >= 2
+            and not health.get("dead_letter_jobs")
+        )
+        log(
+            f"restart smoke: mid_scan={restarted_mid_scan} "
+            f"generation={rec['generation']} identical={identical} "
+            f"zero_lost={complete}"
+        )
+        if not ok:
+            log(f"!!! restart smoke FAILED: {rec}")
+        return ok, rec
+    finally:
+        worker.stop_requested = True
+        if srv2 is not None:
+            srv2.shutdown()
+
+
 def run_smoke() -> int:
     """CI-fast pipeline A/B (tools/preflight.sh): bundled corpus,
     tiny batches, no subprocess phases. Honors SWARM_PIPELINE as the
@@ -2023,6 +2165,18 @@ def run_smoke() -> int:
         float(gw_rec["shed_429"]),
         extra={"gateway": gw_rec},
     )
+    # restart smoke (docs/DURABILITY.md): one mid-scan server restart
+    # against the durable journal — rc-gated on verdict identity vs the
+    # restart-free baseline AND zero lost jobs
+    rs_ok, rs_rec = _smoke_restart_clause()
+    ok = ok and rs_ok
+    emit(
+        "smoke_restart_identity",
+        1.0 if rs_ok else 0.0,
+        " (mid-scan server restart: raw identity + zero lost jobs)",
+        1.0 if rs_ok else 0.0,
+        extra={"restart": rs_rec},
+    )
     # shard smoke: the sharded serving path on the 8-device host-
     # platform mesh, rc-gated on verdict identity (docs/SHARDING.md).
     # Runs in its OWN subprocess: the forced device-count flag also
@@ -2087,8 +2241,8 @@ def run_smoke() -> int:
             )
     if not ok:
         log(
-            "!!! pipeline/walk/shard/dedup/gateway verdict mismatch — "
-            "smoke FAILED"
+            "!!! pipeline/walk/shard/dedup/gateway/restart verdict "
+            "mismatch — smoke FAILED"
         )
     return 0 if ok else 1
 

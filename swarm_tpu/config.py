@@ -91,6 +91,22 @@ class Config:
     # unreachable ("" = <worker work_dir>/spool)
     spool_dir: str = ""
 
+    # --- durable queue journal (docs/DURABILITY.md) ---
+    # write-ahead journal of queue mutations in the blob store: every
+    # mutation is journaled BEFORE the state store (and before the
+    # client's 200), and a restarting server replays it — the embedded
+    # MemoryStateStore deployment becomes crash-consistent. Off keeps
+    # the pre-journal behavior (state dies with the process).
+    journal_enabled: bool = True
+    # WAL segments accumulated before an opportunistic checkpoint
+    # folds them into a snapshot
+    journal_compact_segments: int = 512
+    # re-lease grace: recovered leases are EXPIRED down to this window
+    # (0 = lease_seconds / 2) — long enough for a live worker's next
+    # heartbeat to re-lease its job through the normal fencing path,
+    # short enough that a dead worker's job requeues quickly
+    journal_recovery_grace_s: float = 0.0
+
     # --- fleet result cache (docs/CACHING.md) ---
     # shared content-addressed result tier behind the per-engine memo:
     # "off" (default) leaves every path unchanged; "memory" shares one

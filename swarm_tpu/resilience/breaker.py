@@ -135,6 +135,17 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._transition(self.OPEN)
 
+    def reset(self) -> None:
+        """Force-close and forget the failure streak. Used when the
+        FAILING PEER is known to have been replaced — a worker seeing
+        the control-plane generation change closes its transport
+        breakers because the process that earned the failures is gone
+        (docs/DURABILITY.md)."""
+        with self._lock:
+            self._failures = 0
+            self._probe_out = False
+            self._transition(self.CLOSED)
+
 
 class BreakerBoard:
     """Lazily-created breakers sharing one config, keyed by name
@@ -166,3 +177,11 @@ class BreakerBoard:
 
     def any_open(self) -> bool:
         return any(s != CircuitBreaker.CLOSED for s in self.states().values())
+
+    def reset_all(self) -> None:
+        """Force-close every breaker on this board (see
+        :meth:`CircuitBreaker.reset`)."""
+        with self._lock:
+            breakers = list(self._breakers.values())
+        for br in breakers:
+            br.reset()

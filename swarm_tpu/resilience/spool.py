@@ -23,7 +23,7 @@ from pathlib import Path
 from typing import Optional
 
 from swarm_tpu.resilience.transport import TransportError
-from swarm_tpu.telemetry import REGISTRY
+from swarm_tpu.telemetry import REGISTRY, emit_event
 
 _SPOOLED = REGISTRY.counter(
     "swarm_resilience_spooled_chunks_total",
@@ -67,13 +67,25 @@ class OutputSpool:
         _SPOOLED.inc()
 
     def entries(self) -> list[dict]:
+        """Spooled entries in (scan_id, chunk_index) order. Chunk-index
+        order is load-bearing for replay determinism: a lexical
+        filename sort puts ``scan_10`` before ``scan_2``, so two
+        replays of the same spool could touch the server in different
+        orders — post-restart reconciliation must see one canonical
+        sequence per scan (docs/DURABILITY.md)."""
         out = []
         for meta_path in sorted(self.root.glob("*.json")):
             try:
                 out.append(json.loads(meta_path.read_text()))
             except (ValueError, OSError):
                 continue
-        return out
+        return sorted(
+            out,
+            key=lambda m: (
+                str(m.get("scan_id") or ""),
+                int(m.get("chunk_index") or 0),
+            ),
+        )
 
     def __len__(self) -> int:
         return len(list(self.root.glob("*.json")))
@@ -87,10 +99,14 @@ class OutputSpool:
 
     # ------------------------------------------------------------------
     def replay(self, client, status_complete: str = "complete") -> int:
-        """Push every spooled chunk through ``client``; returns the
-        number of entries cleared. Stops early on TransportError (the
-        server went away again — keep the rest for next time)."""
+        """Push every spooled chunk through ``client`` in per-scan
+        chunk-index order; returns the number of entries cleared. Stops
+        early on TransportError (the server went away again — keep the
+        rest for next time). Logs one summary line per scan so a
+        post-restart operator can reconcile exactly what the spool
+        replayed (docs/DURABILITY.md)."""
         cleared = 0
+        per_scan: dict[str, dict[str, list[int]]] = {}
         for meta in self.entries():
             job_id = meta["job_id"]
             data_path = self.root / f"{job_id}.bin"
@@ -127,7 +143,22 @@ class OutputSpool:
                 break
             self._drop(job_id)
             cleared += 1
-            _REPLAYED.labels(
-                outcome="completed" if ok else "fenced"
-            ).inc()
+            outcome = "completed" if ok else "fenced"
+            _REPLAYED.labels(outcome=outcome).inc()
+            per_scan.setdefault(
+                str(meta.get("scan_id")), {"completed": [], "fenced": []}
+            )[outcome].append(int(meta.get("chunk_index") or 0))
+        for scan_id in sorted(per_scan):
+            summary = per_scan[scan_id]
+            print(
+                f"spool replay [{scan_id}]: "
+                f"completed chunks {summary['completed']}, "
+                f"fenced chunks {summary['fenced']}"
+            )
+            emit_event(
+                "spool.scan_replayed",
+                scan_id=scan_id,
+                completed=summary["completed"],
+                fenced=summary["fenced"],
+            )
         return cleared

@@ -200,6 +200,12 @@ class SwarmServer:
                 # GET /tenants
                 "pressure": round(self.gateway.pressure(snap), 4),
                 "tenant_count": len(self.queue.tenants()),
+                # durability surface (docs/DURABILITY.md): the
+                # monotonic control-plane generation (0 = journal off)
+                # and what boot-time recovery materialized, so "did the
+                # restart lose anything" is one curl away
+                "generation": self.queue.generation,
+                "recovery": self.queue.recovery_summary,
             },
         )
 
@@ -433,9 +439,15 @@ class SwarmServer:
     def _get_job(self, m, q, body, h):
         worker_id = (q.get("worker_id") or [None])[0]
         job = self.queue.next_job(worker_id or "unknown")
+        # every poll answer carries the control-plane generation
+        # (docs/DURABILITY.md): a worker seeing it change knows the
+        # server restarted and re-registers / resets its breakers
+        gen = {"X-Swarm-Generation": str(self.queue.generation)}
         if job is None:
-            return self._text(204, "")
-        return self._json(200, job)
+            code, payload, ctype = self._text(204, "")
+            return code, payload, ctype, gen
+        code, payload, ctype = self._json(200, job)
+        return code, payload, ctype, gen
 
     def _spin_up(self, m, q, body, h):
         try:
@@ -681,6 +693,49 @@ def _make_httpd(server: SwarmServer) -> ThreadingHTTPServer:
             self._run("HEAD")
 
     class _Server(ThreadingHTTPServer):
+        """ThreadingHTTPServer whose shutdown actually severs clients.
+
+        The stdlib's shutdown() stops the accept loop but keep-alive
+        handler threads (daemonized) keep serving the OLD server
+        object's routes — a client with a pooled connection would keep
+        reading a dead control plane's state across an in-process
+        restart (journal recovery made this observable: the stale
+        generation kept being served). Track live connections and
+        force-close them in server_close(), which is what a real
+        process death does to its sockets anyway."""
+
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._live_lock = threading.Lock()  # guards: _live_conns (reads)
+            self._live_conns: set = set()
+
+        def process_request(self, request, client_address):
+            with self._live_lock:
+                self._live_conns.add(request)
+            super().process_request(request, client_address)
+
+        def shutdown_request(self, request):
+            with self._live_lock:
+                self._live_conns.discard(request)
+            super().shutdown_request(request)
+
+        def server_close(self):
+            super().server_close()
+            import socket as _socket
+
+            with self._live_lock:
+                conns = list(self._live_conns)
+                self._live_conns.clear()
+            for conn in conns:
+                try:
+                    conn.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
         def handle_error(self, request, client_address):
             # a /stream client hanging up mid-push (or any keep-alive
             # peer resetting) is normal operation, not a server error —

@@ -37,8 +37,15 @@ from swarm_tpu.datamodel import (
     rollup_scans,
 )
 from swarm_tpu.gateway.admission import DEFAULT_TENANT
+from swarm_tpu.server.journal import QueueJournal
 from swarm_tpu.stores import BlobStore, DocStore, StateStore
 from swarm_tpu.telemetry import REGISTRY, emit_event
+from swarm_tpu.telemetry.journal_export import (
+    JOURNAL_CORRUPT,
+    JOURNAL_REPLAYED,
+    QUEUE_GENERATION,
+    QUEUE_RECOVERED,
+)
 
 # Queue-service metric families (process-wide; multiple in-process
 # services share them, which matches the one-service-per-server reality)
@@ -89,6 +96,7 @@ class JobQueueService:
         blobs: BlobStore,
         docs: DocStore,
         fleet=None,
+        journal: Optional[QueueJournal] = None,
     ):
         self.cfg = cfg
         self.state = state
@@ -110,6 +118,31 @@ class JobQueueService:
         # served last, so a deep queue from one tenant can never starve
         # the others (equal weights; the cursor only moves on a serve)
         self._rr_cursor = 0  # guarded-by: _lock
+        # durable queue journal (docs/DURABILITY.md): every mutation is
+        # journaled BEFORE the state store is touched, so the journal
+        # is always a superset of the store and a restart replays it.
+        # The journal lock serializes {append → store write} pairs
+        # against {snapshot → checkpoint} — without it a checkpoint
+        # could fold state that misses an appended-but-unapplied record
+        # whose segment it then prunes. Lock order: _lock → _journal_lock
+        # (checkpoint takes only _journal_lock, so no cycle). It guards
+        # an ORDERING, not a field — the journal's own counters carry
+        # their own guarded-by annotations (server/journal.py).
+        self._journal_lock = threading.RLock()
+        if journal is None and cfg.journal_enabled:
+            journal = QueueJournal(
+                blobs, compact_segments=cfg.journal_compact_segments
+            )
+        self._journal = journal
+        #: monotonic control-plane generation: bumped once per
+        #: journal-enabled boot (0 = journal disabled). Rides the
+        #: X-Swarm-Generation header so workers detect restarts.
+        self.generation = 0
+        #: summary of the boot-time recovery (None when nothing was
+        #: recovered) — surfaced on /healthz for operators
+        self.recovery_summary: Optional[dict] = None
+        if self._journal is not None:
+            self.recovery_summary = self.recover()
 
     # ------------------------------------------------------------------
     # Tenant queues (docs/GATEWAY.md)
@@ -301,6 +334,12 @@ class JobQueueService:
         batch_size = int(float(job_data.get("batch_size") or 0))
         base_index = int(job_data.get("chunk_index") or 0)
 
+        if self._journal is not None and not self.state.hget("tenants", tenant):
+            # tenant-registry op journaled BEFORE the registry write,
+            # like every other mutation (recovery rebuilds the registry
+            # and the per-tenant dispatch lists from these records)
+            with self._journal_lock:
+                self._journal.append({"op": "tenant", "tenant": tenant})
         self.state.hset("tenants", tenant, "1")
         queue_list = self._queue_list(tenant)
         queued = 0
@@ -325,10 +364,27 @@ class JobQueueService:
                 chunk_index=chunk_index,
                 tenant=tenant,
             )
+        self._maybe_checkpoint()
         return {"scan_id": scan_id, "chunks": queued}
 
     def _put_job(self, job: Job) -> None:
-        self.state.hset("jobs", job.job_id, job.to_json())
+        """Persist one job record, WRITE-AHEAD: the journal append is
+        ordered before the state-store write (and therefore before any
+        route's 200 — an admitted job is never unjournaled). A journal
+        failure raises and the store is left untouched: the mutation
+        observably never happened."""
+        if self._journal is not None:
+            with self._journal_lock:
+                self._journal.append(
+                    {
+                        "op": "job",
+                        "job": job.to_wire(),
+                        "rr_cursor": self._rr_cursor,
+                    }
+                )
+                self.state.hset("jobs", job.job_id, job.to_json())
+        else:
+            self.state.hset("jobs", job.job_id, job.to_json())
         with self._gen_lock:
             self._jobs_generation += 1
 
@@ -381,7 +437,15 @@ class JobQueueService:
                 job.worker_id = worker_id
                 job.lease_expires_at = now + self.cfg.lease_seconds
                 job.attempts += 1
-                self._put_job(job)
+                try:
+                    self._put_job(job)
+                except Exception:
+                    # journal append failed: the dispatch observably
+                    # never happened — restore the popped id to the
+                    # FRONT of its list so the job isn't stranded
+                    # QUEUED-but-unlisted until a restart
+                    self.state.lpush(name, job.job_id)
+                    raise
                 self.state.hset(
                     "leases", job.job_id, str(job.lease_expires_at)
                 )
@@ -441,7 +505,6 @@ class JobQueueService:
                 continue
             if job.lease_expires_at >= now:
                 continue
-            self.state.hdel("leases", job_id)
             self._record_failure(job, "lease expired")
             if job.attempts >= self.cfg.max_attempts:
                 # quarantine, not a silent terminal failure: the job
@@ -452,7 +515,12 @@ class JobQueueService:
             job.status = JobStatus.QUEUED
             job.worker_id = None
             job.lease_expires_at = None
+            # journaled record FIRST, auxiliary keys after: if the
+            # append fails the lease-index entry is still present, so
+            # the next dispatch retries this requeue — dropping the
+            # lease first would strand an ACTIVE job nothing scans
             self._put_job(job)
+            self.state.hdel("leases", job_id)
             # a requeue goes back to ITS tenant's list: lease recovery
             # must not launder an abusive tenant's jobs into another
             # tenant's dispatch share
@@ -515,6 +583,10 @@ class JobQueueService:
             job.lease_expires_at = now + self.cfg.lease_seconds
             self._put_job(job)
             self.state.hset("leases", job_id, str(job.lease_expires_at))
+        # heartbeats are the steadiest journal writer — give them the
+        # compaction duty too, or an idle-but-leased fleet would grow
+        # the WAL without bound
+        self._maybe_checkpoint()
         _LEASE_RENEWALS.labels(outcome="renewed").inc()
         emit_event(
             "job.lease_renewed",
@@ -581,7 +653,9 @@ class JobQueueService:
         # concurrent dispatch or _requeue_expired (satellite: a zombie
         # whose lease expired must never complete a re-leased job)
         with self._lock:
-            return self._update_job_locked(job_id, changes)
+            out = self._update_job_locked(job_id, changes)
+        self._maybe_checkpoint()
+        return out
 
     def _update_job_locked(self, job_id: str, changes: dict) -> bool:
         job = self._get_job_record(job_id)
@@ -637,17 +711,26 @@ class JobQueueService:
                 )
             return True
         wire = job.to_wire()
+        became_complete = False
         for key, value in changes.items():
             if key in wire and key is not None:
                 wire[key] = value
                 if key == "status" and value == JobStatus.COMPLETE:
                     wire["completed_at"] = time.time()
-                    self.state.rpush("completed", job_id)
+                    became_complete = True
         updated = Job.from_wire(wire)
         if updated.status in JobStatus.TERMINAL:
             updated.lease_expires_at = None
-            self.state.hdel("leases", job_id)
+        # journaled record FIRST (a failed append 500s with nothing
+        # half-applied), auxiliary keys after — pushing `completed`
+        # before the record write could feed the tail client a
+        # completion whose job record never updated, and a retried
+        # update would then push it twice
         self._put_job(updated)
+        if updated.status in JobStatus.TERMINAL:
+            self.state.hdel("leases", job_id)
+        if became_complete:
+            self.state.rpush("completed", job_id)
         if updated.status in JobStatus.TERMINAL and updated.status != job.status:
             _JOBS_TERMINAL.labels(status=updated.status).inc()
             # fold the worker-reported perf sample into the fleet-wide
@@ -797,8 +880,224 @@ class JobQueueService:
     # ------------------------------------------------------------------
     def reset(self) -> None:
         """Flush all queue/scan state (reference /reset, server.py:550-554)."""
-        self.state.flushall()
+        with self._journal_lock:
+            self.state.flushall()
+            if self._journal is not None:
+                # the journal must die with the state it describes, or
+                # the next boot would resurrect a deliberately-flushed
+                # queue (the generation counter survives — a reset is
+                # an operational event, not a new server identity)
+                self._journal.clear()
         with self._lock:
             self._rr_cursor = 0
         with self._gen_lock:
             self._jobs_generation += 1
+
+    # ------------------------------------------------------------------
+    # Durable journal: recovery + checkpointing (docs/DURABILITY.md)
+    # ------------------------------------------------------------------
+    def _journal_state(self) -> dict:
+        """The full queue state in journal-snapshot form. Callers hold
+        ``_journal_lock`` so no append can land between this read and
+        the checkpoint that prunes the segments it covers."""
+        jobs: dict[str, Any] = {}
+        for job_id, raw in self.state.hgetall("jobs").items():
+            try:
+                jobs[job_id] = json.loads(raw)
+            except ValueError:
+                continue
+        queues = {
+            name: self.state.lrange(name, 0, -1)
+            for name in self._queue_names()
+        }
+        return {
+            "jobs": jobs,
+            "queues": queues,
+            "tenants": self.tenants(),
+            "rr_cursor": self._rr_cursor,
+        }
+
+    def _maybe_checkpoint(self) -> None:
+        """Opportunistic compaction: fold the WAL into a snapshot once
+        enough segments accumulated. Runs on mutating routes' threads
+        (never under ``_lock``); the unlucky caller pays one O(jobs)
+        snapshot write — control-plane rates make that cheap, and the
+        next boot's replay stays O(snapshot + recent WAL)."""
+        journal = self._journal
+        if journal is None:
+            return
+        if journal.segments_pending < journal.compact_segments:
+            return
+        with self._journal_lock:
+            if journal.segments_pending < journal.compact_segments:
+                return  # another thread compacted first
+            try:
+                journal.checkpoint(self._journal_state())
+            except Exception as e:
+                # compaction is an optimization: a failure must never
+                # fail the mutating route that happened to trigger it —
+                # the WAL just keeps growing until a checkpoint lands
+                print(f"journal checkpoint failed (will retry): {e}")
+
+    def recover(self) -> Optional[dict]:
+        """Boot-time recovery: bump the server generation, replay the
+        journal into the state store, reconcile against the idempotent
+        chunk-output store, and re-arm leases with a short grace
+        window. Returns a summary dict, or None when the journal holds
+        no state (fresh deployment)."""
+        journal = self._journal
+        if journal is None:
+            return None
+        self.generation = journal.bump_generation()
+        QUEUE_GENERATION.set(self.generation)
+        if not journal.has_state():
+            return None
+        now = time.time()
+        snapshot, records = journal.replay()
+
+        jobs: dict[str, Job] = {}
+        order: dict[str, int] = {}
+        tenants: set[str] = set()
+        cursor = 0
+        idx = 0
+        replayed = 0
+
+        def _adopt(job_id: str, wire: dict) -> None:
+            nonlocal idx
+            try:
+                jobs[job_id] = Job.from_wire(wire)
+            except (KeyError, TypeError, ValueError):
+                JOURNAL_CORRUPT.inc()
+                return
+            order[job_id] = idx
+            idx += 1
+
+        if snapshot:
+            for job_id, wire in (snapshot.get("jobs") or {}).items():
+                _adopt(job_id, wire)
+                replayed += 1
+            # the snapshot's queue lists carry the REAL dispatch order;
+            # jobs they name sort ahead of later WAL mutations
+            for ids in (snapshot.get("queues") or {}).values():
+                for job_id in ids:
+                    if job_id in order:
+                        order[job_id] = idx
+                        idx += 1
+            tenants.update(
+                t for t in (snapshot.get("tenants") or ()) if isinstance(t, str)
+            )
+            try:
+                cursor = int(snapshot.get("rr_cursor") or 0)
+            except (TypeError, ValueError):
+                cursor = 0
+        for rec in records:
+            replayed += 1
+            if rec.get("op") == "tenant":
+                tenant = rec.get("tenant")
+                if isinstance(tenant, str):
+                    tenants.add(tenant)
+                continue
+            wire = rec.get("job")
+            if not isinstance(wire, dict) or not wire.get("job_id"):
+                JOURNAL_CORRUPT.inc()
+                continue
+            _adopt(str(wire["job_id"]), wire)
+            if "rr_cursor" in rec:
+                try:
+                    cursor = int(rec["rr_cursor"])
+                except (TypeError, ValueError):
+                    pass
+        JOURNAL_REPLAYED.inc(replayed)
+
+        # tenant registry: journaled tenant ops plus every tenant a job
+        # record names (belt and braces — the registry is reconstructed,
+        # never trusted to survive)
+        for job in jobs.values():
+            if job.tenant:
+                tenants.add(job.tenant)
+        for tenant in sorted(tenants):
+            self.state.hset("tenants", tenant, "1")
+
+        # rebuild, never merge: on a backend whose state survived (real
+        # Redis) stale dispatch lists / leases would double-push
+        for name in set(self._queue_names()) | {"job_queue"}:
+            self.state.lclear(name)
+        for job_id in self.state.hkeys("leases"):
+            self.state.hdel("leases", job_id)
+
+        grace = self.cfg.journal_recovery_grace_s or (
+            self.cfg.lease_seconds / 2.0
+        )
+        counts = {
+            "queued": 0, "leased": 0, "terminal": 0,
+            "completed_from_store": 0,
+        }
+        queued: list[str] = []
+        for job_id, job in jobs.items():
+            # "output present ⇒ complete" only applies to jobs that
+            # were actually DISPATCHED at least once (ACTIVE, or
+            # requeued with attempts consumed): a never-dispatched
+            # QUEUED job whose output key exists is a REUSED scan_id's
+            # stale blob (/reset keeps chunk outputs, reference
+            # behavior) and must re-execute, not adopt old results
+            ran = job.status in JobStatus.ACTIVE or job.attempts > 0
+            if (
+                job.status not in JobStatus.TERMINAL
+                and ran
+                and self.blobs.exists(
+                    chunk_output_key(job.scan_id, job.chunk_index)
+                )
+            ):
+                # the idempotent chunk store is truth: output present
+                # means the chunk WAS completed, whatever the journal
+                # tail says — never re-execute finished work. (Not
+                # pushed to the legacy `completed` pop-list: replaying
+                # a pre-crash push would re-emit the chunk to a tail
+                # client — docs/DURABILITY.md.)
+                job.status = JobStatus.COMPLETE
+                job.completed_at = job.completed_at or now
+                job.lease_expires_at = None
+                counts["completed_from_store"] += 1
+            elif job.status == JobStatus.QUEUED:
+                queued.append(job_id)
+                counts["queued"] += 1
+            elif job.status in JobStatus.ACTIVE:
+                # recovered leases are EXPIRED down to a short grace
+                # window: a live worker's next heartbeat re-leases its
+                # job through the normal fenced renew path; a worker
+                # that died with the server lets the grace lapse and
+                # the job requeues through _requeue_expired
+                job.lease_expires_at = now + grace
+                self.state.hset(
+                    "leases", job_id, str(job.lease_expires_at)
+                )
+                counts["leased"] += 1
+            else:
+                counts["terminal"] += 1
+            self.state.hset("jobs", job_id, job.to_json())
+        for job_id in sorted(queued, key=lambda j: order.get(j, 0)):
+            self.state.rpush(self._queue_list(jobs[job_id].tenant), job_id)
+
+        with self._lock:
+            self._rr_cursor = cursor
+        with self._gen_lock:
+            self._jobs_generation += 1
+        for outcome, n in counts.items():
+            if n:
+                QUEUE_RECOVERED.labels(outcome=outcome).inc(n)
+        # fold everything into a fresh snapshot so the NEXT boot's
+        # replay is O(live state), not O(history). Best-effort:
+        # recovery already succeeded, and the un-compacted WAL replays
+        # identically next time.
+        with self._journal_lock:
+            try:
+                journal.checkpoint(self._journal_state())
+            except Exception as e:
+                print(f"post-recovery checkpoint failed (will retry): {e}")
+        summary = {
+            "generation": self.generation,
+            "replayed_records": replayed,
+            **counts,
+        }
+        emit_event("queue.recovered", **summary)
+        return summary

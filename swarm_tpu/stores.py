@@ -16,6 +16,7 @@ worker fleet should not need a Redis/Mongo/S3 side-car to run a scan.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from collections import deque
 from pathlib import Path
@@ -77,6 +78,14 @@ class StateStore:
         raise NotImplementedError
 
     def lpush(self, name: str, value: str) -> None:
+        raise NotImplementedError
+
+    def lclear(self, name: str) -> None:
+        """Drop one list wholesale (Redis ``DEL``). Journal recovery
+        (docs/DURABILITY.md) REBUILDS every dispatch list from the
+        replayed job records; on a backend whose state survived the
+        restart (real Redis) the stale lists must be cleared first or
+        the rebuild would double-push every queued job."""
         raise NotImplementedError
 
     def lpop(self, name: str) -> Optional[str]:
@@ -154,6 +163,10 @@ class MemoryStateStore(StateStore):
             q = self._lists.get(name)
             return q.popleft() if q else None
 
+    def lclear(self, name):
+        with self._lock:
+            self._lists.pop(name, None)
+
     def lrange(self, name, start, stop):
         with self._lock:
             items = list(self._lists.get(name, ()))
@@ -216,6 +229,9 @@ class RedisStateStore(StateStore):
     def lpop(self, name):
         return self._d(self._r.lpop(name))
 
+    def lclear(self, name):
+        self._r.delete(name)
+
     def lrange(self, name, start, stop):
         return [v.decode() for v in self._r.lrange(name, start, stop)]
 
@@ -249,6 +265,11 @@ class BlobStore:
     def list(self, prefix: str) -> list[str]:
         raise NotImplementedError
 
+    def delete(self, key: str) -> None:
+        """Remove one blob (missing keys are a no-op — journal
+        compaction and reset may race a crash-recovery's leftovers)."""
+        raise NotImplementedError
+
     def delete_all(self) -> None:
         raise NotImplementedError
 
@@ -274,13 +295,26 @@ class LocalBlobStore(BlobStore):
         p = self._path(key)
         with self._lock:
             p.parent.mkdir(parents=True, exist_ok=True)
-            p.write_bytes(data)
+            # crash-atomic (docs/DURABILITY.md): a kill -9 mid-write
+            # must never leave a truncated chunk or journal segment —
+            # recovery reconciles "output blob present ⇒ job complete",
+            # so a half blob would become a half result. Same-directory
+            # temp + rename is atomic on POSIX.
+            tmp = p.with_name(p.name + f".tmp-{os.getpid()}")
+            tmp.write_bytes(data)
+            os.replace(tmp, p)
 
     def get(self, key):
         return self._path(key).read_bytes()
 
     def exists(self, key):
         return self._path(key).is_file()
+
+    def delete(self, key):
+        try:
+            self._path(key).unlink()
+        except FileNotFoundError:
+            pass
 
     def list(self, prefix):
         root = self._root.resolve()
@@ -296,7 +330,10 @@ class LocalBlobStore(BlobStore):
             return []
         out = []
         for p in base_dir.rglob("*"):
-            if p.is_file():
+            if p.is_file() and ".tmp-" not in p.name:
+                # in-flight atomic-put temp files are not blobs: a
+                # racing list must never hand a half-written key to
+                # raw_scan or journal replay
                 rel = p.relative_to(root).as_posix()
                 if rel.startswith(prefix):
                     out.append(rel)
@@ -335,6 +372,10 @@ class MemoryBlobStore(BlobStore):
         with self._lock:
             return sorted(k for k in self._blobs if k.startswith(prefix))
 
+    def delete(self, key):
+        with self._lock:
+            self._blobs.pop(key, None)
+
     def delete_all(self):
         with self._lock:
             self._blobs.clear()
@@ -368,6 +409,9 @@ class S3BlobStore(BlobStore):
         for page in paginator.paginate(Bucket=self._bucket, Prefix=prefix):
             keys.extend(o["Key"] for o in page.get("Contents", []))
         return sorted(keys)
+
+    def delete(self, key):
+        self._s3.delete_object(Bucket=self._bucket, Key=key)
 
     def delete_all(self):
         raise NotImplementedError("refusing to wipe a real bucket")

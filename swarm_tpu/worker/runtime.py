@@ -72,6 +72,14 @@ _POLL_ERRORS = REGISTRY.counter(
     "swarm_worker_poll_errors_total",
     "Polls that failed with a transport error (server down ≠ idle queue)",
 )
+_SERVER_GENERATION = REGISTRY.gauge(
+    "swarm_worker_server_generation",
+    "Control-plane generation last observed on a successful poll",
+)
+_SERVER_RESTARTS = REGISTRY.counter(
+    "swarm_worker_server_restarts_total",
+    "Control-plane generation changes observed by this worker",
+)
 
 
 class ServerClient:
@@ -89,6 +97,12 @@ class ServerClient:
         self.timeout = timeout
         self.session = requests.Session()
         self.session.headers["Authorization"] = f"Bearer {api_key}"
+        #: control-plane generation from the most recent /get-job
+        #: answer's X-Swarm-Generation header (None until the first
+        #: successful poll, or against a pre-journal server). The poll
+        #: loop watches it to detect server restarts
+        #: (docs/DURABILITY.md).
+        self.last_server_generation: Optional[int] = None
 
     def _request(self, op: str, method: str, path: str, detail=None, **kw):
         fault_point(f"transport.{op}", detail=detail, exc=TransportError)
@@ -106,6 +120,12 @@ class ServerClient:
         resp = self._request(
             "get_job", "GET", "/get-job", params={"worker_id": worker_id}
         )
+        gen = resp.headers.get("X-Swarm-Generation")
+        if gen is not None:
+            try:
+                self.last_server_generation = int(gen)
+            except ValueError:
+                pass
         return resp.json() if resp.status_code == 200 else None
 
     def update_job(self, job_id: str, changes: dict, worker_id: Optional[str] = None) -> bool:
@@ -183,6 +203,9 @@ class JobProcessor:
         #: None until a pipelined engine reports) — heartbeats carry it
         #: to the gateway's admission pressure signal
         self._last_saturation: Optional[float] = None
+        #: control-plane generation seen on the last successful poll
+        #: (None until the first; docs/DURABILITY.md)
+        self._seen_generation: Optional[int] = None
 
     # ------------------------------------------------------------------
     def prewarm(self, module_name: str) -> bool:
@@ -227,6 +250,10 @@ class JobProcessor:
                 print(f"error getting job: {e}")
                 time.sleep(self.cfg.poll_interval_idle_s)
                 continue
+            # a successful poll re-registered this worker's WorkerInfo
+            # server-side (next_job saves it on every poll); what's
+            # left is OUR side of a control-plane restart
+            self._note_server_generation()
             # the poll proved the server reachable: flush any finished
             # chunks spooled while it was down (idempotent via fencing)
             self._replay_spool()
@@ -244,6 +271,37 @@ class JobProcessor:
                 print(f"error processing job: {e}")
                 time.sleep(self.cfg.poll_interval_idle_s)
             time.sleep(self.cfg.poll_interval_busy_s)
+
+    def _note_server_generation(self) -> None:
+        """Detect a control-plane restart (docs/DURABILITY.md): the
+        X-Swarm-Generation header on the poll that just succeeded. On a
+        change, this worker's WorkerInfo/status is ALREADY re-registered
+        (the poll itself wrote it — /get-statuses is never stale past
+        the first post-restart poll); locally we close the transport
+        breakers the dead process earned, so the heartbeat and upload
+        paths resume cleanly instead of waiting out a stale cooldown."""
+        gen = getattr(self.client, "last_server_generation", None)
+        if gen is None or gen == self._seen_generation:
+            return
+        prior = self._seen_generation
+        self._seen_generation = gen
+        _SERVER_GENERATION.set(gen)
+        if prior is None:
+            return  # first contact, not a restart
+        _SERVER_RESTARTS.inc()
+        breakers = getattr(self.client, "breakers", None)
+        if breakers is not None:
+            breakers.reset_all()
+        emit_event(
+            "worker.server_restarted",
+            worker_id=self.cfg.worker_id,
+            generation=gen,
+            prior_generation=prior,
+        )
+        print(
+            f"server restarted (generation {prior} -> {gen}); "
+            "re-registered and reset transport breakers"
+        )
 
     def _replay_spool(self) -> None:
         if not len(self.spool):

@@ -1,0 +1,431 @@
+"""Durable queue journal (docs/DURABILITY.md): WAL append/replay,
+crash-safe compaction, generation monotonicity, recovery semantics, and
+the append-before-ack ordering invariant the journal writer pins."""
+
+import json
+
+import pytest
+
+from swarm_tpu.config import Config
+from swarm_tpu.datamodel import JobStatus
+from swarm_tpu.resilience.faults import FaultInjected, clear_plan, install_plan
+from swarm_tpu.server.journal import JournalError, QueueJournal
+from swarm_tpu.server.queue import JobQueueService
+from swarm_tpu.stores import (
+    MemoryBlobStore,
+    MemoryDocStore,
+    MemoryStateStore,
+)
+from swarm_tpu.telemetry import REGISTRY
+
+
+def _metric(name: str) -> float:
+    total = 0.0
+    for line in REGISTRY.render().splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            sample = line.split("{")[0].split(" ")[0]
+            if sample == name:
+                try:
+                    total += float(line.rsplit(" ", 1)[1])
+                except ValueError:
+                    pass
+    return total
+
+
+def _service(blobs=None, state=None, **cfg_kw):
+    cfg_kw.setdefault("lease_seconds", 5.0)
+    cfg = Config(**cfg_kw)
+    return JobQueueService(
+        cfg,
+        state or MemoryStateStore(),
+        blobs or MemoryBlobStore(),
+        MemoryDocStore(),
+    )
+
+
+def _queue(svc, scan_id, n, tenant=None):
+    svc.queue_scan(
+        {
+            "module": "echo",
+            "file_content": [f"row{i}\n" for i in range(n)],
+            "batch_size": 1,
+            "scan_id": scan_id,
+        },
+        tenant=tenant,
+    )
+
+
+# ---------------------------------------------------------------------------
+# QueueJournal unit contract
+# ---------------------------------------------------------------------------
+
+
+def test_append_replay_roundtrip_in_order():
+    j = QueueJournal(MemoryBlobStore())
+    j.append({"op": "tenant", "tenant": "a"})
+    j.append_many(
+        [{"op": "job", "job": {"job_id": f"s_1_{i}"}} for i in range(3)]
+    )
+    snapshot, records = j.replay()
+    assert snapshot is None
+    got = list(records)
+    assert [r.get("tenant") or r["job"]["job_id"] for r in got] == [
+        "a", "s_1_0", "s_1_1", "s_1_2",
+    ]
+
+
+def test_checkpoint_prunes_segments_and_seeds_replay():
+    blobs = MemoryBlobStore()
+    j = QueueJournal(blobs)
+    j.append({"op": "job", "job": {"job_id": "s_1_0"}})
+    j.checkpoint({"jobs": {"s_1_0": {"job_id": "s_1_0"}}})
+    assert blobs.list("_journal/seg/") == []  # folded into the snapshot
+    j.append({"op": "job", "job": {"job_id": "s_1_1"}})
+    snapshot, records = j.replay()
+    assert set(snapshot["jobs"]) == {"s_1_0"}
+    assert [r["job"]["job_id"] for r in records] == ["s_1_1"]
+
+
+def test_crashed_compaction_leftover_segments_are_skipped():
+    """Snapshot written, prune crashed: leftover low-seq segments must
+    be filtered by sequence number, never double-applied."""
+    blobs = MemoryBlobStore()
+    j = QueueJournal(blobs)
+    j.append({"op": "job", "job": {"job_id": "s_1_0", "status": "queued"}})
+    j.checkpoint({"jobs": {"s_1_0": {"job_id": "s_1_0", "status": "complete"}}})
+    # resurrect a pre-snapshot segment, as a crash mid-prune would
+    blobs.put(
+        "_journal/seg/000000000001.jsonl",
+        json.dumps(
+            {"op": "job", "job": {"job_id": "s_1_0", "status": "queued"}}
+        ).encode() + b"\n",
+    )
+    snapshot, records = j.replay()
+    assert list(records) == []  # the stale segment did not replay
+    assert snapshot["jobs"]["s_1_0"]["status"] == "complete"
+
+
+def test_sequence_resumes_after_restart():
+    blobs = MemoryBlobStore()
+    j1 = QueueJournal(blobs)
+    j1.append({"op": "job", "job": {"job_id": "a"}})
+    j2 = QueueJournal(blobs)  # a restarted writer
+    j2.append({"op": "job", "job": {"job_id": "b"}})
+    _snap, records = QueueJournal(blobs).replay()
+    assert [r["job"]["job_id"] for r in records] == ["a", "b"]
+
+
+def test_corrupt_records_skipped_and_counted():
+    blobs = MemoryBlobStore()
+    j = QueueJournal(blobs)
+    j.append({"op": "job", "job": {"job_id": "ok_1"}})
+    blobs.put("_journal/seg/000000000999.jsonl", b"{not json\n")
+    before = _metric("swarm_journal_corrupt_records_total")
+    _snap, records = QueueJournal(blobs).replay()
+    assert [r["job"]["job_id"] for r in records] == ["ok_1"]
+    assert _metric("swarm_journal_corrupt_records_total") == before + 1
+
+
+def test_generation_monotonic_and_survives_clear():
+    blobs = MemoryBlobStore()
+    j = QueueJournal(blobs)
+    assert j.generation() == 0
+    assert j.bump_generation() == 1
+    assert j.bump_generation() == 2
+    j.append({"op": "job", "job": {"job_id": "x"}})
+    j.clear()
+    assert not j.has_state()
+    assert QueueJournal(blobs).generation() == 2
+
+
+# ---------------------------------------------------------------------------
+# Append-before-ack: the journal writer's ordering invariant
+# ---------------------------------------------------------------------------
+
+
+class _SpyState(MemoryStateStore):
+    def __init__(self, log):
+        super().__init__()
+        self._log = log
+
+    def hset(self, name, key, value):
+        if name == "jobs":
+            self._log.append(("store", key))
+        super().hset(name, key, value)
+
+
+class _SpyJournal(QueueJournal):
+    def __init__(self, blobs, log):
+        super().__init__(blobs)
+        self._log = log
+
+    def append_many(self, records):
+        for r in records:
+            if r.get("op") == "job":
+                self._log.append(("journal", r["job"]["job_id"]))
+        super().append_many(records)
+
+
+def test_append_before_ack_ordering():
+    """REGRESSION PIN (docs/DURABILITY.md): every job-record store
+    write is immediately preceded by ITS journal append — across
+    submission, dispatch, status updates, renewals and requeues."""
+    log: list = []
+    cfg = Config(lease_seconds=5.0)
+    blobs = MemoryBlobStore()
+    svc = JobQueueService(
+        cfg, _SpyState(log), blobs, MemoryDocStore(),
+        journal=_SpyJournal(blobs, log),
+    )
+    _queue(svc, "ord_1", 3)
+    job = svc.next_job("w1")
+    svc.update_job(job["job_id"], {"status": "executing", "worker_id": "w1"})
+    svc.renew_lease(job["job_id"], "w1")
+    svc.update_job(job["job_id"], {"status": "complete", "worker_id": "w1"})
+    assert log, "spies observed nothing"
+    for i, (kind, job_id) in enumerate(log):
+        if kind == "store":
+            assert log[i - 1] == ("journal", job_id), (
+                f"store write of {job_id} at log[{i}] was not "
+                f"immediately preceded by its journal append: {log}"
+            )
+
+
+def test_failed_append_during_dispatch_restores_the_queue_list():
+    """A journal failure mid-dispatch must leave the job claimable:
+    the popped id goes back to the FRONT of its list, not into a
+    QUEUED-but-unlisted limbo that only a restart would heal."""
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs)
+    _queue(svc, "dsp_1", 2)
+    install_plan("journal.append:1")
+    try:
+        with pytest.raises(JournalError):
+            svc.next_job("w1")
+    finally:
+        clear_plan()
+    assert svc.queue_depth() == 2  # both ids still listed, in order
+    assert svc.next_job("w1")["job_id"] == "dsp_1_0"
+    assert svc.next_job("w1")["job_id"] == "dsp_1_1"
+
+
+def test_failed_append_during_requeue_keeps_lease_entry_for_retry():
+    """_requeue_expired writes the journaled record FIRST: an append
+    failure must leave the lease-index entry so the next dispatch
+    retries the requeue (dropping it first stranded the job)."""
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs, lease_seconds=0.01)
+    _queue(svc, "rq_1", 1)
+    job = svc.next_job("w1")
+    import time as _time
+
+    _time.sleep(0.05)  # lease lapses
+    install_plan("journal.append:1")
+    try:
+        with pytest.raises(JournalError):
+            svc.next_job("w2")  # the expiry sweep hits the fault
+    finally:
+        clear_plan()
+    assert svc.state.hget("leases", job["job_id"]) is not None
+    # next sweep completes the requeue and re-dispatches
+    redone = svc.next_job("w2")
+    assert redone is not None and redone["job_id"] == job["job_id"]
+
+
+def test_failed_append_during_complete_does_not_feed_the_tail():
+    """The legacy `completed` pop-list is only pushed AFTER the
+    journaled record lands: an append failure must not emit a
+    completion the job record never reached (double-terminal risk on
+    the retried update)."""
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs)
+    _queue(svc, "cm_1", 1)
+    job = svc.next_job("w1")
+    svc.put_output_chunk("cm_1", 0, b"out\n")
+    install_plan("journal.append:1")
+    try:
+        with pytest.raises(JournalError):
+            svc.update_job(
+                job["job_id"], {"status": "complete", "worker_id": "w1"}
+            )
+    finally:
+        clear_plan()
+    assert svc.latest_completed_job_id() is None
+    assert json.loads(
+        svc.state.hget("jobs", job["job_id"])
+    )["status"] != JobStatus.COMPLETE
+    # the worker's retry lands exactly once
+    assert svc.update_job(
+        job["job_id"], {"status": "complete", "worker_id": "w1"}
+    )
+    assert svc.latest_completed_job_id() == job["job_id"]
+    assert svc.latest_completed_job_id() is None
+
+
+def test_reused_scan_id_stale_output_not_adopted_by_recovery():
+    """/reset keeps chunk blobs (reference behavior); a resubmitted
+    scan_id recovered before dispatch must NOT adopt the previous
+    incarnation's output — never-dispatched jobs re-execute."""
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs)
+    _queue(svc, "reuse_1", 1)
+    job = svc.next_job("w1")
+    svc.put_output_chunk("reuse_1", 0, b"monday-results\n")
+    svc.update_job(job["job_id"], {"status": "complete", "worker_id": "w1"})
+    svc.reset()
+    _queue(svc, "reuse_1", 1)  # Tuesday's resubmission, new inputs
+    svc2 = _service(blobs=blobs)  # crash before any dispatch
+    rec = json.loads(svc2.state.hget("jobs", "reuse_1_0"))
+    assert rec["status"] == JobStatus.QUEUED, (
+        "recovery adopted a stale output for a never-dispatched job"
+    )
+    assert svc2.recovery_summary["completed_from_store"] == 0
+    assert svc2.next_job("w1")["job_id"] == "reuse_1_0"
+
+
+def test_failed_append_means_mutation_never_happened():
+    """A journal append failure must 500 the route BEFORE the store is
+    touched: the job is absent everywhere, nothing half-applied."""
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs)
+    install_plan("journal.append:1")
+    try:
+        with pytest.raises(JournalError):
+            _queue(svc, "wal_1", 1)
+        assert svc.state.hget("jobs", "wal_1_0") is None
+        assert svc.queue_depth() == 0
+        # the journal holds no record either: the fault fired before
+        # the segment write
+        svc2 = _service(blobs=blobs)
+        assert svc2.statuses()["jobs"] == {}
+    finally:
+        clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# Recovery semantics through JobQueueService
+# ---------------------------------------------------------------------------
+
+
+def test_recovery_rebuilds_tenant_queues_in_order():
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs)
+    _queue(svc, "ta_1", 3, tenant="tA")
+    _queue(svc, "tb_1", 2, tenant="tB")
+    order_a = svc.state.lrange("job_queue:t:tA", 0, -1)
+    svc2 = _service(blobs=blobs)
+    assert svc2.generation == 2
+    assert svc2.tenants() == ["default", "tA", "tB"]
+    assert svc2.state.lrange("job_queue:t:tA", 0, -1) == order_a
+    assert svc2.tenant_depth("tB") == 2
+    # draining works: every recovered job is dispatchable exactly once
+    seen = set()
+    while True:
+        job = svc2.next_job("w")
+        if job is None:
+            break
+        seen.add(job["job_id"])
+    assert len(seen) == 5
+
+
+def test_recovery_completes_jobs_whose_output_exists():
+    """Outputs present ⇒ job completed, regardless of the journal tail
+    — the worker uploaded, the crash beat the status update."""
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs)
+    _queue(svc, "rc_1", 2)
+    job = svc.next_job("w1")
+    svc.put_output_chunk("rc_1", int(job["chunk_index"]), b"done\n")
+    svc2 = _service(blobs=blobs)
+    rec = svc2.recovery_summary
+    assert rec["completed_from_store"] == 1
+    status = json.loads(svc2.state.hget("jobs", job["job_id"]))
+    assert status["status"] == JobStatus.COMPLETE
+    # ...and a pre-restart zombie's completion can't double-terminal it
+    assert svc2.update_job(
+        job["job_id"], {"status": "complete", "worker_id": "w1"}
+    ) is False
+    assert svc2.latest_completed_job_id() is None  # no duplicate push
+
+
+def test_recovery_expires_leases_to_grace_and_keeps_fencing():
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs, lease_seconds=100.0)
+    _queue(svc, "lg_1", 1)
+    job = svc.next_job("w1")
+    import time as _time
+
+    before = _time.time()
+    svc2 = _service(blobs=blobs, lease_seconds=100.0)
+    raw = json.loads(svc2.state.hget("jobs", job["job_id"]))
+    # not the original ~100 s lease: expired down to the grace window
+    assert raw["lease_expires_at"] <= before + 51.0
+    assert raw["worker_id"] == "w1"
+    # the live worker re-leases through the normal fenced renew path
+    assert svc2.renew_lease(job["job_id"], "w1") is not None
+    assert svc2.renew_lease(job["job_id"], "other") is None
+
+
+def test_recovery_preserves_dead_letter_and_attempts():
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs, max_attempts=1)
+    _queue(svc, "dl_1", 1)
+    job = svc.next_job("w1")
+    svc.update_job(job["job_id"], {"status": "cmd failed", "worker_id": "w1"})
+    assert [d["job_id"] for d in svc.dead_letter_jobs()] == ["dl_1_0"]
+    svc2 = _service(blobs=blobs, max_attempts=1)
+    [dead] = svc2.dead_letter_jobs()
+    assert dead["job_id"] == "dl_1_0"
+    assert dead["failure_history"]
+    assert svc2.recovery_summary["terminal"] == 1
+    # operator requeue still works on the recovered record
+    assert svc2.requeue_dead_letter("dl_1_0")
+    assert svc2.next_job("w2")["job_id"] == "dl_1_0"
+
+
+def test_reset_clears_journal_too():
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs)
+    _queue(svc, "rs_1", 2)
+    svc.reset()
+    svc2 = _service(blobs=blobs)
+    assert svc2.recovery_summary is None
+    assert svc2.statuses()["jobs"] == {}
+    assert svc2.generation == 2  # the generation counter survived the reset
+
+
+def test_journal_disabled_keeps_legacy_behavior():
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs, journal_enabled=False)
+    _queue(svc, "off_1", 2)
+    assert svc.generation == 0
+    assert blobs.list("_journal/") == []
+    svc2 = _service(blobs=blobs, journal_enabled=False)
+    assert svc2.statuses()["jobs"] == {}  # state died with the process
+
+
+def test_opportunistic_checkpoint_bounds_wal_growth():
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs, journal_compact_segments=8)
+    _queue(svc, "cp_1", 20)  # 20 job appends + 1 tenant record
+    assert svc._journal.segments_pending < 8 + 2
+    assert blobs.list("_journal/snap/")
+    # and the compacted journal still recovers everything
+    svc2 = _service(blobs=blobs, journal_compact_segments=8)
+    assert svc2.recovery_summary["queued"] == 20
+    assert svc2.queue_depth() == 20
+
+
+def test_replay_fault_fails_boot_loudly():
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs)
+    _queue(svc, "rf_1", 1)
+    install_plan("journal.replay:1")
+    try:
+        with pytest.raises(FaultInjected):
+            _service(blobs=blobs)
+    finally:
+        clear_plan()
+    # operator cleared the cause: the next boot recovers normally
+    svc2 = _service(blobs=blobs)
+    assert svc2.recovery_summary["queued"] == 1

@@ -77,6 +77,10 @@ class _FakeRedisClient:
         stop = len(q) if stop == -1 else stop + 1
         return q[start:stop]
 
+    def delete(self, name):
+        self.l.pop(name, None)
+        self.h.pop(name, None)
+
     def llen(self, name):
         return len(self.l.get(name, []))
 
@@ -228,6 +232,9 @@ def test_redis_adapter_contract(fake_backends):
     assert store.lrange("job_queue", 0, -1) == ["front", "a", "b"]
     assert store.lpop("job_queue") == "front"
     assert store.lpop("nothing") is None
+    # journal recovery rebuilds dispatch lists wholesale (DEL on Redis)
+    store.lclear("job_queue")
+    assert store.llen("job_queue") == 0
     store.flushall()
     assert store.hkeys("jobs") == []
 

@@ -413,6 +413,30 @@ def test_spool_survives_restart(tmp_path):
     assert again.replay(_SpoolServer()) == 1
 
 
+def test_spool_replays_in_chunk_index_order_with_scan_summary(
+    tmp_path, capsys
+):
+    """Replay order is (scan_id, chunk_index) — NUMERIC chunk order,
+    where a lexical filename sort would put chunk 10 before chunk 2 —
+    and one summary line per scan makes post-restart reconciliation
+    deterministic (docs/DURABILITY.md)."""
+    spool = OutputSpool(tmp_path / "spool")
+    for idx in (10, 2, 0):
+        spool.put(f"scanx_1_{idx}", "scanx_1", idx, "w0", b"x%d" % idx)
+    spool.put("scana_1_1", "scana_1", 1, "w0", b"a1")
+    srv = _SpoolServer()
+    assert spool.replay(srv) == 4
+    assert srv.puts == [
+        ("scana_1", 1, b"a1"),
+        ("scanx_1", 0, b"x0"),
+        ("scanx_1", 2, b"x2"),
+        ("scanx_1", 10, b"x10"),
+    ]
+    out = capsys.readouterr().out
+    assert "spool replay [scana_1]: completed chunks [1]" in out
+    assert "spool replay [scanx_1]: completed chunks [0, 2, 10]" in out
+
+
 # ---------------------------------------------------------------------------
 # Dead-letter quarantine (queue level)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,353 @@
+"""Rolling-restart chaos soak (docs/DURABILITY.md capstone): the C2
+server runs as a REAL subprocess and is ``kill -9``'d three times
+mid-scan under a seeded fault plan while two real workers on two
+tenants keep scanning and a streaming client follows results. The
+journal + recovery must deliver: every scan completes with ``/raw``
+bit-identical to a restart-free baseline, zero jobs lost or
+double-terminal, and the stream resumes seamlessly across every kill.
+
+Plus the worker-side satellite: a worker observing the server
+generation change re-registers (its WorkerInfo is current after ONE
+poll) and force-closes its transport breakers so heartbeats/uploads
+resume without waiting out stale cooldowns.
+"""
+
+import base64
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+import requests
+
+from swarm_tpu.client.cli import JobClient
+from swarm_tpu.config import Config
+from swarm_tpu.resilience.faults import clear_plan, install_plan
+from swarm_tpu.server.app import SwarmServer
+from swarm_tpu.worker.runtime import JobProcessor
+
+TEMPLATES = "tests/data/templates"
+API_KEY = "rrkey"
+
+#: worker-process plan (installed in THIS process, where the workers
+#: run): dropped polls, one chunk's uploads failing past the whole
+#: retry budget (spool → replay), and a 0.25 s execute delay per rra/
+#: rrb chunk so three kill windows fit inside the scans
+WORKER_PLAN = (
+    "seed=7;"
+    "transport.get_job:3,9;"
+    "transport.put_chunk/rra_1_1:1-3;"
+    "executor.run/rr*:*:sleep=0.25"
+)
+#: server-subprocess plan (via env): a couple of state-store write
+#: faults so routes 500 mid-soak and workers ride their retry budget
+SERVER_PLAN = "seed=7;store.hset/workers:5,11"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_server(port: int, tmp, log_name: str):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "SWARM_BLOB_ROOT": str(tmp / "blobs"),
+        "SWARM_DOC_ROOT": str(tmp / "docs"),
+        "SWARM_FAULT_PLAN": SERVER_PLAN,
+        "SWARM_LEASE_SECONDS": "3",
+        "SWARM_MAX_ATTEMPTS": "6",
+        "SWARM_GATEWAY_STREAM_POLL_S": "0.02",
+    }
+    log = open(tmp / log_name, "ab")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "swarm_tpu.server",
+            "--host", "127.0.0.1", "--port", str(port),
+            "--api-key", API_KEY,
+        ],
+        env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+
+
+def _wait_healthy(port: int, deadline_s: float = 30.0) -> dict:
+    end = time.time() + deadline_s
+    while time.time() < end:
+        try:
+            r = requests.get(
+                f"http://127.0.0.1:{port}/healthz", timeout=2
+            )
+            if r.status_code == 200:
+                return r.json()
+        except requests.RequestException:
+            pass
+        time.sleep(0.05)
+    raise AssertionError("server did not become healthy in time")
+
+
+def _worker_cfg(tmp, port: int, worker_id: str) -> Config:
+    modules_dir = tmp / "modules"
+    if not modules_dir.is_dir():
+        modules_dir.mkdir()
+        (modules_dir / "fingerprint.json").write_text(
+            json.dumps({"backend": "tpu", "templates": TEMPLATES})
+        )
+    return Config(
+        server_url=f"http://127.0.0.1:{port}", api_key=API_KEY,
+        worker_id=worker_id, modules_dir=str(modules_dir),
+        poll_interval_idle_s=0.03, poll_interval_busy_s=0.01,
+        lease_seconds=3.0, max_attempts=6,
+        heartbeat_interval_s=0.2,
+        transport_retries=2, transport_backoff_s=0.02,
+        transport_backoff_max_s=0.1,
+        transport_breaker_threshold=500,
+        spool_dir=str(tmp / f"spool-{worker_id}"),
+    )
+
+
+def _rows(n: int):
+    rows = [
+        {"host": f"10.7.0.{i}", "port": 443, "status": 200,
+         "body": f"<title>Demo Admin</title> demo-build 9.{i} page {i}"}
+        for i in range(n - 1)
+    ]
+    rows.append(
+        {"host": "10.7.9.1", "port": 7777,
+         "banner_b64": base64.b64encode(b"DEMOD: 2 service ready").decode()}
+    )
+    return rows
+
+
+def _submit(client, tmp, scan_id, rows, tenant=None):
+    f = tmp / f"{scan_id}.jsonl"
+    f.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    tenant_client = JobClient(client.base, API_KEY, tenant=tenant)
+    code, _ = tenant_client.start_scan(
+        str(f), "fingerprint", 0, 1, scan_id=scan_id
+    )
+    assert code == 200
+
+
+N_A, N_B = 12, 8  # chunks per scan (batch_size 1)
+
+
+def test_rolling_restart_soak(tmp_path):
+    port = _free_port()
+    base_url = f"http://127.0.0.1:{port}"
+
+    # --- restart-free baseline: in-process server, same worker code ---
+    base_cfg = Config(
+        host="127.0.0.1", port=0, api_key=API_KEY,
+        blob_root=str(tmp_path / "base" / "blobs"),
+        doc_root=str(tmp_path / "base" / "docs"),
+    )
+    base_srv = SwarmServer(base_cfg)
+    base_srv.start_background()
+    base_client = JobClient(f"http://127.0.0.1:{base_srv.port}", API_KEY)
+    _submit(base_client, tmp_path, "rrabase_1", _rows(N_A))
+    _submit(base_client, tmp_path, "rrbbase_1", _rows(N_B))
+    base_worker_cfg = _worker_cfg(tmp_path, base_srv.port, "base-w")
+    base_worker_cfg.max_jobs = N_A + N_B
+    JobProcessor(base_worker_cfg).process_jobs()
+    baseline_a = base_client.fetch_raw("rrabase_1")
+    baseline_b = base_client.fetch_raw("rrbbase_1")
+    assert baseline_a and baseline_b
+    base_srv.shutdown()
+
+    # --- chaos run: subprocess server, seeded plans, 3x kill -9 ---
+    live = tmp_path / "live"
+    live.mkdir()
+    proc = _spawn_server(port, live, "server.log")
+    plan = install_plan(WORKER_PLAN)
+    client = JobClient(base_url, API_KEY)
+    workers = []
+    threads = []
+    stream_records: list = []
+    stream_error: list = []
+
+    def stream_follow():
+        try:
+            follower = JobClient(base_url, API_KEY)
+            for chunk, text in follower.stream_results(
+                "rra_1", max_reconnects=100, reconnect_delay_s=0.2
+            ):
+                stream_records.append((chunk, text))
+        except Exception as e:  # surfaces in the main assert
+            stream_error.append(e)
+
+    try:
+        assert _wait_healthy(port)["generation"] == 1
+        _submit(client, tmp_path, "rra_1", _rows(N_A), tenant="tenantA")
+        _submit(client, tmp_path, "rrb_1", _rows(N_B), tenant="tenantB")
+
+        st = threading.Thread(target=stream_follow, daemon=True)
+        st.start()
+        for wid in ("w0", "w1"):
+            w = JobProcessor(_worker_cfg(tmp_path, port, wid))
+            workers.append(w)
+            t = threading.Thread(target=w.process_jobs, daemon=True)
+            threads.append(t)
+            t.start()
+
+        def completed_count():
+            try:
+                statuses = client.get_statuses()
+            except requests.RequestException:
+                return None
+            if statuses is None:
+                return None
+            return sum(
+                1 for j in statuses["jobs"].values()
+                if j["status"] == "complete"
+            )
+
+        # three kill -9s, each triggered mid-scan (some chunks done,
+        # some still outstanding)
+        deadline = time.time() + 180
+        kills = 0
+        for threshold in (1, 4, 8):
+            while time.time() < deadline:
+                done = completed_count()
+                if done is not None and done >= threshold:
+                    break
+                time.sleep(0.05)
+            done = completed_count()
+            assert done is None or done < N_A + N_B, (
+                "scans finished before all restarts could fire — "
+                "slow the chunks down"
+            )
+            proc.kill()  # SIGKILL: no shutdown hooks, no flush
+            proc.wait(timeout=10)
+            kills += 1
+            proc = _spawn_server(port, live, "server.log")
+            health = _wait_healthy(port)
+            assert health["generation"] == 1 + kills
+            assert health["recovery"], "restart did not recover state"
+
+        # drain to completion under the plan
+        pending = {"rra_1", "rrb_1"}
+        while time.time() < deadline and pending:
+            time.sleep(0.2)
+            try:
+                statuses = client.get_statuses()
+            except requests.RequestException:
+                continue
+            if statuses is None:
+                continue
+            done = {
+                s["scan_id"] for s in statuses.get("scans", [])
+                if s["percent_complete"] == 100.0
+            }
+            pending -= done
+        assert not pending, f"scans did not complete under chaos: {pending}"
+    finally:
+        for w in workers:
+            w.stop_requested = True
+        for t in threads:
+            t.join(timeout=30)
+        clear_plan()
+
+    try:
+        # --- capstone: /raw bit-identical to the restart-free run ---
+        chaos_a = client.fetch_raw("rra_1")
+        chaos_b = client.fetch_raw("rrb_1")
+        assert chaos_a == baseline_a.replace("rrabase_1", "rra_1")
+        assert chaos_b == baseline_b.replace("rrbbase_1", "rrb_1")
+
+        # --- zero jobs lost or double-terminal ---
+        statuses = client.get_statuses()
+        jobs = {
+            j: r for j, r in statuses["jobs"].items()
+            if r["scan_id"] in ("rra_1", "rrb_1")
+        }
+        assert len(jobs) == N_A + N_B
+        assert all(r["status"] == "complete" for r in jobs.values())
+        assert client.dead_letter_jobs() == []
+
+        # --- streaming client resumed seamlessly across every kill ---
+        st.join(timeout=30)
+        assert not st.is_alive(), "stream did not terminate on scan end"
+        assert not stream_error, f"stream raised: {stream_error}"
+        assert [c for c, _ in stream_records] == list(range(N_A)), (
+            "stream lost or duplicated chunks across restarts"
+        )
+        # each streamed record matches the stored chunk byte for byte
+        # (/raw concatenates in lexical key order, the stream in chunk
+        # order — compare per chunk, not against the concatenation)
+        session = requests.Session()
+        session.headers["Authorization"] = f"Bearer {API_KEY}"
+        for chunk, text in stream_records:
+            r = session.get(
+                f"{base_url}/get-chunk/rra_1/{chunk}", timeout=10
+            )
+            assert r.status_code == 200 and r.json()["contents"] == text
+
+        # --- generations: one bump per boot, monotonic ---
+        health = client.get_healthz()
+        assert health["generation"] == 4  # initial boot + 3 restarts
+
+        # --- the worker-side plan actually fired ---
+        snap = plan.snapshot()
+        assert snap["transport.get_job"]["fired"] == 2
+        assert snap["transport.put_chunk/rra_1_1"]["fired"] == 3
+        assert snap["executor.run/rr*"]["fired"] >= N_A + N_B
+
+        # --- workers observed the restarts ---
+        assert any(
+            (w._seen_generation or 0) >= 2 for w in workers
+        ), "no worker observed a generation change"
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def test_worker_reregisters_and_breakers_reset_on_generation_change(tmp_path):
+    """Satellite (docs/DURABILITY.md): the first successful poll after
+    a server generation change re-registers the worker's WorkerInfo
+    (so /get-statuses is never stale) and force-closes its transport
+    breakers so the heartbeat path resumes immediately."""
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key=API_KEY,
+        blob_root=str(tmp_path / "blobs"),
+        doc_root=str(tmp_path / "docs"),
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    port = srv.port
+    wcfg = _worker_cfg(tmp_path, port, "w-reg")
+    worker = JobProcessor(wcfg)
+    worker.client.get_job("w-reg")
+    worker._note_server_generation()
+    assert worker._seen_generation == 1
+
+    # a breaker the dead server's failures opened
+    breaker = worker.client.breakers.get("renew_lease")
+    for _ in range(worker.client.breakers.threshold + 1):
+        breaker.record_failure()
+    assert breaker.state == "open"
+
+    srv.shutdown()  # the restart (fresh in-memory stores, same journal)
+    srv2 = SwarmServer(Config(**{**cfg.__dict__, "port": port}))
+    srv2.start_background()
+    try:
+        worker.client.get_job("w-reg")
+        worker._note_server_generation()
+        assert worker._seen_generation == 2
+        assert breaker.state == "closed", (
+            "generation change must force-close stale transport breakers"
+        )
+        # the poll itself re-registered the worker server-side
+        statuses = JobClient(
+            f"http://127.0.0.1:{port}", API_KEY
+        ).get_statuses()
+        assert "w-reg" in statuses["workers"]
+    finally:
+        srv2.shutdown()

@@ -79,6 +79,16 @@ REQUIRED_FAMILIES = (
     "swarm_gateway_queued_by_tenant",
     "swarm_gateway_pressure",
     "swarm_gateway_stream_bytes_total",
+    # durable queue journal (docs/DURABILITY.md): registered at
+    # telemetry import (journal_export), op/outcome combos pre-seeded —
+    # every family renders samples even on a never-journaled process
+    "swarm_journal_appends_total",
+    "swarm_journal_replayed_total",
+    "swarm_journal_compactions_total",
+    "swarm_journal_segments",
+    "swarm_journal_corrupt_records_total",
+    "swarm_queue_recovered_jobs_total",
+    "swarm_queue_generation",
 )
 
 

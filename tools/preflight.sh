@@ -48,8 +48,11 @@ echo "== preflight: host-walk floor =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python tools/profile_walk.py --check-floor
 
-echo "== preflight: bench smoke (pipeline A/B + shard smoke, both modes) =="
+echo "== preflight: bench smoke (pipeline A/B + shard + restart smoke, both modes) =="
 # CI-fast A/B on the bundled corpus; rc gates on verdict identity only.
+# Includes the restart smoke (docs/DURABILITY.md): one mid-scan server
+# restart against the durable queue journal, rc-gated on raw identity
+# vs a restart-free baseline + zero lost jobs.
 # Forced to the CPU backend unless the operator pinned one — the smoke
 # validates feed mechanics and parity, not chip throughput. Includes
 # the shard_smoke clause (docs/SHARDING.md): the sharded serving path
