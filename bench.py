@@ -1496,6 +1496,10 @@ def _setup_phase(need_corpus: bool):
 
 def run_phase(phase: str) -> int:
     """One bench phase in this process. Emits its JSON metric lines."""
+    if phase == "aot_child":
+        # the cold-start A/B's measured arm: minimal setup on purpose
+        # (its OWN bring-up is the number)
+        return _aot_child()
     if phase in ("sharded", "shard_smoke"):
         # the mesh path needs >1 device: force the virtual host-
         # platform mesh BEFORE jax initializes (a no-op for real
@@ -1734,6 +1738,42 @@ def run_phase(phase: str) -> int:
             # correctness bug, not a throughput datapoint
             log("!!! sharded serving planes MISMATCH — phase FAILED")
             return 1
+    elif phase == "aot":
+        # AOT cold-start A/B (docs/AOT.md): fresh-process fetch-vs-
+        # compile bring-up over a file-backed artifact store, paired
+        # and identity-gated on the verdict planes. Children inherit
+        # the same corpus resolution as every other phase.
+        os.environ.setdefault(
+            "SWARM_BENCH_CORPUS",
+            str(
+                REFERENCE_CORPUS
+                if REFERENCE_CORPUS.is_dir()
+                else BUNDLED_CORPUS
+            ),
+        )
+        rec = bench_aot_coldstart(reps=2)
+        if not rec.get("ok"):
+            log(f"!!! AOT cold-start phase FAILED: {rec}")
+            return 1
+        emit(
+            "aot_coldstart_speedup",
+            rec["speedup"],
+            "x (fresh-process bring-up: compile arm / warm-fetch arm, "
+            "planes identity-gated)",
+            rec["speedup"],
+            extra={"aot": {k: v for k, v in rec.items() if k != "seed"}},
+        )
+        emit(
+            "aot_bringup_seconds",
+            rec["fetch_bringup_seconds"],
+            "s (median warm-fetch bring-up to first full-plane batch; "
+            "compile arm in extra)",
+            rec["compile_bringup_seconds"]
+            / max(rec["fetch_bringup_seconds"], 1e-9),
+            extra={
+                "compile_bringup_seconds": rec["compile_bringup_seconds"],
+            },
+        )
     elif phase == "shard_smoke":
         # run_smoke's child: engine-level sharded-vs-single verdict
         # identity on the forced 8-device host-platform mesh
@@ -2094,6 +2134,193 @@ def _smoke_restart_clause() -> "tuple[bool, dict]":
             srv2.shutdown()
 
 
+def _aot_child() -> int:
+    """Child entry of the AOT cold-start A/B (docs/AOT.md): ONE fresh
+    process measuring engine bring-up — corpus load (dbcache-warm, so
+    both arms pay the same host cost) then DeviceDB construction
+    through the first full-plane match. Mode ``fetch`` attaches a
+    local-dir AOT store (empty store ⇒ this child is the publisher;
+    warm store ⇒ it loads instead of compiling); mode ``compile`` is
+    the no-AOT reference arm. Prints one ``AOTCHILD {json}`` line."""
+    import hashlib
+
+    resolve_device()
+    mode = os.environ.get("SWARM_AOT_CHILD_MODE", "compile")
+    root = os.environ.get("SWARM_AOT_CHILD_DIR", "")
+    corpus = Path(
+        os.environ.get("SWARM_BENCH_CORPUS", str(BUNDLED_CORPUS))
+    )
+    from swarm_tpu.fingerprints.dbcache import load_or_compile
+    from swarm_tpu.ops.encoding import encode_batch
+    from swarm_tpu.ops.match import DeviceDB
+
+    templates, db = load_or_compile(corpus)
+    rows = realistic_rows(64, seed=5)
+    batch = encode_batch(
+        rows, max_body=1024, max_header=512, pad_rows_to=64
+    )
+    client = None
+    if mode == "fetch" and root:
+        from swarm_tpu.aot import build_aot_client
+        from swarm_tpu.config import Config
+
+        client = build_aot_client(
+            Config(
+                aot_backend="local",
+                aot_dir=root,
+                worker_id=f"bench-aot-{os.getpid()}",
+            )
+        )
+    t0 = time.perf_counter()
+    dev = DeviceDB(db)
+    if client is not None:
+        dev.attach_aot(client)
+        dev.aot_prewarm()
+    planes = dev.match(
+        batch.streams, batch.lengths, batch.status, full=True
+    )
+    bringup = time.perf_counter() - t0
+    h = hashlib.sha256()
+    for p in planes:
+        h.update(np.ascontiguousarray(np.asarray(p)).tobytes())
+    rec = {
+        "mode": mode,
+        "bringup_seconds": round(bringup, 4),
+        "planes_sha256": h.hexdigest(),
+        "executable_count": dev.executable_count(),
+        "fetched_executable_count": dev.fetched_executable_count(),
+        "compile_count": dev.compile_count,
+        "fetch_count": dev.fetch_count,
+    }
+    print("AOTCHILD " + json.dumps(rec), flush=True)
+    return 0
+
+
+def bench_aot_coldstart(reps: int = 2, timeout_s: int = 900) -> dict:
+    """Fresh-process fetch-vs-compile bring-up A/B (docs/AOT.md):
+    seed a file-backed artifact store with one publisher child, then
+    run PAIRED fresh-process reps — a no-AOT compile arm and a
+    warm-store fetch arm, alternating — and gate on every child's
+    verdict planes hashing identically. The per-process persistent
+    XLA cache is disabled in the children (a joining fleet node's
+    local cache is cold; that is the cliff being measured)."""
+    import statistics
+    import subprocess
+    import tempfile
+
+    store_dir = tempfile.mkdtemp(prefix="swarm_bench_aot_")
+
+    def child(mode: str):
+        env = dict(os.environ)
+        env["SWARM_AOT_CHILD_MODE"] = mode
+        env["SWARM_AOT_CHILD_DIR"] = store_dir
+        # cold local XLA cache in every child — the scenario is a
+        # fresh autoscaled node, and a warm persistent cache would
+        # fake the compile arm's cost
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        env.pop("SWARM_XLA_CACHE_DIR", None)
+        # the chaos plan's AOT levers (aot.fetch/aot.put) are THIS
+        # clause's contract; the engine-layer levers (device.dispatch
+        # etc.) are exercised by the engine-backed clauses and would
+        # kill a raw-DeviceDB child that has no breaker to absorb them
+        plan = env.get("SWARM_FAULT_PLAN", "")
+        if plan:
+            kept = [
+                item
+                for item in plan.split(";")
+                if item.startswith(("seed=", "aot."))
+            ]
+            env["SWARM_FAULT_PLAN"] = ";".join(kept)
+        try:
+            r = subprocess.run(
+                [sys.executable, __file__, "--phase", "aot_child"],
+                stdout=subprocess.PIPE,
+                text=True,
+                env=env,
+                timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            return None
+        if r.returncode != 0:
+            return None
+        for line in r.stdout.splitlines():
+            if line.startswith("AOTCHILD "):
+                try:
+                    return json.loads(line[len("AOTCHILD "):])
+                except json.JSONDecodeError:
+                    return None
+        return None
+
+    import shutil
+
+    try:
+        seed = child("fetch")  # empty store: compiles AND publishes
+        if seed is None:
+            return {"ok": False, "reason": "seed child failed"}
+        compile_s: list = []
+        fetch_s: list = []
+        warm: list = []
+        digests = {seed["planes_sha256"]}
+        for i in range(max(reps, 1)):
+            # alternate the arm order so drift (page cache, thermal)
+            # can't systematically favor one side
+            order = (
+                ("compile", "fetch") if i % 2 == 0 else ("fetch", "compile")
+            )
+            for mode in order:
+                rec = child(mode)
+                if rec is None:
+                    return {"ok": False, "reason": f"{mode} child failed"}
+                digests.add(rec["planes_sha256"])
+                if mode == "compile":
+                    compile_s.append(rec["bringup_seconds"])
+                else:
+                    fetch_s.append(rec["bringup_seconds"])
+                    warm.append(rec)
+    finally:
+        # the store holds serialized executables (MBs per shape class)
+        # — a leaked dir per smoke/bench run would steadily fill /tmp
+        shutil.rmtree(store_dir, ignore_errors=True)
+    identical = len(digests) == 1
+    from swarm_tpu.resilience.faults import active_plan
+
+    # the children inherit SWARM_FAULT_PLAN via env, so the plan may
+    # be armed there even before this process fired any point
+    chaos = active_plan() is not None or bool(
+        os.environ.get("SWARM_FAULT_PLAN", "")
+    )
+    # zero-compile is the warm-fetch contract — except under an armed
+    # chaos plan, where injected aot.fetch faults legitimately force
+    # the fallback compile (the identity gate still holds)
+    warm_zero_compile = all(r["compile_count"] == 0 for r in warm)
+    med_c = statistics.median(compile_s)
+    med_f = statistics.median(fetch_s)
+    return {
+        "ok": identical and (warm_zero_compile or chaos),
+        "identical": identical,
+        "warm_zero_compile": warm_zero_compile,
+        "chaos_plan": chaos,
+        "speedup": med_c / max(med_f, 1e-9),
+        "compile_bringup_seconds": med_c,
+        "fetch_bringup_seconds": med_f,
+        "seed": seed,
+        "warm_fetched": [r["fetched_executable_count"] for r in warm],
+    }
+
+
+def _smoke_aot_clause() -> "tuple[bool, dict]":
+    """AOT cold-start smoke (docs/AOT.md): one seed + one paired
+    fresh-process rep on the bundled corpus, rc-gated on verdict-plane
+    identity across every arm AND on the warm fetch compiling nothing
+    (relaxed to identity-only under an armed chaos fault plan, whose
+    aot.fetch/aot.put injections force the documented fallback)."""
+    rec = bench_aot_coldstart(reps=1)
+    ok = bool(rec.get("ok"))
+    if not ok:
+        log(f"!!! AOT smoke FAILED: {rec}")
+    return ok, rec
+
+
 def run_smoke() -> int:
     """CI-fast pipeline A/B (tools/preflight.sh): bundled corpus,
     tiny batches, no subprocess phases. Honors SWARM_PIPELINE as the
@@ -2152,6 +2379,23 @@ def run_smoke() -> int:
         "bundled-corpus smoke)",
         ded["speedup"],
         extra={"dedup": ded},
+    )
+    # AOT cold-start smoke (docs/AOT.md): fresh-process fetch-vs-
+    # compile bring-up over a file-backed store — rc-gated on verdict
+    # identity across every arm, and on the warm fetch compiling
+    # nothing (identity-only under the chaos plan, whose aot.* faults
+    # force the documented compile fallback)
+    aot_ok, aot_rec = _smoke_aot_clause()
+    ok = ok and aot_ok
+    emit(
+        "smoke_aot_coldstart_speedup",
+        aot_rec.get("speedup", 0.0),
+        "x (fresh-process compile vs warm-fetch bring-up, "
+        "bundled-corpus smoke)",
+        aot_rec.get("speedup", 0.0),
+        extra={
+            "aot": {k: v for k, v in aot_rec.items() if k != "seed"}
+        },
     )
     # gateway smoke (docs/GATEWAY.md): 3 tenants (one rate-limited)
     # against a real server + worker — rc-gated on cross-tenant verdict
@@ -2254,7 +2498,7 @@ def run_smoke() -> int:
 #: synthesizes never delays the headline.
 PHASES = [
     "service", "service_full", "streaming", "jarm", "device", "sharded",
-    "oracle", "exact",
+    "aot", "oracle", "exact",
 ]
 
 

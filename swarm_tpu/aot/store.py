@@ -1,0 +1,580 @@
+"""Artifact store + breaker-wrapped client for the AOT executable cache.
+
+Storage rides the same Redis/S3-role pair as the result tier
+(docs/CACHING.md), under its own ``swarm:aot`` namespace with the SAME
+epoch + fencing-token discipline (:class:`AotStore` subclasses the
+tier): artifact payloads (serialized executables, binary, potentially
+MBs) always live in the BLOB store; the state store holds a small
+JSON index entry per artifact so a joining worker can enumerate what
+is published for its program group without touching a single blob.
+
+Key schema (docs/AOT.md): every artifact digest is sha256 over
+
+- the **program group** — kernel source salt (:func:`kernel_code_salt`)
+  + the jax/jaxlib/XLA environment (:func:`jax_fingerprint`): a jaxlib
+  upgrade or device change can never load a stale binary;
+- the **kernel id** (``dd.A`` / ``dd.B`` / ``dd.fused`` / ``sh.*`` for
+  the mesh twins) + the trace salt (layout metadata, candidate budget,
+  mesh shape — everything the traced program depends on besides array
+  shapes);
+- the **static args** (the phase-B ladder rung ``kc``, full/donate
+  flags) and the **aval signature** of every argument (shapes/dtypes —
+  the corpus-FREE program still has corpus-SIZED argument shapes).
+
+Corpus *content* is deliberately absent: the PR 3 argument convention
+made the programs corpus-free, so one published executable serves
+every corpus whose layout SHAPES match — a corpus refresh that keeps
+shapes does not even miss. The epoch exists for the operator
+"poisoned artifact" lever: ``bump_epoch`` moves every reader/writer
+to a fresh namespace (docs/AOT.md runbook), exactly like the result
+tier.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Optional
+
+from swarm_tpu.cache.tier import SharedResultTier, _process_token
+from swarm_tpu.telemetry.aot_export import (
+    AOT_ARTIFACT_BYTES,
+    AOT_BRINGUP_SECONDS,
+    AOT_FETCHES,
+    AOT_PUBLISHES,
+)
+
+#: wire format version — salts every digest AND prefixes every
+#: payload, so a serialization change can never load stale artifacts
+_FORMAT = b"swarm-aot-v1"
+
+#: kernel source files whose bytes salt the program group: any edit to
+#: the traced programs (or the layout builder that shapes their
+#: arguments) invalidates every published artifact. Relative to the
+#: repo's ``swarm_tpu`` package directory.
+_KERNEL_FILES = (
+    "ops/match.py",
+    "ops/regexdev.py",
+    "ops/md5.py",
+    "ops/hashing.py",
+    "ops/encoding.py",
+    "fingerprints/compile.py",
+    "parallel/sharded.py",
+)
+
+
+def kernel_code_salt() -> str:
+    """sha256 hex over the kernel/layout source files — the "same
+    traced program" half of the program group."""
+    import pathlib
+
+    h = hashlib.sha256(_FORMAT)
+    pkg = pathlib.Path(__file__).resolve().parent.parent
+    for name in _KERNEL_FILES:
+        h.update(name.encode())
+        try:
+            h.update((pkg / name).read_bytes())
+        except OSError:
+            h.update(b"?")
+    return h.hexdigest()
+
+
+def jax_fingerprint() -> str:
+    """The jax/jaxlib/XLA environment an executable is only valid in:
+    versions, backend platform, device kind and count, and the XLA
+    flags that shape codegen (``XLA_FLAGS`` carries e.g. the forced
+    host-platform device count). A serialized executable is a compiled
+    binary — loading it under ANY other fingerprint is undefined, so
+    the fingerprint rides the digest and a mismatch is a clean miss."""
+    import os
+
+    import jax
+    import jaxlib
+    import numpy as np
+
+    devs = jax.devices()
+    return "|".join(
+        (
+            jax.__version__,
+            jaxlib.__version__,
+            np.__version__,
+            devs[0].platform,
+            getattr(devs[0], "device_kind", "?"),
+            str(len(devs)),
+            str(jax.process_count()),
+            os.environ.get("XLA_FLAGS", ""),
+        )
+    )
+
+
+class AotStore(SharedResultTier):
+    """Artifact store over the state/blob role pair — the result
+    tier's epoch + fencing plumbing (inherited) with an artifact data
+    plane: payloads in the blob store, JSON index entries in the state
+    hash ``{prefix}:x:{epoch}``, blob keys ``aot/{epoch}/{digest}``."""
+
+    _INDEX_FAMILY = "x"
+
+    def __init__(self, state, blobs, prefix: str = "swarm:aot"):
+        if blobs is None:
+            raise ValueError("AotStore needs a blob store for payloads")
+        super().__init__(state, blobs, prefix=prefix)
+
+    def _index_name(self, epoch: str) -> str:
+        return self._hash_name(self._INDEX_FAMILY, epoch)
+
+    def _artifact_key(self, epoch: str, digest: str) -> str:
+        return f"aot/{epoch}/{digest}"
+
+    def list_index(self, epoch: str) -> dict:
+        """digest → raw JSON index entry for every published artifact
+        in one epoch namespace (the prewarm enumeration — one hgetall,
+        no blob traffic)."""
+        return self._state.hgetall(self._index_name(epoch))
+
+    def get_artifact(
+        self, epoch: str, digest: str
+    ) -> Optional[tuple[str, bytes]]:
+        """→ (index entry, payload bytes) or None. A live index entry
+        whose blob vanished is a miss (same rule as the tier's spilled
+        values)."""
+        meta = self._state.hget(self._index_name(epoch), digest)
+        if meta is None:
+            return None
+        try:
+            payload = self._blobs.get(self._artifact_key(epoch, digest))
+        except Exception:
+            return None
+        return meta, payload
+
+    def put_artifact(
+        self, epoch: str, digest: str, meta: str, payload: bytes,
+        writer_id: str, token: int,
+    ) -> str:
+        """Publish one artifact under the writer's fencing token —
+        checked BEFORE the write (stale-writer reject) and AGAIN after
+        it (a writer superseded mid-write learns it was fenced). The
+        payload blob lands before the index entry, so a reader can
+        never see an index entry whose blob is still in flight; the
+        mid-write bytes are not unwound for the same reason as the
+        result tier's (docs/CACHING.md): within an epoch an artifact
+        is a pure function of its digest, so a superseded same-epoch
+        writer's bytes are identical to the live successor's."""
+        if self.writer_token(writer_id) != token:
+            return "fenced"
+        self._blobs.put(self._artifact_key(epoch, digest), payload)
+        self._state.hset(self._index_name(epoch), digest, meta)
+        if self.writer_token(writer_id) != token:
+            return "fenced"
+        return "stored"
+
+
+class AotClient:
+    """A worker's view of the artifact store: epoch-bound, breaker-
+    wrapped, telemetry-counted — the exact contract of the result
+    tier's client (docs/CACHING.md): a dead/slow backend trips the
+    breaker and every lookup degrades to "compile locally", it never
+    blocks a dispatch. Chaos levers ``aot.fetch`` / ``aot.put``
+    (docs/RESILIENCE.md) inject that failure mode.
+
+    Loaded executables live in a process-wide **pool** (digest →
+    loaded callable): :meth:`prewarm` fills it from the store index at
+    engine bring-up, and :class:`~swarm_tpu.aot.jitcache.AotJit`
+    consults it before touching the store on the dispatch path.
+
+    Thread contract: dispatch (scheduler submit thread), a degraded
+    batch's retry (walk worker) and prewarm (bring-up) can all reach
+    the client — the pool and counters sit under ``_lock``.
+    """
+
+    #: loaded-executable pool bound: dict order is insertion order, the
+    #: oldest entries drop past the cap — the same bounded-RSS rule the
+    #: per-wrapper AotJit LRU enforces (an evicted executable simply
+    #: re-fetches from the store if that shape comes back)
+    _POOL_MAX = 128
+
+    def __init__(
+        self,
+        store: AotStore,
+        worker_id: str = "worker",
+        publish: bool = True,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 30.0,
+    ):
+        from swarm_tpu.resilience.breaker import CircuitBreaker
+
+        self._store = store
+        self._worker_id = worker_id
+        self.publish_enabled = bool(publish)
+        self._breaker = CircuitBreaker(
+            f"aot.store.{worker_id}",
+            threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s,
+        )
+        self._lock = threading.Lock()  # guards: _pool (reads), _counters, _group, _epoch, _epoch_read_at, _warned
+        self._pool: dict = {}
+        self._counters = {
+            "fetch_hits": 0,
+            "fetch_misses": 0,
+            "deserialize_errors": 0,
+            "published": 0,
+            "publish_fenced": 0,
+            "prewarmed": 0,
+        }
+        self._group: Optional[str] = None
+        self._epoch: Optional[str] = None
+        self._epoch_read_at = 0.0
+        self._warned = False
+
+    # -- identity ------------------------------------------------------
+    #: how long a read epoch is trusted before the generation counter
+    #: is re-read — the propagation ceiling for an operator
+    #: ``bump_epoch`` on a live fleet (docs/AOT.md runbook)
+    _EPOCH_TTL_S = 60.0
+
+    def group(self) -> str:
+        """The program group digest (code salt + jax fingerprint) —
+        computed once; everything published/fetched by this process
+        lives under it."""
+        with self._lock:
+            if self._group is None:
+                h = hashlib.sha256(_FORMAT)
+                h.update(kernel_code_salt().encode())
+                h.update(jax_fingerprint().encode())
+                self._group = h.hexdigest()[:24]
+            return self._group
+
+    def key_digest(self, kernel_id: str, salt: str, static_repr: str,
+                   aval_sig: str) -> str:
+        """The full artifact digest for one (kernel, statics, shapes)
+        triple under this process's program group."""
+        h = hashlib.sha256(_FORMAT)
+        for part in (self.group(), kernel_id, salt, static_repr, aval_sig):
+            h.update(part.encode())
+            h.update(b"\x00")
+        return h.hexdigest()
+
+    def _epoch_name(self) -> Optional[str]:
+        now = time.monotonic()
+        with self._lock:
+            if (
+                self._epoch is not None
+                and now - self._epoch_read_at < self._EPOCH_TTL_S
+            ):
+                return self._epoch
+        gen = self._guarded(
+            "aot.fetch", "epoch", self._store.epoch_generation
+        )
+        with self._lock:
+            if gen is None:
+                return self._epoch  # stale-by-≤TTL or None: degrade
+            self._epoch = f"g{gen}"
+            self._epoch_read_at = now
+            return self._epoch
+
+    # -- breaker plumbing ---------------------------------------------
+    def _guarded(self, point: str, detail: str, fn):
+        from swarm_tpu.resilience.faults import fault_point
+
+        br = self._breaker
+        if not br.allow():
+            return None
+        try:
+            fault_point(point, detail=detail)
+            out = fn()
+        except Exception as e:
+            br.record_failure()
+            with self._lock:
+                warn = not self._warned
+                self._warned = True
+            if warn:
+                print(
+                    f"AOT executable cache degraded to compile-only "
+                    f"({type(e).__name__}: {e}) "
+                    f"[breaker {br.name}: {br.state}]"
+                )
+            return None
+        br.record_success()
+        with self._lock:
+            self._warned = False
+        return out
+
+    # requires-lock: _lock (every caller inserts under the lock)
+    def _pool_put(self, digest: str, loaded) -> None:
+        self._pool.pop(digest, None)
+        while len(self._pool) >= self._POOL_MAX:
+            self._pool.pop(next(iter(self._pool)))
+        self._pool[digest] = loaded
+
+    # -- fetch path ----------------------------------------------------
+    def fetch_loaded(self, digest: str):
+        """→ a loaded executable for ``digest``, or None (miss /
+        degraded / deserialize failure — the caller compiles). Pool
+        hits never touch the store; store hits are deserialized here
+        and pooled for any later same-shape kernel."""
+        with self._lock:
+            loaded = self._pool.get(digest)
+            if loaded is not None:
+                self._counters["fetch_hits"] += 1
+        if loaded is not None:
+            AOT_FETCHES.labels(outcome="hit").inc(1)
+            return loaded
+        epoch = self._epoch_name()
+        if epoch is None:
+            return None
+        got = self._guarded(
+            "aot.fetch", "artifact",
+            lambda: self._store.get_artifact(epoch, digest),
+        )
+        if got is None:
+            with self._lock:
+                self._counters["fetch_misses"] += 1
+            AOT_FETCHES.labels(outcome="miss").inc(1)
+            return None
+        _meta, payload = got
+        t0 = time.perf_counter()
+        loaded = self._load_payload(payload)
+        if loaded is None:
+            with self._lock:
+                self._counters["deserialize_errors"] += 1
+            AOT_FETCHES.labels(outcome="deserialize_error").inc(1)
+            return None
+        AOT_BRINGUP_SECONDS.labels(source="fetch").observe(
+            time.perf_counter() - t0
+        )
+        AOT_ARTIFACT_BYTES.set(len(payload))
+        with self._lock:
+            self._counters["fetch_hits"] += 1
+            self._pool_put(digest, loaded)
+        AOT_FETCHES.labels(outcome="hit").inc(1)
+        return loaded
+
+    def _load_payload(self, payload: bytes):
+        """Deserialize one artifact; None on ANY failure (foreign
+        topology, corrupt bytes, version skew) — a bad artifact must
+        cost a compile, never an exception on the dispatch path."""
+        from swarm_tpu.aot.jitcache import load_compiled
+
+        try:
+            return load_compiled(payload)
+        except Exception:
+            return None
+
+    def _load_verify(self, payload: bytes) -> None:
+        """Raise if ``payload`` does not deserialize on this backend
+        (the publish gate; the loaded probe is discarded)."""
+        from swarm_tpu.aot.jitcache import load_compiled
+
+        load_compiled(payload)
+
+    def note_compile_seconds(self, seconds: float) -> None:
+        """Record a local compile on the AOT-managed path (the miss
+        arm of the bring-up histogram)."""
+        AOT_BRINGUP_SECONDS.labels(source="compile").observe(seconds)
+
+    # -- publish path --------------------------------------------------
+    def publish(self, digest: str, meta: dict, compiled) -> str:
+        """Serialize + publish one locally compiled executable.
+        Returns the outcome (``stored`` / ``fenced`` / ``error`` /
+        ``disabled``); failures are counted and swallowed — publishing
+        is strictly best-effort."""
+        import json
+
+        from swarm_tpu.aot.jitcache import serialize_compiled
+
+        if not self.publish_enabled:
+            return "disabled"
+        epoch = self._epoch_name()
+        if epoch is None:
+            AOT_PUBLISHES.labels(outcome="error").inc(1)
+            return "error"
+        try:
+            payload = serialize_compiled(compiled)
+            # round-trip verification: a payload that cannot load HERE
+            # cannot load anywhere (same topology) — publishing it
+            # would poison the store with deserialize_error misses for
+            # every joining worker. Load cost is milliseconds next to
+            # the compile that just happened.
+            self._load_verify(payload)
+        except Exception:
+            # some executables don't serialize (backend-dependent);
+            # they simply stay process-local
+            AOT_PUBLISHES.labels(outcome="error").inc(1)
+            return "error"
+        meta = dict(meta)
+        meta["g"] = self.group()
+        meta["n"] = len(payload)
+        writer = f"{self._worker_id}:aot"
+        body = json.dumps(meta, separators=(",", ":"))
+
+        def put():
+            token = _process_token(self._store, writer)
+            return self._store.put_artifact(
+                epoch, digest, body, payload, writer, token
+            )
+
+        out = self._guarded("aot.put", "artifact", put)
+        if out is None:
+            AOT_PUBLISHES.labels(outcome="error").inc(1)
+            return "error"
+        with self._lock:
+            if out == "stored":
+                self._counters["published"] += 1
+            else:
+                self._counters["publish_fenced"] += 1
+            # the compiled object IS the loaded form — pool it so a
+            # sibling engine in this process fetches without the store
+            self._pool_put(digest, compiled)
+        AOT_PUBLISHES.labels(outcome=out).inc(1)
+        AOT_ARTIFACT_BYTES.set(len(payload))
+        return out
+
+    # -- bring-up ------------------------------------------------------
+    def prewarm(self) -> int:
+        """Load every artifact published for this process's program
+        group into the pool (worker bring-up: fetch-and-load INSTEAD
+        of compiling — docs/AOT.md). Artifacts that fail to load are
+        counted and skipped; a dead store prewarms nothing. Returns
+        the number of executables now pooled."""
+        import json
+
+        epoch = self._epoch_name()
+        if epoch is None:
+            return 0
+        index = self._guarded(
+            "aot.fetch", "index", lambda: self._store.list_index(epoch)
+        )
+        if not index:
+            return 0
+        group = self.group()
+        n = 0
+        for digest, raw in index.items():
+            try:
+                meta = json.loads(raw)
+            except ValueError:
+                continue
+            if meta.get("g") != group:
+                continue
+            with self._lock:
+                if digest in self._pool:
+                    n += 1
+                    continue
+            got = self._guarded(
+                "aot.fetch", "artifact",
+                lambda d=digest: self._store.get_artifact(epoch, d),
+            )
+            if got is None:
+                continue
+            t0 = time.perf_counter()
+            loaded = self._load_payload(got[1])
+            if loaded is None:
+                with self._lock:
+                    self._counters["deserialize_errors"] += 1
+                AOT_FETCHES.labels(outcome="deserialize_error").inc(1)
+                continue
+            AOT_BRINGUP_SECONDS.labels(source="fetch").observe(
+                time.perf_counter() - t0
+            )
+            with self._lock:
+                self._pool_put(digest, loaded)
+                self._counters["prewarmed"] += 1
+            n += 1
+        return n
+
+    def counters(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out["pool"] = len(self._pool)
+            out["breaker"] = self._breaker.state
+            return out
+
+
+# ---------------------------------------------------------------------------
+# Factory
+# ---------------------------------------------------------------------------
+
+_FACTORY_LOCK = threading.Lock()
+_MEMORY_STORE: Optional[AotStore] = None  # guarded-by: _FACTORY_LOCK (reads)
+#: one store object per backend location in this process — the fencing
+#: registry is keyed per store OBJECT (cache.tier._process_token), so
+#: same-identity clients must share the instance (docs/CACHING.md)
+_SHARED_STORES: dict = {}  # guarded-by: _FACTORY_LOCK (reads)
+
+
+def _memory_store() -> AotStore:
+    global _MEMORY_STORE
+    from swarm_tpu.stores import MemoryBlobStore, MemoryStateStore
+
+    with _FACTORY_LOCK:
+        if _MEMORY_STORE is None:
+            _MEMORY_STORE = AotStore(MemoryStateStore(), MemoryBlobStore())
+        return _MEMORY_STORE
+
+
+def _local_store(root: str) -> AotStore:
+    from swarm_tpu.stores import LocalBlobStore, LocalStateStore
+
+    with _FACTORY_LOCK:
+        store = _SHARED_STORES.get(("local", root))
+        if store is None:
+            store = _SHARED_STORES[("local", root)] = AotStore(
+                LocalStateStore(f"{root}/state"),
+                LocalBlobStore(f"{root}/blobs"),
+            )
+        return store
+
+
+def _redis_store(url: str, blob_dir: str, s3_bucket: str) -> AotStore:
+    from swarm_tpu.stores import (
+        LocalBlobStore,
+        RedisStateStore,
+        S3BlobStore,
+    )
+
+    with _FACTORY_LOCK:
+        key = ("redis", url, blob_dir, s3_bucket)
+        store = _SHARED_STORES.get(key)
+        if store is None:
+            if s3_bucket:
+                blobs = S3BlobStore(s3_bucket)
+            else:
+                blobs = LocalBlobStore(blob_dir or "/tmp/swarm_aot_blobs")
+            store = _SHARED_STORES[key] = AotStore(
+                RedisStateStore(url), blobs
+            )
+        return store
+
+
+def build_aot_client(cfg) -> Optional[AotClient]:
+    """Construct the AOT client from a :class:`swarm_tpu.config.
+    Config` (``SWARM_AOT_*`` knobs); None when the cache is off.
+
+    Backends: ``memory`` (per-process, tests), ``local`` (file-backed
+    under ``aot_dir`` — cross-process on one host with zero side-cars;
+    the bench's fresh-process A/B rides this), ``redis`` (fleet-wide:
+    state via ``aot_url``/``redis_url``, payload blobs via the S3 role
+    when ``s3_bucket`` is set, else a shared directory)."""
+    backend = (getattr(cfg, "aot_backend", "off") or "off").lower()
+    if backend in ("off", "", "0", "none", "false"):
+        return None
+    if backend == "memory":
+        store = _memory_store()
+    elif backend == "local":
+        root = getattr(cfg, "aot_dir", "") or "/tmp/swarm_aot"
+        store = _local_store(root)
+    elif backend == "redis":
+        store = _redis_store(
+            getattr(cfg, "aot_url", "") or cfg.redis_url,
+            getattr(cfg, "aot_dir", ""),
+            getattr(cfg, "s3_bucket", ""),
+        )
+    else:
+        raise ValueError(f"unknown aot_backend {backend!r}")
+    return AotClient(
+        store,
+        worker_id=getattr(cfg, "worker_id", "worker"),
+        publish=getattr(cfg, "aot_publish", True),
+        breaker_threshold=getattr(cfg, "aot_breaker_threshold", 3),
+        breaker_cooldown_s=getattr(cfg, "aot_breaker_cooldown_s", 30.0),
+    )

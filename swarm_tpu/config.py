@@ -136,6 +136,31 @@ class Config:
     cache_ttl_s: float = 0.0
     cache_max_entries: int = 0
 
+    # --- AOT executable cache (docs/AOT.md) ---
+    # ship serialized XLA executables through the shared stores so a
+    # joining worker fetches instead of compiling: "off" (default)
+    # keeps today's per-process compile path; "memory" shares one
+    # embedded store across this process's engines (tests); "local"
+    # is file-backed under aot_dir (cross-process on one host, zero
+    # side-cars); "redis" goes fleet-wide (state via aot_url/
+    # redis_url, payload blobs via the S3 role when s3_bucket is set,
+    # else a shared directory). Env: SWARM_AOT_BACKEND.
+    aot_backend: str = "off"
+    # store Redis URL ("" = reuse redis_url)
+    aot_url: str = ""
+    # artifact directory for the local backend / redis blob side
+    aot_dir: str = ""
+    # publish locally compiled executables back to the store (off =
+    # read-only consumer)
+    aot_publish: bool = True
+    # fetch-and-load every published same-group executable at engine
+    # bring-up (the cold-start win; off = lazy per-dispatch fetch)
+    aot_prewarm: bool = True
+    # breaker around every store op: a dead backend degrades to
+    # compile-only, it never blocks a dispatch
+    aot_breaker_threshold: int = 3
+    aot_breaker_cooldown_s: float = 30.0
+
     # --- multi-tenant gateway (docs/GATEWAY.md) ---
     # per-tenant token bucket: submissions/second refill (0 = unlimited,
     # the single-operator default) and burst capacity

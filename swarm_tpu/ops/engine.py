@@ -91,6 +91,10 @@ class EngineStats:
     # corpus-as-arguments kernel makes this corpus-size-free)
     device_compile_seconds: float = 0.0
     device_compiles: int = 0
+    # AOT executable-cache fetch twin (docs/AOT.md): dispatches that
+    # LOADED a published executable instead of compiling it
+    device_fetch_seconds: float = 0.0
+    device_fetches: int = 0
     host_confirm_seconds: float = 0.0
     host_confirm_pairs: int = 0
     host_always_pairs: int = 0
@@ -313,6 +317,86 @@ class MatchEngine:
         self.sharded = None
         self.mesh = None
         self._candidate_k = candidate_k
+        # every db-derived lookup table lives in _bind_db so a live
+        # corpus refresh (refresh_corpus, docs/AOT.md) can re-derive
+        # them against the new CompiledDB without rebuilding the engine
+        self._bind_db()
+        # content-keyed extraction memo (cross-batch): scan responses
+        # repeat heavily (default pages are byte-identical fleet-wide)
+        # and tech templates with version extractors fire on most rows,
+        # so re-running the same regex/kval over the same bytes per row
+        # dominated the host walk. Keyed per EXTRACTOR on exactly the
+        # content it reads; bounded FIFO (keys hold the part bytes).
+        self._ext_cache: dict = {}
+        # cross-batch confirm memo for part-keyed matcher types
+        # (word/regex/binary/size) — same bounding as _ext_cache
+        self._confirm_cache: dict = {}
+        # cross-batch VERDICT memo: content key -> (packed verdict row,
+        # extraction entries, deferred row-dependent template ids).
+        # Fleet batches repeat the same pages batch after batch; known
+        # content skips the encode, the device, and the host walk
+        # entirely. Entries are only stored for fully-resolved
+        # (non-truncated, non-overflow) content. Bounded FIFO.
+        self._verdict_memo: dict = {}
+        # C resident verdict cache (native/scanio.VerdictMemo) — the
+        # production form of _verdict_memo: its lookup pass serves
+        # known rows straight into the batch's bits plane with no
+        # per-row Python work. Lazily created on first encode so
+        # oracle-only engines stay native-free; the dict memo remains
+        # the no-toolchain fallback.
+        self._vmemo = None
+        self._native_memo_ok = None
+        # fleet-wide shared result tier (docs/CACHING.md): when a
+        # ResultCacheClient is attached, the memos above become the L1
+        # in front of it — lookups go L1 → shared tier → device, fresh
+        # walk results batch-write back after finish_packed, and the
+        # batched walk's confirm cache promotes into the tier's second
+        # value family. None (the default) keeps every path unchanged.
+        self._result_cache = None
+        # AOT executable cache (docs/AOT.md): when an AotClient is
+        # attached, the device/sharded matchers fetch published
+        # serialized executables before compiling and publish what
+        # they compile. None (the default) keeps the compile path.
+        self._aot_client = None
+        # row ids the scheduler's prefetch stage already consulted the
+        # shared tier for (hits landed in the L1, misses are
+        # suppressed client-side): the encode-time consult skips them
+        # so a fresh row's content is sha256'd once per batch, not
+        # twice. id() keys are safe here because a stale entry can
+        # only SKIP a consult (the row is computed locally) — it can
+        # never serve wrong data. Bounded FIFO via _cache_put.
+        self._shared_seen: dict = {}
+        # recycled verdict planes for reuse_buffers encodes, keyed PER
+        # SHAPE (see _encode_native): alternating batch shapes (bucket
+        # scheduler, partial final chunks) each keep their own depth-8
+        # rotation instead of re-allocating 8 planes on every change
+        self._bits_pool = _RotatingPool(depth=8)
+        # device-degraded mode (docs/RESILIENCE.md): a device-path
+        # failure (XLA compile error, OOM, persistent-cache corruption
+        # — or an injected device.dispatch fault) trips a per-shape-
+        # class breaker and the batch falls back to the exact CPU
+        # oracle; verdicts stay bit-identical, only throughput
+        # degrades. The breaker cooldown periodically retries the
+        # device path, so a transient fault self-heals.
+        from swarm_tpu.resilience.breaker import BreakerBoard
+
+        self._device_breakers = BreakerBoard(
+            "engine.device",
+            threshold=device_breaker_threshold,
+            cooldown_s=device_breaker_cooldown_s,
+        )
+        # export this engine's stats to /metrics: weakref-tracked, read
+        # only at scrape time — zero cost on the match hot path
+        from swarm_tpu.telemetry.engine_export import register_engine
+
+        register_engine(self)
+
+    def _bind_db(self) -> None:
+        """Derive every db-indexed lookup table the walk and the
+        sparse-confirmation paths read (provenance maps, extractor
+        plans, CSR op->matcher tables). Called from __init__ and again
+        by :meth:`refresh_corpus` after a corpus-delta swap — the
+        tables are pure functions of ``self.db``."""
         db = self.db
         # device matcher/op id → source objects for sparse confirmation.
         # m == -1 is a synthesized extraction prefilter (extractor-only
@@ -432,51 +516,6 @@ class MatchEngine:
         ]
         self._op_prefilter_py = [bool(x) for x in db.op_prefilter]
         self._op_cond_and_py = [bool(x) for x in db.op_cond_and]
-        # content-keyed extraction memo (cross-batch): scan responses
-        # repeat heavily (default pages are byte-identical fleet-wide)
-        # and tech templates with version extractors fire on most rows,
-        # so re-running the same regex/kval over the same bytes per row
-        # dominated the host walk. Keyed per EXTRACTOR on exactly the
-        # content it reads; bounded FIFO (keys hold the part bytes).
-        self._ext_cache: dict = {}
-        # cross-batch confirm memo for part-keyed matcher types
-        # (word/regex/binary/size) — same bounding as _ext_cache
-        self._confirm_cache: dict = {}
-        # cross-batch VERDICT memo: content key -> (packed verdict row,
-        # extraction entries, deferred row-dependent template ids).
-        # Fleet batches repeat the same pages batch after batch; known
-        # content skips the encode, the device, and the host walk
-        # entirely. Entries are only stored for fully-resolved
-        # (non-truncated, non-overflow) content. Bounded FIFO.
-        self._verdict_memo: dict = {}
-        # C resident verdict cache (native/scanio.VerdictMemo) — the
-        # production form of _verdict_memo: its lookup pass serves
-        # known rows straight into the batch's bits plane with no
-        # per-row Python work. Lazily created on first encode so
-        # oracle-only engines stay native-free; the dict memo remains
-        # the no-toolchain fallback.
-        self._vmemo = None
-        self._native_memo_ok = None
-        # fleet-wide shared result tier (docs/CACHING.md): when a
-        # ResultCacheClient is attached, the memos above become the L1
-        # in front of it — lookups go L1 → shared tier → device, fresh
-        # walk results batch-write back after finish_packed, and the
-        # batched walk's confirm cache promotes into the tier's second
-        # value family. None (the default) keeps every path unchanged.
-        self._result_cache = None
-        # row ids the scheduler's prefetch stage already consulted the
-        # shared tier for (hits landed in the L1, misses are
-        # suppressed client-side): the encode-time consult skips them
-        # so a fresh row's content is sha256'd once per batch, not
-        # twice. id() keys are safe here because a stale entry can
-        # only SKIP a consult (the row is computed locally) — it can
-        # never serve wrong data. Bounded FIFO via _cache_put.
-        self._shared_seen: dict = {}
-        # recycled verdict planes for reuse_buffers encodes, keyed PER
-        # SHAPE (see _encode_native): alternating batch shapes (bucket
-        # scheduler, partial final chunks) each keep their own depth-8
-        # rotation instead of re-allocating 8 planes on every change
-        self._bits_pool = _RotatingPool(depth=8)
         # ROW-dependent templates: verdicts/extractions that read
         # beyond the response content (host/port/duration dsl vars,
         # part "host") — e.g. the takeover family's
@@ -505,25 +544,7 @@ class MatchEngine:
         self._rowdep_mask = np.zeros(db.num_templates, dtype=np.uint8)
         for i in self._rowdep_t:
             self._rowdep_mask[i] = 1
-        # device-degraded mode (docs/RESILIENCE.md): a device-path
-        # failure (XLA compile error, OOM, persistent-cache corruption
-        # — or an injected device.dispatch fault) trips a per-shape-
-        # class breaker and the batch falls back to the exact CPU
-        # oracle; verdicts stay bit-identical, only throughput
-        # degrades. The breaker cooldown periodically retries the
-        # device path, so a transient fault self-heals.
-        from swarm_tpu.resilience.breaker import BreakerBoard
 
-        self._device_breakers = BreakerBoard(
-            "engine.device",
-            threshold=device_breaker_threshold,
-            cooldown_s=device_breaker_cooldown_s,
-        )
-        # export this engine's stats to /metrics: weakref-tracked, read
-        # only at scrape time — zero cost on the match hot path
-        from swarm_tpu.telemetry.engine_export import register_engine
-
-        register_engine(self)
 
     _EXT_CACHE_MAX = 16384
 
@@ -1250,6 +1271,10 @@ class MatchEngine:
 
             self.sharded = ShardedMatcher(self.db, mesh, candidate_k=self._candidate_k)
             self.mesh = mesh
+            if self._aot_client is not None:
+                # the sharded matcher is built lazily — a client
+                # attached before backend resolution lands here
+                self.sharded.attach_aot(self._aot_client)
         self._backend_ready = True
 
     # ------------------------------------------------------------------
@@ -2115,6 +2140,10 @@ class MatchEngine:
             matcher, "compile_seconds", 0.0
         )
         self.stats.device_compiles = getattr(matcher, "compile_count", 0)
+        self.stats.device_fetch_seconds = getattr(
+            matcher, "fetch_seconds", 0.0
+        )
+        self.stats.device_fetches = getattr(matcher, "fetch_count", 0)
         # rows needing whole-row reconfirmation (candidate overflow or
         # stream truncation made word bits unsound for the row)
         row_redo = overflow | _rows_view(batch.truncated)
@@ -2878,6 +2907,85 @@ class MatchEngine:
 
             client.bind_corpus(corpus_digest(self.templates))
         self._result_cache = client
+
+    def attach_aot(self, client) -> None:
+        """Attach an AOT executable-cache client
+        (:class:`swarm_tpu.aot.AotClient`) to whichever device backend
+        serves this engine — the single-device :class:`DeviceDB` now,
+        and the sharded matcher when backend resolution builds it
+        (docs/AOT.md). ``None`` detaches."""
+        self._aot_client = client
+        self.device.attach_aot(client)
+        if self.sharded is not None:
+            self.sharded.attach_aot(client)
+
+    def aot_prewarm(self) -> int:
+        """Bring-up fetch: resolve the backend, then pool every
+        published executable for this process's program group so the
+        first dispatch of each published shape class loads instead of
+        compiling (worker/runtime.py calls this right after engine
+        construction). Returns the pooled executable count."""
+        if self._aot_client is None:
+            return 0
+        if not self._backend_ready:
+            self._resolve_backend()
+        backend = self.sharded if self.sharded is not None else self.device
+        return backend.aot_prewarm()
+
+    def refresh_corpus(self, templates_new, db_new=None) -> dict:
+        """Zero-downtime corpus refresh against a LIVE engine
+        (docs/AOT.md): delta-compile the new template list against the
+        current CompiledDB (unchanged word tables adopted by identity,
+        only the touched stacked-table rows rebuilt), upload only the
+        changed layout leaves, re-derive the db-indexed lookup tables,
+        drop every content-keyed memo (matcher/op indices renumber and
+        plane widths can change — stale entries would be wrong, not
+        slow), and move the shared result tier to the new corpus
+        epoch with ONE ``bind_corpus`` call. When the trace signature
+        survives the refresh, the live executables keep serving and
+        the next batch pays only the delta uploads — no layout
+        rebuild, no recompile.
+
+        Caller contract: quiesce first — no batch may be in flight
+        (dispatched-not-collected, or inside the scheduler's
+        in-flight window) across this call.
+
+        ``db_new``: optional precompiled CompiledDB for
+        ``templates_new`` (e.g. from ``fingerprints/dbcache``); it is
+        delta-layouted against the current db either way. Returns the
+        combined delta stats."""
+        from swarm_tpu.fingerprints.compile import (
+            build_device_layout_delta,
+            compile_corpus_delta,
+        )
+
+        stats: dict = {}
+        if db_new is None:
+            db_new, stats = compile_corpus_delta(
+                list(templates_new), self.db
+            )
+        else:
+            build_device_layout_delta(db_new, self.db, stats)
+        self.templates = list(templates_new)
+        self.db = db_new
+        self._bind_db()
+        stats.update(self.device.update_layout(db_new))
+        if self.sharded is not None:
+            stats["sharded"] = self.sharded.refresh(db_new)
+        # stale-corpus state: every content-keyed memo maps content →
+        # verdicts/indices of the OLD corpus — invalid, not just cold
+        self._verdict_memo.clear()
+        self._vmemo = None  # recreated lazily at the new plane width
+        self._ext_cache.clear()
+        self._confirm_cache.clear()
+        self._shared_seen.clear()
+        # shared result tier: ONE namespace move — the epoch's digest
+        # half covers the corpus content + lowering code
+        if self._result_cache is not None:
+            from swarm_tpu.cache.tier import corpus_digest
+
+            self._result_cache.bind_corpus(corpus_digest(self.templates))
+        return stats
 
     def _ensure_vmemo(self, nbits: int):
         """The C resident verdict cache, created on first need (both

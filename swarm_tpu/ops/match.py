@@ -299,14 +299,23 @@ class DeviceDB:
         )
         self.compile_seconds = 0.0  # guarded-by: _counter_lock
         self.compile_count = 0  # guarded-by: _counter_lock
+        #: AOT executable-cache twin of the compile spy (docs/AOT.md):
+        #: dispatches that LOADED at least one published executable
+        #: instead of compiling it, and the wall time those loads took
+        #: at the dispatch boundary — counted distinctly so the
+        #: compile-count spy stays honest on the fetch path
+        self.fetch_seconds = 0.0  # guarded-by: _counter_lock
+        self.fetch_count = 0  # guarded-by: _counter_lock
         #: most recent compacted dispatch: survivor_max / verify_k /
         #: budget (the "phase B launches at survivor size" evidence —
         #: bench and tools/profile_device surface it)
         self.last_compact: dict = {}  # guarded-by: _counter_lock
         self.staging = _StagingPool()
         self._counter_lock = threading.Lock()
+        self._aot = None  # AotClient (attach_aot) — None = compile-only
         self._meta = None
         self._arrays = None  # device-resident argument pytree
+        self._arrays_np = None  # host twin of _arrays (delta refresh)
         # full flag -> fused jit fn (legacy arm); "A" -> phase A;
         # ("B", full, donate_streams) -> phase B. Writes only under the
         # lock; the double-checked fast-path .get() reads are benign
@@ -318,9 +327,168 @@ class DeviceDB:
         if self._arrays is None:
             meta, arrays_np = fpc.build_device_layout(self.db)
             self._meta = meta
+            self._arrays_np = arrays_np
             # upload once; jnp.asarray leaves numpy → device committed
             self._arrays = jax.tree_util.tree_map(jnp.asarray, arrays_np)
         return self._meta, self._arrays
+
+    # -- AOT executable cache (docs/AOT.md) ----------------------------
+    def attach_aot(self, client) -> None:
+        """Attach an :class:`~swarm_tpu.aot.AotClient`: every
+        subsequently built kernel wrapper becomes an
+        :class:`~swarm_tpu.aot.AotJit` that fetches published
+        executables before compiling (and publishes what it compiles).
+        Live wrappers are dropped so the attach takes effect at the
+        next dispatch; ``None`` detaches."""
+        with self._counter_lock:
+            self._aot = client
+            self._fn_cache.clear()
+
+    def _trace_salt(self, db=None, meta=None) -> str:
+        """Everything the traced programs depend on besides argument
+        shapes (the aval signature covers those) and the corpus BYTES
+        (corpus-free by the PR 3 argument convention): layout metadata
+        and the static ints the kernel closures read off ``db``."""
+        if db is None:
+            db = self.db
+        if meta is None:
+            meta, _ = self._ensure_layout()
+        return repr(
+            (
+                meta,
+                self.candidate_k,
+                db.num_slots,
+                db.num_templates,
+                int(db.op_src.shape[0]),
+                int(db.m_src.shape[0]),
+                int(db.rx_seq_always.sum()),
+            )
+        )
+
+    def _layout_signature(self, db, meta, arrays_np) -> tuple:
+        """The full trace signature of one (db, layout) pair: the
+        trace salt plus every layout leaf's (path, shape, dtype). Two
+        equal signatures lower IDENTICAL programs, so the live
+        executables can keep serving across a corpus refresh — the
+        corpus rides the arguments (docs/DEVICE_MATCH.md), a verdict
+        can only depend on the array CONTENT the next dispatch
+        passes."""
+        leaves = jax.tree_util.tree_flatten_with_path(arrays_np)[0]
+        return (
+            self._trace_salt(db, meta),
+            tuple(
+                (
+                    jax.tree_util.keystr(p),
+                    tuple(leaf.shape),
+                    str(leaf.dtype),
+                )
+                for p, leaf in leaves
+            ),
+        )
+
+    def update_layout(self, db_new) -> dict:
+        """Zero-downtime corpus refresh (docs/AOT.md): swap in a new
+        CompiledDB, uploading ONLY the layout leaves the delta build
+        actually changed — a leaf adopted by object identity
+        (``compile.build_device_layout_delta``) keeps its existing
+        DEVICE array, no H2D transfer. When the trace signature is
+        unchanged (shapes and statics equal — e.g. a template EDIT
+        that keeps every width), the live executables keep serving
+        and the refresh costs only the changed uploads; otherwise the
+        wrapper cache drops and the next dispatch compiles or AOT-
+        fetches against the new shapes.
+
+        Caller contract: quiesce dispatches first (no batch in
+        flight) — the engine's :meth:`~swarm_tpu.ops.engine.
+        MatchEngine.refresh_corpus` is the supported entry point."""
+        meta_old, _ = self._ensure_layout()
+        old_np = self._arrays_np
+        meta_new, new_np = fpc.build_device_layout(db_new)
+        old_host = {
+            jax.tree_util.keystr(p): leaf
+            for p, leaf in jax.tree_util.tree_flatten_with_path(old_np)[0]
+        }
+        old_dev = {
+            jax.tree_util.keystr(p): leaf
+            for p, leaf in jax.tree_util.tree_flatten_with_path(
+                self._arrays
+            )[0]
+        }
+        flat_new, _ = jax.tree_util.tree_flatten_with_path(new_np)
+        uploaded = reused = 0
+        dev_leaves = []
+        for path, leaf in flat_new:
+            key = jax.tree_util.keystr(path)
+            if old_host.get(key) is leaf and key in old_dev:
+                dev_leaves.append(old_dev[key])
+                reused += 1
+            else:
+                dev_leaves.append(jnp.asarray(leaf))
+                uploaded += 1
+        new_dev = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(new_np), dev_leaves
+        )
+        keep = self._layout_signature(
+            self.db, meta_old, old_np
+        ) == self._layout_signature(db_new, meta_new, new_np)
+        with self._counter_lock:
+            self.db = db_new
+            self._meta = meta_new
+            self._arrays_np = new_np
+            self._arrays = new_dev
+            if not keep:
+                self._fn_cache.clear()
+        return {
+            "uploaded_leaves": uploaded,
+            "reused_leaves": reused,
+            "executables_kept": keep,
+        }
+
+    def _wrap_jit(
+        self, fun, kernel_id: str, static_argnums=(), donate_argnums=()
+    ):
+        """``jax.jit`` (no AOT client — today's path, bit-for-bit) or
+        the explicitly managed :class:`AotJit` twin."""
+        if self._aot is None:
+            return jax.jit(
+                fun,
+                static_argnums=static_argnums,
+                donate_argnums=donate_argnums,
+            )
+        from swarm_tpu.aot.jitcache import AotJit
+
+        return AotJit(
+            fun,
+            kernel_id=kernel_id,
+            salt=self._trace_salt(),
+            client=self._aot,
+            static_argnums=static_argnums,
+            donate_argnums=donate_argnums,
+            cap=4 * self.MAX_COMPILED,
+        )
+
+    def fetched_executable_count(self, full: bool = True) -> int:
+        """Live executables serving this DB that were LOADED from the
+        AOT cache instead of compiled — the fetch-path twin of
+        :meth:`executable_count` (which counts local compiles only).
+        Includes the standing phase-A kernel: a warm-fetch bring-up
+        should compile nothing at all."""
+        from swarm_tpu.aot.jitcache import fetched_size_of
+
+        n = 0
+        for key in (full, ("B", full, True), ("B", full, False), "A"):
+            fn = self._fn_cache.get(key)
+            if fn is not None:
+                n += fetched_size_of(fn)
+        return n
+
+    def aot_prewarm(self) -> int:
+        """Bring-up fetch (worker/runtime.py): pool every published
+        executable for this process's program group so the first
+        dispatch of each published shape class loads instead of
+        compiling. No-op without an attached client."""
+        client = self._aot
+        return client.prewarm() if client is not None else 0
 
     def _budget(self) -> int:
         meta, _ = self._ensure_layout()
@@ -360,7 +528,7 @@ class DeviceDB:
                         return fuse_planes(planes, overflow)
                     return out
 
-                fn = jax.jit(kernel)
+                fn = self._wrap_jit(kernel, f"dd.fused.full={full}")
                 self._fn_cache[full] = fn
         return fn
 
@@ -390,7 +558,7 @@ class DeviceDB:
                     nmax = jnp.max(jnp.minimum(n_surv, K))
                     return cnt, overflow, nmax
 
-                fn = jax.jit(kernel_a)
+                fn = self._wrap_jit(kernel_a, "dd.A")
                 self._fn_cache["A"] = fn
         return fn
 
@@ -453,7 +621,12 @@ class DeviceDB:
             donate = (
                 (2, 3, 4, 5, 6) if donate_streams else (5, 6)
             )  # streams, lengths, status, cnt, overflow | cnt, overflow
-            fn = jax.jit(kernel_b, static_argnums=(0,), donate_argnums=donate)
+            fn = self._wrap_jit(
+                kernel_b,
+                f"dd.B.full={full}",
+                static_argnums=(0,),
+                donate_argnums=donate,
+            )
             self._fn_cache[key] = fn
         return fn
 
@@ -537,14 +710,25 @@ class DeviceDB:
         race-free with snapshot-outside-lock reads."""
         import time as _time
 
+        from swarm_tpu.aot.jitcache import fetched_size_of
+
         spies = [fn for fn in fns if hasattr(fn, "_cache_size")]
         with self._counter_lock:
             n0 = sum(fn._cache_size() for fn in spies)
+            f0 = sum(fetched_size_of(fn) for fn in spies)
             t0 = _time.perf_counter()
             out = launch()
+            dt = _time.perf_counter() - t0
             grew = sum(fn._cache_size() for fn in spies) - n0
+            grew_f = sum(fetched_size_of(fn) for fn in spies) - f0
+            if grew_f > 0:
+                # a deserialized AOT load is NOT a compile (docs/AOT.md
+                # — the fetch-path honesty contract): it gets its own
+                # spy pair; a dispatch that fetched one phase and
+                # compiled the other counts on both
+                self.fetch_seconds += dt
+                self.fetch_count += 1
             if grew > 0:
-                dt = _time.perf_counter() - t0
                 self.compile_seconds += dt
                 self.compile_count += 1
                 m = _device_metrics()

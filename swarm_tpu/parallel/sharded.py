@@ -260,6 +260,12 @@ class ShardedMatcher:
         self.staging = _StagingPool()
         self.compile_seconds = 0.0  # guarded-by: _counter_lock
         self.compile_count = 0  # guarded-by: _counter_lock
+        #: AOT executable-cache fetch spy (docs/AOT.md): dispatches
+        #: that LOADED a published executable instead of compiling —
+        #: counted distinctly so the compile spy stays honest
+        self.fetch_seconds = 0.0  # guarded-by: _counter_lock
+        self.fetch_count = 0  # guarded-by: _counter_lock
+        self._aot = None  # AotClient (attach_aot) — None = compile-only
         #: most recent compacted dispatch: survivor_max / verify_k /
         #: budget (the "phase B launches at survivor size" evidence)
         self.last_compact: dict = {}  # guarded-by: _counter_lock
@@ -329,6 +335,175 @@ class ShardedMatcher:
             from jax.experimental.shard_map import shard_map as smap
 
             return smap, {"check_rep": False}
+
+    # -- AOT executable cache (docs/AOT.md) ----------------------------
+    def attach_aot(self, client) -> None:
+        """Attach an :class:`~swarm_tpu.aot.AotClient` so every
+        subsequently built mesh step fetches published executables
+        before compiling. Multi-process meshes stay compile-only (an
+        executable image is only loadable on the topology it was
+        compiled for, and cross-host coordination of the load is not
+        worth the coupling — the per-host persistent XLA cache already
+        covers that deployment). Live wrappers drop so the attach
+        takes effect at the next dispatch."""
+        with self._counter_lock:
+            self._aot = None if self.multiprocess else client
+            self._fn_cache.clear()
+
+    def _trace_salt(self) -> str:
+        """The sharded twin of ``DeviceDB._trace_salt``: layout
+        metadata + kernel statics + the MESH (axis names/sizes — a
+        (2,2,2) executable must never serve an (8,1,1) worker)."""
+        db = self.db
+        return repr(
+            (
+                self.meta,
+                self.candidate_k,
+                tuple(sorted(self.ranks.items())),
+                self.halo,
+                db.num_slots,
+                db.num_templates,
+                int(db.op_src.shape[0]),
+                int(db.m_src.shape[0]),
+                int(db.rx_seq_always.sum()),
+            )
+        )
+
+    def _wrap_jit(self, fun, kernel_id: str, donate_argnums=()):
+        if self._aot is None:
+            if donate_argnums:
+                return jax.jit(fun, donate_argnums=donate_argnums)
+            return jax.jit(fun)
+        from swarm_tpu.aot.jitcache import AotJit
+
+        return AotJit(
+            fun,
+            kernel_id=kernel_id,
+            salt=self._trace_salt(),
+            client=self._aot,
+            donate_argnums=donate_argnums,
+            cap=4 * MAX_COMPILED,
+        )
+
+    def executable_count(self) -> int:
+        """Live locally-compiled executables across every cached mesh
+        step (the compile spy's cache-size view; fetched loads are
+        counted by :meth:`fetched_executable_count` instead)."""
+        with self._counter_lock:
+            fns = list(self._fn_cache.values())
+        return sum(
+            int(fn._cache_size())
+            for fn in fns
+            if hasattr(fn, "_cache_size")
+        )
+
+    def fetched_executable_count(self) -> int:
+        from swarm_tpu.aot.jitcache import fetched_size_of
+
+        with self._counter_lock:
+            fns = list(self._fn_cache.values())
+        return sum(fetched_size_of(fn) for fn in fns)
+
+    def aot_prewarm(self) -> int:
+        """Pool every published executable for this program group
+        (bring-up fetch; see ``DeviceDB.aot_prewarm``)."""
+        client = self._aot
+        return client.prewarm() if client is not None else 0
+
+    # -- corpus refresh (docs/AOT.md) ----------------------------------
+    def refresh(self, db_new: fpc.CompiledDB) -> dict:
+        """Zero-downtime corpus refresh on the mesh: recompute the
+        per-rank stacked/replicated host pytrees and re-upload ONLY
+        the leaves whose bytes changed (byte-equal leaves keep their
+        existing device arrays — the rank-sharded stack is rebuilt on
+        host but the ICI/H2D traffic is delta-sized). The trace
+        signature decides executable retention exactly as on the
+        single-device path. Caller quiesces dispatches first."""
+        old_salt = self._trace_salt()
+        old_tab_np, old_rep_np = self._tab_np, self._rep_np
+        old_tab_j, old_rep_j = self._tab_j, self._rep_j
+        self.db = db_new
+        self.meta = fpc.layout_meta(db_new)
+        self.halo = (
+            max_entry_len(db_new) if self.ranks.get("seq", 1) > 1 else 0
+        )
+        self._tab_np = shard_stacked_np(
+            db_new, self.ranks.get("model", 1)
+        )
+        self._rep_np = {
+            "slot_bytes": db_new.slot_bytes,
+            "slot_len": db_new.slot_len,
+            "tiny_bytes": db_new.tiny_bytes,
+            "tiny_slot": db_new.tiny_slot,
+            "verdict": fpc.verdict_arrays_np(db_new),
+            "rx": fpc.rx_arrays_np(db_new),
+        }
+
+        def upload(new_np, old_np_map, old_j_map, spec_of):
+            old_host = {
+                jax.tree_util.keystr(p): leaf
+                for p, leaf in jax.tree_util.tree_flatten_with_path(
+                    old_np_map
+                )[0]
+            }
+            old_dev = {
+                jax.tree_util.keystr(p): leaf
+                for p, leaf in jax.tree_util.tree_flatten_with_path(
+                    old_j_map
+                )[0]
+            }
+            flat, _ = jax.tree_util.tree_flatten_with_path(new_np)
+            out = []
+            n_up = 0
+            for path, leaf in flat:
+                key = jax.tree_util.keystr(path)
+                old = old_host.get(key)
+                if (
+                    key in old_dev
+                    and isinstance(old, np.ndarray)
+                    and old.dtype == leaf.dtype
+                    and old.shape == leaf.shape
+                    and (old is leaf or np.array_equal(old, leaf))
+                ):
+                    out.append(old_dev[key])
+                else:
+                    n_up += 1
+                    if self.multiprocess:
+                        out.append(self._global(leaf, spec_of(path)))
+                    else:
+                        out.append(jnp.asarray(leaf))
+            return (
+                jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(new_np), out
+                ),
+                n_up,
+            )
+
+        self._tab_j, up_tab = upload(
+            self._tab_np, old_tab_np, old_tab_j, lambda _p: P("model")
+        )
+        self._rep_j, up_rep = upload(
+            self._rep_np, old_rep_np, old_rep_j, lambda _p: P()
+        )
+        old_leaves = jax.tree_util.tree_leaves((old_tab_np, old_rep_np))
+        new_leaves = jax.tree_util.tree_leaves(
+            (self._tab_np, self._rep_np)
+        )
+        keep = (
+            old_salt == self._trace_salt()
+            and len(old_leaves) == len(new_leaves)
+            and all(
+                o.shape == n.shape and o.dtype == n.dtype
+                for o, n in zip(old_leaves, new_leaves)
+            )
+        )
+        with self._counter_lock:
+            if not keep:
+                self._fn_cache.clear()
+        return {
+            "uploaded_leaves": up_tab + up_rep,
+            "executables_kept": keep,
+        }
 
     def _specs(self, streams: dict, lengths: dict):
         """(tab, rep, streams, lengths) partition specs for one batch
@@ -495,7 +670,7 @@ class ShardedMatcher:
             out_specs=out_specs,
             **smap_kwargs,
         )
-        return jax.jit(fn)
+        return self._wrap_jit(fn, f"sh.fused.full={full}")
 
     def _build_phase_a(self, streams: dict, lengths: dict):
         """Standing sharded phase A: per-rank stacked bloom probe →
@@ -539,7 +714,7 @@ class ShardedMatcher:
             out_specs=(rank_spec, rank_spec, P()),
             **smap_kwargs,
         )
-        return jax.jit(fn)
+        return self._wrap_jit(fn, "sh.A")
 
     def _build_phase_b(
         self, streams: dict, lengths: dict, kc: int, full: bool,
@@ -611,7 +786,12 @@ class ShardedMatcher:
         donate = (
             (2, 3, 4, 5, 6) if donate_streams else (5, 6)
         )  # streams, lengths, status, cnt, overflow | cnt, overflow
-        return jax.jit(fn, donate_argnums=donate)
+        # kc rides the kernel id (it is baked into the step closure
+        # here, not a static argnum) so every ladder rung publishes
+        # its own artifact
+        return self._wrap_jit(
+            fn, f"sh.B.kc={kc}.full={full}", donate_argnums=donate
+        )
 
     # ------------------------------------------------------------------
     def _get_fn(self, key, builder):
@@ -679,15 +859,33 @@ class ShardedMatcher:
         )
         return s_j, l_j, st_j
 
-    def _note_launch(self, fresh: bool, t0: float) -> None:
-        """Compile accounting at the dispatch boundary (same contract
-        as DeviceDB's spy: wall time of dispatches that built at least
-        one new executable)."""
-        if not fresh:
+    def _note_launch(self, launches, t0: float) -> None:
+        """Compile/fetch accounting at the dispatch boundary (same
+        contract as DeviceDB's spy: wall time of dispatches that made
+        at least one new executable servable, attributed to the
+        compile or the AOT-fetch pair by what the freshly built
+        wrappers actually did — a deserialized load is NOT a
+        compile). ``launches`` = [(fn, freshly_built), ...]; the
+        wrappers have been CALLED by the time this runs."""
+        from swarm_tpu.aot.jitcache import fetched_size_of
+
+        fresh_fns = [fn for fn, fresh in launches if fresh]
+        if not fresh_fns:
             return
+        compiled = sum(
+            int(fn._cache_size())
+            for fn in fresh_fns
+            if hasattr(fn, "_cache_size")
+        )
+        fetched = sum(fetched_size_of(fn) for fn in fresh_fns)
+        dt = time.perf_counter() - t0
         with self._counter_lock:
-            self.compile_seconds += time.perf_counter() - t0
-            self.compile_count += 1
+            if fetched:
+                self.fetch_seconds += dt
+                self.fetch_count += 1
+            if compiled:
+                self.compile_seconds += dt
+                self.compile_count += 1
 
     def _dispatch_metrics(self, streams: dict, halo_exchanges: int = 1) -> None:
         m = _shard_metrics()
@@ -737,7 +935,7 @@ class ShardedMatcher:
                 lambda: self._build_fused(streams, lengths, full),
             )
             out = fn(self._tab_j, self._rep_j, s_j, l_j, st_j)
-            self._note_launch(fresh, t0)
+            self._note_launch([(fn, fresh)], t0)
             self._dispatch_metrics(streams)
             return out
 
@@ -763,7 +961,7 @@ class ShardedMatcher:
             ),
         )
         out = fb(self._tab_j, self._rep_j, s_j, l_j, st_j, cnt, ovf)
-        self._note_launch(fresh_a or fresh_b, t0)
+        self._note_launch([(fa, fresh_a), (fb, fresh_b)], t0)
         with self._counter_lock:
             self.last_compact = {
                 "survivor_max": n_live,

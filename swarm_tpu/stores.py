@@ -242,6 +242,173 @@ class RedisStateStore(StateStore):
         self._r.flushall()
 
 
+class LocalStateStore(StateStore):
+    """File-backed state store: one JSON document per key name under a
+    directory, every read-modify-write serialized by an ``fcntl``
+    file lock, so MULTIPLE PROCESSES on one host share state with zero
+    side-cars. Built for the AOT executable cache's no-sidecar fleet
+    mode and the bench's fresh-process cold-start A/B (docs/AOT.md) —
+    the hot control plane should still prefer Memory (in-process) or
+    Redis (multi-host): every op here costs a file open + lock.
+
+    ``hincr`` is atomic across processes (read-modify-write under the
+    exclusive lock), which is what the fencing-token counter and epoch
+    generation need.
+    """
+
+    def __init__(self, root: str | Path) -> None:
+        self._root = Path(root)
+        self._root.mkdir(parents=True, exist_ok=True)
+        self._lockfile = self._root / ".lock"
+        self._thread_lock = threading.Lock()
+
+    def _path(self, name: str) -> Path:
+        # flat namespace, filesystem-safe: hex-escape anything outside
+        # [A-Za-z0-9._-] so "swarm:aot:x:…" can't traverse or collide
+        safe = "".join(
+            c if c.isalnum() or c in "._-" else f"%{ord(c):02x}"
+            for c in name
+        )
+        return self._root / (safe + ".json")
+
+    class _Locked:
+        def __init__(self, store: "LocalStateStore"):
+            self._store = store
+            self._fh = None
+
+        def __enter__(self):
+            import fcntl
+
+            self._store._thread_lock.acquire()
+            try:
+                self._fh = open(self._store._lockfile, "a+")
+                fcntl.flock(self._fh, fcntl.LOCK_EX)
+            except BaseException:
+                # a failed open/flock (fd exhaustion, removed root)
+                # must release the thread lock — __exit__ never runs
+                # when __enter__ raises, and a stuck lock would hang
+                # every later store op in the process instead of
+                # letting the caller's breaker degrade
+                if self._fh is not None:
+                    self._fh.close()
+                    self._fh = None
+                self._store._thread_lock.release()
+                raise
+            return self
+
+        def __exit__(self, *exc):
+            import fcntl
+
+            fcntl.flock(self._fh, fcntl.LOCK_UN)
+            self._fh.close()
+            self._store._thread_lock.release()
+            return False
+
+    def _load(self, name: str) -> dict:
+        try:
+            return json.loads(self._path(name).read_text())
+        except (OSError, ValueError):
+            return {"h": {}, "l": []}
+
+    def _save(self, name: str, doc: dict) -> None:
+        p = self._path(name)
+        tmp = p.with_name(p.name + f".tmp-{os.getpid()}")
+        tmp.write_text(json.dumps(doc, separators=(",", ":")))
+        os.replace(tmp, p)  # crash-atomic, same as LocalBlobStore.put
+
+    def hset(self, name, key, value):
+        from swarm_tpu.resilience.faults import fault_point
+
+        fault_point("store.hset", detail=name)
+        with self._Locked(self):
+            doc = self._load(name)
+            doc["h"][key] = value
+            self._save(name, doc)
+
+    def hget(self, name, key):
+        with self._Locked(self):
+            return self._load(name)["h"].get(key)
+
+    def hmget(self, name, keys):
+        with self._Locked(self):
+            h = self._load(name)["h"]
+            return [h.get(k) for k in keys]
+
+    def hset_many(self, name, mapping):
+        with self._Locked(self):
+            doc = self._load(name)
+            doc["h"].update(mapping)
+            self._save(name, doc)
+
+    def hincr(self, name, key, by=1):
+        with self._Locked(self):
+            doc = self._load(name)
+            value = int(doc["h"].get(key, "0")) + int(by)
+            doc["h"][key] = str(value)
+            self._save(name, doc)
+            return value
+
+    def hkeys(self, name):
+        with self._Locked(self):
+            return list(self._load(name)["h"].keys())
+
+    def hgetall(self, name):
+        with self._Locked(self):
+            return dict(self._load(name)["h"])
+
+    def hdel(self, name, key):
+        with self._Locked(self):
+            doc = self._load(name)
+            if key in doc["h"]:
+                del doc["h"][key]
+                self._save(name, doc)
+
+    def rpush(self, name, value):
+        with self._Locked(self):
+            doc = self._load(name)
+            doc["l"].append(value)
+            self._save(name, doc)
+
+    def lpush(self, name, value):
+        with self._Locked(self):
+            doc = self._load(name)
+            doc["l"].insert(0, value)
+            self._save(name, doc)
+
+    def lpop(self, name):
+        with self._Locked(self):
+            doc = self._load(name)
+            if not doc["l"]:
+                return None
+            value = doc["l"].pop(0)
+            self._save(name, doc)
+            return value
+
+    def lclear(self, name):
+        with self._Locked(self):
+            doc = self._load(name)
+            if doc["l"]:
+                doc["l"] = []
+                self._save(name, doc)
+
+    def lrange(self, name, start, stop):
+        with self._Locked(self):
+            items = list(self._load(name)["l"])
+        if stop == -1:
+            return items[start:]
+        return items[start : stop + 1]
+
+    def llen(self, name):
+        with self._Locked(self):
+            return len(self._load(name)["l"])
+
+    def flushall(self):
+        with self._Locked(self):
+            for p in self._root.glob("*.json"):
+                if ".tmp-" not in p.name:
+                    p.unlink(missing_ok=True)
+
+
 # ---------------------------------------------------------------------------
 # Blob store (S3-role): chunk input/output files.
 # ---------------------------------------------------------------------------

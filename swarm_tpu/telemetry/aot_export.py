@@ -1,0 +1,70 @@
+"""Always-registered ``swarm_aot_*`` metric families (docs/AOT.md).
+
+The AOT executable cache ships serialized XLA executables through the
+shared Redis/S3-role stores so a joining worker FETCHES its compiled
+kernels instead of compiling them (the fleet cold-start story). These
+families are registered at telemetry import time — not on first
+client construction — so EVERY process's ``/metrics`` carries them
+with rendered samples (``tools/check_metrics.py`` requires them on a
+server that has no engine and no AOT store at all). Label combos are
+pre-seeded for the same reason: a labeled family with no observed
+combos renders no lines, which would read as "family missing" to the
+exposition check.
+"""
+
+from __future__ import annotations
+
+from swarm_tpu.telemetry.metrics import REGISTRY
+
+#: artifact fetch outcomes. ``hit`` = a published executable was
+#: loaded (from the prewarm pool or the store) instead of compiling;
+#: ``miss`` = nothing published for this key (the worker compiles and,
+#: when publishing is on, becomes the publisher); ``deserialize_error``
+#: = the artifact existed but failed to load (foreign
+#: jaxlib/device topology or corrupt bytes) — the worker falls back to
+#: a live compile, it never blocks (docs/RESILIENCE.md).
+AOT_FETCHES = REGISTRY.counter(
+    "swarm_aot_fetch_total",
+    "AOT executable-cache fetches by outcome (hit = loaded instead "
+    "of compiled; deserialize_error = artifact present but unloadable, "
+    "fell back to compile)",
+    ("outcome",),
+)
+for _o in ("hit", "miss", "deserialize_error"):
+    AOT_FETCHES.labels(outcome=_o)
+del _o
+
+#: artifact publish outcomes after a local compile. ``fenced`` =
+#: rejected by the store's fencing-token check (a superseded writer);
+#: ``error`` = the breaker-wrapped store op failed (store degraded,
+#: artifact dropped — the executable still serves locally).
+AOT_PUBLISHES = REGISTRY.counter(
+    "swarm_aot_publish_total",
+    "AOT executable-cache publishes by outcome",
+    ("outcome",),
+)
+for _o in ("stored", "fenced", "error"):
+    AOT_PUBLISHES.labels(outcome=_o)
+del _o
+
+#: wall seconds an executable took to become servable on THIS worker:
+#: observed per fetch-load (deserialize_and_load) and per local
+#: compile on the AOT-managed path — the fetch/compile bring-up gap is
+#: the whole point (bench's ``aot_coldstart_speedup``).
+AOT_BRINGUP_SECONDS = REGISTRY.histogram(
+    "swarm_aot_bringup_seconds",
+    "Seconds to make one executable servable (fetch+deserialize on a "
+    "hit, trace+compile on a miss), by source",
+    ("source",),
+)
+AOT_BRINGUP_SECONDS.labels(source="fetch")
+AOT_BRINGUP_SECONDS.labels(source="compile")
+
+#: byte size of the most recently moved artifact (published or
+#: fetched) — the operator's "how big are these things" gauge.
+AOT_ARTIFACT_BYTES = REGISTRY.gauge(
+    "swarm_aot_artifact_bytes",
+    "Size in bytes of the most recently published or fetched AOT "
+    "artifact",
+)
+AOT_ARTIFACT_BYTES.set(0)

@@ -806,6 +806,32 @@ class JobProcessor:
                     engine.attach_result_cache(client)
             except Exception as e:
                 print(f"result cache unavailable ({e}); running L1-only")
+            # AOT executable cache (docs/AOT.md): a joining worker
+            # FETCHES the fleet's published executables at bring-up
+            # instead of compiling them per shape class — the cold-
+            # start cliff becomes a one-time fleet-wide cost.
+            # SWARM_AOT_BACKEND=off (default) skips this entirely; a
+            # store that can't be built or prewarmed must not kill
+            # engine bring-up (breaker-wrapped, never blocks).
+            from swarm_tpu.aot import build_aot_client
+
+            try:
+                aot = build_aot_client(self.cfg)
+                if aot is not None:
+                    engine.attach_aot(aot)
+                    if self.cfg.aot_prewarm:
+                        import time as _time
+
+                        t0 = _time.perf_counter()
+                        n = engine.aot_prewarm()
+                        if n:
+                            print(
+                                f"AOT prewarm: {n} executables loaded "
+                                f"in {_time.perf_counter() - t0:.2f}s"
+                            )
+            except Exception as e:
+                print(f"AOT executable cache unavailable ({e}); "
+                      "compiling locally")
             self._engines[templates_dir] = engine
         return engine
 

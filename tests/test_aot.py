@@ -1,0 +1,292 @@
+"""AOT executable cache (docs/AOT.md).
+
+Pins the ISSUE-13 acceptance contracts:
+
+- a second-process warm-fetch bring-up compiles ZERO executables for an
+  already-published shape class (``executable_count == 0``,
+  ``fetched_executable_count > 0``) with verdict planes bit-identical
+  to the compiled path, on both :class:`DeviceDB` and the 8-virtual-
+  device :class:`ShardedMatcher` mesh;
+- the compile-count spy and the ``_fn_cache`` LRU count a
+  deserialized load DISTINCTLY from a compile (the width-bucket
+  sharing property holds on the fetch path);
+- any miss / deserialize failure / injected ``aot.fetch``/``aot.put``
+  fault falls back to a live compile — breaker-wrapped, never blocks,
+  verdicts identical;
+- publishes ride the epoch + fencing-token discipline (a superseded
+  writer is fenced; an epoch bump makes every artifact unreachable).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from swarm_tpu.aot import AotClient, AotStore, aval_signature
+from swarm_tpu.fingerprints import load_corpus
+from swarm_tpu.fingerprints.compile import compile_corpus
+from swarm_tpu.ops.encoding import encode_batch
+from swarm_tpu.ops.match import DeviceDB
+from swarm_tpu.resilience.faults import clear_plan, install_plan
+from swarm_tpu.stores import MemoryBlobStore, MemoryStateStore
+
+from test_match_parity import fuzz_rows
+
+DATA = "tests/data/templates"
+
+
+@pytest.fixture(scope="module")
+def world():
+    templates, errors = load_corpus(DATA)
+    assert templates and not errors
+    db = compile_corpus(templates)
+    rows = fuzz_rows(templates, random.Random(57), 16)
+    batch = encode_batch(rows, max_body=512, max_header=512, pad_rows_to=16)
+    return templates, db, rows, batch
+
+
+def _store():
+    return AotStore(MemoryStateStore(), MemoryBlobStore())
+
+
+def _match(db, batch, client=None, prewarm=False):
+    dev = DeviceDB(db)
+    if client is not None:
+        dev.attach_aot(client)
+        if prewarm:
+            dev.aot_prewarm()
+    planes = dev.match(
+        batch.streams, batch.lengths, batch.status, full=True
+    )
+    return dev, planes
+
+
+def _assert_planes_equal(a, b):
+    names = ("t_value", "t_unc", "op_value", "op_unc", "m_unc", "overflow")
+    for name, x, y in zip(names, a, b):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=name
+        )
+
+
+# ----------------------------------------------------------------------
+# warm fetch: the acceptance capstones
+# ----------------------------------------------------------------------
+
+
+def test_warm_fetch_devicedb_compiles_nothing(world):
+    """Publisher process compiles + publishes; a fresh DeviceDB with a
+    fresh client over the same store loads EVERYTHING — zero local
+    compiles, planes bit-identical to both the compiled-and-published
+    run and the no-AOT reference."""
+    _t, db, _rows, batch = world
+    store = _store()
+    d1, p1 = _match(db, batch, AotClient(store, worker_id="pub"))
+    assert d1.compile_count >= 1 and d1.executable_count() >= 1
+    assert d1.fetched_executable_count() == 0
+
+    c2 = AotClient(store, worker_id="join")
+    d2, p2 = _match(db, batch, c2, prewarm=True)
+    assert d2.executable_count() == 0
+    assert d2.compile_count == 0
+    assert d2.fetched_executable_count() > 0
+    assert d2.fetch_count >= 1 and d2.fetch_seconds > 0
+    assert c2.counters()["fetch_hits"] >= 2  # phase A + phase B
+    _assert_planes_equal(p1, p2)
+
+    d3, p3 = _match(db, batch)  # no AOT at all — the reference twin
+    _assert_planes_equal(p2, p3)
+
+
+def test_warm_fetch_lazy_without_prewarm(world):
+    """The dispatch-time fetch alone (no bring-up prewarm) also
+    compiles nothing for a published shape class."""
+    _t, db, _rows, batch = world
+    store = _store()
+    _d1, p1 = _match(db, batch, AotClient(store, worker_id="pub"))
+    d2, p2 = _match(
+        db, batch, AotClient(store, worker_id="lazy"), prewarm=False
+    )
+    assert d2.executable_count() == 0 and d2.compile_count == 0
+    assert d2.fetched_executable_count() > 0
+    _assert_planes_equal(p1, p2)
+
+
+def test_warm_fetch_sharded_mesh(world):
+    """The mesh twin: a fresh ShardedMatcher over the 8-virtual-device
+    mesh loads every published step — zero compiles, planes
+    bit-identical."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the suite's forced multi-device host platform")
+    from swarm_tpu.parallel.mesh import make_mesh
+    from swarm_tpu.parallel.sharded import (
+        ShardedMatcher,
+        pad_streams_for_seq,
+    )
+
+    _t, db, _rows, batch = world
+    mesh = make_mesh()
+    store = _store()
+    s1 = ShardedMatcher(db, mesh)
+    s1.attach_aot(AotClient(store, worker_id="pub"))
+    streams = dict(batch.streams)
+    pad_streams_for_seq(streams, s1.ranks.get("seq", 1), s1.halo)
+    p1 = s1.match(streams, batch.lengths, batch.status, full=True)
+    assert s1.compile_count >= 1 and s1.executable_count() >= 1
+
+    s2 = ShardedMatcher(db, mesh)
+    c2 = AotClient(store, worker_id="join")
+    s2.attach_aot(c2)
+    assert s2.aot_prewarm() >= 2
+    p2 = s2.match(streams, batch.lengths, batch.status, full=True)
+    assert s2.executable_count() == 0 and s2.compile_count == 0
+    assert s2.fetched_executable_count() > 0 and s2.fetch_count >= 1
+    _assert_planes_equal(p1, p2)
+
+
+def test_width_bucket_sharing_holds_on_fetch_path(world):
+    """PR 3's width-bucket property, fetch edition: two batches of the
+    SAME padded shape share one fetched executable — the second batch
+    fetches nothing new and compiles nothing (the spy pair stays
+    (0, constant))."""
+    _t, db, rows, batch = world
+    store = _store()
+    _d1, _p1 = _match(db, batch, AotClient(store, worker_id="pub"))
+    d2, _p2 = _match(db, batch, AotClient(store, worker_id="join"))
+    n_fetched = d2.fetched_executable_count()
+    assert n_fetched > 0
+    # same padded shape AND same ladder rung (same content re-encoded
+    # into fresh arrays — a different survivor count would honestly
+    # select a different rung, which is a different executable):
+    # the fetched executables serve, nothing new compiles or fetches
+    batch2 = encode_batch(
+        rows, max_body=512, max_header=512, pad_rows_to=16
+    )
+    d2.match(batch2.streams, batch2.lengths, batch2.status, full=True)
+    assert d2.executable_count() == 0
+    assert d2.fetched_executable_count() == n_fetched
+
+
+# ----------------------------------------------------------------------
+# fallback paths: miss / deserialize failure / chaos faults
+# ----------------------------------------------------------------------
+
+
+def test_deserialize_failure_falls_back_to_compile(world):
+    """A corrupt artifact (or one from a foreign topology) is a MISS,
+    never an exception: the worker compiles and verdicts are
+    identical."""
+    _t, db, _rows, batch = world
+    store = _store()
+    c1 = AotClient(store, worker_id="pub")
+    _d1, p1 = _match(db, batch, c1)
+    # corrupt every published payload in place
+    epoch = f"g{store.epoch_generation()}"
+    for digest in store.list_index(epoch):
+        store._blobs.put(store._artifact_key(epoch, digest), b"garbage")
+    c2 = AotClient(store, worker_id="victim")
+    d2, p2 = _match(db, batch, c2)
+    assert d2.compile_count >= 1 and d2.executable_count() >= 1
+    assert d2.fetched_executable_count() == 0
+    assert c2.counters()["deserialize_errors"] >= 1
+    _assert_planes_equal(p1, p2)
+
+
+def test_chaos_faults_degrade_to_compile(world):
+    """``aot.fetch`` / ``aot.put`` fault points (docs/RESILIENCE.md):
+    a faulted store trips the breaker, the dispatch compiles locally,
+    and planes stay bit-identical."""
+    _t, db, _rows, batch = world
+    store = _store()
+    _d1, p1 = _match(db, batch, AotClient(store, worker_id="pub"))
+    plan = install_plan("seed=3;aot.fetch:1-4;aot.put:1-2")
+    try:
+        c2 = AotClient(store, worker_id="chaos", breaker_threshold=2)
+        d2, p2 = _match(db, batch, c2)
+        _assert_planes_equal(p1, p2)
+        assert d2.compile_count >= 1  # fetch faulted → compiled
+        snap = plan.snapshot()
+        assert sum(c["fired"] for c in snap.values()) > 0
+    finally:
+        clear_plan()
+    # store healthy again: the NEXT fresh client warm-fetches normally
+    d3, p3 = _match(db, batch, AotClient(store, worker_id="after"))
+    assert d3.compile_count == 0 and d3.fetched_executable_count() > 0
+    _assert_planes_equal(p1, p3)
+
+
+def test_epoch_bump_hides_artifacts(world):
+    """The poisoned-artifact runbook lever: ``bump_epoch`` moves every
+    reader/writer to a fresh namespace — the next worker compiles (and
+    republished artifacts serve workers after it)."""
+    _t, db, _rows, batch = world
+    store = _store()
+    _d1, p1 = _match(db, batch, AotClient(store, worker_id="pub"))
+    store.bump_epoch()
+    c2 = AotClient(store, worker_id="postbump")
+    d2, p2 = _match(db, batch, c2)
+    assert d2.compile_count >= 1 and d2.fetched_executable_count() == 0
+    _assert_planes_equal(p1, p2)
+    # the new epoch now holds the republished artifacts
+    d3, _p3 = _match(db, batch, AotClient(store, worker_id="join2"))
+    assert d3.compile_count == 0 and d3.fetched_executable_count() > 0
+
+
+def test_superseded_writer_publishes_are_fenced(world):
+    """The fencing-token discipline (docs/CACHING.md): re-acquiring a
+    writer identity supersedes the old holder, whose publishes then
+    report fenced instead of claiming success."""
+    _t, db, _rows, batch = world
+    store = _store()
+    c1 = AotClient(store, worker_id="w")
+    _d1, _p1 = _match(db, batch, c1)  # acquires the process token
+    assert c1.counters()["published"] >= 1
+    # a "restarted" instance of the same identity elsewhere supersedes
+    store.acquire_writer("w:aot")
+    out = c1.publish(
+        c1.key_digest("test.k", "s", "()", "sig"), {}, _compiled_probe()
+    )
+    assert out == "fenced"
+    assert c1.counters()["publish_fenced"] >= 1
+
+
+def _compiled_probe():
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda x: x + 1).lower(jnp.ones((2,))).compile()
+
+
+# ----------------------------------------------------------------------
+# key schema
+# ----------------------------------------------------------------------
+
+
+def test_aval_signature_is_shape_and_dtype_sensitive():
+    sig = aval_signature(
+        {"a": np.zeros((2, 3), np.uint8), "b": np.zeros((4,), np.int32)}
+    )
+    assert sig == aval_signature(
+        {"a": np.ones((2, 3), np.uint8), "b": np.ones((4,), np.int32)}
+    )
+    assert sig != aval_signature(
+        {"a": np.zeros((2, 4), np.uint8), "b": np.zeros((4,), np.int32)}
+    )
+    assert sig != aval_signature(
+        {"a": np.zeros((2, 3), np.uint16), "b": np.zeros((4,), np.int32)}
+    )
+
+
+def test_key_digest_separates_kernels_statics_and_shapes(world):
+    store = _store()
+    c = AotClient(store, worker_id="k")
+    base = c.key_digest("dd.B", "salt", "(8,)", "sig")
+    assert base != c.key_digest("dd.A", "salt", "(8,)", "sig")
+    assert base != c.key_digest("dd.B", "salt", "(16,)", "sig")  # rung
+    assert base != c.key_digest("dd.B", "salt2", "(8,)", "sig")
+    assert base != c.key_digest("dd.B", "salt", "(8,)", "sig2")
+    assert base == c.key_digest("dd.B", "salt", "(8,)", "sig")

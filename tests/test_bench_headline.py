@@ -81,6 +81,16 @@ def _fake_phase_output(phase: str) -> str:
              "dispatch/collect serve, identity-gated)",
              "vs_baseline": 124.0},
         ],
+        "aot": [
+            {"metric": "aot_coldstart_speedup", "value": 18.3,
+             "unit": "x (fresh-process bring-up: compile arm / "
+             "warm-fetch arm, planes identity-gated)",
+             "vs_baseline": 18.3},
+            {"metric": "aot_bringup_seconds", "value": 0.23,
+             "unit": "s (median warm-fetch bring-up to first "
+             "full-plane batch; compile arm in extra)",
+             "vs_baseline": 18.3},
+        ],
         "oracle": [
             {"metric": "cpu_oracle_rows_per_sec", "value": 12.0,
              "unit": "rows/sec", "vs_baseline": 1.0},

@@ -1,0 +1,260 @@
+"""Corpus-delta compile + zero-downtime engine refresh (docs/AOT.md).
+
+Pins the ISSUE-13 acceptance contracts:
+
+- a single-template add/remove/edit delta-compiles to a CompiledDB +
+  device layout BIT-IDENTICAL to a from-scratch build;
+- only the TOUCHED stacked-table rows rebuild (rebuild-count spy:
+  ``tables_rebuilt`` / ``rows_rebuilt``), every unchanged stacked-
+  table array is reused, and ``stack_tables_np`` (the full-stack
+  builder) is never invoked on the delta path;
+- a refresh against a LIVE engine bumps the shared-cache epoch
+  exactly once (one ``bind_corpus``) and serves the next batch
+  without a full layout rebuild, verdicts equal to a fresh engine;
+- a no-op refresh keeps the live executables (trace signature
+  unchanged) and uploads nothing.
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+import swarm_tpu.fingerprints.compile as fpc
+from swarm_tpu.fingerprints import load_corpus
+from swarm_tpu.fingerprints.compile import (
+    build_device_layout,
+    compile_corpus,
+    compile_corpus_delta,
+)
+from swarm_tpu.fingerprints.model import Matcher, Operation, Response, Template
+from swarm_tpu.ops.engine import MatchEngine
+
+from test_match_parity import fuzz_rows
+
+DATA = "tests/data/templates"
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    templates, errors = load_corpus(DATA)
+    assert templates and not errors
+    return templates
+
+
+def _new_word_template(tid="delta-probe", needle="deltaprobe-needle-xyz"):
+    return Template(
+        id=tid,
+        protocol="http",
+        operations=[
+            Operation(
+                matchers=[
+                    Matcher(type="word", part="body", words=[needle])
+                ]
+            )
+        ],
+    )
+
+
+def _assert_tree_equal(a, b):
+    fa, ta = jax.tree_util.tree_flatten_with_path(a)
+    fb, tb = jax.tree_util.tree_flatten_with_path(b)
+    assert str(ta) == str(tb), "layout structure drift"
+    for (pa, xa), (_pb, xb) in zip(fa, fb):
+        np.testing.assert_array_equal(
+            np.asarray(xa), np.asarray(xb),
+            err_msg=jax.tree_util.keystr(pa),
+        )
+
+
+_DB_ARRAYS = (
+    "slot_bytes", "slot_len", "tiny_bytes", "tiny_slot", "m_kind",
+    "m_negative", "m_cond_and", "m_scalar", "m_residue", "m_status",
+    "m_size", "op_cond_and", "op_prefilter", "t_prefilter", "m_src",
+    "op_src", "rx_m_ids", "rx_bytemap",
+)
+
+
+@pytest.mark.parametrize(
+    "case",
+    ["add_at_end", "remove_last", "remove_mid", "edit_word"],
+)
+def test_delta_bit_identical_to_scratch(corpus, case):
+    """add/remove/edit: the delta CompiledDB and device layout equal a
+    from-scratch compile bit for bit."""
+    base = list(corpus)
+    if case == "add_at_end":
+        new = base + [_new_word_template()]
+    elif case == "remove_last":
+        new = base[:-1]
+    elif case == "remove_mid":
+        new = base[:2] + base[3:]
+    else:  # edit_word: same id, different needle
+        base = base + [_new_word_template()]
+        new = base[:-1] + [
+            _new_word_template(needle="deltaprobe-other-needle")
+        ]
+    db_old = compile_corpus(base)
+    build_device_layout(db_old)
+    db_delta, stats = compile_corpus_delta(new, db_old)
+    db_scratch = compile_corpus(new)
+    m_s, a_s = build_device_layout(db_scratch)
+    m_d, a_d = db_delta._device_layout
+    assert m_d == m_s
+    _assert_tree_equal(a_d, a_s)
+    for name in _DB_ARRAYS:
+        np.testing.assert_array_equal(
+            getattr(db_delta, name), getattr(db_scratch, name),
+            err_msg=name,
+        )
+    assert db_delta.template_ids == db_scratch.template_ids
+    assert stats["tables_total"] == len(db_delta.tables)
+
+
+def test_single_add_rebuilds_only_touched_rows(corpus, monkeypatch):
+    """The rebuild-count spy: a one-template add whose words land in
+    ONE table rebuilds exactly that stacked row, reuses every other
+    (WordTable objects adopted by identity), and never calls the
+    full-stack builder."""
+    db_old = compile_corpus(corpus)
+    build_device_layout(db_old)
+    calls = []
+    real = fpc.stack_tables_np
+    monkeypatch.setattr(
+        fpc, "stack_tables_np", lambda *a: calls.append(1) or real(*a)
+    )
+    db_new, stats = compile_corpus_delta(
+        list(corpus) + [_new_word_template()], db_old
+    )
+    assert not calls, "delta path fell back to a full stack build"
+    T = stats["tables_total"]
+    assert stats["tables_rebuilt"] == 1 and stats["tables_reused"] == T - 1
+    assert stats["rows_rebuilt"] == 1 and stats["rows_reused"] == T - 1
+    # unchanged WordTables are the SAME objects (zero re-derivation)
+    reused = sum(
+        1 for t in db_new.tables if any(t is o for o in db_old.tables)
+    )
+    assert reused == T - 1
+
+
+def test_noop_delta_reuses_everything(corpus):
+    db_old = compile_corpus(corpus)
+    _m, a_old = build_device_layout(db_old)
+    db_new, stats = compile_corpus_delta(list(corpus), db_old)
+    assert stats["tables_rebuilt"] == 0 and stats["rows_rebuilt"] == 0
+    assert stats["leaves_reused"] == stats["leaves_total"]
+    # every layout leaf is the OLD array object → zero re-upload
+    _m2, a_new = db_new._device_layout
+    old_leaves = jax.tree_util.tree_leaves(a_old)
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(a_new)):
+        assert leaf is old_leaves[i]
+
+
+# ----------------------------------------------------------------------
+# live-engine refresh
+# ----------------------------------------------------------------------
+
+
+def _rows(templates, n=12, with_needle=True):
+    rows = fuzz_rows(templates, random.Random(3), n)
+    if with_needle:
+        rows.append(
+            Response(
+                host="h", port=80, status=200,
+                body=b"hello deltaprobe-needle-xyz world",
+                header=b"X-Probe: 1\r\n",
+            )
+        )
+    return rows
+
+
+def _ids(rms):
+    return [sorted(rm.template_ids) for rm in rms]
+
+
+def test_live_refresh_serves_next_batch(corpus, monkeypatch):
+    """The acceptance capstone: a one-template refresh against a live
+    engine reuses every unchanged stacked-table array (spy-asserted),
+    rebuilds nothing wholesale, and the NEXT match call serves the new
+    corpus with verdicts identical to a fresh engine."""
+    rows = _rows(corpus)
+    eng = MatchEngine(list(corpus), mesh=None, batch_rows=16)
+    before = eng.match(rows)
+    assert not any("delta-probe" in ids for ids in _ids(before))
+
+    calls = []
+    real = fpc.stack_tables_np
+    monkeypatch.setattr(
+        fpc, "stack_tables_np", lambda *a: calls.append(1) or real(*a)
+    )
+    stats = eng.refresh_corpus(list(corpus) + [_new_word_template()])
+    assert not calls, "refresh paid a full layout rebuild"
+    assert stats["rows_reused"] == stats["tables_total"] - 1
+    assert stats["reused_leaves"] > 0
+
+    after = eng.match(rows)
+    fresh = MatchEngine(
+        list(corpus) + [_new_word_template()], mesh=None, batch_rows=16
+    )
+    want = fresh.match(rows)
+    assert _ids(after) == _ids(want)
+    assert [rm.extractions for rm in after] == [
+        rm.extractions for rm in want
+    ]
+    assert "delta-probe" in _ids(after)[-1]
+
+
+def test_refresh_bumps_shared_cache_epoch_exactly_once(corpus):
+    """The shared result tier moves namespace EXACTLY once per
+    refresh: one bind_corpus call, and the bound epoch's digest half
+    actually changed (stale entries unreachable)."""
+    from swarm_tpu.cache.tier import ResultCacheClient, SharedResultTier
+    from swarm_tpu.stores import MemoryBlobStore, MemoryStateStore
+
+    tier = SharedResultTier(MemoryStateStore(), MemoryBlobStore())
+    client = ResultCacheClient(tier, worker_id="delta")
+    eng = MatchEngine(list(corpus), mesh=None, batch_rows=16)
+    eng.attach_result_cache(client)
+    epoch_before = client.counters()["epoch"]
+    assert epoch_before
+
+    binds = []
+    real_bind = client.bind_corpus
+    client.bind_corpus = lambda d: binds.append(d) or real_bind(d)
+    eng.refresh_corpus(list(corpus) + [_new_word_template()])
+    assert len(binds) == 1
+    epoch_after = client.counters()["epoch"]
+    assert epoch_after and epoch_after != epoch_before
+
+
+def test_refresh_invalidates_content_memos(corpus):
+    """Memoized verdicts for the OLD corpus must not serve the new
+    one: the same content row re-resolves and picks up the added
+    template after the refresh."""
+    rows = _rows(corpus)
+    eng = MatchEngine(list(corpus), mesh=None, batch_rows=16)
+    r1 = eng.match(rows)
+    r1b = eng.match(rows)  # memo-warm second pass
+    assert _ids(r1) == _ids(r1b)
+    eng.refresh_corpus(list(corpus) + [_new_word_template()])
+    r2 = eng.match(rows)
+    assert "delta-probe" in _ids(r2)[-1]
+
+
+def test_noop_refresh_keeps_executables(corpus):
+    """Refreshing onto an identical corpus keeps the live executables
+    (trace signature unchanged) and uploads nothing — the refresh is
+    pure bookkeeping."""
+    rows = _rows(corpus, with_needle=False)
+    eng = MatchEngine(list(corpus), mesh=None, batch_rows=16)
+    r1 = eng.match(rows)
+    n_exec = eng.device.executable_count()
+    stats = eng.refresh_corpus(list(corpus))
+    assert stats["executables_kept"] is True
+    assert stats["uploaded_leaves"] == 0
+    assert eng.device.executable_count() == n_exec
+    r2 = eng.match(rows)
+    assert _ids(r1) == _ids(r2)

@@ -662,6 +662,8 @@ def test_lock_using_modules_carry_guard_annotations():
         "swarm_tpu/cache/tier.py",
         "swarm_tpu/gateway/admission.py",
         "swarm_tpu/server/journal.py",
+        "swarm_tpu/aot/store.py",
+        "swarm_tpu/aot/jitcache.py",
     ]
     bare = []
     for m in expected:

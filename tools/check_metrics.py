@@ -89,6 +89,14 @@ REQUIRED_FAMILIES = (
     "swarm_journal_corrupt_records_total",
     "swarm_queue_recovered_jobs_total",
     "swarm_queue_generation",
+    # AOT executable cache (docs/AOT.md): registered at telemetry
+    # import (aot_export), outcome/source combos pre-seeded and the
+    # artifact-bytes gauge zero-initialized — every family renders
+    # samples even in a store-free process
+    "swarm_aot_fetch_total",
+    "swarm_aot_publish_total",
+    "swarm_aot_bringup_seconds",
+    "swarm_aot_artifact_bytes",
 )
 
 

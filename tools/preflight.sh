@@ -64,13 +64,14 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=off python bench.py --smoke
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=on python bench.py --smoke
 
 echo "== preflight: chaos smoke (seeded fault plan, docs/RESILIENCE.md) =="
-# injected device + result-cache faults must leave verdicts
-# bit-identical (device-degraded mode falls back to the exact CPU
-# oracle; a faulted cache.get/cache.put trips the tier breaker and the
-# scan degrades to L1-only, docs/CACHING.md); rc gates on verdict
-# identity AND on the plan actually firing
+# injected device + result-cache + AOT-store faults must leave
+# verdicts bit-identical (device-degraded mode falls back to the exact
+# CPU oracle; a faulted cache.get/cache.put trips the tier breaker and
+# the scan degrades to L1-only, docs/CACHING.md; a faulted
+# aot.fetch/aot.put degrades the executable cache to compile-only,
+# docs/AOT.md); rc gates on verdict identity AND on the plan firing
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=on \
-    SWARM_FAULT_PLAN="seed=7;device.dispatch:1,3;cache.get:2,4;cache.put:1" \
+    SWARM_FAULT_PLAN="seed=7;device.dispatch:1,3;cache.get:2,4;cache.put:1;aot.fetch:1-2;aot.put:1" \
     python bench.py --smoke
 
 echo "== preflight: bench =="

@@ -99,6 +99,15 @@ def main() -> int:
         f"  compile: {matcher.compile_seconds:.2f}s over "
         f"{matcher.compile_count} executable(s)"
     )
+    # AOT executable cache (docs/AOT.md): a deserialized load is NOT a
+    # compile — report the fetch pair distinctly so a warm-fetch
+    # bring-up honestly shows 0 compiles instead of fast "compiles"
+    if matcher.fetch_count:
+        print(
+            f"  aot fetch: {matcher.fetch_seconds:.2f}s over "
+            f"{matcher.fetch_count} dispatch(es), "
+            f"{matcher.fetched_executable_count()} fetched executable(s)"
+        )
 
     if "--record-floor" in argv:
         rec = {

@@ -74,6 +74,9 @@ DEFAULT_TARGETS = (
     "swarm_tpu/ops/regexdev.py",
     "swarm_tpu/fingerprints/compile.py",
     "swarm_tpu/parallel/sharded.py",
+    # the AOT lowering entry point (docs/AOT.md): AotJit owns the
+    # explicit lower/compile path every managed kernel goes through
+    "swarm_tpu/aot/jitcache.py",
 )
 
 SYNC_CALLS = {"float", "int", "bool"}
