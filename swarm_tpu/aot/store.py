@@ -148,6 +148,7 @@ class AotStore(SharedResultTier):
             return None
         return meta, payload
 
+    # pairs: writer_token / _blobs.put; pairs: writer_token / _state.hset (fence re-check, docs/AOT.md)
     def put_artifact(
         self, epoch: str, digest: str, meta: str, payload: bytes,
         writer_id: str, token: int,
@@ -274,6 +275,7 @@ class AotClient:
             return self._epoch
 
     # -- breaker plumbing ---------------------------------------------
+    # may-block: wraps one artifact-store op behind the breaker
     def _guarded(self, point: str, detail: str, fn):
         from swarm_tpu.resilience.faults import fault_point
 

@@ -341,6 +341,7 @@ class SharedResultTier:
             out[digest] = raw
         return out
 
+    # pairs: writer_token / _state.hset_many; pairs: writer_token / _blobs.put (fence re-check, docs/CACHING.md)
     def put_many(
         self, family: str, epoch: str, items: list, writer_id: str,
         token: int,
@@ -425,7 +426,7 @@ def _process_token(tier: SharedResultTier, writer: str) -> int:
             per_tier = _PROC_TOKENS[tier] = {}
         token = per_tier.get(writer)
         if token is None:
-            token = per_tier[writer] = tier.acquire_writer(writer)
+            token = per_tier[writer] = tier.acquire_writer(writer)  # blocking-ok: one-time token mint per (tier, writer) — serialized registration IS the discipline (docs/CACHING.md)
         return token
 
 
@@ -479,6 +480,7 @@ class ResultCacheClient:
         # not each mint a token for the same identity — the loser's
         # token would disagree with the registry and every later
         # writeback would be silently fenced
+        # lock-order: _bind_lock -> _lock
         self._bind_lock = threading.Lock()
         self._recent_miss: dict = {}
         self._hits = 0
@@ -547,7 +549,7 @@ class ResultCacheClient:
                     tok = _process_token(self._tier, writer)
                 return f"{digest[:24]}.g{gen}", tok
 
-            out = self._guarded("cache.get", "bind", bind)
+            out = self._guarded("cache.get", "bind", bind)  # blocking-ok: the bind sequence (epoch read + token mint) is serialized by design — one guarded RTT per epoch TTL
             if out is None:
                 # re-read failed (breaker open / backend down): keep
                 # serving on the stale-by-≤TTL epoch if we have one —
@@ -562,6 +564,7 @@ class ResultCacheClient:
         return epoch
 
     # -- breaker plumbing ---------------------------------------------
+    # may-block: wraps one tier store op behind the breaker
     def _guarded(self, point: str, detail: str, fn):
         """Run one tier op behind the breaker; None = degraded (the
         caller treats it as a miss / dropped write)."""

@@ -48,6 +48,9 @@ except ImportError:  # pragma: no cover
     import sre_constants as sre_c  # type: ignore
 
 
+# swarmlint-exempt: _WARN_LOCK serializes the PROCESS-GLOBAL warnings
+# filter save/mutate/restore window (see quiet_warnings below) — there
+# is no module attribute to guard
 _WARN_LOCK = threading.Lock()
 
 

@@ -2895,6 +2895,7 @@ class MatchEngine:
     # ------------------------------------------------------------------
     # Shared result tier (docs/CACHING.md): L1 → shared → device
     # ------------------------------------------------------------------
+    # once: client.bind_corpus (attach binds the tier epoch exactly once)
     def attach_result_cache(self, client) -> None:
         """Attach a fleet-wide content-addressed result tier
         (:class:`swarm_tpu.cache.ResultCacheClient`). The client is
@@ -2932,6 +2933,7 @@ class MatchEngine:
         backend = self.sharded if self.sharded is not None else self.device
         return backend.aot_prewarm()
 
+    # once: _result_cache.bind_corpus (ONE shared-cache epoch move per refresh, docs/CACHING.md)
     def refresh_corpus(self, templates_new, db_new=None) -> dict:
         """Zero-downtime corpus refresh against a LIVE engine
         (docs/AOT.md): delta-compile the new template list against the

@@ -231,6 +231,7 @@ class QueueJournal:
         with self._lock:
             return self._segments
 
+    # orders: blobs.put < blobs.delete (snapshot durably lands before the segments it covers are pruned)
     def checkpoint(self, state: dict) -> int:
         """Fold ``state`` (the full queue state, journal-format) into a
         snapshot and prune the WAL segments it covers. Crash-safe:

@@ -124,10 +124,12 @@ class JobQueueService:
         # The journal lock serializes {append → store write} pairs
         # against {snapshot → checkpoint} — without it a checkpoint
         # could fold state that misses an appended-but-unapplied record
-        # whose segment it then prunes. Lock order: _lock → _journal_lock
-        # (checkpoint takes only _journal_lock, so no cycle). It guards
-        # an ORDERING, not a field — the journal's own counters carry
-        # their own guarded-by annotations (server/journal.py).
+        # whose segment it then prunes. It guards an ORDERING, not a
+        # field — the journal's own counters carry their own guarded-by
+        # annotations (server/journal.py). The acquisition order below
+        # is declared for the lockorder pass: checkpoint takes only
+        # _journal_lock, so no cycle.
+        # lock-order: _lock -> _journal_lock
         self._journal_lock = threading.RLock()
         if journal is None and cfg.journal_enabled:
             journal = QueueJournal(
@@ -322,6 +324,7 @@ class JobQueueService:
             raise ValueError("Invalid batch_size or chunk_index")
         return str(module), str(scan_id), tenant
 
+    # orders: _put_job < state.rpush (journaled record before the dispatch-list push)
     def queue_scan(
         self,
         job_data: dict,
@@ -339,7 +342,7 @@ class JobQueueService:
             # like every other mutation (recovery rebuilds the registry
             # and the per-tenant dispatch lists from these records)
             with self._journal_lock:
-                self._journal.append({"op": "tenant", "tenant": tenant})
+                self._journal.append({"op": "tenant", "tenant": tenant})  # blocking-ok: WAL append under _journal_lock is the append->apply atom (docs/DURABILITY.md)
         self.state.hset("tenants", tenant, "1")
         queue_list = self._queue_list(tenant)
         queued = 0
@@ -367,6 +370,9 @@ class JobQueueService:
         self._maybe_checkpoint()
         return {"scan_id": scan_id, "chunks": queued}
 
+    # orders: _journal.append < state.hset (append-before-ack, docs/DURABILITY.md)
+    # blocking-ok: the WAL append + record write under _journal_lock IS
+    # the append->apply atom the durability design requires
     def _put_job(self, job: Job) -> None:
         """Persist one job record, WRITE-AHEAD: the journal append is
         ordered before the state-store write (and therefore before any
@@ -395,6 +401,10 @@ class JobQueueService:
     # ------------------------------------------------------------------
     # Dispatch (reference get_job, server.py:465-515) + leases
     # ------------------------------------------------------------------
+    # orders: _put_job < state.hset (record-first: the lease index follows the journaled record)
+    # blocking-ok: dispatch atomicity — the pop->lease transition must be
+    # invisible to a concurrent renew/update (docs/DURABILITY.md), so the
+    # dispatch lock intentionally spans the control-plane store writes
     def next_job(self, worker_id: str) -> Optional[dict]:
         now = time.time()
         worker = self._load_worker(worker_id)
@@ -473,6 +483,9 @@ class JobQueueService:
         self._save_worker(worker)
         return None
 
+    # requires-lock: _lock (runs inside next_job's dispatch transaction)
+    # orders: _put_job < state.rpush; orders: _put_job < state.hdel (record-first requeue)
+    # blocking-ok: lease recovery is part of the dispatch transaction
     def _requeue_expired(self, now: float) -> None:
         """Lease enforcement: in-progress jobs whose lease lapsed go back
         to the queue (the reference loses them forever).
@@ -488,12 +501,12 @@ class JobQueueService:
                 pass
             raw = self.state.hget("jobs", job_id)
             if raw is None:
-                self.state.hdel("leases", job_id)
+                self.state.hdel("leases", job_id)  # protocol-ok: dangling lease (no job record) — nothing to journal
                 continue
             try:
                 job = Job.from_json(raw)
             except (ValueError, KeyError, TypeError):
-                self.state.hdel("leases", job_id)
+                self.state.hdel("leases", job_id)  # protocol-ok: unparseable record — index hygiene, no record mutation paired
                 continue
             # any ACTIVE status is leased: a worker dying mid-execution
             # leaves "executing" (not "in progress"), and its job must
@@ -501,7 +514,7 @@ class JobQueueService:
             # lost every job whose worker died after the first status
             # update (resilience PR regression find)
             if job.status not in JobStatus.ACTIVE or job.lease_expires_at is None:
-                self.state.hdel("leases", job_id)
+                self.state.hdel("leases", job_id)  # protocol-ok: terminal/unleased record — index hygiene, no record mutation paired
                 continue
             if job.lease_expires_at >= now:
                 continue
@@ -539,6 +552,8 @@ class JobQueueService:
         )
         job.failure_history = history
 
+    # requires-lock: _lock; orders: _put_job < state.hdel (record-first quarantine)
+    # blocking-ok: the terminal transition rides its caller's dispatch transaction
     def _quarantine(self, job: Job, reason: str) -> None:
         """Move a job to the dead-letter state (caller holds the lock
         and has already recorded the triggering failure)."""
@@ -561,6 +576,8 @@ class JobQueueService:
     # ------------------------------------------------------------------
     # Lease heartbeats (resilience PR): POST /renew-lease/<job_id>
     # ------------------------------------------------------------------
+    # orders: _put_job < state.hset (record-first lease extension)
+    # blocking-ok: the fenced renew must be atomic against dispatch/expiry
     def renew_lease(self, job_id: str, worker_id: Optional[str]) -> Optional[float]:
         """Extend a live lease for its current assignee. Returns the
         new expiry, or None when the renewal is rejected — unknown job,
@@ -613,6 +630,8 @@ class JobQueueService:
                 out.append(rec)
         return sorted(out, key=lambda r: r.get("job_id") or "")
 
+    # orders: _put_job < state.rpush (journaled record before the dispatch-list push)
+    # blocking-ok: the requeue transition must be atomic against dispatch
     def requeue_dead_letter(self, job_id: str) -> bool:
         """Operator action: put a quarantined job back in the queue
         with a fresh attempt budget (history is kept)."""
@@ -657,6 +676,9 @@ class JobQueueService:
         self._maybe_checkpoint()
         return out
 
+    # requires-lock: _lock (update_job wraps; fencing decision + transition atomicity)
+    # orders: _put_job < state.hdel; orders: _put_job < state.rpush (record-first, docs/DURABILITY.md)
+    # blocking-ok: the fenced status transition rides the dispatch lock by design
     def _update_job_locked(self, job_id: str, changes: dict) -> bool:
         job = self._get_job_record(job_id)
         if job is None:
@@ -692,14 +714,20 @@ class JobQueueService:
             and new_status != JobStatus.DEAD_LETTER
         ):
             self._record_failure(job, new_status)
-            self.state.hdel("leases", job_id)
             if job.attempts >= self.cfg.max_attempts:
                 self._quarantine(job, reason="attempts_exhausted")
             else:
                 job.status = JobStatus.QUEUED
                 job.worker_id = None
                 job.lease_expires_at = None
+                # journaled record FIRST, lease-index drop after: if the
+                # append fails the lease entry survives and the expiry
+                # scan retries this transition — dropping the lease
+                # first would strand an ACTIVE job nothing scans (the
+                # same rule _requeue_expired documents; found by the
+                # swarmlint protocol pass)
                 self._put_job(job)
+                self.state.hdel("leases", job_id)
                 self.state.rpush(self._queue_list(job.tenant), job.job_id)
                 _JOBS_RETRIED.labels(status=new_status).inc()
                 emit_event(
@@ -878,6 +906,8 @@ class JobQueueService:
         return True
 
     # ------------------------------------------------------------------
+    # blocking-ok: flush + journal clear must be one atom — a mutation
+    # interleaved between them would survive into the next boot's replay
     def reset(self) -> None:
         """Flush all queue/scan state (reference /reset, server.py:550-554)."""
         with self._journal_lock:
@@ -896,6 +926,9 @@ class JobQueueService:
     # ------------------------------------------------------------------
     # Durable journal: recovery + checkpointing (docs/DURABILITY.md)
     # ------------------------------------------------------------------
+    # requires-lock: _journal_lock
+    # blocking-ok: the snapshot read must exclude concurrent appends —
+    # that exclusion is the journal lock's documented purpose
     def _journal_state(self) -> dict:
         """The full queue state in journal-snapshot form. Callers hold
         ``_journal_lock`` so no append can land between this read and
@@ -917,6 +950,8 @@ class JobQueueService:
             "rr_cursor": self._rr_cursor,
         }
 
+    # blocking-ok: the snapshot->checkpoint pair holds _journal_lock so
+    # no append lands between the state read and the segment prune
     def _maybe_checkpoint(self) -> None:
         """Opportunistic compaction: fold the WAL into a snapshot once
         enough segments accumulated. Runs on mutating routes' threads
@@ -939,6 +974,8 @@ class JobQueueService:
                 # the WAL just keeps growing until a checkpoint lands
                 print(f"journal checkpoint failed (will retry): {e}")
 
+    # blocking-ok: boot-time recovery runs before any route thread exists;
+    # the post-recovery checkpoint holds _journal_lock like every other
     def recover(self) -> Optional[dict]:
         """Boot-time recovery: bump the server generation, replay the
         journal into the state store, reconcile against the idempotent

@@ -541,11 +541,11 @@ class MemoryBlobStore(BlobStore):
 
     def delete(self, key):
         with self._lock:
-            self._blobs.pop(key, None)
+            self._blobs.pop(key, None)  # blocking-ok: _blobs is the embedded store's own dict — this IS the O(1) store primitive
 
     def delete_all(self):
         with self._lock:
-            self._blobs.clear()
+            self._blobs.clear()  # blocking-ok: embedded store primitive — in-memory dict clear under its own lock
 
 
 class S3BlobStore(BlobStore):
@@ -624,7 +624,7 @@ class _MemoryCollection(DocCollection):
 
     def insert_one(self, doc):
         with self._lock:
-            self._docs.append(dict(doc))
+            self._docs.append(dict(doc))  # blocking-ok: _docs is the embedded collection's own list — this IS the store primitive
 
     def find_one(self, query):
         with self._lock:

@@ -232,6 +232,37 @@ def test_failed_append_during_requeue_keeps_lease_entry_for_retry():
     assert redone is not None and redone["job_id"] == job["job_id"]
 
 
+def test_failed_append_during_worker_failure_retry_keeps_lease_entry():
+    """_update_job_locked's retry path writes the journaled record
+    FIRST (a swarmlint protocol-pass find, docs/ANALYSIS.md): a journal
+    append failure during a fenced worker-reported failure must leave
+    the lease-index entry, so the expiry sweep retries the transition —
+    dropping the lease first stranded an ACTIVE job nothing scans."""
+    blobs = MemoryBlobStore()
+    svc = _service(blobs=blobs, lease_seconds=0.01)
+    _queue(svc, "wf_1", 1)
+    job = svc.next_job("w1")
+    install_plan("journal.append:1")
+    try:
+        with pytest.raises(JournalError):
+            svc.update_job(
+                job["job_id"], {"status": "failed", "worker_id": "w1"}
+            )
+    finally:
+        clear_plan()
+    # nothing half-applied: still leased, record still ACTIVE
+    assert svc.state.hget("leases", job["job_id"]) is not None
+    assert json.loads(
+        svc.state.hget("jobs", job["job_id"])
+    )["status"] in JobStatus.ACTIVE
+    # the lease lapses and the sweep completes the requeue
+    import time as _time
+
+    _time.sleep(0.05)
+    redone = svc.next_job("w2")
+    assert redone is not None and redone["job_id"] == job["job_id"]
+
+
 def test_failed_append_during_complete_does_not_feed_the_tail():
     """The legacy `completed` pop-list is only pushed AFTER the
     journaled record lands: an append failure must not emit a

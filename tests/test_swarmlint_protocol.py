@@ -1,0 +1,721 @@
+"""swarmlint v2 self-tests: protocol + lockorder + inventory passes
+and the CLI satellites (docs/ANALYSIS.md).
+
+Same doctrine as tests/test_swarmlint.py: every new rule gets a
+positive control (a fixture with the violation fires at the expected
+site) and a negative control (the disciplined twin stays silent), the
+real control-plane modules are pinned to DECLARE their contracts, and
+acceptance facts tie the passes to the repo as committed.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+from tools.swarmlint import inventory, lockorder, protocol
+from tools.swarmlint.__main__ import (
+    FIXTURE_DIR,
+    changed_files,
+    main as swarmlint_main,
+    selfcheck,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _write(tmp_path: Path, name: str, body: str) -> Path:
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return p
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# protocol pass: orders
+# ---------------------------------------------------------------------------
+
+ORDERS_FIXTURE = '''
+class Queue:
+    # orders: journal.append < state.hset
+    def good(self, job):
+        if self.journal is not None:
+            self.journal.append({"op": "job"})
+            self.state.hset("jobs", job.id, job.data)
+        else:
+            self.state.hset("jobs", job.id, job.data)
+
+    # orders: journal.append < state.hset
+    def bad(self, job):
+        self.state.hset("jobs", job.id, job.data)
+        self.journal.append({"op": "job"})
+
+    # orders: journal.append < state.hset
+    def bad_one_branch(self, job):
+        if job.urgent:
+            self.journal.append({"op": "job"})
+        self.state.hset("jobs", job.id, job.data)
+
+    # orders: journal.append < state.hset
+    def waived(self, job):
+        self.state.hset("jobs", job.id, job.data)  # protocol-ok: fixture — compensation write
+        self.journal.append({"op": "job"})
+'''
+
+
+def test_protocol_orders_controls(tmp_path):
+    p = _write(tmp_path, "fix_orders.py", ORDERS_FIXTURE)
+    findings = protocol.check_file(p)
+    order = _by_rule(findings, protocol.RULE_ORDER)
+    # bad (wrong order) and bad_one_branch (one path misses the append)
+    # fire; good (None-guard suspends the journal-less branch) and the
+    # waived site are silent
+    assert sorted(f.symbol for f in order) == [
+        "Queue.bad", "Queue.bad_one_branch",
+    ]
+    assert not [f for f in findings if "good" in f.symbol]
+    assert not [f for f in order if "waived" in f.symbol]
+
+
+ORDERS_LOOP_FIXTURE = '''
+class Queue:
+    # orders: put_job < state.rpush
+    def good_loop(self, chunks):
+        for chunk in chunks:
+            self.put_job(chunk)
+            self.state.rpush("q", chunk.id)
+
+    # orders: put_job < state.rpush
+    def bad_loop(self, chunks):
+        for chunk in chunks:
+            self.state.rpush("q", chunk.id)
+            self.put_job(chunk)
+'''
+
+
+def test_protocol_orders_is_per_path_not_loop_carried(tmp_path):
+    """An iteration's rpush must follow an iteration's put_job — the
+    previous iteration's put_job satisfying THIS iteration's push is
+    the bounded-unrolling trap the pass must not fall into for the
+    in-body sequence, while a correct in-body order stays silent."""
+    p = _write(tmp_path, "fix_loop.py", ORDERS_LOOP_FIXTURE)
+    findings = protocol.check_file(p)
+    order = _by_rule(findings, protocol.RULE_ORDER)
+    assert [f.symbol for f in order] == ["Queue.bad_loop"]
+
+
+# ---------------------------------------------------------------------------
+# protocol pass: pairs (fence check-before-and-after)
+# ---------------------------------------------------------------------------
+
+PAIRS_FIXTURE = '''
+class Tier:
+    # pairs: writer_token / state.hset_many
+    def good(self, items, writer, token):
+        if self.writer_token(writer) != token:
+            return "fenced"
+        self.state.hset_many("entries", items)
+        if self.writer_token(writer) != token:
+            return "fenced"
+        return "stored"
+
+    # pairs: writer_token / state.hset_many
+    def missing_before(self, items, writer, token):
+        self.state.hset_many("entries", items)
+        if self.writer_token(writer) != token:
+            return "fenced"
+        return "stored"
+
+    # pairs: writer_token / state.hset_many
+    def missing_after(self, items, writer, token):
+        if self.writer_token(writer) != token:
+            return "fenced"
+        self.state.hset_many("entries", items)
+        return "stored"
+
+    # pairs: writer_token / state.hset_many
+    def missing_after_one_path(self, items, writer, token):
+        if self.writer_token(writer) != token:
+            return "fenced"
+        self.state.hset_many("entries", items)
+        if items:
+            return "stored"  # early exit skips the re-check
+        if self.writer_token(writer) != token:
+            return "fenced"
+        return "stored"
+'''
+
+
+def test_protocol_pairs_controls(tmp_path):
+    p = _write(tmp_path, "fix_pairs.py", PAIRS_FIXTURE)
+    findings = protocol.check_file(p)
+    pair = _by_rule(findings, protocol.RULE_PAIR)
+    got = sorted((f.symbol, f.detail.rsplit(":", 1)[-1]) for f in pair)
+    assert got == [
+        ("Tier.missing_after", "after"),
+        ("Tier.missing_after_one_path", "after"),
+        ("Tier.missing_before", "before"),
+    ]
+    assert not [f for f in pair if f.symbol == "Tier.good"]
+
+
+# ---------------------------------------------------------------------------
+# protocol pass: once (epoch bump exactly once)
+# ---------------------------------------------------------------------------
+
+ONCE_FIXTURE = '''
+class Engine:
+    # once: cache.bind_corpus
+    def good(self, digest):
+        if self.cache is not None:
+            self.cache.bind_corpus(digest)
+        return True
+
+    # once: cache.bind_corpus
+    def double(self, digest):
+        self.cache.bind_corpus(digest)
+        self.cache.bind_corpus(digest)
+
+    # once: cache.bind_corpus
+    def skipped_path(self, digest):
+        if digest:
+            self.cache.bind_corpus(digest)
+        return True
+
+    # once: cache.bind_corpus
+    def alias_good(self, digest):
+        client = self.cache
+        if client is None:
+            return False
+        client.bind_corpus(digest)
+        return True
+'''
+
+
+def test_protocol_once_controls(tmp_path):
+    p = _write(tmp_path, "fix_once.py", ONCE_FIXTURE)
+    findings = protocol.check_file(p)
+    once = _by_rule(findings, protocol.RULE_ONCE)
+    got = sorted((f.symbol, f.detail.rsplit(":", 1)[-1]) for f in once)
+    # double fires 'twice'; skipped_path fires 'missing' (the guard is
+    # not a None-test on the event's receiver, so no suspension); the
+    # None-guarded good and the local-alias twin are silent
+    assert got == [
+        ("Engine.double", "twice"),
+        ("Engine.skipped_path", "missing"),
+    ]
+
+
+def test_protocol_unmatched_event_is_config_finding(tmp_path):
+    p = _write(tmp_path, "fix_unmatched.py", '''
+class C:
+    # orders: journal.append < state.hset
+    def renamed(self):
+        self.journal.append({})
+        self.state.hset_all("jobs", {})
+''')
+    findings = protocol.check_file(p)
+    cfg = _by_rule(findings, protocol.RULE_CONFIG)
+    assert any("matches no call" in f.message for f in cfg)
+
+
+def test_protocol_empty_waiver_reason_is_config_finding(tmp_path):
+    p = _write(tmp_path, "fix_emptywaiver.py", '''
+class C:
+    # orders: journal.append < state.hset
+    def bad(self):
+        self.state.hset("jobs", 1, 2)  # protocol-ok:
+        self.journal.append({})
+''')
+    findings = protocol.check_file(p)
+    cfg = _by_rule(findings, protocol.RULE_CONFIG)
+    assert any("needs a reason" in f.message for f in cfg)
+
+
+def test_protocol_contracts_declared_on_control_plane():
+    """The prose invariants of docs/DURABILITY.md / CACHING.md / AOT.md
+    are now DECLARED annotations the pass enforces — pin them the way
+    test_lock_using_modules pins guard annotations."""
+    q = protocol.declared_contracts(REPO / "swarm_tpu/server/queue.py")
+    kinds = {
+        sym: {(c.kind, c.label()) for c in cs} for sym, cs in q.items()
+    }
+    assert ("orders", "_journal.append < state.hset") in kinds[
+        "JobQueueService._put_job"
+    ]
+    for sym in (
+        "JobQueueService.next_job",
+        "JobQueueService._requeue_expired",
+        "JobQueueService._update_job_locked",
+    ):
+        assert any(k == "orders" for k, _l in kinds[sym]), sym
+    t = protocol.declared_contracts(REPO / "swarm_tpu/cache/tier.py")
+    assert {
+        ("pairs", "writer_token / _state.hset_many"),
+        ("pairs", "writer_token / _blobs.put"),
+    } <= {(c.kind, c.label()) for c in t["SharedResultTier.put_many"]}
+    a = protocol.declared_contracts(REPO / "swarm_tpu/aot/store.py")
+    assert any(
+        c.kind == "pairs" for c in a["AotStore.put_artifact"]
+    )
+    e = protocol.declared_contracts(REPO / "swarm_tpu/ops/engine.py")
+    assert any(
+        c.kind == "once" and "bind_corpus" in c.label()
+        for c in e["MatchEngine.refresh_corpus"]
+    )
+    j = protocol.declared_contracts(REPO / "swarm_tpu/server/journal.py")
+    assert ("orders", "blobs.put < blobs.delete") in {
+        (c.kind, c.label()) for c in j["QueueJournal.checkpoint"]
+    }
+
+
+# ---------------------------------------------------------------------------
+# lockorder pass
+# ---------------------------------------------------------------------------
+
+CYCLE_FIXTURE = '''
+import threading
+
+
+class Locks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+
+def test_lockorder_cycle_detected(tmp_path):
+    p = _write(tmp_path, "fix_cycle.py", CYCLE_FIXTURE)
+    findings = lockorder.run([p])
+    cyc = _by_rule(findings, lockorder.RULE_CYCLE)
+    assert len(cyc) == 1
+    assert "_a" in cyc[0].message and "_b" in cyc[0].message
+
+
+def test_lockorder_consistent_order_is_silent(tmp_path):
+    p = _write(tmp_path, "fix_nocycle.py", '''
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def one():
+    with _a:
+        with _b:
+            pass
+
+
+def two():
+    with _a:
+        with _b:
+            pass
+''')
+    assert lockorder.run([p]) == []
+
+
+def test_lockorder_declared_edge_joins_the_graph(tmp_path):
+    """A '# lock-order:' declaration closes a cycle the lexical view
+    alone cannot see (the callee-takes-its-own-lock case)."""
+    p = _write(tmp_path, "fix_declared.py", '''
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+# lock-order: _b -> _a
+
+
+def one():
+    with _a:
+        with _b:
+            pass
+''')
+    findings = lockorder.run([p])
+    assert [f.rule for f in findings] == [lockorder.RULE_CYCLE]
+
+
+def test_lockorder_multi_item_with_counts_as_ordered_acquisition(tmp_path):
+    """`with a, b:` acquires in item order — the combined form must
+    contribute the a->b edge (and catch `with a, a:` self-deadlock),
+    or an ABBA deadlock whose forward half is combined slips through."""
+    p = _write(tmp_path, "fix_multiwith.py", '''
+import threading
+
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def combined(self):
+        with self._a, self._b:
+            pass
+
+    def reversed_nested(self):
+        with self._b:
+            with self._a:
+                pass
+
+    def double(self):
+        with self._a, self._a:
+            pass
+''')
+    findings = lockorder.run([p])
+    cyc = _by_rule(findings, lockorder.RULE_CYCLE)
+    assert any("self-deadlock" in f.message for f in cyc)
+    assert any("_a" in f.message and "_b" in f.message
+               and "cycle" in f.message for f in cyc)
+
+
+def test_protocol_try_else_runs_only_on_no_exception_path(tmp_path):
+    """`else` executes only when the try body raised nothing: a once-
+    event split across handler and else is NOT a double call, and an
+    else-side re-check must not be credited to handler paths."""
+    p = _write(tmp_path, "fix_tryelse.py", '''
+class C:
+    # once: cache.bump_epoch
+    def split_once(self):
+        try:
+            self.compile()
+        except ValueError:
+            self.cache.bump_epoch()
+        else:
+            self.cache.bump_epoch()
+
+    # pairs: writer_token / state.hset
+    def recheck_in_else_reraise(self, w, t):
+        if self.writer_token(w) != t:
+            return "fenced"
+        try:
+            self.state.hset("jobs", 1, 2)
+        except ValueError:
+            raise
+        else:
+            if self.writer_token(w) != t:
+                return "fenced"
+        return "stored"
+
+    # pairs: writer_token / state.hset
+    def handler_returns_unchecked(self, w, t):
+        if self.writer_token(w) != t:
+            return "fenced"
+        try:
+            self.state.hset("jobs", 1, 2)
+        except ValueError:
+            return "error"
+        else:
+            if self.writer_token(w) != t:
+                return "fenced"
+        return "stored"
+''')
+    findings = protocol.check_file(p)
+    once = _by_rule(findings, protocol.RULE_ONCE)
+    assert not [f for f in once if "twice" in f.detail], [
+        f.render() for f in once
+    ]
+    # re-raise handler + else-side re-check: every normal exit is
+    # covered, silent; a handler that RETURNS after a possibly-landed
+    # write without re-checking is the real gap and must fire (the
+    # else-side check cannot be credited to the handler path)
+    pair = _by_rule(findings, protocol.RULE_PAIR)
+    assert [f.symbol for f in pair] == ["C.handler_returns_unchecked"], [
+        f.render() for f in pair
+    ]
+
+
+def test_changed_with_update_baseline_is_rejected(capsys):
+    """A partial scan must never rewrite the baseline — it would drop
+    every unchanged-file entry with its written justification."""
+    import pytest
+
+    with pytest.raises(SystemExit):
+        swarmlint_main(["--changed", "--update-baseline"])
+    capsys.readouterr()
+
+
+def test_lockorder_self_reacquire(tmp_path):
+    p = _write(tmp_path, "fix_self.py", '''
+import threading
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rlock = threading.RLock()
+
+    def deadlocks(self):
+        with self._lock:
+            with self._lock:
+                pass
+
+    def reentrant_ok(self):
+        with self._rlock:
+            with self._rlock:
+                pass
+''')
+    findings = lockorder.run([p])
+    cyc = _by_rule(findings, lockorder.RULE_CYCLE)
+    assert [f.symbol for f in cyc] == ["C.deadlocks"]
+
+
+BLOCKING_FIXTURE = '''
+import threading
+import time
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad_store(self):
+        with self._lock:
+            self.state.hgetall("jobs")
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(1)
+
+    def bad_wait(self, fut):
+        with self._lock:
+            fut.result()
+
+    def snapshot_then_render(self):
+        with self._lock:
+            snap = dict(self.table)
+        self.state.hset_many("jobs", snap)
+
+    def waived(self):
+        with self._lock:
+            self.state.hgetall("jobs")  # blocking-ok: fixture — embedded store, O(1)
+
+    # blocking-ok: fixture — this function IS the journaled atom
+    def blessed(self):
+        with self._lock:
+            self.state.hset("jobs", 1, 2)
+
+    def string_join_ok(self, parts):
+        with self._lock:
+            return "|".join(parts)
+'''
+
+
+def test_lockorder_blocking_controls(tmp_path):
+    p = _write(tmp_path, "fix_blocking.py", BLOCKING_FIXTURE)
+    findings = lockorder.run([p])
+    blk = _by_rule(findings, lockorder.RULE_BLOCK)
+    assert sorted(f.symbol for f in blk) == [
+        "C.bad_sleep", "C.bad_store", "C.bad_wait",
+    ]
+    for silent in ("snapshot_then_render", "waived", "blessed",
+                   "string_join_ok"):
+        assert not [f for f in findings if silent in f.symbol], silent
+
+
+def test_lockorder_may_block_propagates_and_requires_lock_counts(tmp_path):
+    p = _write(tmp_path, "fix_mayblock.py", '''
+import threading
+
+_lock = threading.Lock()
+
+
+# may-block: wraps a store op behind a breaker
+def _guarded(fn):
+    return fn()
+
+
+def bad():
+    with _lock:
+        _guarded(lambda: 1)
+
+
+def helper():  # requires-lock: _lock
+    _guarded(lambda: 1)
+
+
+def outside():
+    _guarded(lambda: 1)
+''')
+    findings = lockorder.run([p])
+    blk = _by_rule(findings, lockorder.RULE_BLOCK)
+    assert sorted(f.symbol for f in blk) == ["bad", "helper"]
+
+
+def test_lockorder_unknown_declared_lock_is_config(tmp_path):
+    p = _write(tmp_path, "fix_badedge.py", '''
+import threading
+
+_a = threading.Lock()
+# lock-order: _a -> _missing
+''')
+    findings = lockorder.run([p])
+    cfg = _by_rule(findings, lockorder.RULE_CONFIG)
+    assert cfg and "unknown lock" in cfg[0].message
+
+
+def test_lockorder_real_graph_declares_queue_journal_edge():
+    """The queue's documented _lock -> _journal_lock ordering is a
+    DECLARED edge, and the repo-wide graph is acyclic (the clean HEAD
+    acceptance below depends on it)."""
+    edges = lockorder.lock_graph(
+        [REPO / "swarm_tpu/server/queue.py",
+         REPO / "swarm_tpu/cache/tier.py"]
+    )
+    assert (
+        ("swarm_tpu/server/queue.py", "_lock"),
+        ("swarm_tpu/server/queue.py", "_journal_lock"),
+        True,
+    ) in edges
+    assert (
+        ("swarm_tpu/cache/tier.py", "_bind_lock"),
+        ("swarm_tpu/cache/tier.py", "_lock"),
+        False,
+    ) in edges
+
+
+# ---------------------------------------------------------------------------
+# inventory pass
+# ---------------------------------------------------------------------------
+
+def test_inventory_bare_exempt_and_annotated(tmp_path):
+    bare = _write(tmp_path, "fix_bare.py", '''
+import threading
+
+_lock = threading.Lock()
+''')
+    annotated = _write(tmp_path, "fix_annotated.py", '''
+import threading
+
+_lock = threading.Lock()
+_n = 0  # guarded-by: _lock
+''')
+    exempt = _write(tmp_path, "fix_exempt.py", '''
+# swarmlint-exempt: fixture — lock serializes an external resource
+import threading
+
+_lock = threading.Lock()
+''')
+    empty = _write(tmp_path, "fix_emptyexempt.py", '''
+# swarmlint-exempt:
+import threading
+
+_lock = threading.Lock()
+''')
+    nolock = _write(tmp_path, "fix_nolock.py", "X = 1\n")
+    assert [f.rule for f in inventory.run([bare])] == [inventory.RULE_BARE]
+    assert inventory.run([annotated]) == []
+    assert inventory.run([exempt]) == []
+    assert [f.rule for f in inventory.run([empty])] == [
+        inventory.RULE_CONFIG
+    ]
+    assert inventory.run([nolock]) == []
+
+
+def test_inventory_discovery_replaces_the_hand_list():
+    """discover() finds the lock-declaring control-plane modules the
+    old hand-maintained list named — and every discovered lock module
+    on HEAD is annotated or exempt (the pass fires nothing)."""
+    inv = inventory.discover()
+    rels = {p.relative_to(REPO).as_posix(): flags for p, flags in inv.items()}
+    for must in (
+        "swarm_tpu/server/queue.py",
+        "swarm_tpu/cache/tier.py",
+        "swarm_tpu/aot/store.py",
+        "swarm_tpu/telemetry/metrics.py",
+        "swarm_tpu/resilience/breaker.py",
+    ):
+        assert must in rels, must
+        assert rels[must]["locks"], must
+    assert inventory.run(sorted(inv)) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI satellites: --format, --changed, --selfcheck, exit codes
+# ---------------------------------------------------------------------------
+
+def test_format_json_and_sarif(tmp_path, capsys):
+    fixture = _write(tmp_path, "fix_fmt.py", '''
+import threading
+
+_lk = threading.Lock()
+_shared = []  # guarded-by: _lk
+
+
+def racy():
+    _shared.append(1)
+''')
+    out_json = tmp_path / "findings.json"
+    rc = swarmlint_main([
+        "--pass", "guards", "--paths", str(fixture),
+        "--format", "json", "--output", str(out_json),
+    ])
+    assert rc == 1
+    doc = json.loads(out_json.read_text())
+    assert doc["tool"] == "swarmlint" and not doc["ok"]
+    assert doc["new"][0]["rule"] == "guard-write"
+    assert doc["new"][0]["fingerprint"]
+
+    out_sarif = tmp_path / "findings.sarif"
+    rc = swarmlint_main([
+        "--pass", "guards", "--paths", str(fixture),
+        "--format", "sarif", "--output", str(out_sarif),
+    ])
+    assert rc == 1
+    sarif = json.loads(out_sarif.read_text())
+    assert sarif["version"] == "2.1.0"
+    res = sarif["runs"][0]["results"]
+    assert res[0]["ruleId"] == "guard-write"
+    assert res[0]["locations"][0]["physicalLocation"][
+        "artifactLocation"]["uri"].endswith("fix_fmt.py")
+    capsys.readouterr()
+
+
+def test_changed_mode_sees_the_repo():
+    """--changed resolves a merge-base in this repo (a usable git
+    checkout) and the changed subset of a clean-or-annotated HEAD
+    exits 0 like the full run."""
+    changed = changed_files()
+    assert changed is not None
+    assert swarmlint_main(["--changed"]) == 0
+
+
+def test_selfcheck_all_passes_bite(capsys):
+    assert selfcheck() == 0
+    capsys.readouterr()
+
+
+def test_fixture_violations_exit_one_for_every_new_pass():
+    """Acceptance: the bundled broken fixtures exit non-zero against
+    the REAL baseline for each new pass — the preflight selfcheck's
+    exit-1 guarantee, pinned per pass."""
+    for which, name in (
+        ("protocol", "broken_protocol.py"),
+        ("lockorder", "broken_lockorder.py"),
+        ("inventory", "broken_inventory.py"),
+    ):
+        rc = swarmlint_main(
+            ["--pass", which, "--paths", str(FIXTURE_DIR / name)]
+        )
+        assert rc == 1, which
+
+
+def test_protocol_and_lockorder_clean_on_head():
+    """Acceptance: both new passes run over their default scopes on
+    the repo as committed and report nothing — every real finding they
+    surfaced was fixed in this PR (the _update_job_locked record-first
+    fix) or carries a written waiver."""
+    assert swarmlint_main(["--pass", "protocol", "--pass", "lockorder",
+                           "--pass", "inventory"]) == 0

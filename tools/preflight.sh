@@ -8,10 +8,18 @@
 set -e
 cd "$(dirname "$0")/.."
 
+echo "== preflight: swarmlint selfcheck (docs/ANALYSIS.md) =="
+# every pass must still fire on its deliberately-broken bundled
+# fixture — guards against a pass that silently stops matching
+python -m tools.swarmlint --selfcheck
+
 echo "== preflight: swarmlint (static analysis, docs/ANALYSIS.md) =="
-# three passes — lock discipline, jit hygiene, native audit — diffed
-# against the justified-suppressions baseline; any NEW finding fails
-python -m tools.swarmlint
+# six passes — lock discipline, jit hygiene, native audit, protocol
+# ordering, lock-order/blocking, module inventory — diffed against the
+# justified-suppressions baseline; any NEW finding fails. Machine-
+# readable findings are archived next to the tier-1 log for CI
+# annotation tooling.
+python -m tools.swarmlint --format json --output /tmp/swarmlint.json
 
 echo "== preflight: ASan/UBSan native audit (docs/ANALYSIS.md) =="
 # rebuild the three .so under ASan+UBSan and rerun the native-pass

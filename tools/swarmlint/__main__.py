@@ -1,8 +1,11 @@
-"""swarmlint entry point — run all three passes, diff against the
-baseline, exit non-zero on any NEW finding (docs/ANALYSIS.md).
+"""swarmlint entry point — run all passes, diff against the baseline,
+exit non-zero on any NEW finding (docs/ANALYSIS.md).
 
     python -m tools.swarmlint                 # full run (preflight step)
+    python -m tools.swarmlint --changed       # only files vs merge-base
     python -m tools.swarmlint --json          # machine-readable findings
+    python -m tools.swarmlint --format sarif --output findings.sarif
+    python -m tools.swarmlint --selfcheck     # prove the passes still bite
     python -m tools.swarmlint --no-baseline   # raw findings, no diff
     python -m tools.swarmlint --update-baseline
         # rewrite baseline.json from the current findings; existing
@@ -18,13 +21,22 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
+from typing import Optional
 
 # Allow running as `python tools/swarmlint/__main__.py` too
 sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
 
-from tools.swarmlint import guards, jithygiene, native_audit  # noqa: E402
+from tools.swarmlint import (  # noqa: E402
+    guards,
+    inventory,
+    jithygiene,
+    lockorder,
+    native_audit,
+    protocol,
+)
 from tools.swarmlint.common import (  # noqa: E402
     BASELINE_PATH,
     REPO_ROOT,
@@ -33,16 +45,26 @@ from tools.swarmlint.common import (  # noqa: E402
     diff_against_baseline,
 )
 
-PASSES = ("guards", "jit", "native")
+PASSES = ("guards", "jit", "native", "protocol", "lockorder", "inventory")
+
+
+def _swarm_py() -> list[Path]:
+    return [
+        p
+        for p in sorted((REPO_ROOT / "swarm_tpu").rglob("*.py"))
+        if "__pycache__" not in p.parts
+    ]
 
 
 def default_paths(which: str) -> list[Path]:
-    if which == "guards":
-        return [
-            p
-            for p in (REPO_ROOT / "swarm_tpu").rglob("*.py")
-            if "__pycache__" not in p.parts
-        ]
+    if which in ("guards", "protocol"):
+        return _swarm_py()
+    if which == "lockorder":
+        # the auto-discovered inventory: lock declarers + store
+        # importers (docs/ANALYSIS.md §inventory)
+        return sorted(inventory.discover())
+    if which == "inventory":
+        return _swarm_py()
     if which == "jit":
         return [
             REPO_ROOT / t
@@ -54,7 +76,41 @@ def default_paths(which: str) -> list[Path]:
     raise ValueError(which)
 
 
-def collect(passes, paths_override=None) -> list[Finding]:
+RUNNERS = {
+    "guards": guards.run,
+    "jit": jithygiene.run,
+    "native": native_audit.run,
+    "protocol": protocol.run,
+    "lockorder": lockorder.run,
+    "inventory": inventory.run,
+}
+
+
+def changed_files() -> Optional[set[Path]]:
+    """Files differing from the merge-base with main (committed or in
+    the working tree) plus untracked files; None when git is unusable
+    (the caller falls back to a full run)."""
+    def git(*args: str):
+        return subprocess.run(
+            ["git", "-C", str(REPO_ROOT), *args],
+            capture_output=True, text=True,
+        )
+
+    mb = git("merge-base", "HEAD", "main")
+    base = mb.stdout.strip() if mb.returncode == 0 else "HEAD"
+    diff = git("diff", "--name-only", base)
+    if diff.returncode != 0:
+        return None
+    names = {l.strip() for l in diff.stdout.splitlines() if l.strip()}
+    untracked = git("ls-files", "--others", "--exclude-standard")
+    if untracked.returncode == 0:
+        names |= {
+            l.strip() for l in untracked.stdout.splitlines() if l.strip()
+        }
+    return {(REPO_ROOT / n).resolve() for n in names}
+
+
+def collect(passes, paths_override=None, changed=None) -> list[Finding]:
     findings: list[Finding] = []
     for which in passes:
         paths = (
@@ -62,12 +118,10 @@ def collect(passes, paths_override=None) -> list[Finding]:
             if paths_override
             else default_paths(which)
         )
-        if which == "guards":
-            findings.extend(guards.run(paths))
-        elif which == "jit":
-            findings.extend(jithygiene.run(paths))
-        elif which == "native":
-            findings.extend(native_audit.run(paths))
+        if changed is not None:
+            paths = [p for p in paths if p.resolve() in changed]
+        if paths:
+            findings.extend(RUNNERS[which](paths))
     # nested defs are reachable from several enclosing walks (e.g. a
     # jitted def inside a factory inside a method) — report each site once
     seen: set[tuple] = set()
@@ -80,17 +134,147 @@ def collect(passes, paths_override=None) -> list[Finding]:
     return unique
 
 
+# ---------------------------------------------------------------------------
+# Machine-readable emitters (--format json|sarif)
+# ---------------------------------------------------------------------------
+
+def _finding_dict(f: Finding) -> dict:
+    d = dict(f.__dict__)
+    d["fingerprint"] = f.fingerprint
+    return d
+
+
+def emit_json(findings: list[Finding], res, passes) -> str:
+    payload = {
+        "version": 1,
+        "tool": "swarmlint",
+        "passes": list(passes),
+        "new": [_finding_dict(f) for f in (res.new if res else findings)],
+        "suppressed": len(res.suppressed) if res else 0,
+        "unjustified": res.unjustified if res else [],
+        "stale": res.stale if res else [],
+        "ok": res.ok if res else not findings,
+    }
+    return json.dumps(payload, indent=2)
+
+
+def emit_sarif(findings: list[Finding], res, passes) -> str:
+    new = res.new if res else findings
+    rules = sorted({f.rule for f in new})
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+            "partialFingerprints": {"swarmlint/v1": f.fingerprint},
+        }
+        for f in new
+    ]
+    return json.dumps({
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "swarmlint",
+                "informationUri": "docs/ANALYSIS.md",
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": results,
+        }],
+    }, indent=2)
+
+
+# ---------------------------------------------------------------------------
+# Selfcheck (--selfcheck): deliberately-broken fixtures must keep firing
+# ---------------------------------------------------------------------------
+
+FIXTURE_DIR = Path(__file__).resolve().parent / "fixtures"
+
+#: pass -> (fixture file, rules that MUST fire on it). If a pass stops
+#: producing these findings it has silently lost its teeth — preflight
+#: fails loudly instead of green-lighting a toothless analyzer.
+SELFCHECK = {
+    "guards": ("broken_guards.py", {guards.RULE_WRITE}),
+    "jit": ("broken_jit.py", {jithygiene.RULE_CAPTURE}),
+    "native": ("broken_native.cpp", {native_audit.RULE_UNCHECKED}),
+    "protocol": (
+        "broken_protocol.py",
+        {protocol.RULE_ORDER, protocol.RULE_PAIR, protocol.RULE_ONCE},
+    ),
+    "lockorder": (
+        "broken_lockorder.py",
+        {lockorder.RULE_CYCLE, lockorder.RULE_BLOCK},
+    ),
+    "inventory": ("broken_inventory.py", {inventory.RULE_BARE}),
+}
+
+
+def selfcheck() -> int:
+    ok = True
+    for which, (name, expected) in SELFCHECK.items():
+        fixture = FIXTURE_DIR / name
+        if not fixture.exists():
+            print(f"selfcheck FAIL: missing fixture {fixture}")
+            ok = False
+            continue
+        fired = {f.rule for f in RUNNERS[which]([fixture])}
+        missing = expected - fired
+        if missing:
+            print(
+                f"selfcheck FAIL: pass {which!r} no longer fires "
+                f"{sorted(missing)} on {name} (fired: {sorted(fired)})"
+            )
+            ok = False
+        else:
+            print(
+                f"selfcheck ok: {which} fires {sorted(expected)} on {name}"
+            )
+    if ok:
+        print("swarmlint selfcheck OK: every pass still bites")
+        return 0
+    return 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="swarmlint")
     ap.add_argument(
         "--pass", dest="passes", action="append", choices=PASSES,
-        help="run only this pass (repeatable; default: all three)",
+        help="run only this pass (repeatable; default: all)",
     )
     ap.add_argument(
         "--paths", nargs="+",
         help="override the scanned files (use with --pass)",
     )
-    ap.add_argument("--json", action="store_true")
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="lint only files differing from the merge-base with main "
+        "(fast local iteration; the full pass stays the preflight "
+        "default)",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="shorthand for --format json to stdout")
+    ap.add_argument(
+        "--format", choices=("json", "sarif"), default=None,
+        help="emit machine-readable findings (CI annotations)",
+    )
+    ap.add_argument(
+        "--output", type=Path, default=None,
+        help="write the --format payload here instead of stdout",
+    )
+    ap.add_argument(
+        "--selfcheck", action="store_true",
+        help="run every pass over its deliberately-broken bundled "
+        "fixture and fail unless the expected findings fire",
+    )
     ap.add_argument(
         "--no-baseline", action="store_true",
         help="report raw findings without the baseline diff",
@@ -108,8 +292,32 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
     passes = args.passes or list(PASSES)
+    if args.json and args.format is None:
+        args.format = "json"
+    if args.changed and args.update_baseline:
+        # a partial scan sees only changed-file findings; rewriting the
+        # baseline from it would silently delete every unchanged-file
+        # entry along with its human-written justification
+        ap.error("--update-baseline needs the full scan; drop --changed")
 
-    findings = collect(passes, args.paths)
+    if args.selfcheck:
+        return selfcheck()
+
+    changed = None
+    if args.changed:
+        changed = changed_files()
+        if changed is None:
+            print(
+                "swarmlint: --changed needs a usable git repo — "
+                "falling back to the full run", file=sys.stderr,
+            )
+        else:
+            print(
+                f"swarmlint --changed: {len(changed)} changed file(s) "
+                f"vs merge-base"
+            )
+
+    findings = collect(passes, args.paths, changed)
 
     if args.update_baseline:
         old = Baseline.load(args.baseline)
@@ -140,21 +348,24 @@ def main(argv=None) -> int:
                 print(f"  {e['fingerprint']}  {e['location']}")
         return 0
 
+    res = None
+    if not args.no_baseline:
+        res = diff_against_baseline(findings, Baseline.load(args.baseline))
+
+    if args.format:
+        emit = emit_json if args.format == "json" else emit_sarif
+        payload = emit(findings, res, passes)
+        if args.output:
+            args.output.write_text(payload + "\n")
+            print(f"swarmlint: wrote {args.format} -> {args.output}")
+        else:
+            print(payload)
+
     if args.no_baseline:
         for f in findings:
             print(f.render())
-        if args.json:
-            print(json.dumps([f.__dict__ for f in findings], indent=2))
         return 1 if findings else 0
 
-    res = diff_against_baseline(findings, Baseline.load(args.baseline))
-    if args.json:
-        print(json.dumps({
-            "new": [f.__dict__ for f in res.new],
-            "suppressed": len(res.suppressed),
-            "unjustified": res.unjustified,
-            "stale": res.stale,
-        }, indent=2))
     if res.new:
         print(
             f"swarmlint: {len(res.new)} NEW finding(s) "

@@ -11,6 +11,7 @@ there" is not a justification).
 
 from __future__ import annotations
 
+import ast
 import hashlib
 import io
 import json
@@ -55,6 +56,44 @@ def rel(path: Path | str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# AST helpers shared by the Python-source passes (guards / protocol /
+# lockorder) — one attribute-chain walker, so the passes can never
+# diverge on which calls they see
+# ---------------------------------------------------------------------------
+
+def dotted_path(node: ast.AST) -> Optional[tuple[str, ...]]:
+    """Name/Attribute chain -> path tuple. ``self.a.b`` ->
+    ("self","a","b"); ``x`` -> ("x",). None for anything else (calls,
+    subscripts...)."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The name a ``with`` subject 'holds': terminal attribute or bare
+    name. Calls (``with open(f)``) hold nothing."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def strip_self(p: tuple[str, ...]) -> tuple[str, ...]:
+    """Drop a leading ``self``/``cls`` from a dotted path."""
+    if len(p) > 1 and p[0] in ("self", "cls"):
+        return p[1:]
+    return p
+
+
+# ---------------------------------------------------------------------------
 # Comment harvesting (the annotation conventions ride comments)
 # ---------------------------------------------------------------------------
 
@@ -82,21 +121,21 @@ def comment_map(source: str) -> CommentMap:
     return out
 
 
-def annotation_on(
+def annotations_all(
     comments: dict[int, str], line: int, tag: str
-) -> Optional[str]:
-    """Return the payload of ``# <tag>: ...`` attached to ``line`` —
-    trailing on the line itself or anywhere in the contiguous
-    comment-ONLY block directly above it. Returns None when absent,
-    "" when present but empty. The payload must fit on the tagged
-    comment line (a parenthetical may spill over — parsers strip from
-    the first '(')."""
+) -> list[str]:
+    """Every ``# <tag>: ...`` payload attached to ``line`` — trailing
+    on the line itself, then the contiguous comment-ONLY block directly
+    above it (nearest first) — the protocol pass allows several
+    ``orders:``/``pairs:`` contracts on one def. A bare ``# <tag>``
+    yields ""."""
     only = getattr(comments, "only", set())
     candidates = [line]
     ln = line - 1
     while ln in only:
         candidates.append(ln)
         ln -= 1
+    out: list[str] = []
     for ln in candidates:
         text = comments.get(ln)
         if text is None:
@@ -105,10 +144,22 @@ def annotation_on(
         for part in text.split(";"):
             part = part.strip()
             if part.startswith(tag + ":"):
-                return part[len(tag) + 1 :].strip()
-            if part == tag:
-                return ""
-    return None
+                out.append(part[len(tag) + 1 :].strip())
+            elif part == tag:
+                out.append("")
+    return out
+
+
+def annotation_on(
+    comments: dict[int, str], line: int, tag: str
+) -> Optional[str]:
+    """The first payload of ``# <tag>: ...`` attached to ``line``
+    (same attachment rules as :func:`annotations_all`). Returns None
+    when absent, "" when present but empty. The payload must fit on
+    the tagged comment line (a parenthetical may spill over — parsers
+    strip from the first '(')."""
+    found = annotations_all(comments, line, tag)
+    return found[0] if found else None
 
 
 # ---------------------------------------------------------------------------

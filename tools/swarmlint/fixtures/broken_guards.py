@@ -1,0 +1,13 @@
+# swarmlint selfcheck fixture: deliberate guard-write violation. If
+# the guards pass stops firing here, preflight fails (docs/ANALYSIS.md
+# §selfcheck). Never imported by production code.
+import threading
+
+
+class BrokenCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0  # guarded-by: _lock
+
+    def racy(self):
+        self.hits += 1  # write outside 'with self._lock'

@@ -1,0 +1,8 @@
+# swarmlint selfcheck fixture: a lock-declaring module with NO guard
+# annotation and no swarmlint-exempt marker (docs/ANALYSIS.md
+# §inventory). If the inventory pass stops firing inventory-bare here,
+# preflight fails. Never imported by production code.
+import threading
+
+_lock = threading.Lock()
+_shared = []

@@ -1,0 +1,14 @@
+# swarmlint selfcheck fixture: deliberate undeclared jit capture. If
+# the jit-hygiene pass stops firing here, preflight fails
+# (docs/ANALYSIS.md §selfcheck). Never imported by production code.
+import jax
+
+
+def build_kernel(db):
+    meta = db["meta"]
+
+    @jax.jit
+    def kernel(streams):
+        return streams + meta  # undeclared capture of `meta`
+
+    return kernel

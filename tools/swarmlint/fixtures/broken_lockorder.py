@@ -1,0 +1,27 @@
+# swarmlint selfcheck fixture: a deliberate lock-order cycle and a
+# blocking store call under a lock (docs/ANALYSIS.md §lockorder). If
+# the lockorder pass stops firing lock-cycle / lock-blocking here,
+# preflight fails. Never imported by production code.
+import threading
+import time
+
+
+class BrokenLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()  # guards: shared
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self.shared = 1
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                self.shared = 2
+
+    def slow_render(self):
+        with self._b:
+            self.state.hgetall("jobs")  # store IO under the lock
+            time.sleep(0.5)

@@ -1,0 +1,9 @@
+// swarmlint selfcheck fixture: deliberate unchecked CPython return.
+// If the native-audit pass stops firing here, preflight fails
+// (docs/ANALYSIS.md §selfcheck). Never compiled or linked.
+#include <Python.h>
+
+static PyObject* broken_append(PyObject* out, PyObject* item) {
+  PyList_Append(out, item);  // result dropped on the floor
+  Py_RETURN_NONE;
+}

@@ -1,0 +1,23 @@
+# swarmlint selfcheck fixture: one deliberate violation of each
+# protocol contract kind (docs/ANALYSIS.md §protocol). If the protocol
+# pass stops firing proto-order / proto-pair / proto-once here,
+# preflight fails. Never imported by production code.
+
+
+class BrokenService:
+    # orders: journal.append < state.hset
+    def store_then_journal(self, job):
+        self.state.hset("jobs", job.id, job.data)  # ack before WAL
+        self.journal.append({"op": "job", "job": job.id})
+
+    # pairs: writer_token / state.hset_many
+    def unfenced_after(self, items, writer, token):
+        if self.writer_token(writer) != token:
+            return "fenced"
+        self.state.hset_many("entries", items)
+        return "stored"  # no re-check after the write
+
+    # once: cache.bump_epoch
+    def double_bump(self):
+        self.cache.bump_epoch()
+        self.cache.bump_epoch()  # second epoch move on the same refresh

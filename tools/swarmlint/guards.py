@@ -52,7 +52,9 @@ from tools.swarmlint.common import (
     Finding,
     annotation_on,
     comment_map,
+    dotted_path as _dotted_path,
     rel,
+    terminal_name as _terminal_name,
 )
 
 RULE_WRITE = "guard-write"
@@ -70,30 +72,6 @@ MUTATORS = {
 INIT_METHODS = {"__init__", "__new__", "__post_init__", "__set_name__"}
 
 LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
-
-
-def _dotted_path(node: ast.AST) -> Optional[tuple[str, ...]]:
-    """Name/Attribute chain -> path tuple. ``self.a.b`` -> ("self","a","b");
-    ``x`` -> ("x",). None for anything else (calls, subscripts...)."""
-    parts: list[str] = []
-    cur = node
-    while isinstance(cur, ast.Attribute):
-        parts.append(cur.attr)
-        cur = cur.value
-    if isinstance(cur, ast.Name):
-        parts.append(cur.id)
-        return tuple(reversed(parts))
-    return None
-
-
-def _terminal_name(node: ast.AST) -> Optional[str]:
-    """The name a ``with`` subject 'holds': terminal attribute or bare
-    name. Calls (``with open(f)``) hold nothing."""
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return None
 
 
 @dataclass

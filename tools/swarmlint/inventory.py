@@ -1,0 +1,113 @@
+"""Auto-discovered module inventory (docs/ANALYSIS.md §inventory).
+
+The lock-annotation coverage used to be pinned by a HAND-MAINTAINED
+module list in tests/test_swarmlint.py — which means a brand-new
+module that grows a ``threading.Lock`` silently ships with zero
+declared discipline until a human remembers to extend the list. This
+pass inverts that: the inventory is discovered at analyzer startup
+(grep for lock factories and store imports over ``swarm_tpu/**``), and
+every lock-DECLARING module must either carry at least one guard
+annotation (``# guarded-by:`` / ``# guards:`` / ``# requires-lock:``)
+or opt out explicitly with ``# swarmlint-exempt: <reason>`` — an
+escape hatch that leaves a written trail instead of a silent gap.
+
+Store-importing modules are discovered too (they are the lockorder
+pass's default scan scope: a module doing store IO is exactly where a
+blocking-under-lock slip lands), but only lock declarers are REQUIRED
+to annotate.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tools.swarmlint import guards
+from tools.swarmlint.common import Finding, REPO_ROOT, comment_map, rel
+
+RULE_BARE = "inventory-bare"
+RULE_CONFIG = "inventory-config"
+
+LOCK_RE = re.compile(
+    r"\bthreading\.(Lock|RLock|Condition|Semaphore|BoundedSemaphore)\s*\("
+)
+STORE_IMPORT_RE = re.compile(
+    r"^\s*(from\s+swarm_tpu\.stores\s+import|from\s+swarm_tpu\s+import\s+"
+    r"stores\b|import\s+swarm_tpu\.stores\b)",
+    re.MULTILINE,
+)
+
+
+def classify(path: Path) -> dict:
+    """{'locks': bool, 'stores': bool} for one module."""
+    try:
+        source = path.read_text()
+    except OSError:
+        return {"locks": False, "stores": False}
+    return {
+        "locks": LOCK_RE.search(source) is not None,
+        "stores": STORE_IMPORT_RE.search(source) is not None,
+    }
+
+
+def discover(root: Path = None) -> dict[Path, dict]:
+    """Every swarm_tpu module that declares a lock or imports the
+    store roles — the analyzer's working inventory, rebuilt from the
+    tree on every run so it can never go stale."""
+    root = root or (REPO_ROOT / "swarm_tpu")
+    out: dict[Path, dict] = {}
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        flags = classify(p)
+        if flags["locks"] or flags["stores"]:
+            out[p] = flags
+    return out
+
+
+def exemption(source: str) -> tuple[bool, str]:
+    """(present, reason) for a module-level ``# swarmlint-exempt:``
+    marker anywhere in the file's comments."""
+    for text in comment_map(source).values():
+        for part in text.split(";"):
+            part = part.strip()
+            if part.startswith("swarmlint-exempt:"):
+                return True, part[len("swarmlint-exempt:"):].strip()
+            if part == "swarmlint-exempt":
+                return True, ""
+    return False, ""
+
+
+def check_file(path: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    if not classify(path)["locks"]:
+        return findings
+    source = path.read_text()
+    rp = rel(path)
+    exempt, reason = exemption(source)
+    if exempt:
+        if not reason:
+            findings.append(Finding(
+                RULE_CONFIG, rp, 1, "",
+                "'# swarmlint-exempt:' needs a reason",
+                detail="empty-exempt",
+            ))
+        return findings
+    _fs, mg = guards.check_file(path)
+    if not mg.specs and not mg.requires:
+        findings.append(Finding(
+            RULE_BARE, rp, 1, "",
+            "module declares a threading lock but carries no guard "
+            "annotation ('# guarded-by:' / '# guards:' / "
+            "'# requires-lock:'); declare what the lock protects or "
+            "opt out with '# swarmlint-exempt: <reason>'",
+            detail="bare-lock-module",
+        ))
+    return findings
+
+
+def run(paths: list[Path]) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in sorted(paths):
+        findings.extend(check_file(p))
+    return findings

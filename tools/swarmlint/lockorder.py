@@ -1,0 +1,473 @@
+"""Lock-order + blocking-IO-under-lock pass (docs/ANALYSIS.md §lockorder).
+
+Two hazards the guards pass (per-site lock discipline) cannot see:
+
+- **Deadlock by inconsistent acquisition order**: thread 1 takes A then
+  B, thread 2 takes B then A. This pass builds the lock-acquisition
+  graph from lexical ``with`` nesting across every lock-using module
+  (a ``requires-lock`` body counts as holding its lock) plus edges the
+  author DECLARES with ``# lock-order: A -> B`` for orderings the
+  lexical view can't witness (a callee takes its own lock while the
+  caller holds one — e.g. the queue's ``_lock -> _journal_lock``
+  pairing, docs/DURABILITY.md). Any cycle in the combined graph is a
+  ``lock-cycle`` finding; re-entering a non-reentrant Lock is a
+  self-cycle. Lock identity is BY NAME within a module (the guards
+  pass's documented limit); declared edges may cross modules with the
+  qualified form ``# lock-order: _lock -> server/journal.py:_lock``.
+
+- **Blocking under a lock**: a state/blob/doc store op, HTTP call,
+  ``.result()`` / ``.join()`` wait, or ``time.sleep`` while a declared
+  lock is held serializes every other thread behind one slow backend —
+  the failure mode the PR 10 snapshot-then-render rule exists to
+  prevent (copy under the lock, render outside it). Every such site is
+  a ``lock-blocking`` finding unless waived with
+  ``# blocking-ok: <reason>`` on the site line, or on the ``def`` line
+  to bless a whole function whose design deliberately pairs its lock
+  with store atomicity (the queue's journaled mutators). A function
+  that wraps store IO behind a plain call (the tier's breaker shim)
+  declares itself ``# may-block: <what>`` so its call sites are
+  checked too.
+
+Blocking-call recognition is receiver-shaped: a dotted call whose
+receiver chain contains a store-role name (``state``/``_state``/
+``blobs``/``_blobs``/``docs``/``_docs``/``store``/``_store``/
+``journal``/``_journal``/``tier``/``_tier``/``coll``…), the named
+waits above, or a local ``# may-block`` function. ``os.path.join`` and
+string ``join`` are excluded.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from tools.swarmlint import guards
+from tools.swarmlint.common import (
+    Finding,
+    annotation_on,
+    comment_map,
+    dotted_path as _dotted,
+    rel,
+    strip_self as _strip_self,
+    terminal_name as _terminal_name,
+)
+
+RULE_CYCLE = "lock-cycle"
+RULE_BLOCK = "lock-blocking"
+RULE_CONFIG = "lockorder-config"
+
+#: receiver-chain segments that mark a call as store IO
+STORE_ROOTS = {
+    "state", "_state", "blobs", "_blobs", "docs", "_docs",
+    "store", "_store", "blob_store", "_blob_store",
+    "journal", "_journal", "tier", "_tier", "coll", "_coll",
+}
+
+_NETWORK_ROOTS = {"requests", "urllib", "httpx", "socket"}
+_WAIT_ATTRS = {"join", "result"}
+
+
+def blocking_reason(
+    path: tuple[str, ...], mayblock: set[str]
+) -> Optional[str]:
+    """Why a call with this (self-stripped) dotted path counts as
+    blocking, or None."""
+    if path == ("time", "sleep"):
+        return "time.sleep"
+    if path[0] in _NETWORK_ROOTS or path[-1] == "urlopen":
+        return "network IO"
+    if (
+        len(path) >= 2
+        and path[-1] in _WAIT_ATTRS
+        and "os" not in path
+        and "path" not in path[:-1]
+    ):
+        return f"blocking wait (.{path[-1]}())"
+    if any(seg in STORE_ROOTS for seg in path[:-1]):
+        return "store IO"
+    if len(path) == 1 and path[0] in mayblock:
+        return f"call to '# may-block' function {path[0]}()"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Per-module collection
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Edge:
+    src: tuple[str, str]   # (module, lock)
+    dst: tuple[str, str]
+    path: str
+    line: int
+    symbol: str
+    declared: bool = False
+
+    def site(self) -> str:
+        where = "declared" if self.declared else self.symbol or "<module>"
+        return f"{self.path}:{self.line} ({where})"
+
+
+@dataclass
+class ModuleLocks:
+    path: Path
+    rp: str
+    lock_names: set[str] = field(default_factory=set)
+    rlocks: set[str] = field(default_factory=set)
+    requires: dict = field(default_factory=dict)
+    mayblock: set[str] = field(default_factory=set)
+    declared: list[tuple[int, str]] = field(default_factory=list)
+
+
+def _collect_module(path: Path, tree: ast.Module, comments) -> ModuleLocks:
+    _fs, mg = guards.check_file(path)
+    ml = ModuleLocks(
+        path, rel(path), set(mg.lock_names), set(), dict(mg.requires)
+    )
+
+    class C(ast.NodeVisitor):
+        def _assign(self, node, targets):
+            value = getattr(node, "value", None)
+            if (
+                isinstance(value, ast.Call)
+                and _terminal_name(value.func) in ("RLock", "Condition")
+            ):
+                # Condition/RLock are reentrant for the self-cycle rule
+                for t in targets:
+                    p = _dotted(t)
+                    if p:
+                        ml.rlocks.add(p[-1])
+
+        def visit_Assign(self, node):
+            self._assign(node, node.targets)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            self._assign(node, [node.target])
+            self.generic_visit(node)
+
+        def _def(self, node):
+            if annotation_on(comments, node.lineno, "may-block") is not None:
+                ml.mayblock.add(node.name)
+            self.generic_visit(node)
+
+        visit_FunctionDef = _def
+        visit_AsyncFunctionDef = _def
+
+    C().visit(tree)
+    for line, text in sorted(comments.items()):
+        for part in text.split(";"):
+            part = part.strip()
+            if part.startswith("lock-order:"):
+                ml.declared.append(
+                    (line, part[len("lock-order:"):].strip())
+                )
+    return ml
+
+
+class _Walker(ast.NodeVisitor):
+    """Held-lock tracking walk: lexical with-nesting edges + blocking
+    calls under a held lock. Same scoping rules as guards._SiteChecker:
+    function boundaries reset the held set, requires-lock seeds it."""
+
+    def __init__(self, ml: ModuleLocks, comments,
+                 edges: list[Edge], findings: list[Finding]):
+        self.ml = ml
+        self.comments = comments
+        self.edges = edges
+        self.findings = findings
+        self.cls: Optional[str] = None
+        self.func_stack: list[str] = []
+        self.held_stack: list[list[str]] = [[]]
+        self.blessed_stack: list[bool] = [False]
+        self._reported: set[str] = set()
+
+    @property
+    def held(self) -> list[str]:
+        return self.held_stack[-1]
+
+    def _symbol(self) -> str:
+        parts = ([self.cls] if self.cls else []) + self.func_stack
+        return ".".join(parts)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        prev, self.cls = self.cls, node.name
+        prev_funcs, self.func_stack = self.func_stack, []
+        self.generic_visit(node)
+        self.cls, self.func_stack = prev, prev_funcs
+
+    def _visit_def(self, node):
+        self.func_stack.append(node.name)
+        req = self.ml.requires.get((self.cls, node.name))
+        self.held_stack.append([req] if req else [])
+        payload = annotation_on(self.comments, node.lineno, "blocking-ok")
+        blessed = payload is not None
+        if blessed and not payload:
+            self.findings.append(Finding(
+                RULE_CONFIG, self.ml.rp, node.lineno, self._symbol(),
+                "'# blocking-ok:' needs a reason",
+                detail=f"emptybless:{self._symbol()}",
+            ))
+        self.blessed_stack.append(blessed)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.blessed_stack.pop()
+        self.held_stack.pop()
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self.held_stack.append([])
+        self.blessed_stack.append(False)
+        self.generic_visit(node)
+        self.blessed_stack.pop()
+        self.held_stack.pop()
+
+    def visit_With(self, node: ast.With):
+        # a multi-item `with a, b:` acquires in item order — edges and
+        # the self-reacquire check must see earlier items of the SAME
+        # statement as already held, or an ABBA deadlock whose forward
+        # half is combined would go undetected
+        acquired: list[str] = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            name = _terminal_name(item.context_expr)
+            if name not in self.ml.lock_names:
+                continue
+            held_now = self.held + acquired
+            if name in held_now:
+                if name not in self.ml.rlocks:
+                    detail = f"self:{name}:{self._symbol()}"
+                    if detail not in self._reported:
+                        self._reported.add(detail)
+                        self.findings.append(Finding(
+                            RULE_CYCLE, self.ml.rp, node.lineno,
+                            self._symbol(),
+                            f"re-acquisition of non-reentrant lock "
+                            f"{name!r} while already held "
+                            f"(self-deadlock)",
+                            detail=detail,
+                        ))
+                continue
+            for h in held_now:
+                self.edges.append(Edge(
+                    (self.ml.rp, h), (self.ml.rp, name),
+                    self.ml.rp, node.lineno, self._symbol(),
+                ))
+            acquired.append(name)
+        self.held_stack.append(self.held + acquired)
+        self.blessed_stack.append(self.blessed_stack[-1])
+        for stmt in node.body:
+            self.visit(stmt)
+        self.blessed_stack.pop()
+        self.held_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _waived(self, line: int) -> bool:
+        payload = annotation_on(self.comments, line, "blocking-ok")
+        if payload is None:
+            return False
+        if not payload:
+            self.findings.append(Finding(
+                RULE_CONFIG, self.ml.rp, line, self._symbol(),
+                "'# blocking-ok:' needs a reason",
+                detail=f"emptywaiver:{self._symbol()}:{line}",
+            ))
+        return True
+
+    def visit_Call(self, node: ast.Call):
+        if self.held and not self.blessed_stack[-1]:
+            p = _dotted(node.func)
+            if p is not None:
+                path = _strip_self(p)
+                reason = blocking_reason(path, self.ml.mayblock)
+                if reason is not None:
+                    detail = (
+                        f"{'.'.join(path)}:{self._symbol()}:"
+                        f"{'+'.join(sorted(set(self.held)))}"
+                    )
+                    if (
+                        detail not in self._reported
+                        and not self._waived(node.lineno)
+                    ):
+                        self._reported.add(detail)
+                        self.findings.append(Finding(
+                            RULE_BLOCK, self.ml.rp, node.lineno,
+                            self._symbol(),
+                            f"{reason} ({'.'.join(path)}) while holding "
+                            f"{', '.join(sorted(set(self.held)))} — "
+                            f"snapshot-then-render (docs/GATEWAY.md) or "
+                            f"waive with '# blocking-ok: <reason>'",
+                            detail=detail,
+                        ))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# Graph assembly + cycle detection
+# ---------------------------------------------------------------------------
+
+def _resolve_declared(
+    ml: ModuleLocks, modules: dict[str, ModuleLocks],
+    edges: list[Edge], findings: list[Finding],
+) -> None:
+    def resolve(name: str, line: int) -> Optional[tuple[str, str]]:
+        if ":" in name:
+            suffix, lock = name.rsplit(":", 1)
+            cands = [
+                rp for rp in modules
+                if rp == suffix or rp.endswith("/" + suffix)
+            ]
+            if not cands:
+                findings.append(Finding(
+                    RULE_CONFIG, ml.rp, line, "",
+                    f"lock-order references unknown module {suffix!r}",
+                    detail=f"unknown-module:{name}",
+                ))
+                return None
+            target = modules[cands[0]]
+        else:
+            suffix, lock, target = ml.rp, name, ml
+        if lock not in target.lock_names:
+            findings.append(Finding(
+                RULE_CONFIG, ml.rp, line, "",
+                f"lock-order references unknown lock {lock!r} in "
+                f"{target.rp}",
+                detail=f"unknown-lock:{name}",
+            ))
+            return None
+        return (target.rp, lock)
+
+    for line, payload in ml.declared:
+        payload = payload.split("(")[0].strip()
+        chain = [s.strip() for s in payload.split("->")]
+        if len(chain) < 2 or not all(chain):
+            findings.append(Finding(
+                RULE_CONFIG, ml.rp, line, "",
+                f"malformed '# lock-order:' (want 'A -> B'): {payload!r}",
+                detail=f"parse:{payload[:40]}",
+            ))
+            continue
+        nodes = [resolve(n, line) for n in chain]
+        for a, b in zip(nodes, nodes[1:]):
+            if a is None or b is None:
+                continue
+            edges.append(Edge(a, b, ml.rp, line, "", declared=True))
+
+
+def find_cycles(edges: list[Edge]) -> list[list[tuple[str, str]]]:
+    """Elementary cycles via SCC: every SCC with more than one node
+    (self-edges are reported separately at the site) yields one
+    representative cycle path."""
+    adj: dict = {}
+    for e in edges:
+        adj.setdefault(e.src, set()).add(e.dst)
+        adj.setdefault(e.dst, set())
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    sccs: list[list] = []
+    counter = [0]
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(adj[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def build(paths: list[Path]) -> tuple[list[Edge], list[Finding]]:
+    edges: list[Edge] = []
+    findings: list[Finding] = []
+    modules: dict[str, ModuleLocks] = {}
+    parsed: list[tuple[ModuleLocks, ast.Module]] = []
+    for p in sorted(paths):
+        source = p.read_text()
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            findings.append(Finding(
+                RULE_CONFIG, rel(p), e.lineno or 1, "",
+                f"syntax error: {e.msg}",
+            ))
+            continue
+        comments = comment_map(source)
+        ml = _collect_module(p, tree, comments)
+        modules[ml.rp] = ml
+        parsed.append((ml, tree))
+        if ml.lock_names or ml.requires:
+            _Walker(ml, comments, edges, findings).visit(tree)
+    for ml, _tree in parsed:
+        _resolve_declared(ml, modules, edges, findings)
+    return edges, findings
+
+
+def run(paths: list[Path]) -> list[Finding]:
+    edges, findings = build(paths)
+    for scc in find_cycles(edges):
+        members = set(scc)
+        contributing = [
+            e for e in edges if e.src in members and e.dst in members
+        ]
+        names = [f"{m}:{lk}" for m, lk in scc]
+        sites = "; ".join(e.site() for e in contributing[:4])
+        first = contributing[0] if contributing else None
+        findings.append(Finding(
+            RULE_CYCLE,
+            first.path if first else scc[0][0],
+            first.line if first else 1,
+            "",
+            f"lock-order cycle between {{{', '.join(names)}}} — "
+            f"acquisition sites: {sites}",
+            detail="cycle:" + "|".join(sorted(names)),
+        ))
+    return findings
+
+
+def lock_graph(paths: list[Path]) -> set[tuple]:
+    """((src_module, src_lock), (dst_module, dst_lock), declared) edge
+    set — the test surface pinning that real orderings are declared."""
+    edges, _f = build(paths)
+    return {(e.src, e.dst, e.declared) for e in edges}

@@ -1,0 +1,594 @@
+"""Flow-sensitive protocol-ordering pass (docs/ANALYSIS.md §protocol).
+
+PRs 9-13 grew the control plane around cross-layer *ordering*
+invariants — journal append before the paired state-store write
+(docs/DURABILITY.md), fence-token check before AND after every shared
+store write (docs/CACHING.md, docs/AOT.md), exactly one cache-epoch
+move per corpus refresh — that existed only as prose plus one spy test
+each. This pass promotes them to checked annotations on the functions
+that carry them, verified on EVERY path through the function by a
+small abstract interpreter, not just the paths a test happens to walk.
+
+Annotation grammar (on a ``def`` line or the comment block above it;
+several may share a comment separated by ``;``; a trailing
+parenthetical is stripped):
+
+``# orders: A < B`` — on every path through this function, any call
+    matching event ``B`` must be preceded by a call matching ``A``.
+``# pairs: C / O`` — every call matching ``O`` must be preceded by a
+    call matching ``C`` on every path from entry, AND followed by one
+    on every path from the ``O`` site to a normal exit (the
+    check-before-and-recheck-after fencing shape).
+``# once: E`` — every path through the function calls ``E`` exactly
+    once (the epoch-bump-exactly-once shape).
+``# protocol-ok: <reason>`` — waives one site (reason mandatory).
+
+Events are dotted call patterns (``_journal.append``, ``state.hset``,
+``_put_job``) matched as a suffix of the call's attribute chain with a
+leading ``self``/``cls`` stripped; a local name bound straight from an
+attribute (``client = self._result_cache``) is resolved through the
+alias. An annotation naming an event that matches NO call in the
+function is a ``proto-config`` finding — a rename cannot silently
+disable a contract.
+
+None-guard awareness: a branch that tested a contract event's
+receiver against None (``if self._journal is not None: ...``)
+suspends, on the None side, every contract mentioning that receiver —
+"append-before-write applies only when a journal is configured" is
+expressed by the code's own guard, not by a waiver. Recognized tests:
+``x is None`` / ``x is not None``, ``not`` around them, and the
+definite halves of ``and`` / ``or`` chains.
+
+Deliberate limits: intra-procedural (a helper's internals are opaque —
+annotate the helper), loops analyzed with two unrollings (enough for
+loop-carried A-before-B ordering), ``raise`` exits skip the pairs/once
+exit obligations (an error path owes no post-check), nested defs and
+lambdas are skipped (they run later, like the guards pass's closure
+rule).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+from tools.swarmlint.common import (
+    Finding,
+    annotation_on,
+    annotations_all,
+    comment_map,
+    dotted_path as _dotted,
+    rel,
+    strip_self as _strip_self,
+)
+
+RULE_ORDER = "proto-order"
+RULE_PAIR = "proto-pair"
+RULE_ONCE = "proto-once"
+RULE_CONFIG = "proto-config"
+
+#: world-set safety valve: a function whose path state outgrows this is
+#: reported (proto-config) instead of silently half-checked
+_MAX_WORLDS = 4096
+
+
+@dataclass(frozen=True)
+class Contract:
+    kind: str                       # "orders" | "pairs" | "once"
+    first: tuple[str, ...]          # A / CHECK / E
+    second: Optional[tuple[str, ...]]  # B / OP; None for "once"
+    line: int
+
+    def events(self) -> list[tuple[str, ...]]:
+        return [self.first] + ([self.second] if self.second else [])
+
+    def label(self) -> str:
+        a = ".".join(self.first)
+        if self.kind == "orders":
+            return f"{a} < {'.'.join(self.second)}"
+        if self.kind == "pairs":
+            return f"{a} / {'.'.join(self.second)}"
+        return a
+
+
+def _parse_event(text: str) -> Optional[tuple[str, ...]]:
+    text = text.split("(")[0].strip()
+    if not text:
+        return None
+    parts = tuple(p.strip() for p in text.split("."))
+    return parts if all(parts) else None
+
+
+def parse_contracts(
+    comments, line: int, rp: str, symbol: str, findings: list[Finding]
+) -> list[Contract]:
+    out: list[Contract] = []
+    for kind, sep in (("orders", "<"), ("pairs", "/"), ("once", None)):
+        for payload in annotations_all(comments, line, kind):
+            if sep is None:
+                ev = _parse_event(payload)
+                if ev is None:
+                    findings.append(Finding(
+                        RULE_CONFIG, rp, line, symbol,
+                        f"malformed '# once:' annotation: {payload!r}",
+                        detail=f"parse:once:{payload[:40]}",
+                    ))
+                    continue
+                out.append(Contract("once", ev, None, line))
+                continue
+            # the trailing parenthetical is commentary — strip it before
+            # splitting (a docs/ path inside it would split 'pairs')
+            halves = payload.split("(")[0].split(sep)
+            a = _parse_event(halves[0]) if len(halves) == 2 else None
+            b = _parse_event(halves[1]) if len(halves) == 2 else None
+            if a is None or b is None:
+                findings.append(Finding(
+                    RULE_CONFIG, rp, line, symbol,
+                    f"malformed '# {kind}:' annotation (want 'A {sep} "
+                    f"B'): {payload!r}",
+                    detail=f"parse:{kind}:{payload[:40]}",
+                ))
+                continue
+            out.append(Contract(kind, a, b, line))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Call-event plumbing
+# ---------------------------------------------------------------------------
+
+def _calls_in(node: ast.AST) -> list[ast.Call]:
+    """Call nodes in (approximate) execution order — post-order, so an
+    inner call completes before the call it feeds. Nested defs/lambdas
+    are opaque (they run later)."""
+    out: list[ast.Call] = []
+
+    def rec(n: ast.AST) -> None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        for c in ast.iter_child_nodes(n):
+            rec(c)
+        if isinstance(n, ast.Call):
+            out.append(n)
+
+    rec(node)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The abstract interpreter
+# ---------------------------------------------------------------------------
+# A *world* is one reachable abstract state: (facts, cstates) where
+# facts is a frozenset of (path, "none"|"set") receiver-nullability
+# facts and cstates is a tuple with one small tuple per contract:
+#   orders: (a_seen,)
+#   pairs:  (c_seen, pending, last_op_line)
+#   once:   (count<=2, last_line)
+
+_ORD0 = (False,)
+_PAIR0 = (False, False, 0)
+_ONCE0 = (0, 0)
+
+
+def _init_state(c: Contract):
+    return {"orders": _ORD0, "pairs": _PAIR0, "once": _ONCE0}[c.kind]
+
+
+def _facts_of_test(test: ast.AST):
+    """(true_facts, false_facts) each a dict path->tag, from the
+    recognized nullability test shapes; unknown shapes yield ({}, {})."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        t, f = _facts_of_test(test.operand)
+        return f, t
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        lhs = _dotted(test.left)
+        rhs = test.comparators[0]
+        is_none = isinstance(rhs, ast.Constant) and rhs.value is None
+        if lhs is not None and is_none:
+            p = _strip_self(lhs)
+            if isinstance(test.ops[0], ast.Is):
+                return {p: "none"}, {p: "set"}
+            if isinstance(test.ops[0], ast.IsNot):
+                return {p: "set"}, {p: "none"}
+    if isinstance(test, ast.BoolOp):
+        # and: the then-branch knows every conjunct held;
+        # or: the else-branch knows every disjunct failed
+        merged_t: dict = {}
+        merged_f: dict = {}
+        for v in test.values:
+            t, f = _facts_of_test(v)
+            merged_t.update(t)
+            merged_f.update(f)
+        if isinstance(test.op, ast.And):
+            return merged_t, {}
+        return {}, merged_f
+    if isinstance(test, (ast.Name, ast.Attribute)):
+        p = _dotted(test)
+        if p is not None:
+            return {_strip_self(p): "set"}, {}
+    return {}, {}
+
+
+def _with_facts(facts: frozenset, new: dict) -> frozenset:
+    if not new:
+        return facts
+    out = {pf for pf in facts if pf[0] not in new}
+    out.update(new.items())
+    return frozenset(out)
+
+
+class _FuncAnalysis:
+    def __init__(self, fn: ast.AST, contracts: list[Contract],
+                 comments, rp: str, symbol: str,
+                 aliases: Optional[dict] = None):
+        self.fn = fn
+        self.contracts = contracts
+        self.comments = comments
+        self.rp = rp
+        self.symbol = symbol
+        self.findings: list[Finding] = []
+        self._seen_details: set[str] = set()
+        self.aliases: dict[str, tuple[str, ...]] = dict(aliases or {})
+        self.matched: set[int] = set()   # contract-event ids that matched
+        self.exit_worlds: list = []      # normal exits (return / fall-off)
+        self.overflow = False
+
+    # -- findings ------------------------------------------------------
+    def _emit(self, rule: str, line: int, message: str, detail: str):
+        if detail in self._seen_details:
+            return
+        if self._waived(line):
+            return
+        self._seen_details.add(detail)
+        self.findings.append(Finding(
+            rule, self.rp, line, self.symbol, message, detail=detail
+        ))
+
+    def _waived(self, line: int) -> bool:
+        payload = annotation_on(self.comments, line, "protocol-ok")
+        if payload is None:
+            return False
+        if not payload:
+            self._seen_details.add(f"emptywaiver:{line}")
+            self.findings.append(Finding(
+                RULE_CONFIG, self.rp, line, self.symbol,
+                "'# protocol-ok:' needs a reason",
+                detail=f"emptywaiver:{self.symbol}:{line}",
+            ))
+        return True
+
+    # -- events --------------------------------------------------------
+    def _resolve(self, path: tuple[str, ...]) -> tuple[str, ...]:
+        if path and path[0] in self.aliases:
+            path = self.aliases[path[0]] + path[1:]
+        return _strip_self(path)
+
+    def _matches(self, pattern: tuple[str, ...], path: tuple[str, ...]) -> bool:
+        return (
+            len(path) >= len(pattern)
+            and path[-len(pattern):] == pattern
+        )
+
+    def _suspended(self, contract: Contract, facts: frozenset) -> bool:
+        for ev in contract.events():
+            if len(ev) > 1 and (ev[:-1], "none") in facts:
+                return True
+        return False
+
+    def _apply_call(self, world, call: ast.Call):
+        """One call event against one world -> successor world."""
+        p = _dotted(call.func)
+        if p is None:
+            return world
+        path = self._resolve(p)
+        facts, cstates = world
+        out = list(cstates)
+        line = call.lineno
+        for i, c in enumerate(self.contracts):
+            if self._suspended(c, facts):
+                continue
+            hit_first = self._matches(c.first, path)
+            hit_second = c.second is not None and self._matches(c.second, path)
+            if hit_first:
+                self.matched.add(2 * i)
+            if hit_second:
+                self.matched.add(2 * i + 1)
+            if c.kind == "orders":
+                (a_seen,) = out[i]
+                if hit_second and not a_seen:
+                    self._emit(
+                        RULE_ORDER, line,
+                        f"call to {'.'.join(c.second)} not preceded by "
+                        f"{'.'.join(c.first)} on every path "
+                        f"(contract '{c.label()}')",
+                        detail=f"{self.symbol}:{c.label()}",
+                    )
+                if hit_first:
+                    out[i] = (True,)
+            elif c.kind == "pairs":
+                c_seen, pending, last = out[i]
+                if hit_first:
+                    out[i] = (True, False, last)
+                elif hit_second:
+                    if not c_seen:
+                        self._emit(
+                            RULE_PAIR, line,
+                            f"{'.'.join(c.second)} without a preceding "
+                            f"{'.'.join(c.first)} check on every path "
+                            f"(contract '{c.label()}')",
+                            detail=f"{self.symbol}:{c.label()}:before",
+                        )
+                    out[i] = (c_seen, True, line)
+            elif c.kind == "once":
+                count, _last = out[i]
+                if hit_first:
+                    if count >= 1:
+                        self._emit(
+                            RULE_ONCE, line,
+                            f"{'.'.join(c.first)} called more than once "
+                            f"on a path (contract 'once: {c.label()}')",
+                            detail=f"{self.symbol}:{c.label()}:twice",
+                        )
+                    out[i] = (min(count + 1, 2), line)
+        return facts, tuple(out)
+
+    def _apply_calls(self, worlds: set, node: ast.AST) -> set:
+        calls = _calls_in(node)
+        if not calls:
+            return worlds
+        for call in calls:
+            worlds = {self._apply_call(w, call) for w in worlds}
+        return worlds
+
+    # -- statements ----------------------------------------------------
+    def _exec_block(self, stmts, worlds: set, loop_ctx) -> set:
+        for stmt in stmts:
+            if not worlds or self.overflow:
+                break
+            worlds = self._exec_stmt(stmt, worlds, loop_ctx)
+            if len(worlds) > _MAX_WORLDS:
+                self.overflow = True
+        return worlds
+
+    def _exec_stmt(self, stmt, worlds: set, loop_ctx) -> set:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return worlds  # nested scope: runs later / elsewhere
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                worlds = self._apply_calls(worlds, stmt.value)
+            self.exit_worlds.extend(worlds)
+            return set()
+        if isinstance(stmt, ast.Raise):
+            worlds = self._apply_calls(worlds, stmt)
+            return set()  # error exit: no post-obligations
+        if isinstance(stmt, ast.If):
+            worlds = self._apply_calls(worlds, stmt.test)
+            tf, ff = _facts_of_test(stmt.test)
+            # resolve local aliases so `client = self._cache; if client
+            # is None:` suspends contracts rooted at `_cache`
+            tf = {self._resolve(p): t for p, t in tf.items()}
+            ff = {self._resolve(p): t for p, t in ff.items()}
+            then_in = {(_with_facts(f, tf), cs) for f, cs in worlds}
+            else_in = {(_with_facts(f, ff), cs) for f, cs in worlds}
+            then_out = self._exec_block(stmt.body, then_in, loop_ctx)
+            else_out = self._exec_block(stmt.orelse, else_in, loop_ctx)
+            return then_out | else_out
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._exec_loop(stmt, worlds)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                worlds = self._apply_calls(worlds, item.context_expr)
+            return self._exec_block(stmt.body, worlds, loop_ctx)
+        if isinstance(stmt, ast.Try):
+            return self._exec_try(stmt, worlds, loop_ctx)
+        if isinstance(stmt, ast.Break):
+            if loop_ctx is not None:
+                loop_ctx["break"].update(worlds)
+            return set()
+        if isinstance(stmt, ast.Continue):
+            if loop_ctx is not None:
+                loop_ctx["continue"].update(worlds)
+            return set()
+        # simple statement: events, then alias/fact effects
+        worlds = self._apply_calls(worlds, stmt)
+        if isinstance(stmt, ast.Assign):
+            worlds = self._apply_assign(stmt, worlds)
+        return worlds
+
+    def _apply_assign(self, stmt: ast.Assign, worlds: set) -> set:
+        value_path = (
+            _dotted(stmt.value)
+            if isinstance(stmt.value, (ast.Attribute, ast.Name))
+            else None
+        )
+        for t in stmt.targets:
+            tp = _dotted(t)
+            if tp is None:
+                continue
+            stripped = _strip_self(tp)
+            if len(tp) == 1 and value_path is not None:
+                # local alias of an attribute/name: client = self._x
+                self.aliases[tp[0]] = _strip_self(value_path)
+            elif len(tp) == 1:
+                self.aliases.pop(tp[0], None)
+            # a write invalidates nullability facts about the path
+            worlds = {
+                (frozenset(pf for pf in f if pf[0] != stripped), cs)
+                for f, cs in worlds
+            }
+        return worlds
+
+    def _exec_loop(self, stmt, worlds: set) -> set:
+        ctx = {"break": set(), "continue": set()}
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            worlds = self._apply_calls(worlds, stmt.iter)
+        after = set(worlds)  # zero iterations
+        cur = set(worlds)
+        for _ in range(2):  # bounded unrolling: loop-carried effects
+            if isinstance(stmt, ast.While):
+                cur = self._apply_calls(cur, stmt.test)
+            body_out = self._exec_block(stmt.body, set(cur), ctx)
+            cur = body_out | ctx["continue"]
+            ctx["continue"] = set()
+            after |= cur
+        after |= ctx["break"]
+        if stmt.orelse:
+            after = self._exec_block(stmt.orelse, after, None)
+        return after
+
+    def _exec_try(self, stmt: ast.Try, worlds: set, loop_ctx) -> set:
+        # collect the state after each try-body statement: a handler
+        # can be entered from any of those points
+        intermediate = set(worlds)
+        cur = set(worlds)
+        for s in stmt.body:
+            if not cur:
+                break
+            cur = self._exec_stmt(s, cur, loop_ctx)
+            intermediate |= cur
+        # `else` runs ONLY on the no-exception path (the after-body
+        # worlds) — feeding it handler outputs would double-count a
+        # once-event split across handler and else, and credit an
+        # else-side re-check to handler paths that skipped it
+        no_exc = set(cur)
+        if stmt.orelse:
+            no_exc = self._exec_block(stmt.orelse, no_exc, loop_ctx)
+        handler_out: set = set()
+        for handler in stmt.handlers:
+            handler_out |= self._exec_block(
+                handler.body, set(intermediate), loop_ctx
+            )
+        out = no_exc | handler_out
+        if stmt.finalbody:
+            out = self._exec_block(stmt.finalbody, out | intermediate,
+                                   loop_ctx)
+        return out
+
+    # -- driver --------------------------------------------------------
+    def run(self) -> list[Finding]:
+        init = (frozenset(), tuple(_init_state(c) for c in self.contracts))
+        leftover = self._exec_block(list(self.fn.body), {init}, None)
+        self.exit_worlds.extend(leftover)
+        if self.overflow:
+            self.findings.append(Finding(
+                RULE_CONFIG, self.rp, self.fn.lineno, self.symbol,
+                "function too complex for the protocol interpreter "
+                f"(> {_MAX_WORLDS} abstract states) — split it or drop "
+                "the annotation",
+                detail=f"overflow:{self.symbol}",
+            ))
+            return self.findings
+        for facts, cstates in self.exit_worlds:
+            for i, c in enumerate(self.contracts):
+                if self._suspended(c, facts):
+                    continue
+                if c.kind == "pairs":
+                    _c_seen, pending, last = cstates[i]
+                    if pending:
+                        self._emit(
+                            RULE_PAIR, last or c.line,
+                            f"{'.'.join(c.second)} not followed by a "
+                            f"{'.'.join(c.first)} re-check on every "
+                            f"path to exit (contract '{c.label()}')",
+                            detail=f"{self.symbol}:{c.label()}:after",
+                        )
+                elif c.kind == "once":
+                    count, _last = cstates[i]
+                    if count == 0:
+                        self._emit(
+                            RULE_ONCE, c.line,
+                            f"{'.'.join(c.first)} not called on every "
+                            f"path (contract 'once: {c.label()}'; guard "
+                            f"the skip with an 'is None' test to exempt "
+                            f"a path)",
+                            detail=f"{self.symbol}:{c.label()}:missing",
+                        )
+        # anti-rot: an event no call ever matched means the contract
+        # quietly checks nothing (typo, or the callee was renamed)
+        for i, c in enumerate(self.contracts):
+            for j, ev in enumerate(c.events()):
+                if (2 * i + j) not in self.matched:
+                    self.findings.append(Finding(
+                        RULE_CONFIG, self.rp, c.line, self.symbol,
+                        f"contract '{c.label()}' names event "
+                        f"{'.'.join(ev)!r} which matches no call in "
+                        f"this function",
+                        detail=f"unmatched:{self.symbol}:{'.'.join(ev)}",
+                    ))
+        return self.findings
+
+
+# ---------------------------------------------------------------------------
+# Module driver
+# ---------------------------------------------------------------------------
+
+class _Harvester(ast.NodeVisitor):
+    def __init__(self, comments, rp: str):
+        self.comments = comments
+        self.rp = rp
+        self.cls: Optional[str] = None
+        self.targets: list[tuple[str, ast.AST, list[Contract]]] = []
+        self.findings: list[Finding] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        prev, self.cls = self.cls, node.name
+        self.generic_visit(node)
+        self.cls = prev
+
+    def _handle_def(self, node):
+        symbol = f"{self.cls}.{node.name}" if self.cls else node.name
+        contracts = parse_contracts(
+            self.comments, node.lineno, self.rp, symbol, self.findings
+        )
+        if contracts:
+            self.targets.append((symbol, node, contracts))
+        self.generic_visit(node)
+
+    visit_FunctionDef = _handle_def
+    visit_AsyncFunctionDef = _handle_def
+
+
+def check_file(path: Path) -> list[Finding]:
+    source = path.read_text()
+    rp = rel(path)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(
+            RULE_CONFIG, rp, e.lineno or 1, "",
+            f"syntax error: {e.msg}",
+        )]
+    comments = comment_map(source)
+    h = _Harvester(comments, rp)
+    h.visit(tree)
+    findings = list(h.findings)
+    for symbol, fn, contracts in h.targets:
+        findings.extend(
+            _FuncAnalysis(fn, contracts, comments, rp, symbol).run()
+        )
+    return findings
+
+
+def run(paths: list[Path]) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in sorted(paths):
+        findings.extend(check_file(p))
+    return findings
+
+
+def declared_contracts(path: Path) -> dict[str, list[Contract]]:
+    """symbol -> contracts — the annotation surface for a module (tests
+    pin that the control-plane invariants are DECLARED, the same way
+    ``guards.guarded_paths`` pins the lock annotations)."""
+    source = path.read_text()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return {}
+    comments = comment_map(source)
+    h = _Harvester(comments, rel(path))
+    h.visit(tree)
+    return {symbol: contracts for symbol, _fn, contracts in h.targets}
