@@ -842,9 +842,12 @@ def _redis_tier(url: str, spill_dir: str) -> SharedResultTier:
         return tier
 
 
-def build_result_cache(cfg) -> Optional[ResultCacheClient]:
-    """Construct the tier client from a :class:`swarm_tpu.config.
-    Config` (``SWARM_CACHE_*`` knobs); None when the tier is off."""
+def build_tier(cfg) -> Optional[SharedResultTier]:
+    """The shared tier for a Config's ``SWARM_CACHE_*`` knobs — the
+    ONE backend-dispatch + retention-policy site, shared by the
+    engine-side :func:`build_result_cache` and the gateway-side scan
+    cache (``gateway/qoscache.py``) so the two can never drift. None
+    when the tier is off."""
     backend = (getattr(cfg, "cache_backend", "off") or "off").lower()
     if backend in ("off", "", "0", "none", "false"):
         return None
@@ -863,6 +866,15 @@ def build_result_cache(cfg) -> Optional[ResultCacheClient]:
         getattr(cfg, "cache_ttl_s", 0.0),
         getattr(cfg, "cache_max_entries", 0),
     )
+    return tier
+
+
+def build_result_cache(cfg) -> Optional[ResultCacheClient]:
+    """Construct the tier client from a :class:`swarm_tpu.config.
+    Config` (``SWARM_CACHE_*`` knobs); None when the tier is off."""
+    tier = build_tier(cfg)
+    if tier is None:
+        return None
     return ResultCacheClient(
         tier,
         worker_id=cfg.worker_id,

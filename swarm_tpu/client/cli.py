@@ -33,6 +33,7 @@ class JobClient:
         api_key: str,
         timeout: float = 60.0,
         tenant: Optional[str] = None,
+        qos: Optional[str] = None,
     ):
         self.base = server_url.rstrip("/")
         self.timeout = timeout
@@ -43,6 +44,11 @@ class JobClient:
             # absent = the server's default tenant, the reference wire
             # behavior
             self.session.headers["X-Swarm-Tenant"] = tenant
+        if qos:
+            # latency class next to the tenant header (docs/GATEWAY.md
+            # §QoS): "interactive" rides the express lane + gateway
+            # cache; absent/"bulk" is the reference wire behavior
+            self.session.headers["X-Swarm-QoS"] = qos
         #: trace ID of the most recent submission (scan/stream): the
         #: correlation key every layer's event lines carry for it
         self.last_trace_id: Optional[str] = None
@@ -410,6 +416,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--scan-id", help="scan id (cat/stream)")
     parser.add_argument("--tenant", default=None,
                         help="tenant id sent as X-Swarm-Tenant (gateway)")
+    parser.add_argument("--qos", default=None,
+                        choices=["bulk", "interactive"],
+                        help="latency class sent as X-Swarm-QoS: "
+                             "'interactive' rides the express lane with "
+                             "deadline-bounded batching (scan/stream)")
     parser.add_argument("--from-chunk", type=int, default=0,
                         help="resume cursor for stream follow mode")
     parser.add_argument("--job-id", help="job id (dead-letter --requeue)")
@@ -420,7 +431,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     cfg = Config.load(path=args.config, server_url=args.server_url, api_key=args.api_key)
-    client = JobClient(cfg.resolve_url(), cfg.api_key, tenant=args.tenant)
+    client = JobClient(
+        cfg.resolve_url(), cfg.api_key, tenant=args.tenant, qos=args.qos
+    )
 
     if args.configure:
         cfg.save(args.config)

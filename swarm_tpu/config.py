@@ -193,6 +193,32 @@ class Config:
     # with ?from=<cursor>)
     gateway_stream_poll_s: float = 0.05
     gateway_stream_idle_timeout_s: float = 300.0
+    # --- latency-tiered serving (docs/GATEWAY.md §QoS) ---
+    # bulk-starvation bound for the express dispatch lane: at most this
+    # many consecutive interactive serves while bulk work is waiting,
+    # then one bulk job is served unconditionally. With no interactive
+    # submissions the express lists stay empty and dispatch order is
+    # byte-identical to the pre-QoS queue.
+    qos_express_burst: int = 4
+    # an interactive row older than this forces an early partial-bucket
+    # flush in the scheduler's planner (the deadline that bounds
+    # express-lane tail latency; only rows of the interactive class
+    # consult it, so bulk-only feeds are untouched)
+    qos_deadline_ms: float = 50.0
+    # max-age flush for EVERY bucket class (the bulk trickle-tail fix):
+    # 0 = off, today's behavior — a partial bucket waits for end of
+    # stream; >0 bounds how long any planned row can sit unflushed
+    sched_max_age_ms: float = 0.0
+    # answer fleet-known interactive submissions at the gateway tier
+    # (content-key lookup against the shared result cache BEFORE
+    # admission — zero worker dispatch on a hit). Requires
+    # cache_backend != off; bulk submissions never consult it.
+    qos_gateway_cache: bool = True
+    # completed chunks at or under this many target lines are written
+    # back to the gateway cache (the short-circuit's feed; 0 disables
+    # writeback). Small by design: interactive probes are single-target
+    # and the gateway tier must not mirror whole bulk scans.
+    qos_cache_max_rows: int = 16
     # queue-depth-driven autoscale advisor (server/fleet.py): target
     # waiting-jobs-per-node ratio, node bounds, and whether POST
     # /autoscale may actually apply the recommendation (default:

@@ -179,6 +179,20 @@ class Job:
     # land there, so legacy job records round-trip unchanged. Extra
     # wire key the reference client ignores.
     tenant: Optional[str] = None
+    # latency class (docs/GATEWAY.md §QoS): None = bulk, the reference
+    # wire behavior — submissions without X-Swarm-QoS land here and the
+    # record round-trips unchanged. "interactive" rides the express
+    # dispatch lane and the scheduler's deadline-flush path. Extra wire
+    # key the reference client ignores.
+    qos: Optional[str] = None
+    # gateway admission stamp (time.time() at queue_scan): the
+    # admission-to-verdict latency histograms subtract this from
+    # completed_at per QoS class. Extra wire key.
+    admitted_at: Optional[float] = None
+    # target-line count of this job's input chunk (stamped at
+    # submission): the gateway cache's writeback hook reads it to skip
+    # over-bound bulk chunks without fetching the blob. Extra wire key.
+    chunk_rows: Optional[int] = None
 
     @classmethod
     def create(
@@ -188,6 +202,9 @@ class Job:
         module: str,
         trace_id: Optional[str] = None,
         tenant: Optional[str] = None,
+        qos: Optional[str] = None,
+        admitted_at: Optional[float] = None,
+        chunk_rows: Optional[int] = None,
     ) -> "Job":
         return cls(
             job_id=job_id_for(scan_id, chunk_index),
@@ -196,6 +213,9 @@ class Job:
             module=module,
             trace_id=trace_id,
             tenant=tenant,
+            qos=qos,
+            admitted_at=admitted_at,
+            chunk_rows=chunk_rows,
         )
 
     def to_wire(self) -> dict[str, Any]:

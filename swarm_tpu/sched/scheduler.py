@@ -60,8 +60,17 @@ import threading
 import time
 from typing import Callable, Iterable, Iterator, Optional, Sequence
 
-from swarm_tpu.sched.buckets import BucketPlanner, PlannedBatch
+from swarm_tpu.sched.buckets import (
+    QOS_BULK,
+    QOS_INTERACTIVE,
+    BucketPlanner,
+    PlannedBatch,
+)
 from swarm_tpu.telemetry import REGISTRY
+from swarm_tpu.telemetry.sched_export import (
+    SCHED_BATCH_AGE,
+    SCHED_FLUSH_DEADLINE,
+)
 
 _BATCHES = REGISTRY.counter(
     "swarm_sched_batches_total",
@@ -134,6 +143,15 @@ class SchedulerConfig:
     #: "auto" = offload when a spare core exists and the engine's
     #: batched walk is enabled
     walk_offload: str = "auto"
+    #: interactive-row coalescing deadline (docs/GATEWAY.md §QoS): an
+    #: interactive row older than this forces an early partial-bucket
+    #: flush — the express batch preempts further coalescing while
+    #: bulk batches already on device keep flying. Only consulted for
+    #: streams that actually carry interactive rows; 0 disables.
+    qos_deadline_ms: float = 50.0
+    #: max-age flush for EVERY bucket (the bulk trickle-tail bound);
+    #: 0 = off, the pre-QoS hold-until-end-of-stream behavior
+    max_age_ms: float = 0.0
 
     def __post_init__(self):
         # queue_depth (≤2) + inflight (≤4) + the offloaded walk (1) +
@@ -270,6 +288,7 @@ class BatchScheduler:
         self,
         chunks: Iterable,
         decode: Optional[Callable[[object], Sequence]] = None,
+        qos=None,
     ) -> Iterator[list]:
         """Stream chunks through the pipeline; yield each chunk's
         RowMatches list in chunk order as it completes.
@@ -279,10 +298,26 @@ class BatchScheduler:
         prefetch stage (on its thread when one is used). Buckets
         accumulate across chunk boundaries; a chunk's results surface
         once every bucket holding one of its rows has been walked (at
-        the latest, at end of stream when partial buckets flush)."""
+        the latest, at end of stream when partial buckets flush).
+
+        ``qos`` classifies chunks for the express lane
+        (docs/GATEWAY.md §QoS): None = all bulk (the pre-QoS
+        behavior), a class string applies to every chunk (the worker's
+        one-job-one-class feed), a callable maps each raw chunk
+        payload to its class (the bench's bimodal feed). Interactive
+        rows coalesce in their own buckets and flush early once older
+        than ``qos_deadline_ms`` — results stay bit-identical, only
+        the batching changes."""
         engine = self.engine
         cfg = self.config
         stats = self.stats
+        if callable(qos):
+            qos_of = qos
+        else:
+            fixed_qos = (
+                QOS_INTERACTIVE if qos == QOS_INTERACTIVE else QOS_BULK
+            )
+            qos_of = lambda _payload: fixed_qos  # noqa: E731
         target = cfg.rows_target or engine.batch_rows
         # mesh-aware placement (docs/SHARDING.md): a sharded backend's
         # bucket targets round up to the 'data' axis size so full
@@ -294,6 +329,8 @@ class BatchScheduler:
             max_body=engine.max_body,
             max_header=engine.max_header,
             data_ranks=data_ranks,
+            qos_deadline_s=max(0.0, cfg.qos_deadline_ms) / 1000.0,
+            max_age_s=max(0.0, cfg.max_age_ms) / 1000.0,
         )
         # chunk bookkeeping (prefetch registers, submission completes;
         # the lock only matters in threaded mode)
@@ -335,7 +372,12 @@ class BatchScheduler:
                 engine, "prefetch_shared_memo", None
             )
             for chunk in chunks:
+                # classify from the RAW payload (decode may consume it)
+                chunk_qos = qos_of(chunk)
+                if chunk_qos != QOS_INTERACTIVE:
+                    chunk_qos = QOS_BULK
                 rows = list(decode(chunk) if decode else chunk)
+                now_chunk = time.monotonic()
                 with self._lock:
                     cid = len(chunk_start)
                     chunk_start.append(gid)
@@ -375,8 +417,10 @@ class BatchScheduler:
                                 _ROWS.labels(source="dead").inc(n_dead)
                             pb = PlannedBatch(
                                 ids=range(gid, gid + len(rows)),
-                                rows=rows, bucket="memo", kind="memo",
-                                data_ranks=data_ranks,
+                                rows=rows,
+                                bucket=BucketPlanner._memo_label(chunk_qos),
+                                kind="memo",
+                                data_ranks=data_ranks, qos=chunk_qos,
                             )
                             gid += len(rows)
                             yield pb, spec_pre
@@ -412,10 +456,10 @@ class BatchScheduler:
                         is_known = known is not None and known[j]
                     if is_known:
                         n_memo += 1
-                        pb = add_known(i, row)
+                        pb = add_known(i, row, chunk_qos, now_chunk)
                     else:
                         n_fresh += 1
-                        pb = add_fresh(i, row)
+                        pb = add_fresh(i, row, chunk_qos, now_chunk)
                     if pb is not None:
                         yield pb, None
                 if dead_ids:
@@ -431,6 +475,14 @@ class BatchScheduler:
                 self._steady_streak = (
                     0 if n_fresh else self._steady_streak + 1
                 )
+                # deadline-forced flushes (docs/GATEWAY.md §QoS): an
+                # interactive row older than qos_deadline_ms preempts
+                # further coalescing as a small express batch; with
+                # max_age_ms set, bulk tails get the same bound.
+                # Checked once per chunk — the feed's natural tick.
+                for pb in planner.flush_due(time.monotonic()):
+                    SCHED_FLUSH_DEADLINE.labels(qos=pb.qos).inc()
+                    yield pb, None
             for pb in planner.flush_all():
                 yield pb, None
 
@@ -558,6 +610,12 @@ class BatchScheduler:
             _INFLIGHT.set(len(inflight))
             stats.batches += 1
             _BATCHES.labels(bucket=pb.bucket, kind=pb.kind).inc()
+            if pb.oldest_ts is not None:
+                # the oldest row's coalescing wait — what the deadline
+                # flush bounds per class (docs/GATEWAY.md §QoS)
+                SCHED_BATCH_AGE.labels(qos=pb.qos).observe(
+                    max(0.0, time.monotonic() - pb.oldest_ts)
+                )
             if pb.kind == "fresh":
                 stats.device_batches += 1
                 stats.fill_sum += pb.fill_rows

@@ -26,19 +26,30 @@ from typing import Any, Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from swarm_tpu.config import Config
-from swarm_tpu.datamodel import SCAN_ID_RE, JobStatus
+from swarm_tpu.datamodel import (
+    SCAN_ID_RE,
+    JobStatus,
+    chunk_generator,
+    chunk_output_key,
+)
 from swarm_tpu.gateway.admission import (
     DEFAULT_TENANT,
     AdmissionController,
     PressureSnapshot,
 )
+from swarm_tpu.gateway.qos import QOS_HEADER, QOS_INTERACTIVE, parse_qos
+from swarm_tpu.gateway.qoscache import build_gateway_cache
 from swarm_tpu.gateway.streaming import stream_scan
 from swarm_tpu.server.fleet import AutoscaleAdvisor, build_provider
 from swarm_tpu.server.queue import JobQueueService
 from swarm_tpu.stores import build_stores
 from swarm_tpu.telemetry import REGISTRY
 from swarm_tpu.telemetry.events import header_trace_id, new_trace_id
-from swarm_tpu.telemetry.gateway_export import GATEWAY_QUEUED
+from swarm_tpu.telemetry.gateway_export import (
+    GATEWAY_LATENCY,
+    GATEWAY_QUEUED,
+    GATEWAY_SHORT_CIRCUIT,
+)
 from swarm_tpu.telemetry.metrics import CONTENT_TYPE as _METRICS_CTYPE
 
 _HTTP_REQUESTS = REGISTRY.counter(
@@ -94,6 +105,17 @@ class SwarmServer:
         self.autoscaler = AutoscaleAdvisor.from_config(
             self.queue, self.fleet, cfg
         )
+        # gateway-tier result cache (docs/GATEWAY.md §QoS): interactive
+        # submissions whose chunks are fleet-known complete HERE with
+        # zero worker dispatch. None (the default: cache_backend=off)
+        # keeps the submit path byte-identical; a backend that can't be
+        # built must not kill the server — the cache is an accelerator,
+        # never a dependency.
+        self.qos_cache = None
+        try:
+            self.qos_cache = build_gateway_cache(cfg)
+        except Exception as e:
+            print(f"gateway scan cache unavailable ({e}); pass-through")
         self._routes: list[tuple[str, re.Pattern, Callable, str]] = []
         self._register_routes()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -248,9 +270,67 @@ class SwarmServer:
         except ValueError:
             return self._json(400, {"message": "Invalid JSON"})
         self._note_perf_saturation(changes)
+        if (
+            self.qos_cache is not None
+            and changes.get("status") == JobStatus.COMPLETE
+        ):
+            # cache BEFORE the status flip becomes visible: a client
+            # that observes "scan complete" and immediately re-submits
+            # the content must hit (writeback-after-update left a
+            # window where complete-but-uncached raced the re-submit).
+            # The output chunk is already durable (the worker uploads
+            # before posting COMPLETE), and the chunk store is
+            # idempotent by content — caching bytes for an update that
+            # then gets fenced stores exactly what /raw serves anyway.
+            self._qos_cache_writeback(m["job_id"])
         if self.queue.update_job(m["job_id"], changes):
             return self._json(200, {"message": "Job status updated"})
         return self._json(404, {"message": "Job not found"})
+
+    def _qos_cache_writeback(self, job_id: str) -> None:
+        """Feed the gateway-tier cache from a freshly completed chunk
+        (docs/GATEWAY.md §QoS): small chunks — interactive probes and
+        bulk trickles up to ``qos_cache_max_rows`` target lines — are
+        stored under their ``(module, lines)`` content key so a later
+        identical interactive submission short-circuits at the gateway.
+        Best-effort by design: a failed writeback costs one future
+        device round trip, never the 200 this route will earn."""
+        max_rows = int(getattr(self.cfg, "qos_cache_max_rows", 0))
+        if max_rows <= 0:
+            return
+        try:
+            rec = self.queue.job_record(job_id)
+            if rec is None:
+                return
+            # size fast-path off the job record (queue_scan stamps
+            # chunk_rows): a bulk flood's big chunks skip the blob
+            # read entirely on this status hot path
+            known_rows = rec.get("chunk_rows")
+            if isinstance(known_rows, int) and known_rows > max_rows:
+                return
+            scan_id, chunk_index = rec["scan_id"], int(rec["chunk_index"])
+            data = self.queue.input_chunk(scan_id, chunk_index)
+            if data is None:
+                return
+            # size-bail on the raw bytes BEFORE decoding: this hook
+            # rides every completed chunk's status POST, and a bulk
+            # flood's big chunks must pay a byte count, not a full
+            # decode, to learn they're over the bound
+            if data.count(b"\n") + 1 > max_rows:
+                return
+            # the exact inverse of queue_scan's '\n'.join — NOT
+            # splitlines(), which also splits on \x0b / \x1c /
+            # U+2028 etc. and would alias a one-weird-line chunk's
+            # digest with an honest N-line submission's
+            lines = data.decode("utf-8", "surrogateescape").split("\n")
+            if not any(lines) or len(lines) > max_rows:
+                return
+            output = self.queue.blobs.get(
+                chunk_output_key(scan_id, chunk_index)
+            )
+            self.qos_cache.writeback(rec["module"], lines, output)
+        except Exception as e:
+            print(f"gateway cache writeback skipped for {job_id}: {e}")
 
     def _note_perf_saturation(self, changes: dict) -> None:
         """Fold a completed job's perf fields into the admission
@@ -333,7 +413,35 @@ class SwarmServer:
             open_breakers=open_breakers,
         )
 
+    def _admission_decision(self, tenant: str):
+        return self.gateway.decide(
+            tenant,
+            self._pressure_snapshot(),
+            time.monotonic(),
+            tenant_depth=self.queue.tenant_depth(tenant),
+        )
+
+    @staticmethod
+    def _shed_response(decision) -> tuple:
+        retry_after = max(0.0, decision.retry_after_s)
+        import math
+
+        return (
+            429,
+            json.dumps(
+                {
+                    "message": "Request shed by admission control",
+                    "reason": decision.reason,
+                    "retry_after_s": round(retry_after, 3),
+                    "pressure": round(decision.pressure, 4),
+                }
+            ).encode(),
+            "application/json",
+            {"Retry-After": str(max(1, math.ceil(retry_after)))},
+        )
+
     def _queue_job(self, m, q, body, h):
+        t0 = time.perf_counter()
         try:
             job_data = json.loads(body or b"{}")
         except ValueError:
@@ -342,45 +450,77 @@ class SwarmServer:
         # submitting tenant; absent = the default tenant, preserving
         # the reference wire contract
         tenant = (self._header(h, "X-Swarm-Tenant") or "").strip() or DEFAULT_TENANT
+        # QoS class (docs/GATEWAY.md §QoS): X-Swarm-QoS next to the
+        # tenant header; absent/"bulk" = None, the reference behavior.
+        # An unknown class is a 400, never a silent bulk ride.
+        try:
+            qos = parse_qos(self._header(h, QOS_HEADER))
+        except ValueError as e:
+            return self._text(400, str(e))
         # shape-validate BEFORE admission: a malformed submission is a
         # 400, never a consumed rate token or an "admitted" count
         try:
-            _module, _scan_id, tenant = JobQueueService.validate_scan(
+            module, _scan_id, tenant = JobQueueService.validate_scan(
                 job_data, tenant
             )
         except ValueError as e:
             return self._text(400, str(e))
-        # admission control: shed, never block — a 429 with Retry-After
-        # is the overload story, not a growing queue
-        decision = self.gateway.decide(
-            tenant,
-            self._pressure_snapshot(),
-            time.monotonic(),
-            tenant_depth=self.queue.tenant_depth(tenant),
-        )
-        if not decision.admitted:
-            retry_after = max(0.0, decision.retry_after_s)
-            import math
-
-            return (
-                429,
-                json.dumps(
-                    {
-                        "message": "Request shed by admission control",
-                        "reason": decision.reason,
-                        "retry_after_s": round(retry_after, 3),
-                        "pressure": round(decision.pressure, 4),
-                    }
-                ).encode(),
-                "application/json",
-                {"Retry-After": str(max(1, math.ceil(retry_after)))},
-            )
-        # trace propagation: honor the client's X-Swarm-Trace, mint one
-        # for clients that don't send it (reference client) so every job
-        # record carries a usable correlation id either way
         trace_id = header_trace_id(h) or new_trace_id()
+        # admission control, ONE decision for every path: shed, never
+        # block — a 429 with Retry-After is the overload story, not a
+        # growing queue. The decision runs before the cache lookup on
+        # purpose: a hit needs the same decision anyway (answering
+        # from cache is cheap — no worker, no queue seat — but not
+        # free: blobs + a journaled record per chunk, so cached
+        # content must not become an unthrottled durable-write path),
+        # and under overload the shed skips the digest + tier round
+        # trip entirely
+        decision = self._admission_decision(tenant)
+        if not decision.admitted:
+            return self._shed_response(decision)
+        # gateway-tier short-circuit (docs/GATEWAY.md §QoS): an
+        # admitted interactive submission whose every chunk is
+        # fleet-known completes right here — zero worker dispatch.
+        # Only chunks the writeback bound (qos_cache_max_rows) can
+        # ever have stored are looked up: a big bulk-shaped
+        # interactive submission is a guaranteed miss, and must not
+        # pay per-chunk digests + a tier round trip to learn it
+        if qos == QOS_INTERACTIVE and self.qos_cache is not None:
+            lines, batch_size, _base = JobQueueService.parse_submission(
+                job_data
+            )
+            max_rows = int(getattr(self.cfg, "qos_cache_max_rows", 0))
+            chunks = (
+                list(chunk_generator(lines, batch_size))
+                if lines and max_rows > 0 else []
+            )
+            if any(len(c) > max_rows for c in chunks):
+                chunks = []
+            outputs = (
+                self.qos_cache.lookup_chunks(module, chunks)
+                if chunks else None
+            )
+            if outputs is not None:
+                try:
+                    self.queue.complete_scan_from_cache(
+                        job_data, outputs, trace_id=trace_id,
+                        tenant=tenant, qos=qos,
+                    )
+                except ValueError as e:
+                    return self._text(400, str(e))
+                GATEWAY_SHORT_CIRCUIT.labels(outcome="hit").inc()
+                GATEWAY_LATENCY.labels(qos=QOS_INTERACTIVE).observe(
+                    time.perf_counter() - t0
+                )
+                return self._text(200, "Job queued successfully")
+            GATEWAY_SHORT_CIRCUIT.labels(outcome="miss").inc()
+        # trace_id minted above (honoring the client's X-Swarm-Trace)
+        # so the short-circuit path and the queued path correlate the
+        # same way
         try:
-            self.queue.queue_scan(job_data, trace_id=trace_id, tenant=tenant)
+            self.queue.queue_scan(
+                job_data, trace_id=trace_id, tenant=tenant, qos=qos
+            )
         except ValueError as e:
             return self._text(400, str(e))
         return self._text(200, "Job queued successfully")

@@ -37,9 +37,11 @@ from swarm_tpu.datamodel import (
     rollup_scans,
 )
 from swarm_tpu.gateway.admission import DEFAULT_TENANT
+from swarm_tpu.gateway.qos import QOS_INTERACTIVE, qos_class
 from swarm_tpu.server.journal import QueueJournal
 from swarm_tpu.stores import BlobStore, DocStore, StateStore
 from swarm_tpu.telemetry import REGISTRY, emit_event
+from swarm_tpu.telemetry.gateway_export import GATEWAY_LATENCY
 from swarm_tpu.telemetry.journal_export import (
     JOURNAL_CORRUPT,
     JOURNAL_REPLAYED,
@@ -71,6 +73,11 @@ _LEASE_RENEWALS = REGISTRY.counter(
     "swarm_queue_lease_renewals_total",
     "Lease renewal requests",
     ("outcome",),
+)
+_EXPRESS_SERVED = REGISTRY.counter(
+    "swarm_queue_express_served_total",
+    "Jobs dispatched from the interactive express lane "
+    "(docs/GATEWAY.md §QoS)",
 )
 _JOBS_TERMINAL = REGISTRY.counter(
     "swarm_queue_jobs_terminal_total",
@@ -118,6 +125,13 @@ class JobQueueService:
         # served last, so a deep queue from one tenant can never starve
         # the others (equal weights; the cursor only moves on a serve)
         self._rr_cursor = 0  # guarded-by: _lock
+        # express-lane twin of the cursor (docs/GATEWAY.md §QoS):
+        # interactive tenants rotate fairly among themselves, same rule
+        self._rr_cursor_x = 0  # guarded-by: _lock
+        # consecutive express serves while bulk work was waiting — the
+        # bulk-starvation bound (cfg.qos_express_burst) ticks against
+        # this and forces one bulk serve when it trips
+        self._express_streak = 0  # guarded-by: _lock
         # durable queue journal (docs/DURABILITY.md): every mutation is
         # journaled BEFORE the state store is touched, so the journal
         # is always a superset of the store and a restart replays it.
@@ -150,23 +164,40 @@ class JobQueueService:
     # Tenant queues (docs/GATEWAY.md)
     # ------------------------------------------------------------------
     @staticmethod
-    def _queue_list(tenant: Optional[str]) -> str:
-        """Dispatch-list key for one tenant. The default tenant keeps
-        the reference's bare ``job_queue`` list so legacy tooling (and
-        a real Redis populated by the reference server) interoperates
-        unchanged; other tenants get their own bounded list."""
+    def _queue_list(tenant: Optional[str], qos: Optional[str] = None) -> str:
+        """Dispatch-list key for one (tenant, QoS lane). The default
+        tenant's bulk lane keeps the reference's bare ``job_queue``
+        list so legacy tooling (and a real Redis populated by the
+        reference server) interoperates unchanged; other tenants get
+        their own bounded list, and the interactive express lane gets
+        a ``:x``-prefixed twin per tenant (docs/GATEWAY.md §QoS)."""
+        if qos == QOS_INTERACTIVE:
+            if not tenant or tenant == DEFAULT_TENANT:
+                return "job_queue:x"
+            return f"job_queue:x:t:{tenant}"
         if not tenant or tenant == DEFAULT_TENANT:
             return "job_queue"
         return f"job_queue:t:{tenant}"
 
-    def _queue_names(self) -> list[str]:
-        """Every dispatch list, default first then registered tenants
-        in sorted order (a stable rotation order for the fair cursor)."""
-        names = ["job_queue"]
-        for tenant in sorted(self.state.hkeys("tenants")):
+    def _lane_names(
+        self, qos: Optional[str] = None, tenants: Optional[list] = None
+    ) -> list[str]:
+        """ONE lane's dispatch lists, default tenant first then
+        registered tenants in sorted order (a stable rotation order
+        for that lane's fair cursor). ``tenants`` lets the dispatch
+        hot path reuse one registry read for both lanes."""
+        if tenants is None:
+            tenants = sorted(self.state.hkeys("tenants"))
+        names = [self._queue_list(None, qos)]
+        for tenant in tenants:
             if tenant != DEFAULT_TENANT:
-                names.append(self._queue_list(tenant))
+                names.append(self._queue_list(tenant, qos))
         return names
+
+    def _queue_names(self) -> list[str]:
+        """Every dispatch list across both lanes, express first (the
+        order dispatch consults them)."""
+        return self._lane_names(QOS_INTERACTIVE) + self._lane_names()
 
     def tenants(self) -> list[str]:
         """Registered tenants (default always listed first)."""
@@ -176,17 +207,19 @@ class JobQueueService:
         return [DEFAULT_TENANT] + rest
 
     def tenant_depths(self) -> dict[str, int]:
-        """Waiting-job depth per tenant (O(1) llen per tenant)."""
-        out = {DEFAULT_TENANT: self.state.llen("job_queue")}
-        for tenant in self.tenants():
-            if tenant != DEFAULT_TENANT:
-                out[tenant] = self.state.llen(self._queue_list(tenant))
-        return out
+        """Waiting-job depth per tenant, both lanes (two O(1) llens
+        per tenant)."""
+        return {
+            tenant: self.tenant_depth(tenant) for tenant in self.tenants()
+        }
 
     def tenant_depth(self, tenant: Optional[str]) -> int:
-        """ONE tenant's waiting-job depth — O(1), for the admission
-        hot path (the all-tenant map is O(tenants) store calls)."""
-        return self.state.llen(self._queue_list(tenant))
+        """ONE tenant's waiting-job depth across both lanes — two
+        llens, for the admission hot path (the all-tenant map is
+        O(tenants) store calls)."""
+        return self.state.llen(self._queue_list(tenant)) + self.state.llen(
+            self._queue_list(tenant, QOS_INTERACTIVE)
+        )
 
     # ------------------------------------------------------------------
     # Telemetry snapshots (scrape-time: /metrics and /healthz)
@@ -324,18 +357,31 @@ class JobQueueService:
             raise ValueError("Invalid batch_size or chunk_index")
         return str(module), str(scan_id), tenant
 
+    @staticmethod
+    def parse_submission(job_data: dict) -> tuple[list, int, int]:
+        """``(lines, batch_size, base_index)`` of one submission — the
+        ONE normalization site. queue_scan, complete_scan_from_cache
+        and the gateway's short-circuit lookup all chunk through this,
+        so the cache lookup's digests and the persisted chunks can
+        never drift apart (a drift would silently misalign cached
+        outputs against chunks)."""
+        lines = [
+            l.rstrip("\n") for l in (job_data.get("file_content") or [])
+        ]
+        batch_size = int(float(job_data.get("batch_size") or 0))
+        base_index = int(job_data.get("chunk_index") or 0)
+        return lines, batch_size, base_index
+
     # orders: _put_job < state.rpush (journaled record before the dispatch-list push)
     def queue_scan(
         self,
         job_data: dict,
         trace_id: Optional[str] = None,
         tenant: Optional[str] = None,
+        qos: Optional[str] = None,
     ) -> dict:
         module, scan_id, tenant = self.validate_scan(job_data, tenant)
-        file_content = job_data.get("file_content") or []
-        lines = [l.rstrip("\n") for l in file_content]
-        batch_size = int(float(job_data.get("batch_size") or 0))
-        base_index = int(job_data.get("chunk_index") or 0)
+        lines, batch_size, base_index = self.parse_submission(job_data)
 
         if self._journal is not None and not self.state.hget("tenants", tenant):
             # tenant-registry op journaled BEFORE the registry write,
@@ -344,7 +390,11 @@ class JobQueueService:
             with self._journal_lock:
                 self._journal.append({"op": "tenant", "tenant": tenant})  # blocking-ok: WAL append under _journal_lock is the append->apply atom (docs/DURABILITY.md)
         self.state.hset("tenants", tenant, "1")
-        queue_list = self._queue_list(tenant)
+        # QoS lane selection (docs/GATEWAY.md §QoS): interactive scans
+        # land on the tenant's express list; qos None (every reference
+        # submission) keeps the exact pre-QoS list
+        queue_list = self._queue_list(tenant, qos)
+        admitted_at = time.time()
         queued = 0
         for offset, chunk in enumerate(chunk_generator(lines, batch_size)):
             chunk_index = base_index + offset
@@ -352,7 +402,9 @@ class JobQueueService:
                 chunk_input_key(scan_id, chunk_index), "\n".join(chunk).encode()
             )
             job = Job.create(
-                scan_id, chunk_index, module, trace_id=trace_id, tenant=tenant
+                scan_id, chunk_index, module, trace_id=trace_id,
+                tenant=tenant, qos=qos, admitted_at=admitted_at,
+                chunk_rows=len(chunk),
             )
             self._put_job(job)
             self.state.rpush(queue_list, job.job_id)
@@ -366,6 +418,7 @@ class JobQueueService:
                 module=module,
                 chunk_index=chunk_index,
                 tenant=tenant,
+                qos=qos,
             )
         self._maybe_checkpoint()
         return {"scan_id": scan_id, "chunks": queued}
@@ -386,6 +439,7 @@ class JobQueueService:
                         "op": "job",
                         "job": job.to_wire(),
                         "rr_cursor": self._rr_cursor,
+                        "rr_cursor_x": self._rr_cursor_x,
                     }
                 )
                 self.state.hset("jobs", job.job_id, job.to_json())
@@ -397,6 +451,75 @@ class JobQueueService:
     def _get_job_record(self, job_id: str) -> Optional[Job]:
         raw = self.state.hget("jobs", job_id)
         return Job.from_json(raw) if raw else None
+
+    def job_record(self, job_id: str) -> Optional[dict]:
+        """One job's wire record (public: the gateway's cache-writeback
+        hook reads a completed job's module/QoS/chunk coordinates)."""
+        job = self._get_job_record(job_id)
+        return job.to_wire() if job is not None else None
+
+    # orders: blobs.put < _put_job (output chunk durable before the COMPLETE record —
+    # recovery's output-present=>complete reconciliation reads the blob store as truth)
+    def complete_scan_from_cache(
+        self,
+        job_data: dict,
+        outputs: list,
+        trace_id: Optional[str] = None,
+        tenant: Optional[str] = None,
+        qos: Optional[str] = None,
+    ) -> dict:
+        """Gateway-tier short-circuit (docs/GATEWAY.md §QoS): persist
+        fleet-known outputs and create already-COMPLETE job records —
+        the scan finishes without touching a dispatch list or a
+        worker. ``outputs`` aligns 1:1 with the submission's chunks
+        (the caller looked every one of them up in the shared tier);
+        every downstream surface — /raw, /stream, /get-statuses, the
+        tail client's ``completed`` pop-list — behaves exactly as if a
+        worker had drained the scan."""
+        module, scan_id, tenant = self.validate_scan(job_data, tenant)
+        lines, batch_size, base_index = self.parse_submission(job_data)
+        if self._journal is not None and not self.state.hget("tenants", tenant):
+            with self._journal_lock:
+                self._journal.append({"op": "tenant", "tenant": tenant})  # blocking-ok: WAL append under _journal_lock is the append->apply atom (docs/DURABILITY.md)
+        self.state.hset("tenants", tenant, "1")
+        admitted_at = time.time()
+        done = 0
+        for offset, (chunk, output) in enumerate(
+            zip(chunk_generator(lines, batch_size), outputs)
+        ):
+            chunk_index = base_index + offset
+            self.blobs.put(
+                chunk_input_key(scan_id, chunk_index),
+                "\n".join(chunk).encode(),
+            )
+            # output BEFORE the record: a COMPLETE record must never
+            # exist without its chunk
+            self.blobs.put(chunk_output_key(scan_id, chunk_index), output)
+            job = Job.create(
+                scan_id, chunk_index, module, trace_id=trace_id,
+                tenant=tenant, qos=qos, admitted_at=admitted_at,
+                chunk_rows=len(chunk),
+            )
+            job.status = JobStatus.COMPLETE
+            job.completed_at = time.time()
+            self._put_job(job)
+            # the tail client follows the same pop-list a worker-drained
+            # completion feeds
+            self.state.rpush("completed", job.job_id)
+            _JOBS_TERMINAL.labels(status=JobStatus.COMPLETE).inc()
+            done += 1
+            emit_event(
+                "job.short_circuit",
+                trace_id=trace_id,
+                job_id=job.job_id,
+                scan_id=scan_id,
+                module=module,
+                chunk_index=chunk_index,
+                tenant=tenant,
+                qos=qos,
+            )
+        self._maybe_checkpoint()
+        return {"scan_id": scan_id, "chunks": done}
 
     # ------------------------------------------------------------------
     # Dispatch (reference get_job, server.py:465-515) + leases
@@ -411,32 +534,42 @@ class JobQueueService:
         worker.last_contact = now
 
         job: Optional[Job] = None
+        express = False
         with self._lock:
             self._requeue_expired(now)
-            # weighted-fair dequeue (docs/GATEWAY.md): scan the tenant
-            # lists round-robin from the cursor, serve the first
-            # non-empty one, park the cursor AFTER it — one tenant's
-            # backlog can delay another by at most (tenants - 1) serves
-            names = self._queue_names()
-            for k in range(len(names)):
-                name = names[(self._rr_cursor + k) % len(names)]
-                # loop (not recursion): drop dangling ids from queue/
-                # hash desync (e.g. /reset racing a submit) without
-                # blowing the stack
-                while True:
-                    job_id = self.state.lpop(name)
-                    if job_id is None:
-                        break
-                    job = self._get_job_record(job_id)
-                    if job is not None and job.status == JobStatus.QUEUED:
-                        break
-                    # dangling id, or a job that left QUEUED while its
-                    # id was still in the list (e.g. completed unfenced
-                    # after a lease-expiry requeue) — never re-lease
-                    job = None
+            # lane policy (docs/GATEWAY.md §QoS): the express lane is
+            # served ahead of bulk so an interactive job admitted
+            # mid-flood pre-empts the backlog — but at most
+            # qos_express_burst consecutive times while bulk work is
+            # actually waiting, then one bulk serve is forced. With no
+            # interactive submissions the express lists are empty and
+            # this is byte-identical to the pre-QoS dequeue.
+            burst = max(1, int(self.cfg.qos_express_burst))
+            # ONE registry read serves both lanes' list names and the
+            # starvation check — the dispatch hot path must not scale
+            # its store round trips with how many places need the list
+            tenants = sorted(self.state.hkeys("tenants"))
+            lane_names = {
+                QOS_INTERACTIVE: self._lane_names(QOS_INTERACTIVE, tenants),
+                None: self._lane_names(None, tenants),
+            }
+            lanes = [QOS_INTERACTIVE, None]
+            if self._express_streak >= burst:
+                lanes = [None, QOS_INTERACTIVE]
+            for lane in lanes:
+                job, name = self._pop_lane(lane, lane_names[lane])
                 if job is not None:
-                    self._rr_cursor = (self._rr_cursor + k + 1) % len(names)
+                    express = lane == QOS_INTERACTIVE
                     break
+            if job is not None:
+                if express and any(
+                    self.state.llen(n) for n in lane_names[None]
+                ):
+                    # the streak only grows while bulk work waits — an
+                    # idle bulk lane means nothing is being starved
+                    self._express_streak += 1
+                else:
+                    self._express_streak = 0
 
             if job is not None:
                 # lease assignment stays under the store lock: between
@@ -465,12 +598,15 @@ class JobQueueService:
             worker.status = WorkerStatus.ACTIVE
             self._save_worker(worker)
             _JOBS_DISPATCHED.inc()
+            if express:
+                _EXPRESS_SERVED.inc()
             emit_event(
                 "job.dispatch",
                 trace_id=job.trace_id,
                 job_id=job.job_id,
                 worker_id=worker_id,
                 attempts=job.attempts,
+                qos=job.qos,
             )
             return job.to_wire()
 
@@ -482,6 +618,43 @@ class JobQueueService:
                 self.fleet.teardown_async(worker_id)
         self._save_worker(worker)
         return None
+
+    # requires-lock: _lock (runs inside next_job's dispatch transaction)
+    # blocking-ok: the lane pop IS the dispatch transaction's first
+    # half — the pop->lease transition must be invisible to a
+    # concurrent renew/update (the same waiver next_job documents)
+    def _pop_lane(
+        self, qos: Optional[str], names: list
+    ) -> tuple[Optional[Job], Optional[str]]:
+        """Weighted-fair dequeue over ONE lane's tenant lists
+        (docs/GATEWAY.md): scan round-robin from the lane's cursor,
+        serve the first non-empty list, park the cursor AFTER it — one
+        tenant's backlog can delay another by at most (tenants - 1)
+        serves. Returns ``(job, list_name)`` or ``(None, None)``."""
+        is_x = qos == QOS_INTERACTIVE
+        cursor = self._rr_cursor_x if is_x else self._rr_cursor
+        for k in range(len(names)):
+            name = names[(cursor + k) % len(names)]
+            # loop (not recursion): drop dangling ids from queue/hash
+            # desync (e.g. /reset racing a submit) without blowing the
+            # stack
+            while True:
+                job_id = self.state.lpop(name)
+                if job_id is None:
+                    break
+                job = self._get_job_record(job_id)
+                if job is not None and job.status == JobStatus.QUEUED:
+                    # dangling ids, or a job that left QUEUED while its
+                    # id was still in the list (e.g. completed unfenced
+                    # after a lease-expiry requeue), are dropped above —
+                    # never re-leased
+                    nxt = (cursor + k + 1) % len(names)
+                    if is_x:
+                        self._rr_cursor_x = nxt
+                    else:
+                        self._rr_cursor = nxt
+                    return job, name
+        return None, None
 
     # requires-lock: _lock (runs inside next_job's dispatch transaction)
     # orders: _put_job < state.rpush; orders: _put_job < state.hdel (record-first requeue)
@@ -534,10 +707,13 @@ class JobQueueService:
             # lease first would strand an ACTIVE job nothing scans
             self._put_job(job)
             self.state.hdel("leases", job_id)
-            # a requeue goes back to ITS tenant's list: lease recovery
-            # must not launder an abusive tenant's jobs into another
-            # tenant's dispatch share
-            self.state.rpush(self._queue_list(job.tenant), job.job_id)
+            # a requeue goes back to ITS tenant's list IN ITS LANE:
+            # lease recovery must not launder an abusive tenant's jobs
+            # into another tenant's dispatch share, and an interactive
+            # job must keep its QoS class across retries
+            self.state.rpush(
+                self._queue_list(job.tenant, job.qos), job.job_id
+            )
             _JOBS_REQUEUED.inc()
             emit_event(
                 "job.requeued", trace_id=job.trace_id, job_id=job_id,
@@ -644,7 +820,10 @@ class JobQueueService:
             job.lease_expires_at = None
             job.attempts = 0
             self._put_job(job)
-            self.state.rpush(self._queue_list(job.tenant), job.job_id)
+            # operator requeue keeps the tenant and the QoS lane too
+            self.state.rpush(
+                self._queue_list(job.tenant, job.qos), job.job_id
+            )
         _JOBS_REQUEUED.inc()
         emit_event(
             "job.dead_letter_requeued", trace_id=job.trace_id, job_id=job_id
@@ -728,7 +907,10 @@ class JobQueueService:
                 # swarmlint protocol pass)
                 self._put_job(job)
                 self.state.hdel("leases", job_id)
-                self.state.rpush(self._queue_list(job.tenant), job.job_id)
+                # retries keep the tenant AND the QoS lane
+                self.state.rpush(
+                    self._queue_list(job.tenant, job.qos), job.job_id
+                )
                 _JOBS_RETRIED.labels(status=new_status).inc()
                 emit_event(
                     "job.retry",
@@ -784,6 +966,19 @@ class JobQueueService:
                     and rows > 0
                 ):
                     _JOB_ROWS.inc(rows)
+                # admission-to-verdict latency, per QoS class
+                # (docs/GATEWAY.md §QoS): one observation per job at
+                # its COMPLETE transition. Finiteness/sign-guarded —
+                # the stamps ride job records a buggy worker's update
+                # could have clobbered
+                if isinstance(updated.admitted_at, (int, float)) and isinstance(
+                    updated.completed_at, (int, float)
+                ):
+                    dt = updated.completed_at - updated.admitted_at
+                    if math.isfinite(dt) and dt >= 0:
+                        GATEWAY_LATENCY.labels(
+                            qos=qos_class(updated.qos)
+                        ).observe(dt)
             emit_event(
                 "job.terminal",
                 trace_id=updated.trace_id,
@@ -920,6 +1115,8 @@ class JobQueueService:
                 self._journal.clear()
         with self._lock:
             self._rr_cursor = 0
+            self._rr_cursor_x = 0
+            self._express_streak = 0
         with self._gen_lock:
             self._jobs_generation += 1
 
@@ -948,6 +1145,7 @@ class JobQueueService:
             "queues": queues,
             "tenants": self.tenants(),
             "rr_cursor": self._rr_cursor,
+            "rr_cursor_x": self._rr_cursor_x,
         }
 
     # blocking-ok: the snapshot->checkpoint pair holds _journal_lock so
@@ -996,6 +1194,7 @@ class JobQueueService:
         order: dict[str, int] = {}
         tenants: set[str] = set()
         cursor = 0
+        cursor_x = 0
         idx = 0
         replayed = 0
 
@@ -1027,6 +1226,10 @@ class JobQueueService:
                 cursor = int(snapshot.get("rr_cursor") or 0)
             except (TypeError, ValueError):
                 cursor = 0
+            try:
+                cursor_x = int(snapshot.get("rr_cursor_x") or 0)
+            except (TypeError, ValueError):
+                cursor_x = 0
         for rec in records:
             replayed += 1
             if rec.get("op") == "tenant":
@@ -1042,6 +1245,11 @@ class JobQueueService:
             if "rr_cursor" in rec:
                 try:
                     cursor = int(rec["rr_cursor"])
+                except (TypeError, ValueError):
+                    pass
+            if "rr_cursor_x" in rec:
+                try:
+                    cursor_x = int(rec["rr_cursor_x"])
                 except (TypeError, ValueError):
                     pass
         JOURNAL_REPLAYED.inc(replayed)
@@ -1113,10 +1321,15 @@ class JobQueueService:
                 counts["terminal"] += 1
             self.state.hset("jobs", job_id, job.to_json())
         for job_id in sorted(queued, key=lambda j: order.get(j, 0)):
-            self.state.rpush(self._queue_list(jobs[job_id].tenant), job_id)
+            # rebuilt into the job's OWN (tenant, QoS lane) list — a
+            # restart must not demote recovered interactive jobs to
+            # the bulk lane
+            job = jobs[job_id]
+            self.state.rpush(self._queue_list(job.tenant, job.qos), job_id)
 
         with self._lock:
             self._rr_cursor = cursor
+            self._rr_cursor_x = cursor_x
         with self._gen_lock:
             self._jobs_generation += 1
         for outcome, n in counts.items():

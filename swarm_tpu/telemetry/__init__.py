@@ -40,5 +40,6 @@ from swarm_tpu.telemetry import device_export  # noqa: E402,F401
 from swarm_tpu.telemetry import shard_export  # noqa: E402,F401
 from swarm_tpu.telemetry import memo_export  # noqa: E402,F401
 from swarm_tpu.telemetry import gateway_export  # noqa: E402,F401
+from swarm_tpu.telemetry import sched_export  # noqa: E402,F401
 from swarm_tpu.telemetry import journal_export  # noqa: E402,F401
 from swarm_tpu.telemetry import aot_export  # noqa: E402,F401

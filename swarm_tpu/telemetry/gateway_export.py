@@ -64,3 +64,34 @@ GATEWAY_STREAM_BYTES = REGISTRY.counter(
     "swarm_gateway_stream_bytes_total",
     "Result bytes pushed to /stream clients (NDJSON payload lines)",
 )
+
+#: admission-to-verdict latency per QoS class (docs/GATEWAY.md §QoS):
+#: observed once per job at its COMPLETE transition (completed_at -
+#: admitted_at), and once per gateway-cache short-circuit (the handler
+#: elapsed time — the scan completed without a worker). Buckets span
+#: the interactive SLO range through bulk batch times. Both class
+#: combos pre-seeded so the families render before the first scan.
+GATEWAY_LATENCY = REGISTRY.histogram(
+    "swarm_gateway_latency_seconds",
+    "Admission-to-verdict latency by QoS class",
+    ("qos",),
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0, 60.0),
+)
+for _q in ("bulk", "interactive"):
+    GATEWAY_LATENCY.labels(qos=_q)
+del _q
+
+#: gateway-tier cache short-circuit outcomes (docs/GATEWAY.md §QoS):
+#: ``hit`` = every chunk of an interactive submission was fleet-known
+#: and the scan completed at the gateway with zero worker dispatch;
+#: ``miss`` = at least one chunk unknown, normal admission followed
+GATEWAY_SHORT_CIRCUIT = REGISTRY.counter(
+    "swarm_gateway_cache_short_circuit_total",
+    "Interactive submissions answered (hit) or passed through (miss) "
+    "by the gateway-tier result cache",
+    ("outcome",),
+)
+for _o in ("hit", "miss"):
+    GATEWAY_SHORT_CIRCUIT.labels(outcome=_o)
+del _o

@@ -420,7 +420,9 @@ class JobProcessor:
                 # detail carries the job id so a plan can poison one job
                 fault_point("executor.run", detail=job_id)
                 if module.backend == "tpu":
-                    output = self._execute_tpu(module, data)
+                    output = self._execute_tpu(
+                        module, data, qos=job.get("qos")
+                    )
                 elif module.backend == "probe":
                     output = self._execute_probe(module, data)
                 elif module.backend == "service":
@@ -835,11 +837,16 @@ class JobProcessor:
             self._engines[templates_dir] = engine
         return engine
 
-    def _execute_tpu(self, module: ModuleSpec, data: bytes) -> bytes:
+    def _execute_tpu(
+        self, module: ModuleSpec, data: bytes, qos: Optional[str] = None
+    ) -> bytes:
         """Device-batch path: chunk rows → MatchEngine → JSONL hits.
 
         ``input_format: targets`` first runs the native probe front-end
-        (resolve + connect + banner/HTTP fetch) to build the rows."""
+        (resolve + connect + banner/HTTP fetch) to build the rows.
+        ``qos`` is the job's latency class (docs/GATEWAY.md §QoS): on
+        the pipelined path interactive chunks ride the scheduler's
+        express buckets with the deadline flush armed."""
         if not module.templates_dir:
             raise ValueError(f"tpu module {module.name} missing 'templates'")
         engine = self._engine_for(module.templates_dir)
@@ -886,8 +893,13 @@ class JobProcessor:
 
             rows = []
             results = []
+            sched = engine.scheduler()
+            # operator deadline knobs reach the planner here (the
+            # scheduler is engine-lazy; the engine ctor never sees cfg)
+            sched.config.qos_deadline_ms = self.cfg.qos_deadline_ms
+            sched.config.max_age_ms = self.cfg.sched_max_age_ms
             for ci, res in enumerate(
-                engine.scheduler().run(payloads, decode=decode)
+                sched.run(payloads, decode=decode, qos=qos)
             ):
                 rows.extend(rows_by_chunk.pop(ci))
                 results.extend(res)

@@ -91,6 +91,13 @@ def _fake_phase_output(phase: str) -> str:
              "full-plane batch; compile arm in extra)",
              "vs_baseline": 18.3},
         ],
+        "latency": [
+            {"metric": "qos_interactive_p99_speedup", "value": 6.2,
+             "unit": "x (interactive admission-to-verdict p99: bulk "
+             "lane / express lane, open-loop bimodal load)",
+             "vs_baseline": 1.24, "interactive_p99_ms": 3534.2,
+             "bulk_retention_ratio": 1.006},
+        ],
         "oracle": [
             {"metric": "cpu_oracle_rows_per_sec", "value": 12.0,
              "unit": "rows/sec", "vs_baseline": 1.0},

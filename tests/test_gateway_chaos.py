@@ -57,9 +57,9 @@ def stack(tmp_path, monkeypatch):
     device_lock = threading.Lock()
     orig_execute = rt.JobProcessor._execute_tpu
 
-    def serialized(self, module, data):
+    def serialized(self, module, data, **kw):
         with device_lock:
-            return orig_execute(self, module, data)
+            return orig_execute(self, module, data, **kw)
 
     monkeypatch.setattr(rt.JobProcessor, "_execute_tpu", serialized)
     modules_dir = tmp_path / "modules"

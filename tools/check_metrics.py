@@ -79,6 +79,13 @@ REQUIRED_FAMILIES = (
     "swarm_gateway_queued_by_tenant",
     "swarm_gateway_pressure",
     "swarm_gateway_stream_bytes_total",
+    # latency-tiered serving (docs/GATEWAY.md §QoS): per-class
+    # admission-to-verdict histogram + the scheduler's deadline-flush
+    # counter, both registered at telemetry import (gateway_export /
+    # sched_export) with bulk+interactive combos pre-seeded — every
+    # process's /metrics carries them, scheduler imported or not
+    "swarm_gateway_latency_seconds",
+    "swarm_sched_flush_deadline_total",
     # durable queue journal (docs/DURABILITY.md): registered at
     # telemetry import (journal_export), op/outcome combos pre-seeded —
     # every family renders samples even on a never-journaled process
