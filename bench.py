@@ -1837,6 +1837,218 @@ def bench_qos_latency(
     return rec
 
 
+def _trace_latency_breakdown(
+    flood_jobs: int = 4, flood_batch: int = 4, probes: int = 3
+) -> dict:
+    """Span-derived per-QoS-class latency decomposition for the
+    latency phase's headline JSON (docs/OBSERVABILITY.md §Tracing): a
+    short traced run — one bulk flood plus interactive probes against
+    a real server + worker — whose assembled waterfalls are reduced to
+    per-segment medians (queue-wait / sched / download / execute /
+    device / walk / upload, ms) per class. Runs SEPARATELY from the
+    timed A/B arms on purpose: the headline latency numbers stay
+    tracing-free, and this run answers the follow-up question those
+    numbers raise ("where does the interactive p99 actually go?")."""
+    import statistics
+
+    from swarm_tpu.telemetry import tracing
+
+    rng = np.random.default_rng(47)
+    flood_lines = []
+    for i in range(flood_jobs * flood_batch):
+        salt = bytes(rng.integers(97, 123, size=24, dtype=np.uint8)).decode()
+        flood_lines.append(
+            json.dumps(
+                {"host": f"198.51.101.{i % 254}", "port": 80,
+                 "status": 200,
+                 "body": f"<title>TBulk {i}</title> {salt}"}
+            ) + "\n"
+        )
+    probe_lines = _qos_probe_lines(probes, seed=53)
+    tracing.set_enabled(True)
+    stack = _QosStack("tlat", busy_s=0.01)
+    try:
+        ids = {
+            "bulk": ["tlflood_1"],
+            "interactive": [f"tlprobe{i}_1" for i in range(probes)],
+        }
+        codes = [stack.submit("tlflood_1", flood_lines, flood_batch)]
+        codes += [
+            stack.submit(ids["interactive"][i], [line], 1,
+                         qos="interactive")
+            for i, line in enumerate(probe_lines)
+        ]
+        done, _ = stack.wait_complete(
+            ids["bulk"] + ids["interactive"], deadline_s=300
+        )
+        segs = ("queue-wait", "sched", "download", "execute",
+                "device", "walk", "upload")
+        out: dict = {
+            "all_complete": bool(done),
+            "codes_ok": all(c == 200 for c in codes),
+        }
+        for cls, scan_ids in ids.items():
+            by_name: dict = {}
+            n_docs = 0
+            for sid in scan_ids:
+                doc = stack.client.get_trace(sid)
+                if not doc:
+                    continue
+                n_docs += 1
+                for s in doc.get("spans", []):
+                    if (
+                        s.get("name") in segs
+                        and s.get("duration_s") is not None
+                    ):
+                        by_name.setdefault(s["name"], []).append(
+                            float(s["duration_s"])
+                        )
+            out[cls] = {
+                "traces": n_docs,
+                "segment_median_ms": {
+                    name: round(statistics.median(vals) * 1000.0, 2)
+                    for name, vals in sorted(by_name.items())
+                },
+            }
+        return out
+    finally:
+        stack.close()
+        tracing.set_enabled(None)
+
+
+def bench_trace_overhead_ab(
+    templates=None, db=None, n_rows: int = 0, reps: int = 3
+) -> dict:
+    """Tracing-overhead A/B (docs/OBSERVABILITY.md §Tracing): the same
+    fresh content matched with tracing ENABLED — an active per-attempt
+    context bound, exactly as the worker binds one, and the shared
+    result tier attached so the per-operation cache spans fire (the
+    span-densest production path) — vs DISABLED, on an identical
+    sibling setup. Both arms match the SAME fresh content in adjacent
+    interleaved pairs (order alternating per pair) and their walls are
+    summed, so the retention ratio comes from two equally-drifted long
+    windows; verdict identity is asserted on every pair. The gate
+    is <2% fresh-content throughput regression (retention >= 0.98) —
+    the "near-zero cost" contract that lets tracing ship default-off
+    yet be flipped on in production without a capacity conversation."""
+    import time as _time
+
+    from swarm_tpu.cache import ResultCacheClient, SharedResultTier
+    from swarm_tpu.fingerprints.dbcache import load_or_compile
+    from swarm_tpu.ops.engine import MatchEngine
+    from swarm_tpu.stores import MemoryBlobStore, MemoryStateStore
+    from swarm_tpu.telemetry import tracing
+
+    if templates is None:
+        corpus = Path(
+            os.environ.get("SWARM_BENCH_CORPUS", str(BUNDLED_CORPUS))
+        )
+        templates, db = load_or_compile(corpus)
+    n_rows = n_rows or 512
+    #: pairs per rep: a single 512-row match is ~0.2 s on a 2-core CPU
+    #: box, where scheduler jitter alone swings one pair ratio by ±10%
+    #: — far too noisy for a 2% gate; summing walls over reps×passes
+    #: adjacent interleaved pairs gives each arm one long effective
+    #: window that actually resolves the regression being gated
+    passes = 3
+    rng = np.random.default_rng(4747)
+    base = realistic_rows(n_rows, seed=74)
+    for r in base:
+        # one width class (see bench_dedup_fleet's salt rationale)
+        r.body = (b"<!-- 0123456789012345678901234567890123456789 -->"
+                  + r.body)[:448]
+
+    def resalt(rows):
+        # fresh digests at EXACTLY the original lengths: same width
+        # classes and batch shapes, nothing left to compile inside the
+        # timed window
+        out = _clone_rows(rows)
+        for r in out:
+            s = bytes(rng.integers(97, 123, size=40, dtype=np.uint8))
+            r.body = r.body[:5] + s + r.body[45:]
+        return out
+
+    def mk_arm():
+        # each arm keeps its OWN engine + tier for the whole run: fresh
+        # content always misses, so every pass does identical work and
+        # pays the per-operation cache spans (the span-densest path)
+        eng = MatchEngine(
+            templates, mesh=None, batch_rows=max(64, n_rows // 4),
+            max_body=MAX_BODY, max_header=MAX_HEADER, db=db,
+        )
+        tier = SharedResultTier(MemoryStateStore(), MemoryBlobStore())
+        eng.attach_result_cache(
+            ResultCacheClient(tier, worker_id="bench-trace-ab")
+        )
+        eng.match(resalt(base))  # untimed same-shape warm
+        return eng
+
+    engines = {False: mk_arm(), True: mk_arm()}
+    walls = {False: [], True: []}
+    identical = True
+    traced_spans = 0
+    n_pairs = max(reps, 1) * passes
+    n_pairs += n_pairs % 2  # even: each arm runs second equally often
+    for k in range(n_pairs):
+        content = resalt(base)  # fresh per pair, shared by both arms
+        # adjacent-in-time pairs with the arm order alternating per
+        # pair: box-level drift (CPU frequency, page cache warming)
+        # hits both arms symmetrically instead of favoring whichever
+        # arm habitually runs second
+        order = (False, True) if k % 2 == 0 else (True, False)
+        outs: dict = {}
+        for traced in order:
+            tracing.set_enabled(traced)
+            try:
+                # same code path both arms: disabled ⇒ ctx is None and
+                # activate/span are the documented no-ops being measured
+                ctx = tracing.attempt_context(
+                    "bench-trace-ab", job_id=f"ab{k}"
+                )
+                timed = _clone_rows(content)
+                t0 = _time.perf_counter()
+                with tracing.activate(ctx):
+                    with tracing.span("execute"):
+                        outs[traced] = engines[traced].match(timed)
+                walls[traced].append(_time.perf_counter() - t0)
+            finally:
+                tracing.set_enabled(None)
+            if ctx is not None:
+                traced_spans = max(traced_spans, ctx.span_count())
+        identical = identical and _verdicts_equal(outs[False], outs[True])
+
+    def trimmed(lst):
+        # drop each arm's single worst wall: one stray GC pause / cron
+        # tick otherwise swings the summed ratio past the 2% gate, and
+        # dropping the max from BOTH arms keeps the comparison fair
+        return sum(lst) - (max(lst) if len(lst) > 2 else 0.0)
+
+    wall_off, wall_on = trimmed(walls[False]), trimmed(walls[True])
+    n_eff = n_pairs - (1 if n_pairs > 2 else 0)
+    off = {"rows_per_sec": round(n_rows * n_eff / wall_off, 1)}
+    on = {"rows_per_sec": round(n_rows * n_eff / wall_on, 1)}
+    retention = wall_off / max(wall_on, 1e-9)
+    ok = bool(identical) and retention >= 0.98
+    rec = {
+        "ok": ok,
+        "retention": round(retention, 4),
+        "verdicts_identical": bool(identical),
+        "tracing_off_rows_per_sec": off["rows_per_sec"],
+        "tracing_on_rows_per_sec": on["rows_per_sec"],
+        "traced_spans": traced_spans,
+        "n_rows": n_rows,
+        "passes": passes,
+        "reps": reps,
+    }
+    log(
+        f"trace overhead A/B: off {off['rows_per_sec']:.0f} -> on "
+        f"{on['rows_per_sec']:.0f} rows/s (retention {retention:.4f}, "
+        f"gate >= 0.98); {traced_spans} spans/attempt; verdicts "
+        f"{'identical' if identical else 'MISMATCH'}"
+    )
+    return rec
+
+
 def _setup_phase(need_corpus: bool):
     """Per-phase process setup: backend + (optionally) corpus. Returns
     (templates, db, dev) — templates/db None when not needed."""
@@ -2158,6 +2370,10 @@ def run_phase(phase: str) -> int:
         # lanes, not corpus scale (the exact phase owns that).
         os.environ.setdefault("SWARM_BENCH_CORPUS", str(BUNDLED_CORPUS))
         rec = bench_qos_latency()
+        # span-derived per-class decomposition rides the headline JSON
+        # (a separate short traced run — the timed A/B arms above stay
+        # tracing-free; docs/OBSERVABILITY.md §Tracing)
+        rec["span_breakdown"] = _trace_latency_breakdown()
         emit(
             "qos_interactive_p99_speedup",
             rec["p99_speedup"],
@@ -2172,6 +2388,21 @@ def run_phase(phase: str) -> int:
         )
         if not rec.get("ok"):
             log(f"!!! qos latency phase FAILED: {rec}")
+            return 1
+        # tracing-overhead gate (docs/OBSERVABILITY.md §Tracing):
+        # tracing-on vs tracing-off fresh-content throughput, paired
+        # interleaved, rc-gated at <2% regression + verdict identity
+        tab = bench_trace_overhead_ab()
+        emit(
+            "trace_overhead_retention",
+            tab["retention"],
+            " (tracing-on / tracing-off fresh-content rows/s; paired "
+            "interleaved A/B, gate >= 0.98)",
+            tab["retention"],
+            extra={"trace_overhead": tab},
+        )
+        if not tab.get("ok"):
+            log(f"!!! trace overhead gate FAILED: {tab}")
             return 1
     elif phase == "shard_smoke":
         # run_smoke's child: engine-level sharded-vs-single verdict
@@ -2619,6 +2850,81 @@ def _smoke_qos_clause() -> "tuple[bool, dict]":
         stack.close()
 
 
+def _smoke_trace_clause() -> "tuple[bool, dict]":
+    """Trace-waterfall smoke (docs/OBSERVABILITY.md §Tracing): one scan
+    through a REAL server + worker with tracing enabled. The rc gates:
+    the assembled waterfall exists, has ZERO orphan spans (every span's
+    parent resolves — a lossy assembly would break attribution
+    silently), and its root-level segments sum to within 10% of the
+    scan's gateway-latency observation. Under an armed chaos plan the
+    sum gate is relaxed (injected faults force retries whose re-queue
+    gaps legitimately stretch the window) but the orphan gate holds —
+    a retried attempt must still link into ONE waterfall."""
+    from swarm_tpu.resilience.faults import active_plan
+    from swarm_tpu.telemetry import tracing
+
+    tracing.set_enabled(True)
+    stack = _QosStack(
+        "trace",
+        # ride the smoke's pipeline mode so the sched span path is
+        # exercised when preflight's pipeline=on invocation runs
+        pipeline=os.environ.get("SWARM_PIPELINE", "off"),
+        busy_s=0.01,
+    )
+    try:
+        lines = [
+            json.dumps(
+                {"host": f"10.6.0.{i}", "port": 443, "status": 200,
+                 "body": f"<title>Demo Admin</title> demo-build 6.{i}"}
+            ) + "\n"
+            for i in range(4)
+        ]
+        code = stack.submit("trsmoke_1", lines, 2)
+        done, _ = stack.wait_complete(["trsmoke_1"], deadline_s=240)
+        doc = stack.client.get_trace("trsmoke_1")
+        chaos = active_plan() is not None
+        if doc is None:
+            rec = {"code": code, "all_complete": bool(done), "doc": None}
+            log(f"!!! trace smoke FAILED (no waterfall): {rec}")
+            return False, rec
+        orphans = tracing.waterfall_orphans(doc)
+        gl = float(doc.get("gateway_latency_s") or 0.0)
+        seg = float(doc.get("segments_sum_s") or 0.0)
+        within = gl > 0 and abs(seg - gl) / gl <= 0.10
+        cp = tracing.critical_path(doc)
+        rec = {
+            "code": code,
+            "all_complete": bool(done),
+            "status": doc.get("status"),
+            "span_count": len(doc.get("spans", [])),
+            "span_names": sorted({s["name"] for s in doc.get("spans", [])}),
+            "orphans": len(orphans),
+            "gateway_latency_s": round(gl, 4),
+            "segments_sum_s": round(seg, 4),
+            "within_10pct": within,
+            "critical_path": [
+                (n, round(s, 4), round(f, 3)) for n, s, f in cp[:4]
+            ],
+            "chaos_plan": chaos,
+        }
+        ok = (
+            code == 200 and bool(done) and not orphans
+            and (within or chaos)
+        )
+        log(
+            f"trace smoke: {rec['span_count']} spans, "
+            f"{len(orphans)} orphan(s), segments {seg:.3f}s vs gateway "
+            f"{gl:.3f}s (within 10%: {within})"
+            + (" (chaos: sum gate relaxed)" if chaos else "")
+        )
+        if not ok:
+            log(f"!!! trace smoke FAILED: {rec}")
+        return ok, rec
+    finally:
+        stack.close()
+        tracing.set_enabled(None)  # back to env/config-driven default
+
+
 def _aot_child() -> int:
     """Child entry of the AOT cold-start A/B (docs/AOT.md): ONE fresh
     process measuring engine bring-up — corpus load (dbcache-warm, so
@@ -2907,6 +3213,21 @@ def run_smoke() -> int:
         "identity + short-circuit rc-gated)",
         1.0 if qos_ok else 0.0,
         extra={"qos": qos_rec},
+    )
+    # trace smoke (docs/OBSERVABILITY.md §Tracing): one traced scan
+    # through a real server + worker — rc-gated on an assembled
+    # waterfall with zero orphan spans whose segments sum within 10%
+    # of the gateway latency observation (sum gate relaxed under the
+    # chaos plan; the orphan gate always holds)
+    tr_ok, tr_rec = _smoke_trace_clause()
+    ok = ok and tr_ok
+    emit(
+        "smoke_trace_waterfall",
+        1.0 if tr_ok else 0.0,
+        " (assembled waterfall: zero orphans + segments within 10% "
+        "of gateway latency)",
+        1.0 if tr_ok else 0.0,
+        extra={"trace": tr_rec},
     )
     # restart smoke (docs/DURABILITY.md): one mid-scan server restart
     # against the durable journal — rc-gated on verdict identity vs the

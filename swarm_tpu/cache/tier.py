@@ -574,8 +574,13 @@ class ResultCacheClient:
         if not br.allow():
             return None
         try:
+            from swarm_tpu.telemetry import tracing
+
             fault_point(point, detail=detail)
-            out = fn()
+            # child span under the worker's ambient attempt context
+            # (no-op object when tracing is off / no context bound)
+            with tracing.span(point, detail=detail):
+                out = fn()
         except Exception as e:
             br.record_failure()
             with self._lock:

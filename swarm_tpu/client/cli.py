@@ -248,6 +248,24 @@ class JobClient:
         )
         return resp.status_code, resp.text
 
+    def get_trace(self, scan_id: str) -> Optional[dict]:
+        """Assembled per-scan span waterfall (``swarm trace`` —
+        docs/OBSERVABILITY.md §Tracing); None = unknown scan, retention
+        expired, or tracing was off when the scan ran."""
+        resp = self.session.get(
+            f"{self.base}/trace/{scan_id}", timeout=self.timeout
+        )
+        return resp.json() if resp.status_code == 200 else None
+
+    def post_spans(self, scan_id: str, spans: list) -> bool:
+        """Attach an out-of-band span batch to an open scan."""
+        resp = self.session.post(
+            f"{self.base}/spans",
+            json={"scan_id": scan_id, "spans": spans},
+            timeout=self.timeout,
+        )
+        return resp.status_code == 200
+
 
 # ---------------------------------------------------------------------------
 # Views
@@ -371,6 +389,66 @@ def render_tenants(tenants: dict) -> str:
     return str(table)
 
 
+def render_trace(doc: dict) -> str:
+    """One scan's latency waterfall as a parent-linked tree with
+    per-segment percentages, plus the critical-path summary
+    ("queue-wait 61%, device 22%, upload 9%") —
+    docs/OBSERVABILITY.md §Tracing."""
+    from swarm_tpu.telemetry import tracing
+
+    root = doc["root"]
+    total = root.get("duration_s") or 0.0
+    spans = doc.get("spans") or []
+    children: dict = {}
+    for s in sorted(spans, key=lambda sp: sp.get("start") or 0.0):
+        children.setdefault(s.get("parent_id"), []).append(s)
+
+    lines = [
+        f"scan {doc.get('scan_id')}  trace {doc.get('trace_id')}  "
+        f"status {doc.get('status')}  chunks {doc.get('chunks')}"
+        + (f"  qos {doc['qos']}" if doc.get("qos") else ""),
+        f"gateway latency {total * 1000:.1f} ms; "
+        f"segments sum {doc.get('segments_sum_s', 0.0) * 1000:.1f} ms"
+        + (
+            f" ({doc.get('segments_sum_s', 0.0) / total * 100:.1f}%)"
+            if total > 0 else ""
+        ),
+    ]
+    shown_attrs = (
+        "attempt", "qos", "worker_id", "module", "rows", "error", "tenant"
+    )
+
+    def walk(span_id, prefix: str) -> None:
+        kids = children.get(span_id, [])
+        for i, s in enumerate(kids):
+            last = i == len(kids) - 1
+            dur = s.get("duration_s") or 0.0
+            pct = (dur / total * 100.0) if total > 0 else 0.0
+            attrs = s.get("attrs") or {}
+            extra = " ".join(
+                f"{k}={attrs[k]}" for k in shown_attrs if k in attrs
+            )
+            lines.append(
+                f"{prefix}{'└─ ' if last else '├─ '}"
+                f"{s.get('name', '?'):<18} {dur * 1000:9.1f} ms {pct:5.1f}%"
+                + (f"  {extra}" if extra else "")
+            )
+            walk(s.get("span_id"), prefix + ("   " if last else "│  "))
+
+    walk(root.get("span_id"), "")
+    orphans = tracing.waterfall_orphans(doc)
+    if orphans:
+        names = ", ".join(sorted({s.get("name", "?") for s in orphans}))
+        lines.append(f"! {len(orphans)} orphan span(s) (lost parents): {names}")
+    cp = tracing.critical_path(doc)
+    if cp:
+        lines.append(
+            "critical path: "
+            + ", ".join(f"{name} {frac * 100.0:.0f}%" for name, _s, frac in cp)
+        )
+    return "\n".join(lines)
+
+
 def render_scans(statuses: dict) -> str:
     table = Table(
         ["Scan ID", "Chunks", "Complete", "%", "Workers", "Module", "Started",
@@ -396,7 +474,7 @@ def render_scans(statuses: dict) -> str:
 
 ACTIONS = [
     "scan", "workers", "scans", "jobs", "metrics", "dead-letter", "tenants",
-    "spinup", "terminate", "cat", "stream", "recycle", "reset",
+    "spinup", "terminate", "cat", "stream", "trace", "recycle", "reset",
 ]
 
 
@@ -413,7 +491,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--batch-size", default="auto")
     parser.add_argument("--prefix", help="node name prefix (spinup/terminate)")
     parser.add_argument("--nodes", type=int, help="node count (spinup)")
-    parser.add_argument("--scan-id", help="scan id (cat/stream)")
+    parser.add_argument("--scan-id", help="scan id (cat/stream/trace)")
     parser.add_argument("--tenant", default=None,
                         help="tenant id sent as X-Swarm-Tenant (gateway)")
     parser.add_argument("--qos", default=None,
@@ -619,6 +697,20 @@ def _run_action(args, cfg: Config, client: JobClient) -> int:
             print("scan-id is required for cat")
             return 1
         print(client.fetch_raw(args.scan_id))
+        return 0
+
+    if args.action == "trace":
+        if not args.scan_id:
+            print("scan-id is required for trace")
+            return 1
+        doc = client.get_trace(args.scan_id)
+        if doc is None:
+            print(
+                f"No trace for scan {args.scan_id} (tracing disabled, "
+                "retention expired, or unknown scan)"
+            )
+            return 1
+        print(render_trace(doc))
         return 0
 
     if args.action == "reset":

@@ -227,6 +227,11 @@ class Config:
     gateway_autoscale_min_nodes: int = 0
     gateway_autoscale_max_nodes: int = 8
     gateway_autoscale_apply: bool = False
+    # end-to-end span tracing (docs/OBSERVABILITY.md §Tracing): off by
+    # default — disabled tracing keeps wire payloads byte-identical to
+    # the untraced build. Env: SWARM_TRACE_ENABLED (SWARM_TRACE also
+    # arms the tracing module directly, process-wide).
+    trace_enabled: bool = False
 
     # --- fleet orchestration ---
     fleet_provider: str = "null"  # "null" | "digitalocean" | "process"

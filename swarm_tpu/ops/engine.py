@@ -95,6 +95,14 @@ class EngineStats:
     # LOADED a published executable instead of compiling it
     device_fetch_seconds: float = 0.0
     device_fetches: int = 0
+    # split-phase device attribution (ops/match.py dispatch): phase A is
+    # the wall up to the survivor-scalar sync, the remainder of the
+    # device wall is phase B + transfer. Populated on the single-device
+    # compacted path only (0.0 elsewhere); both are included in
+    # device_seconds. The worker folds these into device.phase_a /
+    # device.phase_b child spans (docs/OBSERVABILITY.md §Tracing).
+    phase_a_seconds: float = 0.0
+    phase_b_seconds: float = 0.0
     host_confirm_seconds: float = 0.0
     host_confirm_pairs: int = 0
     host_always_pairs: int = 0
@@ -1545,6 +1553,19 @@ class MatchEngine:
         rows = next(iter(streams.values())).shape[0] if streams else 0
         return f"r{rows}." + ".".join(parts)
 
+    def _note_phase_split(self, matcher, dt: float) -> None:
+        """Attribute one device interval to phase A/B from the
+        matcher's per-dispatch ``last_compact["phase_a_s"]`` marker
+        (popped — exactly one consumer per dispatch, so a later
+        non-compacted or failed dispatch can't replay a stale split)."""
+        # requires-lock: _stats_lock
+        lc = getattr(matcher, "last_compact", None)
+        pa = lc.pop("phase_a_s", None) if isinstance(lc, dict) else None
+        if isinstance(pa, (int, float)) and pa > 0:
+            pa = min(float(pa), dt)
+            self.stats.phase_a_seconds += pa
+            self.stats.phase_b_seconds += max(0.0, dt - pa)
+
     def _note_device_fault(self, breaker, exc: BaseException) -> None:
         # under the scheduler's walk offload this runs on the submit
         # thread (begin_packed) AND the walk worker (_walk_plane) —
@@ -2133,7 +2154,9 @@ class MatchEngine:
         pm_unc = _rows_view(pm_unc)
         overflow = _rows_view(overflow)
         with self._stats_lock:
-            self.stats.device_seconds += time.perf_counter() - t0
+            dt_dev = time.perf_counter() - t0
+            self.stats.device_seconds += dt_dev
+            self._note_phase_split(matcher, dt_dev)
         # compile-time attribution rides the matcher's counters (the
         # sharded matcher carries the same spy fields per mesh shape)
         self.stats.device_compile_seconds = getattr(
@@ -2471,7 +2494,9 @@ class MatchEngine:
                     # re-tries the sync path only if the breaker allows)
                     self._note_device_fault(breaker, e)
                 with self._stats_lock:
-                    self.stats.device_seconds += time.perf_counter() - t0
+                    dt_dev = time.perf_counter() - t0
+                    self.stats.device_seconds += dt_dev
+                    self._note_phase_split(matcher, dt_dev)
         return ("native", all_rows, pre, pending)
 
     def finish_packed(self, handle) -> PackedMatches:

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 import warnings
 from typing import Optional
 
@@ -760,7 +761,12 @@ class DeviceDB:
         width; phase B itself is launched asynchronously at that
         width."""
         from swarm_tpu.resilience.faults import fault_point
+        from swarm_tpu.telemetry import tracing
 
+        # always-on flight-ring record BEFORE the fault point: when a
+        # seeded device.dispatch fault fires, the resulting flight dump
+        # carries the dispatch that tripped it (docs/OBSERVABILITY.md)
+        tracing.flight_event("device.dispatch")
         # device-path chaos lever (docs/RESILIENCE.md): stands in for
         # XLA compile errors / OOM / cache corruption; MatchEngine
         # catches the failure and degrades to the exact CPU oracle
@@ -793,17 +799,23 @@ class DeviceDB:
 
         # requires-lock: _counter_lock (invoked via _spied_launch)
         def launch():
+            t_a = time.perf_counter()
             cnt, overflow, nmax = fa(arrays, s_j, l_j)
             # the ONE host sync between phases: a scalar read that
             # sizes phase B to live work instead of worst-case budget
             # host-sync-ok: the blessed 4-byte phase-A survivor scalar
             n_live = int(nmax)
+            # the scalar read blocks on phase A, so the wall up to here
+            # IS phase A — MatchEngine pops it into EngineStats
+            # phase_a/phase_b attribution (one consumer per dispatch)
+            phase_a_s = time.perf_counter() - t_a
             kc = fpc.survivor_bucket(n_live, budget)
             out = fb(kc, arrays, s_j, l_j, st_j, cnt, overflow)
             self.last_compact = {
                 "survivor_max": n_live,
                 "verify_k": kc,
                 "budget": budget,
+                "phase_a_s": phase_a_s,
             }
             return out
 

@@ -67,6 +67,7 @@ from swarm_tpu.sched.buckets import (
     PlannedBatch,
 )
 from swarm_tpu.telemetry import REGISTRY
+from swarm_tpu.telemetry import tracing
 from swarm_tpu.telemetry.sched_export import (
     SCHED_BATCH_AGE,
     SCHED_FLUSH_DEADLINE,
@@ -482,6 +483,11 @@ class BatchScheduler:
                 # Checked once per chunk — the feed's natural tick.
                 for pb in planner.flush_due(time.monotonic()):
                     SCHED_FLUSH_DEADLINE.labels(qos=pb.qos).inc()
+                    # always-on flight-ring record: a deadline preempt
+                    # is exactly the context a post-mortem wants
+                    tracing.flight_event(
+                        "sched.deadline_flush", qos=pb.qos, bucket=pb.bucket
+                    )
                     yield pb, None
             for pb in planner.flush_all():
                 yield pb, None

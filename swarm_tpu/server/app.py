@@ -44,7 +44,8 @@ from swarm_tpu.server.fleet import AutoscaleAdvisor, build_provider
 from swarm_tpu.server.queue import JobQueueService
 from swarm_tpu.stores import build_stores
 from swarm_tpu.telemetry import REGISTRY
-from swarm_tpu.telemetry.events import header_trace_id, new_trace_id
+from swarm_tpu.telemetry import tracing
+from swarm_tpu.telemetry.events import emit_event, header_trace_id, new_trace_id
 from swarm_tpu.telemetry.gateway_export import (
     GATEWAY_LATENCY,
     GATEWAY_QUEUED,
@@ -85,6 +86,11 @@ class SwarmServer:
             install_plan(cfg.fault_plan)  # deterministic chaos (tests/soak)
         else:
             active_plan()  # registers the armed-state gauge for /metrics
+        # span tracing (docs/OBSERVABILITY.md §Tracing): config can arm
+        # it process-wide but never forces it OFF — an operator's
+        # SWARM_TRACE=1 env wins over an unset config field
+        if cfg.trace_enabled:
+            tracing.set_enabled(True)
         # see _advertise_url: captured before any bind mutates it. A URL
         # a PRIOR server instance derived (cfg.server_url_derived) still
         # counts as defaulted — a supervisor reusing one Config across
@@ -95,8 +101,21 @@ class SwarmServer:
         )
         if queue is None:
             state, blobs, docs = build_stores(cfg)
+            # flight-recorder persistence (docs/OBSERVABILITY.md
+            # §Tracing): the sink must attach BEFORE the queue is
+            # constructed — journal recovery fires its flight dump
+            # from inside JobQueueService.__init__, and a sink attached
+            # after would miss exactly the dump that motivates
+            # persisting the ring
+            self._flight_unsub = tracing.FLIGHT.add_sink(
+                tracing.blob_flight_sink(blobs)
+            )
             fleet = fleet if fleet is not None else build_provider(cfg)
             queue = JobQueueService(cfg, state, blobs, docs, fleet=fleet)
+        else:
+            self._flight_unsub = tracing.FLIGHT.add_sink(
+                tracing.blob_flight_sink(queue.blobs)
+            )
         self.queue = queue
         self.fleet = fleet if fleet is not None else queue.fleet
         # multi-tenant front door (docs/GATEWAY.md): admission control
@@ -172,6 +191,8 @@ class SwarmServer:
         r("GET", r"^/raw/(?P<scan_id>[^/]+)$", self._raw, "/raw")
         r("POST", r"^/queue$", self._queue_job, "/queue")
         r("GET", r"^/get-job$", self._get_job, "/get-job")
+        r("POST", r"^/spans$", self._post_spans, "/spans")
+        r("GET", r"^/trace/(?P<scan_id>[^/]+)$", self._get_trace, "/trace")
         r("GET", r"^/stream/(?P<scan_id>[^/]+)$", self._stream, "/stream")
         r("GET", r"^/tenants$", self._tenants, "/tenants")
         r("GET", r"^/autoscale$", self._autoscale_recommend, "/autoscale")
@@ -328,7 +349,23 @@ class SwarmServer:
             output = self.queue.blobs.get(
                 chunk_output_key(scan_id, chunk_index)
             )
-            self.qos_cache.writeback(rec["module"], lines, output)
+            stored = self.qos_cache.writeback(rec["module"], lines, output)
+            # trace_id rides the writeback event (satellite: the cache
+            # entries a short-circuit later answers from are traceable
+            # back to the scan that fed them)
+            emit_event(
+                "cache.writeback",
+                trace_id=rec.get("trace_id"),
+                job_id=job_id,
+                scan_id=scan_id,
+                chunk_index=chunk_index,
+                module=rec["module"],
+                stored=bool(stored),
+            )
+            tracing.flight_event(
+                "cache.writeback", trace_id=rec.get("trace_id"),
+                job_id=job_id, stored=bool(stored),
+            )
         except Exception as e:
             print(f"gateway cache writeback skipped for {job_id}: {e}")
 
@@ -356,6 +393,38 @@ class SwarmServer:
                     saturation = stall / wall
         if saturation is not None:
             self.gateway.note_saturation(worker_id, saturation)
+
+    def _post_spans(self, m, q, body, h):
+        """Mid-scan span shipping (docs/OBSERVABILITY.md §Tracing): a
+        worker whose attempt outgrows the perf-field batch bound posts
+        ``{"scan_id": ..., "spans": [...]}`` here instead. Spans for a
+        scan the assembler isn't holding are counted as dropped and
+        still 200 — tracing is telemetry, not control flow."""
+        try:
+            data = json.loads(body or b"{}")
+        except ValueError:
+            return self._json(400, {"message": "Invalid JSON"})
+        scan_id = data.get("scan_id")
+        spans = data.get("spans")
+        if not scan_id or not SCAN_ID_RE.match(str(scan_id)) or not isinstance(
+            spans, list
+        ):
+            return self._json(
+                400, {"message": "scan_id and spans list required"}
+            )
+        added = self.queue.tracer.add_spans(str(scan_id), spans)
+        return self._json(200, {"added": added})
+
+    def _get_trace(self, m, q, body, h):
+        """One scan's assembled latency waterfall (memory, blob store,
+        or a live partial view of a still-running scan)."""
+        scan_id = m["scan_id"]
+        if not SCAN_ID_RE.match(scan_id):
+            return self._json(400, {"message": "Invalid scan_id"})
+        doc = self.queue.tracer.get(scan_id)
+        if doc is None:
+            return self._json(404, {"message": "No trace for scan"})
+        return self._json(200, doc)
 
     def _get_chunk(self, m, q, body, h):
         content = self.queue.output_chunk(m["scan_id"], int(m["chunk_id"]))
@@ -442,6 +511,7 @@ class SwarmServer:
 
     def _queue_job(self, m, q, body, h):
         t0 = time.perf_counter()
+        t_wall = time.time()
         try:
             job_data = json.loads(body or b"{}")
         except ValueError:
@@ -496,33 +566,76 @@ class SwarmServer:
             )
             if any(len(c) > max_rows for c in chunks):
                 chunks = []
+            lk0 = time.perf_counter()
+            lk_wall = time.time()
             outputs = (
                 self.qos_cache.lookup_chunks(module, chunks)
                 if chunks else None
             )
+            lk1 = time.perf_counter()
             if outputs is not None:
+                comp_wall = time.time()
                 try:
-                    self.queue.complete_scan_from_cache(
+                    result = self.queue.complete_scan_from_cache(
                         job_data, outputs, trace_id=trace_id,
                         tenant=tenant, qos=qos,
                     )
                 except ValueError as e:
                     return self._text(400, str(e))
                 GATEWAY_SHORT_CIRCUIT.labels(outcome="hit").inc()
+                elapsed = time.perf_counter() - t0
                 GATEWAY_LATENCY.labels(qos=QOS_INTERACTIVE).observe(
-                    time.perf_counter() - t0
+                    elapsed, trace_id=trace_id
                 )
+                # zero-dispatch waterfall (satellite: short-circuit
+                # scans are fully traceable): admission → cache.lookup
+                # → completion tile the exact window the latency
+                # histogram just observed, so the segments-sum gate
+                # holds for this path too
+                if tracing.enabled():
+                    self.queue.tracer.assemble_short_circuit(
+                        result["scan_id"], trace_id, t_wall, elapsed,
+                        result["chunks"],
+                        [
+                            tracing.make_span(
+                                "admission", trace_id, t_wall, lk0 - t0,
+                                tenant=tenant,
+                            ),
+                            tracing.make_span(
+                                "cache.lookup", trace_id, lk_wall,
+                                lk1 - lk0, chunks=result["chunks"],
+                            ),
+                            tracing.make_span(
+                                "completion", trace_id, comp_wall,
+                                max(0.0, elapsed - (lk1 - t0)),
+                            ),
+                        ],
+                        qos=QOS_INTERACTIVE, tenant=tenant,
+                    )
+                    self.queue.tracer.flush()
                 return self._text(200, "Job queued successfully")
             GATEWAY_SHORT_CIRCUIT.labels(outcome="miss").inc()
         # trace_id minted above (honoring the client's X-Swarm-Trace)
         # so the short-circuit path and the queued path correlate the
         # same way
+        adm_s = time.perf_counter() - t0
         try:
-            self.queue.queue_scan(
+            result = self.queue.queue_scan(
                 job_data, trace_id=trace_id, tenant=tenant, qos=qos
             )
         except ValueError as e:
             return self._text(400, str(e))
+        if tracing.enabled():
+            # pre-admission handler time, recorded OUTSIDE the
+            # gateway-latency window (start < admitted_at by
+            # construction — the waterfall's segment sum deliberately
+            # excludes it; docs/OBSERVABILITY.md §Tracing)
+            self.queue.tracer.add_spans(result["scan_id"], [
+                tracing.make_span(
+                    "admission", trace_id, t_wall, adm_s, tenant=tenant,
+                    qos=qos,
+                ),
+            ])
         return self._text(200, "Job queued successfully")
 
     def _stream(self, m, q, body, h):
@@ -744,6 +857,7 @@ class SwarmServer:
 
     def shutdown(self) -> None:
         REGISTRY.remove_collector(self._collector)
+        self._flight_unsub()
         # zero the by-state children this server populated: the gauge is
         # process-global, and a later server instance (supervisor
         # restart, sequential test fixtures) must not keep reporting the

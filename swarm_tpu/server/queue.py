@@ -41,6 +41,7 @@ from swarm_tpu.gateway.qos import QOS_INTERACTIVE, qos_class
 from swarm_tpu.server.journal import QueueJournal
 from swarm_tpu.stores import BlobStore, DocStore, StateStore
 from swarm_tpu.telemetry import REGISTRY, emit_event
+from swarm_tpu.telemetry import tracing
 from swarm_tpu.telemetry.gateway_export import GATEWAY_LATENCY
 from swarm_tpu.telemetry.journal_export import (
     JOURNAL_CORRUPT,
@@ -154,6 +155,11 @@ class JobQueueService:
         #: journal-enabled boot (0 = journal disabled). Rides the
         #: X-Swarm-Generation header so workers detect restarts.
         self.generation = 0
+        #: per-scan trace-waterfall assembler (docs/OBSERVABILITY.md
+        #: §Tracing). Constructed BEFORE recovery so recovered scans
+        #: can re-register and keep their original trace ids; every
+        #: method no-ops when tracing is disabled.
+        self.tracer = tracing.TraceAssembler(blobs)
         #: summary of the boot-time recovery (None when nothing was
         #: recovered) — surfaced on /healthz for operators
         self.recovery_summary: Optional[dict] = None
@@ -420,6 +426,10 @@ class JobQueueService:
                 tenant=tenant,
                 qos=qos,
             )
+        self.tracer.register_scan(
+            scan_id, trace_id, admitted_at, queued, qos=qos, tenant=tenant,
+            generation=self.generation or None,
+        )
         self._maybe_checkpoint()
         return {"scan_id": scan_id, "chunks": queued}
 
@@ -593,6 +603,10 @@ class JobQueueService:
                     "leases", job.job_id, str(job.lease_expires_at)
                 )
 
+        # lease-expiry quarantines above can finish (degrade) a scan's
+        # waterfall; persist it now that the dispatch lock is released
+        self.tracer.flush()
+
         if job is not None:
             worker.polls_with_no_jobs = 0
             worker.status = WorkerStatus.ACTIVE
@@ -600,6 +614,10 @@ class JobQueueService:
             _JOBS_DISPATCHED.inc()
             if express:
                 _EXPRESS_SERVED.inc()
+            # server-stamped enqueue→lease wait for this attempt: both
+            # endpoints are this process's own clock, so the waterfall's
+            # dominant segment needs no cross-host clock agreement
+            self.tracer.record_queue_wait(job, now)
             emit_event(
                 "job.dispatch",
                 trace_id=job.trace_id,
@@ -740,6 +758,18 @@ class JobQueueService:
         self.state.hdel("leases", job.job_id)
         _JOBS_TERMINAL.labels(status=JobStatus.DEAD_LETTER).inc()
         _JOBS_DEAD_LETTER.inc()
+        # a quarantined chunk still closes its scan's waterfall (as
+        # degraded), and the flight ring is dumped for the post-mortem.
+        # Both are memory-only under this lock: the assembler stages,
+        # flush() persists later; the dump's sinks run on a daemon
+        # thread (tracing.FlightRecorder contract)
+        self.tracer.job_terminal(
+            job.scan_id, job.job_id, JobStatus.DEAD_LETTER,
+            time.time(),
+        )
+        tracing.flight_dump(
+            "dead_letter", detail=f"{job.job_id} after {job.attempts} attempts"
+        )
         emit_event(
             "job.dead_letter",
             trace_id=job.trace_id,
@@ -852,6 +882,9 @@ class JobQueueService:
         # whose lease expired must never complete a re-leased job)
         with self._lock:
             out = self._update_job_locked(job_id, changes)
+        # persist any waterfall the transition just finished — blob IO,
+        # so outside the lock (same placement rule as _maybe_checkpoint)
+        self.tracer.flush()
         self._maybe_checkpoint()
         return out
 
@@ -885,6 +918,18 @@ class JobQueueService:
         # requeuing it would put an actively-executing job back in the
         # queue and double-execute it. Unfenced failures keep the
         # reference's terminal wire behavior below.
+        # worker-shipped span batch rides perf but must NOT persist into
+        # the job record (spans are assembly input, and a record that
+        # grows with span volume would bloat every journal checkpoint).
+        # Extracted BEFORE the retry branch: a failed attempt's spans
+        # still belong to the scan's waterfall — a retried job must
+        # assemble into ONE trace carrying every attempt.
+        spans = None
+        perf_in = changes.get("perf")
+        if isinstance(perf_in, dict) and "spans" in perf_in:
+            perf_in = dict(perf_in)
+            spans = perf_in.pop("spans")
+            changes["perf"] = perf_in
         new_status = changes.get("status")
         if (
             self.cfg.retry_failed
@@ -892,6 +937,8 @@ class JobQueueService:
             and new_status in JobStatus.FAILED
             and new_status != JobStatus.DEAD_LETTER
         ):
+            if spans:
+                self.tracer.add_spans(job.scan_id, spans)
             self._record_failure(job, new_status)
             if job.attempts >= self.cfg.max_attempts:
                 self._quarantine(job, reason="attempts_exhausted")
@@ -958,7 +1005,12 @@ class JobQueueService:
                 for phase in ("download", "execute", "upload"):
                     v = perf.get(f"{phase}_s")
                     if isinstance(v, (int, float)) and math.isfinite(v):
-                        _JOB_PHASE_SECONDS.labels(phase=phase).observe(v)
+                        # exemplar-carrying observe: the worst recent
+                        # observation's trace_id rides the +Inf bucket
+                        # line when SWARM_METRICS_EXEMPLARS is set
+                        _JOB_PHASE_SECONDS.labels(phase=phase).observe(
+                            v, trace_id=updated.trace_id
+                        )
                 rows = perf.get("rows")
                 if (
                     isinstance(rows, (int, float))
@@ -978,7 +1030,14 @@ class JobQueueService:
                     if math.isfinite(dt) and dt >= 0:
                         GATEWAY_LATENCY.labels(
                             qos=qos_class(updated.qos)
-                        ).observe(dt)
+                        ).observe(dt, trace_id=updated.trace_id)
+            # waterfall assembly: attach this chunk's span batch and
+            # close the scan when its last chunk lands. Memory-only
+            # here (caller holds _lock); update_job flushes after.
+            self.tracer.job_terminal(
+                updated.scan_id, job_id, updated.status,
+                updated.completed_at, spans=spans,
+            )
             emit_event(
                 "job.terminal",
                 trace_id=updated.trace_id,
@@ -1349,5 +1408,45 @@ class JobQueueService:
             "replayed_records": replayed,
             **counts,
         }
+        # re-register unfinished scans with the waterfall assembler
+        # under their ORIGINAL trace ids — a kill-9'd scan's recovered
+        # attempts land in the same trace the client started, which is
+        # what links pre- and post-restart work in `swarm trace`
+        if tracing.enabled():
+            by_scan: dict[str, list[Job]] = {}
+            for job in jobs.values():
+                by_scan.setdefault(job.scan_id, []).append(job)
+            for scan_id, sjobs in by_scan.items():
+                done = sum(
+                    1 for j in sjobs if j.status in JobStatus.TERMINAL
+                )
+                if done >= len(sjobs):
+                    continue
+                trace_id = next((j.trace_id for j in sjobs if j.trace_id), None)
+                admitted = min(
+                    (j.admitted_at for j in sjobs
+                     if isinstance(j.admitted_at, (int, float))),
+                    default=None,
+                )
+                self.tracer.register_scan(
+                    scan_id, trace_id, admitted, len(sjobs),
+                    qos=next((j.qos for j in sjobs if j.qos), None),
+                    tenant=next((j.tenant for j in sjobs if j.tenant), None),
+                    generation=self.generation,
+                    done=done,
+                )
+                if trace_id:
+                    self.tracer.add_spans(scan_id, [tracing.make_span(
+                        "journal-recovery", trace_id, now, 0.0,
+                        generation=self.generation,
+                        recovered_jobs=len(sjobs),
+                    )])
+        # always-on flight dump: the ring captured the pre-replay boot
+        # context, and post-mortems of whatever killed the previous
+        # generation start here
+        tracing.flight_dump(
+            "journal_recovery",
+            detail=f"generation={self.generation} replayed={replayed}",
+        )
         emit_event("queue.recovered", **summary)
         return summary

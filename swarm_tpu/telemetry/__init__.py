@@ -43,3 +43,4 @@ from swarm_tpu.telemetry import gateway_export  # noqa: E402,F401
 from swarm_tpu.telemetry import sched_export  # noqa: E402,F401
 from swarm_tpu.telemetry import journal_export  # noqa: E402,F401
 from swarm_tpu.telemetry import aot_export  # noqa: E402,F401
+from swarm_tpu.telemetry import trace_export  # noqa: E402,F401

@@ -25,8 +25,10 @@ Usage::
 from __future__ import annotations
 
 import math
+import os
 import re
 import threading
+import time
 from typing import Callable, Iterable, Optional, Sequence
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -38,6 +40,27 @@ DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
     30.0, 60.0,
 )
+
+#: Exemplar rendering gate (docs/OBSERVABILITY.md §Tracing). Histograms
+#: can carry the trace ID of their worst recent observation, rendered
+#: in the OpenMetrics-style ``# {trace_id="..."} <value>`` suffix on
+#: the ``+Inf`` bucket line — but ONLY when this env var is truthy,
+#: because the strict 0.0.4 text format (and this module's own
+#: ``parse_exposition``) rejects exemplar suffixes. Default off keeps
+#: every existing scraper green; opt in for OpenMetrics-aware backends.
+EXEMPLARS_ENV = "SWARM_METRICS_EXEMPLARS"
+
+#: Exemplar replacement policy: a stored exemplar survives until a
+#: worse (larger) observation arrives or it ages past this horizon —
+#: "worst RECENT observation", so a single historic spike doesn't pin
+#: the exemplar forever.
+EXEMPLAR_MAX_AGE_S = 60.0
+
+
+def exemplars_enabled() -> bool:
+    return os.environ.get(EXEMPLARS_ENV, "").strip().lower() in (
+        "1", "true", "yes", "on"
+    )
 
 
 def escape_label_value(value: str) -> str:
@@ -100,6 +123,11 @@ class _Metric:
 
     def _render_child(self, label_values: tuple, child) -> Iterable[str]:
         raise NotImplementedError
+
+    def _observe_exemplar(self, child, label_values, value, trace_id) -> None:
+        # only histograms keep exemplars; for other kinds this defers
+        # to _observe, which raises the usual kind mismatch
+        self._observe(child, value)
 
     # -----------------------------------------------------------------
     def labels(self, *values, **kw) -> "_Handle":
@@ -182,8 +210,13 @@ class _Handle:
     def set(self, value: float) -> None:
         self._metric._set(self._child, value)
 
-    def observe(self, value: float) -> None:
-        self._metric._observe(self._child, value)
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
+        if trace_id is None:
+            self._metric._observe(self._child, value)
+        else:
+            self._metric._observe_exemplar(
+                self._child, self._label_values, value, trace_id
+            )
 
     @property
     def value(self):
@@ -279,6 +312,10 @@ class Histogram(_Metric):
             raise ValueError("duplicate histogram buckets")
         self.buckets = tuple(bounds)
         super().__init__(name, help_text, labelnames)
+        # label-values → (observed value, trace_id, wall ts): the worst
+        # recent observation per series, rendered as an exemplar suffix
+        # when SWARM_METRICS_EXEMPLARS is set
+        self._exemplars: dict[tuple, tuple] = {}  # guarded-by: _lock
 
     def _new_child(self):
         # [per-bucket counts..., count, sum]
@@ -293,6 +330,24 @@ class Histogram(_Metric):
                     break
             child[-2] += 1
             child[-1] += value
+
+    def _observe_exemplar(self, child, label_values, value, trace_id) -> None:
+        value = float(value)
+        now = time.time()
+        with self._lock:
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    child[i] += 1
+                    break
+            child[-2] += 1
+            child[-1] += value
+            cur = self._exemplars.get(label_values)
+            if (
+                cur is None
+                or value >= cur[0]
+                or now - cur[2] > EXEMPLAR_MAX_AGE_S
+            ):
+                self._exemplars[label_values] = (value, str(trace_id), now)
 
     def _inc(self, child, amount) -> None:
         raise TypeError(f"{self.name} is a histogram; use observe()")
@@ -319,7 +374,18 @@ class Histogram(_Metric):
             yield f"{self.name}_bucket{_labels_str(ln, lv)} {cumulative}"
         lv = label_values + ("+Inf",)
         ln = self.labelnames + ("le",)
-        yield f"{self.name}_bucket{_labels_str(ln, lv)} {child[-2]}"
+        inf_line = f"{self.name}_bucket{_labels_str(ln, lv)} {child[-2]}"
+        if exemplars_enabled():
+            # render() calls this OUTSIDE self._lock (child is a copy),
+            # so a brief re-acquire for the exemplar read is safe
+            with self._lock:
+                ex = self._exemplars.get(label_values)
+            if ex is not None:
+                inf_line += (
+                    f' # {{trace_id="{escape_label_value(ex[1])}"}}'
+                    f" {_fmt_value(ex[0])}"
+                )
+        yield inf_line
         base = _labels_str(self.labelnames, label_values)
         yield f"{self.name}_sum{base} {_fmt_value(child[-1])}"
         yield f"{self.name}_count{base} {child[-2]}"

@@ -36,6 +36,7 @@ from swarm_tpu.resilience.transport import (
     TransportError,
 )
 from swarm_tpu.telemetry import REGISTRY, emit_event
+from swarm_tpu.telemetry import tracing
 from swarm_tpu.utils.trace import PhaseTimer, maybe_device_profile
 from swarm_tpu.worker.modules import (
     ModuleRegistry,
@@ -80,6 +81,10 @@ _SERVER_RESTARTS = REGISTRY.counter(
     "swarm_worker_server_restarts_total",
     "Control-plane generation changes observed by this worker",
 )
+
+#: span batches up to this size ride the completed-job ``perf`` field;
+#: larger batches (long scans) ship out of band via ``POST /spans``
+_SPAN_INLINE_MAX = 256
 
 
 class ServerClient:
@@ -146,6 +151,17 @@ class ServerClient:
         resp = self._request(
             "put_chunk", "POST", f"/put-output-chunk/{scan_id}/{chunk_index}",
             detail=f"{scan_id}_{chunk_index}", data=data,
+        )
+        return resp.status_code == 200
+
+    def post_spans(self, scan_id: str, spans: list) -> bool:
+        """Ship a span batch out of band (docs/OBSERVABILITY.md
+        §Tracing) — used when an attempt's batch is too large to ride
+        the completed-job perf field, or the attempt failed and there
+        is no perf field to ride."""
+        resp = self._request(
+            "post_spans", "POST", "/spans", detail=scan_id,
+            json={"scan_id": scan_id, "spans": spans},
         )
         return resp.status_code == 200
 
@@ -326,8 +342,36 @@ class JobProcessor:
             _JOBS_PROCESSED.labels(outcome=JobStatus.CMD_FAILED).inc()
             return
         timer = PhaseTimer()
+        # per-attempt span collector (None when tracing is off or the
+        # job carries no trace id — the completed-job wire payload is
+        # then byte-identical to the untraced build)
+        ctx = tracing.attempt_context(
+            trace_id,
+            job_id=job_id,
+            attempt=job.get("attempts"),
+            worker_id=self.cfg.worker_id,
+            module=job.get("module"),
+        )
+
+        def _ship_spans(extra):
+            """Close the attempt root; inline the batch on the perf
+            field when small, else POST /spans (also the only path for
+            failed attempts, which carry no perf)."""
+            spans = ctx.finish()
+            if not spans:
+                return extra
+            perf = extra.get("perf")
+            if isinstance(perf, dict) and len(spans) <= _SPAN_INLINE_MAX:
+                return {**extra, "perf": {**perf, "spans": spans}}
+            try:
+                self.client.post_spans(scan_id, spans)
+            except Exception as e:
+                print(f"span batch undeliverable: {e}")
+            return extra
 
         def update(status, **extra):
+            if status in JobStatus.TERMINAL and ctx is not None:
+                extra = _ship_spans(extra)
             try:
                 ok = self.client.update_job(
                     job_id,
@@ -389,7 +433,10 @@ class JobProcessor:
         self._last_heartbeat = hb
         hb.start()
         try:
-            self._run_chunk(job, job_id, scan_id, chunk_index, timer, update)
+            with tracing.activate(ctx):
+                self._run_chunk(
+                    job, job_id, scan_id, chunk_index, timer, update
+                )
         finally:
             hb.stop()
 
@@ -400,7 +447,7 @@ class JobProcessor:
         """Download → execute → upload under an active heartbeat."""
         update(JobStatus.STARTING)
         update(JobStatus.DOWNLOADING)
-        with timer.phase("download"):
+        with timer.phase("download"), tracing.span("download"):
             data = self.client.get_input_chunk(scan_id, chunk_index)
         if data is None:
             update(JobStatus.CMD_FAILED)
@@ -414,8 +461,11 @@ class JobProcessor:
             update(JobStatus.CMD_FAILED)
             return
 
+        # kept as a named object: after a successful execute the engine
+        # stats deltas are folded into device/walk child spans under it
+        exec_span = tracing.span("execute", module=job.get("module"))
         try:
-            with timer.phase("execute"), maybe_device_profile(job_id):
+            with timer.phase("execute"), maybe_device_profile(job_id), exec_span:
                 # chaos lever: fail (or delay) this chunk's execution —
                 # detail carries the job id so a plan can poison one job
                 fault_point("executor.run", detail=job_id)
@@ -451,7 +501,7 @@ class JobProcessor:
 
         update(JobStatus.UPLOADING)
         unreachable = False
-        with timer.phase("upload"):
+        with timer.phase("upload"), tracing.span("upload"):
             try:
                 ok = self.client.put_output_chunk(scan_id, chunk_index, output)
             except TransportError:
@@ -467,6 +517,9 @@ class JobProcessor:
             perf["output_bytes"] = len(output)
             perf.update(self._engine_perf_delta())
             perf.update(self._scan_perf_extra)
+            ctx = tracing.current_context()
+            if ctx is not None:
+                self._synth_engine_spans(ctx, perf, exec_span)
             # this worker's non-closed breakers (transport + device)
             # ride the perf fields to the server, so /get-statuses
             # shows remote-fleet degradation the server-side /healthz
@@ -521,19 +574,75 @@ class JobProcessor:
         )
         print(f"server unreachable; spooled finished chunk {job_id}")
 
+    def _synth_engine_spans(self, ctx, perf: dict, exec_span) -> None:
+        """Fold the engine's accumulated device/walk timings into child
+        spans of the execute span. The device holds no wall clock of
+        its own, so the phases are laid out contiguously from the
+        execute start; the DURATIONS are the authoritative EngineStats
+        deltas (device phase A/B included when the engine reports
+        them), which is what the critical-path attribution consumes."""
+        parent = getattr(exec_span, "span_id", None)
+        start = getattr(exec_span, "start", None)
+        if parent is None or start is None:
+            return
+        device_s = perf.get("device_s") or 0.0
+        walk_s = perf.get("host_confirm_s") or 0.0
+        if device_s > 0:
+            dev_id = ctx.add_synth(
+                "device", start, device_s, parent_id=parent,
+                rows=perf.get("rows"), mesh=perf.get("mesh"),
+                pipeline=perf.get("pipeline"),
+            )
+            pa = perf.get("phase_a_s") or 0.0
+            pb = perf.get("phase_b_s") or 0.0
+            if pa > 0:
+                ctx.add_synth(
+                    "device.phase_a", start, pa, parent_id=dev_id
+                )
+            if pb > 0:
+                ctx.add_synth(
+                    "device.phase_b", start + pa, pb, parent_id=dev_id
+                )
+        if walk_s > 0:
+            ctx.add_synth("walk", start + device_s, walk_s, parent_id=parent)
+
+    def _mark_engine_stats(self, engine) -> None:
+        """Snapshot the cumulative engine counters at job start so
+        :meth:`_engine_perf_delta` can report this job's delta."""
+        ds = engine.stats
+        self._engine_stats_mark = (
+            engine,
+            ds.rows,
+            ds.device_seconds,
+            ds.host_confirm_seconds,
+            getattr(ds, "phase_a_seconds", 0.0),
+            getattr(ds, "phase_b_seconds", 0.0),
+        )
+
     def _engine_perf_delta(self) -> dict:
         """Device-engine stats accumulated during this job (tpu backend
         caches engines across jobs, so report the delta since job start)."""
         mark = self._engine_stats_mark
         if mark is None:
             return {}
-        engine, rows0, dev0, confirm0 = mark
+        engine, rows0, dev0, confirm0, pa0, pb0 = mark
         ds = engine.stats
         out = {
             "rows": ds.rows - rows0,
             "device_s": round(ds.device_seconds - dev0, 6),
             "host_confirm_s": round(ds.host_confirm_seconds - confirm0, 6),
         }
+        # split-phase device attribution, when the matcher reported it
+        # (single-device compacted path); feeds the device.phase_a/b
+        # child spans. Tracing-gated: with tracing off the perf wire
+        # payload must stay byte-identical to the untraced build.
+        if tracing.enabled():
+            pa = round(getattr(ds, "phase_a_seconds", 0.0) - pa0, 6)
+            pb = round(getattr(ds, "phase_b_seconds", 0.0) - pb0, 6)
+            if pa > 0:
+                out["phase_a_s"] = pa
+            if pb > 0:
+                out["phase_b_s"] = pb
         mesh = getattr(engine, "mesh", None)
         if mesh is not None:
             out["mesh"] = "x".join(
@@ -571,12 +680,7 @@ class JobProcessor:
         if not module.templates_dir:
             raise ValueError(f"active module {module.name} missing 'templates'")
         engine = self._engine_for(module.templates_dir)
-        self._engine_stats_mark = (
-            engine,
-            engine.stats.rows,
-            engine.stats.device_seconds,
-            engine.stats.host_confirm_seconds,
-        )
+        self._mark_engine_stats(engine)
         # keyed by probe spec + vars too: two modules sharing a
         # templates dir but differing in ports/timeouts/concurrency or
         # operator-supplied template vars must not alias
@@ -850,12 +954,7 @@ class JobProcessor:
         if not module.templates_dir:
             raise ValueError(f"tpu module {module.name} missing 'templates'")
         engine = self._engine_for(module.templates_dir)
-        self._engine_stats_mark = (
-            engine,
-            engine.stats.rows,
-            engine.stats.device_seconds,
-            engine.stats.host_confirm_seconds,
-        )
+        self._mark_engine_stats(engine)
         text = data.decode("utf-8", "surrogateescape")
         if module.input_format == "targets":
             # double-buffered: probe wave i+1 while matching wave i
@@ -898,11 +997,15 @@ class JobProcessor:
             # scheduler is engine-lazy; the engine ctor never sees cfg)
             sched.config.qos_deadline_ms = self.cfg.qos_deadline_ms
             sched.config.max_age_ms = self.cfg.sched_max_age_ms
-            for ci, res in enumerate(
-                sched.run(payloads, decode=decode, qos=qos)
-            ):
-                rows.extend(rows_by_chunk.pop(ci))
-                results.extend(res)
+            # "sched" = the continuous-batching drive window (planning,
+            # coalescing, deadline flushes); the engine's device/walk
+            # attribution rides the synthesized child spans instead
+            with tracing.span("sched", qos=qos, pipeline="on"):
+                for ci, res in enumerate(
+                    sched.run(payloads, decode=decode, qos=qos)
+                ):
+                    rows.extend(rows_by_chunk.pop(ci))
+                    results.extend(res)
         else:
             rows = []
             for line in text.splitlines():

@@ -1,0 +1,442 @@
+"""End-to-end span tracing (docs/OBSERVABILITY.md §Tracing): per-scan
+latency waterfalls, critical-path attribution, exemplar rendering, and
+the fault flight recorder.
+
+Pins the tentpole's acceptance contract:
+- a dispatched scan assembles into ONE parent-linked waterfall whose
+  root-level segment coverage lands within 10% of that scan's
+  gateway-latency observation, with zero orphaned spans;
+- a gateway-cache short-circuit gets the same treatment (admission →
+  cache.lookup → completion) without any worker involvement;
+- a retried job contributes BOTH attempts (spans + queue-waits) to a
+  single trace; journal recovery re-links in-flight scans to their
+  ORIGINAL trace ids and leaves a marker span + flight dump;
+- a seeded ``device.dispatch`` fault dumps the flight ring and the
+  dump contains the pre-fault dispatch record;
+- tracing disabled (the default) keeps the wire byte-identical: no
+  ``spans`` perf key, 404 traces, strict-parseable /metrics with no
+  exemplar suffixes — which only appear under SWARM_METRICS_EXEMPLARS.
+"""
+
+import json
+import time
+
+import pytest
+import requests
+
+from swarm_tpu.config import Config
+from swarm_tpu.datamodel import JobStatus
+from swarm_tpu.server.app import SwarmServer
+from swarm_tpu.server.queue import JobQueueService
+from swarm_tpu.stores import MemoryBlobStore, MemoryDocStore, MemoryStateStore
+from swarm_tpu.telemetry import tracing
+from swarm_tpu.telemetry.tracing import (
+    FLIGHT,
+    critical_path,
+    make_span,
+    waterfall_orphans,
+)
+
+
+@pytest.fixture
+def traced():
+    tracing.set_enabled(True)
+    yield
+    tracing.set_enabled(None)
+
+
+# ---------------------------------------------------------------------------
+# dispatched-scan waterfall, end to end through a real worker
+# ---------------------------------------------------------------------------
+
+
+def _echo_server(tmp_path, **cfg_kw):
+    modules_dir = tmp_path / "modules"
+    modules_dir.mkdir(exist_ok=True)
+    (modules_dir / "echo.json").write_text(
+        json.dumps({"command": "cat {input} > {output}"})
+    )
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="wfkey",
+        blob_root=str(tmp_path / "blobs"), doc_root=str(tmp_path / "docs"),
+        modules_dir=str(modules_dir),
+        poll_interval_idle_s=0.05, poll_interval_busy_s=0.01,
+        **cfg_kw,
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    cfg.server_url = f"http://127.0.0.1:{srv.port}"
+    return cfg, srv
+
+
+def test_dispatched_scan_waterfall_complete(tmp_path, traced):
+    """Two chunks through a real worker: the assembled waterfall is
+    parent-linked (zero orphans), carries every ladder rung, and its
+    root-level coverage sums to within 10% of the scan's gateway
+    latency — the PR's headline acceptance gate."""
+    from swarm_tpu.client.cli import JobClient, render_trace
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    cfg, srv = _echo_server(tmp_path)
+    try:
+        scan_file = tmp_path / "targets.txt"
+        scan_file.write_text("alpha\nbeta\n")
+        client = JobClient(cfg.resolve_url(), cfg.api_key)
+        code, _ = client.start_scan(str(scan_file), "echo", 0, 1, scan_id="wfall_1")
+        assert code == 200
+
+        wcfg = Config(**{**cfg.__dict__, "max_jobs": 2, "worker_id": "wf-w"})
+        proc = JobProcessor(wcfg)
+        proc.process_jobs()
+        assert proc.jobs_done == 2
+
+        doc = client.get_trace("wfall_1")
+        assert doc is not None, "no assembled trace for completed scan"
+        assert doc["status"] == "complete"
+        assert doc["trace_id"] == client.last_trace_id
+        assert waterfall_orphans(doc) == []
+
+        names = {s["name"] for s in doc["spans"]}
+        for expected in ("queue-wait", "download", "execute", "upload"):
+            assert expected in names, (expected, sorted(names))
+        # two attempts (one per chunk), each with its own queue-wait
+        assert sum(1 for s in doc["spans"] if s["name"] == "attempt") == 2
+        assert sum(1 for s in doc["spans"] if s["name"] == "queue-wait") == 2
+
+        gl = doc["gateway_latency_s"]
+        seg = doc["segments_sum_s"]
+        assert gl > 0
+        assert abs(seg - gl) / gl <= 0.10, (seg, gl)
+
+        cp = critical_path(doc)
+        assert cp and cp[0][1] > 0
+        rendered = render_trace(doc)
+        for needle in ("wfall_1", "queue-wait", "execute", "critical path"):
+            assert needle in rendered, (needle, rendered)
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# gateway-cache short-circuit waterfall (no worker involved)
+# ---------------------------------------------------------------------------
+
+
+def _post_queue(srv, lines, scan_id, qos=None, batch=1):
+    headers = {"Authorization": "Bearer wfkey"}
+    if qos:
+        headers["X-Swarm-QoS"] = qos
+    return requests.post(
+        f"http://127.0.0.1:{srv.port}/queue",
+        json={"module": "echo", "file_content": lines, "batch_size": batch,
+              "scan_id": scan_id, "chunk_index": 0},
+        headers=headers,
+        timeout=10,
+    )
+
+
+def _drain_one(srv, worker_id="w1", output=b"out\n"):
+    auth = {"Authorization": "Bearer wfkey"}
+    base = f"http://127.0.0.1:{srv.port}"
+    job = requests.get(
+        base + "/get-job", params={"worker_id": worker_id}, headers=auth,
+        timeout=10,
+    ).json()
+    requests.post(
+        base + f"/put-output-chunk/{job['scan_id']}/{job['chunk_index']}",
+        data=output, headers=auth, timeout=10,
+    )
+    requests.post(
+        base + f"/update-job/{job['job_id']}",
+        json={"status": "complete", "worker_id": worker_id},
+        headers=auth, timeout=10,
+    )
+    return job
+
+
+def test_short_circuit_scan_gets_waterfall(tmp_path, traced):
+    """A QoS-cache-answered interactive scan still assembles a trace:
+    admission → cache.lookup → completion, zero orphans, and the same
+    10% coverage gate against its (sub-millisecond) gateway latency."""
+    cfg, srv = _echo_server(tmp_path, cache_backend="memory")
+    try:
+        assert _post_queue(
+            srv, ["tgt\n"], "probe_1", qos="interactive"
+        ).status_code == 200
+        _drain_one(srv, output=b"tgt [found]\n")
+        assert _post_queue(
+            srv, ["tgt\n"], "probe_2", qos="interactive"
+        ).status_code == 200
+        assert srv.queue.job_record("probe_2_0")["status"] == JobStatus.COMPLETE
+
+        resp = requests.get(
+            f"http://127.0.0.1:{srv.port}/trace/probe_2",
+            headers={"Authorization": "Bearer wfkey"}, timeout=10,
+        )
+        assert resp.status_code == 200
+        doc = resp.json()
+        assert doc["status"] == "short_circuit"
+        names = {s["name"] for s in doc["spans"]}
+        assert {"admission", "cache.lookup", "completion"} <= names, names
+        assert waterfall_orphans(doc) == []
+        gl, seg = doc["gateway_latency_s"], doc["segments_sum_s"]
+        assert gl > 0 and abs(seg - gl) / gl <= 0.10, (seg, gl)
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# retry + recovery: one scan, one trace, every attempt
+# ---------------------------------------------------------------------------
+
+
+def _queue_service(blobs=None, **cfg_kw):
+    return JobQueueService(
+        Config(**cfg_kw), MemoryStateStore(),
+        blobs if blobs is not None else MemoryBlobStore(), MemoryDocStore(),
+    )
+
+
+def test_retried_job_assembles_one_trace_with_both_attempts(traced):
+    """A worker-failed-then-requeued job contributes the FAILED
+    attempt's spans too: the finished waterfall carries two attempt
+    spans and two queue-wait spans under one trace."""
+    q = _queue_service()
+    tid = "aa" * 8
+    q.queue_scan(
+        {"module": "echo", "file_content": ["t\n"], "batch_size": 1,
+         "scan_id": "retry_1"},
+        trace_id=tid,
+    )
+    job = q.next_job("w1")
+    t0 = time.time()
+    q.update_job(job["job_id"], {
+        "status": JobStatus.CMD_FAILED, "worker_id": "w1",
+        "perf": {"spans": [
+            make_span("attempt", tid, t0 - 0.02, 0.01, attempt=1, error="boom"),
+        ]},
+    })
+    assert q.job_record(job["job_id"])["status"] == JobStatus.QUEUED
+
+    job2 = q.next_job("w1")
+    assert job2["job_id"] == job["job_id"]
+    q.update_job(job2["job_id"], {
+        "status": JobStatus.COMPLETE, "worker_id": "w1",
+        "perf": {"spans": [
+            make_span("attempt", tid, time.time() - 0.01, 0.01, attempt=2),
+        ]},
+    })
+
+    doc = q.tracer.get("retry_1")
+    assert doc is not None and doc["status"] == "complete"
+    assert doc["trace_id"] == tid
+    attempts = [s for s in doc["spans"] if s["name"] == "attempt"]
+    assert sorted(s["attrs"]["attempt"] for s in attempts) == [1, 2]
+    waits = [s for s in doc["spans"] if s["name"] == "queue-wait"]
+    assert len(waits) == 2
+    assert waterfall_orphans(doc) == []
+
+
+def test_journal_recovery_links_original_trace(traced):
+    """kill-9 mid-scan: a recovered queue re-registers the unfinished
+    scan under its ORIGINAL trace id, stamps a journal-recovery marker
+    span, and dumps the flight ring — then the drained remainder still
+    assembles into that same trace."""
+    blobs = MemoryBlobStore()
+    svc1 = _queue_service(blobs=blobs)
+    tid = "bb" * 8
+    svc1.queue_scan(
+        {"module": "echo", "file_content": ["x\n", "y\n"], "batch_size": 1,
+         "scan_id": "recov_1"},
+        trace_id=tid,
+    )
+    j1 = svc1.next_job("w1")
+    svc1.update_job(j1["job_id"], {
+        "status": JobStatus.COMPLETE, "worker_id": "w1",
+        "perf": {"spans": [
+            make_span("attempt", tid, time.time() - 0.01, 0.01, attempt=1),
+        ]},
+    })
+
+    before = {d["seq"] for d in FLIGHT.last_dumps()}
+    # fresh state store + same blob store = process death and journal
+    # replay (the durability suite's crash model)
+    svc2 = _queue_service(blobs=blobs)
+    recov = [
+        d for d in FLIGHT.last_dumps()
+        if d["seq"] not in before and d["reason"] == "journal_recovery"
+    ]
+    assert recov, "recovery did not dump the flight ring"
+
+    j2 = svc2.next_job("w2")
+    assert j2 is not None and j2["scan_id"] == "recov_1"
+    svc2.update_job(j2["job_id"], {
+        "status": JobStatus.COMPLETE, "worker_id": "w2",
+        "perf": {"spans": [
+            make_span("attempt", tid, time.time() - 0.01, 0.01, attempt=1),
+        ]},
+    })
+
+    doc = svc2.tracer.get("recov_1")
+    assert doc is not None
+    assert doc["trace_id"] == tid, "recovered scan lost its trace id"
+    names = {s["name"] for s in doc["spans"]}
+    assert "journal-recovery" in names, names
+    assert waterfall_orphans(doc) == []
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_dump_on_seeded_device_dispatch_fault():
+    """The wiring the chaos plan exercises for real: ops/match.py
+    records a flight event BEFORE its fault point, so the dump fired by
+    the fault carries the dispatch that died. Always-on — no traced
+    fixture here."""
+    from swarm_tpu.resilience.faults import (
+        FaultInjected,
+        clear_plan,
+        fault_point,
+        install_plan,
+    )
+
+    before = {d["seq"] for d in FLIGHT.last_dumps()}
+    tracing.flight_event("device.dispatch", rows=4, shape="w448h192")
+    install_plan("device.dispatch:1")
+    try:
+        with pytest.raises(FaultInjected):
+            fault_point("device.dispatch")
+    finally:
+        clear_plan()
+
+    dumps = [
+        d for d in FLIGHT.last_dumps()
+        if d["seq"] not in before
+        and d["reason"] == "fault" and d["detail"] == "device.dispatch"
+    ]
+    assert dumps, "seeded fault did not dump the flight ring"
+    assert any(
+        r["name"] == "device.dispatch" for r in dumps[-1]["records"]
+    ), "dump missing the pre-fault dispatch record"
+
+
+# ---------------------------------------------------------------------------
+# disabled = byte-identical wire; exemplars behind their own flag
+# ---------------------------------------------------------------------------
+
+
+def test_tracing_disabled_preserves_wire(tmp_path, monkeypatch):
+    """Default-off contract: no spans in perf, no stored traces (404),
+    and /metrics stays strict 0.0.4 with zero exemplar suffixes."""
+    from swarm_tpu.client.cli import JobClient
+    from swarm_tpu.telemetry.metrics import parse_exposition
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    monkeypatch.delenv("SWARM_TRACE", raising=False)
+    monkeypatch.delenv("SWARM_TRACE_ENABLED", raising=False)
+    tracing.set_enabled(None)
+    assert not tracing.enabled()
+
+    cfg, srv = _echo_server(tmp_path)
+    try:
+        scan_file = tmp_path / "t.txt"
+        scan_file.write_text("alpha\n")
+        client = JobClient(cfg.resolve_url(), cfg.api_key)
+        code, _ = client.start_scan(str(scan_file), "echo", 0, 1, scan_id="off_1")
+        assert code == 200
+        wcfg = Config(**{**cfg.__dict__, "max_jobs": 1, "worker_id": "off-w"})
+        JobProcessor(wcfg).process_jobs()
+
+        rec = srv.queue.job_record("off_1_0")
+        assert rec["status"] == JobStatus.COMPLETE
+        assert "spans" not in (rec.get("perf") or {}), rec["perf"]
+
+        resp = requests.get(
+            f"http://127.0.0.1:{srv.port}/trace/off_1",
+            headers={"Authorization": "Bearer wfkey"}, timeout=10,
+        )
+        assert resp.status_code == 404
+
+        text = requests.get(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ).text
+        parse_exposition(text)  # raises on any malformed line
+        assert " # {" not in text
+    finally:
+        srv.shutdown()
+
+
+def test_exemplar_rendering_behind_flag(monkeypatch):
+    """Exemplar suffix appears on the +Inf bucket line only, only when
+    SWARM_METRICS_EXEMPLARS is set, and carries the WORST recent
+    observation's trace id; flag-off output strict-parses."""
+    from swarm_tpu.telemetry.metrics import MetricsRegistry, parse_exposition
+
+    reg = MetricsRegistry()
+    h = reg.histogram("test_trace_exemplar_seconds", "t", ("qos",))
+    h.labels(qos="interactive").observe(0.25, trace_id="worstworstworst1")
+    h.labels(qos="interactive").observe(0.01, trace_id="smallsmallsmall1")
+
+    monkeypatch.delenv("SWARM_METRICS_EXEMPLARS", raising=False)
+    off = reg.render()
+    assert "# {" not in off
+    parse_exposition(off)
+
+    monkeypatch.setenv("SWARM_METRICS_EXEMPLARS", "1")
+    on = reg.render()
+    ex_lines = [ln for ln in on.splitlines() if "# {" in ln]
+    assert len(ex_lines) == 1, ex_lines
+    assert 'le="+Inf"' in ex_lines[0]
+    assert 'trace_id="worstworstworst1"' in ex_lines[0]
+
+
+# ---------------------------------------------------------------------------
+# POST /spans ingestion route
+# ---------------------------------------------------------------------------
+
+
+def test_post_spans_route(tmp_path, traced):
+    """Out-of-band span shipping: valid batch lands on the scan's
+    assembler, unknown scans are counted-dropped (still 200 — workers
+    must not retry-loop on a retired trace), malformed payloads 400."""
+    cfg, srv = _echo_server(tmp_path)
+    try:
+        assert _post_queue(srv, ["a\n"], "sp_1").status_code == 200
+        auth = {"Authorization": "Bearer wfkey"}
+        base = f"http://127.0.0.1:{srv.port}"
+        tid = "cc" * 8
+        good = requests.post(
+            base + "/spans",
+            json={"scan_id": "sp_1", "spans": [
+                make_span("host.extra", tid, time.time(), 0.002),
+            ]},
+            headers=auth, timeout=10,
+        )
+        assert good.status_code == 200
+        assert good.json()["added"] == 1
+
+        unknown = requests.post(
+            base + "/spans",
+            json={"scan_id": "nope_1", "spans": [
+                make_span("x", tid, time.time(), 0.001),
+            ]},
+            headers=auth, timeout=10,
+        )
+        assert unknown.status_code == 200
+        assert unknown.json()["added"] == 0
+
+        for bad in (
+            {"spans": []},                       # missing scan_id
+            {"scan_id": "sp_1"},                 # missing spans
+            {"scan_id": "sp_1", "spans": "x"},   # spans not a list
+        ):
+            assert requests.post(
+                base + "/spans", json=bad, headers=auth, timeout=10,
+            ).status_code == 400
+        assert requests.post(
+            base + "/spans", data=b"{not json", headers=auth, timeout=10,
+        ).status_code == 400
+    finally:
+        srv.shutdown()

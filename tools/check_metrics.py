@@ -104,6 +104,13 @@ REQUIRED_FAMILIES = (
     "swarm_aot_publish_total",
     "swarm_aot_bringup_seconds",
     "swarm_aot_artifact_bytes",
+    # span tracing + flight recorder (docs/OBSERVABILITY.md §Tracing):
+    # registered at telemetry import (trace_export), reason combos
+    # pre-seeded — every family renders samples even with tracing off
+    "swarm_trace_spans_total",
+    "swarm_trace_spans_dropped_total",
+    "swarm_trace_assembled_total",
+    "swarm_trace_flight_dumps_total",
 )
 
 
