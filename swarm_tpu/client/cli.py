@@ -266,6 +266,121 @@ class JobClient:
         )
         return resp.status_code == 200
 
+    # ------------------------------------------------------------------
+    def monitor_add(
+        self,
+        path: str,
+        module: str,
+        interval_s: float,
+        monitor_id: Optional[str] = None,
+        batch_size: int = 0,
+        paused: bool = False,
+    ) -> tuple[int, str]:
+        """Register (or upsert) a standing monitor over the targets in
+        ``path`` — tenant/QoS ride the session headers exactly like a
+        one-shot scan (docs/MONITORING.md)."""
+        with open(path, "r") as f:
+            targets = f.readlines()
+        data = {
+            "module": module,
+            "targets": targets,
+            "interval_s": interval_s,
+            "batch_size": int(batch_size),
+            "paused": paused,
+        }
+        if monitor_id:
+            data["monitor_id"] = monitor_id
+        resp = self.session.post(
+            f"{self.base}/monitor", json=data, timeout=self.timeout
+        )
+        return resp.status_code, resp.text
+
+    def monitor_list(self) -> Optional[list]:
+        resp = self.session.get(f"{self.base}/monitor", timeout=self.timeout)
+        return resp.json()["monitors"] if resp.status_code == 200 else None
+
+    def monitor_update(self, monitor_id: str, op: str) -> tuple[int, str]:
+        """``op`` is rm | pause | resume."""
+        resp = self.session.post(
+            f"{self.base}/monitor/{monitor_id}",
+            json={"op": op},
+            timeout=self.timeout,
+        )
+        return resp.status_code, resp.text
+
+    def monitor_feed(
+        self,
+        monitor_id: str,
+        from_seq: int = 0,
+        max_reconnects: int = 8,
+        reconnect_delay_s: float = 0.5,
+    ):
+        """Follow a monitor's change feed: yields diff-record dicts in
+        ``seq`` order from ``GET /monitor-feed/<id>`` (NDJSON).
+
+        Same resume discipline as :meth:`stream_results`, with the
+        record ``seq`` as the cursor: on any disconnect — server
+        restart, idle-timeout record, dropped connection — reconnect
+        with ``?from=<last seq + 1>`` and continue from exactly the
+        last acked record (the feed store is idempotent, so nothing
+        duplicates or drops). Timeout records reconnect for free; the
+        budget burns only on real failures and resets on progress."""
+        cursor = int(from_seq)
+        failures = 0
+        while True:
+            ended = saw_timeout = False
+            try:
+                resp = self.session.get(
+                    f"{self.base}/monitor-feed/{monitor_id}",
+                    params={"from": cursor},
+                    stream=True,
+                    timeout=self.timeout,
+                )
+                if resp.status_code != 200:
+                    raise requests.HTTPError(
+                        f"/monitor-feed: {resp.status_code}"
+                    )
+                for line in resp.iter_lines():
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    event = rec.get("event")
+                    if event == "end":
+                        ended = True
+                        break
+                    if event == "timeout":
+                        saw_timeout = True
+                        break  # reconnect from the cursor
+                    if "seq" in rec:
+                        cursor = int(rec["seq"]) + 1
+                        failures = 0
+                        yield rec
+            except requests.exceptions.ReadTimeout:
+                # healthy-but-quiet monitor (see stream_results): the
+                # server's own idle record may be minutes away —
+                # reconnect without burning the budget
+                time.sleep(reconnect_delay_s)
+                continue
+            except (requests.RequestException, ValueError, OSError):
+                failures += 1
+                if failures > max_reconnects:
+                    raise
+                time.sleep(reconnect_delay_s)
+                continue
+            if ended:
+                return
+            if saw_timeout:
+                time.sleep(reconnect_delay_s)
+                continue
+            failures += 1
+            if failures > max_reconnects:
+                raise requests.ConnectionError(
+                    f"/monitor-feed/{monitor_id}: disconnected without "
+                    f"an end record after {max_reconnects} reconnects "
+                    f"(next seq {cursor})"
+                )
+            time.sleep(reconnect_delay_s)
+
 
 # ---------------------------------------------------------------------------
 # Views
@@ -451,19 +566,44 @@ def render_trace(doc: dict) -> str:
 
 def render_scans(statuses: dict) -> str:
     table = Table(
-        ["Scan ID", "Chunks", "Complete", "%", "Workers", "Module", "Started",
-         "Completed", "ECT", "Rows/s"]
+        ["Scan ID", "Chunks", "Complete", "%", "Workers", "Module", "Monitor",
+         "Started", "Completed", "ECT", "Rows/s"]
     )
     for s in statuses.get("scans", []):
         ect = estimate_completion_time(
             s.get("scan_started"), s.get("total_chunks") or 1,
             s.get("chunks_complete") or 0, s.get("completed_at"),
         )
+        # monitor provenance: which standing spec fired this scan, and
+        # as which epoch (blank for one-shot scans — docs/MONITORING.md)
+        mon = (
+            f"{s['monitor_id']}@e{s.get('monitor_epoch')}"
+            if s.get("monitor_id") else ""
+        )
         table.add_row(
             [s.get("scan_id"), s.get("total_chunks"), s.get("chunks_complete"),
              s.get("percent_complete"), len(s.get("workers") or []), s.get("module"),
-             _fmt_ts(s.get("scan_started")), _fmt_ts(s.get("completed_at")),
+             mon, _fmt_ts(s.get("scan_started")), _fmt_ts(s.get("completed_at")),
              ect or "", s.get("rows_per_second") or ""]
+        )
+    return str(table)
+
+
+def render_monitors(monitors: list) -> str:
+    """Standing-spec registry readout (`swarm monitor ls` —
+    docs/MONITORING.md)."""
+    table = Table(
+        ["Monitor ID", "Module", "Targets", "Interval", "Tenant", "QoS",
+         "Epoch", "Paused", "Last Scan"]
+    )
+    for m in monitors:
+        table.add_row(
+            [m.get("monitor_id"), m.get("module"),
+             len(m.get("targets") or []),
+             f"{float(m.get('interval_s') or 0):g}s",
+             m.get("tenant"), m.get("qos"), m.get("epoch"),
+             "yes" if m.get("paused") else "",
+             m.get("last_scan_id") or ""]
         )
     return str(table)
 
@@ -474,13 +614,19 @@ def render_scans(statuses: dict) -> str:
 
 ACTIONS = [
     "scan", "workers", "scans", "jobs", "metrics", "dead-letter", "tenants",
-    "spinup", "terminate", "cat", "stream", "trace", "recycle", "reset",
+    "spinup", "terminate", "cat", "stream", "trace", "monitor", "recycle",
+    "reset",
 ]
+
+#: second-level verbs for ``swarm monitor`` (default: ls)
+MONITOR_SUBACTIONS = ["add", "rm", "ls", "pause", "resume", "follow"]
 
 
 def main(argv: Optional[list[str]] = None) -> int:
     parser = argparse.ArgumentParser(description="Swarm Scan Client")
     parser.add_argument("action", nargs="?", choices=ACTIONS)
+    parser.add_argument("subaction", nargs="?", default=None,
+                        help="monitor subverb: " + "|".join(MONITOR_SUBACTIONS))
     parser.add_argument("--server-url", default=None)
     parser.add_argument("--api-key", default=None)
     parser.add_argument("--config", default=None)
@@ -501,6 +647,12 @@ def main(argv: Optional[list[str]] = None) -> int:
                              "deadline-bounded batching (scan/stream)")
     parser.add_argument("--from-chunk", type=int, default=0,
                         help="resume cursor for stream follow mode")
+    parser.add_argument("--interval", type=float, default=None,
+                        help="rescan cadence in seconds (monitor add)")
+    parser.add_argument("--monitor-id", default=None,
+                        help="monitor id (monitor add/rm/pause/resume/follow)")
+    parser.add_argument("--from-seq", type=int, default=0,
+                        help="resume cursor for monitor follow mode")
     parser.add_argument("--job-id", help="job id (dead-letter --requeue)")
     parser.add_argument("--requeue", action="store_true",
                         help="requeue the quarantined --job-id (dead-letter)")
@@ -712,6 +864,52 @@ def _run_action(args, cfg: Config, client: JobClient) -> int:
             return 1
         print(render_trace(doc))
         return 0
+
+    if args.action == "monitor":
+        sub = args.subaction or "ls"
+        if sub not in MONITOR_SUBACTIONS:
+            print(f"monitor subaction must be one of: "
+                  f"{', '.join(MONITOR_SUBACTIONS)}")
+            return 1
+        if sub == "ls":
+            monitors = client.monitor_list()
+            if monitors is None:
+                print("Failed to retrieve monitors")
+                return 1
+            print(f"Monitors: {len(monitors)}")
+            print(render_monitors(monitors))
+            return 0
+        if sub == "add":
+            if not args.file or not args.module or args.interval is None:
+                print("file, module and --interval are required for "
+                      "monitor add")
+                return 1
+            batch = (
+                0 if args.batch_size == "auto"
+                else int(float(args.batch_size))
+            )
+            code, text = client.monitor_add(
+                args.file, args.module, args.interval,
+                monitor_id=args.monitor_id, batch_size=batch,
+            )
+            print(f"Monitor Add Status Code: {code}")
+            print(f"Monitor Add Response: {text}")
+            return 0 if code == 200 else 1
+        if not args.monitor_id:
+            print(f"--monitor-id is required for monitor {sub}")
+            return 1
+        if sub == "follow":
+            for rec in client.monitor_feed(
+                args.monitor_id, from_seq=args.from_seq
+            ):
+                sys.stdout.write(
+                    json.dumps(rec, separators=(",", ":")) + "\n"
+                )
+                sys.stdout.flush()
+            return 0
+        code, text = client.monitor_update(args.monitor_id, sub)
+        print(code, text)
+        return 0 if code == 200 else 1
 
     if args.action == "reset":
         code, text = client.reset()

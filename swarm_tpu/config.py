@@ -227,6 +227,26 @@ class Config:
     gateway_autoscale_min_nodes: int = 0
     gateway_autoscale_max_nodes: int = 8
     gateway_autoscale_apply: bool = False
+    # --- continuous monitoring (docs/MONITORING.md) ---
+    # standing rescan subsystem: registered monitor specs fire epochs
+    # on a cadence through the admission path, diff verdicts against
+    # the prior epoch and push changes over /monitor-feed. Off = the
+    # routes 404-equivalent (registration rejected) and no ticker
+    # thread starts.
+    monitor_enabled: bool = True
+    # scheduler ticker cadence: how often due specs are checked. The
+    # cadence floor for spec intervals too — an interval below one
+    # tick can never fire more often than the ticker runs.
+    monitor_tick_s: float = 0.25
+    # registry bound: a POST /monitor past this many standing specs is
+    # rejected (specs are journaled state — an unbounded registry
+    # would grow every snapshot)
+    monitor_max_specs: int = 256
+    # /monitor-feed/<id>: poll cadence for new diff records and the
+    # idle window after which the server closes the stream (client
+    # resumes with ?from=<cursor>)
+    monitor_feed_poll_s: float = 0.1
+    monitor_feed_idle_timeout_s: float = 300.0
     # end-to-end span tracing (docs/OBSERVABILITY.md §Tracing): off by
     # default — disabled tracing keeps wire payloads byte-identical to
     # the untraced build. Env: SWARM_TRACE_ENABLED (SWARM_TRACE also

@@ -193,6 +193,15 @@ class Job:
     # submission): the gateway cache's writeback hook reads it to skip
     # over-bound bulk chunks without fetching the blob. Extra wire key.
     chunk_rows: Optional[int] = None
+    # standing-monitor provenance (docs/MONITORING.md): jobs fired by
+    # a monitor epoch carry the spec id and epoch number so `swarm
+    # scans` / /get-statuses can attribute a scan to its monitor. None
+    # on every one-shot submission — the reference wire contract is
+    # byte-preserved when absent (extra always-present-None keys, the
+    # same pattern as tenant/qos). Extra wire keys the reference
+    # client ignores.
+    monitor_id: Optional[str] = None
+    monitor_epoch: Optional[int] = None
 
     @classmethod
     def create(
@@ -205,6 +214,8 @@ class Job:
         qos: Optional[str] = None,
         admitted_at: Optional[float] = None,
         chunk_rows: Optional[int] = None,
+        monitor_id: Optional[str] = None,
+        monitor_epoch: Optional[int] = None,
     ) -> "Job":
         return cls(
             job_id=job_id_for(scan_id, chunk_index),
@@ -216,6 +227,8 @@ class Job:
             qos=qos,
             admitted_at=admitted_at,
             chunk_rows=chunk_rows,
+            monitor_id=monitor_id,
+            monitor_epoch=monitor_epoch,
         )
 
     def to_wire(self) -> dict[str, Any]:
@@ -277,6 +290,11 @@ class ScanSummary:
     device_seconds: Optional[float] = None
     execute_seconds: Optional[float] = None
     rows_per_second: Optional[float] = None
+    # standing-monitor provenance (docs/MONITORING.md): set when the
+    # scan's jobs were fired by a monitor epoch, None for one-shot
+    # scans — the reference rollup shape gains only extra keys
+    monitor_id: Optional[str] = None
+    monitor_epoch: Optional[int] = None
 
     def to_wire(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -309,6 +327,9 @@ def rollup_scans(jobs: dict[str, dict]) -> list[dict]:
             summary.completed_at is None or completed > summary.completed_at
         ):
             summary.completed_at = completed
+        if summary.monitor_id is None and job.get("monitor_id"):
+            summary.monitor_id = job.get("monitor_id")
+            summary.monitor_epoch = job.get("monitor_epoch")
         perf = job.get("perf")
         if isinstance(perf, dict):
             summary.rows_processed = (summary.rows_processed or 0) + int(

@@ -54,14 +54,50 @@ def scan_chunk_digest(module: str, chunk_lines: Sequence[str]) -> str:
     """Content address of one submission chunk: sha256 over the module
     name and the chunk's target lines, length-prefixed (the same
     discipline as ``cache.tier.row_digest`` — concatenation stays
-    unambiguous). The digest covers exactly what the worker's input
-    chunk will contain, so a completed chunk's writeback and a later
-    identical submission's lookup meet on the same key."""
+    unambiguous). Since the per-target key landed this is the
+    MIGRATION-PATH key: still consulted on lookup so entries written by
+    a pre-migration server keep hitting for one epoch, no longer
+    written. Remove with the next ``bump_epoch``-worthy change."""
     out = bytearray(_FORMAT)
     _lp(out, b"gwscan")
     _lp(out, module.encode("utf-8", "surrogateescape"))
     _lp_seq(out, chunk_lines)
     return hashlib.sha256(bytes(out)).hexdigest()
+
+
+def scan_target_digest(module: str, target: str) -> str:
+    """Content address of ONE target line's output segment — the
+    primary gateway-family key. Keying by (module, target) instead of
+    (module, chunk) means any re-chunking of the same assets dedups: a
+    monitor epoch at batch 5 hits entries written by a one-shot scan at
+    batch 16, because both decompose to the same target keys."""
+    out = bytearray(_FORMAT)
+    _lp(out, b"gwtarget")
+    _lp(out, module.encode("utf-8", "surrogateescape"))
+    _lp(out, target.encode("utf-8", "surrogateescape"))
+    return hashlib.sha256(bytes(out)).hexdigest()
+
+
+def split_output_segments(output: bytes, n: int) -> Optional[list[bytes]]:
+    """Split a chunk output into per-target segments, one per input
+    line, such that ``b"".join(segments) == output`` exactly. None when
+    the output does not carry one line per target (multi-line verdict
+    modules) — those chunks stay chunk-granular. The invariant is what
+    makes per-target reassembly byte-identical to the output a worker
+    would have uploaded for the same chunk."""
+    if n <= 0:
+        return None
+    if n == 1:
+        return [output]
+    parts = output.split(b"\n")
+    if parts and parts[-1] == b"":
+        core = parts[:-1]
+        if len(core) != n:
+            return None
+        return [p + b"\n" for p in core]
+    if len(parts) != n:
+        return None
+    return [p + b"\n" for p in parts[:-1]] + [parts[-1]]
 
 
 class GatewayScanCache:
@@ -127,60 +163,109 @@ class GatewayScanCache:
         return epoch, token
 
     # ------------------------------------------------------------------
-    def lookup_chunks(
+    @staticmethod
+    def _b64(raw) -> Optional[bytes]:
+        try:
+            return base64.b64decode(raw, validate=True)
+        except (ValueError, TypeError):
+            # a corrupt entry is a MISS, never an exception on the
+            # submit path
+            return None
+
+    def lookup_chunks_partial(
         self, module: str, chunks: Sequence[Sequence[str]]
-    ) -> Optional[list[bytes]]:
-        """Outputs for EVERY chunk of a submission, or None when any
-        chunk is unknown (all-or-nothing: a partial hit falls through
-        to normal admission so lease/retry semantics stay untouched).
-        One batched tier read for the whole submission."""
+    ) -> Optional[list]:
+        """Per-chunk outputs with None holes for unknown chunks — or
+        None outright when the backend is unreachable. One batched tier
+        read covers every target digest of every chunk PLUS the legacy
+        chunk digests (the migration-path read). A chunk resolves
+        per-target first (so re-chunked assets dedup), falling back to
+        its whole-chunk entry."""
         if not chunks:
             return None
         bound = self._ensure_bound()
         if bound is None:
             return None
         epoch, _token = bound
-        digests = [scan_chunk_digest(module, c) for c in chunks]
+        want: list[str] = []
+        per_chunk: list[tuple[list[str], str]] = []
+        for c in chunks:
+            tdigests = [scan_target_digest(module, t) for t in c]
+            cdigest = scan_chunk_digest(module, c)
+            per_chunk.append((tdigests, cdigest))
+            want.extend(tdigests)
+            want.append(cdigest)
         try:
-            got = self._tier.get_many(FAMILY, epoch, digests)
+            got = self._tier.get_many(FAMILY, epoch, want)
         except Exception as e:
             self._degraded(e)
             return None
-        outputs: list[bytes] = []
-        for digest in digests:
-            raw = got.get(digest)
-            if raw is None:
+        outputs: list = []
+        for tdigests, cdigest in per_chunk:
+            segments = [self._b64(got[d]) for d in tdigests if d in got]
+            if len(segments) == len(tdigests) and all(
+                s is not None for s in segments
+            ):
+                outputs.append(b"".join(segments))
                 with self._lock:
+                    self._hits += 1
+                continue
+            whole = self._b64(got[cdigest]) if cdigest in got else None
+            outputs.append(whole)
+            with self._lock:
+                if whole is not None:
+                    self._hits += 1
+                else:
                     self._misses += 1
-                return None
-            try:
-                outputs.append(base64.b64decode(raw, validate=True))
-            except (ValueError, TypeError):
-                # a corrupt entry is a MISS, never an exception on the
-                # submit path
-                with self._lock:
-                    self._misses += 1
-                return None
-        with self._lock:
-            self._hits += 1
+        return outputs
+
+    def lookup_chunks(
+        self, module: str, chunks: Sequence[Sequence[str]]
+    ) -> Optional[list[bytes]]:
+        """Outputs for EVERY chunk of a submission, or None when any
+        chunk is unknown (all-or-nothing: a partial hit falls through
+        to normal admission so lease/retry semantics stay untouched —
+        the interactive short-circuit contract). The monitor epoch path
+        uses :meth:`lookup_chunks_partial` instead, where partial
+        completion is the point."""
+        outputs = self.lookup_chunks_partial(module, chunks)
+        if outputs is None or any(o is None for o in outputs):
+            return None
         return outputs
 
     def writeback(
         self, module: str, chunk_lines: Sequence[str], output: bytes
     ) -> bool:
-        """Store one completed chunk's output under its content key —
+        """Store one completed chunk's output under its content keys —
         fenced, best-effort (a dropped write costs one future device
-        round trip, never correctness)."""
+        round trip, never correctness). Splittable outputs (one line
+        per target — the normal module contract) store PER-TARGET
+        segments; unsplittable ones keep the whole-chunk key, so
+        multi-line-verdict modules stay exactly as cacheable as before
+        the migration."""
         bound = self._ensure_bound()
         if bound is None:
             return False
         epoch, token = bound
-        value = base64.b64encode(output).decode("ascii")
+        segments = split_output_segments(output, len(chunk_lines))
+        if segments is not None:
+            pairs = [
+                (
+                    scan_target_digest(module, target),
+                    base64.b64encode(seg).decode("ascii"),
+                )
+                for target, seg in zip(chunk_lines, segments)
+            ]
+        else:
+            pairs = [
+                (
+                    scan_chunk_digest(module, chunk_lines),
+                    base64.b64encode(output).decode("ascii"),
+                )
+            ]
         try:
             outcome, stored = self._tier.put_many(
-                FAMILY, epoch,
-                [(scan_chunk_digest(module, chunk_lines), value)],
-                self._writer, token,
+                FAMILY, epoch, pairs, self._writer, token,
             )
         except Exception as e:
             self._degraded(e)

@@ -40,6 +40,9 @@ from swarm_tpu.gateway.admission import (
 from swarm_tpu.gateway.qos import QOS_HEADER, QOS_INTERACTIVE, parse_qos
 from swarm_tpu.gateway.qoscache import build_gateway_cache
 from swarm_tpu.gateway.streaming import stream_scan
+from swarm_tpu.monitor.feed import feed_prefix, stream_feed
+from swarm_tpu.monitor.service import MonitorService
+from swarm_tpu.monitor.spec import MONITOR_ID_RE, MonitorSpec
 from swarm_tpu.server.fleet import AutoscaleAdvisor, build_provider
 from swarm_tpu.server.queue import JobQueueService
 from swarm_tpu.stores import build_stores
@@ -135,6 +138,28 @@ class SwarmServer:
             self.qos_cache = build_gateway_cache(cfg)
         except Exception as e:
             print(f"gateway scan cache unavailable ({e}); pass-through")
+        # continuous monitoring (docs/MONITORING.md): the ticker thread
+        # is server-lifecycle-owned; the DURABLE spec registry lives in
+        # the queue (journaled). The verdict-plane store shares the
+        # gateway cache's tier instance so both views of the shared
+        # tier agree within this process; with no tier it degrades to
+        # rebuilding planes from the change feed.
+        self.monitor: Optional[MonitorService] = None
+        if getattr(cfg, "monitor_enabled", True):
+            tier = (
+                self.qos_cache._tier if self.qos_cache is not None else None
+            )
+            if tier is None:
+                try:
+                    from swarm_tpu.cache.tier import build_tier
+
+                    tier = build_tier(cfg)
+                except Exception:
+                    tier = None
+            self.monitor = MonitorService(
+                self.queue, cfg, submit=self._submit_monitor_epoch, tier=tier
+            )
+            self.monitor.start()
         self._routes: list[tuple[str, re.Pattern, Callable, str]] = []
         self._register_routes()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -194,6 +219,10 @@ class SwarmServer:
         r("POST", r"^/spans$", self._post_spans, "/spans")
         r("GET", r"^/trace/(?P<scan_id>[^/]+)$", self._get_trace, "/trace")
         r("GET", r"^/stream/(?P<scan_id>[^/]+)$", self._stream, "/stream")
+        r("POST", r"^/monitor$", self._monitor_post, "/monitor")
+        r("GET", r"^/monitor$", self._monitor_list, "/monitor")
+        r("POST", r"^/monitor/(?P<monitor_id>[^/]+)$", self._monitor_update, "/monitor-update")
+        r("GET", r"^/monitor-feed/(?P<monitor_id>[^/]+)$", self._monitor_feed, "/monitor-feed")
         r("GET", r"^/tenants$", self._tenants, "/tenants")
         r("GET", r"^/autoscale$", self._autoscale_recommend, "/autoscale")
         r("POST", r"^/autoscale$", self._autoscale_apply, "/autoscale")
@@ -658,6 +687,165 @@ class SwarmServer:
         )
         return 200, gen, "application/x-ndjson"
 
+    # ------------------------------------------------------------------
+    # Continuous monitoring (docs/MONITORING.md)
+    # ------------------------------------------------------------------
+    def _submit_monitor_epoch(self, spec, scan_id, epoch) -> Optional[dict]:
+        """The ticker's epoch-submit callback: one admission decision
+        (epoch fires are rate-limited like any submission — a shed
+        epoch returns None and the spec retries next tick, late), then
+        a PARTIAL gateway-cache lookup so fleet-known targets complete
+        with zero dispatch, then the journaled fire."""
+        decision = self._admission_decision(spec.tenant)
+        if not decision.admitted:
+            return None
+        cached = None
+        max_rows = int(getattr(self.cfg, "qos_cache_max_rows", 0))
+        if self.qos_cache is not None and max_rows > 0:
+            lines = [t.rstrip("\n") for t in spec.targets]
+            chunks = list(chunk_generator(lines, spec.batch_size))
+            outs = self.qos_cache.lookup_chunks_partial(spec.module, chunks)
+            if outs:
+                cached = {
+                    i: o
+                    for i, o in enumerate(outs)
+                    if o is not None and len(chunks[i]) <= max_rows
+                }
+        try:
+            return self.queue.fire_monitor_epoch(
+                spec.to_wire(), scan_id, epoch,
+                cached_outputs=cached, trace_id=new_trace_id(),
+            )
+        except Exception as e:
+            # a failed fire (journal down, malformed spec) must not
+            # kill the ticker; the spec stays due and retries
+            print(f"monitor epoch fire failed for {spec.monitor_id}: {e}")
+            return None
+
+    def _monitor_post(self, m, q, body, h):
+        """Register or update a standing monitor spec. Tenant and QoS
+        ride the same headers as a one-shot submission; an update
+        preserves the existing cadence (epoch, next_fire_at) so
+        changing targets never re-fires or rewinds a monitor."""
+        if self.monitor is None:
+            return self._json(503, {"message": "Monitoring disabled"})
+        try:
+            data = json.loads(body or b"{}")
+        except ValueError:
+            return self._json(400, {"message": "Invalid JSON"})
+        tenant = (
+            self._header(h, "X-Swarm-Tenant") or ""
+        ).strip() or DEFAULT_TENANT
+        try:
+            qos = parse_qos(self._header(h, QOS_HEADER))
+        except ValueError as e:
+            return self._json(400, {"message": str(e)})
+        monitor_id = str(data.get("monitor_id") or "")
+        if not monitor_id:
+            import uuid
+
+            monitor_id = f"mon-{uuid.uuid4().hex[:12]}"
+        try:
+            spec = MonitorSpec(
+                monitor_id=monitor_id,
+                module=str(data.get("module") or ""),
+                targets=[str(t) for t in (data.get("targets") or [])],
+                interval_s=float(data.get("interval_s") or 0.0),
+                tenant=tenant,
+                qos=qos,
+                batch_size=int(data.get("batch_size") or 0),
+                paused=bool(data.get("paused")),
+                created_at=time.time(),
+            )
+        except (TypeError, ValueError) as e:
+            return self._json(400, {"message": str(e)})
+        problem = spec.validate()
+        if problem is not None:
+            return self._json(400, {"message": problem})
+        existing = self.queue.get_monitor(spec.monitor_id)
+        if existing is None:
+            limit = int(getattr(self.cfg, "monitor_max_specs", 0))
+            if limit > 0 and len(self.queue.list_monitors()) >= limit:
+                return self._json(
+                    429, {"message": "Monitor registry full"}
+                )
+        else:
+            spec.created_at = float(
+                existing.get("created_at") or spec.created_at
+            )
+            spec.epoch = int(existing.get("epoch") or 0)
+            spec.next_fire_at = float(existing.get("next_fire_at") or 0.0)
+            spec.last_scan_id = existing.get("last_scan_id")
+            spec.refire = bool(existing.get("refire"))
+        try:
+            self.queue.put_monitor(spec.to_wire())
+        except Exception as e:
+            return self._json(503, {"message": f"Registration failed: {e}"})
+        return self._json(
+            200,
+            {
+                "monitor_id": spec.monitor_id,
+                "epoch": spec.epoch,
+                "paused": spec.paused,
+            },
+        )
+
+    def _monitor_list(self, m, q, body, h):
+        return self._json(200, {"monitors": self.queue.list_monitors()})
+
+    def _monitor_update(self, m, q, body, h):
+        """``{"op": "rm"|"pause"|"resume"}`` — mutations, not a generic
+        PATCH; spec changes go through POST /monitor upserts."""
+        monitor_id = m["monitor_id"]
+        try:
+            data = json.loads(body or b"{}")
+        except ValueError:
+            return self._json(400, {"message": "Invalid JSON"})
+        op = data.get("op")
+        existing = self.queue.get_monitor(monitor_id)
+        if existing is None:
+            return self._json(404, {"message": "Monitor not found"})
+        if op == "rm":
+            self.queue.remove_monitor(monitor_id)
+            return self._json(200, {"message": "Monitor removed"})
+        if op in ("pause", "resume"):
+            spec = dict(existing)
+            spec["paused"] = op == "pause"
+            try:
+                self.queue.put_monitor(spec)
+            except Exception as e:
+                return self._json(503, {"message": f"Update failed: {e}"})
+            return self._json(
+                200, {"monitor_id": monitor_id, "paused": spec["paused"]}
+            )
+        return self._json(400, {"message": "op must be rm, pause or resume"})
+
+    def _monitor_feed(self, m, q, body, h):
+        """Resumable NDJSON change feed (docs/MONITORING.md §Feed
+        resume contract): ``?from=N`` skips the first N records; the
+        generator long-polls for new ones. A removed monitor's stored
+        feed stays readable until drained (then ``end``)."""
+        monitor_id = m["monitor_id"]
+        if not MONITOR_ID_RE.match(monitor_id):
+            return self._json(400, {"message": "Invalid monitor_id"})
+        try:
+            from_seq = int((q.get("from") or ["0"])[0])
+        except ValueError:
+            return self._json(400, {"message": "Invalid from cursor"})
+        if self.queue.get_monitor(monitor_id) is None and not (
+            self.queue.blobs.list(feed_prefix(monitor_id))
+        ):
+            return self._json(404, {"message": "Monitor not found"})
+        gen = stream_feed(
+            self.queue.blobs,
+            monitor_id,
+            from_seq=max(0, from_seq),
+            poll_s=self.cfg.monitor_feed_poll_s,
+            idle_timeout_s=self.cfg.monitor_feed_idle_timeout_s,
+            alive=lambda: self.queue.get_monitor(monitor_id) is not None,
+        )
+        return 200, gen, "application/x-ndjson"
+
     def _tenants(self, m, q, body, h):
         """Per-tenant operator surface: queue depth, jobs by state,
         admission counters (`swarm tenants`)."""
@@ -856,6 +1044,8 @@ class SwarmServer:
         return self._httpd.server_address[1]
 
     def shutdown(self) -> None:
+        if self.monitor is not None:
+            self.monitor.stop()
         REGISTRY.remove_collector(self._collector)
         self._flight_unsub()
         # zero the by-state children this server populated: the gauge is

@@ -49,6 +49,7 @@ from swarm_tpu.telemetry.journal_export import (
     QUEUE_GENERATION,
     QUEUE_RECOVERED,
 )
+from swarm_tpu.telemetry.monitor_export import MONITOR_SPECS
 
 # Queue-service metric families (process-wide; multiple in-process
 # services share them, which matches the one-service-per-server reality)
@@ -385,7 +386,17 @@ class JobQueueService:
         trace_id: Optional[str] = None,
         tenant: Optional[str] = None,
         qos: Optional[str] = None,
+        monitor_id: Optional[str] = None,
+        monitor_epoch: Optional[int] = None,
+        cached_outputs: Optional[dict] = None,
     ) -> dict:
+        """``monitor_id``/``monitor_epoch`` stamp epoch scans with
+        their provenance (extra wire fields, absent for one-shots).
+        ``cached_outputs`` maps chunk OFFSET → fleet-known output
+        bytes: those chunks complete at the gateway (output persisted,
+        record created COMPLETE) while the rest dispatch normally — the
+        partial short-circuit a 95%-unchanged monitor epoch rides
+        (docs/MONITORING.md §Cost model)."""
         module, scan_id, tenant = self.validate_scan(job_data, tenant)
         lines, batch_size, base_index = self.parse_submission(job_data)
 
@@ -402,6 +413,8 @@ class JobQueueService:
         queue_list = self._queue_list(tenant, qos)
         admitted_at = time.time()
         queued = 0
+        completed = 0
+        total = 0
         for offset, chunk in enumerate(chunk_generator(lines, batch_size)):
             chunk_index = base_index + offset
             self.blobs.put(
@@ -411,7 +424,31 @@ class JobQueueService:
                 scan_id, chunk_index, module, trace_id=trace_id,
                 tenant=tenant, qos=qos, admitted_at=admitted_at,
                 chunk_rows=len(chunk),
+                monitor_id=monitor_id, monitor_epoch=monitor_epoch,
             )
+            total += 1
+            cached = (cached_outputs or {}).get(offset)
+            if cached is not None:
+                # fleet-known chunk: output BEFORE the COMPLETE record,
+                # same ordering contract as complete_scan_from_cache
+                self.blobs.put(chunk_output_key(scan_id, chunk_index), cached)
+                job.status = JobStatus.COMPLETE
+                job.completed_at = time.time()
+                self._put_job(job)
+                self.state.rpush("completed", job.job_id)
+                _JOBS_TERMINAL.labels(status=JobStatus.COMPLETE).inc()
+                completed += 1
+                emit_event(
+                    "job.short_circuit",
+                    trace_id=trace_id,
+                    job_id=job.job_id,
+                    scan_id=scan_id,
+                    module=module,
+                    chunk_index=chunk_index,
+                    tenant=tenant,
+                    qos=qos,
+                )
+                continue
             self._put_job(job)
             self.state.rpush(queue_list, job.job_id)
             queued += 1
@@ -427,11 +464,16 @@ class JobQueueService:
                 qos=qos,
             )
         self.tracer.register_scan(
-            scan_id, trace_id, admitted_at, queued, qos=qos, tenant=tenant,
-            generation=self.generation or None,
+            scan_id, trace_id, admitted_at, total, qos=qos, tenant=tenant,
+            generation=self.generation or None, done=completed,
         )
         self._maybe_checkpoint()
-        return {"scan_id": scan_id, "chunks": queued}
+        result = {"scan_id": scan_id, "chunks": total}
+        if cached_outputs is not None:
+            # extra key only on the monitor epoch path: the one-shot
+            # submission response stays byte-identical to the reference
+            result["cached_chunks"] = completed
+        return result
 
     # orders: _journal.append < state.hset (append-before-ack, docs/DURABILITY.md)
     # blocking-ok: the WAL append + record write under _journal_lock IS
@@ -530,6 +572,129 @@ class JobQueueService:
             )
         self._maybe_checkpoint()
         return {"scan_id": scan_id, "chunks": done}
+
+    # ------------------------------------------------------------------
+    # Monitor registry (docs/MONITORING.md): standing-rescan specs are
+    # queue state — journaled like jobs, snapshot like jobs, recovered
+    # like jobs. The ticker (monitor/service.py) only READS this
+    # registry; every mutation funnels through these three methods.
+    # ------------------------------------------------------------------
+    def list_monitors(self) -> list[dict]:
+        out = []
+        for _mid, raw in sorted(self.state.hgetall("monitors").items()):
+            try:
+                out.append(json.loads(raw))
+            except ValueError:
+                continue
+        return out
+
+    def get_monitor(self, monitor_id: str) -> Optional[dict]:
+        raw = self.state.hget("monitors", monitor_id)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    def _monitor_gauge(self) -> None:
+        MONITOR_SPECS.labels().set(len(self.state.hkeys("monitors")))
+
+    # orders: _journal.append < state.hset (append-before-ack: a registered
+    # spec is never unjournaled, docs/DURABILITY.md)
+    # blocking-ok: the WAL append + registry write under _journal_lock IS
+    # the append->apply atom the durability design requires
+    def put_monitor(self, spec_wire: dict) -> None:
+        """Register/update one spec (add, pause, resume, cadence
+        advance). WRITE-AHEAD like every queue mutation: a journal
+        failure raises and the registry is untouched."""
+        monitor_id = str(spec_wire["monitor_id"])
+        payload = json.dumps(spec_wire, separators=(",", ":"))
+        if self._journal is not None:
+            with self._journal_lock:
+                self._journal.append({"op": "monitor_spec", "spec": spec_wire})  # blocking-ok: WAL append under _journal_lock is the append->apply atom (docs/DURABILITY.md)
+                self.state.hset("monitors", monitor_id, payload)
+        else:
+            self.state.hset("monitors", monitor_id, payload)
+        self._monitor_gauge()
+        self._maybe_checkpoint()
+
+    # orders: _journal.append < state.hdel (same append-before-apply atom)
+    # blocking-ok: the WAL append + registry delete under _journal_lock IS
+    # the append->apply atom the durability design requires
+    def remove_monitor(self, monitor_id: str) -> bool:
+        if self.state.hget("monitors", monitor_id) is None:
+            return False
+        if self._journal is not None:
+            with self._journal_lock:
+                self._journal.append({"op": "monitor_rm", "monitor_id": monitor_id})  # blocking-ok: WAL append under _journal_lock is the append->apply atom (docs/DURABILITY.md)
+                self.state.hdel("monitors", monitor_id)
+        else:
+            self.state.hdel("monitors", monitor_id)
+        self._monitor_gauge()
+        self._maybe_checkpoint()
+        return True
+
+    # orders: _journal.append < queue_scan (append-before-fire: the epoch
+    # advance is journaled before any job record exists, so kill-9 leaves
+    # either a fired epoch or a journaled-but-unfired one that recovery
+    # flags for a single late re-fire — never a double fire)
+    # blocking-ok: the WAL append + cadence write under _journal_lock IS
+    # the append->apply atom the durability design requires
+    def fire_monitor_epoch(
+        self,
+        spec_wire: dict,
+        scan_id: str,
+        epoch: int,
+        cached_outputs: Optional[dict] = None,
+        trace_id: Optional[str] = None,
+    ) -> dict:
+        """Advance one spec's cadence and submit its epoch scan. The
+        journaled spec update (epoch, next_fire_at, last_scan_id) and
+        the scan submission are deliberately ordered append-first: the
+        journal may claim an epoch whose scan never happened (recovery
+        re-fires it once, late, under the same scan id), but a scan can
+        never exist that the journal doesn't know about.
+
+        ``next_fire_at = now + interval`` — never ``+= k*interval`` —
+        is the fire-once-late rule for missed-while-down epochs."""
+        now = time.time()
+        spec = dict(spec_wire)
+        spec["epoch"] = int(epoch)
+        spec["last_scan_id"] = scan_id
+        spec["next_fire_at"] = now + float(spec.get("interval_s") or 0.0)
+        spec["refire"] = False
+        monitor_id = str(spec["monitor_id"])
+        payload = json.dumps(spec, separators=(",", ":"))
+        if self._journal is not None:
+            with self._journal_lock:
+                self._journal.append(
+                    {
+                        "op": "monitor_epoch",
+                        "monitor_id": monitor_id,
+                        "epoch": int(epoch),
+                        "scan_id": scan_id,
+                        "spec": spec,
+                    }
+                )  # blocking-ok: WAL append under _journal_lock is the append->apply atom (docs/DURABILITY.md)
+                self.state.hset("monitors", monitor_id, payload)
+        else:
+            self.state.hset("monitors", monitor_id, payload)
+        result = self.queue_scan(
+            {
+                "module": spec.get("module"),
+                "file_content": list(spec.get("targets") or []),
+                "batch_size": spec.get("batch_size") or 0,
+                "scan_id": scan_id,
+            },
+            trace_id=trace_id,
+            tenant=spec.get("tenant"),
+            qos=spec.get("qos"),
+            monitor_id=monitor_id,
+            monitor_epoch=int(epoch),
+            cached_outputs=cached_outputs if cached_outputs is not None else {},
+        )
+        return result
 
     # ------------------------------------------------------------------
     # Dispatch (reference get_job, server.py:465-515) + leases
@@ -1178,6 +1343,7 @@ class JobQueueService:
             self._express_streak = 0
         with self._gen_lock:
             self._jobs_generation += 1
+        self._monitor_gauge()
 
     # ------------------------------------------------------------------
     # Durable journal: recovery + checkpointing (docs/DURABILITY.md)
@@ -1199,12 +1365,19 @@ class JobQueueService:
             name: self.state.lrange(name, 0, -1)
             for name in self._queue_names()
         }
+        monitors: dict[str, Any] = {}
+        for mid, raw in self.state.hgetall("monitors").items():
+            try:
+                monitors[mid] = json.loads(raw)
+            except ValueError:
+                continue
         return {
             "jobs": jobs,
             "queues": queues,
             "tenants": self.tenants(),
             "rr_cursor": self._rr_cursor,
             "rr_cursor_x": self._rr_cursor_x,
+            "monitors": monitors,
         }
 
     # blocking-ok: the snapshot->checkpoint pair holds _journal_lock so
@@ -1252,6 +1425,7 @@ class JobQueueService:
         jobs: dict[str, Job] = {}
         order: dict[str, int] = {}
         tenants: set[str] = set()
+        monitors: dict[str, dict] = {}
         cursor = 0
         cursor_x = 0
         idx = 0
@@ -1289,12 +1463,27 @@ class JobQueueService:
                 cursor_x = int(snapshot.get("rr_cursor_x") or 0)
             except (TypeError, ValueError):
                 cursor_x = 0
+            for mid, wire in (snapshot.get("monitors") or {}).items():
+                if isinstance(wire, dict):
+                    monitors[str(mid)] = wire
         for rec in records:
             replayed += 1
             if rec.get("op") == "tenant":
                 tenant = rec.get("tenant")
                 if isinstance(tenant, str):
                     tenants.add(tenant)
+                continue
+            # monitor ops branch BEFORE the job fallback: an
+            # unrecognized op would otherwise count as a corrupt job
+            if rec.get("op") in ("monitor_spec", "monitor_epoch"):
+                wire = rec.get("spec")
+                if isinstance(wire, dict) and wire.get("monitor_id"):
+                    monitors[str(wire["monitor_id"])] = wire
+                else:
+                    JOURNAL_CORRUPT.inc()
+                continue
+            if rec.get("op") == "monitor_rm":
+                monitors.pop(str(rec.get("monitor_id") or ""), None)
                 continue
             wire = rec.get("job")
             if not isinstance(wire, dict) or not wire.get("job_id"):
@@ -1328,6 +1517,33 @@ class JobQueueService:
             self.state.lclear(name)
         for job_id in self.state.hkeys("leases"):
             self.state.hdel("leases", job_id)
+        for mid in self.state.hkeys("monitors"):
+            self.state.hdel("monitors", mid)
+
+        # monitor cadence reconciliation (docs/MONITORING.md §Crash
+        # points): a journaled epoch whose scan has no job record and no
+        # output blob died between append and fire — flag it for ONE
+        # late re-fire under its journaled scan id. Everything else
+        # resumes its cadence from the journaled next_fire_at (a spec
+        # that slept through N intervals is simply due, and the ticker's
+        # `now + interval` advance fires it once, not N times).
+        scan_ids = {j.scan_id for j in jobs.values()}
+        for mid, spec in monitors.items():
+            sid = spec.get("last_scan_id")
+            if (
+                sid
+                and int(spec.get("epoch") or 0) > 0
+                and sid not in scan_ids
+                and not self.blobs.list(f"{sid}/output/")
+            ):
+                spec = dict(spec)
+                spec["refire"] = True
+                spec["next_fire_at"] = 0.0
+                monitors[mid] = spec
+            self.state.hset(
+                "monitors", mid, json.dumps(monitors[mid], separators=(",", ":"))
+            )
+        self._monitor_gauge()
 
         grace = self.cfg.journal_recovery_grace_s or (
             self.cfg.lease_seconds / 2.0
@@ -1406,6 +1622,7 @@ class JobQueueService:
         summary = {
             "generation": self.generation,
             "replayed_records": replayed,
+            "monitors": len(monitors),
             **counts,
         }
         # re-register unfinished scans with the waterfall assembler

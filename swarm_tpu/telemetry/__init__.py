@@ -30,11 +30,11 @@ from swarm_tpu.telemetry.events import (  # noqa: F401
 )
 
 # swarm_walk_* / swarm_device_* / swarm_shard_* / swarm_memo_* /
-# swarm_gateway_* / swarm_journal_* / swarm_aot_* families register at
-# import time so every process's /metrics carries them
-# (docs/HOST_WALK.md, docs/DEVICE_MATCH.md, docs/SHARDING.md,
-# docs/CACHING.md, docs/GATEWAY.md, docs/DURABILITY.md, docs/AOT.md;
-# check_metrics contract)
+# swarm_gateway_* / swarm_journal_* / swarm_aot_* / swarm_monitor_*
+# families register at import time so every process's /metrics carries
+# them (docs/HOST_WALK.md, docs/DEVICE_MATCH.md, docs/SHARDING.md,
+# docs/CACHING.md, docs/GATEWAY.md, docs/DURABILITY.md, docs/AOT.md,
+# docs/MONITORING.md; check_metrics contract)
 from swarm_tpu.telemetry import walk_export  # noqa: E402,F401
 from swarm_tpu.telemetry import device_export  # noqa: E402,F401
 from swarm_tpu.telemetry import shard_export  # noqa: E402,F401
@@ -44,3 +44,4 @@ from swarm_tpu.telemetry import sched_export  # noqa: E402,F401
 from swarm_tpu.telemetry import journal_export  # noqa: E402,F401
 from swarm_tpu.telemetry import aot_export  # noqa: E402,F401
 from swarm_tpu.telemetry import trace_export  # noqa: E402,F401
+from swarm_tpu.telemetry import monitor_export  # noqa: E402,F401

@@ -98,6 +98,12 @@ def _fake_phase_output(phase: str) -> str:
              "vs_baseline": 1.24, "interactive_p99_ms": 3534.2,
              "bulk_retention_ratio": 1.006},
         ],
+        "monitor": [
+            {"metric": "monitor_steady_rescan_cost_ratio", "value": 0.05,
+             "unit": "ratio (steady-state dispatched chunks / first-scan "
+             "dispatched; <=0.05 acceptance, feed replay identity gated)",
+             "vs_baseline": 1.0},
+        ],
         "oracle": [
             {"metric": "cpu_oracle_rows_per_sec", "value": 12.0,
              "unit": "rows/sec", "vs_baseline": 1.0},

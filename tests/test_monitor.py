@@ -1,0 +1,546 @@
+"""Continuous monitoring subsystem (docs/MONITORING.md): diff-engine
+unit contracts, registry lifecycle over HTTP, epoch fire → diff →
+feed → provenance, paused specs, change-feed mid-stream disconnect
+resume, and kill-9 recovery — cadence resumes without double-firing
+and the feed resumes from the last-acked cursor with no duplicate or
+lost diff records."""
+
+import json
+import time
+
+import pytest
+import requests
+
+from swarm_tpu.client.cli import JobClient
+from swarm_tpu.config import Config
+from swarm_tpu.datamodel import SCAN_ID_RE, chunk_input_key, parse_scan_id
+from swarm_tpu.gateway.qoscache import (
+    build_gateway_cache,
+    split_output_segments,
+)
+from swarm_tpu.monitor import feed as mfeed
+from swarm_tpu.monitor.diff import (
+    diff_epoch,
+    encode_record,
+    extract_verdicts,
+    plane_from_records,
+)
+from swarm_tpu.monitor.spec import MonitorSpec
+from swarm_tpu.server.app import SwarmServer
+
+
+# ----------------------------------------------------------------------
+# diff engine (pure)
+# ----------------------------------------------------------------------
+def test_split_output_segments_contract():
+    # n == 1: the whole output is the segment, newline or not
+    assert split_output_segments(b"anything at all", 1) == [b"anything at all"]
+    # one line per target, trailing newline preserved per segment
+    segs = split_output_segments(b"a\nb\n", 2)
+    assert segs == [b"a\n", b"b\n"]
+    assert b"".join(segs) == b"a\nb\n"
+    # missing trailing newline on the last segment still joins exactly
+    segs = split_output_segments(b"a\nb", 2)
+    assert segs == [b"a\n", b"b"]
+    assert b"".join(segs) == b"a\nb"
+    # line-count mismatch -> not splittable (multi-line verdict module)
+    assert split_output_segments(b"a\nb\nc\n", 2) is None
+    assert split_output_segments(b"", 2) is None
+    assert split_output_segments(b"x\n", 0) is None
+
+
+def test_diff_epoch_lifecycle():
+    order = ["t1", "t2"]
+    # epoch 1: t1 finds, t2 empty (no finding on first sight -> nothing)
+    recs1, plane1 = diff_epoch("m", 1, {}, {"t1": "f1", "t2": ""}, order, 0)
+    assert [(r["kind"], r["target"], r["seq"]) for r in recs1] == [
+        ("new", "t1", 0)
+    ]
+    assert plane1 == {"t1": {"v": "f1", "fs": 1}}
+    # epoch 2: t1 changes (first_seen sticks), t2 appears
+    recs2, plane2 = diff_epoch(
+        "m", 2, plane1, {"t1": "f2", "t2": "x"}, order, 1
+    )
+    assert [(r["kind"], r["target"], r["seq"]) for r in recs2] == [
+        ("changed", "t1", 1),
+        ("new", "t2", 2),
+    ]
+    assert recs2[0]["prev"] == "f1" and recs2[0]["first_seen"] == 1
+    assert plane2["t2"] == {"v": "x", "fs": 2}
+    # epoch 3: t1 resolves, t2 unchanged emits nothing
+    recs3, plane3 = diff_epoch(
+        "m", 3, plane2, {"t1": "", "t2": "x"}, order, 3
+    )
+    assert [(r["kind"], r["target"]) for r in recs3] == [("resolved", "t1")]
+    assert "t1" not in plane3
+    # epoch 4: t2 has no output this epoch -> carries prior, no record;
+    # t1 reappears as NEW with a fresh first_seen
+    recs4, plane4 = diff_epoch("m", 4, plane3, {"t1": "f3"}, order, 4)
+    assert [(r["kind"], r["target"]) for r in recs4] == [("new", "t1")]
+    assert recs4[0]["first_seen"] == 4
+    assert plane4["t2"] == {"v": "x", "fs": 2}
+
+
+def test_diff_epoch_departed_targets_and_determinism():
+    prev = {
+        "zed": {"v": "a", "fs": 1},
+        "abc": {"v": "b", "fs": 1},
+        "kept": {"v": "c", "fs": 1},
+    }
+    recs, plane = diff_epoch("m", 2, prev, {"kept": "c2"}, ["kept"], 7)
+    # in-spec records first, departed targets resolved in lexicographic
+    # order after them; seq is seq_base + position
+    assert [(r["kind"], r["target"], r["seq"]) for r in recs] == [
+        ("changed", "kept", 7),
+        ("resolved", "abc", 8),
+        ("resolved", "zed", 9),
+    ]
+    assert set(plane) == {"kept"}
+    # byte-identical re-run: the idempotent-recovery contract
+    recs2, _ = diff_epoch("m", 2, prev, {"kept": "c2"}, ["kept"], 7)
+    assert b"".join(encode_record(r) for r in recs) == b"".join(
+        encode_record(r) for r in recs2
+    )
+
+
+def test_plane_from_records_fold_matches_final_plane():
+    plane: dict = {}
+    all_records = []
+    epochs = [
+        {"a": "1", "b": ""},
+        {"a": "2", "b": "x"},
+        {"a": "", "b": "x"},
+        {"a": "3"},
+    ]
+    for i, verdicts in enumerate(epochs, start=1):
+        recs, plane = diff_epoch(
+            "m", i, plane, verdicts, ["a", "b"], len(all_records)
+        )
+        all_records.extend(recs)
+    assert plane_from_records(all_records) == plane
+
+
+def test_extract_verdicts_per_line_and_coarse():
+    chunks = [["a", "b"], ["c"], ["d"]]
+    outputs = {0: b"va\nvb\n", 1: b"multi\nline\nout\n"}  # chunk 2 failed
+    v = extract_verdicts(chunks, outputs)
+    assert v == {"a": "va", "b": "vb", "c": "multi\nline\nout"}
+    assert "d" not in v  # no output -> no verdict -> carries prior
+
+
+def test_monitor_spec_validate_and_scan_ids():
+    spec = MonitorSpec("m-1", "echo", ["a\n"], 30.0)
+    assert spec.validate() is None
+    assert MonitorSpec("has.dots", "echo", ["a"], 30.0).validate()
+    assert MonitorSpec("m", "echo", [], 30.0).validate()
+    assert MonitorSpec("m", "echo", ["a"], 0.0).validate()
+    assert MonitorSpec("m", "", ["a"], 30.0).validate()
+    sid = spec.scan_id_for(3, now=1234.0)
+    assert SCAN_ID_RE.match(sid)
+    assert parse_scan_id(sid) == ("m-1.e3", 1234)
+    spec.next_fire_at = 100.0
+    assert not spec.due(99.0) and spec.due(100.0)
+    spec.paused = True
+    assert not spec.due(1e9)
+    # wire round trip preserves cadence state
+    spec.paused = False
+    spec.epoch, spec.last_scan_id, spec.refire = 4, sid, True
+    assert MonitorSpec.from_wire(spec.to_wire()) == spec
+
+
+# ----------------------------------------------------------------------
+# per-target gateway cache keys (satellite: re-chunk dedup)
+# ----------------------------------------------------------------------
+def test_per_target_cache_rechunk_dedup(tmp_path):
+    cfg = Config(
+        api_key="sk", blob_root=str(tmp_path / "b"),
+        doc_root=str(tmp_path / "d"), cache_backend="memory",
+    )
+    cache = build_gateway_cache(cfg)
+    assert cache is not None
+    # module name unique to this test: the in-process memory tier is
+    # process-global, and per-target keys would otherwise leak into
+    # other tests' (module, target) lookups
+    mod = "rechunkmod"
+    # splittable writeback at batch 3 serves ANY re-chunking
+    assert cache.writeback(mod, ["a", "b", "c"], b"va\nvb\nvc\n")
+    outs = cache.lookup_chunks_partial(mod, [["b"], ["c", "a"]])
+    assert outs == [b"vb\n", b"vc\nva\n"]
+    # unsplittable output keeps the whole-chunk key: per-target misses,
+    # the original chunking still hits (the migration path)
+    assert cache.writeback(mod, ["x", "y"], b"one coarse line\n")
+    outs = cache.lookup_chunks_partial(mod, [["x"], ["x", "y"]])
+    assert outs == [None, b"one coarse line\n"]
+
+
+# ----------------------------------------------------------------------
+# server integration
+# ----------------------------------------------------------------------
+AUTH = {"Authorization": "Bearer sk"}
+
+
+def _make_server(tmp_path, **kw) -> SwarmServer:
+    cfg = Config(
+        host="127.0.0.1", port=0, api_key="sk",
+        blob_root=str(tmp_path / "blobs"), doc_root=str(tmp_path / "docs"),
+        monitor_tick_s=3600.0,  # parked: tests drive tick()/drain()
+        monitor_feed_poll_s=0.01,
+        monitor_feed_idle_timeout_s=1.0,
+        **kw,
+    )
+    srv = SwarmServer(cfg)
+    srv.start_background()
+    return srv
+
+
+def _register(srv, monitor_id, targets, module="monmod", interval_s=3600.0,
+              batch_size=1, **extra):
+    return requests.post(
+        f"http://127.0.0.1:{srv.port}/monitor",
+        json={
+            "monitor_id": monitor_id, "module": module, "targets": targets,
+            "interval_s": interval_s, "batch_size": batch_size, **extra,
+        },
+        headers=AUTH, timeout=10,
+    )
+
+
+def _pump(srv, out_line, worker="w", limit=64) -> int:
+    """Drain the dispatch queue through the real HTTP worker surface,
+    one content-derived verdict line per input line."""
+    base = f"http://127.0.0.1:{srv.port}"
+    done = 0
+    for _ in range(limit):
+        r = requests.get(
+            base + "/get-job", params={"worker_id": worker},
+            headers=AUTH, timeout=10,
+        )
+        if r.status_code != 200:
+            break
+        job = r.json()
+        sid, idx = job["scan_id"], int(job["chunk_index"])
+        raw = srv.queue.blobs.get(chunk_input_key(sid, idx)).decode()
+        out = "".join(out_line(line) for line in raw.split("\n"))
+        requests.post(
+            base + f"/put-output-chunk/{sid}/{idx}",
+            data=out.encode(), headers=AUTH, timeout=10,
+        )
+        requests.post(
+            base + f"/update-job/{job['job_id']}",
+            json={"status": "complete"}, headers=AUTH, timeout=10,
+        )
+        done += 1
+    return done
+
+
+def _fire_epoch(srv, out_line=lambda ln: f"v:{ln}\n", deadline_s=20.0) -> int:
+    """tick (forced due) -> pump workers -> drain until the epoch's
+    diff commits. Returns fired count from the tick."""
+    fired = srv.monitor.tick(now=time.time() + 1e6)
+    _pump(srv, out_line)
+    end = time.time() + deadline_s
+    while time.time() < end:
+        if srv.monitor.drain() > 0:
+            return fired
+        time.sleep(0.02)
+    raise AssertionError("epoch diff did not commit before deadline")
+
+
+def _feed_lines(srv, monitor_id, from_seq=0):
+    """Collect (records, terminal control event) over the raw wire."""
+    resp = requests.get(
+        f"http://127.0.0.1:{srv.port}/monitor-feed/{monitor_id}",
+        params={"from": from_seq}, headers=AUTH, stream=True, timeout=30,
+    )
+    records, control = [], None
+    for line in resp.iter_lines():
+        rec = json.loads(line)
+        if "event" in rec:
+            control = rec
+            break
+        records.append(rec)
+    resp.close()
+    return records, control
+
+
+def test_monitor_registry_lifecycle_http(tmp_path):
+    srv = _make_server(tmp_path)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        # generated id on register without one
+        r = requests.post(
+            base + "/monitor",
+            json={"module": "echo", "targets": ["a\n"], "interval_s": 60},
+            headers=AUTH, timeout=10,
+        )
+        assert r.status_code == 200 and r.json()["monitor_id"]
+        # malformed specs are rejected
+        assert _register(srv, "bad", [], interval_s=60).status_code == 400
+        assert _register(srv, "bad", ["a\n"], interval_s=0).status_code == 400
+        assert _register(srv, "no.dots", ["a\n"]).status_code == 400
+        # explicit register + list
+        assert _register(srv, "m1", ["a\n", "b\n"]).status_code == 200
+        mons = requests.get(
+            base + "/monitor", headers=AUTH, timeout=10
+        ).json()["monitors"]
+        m1 = next(m for m in mons if m["monitor_id"] == "m1")
+        assert m1["targets"] == ["a\n", "b\n"] and not m1["paused"]
+        # pause / resume / rm
+        for op, paused in (("pause", True), ("resume", False)):
+            r = requests.post(
+                base + "/monitor/m1", json={"op": op},
+                headers=AUTH, timeout=10,
+            )
+            assert r.status_code == 200 and r.json()["paused"] is paused
+        r = requests.post(
+            base + "/monitor/m1", json={"op": "sideways"},
+            headers=AUTH, timeout=10,
+        )
+        assert r.status_code == 400
+        assert requests.post(
+            base + "/monitor/m1", json={"op": "rm"}, headers=AUTH, timeout=10
+        ).status_code == 200
+        assert requests.post(
+            base + "/monitor/m1", json={"op": "rm"}, headers=AUTH, timeout=10
+        ).status_code == 404
+        # feed of a never-seen monitor is a 404, not an empty stream
+        assert requests.get(
+            base + "/monitor-feed/ghost", headers=AUTH, timeout=10
+        ).status_code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_epoch_fire_diff_feed_and_provenance(tmp_path):
+    srv = _make_server(tmp_path)
+    try:
+        assert _register(srv, "m1", ["a\n", "b\n", "c\n"]).status_code == 200
+        assert _fire_epoch(srv) == 1
+        records, _ = _feed_lines(srv, "m1")
+        assert [(r["kind"], r["target"], r["seq"]) for r in records] == [
+            ("new", "a", 0), ("new", "b", 1), ("new", "c", 2),
+        ]
+        assert records[0]["verdict"] == "v:a" and records[0]["epoch"] == 1
+        assert mfeed.marked_epochs(srv.queue.blobs, "m1") == [1]
+        # epoch 2: only b's verdict changes -> exactly one record
+        assert _fire_epoch(
+            srv, lambda ln: (f"v2:{ln}\n" if ln == "b" else f"v:{ln}\n")
+        ) == 1
+        records, _ = _feed_lines(srv, "m1")
+        assert [(r["kind"], r["target"], r["seq"]) for r in records[3:]] == [
+            ("changed", "b", 3)
+        ]
+        assert records[3]["prev"] == "v:b" and records[3]["first_seen"] == 1
+        # provenance: both epoch scans carry monitor_id/epoch through
+        # /get-statuses (the `swarm scans` Monitor column)
+        scans = requests.get(
+            f"http://127.0.0.1:{srv.port}/get-statuses",
+            headers=AUTH, timeout=10,
+        ).json()["scans"]
+        by_epoch = {
+            s["monitor_epoch"]: s for s in scans
+            if s.get("monitor_id") == "m1"
+        }
+        assert set(by_epoch) == {1, 2}
+        assert all(s["scan_status"] == "complete" for s in by_epoch.values())
+    finally:
+        srv.shutdown()
+
+
+def test_paused_monitor_emits_nothing(tmp_path):
+    srv = _make_server(tmp_path)
+    try:
+        assert _register(srv, "m1", ["a\n"], paused=True).status_code == 200
+        assert srv.monitor.tick(now=time.time() + 1e6) == 0
+        assert srv.queue.blobs.list(mfeed.feed_prefix("m1")) == []
+        assert mfeed.marked_epochs(srv.queue.blobs, "m1") == []
+        spec = srv.queue.get_monitor("m1")
+        assert spec["epoch"] == 0 and spec["last_scan_id"] is None
+        # resume makes it due again
+        requests.post(
+            f"http://127.0.0.1:{srv.port}/monitor/m1",
+            json={"op": "resume"}, headers=AUTH, timeout=10,
+        )
+        assert _fire_epoch(srv) == 1
+        assert mfeed.marked_epochs(srv.queue.blobs, "m1") == [1]
+    finally:
+        srv.shutdown()
+
+
+def test_feed_mid_stream_disconnect_resumes_without_dups(tmp_path):
+    srv = _make_server(tmp_path)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        assert _register(srv, "m1", [f"t{i}\n" for i in range(4)]).status_code == 200
+        assert _fire_epoch(srv) == 1
+        # consume exactly 2 records over the raw wire, then sever
+        resp = requests.get(
+            base + "/monitor-feed/m1", headers=AUTH, stream=True, timeout=10
+        )
+        acked = []
+        for line in resp.iter_lines():
+            acked.append(json.loads(line))
+            if len(acked) == 2:
+                break
+        resp.close()
+        assert [r["seq"] for r in acked] == [0, 1]
+        # client resume from the cursor: remaining records, no dups
+        client = JobClient(base, "sk")
+        resumed = []
+        for rec in client.monitor_feed("m1", from_seq=acked[-1]["seq"] + 1):
+            resumed.append(rec)
+            if len(resumed) == 2:
+                break
+        assert [r["seq"] for r in resumed] == [2, 3]
+        assert [r["target"] for r in acked + resumed] == [
+            "t0", "t1", "t2", "t3"
+        ]
+        # removed monitor: the stored feed stays readable until drained,
+        # then the stream ENDS instead of long-polling
+        requests.post(
+            base + "/monitor/m1", json={"op": "rm"}, headers=AUTH, timeout=10
+        )
+        records, control = _feed_lines(srv, "m1")
+        assert len(records) == 4
+        assert control == {"event": "end", "next_seq": 4}
+        # and the client generator terminates on its own
+        assert [r["seq"] for r in client.monitor_feed("m1")] == [0, 1, 2, 3]
+    finally:
+        srv.shutdown()
+
+
+def test_kill9_mid_epoch_resumes_cadence_and_feed(tmp_path):
+    """Server dies (no shutdown — fresh process over the same durable
+    stores) after epoch 2 fired and ONE of three chunks completed: the
+    journal resumes the cadence without double-firing, the interrupted
+    epoch completes exactly once, and a feed client resumes from its
+    last-acked cursor with no duplicate or lost records."""
+    srv = _make_server(tmp_path)
+    epoch2 = lambda ln: f"v2:{ln}\n"
+    try:
+        assert _register(srv, "m1", ["a\n", "b\n", "c\n"]).status_code == 200
+        assert _fire_epoch(srv) == 1  # epoch 1 commits: records 0..2
+        records, _ = _feed_lines(srv, "m1")
+        cursor = records[-1]["seq"] + 1
+        assert cursor == 3
+        # epoch 2 fires; only one chunk lands before the crash
+        assert srv.monitor.tick(now=time.time() + 1e6) == 1
+        assert _pump(srv, epoch2, limit=1) == 1
+        spec_before = srv.queue.get_monitor("m1")
+        assert spec_before["epoch"] == 2
+    finally:
+        pass  # kill-9: deliberately NO shutdown
+    srv2 = _make_server(tmp_path)
+    try:
+        # recovered spec: same epoch, same scan id, NOT flagged refire
+        # (the scan materialized) — and not due, so no double fire
+        spec = srv2.queue.get_monitor("m1")
+        assert spec["epoch"] == 2
+        assert spec["last_scan_id"] == spec_before["last_scan_id"]
+        assert not spec["refire"]
+        assert srv2.monitor.tick(now=time.time()) == 0
+        # the interrupted epoch is pending on the new server: complete
+        # the remaining chunks and drain
+        assert _pump(srv2, epoch2) == 2
+        end = time.time() + 20
+        while srv2.monitor.drain() == 0 and time.time() < end:
+            time.sleep(0.02)
+        assert mfeed.marked_epochs(srv2.queue.blobs, "m1") == [1, 2]
+        # exactly-once records with contiguous seqs across the crash
+        records, _ = _feed_lines(srv2, "m1")
+        assert [r["seq"] for r in records] == list(range(6))
+        assert [(r["kind"], r["target"]) for r in records[3:]] == [
+            ("changed", "a"), ("changed", "b"), ("changed", "c"),
+        ]
+        # feed resume from the pre-crash cursor sees only epoch 2
+        resumed, control = _feed_lines(srv2, "m1", from_seq=cursor)
+        assert [r["seq"] for r in resumed] == [3, 4, 5]
+        assert control == {"event": "timeout", "next_seq": 6}
+        # cadence continues: the NEXT tick fires epoch 3, once
+        assert _fire_epoch(srv2, epoch2) == 1
+        assert srv2.queue.get_monitor("m1")["epoch"] == 3
+        assert mfeed.marked_epochs(srv2.queue.blobs, "m1") == [1, 2, 3]
+        records, _ = _feed_lines(srv2, "m1")
+        assert len(records) == 6  # unchanged epoch emits no records
+    finally:
+        srv2.shutdown()
+        srv.shutdown()
+
+
+def test_kill9_between_journal_and_fire_refires_same_epoch(tmp_path):
+    """Crash between the journaled epoch advance and the scan submit:
+    recovery flags the spec for ONE late re-fire of the SAME epoch
+    under the SAME scan id."""
+    srv = _make_server(tmp_path)
+    try:
+        assert _register(srv, "m1", ["a\n", "b\n"]).status_code == 200
+        boom = RuntimeError("crashed before fire")
+        srv.queue.queue_scan = lambda *a, **kw: (_ for _ in ()).throw(boom)
+        assert srv.monitor.tick(now=time.time() + 1e6) == 0  # fire failed
+        spec = srv.queue.get_monitor("m1")
+        assert spec["epoch"] == 1 and spec["last_scan_id"]
+        sid = spec["last_scan_id"]
+    finally:
+        pass  # kill-9
+    srv2 = _make_server(tmp_path)
+    try:
+        spec = srv2.queue.get_monitor("m1")
+        assert spec["refire"] and spec["next_fire_at"] == 0.0
+        assert spec["epoch"] == 1 and spec["last_scan_id"] == sid
+        # re-fires immediately (due now), same epoch + scan id
+        assert srv2.monitor.tick(now=time.time()) == 1
+        spec = srv2.queue.get_monitor("m1")
+        assert spec["epoch"] == 1 and spec["last_scan_id"] == sid
+        assert not spec["refire"]
+        _pump(srv2, lambda ln: f"v:{ln}\n")
+        end = time.time() + 20
+        while srv2.monitor.drain() == 0 and time.time() < end:
+            time.sleep(0.02)
+        assert mfeed.marked_epochs(srv2.queue.blobs, "m1") == [1]
+        records, _ = _feed_lines(srv2, "m1")
+        assert [(r["kind"], r["target"], r["epoch"]) for r in records] == [
+            ("new", "a", 1), ("new", "b", 1),
+        ]
+    finally:
+        srv2.shutdown()
+        srv.shutdown()
+
+
+def test_steady_state_epoch_is_zero_dispatch(tmp_path):
+    """With the gateway cache on, an unchanged fleet's second epoch
+    completes entirely from per-target cache entries written back by
+    epoch 1 — no worker lease at all — and emits no diff records."""
+    srv = _make_server(
+        tmp_path, cache_backend="memory", qos_cache_max_rows=8
+    )
+    try:
+        assert _register(srv, "m1", [f"t{i}\n" for i in range(4)]).status_code == 200
+        assert _fire_epoch(srv) == 1  # epoch 1: real dispatch + writeback
+        assert srv.monitor.tick(now=time.time() + 1e6) == 1
+        # nothing to lease: every chunk short-circuited from the cache
+        r = requests.get(
+            f"http://127.0.0.1:{srv.port}/get-job",
+            params={"worker_id": "w"}, headers=AUTH, timeout=10,
+        )
+        assert r.status_code != 200
+        end = time.time() + 20
+        while srv.monitor.drain() == 0 and time.time() < end:
+            time.sleep(0.02)
+        assert mfeed.marked_epochs(srv.queue.blobs, "m1") == [1, 2]
+        records, _ = _feed_lines(srv, "m1")
+        assert len(records) == 4  # epoch 2 added nothing
+        assert json.loads(
+            srv.queue.blobs.get(mfeed.mark_key("m1", 2))
+        )["records"] == 0
+        # the cached epoch still reads complete with provenance
+        scans = requests.get(
+            f"http://127.0.0.1:{srv.port}/get-statuses",
+            headers=AUTH, timeout=10,
+        ).json()["scans"]
+        e2 = next(
+            s for s in scans
+            if s.get("monitor_id") == "m1" and s.get("monitor_epoch") == 2
+        )
+        assert e2["scan_status"] == "complete"
+    finally:
+        srv.shutdown()

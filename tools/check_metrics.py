@@ -111,6 +111,14 @@ REQUIRED_FAMILIES = (
     "swarm_trace_spans_dropped_total",
     "swarm_trace_assembled_total",
     "swarm_trace_flight_dumps_total",
+    # continuous monitoring (docs/MONITORING.md): registered at
+    # telemetry import (monitor_export), diff-record kind combos
+    # pre-seeded and the gauges zero-initialized — every family
+    # renders samples even on a server that never saw a monitor spec
+    "swarm_monitor_epochs_fired_total",
+    "swarm_monitor_diff_records_total",
+    "swarm_monitor_rescan_cache_hit_ratio",
+    "swarm_monitor_standing_specs",
 )
 
 
