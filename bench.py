@@ -1433,14 +1433,14 @@ def bench_sharded_serving(db) -> dict:
             if best_data is None or rate > best_data[1]:
                 best_data = (shape[0], rate)
 
-    # weak scaling (ROADMAP item 4's bench ask): FIXED rows per rank,
-    # growing R — the strong-scaling ladder above holds total rows
-    # constant so per-rank batches shrink with R, which conflates
-    # sharding overhead with small-batch inefficiency; this sweep
-    # keeps every rank's batch at the per-rank sweet spot, so the
-    # R=8 falloff (MULTICHIP_r06) is attributable to collectives/
-    # placement alone and regressions show on the host-platform mesh
-    # before TPU time is spent.
+    # weak scaling: FIXED rows per data rank, growing R — the
+    # strong-scaling ladder above holds total rows constant so
+    # per-rank batches shrink with R, which conflates sharding
+    # overhead with small-batch inefficiency; this sweep keeps every
+    # rank's batch at the per-rank sweet spot, so any falloff is
+    # attributable to collectives/placement alone and regressions
+    # show on the host-platform mesh before TPU time is spent. The
+    # per-shape table below is what tools/shard_floor.json pins.
     rows_per_rank = max(256, ROWS // 4)
     weak: dict = {"rows_per_rank": rows_per_rank, "per_mesh": {}}
     base_rows = realistic_rows(rows_per_rank, seed=29)
@@ -1461,22 +1461,30 @@ def bench_sharded_serving(db) -> dict:
     weak["single_device_rows_per_sec"] = round(rate_1w, 1)
     basis = ""
     for shape in _shard_shapes(n_dev):
-        if shape[1] > 1 or shape[2] > 1:
-            continue  # the weak sweep is the data axis story
+        # rows scale with the DATA axis only: model/seq ranks partition
+        # the candidate space / stream width, not the batch, so fixed
+        # rows-per-data-rank is the weak-scaling contract on every
+        # shape — the (2,2,2) entry isolates the halo+psum cost the
+        # fused single-round exchange is supposed to keep flat
         R = shape[0]
         wrows = realistic_rows(rows_per_rank * R, seed=29)
         wbatch = encode_batch(
             wrows, max_body=MAX_BODY, max_header=MAX_HEADER,
             pad_rows_to=rows_per_rank * R, width_multiple=512,
         )
+        wstreams = dict(wbatch.streams)
+        if shape[2] > 1:
+            wstreams = {k: v.copy() for k, v in wstreams.items()}
+            pad_streams_for_seq(wstreams, shape[2], max_entry_len(db))
         matcher = ShardedMatcher(db, make_mesh(shape))
         wrate = (
             serve_rate(
-                matcher, wbatch.streams, wbatch.lengths, wbatch.status
+                matcher, wstreams, wbatch.lengths, wbatch.status
             )
             * (rows_per_rank * R)
             / ROWS
         )
+        n_chips = shape[0] * shape[1] * shape[2]
         if platform == "cpu":
             # shared silicon: R ranks x fixed work per rank is R x the
             # total work, so rate parity with 1 device is ideal — the
@@ -1484,8 +1492,8 @@ def bench_sharded_serving(db) -> dict:
             eff = wrate / max(rate_1w, 1e-9)
             basis = "host-platform (rate_R / rate_1)"
         else:
-            eff = wrate / max(R * rate_1w, 1e-9)
-            basis = "per-chip (rate_R / (R*rate_1))"
+            eff = wrate / max(n_chips * rate_1w, 1e-9)
+            basis = "per-chip (rate_R / (n_chips*rate_1))"
         key = "x".join(str(d) for d in shape)
         weak["per_mesh"][key] = {
             "rows": rows_per_rank * R,
@@ -1522,16 +1530,111 @@ def bench_sharded_serving(db) -> dict:
 
 
 def _write_multichip(record: dict) -> str:
-    """MULTICHIP_r06.json: the measured pod-scale serving record the
-    ROADMAP tracks (SWARM_MULTICHIP_OUT overrides the path)."""
+    """MULTICHIP_r07.json: the measured pod-scale serving record the
+    ROADMAP tracks (SWARM_MULTICHIP_OUT overrides the path). r07 adds
+    the full per-shape weak-scaling efficiency table (every
+    ``_shard_shapes`` shape, 3-axis meshes included) measured on the
+    overlapped split-phase path."""
     out = os.environ.get("SWARM_MULTICHIP_OUT", "") or str(
-        Path(__file__).parent / "MULTICHIP_r06.json"
+        Path(__file__).parent / "MULTICHIP_r07.json"
     )
     with open(out, "w") as fh:
         json.dump(record, fh, indent=2)
         fh.write("\n")
     log(f"sharded phase: record written to {out}")
     return out
+
+
+#: recorded weak-scaling efficiency floors for the sharded serving
+#: phase (tools/preflight.sh gate; same skip/factor conventions as
+#: tools/device_floor.json and tools/walk_floor.json)
+_SHARD_FLOOR_PATH = Path(__file__).parent / "tools" / "shard_floor.json"
+
+
+def _shard_floor_config(record: dict) -> dict:
+    """The measurement basis a recorded shard floor is only comparable
+    under — any mismatch downgrades the check to a skip, exactly like
+    tools/profile_device.py's gate."""
+    return {
+        "platform": record.get("platform"),
+        "n_devices": record.get("n_devices"),
+        "rows": record.get("rows"),
+        "templates": record.get("templates"),
+        "rows_per_rank": (record.get("weak_scaling") or {}).get(
+            "rows_per_rank"
+        ),
+    }
+
+
+def _shard_floor_record(record: dict) -> int:
+    weak = (record.get("weak_scaling") or {}).get("per_mesh") or {}
+    if not weak:
+        log("shard floor: no weak-scaling table to record; skipping")
+        return 0
+    rec = dict(_shard_floor_config(record))
+    rec["weak_efficiency"] = {
+        key: entry["efficiency"] for key, entry in weak.items()
+    }
+    _SHARD_FLOOR_PATH.write_text(json.dumps(rec, indent=2) + "\n")
+    log(f"shard floor recorded: {rec} -> {_SHARD_FLOOR_PATH}")
+    return 0
+
+
+def _shard_floor_check(record: dict) -> int:
+    """Gate the weak-scaling efficiency table against the recorded
+    per-mesh-shape floors. Efficiency is higher-better, so a shape
+    fails when its current figure drops below floor/SWARM_FLOOR_FACTOR
+    (default 2.0); a shape recorded in the floor but absent from the
+    sweep also fails — silently shrinking coverage is a regression."""
+    if os.environ.get("SWARM_FLOOR_SKIP") == "1":
+        log("shard floor check skipped (SWARM_FLOOR_SKIP=1)")
+        return 0
+    if not _SHARD_FLOOR_PATH.exists():
+        log(
+            f"no recorded shard floor at {_SHARD_FLOOR_PATH}; "
+            "run --record-floor"
+        )
+        return 0  # missing floor is not a failure — first run records
+    floor = json.loads(_SHARD_FLOOR_PATH.read_text())
+    current = _shard_floor_config(record)
+    mismatched = {
+        k: (floor.get(k), v)
+        for k, v in current.items()
+        if floor.get(k) != v
+    }
+    if mismatched:
+        log(
+            "shard floor check skipped: recorded floor does not match "
+            f"this configuration ({mismatched}); re-record with "
+            "--record-floor"
+        )
+        return 0
+    factor = float(os.environ.get("SWARM_FLOOR_FACTOR", "2.0"))
+    weak = (record.get("weak_scaling") or {}).get("per_mesh") or {}
+    rc = 0
+    for key, floor_eff in sorted(
+        (floor.get("weak_efficiency") or {}).items()
+    ):
+        cur = (weak.get(key) or {}).get("efficiency")
+        if cur is None:
+            log(
+                f"FLOOR REGRESSION: mesh {key} missing from the weak "
+                f"sweep (floor efficiency {floor_eff})"
+            )
+            rc = 1
+            continue
+        if cur < floor_eff / factor:
+            log(
+                f"FLOOR REGRESSION: mesh {key} weak-scaling efficiency "
+                f"{cur:.3f} < recorded floor {floor_eff:.3f} / {factor}"
+            )
+            rc = 1
+        else:
+            log(
+                f"shard floor ok: mesh {key} efficiency {cur:.3f} >= "
+                f"{floor_eff:.3f} / {factor}"
+            )
+    return rc
 
 
 def _percentile_ms(vals: list, p: float) -> float:
@@ -2328,6 +2431,14 @@ def run_phase(phase: str) -> int:
             # correctness bug, not a throughput datapoint
             log("!!! sharded serving planes MISMATCH — phase FAILED")
             return 1
+        # regression gate (tools/shard_floor.json): --record-floor
+        # pins the weak-scaling efficiency table, --check-floor fails
+        # the phase when any recorded shape regresses past
+        # SWARM_FLOOR_FACTOR (tools/preflight.sh runs the check)
+        if "--record-floor" in sys.argv:
+            return _shard_floor_record(rec)
+        if "--check-floor" in sys.argv:
+            return _shard_floor_check(rec)
     elif phase == "aot":
         # AOT cold-start A/B (docs/AOT.md): fresh-process fetch-vs-
         # compile bring-up over a file-backed artifact store, paired

@@ -208,6 +208,12 @@ class _StagingPool:
     def __init__(self):
         self.uploads = 0  # guarded-by: _lock
         self.bytes = 0  # guarded-by: _lock
+        #: rank planes held by not-yet-launched deferred reductions
+        #: (the sharded matcher's double-buffered overlap parks the
+        #: per-rank bit planes in a _PendingShard between dispatches —
+        #: an extra in-flight plane the pool budget must see)
+        self.plane_holds = 0  # guarded-by: _lock
+        self.plane_bytes = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def stage(self, streams: dict, lengths: dict, status):
@@ -232,6 +238,18 @@ class _StagingPool:
         with self._lock:
             self.uploads += 1
             self.bytes += n_bytes
+
+    def hold_plane(self, n_bytes: int) -> None:
+        """One deferred reduction parked its rank planes (device bytes
+        that stay live past their dispatch until the launch flushes)."""
+        with self._lock:
+            self.plane_holds += 1
+            self.plane_bytes += int(n_bytes)
+
+    def release_plane(self, n_bytes: int) -> None:
+        with self._lock:
+            self.plane_holds -= 1
+            self.plane_bytes -= int(n_bytes)
 
 
 class DeviceDB:

@@ -17,18 +17,39 @@ droplets; this scales by sharding one batch across a TPU slice):
 The verdict stage runs replicated on every (model, seq) rank after the
 psum — it is tiny next to the probe stage.
 
-Production dispatch is SPLIT-PHASE with survivor compaction, the mesh
-twin of ``DeviceDB.dispatch`` (docs/SHARDING.md, docs/DEVICE_MATCH.md):
-a standing phase-A executable runs every rank's stacked bloom probe
-into a survivor RANK plane, ``pmax``-reduces the batch's max survivor
-count across the whole mesh, and the host reads back that ONE 4-byte
-scalar to pick phase B's ladder width (``compile.survivor_bucket``);
-phase B extracts/verifies at survivor size, psums the bit planes, and
-runs the replicated verdict tail. Per-batch uploads go through the
-dispatch staging pool and are DONATED to phase B together with the
-inter-phase rank plane; the fused single-kernel pjit step is kept as
-the bit-identical reference twin (``SWARM_SHARD_COMPACT=0`` /
-``SWARM_SHARD_DONATE=0``, or the ``compact=``/``donate=`` args).
+Production dispatch is SPLIT-PHASE with survivor compaction and
+OVERLAPPED reduction, the mesh twin of ``DeviceDB.dispatch``
+(docs/SHARDING.md, docs/DEVICE_MATCH.md), as three executables:
+
+- **phase A** runs every rank's stacked bloom probe into a survivor
+  RANK plane. On seq meshes the halo ``ppermute`` is FUSED into this
+  probe and the extended ``[B, W + 2·halo]`` stream views are carried
+  forward — phase B never re-exchanges, so a seq batch pays ONE halo
+  round, not two. Each rank also emits its own clamped max-survivor
+  count; the host reads the tiny per-rank vector (R × 4 bytes, no
+  cross-rank collective) and maxes it to pick phase B's ladder width
+  (``compile.survivor_bucket``). Multi-process meshes keep the
+  ``pmax``'d replicated scalar (a host can only read its own shard).
+- **phase B probe** extracts/verifies at survivor size and stops at
+  the per-rank bit planes — no psum, no verdict tail. One wrapper per
+  ladder rung serves every width bucket of the shape class (the cache
+  key is the stream NAMES, not shapes), so live rung executables stay
+  bounded per mesh shape and AOT-store fetches cover each width.
+- **reduction** (psum + replicated verdict tail + fused-plane pack)
+  is dispatched SEPARATELY and DEFERRED: ``dispatch`` returns a
+  handle holding the launch thunk, and the next ``dispatch`` flushes
+  it right after its own phase A enqueues — batch N's cross-rank
+  reduction rides the device behind phase A of batch N+1, so the
+  host's between-phase read never waits on the previous batch's
+  collectives. ``collect`` forces the handle if no later dispatch
+  already did. One reduction executable serves EVERY ladder rung.
+
+Per-batch uploads go through the dispatch staging pool and are
+DONATED to their last consumer together with the inter-phase rank
+planes; the fused single-kernel pjit step is kept as the bit-identical
+reference twin (``SWARM_SHARD_COMPACT=0`` / ``SWARM_SHARD_DONATE=0``,
+or the ``compact=``/``donate=`` args; ``SWARM_SHARD_OVERLAP=0`` keeps
+the split kernels but launches the reduction inline).
 ``dispatch``/``collect`` split the blocking host read out of the
 launch, so the continuous-batching scheduler keeps ≥2 mesh batches in
 flight exactly as on the single-device path.
@@ -231,19 +252,87 @@ def _shard_metrics():
     return _SHARD_METRICS
 
 
+class _PendingShard:
+    """One compacted batch's DEFERRED cross-rank reduction (psum +
+    verdict tail), double-buffered behind the next batch's phase A.
+
+    ``dispatch`` returns this handle with the reduction un-launched;
+    whoever needs it next fires it exactly once:
+
+    - the NEXT ``dispatch`` flushes it right after its own phase A
+      enqueues (``launched_by == "dispatch"`` — the overlapped case);
+    - otherwise ``collect``/``match`` force it (``"collect"``);
+    - ``SWARM_SHARD_OVERLAP=0`` and multi-process meshes launch inline
+      before ``dispatch`` returns (``"inline"``);
+    - a corpus ``refresh`` drains any straggler (``"refresh"``).
+
+    A launch failure is stored and re-raised at ``force`` so the error
+    surfaces on the batch that owns it, not on the innocent batch whose
+    dispatch happened to flush the buffer. The held rank planes are
+    accounted in the staging pool (``hold_plane``/``release_plane``)
+    while the reduction is in flight.
+    """
+
+    __slots__ = (
+        "_matcher", "_thunk", "_lock", "_out", "_exc", "_done",
+        "_held_bytes", "launched_by",
+    )
+
+    def __init__(self, matcher, thunk, held_bytes: int):
+        self._matcher = matcher
+        self._thunk = thunk
+        self._lock = threading.Lock()
+        self._out = None
+        self._exc = None
+        self._done = False
+        self._held_bytes = int(held_bytes)
+        self.launched_by: Optional[str] = None
+        matcher.staging.hold_plane(self._held_bytes)
+
+    def launch(self, by: str) -> None:
+        """Fire the reduction thunk exactly once (idempotent; safe from
+        the submit thread, the walk worker, and collect concurrently)."""
+        with self._lock:
+            if self._done:
+                return
+            try:
+                self._out = self._thunk()
+            except BaseException as e:  # surfaced at force()
+                self._exc = e
+            finally:
+                self._done = True
+                self._thunk = None
+                self.launched_by = by
+                self._matcher.staging.release_plane(self._held_bytes)
+                self._matcher._clear_pending(self)
+
+    def force(self):
+        """Launch if nothing did yet, then yield the (device-resident)
+        reduction output — or re-raise the launch failure."""
+        self.launch("collect")
+        if self._exc is not None:
+            raise self._exc
+        return self._out
+
+
 @dataclasses.dataclass
 class ShardedMatcher:
     """Builds and caches the pjit'd sharded match step for one mesh.
 
     Serving surface (docs/SHARDING.md): :meth:`dispatch` launches the
     split-phase compacted kernels asynchronously (the only blocking
-    point is the 4-byte pmax'd max-survivor scalar between phases);
-    :meth:`collect` pays the one fused host read. ``MatchEngine.
-    begin_packed``/``finish_packed`` route here exactly as they do to
-    ``DeviceDB``, so the scheduler's in-flight budget and walk offload
-    apply unchanged on the mesh. The fused single-kernel step stays as
-    the bit-identical reference twin (``compact=False``), and
-    ``donate=False`` keeps the staged uploads alive past the launch.
+    point is the tiny per-rank max-survivor read between phases) and
+    returns a :class:`_PendingShard` whose cross-rank reduction stays
+    un-launched until the next dispatch's phase A is in the queue;
+    :meth:`collect` forces the handle and pays the one fused host
+    read. ``MatchEngine.begin_packed``/``finish_packed`` route here
+    exactly as they do to ``DeviceDB``, so the scheduler's in-flight
+    budget and walk offload apply unchanged on the mesh. The fused
+    single-kernel step stays as the bit-identical reference twin
+    (``compact=False``); ``donate=False`` keeps the staged uploads
+    alive past the launch; ``overlap=False`` launches the reduction
+    inline (multi-process meshes always do — deferred collective
+    launch order must stay identical on every process).
     """
 
     db: fpc.CompiledDB
@@ -251,12 +340,15 @@ class ShardedMatcher:
     candidate_k: int = 128
     compact: Optional[bool] = None
     donate: Optional[bool] = None
+    overlap: Optional[bool] = None
 
     def __post_init__(self):
         if self.compact is None:
             self.compact = _env_flag("SWARM_SHARD_COMPACT", True)
         if self.donate is None:
             self.donate = _env_flag("SWARM_SHARD_DONATE", True)
+        if self.overlap is None:
+            self.overlap = _env_flag("SWARM_SHARD_OVERLAP", True)
         self.staging = _StagingPool()
         self.compile_seconds = 0.0  # guarded-by: _counter_lock
         self.compile_count = 0  # guarded-by: _counter_lock
@@ -296,6 +388,14 @@ class ShardedMatcher:
             d.process_index != jax.process_index()
             for d in self.mesh.devices.flat
         )
+        # deferred reduction launch order is host-controlled; on a
+        # multi-controller mesh every process MUST enqueue collectives
+        # in the same order, so overlap stays single-controller-only
+        self.overlap = bool(self.overlap) and not self.multiprocess
+        #: the one un-launched deferred reduction (double buffer depth
+        #: 1: each dispatch flushes its predecessor before parking its
+        #: own handle)
+        self._pending: Optional[_PendingShard] = None  # guarded-by: _counter_lock
         # constant after construction — upload once, not per match call
         if self.multiprocess:
             self._tab_j = {
@@ -340,12 +440,17 @@ class ShardedMatcher:
     def attach_aot(self, client) -> None:
         """Attach an :class:`~swarm_tpu.aot.AotClient` so every
         subsequently built mesh step fetches published executables
-        before compiling. Multi-process meshes stay compile-only (an
-        executable image is only loadable on the topology it was
-        compiled for, and cross-host coordination of the load is not
-        worth the coupling — the per-host persistent XLA cache already
-        covers that deployment). Live wrappers drop so the attach
-        takes effect at the next dispatch."""
+        before compiling. Single-controller multi-device meshes fetch
+        exactly like the single-device path — the store digest already
+        keys on device count + XLA flags and the trace salt keys on
+        the mesh factorization, so every ladder rung of every mesh
+        shape loads instead of compiling. ONLY multi-controller
+        (jax.distributed) meshes stay compile-only: an executable
+        image is only loadable on the topology it was compiled for,
+        and cross-host coordination of the load is not worth the
+        coupling — the per-host persistent XLA cache already covers
+        that deployment. Live wrappers drop so the attach takes
+        effect at the next dispatch."""
         with self._counter_lock:
             self._aot = None if self.multiprocess else client
             self._fn_cache.clear()
@@ -419,6 +524,12 @@ class ShardedMatcher:
         host but the ICI/H2D traffic is delta-sized). The trace
         signature decides executable retention exactly as on the
         single-device path. Caller quiesces dispatches first."""
+        # a still-deferred reduction captured the OLD corpus arrays —
+        # drain it before the swap (callers quiesce dispatches, but a
+        # parked handle outlives its dispatch by design)
+        stale = self._take_pending()
+        if stale is not None:
+            stale.launch("refresh")
         old_salt = self._trace_salt()
         old_tab_np, old_rep_np = self._tab_np, self._rep_np
         old_tab_j, old_rep_j = self._tab_j, self._rep_j
@@ -672,20 +783,68 @@ class ShardedMatcher:
         )
         return self._wrap_jit(fn, f"sh.fused.full={full}")
 
-    def _build_phase_a(self, streams: dict, lengths: dict):
+    def _ext_ctx(self, streams: dict, lengths: dict):
+        """Trace-time twin of :meth:`_exchange_halos` for kernels that
+        receive ALREADY-EXTENDED ``[B, W + 2·halo]`` views carried out
+        of phase A: rebuild the stream context (window offsets in
+        pre-halo coordinates, recovered from the carried width) and
+        the local views WITHOUT a second ppermute round — the fused
+        single-round halo exchange. Unsharded seq passes through.
+        Returns ``(ctx, local_views, back, fwd)``; the local views are
+        lazy slices whose bytes are bit-identical to the pre-exchange
+        stream (``ext[:, h:-h] == local`` by construction), and XLA
+        DCEs them where only their shapes are consumed."""
+        seq_ranks = self.ranks.get("seq", 1)
+        if seq_ranks <= 1:
+            return _StreamCtx(streams, lengths, 0), streams, 0, 0
+        h = self.halo
+        seq_index = jax.lax.axis_index("seq")
+        local = {k: v[:, h:-h] for k, v in streams.items()}
+        offsets = {
+            k: seq_index * (v.shape[1] - 2 * h)
+            for k, v in streams.items()
+        }
+        return _StreamCtx(streams, lengths, offsets), local, h, h
+
+    def _reduce_needs_streams(self, streams) -> bool:
+        """Whether the reduction tail re-reads response bytes (device
+        md5 digest or device regex verify gather whole rows over
+        'seq'). When False the deferred reduce takes no stream
+        argument at all and the phase-B probe is the streams' last
+        consumer (donation moves accordingly)."""
+        db = self.db
+        return bool(
+            (bool(db.m_md5_check.any()) and "body" in streams)
+            or len(db.rx_m_ids)
+        )
+
+    def _build_phase_a(self, streams: dict, lengths: dict, donate_streams: bool):
         """Standing sharded phase A: per-rank stacked bloom probe →
-        survivor RANK plane + per-rank overflow + the globally
-        ``pmax``'d max survivor count (the ONE scalar the host reads
-        between phases). The rank plane and overflow keep an explicit
-        leading (model, seq) axis — every rank's candidate space is
-        distinct, and phase B slices its own plane back out."""
+        survivor RANK plane + per-rank overflow + each rank's clamped
+        max survivor count. The rank plane and overflow keep an
+        explicit leading (model, seq) axis — every rank's candidate
+        space is distinct, and the phase-B probe slices its own plane
+        back out.
+
+        On seq meshes the halo ppermute happens HERE, once: the
+        extended ``[B, W + 2·halo]`` views ride the output straight
+        into phase B (``_ext_ctx`` rebuilds offsets from the carried
+        width), so one batch pays one halo round total.
+
+        The survivor count stays per-rank (single 4-byte lane per
+        device, specced over every axis) so the host read between
+        phases costs R × 4 bytes and NO cross-rank collective; only
+        multi-controller meshes keep the ``pmax``'d replicated scalar,
+        because a process can only read its own shard of a global
+        array."""
         meta = self.meta
         budget = global_candidate_budget(
             self.candidate_k, len(meta.table_stream)
         )
+        carry = self.ranks.get("seq", 1) > 1
 
-        # jit-captures: self, meta, budget (layout metadata + a python
-        # int; both trace-static)
+        # jit-captures: self, meta, budget, carry (layout metadata +
+        # python scalars; all trace-static)
         def step_a(tab, streams, lengths):
             streams_ext, offsets, back, fwd = self._exchange_halos(streams)
             ctx = _StreamCtx(streams_ext, lengths, offsets)
@@ -696,56 +855,73 @@ class ShardedMatcher:
             K = max(1, min(budget, cnt.shape[1]))
             overflow = n_surv > K
             nmax = jnp.max(jnp.minimum(n_surv, K))
-            # global max across the whole mesh: rows over 'data', each
-            # rank's own candidate space over 'model'/'seq' — the host
-            # reads ONE replicated scalar however the mesh factors
-            nmax = jax.lax.pmax(nmax, tuple(self.mesh.axis_names))
-            return cnt[None], overflow[None], nmax
+            if self.multiprocess:
+                # multi-controller: the host can only read its own
+                # shard, so keep the replicated pmax'd scalar
+                nmax_out = jax.lax.pmax(nmax, tuple(self.mesh.axis_names))
+            else:
+                nmax_out = nmax[None]  # per-rank lane; host maxes R ints
+            if carry:
+                return cnt[None], overflow[None], nmax_out, streams_ext
+            return cnt[None], overflow[None], nmax_out
 
         smap, smap_kwargs = self._smap()
         tab_specs, _rep_specs, stream_spec, lengths_spec = self._specs(
             streams, lengths
         )
         rank_spec = P(("model", "seq"), "data")
+        nmax_spec = P() if self.multiprocess else P(("data", "model", "seq"))
+        out_specs = (rank_spec, rank_spec, nmax_spec)
+        if carry:
+            out_specs = out_specs + ({k: P("data", "seq") for k in streams},)
         fn = smap(
             step_a,
             mesh=self.mesh,
             in_specs=(tab_specs, stream_spec, lengths_spec),
-            out_specs=(rank_spec, rank_spec, P()),
+            out_specs=out_specs,
             **smap_kwargs,
         )
-        return self._wrap_jit(fn, "sh.A")
+        # streams are donated into phase A only when the extended
+        # views replace them as every later kernel's input (seq mesh +
+        # matcher-owned staged copies)
+        donate = (1,) if donate_streams else ()
+        return self._wrap_jit(
+            fn,
+            f"sh.A2.mp={int(self.multiprocess)}.don={int(donate_streams)}",
+            donate_argnums=donate,
+        )
 
-    def _build_phase_b(
-        self, streams: dict, lengths: dict, kc: int, full: bool,
-        donate_streams: bool,
+    def _build_phase_b_probe(
+        self, streams: dict, lengths: dict, kc: int, donate_streams: bool,
     ):
-        """Sharded phase B at the static ladder rung ``kc``: per-rank
-        survivor extraction from the phase-A rank plane, gather-verify
-        + tiny at survivor size, psum, and the replicated verdict tail.
-        The staged per-batch uploads and the inter-phase rank plane are
-        DONATED so XLA reuses their buffers (``donate_streams=False``
-        — caller-owned device inputs — still donates the rank plane,
-        which this matcher owns)."""
+        """Sharded phase-B PROBE at the static ladder rung ``kc``:
+        per-rank survivor extraction from the phase-A rank plane,
+        gather-verify + tiny at survivor size — stopping at the
+        per-rank bit planes. No psum, no verdict tail: the cross-rank
+        reduction is a separate deferred executable
+        (:meth:`_build_reduce`), which is what lets batch N's
+        collectives overlap batch N+1's probe. The phase-A rank plane
+        is always DONATED; the (possibly extended) streams are donated
+        only when this probe is their last consumer."""
         db = self.db
         meta = self.meta
         budget = global_candidate_budget(
             self.candidate_k, len(meta.table_stream)
         )
 
-        # jit-captures: self, db, meta, budget, kc, full (metadata and
+        # jit-captures: self, db, meta, budget, kc (metadata and
         # scalars only — kc is the ladder rung this executable serves)
-        def step_b(tab, rep, streams, lengths, status, cnt_r, ovf_r):
-            streams_ext, offsets, back, fwd = self._exchange_halos(streams)
-            ctx = _StreamCtx(streams_ext, lengths, offsets)
+        def step_bp(tab, rep, streams, lengths, cnt_r):
+            ctx, local, back, fwd = self._ext_ctx(streams, lengths)
             tabr = {k: v[0] for k, v in tab.items()}
             cnt = cnt_r[0]
-            overflow = ovf_r[0]
             K = max(1, min(budget, cnt.shape[1]))
             col = compact_candidates(cnt, kc, K)
             # candidate axis = LOCAL window coordinates (pre-halo
-            # widths), exactly what prefilter_counts concatenated
-            col_starts = _col_starts_of(meta, streams)
+            # widths), exactly what prefilter_counts concatenated —
+            # _col_starts_of only reads shapes, so the local slices
+            # cost nothing here
+            col_starts = _col_starts_of(meta, local)
             value_bits, uncertain_bits = verify_candidates(
                 meta,
                 tabr,
@@ -762,36 +938,159 @@ class ShardedMatcher:
                 meta, rep["tiny_bytes"], rep["tiny_slot"], ctx, value_bits,
                 back,
             )
-            return self._combine_finish(
-                value_bits, uncertain_bits, overflow, streams, lengths,
-                status, rep, full,
-            )
+            return value_bits[None], uncertain_bits[None]
 
         smap, smap_kwargs = self._smap()
         tab_specs, rep_specs, stream_spec, lengths_spec = self._specs(
             streams, lengths
         )
         rank_spec = P(("model", "seq"), "data")
-        out_specs = P("data") if full else (P("data"),) * 3
         fn = smap(
-            step_b,
+            step_bp,
             mesh=self.mesh,
             in_specs=(
-                tab_specs, rep_specs, stream_spec, lengths_spec, P("data"),
-                rank_spec, rank_spec,
+                tab_specs, rep_specs, stream_spec, lengths_spec, rank_spec,
             ),
-            out_specs=out_specs,
+            out_specs=(rank_spec, rank_spec),
             **smap_kwargs,
         )
-        donate = (
-            (2, 3, 4, 5, 6) if donate_streams else (5, 6)
-        )  # streams, lengths, status, cnt, overflow | cnt, overflow
+        donate = (2, 4) if donate_streams else (4,)  # [streams,] cnt plane
         # kc rides the kernel id (it is baked into the step closure
         # here, not a static argnum) so every ladder rung publishes
         # its own artifact
         return self._wrap_jit(
-            fn, f"sh.B.kc={kc}.full={full}", donate_argnums=donate
+            fn,
+            f"sh.Bp.kc={kc}.don={int(donate_streams)}",
+            donate_argnums=donate,
         )
+
+    def _build_reduce(
+        self, snames, lnames, full: bool, don_streams: bool,
+        don_host: bool,
+    ):
+        """The ONE deferred reduction executable: psum the per-rank
+        bit planes over the communicating axes + the replicated
+        verdict tail + the fused-plane pack (:meth:`_combine_finish`).
+        Rung-independent — EVERY ladder width of a shape class lands
+        in this same program, so deferring it adds exactly one live
+        executable per mesh shape. ``snames is None`` when the corpus
+        needs no response bytes past the probe (no device md5, no
+        device regex) — the common case, where the reduce ships only
+        the rank planes + lengths/status."""
+        full_flag = full
+        carry = self.ranks.get("seq", 1) > 1
+        h = self.halo
+
+        # jit-captures: self, full_flag, carry, h (python scalars —
+        # trace-static; corpus rides the rep ARGUMENT)
+        def finish(rep, streams, lengths, status, vb_r, ub_r, ovf_r):
+            local = streams
+            if carry:
+                # carried extended views → slice the exact pre-halo
+                # bytes back out for the md5/regex row gathers
+                local = {k: v[:, h:-h] for k, v in streams.items()}
+            return self._combine_finish(
+                vb_r[0], ub_r[0], ovf_r[0], local, lengths, status, rep,
+                full_flag,
+            )
+
+        smap, smap_kwargs = self._smap()
+        rep_specs = jax.tree_util.tree_map(lambda _a: P(), self._rep_np)
+        lengths_spec = {k: P("data") for k in lnames}
+        rank_spec = P(("model", "seq"), "data")
+        out_specs = P("data") if full else (P("data"),) * 3
+        if snames is not None:
+            stream_spec = {k: P("data", "seq") for k in snames}
+            step_r = finish
+            in_specs = (
+                rep_specs, stream_spec, lengths_spec, P("data"),
+                rank_spec, rank_spec, rank_spec,
+            )
+            donate = (4, 5, 6)  # the matcher-owned rank planes, always
+            if don_streams:
+                donate = (1,) + donate
+            if don_host:
+                donate = donate + (2, 3)
+        else:
+
+            # jit-captures: finish (the closure above)
+            def step_r(rep, lengths, status, vb_r, ub_r, ovf_r):
+                return finish(rep, {}, lengths, status, vb_r, ub_r, ovf_r)
+
+            in_specs = (
+                rep_specs, lengths_spec, P("data"),
+                rank_spec, rank_spec, rank_spec,
+            )
+            donate = (3, 4, 5)
+            if don_host:
+                donate = donate + (1, 2)
+        fn = smap(
+            step_r,
+            mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            **smap_kwargs,
+        )
+        return self._wrap_jit(
+            fn,
+            (
+                f"sh.R.full={full}.s={int(snames is not None)}"
+                f".don={int(don_streams)}{int(don_host)}"
+            ),
+            donate_argnums=tuple(sorted(donate)),
+        )
+
+    def _launch_reduce(
+        self, streams_j, lengths_j, status_j, vb, ub, ovf, full: bool,
+        donate_host: bool, snames, lnames,
+    ):
+        """Fetch/build + enqueue the deferred reduction for one batch
+        (the :class:`_PendingShard` thunk body). ``streams_j`` is None
+        when the verdict tail needs no response bytes; on seq meshes
+        it is the carried extended views, sliced back to local inside
+        the step."""
+        t0 = time.perf_counter()
+        needs = streams_j is not None
+        carry = self.ranks.get("seq", 1) > 1
+        # carried extended views are matcher-created inside phase A —
+        # donatable regardless of who owns the original host batch
+        don_s = bool(carry or donate_host)
+        fr, fresh_r = self._get_fn(
+            (
+                "R", snames if needs else None, lnames, full, don_s,
+                bool(donate_host),
+            ),
+            lambda: self._build_reduce(
+                snames if needs else None, lnames, full, don_s,
+                bool(donate_host),
+            ),
+        )
+        if needs:
+            out = fr(self._rep_j, streams_j, lengths_j, status_j, vb, ub, ovf)
+        else:
+            out = fr(self._rep_j, lengths_j, status_j, vb, ub, ovf)
+        self._note_launch([(fr, fresh_r)], t0)
+        return out
+
+    # ------------------------------------------------------------------
+    # deferred-reduction double buffer (depth 1; _PendingShard)
+    # ------------------------------------------------------------------
+    def _take_pending(self) -> Optional[_PendingShard]:
+        with self._counter_lock:
+            handle, self._pending = self._pending, None
+        return handle
+
+    def _set_pending(self, handle: _PendingShard) -> None:
+        with self._counter_lock:
+            self._pending = handle
+
+    def _clear_pending(self, handle: _PendingShard) -> None:
+        """Called by the handle itself once launched, so a handle
+        forced by collect() can never be re-taken by a later
+        dispatch (launch is idempotent anyway — this is hygiene)."""
+        with self._counter_lock:
+            if self._pending is handle:
+                self._pending = None
 
     # ------------------------------------------------------------------
     def _get_fn(self, key, builder):
@@ -887,7 +1186,16 @@ class ShardedMatcher:
                 self.compile_seconds += dt
                 self.compile_count += 1
 
-    def _dispatch_metrics(self, streams: dict, halo_exchanges: int = 1) -> None:
+    def _dispatch_metrics(
+        self, streams: dict, halo_rounds_a: int = 1,
+        halo_rounds_b: int = 0, saved_rounds: int = 0,
+    ) -> None:
+        """Per-dispatch traffic accounting. Halo bytes are labeled by
+        PHASE so the bench can attribute the single-round fusion win:
+        the compacted path pays one phase-A round and charges the
+        round it no longer pays (vs the historical re-exchange in
+        phase B) to the saved counter; the fused twin's one in-kernel
+        exchange counts as phase a."""
         m = _shard_metrics()
         m.SHARD_DISPATCHES.inc(1)
         B = int(next(iter(streams.values())).shape[0])
@@ -897,25 +1205,31 @@ class ShardedMatcher:
             # cross-rank psum (docs/SHARDING.md: B × NS bits per step)
             m.PSUM_BYTES.inc(B * (2 * ns + 1) * 4)
         if self.ranks.get("seq", 1) > 1:
-            # the split-phase path pays the exchange in BOTH phases
-            # (each kernel re-derives its extended stream views rather
-            # than shipping [B, W+2h] buffers across the phase
-            # boundary), so the counter charges every ppermute round
-            m.HALO_BYTES.inc(
-                halo_exchanges * 2 * self.halo * B * len(streams)
-            )
+            round_bytes = 2 * self.halo * B * len(streams)
+            if halo_rounds_a:
+                m.HALO_BYTES.labels(phase="a").inc(
+                    halo_rounds_a * round_bytes
+                )
+            if halo_rounds_b:
+                m.HALO_BYTES.labels(phase="b").inc(
+                    halo_rounds_b * round_bytes
+                )
+            if saved_rounds:
+                m.HALO_SAVED.inc(saved_rounds * round_bytes)
 
     # ------------------------------------------------------------------
     def dispatch(self, streams: dict, lengths: dict, status, full: bool = True):
         """Async half of :meth:`match`: stage the batch, launch the
-        sharded kernel(s), and return the (device-resident, still-
-        computing) output WITHOUT a full host transfer — the
+        sharded kernels, and return WITHOUT a full host transfer — the
         continuous-batching scheduler dispatches batch i+1 here before
         walking batch i's verdicts; :meth:`collect` finalizes.
 
         On the compacted path the only blocking point is the phase-A
-        max-survivor scalar read (4 bytes, ``pmax``'d across the whole
-        mesh) that picks phase B's ladder width."""
+        max-survivor read (R × 4 bytes of per-rank lanes on a
+        single-controller mesh) that picks the probe's ladder width;
+        the cross-rank reduction comes back as an un-launched
+        :class:`_PendingShard` and rides behind the NEXT dispatch's
+        phase A — or behind :meth:`collect` when the window closes."""
         from swarm_tpu.resilience.faults import fault_point
 
         # same fault point as DeviceDB.dispatch: "the device path
@@ -923,7 +1237,13 @@ class ShardedMatcher:
         # (MatchEngine degrades to the CPU oracle either way)
         fault_point("device.dispatch")
         self._check_seq_widths(streams)
-        skey = tuple(sorted((k, v.shape) for k, v in streams.items()))
+        # executable cache keys use stream NAMES, not shapes: the
+        # builders only consume names (partition specs), so ONE
+        # wrapper serves every width bucket of a shape class and the
+        # per-shape executables live in the wrapper's own cache —
+        # bounded rung count per mesh shape, and AOT fetch covers
+        # each width signature under the same kernel id
+        snames = tuple(sorted(streams))
         lkey = tuple(sorted(lengths))
         t0 = time.perf_counter()
         s_j, l_j, st_j = self._stage(streams, lengths, status)
@@ -931,7 +1251,7 @@ class ShardedMatcher:
             # fused legacy/reference arm (also the no-tables corpus,
             # where there is nothing to compact)
             fn, fresh = self._get_fn(
-                ("fused", skey, lkey, full),
+                ("fused", snames, lkey, full),
                 lambda: self._build_fused(streams, lengths, full),
             )
             out = fn(self._tab_j, self._rep_j, s_j, l_j, st_j)
@@ -942,26 +1262,46 @@ class ShardedMatcher:
         donate_streams = self.donate and host_batch_leaves(
             streams, lengths, status
         )
+        carry = self.ranks.get("seq", 1) > 1
+        don_a = bool(donate_streams and carry)
         fa, fresh_a = self._get_fn(
-            ("A", skey, lkey), lambda: self._build_phase_a(streams, lengths)
+            ("A", snames, lkey, don_a),
+            lambda: self._build_phase_a(streams, lengths, don_a),
         )
-        cnt, ovf, nmax = fa(self._tab_j, s_j, l_j)
-        # the ONE host sync between phases: the globally pmax'd
-        # survivor scalar that sizes phase B to live work — the second
-        # blessed 4-byte sync (tools/swarmlint jit-hygiene contract)
-        n_live = int(nmax)  # host-sync-ok: the blessed sharded 4-byte phase-A survivor scalar
+        if carry:
+            cnt, ovf, nmax, s_ext = fa(self._tab_j, s_j, l_j)
+        else:
+            cnt, ovf, nmax = fa(self._tab_j, s_j, l_j)
+            s_ext = s_j
+        # double buffer: with OUR phase A in the queue, flush the
+        # previous batch's deferred reduction — its psum/verdict tail
+        # executes behind the probe while this host thread blocks on
+        # the survivor read below
+        prev = self._take_pending()
+        if prev is not None:
+            prev.launch("dispatch")
+            _shard_metrics().OVERLAPPED.inc(1)
+        # the ONE host sync between phases: the survivor maxima that
+        # size the probe to live work — the second blessed sync of the
+        # jit-hygiene contract (tools/swarmlint). Single-controller
+        # meshes read the per-rank lanes (R × 4 bytes, no collective);
+        # multi-controller meshes read their pmax'd replicated scalar.
+        if self.multiprocess:
+            n_live = int(nmax)  # host-sync-ok: the blessed sharded 4-byte phase-A survivor scalar
+        else:
+            n_live = int(np.asarray(nmax).max())  # host-sync-ok: the blessed sharded phase-A survivor lanes (R × 4 bytes)
         budget = global_candidate_budget(
             self.candidate_k, len(self.meta.table_stream)
         )
         kc = fpc.survivor_bucket(n_live, budget)
-        fb, fresh_b = self._get_fn(
-            ("B", skey, lkey, kc, full, donate_streams),
-            lambda: self._build_phase_b(
-                streams, lengths, kc, full, donate_streams
-            ),
+        needs = self._reduce_needs_streams(streams)
+        don_bp = bool((not needs) and (carry or donate_streams))
+        fbp, fresh_bp = self._get_fn(
+            ("Bp", snames, lkey, kc, don_bp),
+            lambda: self._build_phase_b_probe(streams, lengths, kc, don_bp),
         )
-        out = fb(self._tab_j, self._rep_j, s_j, l_j, st_j, cnt, ovf)
-        self._note_launch([(fa, fresh_a), (fb, fresh_b)], t0)
+        vb, ub = fbp(self._tab_j, self._rep_j, s_ext, l_j, cnt)
+        self._note_launch([(fa, fresh_a), (fbp, fresh_bp)], t0)
         with self._counter_lock:
             self.last_compact = {
                 "survivor_max": n_live,
@@ -970,20 +1310,46 @@ class ShardedMatcher:
             }
         m = _shard_metrics()
         m.SURVIVOR_MAX.set(n_live)
-        self._dispatch_metrics(streams, halo_exchanges=2)
-        return out
+        self._dispatch_metrics(streams, saved_rounds=1)
+        held = sum(int(getattr(a, "nbytes", 0)) for a in (vb, ub, ovf))
+        r_streams = s_ext if needs else None
+        handle = _PendingShard(
+            self,
+            lambda: self._launch_reduce(
+                r_streams, l_j, st_j, vb, ub, ovf, full, donate_streams,
+                snames, lkey,
+            ),
+            held,
+        )
+        if self.overlap:
+            self._set_pending(handle)
+        else:
+            handle.launch("inline")
+        return handle
 
     def collect(self, out):
-        """Blocking half of the full-mode split: one host read of the
-        fused plane array (gathered host-local over DCN first on
-        multi-process meshes), sliced into the engine's six outputs."""
+        """Blocking half of the full-mode split: force the deferred
+        reduction if no later dispatch flushed it, then one host read
+        of the fused plane array (gathered host-local over DCN first
+        on multi-process meshes), sliced into the engine's six
+        outputs."""
+        deferred = isinstance(out, _PendingShard)
+        t0 = time.perf_counter()
+        if deferred:
+            out = out.force()
         if self.multiprocess:
             from jax.experimental import multihost_utils
 
             out = multihost_utils.global_array_to_host_local_array(
                 out, self.mesh, P()
             )
-        return split_fused(self.db, np.asarray(out))
+        res = split_fused(self.db, np.asarray(out))
+        if deferred:
+            # launch-if-needed + device wait + the host read: how long
+            # collect actually stalled on the reduction (≈0 when a
+            # later dispatch already overlapped it)
+            _shard_metrics().REDUCTION_WAIT.inc(time.perf_counter() - t0)
+        return res
 
     # ------------------------------------------------------------------
     def match(self, streams: dict, lengths: dict, status, full: bool = False):
@@ -993,6 +1359,8 @@ class ShardedMatcher:
         out = self.dispatch(streams, lengths, status, full=full)
         if full:
             return self.collect(out)
+        if isinstance(out, _PendingShard):
+            out = out.force()
         if self.multiprocess:
             # global -> host-local (replicated) so every process can
             # read the full result; riding DCN once per batch

@@ -117,7 +117,9 @@ class SchedulerConfig:
     #: depth 8) can never alias an unconsumed batch. On an accelerator
     #: backend the effective depth stays ≥2 even with the walk offload
     #: armed (the whole point: device batches hide the host walk); on
-    #: the CPU fallback it still collapses to 1 (see
+    #: the CPU fallback it still collapses to 1 — UNLESS the engine
+    #: serves a multi-device sharded mesh, where the window must stay
+    #: open for the deferred cross-rank reduction to overlap (see
     #: _device_overlap_ok).
     inflight: int = 2
     #: encoded batches buffered between prefetch and submission — the
@@ -159,7 +161,11 @@ class SchedulerConfig:
         # the encode in progress (1) must stay at or under the
         # recycled-pool depth 8 (see encoding._RotatingPool; the walk
         # slot is charged against inflight in run(), which caps the
-        # offloaded total at 2+3+1+1=7)
+        # offloaded total at 2+3+1+1=7). The sharded matcher's parked
+        # reduction planes (_PendingShard) are DEVICE-side buffers it
+        # staged itself — they ride inside an in-flight batch's slot,
+        # not a pool plane, so the budget is unchanged; the staging
+        # pool tracks them separately (plane_holds/plane_bytes).
         self.inflight = max(1, min(int(self.inflight), 4))
         self.queue_depth = max(1, min(int(self.queue_depth), 2))
 
@@ -230,7 +236,15 @@ class BatchScheduler:
         a real accelerator the kernel runs off-host, so walking batch i
         while the chip crunches i+1 is free. On the CPU fallback the
         "device" IS the host — an in-flight kernel's XLA threads steal
-        exactly the cores the walk needs, so depth collapses to 1."""
+        exactly the cores the walk needs, so depth collapses to 1.
+
+        EXCEPT on a multi-device sharded mesh: the sharded matcher's
+        deferred reduction (parallel/sharded.py, _PendingShard) only
+        overlaps when dispatch N+1 happens before collect N, so the
+        in-flight window must stay open even when the mesh is
+        host-platform virtual devices — the cross-rank psum + verdict
+        tail riding behind the next probe is exactly the serialization
+        the window exists to hide, XLA threads or not."""
         ok = self._overlap_helps
         if ok is None:
             try:
@@ -239,6 +253,20 @@ class BatchScheduler:
                 ok = jax.default_backend() != "cpu"
             except Exception:
                 ok = False
+            if not ok:
+                # resolve the engine's backend first (lazy — the same
+                # resolution the first dispatch would do) so a
+                # configured-but-unbuilt mesh is visible here
+                ranks_fn = getattr(self.engine, "data_ranks", None)
+                if ranks_fn is not None:
+                    try:
+                        ranks_fn()
+                    except Exception:
+                        pass
+                sharded = getattr(self.engine, "sharded", None)
+                mesh = getattr(sharded, "mesh", None)
+                if mesh is not None and int(mesh.devices.size) > 1:
+                    ok = True
             self._overlap_helps = ok
         return ok
 

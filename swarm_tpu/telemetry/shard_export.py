@@ -35,26 +35,56 @@ PSUM_BYTES = REGISTRY.counter(
     "Bit-plane bytes combined over ICI by the sharded match psum",
 )
 #: ppermute halo-exchange bytes per dispatch (2 × halo × rows per
-#: stream; 0 on seq-unsharded meshes)
+#: stream; 0 on seq-unsharded meshes), labeled by the PHASE whose
+#: kernel paid the round — the compacted path fuses the exchange into
+#: phase a and carries extended views, so phase="b" stays flat there
+#: and only the fused reference twin would ever have charged it
 HALO_BYTES = REGISTRY.counter(
     "swarm_shard_halo_bytes_total",
     "Response-stream bytes exchanged as seq-axis ppermute halos",
+    ("phase",),
+)
+#: halo bytes the single-round fused exchange did NOT ship (the
+#: historical phase-B re-exchange, charged here instead of to
+#: swarm_shard_halo_bytes_total — the fusion win, directly scrapeable)
+HALO_SAVED = REGISTRY.counter(
+    "swarm_shard_halo_bytes_saved_total",
+    "Halo bytes avoided by fusing the seq-axis exchange into phase A",
 )
 SHARD_DISPATCHES = REGISTRY.counter(
     "swarm_shard_dispatches_total",
     "Batches dispatched through the sharded mesh matcher",
 )
+#: compacted dispatches whose predecessor's deferred cross-rank
+#: reduction was flushed behind this dispatch's phase A — the
+#: double-buffered overlap actually happening (collect-forced and
+#: inline launches don't count)
+OVERLAPPED = REGISTRY.counter(
+    "swarm_shard_overlapped_dispatches_total",
+    "Sharded dispatches that overlapped the previous batch's deferred "
+    "reduction behind their own phase A",
+)
+#: wall seconds collect() spent blocked on the deferred reduction
+#: (launch-if-needed + device wait + the fused host read); ≈0 per
+#: batch when the in-flight window keeps the overlap fed
+REDUCTION_WAIT = REGISTRY.counter(
+    "swarm_shard_reduction_wait_seconds",
+    "Seconds collect() stalled waiting on deferred sharded reductions",
+)
 #: the most recent compacted sharded batch's global max per-row
-#: survivor count (the pmax'd scalar the host reads between phases)
+#: survivor count (the host-read maxima that size the probe rung)
 SURVIVOR_MAX = REGISTRY.gauge(
     "swarm_shard_survivor_max",
     "Max per-row prefilter survivors (global pmax) in the most recent "
     "compacted sharded batch",
 )
 
-# pre-seed the axis labels so the family always renders samples (a
-# labeled family with no observed combos renders no lines, which would
-# read as "family missing" to the exposition check)
+# pre-seed the axis/phase labels so the families always render samples
+# (a labeled family with no observed combos renders no lines, which
+# would read as "family missing" to the exposition check)
 for _ax in ("data", "model", "seq"):
     MESH_AXIS.labels(axis=_ax).set(0)
 del _ax
+for _ph in ("a", "b"):
+    HALO_BYTES.labels(phase=_ph).inc(0)
+del _ph

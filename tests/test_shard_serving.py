@@ -20,7 +20,18 @@ the mesh-serving contracts:
 - **scheduler overlap**: ``begin_packed``/``finish_packed`` route to
   ``ShardedMatcher.dispatch``/``collect`` and the continuous-batching
   scheduler holds ≥2 mesh batches in flight while the walk offload
-  runs, with results bit-identical to the direct single-device engine.
+  runs, with results bit-identical to the direct single-device engine;
+- **deferred-reduction overlap** (ISSUE 18): batch N's cross-rank
+  reduction stays un-launched until batch N+1's phase A is enqueued
+  (spy-asserted via ``_PendingShard.launched_by``), with planes
+  bit-identical either way;
+- **single-round fused halo**: seq meshes charge ONE phase-A ppermute
+  round per compacted batch (phase-labeled counter), the saved round
+  lands on the saved-bytes counter, and planes stay bit-identical to
+  the fused twin which still re-derives everything in-kernel;
+- **bounded rung wrappers**: executable-cache keys are stream-NAME
+  based, so a second width bucket of the same shape class adds no
+  phase-A/reduce wrapper entries.
 """
 
 from __future__ import annotations
@@ -382,7 +393,7 @@ def test_sched_inflight_ge2_with_walk_offload_on_sharded_engine(corpus):
 def test_shard_metric_families_always_render():
     """The ``swarm_shard_*`` families render samples in a mesh-free
     process (check_metrics contract: families register at telemetry
-    import with axis labels pre-seeded)."""
+    import with axis/phase labels pre-seeded)."""
     from swarm_tpu.telemetry import REGISTRY
 
     text = REGISTRY.render()
@@ -391,7 +402,213 @@ def test_shard_metric_families_always_render():
         "swarm_shard_rank_fill_ratio",
         "swarm_shard_psum_bytes_total",
         "swarm_shard_halo_bytes_total",
+        "swarm_shard_halo_bytes_saved_total",
         "swarm_shard_dispatches_total",
+        "swarm_shard_overlapped_dispatches_total",
+        "swarm_shard_reduction_wait_seconds",
         "swarm_shard_survivor_max",
     ):
         assert f"\n{fam}" in text or text.startswith(fam), fam
+    # the halo counter is phase-labeled with both rounds pre-seeded
+    for phase in ("a", "b"):
+        assert f'swarm_shard_halo_bytes_total{{phase="{phase}"}}' in text
+
+
+# ---------------------------------------------------------------------------
+# deferred-reduction overlap, fused single-round halo, rung sharing
+# (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_two_batch_overlapped_reduction_parity(corpus):
+    """Double-buffered reduction on the 8-device mesh: dispatching
+    batch N+1 launches batch N's parked reduction (spy-asserted via
+    the handle's ``launched_by``), the trailing handle is forced by
+    collect, and BOTH batches' planes stay bit-identical to the fused
+    twin. Plane holds drain back to zero once everything launched."""
+    from swarm_tpu.parallel.sharded import _PendingShard
+    from swarm_tpu.telemetry import shard_export
+
+    templates, db = corpus
+    mesh = make_mesh((8, 1, 1))
+    sm = ShardedMatcher(db, mesh, compact=True, donate=True)
+    assert sm.overlap, "single-controller mesh must default overlap on"
+    ref = ShardedMatcher(db, mesh, compact=False, donate=False)
+    b1 = _fresh_batch(db, templates, seed=901)
+    b2 = _fresh_batch(db, templates, seed=902)
+
+    o0 = shard_export.OVERLAPPED.labels().value
+    h1 = sm.dispatch(b1.streams, b1.lengths, b1.status, full=True)
+    assert isinstance(h1, _PendingShard)
+    assert h1.launched_by is None, "reduction must stay parked"
+    assert sm.staging.plane_holds == 1
+
+    h2 = sm.dispatch(b2.streams, b2.lengths, b2.status, full=True)
+    assert h1.launched_by == "dispatch", (
+        "batch 1's reduction must flush behind batch 2's phase A"
+    )
+    assert shard_export.OVERLAPPED.labels().value == o0 + 1
+
+    got1, got2 = sm.collect(h1), sm.collect(h2)
+    assert h2.launched_by == "collect"
+    assert sm.staging.plane_holds == 0 and sm.staging.plane_bytes == 0
+    assert shard_export.REDUCTION_WAIT.labels().value > 0
+    _assert_planes_equal(
+        got1, ref.match(b1.streams, b1.lengths, b1.status, full=True)
+    )
+    _assert_planes_equal(
+        got2, ref.match(b2.streams, b2.lengths, b2.status, full=True)
+    )
+
+    # overlap off: same planes, reduction launched inline
+    inline = ShardedMatcher(db, mesh, compact=True, donate=True,
+                            overlap=False)
+    h3 = inline.dispatch(b1.streams, b1.lengths, b1.status, full=True)
+    assert h3.launched_by == "inline"
+    _assert_planes_equal(inline.collect(h3), got1)
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 2), (1, 1, 4)])
+def test_sharded_fused_halo_single_round_bit_identity(corpus, shape):
+    """Seq meshes pay ONE halo round per compacted batch: the ppermute
+    fuses into phase A and the extended views carry into the probe and
+    the reduce, so the phase="b" counter stays flat, the saved counter
+    charges exactly the round the old path re-exchanged, and planes
+    stay bit-identical to the fused twin (which derives its own views
+    in-kernel)."""
+    from swarm_tpu.telemetry import shard_export
+
+    templates, db = corpus
+    mesh = make_mesh(shape)
+    batch = _fresh_batch(db, templates, seed=55, seq_ranks=shape[2])
+    sm = ShardedMatcher(db, mesh, compact=True, donate=True)
+    ref = ShardedMatcher(db, mesh, compact=False, donate=False)
+
+    a0 = shard_export.HALO_BYTES.labels(phase="a").value
+    b0 = shard_export.HALO_BYTES.labels(phase="b").value
+    s0 = shard_export.HALO_SAVED.labels().value
+    got = sm.collect(
+        sm.dispatch(batch.streams, batch.lengths, batch.status, full=True)
+    )
+    round_bytes = (
+        2 * sm.halo
+        * int(next(iter(batch.streams.values())).shape[0])
+        * len(batch.streams)
+    )
+    assert shard_export.HALO_BYTES.labels(phase="a").value == a0 + round_bytes
+    assert shard_export.HALO_BYTES.labels(phase="b").value == b0, (
+        "the compacted path must not pay a phase-B halo round"
+    )
+    assert shard_export.HALO_SAVED.labels().value == s0 + round_bytes
+    want = ref.match(batch.streams, batch.lengths, batch.status, full=True)
+    _assert_planes_equal(got, want)
+
+
+def test_sharded_rung_wrappers_shared_across_width_buckets(corpus):
+    """Executable-cache keys are stream-NAME based: a second width
+    bucket of the same shape class rides the SAME phase-A/probe/reduce
+    wrappers (no new cache entries), and exactly one phase-A and one
+    reduce wrapper serve every rung."""
+    templates, db = corpus
+    mesh = make_mesh((8, 1, 1))
+    sm = ShardedMatcher(db, mesh, compact=True, donate=True)
+    single = DeviceDB(db)
+
+    rows = fuzz_rows(templates, random.Random(71), 16)
+    narrow = encode_batch(rows, max_body=512, max_header=256,
+                          pad_rows_to=16, width_multiple=512)
+    wide = encode_batch(rows, max_body=1024, max_header=256,
+                        pad_rows_to=16, width_multiple=512)
+    got_n = sm.collect(sm.dispatch(
+        narrow.streams, narrow.lengths, narrow.status, full=True))
+    keys_after_first = set(sm._fn_cache)
+    got_w = sm.collect(sm.dispatch(
+        wide.streams, wide.lengths, wide.status, full=True))
+    minted = set(sm._fn_cache) - keys_after_first
+    assert all(k[0] == "Bp" for k in minted), (
+        f"a new width bucket may only land on a new survivor rung, "
+        f"never mint phase-A/reduce wrappers: {minted}"
+    )
+    kinds = [k[0] for k in sm._fn_cache]
+    assert kinds.count("A") == 1
+    assert kinds.count("R") == 1
+    # and re-dispatching the wide width adds nothing at all
+    sm.collect(sm.dispatch(
+        wide.streams, wide.lengths, wide.status, full=True))
+    assert set(sm._fn_cache) == keys_after_first | minted
+    # same rows, both widths: verdict planes agree with the
+    # single-device reference
+    want = single.match(
+        narrow.streams, narrow.lengths, narrow.status, full=True
+    )
+    _assert_planes_equal(got_n, want, allow_less_overflow=False)
+    for name, a, w in zip(PLANES, got_w, want):
+        if name == "overflow":
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(w), err_msg=name
+        )
+
+
+def test_sharded_overflow_redo_through_overlapped_path(corpus):
+    """Overflow soundness survives the deferred reduction: at
+    candidate_k=2 a stuffed batch and a clean batch both in flight
+    (batch 1's reduce launched by batch 2's dispatch) still produce
+    the twin's exact planes including the overflow column, and the
+    engine's redo verdicts stay oracle-exact when batches flow through
+    the scheduler's in-flight window."""
+    from swarm_tpu.ops import cpu_ref
+    from swarm_tpu.ops.engine import MatchEngine
+    from swarm_tpu.sched import BatchScheduler, SchedulerConfig
+
+    templates, db = corpus
+    words = [
+        m.words[0].encode()
+        for t in templates
+        for _, m in t.all_matchers()
+        if m.words
+    ][:4]
+    stuffed = b" ".join(words * 16)
+    rows1 = [
+        Response(host="a", port=80, status=200, body=stuffed,
+                 header=b"HTTP/1.1 200 OK\r\nServer: nginx"),
+    ] + fuzz_rows(templates, random.Random(3), 7)
+    rows2 = fuzz_rows(templates, random.Random(4), 8)
+    mesh = make_mesh((8, 1, 1))
+
+    b1 = encode_batch(rows1, max_body=2048, max_header=256, pad_rows_to=8)
+    b2 = encode_batch(rows2, max_body=2048, max_header=256, pad_rows_to=8)
+    tight = ShardedMatcher(db, mesh, candidate_k=2)
+    twin = ShardedMatcher(db, mesh, candidate_k=2, compact=False,
+                          donate=False)
+    h1 = tight.dispatch(b1.streams, b1.lengths, b1.status, full=True)
+    h2 = tight.dispatch(b2.streams, b2.lengths, b2.status, full=True)
+    assert h1.launched_by == "dispatch"
+    got1, got2 = tight.collect(h1), tight.collect(h2)
+    assert bool(np.asarray(got1[-1])[0]), "stuffed row must overflow K=2"
+    _assert_planes_equal(
+        got1, twin.match(b1.streams, b1.lengths, b1.status, full=True)
+    )
+    _assert_planes_equal(
+        got2, twin.match(b2.streams, b2.lengths, b2.status, full=True)
+    )
+
+    eng = MatchEngine(
+        templates, mesh=mesh, batch_rows=8, max_body=2048, max_header=256,
+        db=db, candidate_k=2,
+    )
+    sched = BatchScheduler(
+        eng, SchedulerConfig(rows_target=8, inflight=4, prefetch="inline"),
+    )
+    assert sched._device_overlap_ok(), (
+        "the multi-device mesh must keep the in-flight window open on "
+        "the CPU backend"
+    )
+    results = [r for res in sched.run([rows1, rows2]) for r in res]
+    assert eng.stats.overflow_rows >= 1
+    for got, row in zip(results, rows1 + rows2):
+        want = {
+            t.id for t in eng.db.templates
+            if cpu_ref.match_template(t, row).matched
+        }
+        assert set(got.template_ids) == want

@@ -59,7 +59,10 @@ REQUIRED_FAMILIES = (
     "swarm_shard_rank_fill_ratio",
     "swarm_shard_psum_bytes_total",
     "swarm_shard_halo_bytes_total",
+    "swarm_shard_halo_bytes_saved_total",
     "swarm_shard_dispatches_total",
+    "swarm_shard_overlapped_dispatches_total",
+    "swarm_shard_reduction_wait_seconds",
     "swarm_shard_survivor_max",
     # content-addressed result cache (docs/CACHING.md): registered at
     # telemetry import (memo_export), label combos pre-seeded and the

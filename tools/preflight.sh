@@ -56,6 +56,17 @@ echo "== preflight: host-walk floor =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python tools/profile_walk.py --check-floor
 
+echo "== preflight: sharded weak-scaling floor =="
+# overlapped mesh serving (docs/SHARDING.md): the per-mesh-shape
+# weak-scaling efficiency table on the forced 8-device host-platform
+# mesh must stay within SWARM_FLOOR_FACTOR of the recorded floors
+# (tools/shard_floor.json; SWARM_FLOOR_SKIP=1 on known-noisy hosts).
+# The bundled corpus keeps the sweep CI-sized; rc also gates the
+# bit-identity of every swept shape's planes.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    SWARM_BENCH_CORPUS="tests/data/templates" \
+    python bench.py --phase sharded --check-floor
+
 echo "== preflight: bench smoke (pipeline A/B + shard + restart smoke, both modes) =="
 # CI-fast A/B on the bundled corpus; rc gates on verdict identity only.
 # Includes the restart smoke (docs/DURABILITY.md): one mid-scan server
