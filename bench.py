@@ -2543,6 +2543,53 @@ def run_phase(phase: str) -> int:
         if not ok:
             log(f"!!! monitor phase FAILED: {rec}")
             return 1
+    elif phase == "autoscale":
+        # closed-loop elastic-fleet replay (docs/RESILIENCE.md
+        # §Preemption): diurnal curve vs the simulated preemptible
+        # provider, real workers attached per node, seeded preemption
+        # notices on the spike. Gates: zero lost jobs, /raw identity
+        # vs a fixed fleet, forecast lead >= 0 on the shoulder,
+        # scale-to-zero re-warm cold-start within the SLO, and
+        # bulk-sheds-before-interactive.
+        os.environ.setdefault("SWARM_BENCH_CORPUS", str(BUNDLED_CORPUS))
+        rec = bench_autoscale()
+        emit(
+            "autoscale_forecast_lead_steps",
+            float(
+                -1 if rec.get("forecast_lead_steps") is None
+                else rec["forecast_lead_steps"]
+            ),
+            " steps (spike-peak step minus first nonzero-forecast "
+            "step; gate >= 0 — the advisor scales AHEAD of the spike)",
+            1.0 if rec.get("ok") else 0.0,
+            extra={
+                "autoscale": {
+                    k: v for k, v in rec.items() if k != "steps"
+                },
+                "curve": rec.get("steps"),
+            },
+        )
+        emit(
+            "autoscale_rewarm_coldstart_s",
+            float(rec.get("scale_to_zero", {}).get("coldstart_s")
+                  or 0.0),
+            "s (scale-to-zero re-warm: parked fleet's first node "
+            "servable; gate <= fleet_coldstart_slo_s, AOT-warm)",
+            (
+                rec["coldstart_slo_s"]
+                / max(rec["scale_to_zero"].get("coldstart_s") or 1e-9,
+                      1e-9)
+                if rec.get("scale_to_zero", {}).get("coldstart_s")
+                else 0.0
+            ),
+            extra={"scale_to_zero": rec.get("scale_to_zero")},
+        )
+        if not rec.get("ok"):
+            log(
+                "!!! autoscale phase FAILED: "
+                f"{ {k: v for k, v in rec.items() if k != 'steps'} }"
+            )
+            return 1
     elif phase == "shard_smoke":
         # run_smoke's child: engine-level sharded-vs-single verdict
         # identity on the forced 8-device host-platform mesh
@@ -2901,6 +2948,360 @@ def _smoke_restart_clause() -> "tuple[bool, dict]":
         worker.stop_requested = True
         if srv2 is not None:
             srv2.shutdown()
+
+
+class _FleetStack:
+    """Elastic-fleet harness for the autoscale phase and smoke clause:
+    a real server whose fleet is the deterministic
+    :class:`SimulatedProvider`, with a ``node_factory`` that attaches a
+    REAL in-process worker to every node the moment its cold-start
+    elapses. ONE copy of the bring-up / submit / completion-wait logic
+    for both the phase and the smoke gate (same reasoning as
+    :class:`_QosStack`) — and the same harness, minus the simulated
+    provider, doubles as the fixed-fleet identity baseline."""
+
+    def __init__(self, tag: str, extra_cfg: "dict | None" = None):
+        import tempfile
+        import threading as _threading
+
+        from swarm_tpu.client.cli import JobClient
+        from swarm_tpu.config import Config
+        from swarm_tpu.server.app import SwarmServer
+        from swarm_tpu.server.fleet import InflowForecaster
+        from swarm_tpu.worker.runtime import JobProcessor
+
+        self._threading = _threading
+        self._Config = Config
+        self._JobProcessor = JobProcessor
+        tmp = tempfile.mkdtemp(prefix=f"swarm_fleet_{tag}_")
+        modules_dir = os.path.join(tmp, "modules")
+        os.makedirs(modules_dir)
+        corpus = os.environ.get("SWARM_BENCH_CORPUS", str(BUNDLED_CORPUS))
+        with open(os.path.join(modules_dir, "fingerprint.json"), "w") as f:
+            json.dump({"backend": "tpu", "templates": corpus}, f)
+        self.cfg = Config(
+            host="127.0.0.1", port=0, api_key="fleet",
+            blob_root=os.path.join(tmp, "blobs"),
+            doc_root=os.path.join(tmp, "docs"),
+            modules_dir=modules_dir,
+            poll_interval_idle_s=0.02, poll_interval_busy_s=0.005,
+            lease_seconds=3.0, heartbeat_interval_s=0.25,
+            **(extra_cfg or {}),
+        )
+        self.workers: "dict[str, tuple]" = {}
+        self.srv = SwarmServer(self.cfg)
+        self.srv.start_background()
+        self.cfg.server_url = f"http://127.0.0.1:{self.srv.port}"
+        self.client = JobClient(self.cfg.resolve_url(), self.cfg.api_key)
+        self.provider = self.srv.fleet
+        self.advisor = self.srv.autoscaler
+        if getattr(self.provider, "node_factory", "absent") is None:
+            self.provider.node_factory = self._spawn_worker
+        # compressed forecaster window: the diurnal curve replays in
+        # seconds, not hours — the control LAW is what's under test,
+        # so the EWMA must both rise within a step or two of the
+        # shoulder and decay to zero within the scale-to-zero wait
+        self.advisor.forecaster = InflowForecaster(alpha=0.7, window_s=0.2)
+
+    def _spawn_worker(self, name: str):
+        proc = self._JobProcessor(
+            self._Config(**{**self.cfg.__dict__, "worker_id": name})
+        )
+        t = self._threading.Thread(target=proc.process_jobs, daemon=True)
+        t.start()
+        self.workers[name] = (proc, t)
+
+        class _Handle:
+            def stop(self):  # graceful spin-down rides the drain path
+                proc.request_drain("spin-down")
+                t.join(timeout=30)
+
+            def kill(self):  # post-grace preemption force-kill: no
+                proc.stop_requested = True  # drain, no spool flush
+
+        return _Handle()
+
+    def submit(self, scan_id: str, lines: list, batch: int = 1,
+               qos=None) -> int:
+        import requests as _requests
+
+        headers = {"Authorization": f"Bearer {self.cfg.api_key}"}
+        if qos:
+            headers["X-Swarm-QoS"] = qos
+        return _requests.post(
+            f"{self.cfg.resolve_url()}/queue",
+            json={"module": "fingerprint", "file_content": lines,
+                  "batch_size": batch, "scan_id": scan_id,
+                  "chunk_index": 0},
+            headers=headers, timeout=30,
+        ).status_code
+
+    def wait_complete(self, scan_ids, deadline_s: float = 180,
+                      autoscale: bool = False,
+                      prefix: str = "node") -> bool:
+        pending = set(scan_ids)
+        deadline = time.time() + deadline_s
+        tick = 0
+        while time.time() < deadline and pending:
+            time.sleep(0.05)
+            tick += 1
+            if autoscale and tick % 4 == 0:
+                # keep the control loop closed while draining: boots
+                # complete, kills land, and the advisor may still
+                # scale (a mid-drain spin-down exercises the graceful
+                # drain + requeue path under load)
+                self.provider.poll()
+                self.advisor.apply(prefix)
+            statuses = self.client.get_statuses()
+            if statuses is None:
+                continue
+            pending -= {
+                s["scan_id"] for s in statuses.get("scans", [])
+                if s["percent_complete"] == 100.0
+            }
+        return not pending
+
+    def close(self) -> None:
+        for proc, _t in self.workers.values():
+            proc.stop_requested = True
+        shutdown = getattr(self.provider, "shutdown", None)
+        if shutdown:
+            shutdown()
+        for _proc, t in self.workers.values():
+            t.join(timeout=10)
+        self.srv.shutdown()
+
+
+def bench_autoscale(
+    curve: "list | None" = None,
+    step_s: float = 0.45,
+    n_preempts: int = 3,
+    rows_per_submit: int = 4,
+    full_gates: bool = True,
+    deadline_s: float = 240,
+) -> dict:
+    """Closed-loop elastic-fleet replay (docs/RESILIENCE.md
+    §Preemption, docs/GATEWAY.md): a diurnal submission curve against a
+    REAL server whose fleet is the SimulatedProvider, the advisor's
+    ``apply()`` spinning real in-process workers up and down, with
+    seeded preemption notices landing on the spike. Gates:
+
+    - zero lost jobs: every scan reaches 100%, nothing dead-lettered,
+      across >= ``n_preempts`` preemptions and every drain/deregister;
+    - /raw bit-identical to a fixed-fleet (one static worker) replay
+      of the same submissions — elasticity and preemption change WHEN
+      work runs, never WHAT it answers;
+    - per-class shed ordering: at one fixed mid pressure, bulk sheds
+      while interactive (and the default class) still admit;
+    - (full gates) forecast lead >= 0: the EWMA forecaster shows a
+      nonzero forecast on the spike's rising shoulder, at or before
+      the peak submission step — the advisor scales AHEAD;
+    - (full gates) scale-to-zero parks the idle fleet, and the re-warm
+      cold-start (AOT-warm bring-up) lands within
+      ``cfg.fleet_coldstart_slo_s``.
+    """
+    from swarm_tpu.gateway.admission import (
+        AdmissionController,
+        PressureSnapshot,
+    )
+
+    curve = curve or [1, 1, 2, 3, 6, 8, 6, 3, 1, 0, 0, 0]
+    peak_step = max(range(len(curve)), key=lambda i: curve[i])
+    lines = [
+        json.dumps(
+            {"host": f"10.9.0.{i}", "port": 443, "status": 200,
+             "body": f"<title>Demo Admin</title> demo-build 9.{i} "
+                     f"page {i}"}
+        ) + "\n"
+        for i in range(rows_per_submit)
+    ]
+    extra = dict(
+        fleet_provider="sim",
+        gateway_autoscale_apply=True,
+        gateway_autoscale_jobs_per_node=2,
+        gateway_autoscale_min_nodes=0,
+        gateway_autoscale_max_nodes=3,
+        fleet_scaledown_hysteresis=2,
+        fleet_sim_preempt_grace_s=1.5,
+        fleet_scale_to_zero_after_s=(0.8 if full_gates else 0.0),
+    )
+    prefix = "node"
+    stack = _FleetStack("elastic", extra_cfg=extra)
+    scan_ids: list = []
+    steps: list = []
+    preempted: list = []
+    try:
+        # --- elastic arm: replay the curve, advisor in the loop ---
+        sidx = 0
+        for step, n_sub in enumerate(curve):
+            t_step = time.perf_counter()
+            for _ in range(n_sub):
+                sid = f"ase{sidx}_1"
+                sidx += 1
+                assert stack.submit(sid, lines, 1) == 200
+                scan_ids.append(sid)
+            stack.provider.poll()
+            rec = stack.advisor.apply(prefix)
+            steps.append({
+                "step": step, "submitted": n_sub,
+                "depth": rec["queue_depth"],
+                "forecast_jobs": rec["forecast_jobs"],
+                "target": rec["target_nodes"],
+                "nodes": rec["current_nodes"],
+                "action": rec["action"],
+            })
+            # seeded preemptions land on the spike: one notice per
+            # step from the peak on, against a node that is actually
+            # up, until the quota is in
+            if len(preempted) < n_preempts and step >= peak_step:
+                ready = [
+                    n for n in stack.provider.ready_nodes(prefix)
+                    if n not in preempted
+                ]
+                if ready:
+                    stack.provider.preempt(ready[0])
+                    preempted.append(ready[0])
+            lag = step_s - (time.perf_counter() - t_step)
+            if lag > 0:
+                time.sleep(lag)
+        all_done = stack.wait_complete(
+            scan_ids, deadline_s=deadline_s, autoscale=True,
+            prefix=prefix,
+        )
+
+        # --- scale-to-zero + re-warm (full gates only) ---
+        s2z = {"parked": None, "coldstart_s": None, "rewarm_ok": None}
+        if full_gates and all_done:
+            park_deadline = time.time() + 30
+            parked = False
+            while time.time() < park_deadline and not parked:
+                stack.provider.poll()
+                rec = stack.advisor.apply(prefix)
+                parked = (
+                    rec["target_nodes"] == 0
+                    and not stack.provider.list_nodes(prefix)
+                )
+                time.sleep(0.15)
+            s2z["parked"] = parked
+            if parked:
+                mark = len(stack.provider.events)
+                rw = "aserw_1"
+                assert stack.submit(rw, lines, 1) == 200
+                stack.provider.poll()
+                stack.advisor.apply(prefix)
+                s2z["rewarm_ok"] = stack.wait_complete(
+                    [rw], deadline_s=60, autoscale=True, prefix=prefix,
+                )
+                scan_ids.append(rw)
+                spun: dict = {}
+                cold: list = []
+                for t, ev, name in list(stack.provider.events)[mark:]:
+                    if ev == "spin_up":
+                        spun[name] = t
+                    elif ev == "ready" and name in spun:
+                        cold.append(t - spun[name])
+                if cold:
+                    s2z["coldstart_s"] = round(max(cold), 3)
+
+        notices = sum(
+            1 for _t, ev, _n in stack.provider.events
+            if ev == "preempt_notice"
+        )
+        health = stack.client.get_healthz() or {}
+        drain_outcomes = [
+            p.drain_outcome for p, _t in stack.workers.values()
+            if p.drain_outcome is not None
+        ]
+        elastic_raw = {s: stack.client.fetch_raw(s) for s in scan_ids}
+    finally:
+        stack.close()
+
+    # --- fixed-fleet identity baseline: same submissions, one static
+    # worker, no provider — elasticity must change nothing in /raw ---
+    base = _FleetStack("fixed")
+    try:
+        base._spawn_worker("fixed1")
+        base_ids = [s.replace("ase", "asb", 1) for s in scan_ids]
+        for bsid in base_ids:
+            assert base.submit(bsid, lines, 1) == 200
+        base_done = base.wait_complete(base_ids, deadline_s=deadline_s)
+        identical = base_done and all(
+            elastic_raw[sid]
+            == (base.client.fetch_raw(bsid) or "").replace("asb", "ase")
+            for sid, bsid in zip(scan_ids, base_ids)
+        )
+    finally:
+        base.close()
+
+    # --- per-class shed ordering: bulk sheds first, deterministically
+    ctl = AdmissionController(
+        shed_pressure=0.9, shed_pressure_bulk=0.5,
+        shed_pressure_interactive=0.95,
+    )
+    snap = PressureSnapshot(saturation=0.7)
+    shed_order_ok = (
+        not ctl.decide("t_b", snap, 0.0, qos="bulk").admitted
+        and ctl.decide("t_i", snap, 0.0, qos="interactive").admitted
+        and ctl.decide("t_d", snap, 0.0).admitted
+    )
+
+    first_forecast = next(
+        (s["step"] for s in steps if s["forecast_jobs"] > 0), None
+    )
+    forecast_lead = (
+        peak_step - first_forecast if first_forecast is not None else None
+    )
+    slo = getattr(stack.cfg, "fleet_coldstart_slo_s", 2.0)
+    zero_lost = bool(all_done and not health.get("dead_letter_jobs"))
+    ok = (
+        zero_lost
+        and identical
+        and shed_order_ok
+        and notices >= n_preempts
+    )
+    if full_gates:
+        ok = ok and (
+            forecast_lead is not None and forecast_lead >= 0
+            and bool(s2z["parked"]) and bool(s2z["rewarm_ok"])
+            and s2z["coldstart_s"] is not None
+            and s2z["coldstart_s"] <= slo
+        )
+    return {
+        "ok": ok,
+        "zero_lost": zero_lost,
+        "identical": identical,
+        "shed_order_ok": shed_order_ok,
+        "preempt_notices": notices,
+        "preempted_nodes": preempted,
+        "drain_outcomes": drain_outcomes,
+        "forecast_lead_steps": forecast_lead,
+        "scale_to_zero": s2z,
+        "coldstart_slo_s": slo,
+        "dead_letter": health.get("dead_letter_jobs"),
+        "draining_at_end": health.get("draining_workers"),
+        "steps": steps,
+    }
+
+
+def _smoke_autoscale_clause() -> "tuple[bool, dict]":
+    """Autoscale smoke (docs/RESILIENCE.md §Preemption): a mini
+    diurnal curve against the simulated preemptible fleet with ONE
+    seeded preemption notice — rc-gated on zero lost jobs, the notice
+    actually landing, per-class shed ordering, and /raw identity vs
+    the fixed-fleet baseline. Under the chaos plan the armed
+    ``fleet.preempt`` / ``worker.drain`` faults additionally inject a
+    dispatch-path preemption and one aborted drain; the identity gate
+    must hold regardless (spool + fencing + lease expiry own the
+    recovery)."""
+    rec = bench_autoscale(
+        curve=[1, 2, 4, 2, 0, 0], step_s=0.4, n_preempts=1,
+        full_gates=False, deadline_s=120,
+    )
+    ok = bool(rec.get("ok"))
+    if not ok:
+        log(f"!!! autoscale smoke FAILED: "
+            f"{ {k: v for k, v in rec.items() if k != 'steps'} }")
+    return ok, rec
 
 
 def _smoke_qos_clause() -> "tuple[bool, dict]":
@@ -3590,6 +3991,25 @@ def run_smoke() -> int:
         1.0 if rs_ok else 0.0,
         extra={"restart": rs_rec},
     )
+    # autoscale smoke (docs/RESILIENCE.md §Preemption): mini diurnal
+    # curve against the simulated preemptible fleet, one seeded
+    # preemption — rc-gated on zero lost jobs + /raw identity vs the
+    # fixed-fleet baseline + per-class shed ordering (chaos plan runs
+    # additionally inject a dispatch-path preemption + aborted drain)
+    as_ok, as_rec = _smoke_autoscale_clause()
+    ok = ok and as_ok
+    emit(
+        "smoke_autoscale_identity",
+        1.0 if as_ok else 0.0,
+        " (diurnal replay vs simulated preemptible fleet: zero lost "
+        "jobs + raw identity + bulk-sheds-first)",
+        1.0 if as_ok else 0.0,
+        extra={
+            "autoscale": {
+                k: v for k, v in as_rec.items() if k != "steps"
+            }
+        },
+    )
     # shard smoke: the sharded serving path on the 8-device host-
     # platform mesh, rc-gated on verdict identity (docs/SHARDING.md).
     # Runs in its OWN subprocess: the forced device-count flag also
@@ -3667,7 +4087,7 @@ def run_smoke() -> int:
 #: synthesizes never delays the headline.
 PHASES = [
     "service", "service_full", "streaming", "jarm", "device", "sharded",
-    "aot", "latency", "monitor", "oracle", "exact",
+    "aot", "latency", "monitor", "autoscale", "oracle", "exact",
 ]
 
 

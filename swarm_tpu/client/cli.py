@@ -409,13 +409,51 @@ def _fmt_ts(ts) -> str:
     return time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(ts))
 
 
-def render_workers(statuses: dict) -> str:
-    table = Table(["Worker ID", "Last Contacted", "Polls with No Jobs", "Status"])
+def _fmt_age(ts, now=None) -> str:
+    if not ts:
+        return ""
+    age = max(0.0, (now if now is not None else time.time()) - ts)
+    if age < 120:
+        return f"{age:.1f}s"
+    if age < 7200:
+        return f"{age / 60:.1f}m"
+    return f"{age / 3600:.1f}h"
+
+
+def render_workers(statuses: dict, health: Optional[dict] = None) -> str:
+    """Per-worker fleet readout: state (active / draining / preempted /
+    inactive), last-heartbeat age, poll counters — plus the autoscale
+    advisor's target vs actual when /healthz carries a recommendation
+    (docs/RESILIENCE.md §Preemption)."""
+    draining = statuses.get("draining") or {}
+    table = Table(
+        ["Worker ID", "State", "Heartbeat Age", "Last Contacted",
+         "Polls with No Jobs"]
+    )
     for worker_id, w in statuses.get("workers", {}).items():
+        state = w.get("status") or ""
+        reason = draining.get(worker_id)
+        if reason:
+            state = f"{state} ({reason})"
         table.add_row(
-            [worker_id, _fmt_ts(w.get("last_contact")), w.get("polls_with_no_jobs"), w.get("status")]
+            [worker_id, state, _fmt_age(w.get("last_contact")),
+             _fmt_ts(w.get("last_contact")), w.get("polls_with_no_jobs")]
         )
-    return str(table)
+    lines = [str(table)]
+    auto = (health or {}).get("autoscale")
+    # before the advisor's first control-law tick the status dict
+    # carries None fields — nothing worth a line yet
+    if auto and auto.get("action") is not None:
+        lines.append(
+            f"autoscale[{auto.get('prefix')}]: "
+            f"target {auto.get('target_nodes')} vs "
+            f"actual {auto.get('current_nodes')} nodes "
+            f"({auto.get('action')}"
+            + (", dry-run" if auto.get("dry_run") else "")
+            + f"); queue depth {auto.get('queue_depth')}, "
+            f"forecast {auto.get('forecast_jobs')} jobs"
+        )
+    return "\n".join(lines)
 
 
 def render_jobs(statuses: dict) -> str:
@@ -743,7 +781,13 @@ def _run_action(args, cfg: Config, client: JobClient) -> int:
             return 1
         if args.action == "workers":
             print("Worker Statuses:")
-            print(render_workers(statuses))
+            # the advisor's target-vs-actual line rides the same view;
+            # a dead /healthz just drops it (the table still renders)
+            try:
+                health = client.get_healthz()
+            except requests.RequestException:
+                health = None
+            print(render_workers(statuses, health))
         elif args.action == "jobs":
             print("Job Statuses:")
             print(render_jobs(statuses))

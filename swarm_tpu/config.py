@@ -227,6 +227,48 @@ class Config:
     gateway_autoscale_min_nodes: int = 0
     gateway_autoscale_max_nodes: int = 8
     gateway_autoscale_apply: bool = False
+    # --- closed-loop elastic fleet (docs/RESILIENCE.md §Preemption) ---
+    # EWMA smoothing factor for the inflow forecaster over the
+    # admission history (higher = reacts faster to a spike shoulder)
+    fleet_forecast_alpha: float = 0.3
+    # forecast horizon: how many seconds of forecasted inflow are added
+    # to the current depth when the advisor sizes the fleet (scale
+    # AHEAD of the spike; 0 = depth-reactive, the PR 10 behavior)
+    fleet_forecast_horizon_s: float = 30.0
+    # scale-down hysteresis: the advisor must see a below-target fleet
+    # for this many consecutive recommendations before it shrinks
+    # (scale-up is always immediate)
+    fleet_scaledown_hysteresis: int = 3
+    # park an idle tenant fleet entirely (target 0) after this many
+    # seconds with zero depth and zero forecasted inflow; 0 disables
+    # scale-to-zero and min_nodes is the floor
+    fleet_scale_to_zero_after_s: float = 0.0
+    # simulated provider (tests/bench): RNG seed for preemption draws
+    # and the preemption notice → forced-kill grace window
+    fleet_sim_seed: int = 0
+    fleet_sim_preempt_grace_s: float = 5.0
+    # simulated cold-start latency per node, drawn from the measured
+    # AOT bring-up numbers (docs/AOT.md: 4.2 s cold compile vs 0.23 s
+    # AOT-warm fetch) — aot_warm picks which one a booting sim node pays
+    fleet_sim_coldstart_cold_s: float = 4.2
+    fleet_sim_coldstart_warm_s: float = 0.23
+    fleet_sim_aot_warm: bool = True
+    # cold-start SLO the bench autoscale phase gates on: a parked
+    # (scale-to-zero) tenant's first node must be servable within this
+    # wall-clock budget when the store is AOT-warm
+    fleet_coldstart_slo_s: float = 2.0
+    # graceful drain: how long a draining worker keeps polling for the
+    # drain signal to settle before exiting, and how long the server
+    # waits for a draining worker's lease before force-requeueing
+    worker_drain_timeout_s: float = 30.0
+    # --- per-class shed (docs/GATEWAY.md §QoS, the PR 15 follow-up) ---
+    # composite pressure at/over which BULK submissions shed; 0 = use
+    # gateway_shed_pressure for both classes (pre-PR behavior)
+    gateway_shed_pressure_bulk: float = 0.0
+    # composite pressure at/over which INTERACTIVE submissions shed;
+    # 0 = use gateway_shed_pressure. Set bulk < interactive to shed
+    # bulk first under rising pressure.
+    gateway_shed_pressure_interactive: float = 0.0
     # --- continuous monitoring (docs/MONITORING.md) ---
     # standing rescan subsystem: registered monitor specs fire epochs
     # on a cadence through the admission path, diff verdicts against
@@ -254,7 +296,7 @@ class Config:
     trace_enabled: bool = False
 
     # --- fleet orchestration ---
-    fleet_provider: str = "null"  # "null" | "digitalocean" | "process"
+    fleet_provider: str = "null"  # "null"|"digitalocean"|"process"|"sim"
     fleet_api_token: str = ""
     fleet_rate_limit_per_min: int = 250
     fleet_region: str = "nyc3"

@@ -80,11 +80,23 @@ class JobStatus:
 
 
 class WorkerStatus:
-    """Worker liveness states (reference ``server/server.py:489-507``)."""
+    """Worker liveness states (reference ``server/server.py:489-507``).
+
+    ``draining``/``preempted`` are additions for the elastic fleet
+    (docs/RESILIENCE.md §Preemption): a draining worker finishes its
+    current lease but is offered no new jobs; a preempted worker is a
+    draining worker whose drain was initiated by a provider preemption
+    notice. Both deregister (or lapse) into ``inactive``.
+    """
 
     ACTIVE = "active"
     PENDING = "pending"
     INACTIVE = "inactive"
+    DRAINING = "draining"
+    PREEMPTED = "preempted"
+
+    #: states the queue must not offer new jobs to
+    NO_DISPATCH = frozenset({DRAINING, PREEMPTED})
 
 
 def generate_scan_id(module: str, timestamp: Optional[int] = None) -> str:

@@ -15,7 +15,10 @@ gateway replaces that front door with three deterministic mechanisms:
   scheduler in-flight saturation, open breaker count) folded into a
   single scalar; at/over ``gateway_shed_pressure`` every non-empty
   submission sheds with ``429 + Retry-After``. Shed, never block: the
-  client owns the retry schedule.
+  client owns the retry schedule. Per-class overrides
+  (``gateway_shed_pressure_bulk`` / ``_interactive``, 0 = use the
+  global knob) let bulk shed first so interactive latency survives a
+  pressure ramp.
 
 Every decision is a PURE function of ``(tenant state, snapshot, now)``
 — :meth:`AdmissionController.decide` takes both explicitly so tests
@@ -117,12 +120,20 @@ class AdmissionController:
         max_tenants: int = 1024,
         saturation_ttl_s: float = 60.0,
         tenant_ttl_s: float = 3600.0,
+        shed_pressure_bulk: float = 0.0,
+        shed_pressure_interactive: float = 0.0,
     ):
         self.tenant_rate = float(tenant_rate)
         self.tenant_burst = int(tenant_burst)
         self.tenant_queue_max = int(tenant_queue_max)
         self.queue_high = int(queue_high)
         self.shed_pressure = float(shed_pressure)
+        # per-class shed thresholds (docs/GATEWAY.md §QoS): bulk sheds
+        # at a LOWER pressure than interactive so background work makes
+        # room before foreground work feels anything. 0 = fall back to
+        # the single shed_pressure knob — the pre-QoS wire behavior.
+        self.shed_pressure_bulk = float(shed_pressure_bulk)
+        self.shed_pressure_interactive = float(shed_pressure_interactive)
         self.retry_after_s = float(retry_after_s)
         self.breaker_pressure = float(breaker_pressure)
         # tenant-id cardinality bound: tenant names are CLIENT data, so
@@ -166,7 +177,21 @@ class AdmissionController:
             max_tenants=getattr(cfg, "gateway_max_tenants", 1024),
             saturation_ttl_s=getattr(cfg, "gateway_saturation_ttl_s", 60.0),
             tenant_ttl_s=getattr(cfg, "gateway_tenant_ttl_s", 3600.0),
+            shed_pressure_bulk=getattr(cfg, "gateway_shed_pressure_bulk", 0.0),
+            shed_pressure_interactive=getattr(
+                cfg, "gateway_shed_pressure_interactive", 0.0
+            ),
         )
+
+    def shed_threshold(self, qos: Optional[str]) -> float:
+        """The pressure at/over which this QoS class sheds. The
+        per-class knobs default to 0 = "use the global threshold", so
+        deployments that never set them keep the single-knob rule."""
+        if qos == "bulk" and self.shed_pressure_bulk > 0:
+            return self.shed_pressure_bulk
+        if qos == "interactive" and self.shed_pressure_interactive > 0:
+            return self.shed_pressure_interactive
+        return self.shed_pressure
 
     # ------------------------------------------------------------------
     def pressure(self, snap: PressureSnapshot) -> float:
@@ -199,6 +224,15 @@ class AdmissionController:
         with self._lock:
             self._saturation[worker_id] = (min(1.0, max(0.0, v)), stamp)
 
+    def drop_saturation(self, worker_id: str) -> None:
+        """Forget a worker's saturation report NOW. A deregistered or
+        preempted worker is gone — waiting out ``saturation_ttl_s``
+        would let its final (often maximal: it was draining under
+        load) report pin fleet pressure for up to a minute after the
+        node died."""
+        with self._lock:
+            self._saturation.pop(worker_id, None)
+
     def fleet_saturation(self, now=None) -> float:
         """max() over reports younger than ``saturation_ttl_s`` —
         stale ones are dropped (a dead worker's last word must not
@@ -223,11 +257,13 @@ class AdmissionController:
         snap: PressureSnapshot,
         now: float,
         tenant_depth: int = 0,
+        qos: Optional[str] = None,
     ) -> Decision:
         """Admit or shed one submission for ``tenant``. Deterministic
-        given ``(snapshot, now, tenant_depth)`` and the tenant's bucket
-        fill; counters and gauges update as a side effect."""
+        given ``(snapshot, now, tenant_depth, qos)`` and the tenant's
+        bucket fill; counters and gauges update as a side effect."""
         pressure = self.pressure(snap)
+        shed_at = self.shed_threshold(qos)
         GATEWAY_PRESSURE.labels().set(pressure)
         with self._lock:
             if tenant not in self._counts and tenant != DEFAULT_TENANT:
@@ -261,7 +297,7 @@ class AdmissionController:
             counts = self._counts.setdefault(
                 tenant, {"admitted": 0, "shed": 0}
             )
-            if pressure >= self.shed_pressure:
+            if pressure >= shed_at:
                 decision = Decision(
                     False, "pressure", self.retry_after_s, pressure
                 )

@@ -56,6 +56,7 @@ class RetryingServerClient:
         "get_input_chunk",
         "put_output_chunk",
         "renew_lease",
+        "deregister",
     )
 
     def __init__(
@@ -125,6 +126,9 @@ class RetryingServerClient:
 
     def renew_lease(self, job_id, worker_id, **kw) -> bool:
         return self._call("renew_lease", job_id, worker_id, **kw)
+
+    def deregister(self, worker_id) -> bool:
+        return self._call("deregister", worker_id)
 
     def __getattr__(self, name):
         # non-op attributes (base, session, timeout, …) proxy through

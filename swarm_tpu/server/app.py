@@ -127,6 +127,31 @@ class SwarmServer:
         self.autoscaler = AutoscaleAdvisor.from_config(
             self.queue, self.fleet, cfg
         )
+        # preemption notices close the loop (docs/RESILIENCE.md
+        # §Preemption): a provider that issues them (SimulatedProvider)
+        # drains the doomed worker server-side, so dispatch stops
+        # offering it jobs the moment the notice lands — the worker
+        # itself learns via the X-Swarm-Drain header on its next poll
+        if (
+            hasattr(self.fleet, "on_preempt_notice")
+            and self.fleet.on_preempt_notice is None
+        ):
+            queue_ref = self.queue
+            self.fleet.on_preempt_notice = (
+                lambda name: queue_ref.drain_worker(name, reason="preempted")
+            )
+        # the post-grace force-kill deregisters the name authoritatively:
+        # leases requeue NOW (not at lease expiry) and the drain entry
+        # clears, so a replacement node reusing the name starts clean
+        # even when the killed worker was too wedged to drain itself
+        if (
+            hasattr(self.fleet, "on_kill")
+            and self.fleet.on_kill is None
+        ):
+            queue_ref = self.queue
+            self.fleet.on_kill = (
+                lambda name: queue_ref.deregister_worker(name)
+            )
         # gateway-tier result cache (docs/GATEWAY.md §QoS): interactive
         # submissions whose chunks are fleet-known complete HERE with
         # zero worker dispatch. None (the default: cache_backend=off)
@@ -228,6 +253,8 @@ class SwarmServer:
         r("POST", r"^/autoscale$", self._autoscale_apply, "/autoscale")
         r("POST", r"^/spin-up$", self._spin_up, "/spin-up")
         r("POST", r"^/spin-down$", self._spin_down, "/spin-down")
+        r("POST", r"^/drain/(?P<worker_id>[^/]+)$", self._drain_worker, "/drain")
+        r("POST", r"^/deregister$", self._deregister, "/deregister")
         r("POST", r"^/reset$", self._reset, "/reset")
         r("GET", r"^/get-input-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$", self._get_input_chunk, "/get-input-chunk")
         r("POST", r"^/put-output-chunk/(?P<scan_id>[^/]+)/(?P<chunk_id>[^/]+)$", self._put_output_chunk, "/put-output-chunk")
@@ -278,6 +305,13 @@ class SwarmServer:
                 # restart lose anything" is one curl away
                 "generation": self.queue.generation,
                 "recovery": self.queue.recovery_summary,
+                # elastic-fleet surface (docs/RESILIENCE.md
+                # §Preemption): the advisor's last target vs the
+                # provider's actual node count, plus which workers are
+                # mid-drain — COUNTS and the advisor dict only, no
+                # tenant ids (unauthenticated endpoint)
+                "autoscale": self.autoscaler.status(),
+                "draining_workers": len(self.queue.draining_workers()),
             },
         )
 
@@ -511,12 +545,13 @@ class SwarmServer:
             open_breakers=open_breakers,
         )
 
-    def _admission_decision(self, tenant: str):
+    def _admission_decision(self, tenant: str, qos: Optional[str] = None):
         return self.gateway.decide(
             tenant,
             self._pressure_snapshot(),
             time.monotonic(),
             tenant_depth=self.queue.tenant_depth(tenant),
+            qos=qos,
         )
 
     @staticmethod
@@ -574,7 +609,7 @@ class SwarmServer:
         # content must not become an unthrottled durable-write path),
         # and under overload the shed skips the digest + tier round
         # trip entirely
-        decision = self._admission_decision(tenant)
+        decision = self._admission_decision(tenant, qos=qos)
         if not decision.admitted:
             return self._shed_response(decision)
         # gateway-tier short-circuit (docs/GATEWAY.md §QoS): an
@@ -654,6 +689,14 @@ class SwarmServer:
             )
         except ValueError as e:
             return self._text(400, str(e))
+        # inflow feed for the forecasting advisor (docs/RESILIENCE.md
+        # §Preemption): only chunks that will consume a worker seat —
+        # short-circuited scans never reach dispatch and must not
+        # inflate the fleet-size forecast
+        if self.autoscaler.forecaster is not None and result["chunks"]:
+            self.autoscaler.forecaster.record(
+                result["chunks"], tenant=tenant
+            )
         if tracing.enabled():
             # pre-admission handler time, recorded OUTSIDE the
             # gateway-latency window (start < admitted_at by
@@ -696,7 +739,7 @@ class SwarmServer:
         epoch returns None and the spec retries next tick, late), then
         a PARTIAL gateway-cache lookup so fleet-known targets complete
         with zero dispatch, then the journaled fire."""
-        decision = self._admission_decision(spec.tenant)
+        decision = self._admission_decision(spec.tenant, qos=spec.qos)
         if not decision.admitted:
             return None
         cached = None
@@ -712,10 +755,18 @@ class SwarmServer:
                     if o is not None and len(chunks[i]) <= max_rows
                 }
         try:
-            return self.queue.fire_monitor_epoch(
+            result = self.queue.fire_monitor_epoch(
                 spec.to_wire(), scan_id, epoch,
                 cached_outputs=cached, trace_id=new_trace_id(),
             )
+            dispatched = result["chunks"] - int(
+                result.get("cached_chunks") or 0
+            )
+            if self.autoscaler.forecaster is not None and dispatched > 0:
+                self.autoscaler.forecaster.record(
+                    dispatched, tenant=spec.tenant
+                )
+            return result
         except Exception as e:
             # a failed fire (journal down, malformed spec) must not
             # kill the ticker; the spec stays due and retries
@@ -884,6 +935,13 @@ class SwarmServer:
         # (docs/DURABILITY.md): a worker seeing it change knows the
         # server restarted and re-registers / resets its breakers
         gen = {"X-Swarm-Generation": str(self.queue.generation)}
+        # drain signal delivery (docs/RESILIENCE.md §Preemption): the
+        # poll loop is the one channel every worker already reads, so
+        # the drain order rides it as a response header — no reverse
+        # connection into the worker needed
+        reason = self.queue.drain_reason(worker_id or "unknown")
+        if reason is not None:
+            gen["X-Swarm-Drain"] = reason
         if job is None:
             code, payload, ctype = self._text(204, "")
             return code, payload, ctype, gen
@@ -915,6 +973,37 @@ class SwarmServer:
             return self._json(400, {"message": "Prefix is required"})
         self.fleet.teardown_async(prefix)
         return self._json(202, {"message": f"Spinning down droplets with prefix {prefix}"})
+
+    def _drain_worker(self, m, q, body, h):
+        """Operator-initiated graceful drain (docs/RESILIENCE.md
+        §Preemption): dispatch stops offering the worker jobs; its next
+        poll carries X-Swarm-Drain and the worker finishes its lease,
+        uploads or spools, deregisters, and exits."""
+        try:
+            data = json.loads(body or b"{}")
+        except ValueError:
+            return self._json(400, {"message": "Invalid JSON"})
+        reason = str(data.get("reason") or "drain")
+        if self.queue.drain_worker(m["worker_id"], reason=reason):
+            return self._json(
+                200, {"message": "Worker draining", "reason": reason}
+            )
+        return self._json(409, {"message": "Worker already draining"})
+
+    def _deregister(self, m, q, body, h):
+        """The worker is exiting NOW: hand back any lease immediately
+        (no grace-window wait) and drop its saturation report — a dead
+        node's last word must not pin fleet pressure for a TTL."""
+        try:
+            data = json.loads(body or b"{}")
+        except ValueError:
+            return self._json(400, {"message": "Invalid JSON"})
+        worker_id = str(data.get("worker_id") or "").strip()
+        if not worker_id:
+            return self._json(400, {"message": "worker_id is required"})
+        result = self.queue.deregister_worker(worker_id)
+        self.gateway.drop_saturation(worker_id)
+        return self._json(200, {"message": "Worker deregistered", **result})
 
     def _reset(self, m, q, body, h):
         self.queue.reset()

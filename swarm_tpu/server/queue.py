@@ -38,6 +38,7 @@ from swarm_tpu.datamodel import (
 )
 from swarm_tpu.gateway.admission import DEFAULT_TENANT
 from swarm_tpu.gateway.qos import QOS_INTERACTIVE, qos_class
+from swarm_tpu.resilience.faults import FaultInjected, fault_point
 from swarm_tpu.server.journal import QueueJournal
 from swarm_tpu.stores import BlobStore, DocStore, StateStore
 from swarm_tpu.telemetry import REGISTRY, emit_event
@@ -147,6 +148,15 @@ class JobQueueService:
         # _journal_lock, so no cycle.
         # lock-order: _lock -> _journal_lock
         self._journal_lock = threading.RLock()
+        # drain set (docs/RESILIENCE.md §Preemption): worker id →
+        # reason ("drain" | "preempted" | "sigterm"). Dispatch refuses
+        # these workers so they can finish their current lease and
+        # exit. Guarded by _journal_lock, NOT _lock: every mutation
+        # pairs with its WAL append under that lock (append-before-
+        # apply, like jobs), and _journal_state() snapshots it while
+        # already holding _journal_lock — guarding it with _lock there
+        # would invert the declared _lock -> _journal_lock order.
+        self._draining: dict[str, str] = {}  # guarded-by: _journal_lock
         if journal is None and cfg.journal_enabled:
             journal = QueueJournal(
                 blobs, compact_segments=cfg.journal_compact_segments
@@ -697,6 +707,118 @@ class JobQueueService:
         return result
 
     # ------------------------------------------------------------------
+    # Graceful drain + deregistration (docs/RESILIENCE.md §Preemption)
+    # ------------------------------------------------------------------
+    def drain_reason(self, worker_id: str) -> Optional[str]:
+        """Why this worker is draining, or None (the dispatch-refusal
+        probe; also rides the X-Swarm-Drain response header)."""
+        with self._journal_lock:
+            return self._draining.get(worker_id)
+
+    def draining_workers(self) -> dict[str, str]:
+        """Worker id → drain reason snapshot (/healthz, tests)."""
+        with self._journal_lock:
+            return dict(self._draining)
+
+    # append-before-apply: the WAL append precedes the drain-set write
+    # (a worker told to drain is never offered a job by the next boot)
+    # blocking-ok: the WAL append + drain-set add under _journal_lock IS
+    # the append->apply atom the durability design requires
+    def drain_worker(self, worker_id: str, reason: str = "drain") -> bool:
+        """Mark one worker draining: dispatch stops offering it jobs
+        (it finishes its current lease, uploads or spools, then calls
+        :meth:`deregister_worker`). Sources: the operator route
+        ``POST /drain/<worker>``, a provider preemption notice, or an
+        armed ``fleet.preempt`` chaos clause. Journaled so a server
+        restart mid-drain keeps refusing the worker. Returns False if
+        the worker was already draining."""
+        with self._journal_lock:
+            if worker_id in self._draining:
+                return False
+            if self._journal is not None:
+                self._journal.append(
+                    {"op": "drain", "worker": worker_id, "reason": reason}
+                )  # blocking-ok: WAL append under _journal_lock is the append->apply atom (docs/DURABILITY.md)
+            self._draining[worker_id] = reason
+        worker = self._load_worker(worker_id)
+        worker.status = (
+            WorkerStatus.PREEMPTED
+            if reason == "preempted"
+            else WorkerStatus.DRAINING
+        )
+        self._save_worker(worker)
+        emit_event("worker.drain", worker_id=worker_id, reason=reason)
+        self._maybe_checkpoint()
+        return True
+
+    # orders: _put_job < state.hdel
+    # (record-first requeue, same discipline as _requeue_expired;
+    # append-before-apply: the WAL append precedes the drain-set drop)
+    # blocking-ok: the lease handback must be atomic against dispatch —
+    # a concurrent next_job must see either the old lease or the
+    # requeued job, never a half-released one
+    def deregister_worker(self, worker_id: str) -> dict:
+        """The worker is exiting NOW: drop its drain entry, hand back
+        any lease it still holds immediately (no grace-window wait —
+        the node is gone, waiting out the lease just delays the
+        requeue), and mark it inactive. Runs under the dispatch lock so
+        the handback and a concurrent ``_requeue_expired`` serialize:
+        whichever runs first requeues the job, the other sees QUEUED /
+        a cleared assignee and does nothing — exactly one requeue.
+        The caller (the route) also drops the worker's admission
+        saturation report. Returns ``{"requeued", "was_draining"}``."""
+        requeued = 0
+        with self._lock:
+            with self._journal_lock:
+                was = self._draining.pop(worker_id, None)
+                if self._journal is not None:
+                    self._journal.append(
+                        {"op": "deregister", "worker": worker_id}
+                    )  # blocking-ok: WAL append under _journal_lock is the append->apply atom (docs/DURABILITY.md)
+            for job_id in list(self.state.hkeys("leases")):
+                job = self._get_job_record(job_id)
+                if (
+                    job is None
+                    or job.worker_id != worker_id
+                    or job.status not in JobStatus.ACTIVE
+                ):
+                    continue
+                self._record_failure(job, "worker deregistered")
+                if job.attempts >= self.cfg.max_attempts:
+                    self._quarantine(job, reason="deregistered")
+                    continue
+                job.status = JobStatus.QUEUED
+                job.worker_id = None
+                job.lease_expires_at = None
+                # journaled record FIRST, lease-index drop after — the
+                # same never-strand ordering _requeue_expired documents
+                self._put_job(job)
+                self.state.hdel("leases", job_id)
+                self.state.rpush(
+                    self._queue_list(job.tenant, job.qos), job.job_id
+                )
+                requeued += 1
+                _JOBS_REQUEUED.inc()
+                emit_event(
+                    "job.requeued", trace_id=job.trace_id, job_id=job_id,
+                    attempts=job.attempts,
+                )
+            worker = self._load_worker(worker_id)
+            worker.status = WorkerStatus.INACTIVE
+            self._save_worker(worker)
+        # quarantines above can close a scan's waterfall; persist it
+        # now that the dispatch lock is released
+        self.tracer.flush()
+        self._maybe_checkpoint()
+        emit_event(
+            "worker.deregistered",
+            worker_id=worker_id,
+            requeued=requeued,
+            was_draining=was is not None,
+        )
+        return {"requeued": requeued, "was_draining": was is not None}
+
+    # ------------------------------------------------------------------
     # Dispatch (reference get_job, server.py:465-515) + leases
     # ------------------------------------------------------------------
     # orders: _put_job < state.hset (record-first: the lease index follows the journaled record)
@@ -707,6 +829,32 @@ class JobQueueService:
         now = time.time()
         worker = self._load_worker(worker_id)
         worker.last_contact = now
+
+        # chaos injection site (docs/RESILIENCE.md §Preemption): an
+        # armed ``fleet.preempt`` clause INJECTS a preemption notice
+        # for the polling worker — the dispatch path is the one place
+        # every worker is guaranteed to pass, so the plan can target
+        # any fleet without knowing node names. Gated on the fleet
+        # actually being preemptible (SimulatedProvider & co): a
+        # NullProvider server in the same process must not consume the
+        # plan's counts on a fleet that cannot be preempted.
+        if getattr(self.fleet, "preempt", None) is not None:
+            try:
+                fault_point("fleet.preempt", detail=worker_id)
+            except FaultInjected:
+                self.drain_worker(worker_id, reason="preempted")
+        reason = self.drain_reason(worker_id)
+        if reason is not None:
+            # draining worker: no dispatch — and its idle-poll counter
+            # must NOT creep toward teardown while it finishes its
+            # current lease (the drain path owns the exit)
+            worker.status = (
+                WorkerStatus.PREEMPTED
+                if reason == "preempted"
+                else WorkerStatus.DRAINING
+            )
+            self._save_worker(worker)
+            return None
 
         job: Optional[Job] = None
         express = False
@@ -1250,6 +1398,10 @@ class JobQueueService:
         return {
             "workers": workers, "jobs": jobs, "scans": scans,
             "tenants": tenants,
+            # worker id → drain reason for the mid-drain set (`swarm
+            # workers` annotates the State column with it; authed
+            # endpoint, unlike /healthz's bare count)
+            "draining": self.draining_workers(),
         }
 
     def _persist_scan_summary(self, scan: dict) -> None:
@@ -1331,6 +1483,7 @@ class JobQueueService:
         """Flush all queue/scan state (reference /reset, server.py:550-554)."""
         with self._journal_lock:
             self.state.flushall()
+            self._draining.clear()
             if self._journal is not None:
                 # the journal must die with the state it describes, or
                 # the next boot would resurrect a deliberately-flushed
@@ -1378,6 +1531,7 @@ class JobQueueService:
             "rr_cursor": self._rr_cursor,
             "rr_cursor_x": self._rr_cursor_x,
             "monitors": monitors,
+            "draining": dict(self._draining),
         }
 
     # blocking-ok: the snapshot->checkpoint pair holds _journal_lock so
@@ -1426,6 +1580,7 @@ class JobQueueService:
         order: dict[str, int] = {}
         tenants: set[str] = set()
         monitors: dict[str, dict] = {}
+        draining: dict[str, str] = {}
         cursor = 0
         cursor_x = 0
         idx = 0
@@ -1466,6 +1621,9 @@ class JobQueueService:
             for mid, wire in (snapshot.get("monitors") or {}).items():
                 if isinstance(wire, dict):
                     monitors[str(mid)] = wire
+            for w, why in (snapshot.get("draining") or {}).items():
+                if isinstance(w, str):
+                    draining[w] = str(why or "drain")
         for rec in records:
             replayed += 1
             if rec.get("op") == "tenant":
@@ -1484,6 +1642,18 @@ class JobQueueService:
                 continue
             if rec.get("op") == "monitor_rm":
                 monitors.pop(str(rec.get("monitor_id") or ""), None)
+                continue
+            # drain-set ops branch BEFORE the job fallback too — the
+            # same unknown-op-is-not-a-job rule the monitor ops follow
+            if rec.get("op") == "drain":
+                w = rec.get("worker")
+                if isinstance(w, str):
+                    draining[w] = str(rec.get("reason") or "drain")
+                else:
+                    JOURNAL_CORRUPT.inc()
+                continue
+            if rec.get("op") == "deregister":
+                draining.pop(str(rec.get("worker") or ""), None)
                 continue
             wire = rec.get("job")
             if not isinstance(wire, dict) or not wire.get("job_id"):
@@ -1605,6 +1775,11 @@ class JobQueueService:
         with self._lock:
             self._rr_cursor = cursor
             self._rr_cursor_x = cursor_x
+        with self._journal_lock:
+            # a worker told to drain before the crash stays refused
+            # after it: the drain set survives restarts so a preempted
+            # node can't be handed work during its kill-after-grace
+            self._draining = dict(draining)
         with self._gen_lock:
             self._jobs_generation += 1
         for outcome, n in counts.items():
@@ -1623,6 +1798,7 @@ class JobQueueService:
             "generation": self.generation,
             "replayed_records": replayed,
             "monitors": len(monitors),
+            "draining": len(draining),
             **counts,
         }
         # re-register unfinished scans with the waterfall assembler

@@ -28,7 +28,11 @@ import requests
 
 from swarm_tpu.config import Config
 from swarm_tpu.datamodel import SCAN_ID_RE, JobStatus
-from swarm_tpu.resilience.faults import fault_point, install_plan
+from swarm_tpu.resilience.faults import (
+    FaultInjected,
+    fault_point,
+    install_plan,
+)
 from swarm_tpu.resilience.heartbeat import LeaseHeartbeat
 from swarm_tpu.resilience.spool import OutputSpool
 from swarm_tpu.resilience.transport import (
@@ -37,6 +41,10 @@ from swarm_tpu.resilience.transport import (
 )
 from swarm_tpu.telemetry import REGISTRY, emit_event
 from swarm_tpu.telemetry import tracing
+from swarm_tpu.telemetry.fleet_export import (
+    WORKER_DRAIN,
+    WORKER_DRAIN_SECONDS,
+)
 from swarm_tpu.utils.trace import PhaseTimer, maybe_device_profile
 from swarm_tpu.worker.modules import (
     ModuleRegistry,
@@ -108,6 +116,11 @@ class ServerClient:
         #: loop watches it to detect server restarts
         #: (docs/DURABILITY.md).
         self.last_server_generation: Optional[int] = None
+        #: drain order from the most recent /get-job answer's
+        #: X-Swarm-Drain header (docs/RESILIENCE.md §Preemption): the
+        #: reason string ("drain"/"preempted"/...) or None. The poll
+        #: loop routes it into JobProcessor.request_drain.
+        self.last_drain_reason: Optional[str] = None
 
     def _request(self, op: str, method: str, path: str, detail=None, **kw):
         fault_point(f"transport.{op}", detail=detail, exc=TransportError)
@@ -131,6 +144,7 @@ class ServerClient:
                 self.last_server_generation = int(gen)
             except ValueError:
                 pass
+        self.last_drain_reason = resp.headers.get("X-Swarm-Drain")
         return resp.json() if resp.status_code == 200 else None
 
     def update_job(self, job_id: str, changes: dict, worker_id: Optional[str] = None) -> bool:
@@ -181,6 +195,16 @@ class ServerClient:
         )
         return resp.status_code == 200
 
+    def deregister(self, worker_id: str) -> bool:
+        """Tell the server this worker is exiting NOW: its leases hand
+        back immediately and its saturation report is dropped — no
+        grace-window wait (docs/RESILIENCE.md §Preemption)."""
+        resp = self._request(
+            "deregister", "POST", "/deregister",
+            detail=worker_id, json={"worker_id": worker_id},
+        )
+        return resp.status_code == 200
+
 
 class JobProcessor:
     def __init__(
@@ -214,6 +238,20 @@ class JobProcessor:
         self.jobs_done = 0
         #: cooperative shutdown for threaded workers (chaos soak test)
         self.stop_requested = False
+        #: graceful-drain order (docs/RESILIENCE.md §Preemption): the
+        #: reason string, set by SIGTERM, the X-Swarm-Drain poll
+        #: header, or tests. The poll loop finishes its current lease,
+        #: then runs :meth:`drain` and exits.
+        self.drain_requested: Optional[str] = None
+        #: outcome of the drain that ended process_jobs (None until
+        #: then): "completed" | "spooled" | "idle" | "aborted"
+        self.drain_outcome: Optional[str] = None
+        #: True while a leased chunk is being processed — decides the
+        #: drain outcome ("completed" vs "idle")
+        self._job_in_flight = False
+        #: True when the drain order arrived mid-chunk: the lease was
+        #: finished first, so the drain outcome reports "completed"
+        self._drained_mid_job = False
         self._last_heartbeat: Optional[LeaseHeartbeat] = None
         #: most recently observed scheduler in-flight saturation (0..1;
         #: None until a pipelined engine reports) — heartbeats carry it
@@ -249,9 +287,24 @@ class JobProcessor:
             return False
 
     # ------------------------------------------------------------------
+    def request_drain(self, reason: str) -> None:
+        """Ask the poll loop to drain: finish the current lease, flush
+        or spool, deregister, exit. Callable from a signal handler or
+        another thread — it only sets a flag. First reason wins."""
+        if self.drain_requested is None:
+            self.drain_requested = reason
+            if self._job_in_flight:
+                self._drained_mid_job = True
+
     def process_jobs(self) -> None:
         """The infinite poll loop (reference worker.py:113-126)."""
         while not self.stop_requested:
+            if self.drain_requested is not None:
+                # the current lease (if any) finished on the previous
+                # iteration — process_chunk is synchronous, so reaching
+                # this check means nothing is in flight
+                self.drain(self.drain_requested)
+                return
             try:
                 _LAST_POLL.set(time.time())
                 job = self.client.get_job(self.cfg.worker_id)
@@ -270,12 +323,24 @@ class JobProcessor:
             # server-side (next_job saves it on every poll); what's
             # left is OUR side of a control-plane restart
             self._note_server_generation()
+            # drain order riding the poll answer (docs/RESILIENCE.md
+            # §Preemption): the server stopped offering us jobs — loop
+            # back to the drain check instead of sleeping out an idle
+            # interval first
+            drain = getattr(self.client, "last_drain_reason", None)
+            if drain:
+                self.request_drain(drain)
+                continue
             # the poll proved the server reachable: flush any finished
             # chunks spooled while it was down (idempotent via fencing)
             self._replay_spool()
             try:
                 if job:
-                    self.process_chunk(job)
+                    self._job_in_flight = True
+                    try:
+                        self.process_chunk(job)
+                    finally:
+                        self._job_in_flight = False
                     # max_jobs bounds *attempts*: a failing job must not
                     # leave a --max-jobs worker polling forever
                     self.jobs_done += 1
@@ -329,6 +394,48 @@ class JobProcessor:
             return
         if cleared:
             print(f"spool: replayed {cleared} finished chunk(s)")
+
+    def drain(self, reason: str) -> str:
+        """The graceful exit sequence (docs/RESILIENCE.md §Preemption).
+        The current lease already finished — process_jobs only calls
+        this between chunks — so what's left is flushing any spooled
+        chunks while the server is still reachable, then deregistering
+        (the server hands back leases and drops our saturation report
+        immediately, no grace-window wait). The ``worker.drain`` fault
+        point ABORTS the sequence when armed: the kill-after-grace
+        case, where the node dies mid-drain and recovery rides the
+        on-disk spool + fencing instead of this happy path."""
+        t0 = time.monotonic()
+        outcome = "completed" if self._drained_mid_job else "idle"
+        try:
+            fault_point("worker.drain", detail=self.cfg.worker_id)
+            self._replay_spool()
+            if len(self.spool):
+                # replay couldn't clear everything (server gone again):
+                # the chunks stay spooled on disk for the next process
+                outcome = "spooled"
+            try:
+                self.client.deregister(self.cfg.worker_id)
+            except Exception as e:
+                print(f"drain: deregister undeliverable: {e}")
+        except FaultInjected:
+            # injected mid-drain death: no deregister, no replay — the
+            # server's lease expiry and the on-disk spool own recovery
+            outcome = "aborted"
+        self.drain_outcome = outcome
+        elapsed = time.monotonic() - t0
+        WORKER_DRAIN.labels(outcome=outcome).inc()
+        WORKER_DRAIN_SECONDS.labels().observe(elapsed)
+        emit_event(
+            "worker.stopped",
+            worker_id=self.cfg.worker_id,
+            reason=reason,
+            outcome=outcome,
+            jobs_done=self.jobs_done,
+            drain_seconds=round(elapsed, 4),
+        )
+        print(f"worker drained ({reason}): {outcome} in {elapsed:.2f}s")
+        return outcome
 
     # ------------------------------------------------------------------
     def process_chunk(self, job: dict) -> None:
@@ -1154,6 +1261,19 @@ def main(argv: Optional[list[str]] = None) -> None:
     if maybe_initialize_distributed():
         print("multi-host: jax.distributed initialized")
     proc = JobProcessor(cfg)
+    # SIGTERM routes through the DRAIN path, not a mid-upload death
+    # (docs/RESILIENCE.md §Preemption): the handler only sets a flag,
+    # the poll loop finishes its current lease, flushes or spools, and
+    # deregisters before exiting. Best-effort install — embedded runs
+    # off the main thread can't own signals.
+    import signal
+
+    try:
+        signal.signal(
+            signal.SIGTERM, lambda _sig, _frm: proc.request_drain("sigterm")
+        )
+    except ValueError:
+        pass
     for name in filter(None, (n.strip() for n in cfg.prewarm_modules.split(","))):
         if proc.prewarm(name):
             print(f"prewarmed module {name}")

@@ -104,6 +104,15 @@ def _fake_phase_output(phase: str) -> str:
              "dispatched; <=0.05 acceptance, feed replay identity gated)",
              "vs_baseline": 1.0},
         ],
+        "autoscale": [
+            {"metric": "autoscale_forecast_lead_steps", "value": 4.0,
+             "unit": " steps (spike-peak step minus first "
+             "nonzero-forecast step; gate >= 0)", "vs_baseline": 1.0},
+            {"metric": "autoscale_rewarm_coldstart_s", "value": 0.416,
+             "unit": "s (scale-to-zero re-warm: parked fleet's first "
+             "node servable; gate <= fleet_coldstart_slo_s, AOT-warm)",
+             "vs_baseline": 3.31},
+        ],
         "oracle": [
             {"metric": "cpu_oracle_rows_per_sec", "value": 12.0,
              "unit": "rows/sec", "vs_baseline": 1.0},

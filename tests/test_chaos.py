@@ -210,3 +210,83 @@ def test_dead_letter_requeue_completes_after_poison_lifts(stack):
     statuses = client.get_statuses()
     assert statuses["jobs"]["poisonscan_9_0"]["status"] == "complete"
     assert client.dead_letter_jobs() == []
+
+
+def test_preempted_worker_hard_killed_mid_drain_recovers(stack):
+    """Preemption soak (docs/RESILIENCE.md §Preemption): a worker with
+    a finished chunk stranded in its spool gets a preemption notice,
+    and the provider's kill lands before the graceful drain finishes —
+    the armed worker.drain clause IS the kill -9 mid-drain-upload.
+    Lease expiry hands the chunk to a rescue worker, the dead worker's
+    surviving spool is fenced off on replay (no double-terminal), and
+    the output stays bit-identical to a fault-free baseline."""
+    cfg, srv, tmp_path = stack
+    client = JobClient(cfg.resolve_url(), cfg.api_key)
+
+    rows = _victim_rows()
+    _submit(client, tmp_path, "prebase_1", rows, batch=len(rows))
+    base = _worker(cfg, "base-w")
+    base.cfg.max_jobs = 1
+    base.process_jobs()
+    baseline_raw = client.fetch_raw("prebase_1")
+    assert baseline_raw
+
+    # chunk 0's upload fails past the whole retry budget → spooled.
+    # max_jobs=1 stops the doomed worker right after the spool write
+    # (before any idle-loop replay could drain it): that frozen moment
+    # is "the preemption notice arrived mid-upload"
+    install_plan(
+        "transport.put_chunk/preemptscan_1_0:1-3;"
+        "worker.drain/doomed:*"
+    )
+    _submit(client, tmp_path, "preemptscan_1", rows, batch=len(rows))
+    doomed_cfg = Config(**{
+        **cfg.__dict__, "worker_id": "doomed", "max_jobs": 1,
+        "spool_dir": str(tmp_path / "doomed_spool"),
+    })
+    doomed = JobProcessor(doomed_cfg)
+    doomed.process_jobs()
+    assert len(doomed.spool) == 1, "chunk never reached the spool"
+    # the server-side notice journals the drain entry; the worker's
+    # graceful drain then aborts mid-flight — the armed clause IS the
+    # provider's kill landing before the upload finishes
+    assert srv.queue.drain_worker("doomed", reason="preempted")
+    doomed.request_drain("preempted")
+    assert doomed.drain("preempted") == "aborted"  # the kill won
+    assert len(doomed.spool) == 1                # nothing replayed or lost
+    # no deregister ever arrived: the drain entry is still journaled
+    assert srv.queue.draining_workers() == {"doomed": "preempted"}
+
+    # recovery path 1: lease expiry requeues the chunk to a rescuer
+    rescue = _worker(cfg, "rescue")
+    rescue.cfg.max_jobs = 1
+    rt = threading.Thread(target=rescue.process_jobs, daemon=True)
+    rt.start()
+    deadline = time.time() + 45
+    while rt.is_alive() and time.time() < deadline:
+        time.sleep(0.1)
+    if rt.is_alive():
+        rescue.stop_requested = True
+        rt.join(timeout=10)
+        raise AssertionError(
+            "rescue never finished; job record="
+            + repr(srv.queue.state.hget("jobs", "preemptscan_1_0"))
+            + " leases=" + repr(srv.queue.state.hgetall("leases"))
+            + " draining=" + repr(srv.queue.draining_workers())
+        )
+    chaos_raw = client.fetch_raw("preemptscan_1")
+    assert chaos_raw == baseline_raw.replace("prebase_1", "preemptscan_1")
+    rec = json.loads(srv.queue.state.hget("jobs", "preemptscan_1_0"))
+    assert rec["status"] == "complete" and rec["worker_id"] == "rescue"
+
+    # recovery path 2: the replacement node boots over the dead
+    # worker's disk and replays the spool — fencing rejects the stale
+    # completion (lease renewal bounces) instead of double-finalising
+    clear_plan()
+    doomed2 = JobProcessor(doomed_cfg)
+    assert len(doomed2.spool) == 1               # survived on disk
+    doomed2._replay_spool()
+    assert len(doomed2.spool) == 0               # fenced → dropped
+    assert client.fetch_raw("preemptscan_1") == chaos_raw  # untouched
+    rec = json.loads(srv.queue.state.hget("jobs", "preemptscan_1_0"))
+    assert rec["status"] == "complete" and rec["worker_id"] == "rescue"

@@ -442,6 +442,7 @@ def test_autoscale_recommend_and_dry_run(tmp_path):
     assert rec == {
         "prefix": "node", "queue_depth": 9, "current_nodes": 0,
         "target_nodes": 3, "action": "spin-up", "dry_run": True,
+        "forecast_rate": 0.0, "forecast_jobs": 0.0, "scale_to_zero": False,
     }
     # dry-run: apply() recommends but NEVER touches the provider
     out = adv.apply("node")
@@ -459,9 +460,13 @@ def test_autoscale_apply_scales_up_and_down(tmp_path):
     out = adv.apply("node")
     assert out["applied"] and out["target_nodes"] == 2  # clamped at max
     assert provider.list_nodes("node") == ["node1", "node2"]
-    # drain the queue → scale to min, tearing down highest names first
+    # drain the queue → scale to min, tearing down highest names first;
+    # scale-down waits out the hysteresis streak before acting
     while q.next_job("w") is not None:
         pass
+    for _ in range(adv.scaledown_hysteresis - 1):
+        out = adv.apply("node")
+        assert out["action"] == "hold" and "applied" not in out
     out = adv.apply("node")
     assert out["action"] == "spin-down" and out["applied"]
     assert provider.list_nodes("node") == []

@@ -606,3 +606,246 @@ def test_engine_device_breaker_recovers_after_cooldown():
     assert eng.stats.degraded_batches == degraded_before
     assert not eng._device_breakers.any_open()
     assert [m.template_ids for m in second] == [m.template_ids for m in first]
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain + preemption (docs/RESILIENCE.md §Preemption)
+# ---------------------------------------------------------------------------
+
+
+def test_drain_worker_refuses_dispatch_and_deregister_requeues_once():
+    q = _service(lease_seconds=30.0, max_attempts=5)
+    _queue_one(q)
+    job = q.next_job("pre")
+    jid = job["job_id"]
+    assert q.drain_worker("pre", reason="preempted")
+    assert not q.drain_worker("pre")  # already draining
+    assert q.drain_reason("pre") == "preempted"
+    assert q.next_job("pre") is None  # no dispatch while draining
+    assert q.statuses()["workers"]["pre"]["status"] == "preempted"
+    assert q.statuses()["draining"] == {"pre": "preempted"}
+    # the node dies before lease expiry: deregister hands the lease
+    # back NOW, exactly once
+    out = q.deregister_worker("pre")
+    assert out == {"requeued": 1, "was_draining": True}
+    assert q.drain_reason("pre") is None
+    rejob = q.next_job("healthy")
+    assert rejob is not None and rejob["job_id"] == jid
+    assert q.next_job("second") is None  # exactly one requeue
+    # fencing: the preempted worker's stale terminal bounces, the new
+    # assignee's lands — no double-terminal
+    assert not q.update_job(jid, {"status": "complete", "worker_id": "pre"})
+    assert q.update_job(jid, {"status": "complete", "worker_id": "healthy"})
+    rec = json.loads(q.state.hget("jobs", jid))
+    assert rec["status"] == JobStatus.COMPLETE
+    assert rec["worker_id"] == "healthy"
+
+
+def test_lease_expiry_wins_drain_race_still_exactly_one_requeue():
+    """The satellite race: lease expiry and graceful-drain deregister
+    both want to requeue the same lease — whichever runs first wins and
+    the other must see a job that is no longer the drained worker's."""
+    q = _service(lease_seconds=0.05, max_attempts=5)
+    _queue_one(q)
+    job = q.next_job("pre")
+    jid = job["job_id"]
+    q.drain_worker("pre", reason="preempted")
+    time.sleep(0.08)
+    # expiry runs first: the next dispatch requeues AND re-leases
+    rejob = q.next_job("healthy")
+    assert rejob is not None and rejob["job_id"] == jid
+    # the node's deregister lands after — it must NOT requeue again
+    out = q.deregister_worker("pre")
+    assert out == {"requeued": 0, "was_draining": True}
+    rec = json.loads(q.state.hget("jobs", jid))
+    assert rec["worker_id"] == "healthy"
+    assert not q.update_job(jid, {"status": "complete", "worker_id": "pre"})
+    assert q.update_job(jid, {"status": "complete", "worker_id": "healthy"})
+
+
+def test_drain_set_survives_journal_recovery_until_deregister():
+    """`drain` and `deregister` are WAL ops: a server kill -9 between
+    the notice and the worker's goodbye must recover still refusing to
+    feed the draining worker (docs/DURABILITY.md ordering)."""
+    from swarm_tpu.stores import MemoryBlobStore as _MB
+
+    blobs = _MB()
+    cfg = Config(lease_seconds=5.0)
+    q = JobQueueService(cfg, MemoryStateStore(), blobs, MemoryDocStore())
+    _queue_one(q)
+    assert q.next_job("pre") is not None
+    q.drain_worker("pre", reason="preempted")
+    # crash + replay over the same blob store
+    q2 = JobQueueService(cfg, MemoryStateStore(), blobs, MemoryDocStore())
+    assert q2.drain_reason("pre") == "preempted"
+    assert q2.next_job("pre") is None
+    assert q2.deregister_worker("pre")["was_draining"]
+    # the deregister is journaled too: the NEXT boot sees no drain entry
+    q3 = JobQueueService(cfg, MemoryStateStore(), blobs, MemoryDocStore())
+    assert q3.drain_reason("pre") is None
+
+
+def test_injected_fleet_preempt_gated_on_preemptible_fleet():
+    """An armed fleet.preempt clause must not burn its occurrence
+    counts on a NullProvider server (it cannot be preempted) — only a
+    fleet exposing ``preempt`` reaches the fault point."""
+    install_plan("fleet.preempt:1")
+    q_null = _service()
+    _queue_one(q_null)
+    assert q_null.next_job("w-null") is not None  # count NOT consumed
+    assert q_null.draining_workers() == {}
+
+    class _PreemptibleFleet:
+        def preempt(self, name):
+            return True
+
+    q = JobQueueService(
+        Config(lease_seconds=5.0), MemoryStateStore(), MemoryBlobStore(),
+        MemoryDocStore(), fleet=_PreemptibleFleet(),
+    )
+    _queue_one(q)
+    # occurrence 1 fires here: the poll turns into a preemption notice
+    assert q.next_job("w-sim") is None
+    assert q.draining_workers() == {"w-sim": "preempted"}
+
+
+# ---------------------------------------------------------------------------
+# Worker drain state machine (docs/RESILIENCE.md §Preemption)
+# ---------------------------------------------------------------------------
+
+
+class _DrainClient:
+    """Minimal transport for JobProcessor drain-path tests."""
+
+    def __init__(self, fail_replay=False):
+        self.fail_replay = fail_replay
+        self.deregistered = []
+        self.last_drain_reason = None
+        self.puts = []
+        self.updates = []
+
+    def get_job(self, worker_id):
+        return None
+
+    def renew_lease(self, job_id, worker_id, saturation=None):
+        if self.fail_replay:
+            raise TransportError("down")
+        return True
+
+    def put_output_chunk(self, scan_id, chunk_index, data):
+        self.puts.append((scan_id, chunk_index))
+        return True
+
+    def update_job(self, job_id, changes, worker_id=None):
+        self.updates.append(job_id)
+        return True
+
+    def deregister(self, worker_id):
+        self.deregistered.append(worker_id)
+        return True
+
+
+def _drain_proc(tmp_path, client):
+    from swarm_tpu.worker.runtime import JobProcessor
+
+    cfg = Config(
+        worker_id="wd", poll_interval_idle_s=0.01,
+        spool_dir=str(tmp_path / "spool"),
+    )
+    return JobProcessor(cfg, client=client, work_dir=str(tmp_path / "wd"))
+
+
+def test_worker_drain_header_exits_poll_loop_and_deregisters(
+    tmp_path, capsys
+):
+    client = _DrainClient()
+    proc = _drain_proc(tmp_path, client)
+    client.last_drain_reason = "preempted"  # X-Swarm-Drain on next poll
+    proc.process_jobs()  # returns via the drain path, no jobs processed
+    assert proc.drain_outcome == "idle"
+    assert client.deregistered == ["wd"]
+    assert "worker drained (preempted): idle" in capsys.readouterr().out
+
+
+def test_worker_drain_flushes_spool_before_exit(tmp_path):
+    """Satellite (a): SIGTERM routes through drain, so a chunk spooled
+    during an earlier outage is flushed before the process exits."""
+    client = _DrainClient()
+    proc = _drain_proc(tmp_path, client)
+    proc.spool.put("s_1_0", "s_1", 0, "wd", b"x")
+    proc.request_drain("sigterm")  # what the signal handler does
+    proc.process_jobs()
+    assert client.puts == [("s_1", 0)]  # flushed, not stranded
+    assert len(proc.spool) == 0
+    assert proc.drain_outcome == "idle"
+    assert client.deregistered == ["wd"]
+
+
+def test_worker_drain_spooled_outcome_when_server_unreachable(tmp_path):
+    client = _DrainClient(fail_replay=True)
+    proc = _drain_proc(tmp_path, client)
+    proc.spool.put("s_1_0", "s_1", 0, "wd", b"x")
+    proc.request_drain("sigterm")
+    proc.process_jobs()
+    assert proc.drain_outcome == "spooled"
+    assert len(proc.spool) == 1  # survives on disk for the next process
+    assert client.deregistered == ["wd"]  # goodbye still attempted
+
+
+def test_worker_drain_aborted_by_injected_fault(tmp_path):
+    """An armed worker.drain clause is the kill -9 mid-drain: no
+    replay, no deregister — recovery belongs to lease expiry + the
+    on-disk spool + fencing."""
+    install_plan("worker.drain/wd:*")
+    client = _DrainClient()
+    proc = _drain_proc(tmp_path, client)
+    proc.spool.put("s_1_0", "s_1", 0, "wd", b"x")
+    proc.request_drain("preempted")
+    proc.process_jobs()
+    assert proc.drain_outcome == "aborted"
+    assert client.deregistered == [] and client.puts == []
+    assert len(proc.spool) == 1
+
+
+def test_worker_request_drain_first_reason_wins_and_reports_completed(
+    tmp_path,
+):
+    client = _DrainClient()
+    proc = _drain_proc(tmp_path, client)
+    proc._job_in_flight = True  # drain order lands mid-chunk
+    proc.request_drain("sigterm")
+    proc.request_drain("preempted")  # later reason must not override
+    assert proc.drain_requested == "sigterm"
+    proc._job_in_flight = False  # the lease was finished first
+    assert proc.drain("sigterm") == "completed"
+    assert proc.drain_outcome == "completed"
+
+
+# ---------------------------------------------------------------------------
+# Per-class shed + saturation drop (docs/GATEWAY.md)
+# ---------------------------------------------------------------------------
+
+
+def test_admission_sheds_bulk_before_interactive_and_drops_saturation():
+    from swarm_tpu.gateway.admission import (
+        AdmissionController,
+        PressureSnapshot,
+    )
+
+    ac = AdmissionController(
+        shed_pressure=0.9, shed_pressure_bulk=0.5,
+        shed_pressure_interactive=0.95,
+    )
+    snap = PressureSnapshot(saturation=0.7)
+    d_bulk = ac.decide("t", snap, now=0.0, qos="bulk")
+    assert not d_bulk.admitted and d_bulk.reason == "pressure"
+    assert ac.decide("t", snap, now=0.0, qos="interactive").admitted
+    assert ac.decide("t", snap, now=0.0).admitted  # global 0.9 rule
+    hot = PressureSnapshot(saturation=0.96)
+    assert not ac.decide("t", hot, now=0.0, qos="interactive").admitted
+    # satellite (b): a deregistered worker's saturation report drops
+    # NOW instead of pinning pressure until the TTL ages it out
+    ac.note_saturation("w1", 0.96, now=0.0)
+    assert ac.fleet_saturation(now=1.0) == 0.96
+    ac.drop_saturation("w1")
+    assert ac.fleet_saturation(now=1.0) == 0.0

@@ -122,6 +122,18 @@ REQUIRED_FAMILIES = (
     "swarm_monitor_diff_records_total",
     "swarm_monitor_rescan_cache_hit_ratio",
     "swarm_monitor_standing_specs",
+    # elastic fleet + graceful drain (docs/RESILIENCE.md §Preemption):
+    # registered at telemetry import (fleet_export), state/action/
+    # outcome combos pre-seeded — every family renders samples even on
+    # a NullProvider server that never scaled
+    "swarm_fleet_nodes",
+    "swarm_fleet_target_nodes",
+    "swarm_fleet_forecast_rate",
+    "swarm_fleet_scale_events_total",
+    "swarm_fleet_preemptions_total",
+    "swarm_fleet_coldstart_seconds",
+    "swarm_worker_drain_total",
+    "swarm_worker_drain_seconds",
 )
 
 

@@ -67,11 +67,15 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     SWARM_BENCH_CORPUS="tests/data/templates" \
     python bench.py --phase sharded --check-floor
 
-echo "== preflight: bench smoke (pipeline A/B + shard + restart smoke, both modes) =="
+echo "== preflight: bench smoke (pipeline A/B + shard + restart + autoscale smoke, both modes) =="
 # CI-fast A/B on the bundled corpus; rc gates on verdict identity only.
 # Includes the restart smoke (docs/DURABILITY.md): one mid-scan server
 # restart against the durable queue journal, rc-gated on raw identity
-# vs a restart-free baseline + zero lost jobs.
+# vs a restart-free baseline + zero lost jobs. Includes the autoscale
+# smoke (docs/RESILIENCE.md §Preemption): a mini diurnal curve against
+# the simulated preemptible fleet with one seeded preemption notice,
+# rc-gated on zero lost jobs + raw identity vs a fixed-fleet baseline
+# + bulk-sheds-before-interactive.
 # Forced to the CPU backend unless the operator pinned one — the smoke
 # validates feed mechanics and parity, not chip throughput. Includes
 # the shard_smoke clause (docs/SHARDING.md): the sharded serving path
@@ -88,9 +92,13 @@ echo "== preflight: chaos smoke (seeded fault plan, docs/RESILIENCE.md) =="
 # CPU oracle; a faulted cache.get/cache.put trips the tier breaker and
 # the scan degrades to L1-only, docs/CACHING.md; a faulted
 # aot.fetch/aot.put degrades the executable cache to compile-only,
-# docs/AOT.md); rc gates on verdict identity AND on the plan firing
+# docs/AOT.md; a fleet.preempt fires an injected dispatch-path
+# preemption notice and worker.drain aborts that worker's graceful
+# drain mid-flight, leaving recovery to lease expiry + the on-disk
+# spool + fencing, docs/RESILIENCE.md §Preemption); rc gates on
+# verdict identity AND on the plan firing
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SWARM_PIPELINE=on \
-    SWARM_FAULT_PLAN="seed=7;device.dispatch:1,3;cache.get:2,4;cache.put:1;aot.fetch:1-2;aot.put:1" \
+    SWARM_FAULT_PLAN="seed=7;device.dispatch:1,3;cache.get:2,4;cache.put:1;aot.fetch:1-2;aot.put:1;fleet.preempt:1;worker.drain:1" \
     python bench.py --smoke
 
 echo "== preflight: bench =="
