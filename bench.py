@@ -108,6 +108,12 @@ BASELINES = {
     # of its rows must be served by the shared tier.
     "dedup_warm_speedup": 3.0,
     "dedup_cache_hit_ratio": 0.9,
+    # device workflow gating A/B (docs/WORKFLOWS.md, ISSUE 20): gate
+    # planes decoded off the verdict tail vs the bit-identical host
+    # twin on the same engine and workflow-heavy fresh fleet (1.0 =
+    # parity; the tentpole's point is > 1, rc-gated on per-row result
+    # equality every repeat).
+    "workflow_device_speedup": 1.0,
 }
 
 ROWS = 2048
@@ -814,6 +820,175 @@ def bench_walk_ab(
         "identical": bool(ok),
         "walk_batched_pairs": stats.walk_batched_pairs,
         "walk_batch_rounds": stats.walk_batch_rounds,
+    }
+
+
+_WF_BENCH_N = 24
+
+
+def workflow_stress_templates(n_workflows: int = _WF_BENCH_N) -> list:
+    """Synthetic workflow-heavy corpus slice: every workflow is the
+    reference shape (a tech-detection trigger with NAMED matchers, a
+    tag-selected and a path-selected subtemplate behind the gates), so
+    the lowering exercises WFC_MATCHER conds, tag expansion and path
+    refs at fleet scale — the bundled demo corpus carries exactly ONE
+    workflow, which would measure dispatch overhead, not gating."""
+    from swarm_tpu.fingerprints.model import Matcher, Operation, Template
+
+    out = []
+    for k in range(n_workflows):
+        out.append(Template(
+            id=f"wfb-tech-{k}", protocol="http",
+            source_path=f"http/wfb-tech-{k}.yaml", tags=["wfbtech"],
+            operations=[Operation(matchers_condition="or", matchers=[
+                Matcher(type="word", part="body", name=f"wfb-cms-{k}",
+                        words=[f"powered by WfBench{k} engine"]),
+                Matcher(type="regex", part="header", name=f"wfb-hdr-{k}",
+                        regex=[rf"X-WfBench{k}: [0-9]+\.[0-9]+"]),
+            ])],
+        ))
+        out.append(Template(
+            id=f"wfb-vuln-{k}", protocol="http",
+            source_path=f"http/wfb-vuln-{k}.yaml", tags=[f"wfb{k}"],
+            operations=[Operation(matchers_condition="and", matchers=[
+                Matcher(type="word", part="body",
+                        words=[f"powered by WfBench{k} engine"]),
+                Matcher(type="word", part="body",
+                        words=["wfb-debug-build"]),
+            ])],
+        ))
+        out.append(Template(
+            id=f"wfb-panel-{k}", protocol="http",
+            source_path=f"http/wfb-panel-{k}.yaml", tags=[f"wfb{k}"],
+            operations=[Operation(matchers=[
+                Matcher(type="word", part="body",
+                        words=[f"WfBench{k} admin console"]),
+            ])],
+        ))
+        out.append(Template(
+            id=f"wfb-flow-{k}", protocol="workflow",
+            source_path=f"workflows/wfb-flow-{k}.yaml",
+            extra={"workflows": [{
+                "template": f"http/wfb-tech-{k}.yaml",
+                "matchers": [
+                    {"name": f"wfb-cms-{k}",
+                     "subtemplates": [{"tags": f"wfb{k}"}]},
+                    {"name": f"wfb-hdr-{k}",
+                     "subtemplates": [
+                         {"template": f"http/wfb-vuln-{k}.yaml"},
+                     ]},
+                ],
+            }]},
+        ))
+    return out
+
+
+def workflow_stress_rows(
+    n: int, n_workflows: int = _WF_BENCH_N, seed: int = 7
+) -> list:
+    """Fleet mix where most rows carry one workflow's trigger content
+    (the body OR the header named-matcher alternative) and many also
+    carry subtemplate markers, plus plain filler — every row salted so
+    the feed is fresh content, the case the gate planes serve."""
+    rows = realistic_rows(n, seed=seed)
+    rng = np.random.default_rng(seed * 17 + 3)
+    for i, r in enumerate(rows):
+        salt = bytes(rng.integers(97, 123, size=40, dtype=np.uint8))
+        k = i % n_workflows
+        shape = i % 5
+        parts = []
+        if shape in (0, 1, 2):  # body-trigger rows
+            parts.append(b"powered by WfBench%d engine" % k)
+            if shape != 2:
+                parts.append(b"wfb-debug-build")  # the vuln sub fires
+            if shape == 1:
+                parts.append(b"WfBench%d admin console" % k)
+        elif shape == 3:  # header-trigger alternative
+            r.header = (r.header or b"") + (
+                b"\r\nX-WfBench%d: %d.%d" % (k, i % 9, i % 7)
+            )
+            parts.append(b"wfb-debug-build")
+        # shape 4: plain fleet filler — no trigger fires
+        r.body = (
+            b"<!-- %s -->%s " % (salt, b" ".join(parts)) + r.body
+        )[:2000]
+    return rows
+
+
+def bench_workflow_ab(
+    base_templates, n_rows: int = 0, n_batches: int = 3, reps: int = 3,
+    n_workflows: int = _WF_BENCH_N,
+) -> dict:
+    """Paired interleaved A/B of workflow gating (docs/WORKFLOWS.md):
+    the host-twin reference (``device=False``) vs device gate planes
+    (``device=True``) sharing ONE engine over the same workflow-heavy
+    fresh fleet. Per-row result dicts must be equal on EVERY repeat —
+    the rc gate; the median-ratio pair is reported (the pipeline/walk
+    A/Bs' drift-cancelling scheme). Runner L1 memos and engine content
+    memos are cleared before every arm so both arms pay the identical
+    fresh-dispatch cost and the measured delta is the gating stage."""
+    import time as _time
+
+    from swarm_tpu.ops.engine import MatchEngine
+    from swarm_tpu.ops.workflows import WorkflowRunner
+
+    n_rows = n_rows or min(ROWS, 512)
+    templates = list(base_templates) + workflow_stress_templates(n_workflows)
+    eng = MatchEngine(
+        templates, mesh=None, batch_rows=n_rows, max_body=MAX_BODY,
+        max_header=MAX_HEADER,
+    )
+    dev = WorkflowRunner(templates, engine=eng, device=True)
+    twin = WorkflowRunner(templates, engine=eng, device=False)
+    if dev.plan is None or not dev.device:
+        raise RuntimeError("workflow A/B: no lowered gate planes")
+    batches = [
+        workflow_stress_rows(n_rows, n_workflows, seed=9100 + i)
+        for i in range(n_batches)
+    ]
+    dev.run(batches[0])  # warm the jit shapes outside timing
+
+    def run(runner):
+        eng.clear_content_memos()
+        with runner._memo_lock:
+            runner._wf_memo.clear()
+        t0 = _time.perf_counter()
+        outs = [runner.run(b) for b in batches]
+        dt = _time.perf_counter() - t0
+        return outs, (n_rows * n_batches / dt if dt > 0 else 0.0)
+
+    pairs = []
+    ok = True
+    fired_rows = 0
+    for _rep in range(reps):
+        out_t, rate_t = run(twin)
+        out_d, rate_d = run(dev)
+        ok = ok and out_t == out_d  # per-row dict equality, every repeat
+        fired_rows = sum(1 for b in out_d for per in b if per)
+        pairs.append((rate_t, rate_d))
+    # lower median on even rep counts (see bench_walk_ab): never report
+    # best-of-N as the trend metric
+    pairs.sort(key=lambda p: p[1] / max(p[0], 1e-9))
+    rate_t, rate_d = pairs[(len(pairs) - 1) // 2]
+    speedup = rate_d / max(rate_t, 1e-9)
+    log(
+        f"workflow A/B ({n_batches}x{n_rows} rows, "
+        f"{len(dev.workflows)} workflows, {int(dev.plan.num_terms)} "
+        f"lowered terms): twin {rate_t:.0f} -> device {rate_d:.0f} "
+        f"rows/s ({speedup:.2f}x, {fired_rows} workflow-firing rows); "
+        f"results {'identical' if ok else 'MISMATCH'}"
+    )
+    return {
+        "rows": n_rows,
+        "n_batches": n_batches,
+        "workflows": len(dev.workflows),
+        "host_only_workflows": len(dev.plan.host_only_ids),
+        "lowered_terms": int(dev.plan.num_terms),
+        "workflow_firing_rows": fired_rows,
+        "twin_rows_per_sec": round(rate_t, 1),
+        "device_rows_per_sec": round(rate_d, 1),
+        "speedup": round(speedup, 3),
+        "identical": bool(ok),
     }
 
 
@@ -2213,8 +2388,22 @@ def run_phase(phase: str) -> int:
         os.environ.setdefault("SWARM_BENCH_PHASE_PROBE_DEADLINE", "20")
     templates, db, dev = _setup_phase(
         need_corpus=phase in ("exact", "oracle", "device", "sharded",
-                              "shard_smoke")
+                              "shard_smoke", "workflow")
     )
+    if phase == "workflow":
+        wab = bench_workflow_ab(templates)
+        emit(
+            "workflow_device_speedup",
+            wab["speedup"],
+            "x (device gate planes vs host-twin workflow gating, "
+            "bit-identical per-row results)",
+            wab["speedup"] / BASELINES["workflow_device_speedup"],
+            extra={"workflow_ab": wab},
+        )
+        if not wab["identical"]:
+            log("!!! workflow device/twin per-row mismatch — phase FAILED")
+            return 1
+        return 0
     if phase == "exact":
         (
             exact, fresh_rate, fresh_walk, eng, engine_stats, device_rec,
@@ -2330,6 +2519,21 @@ def run_phase(phase: str) -> int:
             )
         else:
             log("!!! fresh host walk unmeasurably small; metric omitted")
+        # workflow gate-plane A/B (docs/WORKFLOWS.md, ISSUE 20): host
+        # twin vs device gate planes over the workflow-heavy synthetic
+        # fleet, rc-gated on bit-identical per-row workflow results
+        wfab = bench_workflow_ab(templates)
+        emit(
+            "workflow_device_speedup",
+            wfab["speedup"],
+            "x (device gate planes vs host-twin workflow gating, "
+            "bit-identical per-row results)",
+            wfab["speedup"] / BASELINES["workflow_device_speedup"],
+            extra={"workflow_ab": wfab},
+        )
+        if not wfab["identical"]:
+            log("!!! workflow device/twin per-row mismatch — phase FAILED")
+            return 1
         # the HEADLINE emits LAST within the phase (and the phase runs
         # last overall) so the driver's tail-parse captures the honest
         # end-to-end exact metric, not an auxiliary line
@@ -2350,6 +2554,8 @@ def run_phase(phase: str) -> int:
                 # the dispatch A/B record rides here too so one JSON
                 # line carries the whole device-path story
                 "dispatch_ab": dab,
+                # workflow gate-plane A/B (docs/WORKFLOWS.md)
+                "workflow_ab": wfab,
             },
         )
     elif phase == "service":
@@ -3890,6 +4096,22 @@ def run_smoke() -> int:
         wab["speedup"],
         extra={"walk_ab": wab},
     )
+    # workflow A/B rides the smoke too (docs/WORKFLOWS.md): device
+    # gate planes vs the bit-identical host twin over a workflow-heavy
+    # synthetic fleet on ONE engine — per-row result equality is
+    # rc-gated on every repeat; the speedup is recorded, not gated
+    wfab = bench_workflow_ab(
+        templates, n_rows=128, n_batches=2, reps=2, n_workflows=8
+    )
+    ok = ok and wfab["identical"]
+    emit(
+        "smoke_workflow_ab_speedup",
+        wfab["speedup"],
+        "x (device gate planes vs host-twin workflow gating, "
+        "bundled-corpus smoke)",
+        wfab["speedup"],
+        extra={"workflow_ab": wfab},
+    )
     # dedup fleet-replay smoke (docs/CACHING.md): the shared result
     # tier FORCED ON for a second engine lifetime — verdicts must be
     # bit-identical to the tier-off lifetime (rc-gated); speed and hit
@@ -4074,8 +4296,8 @@ def run_smoke() -> int:
             )
     if not ok:
         log(
-            "!!! pipeline/walk/shard/dedup/gateway/monitor/restart "
-            "verdict mismatch — smoke FAILED"
+            "!!! pipeline/walk/workflow/shard/dedup/gateway/monitor/"
+            "restart verdict mismatch — smoke FAILED"
         )
     return 0 if ok else 1
 
@@ -4087,7 +4309,8 @@ def run_smoke() -> int:
 #: synthesizes never delays the headline.
 PHASES = [
     "service", "service_full", "streaming", "jarm", "device", "sharded",
-    "aot", "latency", "monitor", "autoscale", "oracle", "exact",
+    "aot", "latency", "monitor", "autoscale", "workflow", "oracle",
+    "exact",
 ]
 
 

@@ -178,6 +178,36 @@ def decode_entry(raw: str) -> Optional[tuple]:
 
 
 # ---------------------------------------------------------------------------
+# Entry wire format (family "w"): per-content workflow gating results
+# ---------------------------------------------------------------------------
+
+
+def encode_workflow_entry(per: dict) -> Optional[str]:
+    """One workflow gating result ``{workflow_id: [template ids]}`` →
+    compact JSON string (None when an id holds something JSON can't
+    carry — the entry is simply not shared, never mangled)."""
+    try:
+        return json.dumps(
+            {str(k): sorted(str(t) for t in v) for k, v in per.items()},
+            separators=(",", ":"),
+            sort_keys=True,
+        )
+    except (TypeError, ValueError):
+        return None
+
+
+def decode_workflow_entry(raw: str) -> Optional[dict]:
+    """JSON string → ``{workflow_id: [template ids]}``; None on
+    anything malformed (a corrupt entry is a MISS, never an exception
+    on the gating path)."""
+    try:
+        doc = json.loads(raw)
+        return {str(k): [str(t) for t in v] for k, v in doc.items()}
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
 # The shared tier proper
 # ---------------------------------------------------------------------------
 
@@ -487,7 +517,7 @@ class ResultCacheClient:
         self._misses = 0
         # per-family [hits, misses]: the bench's gated hit ratio reads
         # verdict-family outcomes only (confirm digests would dilute it)
-        self._fam: dict = {"v": [0, 0], "c": [0, 0]}
+        self._fam: dict = {"v": [0, 0], "c": [0, 0], "w": [0, 0]}
         self._digest: Optional[str] = None
         self._epoch: Optional[str] = None
         self._epoch_read_at = 0.0
@@ -714,6 +744,73 @@ class ResultCacheClient:
             "c", "confirm",
             [(confirm_digest(k), "1" if v else "0") for k, v in items],
         )
+
+    # -- workflow step family ------------------------------------------
+    def lookup_workflows(self, rows: Sequence) -> dict:
+        """Batched workflow-family lookup: row position → decoded
+        gating result ``{workflow_id: [template ids]}`` for every row
+        whose content the tier holds. Same content addressing as the
+        verdict family (``row_digest``) under the separate ``"w"``
+        namespace — entries cover content-pure workflows only, so a
+        fleet-known trigger's gating costs this lookup, not a device
+        dispatch. Recent-miss suppression is tracked under a
+        ``"w:"``-prefixed key so a workflow miss never suppresses the
+        verdict family's lookup of the same content (or vice versa)."""
+        if not rows:
+            return {}
+        epoch = self._ensure_bound()
+        if epoch is None:
+            return {}
+        members: dict = {}
+        for i, row in enumerate(rows):
+            if not getattr(row, "alive", True):
+                continue
+            members.setdefault(row_digest(row), []).append(i)
+        with self._lock:
+            digests = [
+                d for d in members if ("w:" + d) not in self._recent_miss
+            ]
+        if not digests:
+            return {}
+        t0 = time.perf_counter()
+        got = self._guarded(
+            "cache.get", "workflow",
+            lambda: self._tier.get_many("w", epoch, digests),
+        )
+        if got is None:
+            return {}  # degraded: no real lookup to time
+        MEMO_LOOKUP_SECONDS.labels().observe(time.perf_counter() - t0)
+        out: dict = {}
+        hits = misses = 0
+        missed: list = []
+        for digest in digests:
+            raw = got.get(digest)
+            entry = decode_workflow_entry(raw) if raw is not None else None
+            if entry is None:
+                misses += 1
+                missed.append("w:" + digest)
+                continue
+            hits += 1
+            for i in members[digest]:
+                out[i] = entry
+        self._count(hits, misses, missed, "w")
+        return out
+
+    def writeback_workflows(self, entries: list) -> int:
+        """Batch-write freshly gated results: ``[(row, per_dict), ...]``
+        → the workflow family (``per_dict`` restricted to content-pure
+        workflows by the caller). Returns the stored count (0 when
+        fenced/degraded/disabled)."""
+        if not self.writeback or not entries:
+            return 0
+        items: list = []
+        for row, per in entries:
+            if not getattr(row, "alive", True):
+                continue
+            value = encode_workflow_entry(per)
+            if value is not None:
+                items.append((row_digest(row), value))
+        return self._put("w", "workflow", items)
 
     # -- shared plumbing -----------------------------------------------
     def _put(self, family: str, label: str, items: list) -> int:

@@ -1293,6 +1293,11 @@ class CompiledDB:
     templates: list  # the NT Template objects (for host confirmation)
     stats: dict
 
+    # --- workflow DAG gate planes (docs/WORKFLOWS.md) ---
+    # class-attribute default so pre-workflow dbcache pickles unpickle
+    # to a plan-less db (engine then keeps the host twin for workflows)
+    wf: Optional["WorkflowPlan"] = None
+
     def __getstate__(self):
         # the derived device layout (build_device_layout cache) must
         # not ride dbcache pickles: it duplicates every array and is
@@ -1315,6 +1320,296 @@ class CompiledDB:
         Shared by the single-chip and sharded paths so overflow (and
         therefore host-confirm volume) behaves identically."""
         return (8 + int(self.rx_seq_always.sum())) * batch_rows
+
+
+# ---------------------------------------------------------------------------
+# Workflow DAG lowering (docs/WORKFLOWS.md)
+# ---------------------------------------------------------------------------
+# A workflow's trigger→subtemplate DAG flattens to DNF: every leaf emit
+# (workflow id, reported template id) is reached through one or more
+# conjunctions of *conditions* — trigger hits and named-matcher gates.
+# Conditions reference the verdict planes eval_verdicts already builds,
+# so the gate-apply stage is a gather + Kleene AND/OR over the batch.
+
+#: condition kinds (cond_kind values)
+WFC_HIT_DEV = 0  # device template verdict column (cond_idx = t_idx)
+WFC_OP = 1  # device operation verdict (AND-op gate: op ⇒ matcher)
+WFC_MATCHER = 2  # device matcher verdict (OR-op gate: matcher ⇒ op)
+WFC_HIT_HOST = 3  # template not device-lowered — host hit set decides
+WFC_GATE_HOST = 4  # gate needs the exact CPU oracle (cpu_ref names)
+
+#: DNF shape caps — a workflow that exceeds them is NOT device-lowered
+#: (it stays on the bit-identical host twin), never silently truncated
+WF_MAX_CONDS = 8  # conditions per term (bounds DAG nesting depth)
+WF_MAX_TERMS = 4096  # corpus-wide term budget
+WF_MAX_TERMS_PER_WF = 512  # per-workflow fan-out budget
+
+
+@dataclasses.dataclass
+class WorkflowPlan:
+    """Device-resident workflow gate tables (one per CompiledDB).
+
+    Kleene semantics ride the existing verdict planes: a term is
+    certainly-false as soon as one cond is certainly-false (the
+    dominant no-trigger case — decided on device), certainly-true only
+    when every cond is certainly-true; host kinds (3/4) are
+    (False, uncertain) on device and resolved per row at condition
+    granularity by the runner.
+    """
+
+    cond_kind: np.ndarray  # int32 [NC] — WFC_*
+    cond_idx: np.ndarray  # int32 [NC] — t/op/m id (-1 for host kinds)
+    cond_template: list  # str [NC] — source template id
+    cond_name: list  # str [NC] — gate name ("" for hit conds)
+    term_cond: np.ndarray  # int32 [NTERM, WF_MAX_CONDS] — pad -1 = TRUE
+    term_emit: np.ndarray  # int32 [NTERM] — emit column this term sets
+    emits: list  # [(workflow_id, template_id)] [NE]
+    workflow_ids: list  # str — workflows lowered onto the device
+    host_only_ids: list  # str — workflows the host twin still owns
+    stats: dict
+
+    @property
+    def num_conds(self) -> int:
+        return int(self.cond_kind.shape[0])
+
+    @property
+    def num_terms(self) -> int:
+        return int(self.term_cond.shape[0])
+
+    @property
+    def num_emits(self) -> int:
+        return len(self.emits)
+
+
+def _empty_workflow_plan(host_only_ids: list, stats: dict) -> WorkflowPlan:
+    return WorkflowPlan(
+        cond_kind=np.zeros((0,), dtype=np.int32),
+        cond_idx=np.zeros((0,), dtype=np.int32),
+        cond_template=[],
+        cond_name=[],
+        term_cond=np.zeros((0, WF_MAX_CONDS), dtype=np.int32),
+        term_emit=np.zeros((0,), dtype=np.int32),
+        emits=[],
+        workflow_ids=[],
+        host_only_ids=host_only_ids,
+        stats=stats,
+    )
+
+
+class _WfBail(Exception):
+    """A workflow blew a DNF cap — fall back to the host twin."""
+
+
+def lower_workflows(all_templates: list, db: "CompiledDB") -> WorkflowPlan:
+    """Flatten every workflow DAG into the device gate tables.
+
+    Gate decomposition mirrors ``cpu_ref`` name semantics exactly (a
+    name fires iff its matcher individually matched AND its operation
+    matched): AND-condition op ⇒ the op verdict suffices; OR-condition
+    op ⇒ the matcher verdict suffices. Any alternative that is not
+    device-exact demotes the WHOLE gate to one ``WFC_GATE_HOST`` cond —
+    host resolution computes full gate truth anyway, and mixing exact
+    and host alternatives would double-count terms.
+    """
+    from swarm_tpu.fingerprints.workflows import TemplateIndex, parse_workflow
+
+    workflows = [
+        parse_workflow(t) for t in all_templates if t.protocol == "workflow"
+    ]
+    if not workflows:
+        return _empty_workflow_plan([], {"workflows_total": 0})
+    index = TemplateIndex(
+        [t for t in all_templates if t.protocol != "workflow"]
+    )
+    tidx_of = {t.id: i for i, t in enumerate(db.templates)}
+    op_of: dict[tuple, int] = {}
+    for op_id in range(db.op_src.shape[0]):
+        op_of[(int(db.op_src[op_id, 0]), int(db.op_src[op_id, 1]))] = op_id
+    m_of: dict[tuple, int] = {}
+    for m_id in range(db.m_src.shape[0]):
+        ti, ol, ml = (int(x) for x in db.m_src[m_id])
+        if ml >= 0:
+            m_of[(ti, ol, ml)] = m_id
+
+    cond_rows: list[tuple[int, int, str, str]] = []
+    cond_index: dict[tuple, int] = {}
+
+    def cond_id(kind: int, idx: int, tid: str, name: str = "") -> int:
+        key = (kind, idx, tid, name)
+        ci = cond_index.get(key)
+        if ci is None:
+            ci = len(cond_rows)
+            cond_index[key] = ci
+            cond_rows.append(key)
+        return ci
+
+    def hit_cond(t) -> int:
+        ti = tidx_of.get(t.id)
+        if ti is None:
+            return cond_id(WFC_HIT_HOST, -1, t.id)
+        return cond_id(WFC_HIT_DEV, ti, t.id)
+
+    def gate_alts(t, name: str):
+        """→ list of alternative cond ids (ORed via term duplication),
+        or None when no matcher carries the name (dead gate)."""
+        found = False
+        host = False
+        alts: list[int] = []
+        ti = tidx_of.get(t.id)
+        for ol, op in enumerate(t.operations):
+            for ml, m in enumerate(op.matchers):
+                if m.name != name:
+                    continue
+                found = True
+                if ti is None:
+                    host = True
+                    continue
+                op_id = op_of.get((ti, ol))
+                if op_id is None:
+                    host = True  # op not lowered (e.g. extractor-only)
+                elif (op.matchers_condition or "or").lower() == "and":
+                    # AND op: op fired ⇒ every matcher fired ⇒ name
+                    alts.append(cond_id(WFC_OP, op_id, t.id, name))
+                elif bool(db.op_prefilter[op_id]):
+                    # superset-lowered op: per-matcher bits weakened
+                    host = True
+                else:
+                    m_id = m_of.get((ti, ol, ml))
+                    if m_id is None:
+                        host = True
+                    else:
+                        alts.append(cond_id(WFC_MATCHER, m_id, t.id, name))
+        if not found:
+            return None
+        if host or not alts:
+            return [cond_id(WFC_GATE_HOST, -1, t.id, name)]
+        return alts
+
+    # (sorted cond tuple, (workflow_id, template_id)) — dedup via set
+    term_list: list[tuple[tuple, tuple]] = []
+    term_seen: set = set()
+    workflow_ids: list = []
+    host_only_ids: list = []
+    steps_compiled = 0
+
+    for wf in workflows:
+        wf_terms: list[tuple[tuple, tuple]] = []
+
+        def add_term(conds: list, tid: str, _wf=wf, _acc=wf_terms) -> None:
+            cs = tuple(sorted(set(conds)))
+            if len(cs) > WF_MAX_CONDS or len(_acc) >= WF_MAX_TERMS_PER_WF:
+                raise _WfBail()
+            _acc.append((cs, (_wf.id, tid)))
+
+        def walk_ref(ref, conds: list) -> None:
+            for t in index.resolve(ref):
+                base = conds + [hit_cond(t)]
+                if ref.matchers:
+                    for gate in ref.matchers:
+                        alts = gate_alts(t, gate.name)
+                        if alts is None:
+                            continue
+                        for a in alts:
+                            for sub in gate.subtemplates:
+                                walk_ref(sub, base + [a])
+                elif ref.subtemplates:
+                    for sub in ref.subtemplates:
+                        walk_ref(sub, base)
+                else:
+                    add_term(base, t.id)
+
+        try:
+            for step in wf.steps:
+                triggers = []
+                if step.template:
+                    t = index.by_path(step.template)
+                    if t is not None:
+                        triggers.append(t)
+                for tag in step.tags:
+                    triggers.extend(index.by_tag.get(tag.lower(), []))
+                for trigger in triggers:
+                    base = [hit_cond(trigger)]
+                    if step.matchers:
+                        for gate in step.matchers:
+                            alts = gate_alts(trigger, gate.name)
+                            if alts is None:
+                                continue
+                            for a in alts:
+                                for ref in gate.subtemplates:
+                                    walk_ref(ref, base + [a])
+                    elif step.subtemplates:
+                        for ref in step.subtemplates:
+                            walk_ref(ref, base)
+                    else:
+                        add_term(base, trigger.id)
+            if len(term_list) + len(wf_terms) > WF_MAX_TERMS:
+                raise _WfBail()
+        except _WfBail:
+            host_only_ids.append(wf.id)
+            continue
+        workflow_ids.append(wf.id)
+        steps_compiled += len(wf.steps)
+        for entry in wf_terms:
+            if entry not in term_seen:
+                term_seen.add(entry)
+                term_list.append(entry)
+
+    stats = {
+        "workflows_total": len(workflows),
+        "workflows_device": len(workflow_ids),
+        "workflows_host_only": len(host_only_ids),
+        "steps_compiled": steps_compiled,
+        "terms": len(term_list),
+    }
+    if not term_list:
+        return _empty_workflow_plan(host_only_ids, stats)
+
+    # compact to the conds actually referenced (bailed workflows may
+    # have allocated strays) and allocate emit columns
+    used = sorted({c for cs, _ in term_list for c in cs})
+    remap = {c: i for i, c in enumerate(used)}
+    emits: list = []
+    emit_of: dict[tuple, int] = {}
+    term_cond = np.full((len(term_list), WF_MAX_CONDS), -1, dtype=np.int32)
+    term_emit = np.zeros((len(term_list),), dtype=np.int32)
+    for row, (cs, emit_key) in enumerate(term_list):
+        for j, c in enumerate(cs):
+            term_cond[row, j] = remap[c]
+        ei = emit_of.get(emit_key)
+        if ei is None:
+            ei = len(emits)
+            emit_of[emit_key] = ei
+            emits.append(emit_key)
+        term_emit[row] = ei
+    stats["conds"] = len(used)
+    stats["emits"] = len(emits)
+    return WorkflowPlan(
+        cond_kind=np.array([cond_rows[c][0] for c in used], dtype=np.int32),
+        cond_idx=np.array([cond_rows[c][1] for c in used], dtype=np.int32),
+        cond_template=[cond_rows[c][2] for c in used],
+        cond_name=[cond_rows[c][3] for c in used],
+        term_cond=term_cond,
+        term_emit=term_emit,
+        emits=emits,
+        workflow_ids=workflow_ids,
+        host_only_ids=host_only_ids,
+        stats=stats,
+    )
+
+
+def wf_arrays_np(plan: WorkflowPlan) -> dict:
+    """The workflow gate tables as one host pytree (the wf sub-layout
+    of the verdict arguments). Host kinds gather with a clipped index
+    and are masked to (False, uncertain) by ``cond_host``."""
+    return {
+        "cond_kind": plan.cond_kind,
+        "cond_idx": np.maximum(plan.cond_idx, 0).astype(np.int32),
+        "cond_host": (plan.cond_kind >= WFC_HIT_HOST),
+        "term_cond": plan.term_cond,
+        "term_emit": plan.term_emit,
+        # zeros of shape [NE]: gives the kernel a static emit width
+        "emit_pad": np.zeros((plan.num_emits,), dtype=np.bool_),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -1482,7 +1777,8 @@ def verdict_arrays_np(db: "CompiledDB") -> dict:
     """Every matcher/op/template array ``eval_verdicts`` reads, as one
     host pytree (the verdict half of the argument layout)."""
     kind = db.m_kind
-    return {
+    wf = getattr(db, "wf", None)
+    out = {
         "m_cond_and": db.m_cond_and,
         "m_negative": db.m_negative,
         "m_residue": db.m_residue,
@@ -1507,6 +1803,11 @@ def verdict_arrays_np(db: "CompiledDB") -> dict:
         "t_op_buckets": _bucket_arrays(db.t_op_buckets),
         "rx_m_ids": db.rx_m_ids,
     }
+    # workflow gate tables ride the same pytree — only when the corpus
+    # actually lowered terms (keeps plan-less pytrees byte-identical)
+    if wf is not None and wf.num_terms:
+        out["wf"] = wf_arrays_np(wf)
+    return out
 
 
 def rx_variants(db: "CompiledDB") -> list:
@@ -2697,4 +2998,8 @@ def compile_corpus(
     # a few ints per entry) — absent on pre-delta pickles, which then
     # simply take the full-rebuild path
     out_db._table_keys = table_keys
+    # workflow DAGs lower against the finished device id spaces (the
+    # delta path rebuilds the plan too — gate tables are tiny)
+    out_db.wf = lower_workflows(list(templates), out_db)
+    stats["workflows"] = out_db.wf.stats
     return out_db

@@ -34,6 +34,7 @@ from typing import Callable, Optional
 
 from swarm_tpu.datamodel import JobStatus, chunk_generator, chunk_output_key
 from swarm_tpu.monitor import feed as monitor_feed
+from swarm_tpu.monitor import notify as monitor_notify
 from swarm_tpu.monitor.diff import (
     MonitorPlaneStore,
     diff_epoch,
@@ -89,6 +90,9 @@ class MonitorService:
         ticker thread. Tests and the bench drive ``tick``/``drain``
         directly with ``run_thread=False``."""
         self._reconcile()
+        # corpus-delta subscription: a live engine's refresh_corpus in
+        # this process turns into a journaled due-now touch (below)
+        monitor_notify.register(self)
         if run_thread and self._thread is None:
             self._thread = threading.Thread(
                 target=self._run, name="monitor-ticker", daemon=True
@@ -96,11 +100,47 @@ class MonitorService:
             self._thread.start()
 
     def stop(self) -> None:
+        monitor_notify.unregister(self)
         self._stop.set()
         t = self._thread
         if t is not None:
             t.join(timeout=5.0)
             self._thread = None
+
+    # ------------------------------------------------------------------
+    # corpus-delta out-of-cadence re-evaluation
+    # ------------------------------------------------------------------
+    def on_corpus_delta(self, digest: Optional[str] = None) -> int:
+        """A corpus refresh can change any template's verdict, so every
+        unpaused standing spec is affected: persist a due-now touch
+        (``next_fire_at = 0.0``) through the journaled ``put_monitor``
+        path, and the next normal ``tick()`` fires one immediate diff
+        epoch per spec under the usual admission/shed/journal
+        discipline — the fire itself restores the cadence
+        (``next_fire_at = now + interval``), so one delta costs one
+        epoch, not a faster schedule.
+
+        Nothing fires here. The touch being DURABLE before any fire is
+        the crash contract: kill-9 between notify and fire recovers a
+        spec that is merely due — the next server's first tick fires
+        it once, late, exactly like a missed cadence. Returns the
+        number of specs touched."""
+        now = self._clock()
+        touched = 0
+        for spec in self.list_specs():
+            if spec.paused or spec.due(now):
+                continue  # paused stays parked; already-due fires anyway
+            wire = spec.to_wire()
+            wire["next_fire_at"] = 0.0
+            self._queue.put_monitor(wire)
+            touched += 1
+            emit_event(
+                "monitor.corpus_delta_touch",
+                monitor_id=spec.monitor_id,
+                tenant=spec.tenant,
+                corpus_digest=digest,
+            )
+        return touched
 
     def _run(self) -> None:
         tick_s = max(0.01, float(getattr(self._cfg, "monitor_tick_s", 0.25)))

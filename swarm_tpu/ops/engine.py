@@ -52,6 +52,12 @@ class RowMatches:
     # the group's representative row — duplicate members report 0
     # (the work genuinely wasn't repeated for them).
     confirmed_on_host: int = 0
+    # workflow gate planes for this row (docs/WORKFLOWS.md): packed
+    # (cond_v, cond_u, emit_v, emit_u) uint8 rows from the device
+    # gate-apply stage, or None when the row was memo-served, redone,
+    # degraded, or the corpus lowered no workflow terms — the runner
+    # then resolves every condition on the host (exact either way).
+    wf: Optional[tuple] = None
 
 
 @dataclasses.dataclass
@@ -79,6 +85,11 @@ class PackedMatches:
     # row -> host confirmations spent on it. Confirms happen once per
     # DISTINCT content (dedup) and land on the representative row.
     confirms_per_row: dict
+    # workflow gate planes (docs/WORKFLOWS.md): {"cond_v", "cond_u",
+    # "emit_v", "emit_u"} packed uint8 [B, ...] + "valid" bool [B]
+    # (False for memo-served / redone rows — their planes are stale or
+    # absent); None when the corpus lowered no workflow terms
+    wf: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -1226,15 +1237,25 @@ class MatchEngine:
                 ]
                 if hit:
                     tids_by_row[b] = hit
+        wf = packed.wf
         out = []
         for b in range(n):
             tids = tids_by_row.get(b, [])
             tids.extend(always_by_row.get(b, ()))
+            row_wf = None
+            if wf is not None and bool(wf["valid"][b]):
+                row_wf = (
+                    wf["cond_v"][b],
+                    wf["cond_u"][b],
+                    wf["emit_v"][b],
+                    wf["emit_u"][b],
+                )
             out.append(
                 RowMatches(
                     template_ids=tids,
                     extractions=extr_by_row.get(b, {}),
                     confirmed_on_host=conf.get(b, 0),
+                    wf=row_wf,
                 )
             )
         return out
@@ -1595,6 +1616,9 @@ class MatchEngine:
             np.zeros((B, nob), dtype=np.uint8),
             np.zeros((B, nmb), dtype=np.uint8),
             np.ones((B,), dtype=bool),
+            # no workflow gate planes: the runner resolves every
+            # condition on the host (exact by construction)
+            None,
         )
 
     def _gather_confirm_candidates(
@@ -2129,7 +2153,9 @@ class MatchEngine:
             # and extractions are bit-identical to the device path
             planes = self._oracle_planes(B)
             self.stats.degraded_batches += 1
-        pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow = planes
+        pt_value, pt_unc, pop_value, pop_unc, pm_unc, overflow, wf_planes = (
+            planes
+        )
         # slice off bucket/mesh row padding before the host walk: the
         # leading B positions on the single-device layout, a fancy-
         # index gather when the sharded placement interleaved real
@@ -2153,6 +2179,14 @@ class MatchEngine:
         pop_unc = _rows_view(pop_unc)
         pm_unc = _rows_view(pm_unc)
         overflow = _rows_view(overflow)
+        if wf_planes is not None:
+            # workflow gate planes (docs/WORKFLOWS.md): packed cond/emit
+            # value+uncertainty bits, sliced to the same row view; the
+            # caller invalidates redo rows (their planes were computed
+            # from unsound word bits)
+            wf_planes = tuple(
+                np.ascontiguousarray(_rows_view(p)) for p in wf_planes
+            )
         with self._stats_lock:
             dt_dev = time.perf_counter() - t0
             self.stats.device_seconds += dt_dev
@@ -2460,6 +2494,7 @@ class MatchEngine:
             deferred,
             set(redo_rows.tolist()),
             confirms,
+            wf_planes,
         )
 
     # ------------------------------------------------------------------
@@ -2543,6 +2578,7 @@ class MatchEngine:
             extractions: dict = {}
             host_always: list = []
             conf: dict = {}
+            wf_full: Optional[dict] = None
             if alive_idx:
                 live = self.match_packed([all_rows[i] for i in alive_idx])
                 back = {j: i for j, i in enumerate(alive_idx)}
@@ -2558,6 +2594,18 @@ class MatchEngine:
                 conf = {
                     back[rb]: n for rb, n in live.confirms_per_row.items()
                 }
+                if live.wf is not None:
+                    # dead rows keep valid=False planes: workflows
+                    # match nothing on them by the same contract
+                    wf_full = {
+                        k: np.zeros(
+                            (len(all_rows),) + v.shape[1:], dtype=v.dtype
+                        )
+                        for k, v in live.wf.items()
+                    }
+                    for j, i in enumerate(alive_idx):
+                        for k, v in live.wf.items():
+                            wf_full[k][i] = v[j]
             self.stats.rows += len(all_rows) - len(alive_idx)
             return PackedMatches(
                 bits=bits,
@@ -2565,6 +2613,7 @@ class MatchEngine:
                 extractions=extractions,
                 host_always_matches=host_always,
                 confirms_per_row=conf,
+                wf=wf_full,
             )
 
         rows = all_rows
@@ -2581,7 +2630,7 @@ class MatchEngine:
         nrows = [rows[uniq[s]] for s in new_ids]
         B = len(nrows)
         if batch is not None:
-            pt_value, uextractions, deferred, redo_pos, confirms = (
+            pt_value, uextractions, deferred, redo_pos, confirms, wf_slots = (
                 self._walk_plane(nrows, batch, matcher)
             )
         else:  # every slot served by the verdict memo
@@ -2590,6 +2639,7 @@ class MatchEngine:
             deferred = []
             redo_pos = set()
             confirms = {}
+            wf_slots = None
         self.stats.rows += len(rows)
         self.stats.batches += 1
         # memo-served rows = everything not mapped to a walked slot
@@ -2674,6 +2724,28 @@ class MatchEngine:
         # --- broadcast the unique plane to the source rows ---
         bits = ubits[back] if len(rows) else ubits[:0]
         bits = np.ascontiguousarray(bits)
+        wf_rows: Optional[dict] = None
+        if wf_slots is not None:
+            # workflow gate planes broadcast like the verdict plane;
+            # memo-served slots and redo slots stay valid=False (the
+            # runner resolves their conditions on the host)
+            cv, cu, ev, eu = wf_slots
+            uwf = {
+                "cond_v": np.zeros((U, cv.shape[1]), dtype=np.uint8),
+                "cond_u": np.zeros((U, cu.shape[1]), dtype=np.uint8),
+                "emit_v": np.zeros((U, ev.shape[1]), dtype=np.uint8),
+                "emit_u": np.zeros((U, eu.shape[1]), dtype=np.uint8),
+            }
+            uvalid = np.zeros((U,), dtype=bool)
+            for b in range(B):
+                s = new_ids[b]
+                uwf["cond_v"][s] = cv[b]
+                uwf["cond_u"][s] = cu[b]
+                uwf["emit_v"][s] = ev[b]
+                uwf["emit_u"][s] = eu[b]
+                uvalid[s] = b not in redo_pos
+            wf_rows = {k: v[back] for k, v in uwf.items()}
+            wf_rows["valid"] = uvalid[back]
         extractions = {}
         for (ub, tid), vals in uext_all.items():
             for i in members_of(ub):
@@ -2727,6 +2799,7 @@ class MatchEngine:
             extractions=extractions,
             host_always_matches=host_always_matches,
             confirms_per_row=conf_full,
+            wf=wf_rows,
         )
 
     # ------------------------------------------------------------------
@@ -2751,10 +2824,11 @@ class MatchEngine:
         extractions: dict = {}
         conf_full: dict = {}
         deferred_rows: list = []  # (row_i, t_idx) — decide per row
+        wf_rows: Optional[dict] = None
         if batch is not None:
             nrows = [rows[i] for i in miss_uniq]
             B = len(nrows)
-            pt_value, uext, deferred, redo_pos, confirms = (
+            pt_value, uext, deferred, redo_pos, confirms, wf_slots = (
                 self._walk_plane(nrows, batch, matcher, pending=pending)
             )
             t1 = time.perf_counter()
@@ -2762,6 +2836,28 @@ class MatchEngine:
             # broadcast walked bits to their member rows
             miss_rows = np.flatnonzero(state >= 0)
             bits[miss_rows] = pt_value[state[miss_rows]]
+            if wf_slots is not None:
+                # workflow gate planes for walked rows; memo-served
+                # rows stay valid=False (host-resolved by the runner)
+                cv, cu, ev, eu = wf_slots
+                R = len(rows)
+                sl = state[miss_rows]
+                wf_rows = {
+                    "cond_v": np.zeros((R, cv.shape[1]), dtype=np.uint8),
+                    "cond_u": np.zeros((R, cu.shape[1]), dtype=np.uint8),
+                    "emit_v": np.zeros((R, ev.shape[1]), dtype=np.uint8),
+                    "emit_u": np.zeros((R, eu.shape[1]), dtype=np.uint8),
+                }
+                wf_rows["cond_v"][miss_rows] = cv[sl]
+                wf_rows["cond_u"][miss_rows] = cu[sl]
+                wf_rows["emit_v"][miss_rows] = ev[sl]
+                wf_rows["emit_u"][miss_rows] = eu[sl]
+                slot_ok = np.ones((B,), dtype=bool)
+                for pos in redo_pos:
+                    slot_ok[pos] = False
+                valid = np.zeros((R,), dtype=bool)
+                valid[miss_rows] = slot_ok[sl]
+                wf_rows["valid"] = valid
             ext_by_pos: dict = {}
             for (b, tid), vals in uext.items():
                 ext_by_pos.setdefault(int(b), []).append((tid, vals))
@@ -2888,6 +2984,7 @@ class MatchEngine:
             extractions=extractions,
             host_always_matches=host_always_matches,
             confirms_per_row=conf_full,
+            wf=wf_rows,
         )
 
 
@@ -3008,10 +3105,18 @@ class MatchEngine:
         self._shared_seen.clear()
         # shared result tier: ONE namespace move — the epoch's digest
         # half covers the corpus content + lowering code
-        if self._result_cache is not None:
-            from swarm_tpu.cache.tier import corpus_digest
+        from swarm_tpu.cache.tier import corpus_digest
 
-            self._result_cache.bind_corpus(corpus_digest(self.templates))
+        digest = corpus_digest(self.templates)
+        if self._result_cache is not None:
+            self._result_cache.bind_corpus(digest)
+        # corpus-delta fan-out: any in-process monitor service turns
+        # this into a journaled due-now touch so standing specs fire
+        # one immediate out-of-cadence diff epoch against the new
+        # corpus (docs/MONITORING.md §Out-of-cadence re-evaluation)
+        from swarm_tpu.monitor import notify as monitor_notify
+
+        monitor_notify.notify_corpus_delta(digest)
         return stats
 
     def _ensure_vmemo(self, nbits: int):

@@ -78,16 +78,25 @@ def lru_store(cache: dict, key, val, cap: int = 0) -> None:
 
 
 def fused_plane_widths(db: "fpc.CompiledDB") -> list:
-    """Byte widths of the six ``full``-mode output planes in fused
-    order: t_value, t_unc, op_value, op_unc, m_unc (packed bits), then
-    the 1-byte overflow column."""
+    """Byte widths of the ``full``-mode output planes in fused order:
+    t_value, t_unc, op_value, op_unc, m_unc (packed bits), then — only
+    when the corpus lowered workflow gate tables — wf_cond_v,
+    wf_cond_u, wf_emit_v, wf_emit_u, and finally the 1-byte overflow
+    column."""
     # widths mirror eval_verdicts' plane allocations exactly: the
     # template planes are padded to max(NT, 1) there (an all-host-tail
     # corpus still emits one packed byte), the op/matcher planes are not
     nbt = (max(db.num_templates, 1) + 7) >> 3
     nbo = (db.op_src.shape[0] + 7) >> 3
     nbm = (db.m_src.shape[0] + 7) >> 3
-    return [nbt, nbt, nbo, nbo, nbm, 1]
+    widths = [nbt, nbt, nbo, nbo, nbm]
+    wf = getattr(db, "wf", None)
+    if wf is not None and wf.num_terms:
+        nbc = (wf.num_conds + 7) >> 3
+        nbe = (wf.num_emits + 7) >> 3
+        widths += [nbc, nbc, nbe, nbe]
+    widths.append(1)
+    return widths
 
 
 def fuse_planes(planes, overflow):
@@ -128,8 +137,13 @@ def split_fused(db: "fpc.CompiledDB", buf: np.ndarray):
             f"fused buffer is {buf.shape[1]} bytes wide, plane widths "
             f"sum to {off}"
         )
-    pt, pu, opv, opu, mu, ovf = outs
-    return pt, pu, opv, opu, mu, ovf[:, 0] != 0
+    if len(outs) == 10:
+        pt, pu, opv, opu, mu, cv, cu, ev, eu, ovf = outs
+        wf = (cv, cu, ev, eu)
+    else:
+        pt, pu, opv, opu, mu, ovf = outs
+        wf = None
+    return pt, pu, opv, opu, mu, ovf[:, 0] != 0, wf
 
 
 _DEV_METRICS: dict = {}
@@ -1809,6 +1823,10 @@ def eval_verdicts(
     uncertain matchers (engine.py) instead of the whole template.
     (No m_value plane: an undecided op's certain matchers are neutral
     by the Kleene argument, so the host never reads their values.)
+    When the corpus lowered workflow gate tables (``arrays["wf"]``,
+    docs/WORKFLOWS.md), four more planes follow: per-condition value/
+    uncertainty and per-emit value/uncertainty from the vectorized
+    gate-apply stage.
 
     Uncertainty is refined with three-valued logic at every reduction:
     a verdict already decided by its *certain* inputs (a certain-true
@@ -1999,8 +2017,72 @@ def eval_verdicts(
             gu.any(-1) & ~(gv & ~gu).any(-1)
         )
     if full:
+        wf = arrays.get("wf")
+        if wf is not None:
+            cond_v, cond_u, emit_v, emit_u = _apply_workflow_gates(
+                wf, t_value, t_unc, op_value, op_unc, m_value, m_unc
+            )
+            return (
+                t_value, t_unc, op_value, op_unc, m_unc,
+                cond_v, cond_u, emit_v, emit_u,
+            )
         return t_value, t_unc, op_value, op_unc, m_unc
     return t_value, t_unc
+
+
+def _apply_workflow_gates(
+    wf: dict, t_value, t_unc, op_value, op_unc, m_value, m_unc
+):
+    """Vectorized workflow gate-apply over the whole batch (the
+    device stage of docs/WORKFLOWS.md).
+
+    Gathers each DNF condition from the verdict planes just built,
+    ANDs them per term under Kleene three-valued logic, and ORs terms
+    into the emit plane. Host condition kinds (templates/gates the
+    device doesn't own) read as (False, uncertain); the runner resolves
+    those — and any other uncertain emit — per row at condition
+    granularity, never per workflow. ``m_value`` here is post-negation,
+    matching cpu_ref's individual-matcher semantics for named gates.
+    """
+    B = t_value.shape[0]
+    ck = wf["cond_kind"]  # [NC]
+    ci = wf["cond_idx"]  # [NC], already >= 0
+    host = wf["cond_host"]  # [NC]
+    is_t = ck == fpc.WFC_HIT_DEV
+    is_op = ck == fpc.WFC_OP
+    is_m = ck == fpc.WFC_MATCHER
+    # pad each source plane with one certain-False column so host-kind
+    # (clipped) indices gather in bounds whatever the plane width
+    pad = jnp.zeros((B, 1), dtype=bool)
+    tv = jnp.concatenate([t_value, pad], axis=1)
+    tu = jnp.concatenate([t_unc, pad], axis=1)
+    opv = jnp.concatenate([op_value, pad], axis=1)
+    opu = jnp.concatenate([op_unc, pad], axis=1)
+    mv = jnp.concatenate([m_value, pad], axis=1)
+    mu = jnp.concatenate([m_unc, pad], axis=1)
+    ti = jnp.where(is_t, ci, tv.shape[1] - 1)
+    oi = jnp.where(is_op, ci, opv.shape[1] - 1)
+    mi = jnp.where(is_m, ci, mv.shape[1] - 1)
+    cond_v = tv[:, ti] | opv[:, oi] | mv[:, mi]  # host kinds → False
+    cond_u = tu[:, ti] | opu[:, oi] | mu[:, mi] | host[None, :]
+
+    tc = wf["term_cond"]  # [NTERM, CMAX], pad -1 = vacuously TRUE
+    valid = tc >= 0
+    tcc = jnp.maximum(tc, 0)
+    g_v = jnp.where(valid[None], cond_v[:, tcc], True)  # [B, NTERM, C]
+    g_u = jnp.where(valid[None], cond_u[:, tcc], False)
+    # Kleene AND: one certain-false cond kills the term (the dominant
+    # no-trigger case — decided entirely on device); certain-true
+    # requires every cond certain-true
+    term_dead = (~g_v & ~g_u).any(-1)
+    term_true = (g_v & ~g_u).all(-1)
+
+    te = wf["term_emit"]  # [NTERM]
+    NE = wf["emit_pad"].shape[0]
+    zeros = jnp.zeros((B, NE), dtype=bool)
+    emit_v = zeros.at[:, te].max(term_true)
+    emit_p = zeros.at[:, te].max(~term_dead)
+    return cond_v, cond_u, emit_v, emit_p & ~emit_v
 
 
 def ensure_all_stream(streams: dict, lengths: dict):

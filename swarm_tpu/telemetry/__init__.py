@@ -46,3 +46,4 @@ from swarm_tpu.telemetry import aot_export  # noqa: E402,F401
 from swarm_tpu.telemetry import trace_export  # noqa: E402,F401
 from swarm_tpu.telemetry import monitor_export  # noqa: E402,F401
 from swarm_tpu.telemetry import fleet_export  # noqa: E402,F401
+from swarm_tpu.telemetry import workflow_export  # noqa: E402,F401

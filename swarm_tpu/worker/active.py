@@ -950,6 +950,13 @@ class ActiveScanner:
         live = self._liveness(targets) if need_live else []
         stats["live_targets"] = len(live)
 
+        # headless offload: launch the emulation round NOW so its
+        # network I/O overlaps the device waves below (shared pool,
+        # worker/headless.py); joined where its hits are consumed
+        headless_fut = None
+        if self.headless_scanner is not None and live:
+            headless_fut = self.headless_scanner.run_async(live)
+
         # index-sliced waves: never materialize the full (target × request)
         # cross product — 10k live targets × 3.2k requests is 32M tuples
         nreq = len(self.plan.requests)
@@ -1011,10 +1018,10 @@ class ActiveScanner:
                 for f in ssl_findings
             )
 
-        # headless pass: the browserless JS-free subset drives form
-        # flows / attribute-collection scripts per live target
-        if self.headless_scanner is not None and live:
-            h_hits = self.headless_scanner.run(live)
+        # headless join: the round launched after liveness ran
+        # overlapped with every device wave above
+        if headless_fut is not None:
+            h_hits = headless_fut.result()
             stats["headless_templates"] = len(
                 self.headless_scanner.templates
             )
@@ -1096,6 +1103,30 @@ class ActiveScanner:
                         g.setdefault(h.template_id, []).append(h)
             wf_hits: list[ActiveHit] = []
             seen_wf: set = set()
+            # batched gate re-confirm: every row-carrying hit of a
+            # gate-queried template rides ONE engine batch through the
+            # scheduler (QoS lanes, in-flight overlap, memo families)
+            # instead of a serial per-row host confirm inside
+            # evaluate_hits; recorded names (ssl) keep precedence
+            gate_tids = self.workflow_runner.gate_template_ids
+            needs: list = []
+            where: list = []
+            for gkey, hitmap in groups.items():
+                for tid, hhs in hitmap.items():
+                    if tid not in gate_tids or any(
+                        hh.matcher_names for hh in hhs
+                    ):
+                        continue
+                    for hh in hhs:
+                        if hh.row is not None:
+                            needs.append((tid, hh.row))
+                            where.append((gkey, tid))
+            resolved: dict[tuple, set] = {}
+            if needs:
+                for loc, names in zip(
+                    where, self.workflow_runner.resolve_gate_names(needs)
+                ):
+                    resolved.setdefault(loc, set()).update(names)
             for (host, port), hitmap in groups.items():
                 # ssl hits carry no Response row; their fired matcher
                 # names were recorded by the ssl scanner itself
@@ -1106,6 +1137,9 @@ class ActiveScanner:
                     for tid, hhs in hitmap.items()
                     if any(hh.matcher_names for hh in hhs)
                 }
+                for (gkey, tid), names in resolved.items():
+                    if gkey == (host, port):
+                        known[tid] = sorted(names)
                 per = self.workflow_runner.evaluate_hits(
                     set(hitmap),
                     lambda tid, _m=hitmap: [

@@ -22,10 +22,12 @@ principled subset needs no JS runtime and executes here:
   base the way the browser's property getters would.
 
 - **API-instrumentation hooks** (the postmessage-tracker /
-  postmessage-outgoing-tracker / window-name-domxss idiom): the hook
+  postmessage-outgoing-tracker / window-name-domxss /
+  location-domxss idiom): the hook
   script installs a wrapper that logs when the PAGE's own code calls
   the instrumented API at load time (``addEventListener('message')``,
-  ``postMessage(.., '*')``, a ``window.name`` flow into
+  ``postMessage(.., '*')``, a ``window.name`` or ``location.hash`` /
+  ``location.search`` flow into
   eval/document.write/innerHTML). Without a JS runtime the same
   load-time facts are read statically from the page's actual script
   content — inline ``<script>`` bodies, ``on*`` handler attributes,
@@ -57,11 +59,28 @@ principled subset needs no JS runtime and executes here:
   verdict (silent, never a guess); a page without the library yields
   no output, matching the browser's ReferenceError.
 
-Anything else needing a JS runtime — ``screenshot`` rendering — is
-classified ``js-required`` by :func:`classify` and keeps the honest
-skip marker. The documented
+- **screenshot as a no-op**: the capture itself needs a renderer, but
+  a template whose matchers/extractors only inspect response-derivable
+  state (status/header/body, emulated script outputs) never CONSUMES
+  the image — for those the ``screenshot`` step is an honest no-op and
+  the rest of the flow executes. A template that reads the capture
+  (a matcher/extractor part named after the screenshot step) keeps the
+  skip with its ``js-required-screenshot`` reason: a real render is
+  semantically required.
+
+Anything else needing a JS runtime is classified ``js-required`` by
+:func:`classify` and keeps the honest skip marker. The documented
 bound of the emulation: nodes inserted by page JavaScript are
 invisible (the DOM here is the served HTML, not a rendered tree).
+
+Execution scales through one process-wide bounded pool of emulation
+contexts (``SWARM_HEADLESS_THREADS`` / :func:`configure_headless`) —
+the browser-pool analogue of the engine's walk pool — and
+:meth:`HeadlessScanner.run_async` lets the active scanner overlap a
+whole headless round with its device batches. The pooled round is
+bit-identical to the serial reference path
+(``SWARM_HEADLESS_THREADS=0``): every job owns its session, and
+results assemble in job order.
 
 Matchers evaluate on the final page via the exact CPU oracle with
 nuclei's headless part names mapped (``resp``/``page``/``data`` → the
@@ -69,16 +88,19 @@ full response); matchers/extractors over a named script's output read
 the emulated script result.
 
 Reference: /root/reference/worker/artifacts/templates/headless/*.yaml
-plus cves/2022/CVE-2022-0776.yaml (8 headless templates: 7 execute —
-2 browserless + 4 hook-emulated + 1 version-check; screenshot stays
-honestly skipped).
+plus cves/2022/CVE-2022-0776.yaml (8 headless templates: 8 execute —
+2 browserless + 4 hook-emulated + 1 version-check + the screenshot
+template, whose capture is a no-op because its matchers read only
+response-derivable state).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 import re
-from concurrent.futures import ThreadPoolExecutor
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional, Sequence
 from urllib.parse import urljoin, urlencode, urlsplit, urlunsplit
 
@@ -492,7 +514,33 @@ def _hook_spec(code: str) -> Optional[dict]:
         r"innerHTML|document\.write|eval", code
     ):
         return {"kind": "window-name-sink"}
+    if re.search(
+        r"location\.hash|location\.search|document\.URL", code
+    ) and re.search(r"innerHTML|document\.write|eval", code):
+        # location-domxss idiom: the hook logs URL-derived strings
+        # (hash/search/href) flowing into the DOM-XSS sinks — same
+        # static read-back as the window.name tracker, one source over
+        # (the __proto__-style pollution hooks matched above, so a
+        # location-driven pollution loop never lands here)
+        return {"kind": "location-sink"}
     return None
+
+
+def _screenshot_consumed(t: Template, step: dict) -> bool:
+    """Whether anything reads the capture: a matcher/extractor part
+    named after the screenshot step (or the literal ``screenshot``).
+    Only then does the template semantically require a real render."""
+    args = step.get("args") or {}
+    name = str(step.get("name") or args.get("to") or "screenshot").lower()
+    parts = {name, "screenshot"}
+    for op in t.operations:
+        for m in op.matchers:
+            if (m.part or "").lower() in parts:
+                return True
+        for ex in op.extractors:
+            if (ex.part or "").lower() in parts:
+                return True
+    return False
 
 
 def classify(t: Template) -> Optional[str]:
@@ -517,6 +565,13 @@ def classify(t: Template) -> Optional[str]:
                 # hook emulation, js-required otherwise
                 if str(args.get("part") or "request") != "request":
                     needs_js_env = True
+                continue
+            if act == "screenshot":
+                # the capture needs a renderer; the FLOW doesn't. When
+                # nothing consumes the image the step is an honest
+                # no-op — otherwise keep the skip with its reason
+                if _screenshot_consumed(t, step):
+                    return "js-required-screenshot"
                 continue
             if act in ("text", "click"):
                 if str(args.get("by") or "") not in ("x", "xpath"):
@@ -549,6 +604,55 @@ def classify(t: Template) -> Optional[str]:
 
 # ---------------------------------------------------------------------------
 # execution
+
+# Process-wide bounded pool of emulation contexts — the browser-pool
+# analogue of the engine's walk pool (docs/HOST_WALK.md): every
+# HeadlessScanner in the process shares it, so concurrent scans can't
+# multiply thread counts, and an async round rides it while the device
+# engine chews its own batches.
+_POOL_LOCK = threading.Lock()  # guards: _POOL, _POOL_THREADS
+_POOL: Optional[ThreadPoolExecutor] = None
+_POOL_THREADS: Optional[int] = None
+
+
+def headless_threads() -> int:
+    """Effective shared-pool width: :func:`configure_headless` arg >
+    ``SWARM_HEADLESS_THREADS`` > 16. 0 pins the serial reference
+    path (every round runs inline, no pool)."""
+    with _POOL_LOCK:
+        n = _POOL_THREADS
+    if n is None:
+        env = os.environ.get("SWARM_HEADLESS_THREADS")
+        n = int(env) if env else 16
+    return max(0, int(n))
+
+
+def configure_headless(threads: Optional[int]) -> None:
+    """Re-point the shared emulation pool at runtime (bench A/B,
+    tests): shuts any existing pool down, then re-decides lazily on
+    next use. ``None`` restores env-derived sizing."""
+    global _POOL, _POOL_THREADS
+    with _POOL_LOCK:
+        pool, _POOL = _POOL, None
+        _POOL_THREADS = threads
+    if pool is not None:
+        pool.shutdown(wait=True)
+
+
+def _shared_pool() -> Optional[ThreadPoolExecutor]:
+    """The process pool, lazily built at the configured width; None
+    when the width is 0 (serial reference)."""
+    n = headless_threads()
+    if n <= 0:
+        return None
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="headless"
+            )
+        return _POOL
+
 
 _DEFAULT_HEADERS = (
     ("User-Agent", "Mozilla/5.0 (X11; Linux x86_64) swarm-tpu-headless"),
@@ -702,7 +806,9 @@ def _run_steps(t: Template, steps, sess: _Session, outputs: dict) -> bool:
     for step in steps:
         act = str(step.get("action") or "")
         args = step.get("args") or {}
-        if act in ("waitload", "sleep"):
+        if act in ("waitload", "sleep", "screenshot"):
+            # screenshot: classify admitted only unconsumed captures —
+            # the flow continues, the image is never read
             continue
         if act == "setheader":
             if str(args.get("part") or "request") != "request":
@@ -920,23 +1026,58 @@ _POSTMSG_RE = re.compile(r"\bpostMessage\s*\(")
 _NAME_ALIAS_RE = re.compile(
     r"(?:var|let|const)\s+(\w+)\s*=\s*window\.name\b"
 )
+#: location-source aliases: ``var h = location.hash`` (the optional
+#: trailing accessor — .substr(1), .slice(1) — still taints the alias)
+_LOC_ALIAS_RE = re.compile(
+    r"(?:var|let|const)\s+(\w+)\s*=\s*"
+    r"(?:window\.)?(location\.hash|location\.search|document\.URL)\b"
+)
 
 
-def _window_name_sinks(text: str) -> list:
-    """(sink, snippet) for flows of window.name into eval /
-    document.write / innerHTML — direct or via one local alias."""
-    names = [r"window\.name"]
-    names += [re.escape(m.group(1)) for m in _NAME_ALIAS_RE.finditer(text)]
+def _source_sinks(text: str, sources: list) -> list:
+    """(sink, source, snippet) for flows of the given source
+    expressions into eval / document.write / innerHTML — direct or via
+    one local alias. ``sources`` is ``[(label, pattern), ...]``."""
     out = []
-    for name in names:
+    for label, name in sources:
         for sink, pat in (
             ("eval", rf"\beval\s*\(\s*[^;\n]*?\b{name}\b"),
             ("document.write", rf"document\.write\s*\(\s*[^;\n]*?\b{name}\b"),
             ("innerHTML", rf"\.innerHTML\s*[+]?=\s*[^;\n]*?\b{name}\b"),
         ):
             for m in re.finditer(pat, text):
-                out.append((sink, m.group(0)[:120]))
+                out.append((sink, label, m.group(0)[:120]))
     return out
+
+
+def _window_name_sinks(text: str) -> list:
+    """(sink, snippet) for flows of window.name into eval /
+    document.write / innerHTML — direct or via one local alias."""
+    sources = [("window.name", r"window\.name")]
+    sources += [
+        ("window.name", re.escape(m.group(1)))
+        for m in _NAME_ALIAS_RE.finditer(text)
+    ]
+    return [
+        (sink, snippet)
+        for sink, _src, snippet in _source_sinks(text, sources)
+    ]
+
+
+def _location_sinks(text: str) -> list:
+    """(sink, source, snippet) for flows of location.hash /
+    location.search / document.URL into the DOM-XSS sinks — direct or
+    via one local alias (the location-domxss hook's read-back)."""
+    sources = [
+        ("location.hash", r"location\.hash"),
+        ("location.search", r"location\.search"),
+        ("document.URL", r"document\.URL"),
+    ]
+    sources += [
+        (m.group(2), re.escape(m.group(1)))
+        for m in _LOC_ALIAS_RE.finditer(text)
+    ]
+    return _source_sinks(text, sources)
 
 
 # --- prototype-pollution property model -----------------------------------
@@ -1086,6 +1227,15 @@ def _emulate_alerts(sess: "_Session") -> str:
                         "source": "window.name",
                         "stack": [f"at {label}"],
                     })
+        elif kind == "location-sink":
+            for label, text in scripts:
+                for sink, source, snippet in _location_sinks(text):
+                    alerts.append({
+                        "code": snippet,
+                        "sink": sink,
+                        "source": source,
+                        "stack": [f"at {label}"],
+                    })
         elif kind == "proto-pollution":
             alerts.extend(_pollution_probe(sess, hook))
     return _go_fmt(alerts)
@@ -1109,17 +1259,55 @@ class HeadlessScanner:
         self.connect_timeout = (
             float(spec.get("connect_timeout_ms", 3000)) / 1000.0
         )
+        # per-round in-flight cap on the SHARED pool (a wide fleet scan
+        # must not starve every other scanner's rounds); the pool's own
+        # width bounds the process
         self.concurrency = int(spec.get("headless_concurrency", 16))
 
     def run(self, targets) -> list:
-        """targets: (host, ip, port, tls) tuples (the liveness shape)."""
+        """targets: (host, ip, port, tls) tuples (the liveness shape).
+        One batched round over the shared pool — bit-identical to the
+        serial reference (results assemble in job order; every job
+        owns its session)."""
+        return self._run_round(targets)
+
+    def run_async(self, targets) -> Future:
+        """Start a round without blocking: the active scanner launches
+        this right after liveness and joins after its device waves, so
+        emulation I/O overlaps device batches. The round runs on a
+        dedicated coordinator thread (never a pool slot — a width-1
+        pool must not deadlock on its own coordinator) fanning jobs
+        into the shared pool."""
+        fut: Future = Future()
+
+        def round_main() -> None:
+            try:
+                fut.set_result(self._run_round(targets))
+            except BaseException as e:  # surfaced at .result()
+                fut.set_exception(e)
+
+        threading.Thread(
+            target=round_main, name="headless-round", daemon=True
+        ).start()
+        return fut
+
+    def _run_round(self, targets) -> list:
         if not self.templates or not targets:
             return []
         jobs = [
             (t, tgt) for tgt in targets for t in self.templates
         ]
-        with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
-            results = list(pool.map(lambda j: self._exec(*j), jobs))
+        pool = _shared_pool()
+        if pool is None:  # serial reference path
+            results = [self._exec(*j) for j in jobs]
+            return [h for h in results if h is not None]
+        results = []
+        cap = max(1, self.concurrency)
+        for i in range(0, len(jobs), cap):
+            futs = [
+                pool.submit(self._exec, *j) for j in jobs[i: i + cap]
+            ]
+            results.extend(f.result() for f in futs)
         return [h for h in results if h is not None]
 
     # ------------------------------------------------------------------

@@ -113,6 +113,12 @@ def _fake_phase_output(phase: str) -> str:
              "node servable; gate <= fleet_coldstart_slo_s, AOT-warm)",
              "vs_baseline": 3.31},
         ],
+        "workflow": [
+            {"metric": "workflow_device_speedup", "value": 1.19,
+             "unit": "x (device gate planes vs host-twin workflow "
+             "gating, bit-identical per-row results)",
+             "vs_baseline": 1.19},
+        ],
         "oracle": [
             {"metric": "cpu_oracle_rows_per_sec", "value": 12.0,
              "unit": "rows/sec", "vs_baseline": 1.0},

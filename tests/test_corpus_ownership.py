@@ -17,7 +17,10 @@ mirroring each subsystem's own intake filter:
 - sslscan (worker/sslscan.py:217 filters protocol == "ssl")
 - headless (worker/headless.py classify(): None = executes
   browserlessly, else an explicit reason marker)
-- workflows (ops/workflows.py parses protocol == "workflow")
+- device-workflow (fingerprints/compile.py lower_workflows: the DAG
+  lowered onto the device verdict tail's gate planes,
+  docs/WORKFLOWS.md)
+- workflows (ops/workflows.py host twin: overflow / unlowerable DAGs)
 """
 
 from __future__ import annotations
@@ -41,10 +44,12 @@ pytestmark = pytest.mark.skipif(
 DEVICE_PROTOCOLS = frozenset({"http", "network", "dns"})
 
 
-def _claim(t, headless_classify) -> str:
+def _claim(t, headless_classify, device_wf_ids=frozenset()) -> str:
     """The single execution path (or explicit skip) owning template t."""
     if t.protocol == "workflow":
-        return "workflows"
+        return (
+            "device-workflow" if t.id in device_wf_ids else "workflows"
+        )
     if t.protocol == "file":
         return "filescan"
     if t.protocol == "ssl":
@@ -60,16 +65,27 @@ def _claim(t, headless_classify) -> str:
 
 
 def test_every_template_claimed_exactly_once():
+    from swarm_tpu.fingerprints.dbcache import load_or_compile
     from swarm_tpu.worker.headless import classify
 
     templates, errors = load_corpus(REFERENCE_CORPUS)
     assert not errors
     assert len(templates) == 3989  # the reference corpus, in full
 
+    # the compiled DB's lowered workflow plan decides which DAGs run
+    # on the device gate planes vs the host twin (docs/WORKFLOWS.md)
+    _, db = load_or_compile(REFERENCE_CORPUS)
+    plan = getattr(db, "wf", None)
+    device_wf = (
+        set(plan.workflow_ids) - set(plan.host_only_ids)
+        if plan is not None
+        else set()
+    )
+
     # one claim per template OBJECT: the reference corpus carries one
     # duplicated id (sap-redirect appears at the corpus root and under
     # vulnerabilities/other/), so id-keyed accounting would undercount
-    claims = [_claim(t, classify) for t in templates]
+    claims = [_claim(t, classify, device_wf) for t in templates]
     counts = Counter(claims)
 
     # no template may fall through to an unknown protocol, and the
@@ -81,20 +97,29 @@ def test_every_template_claimed_exactly_once():
     assert sum(counts.values()) == len(templates)
 
     # family totals, pinned to the reference corpus shape: a loader or
-    # classifier change that reroutes a family shows up as a diff here
-    assert counts["workflows"] == 187
+    # classifier change that reroutes a family shows up as a diff here.
+    # Workflow templates split by execution path since the DAG lowering
+    # (docs/WORKFLOWS.md): device-lowered DAGs gate on the verdict
+    # tail's gate planes, overflow/unlowerable ones keep the host twin
+    # — together they still cover every workflow template exactly once,
+    # so nothing is newly orphaned
+    n_workflows = counts.get("device-workflow", 0) + counts.get(
+        "workflows", 0
+    )
+    assert n_workflows == 187
+    assert counts.get("device-workflow", 0) > 0  # the fast path is real
     assert counts["filescan"] == 76
     assert counts["sslscan"] == 5
-    # 7 of 8 headless templates execute (round-4/5 hook emulation +
-    # the version-check class); screenshot carries its explicit reason
-    assert counts["headless"] >= 5
+    # 8 of 8 headless templates execute (round-4/5 hook emulation +
+    # version-check, and the screenshot template whose capture is a
+    # no-op because nothing consumes the image); a future template
+    # that semantically requires a real render lands back on the skip
+    # list with its reason marker
     headless_skips = {
         c: n for c, n in counts.items() if c.startswith("skip:headless")
     }
-    assert counts["headless"] + sum(headless_skips.values()) == 8
-    # every declared skip carries a non-empty reason marker
-    for c in headless_skips:
-        assert c.split(":", 2)[2], c
+    assert counts["headless"] == 8
+    assert not headless_skips, headless_skips
     assert counts["device"] == len(templates) - 187 - 76 - 5 - 8
 
 

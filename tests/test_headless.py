@@ -1,13 +1,16 @@
 """Browserless headless-template subset (worker/headless.py).
 
-Covers: classification of the REAL reference headless corpus (7 of 8
+Covers: classification of the REAL reference headless corpus (8 of 8
 execute: 2 browserless + 4 hook-emulated incl. prototype-pollution +
-CVE-2022-0776's version-check; screenshot honestly skipped), the dvwa-style form
+CVE-2022-0776's version-check + screenshot, whose capture is a no-op
+when nothing consumes the image), the dvwa-style form
 login flow end to end against a local server (click/text/submit +
 cookie jar + redirect), the extract-urls attribute-collection script
-emulation with URL resolution, and the PPScan pollution probe
+emulation with URL resolution, the PPScan pollution probe
 (real navigations + static property model) with positive, hash-probe,
-and guarded/clean negative verdicts.
+and guarded/clean negative verdicts, and the shared emulation pool
+(pooled rounds bit-identical to the serial reference; async rounds
+overlap device batches).
 """
 
 import socketserver
@@ -42,7 +45,11 @@ def test_reference_corpus_classification():
         verdicts[p.stem] = headless.classify(load_template_file(p))
     assert verdicts["dvwa-headless-automatic-login"] is None
     assert verdicts["extract-urls"] is None
-    assert verdicts["screenshot"] == "unsupported-action-screenshot"
+    # nothing in the reference screenshot template consumes the
+    # capture, so the step is an honest no-op and the flow executes
+    # (ISSUE 20 — a matcher/extractor over the image would keep the
+    # skip as js-required-screenshot)
+    assert verdicts["screenshot"] is None
     # hook-emulated since round 4 (static load-time instrumentation);
     # prototype-pollution joined in round 5 (real probe navigations +
     # static pollution property model)
@@ -998,3 +1005,121 @@ def test_version_check_minified_and_misattribution(reveal_server):
                    b"\n// consumer script would be inline on the page")
     sc2 = headless.HeadlessScanner([t])
     assert sc2.run([("127.0.0.1", "127.0.0.1", port, False)]) == []
+
+
+# ----------------------------------------------------------------------
+# shared emulation pool (ISSUE 20): pooled rounds bit-identical to the
+# serial reference; async rounds overlap device batches
+# ----------------------------------------------------------------------
+
+NAV_PROBE_TEMPLATE = """\
+id: demo-nav-probe
+info: {name: n, severity: info}
+headless:
+  - steps:
+      - args:
+          url: "{{BaseURL}}/login.php"
+        action: navigate
+      - action: waitload
+      - action: screenshot
+    matchers:
+      - part: resp
+        type: word
+        words: ["user_token"]
+"""
+
+
+def test_screenshot_consumed_keeps_honest_skip():
+    """The no-op admission is scoped: a template whose matcher reads
+    the capture semantically requires a real render and keeps the
+    skip."""
+    t = T(
+        """\
+        id: wants-pixels
+        info: {name: s, severity: info}
+        headless:
+          - steps:
+              - args: {url: "{{BaseURL}}"}
+                action: navigate
+              - action: screenshot
+                name: shot
+            matchers:
+              - part: shot
+                type: word
+                words: ["x"]
+        """
+    )
+    assert headless.classify(t) == "js-required-screenshot"
+
+
+def test_pooled_round_bit_identical_to_serial(dvwa_server):
+    """The shared pool changes WHEN jobs run, never what comes back:
+    same hits, same job order, as the width-0 serial reference."""
+    ts = [T(DVWA_STYLE_TEMPLATE), T(NAV_PROBE_TEMPLATE, path="t/n.yaml")]
+    targets = [("127.0.0.1", "127.0.0.1", dvwa_server, False)] * 3
+    sc = headless.HeadlessScanner(ts)
+    try:
+        headless.configure_headless(0)  # serial reference
+        serial = sc.run(list(targets))
+        headless.configure_headless(4)  # pooled
+        pooled = sc.run(list(targets))
+    finally:
+        headless.configure_headless(None)
+    assert serial == pooled
+    assert sorted(h.template_id for h in serial) == (
+        ["demo-form-login"] * 3 + ["demo-nav-probe"] * 3
+    )
+
+
+def test_async_round_overlaps_device_batches():
+    """Concurrency spy: run_async hands the round to a coordinator +
+    the shared pool, leaving the calling thread free to drive a device
+    batch to completion while emulation is still in flight — and the
+    pool genuinely overlaps jobs (in-flight peak >= 2)."""
+    from swarm_tpu.fingerprints import load_corpus
+    from swarm_tpu.fingerprints.model import Response
+    from swarm_tpu.ops.engine import MatchEngine
+
+    sc = headless.HeadlessScanner([T(NAV_PROBE_TEMPLATE)])
+    targets = [("h%d" % i, "127.0.0.1", 1, False) for i in range(4)]
+
+    release = threading.Event()
+    lock = threading.Lock()
+    state = {"inflight": 0, "peak": 0}
+
+    def fake_exec(template, target):
+        with lock:
+            state["inflight"] += 1
+            state["peak"] = max(state["peak"], state["inflight"])
+        release.wait(30)
+        with lock:
+            state["inflight"] -= 1
+        return headless.HeadlessHit(
+            target[0], target[2], template.id, [], False
+        )
+
+    sc._exec = fake_exec  # instance attr shadows the bound method
+    try:
+        headless.configure_headless(4)
+        fut = sc.run_async(targets)
+        # jobs are parked on `release`, so the round CANNOT finish yet;
+        # this thread meanwhile pushes a real device batch end to end
+        templates, errors = load_corpus("tests/data/templates")
+        assert not errors
+        eng = MatchEngine(templates, mesh=None, batch_rows=8)
+        got = eng.match([Response(
+            host="x", port=80, status=200,
+            body=b"site powered by AcmeCMS, demo-build 3.11",
+            header=b"HTTP/1.1 200 OK",
+        )])
+        assert "demo-tech" in got[0].template_ids
+        assert not fut.done()  # device batch landed mid-round: overlap
+        release.set()
+        hits = fut.result(timeout=30)
+    finally:
+        release.set()
+        headless.configure_headless(None)
+    assert state["peak"] >= 2  # pool ran jobs concurrently
+    # job order preserved through the pooled assembly
+    assert [h.host for h in hits] == ["h0", "h1", "h2", "h3"]
+    assert [h.template_id for h in hits] == ["demo-nav-probe"] * 4

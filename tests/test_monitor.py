@@ -7,6 +7,7 @@ lost diff records."""
 
 import json
 import time
+from pathlib import Path
 
 import pytest
 import requests
@@ -543,4 +544,120 @@ def test_steady_state_epoch_is_zero_dispatch(tmp_path):
         )
         assert e2["scan_status"] == "complete"
     finally:
+        srv.shutdown()
+
+
+# ----------------------------------------------------------------------
+# corpus-delta-triggered out-of-cadence re-evaluation
+# ----------------------------------------------------------------------
+def test_corpus_delta_notify_registry_semantics():
+    """The registry is idempotent and weak, and a broken listener
+    degrades only itself — the notifier (an engine mid-refresh) never
+    sees the error."""
+    from swarm_tpu.monitor import notify
+
+    class Rec:
+        def __init__(self):
+            self.seen = []
+
+        def on_corpus_delta(self, digest):
+            self.seen.append(digest)
+
+    class Boom:
+        def on_corpus_delta(self, digest):
+            raise RuntimeError("bad listener")
+
+    good, bad = Rec(), Boom()
+    notify.register(good)
+    notify.register(good)  # idempotent: one delivery per delta
+    notify.register(bad)
+    try:
+        notify.notify_corpus_delta("d1")
+        assert good.seen == ["d1"]
+    finally:
+        notify.unregister(good)
+        notify.unregister(bad)
+    notify.notify_corpus_delta("d2")
+    assert good.seen == ["d1"]  # unregistered: no further deliveries
+
+
+def test_corpus_delta_fires_one_out_of_cadence_epoch(tmp_path):
+    """``refresh_corpus`` on a live engine reaches the standing
+    registry through monitor/notify: the spec gets a journaled due-now
+    touch, the next NORMAL tick fires one immediate diff epoch, and
+    the fire itself restores the cadence — one delta costs one epoch,
+    not a faster schedule. Paused specs stay parked."""
+    from swarm_tpu.fingerprints import load_corpus
+    from swarm_tpu.ops.engine import MatchEngine
+
+    srv = _make_server(tmp_path)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        assert _register(srv, "m1", ["a\n", "b\n"]).status_code == 200
+        assert _register(srv, "mp", ["c\n"]).status_code == 200
+        assert requests.post(
+            base + "/monitor/mp", json={"op": "pause"},
+            headers=AUTH, timeout=10,
+        ).status_code == 200
+        assert _fire_epoch(srv) == 1
+        now = time.time()
+        assert srv.queue.get_monitor("m1")["next_fire_at"] > now
+        assert srv.monitor.tick(now=now) == 0  # in cadence: nothing due
+        templates, _ = load_corpus(
+            Path(__file__).resolve().parent / "data" / "templates"
+        )
+        engine = MatchEngine(templates, mesh=None)
+        engine.refresh_corpus(templates)  # no-op delta still notifies
+        spec = srv.queue.get_monitor("m1")
+        assert spec["next_fire_at"] == 0.0  # journaled due-now touch
+        assert srv.queue.get_monitor("mp")["paused"] is True
+        # the next normal tick fires the touched spec — and ONLY it
+        assert srv.monitor.tick(now=time.time()) == 1
+        spec = srv.queue.get_monitor("m1")
+        assert spec["epoch"] == 2
+        assert spec["next_fire_at"] > time.time() + 3000  # cadence back
+        _pump(srv, lambda ln: f"v2:{ln}\n")
+        end = time.time() + 20
+        while srv.monitor.drain() == 0 and time.time() < end:
+            time.sleep(0.02)
+        assert mfeed.marked_epochs(srv.queue.blobs, "m1") == [1, 2]
+        assert srv.monitor.tick(now=time.time()) == 0  # one delta, one epoch
+    finally:
+        srv.shutdown()
+
+
+def test_corpus_delta_kill9_between_notify_and_fire(tmp_path):
+    """The due-now touch is journaled BEFORE any fire, so a crash
+    between notify and fire recovers a spec that is merely due: the
+    next server's first tick fires the out-of-cadence epoch once,
+    late, under the normal journal/admission path — no double fire,
+    no lost delta."""
+    srv = _make_server(tmp_path)
+    try:
+        assert _register(srv, "m1", ["a\n", "b\n"]).status_code == 200
+        assert _fire_epoch(srv) == 1
+        assert srv.queue.get_monitor("m1")["next_fire_at"] > time.time()
+        # the delta lands the durable touch; the process dies before
+        # any tick can turn it into a fire
+        assert srv.monitor.on_corpus_delta("deadbeef") == 1
+        assert srv.queue.get_monitor("m1")["next_fire_at"] == 0.0
+    finally:
+        pass  # kill-9: deliberately NO shutdown
+    srv2 = _make_server(tmp_path)
+    try:
+        spec = srv2.queue.get_monitor("m1")
+        assert spec["next_fire_at"] == 0.0 and spec["epoch"] == 1
+        assert not spec["refire"]  # epoch 1's scan DID materialize
+        # first tick fires the touched epoch once...
+        assert srv2.monitor.tick(now=time.time()) == 1
+        _pump(srv2, lambda ln: f"v2:{ln}\n")
+        end = time.time() + 20
+        while srv2.monitor.drain() == 0 and time.time() < end:
+            time.sleep(0.02)
+        assert mfeed.marked_epochs(srv2.queue.blobs, "m1") == [1, 2]
+        # ...and only once: the fire restored the cadence
+        assert srv2.monitor.tick(now=time.time()) == 0
+        assert srv2.queue.get_monitor("m1")["next_fire_at"] > time.time()
+    finally:
+        srv2.shutdown()
         srv.shutdown()

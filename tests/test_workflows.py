@@ -198,3 +198,133 @@ def test_workflow_in_tpu_backend(tmp_path):
     wf = [r for r in records if r.get("workflow") == "demo-workflow"]
     assert wf and wf[0]["matches"] == ["demo-acme-vuln"]
     assert wf[0]["host"] == "10.0.0.1" and wf[0]["port"] == 80
+
+
+# ----------------------------------------------------------------------
+# device gate planes + step-verdict memo (ISSUE 20)
+# ----------------------------------------------------------------------
+
+
+def _acme_rows():
+    """Fresh Response objects per lifetime (engines may normalize rows
+    in place); three distinct contents, two workflow-firing."""
+    return [
+        Response(
+            host="10.0.0.1", port=80, status=200,
+            body=b"<html><body>site powered by AcmeCMS, demo-build 3.11"
+                 b"</body></html>",
+            header=b"HTTP/1.1 200 OK\r\nX-Widget-Version: 4.2",
+        ),
+        Response(
+            host="10.0.0.2", port=80, status=200,
+            body=b"hello world", header=b"HTTP/1.1 200 OK",
+        ),
+        Response(
+            host="10.0.0.3", port=8080, status=200,
+            body=b"<div>site powered by AcmeCMS, demo-build 9.9 dark</div>",
+            header=b"HTTP/1.1 200 OK\r\nX-Widget-Version: 4.2",
+        ),
+    ]
+
+
+def test_device_planes_match_host_twin_on_stress_fleet():
+    """The lowered gate planes and the host-loop reference twin agree
+    per row over the bench's workflow-heavy synthetic fleet (the same
+    oracle `bench.py --phase workflow` rc-gates at scale)."""
+    import bench as bench_mod
+    from swarm_tpu.ops.engine import MatchEngine
+
+    templates = bench_mod.workflow_stress_templates(6)
+    rows = bench_mod.workflow_stress_rows(48, 6)
+    eng = MatchEngine(templates, mesh=None, batch_rows=16)
+    dev = WorkflowRunner(templates, engine=eng, device=True)
+    twin = WorkflowRunner(templates, engine=eng, device=False)
+    assert dev.plan is not None and dev.device
+    assert not twin.device
+    out_d = dev.run(rows)
+    out_t = twin.run(rows)
+    assert out_d == out_t
+    assert any(out_d)  # the fleet actually fires workflows
+
+
+def test_workflow_rescan_zero_dispatch_from_shared_tier():
+    """Acceptance: a steady-state workflow rescan of fleet-known
+    trigger content completes gating entirely from the shared step-memo
+    family ("w") — a second engine LIFETIME (fresh L1, fresh runner)
+    never calls the engine at all, spy-asserted."""
+    from swarm_tpu.cache import ResultCacheClient, SharedResultTier
+    from swarm_tpu.ops.engine import MatchEngine
+    from swarm_tpu.stores import MemoryBlobStore, MemoryStateStore
+
+    templates, errors = load_corpus(DATA / "templates")
+    assert not errors
+    tier = SharedResultTier(MemoryStateStore(), MemoryBlobStore())
+
+    # lifetime 1: fresh fleet — rows dispatch, gating writes back
+    eng1 = MatchEngine(templates, mesh=None, batch_rows=8)
+    eng1.attach_result_cache(ResultCacheClient(tier, worker_id="wa"))
+    r1 = WorkflowRunner(templates, engine=eng1)
+    assert r1._memo_complete  # every reachable template content-pure
+    out1 = r1.run(_acme_rows())
+    assert out1[0] == {"demo-workflow": ["demo-acme-vuln"]}
+
+    # lifetime 2: fresh engine + runner, warm tier — the spy proves
+    # the rescan never reaches the engine (zero device dispatch)
+    cb = ResultCacheClient(tier, worker_id="wb")
+    eng2 = MatchEngine(templates, mesh=None, batch_rows=8)
+    eng2.attach_result_cache(cb)
+    r2 = WorkflowRunner(templates, engine=eng2)
+    calls: list = []
+    orig = eng2.match
+    eng2.match = lambda rows, **kw: (calls.append(len(rows)), orig(rows, **kw))[1]
+    out2 = r2.run(_acme_rows())
+    assert out2 == out1
+    assert calls == []  # ZERO dispatch: every row served by family "w"
+    assert cb.counters()["shared_hits"] >= 3
+
+
+def test_workflow_memo_survives_corpus_delta_epoch():
+    """Monitor-epoch integration: `refresh_corpus` is the corpus-delta
+    fan-out point — registered monitor listeners get the touch that
+    fires the out-of-cadence diff epoch (monitor/notify.py), and when
+    the refreshed corpus is byte-identical the epoch namespace is
+    unchanged, so that epoch's workflow rescan still serves from the
+    step-memo family with zero dispatch."""
+    from swarm_tpu.cache import ResultCacheClient, SharedResultTier
+    from swarm_tpu.monitor import notify as monitor_notify
+    from swarm_tpu.ops.engine import MatchEngine
+    from swarm_tpu.stores import MemoryBlobStore, MemoryStateStore
+
+    templates, errors = load_corpus(DATA / "templates")
+    assert not errors
+    tier = SharedResultTier(MemoryStateStore(), MemoryBlobStore())
+
+    eng1 = MatchEngine(templates, mesh=None, batch_rows=8)
+    eng1.attach_result_cache(ResultCacheClient(tier, worker_id="ma"))
+    out1 = WorkflowRunner(templates, engine=eng1).run(_acme_rows())
+
+    class Rec:
+        def __init__(self):
+            self.seen = []
+
+        def on_corpus_delta(self, digest=None):
+            self.seen.append(digest)
+
+    rec = Rec()
+    monitor_notify.register(rec)
+    try:
+        eng2 = MatchEngine(templates, mesh=None, batch_rows=8)
+        eng2.attach_result_cache(ResultCacheClient(tier, worker_id="mb"))
+        # the corpus delta: same bytes -> same digest -> same epoch;
+        # the monitor fan-out fires regardless (standing specs diff
+        # against the refreshed corpus out of cadence)
+        eng2.refresh_corpus(list(templates))
+        assert len(rec.seen) == 1 and rec.seen[0]
+        r2 = WorkflowRunner(templates, engine=eng2)
+        calls: list = []
+        orig = eng2.match
+        eng2.match = lambda rows, **kw: (calls.append(len(rows)), orig(rows, **kw))[1]
+        assert r2.run(_acme_rows()) == out1
+        assert calls == []
+    finally:
+        monitor_notify.unregister(rec)

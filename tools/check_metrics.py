@@ -134,6 +134,15 @@ REQUIRED_FAMILIES = (
     "swarm_fleet_coldstart_seconds",
     "swarm_worker_drain_total",
     "swarm_worker_drain_seconds",
+    # device workflow gating (docs/WORKFLOWS.md): registered at
+    # telemetry import (workflow_export), memo-tier combos pre-seeded
+    # and the gauges zero-initialized — every family renders samples
+    # even in a process that never built a WorkflowRunner
+    "swarm_workflow_steps_compiled",
+    "swarm_workflow_gate_plane_batches_total",
+    "swarm_workflow_step_memo_hits_total",
+    "swarm_workflow_step_memo_misses_total",
+    "swarm_workflow_host_twin_fallbacks_total",
 )
 
 
